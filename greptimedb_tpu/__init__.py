@@ -1,0 +1,32 @@
+"""greptimedb_tpu — a TPU-native time-series database framework.
+
+A from-scratch re-design of the capabilities of GreptimeDB (the reference
+surveyed in SURVEY.md): SQL + PromQL engines, LSM columnar storage over
+Parquet with WAL durability, region partitioning with a metadata plane, and
+continuous aggregation — with the scan/aggregate/PromQL hot path executed as
+XLA-compiled kernels on TPU via JAX (segment reductions for group-by,
+sort-based merge-dedup, blockwise windowed kernels for time buckets and
+PromQL range vectors, sharded partial aggregation over a jax.sharding.Mesh).
+
+Layer map (mirrors SURVEY.md §1, re-designed TPU-first):
+
+  servers/    wire protocols (HTTP SQL/PromQL, Influx line protocol, ...)
+  query/      SQL logical plan -> jit'd device stages (QueryEngine)
+  sql/        SQL parser (hand-written; reference forked sqlparser-rs)
+  promql/     PromQL parser + compiler onto the same plan algebra
+  catalog/    table catalog over a KvBackend trait (memory impl first)
+  storage/    region engine: memtable, WAL, Parquet SST, manifest, flush
+  ops/        the device kernel library (the differentiator)
+  parallel/   mesh construction, sharded partial aggregation
+  datatypes/  Arrow-backed type system with time-index metadata
+"""
+
+import jax
+
+# Timestamps are int64 nanoseconds end-to-end (reference:
+# src/common/time/src/timestamp.rs); sums over billions of rows need f64
+# accumulators on CPU test paths. TPU kernels down-cast hot-loop field data
+# to f32/bf16 explicitly where profitable.
+jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"

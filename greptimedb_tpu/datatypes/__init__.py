@@ -1,0 +1,25 @@
+"""Type system substrate (mirrors reference src/datatypes, ~16k LoC Rust).
+
+Arrow-backed: every column is a numpy/pyarrow array on the host and a padded
+device array inside kernels. Tags are dictionary-encoded end-to-end — the
+kernel ABI only ever sees int32 codes (SURVEY.md §7 "hard parts" #2).
+"""
+
+from greptimedb_tpu.datatypes.types import (
+    DataType,
+    SemanticType,
+    TimeUnit,
+)
+from greptimedb_tpu.datatypes.schema import ColumnSchema, Schema
+from greptimedb_tpu.datatypes.recordbatch import RecordBatch
+from greptimedb_tpu.datatypes.vector import DictVector
+
+__all__ = [
+    "DataType",
+    "SemanticType",
+    "TimeUnit",
+    "ColumnSchema",
+    "Schema",
+    "RecordBatch",
+    "DictVector",
+]

@@ -1,0 +1,90 @@
+"""Column vectors. Mirrors the reference's Vector hierarchy
+(src/datatypes/src/vectors.rs:88) but collapsed to two host representations:
+
+- plain numpy arrays (numeric/timestamp/bool), nullable via a separate mask
+- `DictVector` for strings/tags: int32 codes + a value table. The device
+  kernel ABI only ever sees the codes (SURVEY.md §7: dictionary-encoded tag
+  ids end-to-end, matching mito2's dictionary-encoded primary keys,
+  reference sst/parquet/format.rs).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import numpy as np
+import pyarrow as pa
+
+
+@dataclass
+class DictVector:
+    """Dictionary-encoded string column: codes[i] indexes values.
+
+    code -1 encodes NULL (pyarrow dictionary nulls round-trip through this).
+    """
+
+    codes: np.ndarray  # int32 [N]
+    values: np.ndarray  # object/str [K]
+
+    def __post_init__(self):
+        self.codes = np.asarray(self.codes, dtype=np.int32)
+        self.values = np.asarray(self.values, dtype=object)
+
+    def __len__(self) -> int:
+        return len(self.codes)
+
+    def decode(self) -> np.ndarray:
+        """Materialize the string values (host-side, edges only)."""
+        out = np.empty(len(self.codes), dtype=object)
+        valid = self.codes >= 0
+        out[valid] = self.values[self.codes[valid]]
+        out[~valid] = None
+        return out
+
+    def take(self, indices: np.ndarray) -> "DictVector":
+        return DictVector(self.codes[indices], self.values)
+
+    @staticmethod
+    def encode(strings: Sequence, values: Optional[np.ndarray] = None) -> "DictVector":
+        """Encode a sequence of strings (None == NULL) against an optional
+        pre-existing dictionary; new values are appended."""
+        arr = np.asarray(strings, dtype=object)
+        table: dict = {}
+        vals: list = []
+        if values is not None:
+            vals = list(values)
+            table = {v: i for i, v in enumerate(vals)}
+        codes = np.empty(len(arr), dtype=np.int32)
+        for i, s in enumerate(arr):
+            if s is None:
+                codes[i] = -1
+                continue
+            code = table.get(s)
+            if code is None:
+                code = len(vals)
+                table[s] = code
+                vals.append(s)
+            codes[i] = code
+        return DictVector(codes, np.asarray(vals, dtype=object))
+
+    @staticmethod
+    def from_arrow(arr: pa.Array) -> "DictVector":
+        arr = arr.combine_chunks() if isinstance(arr, pa.ChunkedArray) else arr
+        if pa.types.is_dictionary(arr.type):
+            codes = arr.indices.to_numpy(zero_copy_only=False)
+            codes = np.where(np.isnan(codes), -1, codes) if codes.dtype.kind == "f" else codes
+            values = np.asarray(arr.dictionary.to_pylist(), dtype=object)
+            return DictVector(codes.astype(np.int32), values)
+        return DictVector.encode(arr.to_pylist())
+
+    def to_arrow(self) -> pa.Array:
+        mask = self.codes < 0
+        codes = pa.array(self.codes, type=pa.int32(), mask=mask)
+        return pa.DictionaryArray.from_arrays(codes, pa.array(list(self.values), type=pa.string()))
+
+    def remap(self, mapping: np.ndarray) -> "DictVector":
+        """Rewrite codes through `mapping` (old code -> new code), used when
+        merging per-SST dictionaries into a region-global dictionary."""
+        new_codes = np.where(self.codes >= 0, mapping[np.clip(self.codes, 0, None)], -1)
+        return DictVector(new_codes.astype(np.int32), self.values)

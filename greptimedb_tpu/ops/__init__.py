@@ -1,0 +1,22 @@
+"""Device kernel library — the TPU-native replacement for the reference's
+CPU hot loops (SURVEY.md §3.2 "hot loops"): DataFusion hash aggregates become
+segment reductions, mito2's MergeReader k-way heap merge becomes sort-based
+dedup, RANGE/PromQL range vectors become blockwise windowed reductions.
+
+Everything here is shape-static, mask-carrying, jit-compatible JAX. Hosts
+pad ragged data into power-of-two blocks (ops/blocks.py) so jit caches stay
+small (SURVEY.md §7 hard part #1).
+"""
+
+from greptimedb_tpu.ops.blocks import pad_rows, block_size_for
+from greptimedb_tpu.ops.segment import segment_agg, combine_group_ids, time_bucket
+from greptimedb_tpu.ops.dedup import sort_dedup
+
+__all__ = [
+    "pad_rows",
+    "block_size_for",
+    "segment_agg",
+    "combine_group_ids",
+    "time_bucket",
+    "sort_dedup",
+]

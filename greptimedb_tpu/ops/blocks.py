@@ -1,0 +1,45 @@
+"""Fixed-shape column blocks.
+
+XLA traces/compiles once per shape; ragged scan output must therefore be
+padded into a small set of block shapes. We bucket row counts to powers of
+two (floor 1024, cap via streaming in the scan layer), so a region scan
+compiles at most ~20 kernel variants regardless of data size. The validity
+mask rides alongside the data; kernels never compact (dynamic shapes) —
+they mask.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import numpy as np
+
+MIN_BLOCK_ROWS = 1024
+# Default streaming block: 2^22 rows keeps an f32 field column at 16 MiB —
+# large enough to saturate the MXU/VPU, small enough to double-buffer in HBM.
+DEFAULT_BLOCK_ROWS = 1 << 22
+
+
+def block_size_for(n: int, min_rows: int = MIN_BLOCK_ROWS) -> int:
+    """Smallest power-of-two block that fits n rows."""
+    if n <= min_rows:
+        return min_rows
+    return 1 << math.ceil(math.log2(n))
+
+
+def pad_rows(arr: np.ndarray, size: int, fill=0) -> np.ndarray:
+    """Pad axis 0 of `arr` to `size` with `fill`."""
+    n = arr.shape[0]
+    if n == size:
+        return arr
+    assert n < size, (n, size)
+    pad_width = [(0, size - n)] + [(0, 0)] * (arr.ndim - 1)
+    return np.pad(arr, pad_width, constant_values=fill)
+
+
+def make_mask(n: int, size: int) -> np.ndarray:
+    """Validity mask for a block holding n real rows padded to size."""
+    mask = np.zeros(size, dtype=bool)
+    mask[:n] = True
+    return mask

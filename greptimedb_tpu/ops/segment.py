@@ -1,0 +1,209 @@
+"""Segment reductions: the TPU-native group-by.
+
+The reference's group-by is DataFusion's row-hash aggregate, the CPU
+bottleneck of the TSBS double-groupby queries (SURVEY.md §6). On TPU,
+group-by over dictionary-encoded tags + time buckets is a *segment
+reduction*: group ids are computed arithmetically (no hashing — tag codes
+and bucket indices are already dense ints), then reduced with
+scatter-adds/mins/maxes that XLA lowers natively.
+
+All kernels are mask-carrying: padding rows and filtered-out rows simply
+contribute identity elements. NaN field values (NULLs) are treated as
+SQL semantics: excluded from sum/count/min/max/avg.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+# Aggregate ops supported by the kernel. "first"/"last" are by time order
+# within the segment (used by lastpoint / PromQL instant selection).
+AGG_OPS = ("sum", "count", "min", "max", "mean", "first", "last")
+
+
+def time_bucket(ts: jax.Array, interval: int, origin: int = 0) -> jax.Array:
+    """Floor-align int64 timestamps into buckets of `interval` (same unit).
+
+    Mirrors date_bin / RANGE align (reference query/src/range_select/plan.rs:413)
+    and PromQL step alignment. Floor division matches SQL date_bin semantics
+    for timestamps before the origin as well.
+    """
+    return (ts - origin) // interval
+
+
+def combine_group_ids(
+    keys: Sequence[jax.Array],
+    sizes: Sequence[int],
+) -> jax.Array:
+    """Fuse several dense int keys (tag codes, bucket indices) into one
+    dense group id: id = ((k0 * s1 + k1) * s2 + k2) ... Row-major, so sort
+    order of the combined id equals lexicographic order of the keys.
+    """
+    assert len(keys) == len(sizes) and keys
+    gid = keys[0].astype(jnp.int32)
+    for k, s in zip(keys[1:], sizes[1:]):
+        gid = gid * jnp.int32(s) + k.astype(jnp.int32)
+    return gid
+
+
+def _masked(values: jax.Array, mask: jax.Array, fill) -> jax.Array:
+    return jnp.where(mask, values, jnp.asarray(fill, dtype=values.dtype))
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_segments", "ops", "indices_are_sorted"),
+)
+def segment_agg(
+    values: jax.Array,  # [N] or [N, F] field values (float)
+    seg_ids: jax.Array,  # [N] int32 dense group ids
+    mask: jax.Array,  # [N] bool validity (padding & filter)
+    num_segments: int,
+    ops: tuple[str, ...] = ("sum", "count"),
+    ts: Optional[jax.Array] = None,  # [N] int64, required for first/last
+    indices_are_sorted: bool = False,
+) -> dict[str, jax.Array]:
+    """Masked segment reduction. Returns {op: [G] or [G, F]} arrays.
+
+    NULL handling: NaN values are excluded per-element (SQL aggregate
+    semantics); `mask` excludes whole rows (padding / WHERE / dedup).
+    """
+    squeeze = values.ndim == 1
+    if squeeze:
+        values = values[:, None]
+    n, f = values.shape
+    row_mask = mask
+    # element validity: row valid and value not NaN
+    if jnp.issubdtype(values.dtype, jnp.floating):
+        elem_mask = row_mask[:, None] & ~jnp.isnan(values)
+    else:
+        elem_mask = jnp.broadcast_to(row_mask[:, None], values.shape)
+    # invalid rows scatter into a dead segment G (we allocate G+1 and drop it)
+    ids = jnp.where(row_mask, seg_ids, jnp.int32(num_segments))
+    gsz = num_segments + 1
+
+    seg_sum = functools.partial(
+        jax.ops.segment_sum,
+        segment_ids=ids,
+        num_segments=gsz,
+        indices_are_sorted=indices_are_sorted,
+    )
+
+    out: dict[str, jax.Array] = {}
+    need_sum = any(o in ops for o in ("sum", "mean"))
+    need_count = any(o in ops for o in ("count", "mean"))
+    sums = counts = None
+    if need_sum:
+        sums = seg_sum(jnp.where(elem_mask, values, 0).astype(values.dtype))
+    if need_count:
+        counts = seg_sum(elem_mask.astype(jnp.int64))
+    if "sum" in ops:
+        out["sum"] = sums
+    if "count" in ops:
+        out["count"] = counts
+    if "mean" in ops:
+        denom = jnp.maximum(counts, 1).astype(values.dtype)
+        mean = sums / denom
+        out["mean"] = jnp.where(counts > 0, mean, jnp.nan)
+    if "min" in ops:
+        big = _type_max(values.dtype)
+        mins = jax.ops.segment_min(
+            jnp.where(elem_mask, values, big),
+            ids, num_segments=gsz, indices_are_sorted=indices_are_sorted,
+        )
+        out["min"] = jnp.where(mins == big, _null_of(values.dtype), mins)
+    if "max" in ops:
+        small = _type_min(values.dtype)
+        maxs = jax.ops.segment_max(
+            jnp.where(elem_mask, values, small),
+            ids, num_segments=gsz, indices_are_sorted=indices_are_sorted,
+        )
+        out["max"] = jnp.where(maxs == small, _null_of(values.dtype), maxs)
+    if "first" in ops or "last" in ops:
+        assert ts is not None, "first/last need the time column"
+        # argmin/argmax of ts per segment: reduce packed (ts, row index).
+        # ts fits int64; break ties by row index using a second reduction.
+        idx = jnp.arange(n, dtype=jnp.int64)
+        if "last" in ops:
+            best_ts = jax.ops.segment_max(
+                jnp.where(row_mask, ts, jnp.iinfo(jnp.int64).min),
+                ids, num_segments=gsz, indices_are_sorted=indices_are_sorted,
+            )
+            at_best = row_mask & (ts == best_ts[ids])
+            best_idx = jax.ops.segment_max(
+                jnp.where(at_best, idx, -1), ids, num_segments=gsz,
+                indices_are_sorted=indices_are_sorted,
+            )
+            safe = jnp.clip(best_idx, 0, n - 1)
+            vals = values[safe]
+            out["last"] = jnp.where(best_idx[:, None] >= 0, vals, _null_of(values.dtype))
+            out["last_ts"] = best_ts
+        if "first" in ops:
+            best_ts = jax.ops.segment_min(
+                jnp.where(row_mask, ts, jnp.iinfo(jnp.int64).max),
+                ids, num_segments=gsz, indices_are_sorted=indices_are_sorted,
+            )
+            at_best = row_mask & (ts == best_ts[ids])
+            best_idx = jax.ops.segment_max(
+                jnp.where(at_best, idx, -1), ids, num_segments=gsz,
+                indices_are_sorted=indices_are_sorted,
+            )
+            safe = jnp.clip(best_idx, 0, n - 1)
+            vals = values[safe]
+            out["first"] = jnp.where(best_idx[:, None] >= 0, vals, _null_of(values.dtype))
+            out["first_ts"] = best_ts
+
+    # drop the dead padding segment; restore caller's rank
+    trimmed = {}
+    for k, v in out.items():
+        v = v[:num_segments]
+        if squeeze and v.ndim == 2:
+            v = v[:, 0]
+        trimmed[k] = v
+    return trimmed
+
+
+def combine_partial_aggs(
+    partials: dict[str, jax.Array], axis_name: str
+) -> dict[str, jax.Array]:
+    """Merge per-shard partial aggregates across a mesh axis with XLA
+    collectives — the TPU-native MergeScan (reference
+    query/src/dist_plan/merge_scan.rs:122 gathers region streams over
+    Flight; here partial sums/counts ride ICI via psum).
+    """
+    out = {}
+    for op, v in partials.items():
+        if op in ("sum", "count"):
+            out[op] = jax.lax.psum(v, axis_name)
+        elif op == "min":
+            out[op] = jax.lax.pmin(_nan_to(v, _type_max(v.dtype)), axis_name)
+        elif op == "max":
+            out[op] = jax.lax.pmax(_nan_to(v, _type_min(v.dtype)), axis_name)
+        else:
+            raise ValueError(f"non-commutative partial agg: {op}")
+    if "sum" in out and "count" in out:
+        denom = jnp.maximum(out["count"], 1).astype(out["sum"].dtype)
+        out["mean"] = jnp.where(out["count"] > 0, out["sum"] / denom, jnp.nan)
+    return out
+
+
+def _nan_to(v, fill):
+    if jnp.issubdtype(v.dtype, jnp.floating):
+        return jnp.where(jnp.isnan(v), jnp.asarray(fill, v.dtype), v)
+    return v
+
+
+def _type_max(dt):
+    return jnp.inf if jnp.issubdtype(dt, jnp.floating) else jnp.iinfo(dt).max
+
+
+def _type_min(dt):
+    return -jnp.inf if jnp.issubdtype(dt, jnp.floating) else jnp.iinfo(dt).min
+
+
+def _null_of(dt):
+    return jnp.nan if jnp.issubdtype(dt, jnp.floating) else jnp.asarray(0, dt)

@@ -1,0 +1,21 @@
+"""Test harness: force an 8-device virtual CPU mesh before jax initializes.
+
+Mirrors the reference's test strategy (SURVEY.md §4): every distributed
+component runs single-process against in-memory fakes; multi-chip sharding
+is validated on virtual devices.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

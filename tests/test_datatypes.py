@@ -1,0 +1,119 @@
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from greptimedb_tpu.datatypes import (
+    ColumnSchema,
+    DataType,
+    DictVector,
+    RecordBatch,
+    Schema,
+    SemanticType,
+)
+from greptimedb_tpu.datatypes.types import parse_sql_type
+
+
+def make_cpu_schema():
+    return Schema(
+        [
+            ColumnSchema("ts", DataType.TIMESTAMP_MILLISECOND, SemanticType.TIMESTAMP),
+            ColumnSchema("hostname", DataType.STRING, SemanticType.TAG),
+            ColumnSchema("usage_user", DataType.FLOAT64),
+            ColumnSchema("usage_system", DataType.FLOAT64),
+        ]
+    )
+
+
+def test_schema_canonical_order():
+    s = make_cpu_schema()
+    # tags, time index, fields — the storage sort-key order
+    assert s.names == ["hostname", "ts", "usage_user", "usage_system"]
+    assert s.time_index.name == "ts"
+    assert [c.name for c in s.tag_columns] == ["hostname"]
+    assert [c.name for c in s.field_columns] == ["usage_user", "usage_system"]
+
+
+def test_schema_requires_single_time_index():
+    with pytest.raises(ValueError):
+        Schema([ColumnSchema("x", DataType.FLOAT64)])
+    with pytest.raises(ValueError):
+        Schema(
+            [
+                ColumnSchema("a", DataType.TIMESTAMP_SECOND, SemanticType.TIMESTAMP),
+                ColumnSchema("b", DataType.TIMESTAMP_SECOND, SemanticType.TIMESTAMP),
+            ]
+        )
+
+
+def test_schema_arrow_roundtrip():
+    s = make_cpu_schema()
+    s2 = Schema.from_arrow(s.to_arrow())
+    assert s2 == s
+
+
+def test_schema_dict_roundtrip():
+    s = make_cpu_schema()
+    assert Schema.from_dict(s.to_dict()) == s
+
+
+def test_dict_vector_encode_decode():
+    v = DictVector.encode(["a", "b", None, "a", "c"])
+    assert v.codes.tolist() == [0, 1, -1, 0, 2]
+    assert v.decode().tolist() == ["a", "b", None, "a", "c"]
+
+
+def test_dict_vector_arrow_roundtrip():
+    v = DictVector.encode(["x", None, "y", "x"])
+    arr = v.to_arrow()
+    v2 = DictVector.from_arrow(arr)
+    assert v2.decode().tolist() == ["x", None, "y", "x"]
+
+
+def test_recordbatch_arrow_roundtrip():
+    s = make_cpu_schema()
+    rb = RecordBatch(
+        s,
+        {
+            "ts": np.array([1000, 2000, 3000], dtype=np.int64),
+            "hostname": DictVector.encode(["h0", "h1", "h0"]),
+            "usage_user": np.array([1.0, 2.0, 3.0]),
+            "usage_system": np.array([0.5, np.nan, 1.5]),
+        },
+    )
+    arrow = rb.to_arrow()
+    assert arrow.num_rows == 3
+    rb2 = RecordBatch.from_arrow(arrow, s)
+    assert rb2.columns["ts"].tolist() == [1000, 2000, 3000]
+    assert rb2.columns["hostname"].decode().tolist() == ["h0", "h1", "h0"]
+    np.testing.assert_allclose(rb2.columns["usage_user"], [1.0, 2.0, 3.0])
+
+
+def test_recordbatch_concat_merges_dicts():
+    s = make_cpu_schema()
+
+    def mk(hosts, ts0):
+        n = len(hosts)
+        return RecordBatch(
+            s,
+            {
+                "ts": np.arange(ts0, ts0 + n, dtype=np.int64),
+                "hostname": DictVector.encode(hosts),
+                "usage_user": np.ones(n),
+                "usage_system": np.zeros(n),
+            },
+        )
+
+    merged = RecordBatch.concat([mk(["a", "b"], 0), mk(["c", "a"], 10)])
+    assert merged.num_rows == 4
+    assert merged.columns["hostname"].decode().tolist() == ["a", "b", "c", "a"]
+    # codes must index a single merged dictionary
+    assert merged.columns["hostname"].codes.tolist() == [0, 1, 2, 0]
+
+
+def test_parse_sql_type():
+    assert parse_sql_type("DOUBLE") == DataType.FLOAT64
+    assert parse_sql_type("BIGINT") == DataType.INT64
+    assert parse_sql_type("TIMESTAMP(3)") == DataType.TIMESTAMP_MILLISECOND
+    assert parse_sql_type("String") == DataType.STRING
+    with pytest.raises(ValueError):
+        parse_sql_type("geometry")

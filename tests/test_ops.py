@@ -1,0 +1,164 @@
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from greptimedb_tpu.ops import (
+    block_size_for,
+    combine_group_ids,
+    pad_rows,
+    segment_agg,
+    sort_dedup,
+    time_bucket,
+)
+from greptimedb_tpu.ops.blocks import make_mask
+from greptimedb_tpu.ops.dedup import OP_DELETE, OP_PUT
+
+
+def test_block_sizing():
+    assert block_size_for(10) == 1024
+    assert block_size_for(1024) == 1024
+    assert block_size_for(1025) == 2048
+    assert block_size_for(10**6) == 1 << 20
+
+
+def test_pad_and_mask():
+    a = np.arange(5, dtype=np.float64)
+    p = pad_rows(a, 8, fill=-1)
+    assert p.tolist() == [0, 1, 2, 3, 4, -1, -1, -1]
+    m = make_mask(5, 8)
+    assert m.sum() == 5 and m[:5].all()
+
+
+def test_time_bucket():
+    ts = jnp.array([0, 999, 1000, 1500, 2999, -1], dtype=jnp.int64)
+    b = time_bucket(ts, 1000)
+    # floor semantics for negatives (matches date_bin)
+    assert np.asarray(b).tolist() == [0, 0, 1, 1, 2, -1]
+
+
+def test_combine_group_ids_row_major():
+    host = jnp.array([0, 0, 1, 1], dtype=jnp.int32)
+    bucket = jnp.array([0, 1, 0, 1], dtype=jnp.int32)
+    gid = combine_group_ids([host, bucket], [2, 2])
+    assert np.asarray(gid).tolist() == [0, 1, 2, 3]
+
+
+class TestSegmentAgg:
+    def test_basic_sum_count_mean(self, rng):
+        n, g = 1000, 7
+        ids = rng.integers(0, g, n).astype(np.int32)
+        vals = rng.normal(size=n)
+        out = segment_agg(jnp.asarray(vals), jnp.asarray(ids), jnp.ones(n, bool), g,
+                          ops=("sum", "count", "mean", "min", "max"))
+        for k in range(g):
+            sel = vals[ids == k]
+            np.testing.assert_allclose(out["sum"][k], sel.sum(), rtol=1e-12)
+            assert int(out["count"][k]) == len(sel)
+            np.testing.assert_allclose(out["mean"][k], sel.mean(), rtol=1e-12)
+            np.testing.assert_allclose(out["min"][k], sel.min())
+            np.testing.assert_allclose(out["max"][k], sel.max())
+
+    def test_mask_and_padding(self, rng):
+        vals = np.array([1.0, 2.0, 4.0, 8.0, 99.0, 99.0])
+        ids = np.array([0, 0, 1, 1, 0, 1], dtype=np.int32)
+        mask = np.array([True, True, True, True, False, False])
+        out = segment_agg(jnp.asarray(vals), jnp.asarray(ids), jnp.asarray(mask), 2,
+                          ops=("sum", "count"))
+        assert np.asarray(out["sum"]).tolist() == [3.0, 12.0]
+        assert np.asarray(out["count"]).tolist() == [2, 2]
+
+    def test_nan_is_sql_null(self):
+        vals = jnp.array([1.0, np.nan, 3.0, np.nan])
+        ids = jnp.array([0, 0, 1, 1], dtype=jnp.int32)
+        out = segment_agg(vals, ids, jnp.ones(4, bool), 2,
+                          ops=("sum", "count", "mean", "min", "max"))
+        assert np.asarray(out["count"]).tolist() == [1, 1]
+        assert np.asarray(out["sum"]).tolist() == [1.0, 3.0]
+        assert np.asarray(out["mean"]).tolist() == [1.0, 3.0]
+        assert np.asarray(out["min"]).tolist() == [1.0, 3.0]
+
+    def test_empty_group_yields_null(self):
+        vals = jnp.array([5.0])
+        ids = jnp.array([0], dtype=jnp.int32)
+        out = segment_agg(vals, ids, jnp.ones(1, bool), 3,
+                          ops=("sum", "count", "mean", "min", "max"))
+        assert int(out["count"][1]) == 0
+        assert np.isnan(out["mean"][1])
+        assert np.isnan(out["min"][2])
+
+    def test_multi_field(self, rng):
+        n, g, f = 512, 4, 10
+        ids = rng.integers(0, g, n).astype(np.int32)
+        vals = rng.normal(size=(n, f))
+        out = segment_agg(jnp.asarray(vals), jnp.asarray(ids), jnp.ones(n, bool), g,
+                          ops=("mean",))
+        assert out["mean"].shape == (g, f)
+        for k in range(g):
+            np.testing.assert_allclose(out["mean"][k], vals[ids == k].mean(axis=0),
+                                       rtol=1e-12)
+
+    def test_first_last(self):
+        # series 0: (ts=10,v=1), (ts=30,v=3); series 1: (ts=20,v=2)
+        vals = jnp.array([3.0, 1.0, 2.0])
+        ts = jnp.array([30, 10, 20], dtype=jnp.int64)
+        ids = jnp.array([0, 0, 1], dtype=jnp.int32)
+        out = segment_agg(vals, ids, jnp.ones(3, bool), 2, ops=("first", "last"), ts=ts)
+        assert np.asarray(out["last"]).tolist() == [3.0, 2.0]
+        assert np.asarray(out["first"]).tolist() == [1.0, 2.0]
+        assert np.asarray(out["last_ts"]).tolist() == [30, 20]
+
+
+class TestSortDedup:
+    def test_last_write_wins(self):
+        # two writes to (series 0, ts 100): seq 1 then seq 2 -> keep value of seq 2
+        sid = jnp.array([0, 0, 1], dtype=jnp.int32)
+        ts = jnp.array([100, 100, 100], dtype=jnp.int64)
+        seq = jnp.array([1, 2, 1], dtype=jnp.int64)
+        op = jnp.zeros(3, dtype=jnp.int8)
+        order, keep = sort_dedup(sid, ts, seq, op, jnp.ones(3, bool))
+        order, keep = np.asarray(order), np.asarray(keep)
+        kept_rows = order[keep]
+        assert len(kept_rows) == 2
+        assert set(kept_rows.tolist()) == {1, 2}  # row 1 is the seq=2 write
+
+    def test_delete_tombstone(self):
+        sid = jnp.array([0, 0], dtype=jnp.int32)
+        ts = jnp.array([100, 100], dtype=jnp.int64)
+        seq = jnp.array([1, 2], dtype=jnp.int64)
+        op = jnp.array([OP_PUT, OP_DELETE], dtype=jnp.int8)
+        order, keep = sort_dedup(sid, ts, seq, op, jnp.ones(2, bool))
+        assert np.asarray(keep).sum() == 0  # tombstone wins, row gone
+
+    def test_padding_pushed_to_end(self):
+        sid = jnp.array([1, 0, 7], dtype=jnp.int32)
+        ts = jnp.array([5, 9, 0], dtype=jnp.int64)
+        seq = jnp.array([1, 2, 3], dtype=jnp.int64)
+        op = jnp.zeros(3, dtype=jnp.int8)
+        mask = jnp.array([True, True, False])
+        order, keep = sort_dedup(sid, ts, seq, op, mask)
+        order, keep = np.asarray(order), np.asarray(keep)
+        assert not keep[2]
+        assert order[:2].tolist() == [1, 0]  # sorted by (series, ts)
+
+    def test_sorted_output_ordering(self, rng):
+        n = 500
+        sid = rng.integers(0, 20, n).astype(np.int32)
+        ts = rng.integers(0, 1000, n).astype(np.int64)
+        seq = np.arange(n, dtype=np.int64)
+        op = np.zeros(n, dtype=np.int8)
+        order, keep = sort_dedup(
+            jnp.asarray(sid), jnp.asarray(ts), jnp.asarray(seq),
+            jnp.asarray(op), jnp.ones(n, bool))
+        order, keep = np.asarray(order), np.asarray(keep)
+        s2, t2 = sid[order], ts[order]
+        assert np.all((s2[:-1] < s2[1:]) | ((s2[:-1] == s2[1:]) & (t2[:-1] <= t2[1:])))
+        # survivors: exactly the distinct (series, ts) pairs
+        assert keep.sum() == len({(a, b) for a, b in zip(sid, ts)})
+        # each survivor carries the max seq of its run
+        kept = order[keep]
+        best = {}
+        for i in range(n):
+            key = (sid[i], ts[i])
+            if key not in best or seq[i] > seq[best[key]]:
+                best[key] = i
+        assert set(kept.tolist()) == set(best.values())
