@@ -1,0 +1,20 @@
+"""Storage engine (mirrors the reference's mito2 LSM engine, SURVEY.md §2.3),
+re-designed TPU-first:
+
+- The memtable is an *append log* with dictionary-encoded tags — no BTreeMap
+  of encoded keys (reference memtable/time_series.rs:82). Sorting and
+  last-write-wins dedup are deferred to the device sort-dedup kernel at scan
+  and flush time (ops/dedup.py), which replaces the MergeReader heap.
+- SSTs are Parquet with dictionary tag columns + ts + seq + op_type + fields,
+  sorted by (tags..., ts, seq), with row-group min/max pruning — the same
+  on-disk contract as the reference (sst/parquet/writer.rs:41-87) minus the
+  memcomparable key blob: the TPU kernel ABI wants per-tag code columns.
+- WAL is a CRC-framed Arrow-IPC log with batch append and replay.
+- The manifest is a JSON action log with periodic checkpoints
+  (reference manifest/manager.rs:40-42).
+"""
+
+from greptimedb_tpu.storage.engine import RegionEngine, RegionRequest
+from greptimedb_tpu.storage.region import Region, ScanData
+
+__all__ = ["RegionEngine", "RegionRequest", "Region", "ScanData"]

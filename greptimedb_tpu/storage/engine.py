@@ -1,0 +1,150 @@
+"""RegionEngine: the storage engine's public contract.
+
+Mirrors the reference's `store-api::RegionEngine` trait
+(src/store-api/src/region_engine.rs:179-224: handle_request, handle_query)
+and `MitoEngine` (mito2/src/engine.rs:83). The reference shards requests to
+an actor worker pool (worker.rs:110); here writes are synchronous host work
+(dict-encode + append) — cheap enough that the worker pool buys nothing in
+a Python host tier — while all heavy lifting (dedup/aggregate) runs on
+device at query time.
+"""
+
+from __future__ import annotations
+
+import enum
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+from greptimedb_tpu.datatypes.recordbatch import RecordBatch
+from greptimedb_tpu.datatypes.schema import Schema
+from greptimedb_tpu.storage.region import OP_DELETE, OP_PUT, Region, ScanData
+from greptimedb_tpu.storage.wal import Wal
+
+
+class RequestType(enum.Enum):
+    PUT = "put"
+    DELETE = "delete"
+    CREATE = "create"
+    OPEN = "open"
+    CLOSE = "close"
+    DROP = "drop"
+    FLUSH = "flush"
+    COMPACT = "compact"
+    TRUNCATE = "truncate"
+
+
+@dataclass
+class RegionRequest:
+    """Analog of store-api RegionRequest (region_request.rs)."""
+
+    kind: RequestType
+    region_id: int
+    batch: Optional[RecordBatch] = None
+    schema: Optional[Schema] = None
+
+
+@dataclass
+class EngineConfig:
+    data_dir: str
+    wal_sync: bool = False
+    # auto-flush when a memtable exceeds this many bytes (reference
+    # WriteBufferManager global budget, flush.rs:83-135)
+    flush_threshold_bytes: int = 256 << 20
+
+
+class RegionEngine:
+    def __init__(self, config: EngineConfig):
+        self.config = config
+        os.makedirs(config.data_dir, exist_ok=True)
+        self.wal = Wal(os.path.join(config.data_dir, "wal"), sync=config.wal_sync)
+        self.regions: dict[int, Region] = {}
+        self._lock = threading.RLock()
+
+    def _region_dir(self, region_id: int) -> str:
+        return os.path.join(self.config.data_dir, f"region_{region_id}")
+
+    def region(self, region_id: int) -> Region:
+        r = self.regions.get(region_id)
+        if r is None:
+            raise KeyError(f"region {region_id} not open")
+        return r
+
+    # ---- handle_request (reference region_server.rs:120) -------------------
+
+    def handle_request(self, req: RegionRequest) -> int:
+        with self._lock:
+            if req.kind is RequestType.CREATE:
+                assert req.schema is not None
+                if req.region_id in self.regions:
+                    return 0
+                self.regions[req.region_id] = Region.create(
+                    req.region_id, self._region_dir(req.region_id), req.schema, self.wal
+                )
+                return 0
+            if req.kind is RequestType.OPEN:
+                if req.region_id not in self.regions:
+                    self.regions[req.region_id] = Region.open(
+                        req.region_id, self._region_dir(req.region_id), self.wal
+                    )
+                return 0
+            if req.kind is RequestType.CLOSE:
+                self.regions.pop(req.region_id, None)
+                self.wal.close_region(req.region_id)
+                return 0
+            if req.kind is RequestType.DROP:
+                r = self.regions.pop(req.region_id, None)
+                if r is not None:
+                    r.drop()
+                return 0
+            if req.kind is RequestType.FLUSH:
+                self.region(req.region_id).flush()
+                return 0
+            if req.kind is RequestType.COMPACT:
+                self.region(req.region_id).compact()
+                return 0
+
+            region = self.region(req.region_id)
+            if req.kind is RequestType.PUT:
+                n = region.write(req.batch, OP_PUT)
+            elif req.kind is RequestType.DELETE:
+                n = region.write(req.batch, OP_DELETE)
+            else:
+                raise ValueError(f"unhandled request {req.kind}")
+            if region.memtable_bytes >= self.config.flush_threshold_bytes:
+                region.flush()
+            return n
+
+    # ---- convenience wrappers ----------------------------------------------
+
+    def create_region(self, region_id: int, schema: Schema) -> None:
+        self.handle_request(RegionRequest(RequestType.CREATE, region_id, schema=schema))
+
+    def open_region(self, region_id: int) -> None:
+        self.handle_request(RegionRequest(RequestType.OPEN, region_id))
+
+    def put(self, region_id: int, batch: RecordBatch) -> int:
+        return self.handle_request(RegionRequest(RequestType.PUT, region_id, batch=batch))
+
+    def delete(self, region_id: int, batch: RecordBatch) -> int:
+        return self.handle_request(RegionRequest(RequestType.DELETE, region_id, batch=batch))
+
+    def flush(self, region_id: int) -> None:
+        self.handle_request(RegionRequest(RequestType.FLUSH, region_id))
+
+    def compact(self, region_id: int) -> None:
+        self.handle_request(RegionRequest(RequestType.COMPACT, region_id))
+
+    # ---- handle_query (reference region_engine.rs:191) ---------------------
+
+    def scan(
+        self,
+        region_id: int,
+        ts_range: Optional[tuple[int, int]] = None,
+        projection: Optional[Sequence[str]] = None,
+    ) -> Optional[ScanData]:
+        return self.region(region_id).scan(ts_range, projection)
+
+    def close(self) -> None:
+        self.wal.close()

@@ -1,0 +1,151 @@
+"""Region manifest: a JSON action log with periodic checkpoints.
+
+Mirrors the reference's manifest manager (mito2/src/manifest/manager.rs:40-42,
+action.rs): every mutation of the region's file set / metadata is an action
+appended as `<version>.json`; every `checkpoint_distance` actions a full
+`RegionCheckpoint` is written and older deltas are pruned. Region open
+replays checkpoint + deltas (region/opener.rs:62-117), then the WAL.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Optional
+
+from greptimedb_tpu.datatypes.schema import Schema
+from greptimedb_tpu.storage.sst import FileMeta
+
+CHECKPOINT_DISTANCE = 10
+_DELTA_RE = re.compile(r"^(\d{10})\.json$")
+
+
+@dataclass
+class RegionManifestState:
+    """Replayed manifest state."""
+
+    schema: Optional[Schema] = None
+    files: dict[str, FileMeta] = field(default_factory=dict)
+    flushed_seq: int = 0  # WAL entries below this are obsolete
+    manifest_version: int = 0
+    tag_dicts: dict[str, list] = field(default_factory=dict)
+
+    def apply(self, action: dict) -> None:
+        kind = action["kind"]
+        if kind == "change":
+            self.schema = Schema.from_dict(action["schema"])
+        elif kind == "edit":
+            for f in action.get("files_to_add", []):
+                fm = FileMeta.from_dict(f)
+                self.files[fm.file_id] = fm
+            for fid in action.get("files_to_remove", []):
+                self.files.pop(fid, None)
+            if action.get("flushed_seq") is not None:
+                self.flushed_seq = max(self.flushed_seq, action["flushed_seq"])
+            if action.get("tag_dicts") is not None:
+                self.tag_dicts = action["tag_dicts"]
+        elif kind == "truncate":
+            self.files.clear()
+            self.flushed_seq = max(self.flushed_seq, action.get("truncated_seq", self.flushed_seq))
+        elif kind == "checkpoint":
+            self.schema = Schema.from_dict(action["schema"]) if action.get("schema") else None
+            self.files = {f["file_id"]: FileMeta.from_dict(f) for f in action["files"]}
+            self.flushed_seq = action["flushed_seq"]
+            self.tag_dicts = action.get("tag_dicts", {})
+        else:
+            raise ValueError(f"unknown manifest action {kind!r}")
+
+
+class ManifestManager:
+    def __init__(self, manifest_dir: str):
+        self.dir = manifest_dir
+        os.makedirs(manifest_dir, exist_ok=True)
+        self.state = RegionManifestState()
+        self._replay()
+
+    # ---- replay ------------------------------------------------------------
+
+    def _versions(self) -> list[int]:
+        out = []
+        for name in os.listdir(self.dir):
+            m = _DELTA_RE.match(name)
+            if m:
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def _replay(self) -> None:
+        for v in self._versions():
+            with open(self._path(v)) as f:
+                action = json.load(f)
+            self.state.apply(action)
+            self.state.manifest_version = v
+
+    def _path(self, version: int) -> str:
+        return os.path.join(self.dir, f"{version:010d}.json")
+
+    # ---- append ------------------------------------------------------------
+
+    def append(self, action: dict) -> None:
+        v = self.state.manifest_version + 1
+        tmp = self._path(v) + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(action, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._path(v))
+        self.state.apply(action)
+        self.state.manifest_version = v
+        if v % CHECKPOINT_DISTANCE == 0:
+            self._checkpoint()
+
+    def _checkpoint(self) -> None:
+        st = self.state
+        action = {
+            "kind": "checkpoint",
+            "schema": st.schema.to_dict() if st.schema else None,
+            "files": [f.to_dict() for f in st.files.values()],
+            "flushed_seq": st.flushed_seq,
+            "tag_dicts": st.tag_dicts,
+        }
+        v = st.manifest_version + 1
+        tmp = self._path(v) + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(action, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._path(v))
+        st.manifest_version = v
+        # prune deltas older than the checkpoint
+        for old in self._versions():
+            if old < v:
+                try:
+                    os.remove(self._path(old))
+                except FileNotFoundError:
+                    pass
+
+    # ---- convenience -------------------------------------------------------
+
+    def record_schema(self, schema: Schema) -> None:
+        self.append({"kind": "change", "schema": schema.to_dict()})
+
+    def record_flush(
+        self,
+        added: list[FileMeta],
+        flushed_seq: int,
+        tag_dicts: dict[str, list],
+        removed: Optional[list[str]] = None,
+    ) -> None:
+        self.append(
+            {
+                "kind": "edit",
+                "files_to_add": [f.to_dict() for f in added],
+                "files_to_remove": removed or [],
+                "flushed_seq": flushed_seq,
+                "tag_dicts": tag_dicts,
+            }
+        )
+
+    def record_truncate(self, truncated_seq: int) -> None:
+        self.append({"kind": "truncate", "truncated_seq": truncated_seq})

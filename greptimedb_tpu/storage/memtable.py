@@ -1,0 +1,132 @@
+"""Append-log columnar memtable.
+
+TPU-first re-design of the reference's `TimeSeriesMemtable`
+(mito2/src/memtable/time_series.rs:82, BTreeMap of memcomparable keys →
+per-series buffers): here the memtable is an *unsorted append log* of
+column chunks with tags dictionary-encoded against the region's tag
+registry. There is no per-write tree maintenance — ordering and
+last-write-wins dedup happen in the device sort-dedup kernel at scan/flush
+time (ops/dedup.py), which is both cheaper on ingest and exactly the shape
+the TPU wants.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from greptimedb_tpu.datatypes.recordbatch import RecordBatch
+from greptimedb_tpu.datatypes.schema import Schema
+from greptimedb_tpu.datatypes.types import SemanticType
+from greptimedb_tpu.datatypes.vector import DictVector
+
+
+class TagRegistry:
+    """Region-global dictionary per tag column: value -> dense int32 code.
+
+    The analog of mito's primary-key dictionary (sst/parquet/format.rs),
+    kept per-tag so kernels get dense per-tag codes. Codes are stable for
+    the lifetime of the region (append-only)."""
+
+    def __init__(self, tag_names: list[str]):
+        self.tables: dict[str, dict] = {n: {} for n in tag_names}
+        self.values: dict[str, list] = {n: [] for n in tag_names}
+
+    def encode(self, name: str, strings: np.ndarray) -> np.ndarray:
+        table = self.tables[name]
+        vals = self.values[name]
+        codes = np.empty(len(strings), dtype=np.int32)
+        for i, s in enumerate(strings):
+            if s is None:
+                codes[i] = -1
+                continue
+            c = table.get(s)
+            if c is None:
+                c = len(vals)
+                table[s] = c
+                vals.append(s)
+            codes[i] = c
+        return codes
+
+    def remap_dict(self, name: str, file_values: np.ndarray) -> np.ndarray:
+        """Mapping array old_code->region_code for a file-local dictionary."""
+        return self.encode(name, file_values)
+
+    def dict_array(self, name: str) -> np.ndarray:
+        return np.asarray(self.values[name], dtype=object)
+
+    def cardinality(self, name: str) -> int:
+        return len(self.values[name])
+
+    def snapshot(self) -> dict[str, list]:
+        return {k: list(v) for k, v in self.values.items()}
+
+
+@dataclass
+class MemtableChunk:
+    columns: dict[str, np.ndarray]  # tags as int32 codes; ts int64; fields raw
+    seq: np.ndarray  # int64 per-row write sequence
+    op_type: np.ndarray  # int8
+
+
+class Memtable:
+    def __init__(self, schema: Schema, registry: TagRegistry):
+        self.schema = schema
+        self.registry = registry
+        self.chunks: list[MemtableChunk] = []
+        self.num_rows = 0
+        self.bytes_estimate = 0
+        self.ts_min: Optional[int] = None
+        self.ts_max: Optional[int] = None
+
+    def write(self, batch: RecordBatch, seq_start: int, op_type: int) -> int:
+        """Append a batch; returns the number of rows written. Tags are
+        re-encoded against the region registry here (the only host-side
+        per-row work on the ingest path)."""
+        n = batch.num_rows
+        if n == 0:
+            return 0
+        cols: dict[str, np.ndarray] = {}
+        for c in self.schema.columns:
+            col = batch.columns[c.name]
+            if c.semantic is SemanticType.TAG:
+                if isinstance(col, DictVector):
+                    mapping = self.registry.remap_dict(c.name, col.values)
+                    codes = np.where(col.codes >= 0, mapping[np.clip(col.codes, 0, None)], -1)
+                    cols[c.name] = codes.astype(np.int32)
+                else:
+                    cols[c.name] = self.registry.encode(c.name, np.asarray(col, dtype=object))
+            else:
+                cols[c.name] = np.asarray(col)
+        chunk = MemtableChunk(
+            columns=cols,
+            seq=np.arange(seq_start, seq_start + n, dtype=np.int64),
+            op_type=np.full(n, op_type, dtype=np.int8),
+        )
+        self.chunks.append(chunk)
+        self.num_rows += n
+        self.bytes_estimate += sum(a.nbytes if a.dtype != object else a.nbytes * 8 for a in cols.values())
+        ts = cols[self.schema.time_index.name]
+        lo, hi = int(ts.min()), int(ts.max())
+        self.ts_min = lo if self.ts_min is None else min(self.ts_min, lo)
+        self.ts_max = hi if self.ts_max is None else max(self.ts_max, hi)
+        return n
+
+    def is_empty(self) -> bool:
+        return self.num_rows == 0
+
+    def concat(self, ts_range: Optional[tuple[int, int]] = None):
+        """Concatenate chunks (optionally pre-filtered by a coarse time
+        range) → (columns, seq, op_type) numpy arrays."""
+        if not self.chunks:
+            return None
+        if ts_range is not None and self.ts_min is not None:
+            if self.ts_max < ts_range[0] or self.ts_min >= ts_range[1]:
+                return None
+        names = self.schema.names
+        cols = {n: np.concatenate([c.columns[n] for c in self.chunks]) for n in names}
+        seq = np.concatenate([c.seq for c in self.chunks])
+        op = np.concatenate([c.op_type for c in self.chunks])
+        return cols, seq, op

@@ -1,0 +1,282 @@
+"""Region: one LSM instance (mirrors reference `MitoRegion` +
+`VersionControl`, mito2/src/region/version.rs:83-138).
+
+Write path (reference worker/handle_write.rs:34): WAL append is the
+durability boundary, then the memtable ingests and the committed sequence
+advances. Scan path (reference read/scan_region.rs:148-279): collect
+memtable chunks + SSTs overlapping the time predicate, remap file-local tag
+dictionaries into the region registry, and hand the concatenated columns to
+the device tier — sort-dedup and aggregation happen in kernels, not here.
+Flush (worker/handle_flush.rs:34-170): memtable → sorted SST, manifest
+edit, WAL truncation.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import numpy as np
+import pyarrow as pa
+
+from greptimedb_tpu.datatypes.recordbatch import RecordBatch
+from greptimedb_tpu.datatypes.schema import Schema
+from greptimedb_tpu.datatypes.types import SemanticType
+from greptimedb_tpu.datatypes.vector import DictVector
+from greptimedb_tpu.storage.manifest import ManifestManager
+from greptimedb_tpu.storage.memtable import Memtable, TagRegistry
+from greptimedb_tpu.storage.sst import OP_COL, SEQ_COL, FileMeta, SstReader, SstWriter
+from greptimedb_tpu.storage.wal import Wal
+
+OP_PUT = 0
+OP_DELETE = 1
+
+
+@dataclass
+class ScanData:
+    """Host-side scan output: concatenated columns ready for device blocks.
+
+    Tags are int32 codes against `tag_dicts`; rows are NOT yet deduplicated
+    or exactly time-filtered — `seq`/`op_type` ride along so the device
+    sort-dedup kernel can apply last-write-wins + tombstones (the analog of
+    the reference's MergeReader output contract, read.rs:59-73)."""
+
+    schema: Schema
+    columns: dict[str, np.ndarray]
+    seq: np.ndarray
+    op_type: np.ndarray
+    tag_dicts: dict[str, np.ndarray]
+    num_rows: int
+    needs_dedup: bool = True
+
+    @property
+    def tag_cardinalities(self) -> dict[str, int]:
+        return {k: len(v) for k, v in self.tag_dicts.items()}
+
+
+class Region:
+    def __init__(self, region_id: int, region_dir: str, schema: Schema, wal: Wal):
+        self.region_id = region_id
+        self.region_dir = region_dir
+        self.schema = schema
+        self.wal = wal
+        self.manifest = ManifestManager(os.path.join(region_dir, "manifest"))
+        self.sst_writer = SstWriter(os.path.join(region_dir, "sst"), schema)
+        self.sst_reader = SstReader(os.path.join(region_dir, "sst"))
+        tag_names = [c.name for c in schema.tag_columns]
+        self.registry = TagRegistry(tag_names)
+        self.memtable = Memtable(schema, self.registry)
+        self.next_seq = 0
+        self.files: dict[str, FileMeta] = {}
+
+    # ---- lifecycle ---------------------------------------------------------
+
+    @classmethod
+    def create(cls, region_id: int, region_dir: str, schema: Schema, wal: Wal) -> "Region":
+        os.makedirs(region_dir, exist_ok=True)
+        region = cls(region_id, region_dir, schema, wal)
+        region.manifest.record_schema(schema)
+        return region
+
+    @classmethod
+    def open(cls, region_id: int, region_dir: str, wal: Wal) -> "Region":
+        """Replay manifest (checkpoint + deltas), then WAL from flushed_seq
+        (reference region/opener.rs:62-117)."""
+        manifest = ManifestManager(os.path.join(region_dir, "manifest"))
+        st = manifest.state
+        if st.schema is None:
+            raise FileNotFoundError(f"region {region_id} has no manifest at {region_dir}")
+        region = cls(region_id, region_dir, st.schema, wal)
+        region.manifest = manifest
+        region.files = dict(st.files)
+        # restore the tag registry snapshot taken at last flush; WAL replay
+        # below re-adds any values seen since
+        for name, values in st.tag_dicts.items():
+            for v in values:
+                region.registry.encode(name, np.asarray([v], dtype=object))
+        region.next_seq = st.flushed_seq
+        for entry in wal.replay(region_id, from_seq=st.flushed_seq):
+            n = region.memtable.write(entry.batch, entry.seq, entry.op_type)
+            region.next_seq = max(region.next_seq, entry.seq + n)
+        return region
+
+    def drop(self) -> None:
+        self.wal.delete_region(self.region_id)
+        for fid in list(self.files):
+            self.sst_reader.delete(fid)
+        self.files.clear()
+
+    # ---- write -------------------------------------------------------------
+
+    def write(self, batch: RecordBatch, op_type: int = OP_PUT) -> int:
+        """Durable write: WAL first, then memtable (reference
+        region_write_ctx.rs:92-144 + wal.rs:133). Returns affected rows."""
+        n = batch.num_rows
+        if n == 0:
+            return 0
+        seq = self.next_seq
+        self.wal.append(self.region_id, seq, op_type, batch)
+        self.memtable.write(batch, seq, op_type)
+        self.next_seq = seq + n
+        return n
+
+    # ---- flush -------------------------------------------------------------
+
+    def flush(self) -> Optional[FileMeta]:
+        """Memtable → sorted SST; manifest edit; WAL truncate."""
+        data = self.memtable.concat()
+        if data is None:
+            return None
+        cols, seq, op = data
+        order = self._sort_order(cols, seq)
+        sorted_cols = {k: v[order] for k, v in cols.items()}
+        tag_dicts = {
+            c.name: self.registry.dict_array(c.name) for c in self.schema.tag_columns
+        }
+        meta = self.sst_writer.write(sorted_cols, tag_dicts, seq[order], op[order])
+        self.files[meta.file_id] = meta
+        self.manifest.record_flush([meta], flushed_seq=self.next_seq,
+                                   tag_dicts=self.registry.snapshot())
+        self.memtable = Memtable(self.schema, self.registry)
+        self.wal.obsolete(self.region_id, self.next_seq)
+        return meta
+
+    def _sort_order(self, cols: dict[str, np.ndarray], seq: np.ndarray) -> np.ndarray:
+        keys = [seq, cols[self.schema.time_index.name]]
+        for c in reversed(self.schema.tag_columns):
+            keys.append(cols[c.name])
+        return np.lexsort(keys)
+
+    # ---- compaction (minor: merge all L0 into one sorted L1 file) ----------
+
+    def compact(self) -> Optional[FileMeta]:
+        """Merge all SSTs into one sorted, deduplicated file. The merge is
+        the device sort-dedup kernel (SURVEY.md §7: compaction re-encode
+        runs the same kernel as scan), host-side numpy here for the
+        baseline; shadowed rows and tombstones are dropped."""
+        if len(self.files) < 2:
+            return None
+        scan = self.scan()
+        if scan is None or scan.num_rows == 0:
+            return None
+        import jax.numpy as jnp
+        from greptimedb_tpu.ops.dedup import sort_dedup
+        from greptimedb_tpu.ops.segment import combine_group_ids
+
+        tag_names = [c.name for c in self.schema.tag_columns]
+        sizes = [max(scan.tag_cardinalities[n], 1) + 1 for n in tag_names]
+        if tag_names:
+            sid = combine_group_ids(
+                [jnp.asarray(scan.columns[n] + 1) for n in tag_names], sizes
+            )
+        else:
+            sid = jnp.zeros(scan.num_rows, dtype=jnp.int32)
+        ts = jnp.asarray(scan.columns[self.schema.time_index.name])
+        order, keep = sort_dedup(
+            sid, ts, jnp.asarray(scan.seq), jnp.asarray(scan.op_type),
+            jnp.ones(scan.num_rows, dtype=bool),
+        )
+        order = np.asarray(order)[np.asarray(keep)]
+        cols = {k: v[order] for k, v in scan.columns.items()}
+        meta = self.sst_writer.write(
+            cols, scan.tag_dicts, scan.seq[order], scan.op_type[order], level=1
+        )
+        removed = list(self.files)
+        self.files = {meta.file_id: meta}
+        self.manifest.record_flush([meta], flushed_seq=self.next_seq,
+                                   tag_dicts=self.registry.snapshot(), removed=removed)
+        for fid in removed:
+            self.sst_reader.delete(fid)
+        return meta
+
+    # ---- scan --------------------------------------------------------------
+
+    def scan(
+        self,
+        ts_range: Optional[tuple[int, int]] = None,
+        projection: Optional[Sequence[str]] = None,
+    ) -> Optional[ScanData]:
+        """Collect memtable + pruned SSTs into concatenated host columns."""
+        names = self._scan_columns(projection)
+        parts_cols: list[dict[str, np.ndarray]] = []
+        parts_seq: list[np.ndarray] = []
+        parts_op: list[np.ndarray] = []
+
+        for meta in self.files.values():
+            table = self.sst_reader.read(meta, self.schema, ts_range, names)
+            if table is None or table.num_rows == 0:
+                continue
+            cols = self._decode_sst(table, names)
+            parts_cols.append(cols)
+            parts_seq.append(table.column(SEQ_COL).to_numpy(zero_copy_only=False).astype(np.int64))
+            parts_op.append(table.column(OP_COL).to_numpy(zero_copy_only=False).astype(np.int8))
+
+        mem = self.memtable.concat(ts_range)
+        if mem is not None:
+            mcols, mseq, mop = mem
+            parts_cols.append({n: mcols[n] for n in names})
+            parts_seq.append(mseq)
+            parts_op.append(mop)
+
+        if not parts_cols:
+            return None
+        columns = {n: np.concatenate([p[n] for p in parts_cols]) for n in names}
+        seq = np.concatenate(parts_seq)
+        op = np.concatenate(parts_op)
+        tag_dicts = {
+            c.name: self.registry.dict_array(c.name)
+            for c in self.schema.tag_columns
+            if c.name in names
+        }
+        return ScanData(
+            schema=self.schema,
+            columns=columns,
+            seq=seq,
+            op_type=op,
+            tag_dicts=tag_dicts,
+            num_rows=len(seq),
+        )
+
+    def _scan_columns(self, projection: Optional[Sequence[str]]) -> list[str]:
+        ts_name = self.schema.time_index.name
+        if projection is None:
+            return self.schema.names
+        names = list(dict.fromkeys(projection))
+        if ts_name not in names:
+            names.append(ts_name)
+        # dedup correctness needs the full primary key
+        for c in self.schema.tag_columns:
+            if c.name not in names:
+                names.append(c.name)
+        return [n for n in self.schema.names if n in names]
+
+    def _decode_sst(self, table: pa.Table, names: list[str]) -> dict[str, np.ndarray]:
+        cols: dict[str, np.ndarray] = {}
+        for c in self.schema.columns:
+            if c.name not in names:
+                continue
+            arr = table.column(c.name)
+            if c.semantic is SemanticType.TAG:
+                dv = DictVector.from_arrow(
+                    arr.combine_chunks() if isinstance(arr, pa.ChunkedArray) else arr
+                )
+                mapping = self.registry.remap_dict(c.name, dv.values)
+                codes = np.where(dv.codes >= 0,
+                                 mapping[np.clip(dv.codes, 0, None)], -1)
+                cols[c.name] = codes.astype(np.int32)
+            elif c.dtype.is_timestamp:
+                cols[c.name] = arr.to_numpy(zero_copy_only=False).astype(np.int64)
+            else:
+                cols[c.name] = arr.to_numpy(zero_copy_only=False)
+        return cols
+
+    # ---- stats -------------------------------------------------------------
+
+    @property
+    def num_sst_rows(self) -> int:
+        return sum(f.num_rows for f in self.files.values())
+
+    @property
+    def memtable_bytes(self) -> int:
+        return self.memtable.bytes_estimate

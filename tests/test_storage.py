@@ -1,0 +1,179 @@
+import numpy as np
+import pytest
+
+from greptimedb_tpu.datatypes import (
+    ColumnSchema,
+    DataType,
+    DictVector,
+    RecordBatch,
+    Schema,
+    SemanticType,
+)
+from greptimedb_tpu.storage import RegionEngine
+from greptimedb_tpu.storage.engine import EngineConfig
+from greptimedb_tpu.storage.region import OP_DELETE
+from greptimedb_tpu.storage.wal import Wal
+
+
+def cpu_schema():
+    return Schema(
+        [
+            ColumnSchema("ts", DataType.TIMESTAMP_MILLISECOND, SemanticType.TIMESTAMP),
+            ColumnSchema("hostname", DataType.STRING, SemanticType.TAG),
+            ColumnSchema("usage_user", DataType.FLOAT64),
+        ]
+    )
+
+
+def make_batch(schema, hosts, ts, usage):
+    return RecordBatch(
+        schema,
+        {
+            "ts": np.asarray(ts, dtype=np.int64),
+            "hostname": DictVector.encode(hosts),
+            "usage_user": np.asarray(usage, dtype=np.float64),
+        },
+    )
+
+
+@pytest.fixture
+def engine(tmp_path):
+    eng = RegionEngine(EngineConfig(data_dir=str(tmp_path / "data")))
+    yield eng
+    eng.close()
+
+
+class TestWal:
+    def test_append_replay(self, tmp_path):
+        wal = Wal(str(tmp_path / "wal"))
+        s = cpu_schema()
+        wal.append(1, 0, 0, make_batch(s, ["a", "b"], [10, 20], [1.0, 2.0]))
+        wal.append(1, 2, 0, make_batch(s, ["c"], [30], [3.0]))
+        wal.append(2, 0, 0, make_batch(s, ["z"], [99], [9.0]))
+        entries = list(wal.replay(1))
+        assert [e.seq for e in entries] == [0, 2]
+        assert entries[0].batch.columns["hostname"].decode().tolist() == ["a", "b"]
+        assert list(wal.replay(1, from_seq=1))[0].seq == 2
+        wal.close()
+
+    def test_torn_tail_truncated(self, tmp_path):
+        wal = Wal(str(tmp_path / "wal"))
+        s = cpu_schema()
+        wal.append(1, 0, 0, make_batch(s, ["a"], [10], [1.0]))
+        wal.append(1, 1, 0, make_batch(s, ["b"], [20], [2.0]))
+        wal.close()
+        path = str(tmp_path / "wal" / "region_1.wal")
+        with open(path, "r+b") as f:
+            f.seek(0, 2)
+            f.truncate(f.tell() - 7)  # corrupt the last frame
+        wal2 = Wal(str(tmp_path / "wal"))
+        entries = list(wal2.replay(1))
+        assert [e.seq for e in entries] == [0]
+        wal2.close()
+
+    def test_obsolete(self, tmp_path):
+        wal = Wal(str(tmp_path / "wal"))
+        s = cpu_schema()
+        wal.append(1, 0, 0, make_batch(s, ["a"], [10], [1.0]))
+        wal.append(1, 1, 0, make_batch(s, ["b"], [20], [2.0]))
+        wal.obsolete(1, 1)
+        assert [e.seq for e in wal.replay(1)] == [1]
+        wal.close()
+
+
+class TestRegionEngine:
+    def test_write_scan_memtable_only(self, engine):
+        s = cpu_schema()
+        engine.create_region(1, s)
+        n = engine.put(1, make_batch(s, ["h0", "h1", "h0"], [10, 20, 30], [1.0, 2.0, 3.0]))
+        assert n == 3
+        scan = engine.scan(1)
+        assert scan.num_rows == 3
+        assert scan.columns["hostname"].tolist() == [0, 1, 0]
+        assert scan.tag_dicts["hostname"].tolist() == ["h0", "h1"]
+        assert scan.columns["ts"].tolist() == [10, 20, 30]
+
+    def test_flush_and_scan_sst(self, engine):
+        s = cpu_schema()
+        engine.create_region(1, s)
+        engine.put(1, make_batch(s, ["h1", "h0"], [20, 10], [2.0, 1.0]))
+        engine.flush(1)
+        engine.put(1, make_batch(s, ["h0"], [30], [3.0]))
+        scan = engine.scan(1)
+        assert scan.num_rows == 3
+        # codes stay consistent across SST + memtable via the region registry
+        decoded = {
+            (scan.tag_dicts["hostname"][c], t)
+            for c, t in zip(scan.columns["hostname"], scan.columns["ts"])
+        }
+        assert decoded == {("h0", 10), ("h1", 20), ("h0", 30)}
+
+    def test_time_range_pruning(self, engine):
+        s = cpu_schema()
+        engine.create_region(1, s)
+        engine.put(1, make_batch(s, ["a"], [100], [1.0]))
+        engine.flush(1)
+        engine.put(1, make_batch(s, ["a"], [5000], [2.0]))
+        engine.flush(1)
+        scan = engine.scan(1, ts_range=(0, 1000))
+        assert scan.num_rows == 1
+        assert scan.columns["ts"].tolist() == [100]
+        assert engine.scan(1, ts_range=(99999, 100000)) is None
+
+    def test_reopen_replays_wal_and_manifest(self, tmp_path):
+        s = cpu_schema()
+        cfg = EngineConfig(data_dir=str(tmp_path / "d"))
+        eng = RegionEngine(cfg)
+        eng.create_region(7, s)
+        eng.put(7, make_batch(s, ["a", "b"], [10, 20], [1.0, 2.0]))
+        eng.flush(7)
+        eng.put(7, make_batch(s, ["c"], [30], [3.0]))  # only in WAL+memtable
+        eng.close()
+
+        eng2 = RegionEngine(cfg)
+        eng2.open_region(7)
+        scan = eng2.scan(7)
+        assert scan.num_rows == 3
+        hosts = {scan.tag_dicts["hostname"][c] for c in scan.columns["hostname"]}
+        assert hosts == {"a", "b", "c"}
+        # registry codes stable across restart: 'a'→0, 'b'→1, 'c'→2
+        assert scan.tag_dicts["hostname"].tolist() == ["a", "b", "c"]
+        eng2.close()
+
+    def test_delete_tombstone_visible_to_scan(self, engine):
+        s = cpu_schema()
+        engine.create_region(1, s)
+        engine.put(1, make_batch(s, ["a"], [10], [1.0]))
+        engine.delete(1, make_batch(s, ["a"], [10], [float("nan")]))
+        scan = engine.scan(1)
+        assert scan.num_rows == 2
+        assert scan.op_type.tolist() == [0, OP_DELETE]
+        assert scan.seq.tolist() == [0, 1]
+
+    def test_compact_merges_and_dedups(self, engine):
+        s = cpu_schema()
+        engine.create_region(1, s)
+        engine.put(1, make_batch(s, ["a", "b"], [10, 20], [1.0, 2.0]))
+        engine.flush(1)
+        engine.put(1, make_batch(s, ["a"], [10], [9.0]))  # overwrite
+        engine.flush(1)
+        engine.compact(1)
+        region = engine.region(1)
+        assert len(region.files) == 1
+        scan = engine.scan(1)
+        assert scan.num_rows == 2
+        by_key = {
+            (scan.tag_dicts["hostname"][c], t): v
+            for c, t, v in zip(
+                scan.columns["hostname"], scan.columns["ts"], scan.columns["usage_user"]
+            )
+        }
+        assert by_key[("a", 10)] == 9.0  # last write won
+        assert by_key[("b", 20)] == 2.0
+
+    def test_projection_keeps_key_columns(self, engine):
+        s = cpu_schema()
+        engine.create_region(1, s)
+        engine.put(1, make_batch(s, ["a"], [10], [1.0]))
+        scan = engine.scan(1, projection=["usage_user"])
+        assert set(scan.columns) == {"hostname", "ts", "usage_user"}
