@@ -42,7 +42,7 @@ def sort_dedup(
     validity mask and only the sort remains.
     """
     n = series_ids.shape[0]
-    big = jnp.iinfo(jnp.int32).max
+    big = jnp.iinfo(series_ids.dtype).max
     # push invalid rows to the end so the valid prefix stays dense
     s = jnp.where(mask, series_ids, big)
     # lexsort: last key is the primary key

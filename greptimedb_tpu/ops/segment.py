@@ -21,8 +21,10 @@ import jax
 import jax.numpy as jnp
 
 # Aggregate ops supported by the kernel. "first"/"last" are by time order
-# within the segment (used by lastpoint / PromQL instant selection).
-AGG_OPS = ("sum", "count", "min", "max", "mean", "first", "last")
+# within the segment (used by lastpoint / PromQL instant selection);
+# "rows" counts rows irrespective of NULLs (count(*) / group presence);
+# "sumsq" feeds stddev/variance.
+AGG_OPS = ("sum", "count", "min", "max", "mean", "first", "last", "rows", "sumsq")
 
 
 def time_bucket(ts: jax.Array, interval: int, origin: int = 0) -> jax.Array:
@@ -38,15 +40,18 @@ def time_bucket(ts: jax.Array, interval: int, origin: int = 0) -> jax.Array:
 def combine_group_ids(
     keys: Sequence[jax.Array],
     sizes: Sequence[int],
+    dtype=jnp.int32,
 ) -> jax.Array:
     """Fuse several dense int keys (tag codes, bucket indices) into one
     dense group id: id = ((k0 * s1 + k1) * s2 + k2) ... Row-major, so sort
     order of the combined id equals lexicographic order of the keys.
+    Use dtype=int64 when the product of sizes can exceed 2^31 (e.g. the
+    dedup series id over many high-cardinality tags).
     """
     assert len(keys) == len(sizes) and keys
-    gid = keys[0].astype(jnp.int32)
+    gid = keys[0].astype(dtype)
     for k, s in zip(keys[1:], sizes[1:]):
-        gid = gid * jnp.int32(s) + k.astype(jnp.int32)
+        gid = gid * jnp.asarray(s, dtype) + k.astype(dtype)
     return gid
 
 
@@ -105,6 +110,14 @@ def segment_agg(
         out["sum"] = sums
     if "count" in ops:
         out["count"] = counts
+    if "rows" in ops:
+        # [G, 1]: per-group, not per-field
+        out["rows"] = seg_sum(row_mask.astype(jnp.int64)[:, None])
+    if "sumsq" in ops:
+        # NOTE: textbook sum-of-squares is cancellation-prone; acceptable in
+        # f64, but the f32 TPU fast path needs a mean-offset/Welford kernel
+        # before stddev/variance ride it.
+        out["sumsq"] = seg_sum(jnp.where(elem_mask, values * values, 0).astype(values.dtype))
     if "mean" in ops:
         denom = jnp.maximum(counts, 1).astype(values.dtype)
         mean = sums / denom
@@ -177,7 +190,7 @@ def combine_partial_aggs(
     """
     out = {}
     for op, v in partials.items():
-        if op in ("sum", "count"):
+        if op in ("sum", "count", "rows", "sumsq"):
             out[op] = jax.lax.psum(v, axis_name)
         elif op == "min":
             out[op] = jax.lax.pmin(_nan_to(v, _type_max(v.dtype)), axis_name)

@@ -1,0 +1,13 @@
+"""Query engine (mirrors reference src/query + src/operator).
+
+SQL/PromQL parse into one logical plan algebra (reference
+QueryStatement::{Sql, Promql}, query/src/parser.rs:46-48); physical
+execution composes jit-compiled device kernels over padded column blocks:
+filter masks -> group ids -> segment reductions, with host numpy only at
+the edges (result assembly, ORDER BY over group counts).
+"""
+
+from greptimedb_tpu.query.engine import QueryEngine
+from greptimedb_tpu.query.result import QueryResult
+
+__all__ = ["QueryEngine", "QueryResult"]

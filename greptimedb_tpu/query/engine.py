@@ -1,0 +1,427 @@
+"""QueryEngine + statement executor (mirrors reference
+`StatementExecutor` dispatch, operator/src/statement.rs:110-267, and
+`DatafusionQueryEngine::execute`, query/src/datafusion.rs:271).
+
+One engine, two language frontends (SQL here, PromQL via promql/) lowering
+into the same logical plan algebra, executed by the device-kernel physical
+layer.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Union
+
+import numpy as np
+
+from greptimedb_tpu.catalog.catalog import Catalog, CatalogError, DEFAULT_DB, TableInfo
+from greptimedb_tpu.datatypes.recordbatch import RecordBatch
+from greptimedb_tpu.datatypes.schema import ColumnSchema, Schema
+from greptimedb_tpu.datatypes.types import DataType, SemanticType, parse_sql_type
+from greptimedb_tpu.datatypes.vector import DictVector
+from greptimedb_tpu.query import logical as lp
+from greptimedb_tpu.query.expr import PlanError, eval_host
+from greptimedb_tpu.query.physical import PhysicalExecutor
+from greptimedb_tpu.query.planner import plan_select
+from greptimedb_tpu.query.result import QueryResult
+from greptimedb_tpu.sql import ast, parse_sql
+from greptimedb_tpu.storage.engine import RegionEngine
+from greptimedb_tpu.utils.time import coerce_ts_literal
+
+
+@dataclass
+class QueryContext:
+    """Session context (mirrors reference src/session QueryContext)."""
+
+    db: str = DEFAULT_DB
+    timezone: str = "UTC"
+
+
+class QueryEngine:
+    def __init__(self, catalog: Catalog, region_engine: RegionEngine):
+        self.catalog = catalog
+        self.region_engine = region_engine
+        self.executor = PhysicalExecutor(region_engine)
+        self._open_regions: set[int] = set()
+
+    # ---- entry points ------------------------------------------------------
+
+    def execute_sql(self, sql: str, ctx: Optional[QueryContext] = None) -> list[QueryResult]:
+        ctx = ctx or QueryContext()
+        return [self.execute_statement(s, ctx) for s in parse_sql(sql)]
+
+    def execute_one(self, sql: str, ctx: Optional[QueryContext] = None) -> QueryResult:
+        results = self.execute_sql(sql, ctx)
+        if not results:
+            raise PlanError("empty statement")
+        return results[-1]
+
+    def execute_statement(self, stmt: ast.Statement, ctx: QueryContext) -> QueryResult:
+        if isinstance(stmt, ast.Select):
+            return self._select(stmt, ctx)
+        if isinstance(stmt, ast.CreateTable):
+            return self._create_table(stmt, ctx)
+        if isinstance(stmt, ast.CreateDatabase):
+            self.catalog.create_database(stmt.name, stmt.if_not_exists)
+            return QueryResult.of_affected(1)
+        if isinstance(stmt, ast.Insert):
+            return self._insert(stmt, ctx)
+        if isinstance(stmt, ast.Delete):
+            return self._delete(stmt, ctx)
+        if isinstance(stmt, ast.DropTable):
+            return self._drop_table(stmt, ctx)
+        if isinstance(stmt, ast.TruncateTable):
+            return self._truncate(stmt, ctx)
+        if isinstance(stmt, ast.ShowTables):
+            names = self.catalog.list_tables(stmt.database or ctx.db)
+            if stmt.like:
+                from greptimedb_tpu.query.expr import _like_to_regex
+                rx = _like_to_regex(stmt.like)
+                names = [n for n in names if rx.fullmatch(n)]
+            return QueryResult(["Tables"], [DataType.STRING],
+                               [np.asarray(names, dtype=object)])
+        if isinstance(stmt, ast.ShowDatabases):
+            return QueryResult(["Databases"], [DataType.STRING],
+                               [np.asarray(self.catalog.list_databases(), dtype=object)])
+        if isinstance(stmt, ast.DescribeTable):
+            return self._describe(stmt, ctx)
+        if isinstance(stmt, ast.ShowCreateTable):
+            return self._show_create(stmt, ctx)
+        if isinstance(stmt, ast.Explain):
+            return self._explain(stmt, ctx)
+        if isinstance(stmt, ast.Use):
+            if not self.catalog.database_exists(stmt.database):
+                raise CatalogError(f"database {stmt.database!r} not found")
+            ctx.db = stmt.database
+            return QueryResult.of_affected(0)
+        if isinstance(stmt, ast.AlterTable):
+            return self._alter(stmt, ctx)
+        if isinstance(stmt, ast.AdminFunc):
+            return self._admin(stmt, ctx)
+        if isinstance(stmt, ast.Tql):
+            return self._tql(stmt, ctx)
+        raise PlanError(f"unsupported statement {type(stmt).__name__}")
+
+    # ---- table resolution --------------------------------------------------
+
+    def _table(self, name: str, ctx: QueryContext) -> TableInfo:
+        db = ctx.db
+        if "." in name:
+            db, name = name.rsplit(".", 1)
+        info = self.catalog.table(db, name)
+        self._ensure_open(info)
+        return info
+
+    def _ensure_open(self, info: TableInfo) -> None:
+        for rid in info.region_ids:
+            if rid not in self._open_regions:
+                try:
+                    self.region_engine.region(rid)
+                except KeyError:
+                    self.region_engine.open_region(rid)
+                self._open_regions.add(rid)
+
+    # ---- SELECT ------------------------------------------------------------
+
+    def _select(self, sel: ast.Select, ctx: QueryContext) -> QueryResult:
+        if sel.table is None:
+            # SELECT <literals>
+            names, cols, dtypes = [], [], []
+            for i, it in enumerate(sel.items):
+                v = eval_host(it.expr, {}, None, None)
+                arr = np.asarray([v]) if np.ndim(v) == 0 else np.asarray(v)
+                names.append(it.alias or f"column{i}")
+                dtypes.append(None)
+                cols.append(arr)
+            return QueryResult(names, dtypes, cols)
+        info = self._table(sel.table, ctx)
+        plan = plan_select(sel, info)
+        return self.executor.execute(plan)
+
+    # ---- DDL ---------------------------------------------------------------
+
+    def _create_table(self, stmt: ast.CreateTable, ctx: QueryContext) -> QueryResult:
+        db = ctx.db
+        name = stmt.name
+        if "." in name:
+            db, name = name.rsplit(".", 1)
+        time_index = stmt.time_index
+        pks = list(stmt.primary_keys)
+        for c in stmt.columns:
+            if c.is_time_index:
+                time_index = c.name
+            if c.is_primary_key and c.name not in pks:
+                pks.append(c.name)
+        if time_index is None:
+            raise PlanError("CREATE TABLE requires a TIME INDEX column")
+        cols = []
+        for c in stmt.columns:
+            dtype = parse_sql_type(c.type_name)
+            if c.name == time_index:
+                sem = SemanticType.TIMESTAMP
+            elif c.name in pks:
+                sem = SemanticType.TAG
+            else:
+                sem = SemanticType.FIELD
+            default = None
+            if c.default is not None and isinstance(c.default, ast.Literal):
+                default = c.default.value
+            cols.append(ColumnSchema(c.name, dtype, sem, c.nullable, default))
+        schema = Schema(cols)
+        info = self.catalog.create_table(
+            db, name, schema, options=dict(stmt.options),
+            if_not_exists=stmt.if_not_exists,
+        )
+        for rid in info.region_ids:
+            self.region_engine.create_region(rid, schema)
+            self._open_regions.add(rid)
+        return QueryResult.of_affected(0)
+
+    def _drop_table(self, stmt: ast.DropTable, ctx: QueryContext) -> QueryResult:
+        db = ctx.db
+        name = stmt.name
+        if "." in name:
+            db, name = name.rsplit(".", 1)
+        info = self.catalog.drop_table(db, name, stmt.if_exists)
+        if info is None:
+            return QueryResult.of_affected(0)
+        from greptimedb_tpu.storage.engine import RegionRequest, RequestType
+        for rid in info.region_ids:
+            try:
+                self.region_engine.region(rid)
+            except KeyError:
+                self.region_engine.open_region(rid)
+            self.region_engine.handle_request(RegionRequest(RequestType.DROP, rid))
+            self._open_regions.discard(rid)
+        return QueryResult.of_affected(0)
+
+    def _truncate(self, stmt: ast.TruncateTable, ctx: QueryContext) -> QueryResult:
+        info = self._table(stmt.name, ctx)
+        from greptimedb_tpu.storage.engine import RegionRequest, RequestType
+        for rid in info.region_ids:
+            self.region_engine.handle_request(RegionRequest(RequestType.DROP, rid))
+            self.region_engine.create_region(rid, info.schema)
+        return QueryResult.of_affected(0)
+
+    def _alter(self, stmt: ast.AlterTable, ctx: QueryContext) -> QueryResult:
+        info = self._table(stmt.name, ctx)
+        if stmt.action == "add_column":
+            col = stmt.column
+            dtype = parse_sql_type(col.type_name)
+            if col.is_time_index or col.is_primary_key:
+                raise PlanError("can only ADD nullable field columns")
+            new_schema = Schema(
+                list(info.schema.columns)
+                + [ColumnSchema(col.name, dtype, SemanticType.FIELD, True,
+                                col.default.value if isinstance(col.default, ast.Literal) else None)]
+            )
+            for rid in info.region_ids:
+                region = self.region_engine.region(rid)
+                region.flush()
+                region.schema = new_schema
+                region.memtable.schema = new_schema
+                region.sst_writer.schema = new_schema
+                region.manifest.record_schema(new_schema)
+            info.schema = new_schema
+            self.catalog.update_table(info)
+            return QueryResult.of_affected(0)
+        if stmt.action == "drop_column":
+            cols = [c for c in info.schema.columns if c.name != stmt.column_name]
+            dropped = info.schema.column(stmt.column_name)
+            if dropped.semantic is not SemanticType.FIELD:
+                raise PlanError("can only DROP field columns")
+            new_schema = Schema(cols)
+            for rid in info.region_ids:
+                region = self.region_engine.region(rid)
+                region.flush()
+                region.schema = new_schema
+                region.memtable.schema = new_schema
+                region.sst_writer.schema = new_schema
+                region.manifest.record_schema(new_schema)
+            info.schema = new_schema
+            self.catalog.update_table(info)
+            return QueryResult.of_affected(0)
+        raise PlanError(f"unsupported ALTER action {stmt.action}")
+
+    # ---- DML ---------------------------------------------------------------
+
+    def _insert(self, stmt: ast.Insert, ctx: QueryContext) -> QueryResult:
+        info = self._table(stmt.table, ctx)
+        schema = info.schema
+        if stmt.select is not None:
+            raise PlanError("INSERT ... SELECT not yet supported")
+        col_names = stmt.columns or schema.names
+        unknown = set(col_names) - set(schema.names)
+        if unknown:
+            raise PlanError(f"unknown insert columns {sorted(unknown)}")
+        nrows = len(stmt.rows)
+        by_col: dict[str, list] = {n: [] for n in col_names}
+        for row in stmt.rows:
+            if len(row) != len(col_names):
+                raise PlanError("INSERT row arity mismatch")
+            for n, e in zip(col_names, row):
+                v = eval_host(e, {}, schema, None) if not isinstance(e, ast.Literal) else e.value
+                v = None if _is_nan_scalar(v) else v
+                by_col[n].append(v)
+        batch_cols: dict = {}
+        for c in schema.columns:
+            vals = by_col.get(c.name)
+            if vals is None:
+                vals = [c.default] * nrows
+            if c.semantic is SemanticType.TAG:
+                batch_cols[c.name] = DictVector.encode(
+                    [None if v is None else str(v) for v in vals]
+                )
+            elif c.dtype.is_timestamp:
+                coerced = []
+                for v in vals:
+                    if v is None:
+                        raise PlanError(f"time index {c.name} cannot be NULL")
+                    coerced.append(coerce_ts_literal(v, c.dtype))
+                batch_cols[c.name] = np.asarray(coerced, dtype=np.int64)
+            elif c.dtype.is_string:
+                batch_cols[c.name] = DictVector.encode(
+                    [None if v is None else str(v) for v in vals]
+                )
+            elif c.dtype.is_float:
+                batch_cols[c.name] = np.asarray(
+                    [np.nan if v is None else float(v) for v in vals],
+                    dtype=c.dtype.to_numpy(),
+                )
+            elif c.dtype is DataType.BOOL:
+                batch_cols[c.name] = np.asarray(
+                    [False if v is None else bool(v) for v in vals]
+                )
+            else:
+                batch_cols[c.name] = np.asarray(
+                    [0 if v is None else int(v) for v in vals],
+                    dtype=c.dtype.to_numpy(),
+                )
+        batch = RecordBatch(schema, batch_cols)
+        n = self.region_engine.put(info.region_ids[0], batch)
+        return QueryResult.of_affected(n)
+
+    def _delete(self, stmt: ast.Delete, ctx: QueryContext) -> QueryResult:
+        info = self._table(stmt.table, ctx)
+        schema = info.schema
+        key_cols = [c.name for c in schema.tag_columns] + [schema.time_index.name]
+        sel = ast.Select(
+            items=[ast.SelectItem(ast.Column(n)) for n in key_cols],
+            table=stmt.table, where=stmt.where,
+        )
+        rows = self._select(sel, ctx)
+        n = rows.num_rows
+        if n == 0:
+            return QueryResult.of_affected(0)
+        cols: dict = {}
+        d = dict(zip(rows.names, rows.columns))
+        for c in schema.columns:
+            if c.name in d:
+                if c.semantic is SemanticType.TAG:
+                    cols[c.name] = DictVector.encode(list(d[c.name]))
+                else:
+                    cols[c.name] = np.asarray(d[c.name], dtype=np.int64)
+            elif c.dtype.is_float:
+                cols[c.name] = np.full(n, np.nan, dtype=c.dtype.to_numpy())
+            elif c.dtype.is_string:
+                cols[c.name] = DictVector.encode([None] * n)
+            else:
+                cols[c.name] = np.zeros(n, dtype=c.dtype.to_numpy())
+        batch = RecordBatch(schema, cols)
+        affected = self.region_engine.delete(info.region_ids[0], batch)
+        return QueryResult.of_affected(affected)
+
+    # ---- introspection -----------------------------------------------------
+
+    def _describe(self, stmt: ast.DescribeTable, ctx: QueryContext) -> QueryResult:
+        info = self._table(stmt.name, ctx)
+        names, types, keys, nulls, defaults, semantics = [], [], [], [], [], []
+        for c in info.schema.columns:
+            names.append(c.name)
+            types.append(c.dtype.value)
+            keys.append("PRI" if c.semantic in (SemanticType.TAG, SemanticType.TIMESTAMP) else "")
+            nulls.append("YES" if c.nullable else "NO")
+            defaults.append("" if c.default is None else str(c.default))
+            semantics.append(
+                {"tag": "TAG", "timestamp": "TIMESTAMP", "field": "FIELD"}[c.semantic.value]
+            )
+        return QueryResult(
+            ["Column", "Type", "Key", "Null", "Default", "Semantic Type"],
+            [DataType.STRING] * 6,
+            [np.asarray(x, dtype=object) for x in
+             (names, types, keys, nulls, defaults, semantics)],
+        )
+
+    def _show_create(self, stmt: ast.ShowCreateTable, ctx: QueryContext) -> QueryResult:
+        info = self._table(stmt.name, ctx)
+        lines = [f"CREATE TABLE IF NOT EXISTS \"{info.name}\" ("]
+        defs = []
+        for c in info.schema.columns:
+            null = "" if c.nullable else " NOT NULL"
+            defs.append(f'  "{c.name}" {_render_type(c.dtype)}{null}')
+        defs.append(f'  TIME INDEX ("{info.schema.time_index.name}")')
+        tags = [c.name for c in info.schema.tag_columns]
+        if tags:
+            defs.append("  PRIMARY KEY (" + ", ".join(f'"{t}"' for t in tags) + ")")
+        lines.append(",\n".join(defs))
+        lines.append(")")
+        lines.append("ENGINE=mito")
+        if info.options:
+            opts = ", ".join(f"'{k}' = '{v}'" for k, v in info.options.items())
+            lines.append(f"WITH ({opts})")
+        ddl = "\n".join(lines)
+        return QueryResult(
+            ["Table", "Create Table"], [DataType.STRING, DataType.STRING],
+            [np.asarray([info.name], dtype=object), np.asarray([ddl], dtype=object)],
+        )
+
+    def _explain(self, stmt: ast.Explain, ctx: QueryContext) -> QueryResult:
+        if isinstance(stmt.inner, ast.Select) and stmt.inner.table is not None:
+            info = self._table(stmt.inner.table, ctx)
+            plan = plan_select(stmt.inner, info)
+            text = lp.explain_plan(plan)
+        else:
+            text = f"{type(stmt.inner).__name__}"
+        return QueryResult(["plan"], [DataType.STRING],
+                           [np.asarray(text.split("\n"), dtype=object)])
+
+    # ---- admin -------------------------------------------------------------
+
+    def _admin(self, stmt: ast.AdminFunc, ctx: QueryContext) -> QueryResult:
+        fn = stmt.func
+        args = [a.value if isinstance(a, ast.Literal) else None for a in fn.args]
+        if fn.name in ("flush_table", "compact_table"):
+            info = self._table(str(args[0]), ctx)
+            for rid in info.region_ids:
+                if fn.name == "flush_table":
+                    self.region_engine.flush(rid)
+                else:
+                    self.region_engine.compact(rid)
+            return QueryResult.of_affected(0)
+        if fn.name in ("flush_region", "compact_region"):
+            rid = int(args[0])
+            if fn.name == "flush_region":
+                self.region_engine.flush(rid)
+            else:
+                self.region_engine.compact(rid)
+            return QueryResult.of_affected(0)
+        raise PlanError(f"unknown admin function {fn.name!r}")
+
+    # ---- TQL (PromQL embedded in SQL) --------------------------------------
+
+    def _tql(self, stmt: ast.Tql, ctx: QueryContext) -> QueryResult:
+        from greptimedb_tpu.promql.engine import PromqlEngine
+
+        engine = PromqlEngine(self)
+        return engine.eval_range(stmt.query, stmt.start, stmt.end, stmt.step, ctx)
+
+
+def _render_type(dt: DataType) -> str:
+    if dt.is_timestamp:
+        return {"s": "TIMESTAMP(0)", "ms": "TIMESTAMP(3)",
+                "us": "TIMESTAMP(6)", "ns": "TIMESTAMP(9)"}[dt.time_unit.value]
+    return dt.value.upper()
+
+
+def _is_nan_scalar(v) -> bool:
+    return isinstance(v, float) and v != v

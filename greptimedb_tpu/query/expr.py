@@ -1,0 +1,671 @@
+"""Expression compilation: bind -> (device | host) evaluation.
+
+Binding rewrites an AST expression against a scan context so the device
+never sees strings (SURVEY.md §7 hard part #2):
+  - tag-column string comparisons become int32 code comparisons
+  - LIKE on a tag becomes an InList of matching codes (pattern evaluated
+    against the small dictionary on host)
+  - timestamp literals are coerced to the column's storage unit
+Bound expressions are frozen/hashable, so they ride into jit as *static*
+arguments and the evaluator below is plain traced JAX.
+
+The host evaluator mirrors device semantics over numpy and additionally
+handles aggregate-result substitution (post-aggregation HAVING/ORDER BY/
+projection) via an identity-keyed env.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from greptimedb_tpu.datatypes.schema import Schema
+from greptimedb_tpu.datatypes.types import DataType, SemanticType
+from greptimedb_tpu.sql import ast
+from greptimedb_tpu.utils.time import coerce_ts_literal, parse_timestamp_ns
+
+MISSING_CODE = -2  # literal not present in the tag dictionary: matches nothing
+
+
+class PlanError(Exception):
+    pass
+
+
+@dataclass
+class BindContext:
+    schema: Schema
+    tag_dicts: dict[str, np.ndarray]  # tag name -> value table
+
+    def __post_init__(self):
+        self.tag_names = {c.name for c in self.schema.tag_columns}
+        self._lookup = {
+            name: {v: i for i, v in enumerate(vals)}
+            for name, vals in self.tag_dicts.items()
+        }
+
+    def code_of(self, tag: str, value) -> int:
+        if value is None:
+            return -1
+        return self._lookup.get(tag, {}).get(value, MISSING_CODE)
+
+    def codes_matching(self, tag: str, pred: Callable[[str], bool]) -> list[int]:
+        return [i for i, v in enumerate(self.tag_dicts.get(tag, ())) if pred(v)]
+
+    def column_dtype(self, name: str) -> DataType:
+        return self.schema.column(name).dtype
+
+
+# ---- binding ---------------------------------------------------------------
+
+
+def bind_expr(e: ast.Expr, ctx: BindContext) -> ast.Expr:
+    """Rewrite tag/timestamp literals; recurse structurally."""
+    if isinstance(e, ast.BinaryOp):
+        l, r = e.left, e.right
+        if e.op in ("=", "!=", "<", "<=", ">", ">="):
+            tag = _tag_side(l, r, ctx)
+            if tag is not None:
+                col, lit, flipped = tag
+                if e.op in ("=", "!="):
+                    return ast.BinaryOp(e.op, col, ast.Literal(ctx.code_of(col.name, lit.value)))
+                raise PlanError(f"ordering comparison on tag column {col.name!r} unsupported")
+            ts = _ts_side(l, r, ctx)
+            if ts is not None:
+                col, lit, flipped = ts
+                coerced = ast.Literal(coerce_ts_literal(lit.value, ctx.column_dtype(col.name)))
+                op = _flip(e.op) if flipped else e.op
+                return ast.BinaryOp(op, col, coerced)
+        if e.op == "like":
+            if isinstance(l, ast.Column) and l.name in ctx.tag_names and isinstance(r, ast.Literal):
+                rx = _like_to_regex(str(r.value))
+                codes = ctx.codes_matching(l.name, lambda v: rx.fullmatch(v) is not None)
+                return ast.InList(l, tuple(ast.Literal(c) for c in codes))
+            raise PlanError("LIKE is only supported on tag columns")
+        return ast.BinaryOp(e.op, bind_expr(l, ctx), bind_expr(r, ctx))
+    if isinstance(e, ast.UnaryOp):
+        return ast.UnaryOp(e.op, bind_expr(e.operand, ctx))
+    if isinstance(e, ast.Between):
+        col = e.expr
+        if isinstance(col, ast.Column) and col.name in ctx.schema.names and \
+           ctx.column_dtype(col.name).is_timestamp:
+            lo = ast.Literal(coerce_ts_literal(_lit(e.low), ctx.column_dtype(col.name)))
+            hi = ast.Literal(coerce_ts_literal(_lit(e.high), ctx.column_dtype(col.name)))
+            return ast.Between(col, lo, hi, e.negated)
+        return ast.Between(bind_expr(e.expr, ctx), bind_expr(e.low, ctx),
+                           bind_expr(e.high, ctx), e.negated)
+    if isinstance(e, ast.InList):
+        if isinstance(e.expr, ast.Column) and e.expr.name in ctx.tag_names:
+            codes = tuple(
+                ast.Literal(ctx.code_of(e.expr.name, _lit(i))) for i in e.items
+            )
+            return ast.InList(e.expr, codes, e.negated)
+        return ast.InList(bind_expr(e.expr, ctx),
+                          tuple(bind_expr(i, ctx) for i in e.items), e.negated)
+    if isinstance(e, ast.IsNull):
+        return ast.IsNull(bind_expr(e.expr, ctx), e.negated)
+    if isinstance(e, ast.FuncCall):
+        return ast.FuncCall(e.name, tuple(bind_expr(a, ctx) for a in e.args), e.distinct)
+    if isinstance(e, ast.Cast):
+        return ast.Cast(bind_expr(e.expr, ctx), e.type_name)
+    if isinstance(e, ast.Case):
+        return ast.Case(
+            bind_expr(e.operand, ctx) if e.operand else None,
+            tuple((bind_expr(c, ctx), bind_expr(v, ctx)) for c, v in e.whens),
+            bind_expr(e.else_, ctx) if e.else_ else None,
+        )
+    return e
+
+
+def _lit(e: ast.Expr):
+    if not isinstance(e, ast.Literal):
+        raise PlanError(f"expected literal, got {e}")
+    return e.value
+
+
+def _tag_side(l, r, ctx):
+    if isinstance(l, ast.Column) and l.name in ctx.tag_names and isinstance(r, ast.Literal):
+        return l, r, False
+    if isinstance(r, ast.Column) and r.name in ctx.tag_names and isinstance(l, ast.Literal):
+        return r, l, True
+    return None
+
+
+def _ts_side(l, r, ctx):
+    if (isinstance(l, ast.Column) and l.name in ctx.schema.names
+            and ctx.column_dtype(l.name).is_timestamp and isinstance(r, ast.Literal)):
+        return l, r, False
+    if (isinstance(r, ast.Column) and r.name in ctx.schema.names
+            and ctx.column_dtype(r.name).is_timestamp and isinstance(l, ast.Literal)):
+        return r, l, True
+    return None
+
+
+def _flip(op: str) -> str:
+    return {"<": ">", ">": "<", "<=": ">=", ">=": "<="}.get(op, op)
+
+
+def _like_to_regex(pattern: str) -> re.Pattern:
+    out = []
+    for ch in pattern:
+        if ch == "%":
+            out.append(".*")
+        elif ch == "_":
+            out.append(".")
+        else:
+            out.append(re.escape(ch))
+    return re.compile("".join(out), re.IGNORECASE | re.DOTALL)
+
+
+# ---- device evaluation (traced JAX; expr must be bound) --------------------
+
+_DEVICE_FUNCS = {
+    "abs": jnp.abs, "sqrt": jnp.sqrt, "exp": jnp.exp,
+    "ln": jnp.log, "log": jnp.log, "log2": jnp.log2, "log10": jnp.log10,
+    "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan,
+    "asin": jnp.arcsin, "acos": jnp.arccos, "atan": jnp.arctan,
+    "floor": jnp.floor, "ceil": jnp.ceil, "signum": jnp.sign,
+    "trunc": jnp.trunc,
+}
+
+
+def eval_device(e: ast.Expr, cols: dict, ctx_tags: frozenset, schema: Schema):
+    """Evaluate a bound expression over device column arrays. `e` is static
+    under jit; this runs at trace time."""
+
+    def ev(x):
+        return eval_device(x, cols, ctx_tags, schema)
+
+    if isinstance(e, ast.Column):
+        if e.name not in cols:
+            raise PlanError(f"column {e.name!r} not available on device")
+        return cols[e.name]
+    if isinstance(e, ast.Literal):
+        if e.value is None:
+            return jnp.nan
+        if isinstance(e.value, bool):
+            return jnp.asarray(e.value)
+        return jnp.asarray(e.value)
+    if isinstance(e, ast.Interval):
+        return jnp.asarray(e.nanos)
+    if isinstance(e, ast.BinaryOp):
+        if e.op == "and":
+            return _as_bool(ev(e.left)) & _as_bool(ev(e.right))
+        if e.op == "or":
+            return _as_bool(ev(e.left)) | _as_bool(ev(e.right))
+        a, b = ev(e.left), ev(e.right)
+        if e.op == "+":
+            return a + b
+        if e.op == "-":
+            return a - b
+        if e.op == "*":
+            return a * b
+        if e.op == "/":
+            if jnp.issubdtype(jnp.result_type(a, b), jnp.integer):
+                return a // b
+            return a / b
+        if e.op == "%":
+            return a % b
+        if e.op == "=":
+            return a == b
+        if e.op == "!=":
+            return a != b
+        if e.op == "<":
+            return a < b
+        if e.op == "<=":
+            return a <= b
+        if e.op == ">":
+            return a > b
+        if e.op == ">=":
+            return a >= b
+        raise PlanError(f"unsupported device op {e.op!r}")
+    if isinstance(e, ast.UnaryOp):
+        v = ev(e.operand)
+        return ~_as_bool(v) if e.op == "not" else -v
+    if isinstance(e, ast.Between):
+        x = ev(e.expr)
+        res = (x >= ev(e.low)) & (x <= ev(e.high))
+        return ~res if e.negated else res
+    if isinstance(e, ast.InList):
+        x = ev(e.expr)
+        if not e.items:
+            res = jnp.zeros(x.shape, dtype=bool)
+        else:
+            res = x == ev(e.items[0])
+            for item in e.items[1:]:
+                res = res | (x == ev(item))
+        return ~res if e.negated else res
+    if isinstance(e, ast.IsNull):
+        x = e.expr
+        if isinstance(x, ast.Column) and x.name in ctx_tags:
+            res = cols[x.name] < 0
+        else:
+            v = ev(x)
+            res = jnp.isnan(v) if jnp.issubdtype(v.dtype, jnp.floating) else jnp.zeros(v.shape, bool)
+        return ~res if e.negated else res
+    if isinstance(e, ast.FuncCall):
+        return _eval_device_func(e, ev, cols, schema)
+    if isinstance(e, ast.Cast):
+        v = ev(e.expr)
+        t = e.type_name.lower()
+        if t in ("double", "float64"):
+            return v.astype(jnp.float64)
+        if t in ("float", "float32", "real"):
+            return v.astype(jnp.float32)
+        if t in ("bigint", "int64"):
+            return v.astype(jnp.int64)
+        if t in ("int", "integer", "int32"):
+            return v.astype(jnp.int32)
+        raise PlanError(f"unsupported device cast to {e.type_name!r}")
+    if isinstance(e, ast.Case):
+        if e.operand is not None:
+            op = ev(e.operand)
+            conds = [op == ev(c) for c, _ in e.whens]
+        else:
+            conds = [_as_bool(ev(c)) for c, _ in e.whens]
+        vals = [ev(v) for _, v in e.whens]
+        out = ev(e.else_) if e.else_ is not None else jnp.nan
+        for c, v in zip(reversed(conds), reversed(vals)):
+            out = jnp.where(c, v, out)
+        return out
+    raise PlanError(f"cannot evaluate {e!r} on device")
+
+
+def _eval_device_func(e: ast.FuncCall, ev, cols, schema: Schema):
+    name = e.name
+    if name in ("date_bin", "time_bucket"):
+        # date_bin(interval, ts[, origin]) -> bucket START timestamp
+        interval, ts_expr = e.args[0], e.args[1]
+        if not isinstance(interval, ast.Interval):
+            raise PlanError("date_bin needs an INTERVAL first argument")
+        step = _interval_in_col_unit(interval, ts_expr, schema)
+        ts = ev(ts_expr)
+        origin = 0
+        if len(e.args) > 2:
+            origin = int(_lit(e.args[2]))
+        return (ts - origin) // step * step + origin
+    if name == "date_trunc":
+        unit_lit, ts_expr = e.args[0], e.args[1]
+        nanos = _TRUNC_UNITS.get(str(_lit(unit_lit)).lower())
+        if nanos is None:
+            raise PlanError(f"date_trunc unit {_lit(unit_lit)!r} unsupported")
+        step = _scale_to_col_unit(nanos, ts_expr, schema)
+        ts = ev(ts_expr)
+        return ts // step * step
+    if name in ("pow", "power"):
+        return jnp.power(ev(e.args[0]), ev(e.args[1]))
+    if name == "round":
+        v = ev(e.args[0])
+        if len(e.args) > 1:
+            d = int(_lit(e.args[1]))
+            f = 10.0 ** d
+            return jnp.round(v * f) / f
+        return jnp.round(v)
+    if name == "clamp":
+        return jnp.clip(ev(e.args[0]), ev(e.args[1]), ev(e.args[2]))
+    if name in _DEVICE_FUNCS and len(e.args) == 1:
+        return _DEVICE_FUNCS[name](ev(e.args[0]))
+    if name == "to_unixtime":
+        ts_expr = e.args[0]
+        unit = _col_unit_nanos(ts_expr, schema)
+        return ev(ts_expr) * unit // 10**9
+    raise PlanError(f"unsupported device function {name!r}")
+
+
+_TRUNC_UNITS = {
+    "second": 10**9, "minute": 60 * 10**9, "hour": 3600 * 10**9,
+    "day": 86400 * 10**9, "week": 7 * 86400 * 10**9,
+}
+
+
+def _col_unit_nanos(ts_expr: ast.Expr, schema: Schema) -> int:
+    if isinstance(ts_expr, ast.Column) and ts_expr.name in schema.names:
+        dt = schema.column(ts_expr.name).dtype
+        if dt.is_timestamp:
+            return dt.time_unit.nanos_per_unit
+    return 1  # already nanoseconds or plain int
+
+
+def _interval_in_col_unit(interval: ast.Interval, ts_expr: ast.Expr, schema: Schema) -> int:
+    return _scale_to_col_unit(interval.nanos, ts_expr, schema)
+
+
+def _scale_to_col_unit(nanos: int, ts_expr: ast.Expr, schema: Schema) -> int:
+    unit = _col_unit_nanos(ts_expr, schema)
+    step = max(nanos // unit, 1)
+    return step
+
+
+def _as_bool(v):
+    if v.dtype == jnp.bool_:
+        return v
+    return v != 0
+
+
+# ---- host evaluation (numpy; strings allowed; env substitution) ------------
+
+
+def eval_host(
+    e: ast.Expr,
+    cols: dict[str, np.ndarray],
+    schema: Optional[Schema] = None,
+    env: Optional[dict] = None,
+    n: Optional[int] = None,
+):
+    """Numpy twin of eval_device. `env` maps expression *nodes* (hashable)
+    to precomputed arrays — how aggregate results and group keys flow into
+    post-aggregation expressions."""
+
+    def ev(x):
+        return eval_host(x, cols, schema, env, n)
+
+    if env is not None and e in env:
+        return env[e]
+    if isinstance(e, ast.Column):
+        if e.name in cols:
+            return cols[e.name]
+        raise PlanError(f"unknown column {e.name!r}")
+    if isinstance(e, ast.Literal):
+        return np.nan if e.value is None else e.value
+    if isinstance(e, ast.Interval):
+        return e.nanos
+    if isinstance(e, ast.BinaryOp):
+        if e.op == "and":
+            return _np_bool(ev(e.left)) & _np_bool(ev(e.right))
+        if e.op == "or":
+            return _np_bool(ev(e.left)) | _np_bool(ev(e.right))
+        a, b = ev(e.left), ev(e.right)
+        if e.op == "like":
+            rx = _like_to_regex(str(b))
+            return np.asarray([v is not None and rx.fullmatch(str(v)) is not None
+                               for v in np.atleast_1d(a)])
+        ops = {
+            "+": lambda: a + b, "-": lambda: a - b, "*": lambda: a * b,
+            "%": lambda: a % b,
+            "=": lambda: _str_eq(a, b), "!=": lambda: ~_str_eq(a, b),
+            "<": lambda: a < b, "<=": lambda: a <= b,
+            ">": lambda: a > b, ">=": lambda: a >= b,
+        }
+        if e.op == "/":
+            if np.issubdtype(np.result_type(np.asarray(a), np.asarray(b)), np.integer):
+                return np.asarray(a) // np.asarray(b)
+            return np.asarray(a) / np.asarray(b)
+        if e.op in ops:
+            return ops[e.op]()
+        raise PlanError(f"unsupported host op {e.op!r}")
+    if isinstance(e, ast.UnaryOp):
+        v = ev(e.operand)
+        return ~_np_bool(v) if e.op == "not" else -v
+    if isinstance(e, ast.Between):
+        x = ev(e.expr)
+        res = (x >= ev(e.low)) & (x <= ev(e.high))
+        return ~res if e.negated else res
+    if isinstance(e, ast.InList):
+        x = np.asarray(ev(e.expr))
+        items = [_scalar(ev(i)) for i in e.items]
+        if x.dtype == object:
+            res = np.isin(x.astype(str), [str(i) for i in items])
+        else:
+            res = np.isin(x, items)
+        return ~res if e.negated else res
+    if isinstance(e, ast.IsNull):
+        v = np.asarray(ev(e.expr))
+        if v.dtype == object:
+            res = np.asarray([x is None for x in v])
+        elif np.issubdtype(v.dtype, np.floating):
+            res = np.isnan(v)
+        else:
+            res = np.zeros(v.shape, bool)
+        return ~res if e.negated else res
+    if isinstance(e, ast.FuncCall):
+        return _eval_host_func(e, ev, schema)
+    if isinstance(e, ast.Cast):
+        v = ev(e.expr)
+        t = e.type_name.lower()
+        if t in ("double", "float64", "float", "real", "float32"):
+            return np.asarray(v, dtype=np.float64)
+        if t in ("bigint", "int64", "int", "integer", "int32"):
+            return np.asarray(v).astype(np.int64)
+        if t in ("string", "varchar", "text"):
+            return np.asarray([None if x is None else str(x) for x in np.atleast_1d(v)],
+                              dtype=object)
+        if t.startswith("timestamp"):
+            from greptimedb_tpu.datatypes.types import parse_sql_type
+            dtype = parse_sql_type(t)
+            arr = np.atleast_1d(v)
+            return np.asarray([coerce_ts_literal(x, dtype) for x in arr], dtype=np.int64)
+        raise PlanError(f"unsupported cast to {e.type_name!r}")
+    if isinstance(e, ast.Case):
+        whens = e.whens
+        if e.operand is not None:
+            op = np.asarray(ev(e.operand))
+            conds = [_str_eq(op, ev(c)) for c, _ in whens]
+        else:
+            conds = [_np_bool(np.asarray(ev(c))) for c, _ in whens]
+        vals = [ev(v) for _, v in whens]
+        out = ev(e.else_) if e.else_ is not None else np.nan
+        res = np.select(conds, [np.broadcast_to(v, conds[0].shape) for v in vals],
+                        default=out)
+        return res
+    raise PlanError(f"cannot evaluate {e!r} on host")
+
+
+def _eval_host_func(e: ast.FuncCall, ev, schema):
+    name = e.name
+    np_funcs = {
+        "abs": np.abs, "sqrt": np.sqrt, "exp": np.exp, "ln": np.log,
+        "log": np.log, "log2": np.log2, "log10": np.log10,
+        "floor": np.floor, "ceil": np.ceil, "signum": np.sign,
+        "sin": np.sin, "cos": np.cos, "tan": np.tan, "trunc": np.trunc,
+    }
+    if name in np_funcs and len(e.args) == 1:
+        return np_funcs[name](np.asarray(ev(e.args[0]), dtype=np.float64))
+    if name in ("pow", "power"):
+        return np.power(ev(e.args[0]), ev(e.args[1]))
+    if name == "round":
+        v = np.asarray(ev(e.args[0]), dtype=np.float64)
+        d = int(_lit(e.args[1])) if len(e.args) > 1 else 0
+        return np.round(v, d)
+    if name in ("date_bin", "time_bucket"):
+        interval, ts_expr = e.args[0], e.args[1]
+        step = _interval_in_col_unit(interval, ts_expr, schema) if schema else _lit_interval(interval)
+        ts = np.asarray(ev(ts_expr))
+        return ts // step * step
+    if name == "now":
+        import time as _time
+        return int(_time.time() * 1000)
+    raise PlanError(f"unsupported host function {name!r}")
+
+
+def _lit_interval(e):
+    if isinstance(e, ast.Interval):
+        return e.nanos
+    raise PlanError("expected interval")
+
+
+def _np_bool(v):
+    v = np.asarray(v)
+    return v if v.dtype == bool else v != 0
+
+
+def _str_eq(a, b):
+    a_obj = isinstance(a, np.ndarray) and a.dtype == object
+    b_obj = isinstance(b, np.ndarray) and b.dtype == object
+    if a_obj or b_obj or isinstance(a, str) or isinstance(b, str):
+        av = a.astype(str) if isinstance(a, np.ndarray) else str(a)
+        bv = b.astype(str) if isinstance(b, np.ndarray) else str(b)
+        return np.asarray(av == bv)
+    return np.asarray(a == b)
+
+
+def _scalar(v):
+    arr = np.asarray(v)
+    return arr.item() if arr.ndim == 0 else v
+
+
+# ---- time-range extraction (scan pruning) ----------------------------------
+
+
+def extract_ts_bounds(
+    where: Optional[ast.Expr], ts_name: str, dtype: DataType
+) -> Optional[tuple[Optional[int], Optional[int]]]:
+    """Half-open [lo, hi) bounds on the time index from the conjunctive
+    prefix of WHERE (the reference's scan_region time-predicate pruning,
+    read/scan_region.rs:148)."""
+    if where is None:
+        return None
+    lo: Optional[int] = None
+    hi: Optional[int] = None
+
+    def visit(e):
+        nonlocal lo, hi
+        if isinstance(e, ast.BinaryOp) and e.op == "and":
+            visit(e.left)
+            visit(e.right)
+            return
+        if isinstance(e, ast.BinaryOp) and e.op in ("=", "<", "<=", ">", ">="):
+            side = None
+            if isinstance(e.left, ast.Column) and e.left.name == ts_name and isinstance(e.right, ast.Literal):
+                side = (e.op, e.right.value)
+            elif isinstance(e.right, ast.Column) and e.right.name == ts_name and isinstance(e.left, ast.Literal):
+                side = (_flip(e.op), e.left.value)
+            if side is None:
+                return
+            op, raw = side
+            try:
+                v = coerce_ts_literal(raw, dtype)
+            except (ValueError, TypeError):
+                return
+            if op == ">=":
+                lo = v if lo is None else max(lo, v)
+            elif op == ">":
+                lo = v + 1 if lo is None else max(lo, v + 1)
+            elif op == "<":
+                hi = v if hi is None else min(hi, v)
+            elif op == "<=":
+                hi = v + 1 if hi is None else min(hi, v + 1)
+            elif op == "=":
+                lo = v if lo is None else max(lo, v)
+                hi = v + 1 if hi is None else min(hi, v + 1)
+        if isinstance(e, ast.Between) and not e.negated:
+            if isinstance(e.expr, ast.Column) and e.expr.name == ts_name:
+                try:
+                    l = coerce_ts_literal(_lit(e.low), dtype)
+                    h = coerce_ts_literal(_lit(e.high), dtype)
+                except (ValueError, TypeError, PlanError):
+                    return
+                lo = l if lo is None else max(lo, l)
+                hi = h + 1 if hi is None else min(hi, h + 1)
+
+    visit(where)
+    if lo is None and hi is None:
+        return None
+    return lo, hi
+
+
+def collect_columns(e: Optional[ast.Expr], out: set[str]) -> set[str]:
+    """All column names referenced by an expression."""
+    if e is None:
+        return out
+    if isinstance(e, ast.Column):
+        out.add(e.name)
+    elif isinstance(e, ast.BinaryOp):
+        collect_columns(e.left, out)
+        collect_columns(e.right, out)
+    elif isinstance(e, ast.UnaryOp):
+        collect_columns(e.operand, out)
+    elif isinstance(e, ast.Between):
+        for x in (e.expr, e.low, e.high):
+            collect_columns(x, out)
+    elif isinstance(e, ast.InList):
+        collect_columns(e.expr, out)
+        for i in e.items:
+            collect_columns(i, out)
+    elif isinstance(e, ast.IsNull):
+        collect_columns(e.expr, out)
+    elif isinstance(e, ast.FuncCall):
+        for a in e.args:
+            collect_columns(a, out)
+    elif isinstance(e, ast.Cast):
+        collect_columns(e.expr, out)
+    elif isinstance(e, ast.Case):
+        if e.operand:
+            collect_columns(e.operand, out)
+        for c, v in e.whens:
+            collect_columns(c, out)
+            collect_columns(v, out)
+        if e.else_:
+            collect_columns(e.else_, out)
+    return out
+
+
+def has_aggregate(e: Optional[ast.Expr]) -> bool:
+    if e is None:
+        return False
+    if isinstance(e, ast.FuncCall):
+        if e.name in AGG_FUNCS:
+            return True
+        return any(has_aggregate(a) for a in e.args)
+    if isinstance(e, ast.BinaryOp):
+        return has_aggregate(e.left) or has_aggregate(e.right)
+    if isinstance(e, ast.UnaryOp):
+        return has_aggregate(e.operand)
+    if isinstance(e, ast.Between):
+        return any(has_aggregate(x) for x in (e.expr, e.low, e.high))
+    if isinstance(e, ast.InList):
+        return has_aggregate(e.expr) or any(has_aggregate(i) for i in e.items)
+    if isinstance(e, ast.IsNull):
+        return has_aggregate(e.expr)
+    if isinstance(e, ast.Cast):
+        return has_aggregate(e.expr)
+    if isinstance(e, ast.Case):
+        parts = [e.operand, e.else_] + [x for w in e.whens for x in w]
+        return any(has_aggregate(p) for p in parts if p is not None)
+    return False
+
+
+def collect_aggregates(e: Optional[ast.Expr], out: list) -> list:
+    """All aggregate FuncCall nodes in an expression (deduplicated)."""
+    if e is None:
+        return out
+    if isinstance(e, ast.FuncCall) and e.name in AGG_FUNCS:
+        if e not in out:
+            out.append(e)
+        return out
+    if isinstance(e, ast.FuncCall):
+        for a in e.args:
+            collect_aggregates(a, out)
+    elif isinstance(e, ast.BinaryOp):
+        collect_aggregates(e.left, out)
+        collect_aggregates(e.right, out)
+    elif isinstance(e, ast.UnaryOp):
+        collect_aggregates(e.operand, out)
+    elif isinstance(e, ast.Between):
+        for x in (e.expr, e.low, e.high):
+            collect_aggregates(x, out)
+    elif isinstance(e, ast.Case):
+        for w in e.whens:
+            collect_aggregates(w[0], out)
+            collect_aggregates(w[1], out)
+        if e.operand:
+            collect_aggregates(e.operand, out)
+        if e.else_:
+            collect_aggregates(e.else_, out)
+    elif isinstance(e, ast.Cast):
+        collect_aggregates(e.expr, out)
+    elif isinstance(e, ast.InList):
+        collect_aggregates(e.expr, out)
+    elif isinstance(e, ast.IsNull):
+        collect_aggregates(e.expr, out)
+    return out
+
+
+AGG_FUNCS = {
+    "count", "sum", "avg", "mean", "min", "max", "first", "last",
+    "last_value", "first_value", "stddev", "variance",
+}

@@ -1,0 +1,628 @@
+"""Physical execution: logical plan -> streamed device kernels.
+
+The execution model (TPU-first re-design of the reference's volcano-style
+async streams, SURVEY.md §7):
+
+  host scan (pruned, columnar)  ->  fixed-shape padded blocks  ->
+  one fused jit kernel per block: filter mask + group ids + segment
+  reductions  ->  device partial-aggregate combine across blocks  ->
+  tiny host tail (decode group keys, HAVING/ORDER/LIMIT over G rows)
+
+Everything static (expressions, key specs, ops) rides into jit as hashable
+static arguments, so each query shape compiles once and is cached by jax.
+Dedup (last-write-wins) runs as a whole-scan device sort when the table is
+not append-mode.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from greptimedb_tpu.datatypes.types import DataType, SemanticType
+from greptimedb_tpu.ops.blocks import DEFAULT_BLOCK_ROWS, block_size_for, make_mask, pad_rows
+from greptimedb_tpu.ops.dedup import sort_dedup
+from greptimedb_tpu.ops.segment import combine_group_ids, segment_agg
+from greptimedb_tpu.query import logical as lp
+from greptimedb_tpu.query.expr import (
+    BindContext,
+    PlanError,
+    bind_expr,
+    eval_device,
+    eval_host,
+)
+from greptimedb_tpu.query.result import QueryResult
+from greptimedb_tpu.sql import ast
+from greptimedb_tpu.storage.engine import RegionEngine
+from greptimedb_tpu.storage.region import ScanData
+
+MAX_GROUPS = 1 << 24
+
+# primitive kernel ops backing each SQL aggregate
+_PRIMITIVES = {
+    "sum": ("sum", "count"),  # count detects all-NULL groups -> NULL sum
+    "count": ("count",),
+    "rows": ("rows",),
+    "avg": ("sum", "count"),
+    "min": ("min",),
+    "max": ("max",),
+    "first": ("first",),
+    "last": ("last",),
+    "stddev": ("sum", "sumsq", "count"),
+    "variance": ("sum", "sumsq", "count"),
+}
+
+
+@dataclass(frozen=True)
+class DeviceKey:
+    """One group-by key computed on device (static under jit)."""
+
+    kind: str  # "tag" | "bucket" | "pre"
+    column: str
+    size: int
+    step: int = 0  # bucket width in the column's storage unit
+    base: int = 0  # minimum bucket index (offsets ids to 0)
+
+
+# ---- fused per-block kernel ------------------------------------------------
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("where", "keys", "agg_args", "ops", "num_segments",
+                     "ts_name", "tag_names", "schema", "need_ts"),
+)
+def _agg_block(
+    cols: dict,
+    valid: jax.Array,
+    *,
+    where,
+    keys: tuple[DeviceKey, ...],
+    agg_args: tuple,
+    ops: tuple[str, ...],
+    num_segments: int,
+    ts_name: str,
+    tag_names: frozenset,
+    schema,
+    need_ts: bool,
+):
+    mask = valid
+    if where is not None:
+        w = eval_device(where, cols, tag_names, schema)
+        mask = mask & (w if w.dtype == jnp.bool_ else w != 0)
+    if keys:
+        key_arrays = []
+        for k in keys:
+            c = cols[k.column]
+            if k.kind == "tag":
+                arr = (c + 1).astype(jnp.int32)
+            elif k.kind == "bucket":
+                arr = (c // k.step - k.base).astype(jnp.int32)
+            else:
+                arr = c.astype(jnp.int32)
+            key_arrays.append(jnp.clip(arr, 0, k.size - 1))
+        gid = combine_group_ids(key_arrays, tuple(k.size for k in keys))
+    else:
+        gid = jnp.zeros(valid.shape[0], dtype=jnp.int32)
+    if agg_args:
+        vals = [eval_device(a, cols, tag_names, schema) for a in agg_args]
+        vals = [
+            jnp.broadcast_to(v, valid.shape).astype(jnp.float64)
+            if jnp.ndim(v) == 0 else v.astype(jnp.float64)
+            for v in vals
+        ]
+        values = jnp.stack(vals, axis=1)
+    else:
+        values = jnp.zeros((valid.shape[0], 1), dtype=jnp.float64)
+    ts = cols[ts_name] if need_ts else None
+    return segment_agg(values, gid, mask, num_segments, ops=ops, ts=ts)
+
+
+@functools.partial(jax.jit, static_argnames=("where", "tag_names", "schema"))
+def _filter_block(cols: dict, valid: jax.Array, *, where, tag_names, schema):
+    mask = valid
+    if where is not None:
+        w = eval_device(where, cols, tag_names, schema)
+        mask = mask & (w if w.dtype == jnp.bool_ else w != 0)
+    return mask
+
+
+@jax.jit
+def _dedup_mask(sid, ts, seq, op_type, valid):
+    order, keep = sort_dedup(sid, ts, seq, op_type, valid)
+    mask = jnp.zeros(valid.shape, dtype=bool)
+    return mask.at[order].set(keep)
+
+
+def _combine_partials(acc: Optional[dict], p: dict) -> dict:
+    if acc is None:
+        return p
+    out = {}
+    for k, v in p.items():
+        a = acc[k]
+        if k in ("sum", "count", "rows", "sumsq"):
+            out[k] = a + v
+        elif k == "min":
+            out[k] = jnp.fmin(a, v)
+        elif k == "max":
+            out[k] = jnp.fmax(a, v)
+        elif k in ("last", "last_ts", "first", "first_ts"):
+            continue  # handled below as pairs
+        else:
+            raise PlanError(f"cannot combine partial op {k}")
+    if "last" in p:
+        newer = p["last_ts"] > acc["last_ts"]
+        out["last"] = jnp.where(newer[:, None], p["last"], acc["last"])
+        out["last_ts"] = jnp.where(newer, p["last_ts"], acc["last_ts"])
+    if "first" in p:
+        older = p["first_ts"] < acc["first_ts"]
+        out["first"] = jnp.where(older[:, None], p["first"], acc["first"])
+        out["first_ts"] = jnp.where(older, p["first_ts"], acc["first_ts"])
+    return out
+
+
+# ---- executor --------------------------------------------------------------
+
+
+class PhysicalExecutor:
+    def __init__(self, engine: RegionEngine):
+        self.engine = engine
+
+    def execute(self, plan: lp.LogicalPlan) -> QueryResult:
+        # unwrap the linear chain
+        limit = offset = None
+        sort: Optional[lp.Sort] = None
+        node = plan
+        if isinstance(node, lp.Limit):
+            limit, offset = node.limit, node.offset
+            node = node.input
+        if isinstance(node, lp.Sort):
+            sort = node
+            node = node.input
+        if not isinstance(node, lp.Project):
+            raise PlanError(f"unexpected plan root {type(node).__name__}")
+        project = node
+        node = node.input
+        having: Optional[lp.Having] = None
+        if isinstance(node, lp.Having):
+            having = node
+            node = node.input
+        agg: Optional[lp.Aggregate] = None
+        if isinstance(node, lp.Aggregate):
+            agg = node
+            node = node.input
+        where = None
+        if isinstance(node, lp.Filter):
+            where = node.predicate
+            node = node.input
+        if not isinstance(node, lp.Scan):
+            raise PlanError(f"unexpected scan node {type(node).__name__}")
+        scan_node = node
+
+        table = scan_node.table
+        region_id = table.region_ids[0]
+        ts_range = _closed_range(scan_node.ts_range)
+        scan = self.engine.scan(region_id, ts_range, scan_node.columns)
+
+        if agg is not None:
+            return self._execute_agg(scan, table, where, agg, having, project, sort,
+                                     limit, offset, scan_node)
+        return self._execute_raw(scan, table, where, project, sort, limit, offset)
+
+    # ---- aggregate path ----------------------------------------------------
+
+    def _execute_agg(self, scan, table, where, agg, having, project, sort,
+                     limit, offset, scan_node) -> QueryResult:
+        schema = table.schema
+        ts_name = schema.time_index.name
+        if scan is None:
+            return self._empty_agg_result(table, agg, having, project, sort, limit, offset)
+
+        ctx = BindContext(schema, scan.tag_dicts)
+        bound_where = bind_expr(where, ctx) if where is not None else None
+
+        # group keys -> DeviceKeys (+ host factorized pre-keys)
+        keys: list[DeviceKey] = []
+        decoders = []  # per key: fn(int indices) -> value array, dtype
+        extra_cols: dict[str, np.ndarray] = {}
+        for i, (name, kexpr) in enumerate(agg.keys):
+            dk, decode = self._plan_key(i, kexpr, ctx, scan, scan_node, extra_cols)
+            keys.append(dk)
+            decoders.append(decode)
+        num_groups = 1
+        for k in keys:
+            num_groups *= k.size
+        if num_groups > MAX_GROUPS:
+            raise PlanError(
+                f"group cardinality {num_groups} exceeds {MAX_GROUPS}; "
+                "add predicates or reduce keys"
+            )
+
+        # aggregate args -> values matrix columns
+        arg_exprs: list[ast.Expr] = []
+        spec_slot: list[Optional[int]] = []
+        for spec in agg.aggs:
+            if spec.arg is None:
+                spec_slot.append(None)
+                continue
+            b = bind_expr(spec.arg, ctx)
+            if b not in arg_exprs:
+                arg_exprs.append(b)
+            spec_slot.append(arg_exprs.index(b))
+        ops: set = {"rows"}
+        for spec in agg.aggs:
+            ops.update(_PRIMITIVES[spec.func])
+        need_ts = bool({"first", "last"} & ops)
+
+        acc = self._stream_agg(scan, table, bound_where, tuple(keys),
+                               tuple(arg_exprs), tuple(sorted(ops)), num_groups,
+                               ts_name, ctx, extra_cols)
+
+        # finalize on host over G rows
+        acc = {k: np.asarray(v) for k, v in acc.items()}
+        rows = acc["rows"][:, 0] if acc["rows"].ndim == 2 else acc["rows"]
+        if agg.keys:
+            present = np.flatnonzero(rows > 0)
+        else:
+            present = np.arange(1)
+        env: dict = {}
+        # decode group key columns
+        strides = _strides([k.size for k in keys])
+        key_cols: dict[str, tuple[np.ndarray, Optional[DataType]]] = {}
+        for i, ((name, kexpr), decode) in enumerate(zip(agg.keys, decoders)):
+            idx = (present // strides[i]) % keys[i].size
+            col, dtype = decode(idx)
+            env[kexpr] = col
+            key_cols[name] = (col, dtype)
+        # aggregate outputs
+        for spec, slot in zip(agg.aggs, spec_slot):
+            env[spec.call] = _finalize_agg(spec.func, acc, slot, present)
+
+        return self._post_process(env, agg, having, project, sort, limit, offset,
+                                  table, len(present))
+
+    def _plan_key(self, i, kexpr, ctx, scan: ScanData, scan_node, extra_cols):
+        schema = ctx.schema
+        ts_col = schema.time_index
+        if isinstance(kexpr, ast.Column) and kexpr.name in ctx.tag_names:
+            name = kexpr.name
+            card = len(scan.tag_dicts[name])
+            values = scan.tag_dicts[name]
+
+            def decode_tag(idx, values=values):
+                out = np.empty(len(idx), dtype=object)
+                codes = idx - 1
+                valid = codes >= 0
+                out[valid] = values[codes[valid]]
+                out[~valid] = None
+                return out, DataType.STRING
+
+            return DeviceKey("tag", name, card + 1), decode_tag
+        if (isinstance(kexpr, ast.FuncCall) and kexpr.name in ("date_bin", "time_bucket")
+                and isinstance(kexpr.args[0], ast.Interval)
+                and isinstance(kexpr.args[1], ast.Column)
+                and kexpr.args[1].name == ts_col.name):
+            unit = ts_col.dtype.time_unit.nanos_per_unit
+            step = max(kexpr.args[0].nanos // unit, 1)
+            ts_arr = scan.columns[ts_col.name]
+            lo, hi = self._ts_bounds(scan_node, ts_arr)
+            base = lo // step - (1 if lo % step and lo < 0 else 0)
+            base = int(np.floor_divide(lo, step))
+            size = int(np.floor_divide(hi, step)) - base + 1
+
+            def decode_bucket(idx, step=step, base=base, dtype=ts_col.dtype):
+                return (idx.astype(np.int64) + base) * step, dtype
+
+            return DeviceKey("bucket", ts_col.name, size, step=step, base=base), decode_bucket
+        # generic expression: factorize on host
+        host_cols = dict(scan.columns)
+        for c in schema.tag_columns:
+            if c.name in host_cols:
+                from greptimedb_tpu.datatypes.vector import DictVector
+                host_cols[c.name] = DictVector(
+                    scan.columns[c.name], scan.tag_dicts[c.name]
+                ).decode()
+        vals = np.asarray(eval_host(kexpr, host_cols, schema))
+        if np.ndim(vals) == 0:
+            vals = np.broadcast_to(vals, (scan.num_rows,))
+        uniq, inverse = np.unique(vals, return_inverse=True)
+        colname = f"__key_{i}"
+        extra_cols[colname] = inverse.astype(np.int32)
+        out_dtype = None
+        if isinstance(kexpr, ast.Column) and kexpr.name in schema.names:
+            out_dtype = schema.column(kexpr.name).dtype
+
+        def decode_pre(idx, uniq=uniq, out_dtype=out_dtype):
+            return uniq[idx], out_dtype
+
+        return DeviceKey("pre", colname, max(len(uniq), 1)), decode_pre
+
+    def _ts_bounds(self, scan_node, ts_arr) -> tuple[int, int]:
+        lo = hi = None
+        if scan_node.ts_range is not None:
+            lo, hi0 = scan_node.ts_range
+            hi = None if hi0 is None else hi0 - 1
+        if lo is None:
+            lo = int(ts_arr.min())
+        if hi is None:
+            hi = int(ts_arr.max())
+        return lo, hi
+
+    def _stream_agg(self, scan: ScanData, table, bound_where, keys, arg_exprs,
+                    ops, num_groups, ts_name, ctx, extra_cols):
+        schema = table.schema
+        device_col_names = self._device_columns(
+            scan, bound_where, keys, arg_exprs, ts_name, extra_cols
+        )
+        n = scan.num_rows
+        dedup_mask = self._maybe_dedup(scan, table, ctx)
+        block = min(block_size_for(n), DEFAULT_BLOCK_ROWS)
+        tag_names = frozenset(ctx.tag_names)
+        acc = None
+        for start in range(0, n, block):
+            end = min(start + block, n)
+            cols = {}
+            for name in device_col_names:
+                src = extra_cols[name] if name in extra_cols else scan.columns[name]
+                cols[name] = jnp.asarray(pad_rows(src[start:end], block))
+            valid = make_mask(end - start, block)
+            if dedup_mask is not None:
+                valid = valid & pad_rows(np.asarray(dedup_mask[start:end]), block, fill=False)
+            partial = _agg_block(
+                cols, jnp.asarray(valid),
+                where=bound_where, keys=keys, agg_args=arg_exprs, ops=ops,
+                num_segments=num_groups, ts_name=ts_name,
+                tag_names=tag_names, schema=schema,
+                need_ts=bool({"first", "last"} & set(ops)),
+            )
+            acc = _combine_partials(acc, partial)
+        return acc
+
+    def _device_columns(self, scan, bound_where, keys, arg_exprs, ts_name, extra_cols):
+        from greptimedb_tpu.query.expr import collect_columns
+
+        needed: set[str] = set()
+        collect_columns(bound_where, needed)
+        for a in arg_exprs:
+            collect_columns(a, needed)
+        for k in keys:
+            needed.add(k.column)
+        needed.add(ts_name)
+        avail = set(scan.columns) | set(extra_cols)
+        missing = needed - avail
+        if missing:
+            raise PlanError(f"columns missing from scan: {sorted(missing)}")
+        return sorted(needed)
+
+    def _maybe_dedup(self, scan: ScanData, table, ctx) -> Optional[np.ndarray]:
+        if table.append_mode or not scan.needs_dedup:
+            return None
+        tag_names = [c.name for c in table.schema.tag_columns]
+        if tag_names:
+            sizes = [len(scan.tag_dicts[t]) + 1 for t in tag_names]
+            sid = combine_group_ids(
+                [jnp.asarray(scan.columns[t]) + 1 for t in tag_names],
+                sizes, dtype=jnp.int64,
+            )
+        else:
+            sid = jnp.zeros(scan.num_rows, dtype=jnp.int64)
+        ts = jnp.asarray(scan.columns[table.schema.time_index.name])
+        mask = _dedup_mask(sid, ts, jnp.asarray(scan.seq),
+                           jnp.asarray(scan.op_type),
+                           jnp.ones(scan.num_rows, dtype=bool))
+        return np.asarray(mask)
+
+    # ---- raw (non-aggregate) path ------------------------------------------
+
+    def _execute_raw(self, scan, table, where, project, sort, limit, offset) -> QueryResult:
+        schema = table.schema
+        if scan is None:
+            return _project_empty(project, schema)
+        ctx = BindContext(schema, scan.tag_dicts)
+        bound_where = bind_expr(where, ctx) if where is not None else None
+        dedup_mask = self._maybe_dedup(scan, table, ctx)
+        n = scan.num_rows
+        block = min(block_size_for(n), DEFAULT_BLOCK_ROWS)
+        tag_names = frozenset(ctx.tag_names)
+        picked: list[np.ndarray] = []
+        for start in range(0, n, block):
+            end = min(start + block, n)
+            cols = {
+                name: jnp.asarray(pad_rows(arr[start:end], block))
+                for name, arr in scan.columns.items()
+            }
+            valid = make_mask(end - start, block)
+            if dedup_mask is not None:
+                valid = valid & pad_rows(dedup_mask[start:end], block, fill=False)
+            mask = _filter_block(cols, jnp.asarray(valid), where=bound_where,
+                                 tag_names=tag_names, schema=schema)
+            picked.append(np.flatnonzero(np.asarray(mask)) + start)
+        idx = np.concatenate(picked) if picked else np.empty(0, dtype=np.int64)
+
+        # gather + decode on host
+        host_cols: dict[str, np.ndarray] = {}
+        for name, arr in scan.columns.items():
+            taken = arr[idx]
+            if name in scan.tag_dicts:
+                from greptimedb_tpu.datatypes.vector import DictVector
+                taken = DictVector(taken, scan.tag_dicts[name]).decode()
+            host_cols[name] = taken
+
+        env: dict = {}
+        return self._post_process(env, None, None, project, sort, limit, offset,
+                                  table, len(idx), host_cols=host_cols)
+
+    # ---- shared tail: project/having/sort/limit over host arrays -----------
+
+    def _post_process(self, env, agg, having, project, sort, limit, offset,
+                      table, nrows, host_cols=None) -> QueryResult:
+        schema = table.schema
+        host_cols = host_cols or {}
+
+        if having is not None:
+            m = np.asarray(eval_host(having.predicate, host_cols, schema, env))
+            m = m if m.dtype == bool else m != 0
+            m = np.broadcast_to(m, (nrows,))
+            env = {k: v[m] if isinstance(v, np.ndarray) and v.ndim >= 1 and len(v) == nrows else v
+                   for k, v in env.items()}
+            host_cols = {k: v[m] for k, v in host_cols.items()}
+            nrows = int(m.sum())
+
+        out_cols: list[np.ndarray] = []
+        out_names: list[str] = []
+        out_dtypes: list[Optional[DataType]] = []
+        for name, e in project.items:
+            v = eval_host(e, host_cols, schema, env)
+            arr = np.asarray(v)
+            if arr.ndim == 0:
+                arr = np.broadcast_to(arr, (nrows,)).copy()
+            out_cols.append(arr)
+            out_names.append(name)
+            out_dtypes.append(_infer_dtype(e, schema))
+
+        if sort is not None and nrows > 1:
+            order = _host_sort_order(sort.keys, project, out_names, out_cols,
+                                     host_cols, schema, env)
+            out_cols = [c[order] for c in out_cols]
+        if offset:
+            out_cols = [c[offset:] for c in out_cols]
+        if limit is not None:
+            out_cols = [c[:limit] for c in out_cols]
+        return QueryResult(out_names, out_dtypes, out_cols)
+
+    def _empty_agg_result(self, table, agg, having, project, sort, limit, offset):
+        # no data: global aggregates still yield one row
+        env: dict = {}
+        nrows = 0 if agg.keys else 1
+        for name, kexpr in agg.keys:
+            env[kexpr] = np.empty(0, dtype=object)
+        for spec in agg.aggs:
+            if spec.func in ("count", "rows"):
+                env[spec.call] = np.zeros(nrows, dtype=np.int64)
+            else:
+                env[spec.call] = np.full(nrows, np.nan)
+        return self._post_process(env, agg, having, project, sort, limit, offset,
+                                  table, nrows)
+
+
+# ---- helpers ---------------------------------------------------------------
+
+
+def _closed_range(ts_range):
+    if ts_range is None:
+        return None
+    lo, hi = ts_range
+    return (lo if lo is not None else -(1 << 62), hi if hi is not None else (1 << 62))
+
+
+def _strides(sizes: list[int]) -> list[int]:
+    strides = [1] * len(sizes)
+    for i in range(len(sizes) - 2, -1, -1):
+        strides[i] = strides[i + 1] * sizes[i + 1]
+    return strides
+
+
+def _finalize_agg(func: str, acc: dict, slot: Optional[int], present: np.ndarray):
+    def get(op):
+        v = acc[op]
+        if v.ndim == 2:
+            v = v[:, slot if slot is not None else 0]
+        return v[present]
+
+    if func == "rows":
+        return get("rows").astype(np.int64)
+    if func == "count":
+        return get("count").astype(np.int64)
+    if func == "sum":
+        s, c = get("sum"), get("count")
+        return np.where(c > 0, s, np.nan)
+    if func == "avg":
+        s, c = get("sum"), get("count")
+        with np.errstate(invalid="ignore", divide="ignore"):
+            return np.where(c > 0, s / np.maximum(c, 1), np.nan)
+    if func in ("min", "max", "first", "last"):
+        return get(func)
+    if func in ("stddev", "variance"):
+        s, ss, c = get("sum"), get("sumsq"), get("count")
+        with np.errstate(invalid="ignore", divide="ignore"):
+            var = (ss - s * s / np.maximum(c, 1)) / np.maximum(c - 1, 1)
+            var = np.where(c > 1, np.maximum(var, 0.0), np.nan)
+        return np.sqrt(var) if func == "stddev" else var
+    raise PlanError(f"unknown aggregate {func}")
+
+
+def _infer_dtype(e: ast.Expr, schema) -> Optional[DataType]:
+    if isinstance(e, ast.Column) and e.name in schema.names:
+        return schema.column(e.name).dtype
+    if isinstance(e, ast.FuncCall):
+        if e.name in ("date_bin", "time_bucket", "date_trunc"):
+            ts_arg = e.args[1] if len(e.args) > 1 else None
+            if isinstance(ts_arg, ast.Column) and ts_arg.name in schema.names:
+                return schema.column(ts_arg.name).dtype
+        if e.name == "count":
+            return DataType.INT64
+        if e.name in ("min", "max", "first", "last", "first_value", "last_value"):
+            arg = e.args[0] if e.args else None
+            if isinstance(arg, ast.Column) and arg.name in schema.names:
+                dt = schema.column(arg.name).dtype
+                if dt.is_timestamp:
+                    return dt
+            return DataType.FLOAT64
+        return DataType.FLOAT64
+    if isinstance(e, ast.Literal):
+        if isinstance(e.value, bool):
+            return DataType.BOOL
+        if isinstance(e.value, int):
+            return DataType.INT64
+        if isinstance(e.value, float):
+            return DataType.FLOAT64
+        if isinstance(e.value, str):
+            return DataType.STRING
+    return None
+
+
+def _host_sort_order(keys, project, out_names, out_cols, host_cols, schema, env):
+    sort_arrays = []
+    nrows = len(out_cols[0]) if out_cols else 0
+    by_name = dict(zip(out_names, out_cols))
+    for k in reversed(keys):  # lexsort: primary key last
+        if isinstance(k.expr, ast.Column) and k.expr.name in by_name:
+            arr = by_name[k.expr.name]
+        else:
+            arr = np.asarray(eval_host(k.expr, host_cols, schema, env))
+            if arr.ndim == 0:
+                arr = np.broadcast_to(arr, (nrows,))
+        arr = _sortable(arr, k.asc, k.nulls_first)
+        sort_arrays.append(arr)
+    return np.lexsort(sort_arrays)
+
+
+def _sortable(arr: np.ndarray, asc: bool, nulls_first: Optional[bool]) -> np.ndarray:
+    if arr.dtype == object:
+        mask = np.asarray([v is None for v in arr])
+        filled = np.where(mask, "", arr.astype(str))
+        uniq, codes = np.unique(filled, return_inverse=True)
+        key = codes.astype(np.float64)
+        key[mask] = np.nan
+    else:
+        key = arr.astype(np.float64)
+    isnan = np.isnan(key)
+    if not asc:
+        key = -key
+    # SQL default: NULLS LAST for ASC, NULLS FIRST for DESC
+    nf = nulls_first if nulls_first is not None else (not asc)
+    key = np.where(isnan, -np.inf if nf else np.inf, key)
+    return key
+
+
+def _project_empty(project, schema) -> QueryResult:
+    names = [n for n, _ in project.items]
+    dtypes = [_infer_dtype(e, schema) for _, e in project.items]
+    cols = [np.empty(0) for _ in project.items]
+    return QueryResult(names, dtypes, cols)

@@ -1,0 +1,244 @@
+"""SQL AST (mirrors reference src/sql/src/statements/, 17 modules)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence, Union
+
+
+# ---- expressions -----------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Expr:
+    pass
+
+
+@dataclass(frozen=True)
+class Column(Expr):
+    name: str
+    table: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class Literal(Expr):
+    value: object  # int | float | str | bool | None
+
+
+@dataclass(frozen=True)
+class Interval(Expr):
+    nanos: int
+    text: str = ""
+
+
+@dataclass(frozen=True)
+class BinaryOp(Expr):
+    op: str  # + - * / % = != < <= > >= and or like
+    left: Expr
+    right: Expr
+
+
+@dataclass(frozen=True)
+class UnaryOp(Expr):
+    op: str  # - not
+    operand: Expr
+
+
+@dataclass(frozen=True)
+class FuncCall(Expr):
+    name: str  # lowercased
+    args: tuple[Expr, ...] = ()
+    distinct: bool = False
+
+
+@dataclass(frozen=True)
+class Star(Expr):
+    pass
+
+
+@dataclass(frozen=True)
+class Between(Expr):
+    expr: Expr
+    low: Expr
+    high: Expr
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class InList(Expr):
+    expr: Expr
+    items: tuple[Expr, ...]
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class IsNull(Expr):
+    expr: Expr
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class Cast(Expr):
+    expr: Expr
+    type_name: str
+
+
+@dataclass(frozen=True)
+class Case(Expr):
+    operand: Optional[Expr]
+    whens: tuple[tuple[Expr, Expr], ...]
+    else_: Optional[Expr]
+
+
+# ---- statements ------------------------------------------------------------
+
+
+@dataclass
+class Statement:
+    pass
+
+
+@dataclass
+class SelectItem:
+    expr: Expr
+    alias: Optional[str] = None
+
+
+@dataclass
+class OrderByItem:
+    expr: Expr
+    asc: bool = True
+    nulls_first: Optional[bool] = None
+
+
+@dataclass
+class Select(Statement):
+    items: list[SelectItem]
+    table: Optional[str] = None  # single-table FROM (joins later)
+    distinct: bool = False
+    where: Optional[Expr] = None
+    group_by: list[Expr] = field(default_factory=list)
+    having: Optional[Expr] = None
+    order_by: list[OrderByItem] = field(default_factory=list)
+    limit: Optional[int] = None
+    offset: Optional[int] = None
+    # RANGE ... ALIGN extension (reference query/src/range_select)
+    align: Optional[Interval] = None
+    align_to: Optional[Expr] = None
+    align_by: list[Expr] = field(default_factory=list)
+    range_fill: Optional[str] = None
+
+
+@dataclass
+class ColumnDef:
+    name: str
+    type_name: str
+    nullable: bool = True
+    is_time_index: bool = False
+    is_primary_key: bool = False
+    default: Optional[Expr] = None
+
+
+@dataclass
+class CreateTable(Statement):
+    name: str
+    columns: list[ColumnDef]
+    time_index: Optional[str] = None
+    primary_keys: list[str] = field(default_factory=list)
+    if_not_exists: bool = False
+    engine: str = "mito"
+    options: dict = field(default_factory=dict)
+    partitions: Optional[list] = None  # partition bound exprs
+
+
+@dataclass
+class CreateDatabase(Statement):
+    name: str
+    if_not_exists: bool = False
+
+
+@dataclass
+class Insert(Statement):
+    table: str
+    columns: list[str]
+    rows: list[list[Expr]]
+    select: Optional[Select] = None
+
+
+@dataclass
+class Delete(Statement):
+    table: str
+    where: Optional[Expr] = None
+
+
+@dataclass
+class DropTable(Statement):
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
+class TruncateTable(Statement):
+    name: str
+
+
+@dataclass
+class ShowTables(Statement):
+    database: Optional[str] = None
+    like: Optional[str] = None
+
+
+@dataclass
+class ShowDatabases(Statement):
+    pass
+
+
+@dataclass
+class ShowCreateTable(Statement):
+    name: str
+
+
+@dataclass
+class DescribeTable(Statement):
+    name: str
+
+
+@dataclass
+class Explain(Statement):
+    inner: Statement
+    analyze: bool = False
+
+
+@dataclass
+class Use(Statement):
+    database: str
+
+
+@dataclass
+class Tql(Statement):
+    """TQL EVAL (start, end, step) <promql> — PromQL embedded in SQL
+    (reference src/sql parser TQL extension + operator/src/statement/tql.rs)."""
+
+    start: float
+    end: float
+    step: float
+    query: str
+    analyze: bool = False
+    explain: bool = False
+
+
+@dataclass
+class AlterTable(Statement):
+    name: str
+    action: str  # add_column | drop_column | rename
+    column: Optional[ColumnDef] = None
+    column_name: Optional[str] = None
+    new_name: Optional[str] = None
+
+
+@dataclass
+class AdminFunc(Statement):
+    """ADMIN flush_table(...) / compact_table(...) (reference
+    common/function administration functions)."""
+
+    func: FuncCall

@@ -1,0 +1,78 @@
+"""SQL tokenizer."""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+
+KEYWORDS = {
+    "select", "from", "where", "group", "by", "having", "order", "limit",
+    "offset", "asc", "desc", "and", "or", "not", "in", "between", "like",
+    "is", "null", "true", "false", "as", "distinct", "create", "table",
+    "database", "schema", "if", "exists", "primary", "key", "time", "index",
+    "engine", "with", "insert", "into", "values", "delete", "drop", "show",
+    "tables", "databases", "describe", "desc", "explain", "analyze", "use",
+    "interval", "cast", "case", "when", "then", "else", "end", "truncate",
+    "alter", "add", "column", "rename", "to", "tql", "eval", "evaluate",
+    "align", "range", "fill", "partition", "on", "nulls", "first", "last",
+    "admin", "verbose", "copy", "default",
+}
+
+_TOKEN_RE = re.compile(
+    r"""
+    (?P<ws>\s+)
+  | (?P<comment>--[^\n]*|/\*.*?\*/)
+  | (?P<number>\d+\.\d*(?:[eE][+-]?\d+)?|\.\d+(?:[eE][+-]?\d+)?|\d+(?:[eE][+-]?\d+)?)
+  | (?P<string>'(?:[^']|'')*')
+  | (?P<qident>"(?:[^"]|"")*"|`(?:[^`]|``)*`)
+  | (?P<ident>[A-Za-z_][A-Za-z0-9_]*)
+  | (?P<op><=|>=|!=|<>|\|\||::|[-+*/%(),.=<>;@\[\]])
+    """,
+    re.VERBOSE | re.DOTALL,
+)
+
+
+@dataclass(frozen=True)
+class Token:
+    kind: str  # keyword | ident | number | string | op | eof
+    value: str
+    pos: int
+
+    def __repr__(self):
+        return f"{self.kind}:{self.value}"
+
+
+class SqlError(Exception):
+    pass
+
+
+def tokenize(sql: str) -> list[Token]:
+    tokens: list[Token] = []
+    pos = 0
+    while pos < len(sql):
+        m = _TOKEN_RE.match(sql, pos)
+        if m is None:
+            raise SqlError(f"unexpected character {sql[pos]!r} at {pos}")
+        pos = m.end()
+        kind = m.lastgroup
+        text = m.group()
+        if kind in ("ws", "comment"):
+            continue
+        if kind == "ident":
+            low = text.lower()
+            if low in KEYWORDS:
+                tokens.append(Token("keyword", low, m.start()))
+            else:
+                tokens.append(Token("ident", text, m.start()))
+        elif kind == "qident":
+            q = text[0]
+            inner = text[1:-1].replace(q * 2, q)
+            tokens.append(Token("ident", inner, m.start()))
+        elif kind == "string":
+            tokens.append(Token("string", text[1:-1].replace("''", "'"), m.start()))
+        elif kind == "number":
+            tokens.append(Token("number", text, m.start()))
+        else:
+            tokens.append(Token("op", text, m.start()))
+    tokens.append(Token("eof", "", len(sql)))
+    return tokens

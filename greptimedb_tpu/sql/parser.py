@@ -1,0 +1,716 @@
+"""Recursive-descent SQL parser (mirrors reference
+src/sql/src/parser.rs `ParserContext` + statement parsers).
+
+Supports the subset the sqlness suite exercises most: SELECT (aggregates,
+date_bin/time bucketing, WHERE/GROUP/HAVING/ORDER/LIMIT), CREATE TABLE with
+TIME INDEX/PRIMARY KEY/engine/options, CREATE DATABASE, INSERT .. VALUES,
+DELETE, DROP/TRUNCATE/ALTER TABLE, SHOW TABLES/DATABASES/CREATE TABLE,
+DESCRIBE, EXPLAIN [ANALYZE], USE, ADMIN, and TQL EVAL (PromQL embedded in
+SQL, reference sql TQL extension).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional
+
+from greptimedb_tpu.sql import ast
+from greptimedb_tpu.sql.lexer import SqlError, Token, tokenize
+
+# interval text → nanoseconds
+_INTERVAL_UNITS = {
+    "nanosecond": 1, "nanoseconds": 1, "ns": 1,
+    "microsecond": 1_000, "microseconds": 1_000, "us": 1_000,
+    "millisecond": 10**6, "milliseconds": 10**6, "ms": 10**6,
+    "second": 10**9, "seconds": 10**9, "s": 10**9, "sec": 10**9,
+    "minute": 60 * 10**9, "minutes": 60 * 10**9, "m": 60 * 10**9, "min": 60 * 10**9,
+    "hour": 3600 * 10**9, "hours": 3600 * 10**9, "h": 3600 * 10**9,
+    "day": 86400 * 10**9, "days": 86400 * 10**9, "d": 86400 * 10**9,
+    "week": 7 * 86400 * 10**9, "weeks": 7 * 86400 * 10**9, "w": 7 * 86400 * 10**9,
+}
+
+_DURATION_RE = re.compile(r"(\d+(?:\.\d+)?)\s*([A-Za-z]+)")
+
+
+def parse_interval_text(text: str) -> int:
+    """'1 hour', '30s', '1h30m' → nanoseconds."""
+    total = 0.0
+    matched = False
+    for m in _DURATION_RE.finditer(text):
+        qty, unit = float(m.group(1)), m.group(2).lower()
+        if unit not in _INTERVAL_UNITS:
+            raise SqlError(f"unknown interval unit {unit!r} in {text!r}")
+        total += qty * _INTERVAL_UNITS[unit]
+        matched = True
+    if not matched:
+        raise SqlError(f"cannot parse interval {text!r}")
+    return int(total)
+
+
+class Parser:
+    def __init__(self, sql: str):
+        self.sql = sql
+        self.tokens = tokenize(sql)
+        self.i = 0
+
+    # ---- token helpers -----------------------------------------------------
+
+    def peek(self, ahead: int = 0) -> Token:
+        return self.tokens[min(self.i + ahead, len(self.tokens) - 1)]
+
+    def next(self) -> Token:
+        t = self.tokens[self.i]
+        if t.kind != "eof":
+            self.i += 1
+        return t
+
+    def at_kw(self, *kws: str) -> bool:
+        t = self.peek()
+        return t.kind == "keyword" and t.value in kws
+
+    def eat_kw(self, *kws: str) -> bool:
+        if self.at_kw(*kws):
+            self.next()
+            return True
+        return False
+
+    def expect_kw(self, kw: str) -> None:
+        if not self.eat_kw(kw):
+            raise SqlError(f"expected {kw.upper()} at {self.peek()!r}")
+
+    def at_op(self, *ops: str) -> bool:
+        t = self.peek()
+        return t.kind == "op" and t.value in ops
+
+    def eat_op(self, *ops: str) -> bool:
+        if self.at_op(*ops):
+            self.next()
+            return True
+        return False
+
+    def expect_op(self, op: str) -> None:
+        if not self.eat_op(op):
+            raise SqlError(f"expected {op!r} at {self.peek()!r} in {self.sql!r}")
+
+    def ident(self) -> str:
+        t = self.peek()
+        # many keywords are valid identifiers in column position
+        if t.kind in ("ident", "keyword"):
+            self.next()
+            return t.value
+        raise SqlError(f"expected identifier at {t!r}")
+
+    def qualified_name(self) -> str:
+        parts = [self.ident()]
+        while self.eat_op("."):
+            parts.append(self.ident())
+        return ".".join(parts)
+
+    # ---- entry -------------------------------------------------------------
+
+    def parse_statements(self) -> list[ast.Statement]:
+        stmts = []
+        while True:
+            while self.eat_op(";"):
+                pass
+            if self.peek().kind == "eof":
+                break
+            stmts.append(self.parse_statement())
+        return stmts
+
+    def parse_statement(self) -> ast.Statement:
+        t = self.peek()
+        if t.kind != "keyword":
+            raise SqlError(f"expected statement at {t!r}")
+        if t.value == "select":
+            return self.parse_select()
+        if t.value == "create":
+            return self.parse_create()
+        if t.value == "insert":
+            return self.parse_insert()
+        if t.value == "delete":
+            return self.parse_delete()
+        if t.value == "drop":
+            return self.parse_drop()
+        if t.value == "truncate":
+            self.next()
+            self.eat_kw("table")
+            return ast.TruncateTable(self.qualified_name())
+        if t.value == "show":
+            return self.parse_show()
+        if t.value == "describe" or (t.value == "desc" and self.peek(1).kind != "eof"):
+            self.next()
+            self.eat_kw("table")
+            return ast.DescribeTable(self.qualified_name())
+        if t.value == "explain":
+            self.next()
+            analyze = self.eat_kw("analyze")
+            self.eat_kw("verbose")
+            return ast.Explain(self.parse_statement(), analyze=analyze)
+        if t.value == "use":
+            self.next()
+            return ast.Use(self.ident())
+        if t.value == "tql":
+            return self.parse_tql()
+        if t.value == "alter":
+            return self.parse_alter()
+        if t.value == "admin":
+            self.next()
+            expr = self.parse_expr()
+            if not isinstance(expr, ast.FuncCall):
+                raise SqlError("ADMIN expects a function call")
+            return ast.AdminFunc(expr)
+        raise SqlError(f"unsupported statement start {t.value!r}")
+
+    # ---- SELECT ------------------------------------------------------------
+
+    def parse_select(self) -> ast.Select:
+        self.expect_kw("select")
+        distinct = self.eat_kw("distinct")
+        items = [self.parse_select_item()]
+        while self.eat_op(","):
+            items.append(self.parse_select_item())
+        sel = ast.Select(items=items)
+        sel.distinct = distinct
+        if self.eat_kw("from"):
+            sel.table = self.qualified_name()
+        if self.eat_kw("where"):
+            sel.where = self.parse_expr()
+        # RANGE ... ALIGN extension: ALIGN <interval> [TO <expr>] [BY (cols)] [FILL x]
+        if self.eat_kw("align"):
+            sel.align = self.parse_interval_literal()
+            if self.eat_kw("to"):
+                sel.align_to = self.parse_expr()
+            if self.eat_kw("by"):
+                self.expect_op("(")
+                sel.align_by = [self.parse_expr()]
+                while self.eat_op(","):
+                    sel.align_by.append(self.parse_expr())
+                self.expect_op(")")
+            if self.eat_kw("fill"):
+                sel.range_fill = self.ident()
+        if self.eat_kw("group"):
+            self.expect_kw("by")
+            sel.group_by.append(self.parse_expr())
+            while self.eat_op(","):
+                sel.group_by.append(self.parse_expr())
+        if self.eat_kw("having"):
+            sel.having = self.parse_expr()
+        if self.eat_kw("order"):
+            self.expect_kw("by")
+            sel.order_by.append(self.parse_order_item())
+            while self.eat_op(","):
+                sel.order_by.append(self.parse_order_item())
+        if self.eat_kw("limit"):
+            sel.limit = int(self.next().value)
+        if self.eat_kw("offset"):
+            sel.offset = int(self.next().value)
+        return sel
+
+    def parse_select_item(self) -> ast.SelectItem:
+        if self.at_op("*"):
+            self.next()
+            return ast.SelectItem(ast.Star())
+        expr = self.parse_expr()
+        alias = None
+        if self.eat_kw("as"):
+            alias = self.ident()
+        elif self.peek().kind == "ident":
+            alias = self.ident()
+        return ast.SelectItem(expr, alias)
+
+    def parse_order_item(self) -> ast.OrderByItem:
+        expr = self.parse_expr()
+        asc = True
+        if self.eat_kw("asc"):
+            asc = True
+        elif self.eat_kw("desc"):
+            asc = False
+        nulls_first = None
+        if self.eat_kw("nulls"):
+            if self.eat_kw("first"):
+                nulls_first = True
+            elif self.eat_kw("last"):
+                nulls_first = False
+        return ast.OrderByItem(expr, asc, nulls_first)
+
+    # ---- CREATE ------------------------------------------------------------
+
+    def parse_create(self) -> ast.Statement:
+        self.expect_kw("create")
+        if self.eat_kw("database") or self.eat_kw("schema"):
+            ine = self._if_not_exists()
+            return ast.CreateDatabase(self.ident(), if_not_exists=ine)
+        self.expect_kw("table")
+        ine = self._if_not_exists()
+        name = self.qualified_name()
+        stmt = ast.CreateTable(name=name, columns=[], if_not_exists=ine)
+        self.expect_op("(")
+        while not self.at_op(")"):
+            if self.at_kw("primary"):
+                self.next()
+                self.expect_kw("key")
+                self.expect_op("(")
+                while not self.at_op(")"):
+                    stmt.primary_keys.append(self.ident())
+                    self.eat_op(",")
+                self.expect_op(")")
+            elif self.at_kw("time") and self.peek(1).value == "index":
+                self.next()
+                self.next()
+                self.expect_op("(")
+                stmt.time_index = self.ident()
+                self.expect_op(")")
+            else:
+                stmt.columns.append(self.parse_column_def())
+            self.eat_op(",")
+        self.expect_op(")")
+        if self.eat_kw("partition"):
+            self.eat_kw("on")
+            self.eat_kw("columns")  # PARTITION ON COLUMNS (...) (...)
+            stmt.partitions = self._parse_partitions()
+        if self.eat_kw("engine"):
+            self.expect_op("=")
+            stmt.engine = self.ident()
+        if self.eat_kw("with"):
+            self.expect_op("(")
+            while not self.at_op(")"):
+                k = self.qualified_name()
+                self.expect_op("=")
+                t = self.next()
+                stmt.options[k] = t.value
+                self.eat_op(",")
+            self.expect_op(")")
+        return stmt
+
+    def _parse_partitions(self) -> list:
+        # PARTITION ON COLUMNS (col, ...) ( expr, expr, ... )
+        cols = []
+        self.expect_op("(")
+        while not self.at_op(")"):
+            cols.append(self.ident())
+            self.eat_op(",")
+        self.expect_op(")")
+        exprs = []
+        self.expect_op("(")
+        depth = 1
+        # partition bound expressions, comma-separated at depth 1
+        while depth > 0:
+            if self.at_op("("):
+                depth += 1
+                self.next()
+                continue
+            if self.at_op(")"):
+                depth -= 1
+                self.next()
+                continue
+            if depth == 1:
+                if self.eat_op(","):
+                    continue
+                exprs.append(self.parse_expr())
+            else:
+                self.next()
+        return [cols, exprs]
+
+    def _if_not_exists(self) -> bool:
+        if self.at_kw("if"):
+            self.next()
+            self.expect_kw("not")
+            self.expect_kw("exists")
+            return True
+        return False
+
+    def parse_column_def(self) -> ast.ColumnDef:
+        name = self.ident()
+        type_name = self.ident()
+        # parameterized / two-word types: TIMESTAMP(3), DOUBLE PRECISION, BIGINT UNSIGNED
+        if self.at_op("("):
+            self.next()
+            args = []
+            while not self.at_op(")"):
+                args.append(self.next().value)
+                self.eat_op(",")
+            self.expect_op(")")
+            type_name = f"{type_name}({','.join(args)})"
+        elif self.peek().kind == "ident" and self.peek().value.lower() in ("unsigned", "precision"):
+            extra = self.ident().lower()
+            type_name = "double" if extra == "precision" else f"{type_name} {extra}"
+        col = ast.ColumnDef(name=name, type_name=type_name)
+        while True:
+            if self.eat_kw("not"):
+                self.expect_kw("null")
+                col.nullable = False
+            elif self.eat_kw("null"):
+                col.nullable = True
+            elif self.at_kw("time") and self.peek(1).value == "index":
+                self.next()
+                self.next()
+                col.is_time_index = True
+            elif self.at_kw("primary"):
+                self.next()
+                self.expect_kw("key")
+                col.is_primary_key = True
+            elif self.eat_kw("default"):
+                col.default = self.parse_primary()
+            else:
+                break
+        return col
+
+    # ---- INSERT / DELETE ---------------------------------------------------
+
+    def parse_insert(self) -> ast.Insert:
+        self.expect_kw("insert")
+        self.expect_kw("into")
+        table = self.qualified_name()
+        columns: list[str] = []
+        if self.eat_op("("):
+            while not self.at_op(")"):
+                columns.append(self.ident())
+                self.eat_op(",")
+            self.expect_op(")")
+        if self.at_kw("select"):
+            return ast.Insert(table, columns, rows=[], select=self.parse_select())
+        self.expect_kw("values")
+        rows = []
+        while True:
+            self.expect_op("(")
+            row = []
+            while not self.at_op(")"):
+                row.append(self.parse_expr())
+                self.eat_op(",")
+            self.expect_op(")")
+            rows.append(row)
+            if not self.eat_op(","):
+                break
+        return ast.Insert(table, columns, rows)
+
+    def parse_delete(self) -> ast.Delete:
+        self.expect_kw("delete")
+        self.expect_kw("from")
+        table = self.qualified_name()
+        where = self.parse_expr() if self.eat_kw("where") else None
+        return ast.Delete(table, where)
+
+    def parse_drop(self) -> ast.Statement:
+        self.expect_kw("drop")
+        self.expect_kw("table")
+        if_exists = False
+        if self.at_kw("if"):
+            self.next()
+            self.expect_kw("exists")
+            if_exists = True
+        return ast.DropTable(self.qualified_name(), if_exists)
+
+    # ---- SHOW / TQL / ALTER ------------------------------------------------
+
+    def parse_show(self) -> ast.Statement:
+        self.expect_kw("show")
+        if self.eat_kw("databases"):
+            return ast.ShowDatabases()
+        if self.eat_kw("create"):
+            self.expect_kw("table")
+            return ast.ShowCreateTable(self.qualified_name())
+        self.expect_kw("tables")
+        stmt = ast.ShowTables()
+        if self.eat_kw("from") or self.eat_kw("in"):
+            stmt.database = self.ident()
+        if self.eat_kw("like"):
+            stmt.like = self.next().value
+        return stmt
+
+    def parse_tql(self) -> ast.Tql:
+        """TQL EVAL (start, end, step) <promql until end of statement>."""
+        self.expect_kw("tql")
+        analyze = explain = False
+        if self.eat_kw("analyze"):
+            analyze = True
+        elif self.eat_kw("explain"):
+            explain = True
+        else:
+            if not (self.eat_kw("eval") or self.eat_kw("evaluate")):
+                raise SqlError(f"expected EVAL at {self.peek()!r}")
+        if analyze or explain:
+            self.eat_kw("eval") or self.eat_kw("evaluate")
+        self.expect_op("(")
+        start = self._tql_number()
+        self.expect_op(",")
+        end = self._tql_number()
+        self.expect_op(",")
+        step = self._tql_duration()
+        self.expect_op(")")
+        # the rest of the statement (raw text) is PromQL
+        start_pos = self.peek().pos
+        end_pos = len(self.sql)
+        depth = 0
+        while self.peek().kind != "eof":
+            t = self.peek()
+            if t.kind == "op" and t.value == ";" and depth == 0:
+                end_pos = t.pos
+                break
+            if t.kind == "op" and t.value == "(":
+                depth += 1
+            if t.kind == "op" and t.value == ")":
+                depth -= 1
+            end_pos = t.pos + len(t.value) + (2 if t.kind == "string" else 0)
+            self.next()
+        query = self.sql[start_pos:end_pos].strip()
+        return ast.Tql(start, end, step, query, analyze=analyze, explain=explain)
+
+    def _tql_number(self) -> float:
+        t = self.next()
+        if t.kind == "string":
+            return _parse_tql_time(t.value)
+        if t.kind == "op" and t.value == "-":
+            return -float(self.next().value)
+        return float(t.value)
+
+    def _tql_duration(self) -> float:
+        t = self.next()
+        if t.kind == "string":
+            try:
+                return float(t.value)
+            except ValueError:
+                return parse_interval_text(t.value) / 1e9
+        return float(t.value)
+
+    def parse_alter(self) -> ast.AlterTable:
+        self.expect_kw("alter")
+        self.expect_kw("table")
+        name = self.qualified_name()
+        if self.eat_kw("add"):
+            self.eat_kw("column")
+            col = self.parse_column_def()
+            return ast.AlterTable(name, "add_column", column=col)
+        if self.eat_kw("drop"):
+            self.eat_kw("column")
+            return ast.AlterTable(name, "drop_column", column_name=self.ident())
+        if self.eat_kw("rename"):
+            self.eat_kw("to")
+            return ast.AlterTable(name, "rename", new_name=self.ident())
+        raise SqlError(f"unsupported ALTER at {self.peek()!r}")
+
+    # ---- expressions (pratt) -----------------------------------------------
+
+    def parse_expr(self) -> ast.Expr:
+        return self.parse_or()
+
+    def parse_or(self) -> ast.Expr:
+        left = self.parse_and()
+        while self.eat_kw("or"):
+            left = ast.BinaryOp("or", left, self.parse_and())
+        return left
+
+    def parse_and(self) -> ast.Expr:
+        left = self.parse_not()
+        while self.eat_kw("and"):
+            left = ast.BinaryOp("and", left, self.parse_not())
+        return left
+
+    def parse_not(self) -> ast.Expr:
+        if self.eat_kw("not"):
+            return ast.UnaryOp("not", self.parse_not())
+        return self.parse_comparison()
+
+    def parse_comparison(self) -> ast.Expr:
+        left = self.parse_additive()
+        while True:
+            if self.at_op("=", "!=", "<>", "<", "<=", ">", ">="):
+                op = self.next().value
+                if op == "<>":
+                    op = "!="
+                left = ast.BinaryOp(op, left, self.parse_additive())
+            elif self.at_kw("is"):
+                self.next()
+                negated = self.eat_kw("not")
+                self.expect_kw("null")
+                left = ast.IsNull(left, negated)
+            elif self.at_kw("between"):
+                self.next()
+                low = self.parse_additive()
+                self.expect_kw("and")
+                high = self.parse_additive()
+                left = ast.Between(left, low, high)
+            elif self.at_kw("in"):
+                self.next()
+                self.expect_op("(")
+                items = [self.parse_expr()]
+                while self.eat_op(","):
+                    items.append(self.parse_expr())
+                self.expect_op(")")
+                left = ast.InList(left, tuple(items))
+            elif self.at_kw("like"):
+                self.next()
+                left = ast.BinaryOp("like", left, self.parse_additive())
+            elif self.at_kw("not") and self.peek(1).value in ("in", "between", "like"):
+                self.next()
+                inner = self.peek().value
+                if inner == "in":
+                    self.next()
+                    self.expect_op("(")
+                    items = [self.parse_expr()]
+                    while self.eat_op(","):
+                        items.append(self.parse_expr())
+                    self.expect_op(")")
+                    left = ast.InList(left, tuple(items), negated=True)
+                elif inner == "between":
+                    self.next()
+                    low = self.parse_additive()
+                    self.expect_kw("and")
+                    high = self.parse_additive()
+                    left = ast.Between(left, low, high, negated=True)
+                else:
+                    self.next()
+                    left = ast.UnaryOp("not", ast.BinaryOp("like", left, self.parse_additive()))
+            else:
+                return left
+
+    def parse_additive(self) -> ast.Expr:
+        left = self.parse_multiplicative()
+        while self.at_op("+", "-"):
+            op = self.next().value
+            left = ast.BinaryOp(op, left, self.parse_multiplicative())
+        return left
+
+    def parse_multiplicative(self) -> ast.Expr:
+        left = self.parse_unary()
+        while self.at_op("*", "/", "%"):
+            op = self.next().value
+            left = ast.BinaryOp(op, left, self.parse_unary())
+        return left
+
+    def parse_unary(self) -> ast.Expr:
+        if self.at_op("-"):
+            self.next()
+            return ast.UnaryOp("-", self.parse_unary())
+        if self.at_op("+"):
+            self.next()
+            return self.parse_unary()
+        return self.parse_postfix()
+
+    def parse_postfix(self) -> ast.Expr:
+        expr = self.parse_primary()
+        while self.eat_op("::"):
+            expr = ast.Cast(expr, self.ident())
+        return expr
+
+    def parse_primary(self) -> ast.Expr:
+        t = self.peek()
+        if t.kind == "number":
+            self.next()
+            text = t.value
+            if "." in text or "e" in text.lower():
+                return ast.Literal(float(text))
+            return ast.Literal(int(text))
+        if t.kind == "string":
+            self.next()
+            return ast.Literal(t.value)
+        if t.kind == "op" and t.value == "(":
+            self.next()
+            e = self.parse_expr()
+            self.expect_op(")")
+            return e
+        if t.kind == "keyword":
+            if t.value == "null":
+                self.next()
+                return ast.Literal(None)
+            if t.value == "true":
+                self.next()
+                return ast.Literal(True)
+            if t.value == "false":
+                self.next()
+                return ast.Literal(False)
+            if t.value == "interval":
+                self.next()
+                return self.parse_interval_literal()
+            if t.value == "cast":
+                self.next()
+                self.expect_op("(")
+                e = self.parse_expr()
+                self.expect_kw("as")
+                type_name = self.ident()
+                if self.at_op("("):
+                    self.next()
+                    args = []
+                    while not self.at_op(")"):
+                        args.append(self.next().value)
+                        self.eat_op(",")
+                    self.expect_op(")")
+                    type_name = f"{type_name}({','.join(args)})"
+                self.expect_op(")")
+                return ast.Cast(e, type_name)
+            if t.value == "case":
+                return self.parse_case()
+        # identifier / function call / qualified column (keywords allowed as names)
+        if t.kind in ("ident", "keyword"):
+            name = self.ident()
+            if self.at_op("("):
+                self.next()
+                if self.at_op("*"):
+                    self.next()
+                    self.expect_op(")")
+                    return ast.FuncCall(name.lower(), (ast.Star(),))
+                distinct = self.eat_kw("distinct")
+                args: list[ast.Expr] = []
+                while not self.at_op(")"):
+                    args.append(self.parse_expr())
+                    self.eat_op(",")
+                self.expect_op(")")
+                return ast.FuncCall(name.lower(), tuple(args), distinct)
+            if self.at_op("."):
+                self.next()
+                col = self.ident()
+                return ast.Column(col, table=name)
+            return ast.Column(name)
+        raise SqlError(f"unexpected token {t!r} in expression")
+
+    def parse_interval_literal(self) -> ast.Interval:
+        t = self.next()
+        if t.kind == "string":
+            text = t.value
+        elif t.kind == "number":
+            # INTERVAL 1 hour style, or bare '5m' handled as string above
+            unit_t = self.next()
+            text = f"{t.value} {unit_t.value}"
+        else:
+            raise SqlError(f"bad interval at {t!r}")
+        return ast.Interval(parse_interval_text(text), text)
+
+    def parse_case(self) -> ast.Case:
+        self.expect_kw("case")
+        operand = None
+        if not self.at_kw("when"):
+            operand = self.parse_expr()
+        whens = []
+        while self.eat_kw("when"):
+            cond = self.parse_expr()
+            self.expect_kw("then")
+            whens.append((cond, self.parse_expr()))
+        else_ = None
+        if self.eat_kw("else"):
+            else_ = self.parse_expr()
+        self.expect_kw("end")
+        return ast.Case(operand, tuple(whens), else_)
+
+
+def _parse_tql_time(text: str) -> float:
+    """RFC3339-ish or numeric epoch seconds in TQL bounds."""
+    try:
+        return float(text)
+    except ValueError:
+        pass
+    import datetime as dt
+
+    for fmt in ("%Y-%m-%dT%H:%M:%S%z", "%Y-%m-%d %H:%M:%S%z",
+                "%Y-%m-%dT%H:%M:%S", "%Y-%m-%d %H:%M:%S", "%Y-%m-%d"):
+        try:
+            d = dt.datetime.strptime(text.replace("Z", "+0000"), fmt)
+            if d.tzinfo is None:
+                d = d.replace(tzinfo=dt.timezone.utc)
+            return d.timestamp()
+        except ValueError:
+            continue
+    raise SqlError(f"cannot parse TQL time {text!r}")
+
+
+def parse_sql(sql: str) -> list[ast.Statement]:
+    return Parser(sql).parse_statements()

@@ -98,6 +98,9 @@ class Memtable:
                     cols[c.name] = codes.astype(np.int32)
                 else:
                     cols[c.name] = self.registry.encode(c.name, np.asarray(col, dtype=object))
+            elif isinstance(col, DictVector):
+                # non-tag string field: store decoded (no region dictionary)
+                cols[c.name] = col.decode()
             else:
                 cols[c.name] = np.asarray(col)
         chunk = MemtableChunk(

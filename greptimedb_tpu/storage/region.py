@@ -167,11 +167,13 @@ class Region:
         tag_names = [c.name for c in self.schema.tag_columns]
         sizes = [max(scan.tag_cardinalities[n], 1) + 1 for n in tag_names]
         if tag_names:
+            # int64: the cardinality product of several tags can exceed 2^31
             sid = combine_group_ids(
-                [jnp.asarray(scan.columns[n] + 1) for n in tag_names], sizes
+                [jnp.asarray(scan.columns[n] + 1) for n in tag_names], sizes,
+                dtype=jnp.int64,
             )
         else:
-            sid = jnp.zeros(scan.num_rows, dtype=jnp.int32)
+            sid = jnp.zeros(scan.num_rows, dtype=jnp.int64)
         ts = jnp.asarray(scan.columns[self.schema.time_index.name])
         order, keep = sort_dedup(
             sid, ts, jnp.asarray(scan.seq), jnp.asarray(scan.op_type),
@@ -253,8 +255,23 @@ class Region:
 
     def _decode_sst(self, table: pa.Table, names: list[str]) -> dict[str, np.ndarray]:
         cols: dict[str, np.ndarray] = {}
+        n = table.num_rows
         for c in self.schema.columns:
             if c.name not in names:
+                continue
+            if c.name not in table.column_names:
+                # column added by ALTER after this SST was written: backfill
+                # with the declared default, else NULL (NaN / None / -1 code)
+                if c.semantic is SemanticType.TAG:
+                    cols[c.name] = np.full(n, -1, dtype=np.int32)
+                elif c.dtype.is_string:
+                    cols[c.name] = np.full(n, c.default, dtype=object)
+                elif c.dtype.is_float:
+                    fill = np.nan if c.default is None else float(c.default)
+                    cols[c.name] = np.full(n, fill, dtype=c.dtype.to_numpy())
+                else:
+                    fill = c.default if c.default is not None else 0
+                    cols[c.name] = np.full(n, fill, dtype=c.dtype.to_numpy())
                 continue
             arr = table.column(c.name)
             if c.semantic is SemanticType.TAG:

@@ -141,6 +141,9 @@ class SstReader:
         cols = None
         if projection is not None:
             cols = list(dict.fromkeys(list(projection) + [ts_name, SEQ_COL, OP_COL]))
+            # tolerate schema evolution: drop columns the file predates
+            avail = set(pf.schema_arrow.names)
+            cols = [c for c in cols if c in avail]
         table = pf.read_row_groups(groups, columns=cols)
         return table
 

@@ -1,0 +1,1 @@
+"""Shared utilities (the analog of reference src/common/{time,base,...})."""

@@ -1,0 +1,76 @@
+"""Timestamp algebra (mirrors reference src/common/time, ~5k LoC).
+
+Internal representation is int64 in a column-specific unit; all parsing
+lands in nanoseconds and converts down.
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+import re
+from typing import Optional
+
+from greptimedb_tpu.datatypes.types import DataType, TimeUnit
+
+_FORMATS = (
+    "%Y-%m-%d %H:%M:%S.%f%z",
+    "%Y-%m-%dT%H:%M:%S.%f%z",
+    "%Y-%m-%d %H:%M:%S%z",
+    "%Y-%m-%dT%H:%M:%S%z",
+    "%Y-%m-%d %H:%M:%S.%f",
+    "%Y-%m-%dT%H:%M:%S.%f",
+    "%Y-%m-%d %H:%M:%S",
+    "%Y-%m-%dT%H:%M:%S",
+    "%Y-%m-%d %H:%M",
+    "%Y-%m-%d",
+)
+
+
+def parse_timestamp_ns(text: str) -> int:
+    """Parse an ISO-ish timestamp string to epoch nanoseconds (UTC default)."""
+    t = text.strip().replace("Z", "+0000")
+    for fmt in _FORMATS:
+        try:
+            d = dt.datetime.strptime(t, fmt)
+            if d.tzinfo is None:
+                d = d.replace(tzinfo=dt.timezone.utc)
+            epoch = d.timestamp()
+            # avoid float precision loss: split seconds/micros
+            whole = int(epoch // 1)
+            micros = d.microsecond
+            base = dt.datetime(d.year, d.month, d.day, d.hour, d.minute, d.second,
+                               tzinfo=d.tzinfo)
+            return int(base.timestamp()) * 10**9 + micros * 1000
+        except ValueError:
+            continue
+    raise ValueError(f"cannot parse timestamp {text!r}")
+
+
+def ns_to_unit(ns: int, unit: TimeUnit) -> int:
+    return ns // unit.nanos_per_unit
+
+
+def unit_to_ns(value: int, unit: TimeUnit) -> int:
+    return value * unit.nanos_per_unit
+
+
+def coerce_ts_literal(value, dtype: DataType) -> int:
+    """Coerce a SQL literal (string or int) to the storage unit of `dtype`.
+
+    Integer literals are interpreted in the column's own unit (matching the
+    reference's behavior for bare numeric timestamp comparisons)."""
+    unit = dtype.time_unit
+    if isinstance(value, str):
+        return ns_to_unit(parse_timestamp_ns(value), unit)
+    return int(value)
+
+
+def format_ts(value: int, dtype: DataType) -> str:
+    """Render an int timestamp for output (ISO, UTC)."""
+    ns = unit_to_ns(int(value), dtype.time_unit)
+    secs, rem = divmod(ns, 10**9)
+    d = dt.datetime.fromtimestamp(secs, tz=dt.timezone.utc)
+    if rem:
+        frac = f".{rem // 10**6:03d}" if rem % 10**6 == 0 else f".{rem:09d}".rstrip("0")
+        return d.strftime("%Y-%m-%dT%H:%M:%S") + frac
+    return d.strftime("%Y-%m-%dT%H:%M:%S")

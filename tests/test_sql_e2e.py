@@ -1,0 +1,318 @@
+"""End-to-end SQL tests: parse -> plan -> device kernels -> results.
+
+Golden-style checks mirror the reference's sqlness strategy (SURVEY.md §4):
+SQL in, exact rows out, verified against numpy/pandas oracles.
+"""
+
+import numpy as np
+import pytest
+
+from greptimedb_tpu.catalog import Catalog, MemoryKv
+from greptimedb_tpu.query import QueryEngine
+from greptimedb_tpu.storage import RegionEngine
+from greptimedb_tpu.storage.engine import EngineConfig
+
+
+@pytest.fixture
+def db(tmp_path):
+    engine = RegionEngine(EngineConfig(data_dir=str(tmp_path / "data")))
+    qe = QueryEngine(Catalog(MemoryKv()), engine)
+    yield qe
+    engine.close()
+
+
+CREATE_CPU = """
+CREATE TABLE cpu (
+  hostname STRING,
+  region STRING,
+  ts TIMESTAMP(3) NOT NULL,
+  usage_user DOUBLE,
+  usage_system DOUBLE,
+  TIME INDEX (ts),
+  PRIMARY KEY (hostname, region)
+)
+"""
+
+
+def seed(db, rows):
+    db.execute_one(CREATE_CPU)
+    values = ", ".join(
+        f"('{h}', '{r}', {ts}, {uu}, {us})" for h, r, ts, uu, us in rows
+    )
+    db.execute_one(
+        "INSERT INTO cpu (hostname, region, ts, usage_user, usage_system) "
+        f"VALUES {values}"
+    )
+
+
+BASE = [
+    ("h0", "us-west", 1000, 10.0, 1.0),
+    ("h0", "us-west", 2000, 20.0, 2.0),
+    ("h1", "us-east", 1000, 30.0, 3.0),
+    ("h1", "us-east", 2000, 40.0, 4.0),
+    ("h2", "us-west", 1000, 50.0, 5.0),
+]
+
+
+class TestBasics:
+    def test_select_literal(self, db):
+        r = db.execute_one("SELECT 1 + 2")
+        assert r.rows() == [[3]]
+
+    def test_create_insert_select_star(self, db):
+        seed(db, BASE)
+        r = db.execute_one("SELECT * FROM cpu ORDER BY ts, hostname")
+        assert r.names == ["hostname", "region", "ts", "usage_user", "usage_system"]
+        assert r.num_rows == 5
+        rows = r.rows()
+        assert rows[0] == ["h0", "us-west", 1000, 10.0, 1.0]
+        assert rows[1] == ["h1", "us-east", 1000, 30.0, 3.0]
+
+    def test_where_tag_filter(self, db):
+        seed(db, BASE)
+        r = db.execute_one(
+            "SELECT usage_user FROM cpu WHERE hostname = 'h1' ORDER BY ts"
+        )
+        assert [row[0] for row in r.rows()] == [30.0, 40.0]
+
+    def test_where_numeric_and_ts(self, db):
+        seed(db, BASE)
+        r = db.execute_one(
+            "SELECT hostname FROM cpu WHERE usage_user >= 30 AND ts < 2000 ORDER BY hostname"
+        )
+        assert [row[0] for row in r.rows()] == ["h1", "h2"]
+
+    def test_in_and_like(self, db):
+        seed(db, BASE)
+        r = db.execute_one(
+            "SELECT DISTINCT hostname FROM cpu WHERE region IN ('us-west') ORDER BY hostname"
+        )
+        assert [row[0] for row in r.rows()] == ["h0", "h2"]
+        r = db.execute_one(
+            "SELECT DISTINCT hostname FROM cpu WHERE hostname LIKE 'h%' ORDER BY hostname"
+        )
+        assert r.num_rows == 3
+
+    def test_limit_offset(self, db):
+        seed(db, BASE)
+        r = db.execute_one("SELECT hostname FROM cpu ORDER BY ts, hostname LIMIT 2 OFFSET 1")
+        assert [row[0] for row in r.rows()] == ["h1", "h2"]
+
+
+class TestAggregates:
+    def test_global_agg(self, db):
+        seed(db, BASE)
+        r = db.execute_one(
+            "SELECT count(*), sum(usage_user), avg(usage_user), "
+            "min(usage_user), max(usage_user) FROM cpu"
+        )
+        assert r.rows() == [[5, 150.0, 30.0, 10.0, 50.0]]
+
+    def test_group_by_tag(self, db):
+        seed(db, BASE)
+        r = db.execute_one(
+            "SELECT hostname, avg(usage_user) FROM cpu GROUP BY hostname ORDER BY hostname"
+        )
+        assert r.rows() == [["h0", 15.0], ["h1", 35.0], ["h2", 50.0]]
+
+    def test_group_by_two_tags(self, db):
+        seed(db, BASE)
+        r = db.execute_one(
+            "SELECT region, hostname, count(*) FROM cpu "
+            "GROUP BY region, hostname ORDER BY region, hostname"
+        )
+        assert r.rows() == [
+            ["us-east", "h1", 2], ["us-west", "h0", 2], ["us-west", "h2", 1]
+        ]
+
+    def test_group_by_time_bucket(self, db):
+        seed(db, BASE)
+        r = db.execute_one(
+            "SELECT date_bin(INTERVAL '1 second', ts) AS sec, sum(usage_user) "
+            "FROM cpu GROUP BY sec ORDER BY sec"
+        )
+        assert r.rows() == [[1000, 90.0], [2000, 60.0]]
+
+    def test_double_groupby(self, db):
+        seed(db, BASE)
+        r = db.execute_one(
+            "SELECT hostname, date_bin(INTERVAL '1 second', ts) AS sec, "
+            "avg(usage_user) AS au FROM cpu GROUP BY hostname, sec "
+            "ORDER BY hostname, sec"
+        )
+        assert r.rows() == [
+            ["h0", 1000, 10.0], ["h0", 2000, 20.0],
+            ["h1", 1000, 30.0], ["h1", 2000, 40.0],
+            ["h2", 1000, 50.0],
+        ]
+
+    def test_having(self, db):
+        seed(db, BASE)
+        r = db.execute_one(
+            "SELECT hostname, avg(usage_user) AS au FROM cpu "
+            "GROUP BY hostname HAVING au > 20 ORDER BY hostname"
+        )
+        assert r.rows() == [["h1", 35.0], ["h2", 50.0]]
+
+    def test_agg_expression(self, db):
+        seed(db, BASE)
+        r = db.execute_one(
+            "SELECT max(usage_user) - min(usage_user) FROM cpu"
+        )
+        assert r.rows() == [[40.0]]
+
+    def test_count_star_vs_count_col_with_nulls(self, db):
+        db.execute_one(CREATE_CPU)
+        db.execute_one(
+            "INSERT INTO cpu (hostname, region, ts, usage_user) VALUES "
+            "('h0', 'r', 1000, 1.0), ('h0', 'r', 2000, NULL)"
+        )
+        r = db.execute_one("SELECT count(*), count(usage_user) FROM cpu")
+        assert r.rows() == [[2, 1]]
+
+    def test_order_by_agg_desc(self, db):
+        seed(db, BASE)
+        r = db.execute_one(
+            "SELECT hostname, sum(usage_user) AS s FROM cpu "
+            "GROUP BY hostname ORDER BY s DESC LIMIT 2"
+        )
+        assert r.rows() == [["h1", 70.0], ["h2", 50.0]]
+
+    def test_stddev(self, db):
+        seed(db, BASE)
+        r = db.execute_one("SELECT stddev(usage_user) FROM cpu")
+        expected = np.std([10, 20, 30, 40, 50], ddof=1)
+        np.testing.assert_allclose(r.rows()[0][0], expected, rtol=1e-9)
+
+    def test_last_with_ts(self, db):
+        seed(db, BASE)
+        r = db.execute_one(
+            "SELECT hostname, last_value(usage_user) FROM cpu GROUP BY hostname "
+            "ORDER BY hostname"
+        )
+        assert r.rows() == [["h0", 20.0], ["h1", 40.0], ["h2", 50.0]]
+
+
+class TestLifecycle:
+    def test_update_semantics_last_write_wins(self, db):
+        seed(db, BASE)
+        db.execute_one(
+            "INSERT INTO cpu (hostname, region, ts, usage_user, usage_system) "
+            "VALUES ('h0', 'us-west', 1000, 99.0, 9.0)"
+        )
+        r = db.execute_one(
+            "SELECT usage_user FROM cpu WHERE hostname = 'h0' AND ts = 1000"
+        )
+        assert r.rows() == [[99.0]]
+        r = db.execute_one("SELECT count(*) FROM cpu")
+        assert r.rows() == [[5]]
+
+    def test_delete(self, db):
+        seed(db, BASE)
+        db.execute_one("DELETE FROM cpu WHERE hostname = 'h0'")
+        r = db.execute_one("SELECT count(*) FROM cpu")
+        assert r.rows() == [[3]]
+
+    def test_flush_then_query(self, db):
+        seed(db, BASE)
+        db.execute_one("ADMIN flush_table('cpu')")
+        r = db.execute_one("SELECT sum(usage_user) FROM cpu")
+        assert r.rows() == [[150.0]]
+
+    def test_show_and_describe(self, db):
+        seed(db, BASE)
+        r = db.execute_one("SHOW TABLES")
+        assert r.rows() == [["cpu"]]
+        r = db.execute_one("DESCRIBE cpu")
+        d = r.to_pydict()
+        assert d["Column"] == ["hostname", "region", "ts", "usage_user", "usage_system"]
+        assert d["Semantic Type"] == ["TAG", "TAG", "TIMESTAMP", "FIELD", "FIELD"]
+
+    def test_alter_add_column(self, db):
+        seed(db, BASE)
+        db.execute_one("ALTER TABLE cpu ADD COLUMN usage_idle DOUBLE")
+        db.execute_one(
+            "INSERT INTO cpu (hostname, region, ts, usage_user, usage_system, usage_idle) "
+            "VALUES ('h3', 'eu', 3000, 1.0, 1.0, 42.0)"
+        )
+        r = db.execute_one("SELECT usage_idle FROM cpu WHERE hostname = 'h3'")
+        assert r.rows() == [[42.0]]
+        r = db.execute_one("SELECT count(usage_idle), count(*) FROM cpu")
+        assert r.rows() == [[1, 6]]
+
+    def test_drop_table(self, db):
+        seed(db, BASE)
+        db.execute_one("DROP TABLE cpu")
+        assert db.execute_one("SHOW TABLES").num_rows == 0
+
+    def test_timestamp_string_predicates(self, db):
+        db.execute_one(CREATE_CPU)
+        db.execute_one(
+            "INSERT INTO cpu (hostname, region, ts, usage_user) VALUES "
+            "('h0', 'r', '2016-01-01 00:00:00', 1.0), "
+            "('h0', 'r', '2016-01-01 01:00:00', 2.0)"
+        )
+        r = db.execute_one(
+            "SELECT usage_user FROM cpu "
+            "WHERE ts >= '2016-01-01 00:30:00' AND ts < '2016-01-01 02:00:00'"
+        )
+        assert r.rows() == [[2.0]]
+
+    def test_persistence_across_restart(self, tmp_path):
+        from greptimedb_tpu.catalog import FileKv
+
+        cfg = EngineConfig(data_dir=str(tmp_path / "d"))
+        kv_path = str(tmp_path / "d" / "catalog.json")
+        engine = RegionEngine(cfg)
+        qe = QueryEngine(Catalog(FileKv(kv_path)), engine)
+        seed(qe, BASE)
+        qe.execute_one("ADMIN flush_table('cpu')")
+        qe.execute_one(
+            "INSERT INTO cpu (hostname, region, ts, usage_user) VALUES ('h9','x',5000,5.0)"
+        )
+        engine.close()
+
+        engine2 = RegionEngine(cfg)
+        qe2 = QueryEngine(Catalog(FileKv(kv_path)), engine2)
+        r = qe2.execute_one("SELECT count(*) FROM cpu")
+        assert r.rows() == [[6]]
+        r = qe2.execute_one("SELECT usage_user FROM cpu WHERE hostname = 'h9'")
+        assert r.rows() == [[5.0]]
+        engine2.close()
+
+
+class TestOracleParity:
+    """Randomized double-groupby checked against a pandas oracle."""
+
+    def test_random_double_groupby(self, db, rng):
+        import pandas as pd
+
+        n = 5000
+        hosts = [f"host_{i}" for i in range(37)]
+        h = rng.integers(0, len(hosts), n)
+        ts = rng.integers(0, 3_600_000, n)  # 1h of ms
+        uu = rng.normal(50, 20, n).round(3)
+        db.execute_one(
+            "CREATE TABLE t (h STRING, ts TIMESTAMP(3) NOT NULL, v DOUBLE, "
+            "TIME INDEX (ts), PRIMARY KEY (h)) WITH (append_mode = 'true')"
+        )
+        values = ", ".join(
+            f"('{hosts[hi]}', {t}, {v})" for hi, t, v in zip(h, ts, uu)
+        )
+        db.execute_one(f"INSERT INTO t (h, ts, v) VALUES {values}")
+
+        r = db.execute_one(
+            "SELECT h, date_bin(INTERVAL '10 minutes', ts) AS b, avg(v), count(v), "
+            "max(v) FROM t GROUP BY h, b ORDER BY h, b"
+        )
+        df = pd.DataFrame({"h": [hosts[i] for i in h], "ts": ts, "v": uu})
+        df["b"] = df.ts // 600000 * 600000
+        oracle = df.groupby(["h", "b"]).agg(
+            avg=("v", "mean"), cnt=("v", "count"), mx=("v", "max")
+        ).reset_index().sort_values(["h", "b"])
+        assert r.num_rows == len(oracle)
+        np.testing.assert_array_equal(r.column("h"), oracle.h.values)
+        np.testing.assert_array_equal(r.column("b"), oracle.b.values)
+        np.testing.assert_allclose(r.column("avg(v)"), oracle.avg.values, rtol=1e-9)
+        np.testing.assert_array_equal(r.column("count(v)"), oracle.cnt.values)
+        np.testing.assert_allclose(r.column("max(v)"), oracle.mx.values)
