@@ -1,0 +1,161 @@
+#!/usr/bin/env python
+"""TSBS-style benchmark: double-groupby-all (the north-star metric,
+BASELINE.md — reference GreptimeDB v0.8.0: 2215.44 ms on 8-core local).
+
+Workload (mirrors TSBS devops `cpu-only` double-groupby-all): `cpu` table
+with 10 DOUBLE usage fields; query = avg of all 10 fields GROUP BY
+(hour bucket, hostname) over a 12h window. Dataset: HOSTS hosts sampled
+every 10s for 12h (default 4000 hosts -> 17.28M rows x 10 fields).
+
+Pipeline measured end-to-end through the SQL engine: SQL parse -> plan ->
+region scan (SST/memtable) -> device blocks -> fused filter+group+segment
+reduction kernel -> host result assembly. Median of repeated runs after one
+warm-up, matching the reference's warm-page-cache TSBS methodology (here
+the warm cache is HBM-resident column blocks).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "ms", "vs_baseline": N}
+vs_baseline > 1 means faster than the reference's 2215.44 ms.
+"""
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+BASELINE_MS = 2215.44  # BASELINE.md double-groupby-all, local 8c
+
+HOSTS = int(os.environ.get("BENCH_HOSTS", "4000"))
+HOURS = int(os.environ.get("BENCH_HOURS", "12"))
+STEP_S = int(os.environ.get("BENCH_STEP_S", "10"))
+REPEATS = int(os.environ.get("BENCH_REPEATS", "3"))
+FIELDS = [f"usage_{n}" for n in (
+    "user", "system", "idle", "nice", "iowait", "irq", "softirq",
+    "steal", "guest", "guest_nice")]
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def build_db(data_dir):
+    from greptimedb_tpu.catalog import Catalog, MemoryKv
+    from greptimedb_tpu.query import QueryEngine
+    from greptimedb_tpu.storage import RegionEngine
+    from greptimedb_tpu.storage.engine import EngineConfig
+
+    engine = RegionEngine(EngineConfig(data_dir=data_dir))
+    qe = QueryEngine(Catalog(MemoryKv()), engine)
+    field_defs = ",\n  ".join(f"{f} DOUBLE" for f in FIELDS)
+    qe.execute_one(f"""
+        CREATE TABLE cpu (
+          hostname STRING,
+          ts TIMESTAMP(3) NOT NULL,
+          {field_defs},
+          TIME INDEX (ts),
+          PRIMARY KEY (hostname)
+        ) WITH (append_mode = 'true')
+    """)
+    return engine, qe
+
+
+def ingest(engine, qe, t0_ms):
+    """Ingest through the write path (RecordBatch put = the gRPC-analog
+    bulk route), one batch per simulated time slice group."""
+    from greptimedb_tpu.datatypes import DictVector, RecordBatch
+
+    info = qe.catalog.table("public", "cpu")
+    schema = info.schema
+    rid = info.region_ids[0]
+    rng = np.random.default_rng(7)
+    points = HOURS * 3600 // STEP_S
+    host_names = np.asarray([f"host_{i}" for i in range(HOSTS)], dtype=object)
+    rows_total = 0
+    t_start = time.perf_counter()
+    slice_points = max(1, (1 << 21) // HOSTS)  # ~2M rows per batch
+    for p0 in range(0, points, slice_points):
+        p1 = min(p0 + slice_points, points)
+        npts = p1 - p0
+        n = npts * HOSTS
+        host_codes = np.tile(np.arange(HOSTS, dtype=np.int32), npts)
+        ts = np.repeat(
+            t0_ms + (np.arange(p0, p1, dtype=np.int64) * STEP_S * 1000), HOSTS
+        )
+        cols = {
+            "hostname": DictVector(host_codes, host_names),
+            "ts": ts,
+        }
+        for f in FIELDS:
+            cols[f] = rng.uniform(0.0, 100.0, n)
+        batch = RecordBatch(schema, cols)
+        engine.put(rid, batch)
+        rows_total += n
+    ingest_s = time.perf_counter() - t_start
+    return rows_total, ingest_s
+
+
+def main():
+    data_dir = tempfile.mkdtemp(prefix="gtpu_bench_")
+    try:
+        import jax
+        log(f"devices: {jax.devices()}")
+        engine, qe = build_db(data_dir)
+        t0_ms = 1456790400000  # 2016-03-01T00:00:00Z
+        log(f"ingesting {HOSTS} hosts x {HOURS}h @{STEP_S}s ...")
+        rows, ingest_s = ingest(engine, qe, t0_ms)
+        log(f"ingested {rows} rows in {ingest_s:.1f}s "
+            f"({rows / ingest_s:,.0f} rows/s)")
+        engine.flush(qe.catalog.table("public", "cpu").region_ids[0])
+        log("flushed to SST")
+
+        t_end_ms = t0_ms + HOURS * 3600 * 1000
+        avg_list = ", ".join(f"avg({f})" for f in FIELDS)
+        sql = (
+            f"SELECT date_bin(INTERVAL '1 hour', ts) AS hour, hostname, {avg_list} "
+            f"FROM cpu WHERE ts >= {t0_ms} AND ts < {t_end_ms} "
+            f"GROUP BY hour, hostname ORDER BY hour, hostname"
+        )
+        # warm-up: compile + fill the HBM block cache
+        t = time.perf_counter()
+        r = qe.execute_one(sql)
+        log(f"warm-up run: {(time.perf_counter() - t) * 1000:.1f} ms, "
+            f"{r.num_rows} groups")
+        assert r.num_rows == HOSTS * HOURS, r.num_rows
+
+        times = []
+        for i in range(REPEATS):
+            t = time.perf_counter()
+            r = qe.execute_one(sql)
+            dt = (time.perf_counter() - t) * 1000
+            times.append(dt)
+            log(f"run {i + 1}: {dt:.1f} ms")
+        value = float(np.median(times))
+        print(json.dumps({
+            "metric": "tsbs_double_groupby_all_p50_ms",
+            "value": round(value, 2),
+            "unit": "ms",
+            "vs_baseline": round(BASELINE_MS / value, 3),
+            "detail": {
+                "rows": rows,
+                "hosts": HOSTS,
+                "hours": HOURS,
+                "fields": len(FIELDS),
+                "groups": HOSTS * HOURS,
+                "ingest_rows_per_s": round(rows / ingest_s),
+                "baseline_ms": BASELINE_MS,
+                "runs_ms": [round(t, 1) for t in times],
+            },
+        }))
+        engine.close()
+    finally:
+        shutil.rmtree(data_dir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,40 @@
+"""Global runtime configuration (the analog of reference MitoConfig /
+QueryEngineState knobs, layered defaults <- env vars)."""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _platform() -> str:
+    try:
+        return jax.devices()[0].platform
+    except Exception:
+        return "cpu"
+
+
+def compute_dtype() -> np.dtype:
+    """Float dtype for field values inside device kernels. TPU has no
+    native f64 (emulated, slow) — default f32 there; CPU keeps f64 so
+    results are bit-comparable with numpy oracles in tests.
+
+    Override with GREPTIMEDB_TPU_COMPUTE_DTYPE=float32|float64|bfloat16.
+    """
+    env = os.environ.get("GREPTIMEDB_TPU_COMPUTE_DTYPE")
+    if env:
+        return jnp.dtype(env)
+    return jnp.dtype(jnp.float32) if _platform() in ("tpu", "axon") else jnp.dtype(jnp.float64)
+
+
+def device_cache_bytes() -> int:
+    """HBM budget for the device block cache (reference: CacheManager page
+    cache, mito2/src/cache.rs:53-61 — here the 'page cache' IS device HBM).
+    """
+    env = os.environ.get("GREPTIMEDB_TPU_DEVICE_CACHE_BYTES")
+    if env:
+        return int(env)
+    return 8 << 30 if _platform() in ("tpu", "axon") else 1 << 30

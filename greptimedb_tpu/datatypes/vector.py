@@ -48,24 +48,30 @@ class DictVector:
     @staticmethod
     def encode(strings: Sequence, values: Optional[np.ndarray] = None) -> "DictVector":
         """Encode a sequence of strings (None == NULL) against an optional
-        pre-existing dictionary; new values are appended."""
+        pre-existing dictionary; new values are appended. Vectorized via
+        np.unique — only distinct values touch Python."""
         arr = np.asarray(strings, dtype=object)
         table: dict = {}
         vals: list = []
         if values is not None:
             vals = list(values)
             table = {v: i for i, v in enumerate(vals)}
-        codes = np.empty(len(arr), dtype=np.int32)
-        for i, s in enumerate(arr):
-            if s is None:
-                codes[i] = -1
-                continue
-            code = table.get(s)
-            if code is None:
-                code = len(vals)
-                table[s] = code
-                vals.append(s)
-            codes[i] = code
+        if len(arr) == 0:
+            return DictVector(np.empty(0, np.int32), np.asarray(vals, dtype=object))
+        null_mask = np.frompyfunc(lambda x: x is None, 1, 1)(arr).astype(bool)
+        codes = np.full(len(arr), -1, dtype=np.int32)
+        present = ~null_mask
+        if present.any():
+            uniq, inv = np.unique(arr[present].astype(str), return_inverse=True)
+            mapping = np.empty(len(uniq), dtype=np.int32)
+            for i, s in enumerate(uniq):
+                code = table.get(s)
+                if code is None:
+                    code = len(vals)
+                    table[s] = code
+                    vals.append(s)
+                mapping[i] = code
+            codes[present] = mapping[inv]
         return DictVector(codes, np.asarray(vals, dtype=object))
 
     @staticmethod

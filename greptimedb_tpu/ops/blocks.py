@@ -16,15 +16,21 @@ from typing import Optional
 import numpy as np
 
 MIN_BLOCK_ROWS = 1024
-# Default streaming block: 2^22 rows keeps an f32 field column at 16 MiB —
-# large enough to saturate the MXU/VPU, small enough to double-buffer in HBM.
-DEFAULT_BLOCK_ROWS = 1 << 22
+# Streaming kicks in above this: one dispatch per query beats pipelined
+# small blocks when dispatch/transfer round-trips dominate (remote-attached
+# devices); 2^25 rows keeps an f32 column at 128 MiB.
+DEFAULT_BLOCK_ROWS = 1 << 25
+_COARSE = 1 << 20
 
 
 def block_size_for(n: int, min_rows: int = MIN_BLOCK_ROWS) -> int:
-    """Smallest power-of-two block that fits n rows."""
+    """Block shape bucket for n rows: powers of two up to 1M rows, then
+    multiples of 1M (pow2 padding wastes up to 2x at scan scale; 1M-step
+    buckets keep the jit cache small AND the padding <6%)."""
     if n <= min_rows:
         return min_rows
+    if n >= _COARSE:
+        return ((n + _COARSE - 1) // _COARSE) * _COARSE
     return 1 << math.ceil(math.log2(n))
 
 

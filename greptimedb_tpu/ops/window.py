@@ -1,0 +1,192 @@
+"""Windowed (range-vector) kernels over a regular evaluation grid.
+
+TPU-native replacement for the reference's `RangeArray` ragged windows +
+`RangeManipulate`/`InstantManipulate` operators (promql/src/range_array.rs:68,
+extension_plan/*.rs). Instead of materializing per-window sample lists,
+samples are bucketed onto the step grid with one segment reduction, then:
+
+  - window sums/counts  = cumulative-sum differences along the bucket axis
+  - last/first sample   = latest/earliest-nonempty-bucket gathers (cummax /
+                          reverse-cummin) + exact timestamp validation
+  - window min/max      = w-step unrolled running fmin/fmax over bucket mins
+
+Exactness: range windows require the range to be a multiple of the step
+(buckets tile windows exactly); instant-selector lookback is exact for any
+length because the gathered last-sample timestamp is re-validated against
+the true window edge.
+
+Shapes: samples [N] -> bucket grid [S, B, C] -> windows [S, T, C], where
+S = series, T = eval steps, B = T + w buckets, C = value channels (e.g.
+raw + counter-reset-adjusted values ride one kernel call).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from greptimedb_tpu.ops.segment import segment_agg
+
+BIG = jnp.iinfo(jnp.int32).max
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_series", "num_steps", "w", "stats"),
+)
+def window_stats(
+    sidx: jax.Array,  # [N] int32 series index
+    ts: jax.Array,  # [N] float64 sample time (seconds)
+    channels: jax.Array,  # [N, C] float value channels
+    valid: jax.Array,  # [N] bool
+    t0,  # scalar: first eval timestamp (seconds)
+    step,  # scalar: eval step (seconds)
+    num_series: int,
+    num_steps: int,
+    w: int,  # window length in steps
+    stats: tuple[str, ...] = ("sum", "count", "last"),
+) -> dict[str, jax.Array]:
+    """Compute per-(series, eval-step) window statistics. Window j covers
+    (t0 + (j-w)*step, t0 + j*step] — i.e. w whole step-buckets ending at
+    eval time j. Outputs [S, T, C] (ts outputs [S, T])."""
+    S, T, B = num_series, num_steps, num_steps + w
+    n, C = channels.shape
+
+    # bucket: sample at exactly an eval time belongs to that step's bucket
+    b = jnp.ceil((ts - t0) / step).astype(jnp.int32) + (w - 1)
+    ok = valid & (b >= 0) & (b < B)
+    gid = jnp.where(ok, sidx * B + b, S * B).astype(jnp.int32)
+
+    seg_ops = []
+    if "sum" in stats or "count" in stats:
+        seg_ops += ["sum", "count"]
+    if "last" in stats:
+        seg_ops.append("last")
+    if "first" in stats:
+        seg_ops.append("first")
+    if "min" in stats:
+        seg_ops.append("min")
+    if "max" in stats:
+        seg_ops.append("max")
+    per_bucket = segment_agg(
+        channels, gid, ok, S * B, ops=tuple(dict.fromkeys(seg_ops)),
+        ts=_ts_to_int(ts),
+    )
+
+    out: dict[str, jax.Array] = {}
+    j = jnp.arange(T)
+
+    def grid(x, C_=None):
+        return x.reshape(S, B) if C_ is None else x.reshape(S, B, C_)
+
+    bcount = grid(per_bucket["count"], C) if "count" in per_bucket else None
+
+    if "sum" in stats:
+        bsum = grid(per_bucket["sum"], C)
+        cs = jnp.concatenate([jnp.zeros((S, 1, C), bsum.dtype),
+                              jnp.cumsum(bsum, axis=1)], axis=1)
+        out["sum"] = cs[:, w:w + T] - cs[:, 0:T]
+    if "count" in stats:
+        cc = jnp.concatenate([jnp.zeros((S, 1, C), jnp.int64),
+                              jnp.cumsum(bcount.astype(jnp.int64), axis=1)], axis=1)
+        out["count"] = cc[:, w:w + T] - cc[:, 0:T]
+
+    nonempty = None
+    if bcount is not None:
+        nonempty = bcount[:, :, 0] > 0  # row presence: channel 0 mask
+    if "last" in stats:
+        lv = grid(per_bucket["last"], C)
+        lt = grid(per_bucket["last_ts"])
+        nb = jnp.where(nonempty, jnp.arange(B)[None, :], -1)
+        latest = jax.lax.cummax(nb, axis=1)
+        lb = latest[:, w - 1:w - 1 + T]  # [S, T]
+        has = lb >= j[None, :]
+        safe = jnp.clip(lb, 0, B - 1)
+        lval = jnp.take_along_axis(lv, safe[:, :, None], axis=1)
+        lts = _ts_to_float(jnp.take_along_axis(lt, safe, axis=1))
+        out["last"] = jnp.where(has[:, :, None], lval, jnp.nan)
+        out["last_ts"] = jnp.where(has, lts, -jnp.inf)
+    if "first" in stats:
+        fv = grid(per_bucket["first"], C)
+        ft = grid(per_bucket["first_ts"])
+        fb = jnp.where(nonempty, jnp.arange(B)[None, :], BIG)
+        earliest = jnp.flip(jax.lax.cummin(jnp.flip(fb, axis=1), axis=1), axis=1)
+        fbj = earliest[:, 0:T]
+        has = fbj <= (j[None, :] + w - 1)
+        safe = jnp.clip(fbj, 0, B - 1)
+        fval = jnp.take_along_axis(fv, safe[:, :, None], axis=1)
+        fts = _ts_to_float(jnp.take_along_axis(ft, safe, axis=1))
+        out["first"] = jnp.where(has[:, :, None], fval, jnp.nan)
+        out["first_ts"] = jnp.where(has, fts, jnp.inf)
+    if "min" in stats:
+        bmin = grid(per_bucket["min"], C)
+        acc = bmin[:, 0:T]
+        for k in range(1, w):
+            acc = jnp.fmin(acc, bmin[:, k:k + T])
+        out["min"] = acc
+    if "max" in stats:
+        bmax = grid(per_bucket["max"], C)
+        acc = bmax[:, 0:T]
+        for k in range(1, w):
+            acc = jnp.fmax(acc, bmax[:, k:k + T])
+        out["max"] = acc
+    return out
+
+
+def _ts_to_int(ts):
+    # segment first/last need an integer time key; milliseconds keeps
+    # ordering at PromQL resolution
+    return (ts * 1000.0).astype(jnp.int64)
+
+
+def _ts_to_float(t_int):
+    return t_int.astype(jnp.float64) / 1000.0
+
+
+@jax.jit
+def counter_adjust(sidx_sorted: jax.Array, values_sorted: jax.Array) -> jax.Array:
+    """Reset-corrected counter values. Input MUST be sorted by (series, ts).
+    adjusted[i] = v[i] + cumulative resets before i; within-series
+    differences of `adjusted` equal PromQL's reset-corrected deltas
+    (reference promql/src/functions/extrapolate_rate.rs semantics)."""
+    prev_v = jnp.concatenate([values_sorted[:1], values_sorted[:-1]])
+    prev_s = jnp.concatenate([sidx_sorted[:1], sidx_sorted[:-1]])
+    same = sidx_sorted == prev_s
+    reset = jnp.where(same & (values_sorted < prev_v), prev_v, 0.0)
+    # global cumsum is per-series-correct for *differences* because rows
+    # are series-contiguous
+    return values_sorted + jnp.cumsum(reset)
+
+
+def extrapolated_delta(
+    first_val, first_ts, last_val, last_ts, count, window_start, window_end,
+    is_counter: bool, is_rate: bool, range_s: float,
+):
+    """PromQL extrapolation (reference extrapolate_rate.rs:85-92): the raw
+    last-first delta is extrapolated toward the window edges, limited to
+    half an average sample interval when the edge is far. All inputs
+    [S, T] (vals [S, T, 1-channel already selected])."""
+    sampled = last_ts - first_ts
+    delta = last_val - first_val
+    cnt = count.astype(first_val.dtype)
+    ok = (cnt >= 2) & (sampled > 0)
+    avg_interval = sampled / jnp.maximum(cnt - 1, 1)
+    to_start = first_ts - window_start
+    to_end = window_end - last_ts
+    if is_counter:
+        # counters can't be negative: limit start extrapolation to the
+        # zero crossing
+        with jax.numpy_dtype_promotion("standard"):
+            slope = delta / jnp.maximum(sampled, 1e-10)
+            zero_limit = jnp.where(slope > 0, first_val / slope, jnp.inf)
+            to_start = jnp.minimum(to_start, zero_limit)
+    threshold = avg_interval * 1.1
+    ext_start = jnp.where(to_start < threshold, to_start, avg_interval / 2)
+    ext_end = jnp.where(to_end < threshold, to_end, avg_interval / 2)
+    factor = (sampled + ext_start + ext_end) / jnp.maximum(sampled, 1e-10)
+    result = delta * factor
+    if is_rate:
+        result = result / range_s
+    return jnp.where(ok, result, jnp.nan)

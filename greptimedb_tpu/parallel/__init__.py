@@ -1,0 +1,17 @@
+"""Parallel execution over a jax.sharding.Mesh.
+
+The TPU-native replacement for the reference's distributed query fan-out
+(SURVEY.md §2.6): regions map to shards of a device mesh; the
+gather-then-aggregate of MergeScanExec (query/src/dist_plan/merge_scan.rs:122,
+point-to-point Arrow Flight) becomes partial segment aggregation per shard
+combined with psum/pmin/pmax over ICI. Cross-host control stays on gRPC;
+data movement inside a pod rides XLA collectives.
+"""
+
+from greptimedb_tpu.parallel.mesh import (
+    make_mesh,
+    sharded_segment_agg,
+    shard_rows,
+)
+
+__all__ = ["make_mesh", "sharded_segment_agg", "shard_rows"]

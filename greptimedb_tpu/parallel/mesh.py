@@ -1,0 +1,106 @@
+"""Mesh construction + sharded aggregation kernels.
+
+Sharding layout for the scan/aggregate hot path:
+  - axis "shard": rows (series-partitioned regions -> data parallel). Group
+    ids are global, so per-shard partial aggregates are dense [G, F] and
+    combine with psum/pmin/pmax over ICI — the collective MergeScan.
+  - axis "field": measurement columns (tensor-parallel analog). TSBS cpu
+    tables carry 10 usage fields; sharding F keeps per-chip HBM traffic
+    down on wide tables. Outputs stay field-sharded until the host gather.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from greptimedb_tpu.ops.segment import segment_agg
+
+# ops whose partials combine with a collective (first/last need ts pairing,
+# handled only in the single-chip streaming path for now)
+COLLECTIVE_OPS = ("sum", "count", "min", "max", "rows", "sumsq")
+
+
+def make_mesh(
+    devices: Optional[Sequence] = None,
+    shape: Optional[tuple[int, int]] = None,
+    axes: tuple[str, str] = ("shard", "field"),
+) -> Mesh:
+    """Build a 2D (shard, field) mesh. Default: all devices on the shard
+    axis, field axis of 1 (pure row sharding)."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if shape is None:
+        shape = (n, 1)
+    assert shape[0] * shape[1] == n, (shape, n)
+    arr = np.asarray(devices).reshape(shape)
+    return Mesh(arr, axes)
+
+
+def shard_rows(arr: np.ndarray, mesh: Mesh) -> jax.Array:
+    """Place a host row-array onto the mesh sharded along the first axis
+    ("shard"); callers pad to a multiple of the shard axis size first."""
+    spec = P("shard") if arr.ndim == 1 else P("shard", None)
+    return jax.device_put(arr, NamedSharding(mesh, spec))
+
+
+def sharded_segment_agg(
+    values: jax.Array,  # [N, F]
+    seg_ids: jax.Array,  # [N]
+    mask: jax.Array,  # [N]
+    num_segments: int,
+    ops: tuple[str, ...],
+    mesh: Mesh,
+) -> dict[str, jax.Array]:
+    """Masked segment reduction over a (shard, field) mesh: per-shard dense
+    partials, then psum/pmin/pmax along "shard". Result is replicated along
+    "shard" and left sharded along "field"."""
+    for op in ops:
+        if op not in COLLECTIVE_OPS:
+            raise ValueError(f"op {op!r} has no collective combiner")
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P("shard", "field"), P("shard"), P("shard")),
+        out_specs=P(None, "field"),
+        check_vma=False,
+    )
+    def step(v, g, m):
+        part = segment_agg(v, g, m, num_segments, ops=ops)
+        out = {}
+        for op in ops:
+            x = part[op]
+            if x.ndim == 1:
+                x = x[:, None]
+            if op in ("sum", "count", "rows", "sumsq"):
+                out[op] = jax.lax.psum(x, "shard")
+            elif op == "min":
+                big = jnp.asarray(jnp.inf, x.dtype) if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).max
+                filled = jnp.where(jnp.isnan(x), big, x) if jnp.issubdtype(x.dtype, jnp.floating) else x
+                mn = jax.lax.pmin(filled, "shard")
+                out[op] = jnp.where(jnp.isinf(mn), jnp.nan, mn) if jnp.issubdtype(x.dtype, jnp.floating) else mn
+            elif op == "max":
+                small = jnp.asarray(-jnp.inf, x.dtype) if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+                filled = jnp.where(jnp.isnan(x), small, x) if jnp.issubdtype(x.dtype, jnp.floating) else x
+                mx = jax.lax.pmax(filled, "shard")
+                out[op] = jnp.where(jnp.isinf(mx), jnp.nan, mx) if jnp.issubdtype(x.dtype, jnp.floating) else mx
+        return tuple(out[op] for op in ops)
+
+    res = step(values, seg_ids, mask)
+    return dict(zip(ops, res))
+
+
+def pad_to_multiple(arr: np.ndarray, multiple: int, fill=0) -> np.ndarray:
+    n = arr.shape[0]
+    target = ((n + multiple - 1) // multiple) * multiple
+    if target == n:
+        return arr
+    pad_width = [(0, target - n)] + [(0, 0)] * (arr.ndim - 1)
+    return np.pad(arr, pad_width, constant_values=fill)

@@ -1,0 +1,22 @@
+"""PromQL engine (mirrors reference src/promql, ~11.9k LoC).
+
+The reference compiles PromQL to DataFusion plans with custom extension
+operators (SeriesNormalize/InstantManipulate/RangeManipulate/SeriesDivide,
+promql/src/planner.rs:144). The TPU-native re-design evaluates on dense
+[series x eval-step] matrices instead: samples are bucketed onto the step
+grid with segment kernels, range windows become cumulative-sum differences
+and latest-nonempty gathers (ops/window.py), and label aggregations are
+segment reductions over the series axis. `RangeArray`'s ragged windows
+(range_array.rs:68) never materialize — windows are implicit in the grid.
+"""
+
+from greptimedb_tpu.promql.parser import parse_promql
+
+__all__ = ["parse_promql", "PromqlEngine"]
+
+
+def __getattr__(name):
+    if name == "PromqlEngine":
+        from greptimedb_tpu.promql.engine import PromqlEngine
+        return PromqlEngine
+    raise AttributeError(name)

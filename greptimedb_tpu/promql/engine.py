@@ -1,0 +1,818 @@
+"""PromQL evaluation engine.
+
+Mirrors the reference's PromPlanner + extension operators
+(promql/src/planner.rs:144, extension_plan/*) re-designed for dense device
+evaluation (see package docstring): every (sub)expression evaluates to one
+of
+  - SeriesMatrix: labels [S] + values [S, T] (NaN = no sample)
+  - a per-step scalar array [T]
+  - a python float (constant)
+over the regular eval grid (start, end, step). Range-vector functions run
+the window_stats kernel (ops/window.py); label aggregations are segment
+reductions over the series axis; binary-op vector matching joins label
+signatures on host (S is small; T×S math stays on device).
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from greptimedb_tpu.datatypes.types import DataType, SemanticType
+from greptimedb_tpu.ops.segment import combine_group_ids, segment_agg
+from greptimedb_tpu.ops.window import counter_adjust, extrapolated_delta, window_stats
+from greptimedb_tpu.promql.parser import (
+    DEFAULT_LOOKBACK_S,
+    Aggregate,
+    Binary,
+    Call,
+    Matcher,
+    NumberLiteral,
+    PromqlError,
+    StringLiteral,
+    Unary,
+    VectorSelector,
+    parse_promql,
+)
+from greptimedb_tpu.query.result import QueryResult
+
+
+@dataclass
+class SeriesMatrix:
+    labels: list[dict[str, str]]  # S label sets (no __name__)
+    values: jax.Array  # [S, T]
+    metric: Optional[str] = None
+    sample_ts: Optional[jax.Array] = None  # [S, T] for timestamp()
+
+    @property
+    def num_series(self) -> int:
+        return len(self.labels)
+
+
+@dataclass
+class EvalParams:
+    start: float
+    end: float
+    step: float
+    times: np.ndarray  # [T] seconds
+
+    @property
+    def T(self) -> int:
+        return len(self.times)
+
+
+_RANGE_FUNCS = {
+    "rate", "increase", "delta", "avg_over_time", "sum_over_time",
+    "count_over_time", "min_over_time", "max_over_time", "last_over_time",
+    "stddev_over_time", "stdvar_over_time", "present_over_time",
+    "changes", "resets", "deriv", "predict_linear",
+}
+
+_ELEMENTWISE = {
+    "abs": jnp.abs, "ceil": jnp.ceil, "floor": jnp.floor,
+    "exp": jnp.exp, "ln": jnp.log, "log2": jnp.log2, "log10": jnp.log10,
+    "sqrt": jnp.sqrt, "sgn": jnp.sign,
+    "acos": jnp.arccos, "asin": jnp.arcsin, "atan": jnp.arctan,
+    "cos": jnp.cos, "sin": jnp.sin, "tan": jnp.tan,
+    "cosh": jnp.cosh, "sinh": jnp.sinh, "tanh": jnp.tanh,
+    "deg": jnp.degrees, "rad": jnp.radians,
+}
+
+
+class PromqlEngine:
+    def __init__(self, query_engine):
+        self.qe = query_engine
+
+    # ---- public API --------------------------------------------------------
+
+    def eval_range(self, query: str, start: float, end: float, step: float,
+                   ctx=None) -> QueryResult:
+        """Range query -> long-format table (ts, value, labels...) like the
+        reference's TQL output."""
+        times, result = self.eval_matrix(query, start, end, step, ctx)
+        return _to_long_result(times, result)
+
+    def eval_matrix(self, query: str, start: float, end: float, step: float,
+                    ctx=None):
+        if step <= 0:
+            raise PromqlError("step must be positive")
+        node = parse_promql(query)
+        n_steps = int(math.floor((end - start) / step)) + 1
+        times = start + np.arange(n_steps) * step
+        params = EvalParams(start, end, step, times)
+        result = self._eval(node, params, ctx)
+        return times, result
+
+    def eval_instant(self, query: str, t: float, ctx=None):
+        times, result = self.eval_matrix(query, t, t, 1.0, ctx)
+        return times, result
+
+    # ---- evaluation --------------------------------------------------------
+
+    def _eval(self, node, p: EvalParams, ctx):
+        if isinstance(node, NumberLiteral):
+            return node.value
+        if isinstance(node, StringLiteral):
+            return node.value
+        if isinstance(node, Unary):
+            v = self._eval(node.expr, p, ctx)
+            return _map_values(v, lambda x: -x)
+        if isinstance(node, VectorSelector):
+            if node.range_s is not None:
+                raise PromqlError("range vector outside function call")
+            return self._eval_instant_selector(node, p, ctx)
+        if isinstance(node, Call):
+            return self._eval_call(node, p, ctx)
+        if isinstance(node, Aggregate):
+            return self._eval_aggregate(node, p, ctx)
+        if isinstance(node, Binary):
+            return self._eval_binary(node, p, ctx)
+        raise PromqlError(f"cannot evaluate {type(node).__name__}")
+
+    # ---- selectors ---------------------------------------------------------
+
+    def _eval_instant_selector(self, sel: VectorSelector, p: EvalParams, ctx,
+                               lookback: float = DEFAULT_LOOKBACK_S):
+        loaded = self._load(sel, p, ctx, window=lookback)
+        if loaded is None:
+            return SeriesMatrix([], jnp.zeros((0, p.T)))
+        sidx, ts, chans, labels, metric = loaded
+        w = max(1, int(math.ceil(lookback / p.step)))
+        st = window_stats(sidx, ts, chans, jnp.ones(ts.shape, bool),
+                          p.start, p.step, len(labels), p.T, w,
+                          stats=("count", "last"))
+        vals = st["last"][:, :, 0]
+        lts = st["last_ts"]
+        # exact lookback: bucket window may overcover; validate sample ts
+        ok = lts > (jnp.asarray(p.times)[None, :] - lookback)
+        vals = jnp.where(ok, vals, jnp.nan)
+        return SeriesMatrix(labels, vals, metric,
+                            sample_ts=jnp.where(ok, lts, jnp.nan))
+
+    def _range_stats(self, sel: VectorSelector, p: EvalParams, ctx,
+                     stats: tuple[str, ...], extra_channels=()):
+        """Evaluate a range selector into window stats. Returns
+        (stats dict, labels, metric, w, range_s) or None when empty."""
+        range_s = sel.range_s
+        if range_s is None:
+            raise PromqlError("expected a range vector (metric[duration])")
+        ratio = range_s / p.step
+        w = int(round(ratio))
+        if abs(ratio - w) > 1e-9 or w < 1:
+            raise PromqlError(
+                f"range {range_s}s must be a positive multiple of step {p.step}s "
+                "(blocked-window evaluation)")
+        loaded = self._load(sel, p, ctx, window=range_s,
+                            extra_channels=extra_channels)
+        if loaded is None:
+            return None
+        sidx, ts, chans, labels, metric = loaded
+        st = window_stats(sidx, ts, chans, jnp.ones(ts.shape, bool),
+                          p.start, p.step, len(labels), p.T, w, stats=stats)
+        return st, labels, metric, w, range_s
+
+    def _load(self, sel: VectorSelector, p: EvalParams, ctx, window: float,
+              extra_channels=()):
+        """Scan + matcher-filter + series factorization. Returns device
+        arrays sorted by (series, ts): sidx [N], ts seconds [N],
+        channels [N, C], labels, metric. Channel 0 is the raw value;
+        extra_channels in {"adjusted", "changes", "resets", "deriv"} append
+        derived channels."""
+        matchers = list(sel.matchers)
+        metric = sel.metric
+        field_name = None
+        rest: list[Matcher] = []
+        for m in matchers:
+            if m.label == "__name__":
+                if m.op != "=":
+                    raise PromqlError("__name__ supports '=' only")
+                metric = m.value
+            elif m.label == "__field__":
+                if m.op != "=":
+                    raise PromqlError("__field__ supports '=' only")
+                field_name = m.value
+            else:
+                rest.append(m)
+        if metric is None:
+            raise PromqlError("selector needs a metric name")
+
+        qe = self.qe
+        from greptimedb_tpu.catalog.catalog import CatalogError
+        from greptimedb_tpu.query.engine import QueryContext
+        ctx = ctx or QueryContext()
+        try:
+            info = qe._table(metric, ctx)
+        except CatalogError:
+            return None
+        schema = info.schema
+        fields = schema.field_columns
+        if field_name is None:
+            if len(fields) == 1:
+                field_name = fields[0].name
+            elif any(f.name == "greptime_value" for f in fields):
+                field_name = "greptime_value"
+            else:
+                raise PromqlError(
+                    f"metric {metric!r} has {len(fields)} fields; select one "
+                    "with {__field__=\"...\"}"
+                    )
+        elif field_name not in {f.name for f in fields}:
+            raise PromqlError(f"no field {field_name!r} in {metric!r}")
+
+        ts_col = schema.time_index
+        unit = ts_col.dtype.time_unit.nanos_per_unit
+        offset = sel.offset_s
+        lo = int((p.start - window - offset) * 1e9) // unit
+        hi = int((p.end - offset) * 1e9) // unit + 1
+        scan = qe.region_engine.scan(info.region_ids[0], (lo, hi),
+                                     [field_name])
+        if scan is None or scan.num_rows == 0:
+            return None
+
+        tag_names = [c.name for c in schema.tag_columns]
+        mask = np.ones(scan.num_rows, dtype=bool)
+        for m in rest:
+            mask &= _matcher_mask(m, scan, tag_names)
+            if not mask.any():
+                return None
+        # dedup for non-append tables rides the same sort below
+        rows = np.flatnonzero(mask)
+        codes = [scan.columns[t][rows] for t in tag_names]
+        ts_raw = scan.columns[ts_col.name][rows]
+        vals = np.asarray(scan.columns[field_name][rows], dtype=np.float64)
+
+        if tag_names:
+            sizes = [len(scan.tag_dicts[t]) + 1 for t in tag_names]
+            combined = codes[0].astype(np.int64) + 1
+            for c, s in zip(codes[1:], sizes[1:]):
+                combined = combined * s + (c.astype(np.int64) + 1)
+            uniq, sidx = np.unique(combined, return_inverse=True)
+            # decode labels per unique series
+            labels = []
+            strides = [1] * len(sizes)
+            for i in range(len(sizes) - 2, -1, -1):
+                strides[i] = strides[i + 1] * sizes[i + 1]
+            for u in uniq:
+                lab = {}
+                for t_name, stride, size in zip(tag_names, strides, sizes):
+                    code = int(u // stride % size) - 1
+                    if code >= 0:
+                        lab[t_name] = str(scan.tag_dicts[t_name][code])
+                labels.append(lab)
+        else:
+            sidx = np.zeros(len(rows), dtype=np.int64)
+            labels = [{}]
+
+        ts_sec = ts_raw.astype(np.float64) * (unit / 1e9) + offset
+        # sort by (series, ts) once on device: required by counter_adjust /
+        # indicator channels, and makes segment ids sorted for the kernel
+        d_sidx = jnp.asarray(sidx.astype(np.int32))
+        d_ts = jnp.asarray(ts_sec)
+        d_vals = jnp.asarray(vals)
+        order = jnp.lexsort((d_ts, d_sidx))
+        d_sidx, d_ts, d_vals = d_sidx[order], d_ts[order], d_vals[order]
+
+        if not info.append_mode:
+            # last-write-wins for duplicated (series, ts): keep last by seq
+            d_seq = jnp.asarray(scan.seq[rows])[order]
+            nxt_s = jnp.concatenate([d_sidx[1:], jnp.full((1,), -1, d_sidx.dtype)])
+            nxt_t = jnp.concatenate([d_ts[1:], jnp.full((1,), -jnp.inf)])
+            dup_next = (d_sidx == nxt_s) & (d_ts == nxt_t)
+            keep = ~dup_next
+            d_vals = jnp.where(keep, d_vals, jnp.nan)
+
+        chans = [d_vals]
+        if "adjusted" in extra_channels:
+            chans.append(counter_adjust(d_sidx, d_vals))
+        if "changes" in extra_channels or "resets" in extra_channels:
+            prev_v = jnp.concatenate([d_vals[:1], d_vals[:-1]])
+            prev_s = jnp.concatenate([d_sidx[:1], d_sidx[:-1]])
+            same = jnp.concatenate([jnp.zeros(1, bool),
+                                    (d_sidx[1:] == d_sidx[:-1])])
+            if "changes" in extra_channels:
+                chans.append(jnp.where(same & (d_vals != prev_v), 1.0, 0.0))
+            if "resets" in extra_channels:
+                chans.append(jnp.where(same & (d_vals < prev_v), 1.0, 0.0))
+        if "deriv" in extra_channels:
+            tr = d_ts - p.start  # well-conditioned regression coordinates
+            chans += [d_vals * tr, tr, tr * tr]
+        channels = jnp.stack(chans, axis=1)
+        return d_sidx, d_ts, channels, labels, metric
+
+    # ---- calls -------------------------------------------------------------
+
+    def _eval_call(self, call: Call, p: EvalParams, ctx):
+        fn = call.func
+        if fn in _RANGE_FUNCS:
+            return self._eval_range_func(call, p, ctx)
+        if fn == "time":
+            return jnp.asarray(p.times)
+        if fn == "scalar":
+            v = self._eval(call.args[0], p, ctx)
+            if isinstance(v, SeriesMatrix):
+                return v.values[0] if v.num_series == 1 else jnp.full(p.T, jnp.nan)
+            return v
+        if fn == "vector":
+            v = self._eval(call.args[0], p, ctx)
+            arr = _broadcast_scalar(v, p)
+            return SeriesMatrix([{}], arr[None, :])
+        if fn == "timestamp":
+            v = self._eval(call.args[0], p, ctx)
+            if not isinstance(v, SeriesMatrix) or v.sample_ts is None:
+                raise PromqlError("timestamp() needs an instant selector")
+            return SeriesMatrix(v.labels, v.sample_ts, None)
+        if fn in ("clamp", "clamp_min", "clamp_max"):
+            v = self._eval(call.args[0], p, ctx)
+            if not isinstance(v, SeriesMatrix):
+                raise PromqlError(f"{fn} needs a vector")
+            args = [_scalar_of(self._eval(a, p, ctx)) for a in call.args[1:]]
+            if fn == "clamp":
+                out = jnp.clip(v.values, args[0], args[1])
+            elif fn == "clamp_min":
+                out = jnp.maximum(v.values, args[0])
+            else:
+                out = jnp.minimum(v.values, args[0])
+            return SeriesMatrix(v.labels, out)
+        if fn == "round":
+            v = self._eval(call.args[0], p, ctx)
+            to = _scalar_of(self._eval(call.args[1], p, ctx)) if len(call.args) > 1 else 1.0
+            return SeriesMatrix(v.labels, jnp.round(v.values / to) * to)
+        if fn in _ELEMENTWISE:
+            v = self._eval(call.args[0], p, ctx)
+            return _map_values(v, _ELEMENTWISE[fn])
+        if fn in ("sort", "sort_desc"):
+            return self._eval(call.args[0], p, ctx)  # ordering applied at output
+        if fn == "label_replace":
+            return self._label_replace(call, p, ctx)
+        if fn == "label_join":
+            return self._label_join(call, p, ctx)
+        raise PromqlError(f"unsupported function {fn!r}")
+
+    def _eval_range_func(self, call: Call, p: EvalParams, ctx):
+        fn = call.func
+        sel = call.args[-1] if fn == "predict_linear" else call.args[0]
+        sel = call.args[0]
+        if not isinstance(sel, VectorSelector):
+            raise PromqlError(f"{fn} needs a range selector argument")
+
+        if fn in ("rate", "increase", "delta"):
+            counter = fn in ("rate", "increase")
+            extra = ("adjusted",) if counter else ()
+            r = self._range_stats(sel, p, ctx,
+                                  ("count", "first", "last"), extra)
+            if r is None:
+                return SeriesMatrix([], jnp.zeros((0, p.T)))
+            st, labels, metric, w, range_s = r
+            ch = 1 if counter else 0
+            times = jnp.asarray(p.times)
+            vals = extrapolated_delta(
+                st["first"][:, :, ch], st["first_ts"],
+                st["last"][:, :, ch], st["last_ts"],
+                st["count"][:, :, 0],
+                times[None, :] - range_s, times[None, :],
+                is_counter=counter, is_rate=(fn == "rate"), range_s=range_s,
+            )
+            return SeriesMatrix(labels, vals)
+
+        if fn in ("changes", "resets"):
+            r = self._range_stats(sel, p, ctx, ("sum", "count"), (fn,))
+            if r is None:
+                return SeriesMatrix([], jnp.zeros((0, p.T)))
+            st, labels, metric, w, range_s = r
+            present = st["count"][:, :, 0] > 0
+            return SeriesMatrix(labels, jnp.where(present, st["sum"][:, :, 1], jnp.nan))
+
+        if fn in ("deriv", "predict_linear"):
+            r = self._range_stats(sel, p, ctx, ("sum", "count"), ("deriv",))
+            if r is None:
+                return SeriesMatrix([], jnp.zeros((0, p.T)))
+            st, labels, metric, w, range_s = r
+            n = st["count"][:, :, 0].astype(jnp.float64)
+            sv, svt, t1, t2 = (st["sum"][:, :, i] for i in range(4))
+            denom = n * t2 - t1 * t1
+            slope = jnp.where((n >= 2) & (denom != 0), (n * svt - sv * t1) / denom, jnp.nan)
+            if fn == "deriv":
+                return SeriesMatrix(labels, slope)
+            horizon = _scalar_of(self._eval(call.args[1], p, ctx))
+            intercept = (sv - slope * t1) / jnp.maximum(n, 1)
+            now_r = jnp.asarray(p.times)[None, :] - p.start
+            return SeriesMatrix(labels, intercept + slope * (now_r + horizon))
+
+        # *_over_time family
+        stat_map = {
+            "avg_over_time": ("sum", "count"), "sum_over_time": ("sum", "count"),
+            "count_over_time": ("count",), "present_over_time": ("count",),
+            "min_over_time": ("min", "count"), "max_over_time": ("max", "count"),
+            "last_over_time": ("count", "last"),
+            "stddev_over_time": ("sum", "count"), "stdvar_over_time": ("sum", "count"),
+        }
+        extra = ()
+        if fn in ("stddev_over_time", "stdvar_over_time"):
+            extra = ("sq",)
+        stats = stat_map[fn]
+        if fn in ("stddev_over_time", "stdvar_over_time"):
+            r = self._range_stats_sq(sel, p, ctx)
+        else:
+            r = self._range_stats(sel, p, ctx, stats, extra)
+        if r is None:
+            return SeriesMatrix([], jnp.zeros((0, p.T)))
+        st, labels, metric, w, range_s = r
+        cnt = st["count"][:, :, 0]
+        present = cnt > 0
+        if fn == "sum_over_time":
+            out = jnp.where(present, st["sum"][:, :, 0], jnp.nan)
+        elif fn == "avg_over_time":
+            out = jnp.where(present, st["sum"][:, :, 0] / jnp.maximum(cnt, 1), jnp.nan)
+        elif fn in ("count_over_time",):
+            out = jnp.where(present, cnt.astype(jnp.float64), jnp.nan)
+        elif fn == "present_over_time":
+            out = jnp.where(present, 1.0, jnp.nan)
+        elif fn == "min_over_time":
+            out = st["min"][:, :, 0]
+        elif fn == "max_over_time":
+            out = st["max"][:, :, 0]
+        elif fn == "last_over_time":
+            out = st["last"][:, :, 0]
+        elif fn in ("stddev_over_time", "stdvar_over_time"):
+            s, sq = st["sum"][:, :, 0], st["sum"][:, :, 1]
+            n = jnp.maximum(cnt.astype(jnp.float64), 1)
+            var = jnp.maximum(sq / n - (s / n) ** 2, 0.0)  # population, like PromQL
+            out = jnp.where(present, jnp.sqrt(var) if fn == "stddev_over_time" else var, jnp.nan)
+        return SeriesMatrix(labels, out)
+
+    def _range_stats_sq(self, sel, p, ctx):
+        """Range stats with a squared-value channel (stddev/stdvar)."""
+        range_s = sel.range_s
+        w = int(round(range_s / p.step))
+        loaded = self._load(sel, p, ctx, window=range_s)
+        if loaded is None:
+            return None
+        sidx, ts, chans, labels, metric = loaded
+        chans = jnp.concatenate([chans, chans[:, :1] ** 2], axis=1)
+        st = window_stats(sidx, ts, chans, jnp.ones(ts.shape, bool),
+                          p.start, p.step, len(labels), p.T, w,
+                          stats=("sum", "count"))
+        return st, labels, metric, w, range_s
+
+    # ---- aggregation -------------------------------------------------------
+
+    def _eval_aggregate(self, agg: Aggregate, p: EvalParams, ctx):
+        v = self._eval(agg.expr, p, ctx)
+        if not isinstance(v, SeriesMatrix):
+            raise PromqlError(f"{agg.op} needs an instant vector")
+        if v.num_series == 0:
+            return SeriesMatrix([], jnp.zeros((0, p.T)))
+
+        # group signatures
+        sigs = []
+        out_labels = []
+        for lab in v.labels:
+            if agg.by:
+                kept = {k: lab.get(k, "") for k in agg.by if k in lab}
+            elif agg.without:
+                kept = {k: x for k, x in lab.items() if k not in agg.without}
+            elif agg.grouping:
+                kept = {}
+            else:
+                kept = {}
+            sigs.append(tuple(sorted(kept.items())))
+            out_labels.append(kept)
+        uniq = sorted(set(sigs))
+        gidx = np.asarray([uniq.index(s) for s in sigs], dtype=np.int32)
+        G = len(uniq)
+        glabels = [dict(u) for u in uniq]
+
+        vals = v.values  # [S, T]
+        if agg.op in ("sum", "avg", "min", "max", "count", "group",
+                      "stddev", "stdvar"):
+            ops = {
+                "sum": ("sum",), "avg": ("sum", "count"),
+                "min": ("min",), "max": ("max",),
+                "count": ("count",), "group": ("count",),
+                "stddev": ("sum", "sumsq", "count"),
+                "stdvar": ("sum", "sumsq", "count"),
+            }[agg.op]
+            need = set(ops) | {"count"}
+            st = segment_agg(vals, jnp.asarray(gidx),
+                             jnp.ones(v.num_series, bool), G,
+                             ops=tuple(sorted(need)))
+            cnt = st["count"]
+            present = cnt > 0
+            if agg.op == "sum":
+                out = jnp.where(present, st["sum"], jnp.nan)
+            elif agg.op == "avg":
+                out = jnp.where(present, st["sum"] / jnp.maximum(cnt, 1), jnp.nan)
+            elif agg.op in ("min", "max"):
+                out = st[agg.op]
+            elif agg.op in ("count",):
+                out = jnp.where(present, cnt.astype(jnp.float64), jnp.nan)
+            elif agg.op == "group":
+                out = jnp.where(present, 1.0, jnp.nan)
+            else:  # stddev / stdvar (population)
+                n = jnp.maximum(cnt.astype(jnp.float64), 1)
+                var = jnp.maximum(st["sumsq"] / n - (st["sum"] / n) ** 2, 0.0)
+                out = jnp.where(present, var if agg.op == "stdvar" else jnp.sqrt(var), jnp.nan)
+            return SeriesMatrix(glabels, out)
+
+        if agg.op in ("topk", "bottomk"):
+            k = int(_scalar_of(self._eval(agg.param, p, ctx)))
+            vv = vals if agg.op == "topk" else -vals
+            filled = jnp.where(jnp.isnan(vv), -jnp.inf, vv)
+            keep = jnp.zeros(vals.shape, bool)
+            for g in range(G):
+                rows = np.flatnonzero(gidx == g)
+                sub = filled[rows]
+                kk = min(k, len(rows))
+                thresh = -jnp.sort(-sub, axis=0)[kk - 1]
+                keep = keep.at[rows].set(sub >= thresh[None, :])
+            out = jnp.where(keep & ~jnp.isnan(vals), vals, jnp.nan)
+            return SeriesMatrix(v.labels, out, v.metric)
+
+        if agg.op == "quantile":
+            q = _scalar_of(self._eval(agg.param, p, ctx))
+            outs = []
+            for g in range(G):
+                rows = np.flatnonzero(gidx == g)
+                outs.append(jnp.nanquantile(vals[rows], q, axis=0))
+            return SeriesMatrix(glabels, jnp.stack(outs, axis=0))
+
+        raise PromqlError(f"unsupported aggregation {agg.op!r}")
+
+    # ---- binary ops --------------------------------------------------------
+
+    def _eval_binary(self, node: Binary, p: EvalParams, ctx):
+        lhs = self._eval(node.lhs, p, ctx)
+        rhs = self._eval(node.rhs, p, ctx)
+        lv = isinstance(lhs, SeriesMatrix)
+        rv = isinstance(rhs, SeriesMatrix)
+
+        if node.op in ("and", "or", "unless"):
+            if not (lv and rv):
+                raise PromqlError(f"{node.op} needs vector operands")
+            return _set_op(node, lhs, rhs, p)
+
+        if not lv and not rv:
+            a, b = _broadcast_scalar(lhs, p), _broadcast_scalar(rhs, p)
+            out = _apply_op(node.op, a, b)
+            if node.op in _CMP and not node.bool_mod:
+                out = jnp.where(out != 0, a, jnp.nan)
+            return out
+        if lv and not rv:
+            b = _broadcast_scalar(rhs, p)
+            out = _apply_op(node.op, lhs.values, b[None, :])
+            if node.op in _CMP:
+                out = (out.astype(jnp.float64) if node.bool_mod
+                       else jnp.where(out, lhs.values, jnp.nan))
+            return SeriesMatrix(_strip(lhs.labels) if node.op not in _CMP or node.bool_mod else lhs.labels, out)
+        if rv and not lv:
+            a = _broadcast_scalar(lhs, p)
+            out = _apply_op(node.op, a[None, :], rhs.values)
+            if node.op in _CMP:
+                out = (out.astype(jnp.float64) if node.bool_mod
+                       else jnp.where(out, rhs.values, jnp.nan))
+            return SeriesMatrix(_strip(rhs.labels) if node.op not in _CMP or node.bool_mod else rhs.labels, out)
+
+        # vector-vector: join on signature
+        lsig = [_signature(l, node) for l in lhs.labels]
+        rsig = {_signature(l, node): i for i, l in enumerate(rhs.labels)}
+        li, ri, labels = [], [], []
+        for i, s in enumerate(lsig):
+            j = rsig.get(s)
+            if j is not None:
+                li.append(i)
+                ri.append(j)
+                labels.append(_strip([lhs.labels[i]])[0] if not node.group_left
+                              else lhs.labels[i])
+        if not li:
+            return SeriesMatrix([], jnp.zeros((0, p.T)))
+        a = lhs.values[np.asarray(li)]
+        b = rhs.values[np.asarray(ri)]
+        out = _apply_op(node.op, a, b)
+        if node.op in _CMP:
+            out = out.astype(jnp.float64) if node.bool_mod else jnp.where(out, a, jnp.nan)
+        return SeriesMatrix(labels, out)
+
+    # ---- label functions ---------------------------------------------------
+
+    def _label_replace(self, call: Call, p, ctx):
+        v = self._eval(call.args[0], p, ctx)
+        dst, repl, src, regex = (_string_of(a) for a in call.args[1:5])
+        rx = re.compile(regex)
+        labels = []
+        for lab in v.labels:
+            m = rx.fullmatch(lab.get(src, ""))
+            lab = dict(lab)
+            if m is not None:
+                val = m.expand(repl.replace("$", "\\")) if "$" in repl else repl
+                if val:
+                    lab[dst] = val
+                else:
+                    lab.pop(dst, None)
+            labels.append(lab)
+        return SeriesMatrix(labels, v.values, v.metric, v.sample_ts)
+
+    def _label_join(self, call: Call, p, ctx):
+        v = self._eval(call.args[0], p, ctx)
+        dst = _string_of(call.args[1])
+        sep = _string_of(call.args[2])
+        srcs = [_string_of(a) for a in call.args[3:]]
+        labels = []
+        for lab in v.labels:
+            lab = dict(lab)
+            lab[dst] = sep.join(lab.get(s, "") for s in srcs)
+            labels.append(lab)
+        return SeriesMatrix(labels, v.values, v.metric, v.sample_ts)
+
+
+# ---- helpers ---------------------------------------------------------------
+
+_CMP = {"==", "!=", "<", "<=", ">", ">="}
+
+
+def _apply_op(op, a, b):
+    if op == "+":
+        return a + b
+    if op == "-":
+        return a - b
+    if op == "*":
+        return a * b
+    if op == "/":
+        return a / b
+    if op == "%":
+        return jnp.fmod(a, b)
+    if op == "^":
+        return jnp.power(a, b)
+    if op == "==":
+        return a == b
+    if op == "!=":
+        return a != b
+    if op == "<":
+        return a < b
+    if op == "<=":
+        return a <= b
+    if op == ">":
+        return a > b
+    if op == ">=":
+        return a >= b
+    raise PromqlError(f"unknown operator {op}")
+
+
+def _set_op(node: Binary, lhs: SeriesMatrix, rhs: SeriesMatrix, p: EvalParams):
+    lsig = [_signature(l, node) for l in lhs.labels]
+    rsigs = {_signature(l, node) for l in rhs.labels}
+    if node.op == "and":
+        keep = [i for i, s in enumerate(lsig) if s in rsigs]
+        idx = np.asarray(keep, dtype=np.int64)
+        # also require rhs sample present at t
+        rmap = {_signature(l, node): i for i, l in enumerate(rhs.labels)}
+        rsel = np.asarray([rmap[lsig[i]] for i in keep], dtype=np.int64)
+        vals = jnp.where(~jnp.isnan(rhs.values[rsel]), lhs.values[idx], jnp.nan) \
+            if keep else jnp.zeros((0, p.T))
+        return SeriesMatrix([lhs.labels[i] for i in keep], vals, lhs.metric)
+    if node.op == "unless":
+        rmap = {_signature(l, node): i for i, l in enumerate(rhs.labels)}
+        vals_list, labels = [], []
+        for i, s in enumerate(lsig):
+            j = rmap.get(s)
+            if j is None:
+                vals_list.append(lhs.values[i])
+            else:
+                vals_list.append(jnp.where(jnp.isnan(rhs.values[j]),
+                                           lhs.values[i], jnp.nan))
+            labels.append(lhs.labels[i])
+        vals = jnp.stack(vals_list) if vals_list else jnp.zeros((0, p.T))
+        return SeriesMatrix(labels, vals, lhs.metric)
+    # or: lhs plus rhs series whose signature isn't in lhs
+    lsigs = set(lsig)
+    extra = [i for i, l in enumerate(rhs.labels)
+             if _signature(l, node) not in lsigs]
+    labels = list(lhs.labels) + [rhs.labels[i] for i in extra]
+    vals = jnp.concatenate([lhs.values, rhs.values[np.asarray(extra, dtype=np.int64)]]
+                           ) if extra else lhs.values
+    return SeriesMatrix(labels, vals, lhs.metric)
+
+
+def _signature(lab: dict, node: Binary) -> tuple:
+    if node.on:
+        return tuple((k, lab.get(k, "")) for k in node.on)
+    items = {k: v for k, v in lab.items()}
+    if node.ignoring:
+        for k in node.ignoring:
+            items.pop(k, None)
+    return tuple(sorted(items.items()))
+
+
+def _strip(labels: list[dict]) -> list[dict]:
+    return [dict(l) for l in labels]
+
+
+def _map_values(v, f):
+    if isinstance(v, SeriesMatrix):
+        return SeriesMatrix(v.labels, f(v.values))
+    if isinstance(v, (int, float)):
+        return f(jnp.asarray(v)).item() if False else float(f(jnp.asarray(float(v))))
+    return f(v)
+
+
+def _broadcast_scalar(v, p: EvalParams):
+    if isinstance(v, SeriesMatrix):
+        raise PromqlError("expected a scalar")
+    if isinstance(v, (int, float)):
+        return jnp.full(p.T, float(v))
+    return jnp.asarray(v)
+
+
+def _scalar_of(v) -> float:
+    if isinstance(v, (int, float)):
+        return float(v)
+    arr = np.asarray(v)
+    return float(arr.reshape(-1)[0])
+
+
+def _string_of(node) -> str:
+    if isinstance(node, StringLiteral):
+        return node.value
+    raise PromqlError("expected a string literal")
+
+
+def _matcher_mask(m: Matcher, scan, tag_names) -> np.ndarray:
+    """Row mask for one label matcher, via the tag dictionary."""
+    if m.label not in tag_names:
+        # missing label behaves as empty string
+        empty_match = (m.op == "=" and m.value == "") or \
+            (m.op == "!=" and m.value != "") or \
+            (m.op == "=~" and re.fullmatch(m.value, "") is not None) or \
+            (m.op == "!~" and re.fullmatch(m.value, "") is None)
+        return np.ones(scan.num_rows, bool) if empty_match else np.zeros(scan.num_rows, bool)
+    codes = scan.columns[m.label]
+    values = scan.tag_dicts[m.label]
+    lut = np.zeros(len(values) + 1, dtype=bool)  # slot -1 -> last (empty)
+    if m.op == "=":
+        lut[:-1] = values == m.value if len(values) else False
+        lut[-1] = m.value == ""
+    elif m.op == "!=":
+        lut[:-1] = values != m.value
+        lut[-1] = m.value != ""
+    else:
+        rx = re.compile(m.value)
+        hits = np.asarray([rx.fullmatch(str(x)) is not None for x in values], dtype=bool) \
+            if len(values) else np.zeros(0, bool)
+        empty_hit = rx.fullmatch("") is not None
+        if m.op == "=~":
+            lut[:-1] = hits
+            lut[-1] = empty_hit
+        else:
+            lut[:-1] = ~hits
+            lut[-1] = not empty_hit
+    return lut[codes]
+
+
+def _to_long_result(times: np.ndarray, result) -> QueryResult:
+    """Matrix -> long-format table (tags..., ts, value), NaN cells dropped
+    (matches the reference's TQL tabular output)."""
+    if not isinstance(result, SeriesMatrix):
+        arr = np.asarray(_broadcast_with(times, result))
+        ts_ms = (times * 1000).astype(np.int64)
+        return QueryResult(["ts", "value"],
+                           [DataType.TIMESTAMP_MILLISECOND, DataType.FLOAT64],
+                           [ts_ms, arr])
+    vals = np.asarray(result.values)
+    S, T = vals.shape if vals.size else (0, len(times))
+    label_keys = sorted({k for lab in result.labels for k in lab})
+    ts_ms = (times * 1000).astype(np.int64)
+    rows_ts, rows_val = [], []
+    rows_labels = {k: [] for k in label_keys}
+    for s in range(S):
+        present = ~np.isnan(vals[s])
+        n = int(present.sum())
+        if n == 0:
+            continue
+        rows_ts.append(ts_ms[present])
+        rows_val.append(vals[s][present])
+        for k in label_keys:
+            rows_labels[k].append(np.full(n, result.labels[s].get(k), dtype=object))
+    if rows_ts:
+        ts_col = np.concatenate(rows_ts)
+        val_col = np.concatenate(rows_val)
+        lab_cols = {k: np.concatenate(v) for k, v in rows_labels.items()}
+    else:
+        ts_col = np.empty(0, np.int64)
+        val_col = np.empty(0)
+        lab_cols = {k: np.empty(0, object) for k in label_keys}
+    names = label_keys + ["ts", "value"]
+    dtypes = [DataType.STRING] * len(label_keys) + \
+        [DataType.TIMESTAMP_MILLISECOND, DataType.FLOAT64]
+    cols = [lab_cols[k] for k in label_keys] + [ts_col, val_col]
+    return QueryResult(names, dtypes, cols)
+
+
+def _broadcast_with(times, v):
+    if isinstance(v, (int, float)):
+        return np.full(len(times), float(v))
+    return np.asarray(v)

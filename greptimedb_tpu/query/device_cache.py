@@ -1,0 +1,49 @@
+"""Device block cache: the HBM-resident analog of the reference's page
+cache (mito2/src/cache.rs:53-61 + write/file caches).
+
+The reference amortizes repeated scans through an in-memory parquet page
+cache; on TPU the equivalent currency is *device-resident column blocks* —
+host->HBM transfer is the scan bottleneck (SURVEY.md §7 hard part #4), so
+hot blocks stay pinned in HBM keyed by (region, data version, column,
+block window, dtype). Any write/flush/compact bumps the region's data
+version, so stale blocks simply stop being referenced and age out via LRU.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Callable
+
+import jax
+
+from greptimedb_tpu import config
+
+
+class DeviceCache:
+    def __init__(self, budget_bytes: int | None = None):
+        self.budget = budget_bytes if budget_bytes is not None else config.device_cache_bytes()
+        self._lru: OrderedDict[tuple, jax.Array] = OrderedDict()
+        self._bytes = 0
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, key: tuple, build: Callable[[], jax.Array]) -> jax.Array:
+        hit = self._lru.get(key)
+        if hit is not None:
+            self._lru.move_to_end(key)
+            self.hits += 1
+            return hit
+        self.misses += 1
+        arr = build()
+        nbytes = arr.nbytes
+        if nbytes <= self.budget:
+            self._lru[key] = arr
+            self._bytes += nbytes
+            while self._bytes > self.budget and self._lru:
+                _, old = self._lru.popitem(last=False)
+                self._bytes -= old.nbytes
+        return arr
+
+    def clear(self) -> None:
+        self._lru.clear()
+        self._bytes = 0

@@ -76,11 +76,12 @@ class DeviceKey:
 @functools.partial(
     jax.jit,
     static_argnames=("where", "keys", "agg_args", "ops", "num_segments",
-                     "ts_name", "tag_names", "schema", "need_ts"),
+                     "ts_name", "tag_names", "schema", "need_ts", "acc_dtype"),
 )
 def _agg_block(
     cols: dict,
-    valid: jax.Array,
+    n_valid: jax.Array,  # scalar: rows [0, n_valid) are real, rest padding
+    dedup_mask,  # Optional[jax.Array]: survivors of last-write-wins
     *,
     where,
     keys: tuple[DeviceKey, ...],
@@ -91,8 +92,13 @@ def _agg_block(
     tag_names: frozenset,
     schema,
     need_ts: bool,
+    acc_dtype=jnp.float64,
 ):
-    mask = valid
+    some = next(iter(cols.values()))
+    # validity computed on device from a scalar — no host mask transfer
+    mask = jnp.arange(some.shape[0]) < n_valid
+    if dedup_mask is not None:
+        mask = mask & dedup_mask
     if where is not None:
         w = eval_device(where, cols, tag_names, schema)
         mask = mask & (w if w.dtype == jnp.bool_ else w != 0)
@@ -109,24 +115,28 @@ def _agg_block(
             key_arrays.append(jnp.clip(arr, 0, k.size - 1))
         gid = combine_group_ids(key_arrays, tuple(k.size for k in keys))
     else:
-        gid = jnp.zeros(valid.shape[0], dtype=jnp.int32)
+        gid = jnp.zeros(mask.shape[0], dtype=jnp.int32)
     if agg_args:
         vals = [eval_device(a, cols, tag_names, schema) for a in agg_args]
         vals = [
-            jnp.broadcast_to(v, valid.shape).astype(jnp.float64)
-            if jnp.ndim(v) == 0 else v.astype(jnp.float64)
+            jnp.broadcast_to(v, mask.shape).astype(acc_dtype)
+            if jnp.ndim(v) == 0 else v.astype(acc_dtype)
             for v in vals
         ]
         values = jnp.stack(vals, axis=1)
     else:
-        values = jnp.zeros((valid.shape[0], 1), dtype=jnp.float64)
+        values = jnp.zeros((mask.shape[0], 1), dtype=acc_dtype)
     ts = cols[ts_name] if need_ts else None
     return segment_agg(values, gid, mask, num_segments, ops=ops, ts=ts)
 
 
 @functools.partial(jax.jit, static_argnames=("where", "tag_names", "schema"))
-def _filter_block(cols: dict, valid: jax.Array, *, where, tag_names, schema):
-    mask = valid
+def _filter_block(cols: dict, n_valid: jax.Array, dedup_mask, *, where,
+                  tag_names, schema):
+    some = next(iter(cols.values()))
+    mask = jnp.arange(some.shape[0]) < n_valid
+    if dedup_mask is not None:
+        mask = mask & dedup_mask
     if where is not None:
         w = eval_device(where, cols, tag_names, schema)
         mask = mask & (w if w.dtype == jnp.bool_ else w != 0)
@@ -173,6 +183,9 @@ def _combine_partials(acc: Optional[dict], p: dict) -> dict:
 class PhysicalExecutor:
     def __init__(self, engine: RegionEngine):
         self.engine = engine
+        from greptimedb_tpu.query.device_cache import DeviceCache
+
+        self.cache = DeviceCache()
 
     def execute(self, plan: lp.LogicalPlan) -> QueryResult:
         # unwrap the linear chain
@@ -264,8 +277,9 @@ class PhysicalExecutor:
                                tuple(arg_exprs), tuple(sorted(ops)), num_groups,
                                ts_name, ctx, extra_cols)
 
-        # finalize on host over G rows
-        acc = {k: np.asarray(v) for k, v in acc.items()}
+        # finalize on host over G rows; ONE device->host fetch (transfer
+        # round-trips dominate small results on remote-attached devices)
+        acc = _fetch_packed(acc)
         rows = acc["rows"][:, 0] if acc["rows"].ndim == 2 else acc["rows"]
         if agg.keys:
             present = np.flatnonzero(rows > 0)
@@ -356,7 +370,10 @@ class PhysicalExecutor:
 
     def _stream_agg(self, scan: ScanData, table, bound_where, keys, arg_exprs,
                     ops, num_groups, ts_name, ctx, extra_cols):
+        from greptimedb_tpu import config
+
         schema = table.schema
+        acc_dtype = jnp.dtype(config.compute_dtype())
         device_col_names = self._device_columns(
             scan, bound_where, keys, arg_exprs, ts_name, extra_cols
         )
@@ -364,25 +381,49 @@ class PhysicalExecutor:
         dedup_mask = self._maybe_dedup(scan, table, ctx)
         block = min(block_size_for(n), DEFAULT_BLOCK_ROWS)
         tag_names = frozenset(ctx.tag_names)
+        float_fields = {
+            c.name for c in schema.field_columns if c.dtype.is_float
+        }
         acc = None
         for start in range(0, n, block):
             end = min(start + block, n)
             cols = {}
             for name in device_col_names:
-                src = extra_cols[name] if name in extra_cols else scan.columns[name]
-                cols[name] = jnp.asarray(pad_rows(src[start:end], block))
-            valid = make_mask(end - start, block)
+                cols[name] = self._device_block(
+                    scan, name, start, end, block, extra_cols,
+                    acc_dtype if name in float_fields else None,
+                )
+            dmask = None
             if dedup_mask is not None:
-                valid = valid & pad_rows(np.asarray(dedup_mask[start:end]), block, fill=False)
+                dmask = _pad_device_mask(dedup_mask, start, end, block)
             partial = _agg_block(
-                cols, jnp.asarray(valid),
+                cols, jnp.asarray(end - start), dmask,
                 where=bound_where, keys=keys, agg_args=arg_exprs, ops=ops,
                 num_segments=num_groups, ts_name=ts_name,
                 tag_names=tag_names, schema=schema,
                 need_ts=bool({"first", "last"} & set(ops)),
+                acc_dtype=acc_dtype,
             )
             acc = _combine_partials(acc, partial)
         return acc
+
+    def _device_block(self, scan: ScanData, name, start, end, block,
+                      extra_cols, cast_dtype):
+        """Fetch one padded column block, through the HBM block cache when
+        the scan snapshot is cacheable (named region + stable version)."""
+
+        def build():
+            src = extra_cols[name] if name in extra_cols else scan.columns[name]
+            arr = pad_rows(src[start:end], block)
+            if cast_dtype is not None and arr.dtype != cast_dtype:
+                arr = arr.astype(cast_dtype)
+            return jnp.asarray(arr)
+
+        if scan.region_id < 0 or name in extra_cols:
+            return build()
+        key = (scan.region_id, scan.data_version, scan.scan_fingerprint,
+               name, start, block, str(cast_dtype))
+        return self.cache.get(key, build)
 
     def _device_columns(self, scan, bound_where, keys, arg_exprs, ts_name, extra_cols):
         from greptimedb_tpu.query.expr import collect_columns
@@ -400,7 +441,9 @@ class PhysicalExecutor:
             raise PlanError(f"columns missing from scan: {sorted(missing)}")
         return sorted(needed)
 
-    def _maybe_dedup(self, scan: ScanData, table, ctx) -> Optional[np.ndarray]:
+    def _maybe_dedup(self, scan: ScanData, table, ctx) -> Optional[jax.Array]:
+        """Device-resident last-write-wins mask (stays on device; sliced
+        per block without a host round-trip)."""
         if table.append_mode or not scan.needs_dedup:
             return None
         tag_names = [c.name for c in table.schema.tag_columns]
@@ -413,10 +456,9 @@ class PhysicalExecutor:
         else:
             sid = jnp.zeros(scan.num_rows, dtype=jnp.int64)
         ts = jnp.asarray(scan.columns[table.schema.time_index.name])
-        mask = _dedup_mask(sid, ts, jnp.asarray(scan.seq),
+        return _dedup_mask(sid, ts, jnp.asarray(scan.seq),
                            jnp.asarray(scan.op_type),
                            jnp.ones(scan.num_rows, dtype=bool))
-        return np.asarray(mask)
 
     # ---- raw (non-aggregate) path ------------------------------------------
 
@@ -434,13 +476,14 @@ class PhysicalExecutor:
         for start in range(0, n, block):
             end = min(start + block, n)
             cols = {
-                name: jnp.asarray(pad_rows(arr[start:end], block))
-                for name, arr in scan.columns.items()
+                name: self._device_block(scan, name, start, end, block, {}, None)
+                for name in scan.columns
             }
-            valid = make_mask(end - start, block)
+            dmask = None
             if dedup_mask is not None:
-                valid = valid & pad_rows(dedup_mask[start:end], block, fill=False)
-            mask = _filter_block(cols, jnp.asarray(valid), where=bound_where,
+                dmask = _pad_device_mask(dedup_mask, start, end, block)
+            mask = _filter_block(cols, jnp.asarray(end - start), dmask,
+                                 where=bound_where,
                                  tag_names=tag_names, schema=schema)
             picked.append(np.flatnonzero(np.asarray(mask)) + start)
         idx = np.concatenate(picked) if picked else np.empty(0, dtype=np.int64)
@@ -512,6 +555,47 @@ class PhysicalExecutor:
 
 
 # ---- helpers ---------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("start", "end", "block"))
+def _pad_device_mask(mask: jax.Array, start: int, end: int, block: int) -> jax.Array:
+    sl = jax.lax.dynamic_slice_in_dim(mask, start, end - start)
+    return jnp.pad(sl, (0, block - (end - start)), constant_values=False)
+
+
+def _fetch_packed(acc: dict) -> dict[str, np.ndarray]:
+    """Pull all partial-aggregate arrays in one packed device->host
+    transfer. Float-representable ops ride one f64 matrix; int64
+    timestamps (first_ts/last_ts) keep a separate exact transfer."""
+    float_ops = [k for k in acc if k not in ("first_ts", "last_ts")]
+    # pack dtype: f64 for small results (exact counts), compute dtype for
+    # large ones — with many groups, per-group counts stay far below the
+    # f32-exact integer range (2^24) while halving the transfer
+    n_groups = acc[float_ops[0]].shape[0]
+    pack_dtype = jnp.float64 if n_groups <= 4096 else jnp.promote_types(
+        acc["sum"].dtype if "sum" in acc else jnp.float32, jnp.float32)
+    parts, widths = [], []
+    for k in float_ops:
+        v = acc[k]
+        if v.ndim == 1:
+            v = v[:, None]
+        parts.append(v.astype(pack_dtype))
+        widths.append(parts[-1].shape[1])
+    packed = np.asarray(jnp.concatenate(parts, axis=1)) if parts else None
+    out: dict[str, np.ndarray] = {}
+    off = 0
+    for k, w in zip(float_ops, widths):
+        sl = packed[:, off:off + w]
+        off += w
+        if k in ("count", "rows"):
+            sl = sl.astype(np.int64)
+        out[k] = sl if acc[k].ndim == 2 else sl[:, 0]
+    int_ops = [k for k in ("first_ts", "last_ts") if k in acc]
+    if int_ops:
+        ipacked = np.asarray(jnp.stack([acc[k] for k in int_ops], axis=1))
+        for i, k in enumerate(int_ops):
+            out[k] = ipacked[:, i]
+    return out
 
 
 def _closed_range(ts_range):

@@ -35,19 +35,25 @@ class TagRegistry:
         self.values: dict[str, list] = {n: [] for n in tag_names}
 
     def encode(self, name: str, strings: np.ndarray) -> np.ndarray:
+        """Vectorized: unique the batch (O(n log n) in C), then walk only
+        the (small) set of distinct values through the dictionary."""
         table = self.tables[name]
         vals = self.values[name]
-        codes = np.empty(len(strings), dtype=np.int32)
-        for i, s in enumerate(strings):
-            if s is None:
-                codes[i] = -1
-                continue
-            c = table.get(s)
-            if c is None:
-                c = len(vals)
-                table[s] = c
-                vals.append(s)
-            codes[i] = c
+        arr = np.asarray(strings, dtype=object)
+        null_mask = np.frompyfunc(lambda x: x is None, 1, 1)(arr).astype(bool)
+        codes = np.full(len(arr), -1, dtype=np.int32)
+        present = ~null_mask
+        if present.any():
+            uniq, inv = np.unique(arr[present].astype(str), return_inverse=True)
+            mapping = np.empty(len(uniq), dtype=np.int32)
+            for i, s in enumerate(uniq):
+                c = table.get(s)
+                if c is None:
+                    c = len(vals)
+                    table[s] = c
+                    vals.append(s)
+                mapping[i] = c
+            codes[present] = mapping[inv]
         return codes
 
     def remap_dict(self, name: str, file_values: np.ndarray) -> np.ndarray:

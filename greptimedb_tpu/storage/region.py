@@ -14,6 +14,7 @@ edit, WAL truncation.
 from __future__ import annotations
 
 import os
+from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Optional, Sequence
 
@@ -49,6 +50,11 @@ class ScanData:
     tag_dicts: dict[str, np.ndarray]
     num_rows: int
     needs_dedup: bool = True
+    # identity for the device block cache: (region_id, data_version,
+    # scan_fingerprint) names an immutable column snapshot
+    region_id: int = -1
+    data_version: int = 0
+    scan_fingerprint: tuple = ()
 
     @property
     def tag_cardinalities(self) -> dict[str, int]:
@@ -69,6 +75,14 @@ class Region:
         self.memtable = Memtable(schema, self.registry)
         self.next_seq = 0
         self.files: dict[str, FileMeta] = {}
+        # bumped on every mutation; device cache keys include it
+        self.data_version = 0
+        # host scan cache: decoded-column snapshots keyed by
+        # (data_version, ts_range, columns) — the analog of the reference's
+        # decoded-page cache (mito2/src/cache.rs); repeated dashboard/TSBS
+        # queries skip parquet decode entirely
+        self._scan_cache: "OrderedDict[tuple, ScanData]" = OrderedDict()
+        self.scan_cache_entries = 2
 
     # ---- lifecycle ---------------------------------------------------------
 
@@ -119,6 +133,7 @@ class Region:
         self.wal.append(self.region_id, seq, op_type, batch)
         self.memtable.write(batch, seq, op_type)
         self.next_seq = seq + n
+        self.data_version += 1
         return n
 
     # ---- flush -------------------------------------------------------------
@@ -140,6 +155,7 @@ class Region:
                                    tag_dicts=self.registry.snapshot())
         self.memtable = Memtable(self.schema, self.registry)
         self.wal.obsolete(self.region_id, self.next_seq)
+        self.data_version += 1
         return meta
 
     def _sort_order(self, cols: dict[str, np.ndarray], seq: np.ndarray) -> np.ndarray:
@@ -190,6 +206,7 @@ class Region:
                                    tag_dicts=self.registry.snapshot(), removed=removed)
         for fid in removed:
             self.sst_reader.delete(fid)
+        self.data_version += 1
         return meta
 
     # ---- scan --------------------------------------------------------------
@@ -201,6 +218,11 @@ class Region:
     ) -> Optional[ScanData]:
         """Collect memtable + pruned SSTs into concatenated host columns."""
         names = self._scan_columns(projection)
+        cache_key = (self.data_version, ts_range, tuple(names))
+        cached = self._scan_cache.get(cache_key)
+        if cached is not None:
+            self._scan_cache.move_to_end(cache_key)
+            return cached
         parts_cols: list[dict[str, np.ndarray]] = []
         parts_seq: list[np.ndarray] = []
         parts_op: list[np.ndarray] = []
@@ -231,14 +253,21 @@ class Region:
             for c in self.schema.tag_columns
             if c.name in names
         }
-        return ScanData(
+        result = ScanData(
             schema=self.schema,
             columns=columns,
             seq=seq,
             op_type=op,
             tag_dicts=tag_dicts,
             num_rows=len(seq),
+            region_id=self.region_id,
+            data_version=self.data_version,
+            scan_fingerprint=(ts_range, tuple(names)),
         )
+        self._scan_cache[cache_key] = result
+        while len(self._scan_cache) > self.scan_cache_entries:
+            self._scan_cache.popitem(last=False)
+        return result
 
     def _scan_columns(self, projection: Optional[Sequence[str]]) -> list[str]:
         ts_name = self.schema.time_index.name

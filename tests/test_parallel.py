@@ -1,0 +1,55 @@
+"""Sharded aggregation over the 8-device virtual CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from greptimedb_tpu.parallel import make_mesh, shard_rows, sharded_segment_agg
+from greptimedb_tpu.parallel.mesh import pad_to_multiple
+
+
+def test_mesh_shapes():
+    m = make_mesh()
+    assert m.devices.size == 8
+    assert m.axis_names == ("shard", "field")
+    m2 = make_mesh(shape=(4, 2))
+    assert m2.shape == {"shard": 4, "field": 2}
+
+
+@pytest.mark.parametrize("shape", [(8, 1), (4, 2)])
+def test_sharded_agg_matches_numpy(shape, rng):
+    n, g, f = 4096, 13, 4
+    ids = rng.integers(0, g, n).astype(np.int32)
+    vals = rng.normal(size=(n, f))
+    vals[rng.random((n, f)) < 0.05] = np.nan  # sprinkle NULLs
+    mask = rng.random(n) < 0.9
+
+    mesh = make_mesh(shape=shape)
+    out = sharded_segment_agg(
+        jnp.asarray(vals), jnp.asarray(ids), jnp.asarray(mask),
+        g, ("sum", "count", "min", "max"), mesh,
+    )
+    for k in range(g):
+        sel = vals[(ids == k) & mask]
+        for j in range(f):
+            col = sel[:, j]
+            col = col[~np.isnan(col)]
+            np.testing.assert_allclose(out["sum"][k, j], col.sum(), rtol=1e-12)
+            assert int(out["count"][k, j]) == len(col)
+            if len(col):
+                np.testing.assert_allclose(out["min"][k, j], col.min())
+                np.testing.assert_allclose(out["max"][k, j], col.max())
+            else:
+                assert np.isnan(out["min"][k, j])
+
+
+def test_shard_rows_and_padding():
+    mesh = make_mesh()
+    arr = np.arange(100, dtype=np.int64)
+    padded = pad_to_multiple(arr, 8)
+    assert padded.shape[0] == 104
+    sharded = shard_rows(padded, mesh)
+    assert sharded.sharding.is_equivalent_to(
+        jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec("shard")), 1
+    )

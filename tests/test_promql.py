@@ -1,0 +1,247 @@
+"""PromQL engine tests: hand-computed oracles over regular sample grids.
+
+Mirrors the reference's extension-operator tests (feeding built batches
+through InstantManipulate/RangeManipulate and snapshotting, SURVEY.md §4)
+— here SQL-inserted samples evaluated through the full PromQL path.
+"""
+
+import numpy as np
+import pytest
+
+from greptimedb_tpu.catalog import Catalog, MemoryKv
+from greptimedb_tpu.promql.engine import PromqlEngine, SeriesMatrix
+from greptimedb_tpu.query import QueryEngine
+from greptimedb_tpu.storage import RegionEngine
+from greptimedb_tpu.storage.engine import EngineConfig
+
+
+@pytest.fixture
+def db(tmp_path):
+    engine = RegionEngine(EngineConfig(data_dir=str(tmp_path / "data")))
+    qe = QueryEngine(Catalog(MemoryKv()), engine)
+    yield qe
+    engine.close()
+
+
+@pytest.fixture
+def prom(db):
+    return PromqlEngine(db)
+
+
+T0 = 1_000_000  # epoch seconds of first sample
+
+
+def seed_counter(db, hosts=("a", "b"), n=41, step_s=15, slope=2.0):
+    """Linear counters v = slope * i * step per host (host b = 2x slope)."""
+    db.execute_one(
+        "CREATE TABLE http_requests (host STRING, ts TIMESTAMP(3) NOT NULL, "
+        "val DOUBLE, TIME INDEX (ts), PRIMARY KEY (host)) "
+        "WITH (append_mode = 'true')"
+    )
+    rows = []
+    for hi, h in enumerate(hosts):
+        k = slope * (hi + 1)
+        for i in range(n):
+            ts_ms = (T0 + i * step_s) * 1000
+            rows.append(f"('{h}', {ts_ms}, {k * i * step_s})")
+    db.execute_one("INSERT INTO http_requests (host, ts, val) VALUES " +
+                   ", ".join(rows))
+
+
+def as_dict(sm: SeriesMatrix, key="host"):
+    return {lab.get(key): np.asarray(sm.values[i]) for i, lab in enumerate(sm.labels)}
+
+
+class TestSelectors:
+    def test_instant_selector_lookback(self, prom, db):
+        seed_counter(db)
+        start, end, step = T0 + 300, T0 + 420, 60.0
+        times, r = prom.eval_matrix("http_requests", start, end, step)
+        assert isinstance(r, SeriesMatrix)
+        d = as_dict(r)
+        # samples every 15s -> eval points land exactly on samples
+        np.testing.assert_allclose(d["a"], 2.0 * (times - T0))
+        np.testing.assert_allclose(d["b"], 4.0 * (times - T0))
+
+    def test_lookback_expiry(self, prom, db):
+        seed_counter(db, n=2)  # samples at T0, T0+15 only
+        times, r = prom.eval_matrix("http_requests", T0, T0 + 600, 60.0)
+        d = as_dict(r)
+        # beyond 5m after the last sample -> stale (NaN)
+        assert np.isnan(d["a"][-1])
+        assert not np.isnan(d["a"][0])
+
+    def test_matchers(self, prom, db):
+        seed_counter(db)
+        _, r = prom.eval_matrix('http_requests{host="a"}', T0 + 300, T0 + 300, 1.0)
+        assert [l["host"] for l in r.labels] == ["a"]
+        _, r = prom.eval_matrix('http_requests{host!="a"}', T0 + 300, T0 + 300, 1.0)
+        assert [l["host"] for l in r.labels] == ["b"]
+        _, r = prom.eval_matrix('http_requests{host=~"a|b"}', T0 + 300, T0 + 300, 1.0)
+        assert len(r.labels) == 2
+        _, r = prom.eval_matrix('http_requests{host=~"nomatch.*"}', T0 + 300, T0 + 300, 1.0)
+        assert len(r.labels) == 0
+
+    def test_offset(self, prom, db):
+        seed_counter(db)
+        t = T0 + 300
+        _, r0 = prom.eval_matrix("http_requests", t, t, 1.0)
+        _, r1 = prom.eval_matrix("http_requests offset 1m", t, t, 1.0)
+        d0, d1 = as_dict(r0), as_dict(r1)
+        np.testing.assert_allclose(d1["a"][0], d0["a"][0] - 2.0 * 60)
+
+
+class TestRangeFunctions:
+    def test_rate_linear_counter(self, prom, db):
+        seed_counter(db)
+        times, r = prom.eval_matrix("rate(http_requests[2m])", T0 + 300, T0 + 420, 60.0)
+        d = as_dict(r)
+        np.testing.assert_allclose(d["a"], 2.0, rtol=1e-9)
+        np.testing.assert_allclose(d["b"], 4.0, rtol=1e-9)
+
+    def test_increase(self, prom, db):
+        seed_counter(db)
+        times, r = prom.eval_matrix("increase(http_requests[2m])", T0 + 300, T0 + 300, 1.0)
+        d = as_dict(r)
+        np.testing.assert_allclose(d["a"][0], 2.0 * 120, rtol=1e-9)
+
+    def test_rate_with_counter_reset(self, prom, db):
+        db.execute_one(
+            "CREATE TABLE c (host STRING, ts TIMESTAMP(3) NOT NULL, val DOUBLE, "
+            "TIME INDEX (ts), PRIMARY KEY (host)) WITH (append_mode = 'true')"
+        )
+        # counter: 0, 10, 20, 5 (reset), 15 — every 30s
+        vals = [0, 10, 20, 5, 15]
+        rows = [f"('x', {(T0 + i * 30) * 1000}, {v})" for i, v in enumerate(vals)]
+        db.execute_one("INSERT INTO c (host, ts, val) VALUES " + ", ".join(rows))
+        t = T0 + 120
+        times, r = prom.eval_matrix("increase(c[2m])", t, t, 30.0)
+        d = as_dict(r)
+        # left-open window (T0, T0+120] excludes the sample at exactly T0
+        # (modern PromQL); reset-corrected samples 10,20,25,35 -> delta 25
+        # over 90s sampled, extrapolated by (90+30)/90
+        np.testing.assert_allclose(d["x"][0], 25.0 * (120 / 90), rtol=1e-9)
+
+    def test_avg_sum_count_over_time(self, prom, db):
+        seed_counter(db)
+        t = T0 + 300
+        for q, expect_a in [
+            ("avg_over_time(http_requests[1m])", 2.0 * np.mean([300, 285, 270, 255])),
+            ("sum_over_time(http_requests[1m])", 2.0 * sum([300, 285, 270, 255])),
+            ("count_over_time(http_requests[1m])", 4),
+            ("min_over_time(http_requests[1m])", 2.0 * 255),
+            ("max_over_time(http_requests[1m])", 2.0 * 300),
+            ("last_over_time(http_requests[1m])", 2.0 * 300),
+        ]:
+            _, r = prom.eval_matrix(q, t, t, 60.0)
+            d = as_dict(r)
+            np.testing.assert_allclose(d["a"][0], expect_a, rtol=1e-9, err_msg=q)
+
+    def test_delta_gauge(self, prom, db):
+        seed_counter(db)
+        t = T0 + 300
+        _, r = prom.eval_matrix("delta(http_requests[2m])", t, t, 60.0)
+        d = as_dict(r)
+        np.testing.assert_allclose(d["a"][0], 2.0 * 120, rtol=1e-9)
+
+    def test_changes_resets(self, prom, db):
+        db.execute_one(
+            "CREATE TABLE g (host STRING, ts TIMESTAMP(3) NOT NULL, val DOUBLE, "
+            "TIME INDEX (ts), PRIMARY KEY (host)) WITH (append_mode = 'true')"
+        )
+        vals = [1, 1, 2, 1, 1, 3]
+        rows = [f"('x', {(T0 + i * 10) * 1000}, {v})" for i, v in enumerate(vals)]
+        db.execute_one("INSERT INTO g (host, ts, val) VALUES " + ", ".join(rows))
+        t = T0 + 50
+        _, r = prom.eval_matrix("changes(g[50s])", t, t, 10.0)
+        assert as_dict(r)["x"][0] == 3  # 1->2, 2->1, 1->3
+        _, r = prom.eval_matrix("resets(g[50s])", t, t, 10.0)
+        assert as_dict(r)["x"][0] == 1
+
+    def test_deriv(self, prom, db):
+        seed_counter(db)
+        t = T0 + 300
+        _, r = prom.eval_matrix("deriv(http_requests[2m])", t, t, 60.0)
+        np.testing.assert_allclose(as_dict(r)["a"][0], 2.0, rtol=1e-6)
+
+    def test_range_must_align_with_step(self, prom, db):
+        seed_counter(db)
+        from greptimedb_tpu.promql.parser import PromqlError
+        with pytest.raises(PromqlError):
+            prom.eval_matrix("rate(http_requests[90s])", T0, T0 + 300, 60.0)
+
+
+class TestOperators:
+    def test_aggregate_sum_by(self, prom, db):
+        seed_counter(db)
+        t = T0 + 300
+        times, r = prom.eval_matrix("sum(http_requests)", t, t, 1.0)
+        assert r.labels == [{}]
+        np.testing.assert_allclose(np.asarray(r.values)[0, 0], 6.0 * 300)
+        _, r = prom.eval_matrix("sum by (host) (http_requests)", t, t, 1.0)
+        assert len(r.labels) == 2
+        _, r = prom.eval_matrix("avg(http_requests)", t, t, 1.0)
+        np.testing.assert_allclose(np.asarray(r.values)[0, 0], 3.0 * 300)
+        _, r = prom.eval_matrix("count(http_requests)", t, t, 1.0)
+        assert np.asarray(r.values)[0, 0] == 2
+
+    def test_topk(self, prom, db):
+        seed_counter(db)
+        t = T0 + 300
+        _, r = prom.eval_matrix("topk(1, http_requests)", t, t, 1.0)
+        d = as_dict(r)
+        assert np.isnan(d["a"][0])  # host b is larger
+        assert not np.isnan(d["b"][0])
+
+    def test_vector_scalar_arith(self, prom, db):
+        seed_counter(db)
+        t = T0 + 300
+        _, r = prom.eval_matrix("http_requests / 100 + 1", t, t, 1.0)
+        d = as_dict(r)
+        np.testing.assert_allclose(d["a"][0], 600 / 100 + 1)
+
+    def test_vector_vector_matching(self, prom, db):
+        seed_counter(db)
+        t = T0 + 300
+        _, r = prom.eval_matrix("http_requests - http_requests", t, t, 1.0)
+        d = as_dict(r)
+        np.testing.assert_allclose(d["a"][0], 0.0)
+        np.testing.assert_allclose(d["b"][0], 0.0)
+
+    def test_comparison_filter_and_bool(self, prom, db):
+        seed_counter(db)
+        t = T0 + 300
+        _, r = prom.eval_matrix("http_requests > 700", t, t, 1.0)
+        d = as_dict(r)
+        assert np.isnan(d["a"][0])  # 600 filtered out
+        np.testing.assert_allclose(d["b"][0], 1200.0)
+        _, r = prom.eval_matrix("http_requests > bool 700", t, t, 1.0)
+        d = as_dict(r)
+        assert d["a"][0] == 0.0 and d["b"][0] == 1.0
+
+    def test_scalar_literal_expr(self, prom, db):
+        seed_counter(db)
+        times, r = prom.eval_matrix("2 + 3 * 4", T0, T0 + 60, 60.0)
+        np.testing.assert_allclose(np.asarray(r), 14.0)
+
+
+class TestTql:
+    def test_tql_eval_through_sql(self, db):
+        seed_counter(db)
+        r = db.execute_one(
+            f"TQL EVAL ({T0 + 300}, {T0 + 420}, '60') "
+            "sum by (host) (rate(http_requests[2m]))"
+        )
+        assert set(r.names) == {"host", "ts", "value"}
+        d = r.to_pydict()
+        by_host = {}
+        for h, v in zip(d["host"], d["value"]):
+            by_host.setdefault(h, []).append(v)
+        np.testing.assert_allclose(by_host["a"], 2.0, rtol=1e-9)
+        np.testing.assert_allclose(by_host["b"], 4.0, rtol=1e-9)
+
+    def test_tql_label_output(self, db):
+        seed_counter(db)
+        r = db.execute_one(f"TQL EVAL ({T0 + 300}, {T0 + 300}, '1') http_requests")
+        assert r.num_rows == 2
+        assert sorted(r.to_pydict()["host"]) == ["a", "b"]
