@@ -1,0 +1,3 @@
+from greptimedb_tpu.cli import main
+
+main()

@@ -1,0 +1,102 @@
+"""Process entry point (mirrors reference src/cmd: the `greptime` binary's
+`standalone start` subcommand and `cli` REPL, cmd/src/bin/greptime.rs:35-55).
+
+    python -m greptimedb_tpu standalone start --data-home /tmp/db \
+        --http-addr 127.0.0.1:4000
+    python -m greptimedb_tpu repl --data-home /tmp/db
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import time
+
+
+def build_standalone(data_home: str):
+    """Assemble the standalone stack (reference cmd/src/standalone.rs:381-530
+    wiring: kv backend -> catalog -> region engine -> query engine)."""
+    from greptimedb_tpu.catalog import Catalog, FileKv
+    from greptimedb_tpu.query import QueryEngine
+    from greptimedb_tpu.storage import RegionEngine
+    from greptimedb_tpu.storage.engine import EngineConfig
+
+    os.makedirs(data_home, exist_ok=True)
+    engine = RegionEngine(EngineConfig(data_dir=os.path.join(data_home, "data")))
+    catalog = Catalog(FileKv(os.path.join(data_home, "catalog.json")))
+    qe = QueryEngine(catalog, engine)
+    return engine, qe
+
+
+def cmd_standalone(args):
+    from greptimedb_tpu.servers import HttpServer
+
+    engine, qe = build_standalone(args.data_home)
+    host, _, port = args.http_addr.rpartition(":")
+    server = HttpServer(qe, host or "127.0.0.1", int(port))
+    actual = server.start()
+    print(f"greptimedb_tpu standalone listening on http://{host or '127.0.0.1'}:{actual}",
+          flush=True)
+    stop = []
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    try:
+        while not stop:
+            time.sleep(0.2)
+    finally:
+        server.stop()
+        engine.close()
+
+
+def cmd_repl(args):
+    engine, qe = build_standalone(args.data_home)
+    print("greptimedb_tpu REPL — SQL or TQL, \\q to quit")
+    try:
+        while True:
+            try:
+                line = input("sql> ")
+            except EOFError:
+                break
+            if line.strip() in ("\\q", "exit", "quit"):
+                break
+            if not line.strip():
+                continue
+            try:
+                r = qe.execute_one(line)
+                if r.is_query:
+                    print("\t".join(r.names))
+                    for row in r.rows()[:100]:
+                        print("\t".join(str(v) for v in row))
+                    if r.num_rows > 100:
+                        print(f"... ({r.num_rows} rows)")
+                else:
+                    print(f"OK, {r.affected_rows} rows affected")
+            except Exception as e:  # noqa: BLE001 — REPL boundary
+                print(f"error: {e}")
+    finally:
+        engine.close()
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(prog="greptimedb_tpu")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p_sa = sub.add_parser("standalone", help="run the standalone server")
+    sa_sub = p_sa.add_subparsers(dest="subcmd", required=True)
+    p_start = sa_sub.add_parser("start")
+    p_start.add_argument("--data-home", default="./greptimedb_tpu_data")
+    p_start.add_argument("--http-addr", default="127.0.0.1:4000")
+    p_start.set_defaults(fn=cmd_standalone)
+
+    p_repl = sub.add_parser("repl", help="interactive SQL/TQL shell")
+    p_repl.add_argument("--data-home", default="./greptimedb_tpu_data")
+    p_repl.set_defaults(fn=cmd_repl)
+
+    args = parser.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -16,10 +16,11 @@ from typing import Optional
 import numpy as np
 
 MIN_BLOCK_ROWS = 1024
-# Streaming kicks in above this: one dispatch per query beats pipelined
-# small blocks when dispatch/transfer round-trips dominate (remote-attached
-# devices); 2^25 rows keeps an f32 column at 128 MiB.
-DEFAULT_BLOCK_ROWS = 1 << 25
+# Streaming block cap: few dispatches per query (round-trips dominate on
+# remote-attached devices) while keeping kernel temporaries ([block, F]
+# stacked values + element masks) well under HBM: 2^23 rows x 10 f32
+# fields ~= 335 MiB per temporary.
+DEFAULT_BLOCK_ROWS = 1 << 23
 _COARSE = 1 << 20
 
 

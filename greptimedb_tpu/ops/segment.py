@@ -105,14 +105,16 @@ def segment_agg(
     if need_sum:
         sums = seg_sum(jnp.where(elem_mask, values, 0).astype(values.dtype))
     if need_count:
-        counts = seg_sum(elem_mask.astype(jnp.int64))
+        # int32: exact per-block (block rows << 2^31); cross-block combine
+        # upcasts to int64
+        counts = seg_sum(elem_mask.astype(jnp.int32))
     if "sum" in ops:
         out["sum"] = sums
     if "count" in ops:
         out["count"] = counts
     if "rows" in ops:
         # [G, 1]: per-group, not per-field
-        out["rows"] = seg_sum(row_mask.astype(jnp.int64)[:, None])
+        out["rows"] = seg_sum(row_mask.astype(jnp.int32)[:, None])
     if "sumsq" in ops:
         # NOTE: textbook sum-of-squares is cancellation-prone; acceptable in
         # f64, but the f32 TPU fast path needs a mean-offset/Welford kernel

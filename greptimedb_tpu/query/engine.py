@@ -107,7 +107,11 @@ class QueryEngine:
     def _table(self, name: str, ctx: QueryContext) -> TableInfo:
         db = ctx.db
         if "." in name:
-            db, name = name.rsplit(".", 1)
+            # db.table only when the prefix names a real database —
+            # otherwise it's a table name containing dots ("sys.cpu")
+            candidate_db, rest = name.rsplit(".", 1)
+            if self.catalog.database_exists(candidate_db):
+                db, name = candidate_db, rest
         info = self.catalog.table(db, name)
         self._ensure_open(info)
         return info

@@ -73,11 +73,6 @@ class DeviceKey:
 # ---- fused per-block kernel ------------------------------------------------
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("where", "keys", "agg_args", "ops", "num_segments",
-                     "ts_name", "tag_names", "schema", "need_ts", "acc_dtype"),
-)
 def _agg_block(
     cols: dict,
     n_valid: jax.Array,  # scalar: rows [0, n_valid) are real, rest padding
@@ -130,6 +125,47 @@ def _agg_block(
     return segment_agg(values, gid, mask, num_segments, ops=ops, ts=ts)
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("where", "keys", "agg_args", "ops", "num_segments",
+                     "ts_name", "tag_names", "schema", "need_ts", "acc_dtype",
+                     "float_ops", "int_ops", "pack_dtype"),
+)
+def _agg_scan(
+    blocks: tuple,  # tuple of per-block col dicts (pytree)
+    n_valids: jax.Array,  # [nblocks]
+    dedup_masks,  # Optional[tuple of per-block masks]
+    *,
+    where, keys, agg_args, ops, num_segments, ts_name, tag_names, schema,
+    need_ts, acc_dtype, float_ops, int_ops, pack_dtype,
+):
+    """The WHOLE aggregation as one device program: per-block fused
+    filter+group+reduce, on-device partial combine, and a packed result —
+    exactly one dispatch and one device->host transfer per query."""
+    acc = None
+    for i, cols in enumerate(blocks):
+        partial = _agg_block(
+            cols, n_valids[i],
+            dedup_masks[i] if dedup_masks is not None else None,
+            where=where, keys=keys, agg_args=agg_args, ops=ops,
+            num_segments=num_segments, ts_name=ts_name, tag_names=tag_names,
+            schema=schema, need_ts=need_ts, acc_dtype=acc_dtype,
+        )
+        acc = _combine_partials(acc, partial)
+    parts = []
+    for k in float_ops:
+        v = acc[k]
+        if v.ndim == 1:
+            v = v[:, None]
+        parts.append(v.astype(pack_dtype))
+    packed_f = jnp.concatenate(parts, axis=1)
+    if int_ops:
+        packed_i = jnp.stack([acc[k] for k in int_ops], axis=1)
+    else:
+        packed_i = jnp.zeros((0,), jnp.int64)
+    return packed_f, packed_i
+
+
 @functools.partial(jax.jit, static_argnames=("where", "tag_names", "schema"))
 def _filter_block(cols: dict, n_valid: jax.Array, dedup_mask, *, where,
                   tag_names, schema):
@@ -156,7 +192,9 @@ def _combine_partials(acc: Optional[dict], p: dict) -> dict:
     out = {}
     for k, v in p.items():
         a = acc[k]
-        if k in ("sum", "count", "rows", "sumsq"):
+        if k in ("count", "rows"):
+            out[k] = a.astype(jnp.int64) + v.astype(jnp.int64)
+        elif k in ("sum", "sumsq"):
             out[k] = a + v
         elif k == "min":
             out[k] = jnp.fmin(a, v)
@@ -276,10 +314,6 @@ class PhysicalExecutor:
         acc = self._stream_agg(scan, table, bound_where, tuple(keys),
                                tuple(arg_exprs), tuple(sorted(ops)), num_groups,
                                ts_name, ctx, extra_cols)
-
-        # finalize on host over G rows; ONE device->host fetch (transfer
-        # round-trips dominate small results on remote-attached devices)
-        acc = _fetch_packed(acc)
         rows = acc["rows"][:, 0] if acc["rows"].ndim == 2 else acc["rows"]
         if agg.keys:
             present = np.flatnonzero(rows > 0)
@@ -384,7 +418,9 @@ class PhysicalExecutor:
         float_fields = {
             c.name for c in schema.field_columns if c.dtype.is_float
         }
-        acc = None
+        blocks = []
+        dmasks = [] if dedup_mask is not None else None
+        n_valids = []
         for start in range(0, n, block):
             end = min(start + block, n)
             cols = {}
@@ -393,18 +429,55 @@ class PhysicalExecutor:
                     scan, name, start, end, block, extra_cols,
                     acc_dtype if name in float_fields else None,
                 )
-            dmask = None
-            if dedup_mask is not None:
-                dmask = _pad_device_mask(dedup_mask, start, end, block)
-            partial = _agg_block(
-                cols, jnp.asarray(end - start), dmask,
-                where=bound_where, keys=keys, agg_args=arg_exprs, ops=ops,
-                num_segments=num_groups, ts_name=ts_name,
-                tag_names=tag_names, schema=schema,
-                need_ts=bool({"first", "last"} & set(ops)),
-                acc_dtype=acc_dtype,
-            )
-            acc = _combine_partials(acc, partial)
+            blocks.append(cols)
+            n_valids.append(end - start)
+            if dmasks is not None:
+                dmasks.append(_pad_device_mask(dedup_mask, start, end, block))
+
+        # output layout (static): which float/int planes the kernel packs
+        nf = max(len(arg_exprs), 1)
+        produced_f, produced_i = [], []
+        widths = {}
+        for op in ops:
+            if op in ("first", "last"):
+                produced_f.append(op)
+                widths[op] = nf
+                produced_i.append(op + "_ts")
+            elif op == "rows":
+                produced_f.append(op)
+                widths[op] = 1
+            else:
+                produced_f.append(op)
+                widths[op] = nf
+        float_ops = tuple(sorted(produced_f))
+        int_ops = tuple(sorted(produced_i))
+        pack_dtype = jnp.dtype(jnp.float64) if num_groups <= 4096 else acc_dtype
+        if not jnp.issubdtype(pack_dtype, jnp.floating):
+            pack_dtype = jnp.dtype(jnp.float64)
+
+        packed_f, packed_i = _agg_scan(
+            tuple(blocks), jnp.asarray(np.asarray(n_valids)),
+            tuple(dmasks) if dmasks is not None else None,
+            where=bound_where, keys=keys, agg_args=arg_exprs, ops=ops,
+            num_segments=num_groups, ts_name=ts_name, tag_names=tag_names,
+            schema=schema, need_ts=bool({"first", "last"} & set(ops)),
+            acc_dtype=acc_dtype, float_ops=float_ops, int_ops=int_ops,
+            pack_dtype=pack_dtype,
+        )
+        host_f = np.asarray(packed_f)
+        acc: dict[str, np.ndarray] = {}
+        off = 0
+        for k in float_ops:
+            w = widths[k]
+            sl = host_f[:, off:off + w]
+            off += w
+            if k in ("count", "rows"):
+                sl = sl.astype(np.int64)
+            acc[k] = sl
+        if int_ops:
+            host_i = np.asarray(packed_i)
+            for j, k in enumerate(int_ops):
+                acc[k] = host_i[:, j]
         return acc
 
     def _device_block(self, scan: ScanData, name, start, end, block,
@@ -561,41 +634,6 @@ class PhysicalExecutor:
 def _pad_device_mask(mask: jax.Array, start: int, end: int, block: int) -> jax.Array:
     sl = jax.lax.dynamic_slice_in_dim(mask, start, end - start)
     return jnp.pad(sl, (0, block - (end - start)), constant_values=False)
-
-
-def _fetch_packed(acc: dict) -> dict[str, np.ndarray]:
-    """Pull all partial-aggregate arrays in one packed device->host
-    transfer. Float-representable ops ride one f64 matrix; int64
-    timestamps (first_ts/last_ts) keep a separate exact transfer."""
-    float_ops = [k for k in acc if k not in ("first_ts", "last_ts")]
-    # pack dtype: f64 for small results (exact counts), compute dtype for
-    # large ones — with many groups, per-group counts stay far below the
-    # f32-exact integer range (2^24) while halving the transfer
-    n_groups = acc[float_ops[0]].shape[0]
-    pack_dtype = jnp.float64 if n_groups <= 4096 else jnp.promote_types(
-        acc["sum"].dtype if "sum" in acc else jnp.float32, jnp.float32)
-    parts, widths = [], []
-    for k in float_ops:
-        v = acc[k]
-        if v.ndim == 1:
-            v = v[:, None]
-        parts.append(v.astype(pack_dtype))
-        widths.append(parts[-1].shape[1])
-    packed = np.asarray(jnp.concatenate(parts, axis=1)) if parts else None
-    out: dict[str, np.ndarray] = {}
-    off = 0
-    for k, w in zip(float_ops, widths):
-        sl = packed[:, off:off + w]
-        off += w
-        if k in ("count", "rows"):
-            sl = sl.astype(np.int64)
-        out[k] = sl if acc[k].ndim == 2 else sl[:, 0]
-    int_ops = [k for k in ("first_ts", "last_ts") if k in acc]
-    if int_ops:
-        ipacked = np.asarray(jnp.stack([acc[k] for k in int_ops], axis=1))
-        for i, k in enumerate(int_ops):
-            out[k] = ipacked[:, i]
-    return out
 
 
 def _closed_range(ts_range):

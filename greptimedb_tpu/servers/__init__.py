@@ -1,0 +1,11 @@
+"""Protocol servers (mirrors reference src/servers, ~23k LoC: axum HTTP,
+tonic gRPC/Flight, MySQL, Postgres wire...).
+
+Round 1 surface: the HTTP server — /v1/sql, the Prometheus query API,
+InfluxDB line-protocol and OpenTSDB ingestion, /metrics. gRPC/Flight and
+the MySQL/Postgres wire protocols follow in later rounds.
+"""
+
+from greptimedb_tpu.servers.http import HttpServer
+
+__all__ = ["HttpServer"]

@@ -1,0 +1,434 @@
+"""HTTP server (mirrors reference servers::http `HttpServer::make_app`,
+src/servers/src/http.rs:625-801): /v1/sql, the Prometheus HTTP API,
+InfluxDB/OpenTSDB write endpoints, /metrics, /health.
+
+stdlib ThreadingHTTPServer — the host tier serves protocol traffic while
+queries execute as device kernels; no framework dependencies.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import threading
+import time
+import traceback
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+from greptimedb_tpu.query.engine import QueryContext, QueryEngine
+from greptimedb_tpu.query.result import QueryResult
+from greptimedb_tpu.utils.metrics import HTTP_REQUESTS, QUERY_DURATION, REGISTRY
+from greptimedb_tpu.utils.time import unit_to_ns
+
+
+class HttpServer:
+    def __init__(self, query_engine: QueryEngine, host: str = "127.0.0.1",
+                 port: int = 4000):
+        self.qe = query_engine
+        self.host = host
+        self.port = port
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> int:
+        # initialize the jax backend from the MAIN thread: some PJRT
+        # plugins refuse lazy initialization from worker threads
+        import jax
+        jax.devices()
+
+        qe = self.qe
+
+        class Handler(_Handler):
+            query_engine = qe
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self.port
+
+    def stop(self):
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._thread.join(timeout=5)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    query_engine: QueryEngine = None  # injected
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # quiet
+        pass
+
+    # ---- plumbing ----------------------------------------------------------
+
+    def _params(self) -> dict:
+        parsed = urllib.parse.urlparse(self.path)
+        params = {k: v[0] for k, v in urllib.parse.parse_qs(parsed.query).items()}
+        return params
+
+    def _body(self) -> bytes:
+        length = int(self.headers.get("Content-Length") or 0)
+        return self.rfile.read(length) if length else b""
+
+    def _form_or_query(self) -> dict:
+        params = self._params()
+        body = self._body()
+        ctype = (self.headers.get("Content-Type") or "").split(";")[0].strip()
+        if body and ctype in ("application/x-www-form-urlencoded", ""):
+            try:
+                form = {k: v[0] for k, v in
+                        urllib.parse.parse_qs(body.decode()).items()}
+                params = {**form, **params}
+            except UnicodeDecodeError:
+                pass
+        self._raw_body = body
+        return params
+
+    def _send(self, code: int, payload, content_type="application/json"):
+        data = payload if isinstance(payload, bytes) else \
+            json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+        route = urllib.parse.urlparse(self.path).path
+        HTTP_REQUESTS.inc(path=route, status=str(code))
+
+    # ---- routing -----------------------------------------------------------
+
+    def do_GET(self):
+        self._route()
+
+    def do_POST(self):
+        self._route()
+
+    def _route(self):
+        path = urllib.parse.urlparse(self.path).path
+        try:
+            if path == "/health" or path == "/ready":
+                return self._send(200, {})
+            if path == "/metrics":
+                return self._send(200, REGISTRY.render().encode(),
+                                  "text/plain; version=0.0.4")
+            if path == "/v1/sql":
+                return self._handle_sql()
+            if path == "/v1/promql":
+                return self._handle_promql_range(v1=True)
+            if path.startswith("/v1/prometheus/api/v1/") or path.startswith("/api/v1/"):
+                sub = path.split("/api/v1/", 1)[1]
+                if sub == "query_range":
+                    return self._handle_promql_range()
+                if sub == "query":
+                    return self._handle_promql_instant()
+                if sub == "labels":
+                    return self._handle_labels()
+                if sub.startswith("label/") and sub.endswith("/values"):
+                    return self._handle_label_values(sub.split("/")[1])
+                if sub == "series":
+                    return self._handle_series()
+                return self._send(404, _prom_err("unknown endpoint"))
+            if path in ("/v1/influxdb/write", "/v1/influxdb/api/v2/write",
+                        "/influxdb/write"):
+                return self._handle_influx_write()
+            if path in ("/v1/opentsdb/api/put", "/opentsdb/api/put"):
+                return self._handle_opentsdb_put()
+            return self._send(404, {"error": f"no route {path}"})
+        except Exception as e:  # noqa: BLE001 — wire boundary
+            traceback.print_exc()
+            self._send(400, {"code": 3000, "error": str(e),
+                             "execution_time_ms": 0})
+
+    # ---- /v1/sql (reference http.rs:724 sql handler) -----------------------
+
+    def _handle_sql(self):
+        params = self._form_or_query()
+        sql = params.get("sql")
+        if not sql:
+            return self._send(400, {"code": 1004, "error": "missing sql"})
+        ctx = QueryContext(db=params.get("db", "public"))
+        t0 = time.perf_counter()
+        with QUERY_DURATION.time(kind="sql"):
+            results = self.query_engine.execute_sql(sql, ctx)
+        elapsed = round((time.perf_counter() - t0) * 1000, 3)
+        out = []
+        for r in results:
+            if not r.is_query:
+                out.append({"affectedrows": r.affected_rows})
+            else:
+                out.append({"records": _records_json(r)})
+        self._send(200, {"code": 0, "output": out,
+                         "execution_time_ms": elapsed})
+
+    # ---- Prometheus API (reference http.rs:724-744) ------------------------
+
+    def _handle_promql_range(self, v1=False):
+        from greptimedb_tpu.promql.engine import PromqlEngine, SeriesMatrix
+
+        params = self._form_or_query()
+        query = params.get("query") or params.get("promql")
+        if not query:
+            return self._send(400, _prom_err("missing query"))
+        try:
+            start = _prom_time(params["start"])
+            end = _prom_time(params["end"])
+            step = _prom_duration(params.get("step", "60"))
+        except (KeyError, ValueError) as e:
+            return self._send(400, _prom_err(f"bad range params: {e}"))
+        ctx = QueryContext(db=params.get("db", "public"))
+        engine = PromqlEngine(self.query_engine)
+        with QUERY_DURATION.time(kind="promql_range"):
+            times, result = engine.eval_matrix(query, start, end, step, ctx)
+        if isinstance(result, SeriesMatrix):
+            payload = _matrix_json(times, result)
+        else:
+            vals = np.broadcast_to(np.asarray(result, dtype=np.float64),
+                                   times.shape)
+            payload = {"resultType": "matrix",
+                       "result": [{"metric": {},
+                                   "values": _values_json(times, vals)}]}
+        self._send(200, {"status": "success", "data": payload})
+
+    def _handle_promql_instant(self):
+        from greptimedb_tpu.promql.engine import PromqlEngine, SeriesMatrix
+
+        params = self._form_or_query()
+        query = params.get("query")
+        if not query:
+            return self._send(400, _prom_err("missing query"))
+        t = _prom_time(params.get("time", str(time.time())))
+        ctx = QueryContext(db=params.get("db", "public"))
+        engine = PromqlEngine(self.query_engine)
+        with QUERY_DURATION.time(kind="promql_instant"):
+            times, result = engine.eval_matrix(query, t, t, 1.0, ctx)
+        if isinstance(result, SeriesMatrix):
+            vals = np.asarray(result.values)
+            out = []
+            for i, lab in enumerate(result.labels):
+                v = vals[i, -1]
+                if math.isnan(v):
+                    continue
+                metric = dict(lab)
+                if result.metric:
+                    metric["__name__"] = result.metric
+                out.append({"metric": metric, "value": [t, _fmt_float(v)]})
+            payload = {"resultType": "vector", "result": out}
+        else:
+            v = float(np.asarray(result).reshape(-1)[-1])
+            payload = {"resultType": "scalar", "value": [t, _fmt_float(v)]}
+        self._send(200, {"status": "success", "data": payload})
+
+    def _handle_labels(self):
+        params = self._form_or_query()
+        ctx = QueryContext(db=params.get("db", "public"))
+        qe = self.query_engine
+        labels = {"__name__"}
+        matches = _match_params(self)
+        tables = [m for m in matches] or qe.catalog.list_tables(ctx.db)
+        for t in tables:
+            try:
+                info = qe.catalog.table(ctx.db, _metric_of(t))
+            except Exception:
+                continue
+            labels.update(c.name for c in info.schema.tag_columns)
+        self._send(200, {"status": "success", "data": sorted(labels)})
+
+    def _handle_label_values(self, label: str):
+        params = self._form_or_query()
+        ctx = QueryContext(db=params.get("db", "public"))
+        qe = self.query_engine
+        if label == "__name__":
+            return self._send(200, {"status": "success",
+                                    "data": sorted(qe.catalog.list_tables(ctx.db))})
+        values: set = set()
+        for t in qe.catalog.list_tables(ctx.db):
+            try:
+                info = qe._table(t, ctx)
+            except Exception:
+                continue
+            if label not in {c.name for c in info.schema.tag_columns}:
+                continue
+            region = qe.region_engine.region(info.region_ids[0])
+            values.update(str(v) for v in region.registry.values.get(label, []))
+        self._send(200, {"status": "success", "data": sorted(values)})
+
+    def _handle_series(self):
+        from greptimedb_tpu.promql.engine import PromqlEngine, SeriesMatrix
+
+        params = self._form_or_query()
+        matches = _match_params(self)
+        if not matches:
+            return self._send(400, _prom_err("match[] required"))
+        start = _prom_time(params.get("start", "0"))
+        end = _prom_time(params.get("end", str(time.time())))
+        ctx = QueryContext(db=params.get("db", "public"))
+        engine = PromqlEngine(self.query_engine)
+        from greptimedb_tpu.promql.parser import parse_promql, VectorSelector
+        out = []
+        for m in matches:
+            node = parse_promql(m)
+            if isinstance(node, VectorSelector):
+                # series existence over the whole [start, end] range: one
+                # eval at `end` with the range as the lookback window
+                from greptimedb_tpu.promql.engine import EvalParams
+                p = EvalParams(end, end, 1.0, np.asarray([end]))
+                result = engine._eval_instant_selector(
+                    node, p, ctx, lookback=max(end - start, 1.0))
+            else:
+                _, result = engine.eval_matrix(m, end, end, 1.0, ctx)
+            if isinstance(result, SeriesMatrix):
+                vals = np.asarray(result.values)
+                for i, lab in enumerate(result.labels):
+                    if vals.size and np.isnan(vals[i]).all():
+                        continue
+                    metric = dict(lab)
+                    if result.metric:
+                        metric["__name__"] = result.metric
+                    out.append(metric)
+        self._send(200, {"status": "success", "data": out})
+
+    # ---- write protocols ---------------------------------------------------
+
+    def _handle_influx_write(self):
+        from greptimedb_tpu.servers.influx import parse_line_protocol, write_points
+
+        params = self._form_or_query()
+        body = getattr(self, "_raw_body", b"") or self._body()
+        db = params.get("db") or params.get("bucket") or "public"
+        precision = params.get("precision", "ns")
+        points = parse_line_protocol(body.decode())
+        n = write_points(self.query_engine, db, points, precision)
+        self.send_response(204)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+        HTTP_REQUESTS.inc(path="/v1/influxdb/write", status="204")
+        _ = n
+
+    def _handle_opentsdb_put(self):
+        """OpenTSDB JSON put (reference servers/src/opentsdb.rs +
+        http.rs:793-797)."""
+        from greptimedb_tpu.servers.influx import Point, write_points
+
+        body = self._body()
+        data = json.loads(body.decode())
+        if isinstance(data, dict):
+            data = [data]
+        points = []
+        for d in data:
+            ts = int(d["timestamp"])
+            # OpenTSDB: seconds or milliseconds by magnitude
+            ts_ms = ts * 1000 if ts < 10_000_000_000 else ts
+            points.append(Point(
+                measurement=d["metric"],
+                tags=sorted(d.get("tags", {}).items()),
+                fields=[("greptime_value", float(d["value"]))],
+                ts=ts_ms,
+            ))
+        n = write_points(self.query_engine, "public", points, precision="ms")
+        self._send(200, {"success": n, "failed": 0})
+
+
+# ---- formatting ------------------------------------------------------------
+
+
+def _records_json(r: QueryResult) -> dict:
+    schema = {"column_schemas": [
+        {"name": n, "data_type": (dt.value if dt else "string")}
+        for n, dt in zip(r.names, r.dtypes)
+    ]}
+    return {"schema": schema, "rows": _json_rows(r), "total_rows": r.num_rows}
+
+
+def _json_rows(r: QueryResult) -> list:
+    rows = r.rows()
+    # make timestamps ISO strings is greptime-like; keep raw ints (greptime
+    # returns epoch values over HTTP by default)
+    return [[_json_safe(v) for v in row] for row in rows]
+
+
+def _json_safe(v):
+    if isinstance(v, float) and (math.isnan(v) or math.isinf(v)):
+        return None
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    return v
+
+
+def _matrix_json(times: np.ndarray, sm) -> dict:
+    vals = np.asarray(sm.values)
+    out = []
+    for i, lab in enumerate(sm.labels):
+        metric = dict(lab)
+        if sm.metric:
+            metric["__name__"] = sm.metric
+        series_vals = _values_json(times, vals[i])
+        if series_vals:
+            out.append({"metric": metric, "values": series_vals})
+    return {"resultType": "matrix", "result": out}
+
+
+def _values_json(times: np.ndarray, vals: np.ndarray) -> list:
+    out = []
+    for t, v in zip(times.tolist(), np.asarray(vals).tolist()):
+        if v is None or (isinstance(v, float) and math.isnan(v)):
+            continue
+        out.append([t, _fmt_float(v)])
+    return out
+
+
+def _fmt_float(v: float) -> str:
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    if math.isnan(v):
+        return "NaN"
+    return repr(float(v))
+
+
+def _prom_err(msg: str) -> dict:
+    return {"status": "error", "errorType": "bad_data", "error": msg}
+
+
+def _prom_time(s: str) -> float:
+    try:
+        return float(s)
+    except ValueError:
+        pass
+    import datetime as dt
+    t = s.replace("Z", "+00:00")
+    return dt.datetime.fromisoformat(t).timestamp()
+
+
+def _prom_duration(s: str) -> float:
+    try:
+        return float(s)
+    except ValueError:
+        from greptimedb_tpu.promql.parser import parse_duration_s
+        return parse_duration_s(s)
+
+
+def _match_params(handler: _Handler) -> list[str]:
+    parsed = urllib.parse.urlparse(handler.path)
+    qs = urllib.parse.parse_qs(parsed.query)
+    matches = qs.get("match[]", [])
+    body = getattr(handler, "_raw_body", b"")
+    if body:
+        try:
+            form = urllib.parse.parse_qs(body.decode())
+            matches += form.get("match[]", [])
+        except UnicodeDecodeError:
+            pass
+    return matches
+
+
+def _metric_of(match_expr: str) -> str:
+    """Metric name from a simple match[] selector."""
+    return match_expr.split("{")[0].strip() or match_expr

@@ -1,0 +1,235 @@
+"""InfluxDB line protocol ingestion (mirrors reference servers::influxdb +
+operator Inserter auto-create, src/operator/src/insert.rs:112).
+
+`measurement,tag=a,tag2=b field=1.0,field2=2i 1465839830100400200`
+
+Tables are auto-created on first write (tags -> TAG STRING columns, fields
+typed from the first-seen value, `ts` time index); later writes with new
+fields auto-ALTER.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from greptimedb_tpu.catalog.catalog import CatalogError
+from greptimedb_tpu.datatypes import (
+    ColumnSchema, DataType, DictVector, RecordBatch, Schema, SemanticType,
+)
+from greptimedb_tpu.utils.metrics import INGEST_ROWS
+
+
+class LineProtocolError(Exception):
+    pass
+
+
+@dataclass
+class Point:
+    measurement: str
+    tags: list[tuple[str, str]]
+    fields: list[tuple[str, object]]
+    ts: Optional[int]  # raw integer timestamp (precision applied later)
+
+
+def parse_line_protocol(text: str) -> list[Point]:
+    points = []
+    for raw in text.split("\n"):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        points.append(_parse_line(line))
+    return points
+
+
+def _split_unescaped(s: str, sep: str, escapable: str) -> list[str]:
+    parts, cur, i = [], [], 0
+    in_quote = False
+    while i < len(s):
+        ch = s[i]
+        if ch == "\\" and i + 1 < len(s):
+            cur.append(ch)
+            cur.append(s[i + 1])
+            i += 2
+            continue
+        if ch == '"':
+            in_quote = not in_quote
+            cur.append(ch)
+        elif ch == sep and not in_quote:
+            parts.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+        i += 1
+    parts.append("".join(cur))
+    return parts
+
+
+def _unescape(s: str) -> str:
+    out, i = [], 0
+    while i < len(s):
+        if s[i] == "\\" and i + 1 < len(s):
+            out.append(s[i + 1])
+            i += 2
+        else:
+            out.append(s[i])
+            i += 1
+    return "".join(out)
+
+
+def _parse_line(line: str) -> Point:
+    # split into measurement+tags | fields | timestamp on unescaped spaces
+    sections = _split_unescaped(line, " ", ", ")
+    sections = [s for s in sections if s != ""]
+    if len(sections) < 2:
+        raise LineProtocolError(f"malformed line: {line!r}")
+    head = sections[0]
+    fields_part = sections[1]
+    ts = None
+    if len(sections) >= 3:
+        try:
+            ts = int(sections[2])
+        except ValueError:
+            raise LineProtocolError(f"bad timestamp in {line!r}")
+    head_parts = _split_unescaped(head, ",", " ,")
+    measurement = _unescape(head_parts[0])
+    tags = []
+    for t in head_parts[1:]:
+        if "=" not in t:
+            raise LineProtocolError(f"bad tag {t!r}")
+        k, v = t.split("=", 1)
+        tags.append((_unescape(k), _unescape(v)))
+    fields = []
+    for f in _split_unescaped(fields_part, ",", " ,"):
+        if "=" not in f:
+            raise LineProtocolError(f"bad field {f!r}")
+        k, v = f.split("=", 1)
+        fields.append((_unescape(k), _parse_field_value(v)))
+    if not fields:
+        raise LineProtocolError(f"no fields in {line!r}")
+    return Point(measurement, tags, fields, ts)
+
+
+def _parse_field_value(v: str):
+    if v.startswith('"') and v.endswith('"') and len(v) >= 2:
+        return v[1:-1].replace('\\"', '"').replace("\\\\", "\\")
+    if v in ("t", "T", "true", "True", "TRUE"):
+        return True
+    if v in ("f", "F", "false", "False", "FALSE"):
+        return False
+    if v.endswith("i") or v.endswith("u"):
+        return int(v[:-1])
+    return float(v)
+
+
+_PRECISION_TO_MS = {"ns": 1e-6, "u": 1e-3, "us": 1e-3, "ms": 1.0, "s": 1e3,
+                    "m": 60e3, "h": 3600e3}
+
+
+def write_points(query_engine, db: str, points: list[Point],
+                 precision: str = "ns") -> int:
+    """Group points per measurement, auto-create/alter tables, write."""
+    import time as _time
+
+    from greptimedb_tpu.query.engine import QueryContext
+
+    scale = _PRECISION_TO_MS.get(precision)
+    if scale is None:
+        raise LineProtocolError(f"bad precision {precision!r}")
+    ctx = QueryContext(db=db)
+    by_table: dict[str, list[Point]] = {}
+    for p in points:
+        by_table.setdefault(p.measurement, []).append(p)
+    total = 0
+    now_ms = int(_time.time() * 1000)
+    for table_name, pts in by_table.items():
+        info = _ensure_table(query_engine, ctx, table_name, pts)
+        schema = info.schema
+        n = len(pts)
+        tag_names = [c.name for c in schema.tag_columns]
+        field_names = [c.name for c in schema.field_columns]
+        cols: dict = {}
+        for t in tag_names:
+            cols[t] = DictVector.encode(
+                [dict(p.tags).get(t) for p in pts]
+            )
+        ts_vals = np.asarray(
+            [now_ms if p.ts is None else int(p.ts * scale) for p in pts],
+            dtype=np.int64,
+        )
+        cols[schema.time_index.name] = ts_vals
+        for fn in field_names:
+            c = schema.column(fn)
+            vals = [dict(p.fields).get(fn) for p in pts]
+            if c.dtype.is_float:
+                cols[fn] = np.asarray(
+                    [np.nan if v is None else float(v) for v in vals])
+            elif c.dtype is DataType.BOOL:
+                cols[fn] = np.asarray([bool(v) for v in vals])
+            elif c.dtype.is_string:
+                cols[fn] = DictVector.encode(
+                    [None if v is None else str(v) for v in vals])
+            else:
+                cols[fn] = np.asarray(
+                    [0 if v is None else int(v) for v in vals], dtype=np.int64)
+        batch = RecordBatch(schema, cols)
+        total += query_engine.region_engine.put(info.region_ids[0], batch)
+    INGEST_ROWS.inc(total, protocol="influxdb")
+    return total
+
+
+def _ensure_table(query_engine, ctx, name: str, pts: list[Point]):
+    qe = query_engine
+    tags_seen = list(dict.fromkeys(k for p in pts for k, _ in p.tags))
+    fields_seen: dict[str, object] = {}
+    for p in pts:
+        for k, v in p.fields:
+            fields_seen.setdefault(k, v)
+    try:
+        info = qe._table(name, ctx)
+    except CatalogError:
+        cols = [ColumnSchema(t, DataType.STRING, SemanticType.TAG) for t in tags_seen]
+        cols.append(ColumnSchema("ts", DataType.TIMESTAMP_MILLISECOND,
+                                 SemanticType.TIMESTAMP, nullable=False))
+        for fn, v in fields_seen.items():
+            cols.append(ColumnSchema(fn, _field_type(v), SemanticType.FIELD))
+        schema = Schema(cols)
+        info = qe.catalog.create_table(ctx.db, name, schema, options={},
+                                       if_not_exists=True)
+        for rid in info.region_ids:
+            qe.region_engine.create_region(rid, schema)
+            qe._open_regions.add(rid)
+        return info
+    # auto-ALTER for new field columns (reference insert.rs:112
+    # create_or_alter_tables_on_demand)
+    missing = [fn for fn in fields_seen if fn not in info.schema]
+    missing_tags = [t for t in tags_seen if t not in info.schema]
+    if missing_tags:
+        raise LineProtocolError(
+            f"new tag column(s) {missing_tags} on existing table {name!r} "
+            "are not supported")
+    if missing:
+        from greptimedb_tpu.sql import ast
+        for fn in missing:
+            dt = _field_type(fields_seen[fn])
+            type_name = {"float64": "DOUBLE", "int64": "BIGINT",
+                         "bool": "BOOLEAN", "string": "STRING"}[dt.value]
+            qe.execute_statement(
+                ast.AlterTable(name, "add_column",
+                               column=ast.ColumnDef(fn, type_name)), ctx)
+        info = qe._table(name, ctx)
+    return info
+
+
+def _field_type(v) -> DataType:
+    if isinstance(v, bool):
+        return DataType.BOOL
+    if isinstance(v, int):
+        # stored as FLOAT64: integer columns have no NULL representation in
+        # the columnar store yet, and sparse influx fields need NULLs
+        return DataType.FLOAT64
+    if isinstance(v, str):
+        return DataType.STRING
+    return DataType.FLOAT64

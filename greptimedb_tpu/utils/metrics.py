@@ -1,0 +1,148 @@
+"""Internal metrics registry (mirrors the reference's lazy_static
+prometheus registries in every crate's metrics.rs, exposed at /metrics and
+self-scraped — SURVEY.md §5)."""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from typing import Optional
+
+
+class Counter:
+    def __init__(self, name: str, help_: str):
+        self.name = name
+        self.help = help_
+        self._values: dict[tuple, float] = defaultdict(float)
+        self._lock = threading.Lock()
+
+    def inc(self, value: float = 1.0, **labels):
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values[key] += value
+
+    def get(self, **labels) -> float:
+        return self._values.get(tuple(sorted(labels.items())), 0.0)
+
+    def render(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        for key, v in sorted(self._values.items()):
+            out.append(f"{self.name}{_labels(key)} {v}")
+        return out
+
+
+class Gauge(Counter):
+    def set(self, value: float, **labels):
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values[key] = value
+
+    def render(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
+        for key, v in sorted(self._values.items()):
+            out.append(f"{self.name}{_labels(key)} {v}")
+        return out
+
+
+class Histogram:
+    BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 60.0)
+
+    def __init__(self, name: str, help_: str):
+        self.name = name
+        self.help = help_
+        self._buckets: dict[tuple, list[int]] = {}
+        self._sum: dict[tuple, float] = defaultdict(float)
+        self._count: dict[tuple, int] = defaultdict(int)
+        self._lock = threading.Lock()
+
+    def observe(self, value: float, **labels):
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            b = self._buckets.setdefault(key, [0] * (len(self.BUCKETS) + 1))
+            for i, ub in enumerate(self.BUCKETS):
+                if value <= ub:
+                    b[i] += 1
+                    break
+            else:
+                b[-1] += 1
+            self._sum[key] += value
+            self._count[key] += 1
+
+    def time(self, **labels):
+        return _Timer(self, labels)
+
+    def render(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        for key, b in sorted(self._buckets.items()):
+            cum = 0
+            for i, ub in enumerate(self.BUCKETS):
+                cum += b[i]
+                out.append(f"{self.name}_bucket{_labels(key, le=str(ub))} {cum}")
+            cum += b[-1]
+            out.append(f"{self.name}_bucket{_labels(key, le='+Inf')} {cum}")
+            out.append(f"{self.name}_sum{_labels(key)} {self._sum[key]}")
+            out.append(f"{self.name}_count{_labels(key)} {self._count[key]}")
+        return out
+
+
+class _Timer:
+    def __init__(self, hist, labels):
+        self.hist = hist
+        self.labels = labels
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.hist.observe(time.perf_counter() - self.t0, **self.labels)
+
+
+def _labels(key: tuple, **extra) -> str:
+    items = list(key) + sorted(extra.items())
+    if not items:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in items)
+    return "{" + inner + "}"
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: list = []
+        self._lock = threading.Lock()
+
+    def counter(self, name, help_="") -> Counter:
+        m = Counter(name, help_)
+        with self._lock:
+            self._metrics.append(m)
+        return m
+
+    def gauge(self, name, help_="") -> Gauge:
+        m = Gauge(name, help_)
+        with self._lock:
+            self._metrics.append(m)
+        return m
+
+    def histogram(self, name, help_="") -> Histogram:
+        m = Histogram(name, help_)
+        with self._lock:
+            self._metrics.append(m)
+        return m
+
+    def render(self) -> str:
+        lines = []
+        for m in self._metrics:
+            lines.extend(m.render())
+        return "\n".join(lines) + "\n"
+
+
+REGISTRY = Registry()
+
+# framework-wide metrics (analogs of servers/src/metrics.rs etc.)
+HTTP_REQUESTS = REGISTRY.counter("greptimedb_tpu_http_requests_total",
+                                 "HTTP requests by path and status")
+QUERY_DURATION = REGISTRY.histogram("greptimedb_tpu_query_duration_seconds",
+                                    "Query execution latency")
+INGEST_ROWS = REGISTRY.counter("greptimedb_tpu_ingest_rows_total",
+                               "Rows ingested by protocol")

@@ -1,0 +1,190 @@
+"""HTTP protocol tests over a real socket (mirrors the reference's
+tests-integration protocol suites, SURVEY.md §4)."""
+
+import json
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+from greptimedb_tpu.catalog import Catalog, MemoryKv
+from greptimedb_tpu.query import QueryEngine
+from greptimedb_tpu.servers import HttpServer
+from greptimedb_tpu.storage import RegionEngine
+from greptimedb_tpu.storage.engine import EngineConfig
+
+
+@pytest.fixture
+def server(tmp_path):
+    engine = RegionEngine(EngineConfig(data_dir=str(tmp_path / "data")))
+    qe = QueryEngine(Catalog(MemoryKv()), engine)
+    srv = HttpServer(qe, port=0)  # ephemeral port
+    port = srv.start()
+    yield f"http://127.0.0.1:{port}"
+    srv.stop()
+    engine.close()
+
+
+def get(url, **params):
+    q = urllib.parse.urlencode(params)
+    try:
+        with urllib.request.urlopen(f"{url}?{q}") as resp:
+            return resp.status, json.loads(resp.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+def post(url, body: bytes, content_type="application/octet-stream", **params):
+    q = urllib.parse.urlencode(params)
+    req = urllib.request.Request(f"{url}?{q}", data=body, method="POST",
+                                 headers={"Content-Type": content_type})
+    with urllib.request.urlopen(req) as resp:
+        data = resp.read()
+        return resp.status, json.loads(data) if data else {}
+
+
+class TestSqlApi:
+    def test_ddl_insert_query(self, server):
+        status, out = get(f"{server}/v1/sql", sql=(
+            "CREATE TABLE cpu (host STRING, ts TIMESTAMP(3) NOT NULL, "
+            "val DOUBLE, TIME INDEX (ts), PRIMARY KEY (host))"
+        ))
+        assert status == 200 and out["code"] == 0
+        status, out = get(f"{server}/v1/sql", sql=(
+            "INSERT INTO cpu (host, ts, val) VALUES ('a', 1000, 1.5), ('b', 2000, 2.5)"
+        ))
+        assert out["output"][0]["affectedrows"] == 2
+        status, out = get(f"{server}/v1/sql",
+                          sql="SELECT host, val FROM cpu ORDER BY host")
+        records = out["output"][0]["records"]
+        assert [c["name"] for c in records["schema"]["column_schemas"]] == ["host", "val"]
+        assert records["rows"] == [["a", 1.5], ["b", 2.5]]
+        assert records["total_rows"] == 2
+
+    def test_sql_error_shape(self, server):
+        status, out = get(f"{server}/v1/sql", sql="SELECT FROM nope")
+        assert status == 400
+        assert "error" in out
+
+    def test_multi_statement(self, server):
+        status, out = get(f"{server}/v1/sql", sql=(
+            "CREATE TABLE t (ts TIMESTAMP(3) NOT NULL, v DOUBLE, TIME INDEX (ts)); "
+            "INSERT INTO t (ts, v) VALUES (1, 2.0); SELECT count(*) FROM t"
+        ))
+        assert out["code"] == 0
+        assert len(out["output"]) == 3
+        assert out["output"][2]["records"]["rows"] == [[1]]
+
+
+class TestInfluxWrite:
+    def test_write_and_query(self, server):
+        lines = (b"weather,location=us-midwest temperature=82 1465839830100400200\n"
+                 b"weather,location=us-east temperature=75,humidity=30i 1465839830100400200")
+        status, _ = post(f"{server}/v1/influxdb/write", lines, "text/plain")
+        assert status == 204
+        _, out = get(f"{server}/v1/sql", sql=(
+            "SELECT location, temperature, humidity FROM weather ORDER BY location"
+        ))
+        rows = out["output"][0]["records"]["rows"]
+        assert rows == [["us-east", 75.0, 30.0], ["us-midwest", 82.0, None]]
+
+    def test_auto_alter_new_field(self, server):
+        post(f"{server}/v1/influxdb/write", b"m1,h=a f1=1.0 1000000000", "text/plain")
+        post(f"{server}/v1/influxdb/write", b"m1,h=a f1=2.0,f2=9.0 2000000000", "text/plain")
+        _, out = get(f"{server}/v1/sql", sql="SELECT f1, f2 FROM m1 ORDER BY ts")
+        rows = out["output"][0]["records"]["rows"]
+        assert rows == [[1.0, None], [2.0, 9.0]]
+
+    def test_precision_param(self, server):
+        post(f"{server}/v1/influxdb/write", b"m2 v=1.0 1465839830100", "text/plain",
+             precision="ms")
+        _, out = get(f"{server}/v1/sql", sql="SELECT ts FROM m2")
+        assert out["output"][0]["records"]["rows"] == [[1465839830100]]
+
+
+class TestOpentsdb:
+    def test_put(self, server):
+        body = json.dumps([
+            {"metric": "sys.cpu", "timestamp": 1465839830, "value": 18.3,
+             "tags": {"host": "web01"}},
+            {"metric": "sys.cpu", "timestamp": 1465839890, "value": 18.9,
+             "tags": {"host": "web01"}},
+        ]).encode()
+        status, out = post(f"{server}/v1/opentsdb/api/put", body, "application/json")
+        assert status == 200 and out["success"] == 2
+        _, out = get(f"{server}/v1/sql",
+                     sql='SELECT greptime_value FROM "sys.cpu" ORDER BY ts')
+        assert out["output"][0]["records"]["rows"] == [[18.3], [18.9]]
+
+
+class TestPrometheusApi:
+    @pytest.fixture
+    def seeded(self, server):
+        get(f"{server}/v1/sql", sql=(
+            "CREATE TABLE http_requests (host STRING, ts TIMESTAMP(3) NOT NULL, "
+            "val DOUBLE, TIME INDEX (ts), PRIMARY KEY (host)) "
+            "WITH (append_mode = 'true')"
+        ))
+        rows = []
+        for hi, h in enumerate(("a", "b")):
+            for i in range(41):
+                rows.append(f"('{h}', {(1000000 + i * 15) * 1000}, {(hi + 1) * 2.0 * i * 15})")
+        get(f"{server}/v1/sql", sql=(
+            "INSERT INTO http_requests (host, ts, val) VALUES " + ", ".join(rows)
+        ))
+        return server
+
+    def test_query_range(self, seeded):
+        status, out = get(f"{seeded}/v1/prometheus/api/v1/query_range",
+                          query="rate(http_requests[2m])",
+                          start=1000300, end=1000420, step=60)
+        assert out["status"] == "success"
+        data = out["data"]
+        assert data["resultType"] == "matrix"
+        by_host = {r["metric"]["host"]: r["values"] for r in data["result"]}
+        assert len(by_host["a"]) == 3
+        np.testing.assert_allclose(float(by_host["a"][0][1]), 2.0, rtol=1e-9)
+        np.testing.assert_allclose(float(by_host["b"][0][1]), 4.0, rtol=1e-9)
+
+    def test_instant_query(self, seeded):
+        status, out = get(f"{seeded}/v1/prometheus/api/v1/query",
+                          query="http_requests", time=1000300)
+        data = out["data"]
+        assert data["resultType"] == "vector"
+        vals = {r["metric"]["host"]: float(r["value"][1]) for r in data["result"]}
+        assert vals == {"a": 600.0, "b": 1200.0}
+        assert data["result"][0]["metric"]["__name__"] == "http_requests"
+
+    def test_labels_and_values(self, seeded):
+        _, out = get(f"{seeded}/v1/prometheus/api/v1/labels")
+        assert "host" in out["data"] and "__name__" in out["data"]
+        _, out = get(f"{seeded}/v1/prometheus/api/v1/label/host/values")
+        assert out["data"] == ["a", "b"]
+        _, out = get(f"{seeded}/v1/prometheus/api/v1/label/__name__/values")
+        assert "http_requests" in out["data"]
+
+    def test_series(self, seeded):
+        url = f"{seeded}/v1/prometheus/api/v1/series"
+        q = urllib.parse.urlencode({"match[]": "http_requests", "start": 1000000,
+                                    "end": 1001000})
+        with urllib.request.urlopen(f"{url}?{q}") as resp:
+            out = json.loads(resp.read())
+        hosts = sorted(m["host"] for m in out["data"])
+        assert hosts == ["a", "b"]
+
+    def test_bad_query_is_400(self, seeded):
+        status, out = get(f"{seeded}/v1/prometheus/api/v1/query_range",
+                          query="rate(", start=0, end=10, step=1)
+        assert status == 400
+
+
+class TestOps:
+    def test_health_and_metrics(self, server):
+        status, _ = get(f"{server}/health")
+        assert status == 200
+        get(f"{server}/v1/sql", sql="SELECT 1")
+        with urllib.request.urlopen(f"{server}/metrics") as resp:
+            text = resp.read().decode()
+        assert "greptimedb_tpu_http_requests_total" in text
+        assert "greptimedb_tpu_query_duration_seconds" in text
