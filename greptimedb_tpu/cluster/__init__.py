@@ -1,0 +1,3 @@
+from .cluster import Cluster, Datanode, RegionRouter
+
+__all__ = ["Cluster", "Datanode", "RegionRouter"]

@@ -1,0 +1,232 @@
+"""In-process multi-datanode cluster: metasrv + N datanodes + frontend.
+
+Mirrors reference tests-integration/src/cluster.rs:66-135 (a real cluster in
+one process over in-memory wiring) and the distributed deployment shape
+(SURVEY.md §3.1): frontends route region requests via table-route metadata;
+datanodes heartbeat RegionStats to the metasrv and obey its Instructions;
+region data + WAL live on a shared store (the object-storage/remote-WAL
+deployment, which is what makes failover possible).
+
+The frontend side is `RegionRouter`: it satisfies the RegionEngine surface
+the QueryEngine expects (scan/put/delete/create/open/region) but routes each
+region to its owning datanode per the route table, with an invalidation-
+driven cache (reference src/cache + frontend route re-fetch).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional
+
+from ..catalog.catalog import Catalog, TableInfo
+from ..catalog.kv import KvBackend, MemoryKv
+from ..datatypes.schema import Schema
+from ..meta.heartbeat import HeartbeatTask
+from ..meta.instruction import Instruction, InstructionKind
+from ..meta.metasrv import Metasrv, MetasrvOptions, RegionStat
+from ..meta.route import RegionRoute, TableRoute
+from ..partition.rule import RangePartitionRule
+from ..query.engine import QueryContext, QueryEngine
+from ..storage.engine import EngineConfig, RegionEngine, RegionRequest, RequestType
+
+
+class Datanode:
+    """One region server + its heartbeat task (datanode/src/datanode.rs:192
+    + heartbeat.rs analog)."""
+
+    def __init__(self, node_id: str, shared_dir: str, metasrv: Metasrv):
+        self.node_id = node_id
+        self.engine = RegionEngine(EngineConfig(data_dir=shared_dir))
+        self.metasrv = metasrv
+        self.heartbeat = HeartbeatTask(
+            node_id, metasrv, self._region_stats, self._apply_instruction
+        )
+        self.alive = True
+
+    def _region_stats(self) -> list[RegionStat]:
+        stats = []
+        for rid, region in self.engine.regions.items():
+            stats.append(
+                RegionStat(
+                    region_id=rid,
+                    table=str(rid >> 32),
+                    rows=region.memtable.num_rows if hasattr(region, "memtable") else 0,
+                    memtable_bytes=region.memtable_bytes,
+                )
+            )
+        return stats
+
+    def _apply_instruction(self, inst: Instruction) -> None:
+        if inst.kind is InstructionKind.OPEN_REGION:
+            self.engine.open_region(inst.region_id)
+        elif inst.kind is InstructionKind.CLOSE_REGION:
+            self.engine.handle_request(
+                RegionRequest(RequestType.CLOSE, inst.region_id)
+            )
+        elif inst.kind is InstructionKind.DOWNGRADE_REGION:
+            pass  # writes are fenced by the router's route state
+        elif inst.kind is InstructionKind.UPGRADE_REGION:
+            self.engine.open_region(inst.region_id)
+
+    def beat(self, now_ms: Optional[float] = None) -> None:
+        if self.alive:
+            self.heartbeat.beat(now_ms)
+
+    def enforce_leases(self, now_ms: Optional[float] = None) -> list[int]:
+        """RegionAliveKeeper: self-close regions whose lease expired
+        (alive_keeper.rs:49-112)."""
+        expired = self.heartbeat.alive_keeper.expired(now_ms)
+        for rid in expired:
+            self.engine.handle_request(RegionRequest(RequestType.CLOSE, rid))
+            self.heartbeat.alive_keeper.forget(rid)
+        return expired
+
+    def kill(self) -> None:
+        """Simulate process death: stop heartbeating, drop open regions."""
+        self.alive = False
+        for rid in list(self.engine.regions):
+            self.engine.regions.pop(rid, None)
+
+    def close(self) -> None:
+        self.engine.close()
+
+
+class RegionRouter:
+    """Frontend-side region request routing over table routes."""
+
+    def __init__(self, metasrv: Metasrv, datanodes: dict[str, Datanode]):
+        self.metasrv = metasrv
+        self.datanodes = datanodes
+        self._region_node: dict[int, str] = {}
+        self._lock = threading.Lock()
+        metasrv.subscribe_invalidation(self._on_invalidate)
+
+    def _on_invalidate(self, table: str) -> None:
+        with self._lock:
+            self._region_node.clear()
+
+    def _refresh(self) -> None:
+        with self._lock:
+            self._region_node.clear()
+            for route in self.metasrv.routes.all():
+                for rr in route.regions:
+                    if rr.leader_node is not None:
+                        self._region_node[rr.region_id] = rr.leader_node
+
+    def _engine_for(self, region_id: int) -> RegionEngine:
+        node = self._region_node.get(region_id)
+        if node is None:
+            self._refresh()
+            node = self._region_node.get(region_id)
+        if node is None:
+            raise KeyError(f"no route for region {region_id}")
+        dn = self.datanodes[node]
+        if not dn.alive:
+            # stale route to a dead node; force a re-fetch
+            self._refresh()
+            node = self._region_node.get(region_id)
+            dn = self.datanodes[node] if node else None
+            if dn is None or not dn.alive:
+                raise KeyError(f"region {region_id} has no live datanode")
+        return dn.engine
+
+    # --- RegionEngine surface used by QueryEngine ---
+    def region(self, region_id: int):
+        return self._engine_for(region_id).region(region_id)
+
+    def open_region(self, region_id: int) -> None:
+        self._engine_for(region_id).open_region(region_id)
+
+    def create_region(self, region_id: int, schema: Schema) -> None:
+        """Placement: pick a datanode via the metasrv selector, create the
+        region there, and record the route (the CreateTable DDL procedure's
+        region-allocation step, common/meta/src/ddl/create_table.rs analog)."""
+        node = self.metasrv.selector.select(
+            self.metasrv.alive_nodes() or sorted(self.datanodes),
+            self.metasrv.node_stats(),
+        )
+        if node is None:
+            node = sorted(self.datanodes)[0]
+        self.datanodes[node].engine.create_region(region_id, schema)
+        table_key = str(region_id >> 32)
+        route = self.metasrv.routes.get(table_key)
+        if route is None:
+            route = TableRoute(table=table_key, regions=[])
+            self.metasrv.routes.put_new(route)
+            route = self.metasrv.routes.get(table_key)
+        route.regions = [r for r in route.regions if r.region_id != region_id]
+        route.regions.append(RegionRoute(region_id=region_id, leader_node=node))
+        self.metasrv.routes.update(route)
+        with self._lock:
+            self._region_node[region_id] = node
+
+    def put(self, region_id: int, batch) -> int:
+        return self._engine_for(region_id).put(region_id, batch)
+
+    def delete(self, region_id: int, batch) -> int:
+        return self._engine_for(region_id).delete(region_id, batch)
+
+    def flush(self, region_id: int) -> None:
+        self._engine_for(region_id).flush(region_id)
+
+    def compact(self, region_id: int) -> None:
+        self._engine_for(region_id).compact(region_id)
+
+    def scan(self, region_id: int, ts_range=None, projection=None):
+        return self._engine_for(region_id).scan(region_id, ts_range, projection)
+
+    def handle_request(self, req: RegionRequest) -> int:
+        return self._engine_for(req.region_id).handle_request(req)
+
+
+class Cluster:
+    """N datanodes + metasrv + a distributed frontend QueryEngine."""
+
+    def __init__(
+        self,
+        data_dir: str,
+        num_datanodes: int = 3,
+        kv: Optional[KvBackend] = None,
+        opts: Optional[MetasrvOptions] = None,
+    ):
+        self.kv = kv or MemoryKv()
+        self.metasrv = Metasrv(self.kv, opts)
+        self.datanodes: dict[str, Datanode] = {}
+        shared = os.path.join(data_dir, "shared")
+        for i in range(num_datanodes):
+            node_id = f"dn-{i}"
+            self.datanodes[node_id] = Datanode(node_id, shared, self.metasrv)
+        self.router = RegionRouter(self.metasrv, self.datanodes)
+        self.catalog = Catalog(self.kv)
+        self.frontend = QueryEngine(self.catalog, self.router)
+
+    def beat_all(self, now_ms: Optional[float] = None) -> None:
+        for dn in self.datanodes.values():
+            dn.beat(now_ms)
+
+    def tick(self, now_ms: Optional[float] = None) -> list[str]:
+        return self.metasrv.tick(now_ms)
+
+    def sql(self, sql: str, db: str = "public"):
+        return self.frontend.execute_one(sql, QueryContext(db=db))
+
+    def create_partitioned_table(
+        self,
+        sql_create: str,
+        rule: RangePartitionRule,
+        db: str = "public",
+    ) -> TableInfo:
+        """CREATE TABLE with N partitioned regions placed across datanodes
+        (PARTITION ON COLUMNS clause analog)."""
+        from ..sql import parse_sql
+
+        stmt = parse_sql(sql_create)[0]
+        ctx = QueryContext(db=db)
+        self.frontend._create_table_partitioned(stmt, ctx, rule)
+        return self.catalog.table(db, stmt.name)
+
+    def close(self) -> None:
+        for dn in self.datanodes.values():
+            dn.close()

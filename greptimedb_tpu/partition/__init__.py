@@ -1,0 +1,3 @@
+from .rule import PartitionBound, PartitionRule, RangePartitionRule
+
+__all__ = ["PartitionBound", "PartitionRule", "RangePartitionRule"]

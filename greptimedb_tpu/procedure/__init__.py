@@ -1,0 +1,17 @@
+from .procedure import (
+    FnStepProcedure,
+    Procedure,
+    ProcedureManager,
+    ProcedureRecord,
+    ProcedureStore,
+    Status,
+)
+
+__all__ = [
+    "FnStepProcedure",
+    "Procedure",
+    "ProcedureManager",
+    "ProcedureRecord",
+    "ProcedureStore",
+    "Status",
+]

@@ -9,6 +9,7 @@ layer.
 
 from __future__ import annotations
 
+import json
 from dataclasses import dataclass, field
 from typing import Optional, Union
 
@@ -144,7 +145,20 @@ class QueryEngine:
 
     # ---- DDL ---------------------------------------------------------------
 
-    def _create_table(self, stmt: ast.CreateTable, ctx: QueryContext) -> QueryResult:
+    def _create_table_partitioned(
+        self, stmt: ast.CreateTable, ctx: QueryContext, rule
+    ) -> QueryResult:
+        """CREATE TABLE split into one region per partition (reference
+        PARTITION ON COLUMNS clause, partition/src/multi_dim.rs)."""
+        return self._create_table(stmt, ctx, rule=rule)
+
+    def _create_table(
+        self, stmt: ast.CreateTable, ctx: QueryContext, rule=None
+    ) -> QueryResult:
+        if rule is None and stmt.partitions:
+            from greptimedb_tpu.partition.rule import rule_from_partition_ast
+
+            rule = rule_from_partition_ast(stmt.partitions[0], stmt.partitions[1])
         db = ctx.db
         name = stmt.name
         if "." in name:
@@ -175,6 +189,8 @@ class QueryEngine:
         info = self.catalog.create_table(
             db, name, schema, options=dict(stmt.options),
             if_not_exists=stmt.if_not_exists,
+            num_regions=rule.num_regions() if rule is not None else 1,
+            partition_rules=json.loads(rule.to_json()) if rule is not None else None,
         )
         for rid in info.region_ids:
             self.region_engine.create_region(rid, schema)
@@ -302,8 +318,25 @@ class QueryEngine:
                     dtype=c.dtype.to_numpy(),
                 )
         batch = RecordBatch(schema, batch_cols)
-        n = self.region_engine.put(info.region_ids[0], batch)
+        n = self._sharded_write(info, batch, delete=False)
         return QueryResult.of_affected(n)
+
+    def _sharded_write(self, info: TableInfo, batch: RecordBatch, delete: bool) -> int:
+        """Row→region sharding via the table's partition rule (reference
+        operator/src/insert.rs:114-118 + partition/src/splitter.rs)."""
+        write = self.region_engine.delete if delete else self.region_engine.put
+        if len(info.region_ids) == 1 or not info.partition_rules:
+            return write(info.region_ids[0], batch)
+        rule = _cached_rule(info)
+        cols = []
+        for cname in rule.columns:
+            col = batch.columns[cname]
+            cols.append(col.decode() if hasattr(col, "decode") else np.asarray(col))
+        n = 0
+        for region_idx, rows in rule.split(cols, n_rows=batch.num_rows).items():
+            rid = info.region_ids[region_idx]
+            n += write(rid, batch.take(rows))
+        return n
 
     def _delete(self, stmt: ast.Delete, ctx: QueryContext) -> QueryResult:
         info = self._table(stmt.table, ctx)
@@ -332,7 +365,7 @@ class QueryEngine:
             else:
                 cols[c.name] = np.zeros(n, dtype=c.dtype.to_numpy())
         batch = RecordBatch(schema, cols)
-        affected = self.region_engine.delete(info.region_ids[0], batch)
+        affected = self._sharded_write(info, batch, delete=True)
         return QueryResult.of_affected(affected)
 
     # ---- introspection -----------------------------------------------------
@@ -418,6 +451,22 @@ class QueryEngine:
 
         engine = PromqlEngine(self)
         return engine.eval_range(stmt.query, stmt.start, stmt.end, stmt.step, ctx)
+
+
+def _cached_rule(info: TableInfo):
+    """Parse the table's partition rule once and memoize it on the
+    TableInfo (hot write path: no JSON round-trip per INSERT)."""
+    from greptimedb_tpu.partition.rule import RangePartitionRule
+
+    rule = getattr(info, "_rule_cache", None)
+    if rule is None:
+        rule = (
+            info.partition_rules
+            if isinstance(info.partition_rules, RangePartitionRule)
+            else RangePartitionRule.from_json(json.dumps(info.partition_rules))
+        )
+        info._rule_cache = rule
+    return rule
 
 
 def _render_type(dt: DataType) -> str:

@@ -74,7 +74,19 @@ def bind_expr(e: ast.Expr, ctx: BindContext) -> ast.Expr:
                 col, lit, flipped = tag
                 if e.op in ("=", "!="):
                     return ast.BinaryOp(e.op, col, ast.Literal(ctx.code_of(col.name, lit.value)))
-                raise PlanError(f"ordering comparison on tag column {col.name!r} unsupported")
+                # ordering comparison: evaluate against the (small) dictionary
+                # on host -> membership test over matching codes, so the
+                # device still only sees int32 codes
+                op = _flip(e.op) if flipped else e.op
+                litv = str(lit.value)  # tags are strings; compare as strings
+                cmp = {
+                    "<": lambda v: v < litv,
+                    "<=": lambda v: v <= litv,
+                    ">": lambda v: v > litv,
+                    ">=": lambda v: v >= litv,
+                }[op]
+                codes = ctx.codes_matching(col.name, lambda v: cmp(str(v)))
+                return ast.InList(col, tuple(ast.Literal(c) for c in codes))
             ts = _ts_side(l, r, ctx)
             if ts is not None:
                 col, lit, flipped = ts

@@ -257,9 +257,20 @@ class PhysicalExecutor:
         scan_node = node
 
         table = scan_node.table
-        region_id = table.region_ids[0]
         ts_range = _closed_range(scan_node.ts_range)
-        scan = self.engine.scan(region_id, ts_range, scan_node.columns)
+        if len(table.region_ids) == 1:
+            scan = self.engine.scan(table.region_ids[0], ts_range, scan_node.columns)
+        else:
+            # distributed fan-out: gather every region's scan (MergeScan,
+            # dist_plan/merge_scan.rs analog)
+            from greptimedb_tpu.storage.merge_scan import merge_scans
+
+            scan = merge_scans(
+                [
+                    self.engine.scan(rid, ts_range, scan_node.columns)
+                    for rid in table.region_ids
+                ]
+            )
 
         if agg is not None:
             return self._execute_agg(scan, table, where, agg, having, project, sort,

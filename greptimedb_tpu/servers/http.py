@@ -255,8 +255,9 @@ class _Handler(BaseHTTPRequestHandler):
                 continue
             if label not in {c.name for c in info.schema.tag_columns}:
                 continue
-            region = qe.region_engine.region(info.region_ids[0])
-            values.update(str(v) for v in region.registry.values.get(label, []))
+            for rid in info.region_ids:  # union across all regions
+                region = qe.region_engine.region(rid)
+                values.update(str(v) for v in region.registry.values.get(label, []))
         self._send(200, {"status": "success", "data": sorted(values)})
 
     def _handle_series(self):

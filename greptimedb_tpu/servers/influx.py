@@ -124,8 +124,11 @@ def _parse_field_value(v: str):
     return float(v)
 
 
-_PRECISION_TO_MS = {"ns": 1e-6, "u": 1e-3, "us": 1e-3, "ms": 1.0, "s": 1e3,
-                    "m": 60e3, "h": 3600e3}
+# precision -> (numerator, denominator) for exact integer ts -> ms
+# conversion (ns-epoch values exceed 2^53, so float math loses precision)
+_PRECISION_TO_MS = {"ns": (1, 1_000_000), "u": (1, 1000), "us": (1, 1000),
+                    "ms": (1, 1), "s": (1000, 1), "m": (60_000, 1),
+                    "h": (3_600_000, 1)}
 
 
 def write_points(query_engine, db: str, points: list[Point],
@@ -155,8 +158,9 @@ def write_points(query_engine, db: str, points: list[Point],
             cols[t] = DictVector.encode(
                 [dict(p.tags).get(t) for p in pts]
             )
+        num, den = scale
         ts_vals = np.asarray(
-            [now_ms if p.ts is None else int(p.ts * scale) for p in pts],
+            [now_ms if p.ts is None else int(p.ts) * num // den for p in pts],
             dtype=np.int64,
         )
         cols[schema.time_index.name] = ts_vals
@@ -175,7 +179,9 @@ def write_points(query_engine, db: str, points: list[Point],
                 cols[fn] = np.asarray(
                     [0 if v is None else int(v) for v in vals], dtype=np.int64)
         batch = RecordBatch(schema, cols)
-        total += query_engine.region_engine.put(info.region_ids[0], batch)
+        # route through the partition-aware write sharding so line-protocol
+        # and SQL writes agree on row→region placement
+        total += query_engine._sharded_write(info, batch, delete=False)
     INGEST_ROWS.inc(total, protocol="influxdb")
     return total
 

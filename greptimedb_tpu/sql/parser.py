@@ -266,8 +266,13 @@ class Parser:
             self.eat_op(",")
         self.expect_op(")")
         if self.eat_kw("partition"):
-            self.eat_kw("on")
-            self.eat_kw("columns")  # PARTITION ON COLUMNS (...) (...)
+            # PARTITION ON COLUMNS (...) (...); ON/COLUMNS may lex as
+            # keywords or plain idents depending on the keyword table
+            for word in ("on", "columns"):
+                if not self.eat_kw(word):
+                    t = self.peek()
+                    if t.value.lower() == word:
+                        self.next()
             stmt.partitions = self._parse_partitions()
         if self.eat_kw("engine"):
             self.expect_op("=")

@@ -27,7 +27,9 @@ class Counter:
 
     def render(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
-        for key, v in sorted(self._values.items()):
+        with self._lock:
+            items = sorted(self._values.items())
+        for key, v in items:
             out.append(f"{self.name}{_labels(key)} {v}")
         return out
 
@@ -40,7 +42,9 @@ class Gauge(Counter):
 
     def render(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
-        for key, v in sorted(self._values.items()):
+        with self._lock:
+            items = sorted(self._values.items())
+        for key, v in items:
             out.append(f"{self.name}{_labels(key)} {v}")
         return out
 
@@ -74,15 +78,20 @@ class Histogram:
 
     def render(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
-        for key, b in sorted(self._buckets.items()):
+        with self._lock:
+            snapshot = sorted(
+                (key, list(b), self._sum[key], self._count[key])
+                for key, b in self._buckets.items()
+            )
+        for key, b, _sum, _count in snapshot:
             cum = 0
             for i, ub in enumerate(self.BUCKETS):
                 cum += b[i]
                 out.append(f"{self.name}_bucket{_labels(key, le=str(ub))} {cum}")
             cum += b[-1]
             out.append(f"{self.name}_bucket{_labels(key, le='+Inf')} {cum}")
-            out.append(f"{self.name}_sum{_labels(key)} {self._sum[key]}")
-            out.append(f"{self.name}_count{_labels(key)} {self._count[key]}")
+            out.append(f"{self.name}_sum{_labels(key)} {_sum}")
+            out.append(f"{self.name}_count{_labels(key)} {_count}")
         return out
 
 

@@ -1,0 +1,189 @@
+"""Metadata plane tests: procedures, failure detection, selectors, routes,
+partition rules — the reference's in-memory-fake strategy (SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from greptimedb_tpu.catalog.kv import MemoryKv
+from greptimedb_tpu.meta.failure_detector import PhiAccrualFailureDetector
+from greptimedb_tpu.meta.route import RegionRoute, TableRoute, TableRouteManager
+from greptimedb_tpu.meta.selector import (
+    LeaseBasedSelector,
+    LoadBasedSelector,
+    RoundRobinSelector,
+)
+from greptimedb_tpu.partition.rule import PartitionBound, RangePartitionRule
+from greptimedb_tpu.procedure import Procedure, ProcedureManager, Status
+
+
+class CountingProcedure(Procedure):
+    type_name = "counting"
+
+    def __init__(self, state=None, fail_at=None):
+        super().__init__(state)
+        self.state.setdefault("n", 0)
+        self.fail_at = fail_at
+
+    def step(self, ctx):
+        if self.fail_at is not None and self.state["n"] == self.fail_at:
+            self.fail_at = None  # fail once, then succeed on retry
+            raise RuntimeError("transient")
+        self.state["n"] += 1
+        if self.state["n"] >= 3:
+            return Status.finished({"n": self.state["n"]})
+        return Status.executing()
+
+
+class TestProcedures:
+    def test_run_to_completion(self):
+        mgr = ProcedureManager(MemoryKv())
+        rec = mgr.submit(CountingProcedure())
+        assert rec.status == "done"
+        assert rec.output == {"n": 3}
+
+    def test_retry_on_transient_failure(self):
+        mgr = ProcedureManager(MemoryKv())
+        rec = mgr.submit(CountingProcedure(fail_at=1))
+        assert rec.status == "done"
+        assert rec.retries == 1
+
+    def test_rollback_after_exhausted_retries(self):
+        class AlwaysFails(Procedure):
+            type_name = "always_fails"
+            rolled_back = False
+
+            def step(self, ctx):
+                raise RuntimeError("permanent")
+
+            def rollback(self, ctx):
+                AlwaysFails.rolled_back = True
+
+        mgr = ProcedureManager(MemoryKv(), max_retries=2)
+        rec = mgr.submit(AlwaysFails())
+        assert rec.status == "rolled_back"
+        assert AlwaysFails.rolled_back
+
+    def test_crash_recovery_resumes_at_phase(self):
+        kv = MemoryKv()
+        mgr = ProcedureManager(kv)
+
+        class CrashesMidway(CountingProcedure):
+            type_name = "crashy"
+
+            def step(self, ctx):
+                if self.state["n"] == 1 and not self.state.get("resumed"):
+                    # simulate coordinator crash by aborting the drive loop
+                    raise KeyboardInterrupt
+                return super().step(ctx)
+
+        try:
+            mgr.submit(CrashesMidway(), procedure_id="p-crash")
+        except KeyboardInterrupt:
+            pass
+        # "new process": fresh manager over the same kv resumes from n=1
+        mgr2 = ProcedureManager(kv)
+        mgr2.register_loader(
+            "crashy", lambda st: CrashesMidway(state={**st, "resumed": True})
+        )
+        results = mgr2.recover()
+        assert len(results) == 1
+        assert results[0].status == "done"
+        assert results[0].output == {"n": 3}
+
+
+class TestFailureDetector:
+    def test_steady_heartbeats_stay_available(self):
+        d = PhiAccrualFailureDetector()
+        t = 0.0
+        for _ in range(50):
+            d.heartbeat(t)
+            t += 1000.0
+        assert d.is_available(t + 500)
+        assert d.phi(t + 500) < 1.0
+
+    def test_missing_heartbeats_raise_phi(self):
+        d = PhiAccrualFailureDetector()
+        t = 0.0
+        for _ in range(50):
+            d.heartbeat(t)
+            t += 1000.0
+        assert not d.is_available(t + 60_000)
+
+    def test_phi_monotone_in_elapsed(self):
+        d = PhiAccrualFailureDetector()
+        for i in range(20):
+            d.heartbeat(i * 1000.0)
+        phis = [d.phi(19_000 + dt) for dt in (0, 2000, 5000, 10_000, 30_000)]
+        assert all(a <= b for a, b in zip(phis, phis[1:]))
+
+
+class TestSelectors:
+    def test_round_robin_cycles(self):
+        s = RoundRobinSelector()
+        nodes = ["a", "b", "c"]
+        picks = [s.select(nodes, {}) for _ in range(6)]
+        assert picks == ["a", "b", "c", "a", "b", "c"]
+
+    def test_load_based_picks_least_loaded(self):
+        s = LoadBasedSelector()
+        stats = {"a": {"region_count": 5}, "b": {"region_count": 1}, "c": {"region_count": 3}}
+        assert s.select(["a", "b", "c"], stats) == "b"
+
+    def test_exclude(self):
+        s = LeaseBasedSelector()
+        assert s.select(["a", "b"], {}, exclude=["a"]) == "b"
+        assert s.select(["a"], {}, exclude=["a"]) is None
+
+
+class TestRoutes:
+    def test_route_cas_update(self):
+        kv = MemoryKv()
+        mgr = TableRouteManager(kv)
+        route = TableRoute("1024", [RegionRoute(region_id=1, leader_node="dn-0")])
+        assert mgr.put_new(route)
+        got = mgr.get("1024")
+        got.region(1).leader_node = "dn-1"
+        assert mgr.update(got)
+        again = mgr.get("1024")
+        assert again.region(1).leader_node == "dn-1"
+        assert again.version == 1
+
+
+class TestPartitionRule:
+    def test_single_column_ranges(self):
+        rule = RangePartitionRule(
+            ["host"],
+            [PartitionBound(("h10",)), PartitionBound(("h20",)), PartitionBound(())],
+        )
+        hosts = np.array(["h05", "h10", "h15", "h25", "h99"])
+        regions = rule.find_regions([hosts])
+        # region 0: < h10; region 1: [h10, h20); region 2: >= h20
+        assert regions.tolist() == [0, 1, 1, 2, 2]
+
+    def test_multi_column_lexicographic(self):
+        rule = RangePartitionRule(
+            ["dc", "host"],
+            [PartitionBound(("dc1", "h5")), PartitionBound(())],
+        )
+        dc = np.array(["dc0", "dc1", "dc1", "dc2"])
+        host = np.array(["h9", "h4", "h5", "h0"])
+        regions = rule.find_regions([dc, host])
+        assert regions.tolist() == [0, 0, 1, 1]
+
+    def test_split_partitions_rows(self):
+        rule = RangePartitionRule(
+            ["host"], [PartitionBound(("m",)), PartitionBound(())]
+        )
+        hosts = np.array(["a", "z", "b", "x"])
+        parts = rule.split([hosts])
+        assert sorted(parts) == [0, 1]
+        assert sorted(hosts[parts[0]]) == ["a", "b"]
+        assert sorted(hosts[parts[1]]) == ["x", "z"]
+
+    def test_json_roundtrip(self):
+        rule = RangePartitionRule(
+            ["host"], [PartitionBound(("m",)), PartitionBound(())]
+        )
+        rule2 = RangePartitionRule.from_json(rule.to_json())
+        assert rule2.columns == ["host"]
+        assert rule2.num_regions() == 2
