@@ -140,6 +140,12 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._handle_influx_write()
             if path in ("/v1/opentsdb/api/put", "/opentsdb/api/put"):
                 return self._handle_opentsdb_put()
+            if path in ("/v1/prometheus/write", "/v1/prometheus/api/v1/write"):
+                return self._handle_prom_remote_write()
+            if path in ("/v1/prometheus/read", "/v1/prometheus/api/v1/read"):
+                return self._handle_prom_remote_read()
+            if path in ("/v1/otlp/v1/metrics",):
+                return self._handle_otlp_metrics()
             return self._send(404, {"error": f"no route {path}"})
         except Exception as e:  # noqa: BLE001 — wire boundary
             traceback.print_exc()
@@ -310,6 +316,42 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", "0")
         self.end_headers()
         HTTP_REQUESTS.inc(path="/v1/influxdb/write", status="204")
+        _ = n
+
+    def _handle_prom_remote_write(self):
+        from greptimedb_tpu.servers.prom_store import handle_remote_write
+
+        params = self._params()
+        body = self._body()
+        db = params.get("db", "public")
+        handle_remote_write(self.query_engine, body, db)
+        self.send_response(204)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+        HTTP_REQUESTS.inc(path="/v1/prometheus/write", status="204")
+
+    def _handle_prom_remote_read(self):
+        from greptimedb_tpu.servers.prom_store import handle_remote_read
+
+        params = self._params()
+        body = self._body()
+        db = params.get("db", "public")
+        resp = handle_remote_read(self.query_engine, body, db)
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-protobuf")
+        self.send_header("Content-Encoding", "snappy")
+        self.send_header("Content-Length", str(len(resp)))
+        self.end_headers()
+        self.wfile.write(resp)
+        HTTP_REQUESTS.inc(path="/v1/prometheus/read", status="200")
+
+    def _handle_otlp_metrics(self):
+        from greptimedb_tpu.servers.otlp import handle_otlp_metrics
+
+        body = self._body()
+        db = self._params().get("db", "public")
+        n = handle_otlp_metrics(self.query_engine, body, db)
+        self._send(200, {"partialSuccess": {}})
         _ = n
 
     def _handle_opentsdb_put(self):

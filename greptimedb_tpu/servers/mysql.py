@@ -1,0 +1,323 @@
+"""MySQL wire-protocol server.
+
+Mirrors reference src/servers/src/mysql (opensrv-mysql `AsyncMysqlShim`
+impl, handler.rs:153, on_query :357): a real MySQL client can connect,
+authenticate (any credentials accepted unless a UserProvider is installed),
+and run SQL against the query engine. Implements the text protocol
+(protocol 41, handshake v10): COM_QUERY, COM_PING, COM_INIT_DB, COM_QUIT,
+plus enough of the federated-query shims (SELECT @@version_comment and
+friends, federated.rs analog) for standard clients to connect cleanly.
+
+EOF-style result sets (CLIENT_DEPRECATE_EOF not advertised) keep encoding
+simple and broadly compatible.
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import struct
+import threading
+from typing import Optional
+
+import numpy as np
+
+from greptimedb_tpu.query.engine import QueryContext, QueryEngine
+from greptimedb_tpu.utils.metrics import REGISTRY
+
+CLIENT_PROTOCOL_41 = 0x00000200
+CLIENT_CONNECT_WITH_DB = 0x00000008
+CLIENT_PLUGIN_AUTH = 0x00080000
+CLIENT_SECURE_CONNECTION = 0x00008000
+CLIENT_LONG_PASSWORD = 0x00000001
+CLIENT_TRANSACTIONS = 0x00002000
+
+SERVER_CAPS = (
+    CLIENT_PROTOCOL_41
+    | CLIENT_CONNECT_WITH_DB
+    | CLIENT_PLUGIN_AUTH
+    | CLIENT_SECURE_CONNECTION
+    | CLIENT_LONG_PASSWORD
+    | CLIENT_TRANSACTIONS
+)
+
+COM_QUIT = 0x01
+COM_INIT_DB = 0x02
+COM_QUERY = 0x03
+COM_PING = 0x0E
+COM_STMT_PREPARE = 0x16
+
+MYSQL_TYPE_LONGLONG = 8
+MYSQL_TYPE_DOUBLE = 5
+MYSQL_TYPE_VAR_STRING = 253
+MYSQL_TYPE_TIMESTAMP = 7
+
+
+def lenc_int(n: int) -> bytes:
+    if n < 251:
+        return bytes([n])
+    if n < 1 << 16:
+        return b"\xfc" + struct.pack("<H", n)
+    if n < 1 << 24:
+        return b"\xfd" + struct.pack("<I", n)[:3]
+    return b"\xfe" + struct.pack("<Q", n)
+
+
+def lenc_str(s: bytes) -> bytes:
+    return lenc_int(len(s)) + s
+
+
+class _PacketIO:
+    """MySQL packet framing: 3-byte little-endian length + sequence id."""
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self.seq = 0
+
+    def read_packet(self) -> Optional[bytes]:
+        header = self._read_exact(4)
+        if header is None:
+            return None
+        length = header[0] | (header[1] << 8) | (header[2] << 16)
+        self.seq = (header[3] + 1) & 0xFF
+        body = self._read_exact(length)
+        return body
+
+    def _read_exact(self, n: int) -> Optional[bytes]:
+        buf = b""
+        while len(buf) < n:
+            chunk = self.sock.recv(n - len(buf))
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+    def send_packet(self, payload: bytes) -> None:
+        while True:
+            chunk, payload = payload[: 0xFFFFFF], payload[0xFFFFFF:]
+            header = struct.pack("<I", len(chunk))[:3] + bytes([self.seq])
+            self.seq = (self.seq + 1) & 0xFF
+            self.sock.sendall(header + chunk)
+            if len(chunk) < 0xFFFFFF:
+                break
+
+    def reset_seq(self) -> None:
+        self.seq = 0
+
+
+class _Session(socketserver.BaseRequestHandler):
+    def handle(self):
+        io = _PacketIO(self.request)
+        server: MysqlServer = self.server.owner  # type: ignore[attr-defined]
+        # ---- handshake v10 ----
+        salt = b"12345678" + b"901234567890"  # 20 bytes of nonce
+        hs = (
+            b"\x0a"  # protocol version 10
+            + b"greptimedb-tpu-8.0\x00"
+            + struct.pack("<I", threading.get_ident() & 0xFFFFFFFF)
+            + salt[:8]
+            + b"\x00"
+            + struct.pack("<H", SERVER_CAPS & 0xFFFF)
+            + bytes([0x21])  # utf8_general_ci
+            + struct.pack("<H", 0x0002)  # status: autocommit
+            + struct.pack("<H", (SERVER_CAPS >> 16) & 0xFFFF)
+            + bytes([21])  # auth plugin data len
+            + b"\x00" * 10
+            + salt[8:]
+            + b"\x00"
+            + b"mysql_native_password\x00"
+        )
+        io.send_packet(hs)
+        resp = io.read_packet()
+        if resp is None:
+            return
+        # HandshakeResponse41: capabilities(4) maxpkt(4) charset(1) filler(23)
+        # then NUL-terminated username
+        if len(resp) < 32:
+            return
+        db = "public"
+        user = ""
+        try:
+            caps = struct.unpack("<I", resp[:4])[0]
+            pos = 32
+            end = resp.index(b"\x00", pos)
+            user = resp[pos:end].decode()
+            pos = end + 1
+            # auth response (lenenc when CLIENT_SECURE_CONNECTION)
+            if pos < len(resp):
+                alen = resp[pos]
+                pos += 1 + alen
+            if caps & CLIENT_CONNECT_WITH_DB and pos < len(resp):
+                end = resp.index(b"\x00", pos)
+                db = resp[pos:end].decode() or "public"
+        except (ValueError, IndexError):
+            pass
+        if server.user_provider is not None and not server.user_provider.allow(user):
+            io.send_packet(_err(1045, "28000", f"Access denied for user {user!r}"))
+            return
+        io.send_packet(_ok())
+        ctx = QueryContext(db=db)
+        # ---- command loop ----
+        while True:
+            io.reset_seq()
+            pkt = io.read_packet()
+            if pkt is None or not pkt:
+                return
+            cmd, body = pkt[0], pkt[1:]
+            if cmd == COM_QUIT:
+                return
+            if cmd == COM_PING:
+                io.send_packet(_ok())
+                continue
+            if cmd == COM_INIT_DB:
+                ctx = QueryContext(db=body.decode() or "public")
+                io.send_packet(_ok())
+                continue
+            if cmd == COM_STMT_PREPARE:
+                io.send_packet(_err(1295, "HY000", "prepared statements not supported; use the text protocol"))
+                continue
+            if cmd != COM_QUERY:
+                io.send_packet(_err(1047, "08S01", f"unknown command {cmd}"))
+                continue
+            sql = body.decode("utf-8", "replace").strip().rstrip(";")
+            try:
+                result = _dispatch(server.query_engine, sql, ctx)
+            except Exception as e:  # noqa: BLE001 — wire must stay up
+                io.send_packet(_err(1064, "42000", str(e)[:400]))
+                continue
+            _send_result(io, result)
+
+
+def _dispatch(engine: QueryEngine, sql: str, ctx: QueryContext):
+    """Run the SQL, shimming the session variables standard clients probe
+    on connect (reference servers/src/mysql/federated.rs)."""
+    low = sql.lower()
+    if low.startswith(("set ", "commit", "rollback", "begin", "start transaction")):
+        return None  # accepted, no-op
+    if "@@" in low and low.startswith("select"):
+        # SELECT @@version_comment / @@max_allowed_packet / ...
+        names, vals = [], []
+        for var in low.replace("select", "", 1).split(","):
+            var = var.strip().split(" ")[0]
+            name = var.replace("@@", "").split(".")[-1]
+            names.append("@@" + name)
+            vals.append(_SESSION_VARS.get(name, ""))
+        return ("rows", names, [vals])
+    res = engine.execute_one(sql, ctx)
+    if not res.is_query:
+        return ("affected", res.affected_rows)
+    return ("rows", list(res.names), res.rows())
+
+
+_SESSION_VARS = {
+    "version_comment": "greptimedb-tpu",
+    "max_allowed_packet": "16777216",
+    "session.auto_increment_increment": "1",
+    "auto_increment_increment": "1",
+    "character_set_client": "utf8",
+    "character_set_connection": "utf8",
+    "character_set_results": "utf8",
+    "character_set_server": "utf8",
+    "collation_server": "utf8_general_ci",
+    "collation_connection": "utf8_general_ci",
+    "init_connect": "",
+    "interactive_timeout": "28800",
+    "license": "Apache-2.0",
+    "lower_case_table_names": "0",
+    "max_execution_time": "0",
+    "net_write_timeout": "60",
+    "performance_schema": "0",
+    "sql_mode": "",
+    "system_time_zone": "UTC",
+    "time_zone": "UTC",
+    "tx_isolation": "REPEATABLE-READ",
+    "transaction_isolation": "REPEATABLE-READ",
+    "wait_timeout": "28800",
+}
+
+
+def _ok(affected: int = 0) -> bytes:
+    return b"\x00" + lenc_int(affected) + lenc_int(0) + struct.pack("<H", 0x0002) + struct.pack("<H", 0)
+
+
+def _eof() -> bytes:
+    return b"\xfe" + struct.pack("<H", 0) + struct.pack("<H", 0x0002)
+
+
+def _err(code: int, state: str, msg: str) -> bytes:
+    return b"\xff" + struct.pack("<H", code) + b"#" + state.encode() + msg.encode()
+
+
+def _coldef(name: str, ftype: int) -> bytes:
+    return (
+        lenc_str(b"def")
+        + lenc_str(b"")  # schema
+        + lenc_str(b"")  # table
+        + lenc_str(b"")  # org_table
+        + lenc_str(name.encode())
+        + lenc_str(name.encode())
+        + bytes([0x0C])  # fixed-length fields length
+        + struct.pack("<H", 0x21)  # charset utf8
+        + struct.pack("<I", 1024)  # column length
+        + bytes([ftype])
+        + struct.pack("<H", 0)  # flags
+        + bytes([0x1F])  # decimals
+        + b"\x00\x00"
+    )
+
+
+def _send_result(io: _PacketIO, result) -> None:
+    if result is None:
+        io.send_packet(_ok())
+        return
+    if result[0] == "affected":
+        io.send_packet(_ok(result[1]))
+        return
+    _, names, rows = result
+    io.send_packet(lenc_int(len(names)))
+    for n in names:
+        io.send_packet(_coldef(n, MYSQL_TYPE_VAR_STRING))
+    io.send_packet(_eof())
+    for row in rows:
+        payload = b""
+        for v in row:
+            if v is None or (isinstance(v, float) and np.isnan(v)):
+                payload += b"\xfb"  # NULL
+            else:
+                payload += lenc_str(_fmt(v).encode())
+        io.send_packet(payload)
+    io.send_packet(_eof())
+
+
+def _fmt(v) -> str:
+    if isinstance(v, (bool, np.bool_)):
+        return "1" if v else "0"
+    if isinstance(v, (float, np.floating)):
+        return repr(float(v))
+    return str(v)
+
+
+class _TcpServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class MysqlServer:
+    """Threaded MySQL server over the shared QueryEngine."""
+
+    def __init__(self, query_engine: QueryEngine, host: str = "127.0.0.1",
+                 port: int = 4002, user_provider=None):
+        self.query_engine = query_engine
+        self.user_provider = user_provider
+        self._server = _TcpServer((host, port), _Session)
+        self._server.owner = self
+        self.port = self._server.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()

@@ -188,3 +188,42 @@ class TestOps:
             text = resp.read().decode()
         assert "greptimedb_tpu_http_requests_total" in text
         assert "greptimedb_tpu_query_duration_seconds" in text
+
+
+class TestPromRemoteEndpoints:
+    def test_remote_write_then_read(self, server):
+        from tests.test_prom_store import (
+            make_read_request,
+            make_write_request,
+            parse_read_response,
+        )
+
+        body = make_write_request([
+            ({"__name__": "up", "job": "api"}, [(1.0, 1000), (0.0, 2000)]),
+        ])
+        status, _ = post(server + "/v1/prometheus/write", body)
+        assert status == 204
+        # query back over HTTP SQL
+        status, out = get(server + "/v1/sql", sql="SELECT count(*) FROM up")
+        assert status == 200
+        # remote read
+        req = make_read_request(0, 10_000, [(0, "__name__", "up")])
+        import urllib.request as _ur
+
+        r = _ur.Request(server + "/v1/prometheus/read", data=req, method="POST")
+        with _ur.urlopen(r) as resp:
+            assert resp.status == 200
+            results = parse_read_response(resp.read())
+        assert results[0][0][1] == [(1.0, 1000), (0.0, 2000)]
+
+    def test_otlp_metrics_endpoint(self, server):
+        from tests.test_prom_store import TestOtlp
+
+        body = TestOtlp()._otlp_body()
+        status, out = post(server + "/v1/otlp/v1/metrics", body,
+                           content_type="application/x-protobuf")
+        assert status == 200
+        status, out = get(server + "/v1/sql", sql="SELECT host, greptime_value FROM my_gauge")
+        assert status == 200
+        rows = out["output"][0]["records"]["rows"]
+        assert rows == [["h1", 42.0]]

@@ -1,0 +1,198 @@
+"""Prometheus remote write/read, OTLP metrics, and the snappy/protowire
+codecs (reference servers prom_store.rs / otlp tests analog)."""
+
+import struct
+
+import numpy as np
+import pytest
+
+from greptimedb_tpu.catalog.catalog import Catalog
+from greptimedb_tpu.catalog.kv import MemoryKv
+from greptimedb_tpu.query.engine import QueryContext, QueryEngine
+from greptimedb_tpu.servers.otlp import handle_otlp_metrics
+from greptimedb_tpu.servers.prom_store import (
+    handle_remote_read,
+    handle_remote_write,
+    parse_read_request,
+)
+from greptimedb_tpu.storage.engine import EngineConfig, RegionEngine
+from greptimedb_tpu.utils import protowire as pw
+from greptimedb_tpu.utils import snappy
+
+
+@pytest.fixture
+def db(tmp_path):
+    engine = RegionEngine(EngineConfig(data_dir=str(tmp_path)))
+    qe = QueryEngine(Catalog(MemoryKv()), engine)
+    yield qe
+    engine.close()
+
+
+# ---------------------------------------------------------------- snappy
+
+
+class TestSnappy:
+    def test_roundtrip(self):
+        for payload in (b"", b"x", b"hello world" * 100, bytes(range(256)) * 300):
+            assert snappy.decompress(snappy.compress(payload)) == payload
+
+    def test_copy_ops(self):
+        # hand-crafted: literal "abcd" + copy-1(offset=4, len=4) -> "abcdabcd"
+        data = bytes([8]) + bytes([3 << 2]) + b"abcd" + bytes([(0 << 5) | 1, 4])
+        assert snappy.decompress(data) == b"abcdabcd"
+
+    def test_overlapping_copy_rle(self):
+        # literal "a" + copy(offset=1, len=7) -> "aaaaaaaa" (RLE via overlap)
+        data = bytes([8]) + bytes([0 << 2]) + b"a" + bytes([(3 << 2) | 1, 1])
+        assert snappy.decompress(data) == b"aaaaaaaa"
+
+    def test_bad_input_raises(self):
+        with pytest.raises(snappy.SnappyError):
+            snappy.decompress(bytes([100]) + b"\x00")
+
+
+# ---------------------------------------------------------------- helpers
+
+
+def make_write_request(series):
+    """series: [(labels: dict, samples: [(value, ts_ms)])] -> snappy body."""
+    body = b""
+    for labels, samples in series:
+        ts_blob = b""
+        for name, value in labels.items():
+            ts_blob += pw.field_bytes(1, pw.field_str(1, name) + pw.field_str(2, value))
+        for value, ts in samples:
+            ts_blob += pw.field_bytes(2, pw.field_double(1, value) + pw.field_varint(2, ts))
+        body += pw.field_bytes(1, ts_blob)
+    return snappy.compress(body)
+
+
+def make_read_request(start_ms, end_ms, matchers):
+    """matchers: [(type, name, value)] -> snappy ReadRequest body."""
+    q = pw.field_varint(1, start_ms) + pw.field_varint(2, end_ms)
+    for mtype, name, value in matchers:
+        q += pw.field_bytes(3, pw.field_varint(1, mtype) + pw.field_str(2, name)
+                            + pw.field_str(3, value))
+    return snappy.compress(pw.field_bytes(1, q))
+
+
+def parse_read_response(body):
+    raw = snappy.decompress(body)
+    results = []
+    for f, _wt, qr in pw.iter_fields(raw):
+        series = []
+        for f2, _wt2, ts_blob in pw.iter_fields(qr):
+            labels, samples = {}, []
+            for f3, _wt3, v3 in pw.iter_fields(ts_blob):
+                if f3 == 1:
+                    name = value = ""
+                    for f4, _wt4, v4 in pw.iter_fields(v3):
+                        if f4 == 1:
+                            name = v4.decode()
+                        elif f4 == 2:
+                            value = v4.decode()
+                    labels[name] = value
+                elif f3 == 2:
+                    val, ts = 0.0, 0
+                    for f4, wt4, v4 in pw.iter_fields(v3):
+                        if f4 == 1:
+                            val = pw.fixed64_to_double(v4)
+                        elif f4 == 2:
+                            ts = pw.varint_to_sint64(v4)
+                    samples.append((val, ts))
+            series.append((labels, samples))
+        results.append(series)
+    return results
+
+
+# ---------------------------------------------------------------- tests
+
+
+class TestRemoteWrite:
+    def test_write_creates_table_and_rows(self, db):
+        body = make_write_request([
+            ({"__name__": "node_cpu_seconds_total", "host": "a", "mode": "idle"},
+             [(1.5, 1000), (2.5, 2000)]),
+            ({"__name__": "node_cpu_seconds_total", "host": "b", "mode": "idle"},
+             [(3.5, 1000)]),
+        ])
+        n = handle_remote_write(db, body)
+        assert n == 3
+        res = db.execute_one(
+            "SELECT host, greptime_value FROM node_cpu_seconds_total "
+            "WHERE mode = 'idle' ORDER BY host, greptime_timestamp"
+        )
+        assert res.rows() == [["a", 1.5], ["a", 2.5], ["b", 3.5]]
+
+    def test_metric_name_sanitized(self, db):
+        body = make_write_request([
+            ({"__name__": "weird.metric-name", "x": "1"}, [(9.0, 5)])
+        ])
+        handle_remote_write(db, body)
+        res = db.execute_one("SELECT greptime_value FROM weird_metric_name")
+        assert res.rows() == [[9.0]]
+
+
+class TestRemoteRead:
+    def seed(self, db):
+        body = make_write_request([
+            ({"__name__": "http_requests", "job": "api", "instance": "i1"},
+             [(10.0, 1000), (20.0, 2000), (30.0, 3000)]),
+            ({"__name__": "http_requests", "job": "api", "instance": "i2"},
+             [(5.0, 1500)]),
+            ({"__name__": "http_requests", "job": "web", "instance": "i3"},
+             [(7.0, 2500)]),
+        ])
+        handle_remote_write(db, body)
+
+    def test_eq_matcher_and_range(self, db):
+        self.seed(db)
+        req = make_read_request(0, 10_000, [(0, "__name__", "http_requests"),
+                                            (0, "job", "api")])
+        results = parse_read_response(handle_remote_read(db, req))
+        assert len(results) == 1
+        series = results[0]
+        assert len(series) == 2
+        by_instance = {s[0]["instance"]: s[1] for s in series}
+        assert by_instance["i1"] == [(10.0, 1000), (20.0, 2000), (30.0, 3000)]
+        assert by_instance["i2"] == [(5.0, 1500)]
+        assert all(s[0]["__name__"] == "http_requests" for s in series)
+
+    def test_time_range_filters(self, db):
+        self.seed(db)
+        req = make_read_request(1500, 2500, [(0, "__name__", "http_requests")])
+        results = parse_read_response(handle_remote_read(db, req))
+        samples = [s for series in results[0] for s in series[1]]
+        assert sorted(ts for _, ts in samples) == [1500, 2000, 2500]
+
+    def test_regex_matcher(self, db):
+        self.seed(db)
+        req = make_read_request(0, 10_000, [(0, "__name__", "http_requests"),
+                                            (2, "instance", "i[12]")])
+        results = parse_read_response(handle_remote_read(db, req))
+        instances = {s[0]["instance"] for s in results[0]}
+        assert instances == {"i1", "i2"}
+
+    def test_unknown_metric_returns_empty(self, db):
+        req = make_read_request(0, 10_000, [(0, "__name__", "nope")])
+        results = parse_read_response(handle_remote_read(db, req))
+        assert results == [[]]
+
+
+class TestOtlp:
+    def _otlp_body(self):
+        # one gauge metric, one data point: attrs {host: h1}, t=2e9 ns, 42.0
+        attr = pw.field_bytes(7, pw.field_str(1, "host") + pw.field_bytes(2, pw.field_str(1, "h1")))
+        dp = attr + struct.pack("B", (3 << 3) | 1) + struct.pack("<Q", 2_000_000_000)
+        dp += struct.pack("B", (4 << 3) | 1) + struct.pack("<d", 42.0)
+        gauge = pw.field_bytes(1, dp)
+        metric = pw.field_str(1, "my.gauge") + pw.field_bytes(5, gauge)
+        scope_metrics = pw.field_bytes(2, metric)
+        resource_metrics = pw.field_bytes(2, scope_metrics)
+        return pw.field_bytes(1, resource_metrics)
+
+    def test_gauge_ingest(self, db):
+        n = handle_otlp_metrics(db, self._otlp_body())
+        assert n == 1
+        res = db.execute_one("SELECT host, greptime_value, ts FROM my_gauge")
+        assert res.rows() == [["h1", 42.0, 2000]]

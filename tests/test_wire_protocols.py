@@ -1,0 +1,329 @@
+"""MySQL and PostgreSQL wire-protocol tests with minimal hand-rolled
+clients (no driver deps in the image — and speaking the raw protocol is
+itself the conformance check)."""
+
+import socket
+import struct
+
+import pytest
+
+from greptimedb_tpu.catalog.catalog import Catalog
+from greptimedb_tpu.catalog.kv import MemoryKv
+from greptimedb_tpu.query.engine import QueryEngine
+from greptimedb_tpu.servers.mysql import MysqlServer
+from greptimedb_tpu.servers.postgres import PostgresServer
+from greptimedb_tpu.storage.engine import EngineConfig, RegionEngine
+
+
+@pytest.fixture
+def db(tmp_path):
+    engine = RegionEngine(EngineConfig(data_dir=str(tmp_path)))
+    qe = QueryEngine(Catalog(MemoryKv()), engine)
+    qe.execute_one(
+        "CREATE TABLE cpu (host STRING, usage DOUBLE, ts TIMESTAMP TIME INDEX, "
+        "PRIMARY KEY(host))"
+    )
+    qe.execute_one(
+        "INSERT INTO cpu (host, usage, ts) VALUES ('a', 1.5, 1000), ('b', 2.5, 2000)"
+    )
+    yield qe
+    engine.close()
+
+
+# ---------------------------------------------------------------- mysql
+
+
+class MiniMysql:
+    def __init__(self, port, db=""):
+        self.sock = socket.create_connection(("127.0.0.1", port), timeout=10)
+        self.seq = 0
+        self._handshake(db)
+
+    def _read_packet(self):
+        header = self._read(4)
+        n = header[0] | (header[1] << 8) | (header[2] << 16)
+        self.seq = (header[3] + 1) & 0xFF
+        return self._read(n)
+
+    def _read(self, n):
+        buf = b""
+        while len(buf) < n:
+            c = self.sock.recv(n - len(buf))
+            assert c, "connection closed"
+            buf += c
+        return buf
+
+    def _send(self, payload):
+        self.sock.sendall(struct.pack("<I", len(payload))[:3] + bytes([self.seq]) + payload)
+        self.seq = (self.seq + 1) & 0xFF
+
+    def _handshake(self, db):
+        greeting = self._read_packet()
+        assert greeting[0] == 0x0A  # protocol 10
+        caps = 0x0200 | 0x8000 | (0x0008 if db else 0)  # 41 | secure | with_db
+        resp = struct.pack("<I", caps) + struct.pack("<I", 1 << 24) + bytes([0x21]) + b"\x00" * 23
+        resp += b"testuser\x00" + b"\x00"  # empty auth
+        if db:
+            resp += db.encode() + b"\x00"
+        self._send(resp)
+        ok = self._read_packet()
+        assert ok[0] == 0x00, f"auth failed: {ok!r}"
+
+    def query(self, sql):
+        self.seq = 0
+        self._send(b"\x03" + sql.encode())
+        first = self._read_packet()
+        if first[0] == 0x00:  # OK: affected rows
+            return ("ok", first[1])
+        if first[0] == 0xFF:
+            code = struct.unpack("<H", first[1:3])[0]
+            raise RuntimeError(f"mysql error {code}: {first[9:].decode()}")
+        ncols = first[0]
+        cols = []
+        for _ in range(ncols):
+            pkt = self._read_packet()
+            # parse column name: skip 4 lenc strings (def, schema, table, org_table)
+            pos = 0
+            for _ in range(4):
+                ln = pkt[pos]; pos += 1 + ln
+            ln = pkt[pos]; pos += 1
+            cols.append(pkt[pos:pos + ln].decode())
+        eof = self._read_packet()
+        assert eof[0] == 0xFE
+        rows = []
+        while True:
+            pkt = self._read_packet()
+            if pkt[0] == 0xFE and len(pkt) < 9:
+                break
+            row, pos = [], 0
+            while pos < len(pkt):
+                if pkt[pos] == 0xFB:
+                    row.append(None); pos += 1
+                    continue
+                ln = pkt[pos]; pos += 1
+                if ln == 0xFC:
+                    ln = struct.unpack("<H", pkt[pos:pos+2])[0]; pos += 2
+                row.append(pkt[pos:pos + ln].decode()); pos += ln
+            rows.append(row)
+        return ("rows", cols, rows)
+
+    def ping(self):
+        self.seq = 0
+        self._send(b"\x0e")
+        return self._read_packet()[0] == 0x00
+
+    def close(self):
+        self.sock.close()
+
+
+class TestMysqlProtocol:
+    def test_handshake_and_query(self, db):
+        srv = MysqlServer(db, port=0)
+        srv.start()
+        try:
+            c = MiniMysql(srv.port)
+            assert c.ping()
+            kind, cols, rows = c.query("SELECT host, usage FROM cpu ORDER BY host")
+            assert kind == "rows"
+            assert cols == ["host", "usage"]
+            assert rows == [["a", "1.5"], ["b", "2.5"]]
+            c.close()
+        finally:
+            srv.shutdown()
+
+    def test_insert_returns_affected(self, db):
+        srv = MysqlServer(db, port=0)
+        srv.start()
+        try:
+            c = MiniMysql(srv.port)
+            kind, n = c.query("INSERT INTO cpu (host, usage, ts) VALUES ('c', 9.0, 3000)")
+            assert (kind, n) == ("ok", 1)
+            kind, _, rows = c.query("SELECT count(*) FROM cpu")
+            assert rows == [["3"]]
+            c.close()
+        finally:
+            srv.shutdown()
+
+    def test_federated_probes_and_errors(self, db):
+        srv = MysqlServer(db, port=0)
+        srv.start()
+        try:
+            c = MiniMysql(srv.port)
+            kind, cols, rows = c.query("SELECT @@version_comment LIMIT 1")
+            assert rows == [["greptimedb-tpu"]]
+            kind, n = c.query("SET NAMES utf8mb4")
+            assert kind == "ok"
+            with pytest.raises(RuntimeError, match="mysql error"):
+                c.query("SELECT nope FROM cpu")
+            # connection still usable after an error
+            kind, _, rows = c.query("SELECT count(*) FROM cpu")
+            assert rows == [["2"]]
+            c.close()
+        finally:
+            srv.shutdown()
+
+    def test_connect_with_db(self, db):
+        db.execute_one("CREATE DATABASE metrics")
+        srv = MysqlServer(db, port=0)
+        srv.start()
+        try:
+            c = MiniMysql(srv.port, db="metrics")
+            c.query("CREATE TABLE m (host STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(host))")
+            kind, n = c.query("INSERT INTO m (host, v, ts) VALUES ('x', 1.0, 1)")
+            assert (kind, n) == ("ok", 1)
+            c.close()
+        finally:
+            srv.shutdown()
+
+
+# ---------------------------------------------------------------- postgres
+
+
+class MiniPg:
+    def __init__(self, port, database="public"):
+        self.sock = socket.create_connection(("127.0.0.1", port), timeout=10)
+        body = struct.pack("!I", 196608)
+        for k, v in (("user", "tester"), ("database", database)):
+            body += k.encode() + b"\x00" + v.encode() + b"\x00"
+        body += b"\x00"
+        self.sock.sendall(struct.pack("!I", len(body) + 4) + body)
+        self._drain_until_ready()
+
+    def _read(self, n):
+        buf = b""
+        while len(buf) < n:
+            c = self.sock.recv(n - len(buf))
+            assert c, "connection closed"
+            buf += c
+        return buf
+
+    def _read_msg(self):
+        t = self._read(1)
+        (ln,) = struct.unpack("!I", self._read(4))
+        return t, self._read(ln - 4) if ln > 4 else b""
+
+    def _drain_until_ready(self):
+        msgs = []
+        while True:
+            t, body = self._read_msg()
+            msgs.append((t, body))
+            if t == b"Z":
+                return msgs
+            if t == b"E":
+                # keep draining to ReadyForQuery, then raise
+                err = body
+                while True:
+                    t2, _ = self._read_msg()
+                    if t2 == b"Z":
+                        raise RuntimeError(f"pg error: {err!r}")
+
+    def query(self, sql):
+        body = sql.encode() + b"\x00"
+        self.sock.sendall(b"Q" + struct.pack("!I", len(body) + 4) + body)
+        cols, rows, tag = [], [], None
+        msgs = self._drain_until_ready()
+        for t, body in msgs:
+            if t == b"T":
+                (n,) = struct.unpack("!h", body[:2])
+                pos = 2
+                for _ in range(n):
+                    end = body.index(b"\x00", pos)
+                    cols.append(body[pos:end].decode())
+                    pos = end + 1 + 18
+            elif t == b"D":
+                (n,) = struct.unpack("!h", body[:2])
+                pos, row = 2, []
+                for _ in range(n):
+                    (ln,) = struct.unpack("!i", body[pos:pos + 4])
+                    pos += 4
+                    if ln == -1:
+                        row.append(None)
+                    else:
+                        row.append(body[pos:pos + ln].decode())
+                        pos += ln
+                rows.append(row)
+            elif t == b"C":
+                tag = body.rstrip(b"\x00").decode()
+        return cols, rows, tag
+
+    def extended_query(self, sql):
+        """Parse/Bind/Execute/Sync round-trip."""
+        p = b"\x00" + sql.encode() + b"\x00" + struct.pack("!h", 0)
+        self.sock.sendall(b"P" + struct.pack("!I", len(p) + 4) + p)
+        b_ = b"\x00\x00" + struct.pack("!hhh", 0, 0, 0)
+        self.sock.sendall(b"B" + struct.pack("!I", len(b_) + 4) + b_)
+        e = b"\x00" + struct.pack("!i", 0)
+        self.sock.sendall(b"E" + struct.pack("!I", len(e) + 4) + e)
+        self.sock.sendall(b"S" + struct.pack("!I", 4))
+        rows = []
+        msgs = self._drain_until_ready()
+        for t, body in msgs:
+            if t == b"D":
+                (n,) = struct.unpack("!h", body[:2])
+                pos, row = 2, []
+                for _ in range(n):
+                    (ln,) = struct.unpack("!i", body[pos:pos + 4])
+                    pos += 4
+                    if ln == -1:
+                        row.append(None)
+                    else:
+                        row.append(body[pos:pos + ln].decode())
+                        pos += ln
+                rows.append(row)
+        return rows
+
+    def close(self):
+        self.sock.sendall(b"X" + struct.pack("!I", 4))
+        self.sock.close()
+
+
+class TestPostgresProtocol:
+    def test_simple_query(self, db):
+        srv = PostgresServer(db, port=0)
+        srv.start()
+        try:
+            c = MiniPg(srv.port)
+            cols, rows, tag = c.query("SELECT host, usage FROM cpu ORDER BY host")
+            assert cols == ["host", "usage"]
+            assert rows == [["a", "1.5"], ["b", "2.5"]]
+            assert tag == "SELECT 2"
+            c.close()
+        finally:
+            srv.shutdown()
+
+    def test_dml_tags_and_error_recovery(self, db):
+        srv = PostgresServer(db, port=0)
+        srv.start()
+        try:
+            c = MiniPg(srv.port)
+            _, _, tag = c.query("INSERT INTO cpu (host, usage, ts) VALUES ('z', 3.5, 9000)")
+            assert tag == "INSERT 0 1"
+            with pytest.raises(RuntimeError, match="pg error"):
+                c.query("SELECT broken syntax here FROM")
+            cols, rows, _ = c.query("SELECT count(*) FROM cpu")
+            assert rows == [["3"]]
+            c.close()
+        finally:
+            srv.shutdown()
+
+    def test_extended_protocol(self, db):
+        srv = PostgresServer(db, port=0)
+        srv.start()
+        try:
+            c = MiniPg(srv.port)
+            rows = c.extended_query("SELECT host FROM cpu ORDER BY host")
+            assert rows == [["a"], ["b"]]
+            c.close()
+        finally:
+            srv.shutdown()
+
+    def test_set_statements_accepted(self, db):
+        srv = PostgresServer(db, port=0)
+        srv.start()
+        try:
+            c = MiniPg(srv.port)
+            _, _, tag = c.query("SET client_encoding TO 'UTF8'")
+            assert tag == "SET"
+            c.close()
+        finally:
+            srv.shutdown()
