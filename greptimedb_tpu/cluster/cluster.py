@@ -174,8 +174,11 @@ class RegionRouter:
     def compact(self, region_id: int) -> None:
         self._engine_for(region_id).compact(region_id)
 
-    def scan(self, region_id: int, ts_range=None, projection=None):
-        return self._engine_for(region_id).scan(region_id, ts_range, projection)
+    def scan(self, region_id: int, ts_range=None, projection=None,
+             tag_predicates=None):
+        return self._engine_for(region_id).scan(
+            region_id, ts_range, projection, tag_predicates
+        )
 
     def handle_request(self, req: RegionRequest) -> int:
         return self._engine_for(req.region_id).handle_request(req)

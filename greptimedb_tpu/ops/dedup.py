@@ -25,7 +25,7 @@ OP_PUT = 0
 OP_DELETE = 1
 
 
-@functools.partial(jax.jit, static_argnames=("assume_unique_ts",))
+@functools.partial(jax.jit, static_argnames=("assume_unique_ts", "keep_tombstones"))
 def sort_dedup(
     series_ids: jax.Array,  # [N] int32 dense series/primary-key ids
     ts: jax.Array,  # [N] int64
@@ -33,6 +33,7 @@ def sort_dedup(
     op_type: jax.Array,  # [N] int8 OP_PUT/OP_DELETE
     mask: jax.Array,  # [N] bool validity
     assume_unique_ts: bool = False,
+    keep_tombstones: bool = False,
 ) -> tuple[jax.Array, jax.Array]:
     """Returns (order, keep): `order` sorts rows by (series, ts); `keep` is a
     mask in sorted order marking last-write-wins survivors.
@@ -57,7 +58,11 @@ def sort_dedup(
     nxt_s = jnp.concatenate([s_sorted[1:], jnp.full((1,), big, s_sorted.dtype)])
     nxt_t = jnp.concatenate([t_sorted[1:], jnp.full((1,), jnp.iinfo(jnp.int64).min, t_sorted.dtype)])
     is_last = (s_sorted != nxt_s) | (t_sorted != nxt_t)
-    keep = is_last & (s_sorted != big) & (op_sorted != OP_DELETE)
+    keep = is_last & (s_sorted != big)
+    if not keep_tombstones:
+        # partial (windowed) compactions must retain winning tombstones —
+        # an older shadowed PUT may live in a file outside the merge group
+        keep = keep & (op_sorted != OP_DELETE)
     return order, keep
 
 

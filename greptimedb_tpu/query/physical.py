@@ -258,8 +258,14 @@ class PhysicalExecutor:
 
         table = scan_node.table
         ts_range = _closed_range(scan_node.ts_range)
+        # conjunctive tag eq/IN predicates drive inverted-index row-group
+        # pruning inside the scan (reference scan_region.rs index applier)
+        from greptimedb_tpu.storage.index import extract_tag_predicates
+
+        tag_preds = extract_tag_predicates(where, table.schema) or None
         if len(table.region_ids) == 1:
-            scan = self.engine.scan(table.region_ids[0], ts_range, scan_node.columns)
+            scan = self.engine.scan(table.region_ids[0], ts_range,
+                                    scan_node.columns, tag_preds)
         else:
             # distributed fan-out: gather every region's scan (MergeScan,
             # dist_plan/merge_scan.rs analog)
@@ -267,7 +273,7 @@ class PhysicalExecutor:
 
             scan = merge_scans(
                 [
-                    self.engine.scan(rid, ts_range, scan_node.columns)
+                    self.engine.scan(rid, ts_range, scan_node.columns, tag_preds)
                     for rid in table.region_ids
                 ]
             )

@@ -102,7 +102,9 @@ class RegionEngine:
                 self.region(req.region_id).flush()
                 return 0
             if req.kind is RequestType.COMPACT:
-                self.region(req.region_id).compact()
+                # manual compaction is a full merge (reference manual
+                # strict-window strategy); background TWCS runs after flush
+                self.region(req.region_id).compact(strategy="full")
                 return 0
 
             region = self.region(req.region_id)
@@ -114,6 +116,8 @@ class RegionEngine:
                 raise ValueError(f"unhandled request {req.kind}")
             if region.memtable_bytes >= self.config.flush_threshold_bytes:
                 region.flush()
+                # TWCS picker no-ops unless window thresholds are exceeded
+                region.compact()
             return n
 
     # ---- convenience wrappers ----------------------------------------------
@@ -143,8 +147,9 @@ class RegionEngine:
         region_id: int,
         ts_range: Optional[tuple[int, int]] = None,
         projection: Optional[Sequence[str]] = None,
+        tag_predicates: Optional[dict[str, set]] = None,
     ) -> Optional[ScanData]:
-        return self.region(region_id).scan(ts_range, projection)
+        return self.region(region_id).scan(ts_range, projection, tag_predicates)
 
     def close(self) -> None:
         self.wal.close()

@@ -1,0 +1,156 @@
+"""Inverted index: tag value → row-group bitmap, per SST.
+
+Mirrors reference src/index/src/inverted_index (format.rs:28: FST of tag
+values → bitmaps of row segments) + mito2's index applier integration
+(sst/parquet/reader.rs:335-425 prune path). Per SST file we store, for each
+tag column, the sorted distinct *values* present and a row-group bitmask
+per value; scan-time predicates (eq / IN on tags) intersect those bitmasks
+to skip whole row groups — and whole files — before any Parquet page is
+touched.
+
+Values (not per-file codes) key the index so it stays valid as the region
+tag registry grows. Serialization is a JSON sidecar next to the SST — the
+puffin-container analog, one blob per file.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+class InvertedIndexWriter:
+    """Build + persist the per-file index at SST write time."""
+
+    def __init__(self, sst_dir: str):
+        self.sst_dir = sst_dir
+
+    def path(self, file_id: str) -> str:
+        return os.path.join(self.sst_dir, f"{file_id}.idx.json")
+
+    def write(
+        self,
+        file_id: str,
+        tag_codes: dict[str, np.ndarray],  # tag -> int32 codes per row
+        tag_dicts: dict[str, np.ndarray],  # tag -> value table
+        row_group_size: int,
+        num_rows: int,
+    ) -> None:
+        if not tag_codes or num_rows == 0:
+            return
+        n_groups = (num_rows + row_group_size - 1) // row_group_size
+        index: dict[str, dict] = {}
+        for tag, codes in tag_codes.items():
+            values = tag_dicts[tag]
+            masks: dict[str, int] = {}
+            codes = np.asarray(codes)
+            for rg in range(n_groups):
+                chunk = codes[rg * row_group_size:(rg + 1) * row_group_size]
+                for code in np.unique(chunk):
+                    if code < 0:
+                        key = None  # NULL
+                    else:
+                        key = str(values[code])
+                    k = "\x00null" if key is None else key
+                    masks[k] = masks.get(k, 0) | (1 << rg)
+            index[tag] = {"masks": masks}
+        with open(self.path(file_id), "w") as f:
+            json.dump({"n_groups": n_groups, "tags": index}, f)
+
+    def delete(self, file_id: str) -> None:
+        try:
+            os.remove(self.path(file_id))
+        except FileNotFoundError:
+            pass
+
+
+class IndexApplier:
+    """Evaluate tag predicates against a file's index.
+
+    `predicates`: tag name -> set of allowed values (from conjunctive
+    eq/IN clauses). Returns the allowed row-group indices, or None when the
+    file has no index (scan everything), or [] when provably empty.
+    """
+
+    def __init__(self, sst_dir: str):
+        self.sst_dir = sst_dir
+        self._cache: dict[str, Optional[dict]] = {}
+
+    def _load(self, file_id: str) -> Optional[dict]:
+        if file_id in self._cache:
+            return self._cache[file_id]
+        path = os.path.join(self.sst_dir, f"{file_id}.idx.json")
+        data = None
+        if os.path.exists(path):
+            with open(path) as f:
+                data = json.load(f)
+        self._cache[file_id] = data
+        return data
+
+    def apply(
+        self, file_id: str, predicates: dict[str, set]
+    ) -> Optional[list[int]]:
+        data = self._load(file_id)
+        if data is None or not predicates:
+            return None
+        n_groups = data["n_groups"]
+        allowed = (1 << n_groups) - 1
+        for tag, values in predicates.items():
+            tag_index = data["tags"].get(tag)
+            if tag_index is None:
+                continue  # tag not indexed in this file
+            mask = 0
+            for v in values:
+                mask |= tag_index["masks"].get(str(v), 0)
+            allowed &= mask
+            if allowed == 0:
+                return []
+        if allowed == (1 << n_groups) - 1:
+            return None  # nothing pruned
+        return [rg for rg in range(n_groups) if allowed & (1 << rg)]
+
+    def invalidate(self, file_id: str) -> None:
+        self._cache.pop(file_id, None)
+
+
+def extract_tag_predicates(where, schema) -> dict[str, set]:
+    """Conservatively extract `tag = 'v'` / `tag IN (...)` constraints from
+    the top-level conjunction of a raw (pre-bind) WHERE AST. Anything not
+    provably restrictive is ignored — pruning must never drop rows.
+    """
+    from greptimedb_tpu.sql import ast
+
+    tags = {c.name for c in schema.tag_columns}
+    out: dict[str, set] = {}
+
+    def walk(e):
+        if isinstance(e, ast.BinaryOp) and e.op == "and":
+            walk(e.left)
+            walk(e.right)
+            return
+        if isinstance(e, ast.BinaryOp) and e.op == "=":
+            l, r = e.left, e.right
+            if isinstance(r, ast.Column) and isinstance(l, ast.Literal):
+                l, r = r, l
+            if (
+                isinstance(l, ast.Column)
+                and l.name in tags
+                and isinstance(r, ast.Literal)
+            ):
+                out.setdefault(l.name, set()).add(str(r.value))
+            return
+        if (
+            isinstance(e, ast.InList)
+            and not e.negated
+            and isinstance(e.expr, ast.Column)
+            and e.expr.name in tags
+            and all(isinstance(i, ast.Literal) for i in e.items)
+        ):
+            out.setdefault(e.expr.name, set()).update(str(i.value) for i in e.items)
+
+    if where is not None:
+        walk(where)
+    return out

@@ -164,44 +164,77 @@ class Region:
             keys.append(cols[c.name])
         return np.lexsort(keys)
 
-    # ---- compaction (minor: merge all L0 into one sorted L1 file) ----------
+    # ---- compaction (TWCS: merge within time windows) ----------------------
 
-    def compact(self) -> Optional[FileMeta]:
-        """Merge all SSTs into one sorted, deduplicated file. The merge is
-        the device sort-dedup kernel (SURVEY.md §7: compaction re-encode
-        runs the same kernel as scan), host-side numpy here for the
-        baseline; shadowed rows and tombstones are dropped."""
-        if len(self.files) < 2:
+    def compact(self, strategy: str = "twcs") -> list[FileMeta]:
+        """Compact SSTs. "twcs": time-window groups picked by TwcsPicker
+        (reference compaction/twcs.rs); "full": everything into one file
+        (manual strict-window analog, ADMIN compact_table). The merge runs
+        the device sort-dedup kernel — compaction is the same computation
+        as query-time dedup, persisted (SURVEY.md §7)."""
+        from greptimedb_tpu.storage.compaction import TwcsPicker
+
+        if strategy == "full":
+            groups = [list(self.files.values())] if len(self.files) > 1 else []
+        else:
+            groups = TwcsPicker().pick(list(self.files.values()))
+        out: list[FileMeta] = []
+        for group in groups:
+            meta = self._merge_files(group)
+            if meta is not None:
+                out.append(meta)
+        return out
+
+    def _merge_files(self, group: list[FileMeta]) -> Optional[FileMeta]:
+        """Read `group`'s SSTs, sort-dedup on device, rewrite as one L1
+        file, swap in the manifest (compaction/task.rs analog)."""
+        names = self.schema.names
+        parts_cols, parts_seq, parts_op = [], [], []
+        for meta in group:
+            table = self.sst_reader.read(meta, self.schema, None, names)
+            if table is None or table.num_rows == 0:
+                continue
+            parts_cols.append(self._decode_sst(table, names))
+            parts_seq.append(table.column(SEQ_COL).to_numpy(zero_copy_only=False).astype(np.int64))
+            parts_op.append(table.column(OP_COL).to_numpy(zero_copy_only=False).astype(np.int8))
+        if not parts_cols:
             return None
-        scan = self.scan()
-        if scan is None or scan.num_rows == 0:
-            return None
+        columns = {n: np.concatenate([p[n] for p in parts_cols]) for n in names}
+        seq = np.concatenate(parts_seq)
+        op = np.concatenate(parts_op)
+        n_rows = len(seq)
+
         import jax.numpy as jnp
         from greptimedb_tpu.ops.dedup import sort_dedup
         from greptimedb_tpu.ops.segment import combine_group_ids
 
         tag_names = [c.name for c in self.schema.tag_columns]
-        sizes = [max(scan.tag_cardinalities[n], 1) + 1 for n in tag_names]
+        sizes = [max(len(self.registry.dict_array(n)), 1) + 1 for n in tag_names]
         if tag_names:
             # int64: the cardinality product of several tags can exceed 2^31
             sid = combine_group_ids(
-                [jnp.asarray(scan.columns[n] + 1) for n in tag_names], sizes,
+                [jnp.asarray(columns[n] + 1) for n in tag_names], sizes,
                 dtype=jnp.int64,
             )
         else:
-            sid = jnp.zeros(scan.num_rows, dtype=jnp.int64)
-        ts = jnp.asarray(scan.columns[self.schema.time_index.name])
+            sid = jnp.zeros(n_rows, dtype=jnp.int64)
+        ts = jnp.asarray(columns[self.schema.time_index.name])
+        covers_all = len(group) == len(self.files)
         order, keep = sort_dedup(
-            sid, ts, jnp.asarray(scan.seq), jnp.asarray(scan.op_type),
-            jnp.ones(scan.num_rows, dtype=bool),
+            sid, ts, jnp.asarray(seq), jnp.asarray(op),
+            jnp.ones(n_rows, dtype=bool),
+            keep_tombstones=not covers_all,
         )
         order = np.asarray(order)[np.asarray(keep)]
-        cols = {k: v[order] for k, v in scan.columns.items()}
+        cols = {k: v[order] for k, v in columns.items()}
+        tag_dicts = {n: self.registry.dict_array(n) for n in tag_names}
         meta = self.sst_writer.write(
-            cols, scan.tag_dicts, scan.seq[order], scan.op_type[order], level=1
+            cols, tag_dicts, seq[order], op[order], level=1
         )
-        removed = list(self.files)
-        self.files = {meta.file_id: meta}
+        removed = [f.file_id for f in group]
+        for fid in removed:
+            self.files.pop(fid, None)
+        self.files[meta.file_id] = meta
         self.manifest.record_flush([meta], flushed_seq=self.next_seq,
                                    tag_dicts=self.registry.snapshot(), removed=removed)
         for fid in removed:
@@ -215,10 +248,20 @@ class Region:
         self,
         ts_range: Optional[tuple[int, int]] = None,
         projection: Optional[Sequence[str]] = None,
+        tag_predicates: Optional[dict[str, set]] = None,
     ) -> Optional[ScanData]:
-        """Collect memtable + pruned SSTs into concatenated host columns."""
+        """Collect memtable + pruned SSTs into concatenated host columns.
+        `tag_predicates` (tag -> allowed values) drives inverted-index
+        row-group pruning; the scan result may then contain rows the
+        predicate rejects — the device filter still runs, pruning is purely
+        an IO reduction (never affects correctness)."""
         names = self._scan_columns(projection)
-        cache_key = (self.data_version, ts_range, tuple(names))
+        pred_key = (
+            tuple(sorted((k, tuple(sorted(v))) for k, v in tag_predicates.items()))
+            if tag_predicates
+            else None
+        )
+        cache_key = (self.data_version, ts_range, tuple(names), pred_key)
         cached = self._scan_cache.get(cache_key)
         if cached is not None:
             self._scan_cache.move_to_end(cache_key)
@@ -228,7 +271,8 @@ class Region:
         parts_op: list[np.ndarray] = []
 
         for meta in self.files.values():
-            table = self.sst_reader.read(meta, self.schema, ts_range, names)
+            table = self.sst_reader.read(meta, self.schema, ts_range, names,
+                                         tag_predicates=tag_predicates)
             if table is None or table.num_rows == 0:
                 continue
             cols = self._decode_sst(table, names)
@@ -262,7 +306,7 @@ class Region:
             num_rows=len(seq),
             region_id=self.region_id,
             data_version=self.data_version,
-            scan_fingerprint=(ts_range, tuple(names)),
+            scan_fingerprint=(ts_range, tuple(names), pred_key),
         )
         self._scan_cache[cache_key] = result
         while len(self._scan_cache) > self.scan_cache_entries:

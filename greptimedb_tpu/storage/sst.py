@@ -101,6 +101,17 @@ class SstWriter:
             compression="zstd",
             write_statistics=True,
         )
+        # build the per-file inverted index (tag value -> row-group bitmap)
+        from greptimedb_tpu.storage.index import InvertedIndexWriter
+
+        InvertedIndexWriter(self.sst_dir).write(
+            file_id,
+            {c.name: np.asarray(columns[c.name], dtype=np.int32)
+             for c in self.schema.tag_columns},
+            tag_dicts,
+            self.row_group_size,
+            n,
+        )
         ts = np.asarray(columns[ts_name])
         return FileMeta(
             file_id=file_id,
@@ -116,6 +127,9 @@ class SstWriter:
 class SstReader:
     def __init__(self, sst_dir: str):
         self.sst_dir = sst_dir
+        from greptimedb_tpu.storage.index import IndexApplier
+
+        self.index_applier = IndexApplier(sst_dir)
 
     def path(self, file_id: str) -> str:
         return os.path.join(self.sst_dir, f"{file_id}.parquet")
@@ -133,9 +147,19 @@ class SstReader:
         pruned. Internal columns are always materialized."""
         if ts_range is not None and (meta.ts_max < ts_range[0] or meta.ts_min >= ts_range[1]):
             return None
+        # inverted-index pruning first: may rule the file out with no
+        # parquet metadata read at all (reference reader.rs:335-425)
+        idx_groups = None
+        if tag_predicates:
+            idx_groups = self.index_applier.apply(meta.file_id, tag_predicates)
+            if idx_groups == []:
+                return None
         pf = pq.ParquetFile(self.path(meta.file_id))
         ts_name = schema.time_index.name
         groups = self._prune_row_groups(pf, ts_name, ts_range)
+        if idx_groups is not None:
+            allowed = set(idx_groups)
+            groups = [g for g in groups if g in allowed]
         if not groups:
             return None
         cols = None
@@ -173,6 +197,10 @@ class SstReader:
             os.remove(self.path(file_id))
         except FileNotFoundError:
             pass
+        from greptimedb_tpu.storage.index import InvertedIndexWriter
+
+        InvertedIndexWriter(self.sst_dir).delete(file_id)
+        self.index_applier.invalidate(file_id)
 
 
 def _ts_stat(v, ts_type) -> int:
