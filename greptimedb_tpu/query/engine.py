@@ -39,11 +39,17 @@ class QueryContext:
 
 
 class QueryEngine:
-    def __init__(self, catalog: Catalog, region_engine: RegionEngine):
+    def __init__(self, catalog: Catalog, region_engine: RegionEngine,
+                 metric_engine=None):
         self.catalog = catalog
         self.region_engine = region_engine
         self.executor = PhysicalExecutor(region_engine)
         self._open_regions: set[int] = set()
+        if metric_engine is None and hasattr(region_engine, "register_opener"):
+            from greptimedb_tpu.storage.metric_engine import MetricEngine
+
+            metric_engine = MetricEngine(region_engine, catalog.kv)
+        self.metric_engine = metric_engine
 
     # ---- entry points ------------------------------------------------------
 
@@ -186,6 +192,8 @@ class QueryEngine:
                 default = c.default.value
             cols.append(ColumnSchema(c.name, dtype, sem, c.nullable, default))
         schema = Schema(cols)
+        if stmt.engine == "metric":
+            return self._create_metric_table(db, name, schema, stmt, ctx)
         info = self.catalog.create_table(
             db, name, schema, options=dict(stmt.options),
             if_not_exists=stmt.if_not_exists,
@@ -197,6 +205,32 @@ class QueryEngine:
             self._open_regions.add(rid)
         return QueryResult.of_affected(0)
 
+    def _create_metric_table(self, db, name, schema: Schema, stmt, ctx) -> QueryResult:
+        """CREATE TABLE ... ENGINE=metric: a logical table multiplexed onto
+        the shared physical region (reference metric-engine, SURVEY §2.3)."""
+        if self.metric_engine is None:
+            raise PlanError("metric engine not configured")
+        fields = schema.field_columns
+        if len(fields) != 1:
+            raise PlanError("metric engine tables need exactly one field column")
+        if self.catalog.table_exists(db, name):
+            if stmt.if_not_exists:
+                return QueryResult.of_affected(0)
+            raise CatalogError(f"table {db}.{name} already exists")
+        meta = self.metric_engine.create_logical_table(
+            db, name, [c.name for c in schema.tag_columns],
+            ts_name=schema.time_index.name, value_name=fields[0].name,
+        )
+        self.catalog.create_table(
+            db, name, schema, options={**dict(stmt.options), "engine": "metric"},
+            if_not_exists=True,
+        )
+        info = self.catalog.table(db, name)
+        info.region_ids = [meta.logical_region]
+        self.catalog.update_table(info)
+        self._open_regions.add(meta.logical_region)
+        return QueryResult.of_affected(0)
+
     def _drop_table(self, stmt: ast.DropTable, ctx: QueryContext) -> QueryResult:
         db = ctx.db
         name = stmt.name
@@ -204,6 +238,11 @@ class QueryEngine:
             db, name = name.rsplit(".", 1)
         info = self.catalog.drop_table(db, name, stmt.if_exists)
         if info is None:
+            return QueryResult.of_affected(0)
+        if info.options.get("engine") == "metric" and self.metric_engine:
+            self.metric_engine.drop_logical_table(db, name)
+            for rid in info.region_ids:
+                self._open_regions.discard(rid)
             return QueryResult.of_affected(0)
         from greptimedb_tpu.storage.engine import RegionRequest, RequestType
         for rid in info.region_ids:

@@ -60,7 +60,13 @@ class RegionEngine:
         os.makedirs(config.data_dir, exist_ok=True)
         self.wal = Wal(os.path.join(config.data_dir, "wal"), sync=config.wal_sync)
         self.regions: dict[int, Region] = {}
+        # alternate engines (metric engine) hook region-open by id — the
+        # RegionServer multi-engine registration analog (datanode.rs:328)
+        self.openers: list = []
         self._lock = threading.RLock()
+
+    def register_opener(self, fn) -> None:
+        self.openers.append(fn)
 
     def _region_dir(self, region_id: int) -> str:
         return os.path.join(self.config.data_dir, f"region_{region_id}")
@@ -85,6 +91,11 @@ class RegionEngine:
                 return 0
             if req.kind is RequestType.OPEN:
                 if req.region_id not in self.regions:
+                    for opener in self.openers:
+                        r = opener(req.region_id)
+                        if r is not None:
+                            self.regions[req.region_id] = r
+                            return 0
                     self.regions[req.region_id] = Region.open(
                         req.region_id, self._region_dir(req.region_id), self.wal
                     )

@@ -1,0 +1,305 @@
+"""Metric engine: many logical tables over one physical region.
+
+Mirrors reference src/metric-engine (engine.rs:57-98): Prometheus workloads
+create one table per metric — thousands to millions of tiny tables — which
+would drown a region-per-table design. The reference multiplexes logical
+tables onto one physical mito region pair (data + metadata).
+
+TPU-native re-design: the physical data region stores exactly two tag
+columns — `__table` (logical table name) and `__labels` (the canonical
+serialized label set, i.e. THE SERIES ID as one dictionary code) — plus
+`greptime_timestamp` / `greptime_value`. Logical tag columns are virtual:
+at scan time each distinct label-set value is parsed once (dictionary-sized
+work, not row-sized) and per-tag code columns are derived by mapping label-
+set codes through a small lookup table — a single numpy gather. This keeps
+the device kernel ABI identical to normal tables while the storage side
+collapses arbitrary table counts into one LSM region.
+
+Logical table metadata (the reference's metadata region) lives in the kv
+backend under `__metric_engine/`.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import numpy as np
+
+from greptimedb_tpu.catalog.kv import KvBackend
+from greptimedb_tpu.datatypes.recordbatch import RecordBatch
+from greptimedb_tpu.datatypes.schema import ColumnSchema, Schema
+from greptimedb_tpu.datatypes.types import DataType, SemanticType
+from greptimedb_tpu.datatypes.vector import DictVector
+from greptimedb_tpu.storage.engine import RegionEngine, RegionRequest, RequestType
+from greptimedb_tpu.storage.region import OP_PUT, ScanData
+
+TABLE_COL = "__table"
+LABELS_COL = "__labels"
+TS_COL = "greptime_timestamp"
+VALUE_COL = "greptime_value"
+
+META_PREFIX = "__metric_engine/"
+
+
+def physical_schema() -> Schema:
+    return Schema([
+        ColumnSchema(TABLE_COL, DataType.STRING, SemanticType.TAG),
+        ColumnSchema(LABELS_COL, DataType.STRING, SemanticType.TAG),
+        ColumnSchema(TS_COL, DataType.TIMESTAMP_MILLISECOND,
+                     SemanticType.TIMESTAMP, nullable=False),
+        ColumnSchema(VALUE_COL, DataType.FLOAT64, SemanticType.FIELD),
+    ])
+
+
+def encode_labels(tags: dict[str, Optional[str]]) -> str:
+    """Canonical series encoding: sorted k=v pairs, \\x1f-separated (tag
+    values may contain commas; \\x1f cannot appear in Prometheus labels)."""
+    items = sorted((k, v) for k, v in tags.items() if v is not None)
+    return "\x1f".join(f"{k}={v}" for k, v in items)
+
+
+def decode_labels(s: str) -> dict[str, str]:
+    if not s:
+        return {}
+    out = {}
+    for part in s.split("\x1f"):
+        k, _, v = part.partition("=")
+        out[k] = v
+    return out
+
+
+@dataclass
+class LogicalTableMeta:
+    name: str
+    tag_names: list[str]
+    physical_region: int
+    logical_region: int
+    ts_name: str = TS_COL
+    value_name: str = VALUE_COL
+
+    def to_json(self) -> str:
+        return json.dumps(self.__dict__)
+
+    @staticmethod
+    def from_json(s: str) -> "LogicalTableMeta":
+        return LogicalTableMeta(**json.loads(s))
+
+
+class LogicalRegion:
+    """Region-shaped view of one logical table over the physical region.
+
+    Registered in the RegionEngine's region map under the logical region id
+    so the entire query path (scan/put/flush) works unchanged."""
+
+    def __init__(self, meta: LogicalTableMeta, engine: RegionEngine):
+        self.meta = meta
+        self.engine = engine
+        self.region_id = meta.logical_region
+        self.schema = logical_schema(meta.tag_names, meta.ts_name, meta.value_name)
+
+    # -- write: logical batch -> physical rows --
+    def write(self, batch: RecordBatch, op: int) -> int:
+        phys = self.engine.region(self.meta.physical_region)
+        n = batch.num_rows
+        tag_cols = {}
+        for t in self.meta.tag_names:
+            col = batch.columns.get(t)
+            tag_cols[t] = (
+                col.decode() if isinstance(col, DictVector) else
+                (np.asarray(col) if col is not None else np.full(n, None, dtype=object))
+            )
+        labels = []
+        for i in range(n):
+            labels.append(encode_labels(
+                {t: (None if tag_cols[t][i] is None else str(tag_cols[t][i]))
+                 for t in self.meta.tag_names}
+            ))
+        cols = {
+            TABLE_COL: DictVector.encode([self.meta.name] * n),
+            LABELS_COL: DictVector.encode(labels),
+            TS_COL: np.asarray(batch.columns[self.meta.ts_name], dtype=np.int64),
+            VALUE_COL: np.asarray(batch.columns[self.meta.value_name],
+                                  dtype=np.float64),
+        }
+        written = phys.write(RecordBatch(physical_schema(), cols), op)
+        if phys.memtable_bytes >= self.engine.config.flush_threshold_bytes:
+            phys.flush()
+            phys.compact()
+        return written
+
+    @property
+    def memtable_bytes(self) -> int:
+        return 0  # flush policy is owned by the physical region
+
+    @property
+    def registry(self):
+        return _VirtualRegistry(self)
+
+    @property
+    def data_version(self) -> int:
+        return self.engine.region(self.meta.physical_region).data_version
+
+    def flush(self):
+        self.engine.region(self.meta.physical_region).flush()
+
+    def compact(self, strategy: str = "twcs"):
+        return self.engine.region(self.meta.physical_region).compact(strategy)
+
+    def drop(self):
+        pass  # logical drop = metadata removal; physical data is shared
+
+    # -- scan: physical rows -> virtual logical columns --
+    def scan(
+        self,
+        ts_range: Optional[tuple[int, int]] = None,
+        projection: Optional[Sequence[str]] = None,
+        tag_predicates: Optional[dict[str, set]] = None,
+    ) -> Optional[ScanData]:
+        phys = self.engine.region(self.meta.physical_region)
+        # push the table selector down; label predicates are mapped to
+        # label-set values that contain the wanted pair (dictionary-sized)
+        phys_preds: dict[str, set] = {TABLE_COL: {self.meta.name}}
+        scan = phys.scan(ts_range, None, phys_preds)
+        if scan is None:
+            return None
+        table_dict = scan.tag_dicts[TABLE_COL]
+        tcodes = np.where(np.asarray(table_dict).astype(str) == self.meta.name)[0]
+        if len(tcodes) == 0:
+            return None
+        mask = scan.columns[TABLE_COL] == tcodes[0]
+        if not mask.any():
+            return None
+        idx = np.nonzero(mask)[0]
+        labels_dict = np.asarray(scan.tag_dicts[LABELS_COL]).astype(str)
+        label_codes = scan.columns[LABELS_COL][idx]
+        # dictionary-sized parse: label-set value -> per-tag value
+        parsed = [decode_labels(v) for v in labels_dict]
+        columns: dict[str, np.ndarray] = {}
+        tag_dicts: dict[str, np.ndarray] = {}
+        names = projection or self.schema.names
+        # all tags always materialize (dedup needs the full primary key,
+        # Region._scan_columns invariant); each is one dictionary-sized
+        # parse + one numpy gather
+        for t in self.meta.tag_names:
+            per_set = np.asarray([p.get(t) for p in parsed], dtype=object)
+            present = np.asarray([v for v in per_set if v is not None], dtype=object)
+            uniq = np.unique(present.astype(str)) if len(present) else np.asarray([], dtype=object)
+            lookup = {v: i for i, v in enumerate(uniq)}
+            remap = np.asarray(
+                [(-1 if v is None else lookup[str(v)]) for v in per_set],
+                dtype=np.int32,
+            )
+            columns[t] = remap[label_codes]
+            tag_dicts[t] = uniq.astype(object)
+        columns[self.meta.ts_name] = scan.columns[TS_COL][idx]
+        if self.meta.value_name in names:
+            columns[self.meta.value_name] = scan.columns[VALUE_COL][idx]
+        # series key for dedup: the label-set code itself (denser and
+        # cheaper than re-combining the virtual tags)
+        return ScanData(
+            schema=self.schema,
+            columns=columns,
+            seq=scan.seq[idx],
+            op_type=scan.op_type[idx],
+            tag_dicts=tag_dicts,
+            num_rows=int(len(idx)),
+            needs_dedup=scan.needs_dedup,
+            region_id=self.region_id,
+            data_version=scan.data_version,
+            scan_fingerprint=("metric", self.meta.name, ts_range,
+                              tuple(names or ()), scan.scan_fingerprint),
+        )
+
+
+class _VirtualRegistry:
+    """Registry-shaped accessor for label values (HTTP label-values API)."""
+
+    def __init__(self, region: LogicalRegion):
+        self._region = region
+
+    @property
+    def values(self) -> dict[str, list[str]]:
+        scan = self._region.scan()
+        if scan is None:
+            return {t: [] for t in self._region.meta.tag_names}
+        return {t: list(v) for t, v in scan.tag_dicts.items()}
+
+
+def logical_schema(tag_names: list[str], ts_name: str = TS_COL,
+                   value_name: str = VALUE_COL) -> Schema:
+    cols = [ColumnSchema(t, DataType.STRING, SemanticType.TAG) for t in tag_names]
+    cols.append(ColumnSchema(ts_name, DataType.TIMESTAMP_MILLISECOND,
+                             SemanticType.TIMESTAMP, nullable=False))
+    cols.append(ColumnSchema(value_name, DataType.FLOAT64, SemanticType.FIELD))
+    return Schema(cols)
+
+
+class MetricEngine:
+    """Logical-table multiplexer over a RegionEngine (engine.rs:57-98)."""
+
+    def __init__(self, engine: RegionEngine, kv: KvBackend):
+        self.engine = engine
+        self.kv = kv
+        self.engine.register_opener(self._open_logical)
+
+    # physical region management: one data region per (db) group
+    def _physical_region_id(self, db: str) -> int:
+        key = f"{META_PREFIX}physical/{db}"
+        existing = self.kv.get(key)
+        if existing is not None:
+            return int(existing)
+        rid = (0x7FFF0000 << 32) | (self.kv.incr(META_PREFIX + "physical_seq") & 0xFFFFFFFF)
+        if not self.kv.compare_and_put(key, None, str(rid)):
+            return int(self.kv.get(key))
+        return rid
+
+    def create_logical_table(
+        self, db: str, name: str, tag_names: list[str],
+        ts_name: str = TS_COL, value_name: str = VALUE_COL,
+    ) -> LogicalTableMeta:
+        phys_rid = self._physical_region_id(db)
+        try:
+            self.engine.region(phys_rid)
+        except KeyError:
+            try:
+                self.engine.open_region(phys_rid)
+            except FileNotFoundError:
+                self.engine.create_region(phys_rid, physical_schema())
+        logical_rid = (0x7FFE0000 << 32) | (self.kv.incr(META_PREFIX + "logical_seq") & 0xFFFFFFFF)
+        meta = LogicalTableMeta(
+            name=name, tag_names=sorted(tag_names),
+            physical_region=phys_rid, logical_region=logical_rid,
+            ts_name=ts_name, value_name=value_name,
+        )
+        self.kv.put(f"{META_PREFIX}table/{db}/{name}", meta.to_json())
+        self.kv.put(f"{META_PREFIX}region/{logical_rid}", meta.to_json())
+        self.engine.regions[logical_rid] = LogicalRegion(meta, self.engine)
+        return meta
+
+    def drop_logical_table(self, db: str, name: str) -> None:
+        raw = self.kv.get(f"{META_PREFIX}table/{db}/{name}")
+        if raw is None:
+            return
+        meta = LogicalTableMeta.from_json(raw)
+        self.kv.delete(f"{META_PREFIX}table/{db}/{name}")
+        self.kv.delete(f"{META_PREFIX}region/{meta.logical_region}")
+        self.engine.regions.pop(meta.logical_region, None)
+
+    def list_logical_tables(self, db: str) -> list[str]:
+        prefix = f"{META_PREFIX}table/{db}/"
+        return [k[len(prefix):] for k, _ in self.kv.range(prefix)]
+
+    def _open_logical(self, region_id: int):
+        """Opener hook: rebuild a LogicalRegion from kv metadata when the
+        engine is asked to open a logical region id (e.g. after restart)."""
+        raw = self.kv.get(f"{META_PREFIX}region/{region_id}")
+        if raw is None:
+            return None
+        meta = LogicalTableMeta.from_json(raw)
+        try:
+            self.engine.region(meta.physical_region)
+        except KeyError:
+            self.engine.open_region(meta.physical_region)
+        return LogicalRegion(meta, self.engine)

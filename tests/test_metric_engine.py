@@ -1,0 +1,124 @@
+"""Metric engine tests: logical tables over one physical region
+(reference src/metric-engine engine.rs tests analog)."""
+
+import numpy as np
+import pytest
+
+from greptimedb_tpu.catalog.catalog import Catalog
+from greptimedb_tpu.catalog.kv import MemoryKv
+from greptimedb_tpu.query.engine import QueryContext, QueryEngine
+from greptimedb_tpu.storage.engine import EngineConfig, RegionEngine
+from greptimedb_tpu.storage.metric_engine import (
+    decode_labels,
+    encode_labels,
+)
+
+
+@pytest.fixture
+def qe(tmp_path):
+    engine = RegionEngine(EngineConfig(data_dir=str(tmp_path)))
+    q = QueryEngine(Catalog(MemoryKv()), engine)
+    yield q
+    engine.close()
+
+
+CREATE = (
+    "CREATE TABLE {name} (host STRING, job STRING, val DOUBLE, "
+    "ts TIMESTAMP TIME INDEX, PRIMARY KEY(host, job)) ENGINE=metric"
+)
+
+
+class TestLabelCodec:
+    def test_roundtrip(self):
+        labels = {"host": "a", "job": "api,web"}  # comma-safe
+        assert decode_labels(encode_labels(labels)) == labels
+
+    def test_canonical_order(self):
+        assert encode_labels({"b": "2", "a": "1"}) == encode_labels({"a": "1", "b": "2"})
+
+    def test_none_dropped(self):
+        assert decode_labels(encode_labels({"a": "1", "b": None})) == {"a": "1"}
+
+
+class TestMetricEngine:
+    def test_create_write_query(self, qe):
+        qe.execute_one(CREATE.format(name="m1"))
+        qe.execute_one(
+            "INSERT INTO m1 (host, job, val, ts) VALUES "
+            "('h1', 'api', 1.0, 1000), ('h2', 'api', 2.0, 1000), "
+            "('h1', 'api', 3.0, 2000)"
+        )
+        res = qe.execute_one("SELECT host, val FROM m1 ORDER BY host, ts")
+        assert res.rows() == [["h1", 1.0], ["h1", 3.0], ["h2", 2.0]]
+
+    def test_many_logical_tables_share_physical_region(self, qe):
+        for i in range(20):
+            qe.execute_one(CREATE.format(name=f"metric_{i}"))
+            qe.execute_one(
+                f"INSERT INTO metric_{i} (host, job, val, ts) VALUES "
+                f"('h{i}', 'j', {i}.0, 1000)"
+            )
+        # one physical region holds all rows
+        phys_regions = [
+            r for rid, r in qe.region_engine.regions.items()
+            if not hasattr(r, "meta") and (rid >> 32) == 0x7FFF0000
+        ]
+        assert len(phys_regions) == 1
+        # each logical table sees exactly its own rows
+        for i in (0, 7, 19):
+            res = qe.execute_one(f"SELECT host, val FROM metric_{i}")
+            assert res.rows() == [[f"h{i}", float(i)]]
+
+    def test_aggregation_on_logical_table(self, qe):
+        qe.execute_one(CREATE.format(name="cpu_usage"))
+        rows = []
+        for h in range(4):
+            for t in range(10):
+                rows.append(f"('h{h}', 'api', {h}.0, {1000 * (t + 1)})")
+        qe.execute_one(
+            "INSERT INTO cpu_usage (host, job, val, ts) VALUES " + ",".join(rows)
+        )
+        res = qe.execute_one(
+            "SELECT host, avg(val) FROM cpu_usage GROUP BY host ORDER BY host"
+        )
+        assert res.rows() == [["h0", 0.0], ["h1", 1.0], ["h2", 2.0], ["h3", 3.0]]
+
+    def test_lww_dedup_within_series(self, qe):
+        qe.execute_one(CREATE.format(name="m2"))
+        qe.execute_one("INSERT INTO m2 (host, job, val, ts) VALUES ('h', 'j', 1.0, 1000)")
+        qe.execute_one("INSERT INTO m2 (host, job, val, ts) VALUES ('h', 'j', 9.0, 1000)")
+        res = qe.execute_one("SELECT val FROM m2")
+        assert res.rows() == [[9.0]]
+
+    def test_flush_and_reopen(self, qe, tmp_path):
+        qe.execute_one(CREATE.format(name="m3"))
+        qe.execute_one("INSERT INTO m3 (host, job, val, ts) VALUES ('h', 'j', 5.0, 1000)")
+        info = qe.catalog.table("public", "m3")
+        region = qe.region_engine.region(info.region_ids[0])
+        region.flush()
+        # drop the open handle and re-open through the opener hook
+        qe.region_engine.regions.pop(info.region_ids[0])
+        qe._open_regions.discard(info.region_ids[0])
+        res = qe.execute_one("SELECT val FROM m3")
+        assert res.rows() == [[5.0]]
+
+    def test_drop_logical_keeps_others(self, qe):
+        qe.execute_one(CREATE.format(name="keep"))
+        qe.execute_one(CREATE.format(name="gone"))
+        qe.execute_one("INSERT INTO keep (host, job, val, ts) VALUES ('h', 'j', 1.0, 1)")
+        qe.execute_one("INSERT INTO gone (host, job, val, ts) VALUES ('h', 'j', 2.0, 1)")
+        qe.execute_one("DROP TABLE gone")
+        assert qe.metric_engine.list_logical_tables("public") == ["keep"]
+        res = qe.execute_one("SELECT val FROM keep")
+        assert res.rows() == [[1.0]]
+
+    def test_where_on_virtual_tags(self, qe):
+        qe.execute_one(CREATE.format(name="m4"))
+        qe.execute_one(
+            "INSERT INTO m4 (host, job, val, ts) VALUES "
+            "('a', 'x', 1.0, 1000), ('b', 'y', 2.0, 1000)"
+        )
+        res = qe.execute_one("SELECT val FROM m4 WHERE host = 'b'")
+        assert res.rows() == [[2.0]]
+        res = qe.execute_one("SELECT val FROM m4 WHERE job IN ('x')")
+        assert res.rows() == [[1.0]]
