@@ -1,0 +1,3 @@
+from .engine import FlowEngine, FlowInfo
+
+__all__ = ["FlowEngine", "FlowInfo"]

@@ -107,7 +107,31 @@ class QueryEngine:
             return self._admin(stmt, ctx)
         if isinstance(stmt, ast.Tql):
             return self._tql(stmt, ctx)
+        if isinstance(stmt, ast.CreateFlow):
+            self.flow_engine.create_flow(stmt, ctx)
+            return QueryResult.of_affected(0)
+        if isinstance(stmt, ast.DropFlow):
+            self.flow_engine.drop_flow(stmt.name, ctx.db, stmt.if_exists)
+            return QueryResult.of_affected(0)
+        if isinstance(stmt, ast.ShowFlows):
+            flows = self.flow_engine.list_flows(ctx.db)
+            return QueryResult(
+                ["Flows", "Sink", "Source", "Query"],
+                [DataType.STRING] * 4,
+                [np.asarray([f.name for f in flows], dtype=object),
+                 np.asarray([f.sink_table for f in flows], dtype=object),
+                 np.asarray([f.source_table for f in flows], dtype=object),
+                 np.asarray([f.sql for f in flows], dtype=object)],
+            )
         raise PlanError(f"unsupported statement {type(stmt).__name__}")
+
+    @property
+    def flow_engine(self):
+        if not hasattr(self, "_flow_engine"):
+            from greptimedb_tpu.flow import FlowEngine
+
+            self._flow_engine = FlowEngine(self)
+        return self._flow_engine
 
     # ---- table resolution --------------------------------------------------
 

@@ -178,6 +178,31 @@ class DropTable(Statement):
 
 
 @dataclass
+class CreateFlow(Statement):
+    """CREATE FLOW name SINK TO sink AS SELECT ... (reference src/sql
+    CREATE FLOW + src/flow continuous aggregation)."""
+
+    name: str
+    sink_table: str
+    query: "Select"
+    if_not_exists: bool = False
+    expire_after_s: Optional[int] = None
+    comment: str = ""
+    raw_query: str = ""  # original SELECT text, persisted with the flow
+
+
+@dataclass
+class DropFlow(Statement):
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
+class ShowFlows(Statement):
+    pass
+
+
+@dataclass
 class TruncateTable(Statement):
     name: str
 

@@ -241,6 +241,8 @@ class Parser:
         if self.eat_kw("database") or self.eat_kw("schema"):
             ine = self._if_not_exists()
             return ast.CreateDatabase(self.ident(), if_not_exists=ine)
+        if self.eat_kw("flow"):
+            return self._parse_create_flow()
         self.expect_kw("table")
         ine = self._if_not_exists()
         name = self.qualified_name()
@@ -398,13 +400,49 @@ class Parser:
 
     def parse_drop(self) -> ast.Statement:
         self.expect_kw("drop")
-        self.expect_kw("table")
+        is_flow = self.eat_kw("flow")
+        if not is_flow:
+            self.expect_kw("table")
         if_exists = False
         if self.at_kw("if"):
             self.next()
             self.expect_kw("exists")
             if_exists = True
-        return ast.DropTable(self.qualified_name(), if_exists)
+        name = self.qualified_name()
+        return ast.DropFlow(name, if_exists) if is_flow else ast.DropTable(name, if_exists)
+
+    def _parse_create_flow(self) -> ast.CreateFlow:
+        # CREATE FLOW [IF NOT EXISTS] name SINK TO sink
+        #   [EXPIRE AFTER <interval>] [COMMENT '...'] AS <select>
+        ine = self._if_not_exists()
+        name = self.qualified_name()
+        self.expect_kw("sink")
+        self.expect_kw("to")
+        sink = self.qualified_name()
+        expire = None
+        if self.peek().value == "expire":
+            self.next()
+            t = self.peek()
+            if t.value == "after":
+                self.next()
+            expr = self.parse_expr()
+            if isinstance(expr, ast.Interval):
+                expire = expr.nanos // 1_000_000_000
+            elif isinstance(expr, ast.Literal):
+                expire = int(expr.value)
+            else:
+                raise SqlError("EXPIRE AFTER expects an interval or seconds")
+        comment = ""
+        if self.peek().value == "comment":
+            self.next()
+            t = self.next()
+            comment = str(t.value)
+        self.expect_kw("as")
+        raw_query = self.sql[self.peek().pos:]
+        query = self.parse_select()
+        return ast.CreateFlow(name=name, sink_table=sink, query=query,
+                              if_not_exists=ine, expire_after_s=expire,
+                              comment=comment, raw_query=raw_query)
 
     # ---- SHOW / TQL / ALTER ------------------------------------------------
 
@@ -412,6 +450,8 @@ class Parser:
         self.expect_kw("show")
         if self.eat_kw("databases"):
             return ast.ShowDatabases()
+        if self.eat_kw("flows"):
+            return ast.ShowFlows()
         if self.eat_kw("create"):
             self.expect_kw("table")
             return ast.ShowCreateTable(self.qualified_name())
