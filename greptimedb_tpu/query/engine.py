@@ -10,7 +10,6 @@ layer.
 from __future__ import annotations
 
 import json
-from dataclasses import dataclass, field
 from typing import Optional, Union
 
 import numpy as np
@@ -30,19 +29,19 @@ from greptimedb_tpu.storage.engine import RegionEngine
 from greptimedb_tpu.utils.time import coerce_ts_literal
 
 
-@dataclass
-class QueryContext:
-    """Session context (mirrors reference src/session QueryContext)."""
-
-    db: str = DEFAULT_DB
-    timezone: str = "UTC"
+# session-owned context; re-exported here for the many call sites that
+# import it from the engine module
+from greptimedb_tpu.session import QueryContext  # noqa: E402
 
 
 class QueryEngine:
     def __init__(self, catalog: Catalog, region_engine: RegionEngine,
                  metric_engine=None):
+        from greptimedb_tpu.auth import PermissionChecker
+
         self.catalog = catalog
         self.region_engine = region_engine
+        self.permission_checker = PermissionChecker()
         self.executor = PhysicalExecutor(region_engine)
         self._open_regions: set[int] = set()
         if metric_engine is None and hasattr(region_engine, "register_opener"):
@@ -64,6 +63,9 @@ class QueryEngine:
         return results[-1]
 
     def execute_statement(self, stmt: ast.Statement, ctx: QueryContext) -> QueryResult:
+        # statement authorization (reference checks permissions in the
+        # frontend before dispatch, src/frontend/src/instance.rs:305-338)
+        self.permission_checker.check(ctx.user, stmt, ctx.db)
         if isinstance(stmt, ast.Select):
             return self._select(stmt, ctx)
         if isinstance(stmt, ast.CreateTable):

@@ -293,8 +293,6 @@ def _eval_device_func(e: ast.FuncCall, ev, cols, schema: Schema):
     if name in ("date_bin", "time_bucket"):
         # date_bin(interval, ts[, origin]) -> bucket START timestamp
         interval, ts_expr = e.args[0], e.args[1]
-        if not isinstance(interval, ast.Interval):
-            raise PlanError("date_bin needs an INTERVAL first argument")
         step = _interval_in_col_unit(interval, ts_expr, schema)
         ts = ev(ts_expr)
         origin = 0
@@ -343,8 +341,37 @@ def _col_unit_nanos(ts_expr: ast.Expr, schema: Schema) -> int:
     return 1  # already nanoseconds or plain int
 
 
-def _interval_in_col_unit(interval: ast.Interval, ts_expr: ast.Expr, schema: Schema) -> int:
-    return _scale_to_col_unit(interval.nanos, ts_expr, schema)
+def _interval_in_col_unit(interval, ts_expr: ast.Expr, schema: Schema) -> int:
+    return _scale_to_col_unit(_interval_nanos(interval), ts_expr, schema)
+
+
+def _interval_nanos(e) -> int:
+    """Interval AST node or a duration string literal ('1m', '1 minute')
+    → nanoseconds. date_bin/time_bucket accept both spellings."""
+    if isinstance(e, ast.Interval):
+        return e.nanos
+    if isinstance(e, ast.Literal) and isinstance(e.value, str):
+        from greptimedb_tpu.promql.parser import parse_duration_s
+        s = e.value.strip().lower()
+        verbose = {"second": "s", "seconds": "s", "minute": "m",
+                   "minutes": "m", "hour": "h", "hours": "h", "day": "d",
+                   "days": "d", "week": "w", "weeks": "w",
+                   "millisecond": "ms", "milliseconds": "ms"}
+        parts = s.split()
+        if len(parts) == 2 and parts[1] in verbose:
+            s = parts[0] + verbose[parts[1]]
+        try:
+            nanos = int(parse_duration_s(s) * 1e9)
+        except Exception as exc:  # noqa: BLE001 — planner boundary
+            raise PlanError(f"bad interval {e.value!r}") from exc
+        if nanos <= 0:
+            raise PlanError(f"interval must be positive, got {e.value!r}")
+        return nanos
+    if isinstance(e, ast.Literal) and isinstance(e.value, (int, float)):
+        if int(e.value) <= 0:
+            raise PlanError("interval must be positive")
+        return int(e.value)
+    raise PlanError("expected interval")
 
 
 def _scale_to_col_unit(nanos: int, ts_expr: ast.Expr, schema: Schema) -> int:
@@ -495,9 +522,7 @@ def _eval_host_func(e: ast.FuncCall, ev, schema):
 
 
 def _lit_interval(e):
-    if isinstance(e, ast.Interval):
-        return e.nanos
-    raise PlanError("expected interval")
+    return _interval_nanos(e)
 
 
 def _np_bool(v):

@@ -27,10 +27,11 @@ from greptimedb_tpu.utils.time import unit_to_ns
 
 class HttpServer:
     def __init__(self, query_engine: QueryEngine, host: str = "127.0.0.1",
-                 port: int = 4000):
+                 port: int = 4000, user_provider=None):
         self.qe = query_engine
         self.host = host
         self.port = port
+        self.user_provider = user_provider
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -42,8 +43,11 @@ class HttpServer:
 
         qe = self.qe
 
+        provider = self.user_provider
+
         class Handler(_Handler):
             query_engine = qe
+            user_provider = provider
 
         self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
         self.port = self._httpd.server_address[1]
@@ -61,6 +65,7 @@ class HttpServer:
 
 class _Handler(BaseHTTPRequestHandler):
     query_engine: QueryEngine = None  # injected
+    user_provider = None  # injected
     protocol_version = "HTTP/1.1"
 
     def log_message(self, fmt, *args):  # quiet
@@ -102,6 +107,13 @@ class _Handler(BaseHTTPRequestHandler):
         route = urllib.parse.urlparse(self.path).path
         HTTP_REQUESTS.inc(path=route, status=str(code))
 
+
+    def _ctx(self, params: dict) -> QueryContext:
+        from greptimedb_tpu.session import Channel
+        return QueryContext(db=params.get("db", "public"),
+                            channel=Channel.HTTP,
+                            user=getattr(self, "_user", None))
+
     # ---- routing -----------------------------------------------------------
 
     def do_GET(self):
@@ -118,6 +130,25 @@ class _Handler(BaseHTTPRequestHandler):
             if path == "/metrics":
                 return self._send(200, REGISTRY.render().encode(),
                                   "text/plain; version=0.0.4")
+            if self.user_provider is not None:
+                # Basic auth on every data route (reference
+                # servers/src/http/authorize.rs; /health and /metrics
+                # stay open)
+                from greptimedb_tpu.auth import AuthError
+                try:
+                    self._user = self.user_provider.authenticate_basic(
+                        self.headers.get("Authorization") or "")
+                except AuthError as e:
+                    data = json.dumps({"code": 7002, "error": str(e)}).encode()
+                    self.send_response(401)
+                    self.send_header("WWW-Authenticate",
+                                     'Basic realm="greptimedb"')
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                    HTTP_REQUESTS.inc(path=path, status="401")
+                    return
             if path == "/v1/sql":
                 return self._handle_sql()
             if path == "/v1/promql":
@@ -159,7 +190,7 @@ class _Handler(BaseHTTPRequestHandler):
         sql = params.get("sql")
         if not sql:
             return self._send(400, {"code": 1004, "error": "missing sql"})
-        ctx = QueryContext(db=params.get("db", "public"))
+        ctx = self._ctx(params)
         t0 = time.perf_counter()
         with QUERY_DURATION.time(kind="sql"):
             results = self.query_engine.execute_sql(sql, ctx)
@@ -188,7 +219,7 @@ class _Handler(BaseHTTPRequestHandler):
             step = _prom_duration(params.get("step", "60"))
         except (KeyError, ValueError) as e:
             return self._send(400, _prom_err(f"bad range params: {e}"))
-        ctx = QueryContext(db=params.get("db", "public"))
+        ctx = self._ctx(params)
         engine = PromqlEngine(self.query_engine)
         with QUERY_DURATION.time(kind="promql_range"):
             times, result = engine.eval_matrix(query, start, end, step, ctx)
@@ -210,7 +241,7 @@ class _Handler(BaseHTTPRequestHandler):
         if not query:
             return self._send(400, _prom_err("missing query"))
         t = _prom_time(params.get("time", str(time.time())))
-        ctx = QueryContext(db=params.get("db", "public"))
+        ctx = self._ctx(params)
         engine = PromqlEngine(self.query_engine)
         with QUERY_DURATION.time(kind="promql_instant"):
             times, result = engine.eval_matrix(query, t, t, 1.0, ctx)
@@ -233,7 +264,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _handle_labels(self):
         params = self._form_or_query()
-        ctx = QueryContext(db=params.get("db", "public"))
+        ctx = self._ctx(params)
         qe = self.query_engine
         labels = {"__name__"}
         matches = _match_params(self)
@@ -248,7 +279,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _handle_label_values(self, label: str):
         params = self._form_or_query()
-        ctx = QueryContext(db=params.get("db", "public"))
+        ctx = self._ctx(params)
         qe = self.query_engine
         if label == "__name__":
             return self._send(200, {"status": "success",
@@ -275,7 +306,7 @@ class _Handler(BaseHTTPRequestHandler):
             return self._send(400, _prom_err("match[] required"))
         start = _prom_time(params.get("start", "0"))
         end = _prom_time(params.get("end", str(time.time())))
-        ctx = QueryContext(db=params.get("db", "public"))
+        ctx = self._ctx(params)
         engine = PromqlEngine(self.query_engine)
         from greptimedb_tpu.promql.parser import parse_promql, VectorSelector
         out = []

@@ -110,7 +110,8 @@ class _Session(socketserver.BaseRequestHandler):
         io = _PacketIO(self.request)
         server: MysqlServer = self.server.owner  # type: ignore[attr-defined]
         # ---- handshake v10 ----
-        salt = b"12345678" + b"901234567890"  # 20 bytes of nonce
+        import secrets
+        salt = bytes(secrets.choice(range(0x21, 0x7F)) for _ in range(20))
         hs = (
             b"\x0a"  # protocol version 10
             + b"greptimedb-tpu-8.0\x00"
@@ -137,6 +138,7 @@ class _Session(socketserver.BaseRequestHandler):
             return
         db = "public"
         user = ""
+        auth_resp = b""
         try:
             caps = struct.unpack("<I", resp[:4])[0]
             pos = 32
@@ -146,17 +148,29 @@ class _Session(socketserver.BaseRequestHandler):
             # auth response (lenenc when CLIENT_SECURE_CONNECTION)
             if pos < len(resp):
                 alen = resp[pos]
+                auth_resp = resp[pos + 1:pos + 1 + alen]
                 pos += 1 + alen
             if caps & CLIENT_CONNECT_WITH_DB and pos < len(resp):
                 end = resp.index(b"\x00", pos)
                 db = resp[pos:end].decode() or "public"
         except (ValueError, IndexError):
             pass
-        if server.user_provider is not None and not server.user_provider.allow(user):
-            io.send_packet(_err(1045, "28000", f"Access denied for user {user!r}"))
-            return
+        user_info = None
+        if server.user_provider is not None:
+            from greptimedb_tpu.auth import AuthError
+            try:
+                if hasattr(server.user_provider, "authenticate_mysql"):
+                    user_info = server.user_provider.authenticate_mysql(
+                        user, auth_resp, salt)
+                elif not server.user_provider.allow(user):
+                    raise AuthError(f"access denied for user {user!r}")
+            except AuthError:
+                io.send_packet(
+                    _err(1045, "28000", f"Access denied for user {user!r}"))
+                return
         io.send_packet(_ok())
-        ctx = QueryContext(db=db)
+        from greptimedb_tpu.session import Channel
+        ctx = QueryContext(db=db, channel=Channel.MYSQL, user=user_info)
         # ---- command loop ----
         while True:
             io.reset_seq()
@@ -170,7 +184,7 @@ class _Session(socketserver.BaseRequestHandler):
                 io.send_packet(_ok())
                 continue
             if cmd == COM_INIT_DB:
-                ctx = QueryContext(db=body.decode() or "public")
+                ctx = ctx.with_db(body.decode() or "public")
                 io.send_packet(_ok())
                 continue
             if cmd == COM_STMT_PREPARE:

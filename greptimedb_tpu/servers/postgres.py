@@ -86,7 +86,9 @@ class _Session(socketserver.BaseRequestHandler):
         if params is None:
             return
         db = params.get("database", "public") or "public"
-        ctx = QueryContext(db=db)
+        from greptimedb_tpu.session import Channel
+        ctx = QueryContext(db=db, channel=Channel.POSTGRES,
+                           user=params.get("_user_info"))
         engine = server.query_engine
         # prepared statements / portals for the extended protocol
         stmts: dict[str, str] = {}
@@ -162,9 +164,27 @@ class _Session(socketserver.BaseRequestHandler):
                 if k:
                     params[k.decode()] = v.decode()
             user = params.get("user", "")
-            if server.user_provider is not None and not server.user_provider.allow(user):
-                self._error(conn, f"password authentication failed for user {user!r}")
-                return None
+            if server.user_provider is not None:
+                # AuthenticationCleartextPassword (reference pgwire
+                # startup handler, servers/src/postgres/handler.rs)
+                conn.send(b"R", struct.pack("!I", 3))
+                pwd = self._read_password(conn)
+                from greptimedb_tpu.auth import AuthError
+                try:
+                    if pwd is None:
+                        # client sent something other than PasswordMessage
+                        # (or hung up) — fail closed, don't try ''
+                        raise AuthError("no password message")
+                    if hasattr(server.user_provider, "authenticate"):
+                        params["_user_info"] = server.user_provider.authenticate(
+                            user, pwd)
+                    elif not server.user_provider.allow(user):
+                        raise AuthError(user)
+                except AuthError:
+                    self._error(
+                        conn,
+                        f"password authentication failed for user {user!r}")
+                    return None
             conn.send(b"R", struct.pack("!I", 0))  # AuthenticationOk
             for k, v in (
                 ("server_version", "16.0 (greptimedb-tpu)"),
@@ -178,6 +198,20 @@ class _Session(socketserver.BaseRequestHandler):
             conn.send(b"K", struct.pack("!II", threading.get_ident() & 0x7FFFFFFF, 0))
             self._ready(conn)
             return params
+
+    def _read_password(self, conn: _Conn) -> Optional[str]:
+        """Read a PasswordMessage ('p') from the client."""
+        tag = conn.read_exact(1)
+        if tag != b"p":
+            return None
+        raw = conn.read_exact(4)
+        if raw is None:
+            return None
+        (length,) = struct.unpack("!I", raw)
+        body = conn.read_exact(length - 4)
+        if body is None:
+            return None
+        return body.rstrip(b"\x00").decode()
 
     def _ready(self, conn: _Conn) -> None:
         conn.send(b"Z", b"I")

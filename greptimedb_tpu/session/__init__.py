@@ -1,0 +1,59 @@
+"""Session state (mirrors reference `src/session`: `QueryContext` with
+catalog/schema/timezone/channel, src/session/src/context.rs:39).
+
+`QueryContext` travels with every statement from the wire protocol down
+through the query engine; servers stamp the channel and authenticated
+user, `USE <db>` mutates the current schema, and the timezone feeds
+timestamp rendering/coercion.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Optional
+
+from greptimedb_tpu.catalog.catalog import DEFAULT_DB
+
+__all__ = ["Channel", "QueryContext", "DEFAULT_DB"]
+
+
+class Channel(enum.Enum):
+    """Wire protocol a request arrived on (reference
+    src/session/src/context.rs Channel enum)."""
+
+    UNKNOWN = "unknown"
+    HTTP = "http"
+    GRPC = "grpc"
+    MYSQL = "mysql"
+    POSTGRES = "postgres"
+    INFLUX = "influx"
+    OPENTSDB = "opentsdb"
+    PROMETHEUS = "prometheus"
+    OTLP = "otlp"
+    FLOW = "flow"
+
+
+@dataclass
+class QueryContext:
+    """Per-request session context (reference QueryContext,
+    src/session/src/context.rs:39 — catalog/schema/timezone/channel,
+    plus the authenticated user)."""
+
+    db: str = DEFAULT_DB
+    timezone: str = "UTC"
+    channel: Channel = Channel.UNKNOWN
+    user: Optional[object] = None  # auth.UserInfo when authenticated
+    # W3C trace context for cross-process propagation (SURVEY §5)
+    trace_id: Optional[str] = None
+    extensions: dict = field(default_factory=dict)
+
+    @property
+    def current_schema(self) -> str:
+        return self.db
+
+    def with_db(self, db: str) -> "QueryContext":
+        return QueryContext(db=db, timezone=self.timezone,
+                            channel=self.channel, user=self.user,
+                            trace_id=self.trace_id,
+                            extensions=self.extensions)

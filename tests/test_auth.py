@@ -1,0 +1,270 @@
+"""Auth + session tests: provider parsing, per-protocol credential
+verification (HTTP Basic, MySQL native-password scramble, Postgres
+cleartext), and the coarse permission checker."""
+
+import base64
+import json
+import socket
+import struct
+import urllib.request
+
+import pytest
+
+from greptimedb_tpu.auth import (
+    AuthError,
+    PermissionChecker,
+    StaticUserProvider,
+    UserInfo,
+    mysql_native_scramble,
+    user_provider_from_option,
+)
+from greptimedb_tpu.catalog.catalog import Catalog
+from greptimedb_tpu.catalog.kv import MemoryKv
+from greptimedb_tpu.query.engine import QueryEngine
+from greptimedb_tpu.session import Channel, QueryContext
+from greptimedb_tpu.storage.engine import EngineConfig, RegionEngine
+
+
+@pytest.fixture
+def qe(tmp_path):
+    engine = RegionEngine(EngineConfig(data_dir=str(tmp_path)))
+    q = QueryEngine(Catalog(MemoryKv()), engine)
+    q.execute_one(
+        "CREATE TABLE cpu (host STRING, usage DOUBLE, ts TIMESTAMP TIME INDEX, "
+        "PRIMARY KEY(host))"
+    )
+    yield q
+    engine.close()
+
+
+PROVIDER = StaticUserProvider({"alice": "s3cret", "bob": ""})
+
+
+class TestProvider:
+    def test_authenticate(self):
+        assert PROVIDER.authenticate("alice", "s3cret").username == "alice"
+        with pytest.raises(AuthError):
+            PROVIDER.authenticate("alice", "wrong")
+        with pytest.raises(AuthError):
+            PROVIDER.authenticate("nobody", "x")
+
+    def test_from_option_cmd(self):
+        p = user_provider_from_option("static_user_provider:cmd:u1=p1,u2=p2")
+        assert p.authenticate("u2", "p2").username == "u2"
+
+    def test_from_option_file(self, tmp_path):
+        f = tmp_path / "users"
+        f.write_text("# users\nalice = pw1\nbob=pw2\n")
+        p = user_provider_from_option(f"static_user_provider:file:{f}")
+        assert p.authenticate("alice", "pw1").username == "alice"
+        assert p.authenticate("bob", "pw2").username == "bob"
+
+    def test_bad_option(self):
+        with pytest.raises(AuthError):
+            user_provider_from_option("ldap:whatever")
+
+    def test_basic_auth(self):
+        hdr = "Basic " + base64.b64encode(b"alice:s3cret").decode()
+        assert PROVIDER.authenticate_basic(hdr).username == "alice"
+        with pytest.raises(AuthError):
+            PROVIDER.authenticate_basic("Bearer token")
+        with pytest.raises(AuthError):
+            PROVIDER.authenticate_basic(
+                "Basic " + base64.b64encode(b"alice:nope").decode())
+
+    def test_mysql_scramble(self):
+        salt = bytes(range(1, 21))
+        resp = mysql_native_scramble("s3cret", salt)
+        assert PROVIDER.authenticate_mysql("alice", resp, salt).username == "alice"
+        with pytest.raises(AuthError):
+            PROVIDER.authenticate_mysql("alice", b"\x00" * 20, salt)
+
+    def test_mysql_empty_password(self):
+        # empty stored password ⇒ zero-length client auth response
+        salt = bytes(range(1, 21))
+        assert PROVIDER.authenticate_mysql("bob", b"", salt).username == "bob"
+        with pytest.raises(AuthError):
+            PROVIDER.authenticate_mysql("bob", b"x" * 20, salt)
+
+
+class TestPermission:
+    def test_grants(self, qe):
+        from greptimedb_tpu.sql import parse_sql
+
+        checker = PermissionChecker()
+        reader = UserInfo("r", grants=frozenset({"read"}))
+        select = parse_sql("SELECT * FROM cpu")[0]
+        insert = parse_sql("INSERT INTO cpu (host, usage, ts) VALUES ('a',1,1)")[0]
+        checker.check(reader, select, "public")
+        with pytest.raises(AuthError):
+            checker.check(reader, insert, "public")
+        checker.check(UserInfo("w"), insert, "public")  # no grants = all
+
+    def test_protected_schema(self):
+        checker = PermissionChecker()
+        with pytest.raises(AuthError):
+            checker.check(UserInfo("alice"), object(), "greptime_private")
+
+    def test_enforced_in_engine(self, qe):
+        """The engine itself rejects writes from read-only users
+        (regression: the checker must actually be wired into dispatch)."""
+        ctx = QueryContext(user=UserInfo("r", grants=frozenset({"read"})))
+        qe.execute_one("SELECT * FROM cpu", ctx)
+        with pytest.raises(AuthError):
+            qe.execute_one(
+                "INSERT INTO cpu (host, usage, ts) VALUES ('x',1,1)", ctx)
+
+    def test_string_interval_device_path(self, qe):
+        """date_bin with a string interval works through the full
+        aggregate (device) path, and bad intervals fail as PlanError."""
+        from greptimedb_tpu.query.expr import PlanError
+
+        qe.execute_one(
+            "INSERT INTO cpu (host, usage, ts) VALUES ('a',1,1000),('a',3,61000)")
+        r = qe.execute_one(
+            "SELECT host, date_bin('1 minute', ts) AS m, avg(usage) "
+            "FROM cpu GROUP BY host, m ORDER BY m")
+        assert r.rows() == [["a", 0, 1.0], ["a", 60000, 3.0]]
+        with pytest.raises(PlanError):
+            qe.execute_one(
+                "SELECT date_bin('bogus', ts), avg(usage) FROM cpu GROUP BY 1")
+
+
+class TestQueryContext:
+    def test_channel_and_user(self):
+        ctx = QueryContext(db="d", channel=Channel.MYSQL,
+                           user=UserInfo("alice"))
+        assert ctx.current_schema == "d"
+        assert ctx.with_db("e").channel is Channel.MYSQL
+
+
+class TestHttpAuth:
+    @pytest.fixture
+    def server(self, qe):
+        from greptimedb_tpu.servers.http import HttpServer
+
+        srv = HttpServer(qe, port=0, user_provider=PROVIDER)
+        srv.start()
+        yield srv
+        srv.stop()
+
+    def _get(self, port, path, auth=None):
+        req = urllib.request.Request(f"http://127.0.0.1:{port}{path}")
+        if auth:
+            req.add_header(
+                "Authorization",
+                "Basic " + base64.b64encode(auth.encode()).decode())
+        try:
+            with urllib.request.urlopen(req, timeout=10) as r:
+                return r.status, json.loads(r.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read() or b"{}")
+
+    def test_health_open(self, server):
+        status, _ = self._get(server.port, "/health")
+        assert status == 200
+
+    def test_sql_requires_auth(self, server):
+        status, body = self._get(server.port, "/v1/sql?sql=SELECT%201")
+        assert status == 401
+        status, body = self._get(server.port, "/v1/sql?sql=SELECT%201",
+                                 auth="alice:wrong")
+        assert status == 401
+        status, body = self._get(server.port, "/v1/sql?sql=SELECT%201",
+                                 auth="alice:s3cret")
+        assert status == 200
+        assert body["output"]
+
+
+class TestMysqlAuth:
+    def _connect(self, port, user, password):
+        sock = socket.create_connection(("127.0.0.1", port), timeout=10)
+        buf = b""
+        header = self._read(sock, 4)
+        n = header[0] | (header[1] << 8) | (header[2] << 16)
+        greeting = self._read(sock, n)
+        assert greeting[0] == 0x0A
+        # server version is NUL-terminated after the protocol byte
+        ver_end = greeting.index(b"\x00", 1)
+        pos = ver_end + 1 + 4  # thread id
+        salt1 = greeting[pos:pos + 8]
+        pos += 8 + 1  # filler
+        pos += 2 + 1 + 2 + 2 + 1 + 10  # caps lo, charset, status, caps hi, len, reserved
+        salt2 = greeting[pos:pos + 12]
+        salt = salt1 + salt2
+        scramble = mysql_native_scramble(password, salt) if password else b""
+        caps = 0x0200 | 0x8000  # protocol 41 | secure connection
+        resp = (struct.pack("<I", caps) + struct.pack("<I", 1 << 24)
+                + bytes([0x21]) + b"\x00" * 23
+                + user.encode() + b"\x00"
+                + bytes([len(scramble)]) + scramble)
+        sock.sendall(struct.pack("<I", len(resp))[:3] + bytes([header[3] + 1]) + resp)
+        header = self._read(sock, 4)
+        n = header[0] | (header[1] << 8) | (header[2] << 16)
+        pkt = self._read(sock, n)
+        sock.close()
+        return pkt[0]
+
+    def _read(self, sock, n):
+        buf = b""
+        while len(buf) < n:
+            c = sock.recv(n - len(buf))
+            assert c, "closed"
+            buf += c
+        return buf
+
+    def test_scramble_auth(self, qe):
+        from greptimedb_tpu.servers.mysql import MysqlServer
+
+        srv = MysqlServer(qe, port=0, user_provider=PROVIDER)
+        srv.start()
+        try:
+            assert self._connect(srv.port, "alice", "s3cret") == 0x00  # OK
+            assert self._connect(srv.port, "alice", "wrong") == 0xFF  # ERR
+            assert self._connect(srv.port, "nobody", "x") == 0xFF
+        finally:
+            srv.shutdown()
+
+
+class TestPostgresAuth:
+    def test_cleartext_auth(self, qe):
+        from greptimedb_tpu.servers.postgres import PostgresServer
+
+        srv = PostgresServer(qe, port=0, user_provider=PROVIDER)
+        srv.start()
+        try:
+            assert self._login(srv.port, "alice", "s3cret")
+            assert not self._login(srv.port, "alice", "wrong")
+        finally:
+            srv.shutdown()
+
+    def _login(self, port, user, password) -> bool:
+        sock = socket.create_connection(("127.0.0.1", port), timeout=10)
+        body = struct.pack("!I", 196608)
+        body += b"user\x00" + user.encode() + b"\x00"
+        body += b"database\x00public\x00\x00"
+        sock.sendall(struct.pack("!I", len(body) + 4) + body)
+        # expect AuthenticationCleartextPassword
+        tag = sock.recv(1)
+        assert tag == b"R"
+        (length,) = struct.unpack("!I", self._read(sock, 4))
+        (code,) = struct.unpack("!I", self._read(sock, length - 4))
+        assert code == 3
+        pwd = password.encode() + b"\x00"
+        sock.sendall(b"p" + struct.pack("!I", len(pwd) + 4) + pwd)
+        tag = sock.recv(1)
+        ok = False
+        if tag == b"R":
+            (length,) = struct.unpack("!I", self._read(sock, 4))
+            (code,) = struct.unpack("!I", self._read(sock, length - 4))
+            ok = code == 0
+        sock.close()
+        return ok
+
+    def _read(self, sock, n):
+        buf = b""
+        while len(buf) < n:
+            c = sock.recv(n - len(buf))
+            assert c, "closed"
+            buf += c
+        return buf
