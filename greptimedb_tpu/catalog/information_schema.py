@@ -1,0 +1,354 @@
+"""`information_schema` virtual tables (mirrors reference
+src/catalog/src/information_schema/*.rs: tables, columns, schemata,
+partitions, region_peers, cluster_info, runtime_metrics, engines, flows).
+
+Virtual tables materialize from catalog/engine state at query time as
+host-side column dicts; a small host evaluator applies WHERE / projection
+/ ORDER BY / LIMIT (these tables are tiny — no device round-trip).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import numpy as np
+
+from greptimedb_tpu.datatypes.types import DataType
+from greptimedb_tpu.query.result import QueryResult
+from greptimedb_tpu.sql import ast
+
+INFORMATION_SCHEMA = "information_schema"
+
+_START_TIME = time.time()
+
+#: virtual table name -> builder(qe, ctx) -> dict[col -> list]
+_TABLES = {}
+
+
+def _virtual(name):
+    def deco(fn):
+        _TABLES[name] = fn
+        return fn
+    return deco
+
+
+def is_information_schema_query(table: str, db: str) -> bool:
+    if table is None:
+        return False
+    t = table.lower()
+    return t.startswith(INFORMATION_SCHEMA + ".") or (
+        db.lower() == INFORMATION_SCHEMA and t.split(".")[0] in _TABLES
+    )
+
+
+def table_names() -> list[str]:
+    return sorted(_TABLES)
+
+
+# ---- builders ---------------------------------------------------------------
+
+
+@_virtual("schemata")
+def _schemata(qe, ctx):
+    dbs = qe.catalog.list_databases()
+    return {
+        "catalog_name": ["greptime"] * (len(dbs) + 1),
+        "schema_name": list(dbs) + [INFORMATION_SCHEMA],
+    }
+
+
+@_virtual("tables")
+def _tables(qe, ctx):
+    cols = {k: [] for k in ("table_catalog", "table_schema", "table_name",
+                            "table_type", "table_id", "engine")}
+    for db in qe.catalog.list_databases():
+        for name in qe.catalog.list_tables(db):
+            info = qe.catalog.table(db, name)
+            cols["table_catalog"].append("greptime")
+            cols["table_schema"].append(db)
+            cols["table_name"].append(name)
+            cols["table_type"].append("BASE TABLE")
+            cols["table_id"].append(info.table_id)
+            cols["engine"].append(info.options.get("engine", "mito"))
+    for vt in table_names():
+        cols["table_catalog"].append("greptime")
+        cols["table_schema"].append(INFORMATION_SCHEMA)
+        cols["table_name"].append(vt)
+        cols["table_type"].append("LOCAL TEMPORARY")
+        cols["table_id"].append(0)
+        cols["engine"].append("virtual")
+    return cols
+
+
+@_virtual("columns")
+def _columns(qe, ctx):
+    cols = {k: [] for k in (
+        "table_catalog", "table_schema", "table_name", "column_name",
+        "ordinal_position", "data_type", "semantic_type", "is_nullable",
+        "column_default")}
+    for db in qe.catalog.list_databases():
+        for name in qe.catalog.list_tables(db):
+            info = qe.catalog.table(db, name)
+            for i, c in enumerate(info.schema.columns):
+                cols["table_catalog"].append("greptime")
+                cols["table_schema"].append(db)
+                cols["table_name"].append(name)
+                cols["column_name"].append(c.name)
+                cols["ordinal_position"].append(i + 1)
+                cols["data_type"].append(c.dtype.value)
+                cols["semantic_type"].append(c.semantic.value.upper())
+                cols["is_nullable"].append("Yes" if c.nullable else "No")
+                cols["column_default"].append(
+                    "" if c.default is None else str(c.default))
+    return cols
+
+
+@_virtual("partitions")
+def _partitions(qe, ctx):
+    cols = {k: [] for k in ("table_catalog", "table_schema", "table_name",
+                            "partition_name", "partition_expression",
+                            "greptime_partition_id")}
+    for db in qe.catalog.list_databases():
+        for name in qe.catalog.list_tables(db):
+            info = qe.catalog.table(db, name)
+            exprs = [None] * len(info.region_ids)
+            if info.partition_rules:
+                rules = info.partition_rules
+                if isinstance(rules, dict):
+                    bounds = rules.get("bounds") or []
+                    exprs = [str(b) for b in bounds] + [None]
+                    exprs = exprs[:len(info.region_ids)] or [None]
+            for i, rid in enumerate(info.region_ids):
+                cols["table_catalog"].append("greptime")
+                cols["table_schema"].append(db)
+                cols["table_name"].append(name)
+                cols["partition_name"].append(f"p{i}")
+                cols["partition_expression"].append(
+                    exprs[i] if i < len(exprs) else None)
+                cols["greptime_partition_id"].append(rid)
+    return cols
+
+
+@_virtual("region_peers")
+def _region_peers(qe, ctx):
+    cols = {k: [] for k in ("region_id", "peer_id", "peer_addr",
+                            "is_leader", "status")}
+    cluster = getattr(qe, "cluster", None)
+    route = {}
+    if cluster is not None and hasattr(cluster, "region_routes"):
+        route = cluster.region_routes()
+    for db in qe.catalog.list_databases():
+        for name in qe.catalog.list_tables(db):
+            info = qe.catalog.table(db, name)
+            for rid in info.region_ids:
+                peer = route.get(rid, 0)
+                cols["region_id"].append(rid)
+                cols["peer_id"].append(peer)
+                cols["peer_addr"].append(f"datanode-{peer}")
+                cols["is_leader"].append("Yes")
+                cols["status"].append("ALIVE")
+    return cols
+
+
+@_virtual("cluster_info")
+def _cluster_info(qe, ctx):
+    from greptimedb_tpu import __version__
+
+    cols = {k: [] for k in ("peer_id", "peer_type", "peer_addr", "version",
+                            "start_time", "uptime")}
+    cluster = getattr(qe, "cluster", None)
+    peers = []
+    if cluster is not None and hasattr(cluster, "datanode_ids"):
+        peers = [(pid, "DATANODE") for pid in cluster.datanode_ids()]
+        peers += [(0, "METASRV")]
+    peers.append((0, "STANDALONE") if not peers else (0, "FRONTEND"))
+    uptime = time.time() - _START_TIME
+    for pid, ptype in peers:
+        cols["peer_id"].append(pid)
+        cols["peer_type"].append(ptype)
+        cols["peer_addr"].append("127.0.0.1")
+        cols["version"].append(__version__)
+        cols["start_time"].append(int(_START_TIME * 1000))
+        cols["uptime"].append(f"{uptime:.0f}s")
+    return cols
+
+
+@_virtual("runtime_metrics")
+def _runtime_metrics(qe, ctx):
+    from greptimedb_tpu.utils.metrics import REGISTRY
+
+    cols = {"metric_name": [], "value": [], "labels": [],
+            "timestamp": []}
+    now = int(time.time() * 1000)
+    for name, value, labels in REGISTRY.samples():
+        cols["metric_name"].append(name)
+        cols["value"].append(float(value))
+        cols["labels"].append(labels)
+        cols["timestamp"].append(now)
+    return cols
+
+
+@_virtual("engines")
+def _engines(qe, ctx):
+    names = ["mito", "metric", "file"]
+    return {
+        "engine": names,
+        "support": ["DEFAULT"] + ["YES"] * (len(names) - 1),
+        "comment": [
+            "TPU-native LSM time-series engine",
+            "logical tables multiplexed over one physical region",
+            "external files as read-only tables",
+        ],
+    }
+
+
+@_virtual("flows")
+def _flows(qe, ctx):
+    cols = {"flow_name": [], "table_catalog": [], "flow_schema": [],
+            "source_table": [], "sink_table": [], "raw_sql": []}
+    for db in qe.catalog.list_databases():
+        for f in qe.flow_engine.list_flows(db):
+            cols["flow_name"].append(f.name)
+            cols["table_catalog"].append("greptime")
+            cols["flow_schema"].append(db)
+            cols["source_table"].append(f.source_table)
+            cols["sink_table"].append(f.sink_table)
+            cols["raw_sql"].append(f.sql)
+    return cols
+
+
+# ---- host-side mini executor ------------------------------------------------
+
+
+def execute_virtual_select(qe, sel: ast.Select, ctx) -> QueryResult:
+    """SELECT over an information_schema table: materialize, then apply
+    WHERE / projection / ORDER BY / LIMIT on host."""
+    from greptimedb_tpu.query.expr import PlanError
+
+    t = sel.table.lower()
+    name = t.split(".", 1)[1] if t.startswith(INFORMATION_SCHEMA + ".") \
+        else t.split(".")[0]
+    builder = _TABLES.get(name)
+    if builder is None:
+        raise PlanError(f"information_schema table {name!r} not found")
+    if sel.group_by or sel.having is not None or sel.distinct:
+        raise PlanError(
+            "GROUP BY/HAVING/DISTINCT not supported on information_schema")
+    data = {k: np.asarray(v, dtype=object) for k, v in builder(qe, ctx).items()}
+    n = len(next(iter(data.values()))) if data else 0
+
+    def ev(expr):
+        return _eval(expr, data, n)
+
+    mask = np.ones(n, dtype=bool)
+    if sel.where is not None:
+        mask = np.asarray(ev(sel.where), dtype=bool)
+    idx = np.nonzero(mask)[0]
+
+    # projection
+    star = any(isinstance(it.expr, ast.Star) for it in sel.items)
+    is_count = [isinstance(it.expr, ast.FuncCall)
+                and it.expr.name.lower() == "count" for it in sel.items]
+    if star:
+        names = list(data)
+        out_cols = [data[c][idx] for c in names]
+    elif any(is_count):
+        # aggregate shape: only count(*) items allowed (no GROUP BY here)
+        if not all(is_count):
+            raise PlanError(
+                "cannot mix count(*) with plain columns on "
+                "information_schema without GROUP BY")
+        names = [it.alias or "count(*)" for it in sel.items]
+        out_cols = [np.asarray([len(idx)], dtype=object) for _ in sel.items]
+    else:
+        names, out_cols = [], []
+        for i, it in enumerate(sel.items):
+            vals = np.asarray(ev(it.expr), dtype=object)
+            if vals.ndim == 0:
+                vals = np.full(n, vals[()], dtype=object)
+            names.append(it.alias or _expr_name(it.expr, i))
+            out_cols.append(vals[idx])
+
+    # ORDER BY over projected or source columns; multi-key sort applies
+    # keys last-to-first with a stable argsort. DESC negates factorized
+    # codes (reversing a stable sort would also reverse equal-key runs
+    # and destroy the ordering of later keys).
+    if sel.order_by:
+        perm = np.arange(len(out_cols[0]) if out_cols else 0)
+        for ob in reversed(sel.order_by):
+            col = _order_col(ob, names, out_cols, data, idx)
+            codes = np.unique(col, return_inverse=True)[1]
+            asc = ob.asc if hasattr(ob, "asc") else True
+            key = codes if asc else -codes
+            perm = perm[np.argsort(key[perm], kind="stable")]
+        out_cols = [c[perm] for c in out_cols]
+    if sel.limit is not None:
+        out_cols = [c[:sel.limit] for c in out_cols]
+
+    dtypes = [_dtype_of(c) for c in out_cols]
+    return QueryResult(names, dtypes, out_cols)
+
+
+def _order_col(ob, names, out_cols, data, idx):
+    expr = ob.expr if hasattr(ob, "expr") else ob
+    if isinstance(expr, ast.Column):
+        if expr.name in names:
+            return out_cols[names.index(expr.name)]
+        if expr.name in data:
+            return data[expr.name][idx]
+    raise_err = getattr(expr, "name", str(expr))
+    from greptimedb_tpu.query.expr import PlanError
+    raise PlanError(f"cannot ORDER BY {raise_err!r} on information_schema")
+
+
+def _eval(expr, data, n):
+    from greptimedb_tpu.query.expr import PlanError
+
+    if isinstance(expr, ast.Column):
+        if expr.name not in data:
+            raise PlanError(f"unknown column {expr.name!r}")
+        return data[expr.name]
+    if isinstance(expr, ast.Literal):
+        return expr.value
+    if isinstance(expr, ast.BinaryOp):
+        left, right = _eval(expr.left, data, n), _eval(expr.right, data, n)
+        op = expr.op
+        if op == "=":
+            return np.asarray(left) == right
+        if op in ("!=", "<>"):
+            return np.asarray(left) != right
+        if op.upper() == "AND":
+            return np.asarray(left, dtype=bool) & np.asarray(right, dtype=bool)
+        if op.upper() == "OR":
+            return np.asarray(left, dtype=bool) | np.asarray(right, dtype=bool)
+        if op in ("<", "<=", ">", ">="):
+            a, b = np.asarray(left), right
+            return {"<": a < b, "<=": a <= b, ">": a > b, ">=": a >= b}[op]
+        if op.lower() in ("like", "not like"):
+            from greptimedb_tpu.query.expr import _like_to_regex
+            rx = _like_to_regex(str(right))
+            out = np.asarray([bool(rx.fullmatch(str(v))) for v in
+                              np.asarray(left, dtype=object)])
+            return ~out if op.lower().startswith("not") else out
+        raise PlanError(f"unsupported operator {op!r} on information_schema")
+    raise PlanError(
+        f"unsupported expression {type(expr).__name__} on information_schema")
+
+
+def _expr_name(expr, i):
+    if isinstance(expr, ast.Column):
+        return expr.name
+    return f"column{i}"
+
+
+def _dtype_of(col) -> DataType:
+    for v in col:
+        if isinstance(v, bool):
+            return DataType.BOOL
+        if isinstance(v, (int, np.integer)):
+            return DataType.INT64
+        if isinstance(v, (float, np.floating)):
+            return DataType.FLOAT64
+        break
+    return DataType.STRING

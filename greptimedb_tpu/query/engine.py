@@ -66,11 +66,18 @@ class QueryEngine:
         # statement authorization (reference checks permissions in the
         # frontend before dispatch, src/frontend/src/instance.rs:305-338)
         self.permission_checker.check(ctx.user, stmt, ctx.db)
+        from greptimedb_tpu.utils.metrics import STMT_DURATION
+        with STMT_DURATION.time(stmt=type(stmt).__name__):
+            return self._execute_statement(stmt, ctx)
+
+    def _execute_statement(self, stmt: ast.Statement, ctx: QueryContext) -> QueryResult:
         if isinstance(stmt, ast.Select):
             return self._select(stmt, ctx)
         if isinstance(stmt, ast.CreateTable):
             return self._create_table(stmt, ctx)
         if isinstance(stmt, ast.CreateDatabase):
+            if stmt.name.lower() == "information_schema":
+                raise CatalogError("'information_schema' is reserved")
             self.catalog.create_database(stmt.name, stmt.if_not_exists)
             return QueryResult.of_affected(1)
         if isinstance(stmt, ast.Insert):
@@ -82,7 +89,12 @@ class QueryEngine:
         if isinstance(stmt, ast.TruncateTable):
             return self._truncate(stmt, ctx)
         if isinstance(stmt, ast.ShowTables):
-            names = self.catalog.list_tables(stmt.database or ctx.db)
+            from greptimedb_tpu.catalog import information_schema as infoschema
+            db = stmt.database or ctx.db
+            if db.lower() == infoschema.INFORMATION_SCHEMA:
+                names = infoschema.table_names()
+            else:
+                names = self.catalog.list_tables(db)
             if stmt.like:
                 from greptimedb_tpu.query.expr import _like_to_regex
                 rx = _like_to_regex(stmt.like)
@@ -90,8 +102,9 @@ class QueryEngine:
             return QueryResult(["Tables"], [DataType.STRING],
                                [np.asarray(names, dtype=object)])
         if isinstance(stmt, ast.ShowDatabases):
+            dbs = list(self.catalog.list_databases()) + ["information_schema"]
             return QueryResult(["Databases"], [DataType.STRING],
-                               [np.asarray(self.catalog.list_databases(), dtype=object)])
+                               [np.asarray(sorted(dbs), dtype=object)])
         if isinstance(stmt, ast.DescribeTable):
             return self._describe(stmt, ctx)
         if isinstance(stmt, ast.ShowCreateTable):
@@ -99,7 +112,8 @@ class QueryEngine:
         if isinstance(stmt, ast.Explain):
             return self._explain(stmt, ctx)
         if isinstance(stmt, ast.Use):
-            if not self.catalog.database_exists(stmt.database):
+            if stmt.database.lower() != "information_schema" and \
+                    not self.catalog.database_exists(stmt.database):
                 raise CatalogError(f"database {stmt.database!r} not found")
             ctx.db = stmt.database
             return QueryResult.of_affected(0)
@@ -161,6 +175,11 @@ class QueryEngine:
     # ---- SELECT ------------------------------------------------------------
 
     def _select(self, sel: ast.Select, ctx: QueryContext) -> QueryResult:
+        from greptimedb_tpu.catalog import information_schema as infoschema
+
+        if sel.table is not None and \
+                infoschema.is_information_schema_query(sel.table, ctx.db):
+            return infoschema.execute_virtual_select(self, sel, ctx)
         if sel.table is None:
             # SELECT <literals>
             names, cols, dtypes = [], [], []

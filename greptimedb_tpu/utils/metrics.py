@@ -145,6 +145,25 @@ class Registry:
             lines.extend(m.render())
         return "\n".join(lines) + "\n"
 
+    def samples(self):
+        """Flat (metric_name, value, labels) samples — feeds
+        information_schema.runtime_metrics and the self-scrape exporter."""
+        out = []
+        with self._lock:
+            metrics = list(self._metrics)
+        for m in metrics:
+            if isinstance(m, Histogram):
+                with m._lock:
+                    for key in m._count:
+                        out.append((m.name + "_sum", m._sum[key], _labels(key)))
+                        out.append((m.name + "_count", m._count[key], _labels(key)))
+            else:
+                with m._lock:
+                    items = sorted(m._values.items())
+                for key, v in items:
+                    out.append((m.name, v, _labels(key)))
+        return out
+
 
 REGISTRY = Registry()
 
@@ -155,3 +174,6 @@ QUERY_DURATION = REGISTRY.histogram("greptimedb_tpu_query_duration_seconds",
                                     "Query execution latency")
 INGEST_ROWS = REGISTRY.counter("greptimedb_tpu_ingest_rows_total",
                                "Rows ingested by protocol")
+STMT_DURATION = REGISTRY.histogram(
+    "greptimedb_tpu_statement_duration_seconds",
+    "Statement execution latency by statement kind")

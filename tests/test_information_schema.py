@@ -1,0 +1,168 @@
+"""information_schema virtual tables (reference
+src/catalog/src/information_schema/*.rs)."""
+
+import pytest
+
+from greptimedb_tpu.catalog.catalog import Catalog
+from greptimedb_tpu.catalog.kv import MemoryKv
+from greptimedb_tpu.query.engine import QueryContext, QueryEngine
+from greptimedb_tpu.storage.engine import EngineConfig, RegionEngine
+
+
+@pytest.fixture
+def qe(tmp_path):
+    engine = RegionEngine(EngineConfig(data_dir=str(tmp_path)))
+    q = QueryEngine(Catalog(MemoryKv()), engine)
+    q.execute_one(
+        "CREATE TABLE cpu (host STRING, usage DOUBLE, ts TIMESTAMP TIME INDEX, "
+        "PRIMARY KEY(host))"
+    )
+    q.execute_one("CREATE DATABASE metrics")
+    q.execute_one(
+        "CREATE TABLE metrics.mem (host STRING, used DOUBLE, "
+        "ts TIMESTAMP TIME INDEX, PRIMARY KEY(host))"
+    )
+    yield q
+    engine.close()
+
+
+def test_tables(qe):
+    r = qe.execute_one(
+        "SELECT table_schema, table_name, engine FROM information_schema.tables "
+        "WHERE table_type = 'BASE TABLE' ORDER BY table_name")
+    rows = r.rows()
+    assert ["public", "cpu", "mito"] in rows
+    assert ["metrics", "mem", "mito"] in rows
+
+
+def test_tables_has_table_id(qe):
+    r = qe.execute_one(
+        "SELECT table_id FROM information_schema.tables "
+        "WHERE table_name = 'cpu'")
+    assert r.rows()[0][0] >= 1024
+
+
+def test_columns(qe):
+    r = qe.execute_one(
+        "SELECT column_name, data_type, semantic_type "
+        "FROM information_schema.columns WHERE table_name = 'cpu' "
+        "ORDER BY column_name")
+    rows = r.rows()
+    assert ["host", "string", "TAG"] in rows
+    assert ["usage", "float64", "FIELD"] in rows
+    ts_rows = [row for row in rows if row[0] == "ts"]
+    assert ts_rows and ts_rows[0][2] == "TIMESTAMP"
+
+
+def test_schemata(qe):
+    r = qe.execute_one("SELECT schema_name FROM information_schema.schemata")
+    names = [row[0] for row in r.rows()]
+    assert "public" in names and "metrics" in names
+    assert "information_schema" in names
+
+
+def test_partitions_and_region_peers(qe):
+    r = qe.execute_one(
+        "SELECT table_name, partition_name, greptime_partition_id "
+        "FROM information_schema.partitions WHERE table_name = 'cpu'")
+    assert len(r.rows()) == 1
+    rid = r.rows()[0][2]
+    r2 = qe.execute_one(
+        f"SELECT region_id, is_leader, status FROM "
+        f"information_schema.region_peers WHERE region_id = {rid}")
+    assert r2.rows()[0][1:] == ["Yes", "ALIVE"]
+
+
+def test_cluster_info(qe):
+    r = qe.execute_one("SELECT peer_type, version FROM "
+                       "information_schema.cluster_info")
+    assert r.num_rows >= 1
+    assert r.rows()[0][0] in ("STANDALONE", "DATANODE", "FRONTEND")
+
+
+def test_runtime_metrics(qe):
+    # generate at least one sample, then read it back through SQL
+    qe.execute_one("SELECT count(*) FROM cpu")
+    r = qe.execute_one(
+        "SELECT metric_name, value FROM information_schema.runtime_metrics "
+        "WHERE metric_name LIKE 'greptimedb_tpu%'")
+    assert r.num_rows >= 1
+
+
+def test_engines_and_flows(qe):
+    r = qe.execute_one("SELECT engine FROM information_schema.engines")
+    assert "mito" in [row[0] for row in r.rows()]
+    r2 = qe.execute_one("SELECT count(*) FROM information_schema.flows")
+    assert r2.rows()[0][0] == 0
+
+
+def test_use_and_show(qe):
+    ctx = QueryContext()
+    qe.execute_one("USE information_schema", ctx)
+    assert ctx.db == "information_schema"
+    r = qe.execute_one("SHOW TABLES", ctx)
+    names = [row[0] for row in r.rows()]
+    assert "tables" in names and "columns" in names
+    r2 = qe.execute_one("SELECT table_name FROM tables "
+                        "WHERE table_schema = 'public'", ctx)
+    assert ["cpu"] in r2.rows()
+    r3 = qe.execute_one("SHOW DATABASES")
+    assert ["information_schema"] in r3.rows()
+
+
+def test_count_star(qe):
+    r = qe.execute_one(
+        "SELECT count(*) FROM information_schema.columns "
+        "WHERE table_name = 'cpu'")
+    assert r.rows()[0][0] == 3
+
+
+def test_flows_listed(qe):
+    qe.execute_one(
+        "CREATE TABLE cpu_1m (host STRING, avg_usage DOUBLE, "
+        "ts TIMESTAMP TIME INDEX, PRIMARY KEY(host))")
+    qe.execute_one(
+        "CREATE FLOW f1 SINK TO cpu_1m AS SELECT host, avg(usage), "
+        "date_bin(INTERVAL '1 minute', ts) FROM cpu GROUP BY host, 3")
+    r = qe.execute_one(
+        "SELECT flow_name, flow_schema, sink_table "
+        "FROM information_schema.flows")
+    assert ["f1", "public", "cpu_1m"] in r.rows()
+
+
+def test_mixed_count_rejected(qe):
+    from greptimedb_tpu.query.expr import PlanError
+
+    with pytest.raises(PlanError):
+        qe.execute_one("SELECT table_schema, count(*) "
+                       "FROM information_schema.tables GROUP BY table_schema")
+    with pytest.raises(PlanError):
+        qe.execute_one("SELECT table_schema, count(*) "
+                       "FROM information_schema.tables")
+
+
+def test_desc_preserves_secondary_order(qe):
+    qe.execute_one(
+        "CREATE TABLE disk (host STRING, used DOUBLE, ts TIMESTAMP TIME INDEX, "
+        "PRIMARY KEY(host))")
+    r = qe.execute_one(
+        "SELECT table_schema, table_name FROM information_schema.tables "
+        "WHERE table_type = 'BASE TABLE' "
+        "ORDER BY table_schema DESC, table_name ASC")
+    rows = r.rows()
+    pub = [row[1] for row in rows if row[0] == "public"]
+    assert pub == sorted(pub)
+
+
+def test_reserved_database_name(qe):
+    from greptimedb_tpu.catalog.catalog import CatalogError
+
+    with pytest.raises(CatalogError):
+        qe.execute_one("CREATE DATABASE information_schema")
+
+
+def test_limit_and_like(qe):
+    r = qe.execute_one(
+        "SELECT table_name FROM information_schema.tables "
+        "WHERE table_name LIKE 'c%' LIMIT 1")
+    assert r.num_rows == 1
