@@ -235,15 +235,18 @@ def execute_virtual_select(qe, sel: ast.Select, ctx) -> QueryResult:
     if sel.group_by or sel.having is not None or sel.distinct:
         raise PlanError(
             "GROUP BY/HAVING/DISTINCT not supported on information_schema")
+    from greptimedb_tpu.query.expr import eval_host
+
     data = {k: np.asarray(v, dtype=object) for k, v in builder(qe, ctx).items()}
     n = len(next(iter(data.values()))) if data else 0
 
     def ev(expr):
-        return _eval(expr, data, n)
+        return eval_host(expr, data, None, None, n)
 
     mask = np.ones(n, dtype=bool)
     if sel.where is not None:
-        mask = np.asarray(ev(sel.where), dtype=bool)
+        mask = np.broadcast_to(
+            np.asarray(ev(sel.where), dtype=bool), (n,))
     idx = np.nonzero(mask)[0]
 
     # projection
@@ -278,11 +281,19 @@ def execute_virtual_select(qe, sel: ast.Select, ctx) -> QueryResult:
         perm = np.arange(len(out_cols[0]) if out_cols else 0)
         for ob in reversed(sel.order_by):
             col = _order_col(ob, names, out_cols, data, idx)
-            codes = np.unique(col, return_inverse=True)[1]
+            try:
+                codes = np.unique(col, return_inverse=True)[1]
+            except TypeError:
+                # None/mixed types: NULLs first, rest by string value
+                skey = np.asarray(
+                    ["" if v is None else "\x01" + str(v) for v in col])
+                codes = np.unique(skey, return_inverse=True)[1]
             asc = ob.asc if hasattr(ob, "asc") else True
             key = codes if asc else -codes
             perm = perm[np.argsort(key[perm], kind="stable")]
         out_cols = [c[perm] for c in out_cols]
+    if sel.offset:
+        out_cols = [c[sel.offset:] for c in out_cols]
     if sel.limit is not None:
         out_cols = [c[:sel.limit] for c in out_cols]
 
@@ -300,40 +311,6 @@ def _order_col(ob, names, out_cols, data, idx):
     raise_err = getattr(expr, "name", str(expr))
     from greptimedb_tpu.query.expr import PlanError
     raise PlanError(f"cannot ORDER BY {raise_err!r} on information_schema")
-
-
-def _eval(expr, data, n):
-    from greptimedb_tpu.query.expr import PlanError
-
-    if isinstance(expr, ast.Column):
-        if expr.name not in data:
-            raise PlanError(f"unknown column {expr.name!r}")
-        return data[expr.name]
-    if isinstance(expr, ast.Literal):
-        return expr.value
-    if isinstance(expr, ast.BinaryOp):
-        left, right = _eval(expr.left, data, n), _eval(expr.right, data, n)
-        op = expr.op
-        if op == "=":
-            return np.asarray(left) == right
-        if op in ("!=", "<>"):
-            return np.asarray(left) != right
-        if op.upper() == "AND":
-            return np.asarray(left, dtype=bool) & np.asarray(right, dtype=bool)
-        if op.upper() == "OR":
-            return np.asarray(left, dtype=bool) | np.asarray(right, dtype=bool)
-        if op in ("<", "<=", ">", ">="):
-            a, b = np.asarray(left), right
-            return {"<": a < b, "<=": a <= b, ">": a > b, ">=": a >= b}[op]
-        if op.lower() in ("like", "not like"):
-            from greptimedb_tpu.query.expr import _like_to_regex
-            rx = _like_to_regex(str(right))
-            out = np.asarray([bool(rx.fullmatch(str(v))) for v in
-                              np.asarray(left, dtype=object)])
-            return ~out if op.lower().startswith("not") else out
-        raise PlanError(f"unsupported operator {op!r} on information_schema")
-    raise PlanError(
-        f"unsupported expression {type(expr).__name__} on information_schema")
 
 
 def _expr_name(expr, i):

@@ -1,0 +1,391 @@
+"""gRPC data plane via Arrow Flight (mirrors reference servers::grpc:
+`GreptimeDatabase` service + Arrow Flight `do_get`,
+src/servers/src/grpc/{greptime_handler.rs:42,flight.rs:45-115}, and the
+datanode region Flight service, src/servers/src/grpc/region_server.rs:39-92).
+
+Two services on one Flight endpoint:
+
+- **Query service** (frontend analog): `do_get` with a ticket
+  `{"sql": ..., "db": ...}` streams the result as Arrow record batches;
+  `do_put` bulk-ingests Arrow batches into a table (the row-insert path);
+  `do_action` carries DDL/DML and health checks.
+- **Region service** (datanode analog): `do_get` with
+  `{"region_scan": {"region_id": ..., ...}}` streams one region's raw scan
+  (tag codes as dictionary arrays, `__seq`/`__op_type` sideband columns) —
+  the distributed MergeScan transport. The client reassembles `ScanData`
+  and feeds the same device merge/dedup kernels as a local scan
+  (SURVEY.md §2.6: Flight is the reference's data-movement fabric).
+
+Auth: Flight handshake with Basic credentials when a UserProvider is
+installed (the reference authenticates Flight calls the same way,
+servers/src/grpc/flight.rs).
+"""
+
+from __future__ import annotations
+
+import json
+import secrets
+from typing import Optional
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.flight as fl
+
+from greptimedb_tpu.datatypes.schema import Schema
+from greptimedb_tpu.datatypes.types import DataType, SemanticType
+from greptimedb_tpu.datatypes.vector import DictVector
+from greptimedb_tpu.query.result import QueryResult
+from greptimedb_tpu.session import Channel, QueryContext
+from greptimedb_tpu.storage.region import ScanData
+from greptimedb_tpu.utils.time import coerce_ts_literal
+
+SEQ_COL = "__seq"
+OP_COL = "__op_type"
+
+
+# ---- QueryResult ⇄ Arrow ----------------------------------------------------
+
+
+def result_to_table(r: QueryResult) -> pa.Table:
+    arrays, fields = [], []
+    for name, dt, col in zip(r.names, r.dtypes, r.columns):
+        if dt is None:
+            dt = DataType.from_numpy(np.asarray(col).dtype)
+        arr = pa.array(col.tolist(), type=dt.to_arrow())
+        arrays.append(arr)
+        fields.append(pa.field(name, arr.type))
+    return pa.Table.from_arrays(arrays, schema=pa.schema(fields))
+
+
+def table_to_result(t: pa.Table) -> QueryResult:
+    names, dtypes, cols = [], [], []
+    for field, col in zip(t.schema, t.columns):
+        names.append(field.name)
+        dt = DataType.from_arrow(field.type)
+        dtypes.append(dt)
+        if dt.to_numpy() == np.dtype(object):
+            cols.append(np.asarray(col.to_pylist(), dtype=object))
+        else:
+            arr = col.to_numpy(zero_copy_only=False)
+            if arr.dtype != dt.to_numpy() and arr.dtype.kind != "f":
+                arr = arr.astype(dt.to_numpy())
+            cols.append(arr)
+    return QueryResult(names, dtypes, cols)
+
+
+# ---- ScanData ⇄ Arrow (region service wire format) --------------------------
+
+
+def scan_to_table(scan: ScanData) -> pa.Table:
+    arrays, fields = [], []
+    for name, col in scan.columns.items():
+        if name in scan.tag_dicts:
+            codes = np.asarray(col, dtype=np.int32)
+            dict_vals = pa.array(scan.tag_dicts[name].astype(str))
+            arr = pa.DictionaryArray.from_arrays(
+                pa.array(np.where(codes < 0, None, codes), type=pa.int32()),
+                dict_vals)
+        else:
+            arr = pa.array(col)
+        arrays.append(arr)
+        fields.append(pa.field(name, arr.type))
+    arrays.append(pa.array(scan.seq))
+    fields.append(pa.field(SEQ_COL, pa.int64()))
+    arrays.append(pa.array(scan.op_type))
+    fields.append(pa.field(OP_COL, pa.int8()))
+    meta = {
+        b"schema": json.dumps(scan.schema.to_dict()).encode(),
+        b"needs_dedup": b"1" if scan.needs_dedup else b"0",
+        b"region_id": str(scan.region_id).encode(),
+        b"data_version": str(scan.data_version).encode(),
+    }
+    return pa.Table.from_arrays(arrays, schema=pa.schema(fields, metadata=meta))
+
+
+def table_to_scan(t: pa.Table) -> ScanData:
+    meta = t.schema.metadata or {}
+    schema = Schema.from_dict(json.loads(meta[b"schema"].decode()))
+    columns: dict[str, np.ndarray] = {}
+    tag_dicts: dict[str, np.ndarray] = {}
+    seq = op = None
+    for field in t.schema:
+        col = t.column(field.name)
+        if field.name == SEQ_COL:
+            seq = col.to_numpy(zero_copy_only=False).astype(np.int64)
+        elif field.name == OP_COL:
+            op = col.to_numpy(zero_copy_only=False).astype(np.int8)
+        elif pa.types.is_dictionary(field.type):
+            combined = col.combine_chunks() if isinstance(col, pa.ChunkedArray) \
+                else col
+            if isinstance(combined, pa.ChunkedArray):
+                combined = combined.chunk(0)
+            codes = combined.indices.to_numpy(zero_copy_only=False)
+            codes = np.where(np.isnan(codes.astype(np.float64)), -1,
+                             codes).astype(np.int32) \
+                if codes.dtype.kind == "f" else codes.astype(np.int32)
+            columns[field.name] = codes
+            tag_dicts[field.name] = np.asarray(
+                combined.dictionary.to_pylist(), dtype=object)
+        else:
+            columns[field.name] = col.to_numpy(zero_copy_only=False)
+    return ScanData(
+        schema=schema, columns=columns, seq=seq, op_type=op,
+        tag_dicts=tag_dicts, num_rows=t.num_rows,
+        needs_dedup=meta.get(b"needs_dedup", b"1") == b"1",
+        region_id=int(meta.get(b"region_id", b"-1")),
+        data_version=int(meta.get(b"data_version", b"0")),
+    )
+
+
+# ---- auth handlers ----------------------------------------------------------
+
+
+class _BasicServerAuth(fl.ServerAuthHandler):
+    """Flight handshake: client sends 'user:password', server returns an
+    opaque session token validated on every call."""
+
+    def __init__(self, user_provider):
+        super().__init__()
+        self.user_provider = user_provider
+        self._tokens: dict[bytes, str] = {}
+
+    def authenticate(self, outgoing, incoming):
+        from greptimedb_tpu.auth import AuthError
+
+        raw = incoming.read()
+        user, _, pwd = raw.decode().partition(":")
+        try:
+            self.user_provider.authenticate(user, pwd)
+        except AuthError as e:
+            raise fl.FlightUnauthenticatedError(str(e)) from e
+        token = secrets.token_bytes(16)
+        self._tokens[token] = user
+        outgoing.write(token)
+
+    def is_valid(self, token):
+        if token not in self._tokens:
+            raise fl.FlightUnauthenticatedError("invalid token")
+        return self._tokens[token].encode()
+
+
+class _BasicClientAuth(fl.ClientAuthHandler):
+    def __init__(self, user: str, password: str):
+        super().__init__()
+        self._cred = f"{user}:{password}".encode()
+        self._token = b""
+
+    def authenticate(self, outgoing, incoming):
+        outgoing.write(self._cred)
+        self._token = incoming.read()
+
+    def get_token(self):
+        return self._token
+
+
+# ---- server -----------------------------------------------------------------
+
+
+class FlightServer(fl.FlightServerBase):
+    """Frontend + region Flight services on one port."""
+
+    def __init__(self, query_engine, host: str = "127.0.0.1", port: int = 0,
+                 user_provider=None):
+        self.qe = query_engine
+        auth = _BasicServerAuth(user_provider) if user_provider else None
+        location = f"grpc://{host}:{port}"
+        super().__init__(location, auth_handler=auth)
+        self.host = host
+
+    # -- query service --------------------------------------------------------
+
+    def do_get(self, context, ticket):
+        req = json.loads(ticket.ticket.decode())
+        if "region_scan" in req:
+            return self._region_scan(req["region_scan"])
+        ctx = QueryContext(db=req.get("db", "public"), channel=Channel.GRPC)
+        if "sql" in req:
+            result = self.qe.execute_one(req["sql"], ctx)
+        elif "tql" in req:
+            t = req["tql"]
+            from greptimedb_tpu.promql.engine import PromqlEngine
+            result = PromqlEngine(self.qe).eval_range(
+                t["query"], t["start"], t["end"], t["step"], ctx)
+        else:
+            raise fl.FlightServerError("ticket needs 'sql', 'tql' or 'region_scan'")
+        if not result.is_query:
+            table = pa.Table.from_arrays(
+                [pa.array([result.affected_rows], type=pa.int64())],
+                names=["affected_rows"])
+        else:
+            table = result_to_table(result)
+        return fl.RecordBatchStream(table)
+
+    def _region_scan(self, req: dict):
+        """Datanode region service (reference region_server.rs:39-92 —
+        Substrait plan in, Flight stream out; here the scan spec is the
+        plan fragment)."""
+        region_id = req["region_id"]
+        ts_range = tuple(req["ts_range"]) if req.get("ts_range") else None
+        projection = req.get("projection")
+        preds = {k: set(v) for k, v in (req.get("tag_predicates") or {}).items()} \
+            or None
+        scan = self.qe.region_engine.scan(
+            region_id, ts_range=ts_range, projection=projection,
+            tag_predicates=preds)
+        if scan is None:
+            # empty marker: zero-column table with metadata flag
+            return fl.RecordBatchStream(pa.Table.from_arrays(
+                [], schema=pa.schema([], metadata={b"empty": b"1"})))
+        return fl.RecordBatchStream(scan_to_table(scan))
+
+    # -- ingest ----------------------------------------------------------------
+
+    def do_put(self, context, descriptor, reader, writer):
+        """Bulk Arrow ingest into an existing table (the reference's row
+        insert gRPC, greptime_handler.rs:62 — here columnar end-to-end)."""
+        path = [p.decode() for p in descriptor.path]
+        if not path:
+            raise fl.FlightServerError("descriptor path must be [db.]table")
+        table_name = path[-1]
+        db = path[0] if len(path) > 1 else "public"
+        ctx = QueryContext(db=db, channel=Channel.GRPC)
+        arrow_table = reader.read_all()
+        n = self._insert_arrow(table_name, arrow_table, ctx)
+        writer.write(json.dumps({"affected_rows": n}).encode())
+
+    def _insert_arrow(self, table_name: str, t: pa.Table, ctx) -> int:
+        from greptimedb_tpu.datatypes.recordbatch import RecordBatch
+
+        info = self.qe._table(table_name, ctx)
+        schema = info.schema
+        nrows = t.num_rows
+        have = set(t.schema.names)
+        cols: dict = {}
+        for c in schema.columns:
+            if c.name in have:
+                vals = t.column(c.name).to_pylist()
+            else:
+                vals = [c.default] * nrows
+            if c.semantic is SemanticType.TAG or c.dtype.is_string:
+                cols[c.name] = DictVector.encode(
+                    [None if v is None else str(v) for v in vals])
+            elif c.dtype.is_timestamp:
+                coerced = []
+                for v in vals:
+                    if v is None:
+                        raise fl.FlightServerError(
+                            f"time index {c.name} cannot be NULL")
+                    coerced.append(coerce_ts_literal(v, c.dtype))
+                cols[c.name] = np.asarray(coerced, dtype=np.int64)
+            elif c.dtype.is_float:
+                cols[c.name] = np.asarray(
+                    [np.nan if v is None else float(v) for v in vals],
+                    dtype=c.dtype.to_numpy())
+            elif c.dtype is DataType.BOOL:
+                cols[c.name] = np.asarray(
+                    [False if v is None else bool(v) for v in vals])
+            else:
+                cols[c.name] = np.asarray(
+                    [0 if v is None else int(v) for v in vals],
+                    dtype=c.dtype.to_numpy())
+        batch = RecordBatch(schema, cols)
+        return self.qe._sharded_write(info, batch, delete=False)
+
+    # -- control ----------------------------------------------------------------
+
+    def do_action(self, context, action):
+        if action.type == "health":
+            return [json.dumps({"status": "ok"}).encode()]
+        if action.type == "sql":
+            req = json.loads(action.body.to_pybytes().decode())
+            ctx = QueryContext(db=req.get("db", "public"), channel=Channel.GRPC)
+            results = self.qe.execute_sql(req["sql"], ctx)
+            out = []
+            for r in results:
+                if r.is_query:
+                    out.append(json.dumps(
+                        {"rows": r.rows(), "names": r.names}).encode())
+                else:
+                    out.append(json.dumps(
+                        {"affected_rows": r.affected_rows}).encode())
+            return out
+        raise fl.FlightServerError(f"unknown action {action.type!r}")
+
+    def list_actions(self, context):
+        return [("health", "liveness check"),
+                ("sql", "execute SQL, results as JSON")]
+
+    def list_flights(self, context, criteria):
+        ctx = QueryContext()
+        for db in self.qe.catalog.list_databases():
+            for name in self.qe.catalog.list_tables(db):
+                info = self.qe.catalog.table(db, name)
+                fields = [pa.field(c.name, c.dtype.to_arrow())
+                          for c in info.schema.columns]
+                desc = fl.FlightDescriptor.for_path(db, name)
+                yield fl.FlightInfo(pa.schema(fields), desc, [], -1, -1)
+
+
+# ---- client -----------------------------------------------------------------
+
+
+class FlightQueryClient:
+    """Client for the query service (SQL over Flight)."""
+
+    def __init__(self, addr: str, user: Optional[str] = None,
+                 password: Optional[str] = None):
+        self.client = fl.FlightClient(f"grpc://{addr}")
+        if user is not None:
+            self.client.authenticate(_BasicClientAuth(user, password or ""))
+
+    def sql(self, sql: str, db: str = "public") -> QueryResult:
+        ticket = fl.Ticket(json.dumps({"sql": sql, "db": db}).encode())
+        t = self.client.do_get(ticket).read_all()
+        if t.schema.names == ["affected_rows"]:
+            return QueryResult.of_affected(t.column(0)[0].as_py())
+        return table_to_result(t)
+
+    def insert(self, table: str, data: pa.Table, db: str = "public") -> int:
+        desc = fl.FlightDescriptor.for_path(db, table)
+        writer, reader = self.client.do_put(desc, data.schema)
+        writer.write_table(data)
+        writer.done_writing()
+        ack = json.loads(reader.read().to_pybytes().decode())
+        writer.close()
+        return ack["affected_rows"]
+
+    def health(self) -> bool:
+        res = list(self.client.do_action(fl.Action("health", b"")))
+        return json.loads(res[0].body.to_pybytes().decode())["status"] == "ok"
+
+    def close(self):
+        self.client.close()
+
+
+class RegionFlightClient:
+    """Client for the region service — the distributed MergeScan transport
+    (reference query/src/dist_plan/merge_scan.rs:198-259 streams each
+    region over Flight and concatenates; here the reassembled ScanData
+    feeds the device merge kernels)."""
+
+    def __init__(self, addr: str):
+        self.client = fl.FlightClient(f"grpc://{addr}")
+
+    def scan(self, region_id: int, ts_range=None, projection=None,
+             tag_predicates=None) -> Optional[ScanData]:
+        spec = {"region_id": region_id}
+        if ts_range is not None:
+            spec["ts_range"] = list(ts_range)
+        if projection is not None:
+            spec["projection"] = list(projection)
+        if tag_predicates:
+            spec["tag_predicates"] = {k: sorted(v)
+                                      for k, v in tag_predicates.items()}
+        ticket = fl.Ticket(json.dumps({"region_scan": spec}).encode())
+        t = self.client.do_get(ticket).read_all()
+        if (t.schema.metadata or {}).get(b"empty") == b"1":
+            return None
+        return table_to_scan(t)
+
+    def close(self):
+        self.client.close()

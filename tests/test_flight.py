@@ -1,0 +1,157 @@
+"""Arrow Flight gRPC services: SQL query streaming, bulk Arrow ingest,
+region-scan transport, handshake auth (reference servers::grpc,
+src/servers/src/grpc/{flight.rs,region_server.rs})."""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.flight as fl
+import pytest
+
+from greptimedb_tpu.catalog.catalog import Catalog
+from greptimedb_tpu.catalog.kv import MemoryKv
+from greptimedb_tpu.query.engine import QueryEngine
+from greptimedb_tpu.servers.flight import (
+    FlightQueryClient,
+    FlightServer,
+    RegionFlightClient,
+    scan_to_table,
+    table_to_scan,
+)
+from greptimedb_tpu.storage.engine import EngineConfig, RegionEngine
+
+
+@pytest.fixture
+def qe(tmp_path):
+    engine = RegionEngine(EngineConfig(data_dir=str(tmp_path)))
+    q = QueryEngine(Catalog(MemoryKv()), engine)
+    q.execute_one(
+        "CREATE TABLE cpu (host STRING, usage DOUBLE, ts TIMESTAMP(3) TIME INDEX, "
+        "PRIMARY KEY(host))"
+    )
+    q.execute_one(
+        "INSERT INTO cpu (host, usage, ts) VALUES "
+        "('a', 1.0, 1000), ('a', 3.0, 61000), ('b', 10.0, 2000)"
+    )
+    yield q
+    engine.close()
+
+
+@pytest.fixture
+def server(qe):
+    srv = FlightServer(qe, port=0)
+    try:
+        yield srv
+    finally:
+        srv.shutdown()
+
+
+def _addr(srv):
+    return f"127.0.0.1:{srv.port}"
+
+
+class TestQueryService:
+    def test_sql_roundtrip(self, server):
+        client = FlightQueryClient(_addr(server))
+        r = client.sql("SELECT host, usage, ts FROM cpu ORDER BY ts")
+        assert r.names == ["host", "usage", "ts"]
+        assert r.rows()[0] == ["a", 1.0, 1000]
+        assert r.num_rows == 3
+        client.close()
+
+    def test_aggregate_over_flight(self, server):
+        client = FlightQueryClient(_addr(server))
+        r = client.sql("SELECT host, avg(usage) FROM cpu GROUP BY host "
+                       "ORDER BY host")
+        assert r.rows() == [["a", 2.0], ["b", 10.0]]
+        client.close()
+
+    def test_ddl_dml_via_action(self, server):
+        client = FlightQueryClient(_addr(server))
+        r = client.sql("INSERT INTO cpu (host, usage, ts) VALUES ('c', 5, 5000)")
+        assert r.affected_rows == 1
+        assert client.health()
+        client.close()
+
+    def test_bulk_arrow_ingest(self, server):
+        client = FlightQueryClient(_addr(server))
+        data = pa.table({
+            "host": ["d"] * 4,
+            "usage": [1.0, 2.0, 3.0, 4.0],
+            "ts": [100000, 200000, 300000, 400000],
+        })
+        n = client.insert("cpu", data)
+        assert n == 4
+        r = client.sql("SELECT count(*) FROM cpu WHERE host = 'd'")
+        assert r.rows()[0][0] == 4
+        client.close()
+
+    def test_list_flights(self, server):
+        client = fl.FlightClient(f"grpc://{_addr(server)}")
+        flights = list(client.list_flights())
+        paths = [tuple(p.decode() for p in f.descriptor.path)
+                 for f in flights]
+        assert ("public", "cpu") in paths
+        client.close()
+
+
+class TestRegionService:
+    def test_region_scan_roundtrip(self, qe, server):
+        info = qe.catalog.table("public", "cpu")
+        rid = info.region_ids[0]
+        client = RegionFlightClient(_addr(server))
+        scan = client.scan(rid)
+        assert scan is not None
+        assert scan.num_rows == 3
+        assert "host" in scan.tag_dicts
+        # codes decode to the right hosts
+        hosts = scan.tag_dicts["host"][scan.columns["host"]]
+        assert sorted(hosts) == ["a", "a", "b"]
+        assert scan.region_id == rid
+        client.close()
+
+    def test_region_scan_filters(self, qe, server):
+        info = qe.catalog.table("public", "cpu")
+        rid = info.region_ids[0]
+        client = RegionFlightClient(_addr(server))
+        scan = client.scan(rid, ts_range=(0, 10_000),
+                           projection=["host", "usage", "ts"])
+        assert scan is not None
+        assert scan.num_rows <= 3
+        client.close()
+
+    def test_empty_region_scan(self, qe, server):
+        qe.execute_one(
+            "CREATE TABLE empty_t (host STRING, v DOUBLE, "
+            "ts TIMESTAMP TIME INDEX, PRIMARY KEY(host))")
+        rid = qe.catalog.table("public", "empty_t").region_ids[0]
+        client = RegionFlightClient(_addr(server))
+        assert client.scan(rid) is None
+        client.close()
+
+    def test_scandata_serde(self, qe):
+        info = qe.catalog.table("public", "cpu")
+        scan = qe.region_engine.scan(info.region_ids[0])
+        t = scan_to_table(scan)
+        back = table_to_scan(t)
+        assert back.num_rows == scan.num_rows
+        np.testing.assert_array_equal(back.seq, scan.seq)
+        np.testing.assert_array_equal(back.op_type, scan.op_type)
+        for k in scan.columns:
+            np.testing.assert_array_equal(back.columns[k], scan.columns[k])
+        assert back.schema.names == scan.schema.names
+
+
+class TestFlightAuth:
+    def test_handshake(self, qe):
+        from greptimedb_tpu.auth import StaticUserProvider
+
+        srv = FlightServer(qe, port=0,
+                           user_provider=StaticUserProvider({"u": "pw"}))
+        try:
+            ok = FlightQueryClient(f"127.0.0.1:{srv.port}", "u", "pw")
+            assert ok.sql("SELECT count(*) FROM cpu").rows()[0][0] == 3
+            ok.close()
+            with pytest.raises(fl.FlightUnauthenticatedError):
+                FlightQueryClient(f"127.0.0.1:{srv.port}", "u", "nope")
+        finally:
+            srv.shutdown()

@@ -166,3 +166,40 @@ def test_limit_and_like(qe):
         "SELECT table_name FROM information_schema.tables "
         "WHERE table_name LIKE 'c%' LIMIT 1")
     assert r.num_rows == 1
+
+
+def test_offset_pagination(qe):
+    all_rows = qe.execute_one(
+        "SELECT table_name FROM information_schema.tables "
+        "ORDER BY table_name").rows()
+    page2 = qe.execute_one(
+        "SELECT table_name FROM information_schema.tables "
+        "ORDER BY table_name LIMIT 2 OFFSET 2").rows()
+    assert page2 == all_rows[2:4]
+
+
+def test_scalar_where(qe):
+    n_all = qe.execute_one(
+        "SELECT engine FROM information_schema.engines").num_rows
+    n_true = qe.execute_one(
+        "SELECT engine FROM information_schema.engines WHERE 1 = 1").num_rows
+    assert n_true == n_all == 3
+
+
+def test_in_between_predicates(qe):
+    r = qe.execute_one(
+        "SELECT table_name FROM information_schema.tables "
+        "WHERE table_name IN ('cpu', 'mem')")
+    names = [row[0] for row in r.rows()]
+    assert "cpu" in names and "mem" in names
+
+
+def test_order_by_numeric_and_nulls(qe):
+    r = qe.execute_one(
+        "SELECT table_name, table_id FROM information_schema.tables "
+        "WHERE table_type = 'BASE TABLE' ORDER BY table_id")
+    ids = [row[1] for row in r.rows()]
+    assert ids == sorted(ids)
+    # partition_expression is NULL for unpartitioned tables — must not crash
+    qe.execute_one("SELECT * FROM information_schema.partitions "
+                   "ORDER BY partition_expression")
