@@ -32,6 +32,10 @@ class TableInfo:
     options: dict = field(default_factory=dict)
     region_ids: list[int] = field(default_factory=list)
     partition_rules: Optional[list] = None  # (round 1: single region)
+    # user-declared column order from CREATE TABLE; the Schema itself is
+    # canonicalized to (tags, ts, fields) for storage, but positional
+    # INSERT and DESCRIBE follow the declared order
+    column_order: Optional[list] = None
 
     @property
     def append_mode(self) -> bool:
@@ -47,6 +51,7 @@ class TableInfo:
                 "options": self.options,
                 "region_ids": self.region_ids,
                 "partition_rules": self.partition_rules,
+                "column_order": self.column_order,
             }
         )
 
@@ -61,6 +66,7 @@ class TableInfo:
             options=d.get("options", {}),
             region_ids=d.get("region_ids", []),
             partition_rules=d.get("partition_rules"),
+            column_order=d.get("column_order"),
         )
 
 
@@ -94,6 +100,7 @@ class Catalog:
         if_not_exists: bool = False,
         num_regions: int = 1,
         partition_rules: Optional[list] = None,
+        column_order: Optional[list] = None,
     ) -> TableInfo:
         if not self.database_exists(db):
             raise CatalogError(f"database {db!r} not found")
@@ -108,7 +115,7 @@ class Catalog:
         info = TableInfo(
             table_id=table_id, name=name, db=db, schema=schema,
             options=options or {}, region_ids=region_ids,
-            partition_rules=partition_rules,
+            partition_rules=partition_rules, column_order=column_order,
         )
         self.kv.put(f"__table_info/{table_id}", info.to_json())
         if not self.kv.compare_and_put(f"__table_name/{db}/{name}", None, str(table_id)):

@@ -1,0 +1,169 @@
+"""External file formats (mirrors reference `common/datasource`,
+src/common/datasource/src/file_format.rs:57-61: CSV / JSON(ndjson) /
+Parquet / ORC, with compression) — backs COPY TO/FROM and the file
+engine. ORC is not in this environment's pyarrow build; it is reported
+as unsupported rather than stubbed silently.
+"""
+
+from __future__ import annotations
+
+import gzip
+import io
+import json
+import os
+from typing import Optional
+
+import numpy as np
+import pyarrow as pa
+
+from greptimedb_tpu.datatypes.types import DataType, SemanticType
+from greptimedb_tpu.query.result import QueryResult
+
+FORMATS = ("csv", "json", "parquet")
+
+
+class DataSourceError(Exception):
+    pass
+
+
+def infer_format(path: str, explicit: Optional[str] = None) -> str:
+    """Format from the WITH (format=...) option or the file extension
+    (reference file_format.rs `try_from` on extension)."""
+    if explicit:
+        f = explicit.lower()
+        if f == "ndjson":
+            f = "json"
+        if f not in FORMATS:
+            raise DataSourceError(f"unsupported format {explicit!r} "
+                                  f"(supported: {', '.join(FORMATS)})")
+        return f
+    base = path[:-3] if path.endswith(".gz") else path
+    ext = os.path.splitext(base)[1].lstrip(".").lower()
+    if ext in ("ndjson", "jsonl"):
+        ext = "json"
+    if ext in FORMATS:
+        return ext
+    raise DataSourceError(f"cannot infer format from {path!r}; "
+                          "pass WITH (format = '...')")
+
+
+def read_file(path: str, fmt: Optional[str] = None) -> pa.Table:
+    fmt = infer_format(path, fmt)
+    if not os.path.exists(path):
+        raise DataSourceError(f"file {path!r} not found")
+    if fmt == "parquet":
+        import pyarrow.parquet as pq
+        return pq.read_table(path)
+    raw = open(path, "rb").read()
+    if path.endswith(".gz"):
+        raw = gzip.decompress(raw)
+    if fmt == "csv":
+        import pyarrow.csv as pacsv
+        return pacsv.read_csv(io.BytesIO(raw))
+    # ndjson
+    import pyarrow.json as pajson
+    return pajson.read_json(io.BytesIO(raw))
+
+
+def write_file(table: pa.Table, path: str, fmt: Optional[str] = None) -> int:
+    fmt = infer_format(path, fmt)
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    if fmt == "parquet":
+        import pyarrow.parquet as pq
+        pq.write_table(table, path)
+        return table.num_rows
+    buf = io.BytesIO()
+    if fmt == "csv":
+        import pyarrow.csv as pacsv
+        pacsv.write_csv(table, buf)
+    else:  # ndjson
+        cols = {f.name: table.column(f.name).to_pylist() for f in table.schema}
+        lines = []
+        for i in range(table.num_rows):
+            lines.append(json.dumps({k: v[i] for k, v in cols.items()},
+                                    default=str))
+        buf.write(("\n".join(lines) + "\n").encode())
+    data = buf.getvalue()
+    if path.endswith(".gz"):
+        data = gzip.compress(data)
+    with open(path, "wb") as f:
+        f.write(data)
+    return table.num_rows
+
+
+# ---- Arrow → engine ingest (shared by COPY FROM and Flight do_put) ----------
+
+
+def insert_arrow_table(qe, table_name: str, t: pa.Table, ctx) -> int:
+    """Columnar insert of an Arrow table into an existing engine table,
+    mapping columns by name and applying schema coercions (tags →
+    dictionary codes, timestamps → time-index unit)."""
+    from greptimedb_tpu.datatypes.recordbatch import RecordBatch
+    from greptimedb_tpu.datatypes.vector import DictVector
+    from greptimedb_tpu.utils.time import coerce_ts_literal
+
+    info = qe._table(table_name, ctx)
+    schema = info.schema
+    nrows = t.num_rows
+    have = set(t.schema.names)
+    cols: dict = {}
+    for c in schema.columns:
+        if c.name in have:
+            vals = t.column(c.name).to_pylist()
+        else:
+            vals = [c.default] * nrows
+        if c.semantic is SemanticType.TAG or c.dtype.is_string:
+            cols[c.name] = DictVector.encode(
+                [None if v is None else str(v) for v in vals])
+        elif c.dtype.is_timestamp:
+            coerced = []
+            for v in vals:
+                if v is None:
+                    raise DataSourceError(f"time index {c.name} cannot be NULL")
+                coerced.append(coerce_ts_literal(v, c.dtype))
+            cols[c.name] = np.asarray(coerced, dtype=np.int64)
+        elif c.dtype.is_float:
+            cols[c.name] = np.asarray(
+                [np.nan if v is None else float(v) for v in vals],
+                dtype=c.dtype.to_numpy())
+        elif c.dtype is DataType.BOOL:
+            cols[c.name] = np.asarray(
+                [False if v is None else bool(v) for v in vals])
+        else:
+            cols[c.name] = np.asarray(
+                [0 if v is None else int(v) for v in vals],
+                dtype=c.dtype.to_numpy())
+    batch = RecordBatch(schema, cols)
+    return qe._sharded_write(info, batch, delete=False)
+
+
+# ---- QueryResult ⇄ Arrow (shared by COPY TO and the Flight services) --------
+
+
+def result_to_table(r: QueryResult) -> pa.Table:
+    arrays, fields = [], []
+    for name, dt, col in zip(r.names, r.dtypes, r.columns):
+        if dt is None:
+            dt = DataType.from_numpy(np.asarray(col).dtype)
+        arr = pa.array(col.tolist(), type=dt.to_arrow())
+        arrays.append(arr)
+        fields.append(pa.field(name, arr.type))
+    return pa.Table.from_arrays(arrays, schema=pa.schema(fields))
+
+
+def table_to_result(t: pa.Table) -> QueryResult:
+    names, dtypes, cols = [], [], []
+    for field, col in zip(t.schema, t.columns):
+        names.append(field.name)
+        dt = DataType.from_arrow(field.type)
+        dtypes.append(dt)
+        if dt.to_numpy() == np.dtype(object):
+            cols.append(np.asarray(col.to_pylist(), dtype=object))
+        else:
+            arr = col.to_numpy(zero_copy_only=False)
+            if arr.dtype != dt.to_numpy() and arr.dtype.kind != "f":
+                arr = arr.astype(dt.to_numpy())
+            cols.append(arr)
+    return QueryResult(names, dtypes, cols)

@@ -123,6 +123,10 @@ class QueryEngine:
             return self._admin(stmt, ctx)
         if isinstance(stmt, ast.Tql):
             return self._tql(stmt, ctx)
+        if isinstance(stmt, ast.CopyTable):
+            return self._copy_table(stmt, ctx)
+        if isinstance(stmt, ast.CopyDatabase):
+            return self._copy_database(stmt, ctx)
         if isinstance(stmt, ast.CreateFlow):
             self.flow_engine.create_flow(stmt, ctx)
             return QueryResult.of_affected(0)
@@ -221,7 +225,7 @@ class QueryEngine:
                 time_index = c.name
             if c.is_primary_key and c.name not in pks:
                 pks.append(c.name)
-        if time_index is None:
+        if time_index is None and stmt.columns:
             raise PlanError("CREATE TABLE requires a TIME INDEX column")
         cols = []
         for c in stmt.columns:
@@ -236,7 +240,11 @@ class QueryEngine:
             if c.default is not None and isinstance(c.default, ast.Literal):
                 default = c.default.value
             cols.append(ColumnSchema(c.name, dtype, sem, c.nullable, default))
-        schema = Schema(cols)
+        schema = Schema(cols) if stmt.columns else None
+        if stmt.external or stmt.engine == "file":
+            return self._create_file_table(db, name, schema, stmt, ctx)
+        if schema is None:
+            raise PlanError("CREATE TABLE requires a column list")
         if stmt.engine == "metric":
             return self._create_metric_table(db, name, schema, stmt, ctx)
         info = self.catalog.create_table(
@@ -244,11 +252,91 @@ class QueryEngine:
             if_not_exists=stmt.if_not_exists,
             num_regions=rule.num_regions() if rule is not None else 1,
             partition_rules=json.loads(rule.to_json()) if rule is not None else None,
+            column_order=[c.name for c in stmt.columns],
         )
         for rid in info.region_ids:
             self.region_engine.create_region(rid, schema)
             self._open_regions.add(rid)
         return QueryResult.of_affected(0)
+
+    def _create_file_table(self, db, name, schema, stmt, ctx) -> QueryResult:
+        """CREATE EXTERNAL TABLE: an external file as a read-only table
+        (reference file-engine, src/file-engine/src/engine.rs)."""
+        location = stmt.options.get("location")
+        if not location:
+            raise PlanError(
+                "CREATE EXTERNAL TABLE requires WITH (location = '...')")
+        if self.catalog.table_exists(db, name):
+            if stmt.if_not_exists:
+                return QueryResult.of_affected(0)
+            raise CatalogError(f"table {db}.{name} already exists")
+        rid, schema = self.file_engine.create_file_table(
+            db, name, schema, location, stmt.options.get("format"))
+        self.catalog.create_table(
+            db, name, schema,
+            options={**dict(stmt.options), "engine": "file"},
+            if_not_exists=True)
+        info = self.catalog.table(db, name)
+        info.region_ids = [rid]
+        self.catalog.update_table(info)
+        self._open_regions.add(rid)
+        return QueryResult.of_affected(0)
+
+    @property
+    def file_engine(self):
+        if not hasattr(self, "_file_engine"):
+            from greptimedb_tpu.storage.file_engine import FileEngine
+
+            self._file_engine = FileEngine(self.region_engine, self.catalog.kv)
+        return self._file_engine
+
+    def _copy_table(self, stmt: ast.CopyTable, ctx: QueryContext) -> QueryResult:
+        """COPY <table> TO/FROM '<path>' (reference
+        operator/src/statement/copy_table_{to,from}.rs)."""
+        from greptimedb_tpu import datasource
+
+        if stmt.direction == "to":
+            sel = ast.Select(items=[ast.SelectItem(ast.Star())],
+                             table=stmt.table)
+            result = self._select(sel, ctx)
+            n = datasource.write_file(
+                datasource.result_to_table(result), stmt.path,
+                stmt.options.get("format"))
+            return QueryResult.of_affected(n)
+        t = datasource.read_file(stmt.path, stmt.options.get("format"))
+        n = datasource.insert_arrow_table(self, stmt.table, t, ctx)
+        return QueryResult.of_affected(n)
+
+    def _copy_database(self, stmt: ast.CopyDatabase, ctx: QueryContext) -> QueryResult:
+        """COPY DATABASE TO/FROM '<dir>': one parquet file per table
+        (reference operator/src/statement/copy_database.rs)."""
+        import os
+
+        from greptimedb_tpu import datasource
+
+        db = stmt.database
+        fmt = stmt.options.get("format", "parquet")
+        dctx = ctx.with_db(db)
+        total = 0
+        if stmt.direction == "to":
+            os.makedirs(stmt.path, exist_ok=True)
+            for name in self.catalog.list_tables(db):
+                sub = ast.CopyTable(
+                    name, "to", os.path.join(stmt.path, f"{name}.{fmt}"),
+                    dict(stmt.options))
+                total += self._copy_table(sub, dctx).affected_rows
+            return QueryResult.of_affected(total)
+        for fname in sorted(os.listdir(stmt.path)):
+            base, ext = os.path.splitext(fname)
+            if ext.lstrip(".") not in datasource.FORMATS:
+                continue
+            if not self.catalog.table_exists(db, base):
+                continue
+            sub = ast.CopyTable(base, "from",
+                                os.path.join(stmt.path, fname),
+                                dict(stmt.options))
+            total += self._copy_table(sub, dctx).affected_rows
+        return QueryResult.of_affected(total)
 
     def _create_metric_table(self, db, name, schema: Schema, stmt, ctx) -> QueryResult:
         """CREATE TABLE ... ENGINE=metric: a logical table multiplexed onto
@@ -289,6 +377,11 @@ class QueryEngine:
             for rid in info.region_ids:
                 self._open_regions.discard(rid)
             return QueryResult.of_affected(0)
+        if info.options.get("engine") == "file":
+            for rid in info.region_ids:
+                self.file_engine.drop_file_table(rid)
+                self._open_regions.discard(rid)
+            return QueryResult.of_affected(0)
         from greptimedb_tpu.storage.engine import RegionRequest, RequestType
         for rid in info.region_ids:
             try:
@@ -301,6 +394,13 @@ class QueryEngine:
 
     def _truncate(self, stmt: ast.TruncateTable, ctx: QueryContext) -> QueryResult:
         info = self._table(stmt.name, ctx)
+        engine_kind = info.options.get("engine")
+        if engine_kind == "file":
+            raise PlanError("file engine tables are read-only; "
+                            "TRUNCATE is not supported")
+        if engine_kind == "metric":
+            raise PlanError("TRUNCATE is not supported on metric engine "
+                            "logical tables")
         from greptimedb_tpu.storage.engine import RegionRequest, RequestType
         for rid in info.region_ids:
             self.region_engine.handle_request(RegionRequest(RequestType.DROP, rid))
@@ -354,7 +454,8 @@ class QueryEngine:
         schema = info.schema
         if stmt.select is not None:
             raise PlanError("INSERT ... SELECT not yet supported")
-        col_names = stmt.columns or schema.names
+        # positional VALUES bind in the user-declared column order
+        col_names = stmt.columns or info.column_order or schema.names
         unknown = set(col_names) - set(schema.names)
         if unknown:
             raise PlanError(f"unknown insert columns {sorted(unknown)}")
@@ -457,7 +558,9 @@ class QueryEngine:
     def _describe(self, stmt: ast.DescribeTable, ctx: QueryContext) -> QueryResult:
         info = self._table(stmt.name, ctx)
         names, types, keys, nulls, defaults, semantics = [], [], [], [], [], []
-        for c in info.schema.columns:
+        cols = ([info.schema.column(n) for n in info.column_order]
+                if info.column_order else info.schema.columns)
+        for c in cols:
             names.append(c.name)
             types.append(c.dtype.value)
             keys.append("PRI" if c.semantic in (SemanticType.TAG, SemanticType.TIMESTAMP) else "")

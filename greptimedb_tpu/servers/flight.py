@@ -33,44 +33,17 @@ import pyarrow.flight as fl
 
 from greptimedb_tpu.datatypes.schema import Schema
 from greptimedb_tpu.datatypes.types import DataType, SemanticType
-from greptimedb_tpu.datatypes.vector import DictVector
 from greptimedb_tpu.query.result import QueryResult
 from greptimedb_tpu.session import Channel, QueryContext
 from greptimedb_tpu.storage.region import ScanData
-from greptimedb_tpu.utils.time import coerce_ts_literal
 
 SEQ_COL = "__seq"
 OP_COL = "__op_type"
 
 
-# ---- QueryResult ⇄ Arrow ----------------------------------------------------
+# ---- QueryResult ⇄ Arrow: shared converters live in datasource ------------
 
-
-def result_to_table(r: QueryResult) -> pa.Table:
-    arrays, fields = [], []
-    for name, dt, col in zip(r.names, r.dtypes, r.columns):
-        if dt is None:
-            dt = DataType.from_numpy(np.asarray(col).dtype)
-        arr = pa.array(col.tolist(), type=dt.to_arrow())
-        arrays.append(arr)
-        fields.append(pa.field(name, arr.type))
-    return pa.Table.from_arrays(arrays, schema=pa.schema(fields))
-
-
-def table_to_result(t: pa.Table) -> QueryResult:
-    names, dtypes, cols = [], [], []
-    for field, col in zip(t.schema, t.columns):
-        names.append(field.name)
-        dt = DataType.from_arrow(field.type)
-        dtypes.append(dt)
-        if dt.to_numpy() == np.dtype(object):
-            cols.append(np.asarray(col.to_pylist(), dtype=object))
-        else:
-            arr = col.to_numpy(zero_copy_only=False)
-            if arr.dtype != dt.to_numpy() and arr.dtype.kind != "f":
-                arr = arr.astype(dt.to_numpy())
-            cols.append(arr)
-    return QueryResult(names, dtypes, cols)
+from greptimedb_tpu.datasource import result_to_table, table_to_result  # noqa: E402,F401
 
 
 # ---- ScanData ⇄ Arrow (region service wire format) --------------------------
@@ -144,10 +117,14 @@ class _BasicServerAuth(fl.ServerAuthHandler):
     """Flight handshake: client sends 'user:password', server returns an
     opaque session token validated on every call."""
 
+    MAX_TOKENS = 1024  # LRU bound: oldest sessions re-handshake
+
     def __init__(self, user_provider):
+        from collections import OrderedDict
+
         super().__init__()
         self.user_provider = user_provider
-        self._tokens: dict[bytes, str] = {}
+        self._tokens: "OrderedDict[bytes, str]" = OrderedDict()
 
     def authenticate(self, outgoing, incoming):
         from greptimedb_tpu.auth import AuthError
@@ -160,6 +137,8 @@ class _BasicServerAuth(fl.ServerAuthHandler):
             raise fl.FlightUnauthenticatedError(str(e)) from e
         token = secrets.token_bytes(16)
         self._tokens[token] = user
+        while len(self._tokens) > self.MAX_TOKENS:
+            self._tokens.popitem(last=False)
         outgoing.write(token)
 
     def is_valid(self, token):
@@ -213,9 +192,12 @@ class FlightServer(fl.FlightServerBase):
         else:
             raise fl.FlightServerError("ticket needs 'sql', 'tql' or 'region_scan'")
         if not result.is_query:
+            # DML/DDL ack: flagged via schema metadata, not column names
+            # (a SELECT could legitimately project `affected_rows`)
             table = pa.Table.from_arrays(
                 [pa.array([result.affected_rows], type=pa.int64())],
-                names=["affected_rows"])
+                schema=pa.schema([pa.field("affected_rows", pa.int64())],
+                                 metadata={b"affected": b"1"}))
         else:
             table = result_to_table(result)
         return fl.RecordBatchStream(table)
@@ -254,42 +236,9 @@ class FlightServer(fl.FlightServerBase):
         writer.write(json.dumps({"affected_rows": n}).encode())
 
     def _insert_arrow(self, table_name: str, t: pa.Table, ctx) -> int:
-        from greptimedb_tpu.datatypes.recordbatch import RecordBatch
+        from greptimedb_tpu.datasource import insert_arrow_table
 
-        info = self.qe._table(table_name, ctx)
-        schema = info.schema
-        nrows = t.num_rows
-        have = set(t.schema.names)
-        cols: dict = {}
-        for c in schema.columns:
-            if c.name in have:
-                vals = t.column(c.name).to_pylist()
-            else:
-                vals = [c.default] * nrows
-            if c.semantic is SemanticType.TAG or c.dtype.is_string:
-                cols[c.name] = DictVector.encode(
-                    [None if v is None else str(v) for v in vals])
-            elif c.dtype.is_timestamp:
-                coerced = []
-                for v in vals:
-                    if v is None:
-                        raise fl.FlightServerError(
-                            f"time index {c.name} cannot be NULL")
-                    coerced.append(coerce_ts_literal(v, c.dtype))
-                cols[c.name] = np.asarray(coerced, dtype=np.int64)
-            elif c.dtype.is_float:
-                cols[c.name] = np.asarray(
-                    [np.nan if v is None else float(v) for v in vals],
-                    dtype=c.dtype.to_numpy())
-            elif c.dtype is DataType.BOOL:
-                cols[c.name] = np.asarray(
-                    [False if v is None else bool(v) for v in vals])
-            else:
-                cols[c.name] = np.asarray(
-                    [0 if v is None else int(v) for v in vals],
-                    dtype=c.dtype.to_numpy())
-        batch = RecordBatch(schema, cols)
-        return self.qe._sharded_write(info, batch, delete=False)
+        return insert_arrow_table(self.qe, table_name, t, ctx)
 
     # -- control ----------------------------------------------------------------
 
@@ -341,7 +290,7 @@ class FlightQueryClient:
     def sql(self, sql: str, db: str = "public") -> QueryResult:
         ticket = fl.Ticket(json.dumps({"sql": sql, "db": db}).encode())
         t = self.client.do_get(ticket).read_all()
-        if t.schema.names == ["affected_rows"]:
+        if (t.schema.metadata or {}).get(b"affected") == b"1":
             return QueryResult.of_affected(t.column(0)[0].as_py())
         return table_to_result(t)
 

@@ -149,6 +149,28 @@ class CreateTable(Statement):
     engine: str = "mito"
     options: dict = field(default_factory=dict)
     partitions: Optional[list] = None  # partition bound exprs
+    external: bool = False  # CREATE EXTERNAL TABLE (file engine)
+
+
+@dataclass
+class CopyTable(Statement):
+    """COPY <table> TO|FROM '<path>' [WITH (format=..., ...)]
+    (reference operator/src/statement/copy_table_{to,from}.rs)."""
+
+    table: str
+    direction: str  # "to" | "from"
+    path: str
+    options: dict = field(default_factory=dict)
+
+
+@dataclass
+class CopyDatabase(Statement):
+    """COPY DATABASE <db> TO|FROM '<dir>' [WITH (...)]."""
+
+    database: str
+    direction: str
+    path: str
+    options: dict = field(default_factory=dict)
 
 
 @dataclass

@@ -16,6 +16,7 @@ KEYWORDS = {
     "alter", "add", "column", "rename", "to", "tql", "eval", "evaluate",
     "align", "range", "fill", "partition", "on", "nulls", "first", "last",
     "admin", "verbose", "copy", "default", "flow", "flows", "sink",
+    "external",
 }
 
 _TOKEN_RE = re.compile(

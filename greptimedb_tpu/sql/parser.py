@@ -160,7 +160,39 @@ class Parser:
             if not isinstance(expr, ast.FuncCall):
                 raise SqlError("ADMIN expects a function call")
             return ast.AdminFunc(expr)
+        if t.value == "copy":
+            return self.parse_copy()
         raise SqlError(f"unsupported statement start {t.value!r}")
+
+    def parse_copy(self) -> ast.Statement:
+        """COPY [TABLE] <t> | DATABASE <db>  TO|FROM '<path>' [WITH (...)]"""
+        self.expect_kw("copy")
+        is_db = self.eat_kw("database")
+        if not is_db:
+            self.eat_kw("table")
+        name = self.qualified_name()
+        if self.eat_kw("to"):
+            direction = "to"
+        elif self.eat_kw("from"):
+            direction = "from"
+        else:
+            raise SqlError("COPY expects TO or FROM")
+        t = self.next()
+        if t.kind != "string":
+            raise SqlError("COPY expects a quoted path")
+        path = t.value
+        options = {}
+        if self.eat_kw("with"):
+            self.expect_op("(")
+            while not self.at_op(")"):
+                k = self.qualified_name()
+                self.expect_op("=")
+                options[k] = self.next().value
+                self.eat_op(",")
+            self.expect_op(")")
+        if is_db:
+            return ast.CopyDatabase(name, direction, path, options)
+        return ast.CopyTable(name, direction, path, options)
 
     # ---- SELECT ------------------------------------------------------------
 
@@ -243,10 +275,17 @@ class Parser:
             return ast.CreateDatabase(self.ident(), if_not_exists=ine)
         if self.eat_kw("flow"):
             return self._parse_create_flow()
+        external = self.eat_kw("external")
         self.expect_kw("table")
         ine = self._if_not_exists()
         name = self.qualified_name()
-        stmt = ast.CreateTable(name=name, columns=[], if_not_exists=ine)
+        stmt = ast.CreateTable(name=name, columns=[], if_not_exists=ine,
+                               external=external)
+        if external:
+            stmt.engine = "file"
+            if not self.at_op("("):
+                # schema inferred from the file
+                return self._finish_create_table(stmt)
         self.expect_op("(")
         while not self.at_op(")"):
             if self.at_kw("primary"):
@@ -267,6 +306,9 @@ class Parser:
                 stmt.columns.append(self.parse_column_def())
             self.eat_op(",")
         self.expect_op(")")
+        return self._finish_create_table(stmt)
+
+    def _finish_create_table(self, stmt: ast.CreateTable) -> ast.CreateTable:
         if self.eat_kw("partition"):
             # PARTITION ON COLUMNS (...) (...); ON/COLUMNS may lex as
             # keywords or plain idents depending on the keyword table

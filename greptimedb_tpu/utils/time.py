@@ -62,6 +62,13 @@ def coerce_ts_literal(value, dtype: DataType) -> int:
     unit = dtype.time_unit
     if isinstance(value, str):
         return ns_to_unit(parse_timestamp_ns(value), unit)
+    if isinstance(value, dt.datetime):
+        # Arrow timestamp columns round-trip as datetime objects
+        tz = value if value.tzinfo else value.replace(tzinfo=dt.timezone.utc)
+        delta = tz - dt.datetime(1970, 1, 1, tzinfo=dt.timezone.utc)
+        ns = (delta.days * 86_400 + delta.seconds) * 10**9 \
+            + delta.microseconds * 1000
+        return ns_to_unit(ns, unit)
     return int(value)
 
 

@@ -1,0 +1,219 @@
+"""File engine + datasource + COPY TO/FROM (reference src/file-engine,
+common/datasource, operator/src/statement/copy_table_{to,from}.rs)."""
+
+import gzip
+import os
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from greptimedb_tpu import datasource
+from greptimedb_tpu.catalog.catalog import Catalog
+from greptimedb_tpu.catalog.kv import MemoryKv
+from greptimedb_tpu.query.engine import QueryContext, QueryEngine
+from greptimedb_tpu.storage.engine import EngineConfig, RegionEngine
+
+
+@pytest.fixture
+def qe(tmp_path):
+    engine = RegionEngine(EngineConfig(data_dir=str(tmp_path / "data")))
+    q = QueryEngine(Catalog(MemoryKv()), engine)
+    q.execute_one(
+        "CREATE TABLE cpu (host STRING, usage DOUBLE, ts TIMESTAMP(3) TIME INDEX, "
+        "PRIMARY KEY(host))"
+    )
+    q.execute_one(
+        "INSERT INTO cpu (host, usage, ts) VALUES "
+        "('a', 1.0, 1000), ('a', 3.0, 61000), ('b', 10.0, 2000)"
+    )
+    yield q
+    engine.close()
+
+
+class TestDatasource:
+    def test_format_inference(self):
+        assert datasource.infer_format("/x/a.csv") == "csv"
+        assert datasource.infer_format("/x/a.json.gz") == "json"
+        assert datasource.infer_format("/x/a.ndjson") == "json"
+        assert datasource.infer_format("/x/a.parquet") == "parquet"
+        assert datasource.infer_format("/x/a.bin", "CSV") == "csv"
+        with pytest.raises(datasource.DataSourceError):
+            datasource.infer_format("/x/a.bin")
+        with pytest.raises(datasource.DataSourceError):
+            datasource.infer_format("/x/a.csv", "orc")
+
+    @pytest.mark.parametrize("ext", ["csv", "json", "parquet"])
+    def test_roundtrip(self, tmp_path, ext):
+        t = pa.table({"host": ["a", "b"], "v": [1.5, 2.5], "ts": [100, 200]})
+        path = str(tmp_path / f"t.{ext}")
+        assert datasource.write_file(t, path) == 2
+        back = datasource.read_file(path)
+        assert back.num_rows == 2
+        assert back.column("host").to_pylist() == ["a", "b"]
+        assert back.column("v").to_pylist() == [1.5, 2.5]
+
+    def test_gzip_csv(self, tmp_path):
+        t = pa.table({"a": [1, 2, 3]})
+        path = str(tmp_path / "t.csv.gz")
+        datasource.write_file(t, path)
+        with open(path, "rb") as f:
+            assert f.read(2) == b"\x1f\x8b"  # really gzipped
+        assert datasource.read_file(path).column("a").to_pylist() == [1, 2, 3]
+
+
+class TestCopy:
+    def test_copy_to_from_parquet(self, qe, tmp_path):
+        path = str(tmp_path / "cpu.parquet")
+        r = qe.execute_one(f"COPY cpu TO '{path}'")
+        assert r.affected_rows == 3
+        qe.execute_one("CREATE TABLE cpu2 (host STRING, usage DOUBLE, "
+                       "ts TIMESTAMP(3) TIME INDEX, PRIMARY KEY(host))")
+        r = qe.execute_one(f"COPY cpu2 FROM '{path}'")
+        assert r.affected_rows == 3
+        rows = qe.execute_one(
+            "SELECT host, usage FROM cpu2 ORDER BY host, usage").rows()
+        assert rows == [["a", 1.0], ["a", 3.0], ["b", 10.0]]
+
+    def test_copy_csv_with_format(self, qe, tmp_path):
+        path = str(tmp_path / "cpu.data")
+        r = qe.execute_one(f"COPY TABLE cpu TO '{path}' WITH (format = 'csv')")
+        assert r.affected_rows == 3
+        qe.execute_one("DELETE FROM cpu WHERE host = 'b'")
+        r = qe.execute_one(f"COPY cpu FROM '{path}' WITH (format = 'csv')")
+        assert r.affected_rows == 3
+        assert qe.execute_one(
+            "SELECT count(*) FROM cpu WHERE host = 'b'").rows()[0][0] == 1
+
+    def test_copy_database(self, qe, tmp_path):
+        qe.execute_one("CREATE TABLE mem (host STRING, used DOUBLE, "
+                       "ts TIMESTAMP(3) TIME INDEX, PRIMARY KEY(host))")
+        qe.execute_one("INSERT INTO mem (host, used, ts) VALUES ('m', 7.0, 500)")
+        outdir = str(tmp_path / "backup")
+        r = qe.execute_one(f"COPY DATABASE public TO '{outdir}'")
+        assert r.affected_rows == 4  # 3 cpu + 1 mem
+        assert sorted(os.listdir(outdir)) == ["cpu.parquet", "mem.parquet"]
+        # restore into a fresh database with same table defs
+        qe.execute_one("TRUNCATE TABLE cpu")
+        qe.execute_one("TRUNCATE TABLE mem")
+        r = qe.execute_one(f"COPY DATABASE public FROM '{outdir}'")
+        assert r.affected_rows == 4
+        assert qe.execute_one("SELECT count(*) FROM cpu").rows()[0][0] == 3
+
+
+class TestFileEngine:
+    def test_external_table_explicit_schema(self, qe, tmp_path):
+        t = pa.table({"city": ["sf", "nyc", "sf"],
+                      "pop": [1.0, 2.0, 3.0],
+                      "ts": [1000, 2000, 3000]})
+        path = str(tmp_path / "city.parquet")
+        datasource.write_file(t, path)
+        qe.execute_one(
+            f"CREATE EXTERNAL TABLE city (city STRING, pop DOUBLE, "
+            f"ts TIMESTAMP(3) TIME INDEX, PRIMARY KEY(city)) "
+            f"WITH (location = '{path}', format = 'parquet')")
+        rows = qe.execute_one(
+            "SELECT city, pop FROM city ORDER BY ts").rows()
+        assert rows == [["sf", 1.0], ["nyc", 2.0], ["sf", 3.0]]
+        # aggregates run through the same device kernels
+        agg = qe.execute_one(
+            "SELECT city, sum(pop) FROM city GROUP BY city ORDER BY city").rows()
+        assert agg == [["nyc", 2.0], ["sf", 4.0]]
+
+    def test_external_table_inferred_schema(self, qe, tmp_path):
+        t = pa.table({"host": ["x", "y"], "v": [1.5, 2.5],
+                      "ts": pa.array([1000, 2000], type=pa.timestamp("ms"))})
+        path = str(tmp_path / "infer.parquet")
+        datasource.write_file(t, path)
+        qe.execute_one(
+            f"CREATE EXTERNAL TABLE ext WITH (location = '{path}')")
+        desc = qe.execute_one("DESCRIBE TABLE ext").rows()
+        sem = {row[0]: row[5] for row in desc}
+        assert sem["host"] == "TAG" and sem["v"] == "FIELD"
+        assert sem["ts"] == "TIMESTAMP"
+        assert qe.execute_one("SELECT count(*) FROM ext").rows()[0][0] == 2
+
+    def test_external_table_readonly(self, qe, tmp_path):
+        t = pa.table({"host": ["x"], "v": [1.0], "ts": [1000]})
+        path = str(tmp_path / "ro.csv")
+        datasource.write_file(t, path)
+        qe.execute_one(
+            f"CREATE EXTERNAL TABLE ro (host STRING, v DOUBLE, "
+            f"ts TIMESTAMP(3) TIME INDEX, PRIMARY KEY(host)) "
+            f"WITH (location = '{path}')")
+        from greptimedb_tpu.storage.file_engine import FileEngineError
+        with pytest.raises(FileEngineError):
+            qe.execute_one("INSERT INTO ro (host, v, ts) VALUES ('y', 2, 2000)")
+
+    def test_external_table_time_filter(self, qe, tmp_path):
+        t = pa.table({"host": ["x", "x", "x"], "v": [1.0, 2.0, 3.0],
+                      "ts": [1000, 2000, 3000]})
+        path = str(tmp_path / "tf.parquet")
+        datasource.write_file(t, path)
+        qe.execute_one(
+            f"CREATE EXTERNAL TABLE tf (host STRING, v DOUBLE, "
+            f"ts TIMESTAMP(3) TIME INDEX, PRIMARY KEY(host)) "
+            f"WITH (location = '{path}')")
+        rows = qe.execute_one(
+            "SELECT v FROM tf WHERE ts >= 2000 ORDER BY ts").rows()
+        assert rows == [[2.0], [3.0]]
+
+    def test_external_table_reopen(self, qe, tmp_path):
+        """File region metadata survives in kv; a fresh engine reopens it."""
+        t = pa.table({"host": ["x"], "v": [9.0], "ts": [1000]})
+        path = str(tmp_path / "ro2.parquet")
+        datasource.write_file(t, path)
+        qe.execute_one(
+            f"CREATE EXTERNAL TABLE ro2 (host STRING, v DOUBLE, "
+            f"ts TIMESTAMP(3) TIME INDEX, PRIMARY KEY(host)) "
+            f"WITH (location = '{path}')")
+        rid = qe.catalog.table("public", "ro2").region_ids[0]
+        # simulate restart: evict from the live engine, reopen via opener
+        qe.region_engine.regions.pop(rid)
+        qe._open_regions.discard(rid)
+        assert qe.execute_one("SELECT v FROM ro2").rows() == [[9.0]]
+
+    def test_truncate_external_rejected(self, qe, tmp_path):
+        from greptimedb_tpu.query.expr import PlanError
+
+        t = pa.table({"host": ["x"], "v": [1.0], "ts": [1000]})
+        path = str(tmp_path / "tr.csv")
+        datasource.write_file(t, path)
+        qe.execute_one(
+            f"CREATE EXTERNAL TABLE tr (host STRING, v DOUBLE, "
+            f"ts TIMESTAMP(3) TIME INDEX, PRIMARY KEY(host)) "
+            f"WITH (location = '{path}')")
+        with pytest.raises(PlanError):
+            qe.execute_one("TRUNCATE TABLE tr")
+        assert qe.execute_one("SELECT count(*) FROM tr").rows()[0][0] == 1
+
+    def test_copy_path_must_be_quoted(self, qe):
+        from greptimedb_tpu.sql.parser import SqlError
+
+        with pytest.raises(SqlError):
+            qe.execute_one("COPY cpu TO WITH (format='csv')")
+
+    def test_positional_insert_declared_order(self, qe):
+        """Positional VALUES bind in CREATE TABLE order, not the
+        canonical (tags, ts, fields) storage order."""
+        qe.execute_one(
+            "CREATE TABLE pos (host STRING, v DOUBLE, "
+            "ts TIMESTAMP(3) TIME INDEX, PRIMARY KEY(host))")
+        qe.execute_one("INSERT INTO pos VALUES ('a', 1.5, 1000)")
+        assert qe.execute_one(
+            "SELECT host, v, ts FROM pos").rows() == [["a", 1.5, 1000]]
+        desc = qe.execute_one("DESCRIBE TABLE pos").rows()
+        assert [row[0] for row in desc] == ["host", "v", "ts"]
+
+    def test_drop_external_table(self, qe, tmp_path):
+        t = pa.table({"host": ["x"], "v": [1.0], "ts": [1000]})
+        path = str(tmp_path / "dr.csv")
+        datasource.write_file(t, path)
+        qe.execute_one(
+            f"CREATE EXTERNAL TABLE dr (host STRING, v DOUBLE, "
+            f"ts TIMESTAMP(3) TIME INDEX, PRIMARY KEY(host)) "
+            f"WITH (location = '{path}')")
+        qe.execute_one("DROP TABLE dr")
+        assert os.path.exists(path)  # dropping the table keeps the file
+        assert "dr" not in [
+            r[0] for r in qe.execute_one("SHOW TABLES").rows()]

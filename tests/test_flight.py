@@ -65,6 +65,13 @@ class TestQueryService:
         assert r.rows() == [["a", 2.0], ["b", 10.0]]
         client.close()
 
+    def test_affected_rows_alias_not_misdetected(self, server):
+        client = FlightQueryClient(_addr(server))
+        r = client.sql("SELECT count(*) AS affected_rows FROM cpu")
+        assert r.is_query
+        assert r.rows() == [[3]]
+        client.close()
+
     def test_ddl_dml_via_action(self, server):
         client = FlightQueryClient(_addr(server))
         r = client.sql("INSERT INTO cpu (host, usage, ts) VALUES ('c', 5, 5000)")
