@@ -101,6 +101,7 @@ class Catalog:
         num_regions: int = 1,
         partition_rules: Optional[list] = None,
         column_order: Optional[list] = None,
+        region_ids: Optional[list] = None,
     ) -> TableInfo:
         if not self.database_exists(db):
             raise CatalogError(f"database {db!r} not found")
@@ -110,8 +111,9 @@ class Catalog:
                 return self.table(db, name)
             raise CatalogError(f"table {db}.{name} already exists")
         table_id = self.kv.incr("__seq/table_id", start=1023)
-        # region id layout mirrors the reference: table_id << 32 | region_number
-        region_ids = [(table_id << 32) | i for i in range(num_regions)]
+        if region_ids is None:
+            # region id layout mirrors the reference: table_id << 32 | region_number
+            region_ids = [(table_id << 32) | i for i in range(num_regions)]
         info = TableInfo(
             table_id=table_id, name=name, db=db, schema=schema,
             options=options or {}, region_ids=region_ids,

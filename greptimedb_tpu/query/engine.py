@@ -49,6 +49,12 @@ class QueryEngine:
 
             metric_engine = MetricEngine(region_engine, catalog.kv)
         self.metric_engine = metric_engine
+        # eager: registers the file-region opener so external tables
+        # reopen after restart (same reason the metric engine is eager)
+        if hasattr(region_engine, "register_opener"):
+            from greptimedb_tpu.storage.file_engine import FileEngine
+
+            self._file_engine = FileEngine(region_engine, catalog.kv)
 
     # ---- entry points ------------------------------------------------------
 
@@ -272,13 +278,12 @@ class QueryEngine:
             raise CatalogError(f"table {db}.{name} already exists")
         rid, schema = self.file_engine.create_file_table(
             db, name, schema, location, stmt.options.get("format"))
-        self.catalog.create_table(
+        info = self.catalog.create_table(
             db, name, schema,
             options={**dict(stmt.options), "engine": "file"},
-            if_not_exists=True)
-        info = self.catalog.table(db, name)
-        info.region_ids = [rid]
-        self.catalog.update_table(info)
+            if_not_exists=True,
+            column_order=[c.name for c in stmt.columns] or None,
+            region_ids=[rid])
         self._open_regions.add(rid)
         return QueryResult.of_affected(0)
 
@@ -289,6 +294,16 @@ class QueryEngine:
 
             self._file_engine = FileEngine(self.region_engine, self.catalog.kv)
         return self._file_engine
+
+    def _refresh_column_order(self, info: TableInfo,
+                              added: Optional[str] = None,
+                              dropped: Optional[str] = None) -> None:
+        if info.column_order:
+            if added:
+                info.column_order = list(info.column_order) + [added]
+            if dropped:
+                info.column_order = [n for n in info.column_order
+                                     if n != dropped]
 
     def _copy_table(self, stmt: ast.CopyTable, ctx: QueryContext) -> QueryResult:
         """COPY <table> TO/FROM '<path>' (reference
@@ -328,7 +343,10 @@ class QueryEngine:
             return QueryResult.of_affected(total)
         for fname in sorted(os.listdir(stmt.path)):
             base, ext = os.path.splitext(fname)
-            if ext.lstrip(".") not in datasource.FORMATS:
+            ext = ext.lstrip(".").lower()
+            if ext in ("ndjson", "jsonl"):
+                ext = "json"
+            if ext not in datasource.FORMATS:
                 continue
             if not self.catalog.table_exists(db, base):
                 continue
@@ -357,10 +375,9 @@ class QueryEngine:
         self.catalog.create_table(
             db, name, schema, options={**dict(stmt.options), "engine": "metric"},
             if_not_exists=True,
+            column_order=[c.name for c in stmt.columns] or None,
+            region_ids=[meta.logical_region],
         )
-        info = self.catalog.table(db, name)
-        info.region_ids = [meta.logical_region]
-        self.catalog.update_table(info)
         self._open_regions.add(meta.logical_region)
         return QueryResult.of_affected(0)
 
@@ -427,6 +444,7 @@ class QueryEngine:
                 region.sst_writer.schema = new_schema
                 region.manifest.record_schema(new_schema)
             info.schema = new_schema
+            self._refresh_column_order(info, added=col.name)
             self.catalog.update_table(info)
             return QueryResult.of_affected(0)
         if stmt.action == "drop_column":
@@ -443,6 +461,7 @@ class QueryEngine:
                 region.sst_writer.schema = new_schema
                 region.manifest.record_schema(new_schema)
             info.schema = new_schema
+            self._refresh_column_order(info, dropped=stmt.column_name)
             self.catalog.update_table(info)
             return QueryResult.of_affected(0)
         raise PlanError(f"unsupported ALTER action {stmt.action}")

@@ -177,6 +177,10 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._handle_prom_remote_read()
             if path in ("/v1/otlp/v1/metrics",):
                 return self._handle_otlp_metrics()
+            if path == "/v1/scripts":
+                return self._handle_scripts()
+            if path == "/v1/run-script":
+                return self._handle_run_script()
             return self._send(404, {"error": f"no route {path}"})
         except Exception as e:  # noqa: BLE001 — wire boundary
             traceback.print_exc()
@@ -384,6 +388,57 @@ class _Handler(BaseHTTPRequestHandler):
         n = handle_otlp_metrics(self.query_engine, body, db)
         self._send(200, {"partialSuccess": {}})
         _ = n
+
+    # ---- scripts (reference http.rs scripts router + src/script) -----------
+
+    def _script_engine(self):
+        qe = self.query_engine
+        if not hasattr(qe, "_script_engine"):
+            from greptimedb_tpu.script import ScriptEngine
+            qe._script_engine = ScriptEngine(qe)
+        return qe._script_engine
+
+    def _handle_scripts(self):
+        from greptimedb_tpu.script import ScriptError
+
+        params = self._params()
+        db = params.get("db", "public")
+        name = params.get("name")
+        if self.command == "GET":
+            if name:
+                code = self._script_engine().get_script(db, name)
+                if code is None:
+                    return self._send(404, {"error": f"script {name!r} not found"})
+                return self._send(200, {"code": 0, "script": code})
+            return self._send(200, {"code": 0,
+                                    "scripts": self._script_engine().list_scripts(db)})
+        if not name:
+            return self._send(400, {"error": "missing name"})
+        code = self._body().decode()
+        try:
+            self._script_engine().insert_script(db, name, code)
+        except ScriptError as e:
+            return self._send(400, {"code": 1004, "error": str(e)})
+        return self._send(200, {"code": 0})
+
+    def _handle_run_script(self):
+        from greptimedb_tpu.script import ScriptError
+
+        params = self._params()
+        db = params.get("db", "public")
+        name = params.get("name")
+        if not name:
+            return self._send(400, {"error": "missing name"})
+        t0 = time.perf_counter()
+        try:
+            with QUERY_DURATION.time(kind="script"):
+                result = self._script_engine().run_script(db, name)
+        except ScriptError as e:
+            return self._send(400, {"code": 1004, "error": str(e)})
+        elapsed = round((time.perf_counter() - t0) * 1000, 3)
+        return self._send(200, {"code": 0,
+                                "output": [{"records": _records_json(result)}],
+                                "execution_time_ms": elapsed})
 
     def _handle_opentsdb_put(self):
         """OpenTSDB JSON put (reference servers/src/opentsdb.rs +

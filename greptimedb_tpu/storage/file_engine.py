@@ -118,22 +118,17 @@ class FileRegion:
                 raise FileEngineError(
                     f"column {c.name!r} missing from {self.path!r}")
             vals = t.column(c.name).to_pylist()
-            if c.semantic is SemanticType.TAG:
-                svals = np.asarray(
-                    ["" if v is None else str(v) for v in vals], dtype=object)
-                uniq, codes = np.unique(svals.astype(str), return_inverse=True)
-                columns[c.name] = codes.astype(np.int32)
-                tag_dicts[c.name] = uniq.astype(object)
+            if c.semantic is SemanticType.TAG or c.dtype.is_string:
+                # NULLs encode as code -1, same as native regions
+                from greptimedb_tpu.datatypes.vector import DictVector
+                dv = DictVector.encode(
+                    [None if v is None else str(v) for v in vals])
+                columns[c.name] = dv.codes
+                tag_dicts[c.name] = dv.values
             elif c.dtype.is_timestamp:
                 columns[c.name] = np.asarray(
                     [coerce_ts_literal(v, c.dtype) for v in vals],
                     dtype=np.int64)
-            elif c.dtype.is_string:
-                svals = np.asarray(
-                    ["" if v is None else str(v) for v in vals], dtype=object)
-                uniq, codes = np.unique(svals.astype(str), return_inverse=True)
-                columns[c.name] = codes.astype(np.int32)
-                tag_dicts[c.name] = uniq.astype(object)
             elif c.dtype.is_float:
                 columns[c.name] = np.asarray(
                     [np.nan if v is None else float(v) for v in vals],

@@ -205,6 +205,58 @@ class TestFileEngine:
         desc = qe.execute_one("DESCRIBE TABLE pos").rows()
         assert [row[0] for row in desc] == ["host", "v", "ts"]
 
+    def test_external_reopen_fresh_engine(self, qe, tmp_path):
+        """After a full restart (new RegionEngine + QueryEngine over the
+        same kv), the file opener is registered eagerly and the external
+        table still reads."""
+        t = pa.table({"host": ["z"], "v": [5.0], "ts": [1000]})
+        path = str(tmp_path / "fresh.parquet")
+        datasource.write_file(t, path)
+        qe.execute_one(
+            f"CREATE EXTERNAL TABLE fr (host STRING, v DOUBLE, "
+            f"ts TIMESTAMP(3) TIME INDEX, PRIMARY KEY(host)) "
+            f"WITH (location = '{path}')")
+        engine2 = RegionEngine(EngineConfig(data_dir=str(tmp_path / "d2")))
+        qe2 = QueryEngine(qe.catalog, engine2)
+        try:
+            assert qe2.execute_one("SELECT v FROM fr").rows() == [[5.0]]
+        finally:
+            engine2.close()
+
+    def test_null_tags_match_native_semantics(self, qe, tmp_path):
+        t = pa.table({"host": ["x", None], "v": [1.0, 2.0],
+                      "ts": [1000, 2000]})
+        path = str(tmp_path / "nulls.parquet")
+        datasource.write_file(t, path)
+        qe.execute_one(
+            f"CREATE EXTERNAL TABLE nt (host STRING, v DOUBLE, "
+            f"ts TIMESTAMP(3) TIME INDEX, PRIMARY KEY(host)) "
+            f"WITH (location = '{path}')")
+        rows = qe.execute_one("SELECT host, v FROM nt ORDER BY ts").rows()
+        assert rows == [["x", 1.0], [None, 2.0]]
+
+    def test_copy_database_ndjson_roundtrip(self, qe, tmp_path):
+        outdir = str(tmp_path / "njback")
+        r = qe.execute_one(
+            f"COPY DATABASE public TO '{outdir}' WITH (format = 'ndjson')")
+        assert r.affected_rows == 3
+        qe.execute_one("TRUNCATE TABLE cpu")
+        r = qe.execute_one(
+            f"COPY DATABASE public FROM '{outdir}' WITH (format = 'ndjson')")
+        assert r.affected_rows == 3
+
+    def test_alter_updates_column_order(self, qe):
+        qe.execute_one("ALTER TABLE cpu ADD COLUMN extra DOUBLE")
+        desc = [row[0] for row in qe.execute_one("DESCRIBE TABLE cpu").rows()]
+        assert desc == ["host", "usage", "ts", "extra"]
+        qe.execute_one("INSERT INTO cpu VALUES ('c', 5.0, 5000, 7.0)")
+        assert qe.execute_one(
+            "SELECT extra FROM cpu WHERE host = 'c'").rows() == [[7.0]]
+        qe.execute_one("ALTER TABLE cpu DROP COLUMN extra")
+        desc = [row[0] for row in qe.execute_one("DESCRIBE TABLE cpu").rows()]
+        assert desc == ["host", "usage", "ts"]
+        qe.execute_one("INSERT INTO cpu VALUES ('d', 6.0, 6000)")
+
     def test_drop_external_table(self, qe, tmp_path):
         t = pa.table({"host": ["x"], "v": [1.0], "ts": [1000]})
         path = str(tmp_path / "dr.csv")

@@ -1,0 +1,144 @@
+"""Script engine: @coprocessor binding, persistence, HTTP endpoints
+(reference src/script)."""
+
+import json
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+from greptimedb_tpu.catalog.catalog import Catalog
+from greptimedb_tpu.catalog.kv import MemoryKv
+from greptimedb_tpu.query.engine import QueryEngine
+from greptimedb_tpu.script import ScriptEngine, ScriptError
+from greptimedb_tpu.storage.engine import EngineConfig, RegionEngine
+
+
+@pytest.fixture
+def qe(tmp_path):
+    engine = RegionEngine(EngineConfig(data_dir=str(tmp_path)))
+    q = QueryEngine(Catalog(MemoryKv()), engine)
+    q.execute_one(
+        "CREATE TABLE cpu (host STRING, usage DOUBLE, ts TIMESTAMP(3) TIME INDEX, "
+        "PRIMARY KEY(host))"
+    )
+    q.execute_one(
+        "INSERT INTO cpu (host, usage, ts) VALUES "
+        "('a', 1.0, 1000), ('a', 3.0, 61000), ('b', 10.0, 2000)"
+    )
+    yield q
+    engine.close()
+
+
+@pytest.fixture
+def se(qe):
+    return ScriptEngine(qe)
+
+
+DOUBLE_SCRIPT = '''
+@coprocessor(args=["host", "usage"], returns=["host", "doubled"],
+             sql="SELECT host, usage FROM cpu ORDER BY ts")
+def double(host, usage):
+    return host, usage * 2
+'''
+
+
+class TestCoprocessor:
+    def test_sql_bound_args(self, se):
+        r = se.execute(DOUBLE_SCRIPT)
+        assert r.names == ["host", "doubled"]
+        assert r.rows() == [["a", 2.0], ["b", 20.0], ["a", 6.0]]
+
+    def test_jax_in_script(self, se):
+        code = '''
+@coprocessor(args=["usage"], returns=["total"],
+             sql="SELECT usage FROM cpu")
+def total(usage):
+    import jax.numpy as jnp
+    return jnp.sum(jnp.asarray(usage))
+'''
+        r = se.execute(code)
+        assert r.rows() == [[14.0]]
+
+    def test_query_api(self, se):
+        code = '''
+@coprocessor(returns=["n"])
+def count():
+    cols = query("SELECT usage FROM cpu")
+    return np.asarray([len(cols["usage"])])
+'''
+        r = se.execute(code)
+        assert r.rows() == [[3]]
+
+    def test_params(self, se):
+        code = '''
+@coprocessor(args=["x"], returns=["y"])
+def scale(x):
+    return np.asarray(x) * 10
+'''
+        r = se.execute(code, params={"x": [1, 2]})
+        assert r.rows() == [[10], [20]]
+
+    def test_errors(self, se):
+        with pytest.raises(ScriptError):
+            se.execute("x = 1")  # no coprocessor
+        with pytest.raises(ScriptError):
+            se.execute("def broken(:\n  pass")  # syntax error
+        with pytest.raises(ScriptError):
+            se.execute('''
+@coprocessor(args=["nope"], returns=["y"], sql="SELECT usage FROM cpu")
+def f(nope):
+    return nope
+''')
+
+
+class TestPersistence:
+    def test_insert_get_list_delete(self, se):
+        se.insert_script("public", "double", DOUBLE_SCRIPT)
+        assert se.get_script("public", "double") == DOUBLE_SCRIPT
+        assert se.list_scripts("public") == ["double"]
+        r = se.run_script("public", "double")
+        assert r.num_rows == 3
+        se.delete_script("public", "double")
+        assert se.get_script("public", "double") is None
+        with pytest.raises(ScriptError):
+            se.run_script("public", "double")
+
+    def test_invalid_script_not_persisted(self, se):
+        with pytest.raises(ScriptError):
+            se.insert_script("public", "bad", "not python ((")
+        assert se.list_scripts("public") == []
+
+
+class TestHttpScripts:
+    @pytest.fixture
+    def server(self, qe):
+        from greptimedb_tpu.servers.http import HttpServer
+
+        srv = HttpServer(qe, port=0)
+        srv.start()
+        yield srv
+        srv.stop()
+
+    def _post(self, port, path, body=b""):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}", data=body, method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    def test_save_and_run(self, server):
+        st, body = self._post(server.port, "/v1/scripts?db=public&name=double",
+                              DOUBLE_SCRIPT.encode())
+        assert st == 200 and body["code"] == 0
+        st, body = self._post(server.port, "/v1/run-script?db=public&name=double")
+        assert st == 200
+        rows = body["output"][0]["records"]["rows"]
+        assert rows == [["a", 2.0], ["b", 20.0], ["a", 6.0]]
+
+    def test_run_missing(self, server):
+        st, body = self._post(server.port, "/v1/run-script?name=nope")
+        assert st == 400
