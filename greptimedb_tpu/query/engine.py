@@ -194,13 +194,18 @@ class QueryEngine:
             # SELECT <literals>
             names, cols, dtypes = [], [], []
             for i, it in enumerate(sel.items):
-                v = eval_host(it.expr, {}, None, None)
+                if isinstance(it.expr, ast.FuncCall) and it.expr.name in (
+                        "database", "current_schema", "schema"):
+                    v = ctx.db
+                else:
+                    v = eval_host(it.expr, {}, None, None)
                 arr = np.asarray([v]) if np.ndim(v) == 0 else np.asarray(v)
                 names.append(it.alias or f"column{i}")
                 dtypes.append(None)
                 cols.append(arr)
             return QueryResult(names, dtypes, cols)
         info = self._table(sel.table, ctx)
+        sel = _subst_session_funcs(sel, ctx)
         plan = plan_select(sel, info)
         return self.executor.execute(plan)
 
@@ -657,6 +662,41 @@ class QueryEngine:
 
         engine = PromqlEngine(self)
         return engine.eval_range(stmt.query, stmt.start, stmt.end, stmt.step, ctx)
+
+
+def _subst_expr(e, ctx):
+    """Replace session-dependent zero-arg functions (database(),
+    timezone()) with literals before planning."""
+    import dataclasses
+
+    if isinstance(e, ast.FuncCall):
+        if e.name in ("database", "current_schema", "schema"):
+            return ast.Literal(ctx.db)
+        if e.name == "timezone":
+            return ast.Literal(ctx.timezone)
+    if not dataclasses.is_dataclass(e):
+        return e
+    changes = {}
+    for f in dataclasses.fields(e):
+        v = getattr(e, f.name)
+        if isinstance(v, ast.Expr):
+            nv = _subst_expr(v, ctx)
+            if nv is not v:
+                changes[f.name] = nv
+        elif isinstance(v, (tuple, list)) and any(
+                isinstance(x, ast.Expr) for x in v):
+            nv = type(v)(_subst_expr(x, ctx) if isinstance(x, ast.Expr) else x
+                         for x in v)
+            changes[f.name] = nv
+    return dataclasses.replace(e, **changes) if changes else e
+
+
+def _subst_session_funcs(sel: ast.Select, ctx: QueryContext) -> ast.Select:
+    import dataclasses
+
+    items = [ast.SelectItem(_subst_expr(it.expr, ctx), it.alias)
+             for it in sel.items]
+    return dataclasses.replace(sel, items=items)
 
 
 def _cached_rule(info: TableInfo):

@@ -181,6 +181,8 @@ _DEVICE_FUNCS = {
     "ln": jnp.log, "log": jnp.log, "log2": jnp.log2, "log10": jnp.log10,
     "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan,
     "asin": jnp.arcsin, "acos": jnp.arccos, "atan": jnp.arctan,
+    "sinh": jnp.sinh, "cosh": jnp.cosh, "tanh": jnp.tanh,
+    "degrees": jnp.degrees, "radians": jnp.radians,
     "floor": jnp.floor, "ceil": jnp.ceil, "signum": jnp.sign,
     "trunc": jnp.trunc,
 }
@@ -318,6 +320,21 @@ def _eval_device_func(e: ast.FuncCall, ev, cols, schema: Schema):
         return jnp.round(v)
     if name == "clamp":
         return jnp.clip(ev(e.args[0]), ev(e.args[1]), ev(e.args[2]))
+    if name in ("mod", "atan2") and len(e.args) == 2:
+        f = jnp.mod if name == "mod" else jnp.arctan2
+        return f(ev(e.args[0]), ev(e.args[1]))
+    if name in ("greatest", "least") and len(e.args) >= 2:
+        f = jnp.maximum if name == "greatest" else jnp.minimum
+        out = ev(e.args[0])
+        for a in e.args[1:]:
+            out = f(out, ev(a))
+        return out
+    if name == "coalesce" and e.args:
+        out = ev(e.args[0])
+        for a in e.args[1:]:
+            nxt = ev(a)
+            out = jnp.where(jnp.isnan(out), nxt, out)
+        return out
     if name in _DEVICE_FUNCS and len(e.args) == 1:
         return _DEVICE_FUNCS[name](ev(e.args[0]))
     if name == "to_unixtime":
@@ -501,11 +518,55 @@ def _eval_host_func(e: ast.FuncCall, ev, schema):
         "log": np.log, "log2": np.log2, "log10": np.log10,
         "floor": np.floor, "ceil": np.ceil, "signum": np.sign,
         "sin": np.sin, "cos": np.cos, "tan": np.tan, "trunc": np.trunc,
+        "asin": np.arcsin, "acos": np.arccos, "atan": np.arctan,
+        "sinh": np.sinh, "cosh": np.cosh, "tanh": np.tanh,
+        "degrees": np.degrees, "radians": np.radians,
     }
     if name in np_funcs and len(e.args) == 1:
         return np_funcs[name](np.asarray(ev(e.args[0]), dtype=np.float64))
     if name in ("pow", "power"):
         return np.power(ev(e.args[0]), ev(e.args[1]))
+    if name in ("mod", "atan2") and len(e.args) == 2:
+        f = np.mod if name == "mod" else np.arctan2
+        return f(np.asarray(ev(e.args[0]), dtype=np.float64),
+                 np.asarray(ev(e.args[1]), dtype=np.float64))
+    if name in ("greatest", "least") and len(e.args) >= 2:
+        f = np.maximum if name == "greatest" else np.minimum
+        out = np.asarray(ev(e.args[0]))
+        for a in e.args[1:]:
+            out = f(out, np.asarray(ev(a)))
+        return out
+    if name == "coalesce" and e.args:
+        out = np.asarray(ev(e.args[0]), dtype=np.float64)
+        for a in e.args[1:]:
+            nxt = np.broadcast_to(
+                np.asarray(ev(a), dtype=np.float64), out.shape)
+            out = np.where(np.isnan(out), nxt, out)
+        return out
+    if name == "clamp" and len(e.args) == 3:
+        return np.clip(np.asarray(ev(e.args[0]), dtype=np.float64),
+                       ev(e.args[1]), ev(e.args[2]))
+    if name == "to_unixtime" and len(e.args) == 1:
+        unit = _col_unit_nanos(e.args[0], schema) if schema else 10**6
+        return np.asarray(ev(e.args[0])) * unit // 10**9
+    if name == "date_format" and len(e.args) == 2:
+        import datetime as _dt
+        unit = _col_unit_nanos(e.args[0], schema) if schema else 10**6
+        fmt = str(_lit(e.args[1]))
+        vals = np.atleast_1d(np.asarray(ev(e.args[0]), dtype=np.int64))
+        out = np.asarray([
+            _dt.datetime.fromtimestamp(v * unit / 1e9, _dt.timezone.utc)
+            .strftime(fmt) for v in vals.tolist()], dtype=object)
+        return out
+    if name == "version":
+        return "8.0.0-greptimedb-tpu"
+    if name == "build":
+        from greptimedb_tpu import __version__
+        return f"greptimedb_tpu {__version__} (jax/XLA TPU backend)"
+    if name in ("database", "current_schema", "schema"):
+        return "public"  # overridden with session db in engine._select
+    if name == "timezone":
+        return "UTC"
     if name == "round":
         v = np.asarray(ev(e.args[0]), dtype=np.float64)
         d = int(_lit(e.args[1])) if len(e.args) > 1 else 0
@@ -705,4 +766,6 @@ def collect_aggregates(e: Optional[ast.Expr], out: list) -> list:
 AGG_FUNCS = {
     "count", "sum", "avg", "mean", "min", "max", "first", "last",
     "last_value", "first_value", "stddev", "variance",
+    "argmax", "argmin", "median", "percentile", "approx_percentile_cont",
+    "polyval",
 }

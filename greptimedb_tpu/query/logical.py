@@ -35,9 +35,10 @@ class Filter(LogicalPlan):
 @dataclass
 class AggSpec:
     name: str  # output name
-    func: str  # sum|count|avg|min|max|first|last|stddev|variance|rows
+    func: str  # sum|count|avg|min|max|first|last|stddev|variance|rows|host aggs
     arg: Optional[ast.Expr]  # None for count(*)
     call: ast.FuncCall  # original node (env key for post-agg exprs)
+    extra_args: tuple = ()  # literal params (percentile p, polyval x)
 
 
 @dataclass

@@ -312,11 +312,14 @@ class PhysicalExecutor:
                 "add predicates or reduce keys"
             )
 
-        # aggregate args -> values matrix columns
+        # aggregate args -> values matrix columns (host-computed
+        # order-statistic aggs don't consume a device value plane)
+        from greptimedb_tpu.query.host_agg import HOST_AGGS
+
         arg_exprs: list[ast.Expr] = []
         spec_slot: list[Optional[int]] = []
         for spec in agg.aggs:
-            if spec.arg is None:
+            if spec.arg is None or spec.func in HOST_AGGS:
                 spec_slot.append(None)
                 continue
             b = bind_expr(spec.arg, ctx)
@@ -325,7 +328,8 @@ class PhysicalExecutor:
             spec_slot.append(arg_exprs.index(b))
         ops: set = {"rows"}
         for spec in agg.aggs:
-            ops.update(_PRIMITIVES[spec.func])
+            if spec.func not in HOST_AGGS:
+                ops.update(_PRIMITIVES[spec.func])
         need_ts = bool({"first", "last"} & ops)
 
         acc = self._stream_agg(scan, table, bound_where, tuple(keys),
@@ -346,11 +350,43 @@ class PhysicalExecutor:
             env[kexpr] = col
             key_cols[name] = (col, dtype)
         # aggregate outputs
+        host_specs = [s for s in agg.aggs if s.func in HOST_AGGS]
         for spec, slot in zip(agg.aggs, spec_slot):
+            if spec.func in HOST_AGGS:
+                continue
             env[spec.call] = _finalize_agg(spec.func, acc, slot, present)
+        if host_specs:
+            self._host_aggs(host_specs, keys, scan, extra_cols, bound_where,
+                            table, ctx, num_groups, present, env)
 
         return self._post_process(env, agg, having, project, sort, limit, offset,
                                   table, len(present))
+
+    def _host_aggs(self, host_specs, keys, scan, extra_cols, bound_where,
+                   table, ctx, num_groups, present, env):
+        """Order-statistic aggregates (argmax/percentile/…) over host
+        columns — see host_agg.py for the sort-based group pass. Uses the
+        BOUND where/arg exprs (tag literals → codes, ts literals coerced),
+        so host evaluation over the raw scan columns matches the device
+        semantics exactly."""
+        from greptimedb_tpu.query import host_agg as ha
+        from greptimedb_tpu.query.expr import bind_expr, eval_host
+
+        strides = _strides([k.size for k in keys])
+        gid = ha.row_group_ids(keys, strides, scan, extra_cols)
+        n = scan.num_rows
+        dmask = self._maybe_dedup(scan, table, ctx)
+        mask = ha.host_row_mask(
+            scan, bound_where, table.schema, n,
+            np.asarray(dmask)[:n] if dmask is not None else None)
+        for spec in host_specs:
+            bound_arg = bind_expr(spec.arg, ctx)
+            vals = eval_host(bound_arg, scan.columns, table.schema, None, n)
+            vals = np.broadcast_to(
+                np.asarray(vals, dtype=np.float64), (n,))
+            per_group = ha.compute_host_agg(
+                spec.func, gid, vals, mask, num_groups, spec.extra_args)
+            env[spec.call] = per_group[present]
 
     def _plan_key(self, i, kexpr, ctx, scan: ScanData, scan_node, extra_cols):
         schema = ctx.schema
@@ -533,9 +569,18 @@ class PhysicalExecutor:
 
     def _maybe_dedup(self, scan: ScanData, table, ctx) -> Optional[jax.Array]:
         """Device-resident last-write-wins mask (stays on device; sliced
-        per block without a host round-trip)."""
+        per block without a host round-trip). Memoized per ScanData so a
+        query mixing device and host aggregates computes it once."""
         if table.append_mode or not scan.needs_dedup:
             return None
+        cached = getattr(scan, "_dedup_mask_cache", None)
+        if cached is not None:
+            return cached
+        mask = self._compute_dedup(scan, table)
+        scan._dedup_mask_cache = mask
+        return mask
+
+    def _compute_dedup(self, scan: ScanData, table) -> jax.Array:
         tag_names = [c.name for c in table.schema.tag_columns]
         if tag_names:
             sizes = [len(scan.tag_dicts[t]) + 1 for t in tag_names]

@@ -25,7 +25,14 @@ _FUNC_CANON = {
     "first": "first", "first_value": "first",
     "last": "last", "last_value": "last",
     "stddev": "stddev", "variance": "variance",
+    # order-statistic UDAFs (reference common/function scalars/aggregate)
+    "argmax": "argmax", "argmin": "argmin", "median": "median",
+    "percentile": "percentile", "approx_percentile_cont": "percentile",
+    "polyval": "polyval",
 }
+
+#: funcs taking a literal parameter after the column arg
+_PARAM_AGGS = {"percentile", "polyval"}
 
 
 def plan_select(sel: ast.Select, table: TableInfo) -> lp.LogicalPlan:
@@ -105,7 +112,26 @@ def plan_select(sel: ast.Select, table: TableInfo) -> lp.LogicalPlan:
                 raise PlanError(f"{call.name} needs an argument")
             else:
                 arg = call.args[0]
-            specs.append(lp.AggSpec(_default_name(call), func, arg, call))
+            extra: tuple = ()
+            if func in _PARAM_AGGS:
+                if len(call.args) != 2 or not isinstance(call.args[1], ast.Literal):
+                    raise PlanError(
+                        f"{call.name} needs (column, <numeric literal>)")
+                try:
+                    p = float(call.args[1].value)
+                except (TypeError, ValueError) as exc:
+                    raise PlanError(
+                        f"{call.name} parameter must be numeric, got "
+                        f"{call.args[1].value!r}") from exc
+                if call.name == "approx_percentile_cont":
+                    # standard signature takes a FRACTION in [0, 1]
+                    if not 0.0 <= p <= 1.0:
+                        raise PlanError(
+                            "approx_percentile_cont fraction must be in [0, 1]")
+                    p *= 100.0
+                extra = (p,)
+            specs.append(lp.AggSpec(_default_name(call), func, arg, call,
+                                    extra_args=extra))
         plan = lp.Aggregate(plan, keys, specs)
         _validate_agg_items(items, group_exprs, agg_calls)
         if having is not None:

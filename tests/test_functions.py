@@ -1,0 +1,176 @@
+"""Function library parity (reference src/common/function): scalar math,
+date functions, system functions, and order-statistic aggregates
+(argmax/argmin/median/percentile/polyval)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from greptimedb_tpu.catalog.catalog import Catalog
+from greptimedb_tpu.catalog.kv import MemoryKv
+from greptimedb_tpu.query.engine import QueryContext, QueryEngine
+from greptimedb_tpu.storage.engine import EngineConfig, RegionEngine
+
+
+@pytest.fixture
+def qe(tmp_path):
+    engine = RegionEngine(EngineConfig(data_dir=str(tmp_path)))
+    q = QueryEngine(Catalog(MemoryKv()), engine)
+    q.execute_one(
+        "CREATE TABLE cpu (host STRING, usage DOUBLE, ts TIMESTAMP(3) TIME INDEX, "
+        "PRIMARY KEY(host))"
+    )
+    q.execute_one(
+        "INSERT INTO cpu (host, usage, ts) VALUES "
+        "('a', 1.0, 1000), ('a', 3.0, 2000), ('a', 2.0, 3000), "
+        "('b', 10.0, 1000), ('b', 30.0, 2000), ('b', 20.0, 3000)"
+    )
+    yield q
+    engine.close()
+
+
+def one(qe, sql):
+    return qe.execute_one(sql).rows()[0][0]
+
+
+class TestScalarFunctions:
+    def test_math_literals(self, qe):
+        assert one(qe, "SELECT abs(-3)") == 3
+        assert one(qe, "SELECT mod(7, 3)") == pytest.approx(1.0)
+        assert one(qe, "SELECT atan2(1, 1)") == pytest.approx(math.pi / 4)
+        assert one(qe, "SELECT degrees(3.141592653589793)") == pytest.approx(180.0)
+        assert one(qe, "SELECT radians(180)") == pytest.approx(math.pi)
+        assert one(qe, "SELECT sinh(0)") == pytest.approx(0.0)
+        assert one(qe, "SELECT greatest(1, 5, 3)") == 5
+        assert one(qe, "SELECT least(4, 2, 9)") == 2
+
+    def test_math_on_columns(self, qe):
+        rows = qe.execute_one(
+            "SELECT host, mod(usage, 3) AS m FROM cpu WHERE ts = 2000 "
+            "ORDER BY host").rows()
+        assert rows == [["a", 0.0], ["b", 0.0]]
+        rows = qe.execute_one(
+            "SELECT greatest(usage, 15.0) AS g FROM cpu WHERE host = 'b' "
+            "ORDER BY ts").rows()
+        assert [r[0] for r in rows] == [15.0, 30.0, 20.0]
+
+    def test_date_format(self, qe):
+        r = one(qe, "SELECT date_format(ts, '%Y-%m-%d %H:%M:%S') "
+                    "FROM cpu WHERE host = 'a' AND ts = 1000")
+        assert r == "1970-01-01 00:00:01"
+
+    def test_system_functions(self, qe):
+        assert "greptimedb-tpu" in one(qe, "SELECT version()")
+        assert "jax" in one(qe, "SELECT build()")
+        assert one(qe, "SELECT timezone()") == "UTC"
+        assert one(qe, "SELECT database()") == "public"
+        ctx = QueryContext(db="other")
+        qe.execute_one("CREATE DATABASE other")
+        assert qe.execute_one("SELECT database()", ctx).rows()[0][0] == "other"
+
+
+class TestOrderStatAggs:
+    def test_median(self, qe):
+        rows = qe.execute_one(
+            "SELECT host, median(usage) FROM cpu GROUP BY host "
+            "ORDER BY host").rows()
+        assert rows == [["a", 2.0], ["b", 20.0]]
+
+    def test_percentile(self, qe):
+        rows = qe.execute_one(
+            "SELECT host, percentile(usage, 50) FROM cpu GROUP BY host "
+            "ORDER BY host").rows()
+        assert rows == [["a", 2.0], ["b", 20.0]]
+        # p0 / p100 = min / max
+        assert one(qe, "SELECT percentile(usage, 0) FROM cpu") == 1.0
+        assert one(qe, "SELECT percentile(usage, 100) FROM cpu") == 30.0
+        # interpolation between order statistics
+        r = one(qe, "SELECT percentile(usage, 90) FROM cpu")
+        assert r == pytest.approx(np.percentile(
+            [1.0, 3.0, 2.0, 10.0, 30.0, 20.0], 90))
+
+    def test_argmax_argmin(self, qe):
+        # argmax/argmin return the row position of the extreme within the scan
+        r = qe.execute_one(
+            "SELECT host, argmax(usage) AS am FROM cpu GROUP BY host "
+            "ORDER BY host")
+        am = dict(r.rows())
+        # verify the indices point at the right rows
+        raw = qe.execute_one("SELECT host, usage FROM cpu").rows()
+        assert raw[int(am["a"])] == ["a", 3.0]
+        assert raw[int(am["b"])] == ["b", 30.0]
+        r2 = qe.execute_one("SELECT argmin(usage) FROM cpu")
+        assert raw[int(r2.rows()[0][0])] == ["a", 1.0]
+
+    def test_polyval(self, qe):
+        qe.execute_one("CREATE TABLE coef (k STRING, c DOUBLE, "
+                       "ts TIMESTAMP(3) TIME INDEX, PRIMARY KEY(k))")
+        # coefficients 2, 3, 5 (highest degree first): 2x^2 + 3x + 5 at x=2 = 19
+        qe.execute_one("INSERT INTO coef (k, c, ts) VALUES "
+                       "('p', 2, 1), ('p', 3, 2), ('p', 5, 3)")
+        assert one(qe, "SELECT polyval(c, 2) FROM coef") == pytest.approx(19.0)
+
+    def test_mixed_device_and_host_aggs(self, qe):
+        rows = qe.execute_one(
+            "SELECT host, avg(usage), median(usage), max(usage) FROM cpu "
+            "GROUP BY host ORDER BY host").rows()
+        assert rows == [["a", 2.0, 2.0, 3.0], ["b", 20.0, 20.0, 30.0]]
+
+    def test_host_agg_with_where(self, qe):
+        rows = qe.execute_one(
+            "SELECT host, median(usage) FROM cpu WHERE usage > 1.5 "
+            "GROUP BY host ORDER BY host").rows()
+        assert rows == [["a", 2.5], ["b", 20.0]]
+
+    def test_host_agg_time_bucket(self, qe):
+        rows = qe.execute_one(
+            "SELECT date_bin('1s', ts) AS b, median(usage) FROM cpu "
+            "GROUP BY b ORDER BY b").rows()
+        assert rows == [[1000, 5.5], [2000, 16.5], [3000, 11.0]]
+
+    def test_host_agg_with_ts_string_predicate(self, qe):
+        rows = qe.execute_one(
+            "SELECT host, median(usage) FROM cpu "
+            "WHERE ts >= '1970-01-01 00:00:02' GROUP BY host "
+            "ORDER BY host").rows()
+        assert rows == [["a", 2.5], ["b", 25.0]]
+
+    def test_host_agg_with_tag_predicate(self, qe):
+        rows = qe.execute_one(
+            "SELECT median(usage) FROM cpu WHERE host = 'b'").rows()
+        assert rows == [[20.0]]
+
+    def test_approx_percentile_cont_fraction(self, qe):
+        r = qe.execute_one(
+            "SELECT approx_percentile_cont(usage, 0.5) FROM cpu "
+            "WHERE host = 'a'").rows()
+        assert r == [[2.0]]
+        from greptimedb_tpu.query.expr import PlanError
+        with pytest.raises(PlanError):
+            qe.execute_one("SELECT approx_percentile_cont(usage, 95) FROM cpu")
+
+    def test_database_in_table_query(self, qe):
+        qe.execute_one("CREATE DATABASE otherdb")
+        ctx = QueryContext(db="otherdb")
+        qe.execute_one(
+            "CREATE TABLE t (host STRING, v DOUBLE, ts TIMESTAMP(3) TIME INDEX, "
+            "PRIMARY KEY(host))", ctx)
+        qe.execute_one("INSERT INTO t (host, v, ts) VALUES ('x', 1, 1000)", ctx)
+        rows = qe.execute_one(
+            "SELECT database(), count(*) FROM t", ctx).rows()
+        assert rows == [["otherdb", 1]]
+
+    def test_percentile_non_numeric_param(self, qe):
+        from greptimedb_tpu.query.expr import PlanError
+
+        with pytest.raises(PlanError):
+            qe.execute_one("SELECT percentile(usage, 'abc') FROM cpu")
+
+    def test_percentile_validation(self, qe):
+        from greptimedb_tpu.query.expr import PlanError
+
+        with pytest.raises(PlanError):
+            qe.execute_one("SELECT percentile(usage, 150) FROM cpu")
+        with pytest.raises(PlanError):
+            qe.execute_one("SELECT percentile(usage) FROM cpu")
