@@ -1,0 +1,267 @@
+"""Object storage abstraction (mirrors reference `src/object-store`: the
+OpenDAL wrapper with fs/s3/oss/azblob/gcs backends and the
+`LruCacheLayer` read-through disk cache, src/object-store/src/layers/
+lru_cache/, backend selection at src/datanode/src/store.rs:44-116).
+
+Backends here: `FsStore` (local filesystem, atomic writes via
+tmp+rename) and `MemoryStore` (tests / ephemeral). Remote backends (s3
+etc.) would slot in behind the same five-method interface; this
+environment has no egress, so none are shipped — the cache layer is
+where remote-read economics happen anyway.
+
+`LruCacheLayer` wraps any store with a byte-budgeted read-through LRU —
+the analog of the reference's disk cache for object-store reads. SST
+reads go through `open_input`, which returns a zero-copy reader:
+memory-mapped for fs, buffer-backed for memory/cached stores.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+from typing import Optional
+
+import pyarrow as pa
+
+from greptimedb_tpu.utils.metrics import REGISTRY
+
+OBJECT_STORE_READS = REGISTRY.counter(
+    "greptimedb_tpu_object_store_reads_total",
+    "Object store reads by backend and cache outcome")
+OBJECT_STORE_BYTES = REGISTRY.counter(
+    "greptimedb_tpu_object_store_read_bytes_total",
+    "Object store bytes read")
+
+
+class ObjectStoreError(Exception):
+    pass
+
+
+class ObjectStore:
+    """Five-method contract: read / write / delete / exists / list,
+    plus `open_input` for zero-copy columnar reads."""
+
+    name = "base"
+
+    def read(self, key: str) -> bytes:
+        raise NotImplementedError
+
+    def write(self, key: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    def delete(self, key: str) -> None:
+        raise NotImplementedError
+
+    def exists(self, key: str) -> bool:
+        raise NotImplementedError
+
+    def list(self, prefix: str) -> list[str]:
+        raise NotImplementedError
+
+    def open_input(self, key: str):
+        """A pyarrow-compatible random-access input for `key`."""
+        return pa.BufferReader(self.read(key))
+
+    def size(self, key: str) -> int:
+        return len(self.read(key))
+
+
+class FsStore(ObjectStore):
+    """Local filesystem backend; keys are paths. Writes are atomic
+    (tmp + rename), reads memory-map."""
+
+    name = "fs"
+
+    def read(self, key: str) -> bytes:
+        OBJECT_STORE_READS.inc(backend="fs", outcome="read")
+        try:
+            with open(key, "rb") as f:
+                data = f.read()
+        except FileNotFoundError as e:
+            raise ObjectStoreError(f"object {key!r} not found") from e
+        OBJECT_STORE_BYTES.inc(len(data))
+        return data
+
+    def write(self, key: str, data: bytes) -> None:
+        parent = os.path.dirname(key)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        tmp = key + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())  # durable before rename (manifest contract)
+        os.replace(tmp, key)
+
+    def delete(self, key: str) -> None:
+        try:
+            os.remove(key)
+        except FileNotFoundError:
+            pass
+
+    def exists(self, key: str) -> bool:
+        return os.path.exists(key)
+
+    def list(self, prefix: str) -> list[str]:
+        """Keys under a directory prefix (non-recursive, like a flat
+        object listing of `prefix/`)."""
+        d = prefix if os.path.isdir(prefix) else os.path.dirname(prefix)
+        if not os.path.isdir(d):
+            return []
+        return sorted(
+            os.path.join(d, n) for n in os.listdir(d)
+            if os.path.join(d, n).startswith(prefix)
+            and os.path.isfile(os.path.join(d, n)))
+
+    def open_input(self, key: str):
+        OBJECT_STORE_READS.inc(backend="fs", outcome="mmap")
+        try:
+            return pa.memory_map(key, "rb")
+        except FileNotFoundError as e:
+            raise ObjectStoreError(f"object {key!r} not found") from e
+
+    def size(self, key: str) -> int:
+        return os.path.getsize(key)
+
+
+class MemoryStore(ObjectStore):
+    """In-memory backend (reference kv_backend/memory analog for blobs)."""
+
+    name = "memory"
+
+    def __init__(self):
+        self._data: dict[str, bytes] = {}
+        self._lock = threading.Lock()
+
+    def read(self, key: str) -> bytes:
+        OBJECT_STORE_READS.inc(backend="memory", outcome="read")
+        with self._lock:
+            if key not in self._data:
+                raise ObjectStoreError(f"object {key!r} not found")
+            return self._data[key]
+
+    def write(self, key: str, data: bytes) -> None:
+        with self._lock:
+            self._data[key] = bytes(data)
+
+    def delete(self, key: str) -> None:
+        with self._lock:
+            self._data.pop(key, None)
+
+    def exists(self, key: str) -> bool:
+        with self._lock:
+            return key in self._data
+
+    def list(self, prefix: str) -> list[str]:
+        with self._lock:
+            return sorted(k for k in self._data if k.startswith(prefix))
+
+
+class LruCacheLayer(ObjectStore):
+    """Read-through LRU over another store, bounded by total bytes
+    (reference LruCacheLayer, object-store/src/layers/lru_cache/).
+
+    Writes go straight through and refresh the cache; deletes
+    invalidate. `open_input` serves a BufferReader over cached bytes —
+    repeated SST scans of remote objects skip the backend entirely."""
+
+    name = "lru_cache"
+
+    def __init__(self, inner: ObjectStore, capacity_bytes: int = 256 << 20):
+        self.inner = inner
+        self.capacity = capacity_bytes
+        self._cache: "OrderedDict[str, bytes]" = OrderedDict()
+        self._bytes = 0
+        self._lock = threading.Lock()
+
+    def _get_cached(self, key: str) -> Optional[bytes]:
+        with self._lock:
+            data = self._cache.get(key)
+            if data is not None:
+                self._cache.move_to_end(key)
+                OBJECT_STORE_READS.inc(backend=self.inner.name, outcome="hit")
+            return data
+
+    def _put_cached(self, key: str, data: bytes) -> None:
+        if len(data) > self.capacity:
+            return
+        with self._lock:
+            old = self._cache.pop(key, None)
+            if old is not None:
+                self._bytes -= len(old)
+            self._cache[key] = data
+            self._bytes += len(data)
+            while self._bytes > self.capacity:
+                _, evicted = self._cache.popitem(last=False)
+                self._bytes -= len(evicted)
+
+    def read(self, key: str) -> bytes:
+        data = self._get_cached(key)
+        if data is None:
+            OBJECT_STORE_READS.inc(backend=self.inner.name, outcome="miss")
+            data = self.inner.read(key)
+            self._put_cached(key, data)
+        return data
+
+    def write(self, key: str, data: bytes) -> None:
+        self.inner.write(key, data)
+        self._put_cached(key, bytes(data))
+
+    def delete(self, key: str) -> None:
+        self.inner.delete(key)
+        with self._lock:
+            old = self._cache.pop(key, None)
+            if old is not None:
+                self._bytes -= len(old)
+
+    def exists(self, key: str) -> bool:
+        with self._lock:
+            if key in self._cache:
+                return True
+        return self.inner.exists(key)
+
+    def list(self, prefix: str) -> list[str]:
+        return self.inner.list(prefix)
+
+    def open_input(self, key: str):
+        # local fs already serves lazy page reads via mmap — caching the
+        # whole object would defeat row-group pruning; only buffer-cache
+        # for backends without cheap random access
+        if isinstance(self.inner, FsStore):
+            return self.inner.open_input(key)
+        return pa.BufferReader(self.read(key))
+
+    def size(self, key: str) -> int:
+        with self._lock:
+            data = self._cache.get(key)
+            if data is not None:
+                return len(data)
+        return self.inner.size(key)
+
+    @property
+    def cached_bytes(self) -> int:
+        return self._bytes
+
+
+#: process-wide default backend (local fs) — storage components that are
+#: constructed without an explicit store share this
+DEFAULT_FS = FsStore()
+
+
+def default_store(store: Optional[ObjectStore]) -> ObjectStore:
+    return store if store is not None else DEFAULT_FS
+
+
+def build_store(kind: str = "fs", cache_bytes: int = 0, **kwargs) -> ObjectStore:
+    """Backend selection (reference datanode/src/store.rs:44-56)."""
+    if kind == "fs":
+        store: ObjectStore = FsStore()
+    elif kind == "memory":
+        store = MemoryStore()
+    else:
+        raise ObjectStoreError(
+            f"unsupported object store {kind!r} (supported: fs, memory)")
+    if cache_bytes > 0:
+        store = LruCacheLayer(store, cache_bytes)
+    return store

@@ -52,11 +52,19 @@ class EngineConfig:
     # auto-flush when a memtable exceeds this many bytes (reference
     # WriteBufferManager global budget, flush.rs:83-135)
     flush_threshold_bytes: int = 256 << 20
+    # object store backend for SSTs/manifest/index (reference
+    # object-store crate; fs|memory, optional LRU read cache)
+    object_store: str = "fs"
+    object_store_cache_bytes: int = 0
 
 
 class RegionEngine:
     def __init__(self, config: EngineConfig):
+        from greptimedb_tpu.objectstore import build_store
+
         self.config = config
+        self.store = build_store(config.object_store,
+                                 config.object_store_cache_bytes)
         os.makedirs(config.data_dir, exist_ok=True)
         self.wal = Wal(os.path.join(config.data_dir, "wal"), sync=config.wal_sync)
         self.regions: dict[int, Region] = {}
@@ -86,7 +94,8 @@ class RegionEngine:
                 if req.region_id in self.regions:
                     return 0
                 self.regions[req.region_id] = Region.create(
-                    req.region_id, self._region_dir(req.region_id), req.schema, self.wal
+                    req.region_id, self._region_dir(req.region_id), req.schema,
+                    self.wal, self.store
                 )
                 return 0
             if req.kind is RequestType.OPEN:
@@ -97,7 +106,8 @@ class RegionEngine:
                             self.regions[req.region_id] = r
                             return 0
                     self.regions[req.region_id] = Region.open(
-                        req.region_id, self._region_dir(req.region_id), self.wal
+                        req.region_id, self._region_dir(req.region_id), self.wal,
+                        self.store
                     )
                 return 0
             if req.kind is RequestType.CLOSE:

@@ -21,12 +21,15 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from greptimedb_tpu.objectstore import default_store
+
 
 class InvertedIndexWriter:
     """Build + persist the per-file index at SST write time."""
 
-    def __init__(self, sst_dir: str):
+    def __init__(self, sst_dir: str, store=None):
         self.sst_dir = sst_dir
+        self.store = default_store(store)
 
     def path(self, file_id: str) -> str:
         return os.path.join(self.sst_dir, f"{file_id}.idx.json")
@@ -57,14 +60,11 @@ class InvertedIndexWriter:
                     k = "\x00null" if key is None else key
                     masks[k] = masks.get(k, 0) | (1 << rg)
             index[tag] = {"masks": masks}
-        with open(self.path(file_id), "w") as f:
-            json.dump({"n_groups": n_groups, "tags": index}, f)
+        self.store.write(self.path(file_id),
+                         json.dumps({"n_groups": n_groups, "tags": index}).encode())
 
     def delete(self, file_id: str) -> None:
-        try:
-            os.remove(self.path(file_id))
-        except FileNotFoundError:
-            pass
+        self.store.delete(self.path(file_id))
 
 
 class IndexApplier:
@@ -75,8 +75,9 @@ class IndexApplier:
     file has no index (scan everything), or [] when provably empty.
     """
 
-    def __init__(self, sst_dir: str):
+    def __init__(self, sst_dir: str, store=None):
         self.sst_dir = sst_dir
+        self.store = default_store(store)
         self._cache: dict[str, Optional[dict]] = {}
 
     def _load(self, file_id: str) -> Optional[dict]:
@@ -84,9 +85,8 @@ class IndexApplier:
             return self._cache[file_id]
         path = os.path.join(self.sst_dir, f"{file_id}.idx.json")
         data = None
-        if os.path.exists(path):
-            with open(path) as f:
-                data = json.load(f)
+        if self.store.exists(path):
+            data = json.loads(self.store.read(path).decode())
         self._cache[file_id] = data
         return data
 

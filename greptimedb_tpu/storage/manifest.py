@@ -16,6 +16,7 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from greptimedb_tpu.datatypes.schema import Schema
+from greptimedb_tpu.objectstore import default_store
 from greptimedb_tpu.storage.sst import FileMeta
 
 CHECKPOINT_DISTANCE = 10
@@ -59,9 +60,9 @@ class RegionManifestState:
 
 
 class ManifestManager:
-    def __init__(self, manifest_dir: str):
+    def __init__(self, manifest_dir: str, store=None):
         self.dir = manifest_dir
-        os.makedirs(manifest_dir, exist_ok=True)
+        self.store = default_store(store)
         self.state = RegionManifestState()
         self._replay()
 
@@ -69,16 +70,15 @@ class ManifestManager:
 
     def _versions(self) -> list[int]:
         out = []
-        for name in os.listdir(self.dir):
-            m = _DELTA_RE.match(name)
+        for key in self.store.list(self.dir + os.sep):
+            m = _DELTA_RE.match(os.path.basename(key))
             if m:
                 out.append(int(m.group(1)))
         return sorted(out)
 
     def _replay(self) -> None:
         for v in self._versions():
-            with open(self._path(v)) as f:
-                action = json.load(f)
+            action = json.loads(self.store.read(self._path(v)).decode())
             self.state.apply(action)
             self.state.manifest_version = v
 
@@ -89,12 +89,8 @@ class ManifestManager:
 
     def append(self, action: dict) -> None:
         v = self.state.manifest_version + 1
-        tmp = self._path(v) + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(action, f)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self._path(v))
+        # FsStore.write is atomic (tmp + rename)
+        self.store.write(self._path(v), json.dumps(action).encode())
         self.state.apply(action)
         self.state.manifest_version = v
         if v % CHECKPOINT_DISTANCE == 0:
@@ -110,20 +106,12 @@ class ManifestManager:
             "tag_dicts": st.tag_dicts,
         }
         v = st.manifest_version + 1
-        tmp = self._path(v) + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(action, f)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self._path(v))
+        self.store.write(self._path(v), json.dumps(action).encode())
         st.manifest_version = v
         # prune deltas older than the checkpoint
         for old in self._versions():
             if old < v:
-                try:
-                    os.remove(self._path(old))
-                except FileNotFoundError:
-                    pass
+                self.store.delete(self._path(old))
 
     # ---- convenience -------------------------------------------------------
 

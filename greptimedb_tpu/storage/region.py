@@ -62,14 +62,18 @@ class ScanData:
 
 
 class Region:
-    def __init__(self, region_id: int, region_dir: str, schema: Schema, wal: Wal):
+    def __init__(self, region_id: int, region_dir: str, schema: Schema, wal: Wal,
+                 store=None, manifest: "ManifestManager" = None):
         self.region_id = region_id
         self.region_dir = region_dir
         self.schema = schema
         self.wal = wal
-        self.manifest = ManifestManager(os.path.join(region_dir, "manifest"))
-        self.sst_writer = SstWriter(os.path.join(region_dir, "sst"), schema)
-        self.sst_reader = SstReader(os.path.join(region_dir, "sst"))
+        self.store = store
+        self.manifest = manifest if manifest is not None else \
+            ManifestManager(os.path.join(region_dir, "manifest"), store)
+        self.sst_writer = SstWriter(os.path.join(region_dir, "sst"), schema,
+                                    store=store)
+        self.sst_reader = SstReader(os.path.join(region_dir, "sst"), store)
         tag_names = [c.name for c in schema.tag_columns]
         self.registry = TagRegistry(tag_names)
         self.memtable = Memtable(schema, self.registry)
@@ -87,22 +91,22 @@ class Region:
     # ---- lifecycle ---------------------------------------------------------
 
     @classmethod
-    def create(cls, region_id: int, region_dir: str, schema: Schema, wal: Wal) -> "Region":
-        os.makedirs(region_dir, exist_ok=True)
-        region = cls(region_id, region_dir, schema, wal)
+    def create(cls, region_id: int, region_dir: str, schema: Schema, wal: Wal,
+               store=None) -> "Region":
+        region = cls(region_id, region_dir, schema, wal, store)
         region.manifest.record_schema(schema)
         return region
 
     @classmethod
-    def open(cls, region_id: int, region_dir: str, wal: Wal) -> "Region":
+    def open(cls, region_id: int, region_dir: str, wal: Wal, store=None) -> "Region":
         """Replay manifest (checkpoint + deltas), then WAL from flushed_seq
         (reference region/opener.rs:62-117)."""
-        manifest = ManifestManager(os.path.join(region_dir, "manifest"))
+        manifest = ManifestManager(os.path.join(region_dir, "manifest"), store)
         st = manifest.state
         if st.schema is None:
             raise FileNotFoundError(f"region {region_id} has no manifest at {region_dir}")
-        region = cls(region_id, region_dir, st.schema, wal)
-        region.manifest = manifest
+        region = cls(region_id, region_dir, st.schema, wal, store,
+                     manifest=manifest)
         region.files = dict(st.files)
         # restore the tag registry snapshot taken at last flush; WAL replay
         # below re-adds any values seen since

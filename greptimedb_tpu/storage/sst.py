@@ -28,6 +28,7 @@ from greptimedb_tpu.datatypes.recordbatch import RecordBatch
 from greptimedb_tpu.datatypes.schema import ColumnSchema, Schema
 from greptimedb_tpu.datatypes.types import DataType, SemanticType
 from greptimedb_tpu.datatypes.vector import DictVector
+from greptimedb_tpu.objectstore import default_store
 
 SEQ_COL = "__seq"
 OP_COL = "__op_type"
@@ -56,11 +57,12 @@ class FileMeta:
 
 
 class SstWriter:
-    def __init__(self, sst_dir: str, schema: Schema, row_group_size: int = DEFAULT_ROW_GROUP):
+    def __init__(self, sst_dir: str, schema: Schema,
+                 row_group_size: int = DEFAULT_ROW_GROUP, store=None):
         self.sst_dir = sst_dir
         self.schema = schema
         self.row_group_size = row_group_size
-        os.makedirs(sst_dir, exist_ok=True)
+        self.store = default_store(store)
 
     def write(
         self,
@@ -94,17 +96,19 @@ class SstWriter:
 
         file_id = uuid.uuid4().hex
         path = os.path.join(self.sst_dir, f"{file_id}.parquet")
+        sink = pa.BufferOutputStream()
         pq.write_table(
             table,
-            path,
+            sink,
             row_group_size=self.row_group_size,
             compression="zstd",
             write_statistics=True,
         )
+        self.store.write(path, sink.getvalue())  # pa.Buffer, zero extra copy
         # build the per-file inverted index (tag value -> row-group bitmap)
         from greptimedb_tpu.storage.index import InvertedIndexWriter
 
-        InvertedIndexWriter(self.sst_dir).write(
+        InvertedIndexWriter(self.sst_dir, self.store).write(
             file_id,
             {c.name: np.asarray(columns[c.name], dtype=np.int32)
              for c in self.schema.tag_columns},
@@ -120,16 +124,17 @@ class SstWriter:
             ts_max=int(ts.max()) if n else 0,
             max_seq=int(np.max(seq)) if n else 0,
             level=level,
-            size_bytes=os.path.getsize(path),
+            size_bytes=self.store.size(path),
         )
 
 
 class SstReader:
-    def __init__(self, sst_dir: str):
-        self.sst_dir = sst_dir
+    def __init__(self, sst_dir: str, store=None):
         from greptimedb_tpu.storage.index import IndexApplier
 
-        self.index_applier = IndexApplier(sst_dir)
+        self.sst_dir = sst_dir
+        self.store = default_store(store)
+        self.index_applier = IndexApplier(sst_dir, self.store)
 
     def path(self, file_id: str) -> str:
         return os.path.join(self.sst_dir, f"{file_id}.parquet")
@@ -154,7 +159,7 @@ class SstReader:
             idx_groups = self.index_applier.apply(meta.file_id, tag_predicates)
             if idx_groups == []:
                 return None
-        pf = pq.ParquetFile(self.path(meta.file_id))
+        pf = pq.ParquetFile(self.store.open_input(self.path(meta.file_id)))
         ts_name = schema.time_index.name
         groups = self._prune_row_groups(pf, ts_name, ts_range)
         if idx_groups is not None:
@@ -193,13 +198,10 @@ class SstReader:
         return keep
 
     def delete(self, file_id: str) -> None:
-        try:
-            os.remove(self.path(file_id))
-        except FileNotFoundError:
-            pass
+        self.store.delete(self.path(file_id))
         from greptimedb_tpu.storage.index import InvertedIndexWriter
 
-        InvertedIndexWriter(self.sst_dir).delete(file_id)
+        InvertedIndexWriter(self.sst_dir, self.store).delete(file_id)
         self.index_applier.invalidate(file_id)
 
 

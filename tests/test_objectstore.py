@@ -1,0 +1,129 @@
+"""Object store abstraction: fs/memory backends, LRU cache layer, and
+the storage engine running fully on each backend (reference
+src/object-store with LruCacheLayer)."""
+
+import numpy as np
+import pytest
+
+from greptimedb_tpu.catalog.catalog import Catalog
+from greptimedb_tpu.catalog.kv import MemoryKv
+from greptimedb_tpu.objectstore import (
+    FsStore,
+    LruCacheLayer,
+    MemoryStore,
+    ObjectStoreError,
+    build_store,
+)
+from greptimedb_tpu.query.engine import QueryEngine
+from greptimedb_tpu.storage.engine import EngineConfig, RegionEngine
+
+
+class TestBackends:
+    @pytest.mark.parametrize("make", [lambda p: (FsStore(), str(p)),
+                                      lambda p: (MemoryStore(), "mem")])
+    def test_crud(self, tmp_path, make):
+        store, root = make(tmp_path)
+        key = f"{root}/a/b.bin"
+        assert not store.exists(key)
+        with pytest.raises(ObjectStoreError):
+            store.read(key)
+        store.write(key, b"hello")
+        assert store.exists(key)
+        assert store.read(key) == b"hello"
+        assert store.size(key) == 5
+        store.write(key, b"world!")
+        assert store.read(key) == b"world!"
+        assert store.list(f"{root}/a/") == [key]
+        store.delete(key)
+        assert not store.exists(key)
+        store.delete(key)  # idempotent
+
+    def test_open_input(self, tmp_path):
+        store = FsStore()
+        key = str(tmp_path / "x.bin")
+        store.write(key, b"abcdef")
+        src = store.open_input(key)
+        assert src.read(3) == b"abc"
+
+    def test_build_store(self):
+        assert isinstance(build_store("memory"), MemoryStore)
+        assert isinstance(build_store("fs"), FsStore)
+        layered = build_store("memory", cache_bytes=1024)
+        assert isinstance(layered, LruCacheLayer)
+        with pytest.raises(ObjectStoreError):
+            build_store("s3")
+
+
+class TestLruCache:
+    def test_read_through_and_eviction(self):
+        inner = MemoryStore()
+        cache = LruCacheLayer(inner, capacity_bytes=10)
+        inner.write("a", b"12345")
+        inner.write("b", b"67890")
+        inner.write("c", b"abcde")
+        assert cache.read("a") == b"12345"
+        assert cache.read("b") == b"67890"
+        assert cache.cached_bytes == 10
+        # touching a keeps it hot; c evicts b
+        cache.read("a")
+        cache.read("c")
+        assert cache.cached_bytes == 10
+        # b was evicted: a backend read happens (mutate behind the cache
+        # to observe where the read is served from)
+        inner.write("b", b"NEW__")
+        assert cache.read("b") == b"NEW__"
+        # a was evicted by b's re-insert? capacity 10 holds two of five;
+        # read c served from cache even after deleting from backend
+        inner.delete("c")
+        assert cache.read("c") == b"abcde"
+
+    def test_write_through_and_delete(self):
+        inner = MemoryStore()
+        cache = LruCacheLayer(inner, capacity_bytes=100)
+        cache.write("k", b"v1")
+        assert inner.read("k") == b"v1"
+        cache.delete("k")
+        assert not cache.exists("k")
+        assert cache.cached_bytes == 0
+
+    def test_oversized_object_not_cached(self):
+        inner = MemoryStore()
+        cache = LruCacheLayer(inner, capacity_bytes=4)
+        inner.write("big", b"123456789")
+        assert cache.read("big") == b"123456789"
+        assert cache.cached_bytes == 0
+
+
+@pytest.mark.parametrize("backend,cache", [("fs", 0), ("memory", 0),
+                                           ("fs", 64 << 20)])
+def test_engine_on_backend(tmp_path, backend, cache):
+    """The full write → flush → SST scan → recovery cycle on each object
+    store configuration."""
+    cfg = EngineConfig(data_dir=str(tmp_path), object_store=backend,
+                       object_store_cache_bytes=cache)
+    engine = RegionEngine(cfg)
+    kv = MemoryKv()
+    qe = QueryEngine(Catalog(kv), engine)
+    qe.execute_one(
+        "CREATE TABLE cpu (host STRING, usage DOUBLE, ts TIMESTAMP(3) TIME INDEX, "
+        "PRIMARY KEY(host))")
+    qe.execute_one(
+        "INSERT INTO cpu (host, usage, ts) VALUES "
+        "('a', 1.0, 1000), ('b', 2.0, 2000)")
+    qe.execute_one("ADMIN flush_table('cpu')")
+    qe.execute_one("INSERT INTO cpu (host, usage, ts) VALUES ('c', 3.0, 3000)")
+    rows = qe.execute_one(
+        "SELECT host, usage FROM cpu ORDER BY ts").rows()
+    assert rows == [["a", 1.0], ["b", 2.0], ["c", 3.0]]
+    # repeated scans hit the SST read path (and the LRU when configured)
+    assert qe.execute_one("SELECT count(*) FROM cpu").rows() == [[3]]
+    engine.close()
+
+    if backend == "fs":
+        # restart recovery only applies to durable backends
+        engine2 = RegionEngine(cfg)
+        qe2 = QueryEngine(Catalog(kv), engine2)
+        rows = qe2.execute_one(
+            "SELECT host, usage FROM cpu ORDER BY ts").rows()
+        assert rows == [["a", 1.0], ["b", 2.0], ["c", 3.0]]
+        engine2.close()
