@@ -1,0 +1,74 @@
+"""OpenTSDB telnet protocol (reference src/servers/src/opentsdb.rs)."""
+
+import socket
+import time
+
+import pytest
+
+from greptimedb_tpu.catalog.catalog import Catalog
+from greptimedb_tpu.catalog.kv import MemoryKv
+from greptimedb_tpu.query.engine import QueryEngine
+from greptimedb_tpu.servers.opentsdb import OpentsdbServer, parse_put_line
+from greptimedb_tpu.storage.engine import EngineConfig, RegionEngine
+
+
+@pytest.fixture
+def qe(tmp_path):
+    engine = RegionEngine(EngineConfig(data_dir=str(tmp_path)))
+    q = QueryEngine(Catalog(MemoryKv()), engine)
+    yield q
+    engine.close()
+
+
+class TestParse:
+    def test_put_line(self):
+        m, ts, v, tags = parse_put_line(
+            "put sys.cpu.user 1356998400 42.5 host=web01 cpu=0")
+        assert m == "sys.cpu.user"
+        assert ts == 1356998400000  # seconds -> ms
+        assert v == 42.5
+        assert tags == [("cpu", "0"), ("host", "web01")]
+
+    def test_ms_timestamp(self):
+        _, ts, _, _ = parse_put_line("put m 1356998400123 1")
+        assert ts == 1356998400123
+
+    def test_errors(self):
+        with pytest.raises(ValueError):
+            parse_put_line("get x 1 2")
+        with pytest.raises(ValueError):
+            parse_put_line("put m 1")
+        with pytest.raises(ValueError):
+            parse_put_line("put m 1 2 badtag")
+
+
+class TestTelnet:
+    def test_put_and_query(self, qe):
+        srv = OpentsdbServer(qe, port=0)
+        srv.start()
+        try:
+            sock = socket.create_connection(("127.0.0.1", srv.port), timeout=10)
+            sock.sendall(b"version\n")
+            assert b"opentsdb" in sock.makefile("rb").readline()
+            sock.sendall(
+                b"put sys.cpu.user 1356998400 42.5 host=web01\n"
+                b"put sys.cpu.user 1356998460 43.5 host=web01\n"
+                b"put bad line\n"
+            )
+            # the bad line elicits a diagnostic; puts are silent
+            resp = sock.recv(4096)
+            assert b"put:" in resp
+            sock.sendall(b"exit\n")
+            sock.close()
+            for _ in range(50):  # ingestion is async w.r.t. our reads
+                try:
+                    r = qe.execute_one(
+                        "SELECT greptime_value FROM \"sys.cpu.user\" ORDER BY ts")
+                    if r.num_rows == 2:
+                        break
+                except Exception:
+                    pass
+                time.sleep(0.1)
+            assert r.rows() == [[42.5], [43.5]]
+        finally:
+            srv.shutdown()
