@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import base64
 import hashlib
+import hmac
 import os
 from dataclasses import dataclass, field
 from typing import Optional
@@ -64,7 +65,8 @@ class UserProvider:
 
     def authenticate(self, username: str, password: str) -> UserInfo:
         stored = self.lookup(username)
-        if stored is None or stored != password:
+        if stored is None or not hmac.compare_digest(
+                stored.encode(), password.encode()):
             raise AuthError(f"access denied for user {username!r}")
         return UserInfo(username)
 
@@ -90,7 +92,7 @@ class UserProvider:
             raise AuthError(f"access denied for user {username!r}")
         # empty stored password ⇒ client sends a zero-length auth response
         expect = mysql_native_scramble(stored, salt) if stored else b""
-        if auth_response != expect:
+        if not hmac.compare_digest(auth_response, expect):
             raise AuthError(f"access denied for user {username!r}")
         return UserInfo(username)
 
@@ -173,6 +175,9 @@ def mysql_native_scramble(password: str, salt: bytes) -> bytes:
 _WRITE_STMTS = frozenset({
     "Insert", "Delete", "CreateTable", "CreateDatabase", "DropTable",
     "TruncateTable", "AlterTable", "CreateFlow", "DropFlow", "AdminFunc",
+    # COPY FROM writes into tables; COPY TO writes server-side files —
+    # both require the write grant
+    "CopyTable", "CopyDatabase",
 })
 
 
@@ -184,13 +189,23 @@ class PermissionChecker:
     PROTECTED_SCHEMAS = frozenset({"greptime_private"})
 
     def check(self, user: Optional[UserInfo], stmt, db: str) -> None:
-        if db in self.PROTECTED_SCHEMAS and user is not None \
-                and user.username != "greptime":
+        kind = type(stmt).__name__
+        needed = "write" if kind in _WRITE_STMTS else "read"
+        self.check_access(user, needed, db)
+
+    def check_access(self, user: Optional[UserInfo], needed: str,
+                     db: str) -> None:
+        """Grant + protected-schema check for a raw access kind — used by
+        non-SQL entry points (Flight do_put bulk ingest, region scans)
+        that don't carry a statement AST."""
+        # protected-schema rule applies to every context, authenticated or
+        # not: only the admin user may write greptime_private; reads are
+        # allowed for everyone
+        if db in self.PROTECTED_SCHEMAS and needed == "write" \
+                and (user is None or user.username != "greptime"):
             raise AuthError(f"schema {db!r} is protected")
         if user is None:
             return
-        kind = type(stmt).__name__
-        needed = "write" if kind in _WRITE_STMTS else "read"
         if not user.can(needed):
             raise AuthError(
                 f"user {user.username!r} lacks {needed} permission")

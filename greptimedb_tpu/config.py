@@ -30,6 +30,41 @@ def compute_dtype() -> np.dtype:
     return jnp.dtype(jnp.float32) if _platform() in ("tpu", "axon") else jnp.dtype(jnp.float64)
 
 
+def query_mesh():
+    """Device mesh for distributed query execution, or None when a single
+    device is visible (the common standalone case). All devices ride the
+    "shard" (row) axis — the collective MergeScan (SURVEY §2.6: reference
+    gathers region streams point-to-point at merge_scan.rs:122; here
+    partial aggregates combine with psum over ICI).
+
+    GREPTIMEDB_TPU_MESH=off disables; =NxM forces an (shard, field) shape.
+    """
+    env = os.environ.get("GREPTIMEDB_TPU_MESH", "auto")
+    if env.lower() in ("off", "0", "none"):
+        return None
+    try:
+        n = jax.device_count()
+    except Exception:
+        return None
+    from greptimedb_tpu.parallel.mesh import make_mesh
+
+    if env not in ("auto", ""):
+        s, _, f = env.partition("x")
+        shape = (int(s), int(f or 1))
+        if shape[0] * shape[1] > n:
+            raise ValueError(f"mesh {shape} needs {shape[0]*shape[1]} devices, have {n}")
+        return make_mesh(jax.devices()[: shape[0] * shape[1]], shape)
+    if n <= 1:
+        return None
+    return make_mesh()
+
+
+def mesh_min_rows() -> int:
+    """Scans below this row count skip the mesh path: per-shard dispatch
+    overhead beats the parallelism on tiny results."""
+    return int(os.environ.get("GREPTIMEDB_TPU_MESH_MIN_ROWS", "65536"))
+
+
 def device_cache_bytes() -> int:
     """HBM budget for the device block cache (reference: CacheManager page
     cache, mito2/src/cache.rs:53-61 — here the 'page cache' IS device HBM).

@@ -183,24 +183,35 @@ def segment_agg(
 
 
 def combine_partial_aggs(
-    partials: dict[str, jax.Array], axis_name: str
+    partials: dict[str, jax.Array], axis_name: str, with_mean: bool = False
 ) -> dict[str, jax.Array]:
     """Merge per-shard partial aggregates across a mesh axis with XLA
     collectives — the TPU-native MergeScan (reference
     query/src/dist_plan/merge_scan.rs:122 gathers region streams over
     Flight; here partial sums/counts ride ICI via psum).
+
+    NULL semantics match single-device `segment_agg`: an all-NULL group's
+    min/max is NaN (NaN partials are filled with ±inf for the collective,
+    then groups that stayed at the fill value are restored to NaN).
+    Counts upcast to int64 before psum so >2^31-row totals stay exact.
     """
     out = {}
     for op, v in partials.items():
-        if op in ("sum", "count", "rows", "sumsq"):
+        if op in ("count", "rows"):
+            out[op] = jax.lax.psum(v.astype(jnp.int64), axis_name)
+        elif op in ("sum", "sumsq"):
             out[op] = jax.lax.psum(v, axis_name)
         elif op == "min":
-            out[op] = jax.lax.pmin(_nan_to(v, _type_max(v.dtype)), axis_name)
+            big = _type_max(v.dtype)
+            mn = jax.lax.pmin(_nan_to(v, big), axis_name)
+            out[op] = jnp.where(mn == big, _null_of(v.dtype), mn)
         elif op == "max":
-            out[op] = jax.lax.pmax(_nan_to(v, _type_min(v.dtype)), axis_name)
+            small = _type_min(v.dtype)
+            mx = jax.lax.pmax(_nan_to(v, small), axis_name)
+            out[op] = jnp.where(mx == small, _null_of(v.dtype), mx)
         else:
             raise ValueError(f"non-commutative partial agg: {op}")
-    if "sum" in out and "count" in out:
+    if with_mean and "sum" in out and "count" in out:
         denom = jnp.maximum(out["count"], 1).astype(out["sum"].dtype)
         out["mean"] = jnp.where(out["count"] > 0, out["sum"] / denom, jnp.nan)
     return out

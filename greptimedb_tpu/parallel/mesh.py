@@ -73,24 +73,12 @@ def sharded_segment_agg(
         check_vma=False,
     )
     def step(v, g, m):
+        from greptimedb_tpu.ops.segment import combine_partial_aggs
+
         part = segment_agg(v, g, m, num_segments, ops=ops)
-        out = {}
-        for op in ops:
-            x = part[op]
-            if x.ndim == 1:
-                x = x[:, None]
-            if op in ("sum", "count", "rows", "sumsq"):
-                out[op] = jax.lax.psum(x, "shard")
-            elif op == "min":
-                big = jnp.asarray(jnp.inf, x.dtype) if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).max
-                filled = jnp.where(jnp.isnan(x), big, x) if jnp.issubdtype(x.dtype, jnp.floating) else x
-                mn = jax.lax.pmin(filled, "shard")
-                out[op] = jnp.where(jnp.isinf(mn), jnp.nan, mn) if jnp.issubdtype(x.dtype, jnp.floating) else mn
-            elif op == "max":
-                small = jnp.asarray(-jnp.inf, x.dtype) if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
-                filled = jnp.where(jnp.isnan(x), small, x) if jnp.issubdtype(x.dtype, jnp.floating) else x
-                mx = jax.lax.pmax(filled, "shard")
-                out[op] = jnp.where(jnp.isinf(mx), jnp.nan, mx) if jnp.issubdtype(x.dtype, jnp.floating) else mx
+        part = {op: (x if x.ndim > 1 else x[:, None])
+                for op, x in part.items()}
+        out = combine_partial_aggs(part, "shard")
         return tuple(out[op] for op in ops)
 
     res = step(values, seg_ids, mask)

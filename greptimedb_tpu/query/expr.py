@@ -537,10 +537,23 @@ def _eval_host_func(e: ast.FuncCall, ev, schema):
             out = f(out, np.asarray(ev(a)))
         return out
     if name == "coalesce" and e.args:
-        out = np.asarray(ev(e.args[0]), dtype=np.float64)
-        for a in e.args[1:]:
-            nxt = np.broadcast_to(
-                np.asarray(ev(a), dtype=np.float64), out.shape)
+        vals = [np.atleast_1d(np.asarray(ev(a))) for a in e.args]
+        if any(v.dtype == object for v in vals):
+            # string/tag columns: float/NaN semantics would raise; merge
+            # elementwise on `is None` instead
+            n = max(v.shape[0] for v in vals)
+            out = np.broadcast_to(vals[0], (n,)).astype(object).copy()
+            for v in vals[1:]:
+                nxt = np.broadcast_to(v, (n,))
+                # missing = None or NaN (float NULLs keep NaN semantics
+                # even when boxed in an object array)
+                missing = np.asarray(
+                    [x is None or x != x for x in out], dtype=bool)
+                out[missing] = nxt[missing]
+            return out
+        out = vals[0].astype(np.float64)
+        for a in vals[1:]:
+            nxt = np.broadcast_to(a.astype(np.float64), out.shape)
             out = np.where(np.isnan(out), nxt, out)
         return out
     if name == "clamp" and len(e.args) == 3:

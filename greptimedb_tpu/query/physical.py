@@ -94,6 +94,28 @@ def _agg_block(
     mask = jnp.arange(some.shape[0]) < n_valid
     if dedup_mask is not None:
         mask = mask & dedup_mask
+    return _agg_block_masked(
+        cols, mask, where=where, keys=keys, agg_args=agg_args, ops=ops,
+        num_segments=num_segments, ts_name=ts_name, tag_names=tag_names,
+        schema=schema, need_ts=need_ts, acc_dtype=acc_dtype,
+    )
+
+
+def _agg_block_masked(
+    cols: dict,
+    mask: jax.Array,  # [N] base validity (padding & dedup), pre-filter
+    *,
+    where,
+    keys: tuple[DeviceKey, ...],
+    agg_args: tuple,
+    ops: tuple[str, ...],
+    num_segments: int,
+    ts_name: str,
+    tag_names: frozenset,
+    schema,
+    need_ts: bool,
+    acc_dtype=jnp.float64,
+):
     if where is not None:
         w = eval_device(where, cols, tag_names, schema)
         mask = mask & (w if w.dtype == jnp.bool_ else w != 0)
@@ -166,6 +188,51 @@ def _agg_scan(
     return packed_f, packed_i
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("mesh", "where", "keys", "agg_args", "ops",
+                     "num_segments", "ts_name", "tag_names", "schema",
+                     "acc_dtype", "float_ops", "pack_dtype"),
+)
+def _agg_scan_sharded(
+    cols: dict,  # {name: [N_pad] array sharded along "shard"}
+    base_mask: jax.Array,  # [N_pad] bool, sharded: padding & dedup survivors
+    *,
+    mesh, where, keys, agg_args, ops, num_segments, ts_name, tag_names,
+    schema, acc_dtype, float_ops, pack_dtype,
+):
+    """Multi-device aggregation: each shard runs the same fused
+    filter+group+reduce over its rows, partials combine with psum/pmin/pmax
+    along the "shard" axis — the collective MergeScan (reference
+    query/src/dist_plan/analyzer.rs:35 splits plans at commutativity
+    boundaries and gathers at merge_scan.rs:122; here the combine rides ICI
+    instead of point-to-point Flight). first/last are non-commutative over
+    unordered shards and stay on the single-device path."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    in_specs = ({k: P("shard") for k in cols}, P("shard"))
+
+    @functools.partial(shard_map, mesh=mesh, in_specs=in_specs,
+                       out_specs=P(), check_vma=False)
+    def step(local_cols, local_mask):
+        from greptimedb_tpu.ops.segment import combine_partial_aggs
+
+        part = _agg_block_masked(
+            local_cols, local_mask, where=where, keys=keys,
+            agg_args=agg_args, ops=ops, num_segments=num_segments,
+            ts_name=ts_name, tag_names=tag_names, schema=schema,
+            need_ts=False, acc_dtype=acc_dtype,
+        )
+        part = {op: (v if v.ndim > 1 else v[:, None])
+                for op, v in part.items()}
+        combined = combine_partial_aggs(part, "shard")
+        return jnp.concatenate(
+            [combined[k].astype(pack_dtype) for k in float_ops], axis=1)
+
+    return step(cols, base_mask)
+
+
 @functools.partial(jax.jit, static_argnames=("where", "tag_names", "schema"))
 def _filter_block(cols: dict, n_valid: jax.Array, dedup_mask, *, where,
                   tag_names, schema):
@@ -221,9 +288,13 @@ def _combine_partials(acc: Optional[dict], p: dict) -> dict:
 class PhysicalExecutor:
     def __init__(self, engine: RegionEngine):
         self.engine = engine
+        from greptimedb_tpu import config
         from greptimedb_tpu.query.device_cache import DeviceCache
 
         self.cache = DeviceCache()
+        # multi-device: row-shard the scan over the mesh and combine
+        # partial aggregates with collectives (None on a single chip)
+        self.mesh = config.query_mesh()
 
     def execute(self, plan: lp.LogicalPlan) -> QueryResult:
         # unwrap the linear chain
@@ -466,26 +537,10 @@ class PhysicalExecutor:
         )
         n = scan.num_rows
         dedup_mask = self._maybe_dedup(scan, table, ctx)
-        block = min(block_size_for(n), DEFAULT_BLOCK_ROWS)
         tag_names = frozenset(ctx.tag_names)
         float_fields = {
             c.name for c in schema.field_columns if c.dtype.is_float
         }
-        blocks = []
-        dmasks = [] if dedup_mask is not None else None
-        n_valids = []
-        for start in range(0, n, block):
-            end = min(start + block, n)
-            cols = {}
-            for name in device_col_names:
-                cols[name] = self._device_block(
-                    scan, name, start, end, block, extra_cols,
-                    acc_dtype if name in float_fields else None,
-                )
-            blocks.append(cols)
-            n_valids.append(end - start)
-            if dmasks is not None:
-                dmasks.append(_pad_device_mask(dedup_mask, start, end, block))
 
         # output layout (static): which float/int planes the kernel packs
         nf = max(len(arg_exprs), 1)
@@ -508,15 +563,44 @@ class PhysicalExecutor:
         if not jnp.issubdtype(pack_dtype, jnp.floating):
             pack_dtype = jnp.dtype(jnp.float64)
 
-        packed_f, packed_i = _agg_scan(
-            tuple(blocks), jnp.asarray(np.asarray(n_valids)),
-            tuple(dmasks) if dmasks is not None else None,
-            where=bound_where, keys=keys, agg_args=arg_exprs, ops=ops,
-            num_segments=num_groups, ts_name=ts_name, tag_names=tag_names,
-            schema=schema, need_ts=bool({"first", "last"} & set(ops)),
-            acc_dtype=acc_dtype, float_ops=float_ops, int_ops=int_ops,
-            pack_dtype=pack_dtype,
-        )
+        from greptimedb_tpu.parallel.mesh import COLLECTIVE_OPS
+
+        mesh = self.mesh
+        if (mesh is not None and not int_ops
+                and set(ops) <= set(COLLECTIVE_OPS)
+                and n >= config.mesh_min_rows()):
+            packed_f = self._sharded_scan(
+                scan, mesh, device_col_names, extra_cols, float_fields,
+                acc_dtype, dedup_mask, bound_where, keys, arg_exprs, ops,
+                num_groups, ts_name, tag_names, schema, float_ops, pack_dtype)
+            packed_i = None
+        else:
+            block = min(block_size_for(n), DEFAULT_BLOCK_ROWS)
+            blocks = []
+            dmasks = [] if dedup_mask is not None else None
+            n_valids = []
+            for start in range(0, n, block):
+                end = min(start + block, n)
+                cols = {}
+                for name in device_col_names:
+                    cols[name] = self._device_block(
+                        scan, name, start, end, block, extra_cols,
+                        acc_dtype if name in float_fields else None,
+                    )
+                blocks.append(cols)
+                n_valids.append(end - start)
+                if dmasks is not None:
+                    dmasks.append(_pad_device_mask(dedup_mask, start, end, block))
+
+            packed_f, packed_i = _agg_scan(
+                tuple(blocks), jnp.asarray(np.asarray(n_valids)),
+                tuple(dmasks) if dmasks is not None else None,
+                where=bound_where, keys=keys, agg_args=arg_exprs, ops=ops,
+                num_segments=num_groups, ts_name=ts_name, tag_names=tag_names,
+                schema=schema, need_ts=bool({"first", "last"} & set(ops)),
+                acc_dtype=acc_dtype, float_ops=float_ops, int_ops=int_ops,
+                pack_dtype=pack_dtype,
+            )
         host_f = np.asarray(packed_f)
         acc: dict[str, np.ndarray] = {}
         off = 0
@@ -532,6 +616,47 @@ class PhysicalExecutor:
             for j, k in enumerate(int_ops):
                 acc[k] = host_i[:, j]
         return acc
+
+    def _sharded_scan(self, scan, mesh, device_col_names, extra_cols,
+                      float_fields, acc_dtype, dedup_mask, bound_where, keys,
+                      arg_exprs, ops, num_groups, ts_name, tag_names, schema,
+                      float_ops, pack_dtype):
+        """Place the scan's columns across the mesh's "shard" axis and run
+        the collective aggregation — the integrated multi-chip MergeScan."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        n = scan.num_rows
+        n_shard = mesh.shape["shard"]
+        n_pad = ((n + n_shard - 1) // n_shard) * n_shard
+        sharding = NamedSharding(mesh, P("shard"))
+        cols = {}
+        for name in device_col_names:
+            cast = acc_dtype if name in float_fields else None
+
+            def build(name=name, cast=cast):
+                src = extra_cols[name] if name in extra_cols \
+                    else scan.columns[name]
+                arr = pad_rows(src, n_pad)
+                if cast is not None and arr.dtype != cast:
+                    arr = arr.astype(cast)
+                return jax.device_put(arr, sharding)
+
+            if scan.region_id < 0 or name in extra_cols:
+                cols[name] = build()
+            else:
+                key = (scan.region_id, scan.data_version,
+                       scan.scan_fingerprint, name, "sharded", n_pad,
+                       n_shard, str(cast))
+                cols[name] = self.cache.get(key, build)
+        base = np.arange(n_pad) < n
+        if dedup_mask is not None:
+            base[:n] &= np.asarray(dedup_mask)[:n]
+        base_s = jax.device_put(base, sharding)
+        return _agg_scan_sharded(
+            cols, base_s, mesh=mesh, where=bound_where, keys=keys,
+            agg_args=arg_exprs, ops=ops, num_segments=num_groups,
+            ts_name=ts_name, tag_names=tag_names, schema=schema,
+            acc_dtype=acc_dtype, float_ops=float_ops, pack_dtype=pack_dtype)
 
     def _device_block(self, scan: ScanData, name, start, end, block,
                       extra_cols, cast_dtype):

@@ -125,6 +125,9 @@ class _BasicServerAuth(fl.ServerAuthHandler):
         super().__init__()
         self.user_provider = user_provider
         self._tokens: "OrderedDict[bytes, str]" = OrderedDict()
+        # username -> authenticated UserInfo (with grants), resolved by
+        # FlightServer handlers from context.peer_identity()
+        self._identities: dict[str, object] = {}
 
     def authenticate(self, outgoing, incoming):
         from greptimedb_tpu.auth import AuthError
@@ -132,9 +135,10 @@ class _BasicServerAuth(fl.ServerAuthHandler):
         raw = incoming.read()
         user, _, pwd = raw.decode().partition(":")
         try:
-            self.user_provider.authenticate(user, pwd)
+            info = self.user_provider.authenticate(user, pwd)
         except AuthError as e:
             raise fl.FlightUnauthenticatedError(str(e)) from e
+        self._identities[user] = info
         token = secrets.token_bytes(16)
         self._tokens[token] = user
         while len(self._tokens) > self.MAX_TOKENS:
@@ -171,17 +175,40 @@ class FlightServer(fl.FlightServerBase):
                  user_provider=None):
         self.qe = query_engine
         auth = _BasicServerAuth(user_provider) if user_provider else None
+        self._auth = auth
         location = f"grpc://{host}:{port}"
         super().__init__(location, auth_handler=auth)
         self.host = host
+
+    def _resolve_user(self, context):
+        """Map the Flight peer identity (set by _BasicServerAuth.is_valid)
+        back to the authenticated UserInfo so PermissionChecker sees the
+        same principal gRPC authenticated — without this, grants and
+        protected-schema rules were silently skipped over Flight."""
+        if self._auth is None:
+            return None
+        ident = context.peer_identity()
+        if not ident:
+            return None
+        name = ident.decode() if isinstance(ident, bytes) else str(ident)
+        info = self._auth._identities.get(name)
+        if info is None:
+            from greptimedb_tpu.auth import UserInfo
+            info = UserInfo(name)
+        return info
 
     # -- query service --------------------------------------------------------
 
     def do_get(self, context, ticket):
         req = json.loads(ticket.ticket.decode())
         if "region_scan" in req:
+            user = self._resolve_user(context)
+            if user is not None and not user.can("read"):
+                raise fl.FlightUnauthorizedError(
+                    f"user {user.username!r} lacks read permission")
             return self._region_scan(req["region_scan"])
-        ctx = QueryContext(db=req.get("db", "public"), channel=Channel.GRPC)
+        ctx = QueryContext(db=req.get("db", "public"), channel=Channel.GRPC,
+                           user=self._resolve_user(context))
         if "sql" in req:
             result = self.qe.execute_one(req["sql"], ctx)
         elif "tql" in req:
@@ -230,7 +257,15 @@ class FlightServer(fl.FlightServerBase):
             raise fl.FlightServerError("descriptor path must be [db.]table")
         table_name = path[-1]
         db = path[0] if len(path) > 1 else "public"
-        ctx = QueryContext(db=db, channel=Channel.GRPC)
+        ctx = QueryContext(db=db, channel=Channel.GRPC,
+                           user=self._resolve_user(context))
+        from greptimedb_tpu.auth import AuthError
+        try:
+            # full write authorization (grants + protected schema), same
+            # rules the SQL INSERT path applies
+            self.qe.permission_checker.check_access(ctx.user, "write", db)
+        except AuthError as e:
+            raise fl.FlightUnauthorizedError(str(e)) from e
         arrow_table = reader.read_all()
         n = self._insert_arrow(table_name, arrow_table, ctx)
         writer.write(json.dumps({"affected_rows": n}).encode())
@@ -247,7 +282,8 @@ class FlightServer(fl.FlightServerBase):
             return [json.dumps({"status": "ok"}).encode()]
         if action.type == "sql":
             req = json.loads(action.body.to_pybytes().decode())
-            ctx = QueryContext(db=req.get("db", "public"), channel=Channel.GRPC)
+            ctx = QueryContext(db=req.get("db", "public"), channel=Channel.GRPC,
+                               user=self._resolve_user(context))
             results = self.qe.execute_sql(req["sql"], ctx)
             out = []
             for r in results:
@@ -299,7 +335,12 @@ class FlightQueryClient:
         writer, reader = self.client.do_put(desc, data.schema)
         writer.write_table(data)
         writer.done_writing()
-        ack = json.loads(reader.read().to_pybytes().decode())
+        ack_buf = reader.read()
+        if ack_buf is None:
+            # server errored before acking — close() raises the Flight error
+            writer.close()
+            raise fl.FlightServerError("no ack from server")
+        ack = json.loads(ack_buf.to_pybytes().decode())
         writer.close()
         return ack["affected_rows"]
 
@@ -317,8 +358,11 @@ class RegionFlightClient:
     region over Flight and concatenates; here the reassembled ScanData
     feeds the device merge kernels)."""
 
-    def __init__(self, addr: str):
+    def __init__(self, addr: str, user: Optional[str] = None,
+                 password: Optional[str] = None):
         self.client = fl.FlightClient(f"grpc://{addr}")
+        if user is not None:
+            self.client.authenticate(_BasicClientAuth(user, password or ""))
 
     def scan(self, region_id: int, ts_range=None, projection=None,
              tag_predicates=None) -> Optional[ScanData]:

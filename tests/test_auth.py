@@ -101,9 +101,33 @@ class TestPermission:
         checker.check(UserInfo("w"), insert, "public")  # no grants = all
 
     def test_protected_schema(self):
+        """greptime_private: writes denied for everyone but the admin user
+        (including anonymous contexts); reads allowed (ADVICE r1)."""
+        from greptimedb_tpu.sql import parse_sql
+
         checker = PermissionChecker()
+        select = parse_sql("SELECT * FROM t")[0]
+        insert = parse_sql("INSERT INTO t (a) VALUES (1)")[0]
+        checker.check(UserInfo("alice"), select, "greptime_private")
+        checker.check(None, select, "greptime_private")
         with pytest.raises(AuthError):
-            checker.check(UserInfo("alice"), object(), "greptime_private")
+            checker.check(UserInfo("alice"), insert, "greptime_private")
+        with pytest.raises(AuthError):
+            checker.check(None, insert, "greptime_private")
+        checker.check(UserInfo("greptime"), insert, "greptime_private")
+
+    def test_copy_requires_write(self):
+        """COPY moves data in/out — read-only grants must not allow it
+        (ADVICE r1: ingest/exfil via COPY with only 'read')."""
+        from greptimedb_tpu.sql import parse_sql
+
+        checker = PermissionChecker()
+        reader = UserInfo("r", grants=frozenset({"read"}))
+        copy_from = parse_sql("COPY t FROM '/tmp/x.parquet'")[0]
+        copy_to = parse_sql("COPY t TO '/tmp/x.parquet'")[0]
+        for stmt in (copy_from, copy_to):
+            with pytest.raises(AuthError):
+                checker.check(reader, stmt, "public")
 
     def test_enforced_in_engine(self, qe):
         """The engine itself rejects writes from read-only users

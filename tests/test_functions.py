@@ -55,6 +55,16 @@ class TestScalarFunctions:
             "ORDER BY ts").rows()
         assert [r[0] for r in rows] == [15.0, 30.0, 20.0]
 
+    def test_coalesce_strings(self, qe):
+        """coalesce over string/tag columns merges on `is None` instead of
+        raising via float/NaN coercion (ADVICE r1)."""
+        rows = qe.execute_one(
+            "SELECT coalesce(host, 'missing') AS h FROM cpu "
+            "WHERE ts = 1000 ORDER BY h").rows()
+        assert [r[0] for r in rows] == ["a", "b"]
+        assert one(qe, "SELECT coalesce(usage, 0.0) FROM cpu "
+                       "WHERE host='a' AND ts=1000") == 1.0
+
     def test_date_format(self, qe):
         r = one(qe, "SELECT date_format(ts, '%Y-%m-%d %H:%M:%S') "
                     "FROM cpu WHERE host = 'a' AND ts = 1000")
