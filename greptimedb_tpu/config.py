@@ -59,6 +59,20 @@ def query_mesh():
     return make_mesh()
 
 
+def dense_groups_max() -> int:
+    """Largest dense group-id product the aggregate kernel materializes as
+    [G, F] planes (1M groups x 10 f64 fields = 80 MiB per plane). Beyond
+    this the sparse (sort-compact) path runs — the TPU answer to the
+    reference's unbounded hash aggregate (SURVEY §7 hard part)."""
+    return int(os.environ.get("GREPTIMEDB_TPU_DENSE_GROUPS_MAX", str(1 << 20)))
+
+
+def sparse_groups_max() -> int:
+    """Cap on *observed* distinct groups in the sparse aggregate path
+    (output planes are [cap, F]); queries observing more raise."""
+    return int(os.environ.get("GREPTIMEDB_TPU_SPARSE_GROUPS_MAX", str(1 << 22)))
+
+
 def mesh_min_rows() -> int:
     """Scans below this row count skip the mesh path: per-shard dispatch
     overhead beats the parallelism on tiny results."""

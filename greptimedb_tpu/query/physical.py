@@ -42,8 +42,6 @@ from greptimedb_tpu.sql import ast
 from greptimedb_tpu.storage.engine import RegionEngine
 from greptimedb_tpu.storage.region import ScanData
 
-MAX_GROUPS = 1 << 24
-
 # primitive kernel ops backing each SQL aggregate
 _PRIMITIVES = {
     "sum": ("sum", "count"),  # count detects all-NULL groups -> NULL sum
@@ -233,6 +231,87 @@ def _agg_scan_sharded(
     return step(cols, base_mask)
 
 
+_GID_SENTINEL = (1 << 62)  # > any real combined group id (product guarded)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("where", "keys", "agg_args", "ops", "cap", "ts_name",
+                     "tag_names", "schema", "need_ts", "acc_dtype",
+                     "float_ops", "int_ops", "pack_dtype"),
+)
+def _agg_scan_sparse(
+    cols: dict,  # {name: [N] padded whole-scan arrays}
+    base_mask: jax.Array,  # [N] bool: padding & dedup survivors
+    *,
+    where, keys, agg_args, ops, cap, ts_name, tag_names, schema, need_ts,
+    acc_dtype, float_ops, int_ops, pack_dtype,
+):
+    """Sparse (high-cardinality) aggregation: when the dense key product
+    won't fit as [G, F] planes, sort the observed int64 group ids, compact
+    them to dense [0, U) ids at segment boundaries, and segment-reduce over
+    a static cap — the TPU-native replacement for the reference's hash
+    aggregate (DataFusion row-hash; BASELINE config #5: 1M tag combos).
+    Sorting is XLA-native and shapes stay static: all arrays are [N] or
+    [cap, F]; only the group *count* is dynamic (returned as a scalar).
+    """
+    mask = base_mask
+    if where is not None:
+        w = eval_device(where, cols, tag_names, schema)
+        mask = mask & (w if w.dtype == jnp.bool_ else w != 0)
+    key_arrays, sizes = [], []
+    for k in keys:
+        c = cols[k.column]
+        if k.kind == "tag":
+            arr = (c + 1).astype(jnp.int64)
+        elif k.kind == "bucket":
+            arr = (c // k.step - k.base).astype(jnp.int64)
+        else:
+            arr = c.astype(jnp.int64)
+        key_arrays.append(jnp.clip(arr, 0, k.size - 1))
+        sizes.append(k.size)
+    gid = combine_group_ids(key_arrays, tuple(sizes), dtype=jnp.int64)
+    gid = jnp.where(mask, gid, jnp.int64(_GID_SENTINEL))
+    order = jnp.argsort(gid)
+    sg = gid[order]
+    valid_s = sg != _GID_SENTINEL
+    prev = jnp.concatenate([jnp.full((1,), -1, jnp.int64), sg[:-1]])
+    new = valid_s & (sg != prev)
+    cid = jnp.cumsum(new.astype(jnp.int32)) - 1  # compact id per sorted row
+    ids = jnp.where(valid_s, jnp.clip(cid, 0, cap - 1), jnp.int32(cap))
+    n_groups = new.sum()
+    # observed global id per compact slot (ascending; overflow slots drop)
+    uniq = jnp.full((cap,), _GID_SENTINEL, dtype=jnp.int64).at[
+        jnp.where(new & (cid < cap), cid, cap)
+    ].set(sg, mode="drop")
+
+    if agg_args:
+        vals = [eval_device(a, cols, tag_names, schema) for a in agg_args]
+        vals = [
+            jnp.broadcast_to(v, mask.shape).astype(acc_dtype)
+            if jnp.ndim(v) == 0 else v.astype(acc_dtype)
+            for v in vals
+        ]
+        values = jnp.stack(vals, axis=1)[order]
+    else:
+        values = jnp.zeros((mask.shape[0], 1), dtype=acc_dtype)
+    ts = cols[ts_name][order] if need_ts else None
+    part = segment_agg(values, ids, valid_s, cap, ops=ops, ts=ts,
+                       indices_are_sorted=True)
+    parts = []
+    for k in float_ops:
+        v = part[k]
+        if v.ndim == 1:
+            v = v[:, None]
+        parts.append(v.astype(pack_dtype))
+    packed_f = jnp.concatenate(parts, axis=1)
+    if int_ops:
+        packed_i = jnp.stack([part[k] for k in int_ops], axis=1)
+    else:
+        packed_i = jnp.zeros((0,), jnp.int64)
+    return packed_f, packed_i, uniq, n_groups
+
+
 @functools.partial(jax.jit, static_argnames=("where", "tag_names", "schema"))
 def _filter_block(cols: dict, n_valid: jax.Array, dedup_mask, *, where,
                   tag_names, schema):
@@ -374,14 +453,19 @@ class PhysicalExecutor:
             dk, decode = self._plan_key(i, kexpr, ctx, scan, scan_node, extra_cols)
             keys.append(dk)
             decoders.append(decode)
+        from greptimedb_tpu import config
+
         num_groups = 1
         for k in keys:
             num_groups *= k.size
-        if num_groups > MAX_GROUPS:
+        if num_groups >= _GID_SENTINEL:
             raise PlanError(
-                f"group cardinality {num_groups} exceeds {MAX_GROUPS}; "
-                "add predicates or reduce keys"
+                f"group key space {num_groups} overflows the int64 id "
+                "domain; add predicates or reduce keys"
             )
+        # dense [G, F] planes up to the configured budget; beyond that the
+        # sparse sort-compact path handles arbitrary cardinality
+        sparse = bool(keys) and num_groups > config.dense_groups_max()
 
         # aggregate args -> values matrix columns (host-computed
         # order-statistic aggs don't consume a device value plane)
@@ -403,20 +487,27 @@ class PhysicalExecutor:
                 ops.update(_PRIMITIVES[spec.func])
         need_ts = bool({"first", "last"} & ops)
 
-        acc = self._stream_agg(scan, table, bound_where, tuple(keys),
-                               tuple(arg_exprs), tuple(sorted(ops)), num_groups,
-                               ts_name, ctx, extra_cols)
+        acc, sparse_gids = self._stream_agg(
+            scan, table, bound_where, tuple(keys), tuple(arg_exprs),
+            tuple(sorted(ops)), num_groups, ts_name, ctx, extra_cols, sparse)
         rows = acc["rows"][:, 0] if acc["rows"].ndim == 2 else acc["rows"]
-        if agg.keys:
+        if sparse_gids is not None:
+            # sparse: acc rows [0, U) are the observed groups, in
+            # ascending global-id order
+            present = np.arange(len(sparse_gids))
+            present_gids = sparse_gids
+        elif agg.keys:
             present = np.flatnonzero(rows > 0)
+            present_gids = present
         else:
             present = np.arange(1)
+            present_gids = present
         env: dict = {}
         # decode group key columns
         strides = _strides([k.size for k in keys])
         key_cols: dict[str, tuple[np.ndarray, Optional[DataType]]] = {}
         for i, ((name, kexpr), decode) in enumerate(zip(agg.keys, decoders)):
-            idx = (present // strides[i]) % keys[i].size
+            idx = (present_gids // strides[i]) % keys[i].size
             col, dtype = decode(idx)
             env[kexpr] = col
             key_cols[name] = (col, dtype)
@@ -428,13 +519,14 @@ class PhysicalExecutor:
             env[spec.call] = _finalize_agg(spec.func, acc, slot, present)
         if host_specs:
             self._host_aggs(host_specs, keys, scan, extra_cols, bound_where,
-                            table, ctx, num_groups, present, env)
+                            table, ctx, num_groups, present, env,
+                            sparse_gids)
 
         return self._post_process(env, agg, having, project, sort, limit, offset,
                                   table, len(present))
 
     def _host_aggs(self, host_specs, keys, scan, extra_cols, bound_where,
-                   table, ctx, num_groups, present, env):
+                   table, ctx, num_groups, present, env, sparse_gids=None):
         """Order-statistic aggregates (argmax/percentile/…) over host
         columns — see host_agg.py for the sort-based group pass. Uses the
         BOUND where/arg exprs (tag literals → codes, ts literals coerced),
@@ -445,6 +537,13 @@ class PhysicalExecutor:
 
         strides = _strides([k.size for k in keys])
         gid = ha.row_group_ids(keys, strides, scan, extra_cols)
+        if sparse_gids is not None:
+            # map global ids onto the compact [0, U) slots the device
+            # kernel assigned (ascending global-id order); rows whose
+            # group isn't observed are already masked out below
+            num_groups = len(sparse_gids)
+            gid = np.clip(np.searchsorted(sparse_gids, gid), 0,
+                          max(num_groups - 1, 0))
         n = scan.num_rows
         dmask = self._maybe_dedup(scan, table, ctx)
         mask = ha.host_row_mask(
@@ -527,7 +626,10 @@ class PhysicalExecutor:
         return lo, hi
 
     def _stream_agg(self, scan: ScanData, table, bound_where, keys, arg_exprs,
-                    ops, num_groups, ts_name, ctx, extra_cols):
+                    ops, num_groups, ts_name, ctx, extra_cols, sparse=False):
+        """Run the device aggregation; returns (acc planes, sparse group
+        ids or None). Dense: planes indexed by global group id. Sparse:
+        planes indexed by compact slot, plus the observed global ids."""
         from greptimedb_tpu import config
 
         schema = table.schema
@@ -565,6 +667,12 @@ class PhysicalExecutor:
 
         from greptimedb_tpu.parallel.mesh import COLLECTIVE_OPS
 
+        if sparse:
+            return self._sparse_scan(
+                scan, device_col_names, extra_cols, float_fields, acc_dtype,
+                dedup_mask, bound_where, keys, arg_exprs, ops, ts_name,
+                tag_names, schema, float_ops, int_ops, widths, pack_dtype)
+
         mesh = self.mesh
         if (mesh is not None and not int_ops
                 and set(ops) <= set(COLLECTIVE_OPS)
@@ -601,21 +709,55 @@ class PhysicalExecutor:
                 acc_dtype=acc_dtype, float_ops=float_ops, int_ops=int_ops,
                 pack_dtype=pack_dtype,
             )
-        host_f = np.asarray(packed_f)
-        acc: dict[str, np.ndarray] = {}
-        off = 0
-        for k in float_ops:
-            w = widths[k]
-            sl = host_f[:, off:off + w]
-            off += w
-            if k in ("count", "rows"):
-                sl = sl.astype(np.int64)
-            acc[k] = sl
-        if int_ops:
-            host_i = np.asarray(packed_i)
-            for j, k in enumerate(int_ops):
-                acc[k] = host_i[:, j]
-        return acc
+        return _unpack_acc(packed_f, packed_i, float_ops, int_ops, widths), None
+
+    def _sparse_scan(self, scan, device_col_names, extra_cols, float_fields,
+                     acc_dtype, dedup_mask, bound_where, keys, arg_exprs,
+                     ops, ts_name, tag_names, schema, float_ops, int_ops,
+                     widths, pack_dtype):
+        """High-cardinality aggregation over the whole scan as one padded
+        device program (sort-compact; see _agg_scan_sparse)."""
+        from greptimedb_tpu import config
+
+        n = scan.num_rows
+        n_pad = block_size_for(n)
+        cap = min(n_pad, config.sparse_groups_max())
+        cols = {}
+        for name in device_col_names:
+            cast = acc_dtype if name in float_fields else None
+
+            def build(name=name, cast=cast):
+                src = extra_cols[name] if name in extra_cols \
+                    else scan.columns[name]
+                arr = pad_rows(src, n_pad)
+                if cast is not None and arr.dtype != cast:
+                    arr = arr.astype(cast)
+                return jnp.asarray(arr)
+
+            if scan.region_id < 0 or name in extra_cols:
+                cols[name] = build()
+            else:
+                key = (scan.region_id, scan.data_version,
+                       scan.scan_fingerprint, name, "whole", n_pad, str(cast))
+                cols[name] = self.cache.get(key, build)
+        base = np.arange(n_pad) < n
+        if dedup_mask is not None:
+            base[:n] &= np.asarray(dedup_mask)[:n]
+        packed_f, packed_i, uniq, n_groups = _agg_scan_sparse(
+            cols, jnp.asarray(base), where=bound_where, keys=keys,
+            agg_args=arg_exprs, ops=ops, cap=cap, ts_name=ts_name,
+            tag_names=tag_names, schema=schema,
+            need_ts=bool({"first", "last"} & set(ops)), acc_dtype=acc_dtype,
+            float_ops=float_ops, int_ops=int_ops, pack_dtype=pack_dtype)
+        u = int(n_groups)
+        if u > cap:
+            raise PlanError(
+                f"query observed {u} distinct groups, exceeding the sparse "
+                f"cap {cap}; raise GREPTIMEDB_TPU_SPARSE_GROUPS_MAX or add "
+                "predicates")
+        acc = _unpack_acc(packed_f, packed_i, float_ops, int_ops, widths)
+        acc = {k: v[:u] for k, v in acc.items()}
+        return acc, np.asarray(uniq)[:u]
 
     def _sharded_scan(self, scan, mesh, device_col_names, extra_cols,
                       float_fields, acc_dtype, dedup_mask, bound_where, keys,
@@ -821,6 +963,25 @@ class PhysicalExecutor:
 def _pad_device_mask(mask: jax.Array, start: int, end: int, block: int) -> jax.Array:
     sl = jax.lax.dynamic_slice_in_dim(mask, start, end - start)
     return jnp.pad(sl, (0, block - (end - start)), constant_values=False)
+
+
+def _unpack_acc(packed_f, packed_i, float_ops, int_ops, widths):
+    """Split the kernel's packed output matrix back into per-op planes."""
+    host_f = np.asarray(packed_f)
+    acc: dict[str, np.ndarray] = {}
+    off = 0
+    for k in float_ops:
+        w = widths[k]
+        sl = host_f[:, off:off + w]
+        off += w
+        if k in ("count", "rows"):
+            sl = sl.astype(np.int64)
+        acc[k] = sl
+    if int_ops:
+        host_i = np.asarray(packed_i)
+        for j, k in enumerate(int_ops):
+            acc[k] = host_i[:, j]
+    return acc
 
 
 def _closed_range(ts_range):
