@@ -48,7 +48,19 @@ class RegionRequest:
 @dataclass
 class EngineConfig:
     data_dir: str
-    wal_sync: bool = False
+    # fsync at the WAL append boundary (reference raft-engine fsyncs the
+    # write batch; appends arrive pre-batched, so this is group commit).
+    # Turning it off trades durability of the last writes for latency.
+    wal_sync: bool = True
+    wal_segment_bytes: int = 64 << 20
+    # "local" = segmented files on this node's disk (raft-engine analog);
+    # "remote" = objects on shared storage (Kafka-WAL analog,
+    # log-store/src/kafka/log_store.rs) so failover candidates can replay
+    # without the failed node's disk
+    wal_backend: str = "local"
+    # explicit shared ObjectStore for the remote WAL; default = the
+    # engine's own object store
+    wal_store: Optional[object] = None
     # auto-flush when a memtable exceeds this many bytes (reference
     # WriteBufferManager global budget, flush.rs:83-135)
     flush_threshold_bytes: int = 256 << 20
@@ -66,7 +78,16 @@ class RegionEngine:
         self.store = build_store(config.object_store,
                                  config.object_store_cache_bytes)
         os.makedirs(config.data_dir, exist_ok=True)
-        self.wal = Wal(os.path.join(config.data_dir, "wal"), sync=config.wal_sync)
+        if config.wal_backend == "remote":
+            from greptimedb_tpu.storage.remote_wal import RemoteWal
+
+            self.wal = RemoteWal(config.wal_store or self.store,
+                                 prefix=os.path.join(config.data_dir,
+                                                     "remote_wal"))
+        else:
+            self.wal = Wal(os.path.join(config.data_dir, "wal"),
+                           sync=config.wal_sync,
+                           segment_bytes=config.wal_segment_bytes)
         self.regions: dict[int, Region] = {}
         # alternate engines (metric engine) hook region-open by id — the
         # RegionServer multi-engine registration analog (datanode.rs:328)

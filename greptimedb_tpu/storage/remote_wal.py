@@ -1,0 +1,102 @@
+"""Shared-storage WAL: the remote/Kafka-WAL analog.
+
+Mirrors the reference's `KafkaLogStore` (src/log-store/src/kafka/
+log_store.rs — a shared-topic remote WAL so a failover candidate can
+replay a dead datanode's unflushed writes from durable shared storage).
+The TPU build's shared medium is the object store (fs/memory/S3): each
+acknowledged append is one immutable object keyed by sequence, so any
+node that can see the store can replay the region — no access to the
+failed node's local disk required.
+
+Key layout: `wal/<region_id>/<seq:020d>` → CRC-framed Arrow IPC payload
+(same frame as the local WAL, so torn/corrupt objects are detected).
+`append` is durable once the object write returns (the object store is
+the fsync). `obsolete` deletes keys below the flushed sequence —
+per-object, no rewrite. Listing is ordered by the zero-padded key, which
+IS sequence order.
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+from typing import Iterator
+
+from greptimedb_tpu.datatypes.recordbatch import RecordBatch
+from greptimedb_tpu.objectstore import ObjectStore, ObjectStoreError
+from greptimedb_tpu.storage.wal import WalEntry, _decode_batch, _encode_batch
+
+_HEADER = struct.Struct("<IIQQB")  # payload_len, crc32, region_id, seq, op_type
+
+
+class RemoteWal:
+    """Object-store-backed WAL with the local `Wal` surface (append /
+    replay / obsolete / delete_region / close_region / close)."""
+
+    def __init__(self, store: ObjectStore, prefix: str = "wal"):
+        self.store = store
+        self.prefix = prefix.rstrip("/")
+
+    def _key(self, region_id: int, seq: int) -> str:
+        return f"{self.prefix}/{region_id}/{seq:020d}"
+
+    def _region_prefix(self, region_id: int) -> str:
+        return f"{self.prefix}/{region_id}/"
+
+    # ---- write -------------------------------------------------------------
+
+    def append(self, region_id: int, seq: int, op_type: int,
+               batch: RecordBatch) -> None:
+        payload = _encode_batch(batch)
+        frame = _HEADER.pack(len(payload), zlib.crc32(payload), region_id,
+                             seq, op_type)
+        self.store.write(self._key(region_id, seq), frame + payload)
+
+    # ---- replay ------------------------------------------------------------
+
+    def replay(self, region_id: int, from_seq: int = 0) -> Iterator[WalEntry]:
+        for key in sorted(self.store.list(self._region_prefix(region_id))):
+            seq_str = key.rsplit("/", 1)[-1]
+            try:
+                seq = int(seq_str)
+            except ValueError:
+                continue
+            if seq < from_seq:
+                continue
+            data = self.store.read(key)
+            if len(data) < _HEADER.size:
+                break  # torn object: nothing after it is trustworthy
+            plen, crc, rid, hseq, op = _HEADER.unpack_from(data, 0)
+            payload = data[_HEADER.size:_HEADER.size + plen]
+            if len(payload) != plen or zlib.crc32(payload) != crc:
+                break
+            yield WalEntry(rid, hseq, op, _decode_batch(payload))
+
+    # ---- truncation --------------------------------------------------------
+
+    def obsolete(self, region_id: int, up_to_seq: int) -> None:
+        for key in self.store.list(self._region_prefix(region_id)):
+            try:
+                seq = int(key.rsplit("/", 1)[-1])
+            except ValueError:
+                continue
+            if seq < up_to_seq:
+                try:
+                    self.store.delete(key)
+                except ObjectStoreError:
+                    pass
+
+    def delete_region(self, region_id: int) -> None:
+        for key in self.store.list(self._region_prefix(region_id)):
+            try:
+                self.store.delete(key)
+            except ObjectStoreError:
+                pass
+
+    # ---- lifecycle (no per-region handles to manage) ------------------------
+
+    def close_region(self, region_id: int) -> None:
+        pass
+
+    def close(self) -> None:
+        pass

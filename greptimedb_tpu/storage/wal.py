@@ -1,17 +1,31 @@
-"""Write-ahead log: CRC-framed Arrow IPC entries on local disk.
+"""Write-ahead log: CRC-framed Arrow IPC entries in segmented local files.
 
 Mirrors the reference's `LogStore` trait + raft-engine implementation
 (src/log-store/src/raft_engine/log_store.rs:44,199) and mito2's `Wal`
-append-batch/scan/obsolete surface (mito2/src/wal.rs:53-150). One file per
-region namespace; entries are appended with a length+CRC32 frame so torn
-tails are detected and truncated on replay. Payload is an Arrow IPC stream
-(zero parsing cost on replay — columns come back ready for the memtable).
+append-batch/scan/obsolete surface (mito2/src/wal.rs:53-150). Entries are
+appended with a length+CRC32 frame so torn tails are detected and truncated
+on replay. Payload is an Arrow IPC stream (zero parsing cost on replay —
+columns come back ready for the memtable).
+
+Durability: fsync at the append boundary by DEFAULT (the reference's
+raft-engine fsyncs its write batch; a database that loses acknowledged
+writes on power cut isn't one). Writes arrive pre-batched (one frame per
+put), so the fsync amortizes over the batch exactly like the reference's
+group commit (mito2 worker batches ≤64 requests into one WAL write,
+worker.rs:576-650).
+
+Truncation: the log is a sequence of SEGMENT files per region
+(`region_<id>.<segno>.wal`), rolled at a size threshold. `obsolete`
+deletes whole segments whose entries are all below the flushed sequence —
+O(#segments) header scans, no payload rewrite (the round-1 implementation
+replayed and rewrote the entire file per flush).
 """
 
 from __future__ import annotations
 
 import io
 import os
+import re
 import struct
 import zlib
 from dataclasses import dataclass
@@ -24,6 +38,10 @@ from greptimedb_tpu.datatypes.schema import Schema
 
 _HEADER = struct.Struct("<IIQQB")  # payload_len, crc32, region_id, seq, op_type
 
+DEFAULT_SEGMENT_BYTES = 64 << 20
+
+_SEG_RE = re.compile(r"^region_(\d+)\.(\d+)\.wal$")
+
 
 @dataclass
 class WalEntry:
@@ -34,91 +52,147 @@ class WalEntry:
 
 
 class Wal:
-    """Per-region write-ahead log over a directory of region files."""
+    """Per-region segmented write-ahead log over a directory."""
 
-    def __init__(self, wal_dir: str, sync: bool = False):
+    def __init__(self, wal_dir: str, sync: bool = True,
+                 segment_bytes: int = DEFAULT_SEGMENT_BYTES):
         self.wal_dir = wal_dir
         self.sync = sync
+        self.segment_bytes = segment_bytes
         os.makedirs(wal_dir, exist_ok=True)
-        self._files: dict[int, io.BufferedWriter] = {}
+        # region -> (segno, open append handle)
+        self._files: dict[int, tuple[int, io.BufferedWriter]] = {}
 
-    def _path(self, region_id: int) -> str:
-        return os.path.join(self.wal_dir, f"region_{region_id}.wal")
+    def _seg_path(self, region_id: int, segno: int) -> str:
+        return os.path.join(self.wal_dir, f"region_{region_id}.{segno:08d}.wal")
 
-    def _file(self, region_id: int):
-        f = self._files.get(region_id)
-        if f is None:
-            f = open(self._path(region_id), "ab")
-            self._files[region_id] = f
-        return f
+    def _segments(self, region_id: int) -> list[tuple[int, str]]:
+        """Sorted (segno, path) for a region, including a legacy unsegmented
+        `region_<id>.wal` file as segment -1 if present."""
+        out = []
+        legacy = os.path.join(self.wal_dir, f"region_{region_id}.wal")
+        if os.path.exists(legacy):
+            out.append((-1, legacy))
+        try:
+            names = os.listdir(self.wal_dir)
+        except FileNotFoundError:
+            return out
+        for name in names:
+            m = _SEG_RE.match(name)
+            if m and int(m.group(1)) == region_id:
+                out.append((int(m.group(2)), os.path.join(self.wal_dir, name)))
+        out.sort()
+        return out
+
+    def _writer(self, region_id: int):
+        ent = self._files.get(region_id)
+        if ent is None:
+            segs = self._segments(region_id)
+            segno = segs[-1][0] if segs else 0
+            if segno < 0:
+                segno = 0
+            f = open(self._seg_path(region_id, segno), "ab")
+            ent = (segno, f)
+            self._files[region_id] = ent
+        return ent
+
+    def _roll(self, region_id: int) -> None:
+        segno, f = self._files.pop(region_id)
+        f.close()
+        nf = open(self._seg_path(region_id, segno + 1), "ab")
+        self._files[region_id] = (segno + 1, nf)
 
     # ---- write -------------------------------------------------------------
 
     def append(self, region_id: int, seq: int, op_type: int, batch: RecordBatch) -> None:
         payload = _encode_batch(batch)
         frame = _HEADER.pack(len(payload), zlib.crc32(payload), region_id, seq, op_type)
-        f = self._file(region_id)
+        segno, f = self._writer(region_id)
         f.write(frame)
         f.write(payload)
         f.flush()
         if self.sync:
-            os.fsync(f.fileno())
+            os.fsync(f.fileno())  # ← the durability boundary
+        if f.tell() >= self.segment_bytes:
+            self._roll(region_id)
 
     # ---- replay ------------------------------------------------------------
 
     def replay(self, region_id: int, from_seq: int = 0) -> Iterator[WalEntry]:
-        """Scan entries for a region (reference wal.rs:77 `scan`). Truncates
-        a torn tail in place if the last frame is incomplete/corrupt."""
-        path = self._path(region_id)
-        if not os.path.exists(path):
-            return
+        """Scan entries across segments in order (reference wal.rs:77
+        `scan`). A torn tail in the LAST segment is truncated in place; a
+        corrupt frame in an earlier segment stops replay there (entries
+        beyond it were never acknowledged as durable in order)."""
         self.close_region(region_id)
-        valid_end = 0
-        with open(path, "rb") as f:
-            data = f.read()
-        pos = 0
-        entries = []
-        while pos + _HEADER.size <= len(data):
-            plen, crc, rid, seq, op = _HEADER.unpack_from(data, pos)
-            payload = data[pos + _HEADER.size : pos + _HEADER.size + plen]
-            if len(payload) != plen or zlib.crc32(payload) != crc:
-                break  # torn tail
-            pos += _HEADER.size + plen
-            valid_end = pos
-            if seq >= from_seq:
-                entries.append(WalEntry(rid, seq, op, _decode_batch(payload)))
-        if valid_end < len(data):
-            with open(path, "r+b") as f:
-                f.truncate(valid_end)
-        yield from entries
+        segs = self._segments(region_id)
+        for i, (segno, path) in enumerate(segs):
+            with open(path, "rb") as f:
+                data = f.read()
+            pos = 0
+            valid_end = 0
+            entries = []
+            while pos + _HEADER.size <= len(data):
+                plen, crc, rid, seq, op = _HEADER.unpack_from(data, pos)
+                payload = data[pos + _HEADER.size : pos + _HEADER.size + plen]
+                if len(payload) != plen or zlib.crc32(payload) != crc:
+                    break  # torn tail
+                pos += _HEADER.size + plen
+                valid_end = pos
+                if seq >= from_seq:
+                    entries.append(WalEntry(rid, seq, op, _decode_batch(payload)))
+            if valid_end < len(data):
+                with open(path, "r+b") as f:
+                    f.truncate(valid_end)
+                yield from entries
+                return  # nothing after a torn frame is trustworthy
+            yield from entries
 
     # ---- truncation (post-flush, reference handle_flush.rs WAL truncate) ----
 
     def obsolete(self, region_id: int, up_to_seq: int) -> None:
-        """Drop entries with seq < up_to_seq by rewriting the file."""
-        kept = [e for e in self.replay(region_id) if e.seq >= up_to_seq]
+        """Drop whole segments whose entries all have seq < up_to_seq.
+        Header-only scan per segment — no payload decode, no rewrite. The
+        active (last) segment is never deleted; its obsolete prefix is
+        bounded by segment_bytes and ignored on replay via from_seq."""
         self.close_region(region_id)
-        tmp = self._path(region_id) + ".tmp"
-        with open(tmp, "wb") as f:
-            for e in kept:
-                payload = _encode_batch(e.batch)
-                f.write(_HEADER.pack(len(payload), zlib.crc32(payload), e.region_id, e.seq, e.op_type))
-                f.write(payload)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self._path(region_id))
+        segs = self._segments(region_id)
+        for segno, path in segs[:-1] if segs else []:
+            if self._max_seq(path) < up_to_seq:
+                os.remove(path)
+            else:
+                break  # segments are in seq order; later ones are newer
+
+    @staticmethod
+    def _max_seq(path: str) -> int:
+        """Highest frame seq in a sealed segment (header-skip scan)."""
+        best = -1
+        size = os.path.getsize(path)
+        with open(path, "rb") as f:
+            pos = 0
+            while pos + _HEADER.size <= size:
+                hdr = f.read(_HEADER.size)
+                if len(hdr) < _HEADER.size:
+                    break
+                plen, _, _, seq, _ = _HEADER.unpack(hdr)
+                if pos + _HEADER.size + plen > size:
+                    break  # torn
+                best = max(best, seq)
+                pos += _HEADER.size + plen
+                f.seek(pos)
+        return best
 
     def delete_region(self, region_id: int) -> None:
         self.close_region(region_id)
-        try:
-            os.remove(self._path(region_id))
-        except FileNotFoundError:
-            pass
+        for _, path in self._segments(region_id):
+            try:
+                os.remove(path)
+            except FileNotFoundError:
+                pass
 
     def close_region(self, region_id: int) -> None:
-        f = self._files.pop(region_id, None)
-        if f is not None:
-            f.close()
+        ent = self._files.pop(region_id, None)
+        if ent is not None:
+            ent[1].close()
 
     def close(self) -> None:
         for rid in list(self._files):
