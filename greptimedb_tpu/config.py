@@ -73,6 +73,25 @@ def sparse_groups_max() -> int:
     return int(os.environ.get("GREPTIMEDB_TPU_SPARSE_GROUPS_MAX", str(1 << 22)))
 
 
+def stream_threshold_rows() -> int:
+    """Aggregate scans at or above this row estimate run the streaming
+    (bounded-memory) path: lazy row-group chunks -> fixed-shape device
+    blocks -> incremental on-device combine, instead of materializing the
+    whole scan on host (reference streams lazy row groups with a page
+    cache, mito2/src/sst/parquet/row_group.rs). Below the threshold the
+    materialized path keeps whole column snapshots HBM-cached across
+    repeated queries (the TSBS warm-cache regime); the default hands over
+    to streaming where those snapshots stop fitting."""
+    return int(os.environ.get("GREPTIMEDB_TPU_STREAM_THRESHOLD_ROWS",
+                              str(32 << 20)))
+
+
+def stream_block_rows() -> int:
+    """Fixed device block shape for the streaming path (one compile)."""
+    return int(os.environ.get("GREPTIMEDB_TPU_STREAM_BLOCK_ROWS",
+                              str(2 << 20)))
+
+
 def mesh_min_rows() -> int:
     """Scans below this row count skip the mesh path: per-shard dispatch
     overhead beats the parallelism on tiny results."""

@@ -231,6 +231,37 @@ def _agg_scan_sharded(
     return step(cols, base_mask)
 
 
+class _NotStreamable(Exception):
+    """Query shape the streaming path can't serve (generic group keys,
+    host-side order statistics); caller falls back to the materialized
+    scan."""
+
+
+_agg_block_jit = functools.partial(
+    jax.jit,
+    static_argnames=("where", "keys", "agg_args", "ops", "num_segments",
+                     "ts_name", "tag_names", "schema", "need_ts",
+                     "acc_dtype"),
+)(_agg_block)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("where", "keys", "agg_args", "ops", "num_segments",
+                     "ts_name", "tag_names", "schema", "need_ts",
+                     "acc_dtype"),
+)
+def _agg_step(acc, cols, n_valid, *, where, keys, agg_args, ops,
+              num_segments, ts_name, tag_names, schema, need_ts, acc_dtype):
+    """One streaming step: fold a chunk's partial aggregate into the
+    device-resident accumulator (constant HBM; one dispatch per chunk)."""
+    part = _agg_block(cols, n_valid, None, where=where, keys=keys,
+                      agg_args=agg_args, ops=ops, num_segments=num_segments,
+                      ts_name=ts_name, tag_names=tag_names, schema=schema,
+                      need_ts=need_ts, acc_dtype=acc_dtype)
+    return _combine_partials(acc, part)
+
+
 _GID_SENTINEL = (1 << 62)  # > any real combined group id (product guarded)
 
 
@@ -374,6 +405,9 @@ class PhysicalExecutor:
         # multi-device: row-shard the scan over the mesh and combine
         # partial aggregates with collectives (None on a single chip)
         self.mesh = config.query_mesh()
+        # which aggregate path served the last query (dense | sparse |
+        # sharded | stream) — observability for EXPLAIN/tests
+        self.last_path = None
 
     def execute(self, plan: lp.LogicalPlan) -> QueryResult:
         # unwrap the linear chain
@@ -413,6 +447,24 @@ class PhysicalExecutor:
         from greptimedb_tpu.storage.index import extract_tag_predicates
 
         tag_preds = extract_tag_predicates(where, table.schema) or None
+
+        # beyond-RAM aggregate scans stream: append-mode (no dedup sort),
+        # single region, estimated rows over the threshold
+        if (agg is not None and table.append_mode
+                and len(table.region_ids) == 1):
+            from greptimedb_tpu import config
+
+            stream = self.engine.scan_stream(
+                table.region_ids[0], ts_range, scan_node.columns, tag_preds)
+            if (stream is not None
+                    and stream.est_rows >= config.stream_threshold_rows()):
+                try:
+                    return self._execute_agg_stream(
+                        stream, table, where, agg, having, project, sort,
+                        limit, offset, scan_node)
+                except _NotStreamable:
+                    pass  # materialized fallback below
+
         if len(table.region_ids) == 1:
             scan = self.engine.scan(table.region_ids[0], ts_range,
                                     scan_node.columns, tag_preds)
@@ -490,6 +542,18 @@ class PhysicalExecutor:
         acc, sparse_gids = self._stream_agg(
             scan, table, bound_where, tuple(keys), tuple(arg_exprs),
             tuple(sorted(ops)), num_groups, ts_name, ctx, extra_cols, sparse)
+        host_info = (scan, extra_cols, bound_where, ctx, num_groups)
+        return self._agg_tail(acc, sparse_gids, agg, keys, decoders,
+                              spec_slot, host_info, having, project, sort,
+                              limit, offset, table)
+
+    def _agg_tail(self, acc, sparse_gids, agg, keys, decoders, spec_slot,
+                  host_info, having, project, sort, limit, offset,
+                  table) -> QueryResult:
+        """Shared host tail: decode present groups' keys, finalize
+        aggregates, run HAVING/ORDER/LIMIT over the G-row result."""
+        from greptimedb_tpu.query.host_agg import HOST_AGGS
+
         rows = acc["rows"][:, 0] if acc["rows"].ndim == 2 else acc["rows"]
         if sparse_gids is not None:
             # sparse: acc rows [0, U) are the observed groups, in
@@ -518,12 +582,164 @@ class PhysicalExecutor:
                 continue
             env[spec.call] = _finalize_agg(spec.func, acc, slot, present)
         if host_specs:
+            scan, extra_cols, bound_where, ctx, num_groups = host_info
             self._host_aggs(host_specs, keys, scan, extra_cols, bound_where,
                             table, ctx, num_groups, present, env,
                             sparse_gids)
 
         return self._post_process(env, agg, having, project, sort, limit, offset,
                                   table, len(present))
+
+    def _execute_agg_stream(self, stream, table, where, agg, having, project,
+                            sort, limit, offset, scan_node) -> QueryResult:
+        """Bounded-memory aggregation: lazy scan chunks fold into a
+        device-resident accumulator (see ScanStream). Raises _NotStreamable
+        for shapes that need the whole scan on host (generic keys, host
+        order statistics, sparse cardinality)."""
+        from greptimedb_tpu import config
+        from greptimedb_tpu.query.host_agg import HOST_AGGS
+
+        schema = table.schema
+        ts_name = schema.time_index.name
+        ctx = BindContext(schema, stream.tag_dicts)
+        bound_where = bind_expr(where, ctx) if where is not None else None
+
+        keys: list[DeviceKey] = []
+        decoders = []
+        for i, (name, kexpr) in enumerate(agg.keys):
+            dk, decode = self._plan_key_stream(i, kexpr, ctx, stream, scan_node)
+            keys.append(dk)
+            decoders.append(decode)
+        num_groups = 1
+        for k in keys:
+            num_groups *= k.size
+        if num_groups > config.dense_groups_max():
+            raise _NotStreamable("sparse cardinality")
+
+        arg_exprs: list[ast.Expr] = []
+        spec_slot: list[Optional[int]] = []
+        for spec in agg.aggs:
+            if spec.func in HOST_AGGS:
+                raise _NotStreamable(f"host aggregate {spec.func}")
+            if spec.arg is None:
+                spec_slot.append(None)
+                continue
+            b = bind_expr(spec.arg, ctx)
+            if b not in arg_exprs:
+                arg_exprs.append(b)
+            spec_slot.append(arg_exprs.index(b))
+        ops: set = {"rows"}
+        for spec in agg.aggs:
+            ops.update(_PRIMITIVES[spec.func])
+        need_ts = bool({"first", "last"} & ops)
+
+        self.last_path = "stream"
+        acc = self._fold_stream(stream, table, bound_where, tuple(keys),
+                                tuple(arg_exprs), tuple(sorted(ops)),
+                                num_groups, ts_name, ctx, need_ts,
+                                len(arg_exprs))
+        return self._agg_tail(acc, None, agg, keys, decoders, spec_slot,
+                              None, having, project, sort, limit, offset,
+                              table)
+
+    def _fold_stream(self, stream, table, bound_where, keys, arg_exprs, ops,
+                     num_groups, ts_name, ctx, need_ts, nf):
+        from greptimedb_tpu import config
+
+        schema = table.schema
+        acc_dtype = jnp.dtype(config.compute_dtype())
+        tag_names = frozenset(ctx.tag_names)
+        float_fields = {c.name for c in schema.field_columns if c.dtype.is_float}
+        from greptimedb_tpu.query.expr import collect_columns
+
+        needed: set[str] = set()
+        collect_columns(bound_where, needed)
+        for a in arg_exprs:
+            collect_columns(a, needed)
+        for k in keys:
+            needed.add(k.column)
+        needed.add(ts_name)
+        names = sorted(needed)
+
+        block = config.stream_block_rows()
+        kw = dict(where=bound_where, keys=keys, agg_args=arg_exprs, ops=ops,
+                  num_segments=num_groups, ts_name=ts_name,
+                  tag_names=tag_names, schema=schema, need_ts=need_ts,
+                  acc_dtype=acc_dtype)
+        acc_dev = None
+        for cols_np, nrows in stream.chunks():
+            for start in range(0, nrows, block):
+                end = min(start + block, nrows)
+                dev = {}
+                for name in names:
+                    arr = pad_rows(np.asarray(cols_np[name][start:end]), block)
+                    if name in float_fields and arr.dtype != acc_dtype:
+                        arr = arr.astype(acc_dtype)
+                    dev[name] = jnp.asarray(arr)
+                n_valid = jnp.asarray(end - start)
+                if acc_dev is None:
+                    acc_dev = _agg_block_jit(dev, n_valid, None, **kw)
+                else:
+                    acc_dev = _agg_step(acc_dev, dev, n_valid, **kw)
+        nf = max(nf, 1)
+        if acc_dev is None:
+            # pruned-empty stream: identity planes
+            acc = {}
+            for op in ops:
+                if op == "rows":
+                    acc[op] = np.zeros((num_groups, 1), dtype=np.int64)
+                elif op == "count":
+                    acc[op] = np.zeros((num_groups, nf), dtype=np.int64)
+                elif op in ("sum", "sumsq"):
+                    acc[op] = np.zeros((num_groups, nf))
+                elif op in ("min", "max", "first", "last"):
+                    acc[op] = np.full((num_groups, nf), np.nan)
+                    if op in ("first", "last"):
+                        acc[op + "_ts"] = np.zeros(num_groups, dtype=np.int64)
+            return acc
+        acc = {k: np.asarray(v) for k, v in acc_dev.items()}
+        for k in ("count", "rows"):
+            if k in acc:
+                acc[k] = acc[k].astype(np.int64)
+        return acc
+
+    def _plan_key_stream(self, i, kexpr, ctx, stream, scan_node):
+        """Key planning against stream metadata only (no data columns):
+        tag keys decode from the registry dictionaries; time buckets get
+        their extent from pruned-file stats. Anything needing the actual
+        rows (generic expressions) is not streamable."""
+        schema = ctx.schema
+        ts_col = schema.time_index
+        if isinstance(kexpr, ast.Column) and kexpr.name in ctx.tag_names:
+            name = kexpr.name
+            values = stream.tag_dicts[name]
+
+            def decode_tag(idx, values=values):
+                out = np.empty(len(idx), dtype=object)
+                codes = idx - 1
+                valid = codes >= 0
+                out[valid] = values[codes[valid]]
+                out[~valid] = None
+                return out, DataType.STRING
+
+            return DeviceKey("tag", name, len(values) + 1), decode_tag
+        if (isinstance(kexpr, ast.FuncCall) and kexpr.name in ("date_bin", "time_bucket")
+                and isinstance(kexpr.args[0], ast.Interval)
+                and isinstance(kexpr.args[1], ast.Column)
+                and kexpr.args[1].name == ts_col.name):
+            unit = ts_col.dtype.time_unit.nanos_per_unit
+            step = max(kexpr.args[0].nanos // unit, 1)
+            lo, hi = self._ts_bounds(scan_node, None,
+                                     fallback=(stream.ts_min, stream.ts_max))
+            base = int(np.floor_divide(lo, step))
+            size = int(np.floor_divide(hi, step)) - base + 1
+
+            def decode_bucket(idx, step=step, base=base, dtype=ts_col.dtype):
+                return (idx.astype(np.int64) + base) * step, dtype
+
+            return DeviceKey("bucket", ts_col.name, size, step=step,
+                             base=base), decode_bucket
+        raise _NotStreamable(f"group key {kexpr!r} needs materialized scan")
 
     def _host_aggs(self, host_specs, keys, scan, extra_cols, bound_where,
                    table, ctx, num_groups, present, env, sparse_gids=None):
@@ -614,15 +830,15 @@ class PhysicalExecutor:
 
         return DeviceKey("pre", colname, max(len(uniq), 1)), decode_pre
 
-    def _ts_bounds(self, scan_node, ts_arr) -> tuple[int, int]:
+    def _ts_bounds(self, scan_node, ts_arr, fallback=None) -> tuple[int, int]:
         lo = hi = None
         if scan_node.ts_range is not None:
             lo, hi0 = scan_node.ts_range
             hi = None if hi0 is None else hi0 - 1
         if lo is None:
-            lo = int(ts_arr.min())
+            lo = int(ts_arr.min()) if ts_arr is not None else fallback[0]
         if hi is None:
-            hi = int(ts_arr.max())
+            hi = int(ts_arr.max()) if ts_arr is not None else fallback[1]
         return lo, hi
 
     def _stream_agg(self, scan: ScanData, table, bound_where, keys, arg_exprs,
@@ -668,6 +884,7 @@ class PhysicalExecutor:
         from greptimedb_tpu.parallel.mesh import COLLECTIVE_OPS
 
         if sparse:
+            self.last_path = "sparse"
             return self._sparse_scan(
                 scan, device_col_names, extra_cols, float_fields, acc_dtype,
                 dedup_mask, bound_where, keys, arg_exprs, ops, ts_name,
@@ -677,12 +894,14 @@ class PhysicalExecutor:
         if (mesh is not None and not int_ops
                 and set(ops) <= set(COLLECTIVE_OPS)
                 and n >= config.mesh_min_rows()):
+            self.last_path = "sharded"
             packed_f = self._sharded_scan(
                 scan, mesh, device_col_names, extra_cols, float_fields,
                 acc_dtype, dedup_mask, bound_where, keys, arg_exprs, ops,
                 num_groups, ts_name, tag_names, schema, float_ops, pack_dtype)
             packed_i = None
         else:
+            self.last_path = "dense"
             block = min(block_size_for(n), DEFAULT_BLOCK_ROWS)
             blocks = []
             dmasks = [] if dedup_mask is not None else None

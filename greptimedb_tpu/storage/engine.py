@@ -193,5 +193,16 @@ class RegionEngine:
     ) -> Optional[ScanData]:
         return self.region(region_id).scan(ts_range, projection, tag_predicates)
 
+    def scan_stream(
+        self,
+        region_id: int,
+        ts_range: Optional[tuple[int, int]] = None,
+        projection: Optional[Sequence[str]] = None,
+        tag_predicates: Optional[dict[str, set]] = None,
+    ):
+        """Lazy bounded-memory scan (see region.ScanStream)."""
+        return self.region(region_id).scan_stream(ts_range, projection,
+                                                  tag_predicates)
+
     def close(self) -> None:
         self.wal.close()

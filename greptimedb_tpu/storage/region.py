@@ -61,6 +61,29 @@ class ScanData:
         return {k: len(v) for k, v in self.tag_dicts.items()}
 
 
+@dataclass
+class ScanStream:
+    """Lazy scan: metadata upfront, columns delivered as bounded chunks
+    (reference streams lazy row groups with a page cache,
+    sst/parquet/row_group.rs + reader.rs:335-447; here each chunk becomes
+    one padded device block, so host memory stays flat regardless of scan
+    size). Tag dictionaries come from the region's registry — complete
+    without touching the data. Only append-mode (no-dedup) scans stream;
+    last-write-wins needs the whole scan in one sort."""
+
+    schema: Schema
+    tag_dicts: dict[str, np.ndarray]
+    region_id: int
+    data_version: int
+    est_rows: int
+    ts_min: int  # over the pruned file set + memtable (chunk key planning)
+    ts_max: int
+    _chunks: object  # () -> Iterator[(cols dict, nrows)]
+
+    def chunks(self):
+        return self._chunks()
+
+
 class Region:
     def __init__(self, region_id: int, region_dir: str, schema: Schema, wal: Wal,
                  store=None, manifest: "ManifestManager" = None):
@@ -316,6 +339,58 @@ class Region:
         while len(self._scan_cache) > self.scan_cache_entries:
             self._scan_cache.popitem(last=False)
         return result
+
+    def scan_stream(
+        self,
+        ts_range: Optional[tuple[int, int]] = None,
+        projection: Optional[Sequence[str]] = None,
+        tag_predicates: Optional[dict[str, set]] = None,
+        groups_per_chunk: int = 8,
+    ) -> Optional["ScanStream"]:
+        """Lazy bounded-memory scan (see ScanStream). Returns None when the
+        time range prunes everything."""
+        names = self._scan_columns(projection)
+        files = [
+            meta for meta in self.files.values()
+            if ts_range is None
+            or (meta.ts_max >= ts_range[0] and meta.ts_min < ts_range[1])
+        ]
+        mem = self.memtable.concat(ts_range)
+        if not files and mem is None:
+            return None
+        bounds = [(m.ts_min, m.ts_max) for m in files]
+        if mem is not None and len(mem[1]):
+            ts_name = self.schema.time_index.name
+            bounds.append((int(mem[0][ts_name].min()),
+                           int(mem[0][ts_name].max())))
+        ts_min = min(b[0] for b in bounds)
+        ts_max = max(b[1] for b in bounds)
+        est = sum(m.num_rows for m in files) + (len(mem[1]) if mem else 0)
+
+        def gen():
+            for meta in files:
+                for table in self.sst_reader.iter_chunks(
+                        meta, self.schema, ts_range, names,
+                        tag_predicates=tag_predicates,
+                        groups_per_chunk=groups_per_chunk):
+                    if table.num_rows:
+                        yield self._decode_sst(table, names), table.num_rows
+            if mem is not None and len(mem[1]):
+                yield {n: mem[0][n] for n in names}, len(mem[1])
+
+        return ScanStream(
+            schema=self.schema,
+            tag_dicts={
+                c.name: self.registry.dict_array(c.name)
+                for c in self.schema.tag_columns if c.name in names
+            },
+            region_id=self.region_id,
+            data_version=self.data_version,
+            est_rows=est,
+            ts_min=ts_min,
+            ts_max=ts_max,
+            _chunks=gen,
+        )
 
     def _scan_columns(self, projection: Optional[Sequence[str]]) -> list[str]:
         ts_name = self.schema.time_index.name

@@ -176,6 +176,45 @@ class SstReader:
         table = pf.read_row_groups(groups, columns=cols)
         return table
 
+    def iter_chunks(
+        self,
+        meta: FileMeta,
+        schema: Schema,
+        ts_range: Optional[tuple[int, int]] = None,
+        projection: Optional[Sequence[str]] = None,
+        tag_predicates: Optional[dict[str, set]] = None,
+        groups_per_chunk: int = 8,
+    ):
+        """Lazily yield row-group batches of an SST (reference
+        sst/parquet/row_group.rs lazy InMemoryRowGroup + reader.rs
+        FileRange streaming) — bounded memory for beyond-RAM scans. Same
+        pruning as `read`; each yield decodes only `groups_per_chunk`
+        row groups."""
+        if ts_range is not None and (meta.ts_max < ts_range[0]
+                                     or meta.ts_min >= ts_range[1]):
+            return
+        idx_groups = None
+        if tag_predicates:
+            idx_groups = self.index_applier.apply(meta.file_id, tag_predicates)
+            if idx_groups == []:
+                return
+        pf = pq.ParquetFile(self.store.open_input(self.path(meta.file_id)))
+        ts_name = schema.time_index.name
+        groups = self._prune_row_groups(pf, ts_name, ts_range)
+        if idx_groups is not None:
+            allowed = set(idx_groups)
+            groups = [g for g in groups if g in allowed]
+        if not groups:
+            return
+        cols = None
+        if projection is not None:
+            cols = list(dict.fromkeys(list(projection) + [ts_name, SEQ_COL, OP_COL]))
+            avail = set(pf.schema_arrow.names)
+            cols = [c for c in cols if c in avail]
+        for i in range(0, len(groups), groups_per_chunk):
+            yield pf.read_row_groups(groups[i:i + groups_per_chunk],
+                                     columns=cols)
+
     def _prune_row_groups(
         self, pf: pq.ParquetFile, ts_name: str, ts_range: Optional[tuple[int, int]]
     ) -> list[int]:

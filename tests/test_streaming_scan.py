@@ -1,0 +1,169 @@
+"""Streaming bounded-memory scan: lazy row-group chunks folded into a
+device-resident accumulator (VERDICT r1 item 3 — beyond-RAM aggregate
+scans; reference streams lazy row groups, mito2/src/sst/parquet/
+row_group.rs + reader.rs:335-447)."""
+
+import numpy as np
+import pytest
+
+from greptimedb_tpu.catalog import Catalog, MemoryKv
+from greptimedb_tpu.query import QueryEngine
+from greptimedb_tpu.storage import RegionEngine
+from greptimedb_tpu.storage.engine import EngineConfig
+
+
+@pytest.fixture
+def db(tmp_path, monkeypatch):
+    # stream every aggregate scan, tiny device blocks, no mesh interference
+    monkeypatch.setenv("GREPTIMEDB_TPU_STREAM_THRESHOLD_ROWS", "1")
+    monkeypatch.setenv("GREPTIMEDB_TPU_STREAM_BLOCK_ROWS", "1024")
+    engine = RegionEngine(EngineConfig(data_dir=str(tmp_path)))
+    qe = QueryEngine(Catalog(MemoryKv()), engine)
+    yield qe
+    engine.close()
+
+
+def _fill(db, n_hosts=6, points=400, flushes=3, seed=9):
+    db.execute_one(
+        "CREATE TABLE cpu (host STRING, usage DOUBLE, mem DOUBLE, "
+        "ts TIMESTAMP(3) TIME INDEX, PRIMARY KEY(host)) "
+        "WITH (append_mode = 'true')")
+    rng = np.random.default_rng(seed)
+    usage = np.round(rng.uniform(0, 100, n_hosts * points * flushes), 6)
+    mem = np.round(rng.uniform(0, 64, n_hosts * points * flushes), 6)
+    i = 0
+    for f in range(flushes):
+        rows = []
+        for p in range(points):
+            for h in range(n_hosts):
+                ts = (f * points + p) * 1000
+                rows.append(f"('h{h}', {usage[i]}, {mem[i]}, {ts})")
+                i += 1
+        db.execute_one("INSERT INTO cpu (host, usage, mem, ts) VALUES "
+                       + ",".join(rows))
+        db.execute_one("ADMIN flush_table('cpu')")
+    # plus unflushed memtable rows
+    db.execute_one("INSERT INTO cpu (host, usage, mem, ts) VALUES "
+                   "('h0', 50.0, 32.0, 99999000)")
+
+
+def _materialized(db, sql, monkeypatch):
+    monkeypatch.setenv("GREPTIMEDB_TPU_STREAM_THRESHOLD_ROWS", str(1 << 60))
+    try:
+        return db.execute_one(sql).rows()
+    finally:
+        monkeypatch.setenv("GREPTIMEDB_TPU_STREAM_THRESHOLD_ROWS", "1")
+
+
+class TestStreamingScan:
+    def test_stream_path_taken(self, db, monkeypatch):
+        _fill(db)
+        db.execute_one("SELECT host, avg(usage) FROM cpu GROUP BY host")
+        assert db.executor.last_path == "stream"
+
+    def test_double_groupby_matches(self, db, monkeypatch):
+        _fill(db)
+        sql = ("SELECT host, date_bin(INTERVAL '1 minute', ts) AS m, "
+               "avg(usage), count(usage), min(mem), max(mem), sum(usage) "
+               "FROM cpu GROUP BY host, m ORDER BY host, m")
+        streamed = db.execute_one(sql).rows()
+        assert db.executor.last_path == "stream"
+        mat = _materialized(db, sql, monkeypatch)
+        assert len(streamed) == len(mat) > 0
+        for a, b in zip(streamed, mat):
+            assert a[:2] == b[:2]
+            np.testing.assert_allclose(a[2:], b[2:], rtol=1e-12)
+
+    def test_global_agg_with_where(self, db, monkeypatch):
+        _fill(db)
+        sql = ("SELECT sum(usage), count(mem), max(ts) FROM cpu "
+               "WHERE host IN ('h1', 'h2') AND ts >= 100000")
+        streamed = db.execute_one(sql).rows()
+        assert db.executor.last_path == "stream"
+        mat = _materialized(db, sql, monkeypatch)
+        np.testing.assert_allclose(streamed, mat, rtol=1e-12)
+
+    def test_first_last_streaming(self, db, monkeypatch):
+        _fill(db)
+        sql = ("SELECT host, last(usage), first(mem) FROM cpu "
+               "GROUP BY host ORDER BY host")
+        streamed = db.execute_one(sql).rows()
+        assert db.executor.last_path == "stream"
+        mat = _materialized(db, sql, monkeypatch)
+        assert streamed == mat
+
+    def test_stddev_streaming(self, db, monkeypatch):
+        _fill(db)
+        sql = "SELECT host, stddev(usage) FROM cpu GROUP BY host ORDER BY host"
+        streamed = db.execute_one(sql).rows()
+        mat = _materialized(db, sql, monkeypatch)
+        for a, b in zip(streamed, mat):
+            assert a[0] == b[0]
+            np.testing.assert_allclose(a[1], b[1], rtol=1e-9)
+
+    def test_host_agg_falls_back(self, db, monkeypatch):
+        """median needs the full multiset -> materialized fallback, still
+        correct."""
+        _fill(db)
+        sql = "SELECT host, median(usage) FROM cpu GROUP BY host ORDER BY host"
+        streamed = db.execute_one(sql).rows()
+        assert db.executor.last_path != "stream"
+        mat = _materialized(db, sql, monkeypatch)
+        assert streamed == mat
+
+    def test_ts_pruned_stream(self, db, monkeypatch):
+        """Time-range pruning skips whole files/row-groups in the stream."""
+        _fill(db)
+        sql = ("SELECT host, count(*) AS c FROM cpu "
+               "WHERE ts >= 400000 AND ts < 800000 "
+               "GROUP BY host ORDER BY host")
+        streamed = db.execute_one(sql).rows()
+        mat = _materialized(db, sql, monkeypatch)
+        assert streamed == mat
+
+    def test_non_append_table_not_streamed(self, db, monkeypatch):
+        """Dedup tables need the whole-scan sort; they must not stream."""
+        db.execute_one(
+            "CREATE TABLE d (host STRING, v DOUBLE, "
+            "ts TIMESTAMP(3) TIME INDEX, PRIMARY KEY(host))")
+        db.execute_one("INSERT INTO d (host, v, ts) VALUES ('a', 1.0, 1000)")
+        db.execute_one("INSERT INTO d (host, v, ts) VALUES ('a', 2.0, 1000)")
+        r = db.execute_one("SELECT host, max(v) FROM d GROUP BY host")
+        assert db.executor.last_path != "stream"
+        assert r.rows() == [["a", 2.0]]
+
+
+class TestScanStreamUnit:
+    def test_chunks_bounded(self, tmp_path):
+        """The stream yields multiple chunks for a multi-row-group SST and
+        never materializes the whole region at once."""
+        from greptimedb_tpu.datatypes import (
+            ColumnSchema, DataType, DictVector, RecordBatch, Schema,
+            SemanticType)
+
+        schema = Schema([
+            ColumnSchema("ts", DataType.TIMESTAMP_MILLISECOND,
+                         SemanticType.TIMESTAMP),
+            ColumnSchema("host", DataType.STRING, SemanticType.TAG),
+            ColumnSchema("v", DataType.FLOAT64),
+        ])
+        eng = RegionEngine(EngineConfig(data_dir=str(tmp_path / "e")))
+        eng.create_region(1, schema)
+        region = eng.region(1)
+        region.sst_writer.row_group_size = 1000
+        n = 10_000
+        batch = RecordBatch(schema, {
+            "ts": np.arange(n, dtype=np.int64),
+            "host": DictVector(np.zeros(n, dtype=np.int32),
+                               np.asarray(["h"], dtype=object)),
+            "v": np.ones(n),
+        })
+        eng.put(1, batch)
+        eng.flush(1)
+        stream = eng.scan_stream(1)
+        assert stream.est_rows == n
+        sizes = [nrows for _, nrows in stream.chunks()]
+        assert sum(sizes) == n
+        assert len(sizes) > 1  # actually chunked
+        assert max(sizes) <= 8 * 1000  # groups_per_chunk * row_group_size
+        eng.close()
