@@ -36,6 +36,7 @@ from greptimedb_tpu.promql.parser import (
     NumberLiteral,
     PromqlError,
     StringLiteral,
+    Subquery,
     Unary,
     VectorSelector,
     parse_promql,
@@ -71,7 +72,8 @@ _RANGE_FUNCS = {
     "rate", "increase", "delta", "avg_over_time", "sum_over_time",
     "count_over_time", "min_over_time", "max_over_time", "last_over_time",
     "stddev_over_time", "stdvar_over_time", "present_over_time",
-    "changes", "resets", "deriv", "predict_linear",
+    "changes", "resets", "deriv", "predict_linear", "irate", "idelta",
+    "absent_over_time", "holt_winters",
 }
 
 _ELEMENTWISE = {
@@ -144,7 +146,7 @@ class PromqlEngine:
             return SeriesMatrix([], jnp.zeros((0, p.T)))
         sidx, ts, chans, labels, metric = loaded
         w = max(1, int(math.ceil(lookback / p.step)))
-        st = window_stats(sidx, ts, chans, jnp.ones(ts.shape, bool),
+        st = window_stats(sidx, ts, chans, ~jnp.isnan(chans[:, 0]),
                           p.start, p.step, len(labels), p.T, w,
                           stats=("count", "last"))
         vals = st["last"][:, :, 0]
@@ -155,11 +157,12 @@ class PromqlEngine:
         return SeriesMatrix(labels, vals, metric,
                             sample_ts=jnp.where(ok, lts, jnp.nan))
 
-    def _range_stats(self, sel: VectorSelector, p: EvalParams, ctx,
+    def _range_stats(self, sel, p: EvalParams, ctx,
                      stats: tuple[str, ...], extra_channels=()):
-        """Evaluate a range selector into window stats. Returns
-        (stats dict, labels, metric, w, range_s) or None when empty."""
-        range_s = sel.range_s
+        """Evaluate a range selector OR subquery into window stats.
+        Returns (stats dict, labels, metric, w, range_s) or None when
+        empty."""
+        range_s = getattr(sel, "range_s", None)
         if range_s is None:
             raise PromqlError("expected a range vector (metric[duration])")
         ratio = range_s / p.step
@@ -168,14 +171,80 @@ class PromqlEngine:
             raise PromqlError(
                 f"range {range_s}s must be a positive multiple of step {p.step}s "
                 "(blocked-window evaluation)")
-        loaded = self._load(sel, p, ctx, window=range_s,
-                            extra_channels=extra_channels)
+        loaded = self._load_any(sel, p, ctx, window=range_s,
+                                extra_channels=extra_channels)
         if loaded is None:
             return None
         sidx, ts, chans, labels, metric = loaded
-        st = window_stats(sidx, ts, chans, jnp.ones(ts.shape, bool),
+        st = window_stats(sidx, ts, chans, ~jnp.isnan(chans[:, 0]),
                           p.start, p.step, len(labels), p.T, w, stats=stats)
         return st, labels, metric, w, range_s
+
+    def _load_any(self, sel, p: EvalParams, ctx, window: float,
+                  extra_channels=()):
+        if isinstance(sel, Subquery):
+            return self._load_subquery(sel, p, ctx, extra_channels)
+        return self._load(sel, p, ctx, window, extra_channels)
+
+    def _load_subquery(self, sq: Subquery, p: EvalParams, ctx,
+                       extra_channels=()):
+        """Evaluate the inner expr on the subquery's own grid, flatten the
+        matrix to (series, ts, value) samples, and hand back the same
+        loaded tuple a storage scan produces — downstream window kernels
+        can't tell the difference (reference planner subquery support)."""
+        sub_step = sq.step_s if sq.step_s else p.step
+        lo = p.start - sq.range_s - sq.offset_s
+        hi = p.end - sq.offset_s
+        # Prometheus aligns subquery steps to absolute multiples of step
+        first = math.ceil(lo / sub_step) * sub_step
+        n = int(math.floor((hi - first) / sub_step)) + 1
+        if n <= 0:
+            return None
+        times = first + np.arange(n) * sub_step
+        inner = EvalParams(first, times[-1], sub_step, times)
+        v = self._eval(sq.expr, inner, ctx)
+        if not isinstance(v, SeriesMatrix):
+            raise PromqlError("subquery needs an instant-vector expression")
+        if v.num_series == 0:
+            return None
+        vals = np.asarray(v.values)
+        S, T2 = vals.shape
+        sidx = np.repeat(np.arange(S, dtype=np.int32), T2)
+        ts = np.tile(times + sq.offset_s, S)  # back on the outer timeline
+        flat = vals.reshape(-1)
+        keep = ~np.isnan(flat)  # absent inner samples aren't samples
+        if not keep.any():
+            return None
+        d_sidx = jnp.asarray(sidx[keep])
+        d_ts = jnp.asarray(ts[keep])
+        d_vals = jnp.asarray(flat[keep])
+        channels = self._make_channels(d_sidx, d_ts, d_vals,
+                                       extra_channels, p)
+        return d_sidx, d_ts, channels, v.labels, v.metric
+
+    def _make_channels(self, d_sidx, d_ts, d_vals, extra_channels, p):
+        """Derived per-sample channels riding the window kernel alongside
+        the raw value: counter-reset-adjusted values, change/reset
+        indicators, regression moments, previous-sample value/ts."""
+        chans = [d_vals]
+        if "adjusted" in extra_channels:
+            chans.append(counter_adjust(d_sidx, d_vals))
+        if extra_channels and {"changes", "resets", "prev"} & set(extra_channels):
+            prev_v = jnp.concatenate([d_vals[:1], d_vals[:-1]])
+            same = jnp.concatenate([jnp.zeros(1, bool),
+                                    (d_sidx[1:] == d_sidx[:-1])])
+            if "changes" in extra_channels:
+                chans.append(jnp.where(same & (d_vals != prev_v), 1.0, 0.0))
+            if "resets" in extra_channels:
+                chans.append(jnp.where(same & (d_vals < prev_v), 1.0, 0.0))
+            if "prev" in extra_channels:
+                prev_t = jnp.concatenate([d_ts[:1], d_ts[:-1]])
+                chans.append(jnp.where(same, prev_v, jnp.nan))
+                chans.append(jnp.where(same, prev_t, jnp.nan))
+        if "deriv" in extra_channels:
+            tr = d_ts - p.start  # well-conditioned regression coordinates
+            chans += [d_vals * tr, tr, tr * tr]
+        return jnp.stack(chans, axis=1)
 
     def _load(self, sel: VectorSelector, p: EvalParams, ctx, window: float,
               extra_channels=()):
@@ -287,22 +356,8 @@ class PromqlEngine:
             keep = ~dup_next
             d_vals = jnp.where(keep, d_vals, jnp.nan)
 
-        chans = [d_vals]
-        if "adjusted" in extra_channels:
-            chans.append(counter_adjust(d_sidx, d_vals))
-        if "changes" in extra_channels or "resets" in extra_channels:
-            prev_v = jnp.concatenate([d_vals[:1], d_vals[:-1]])
-            prev_s = jnp.concatenate([d_sidx[:1], d_sidx[:-1]])
-            same = jnp.concatenate([jnp.zeros(1, bool),
-                                    (d_sidx[1:] == d_sidx[:-1])])
-            if "changes" in extra_channels:
-                chans.append(jnp.where(same & (d_vals != prev_v), 1.0, 0.0))
-            if "resets" in extra_channels:
-                chans.append(jnp.where(same & (d_vals < prev_v), 1.0, 0.0))
-        if "deriv" in extra_channels:
-            tr = d_ts - p.start  # well-conditioned regression coordinates
-            chans += [d_vals * tr, tr, tr * tr]
-        channels = jnp.stack(chans, axis=1)
+        channels = self._make_channels(d_sidx, d_ts, d_vals,
+                                       extra_channels, p)
         return d_sidx, d_ts, channels, labels, metric
 
     # ---- calls -------------------------------------------------------------
@@ -347,7 +402,29 @@ class PromqlEngine:
             v = self._eval(call.args[0], p, ctx)
             return _map_values(v, _ELEMENTWISE[fn])
         if fn in ("sort", "sort_desc"):
-            return self._eval(call.args[0], p, ctx)  # ordering applied at output
+            v = self._eval(call.args[0], p, ctx)
+            if not isinstance(v, SeriesMatrix) or v.num_series <= 1:
+                return v
+            # order series by their value at the (last) evaluated instant,
+            # NaN last — matches Prometheus sort() on instant vectors
+            key = np.asarray(v.values[:, -1]).astype(np.float64)
+            rank = np.where(np.isnan(key), np.inf,
+                            key if fn == "sort" else -key)
+            order = np.argsort(rank, kind="stable")
+            return SeriesMatrix([v.labels[i] for i in order],
+                                v.values[np.asarray(order)], v.metric)
+        if fn == "absent":
+            v = self._eval(call.args[0], p, ctx)
+            if not isinstance(v, SeriesMatrix):
+                raise PromqlError("absent needs an instant vector")
+            lab = _absent_labels(call.args[0])
+            if v.num_series == 0:
+                return SeriesMatrix([lab], jnp.ones((1, p.T)))
+            all_absent = jnp.isnan(v.values).all(axis=0)
+            return SeriesMatrix(
+                [lab], jnp.where(all_absent, 1.0, jnp.nan)[None, :])
+        if fn == "histogram_quantile":
+            return self._histogram_quantile(call, p, ctx)
         if fn == "label_replace":
             return self._label_replace(call, p, ctx)
         if fn == "label_join":
@@ -356,9 +433,8 @@ class PromqlEngine:
 
     def _eval_range_func(self, call: Call, p: EvalParams, ctx):
         fn = call.func
-        sel = call.args[-1] if fn == "predict_linear" else call.args[0]
         sel = call.args[0]
-        if not isinstance(sel, VectorSelector):
+        if not isinstance(sel, (VectorSelector, Subquery)):
             raise PromqlError(f"{fn} needs a range selector argument")
 
         if fn in ("rate", "increase", "delta"):
@@ -379,6 +455,41 @@ class PromqlEngine:
                 is_counter=counter, is_rate=(fn == "rate"), range_s=range_s,
             )
             return SeriesMatrix(labels, vals)
+
+        if fn in ("irate", "idelta"):
+            # last two samples in the window (reference functions/
+            # instant_delta.rs): the window kernel's "last" gather carries
+            # the previous-sample value/ts as extra channels
+            r = self._range_stats(sel, p, ctx, ("count", "last"), ("prev",))
+            if r is None:
+                return SeriesMatrix([], jnp.zeros((0, p.T)))
+            st, labels, metric, w, range_s = r
+            last_v = st["last"][:, :, 0]
+            prev_v = st["last"][:, :, 1]
+            prev_t = st["last"][:, :, 2]
+            last_t = st["last_ts"]
+            wstart = jnp.asarray(p.times)[None, :] - range_s
+            ok = (~jnp.isnan(prev_v)) & (prev_t > wstart) & (last_t > prev_t)
+            if fn == "idelta":
+                out = last_v - prev_v
+            else:
+                # counter semantics: reset -> delta is the raw new value
+                delta = jnp.where(last_v < prev_v, last_v, last_v - prev_v)
+                out = delta / (last_t - prev_t)
+            return SeriesMatrix(labels, jnp.where(ok, out, jnp.nan))
+
+        if fn == "absent_over_time":
+            r = self._range_stats(sel, p, ctx, ("count",))
+            lab = _absent_labels(sel)
+            if r is None:
+                return SeriesMatrix([lab], jnp.ones((1, p.T)))
+            st, labels, metric, w, range_s = r
+            any_present = (st["count"][:, :, 0] > 0).any(axis=0)
+            return SeriesMatrix(
+                [lab], jnp.where(any_present, jnp.nan, 1.0)[None, :])
+
+        if fn == "holt_winters":
+            return self._holt_winters(call, sel, p, ctx)
 
         if fn in ("changes", "resets"):
             r = self._range_stats(sel, p, ctx, ("sum", "count"), (fn,))
@@ -446,16 +557,128 @@ class PromqlEngine:
             out = jnp.where(present, jnp.sqrt(var) if fn == "stddev_over_time" else var, jnp.nan)
         return SeriesMatrix(labels, out)
 
+    def _histogram_quantile(self, call: Call, p: EvalParams, ctx):
+        """φ-quantile over `le`-bucketed classic histograms (reference
+        extension_plan/histogram_fold.rs:61: group by labels-minus-le,
+        cumulative buckets, linear interpolation within the bucket)."""
+        phi = _scalar_of(self._eval(call.args[0], p, ctx))
+        v = self._eval(call.args[1], p, ctx)
+        if not isinstance(v, SeriesMatrix):
+            raise PromqlError("histogram_quantile needs an instant vector")
+        groups: dict[tuple, list[tuple[float, int]]] = {}
+        glabels: dict[tuple, dict] = {}
+        for i, lab in enumerate(v.labels):
+            le_s = lab.get("le")
+            if le_s is None:
+                continue
+            try:
+                le = float(le_s.replace("+Inf", "inf")) \
+                    if isinstance(le_s, str) else float(le_s)
+            except ValueError:
+                continue
+            rest = {k: x for k, x in lab.items() if k != "le"}
+            sig = tuple(sorted(rest.items()))
+            groups.setdefault(sig, []).append((le, i))
+            glabels[sig] = rest
+        if not groups:
+            return SeriesMatrix([], jnp.zeros((0, p.T)))
+        out_labels, outs = [], []
+        vals = v.values
+        for sig, buckets in sorted(groups.items()):
+            buckets.sort()
+            les = np.asarray([b[0] for b in buckets])
+            idx = np.asarray([b[1] for b in buckets])
+            if not np.isinf(les[-1]):
+                # no +Inf bucket: quantile undefined (Prometheus -> NaN)
+                out_labels.append(glabels[sig])
+                outs.append(jnp.full(p.T, jnp.nan))
+                continue
+            counts = vals[idx]  # [B, T] cumulative by construction
+            # enforce monotonicity like Prometheus (scrape races)
+            counts = jax.lax.cummax(jnp.nan_to_num(counts), axis=0)
+            total = counts[-1]
+            rank = phi * total
+            # first bucket whose cumulative count reaches the rank
+            reached = counts >= rank[None, :]
+            b = jnp.argmax(reached, axis=0)
+            B = len(les)
+            d_les = jnp.asarray(les)
+            upper = d_les[b]
+            lower = jnp.where(b > 0, d_les[jnp.maximum(b - 1, 0)], 0.0)
+            cum_prev = jnp.where(b > 0,
+                                 jnp.take_along_axis(
+                                     counts, jnp.maximum(b - 1, 0)[None, :],
+                                     axis=0)[0], 0.0)
+            cum_b = jnp.take_along_axis(counts, b[None, :], axis=0)[0]
+            in_bucket = jnp.maximum(cum_b - cum_prev, 1e-300)
+            frac = (rank - cum_prev) / in_bucket
+            interp = lower + (upper - lower) * jnp.clip(frac, 0.0, 1.0)
+            # highest bucket (= +Inf): return the highest finite bound
+            highest_finite = d_les[B - 2] if B >= 2 else jnp.nan
+            res = jnp.where(b >= B - 1, highest_finite, interp)
+            # first bucket with non-positive upper bound: no interpolation
+            res = jnp.where((b == 0) & (upper <= 0), upper, res)
+            res = jnp.where(total > 0, res, jnp.nan)
+            if phi < 0:
+                res = jnp.full(p.T, -jnp.inf)
+            elif phi > 1:
+                res = jnp.full(p.T, jnp.inf)
+            elif math.isnan(phi):
+                res = jnp.full(p.T, jnp.nan)
+            out_labels.append(glabels[sig])
+            outs.append(res)
+        return SeriesMatrix(out_labels, jnp.stack(outs, axis=0))
+
+    def _holt_winters(self, call: Call, sel, p: EvalParams, ctx):
+        """Double exponential smoothing (reference functions/
+        holt_winters.rs). Sequential per-window recurrence — evaluated on
+        host over the loaded samples (windows are small; the scan itself
+        still rides the device path)."""
+        sf = _scalar_of(self._eval(call.args[1], p, ctx))
+        tf = _scalar_of(self._eval(call.args[2], p, ctx))
+        if not 0 < sf < 1 or not 0 < tf < 1:
+            raise PromqlError("holt_winters factors must be in (0, 1)")
+        range_s = sel.range_s
+        loaded = self._load_any(sel, p, ctx, window=range_s)
+        if loaded is None:
+            return SeriesMatrix([], jnp.zeros((0, p.T)))
+        sidx, ts, chans, labels, metric = loaded
+        sidx = np.asarray(sidx)
+        ts = np.asarray(ts)
+        vals = np.asarray(chans[:, 0])
+        ok = ~np.isnan(vals)
+        sidx, ts, vals = sidx[ok], ts[ok], vals[ok]
+        S, T = len(labels), p.T
+        out = np.full((S, T), np.nan)
+        starts = np.searchsorted(sidx, np.arange(S))
+        ends = np.searchsorted(sidx, np.arange(S), side="right")
+        for s in range(S):
+            s_ts = ts[starts[s]:ends[s]]
+            s_v = vals[starts[s]:ends[s]]
+            for j, t in enumerate(p.times):
+                lo = np.searchsorted(s_ts, t - range_s, side="right")
+                hi = np.searchsorted(s_ts, t, side="right")
+                x = s_v[lo:hi]
+                if len(x) < 2:
+                    continue
+                s0, b = x[0], x[1] - x[0]
+                for i in range(1, len(x)):
+                    s1 = sf * x[i] + (1 - sf) * (s0 + b)
+                    b = tf * (s1 - s0) + (1 - tf) * b
+                    s0 = s1
+                out[s, j] = s0
+        return SeriesMatrix(labels, jnp.asarray(out))
+
     def _range_stats_sq(self, sel, p, ctx):
         """Range stats with a squared-value channel (stddev/stdvar)."""
         range_s = sel.range_s
         w = int(round(range_s / p.step))
-        loaded = self._load(sel, p, ctx, window=range_s)
+        loaded = self._load_any(sel, p, ctx, window=range_s)
         if loaded is None:
             return None
         sidx, ts, chans, labels, metric = loaded
         chans = jnp.concatenate([chans, chans[:, :1] ** 2], axis=1)
-        st = window_stats(sidx, ts, chans, jnp.ones(ts.shape, bool),
+        st = window_stats(sidx, ts, chans, ~jnp.isnan(chans[:, 0]),
                           p.start, p.step, len(labels), p.T, w,
                           stats=("sum", "count"))
         return st, labels, metric, w, range_s
@@ -739,6 +962,18 @@ def _string_of(node) -> str:
     if isinstance(node, StringLiteral):
         return node.value
     raise PromqlError("expected a string literal")
+
+
+def _absent_labels(node) -> dict:
+    """Prometheus derives absent()'s output labels from the selector's
+    equality matchers."""
+    sel = node
+    if isinstance(sel, Subquery):
+        sel = sel.expr
+    if isinstance(sel, VectorSelector):
+        return {m.label: m.value for m in sel.matchers
+                if m.op == "=" and m.label not in ("__name__", "__field__")}
+    return {}
 
 
 def _matcher_mask(m: Matcher, scan, tag_names) -> np.ndarray:

@@ -2,7 +2,7 @@
 hand-written tokenizer + pratt parser covering the language surface the
 reference's planner handles: selectors with matchers, range vectors,
 offset, binary ops with bool/on/ignoring/group_left modifiers,
-aggregations with by/without, functions, subquery-free).
+aggregations with by/without, functions, subqueries `expr[range:step]`).
 """
 
 from __future__ import annotations
@@ -79,6 +79,17 @@ class Binary:
 class Unary:
     op: str
     expr: object
+
+
+@dataclass(frozen=True)
+class Subquery:
+    """`expr[range:step]` — inner expr evaluated on its own grid, then
+    consumed like a range vector (reference planner subquery support)."""
+
+    expr: object
+    range_s: float
+    step_s: Optional[float] = None  # None -> outer eval step
+    offset_s: float = 0.0
 
 
 AGG_OPS = {"sum", "avg", "min", "max", "count", "topk", "bottomk", "quantile",
@@ -234,19 +245,27 @@ class _Parser:
             if t.kind == "op" and t.value == "[":
                 self.next()
                 dur = self.expect("duration").value
+                step = self._subquery_step()
                 self.expect("op", "]")
-                if not isinstance(e, VectorSelector) or e.range_s is not None:
-                    raise PromqlError("range modifier on non-selector")
-                e = VectorSelector(e.metric, e.matchers, parse_duration_s(dur),
-                                   e.offset_s, e.at_s)
+                if step is not None:
+                    e = Subquery(e, parse_duration_s(dur), step[0])
+                else:
+                    if not isinstance(e, VectorSelector) or e.range_s is not None:
+                        raise PromqlError("range modifier on non-selector")
+                    e = VectorSelector(e.metric, e.matchers, parse_duration_s(dur),
+                                       e.offset_s, e.at_s)
             elif t.kind == "ident" and t.value == "offset":
                 self.next()
                 neg = self.eat("op", "-")
                 dur = parse_duration_s(self.expect("duration").value)
-                if not isinstance(e, VectorSelector):
+                if isinstance(e, Subquery):
+                    e = Subquery(e.expr, e.range_s, e.step_s,
+                                 (-dur if neg else dur))
+                elif isinstance(e, VectorSelector):
+                    e = VectorSelector(e.metric, e.matchers, e.range_s,
+                                       (-dur if neg else dur), e.at_s)
+                else:
                     raise PromqlError("offset on non-selector")
-                e = VectorSelector(e.metric, e.matchers, e.range_s,
-                                   (-dur if neg else dur), e.at_s)
             elif t.kind == "op" and t.value == "@":
                 self.next()
                 at = float(self.expect("number").value)
@@ -292,6 +311,23 @@ class _Parser:
                 return Call(name, tuple(args))
             return self._selector(name)
         raise PromqlError(f"unexpected token {t.kind}:{t.value}")
+
+    def _subquery_step(self):
+        """Inside `[dur ...`: detect the subquery `:step` part. The
+        tokenizer folds a leading ':' into an ident (metric names may
+        contain ':'), so ':1m' or ':' arrive as idents. Returns None when
+        this is a plain range vector, else a 1-tuple holding the step
+        (None = default resolution)."""
+        t = self.peek()
+        if t.kind != "ident" or not t.value.startswith(":"):
+            return None
+        self.next()
+        rest = t.value[1:]
+        if rest:
+            return (parse_duration_s(rest),)
+        if self.peek().kind == "duration":
+            return (parse_duration_s(self.next().value),)
+        return (None,)
 
     def _selector(self, metric: Optional[str]) -> VectorSelector:
         matchers: list[Matcher] = []
