@@ -36,7 +36,8 @@ class Datanode:
     """One region server + its heartbeat task (datanode/src/datanode.rs:192
     + heartbeat.rs analog)."""
 
-    def __init__(self, node_id: str, shared_dir: str, metasrv: Metasrv):
+    def __init__(self, node_id: str, shared_dir: str, metasrv: Metasrv,
+                 wire: bool = False):
         self.node_id = node_id
         self.engine = RegionEngine(EngineConfig(data_dir=shared_dir))
         self.metasrv = metasrv
@@ -44,6 +45,23 @@ class Datanode:
             node_id, metasrv, self._region_stats, self._apply_instruction
         )
         self.alive = True
+        # wire transport: serve this node's regions over Flight and give
+        # the frontend a network client instead of the in-process engine
+        # (reference: region requests always cross gRPC,
+        # datanode/src/region_server.rs)
+        self.server = None
+        self.remote = None
+        if wire:
+            from ..servers.flight import FlightServer, RemoteRegionEngine
+
+            self.server = FlightServer(None, port=0,
+                                       region_engine=self.engine)
+            self.remote = RemoteRegionEngine(f"127.0.0.1:{self.server.port}")
+
+    def data_engine(self):
+        """What the frontend router talks to: the Flight client in wire
+        mode, the in-process engine otherwise."""
+        return self.remote if self.remote is not None else self.engine
 
     def _region_stats(self) -> list[RegionStat]:
         stats = []
@@ -84,12 +102,20 @@ class Datanode:
         return expired
 
     def kill(self) -> None:
-        """Simulate process death: stop heartbeating, drop open regions."""
+        """Simulate process death: stop heartbeating, drop open regions,
+        stop serving the wire."""
         self.alive = False
         for rid in list(self.engine.regions):
             self.engine.regions.pop(rid, None)
+        if self.server is not None:
+            self.server.shutdown()
+            self.server = None
 
     def close(self) -> None:
+        if self.remote is not None:
+            self.remote.close()
+        if self.server is not None:
+            self.server.shutdown()
         self.engine.close()
 
 
@@ -130,7 +156,7 @@ class RegionRouter:
             dn = self.datanodes[node] if node else None
             if dn is None or not dn.alive:
                 raise KeyError(f"region {region_id} has no live datanode")
-        return dn.engine
+        return dn.data_engine()
 
     # --- RegionEngine surface used by QueryEngine ---
     def region(self, region_id: int):
@@ -149,7 +175,7 @@ class RegionRouter:
         )
         if node is None:
             node = sorted(self.datanodes)[0]
-        self.datanodes[node].engine.create_region(region_id, schema)
+        self.datanodes[node].data_engine().create_region(region_id, schema)
         table_key = str(region_id >> 32)
         route = self.metasrv.routes.get(table_key)
         if route is None:
@@ -180,6 +206,12 @@ class RegionRouter:
             region_id, ts_range, projection, tag_predicates
         )
 
+    def scan_stream(self, region_id: int, ts_range=None, projection=None,
+                    tag_predicates=None):
+        return self._engine_for(region_id).scan_stream(
+            region_id, ts_range, projection, tag_predicates
+        )
+
     def handle_request(self, req: RegionRequest) -> int:
         return self._engine_for(req.region_id).handle_request(req)
 
@@ -193,6 +225,7 @@ class Cluster:
         num_datanodes: int = 3,
         kv: Optional[KvBackend] = None,
         opts: Optional[MetasrvOptions] = None,
+        wire_transport: bool = False,
     ):
         self.kv = kv or MemoryKv()
         self.metasrv = Metasrv(self.kv, opts)
@@ -200,7 +233,8 @@ class Cluster:
         shared = os.path.join(data_dir, "shared")
         for i in range(num_datanodes):
             node_id = f"dn-{i}"
-            self.datanodes[node_id] = Datanode(node_id, shared, self.metasrv)
+            self.datanodes[node_id] = Datanode(node_id, shared, self.metasrv,
+                                               wire=wire_transport)
         self.router = RegionRouter(self.metasrv, self.datanodes)
         self.catalog = Catalog(self.kv)
         self.frontend = QueryEngine(self.catalog, self.router)

@@ -72,8 +72,11 @@ class QueryEngine:
         # statement authorization (reference checks permissions in the
         # frontend before dispatch, src/frontend/src/instance.rs:305-338)
         self.permission_checker.check(ctx.user, stmt, ctx.db)
+        from greptimedb_tpu.utils import tracing
         from greptimedb_tpu.utils.metrics import STMT_DURATION
-        with STMT_DURATION.time(stmt=type(stmt).__name__):
+        ctx.trace_id = tracing.set_trace(ctx.trace_id)
+        with STMT_DURATION.time(stmt=type(stmt).__name__), \
+                tracing.span(f"stmt:{type(stmt).__name__}"):
             return self._execute_statement(stmt, ctx)
 
     def _execute_statement(self, stmt: ast.Statement, ctx: QueryContext) -> QueryResult:
@@ -630,8 +633,34 @@ class QueryEngine:
             text = lp.explain_plan(plan)
         else:
             text = f"{type(stmt.inner).__name__}"
+        lines = text.split("\n")
+        if stmt.analyze:
+            # EXPLAIN ANALYZE: run the statement and report per-stage
+            # wall time from the trace spans, including remote region
+            # spans joined by trace id (reference query/src/analyze.rs +
+            # merge_scan.rs:245-259 metrics piggyback)
+            import time as _time
+
+            from greptimedb_tpu.utils import tracing
+
+            tid = tracing.set_trace(ctx.trace_id)
+            t0 = _time.perf_counter()
+            result = self._execute_statement(stmt.inner, ctx)
+            total_ms = (_time.perf_counter() - t0) * 1000.0
+            spans = tracing.spans_for(tid)
+            lines.append("")
+            lines.append(f"ANALYZE trace={tid} total={total_ms:.2f} ms "
+                         f"rows={result.num_rows}")
+            path = getattr(self.executor, "last_path", None)
+            if path:
+                lines.append(f"  execution path: {path}")
+            for s in spans:
+                attrs = " ".join(f"{k}={v}" for k, v in s.attrs.items())
+                lines.append(
+                    f"  {s.name}: {s.duration_ms:.2f} ms"
+                    + (f" [{attrs}]" if attrs else ""))
         return QueryResult(["plan"], [DataType.STRING],
-                           [np.asarray(text.split("\n"), dtype=object)])
+                           [np.asarray(lines, dtype=object)])
 
     # ---- admin -------------------------------------------------------------
 

@@ -447,6 +447,7 @@ class PhysicalExecutor:
         from greptimedb_tpu.storage.index import extract_tag_predicates
 
         tag_preds = extract_tag_predicates(where, table.schema) or None
+        from greptimedb_tpu.utils import tracing
 
         # beyond-RAM aggregate scans stream: append-mode (no dedup sort),
         # single region, estimated rows over the threshold
@@ -465,25 +466,34 @@ class PhysicalExecutor:
                 except _NotStreamable:
                     pass  # materialized fallback below
 
-        if len(table.region_ids) == 1:
-            scan = self.engine.scan(table.region_ids[0], ts_range,
-                                    scan_node.columns, tag_preds)
-        else:
-            # distributed fan-out: gather every region's scan (MergeScan,
-            # dist_plan/merge_scan.rs analog)
-            from greptimedb_tpu.storage.merge_scan import merge_scans
+        with tracing.span("scan", table=table.name,
+                          regions=len(table.region_ids)):
+            if len(table.region_ids) == 1:
+                scan = self.engine.scan(table.region_ids[0], ts_range,
+                                        scan_node.columns, tag_preds)
+            else:
+                # distributed fan-out: gather every region's scan
+                # (MergeScan, dist_plan/merge_scan.rs analog)
+                from greptimedb_tpu.storage.merge_scan import merge_scans
 
-            scan = merge_scans(
-                [
-                    self.engine.scan(rid, ts_range, scan_node.columns, tag_preds)
-                    for rid in table.region_ids
-                ]
-            )
+                scan = merge_scans(
+                    [
+                        self.engine.scan(rid, ts_range, scan_node.columns,
+                                         tag_preds)
+                        for rid in table.region_ids
+                    ]
+                )
 
         if agg is not None:
-            return self._execute_agg(scan, table, where, agg, having, project, sort,
-                                     limit, offset, scan_node)
-        return self._execute_raw(scan, table, where, project, sort, limit, offset)
+            with tracing.span("aggregate", rows=0 if scan is None
+                              else scan.num_rows):
+                return self._execute_agg(scan, table, where, agg, having,
+                                         project, sort, limit, offset,
+                                         scan_node)
+        with tracing.span("filter_project", rows=0 if scan is None
+                          else scan.num_rows):
+            return self._execute_raw(scan, table, where, project, sort,
+                                     limit, offset)
 
     # ---- aggregate path ----------------------------------------------------
 
@@ -846,6 +856,17 @@ class PhysicalExecutor:
         """Run the device aggregation; returns (acc planes, sparse group
         ids or None). Dense: planes indexed by global group id. Sparse:
         planes indexed by compact slot, plus the observed global ids."""
+        from greptimedb_tpu.utils import tracing
+
+        with tracing.span("device_agg", rows=scan.num_rows,
+                          groups=num_groups):
+            return self._stream_agg_inner(
+                scan, table, bound_where, keys, arg_exprs, ops, num_groups,
+                ts_name, ctx, extra_cols, sparse)
+
+    def _stream_agg_inner(self, scan, table, bound_where, keys, arg_exprs,
+                          ops, num_groups, ts_name, ctx, extra_cols,
+                          sparse=False):
         from greptimedb_tpu import config
 
         schema = table.schema

@@ -169,11 +169,21 @@ class _BasicClientAuth(fl.ClientAuthHandler):
 
 
 class FlightServer(fl.FlightServerBase):
-    """Frontend + region Flight services on one port."""
+    """Frontend + region Flight services on one port.
+
+    Two deployment shapes (reference: frontend gRPC service vs the
+    datanode region server, servers/src/grpc/region_server.rs:39-92):
+    - frontend: pass `query_engine` — SQL/TQL over do_get, bulk ingest
+      over do_put, plus the region service against its region engine.
+    - datanode: pass `region_engine` only — region scan/write/DDL
+      actions; no SQL surface.
+    """
 
     def __init__(self, query_engine, host: str = "127.0.0.1", port: int = 0,
-                 user_provider=None):
+                 user_provider=None, region_engine=None):
         self.qe = query_engine
+        self.engine = region_engine if region_engine is not None \
+            else (query_engine.region_engine if query_engine else None)
         auth = _BasicServerAuth(user_provider) if user_provider else None
         self._auth = auth
         location = f"grpc://{host}:{port}"
@@ -207,8 +217,11 @@ class FlightServer(fl.FlightServerBase):
                 raise fl.FlightUnauthorizedError(
                     f"user {user.username!r} lacks read permission")
             return self._region_scan(req["region_scan"])
+        if self.qe is None:
+            raise fl.FlightServerError("datanode service: region tickets only")
         ctx = QueryContext(db=req.get("db", "public"), channel=Channel.GRPC,
-                           user=self._resolve_user(context))
+                           user=self._resolve_user(context),
+                           trace_id=req.get("trace_id"))
         if "sql" in req:
             result = self.qe.execute_one(req["sql"], ctx)
         elif "tql" in req:
@@ -233,14 +246,20 @@ class FlightServer(fl.FlightServerBase):
         """Datanode region service (reference region_server.rs:39-92 —
         Substrait plan in, Flight stream out; here the scan spec is the
         plan fragment)."""
+        from greptimedb_tpu.utils import tracing
+
         region_id = req["region_id"]
         ts_range = tuple(req["ts_range"]) if req.get("ts_range") else None
         projection = req.get("projection")
         preds = {k: set(v) for k, v in (req.get("tag_predicates") or {}).items()} \
             or None
-        scan = self.qe.region_engine.scan(
-            region_id, ts_range=ts_range, projection=projection,
-            tag_predicates=preds)
+        if req.get("trace_id"):
+            # adopt the caller's trace (region_server.rs:74 analog)
+            tracing.set_trace(req["trace_id"])
+        with tracing.span("region_scan", region=region_id):
+            scan = self.engine.scan(
+                region_id, ts_range=ts_range, projection=projection,
+                tag_predicates=preds)
         if scan is None:
             # empty marker: zero-column table with metadata flag
             return fl.RecordBatchStream(pa.Table.from_arrays(
@@ -251,10 +270,37 @@ class FlightServer(fl.FlightServerBase):
 
     def do_put(self, context, descriptor, reader, writer):
         """Bulk Arrow ingest into an existing table (the reference's row
-        insert gRPC, greptime_handler.rs:62 — here columnar end-to-end)."""
+        insert gRPC, greptime_handler.rs:62 — here columnar end-to-end).
+        Path ["__region__", <rid>, put|delete] is the datanode write path
+        (region_server.rs handle_request analog)."""
         path = [p.decode() for p in descriptor.path]
         if not path:
             raise fl.FlightServerError("descriptor path must be [db.]table")
+        if path[0] == "__region__":
+            user = self._resolve_user(context)
+            if user is not None and not user.can("write"):
+                raise fl.FlightUnauthorizedError(
+                    f"user {user.username!r} lacks write permission")
+            rid = int(path[1])
+            op = path[2] if len(path) > 2 else "put"
+            t = reader.read_all()
+            from greptimedb_tpu.datatypes.recordbatch import RecordBatch
+
+            region = self.engine.region(rid)
+            if t.num_rows:
+                arrow = t.combine_chunks().to_batches()[0]
+            else:
+                arrow = pa.RecordBatch.from_pydict(
+                    {f.name: [] for f in t.schema}, schema=t.schema)
+            batch = RecordBatch.from_arrow(arrow, region.schema)
+            if op == "delete":
+                n = self.engine.delete(rid, batch)
+            else:
+                n = self.engine.put(rid, batch)
+            writer.write(json.dumps({"affected_rows": n}).encode())
+            return
+        if self.qe is None:
+            raise fl.FlightServerError("datanode service: region writes only")
         table_name = path[-1]
         db = path[0] if len(path) > 1 else "public"
         ctx = QueryContext(db=db, channel=Channel.GRPC,
@@ -280,6 +326,35 @@ class FlightServer(fl.FlightServerBase):
     def do_action(self, context, action):
         if action.type == "health":
             return [json.dumps({"status": "ok"}).encode()]
+        if action.type == "region_admin":
+            # datanode control plane (region_server.rs handle_request:
+            # create/open/close/drop/flush/compact + existence probe)
+            req = json.loads(action.body.to_pybytes().decode())
+            rid = req["region_id"]
+            op = req["op"]
+            from greptimedb_tpu.storage.engine import RegionRequest, RequestType
+
+            if op == "create":
+                from greptimedb_tpu.datatypes.schema import Schema as _S
+                self.engine.create_region(rid, _S.from_dict(req["schema"]))
+            elif op == "open":
+                self.engine.open_region(rid)
+            elif op == "exists":
+                try:
+                    self.engine.region(rid)
+                    return [b'{"exists": true}']
+                except KeyError:
+                    return [b'{"exists": false}']
+            elif op == "flush":
+                self.engine.flush(rid)
+            elif op == "compact":
+                self.engine.compact(rid)
+            elif op in ("close", "drop", "truncate"):
+                self.engine.handle_request(
+                    RegionRequest(RequestType[op.upper()], rid))
+            else:
+                raise fl.FlightServerError(f"unknown region op {op!r}")
+            return [b'{"ok": true}']
         if action.type == "sql":
             req = json.loads(action.body.to_pybytes().decode())
             ctx = QueryContext(db=req.get("db", "public"), channel=Channel.GRPC,
@@ -350,6 +425,125 @@ class FlightQueryClient:
 
     def close(self):
         self.client.close()
+
+
+class RemoteRegionEngine:
+    """The RegionEngine surface over the Flight region service — the real
+    network data plane between a frontend and its datanodes (reference:
+    frontends reach regions via serialized plans + Flight streams,
+    datanode/src/region_server.rs:623-660; cluster mode routes every
+    region request through this client instead of in-process calls)."""
+
+    def __init__(self, addr: str, user: Optional[str] = None,
+                 password: Optional[str] = None):
+        self.addr = addr
+        self.client = fl.FlightClient(f"grpc://{addr}")
+        if user is not None:
+            self.client.authenticate(_BasicClientAuth(user, password or ""))
+
+    # -- control -------------------------------------------------------------
+
+    def _admin(self, op: str, region_id: int, **extra) -> dict:
+        body = json.dumps({"op": op, "region_id": region_id, **extra}).encode()
+        res = list(self.client.do_action(fl.Action("region_admin", body)))
+        return json.loads(res[0].body.to_pybytes().decode())
+
+    def create_region(self, region_id: int, schema) -> None:
+        self._admin("create", region_id, schema=schema.to_dict())
+
+    def open_region(self, region_id: int) -> None:
+        self._admin("open", region_id)
+
+    def region(self, region_id: int):
+        """Existence probe (KeyError contract of the local engine). The
+        returned proxy carries identity only — schema mutations (ALTER)
+        need a dedicated RPC, not remote attribute pokes."""
+        if not self._admin("exists", region_id).get("exists"):
+            raise KeyError(f"region {region_id} not found on {self.addr}")
+        return _RemoteRegionProxy(region_id, self)
+
+    def flush(self, region_id: int) -> None:
+        self._admin("flush", region_id)
+
+    def compact(self, region_id: int) -> None:
+        self._admin("compact", region_id)
+
+    def handle_request(self, req) -> int:
+        from greptimedb_tpu.storage.engine import RequestType
+
+        if req.kind is RequestType.PUT:
+            return self.put(req.region_id, req.batch)
+        if req.kind is RequestType.DELETE:
+            return self.delete(req.region_id, req.batch)
+        self._admin(req.kind.value, req.region_id)
+        return 0
+
+    # -- write ---------------------------------------------------------------
+
+    def _write(self, region_id: int, batch, op: str) -> int:
+        desc = fl.FlightDescriptor.for_path("__region__", str(region_id), op)
+        arrow = batch.to_arrow()
+        writer, reader = self.client.do_put(desc, arrow.schema)
+        writer.write_batch(arrow)
+        writer.done_writing()
+        ack_buf = reader.read()
+        if ack_buf is None:
+            writer.close()
+            raise fl.FlightServerError("no ack from region server")
+        ack = json.loads(ack_buf.to_pybytes().decode())
+        writer.close()
+        return ack["affected_rows"]
+
+    def put(self, region_id: int, batch) -> int:
+        return self._write(region_id, batch, "put")
+
+    def delete(self, region_id: int, batch) -> int:
+        return self._write(region_id, batch, "delete")
+
+    # -- read ----------------------------------------------------------------
+
+    def scan(self, region_id: int, ts_range=None, projection=None,
+             tag_predicates=None) -> Optional[ScanData]:
+        from greptimedb_tpu.utils import tracing
+
+        spec = {"region_id": region_id}
+        if ts_range is not None:
+            spec["ts_range"] = list(ts_range)
+        if projection is not None:
+            spec["projection"] = list(projection)
+        if tag_predicates:
+            spec["tag_predicates"] = {k: sorted(v)
+                                      for k, v in tag_predicates.items()}
+        tid = tracing.current_trace_id()
+        if tid:
+            # W3C-style propagation: the frontend's trace id crosses the
+            # wire inside the request (merge_scan.rs:185-201 analog)
+            spec["trace_id"] = tid
+        with tracing.span("remote_region_scan", region=region_id,
+                          addr=self.addr):
+            ticket = fl.Ticket(json.dumps({"region_scan": spec}).encode())
+            t = self.client.do_get(ticket).read_all()
+        if (t.schema.metadata or {}).get(b"empty") == b"1":
+            return None
+        return table_to_scan(t)
+
+    def scan_stream(self, region_id: int, ts_range=None, projection=None,
+                    tag_predicates=None):
+        # remote streaming scan not implemented yet: fall back to the
+        # materialized wire scan (executor handles None)
+        return None
+
+    def close(self) -> None:
+        self.client.close()
+
+
+class _RemoteRegionProxy:
+    def __init__(self, region_id: int, client: RemoteRegionEngine):
+        self.region_id = region_id
+        self._client = client
+
+    def flush(self) -> None:
+        self._client.flush(self.region_id)
 
 
 class RegionFlightClient:

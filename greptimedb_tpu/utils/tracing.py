@@ -1,0 +1,69 @@
+"""Trace context + spans (mirrors reference common/telemetry tracing:
+`TracingContext::to_w3c` rides region requests across process hops,
+query/src/dist_plan/merge_scan.rs:185-201, re-attached server-side at
+servers/src/grpc/region_server.rs:74).
+
+A request's trace id lives in a contextvar; spans record wall-time per
+stage into a bounded ring buffer. EXPLAIN ANALYZE and the region wire
+protocol both ride this: the frontend's trace id crosses Flight inside
+the scan spec, so one query's spans line up across processes.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import time
+import uuid
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Optional
+
+_current: contextvars.ContextVar[Optional[str]] = contextvars.ContextVar(
+    "gtpu_trace_id", default=None)
+
+_SPANS: deque = deque(maxlen=4096)
+
+
+@dataclass
+class Span:
+    trace_id: Optional[str]
+    name: str
+    duration_ms: float
+    started_at: float
+    attrs: dict = field(default_factory=dict)
+
+
+def new_trace_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+def set_trace(trace_id: Optional[str] = None) -> str:
+    """Install (or adopt) a trace id for the current context."""
+    tid = trace_id or new_trace_id()
+    _current.set(tid)
+    return tid
+
+
+def current_trace_id() -> Optional[str]:
+    return _current.get()
+
+
+@contextlib.contextmanager
+def span(name: str, **attrs):
+    t0 = time.perf_counter()
+    started = time.time()
+    try:
+        yield
+    finally:
+        _SPANS.append(Span(_current.get(), name,
+                           (time.perf_counter() - t0) * 1000.0,
+                           started, attrs))
+
+
+def spans_for(trace_id: str) -> list[Span]:
+    return [s for s in _SPANS if s.trace_id == trace_id]
+
+
+def recent_spans(n: int = 100) -> list[Span]:
+    return list(_SPANS)[-n:]
