@@ -212,6 +212,9 @@ class RegionRouter:
             region_id, ts_range, projection, tag_predicates
         )
 
+    def alter_region_schema(self, region_id: int, schema) -> None:
+        self._engine_for(region_id).alter_region_schema(region_id, schema)
+
     def handle_request(self, req: RegionRequest) -> int:
         return self._engine_for(req.region_id).handle_request(req)
 

@@ -639,6 +639,9 @@ class PromqlEngine:
         if not 0 < sf < 1 or not 0 < tf < 1:
             raise PromqlError("holt_winters factors must be in (0, 1)")
         range_s = sel.range_s
+        if range_s is None:
+            raise PromqlError(
+                "holt_winters needs a range vector (metric[duration])")
         loaded = self._load_any(sel, p, ctx, window=range_s)
         if loaded is None:
             return SeriesMatrix([], jnp.zeros((0, p.T)))

@@ -445,12 +445,7 @@ class QueryEngine:
                                 col.default.value if isinstance(col.default, ast.Literal) else None)]
             )
             for rid in info.region_ids:
-                region = self.region_engine.region(rid)
-                region.flush()
-                region.schema = new_schema
-                region.memtable.schema = new_schema
-                region.sst_writer.schema = new_schema
-                region.manifest.record_schema(new_schema)
+                self.region_engine.alter_region_schema(rid, new_schema)
             info.schema = new_schema
             self._refresh_column_order(info, added=col.name)
             self.catalog.update_table(info)
@@ -462,12 +457,7 @@ class QueryEngine:
                 raise PlanError("can only DROP field columns")
             new_schema = Schema(cols)
             for rid in info.region_ids:
-                region = self.region_engine.region(rid)
-                region.flush()
-                region.schema = new_schema
-                region.memtable.schema = new_schema
-                region.sst_writer.schema = new_schema
-                region.manifest.record_schema(new_schema)
+                self.region_engine.alter_region_schema(rid, new_schema)
             info.schema = new_schema
             self._refresh_column_order(info, dropped=stmt.column_name)
             self.catalog.update_table(info)
@@ -643,6 +633,10 @@ class QueryEngine:
 
             from greptimedb_tpu.utils import tracing
 
+            # the inner statement really runs: it needs its OWN
+            # authorization (EXPLAIN itself only required read — without
+            # this a read-only user could EXPLAIN ANALYZE a DELETE)
+            self.permission_checker.check(ctx.user, stmt.inner, ctx.db)
             tid = tracing.set_trace(ctx.trace_id)
             t0 = _time.perf_counter()
             result = self._execute_statement(stmt.inner, ctx)

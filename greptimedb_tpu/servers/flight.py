@@ -332,8 +332,22 @@ class FlightServer(fl.FlightServerBase):
             req = json.loads(action.body.to_pybytes().decode())
             rid = req["region_id"]
             op = req["op"]
+            user = self._resolve_user(context)
+            needed = "read" if op in ("exists", "info") else "write"
+            if user is not None and not user.can(needed):
+                raise fl.FlightUnauthorizedError(
+                    f"user {user.username!r} lacks {needed} permission")
             from greptimedb_tpu.storage.engine import RegionRequest, RequestType
 
+            if op == "info":
+                region = self.engine.region(rid)
+                return [json.dumps(
+                    {"data_version": region.data_version}).encode()]
+            if op == "alter":
+                from greptimedb_tpu.datatypes.schema import Schema as _S
+                self.engine.alter_region_schema(
+                    rid, _S.from_dict(req["schema"]))
+                return [b'{"ok": true}']
             if op == "create":
                 from greptimedb_tpu.datatypes.schema import Schema as _S
                 self.engine.create_region(rid, _S.from_dict(req["schema"]))
@@ -456,11 +470,14 @@ class RemoteRegionEngine:
 
     def region(self, region_id: int):
         """Existence probe (KeyError contract of the local engine). The
-        returned proxy carries identity only — schema mutations (ALTER)
-        need a dedicated RPC, not remote attribute pokes."""
+        returned proxy carries identity + remote-backed metadata; schema
+        mutations go through alter_region_schema, a dedicated RPC."""
         if not self._admin("exists", region_id).get("exists"):
             raise KeyError(f"region {region_id} not found on {self.addr}")
         return _RemoteRegionProxy(region_id, self)
+
+    def alter_region_schema(self, region_id: int, schema) -> None:
+        self._admin("alter", region_id, schema=schema.to_dict())
 
     def flush(self, region_id: int) -> None:
         self._admin("flush", region_id)
@@ -544,6 +561,10 @@ class _RemoteRegionProxy:
 
     def flush(self) -> None:
         self._client.flush(self.region_id)
+
+    @property
+    def data_version(self) -> int:
+        return self._client._admin("info", self.region_id)["data_version"]
 
 
 class RegionFlightClient:

@@ -193,6 +193,16 @@ class RegionEngine:
     ) -> Optional[ScanData]:
         return self.region(region_id).scan(ts_range, projection, tag_predicates)
 
+    def alter_region_schema(self, region_id: int, schema: Schema) -> None:
+        """Apply an ALTER'd schema to a region: flush under the old schema,
+        then swap and record (reference worker/handle_alter.rs)."""
+        region = self.region(region_id)
+        region.flush()
+        region.schema = schema
+        region.memtable.schema = schema
+        region.sst_writer.schema = schema
+        region.manifest.record_schema(schema)
+
     def scan_stream(
         self,
         region_id: int,
