@@ -75,6 +75,8 @@ class PhiAccrualFailureDetector:
         if y > 30.0:
             return 1000.0  # saturate instead of overflowing exp
         e = math.exp(-y * (1.5976 + 0.070566 * y * y))
+        if e == 0.0:
+            return 1000.0  # exp underflowed: certainty of death
         if elapsed > mean:
             return -math.log10(e / (1.0 + e))
         return -math.log10(1.0 - 1.0 / (1.0 + e))

@@ -62,6 +62,11 @@ class HeartbeatTask:
         resp = self.metasrv.handle_heartbeat(
             HeartbeatRequest(node_id=self.node_id, region_stats=stats, now_ms=now_ms)
         )
+        if not resp.leader:
+            # redirected by a follower: no lease grant in this response —
+            # keep existing deadlines (do NOT stamp them to 0) and let the
+            # caller re-ask the current leader (resp.leader_hint)
+            return resp
         self.alive_keeper.renew([s.region_id for s in stats], resp.lease_deadline_ms)
         for inst in resp.instructions:
             if inst.kind == InstructionKind.CLOSE_REGION:

@@ -31,6 +31,7 @@ from typing import Callable, Optional
 
 from ..catalog.kv import KvBackend
 from ..procedure import Procedure, ProcedureManager, Status
+from .election import KvElection, NotLeaderError
 from .failure_detector import PhiAccrualFailureDetector
 from .instruction import Instruction, InstructionKind
 from .route import RegionRoute, TableRoute, TableRouteManager
@@ -67,11 +68,15 @@ class HeartbeatResponse:
     instructions: list[Instruction] = field(default_factory=list)
     lease_deadline_ms: float = 0.0
     leader: bool = True
+    leader_hint: Optional[str] = None  # who to talk to when leader=False
 
 
 class Metasrv:
-    def __init__(self, kv: KvBackend, opts: Optional[MetasrvOptions] = None):
+    def __init__(self, kv: KvBackend, opts: Optional[MetasrvOptions] = None,
+                 node_id: str = "metasrv-0",
+                 election: Optional[KvElection] = None):
         self.kv = kv
+        self.node_id = node_id
         self.opts = opts or MetasrvOptions()
         self.routes = TableRouteManager(kv)
         self.procedures = ProcedureManager(kv)
@@ -89,9 +94,104 @@ class Metasrv:
         self._node_regions: dict[str, dict[int, RegionStat]] = {}
         self._pending: dict[str, list[Instruction]] = {}
         self._failed_over: set[str] = set()  # nodes already handled
+        self._journal_meta: dict[str, tuple] = {}  # node -> (ms, regions)
         self._lock = threading.RLock()
         # cache-invalidation fanout to frontends (cache crate analog)
         self._invalidation_subs: list[Callable[[str], None]] = []
+        # HA: when an election is attached, leader-only APIs are fenced and
+        # a newly-elected leader resumes the shared procedure store
+        # (meta-srv/src/metasrv.rs try_start leader-only bootstrap)
+        self.election = election
+        if election is not None:
+            election.register_candidate({"node": node_id})
+            election.subscribe(self._on_leader_change)
+
+    # ------------------------------------------------------------- election
+    def is_leader(self) -> bool:
+        """Standalone metasrv (no election) is always the leader."""
+        return self.election is None or self.election.is_leader()
+
+    def ensure_leader(self) -> None:
+        if not self.is_leader():
+            hint = self.election.leader_hint() if self.election else None
+            raise NotLeaderError(hint)
+
+    NODE_INFO_ROOT = "__meta_nodes/"
+
+    def _persist_node_info(self, node_id: str, now_ms: float,
+                           failed_over: bool = False) -> None:
+        """Journal the node's liveness + region set to the shared KV so a
+        newly-elected leader inherits cluster membership (the reference
+        stores NodeInfo in the meta KV, meta-srv/src/cluster.rs).
+
+        Throttled: a FileKv put rewrites+fsyncs the whole store, so only
+        persist when the region set changed or half a lease elapsed —
+        journal staleness is bounded by lease/2, well inside the failure
+        detector's acceptable pause."""
+        import dataclasses
+
+        regions = frozenset(self._node_regions.get(node_id, {}))
+        last_ms, last_regions = self._journal_meta.get(node_id, (-1e18, None))
+        if not failed_over and regions == last_regions and \
+                now_ms - last_ms < self.opts.region_lease_s * 1000 / 2:
+            return
+        self._journal_meta[node_id] = (now_ms, regions)
+        self.kv.put(
+            self.NODE_INFO_ROOT + node_id,
+            json.dumps({
+                "last_heartbeat_ms": now_ms,
+                "failed_over": failed_over,
+                "stats": self._node_stats.get(node_id, {}),
+                "regions": [
+                    dataclasses.asdict(s)
+                    for s in self._node_regions.get(node_id, {}).values()
+                ],
+            }),
+        )
+
+    def _inherit_cluster_state(self) -> None:
+        """Seed detectors/region maps from the KV-journaled node infos: a
+        node that stops heartbeating across a coordinator failover must
+        still be detected dead by the NEW leader — and a node the old
+        leader ALREADY failed over must not be failed over again."""
+        with self._lock:
+            for key, raw in self.kv.range(self.NODE_INFO_ROOT):
+                node = key[len(self.NODE_INFO_ROOT):]
+                info = json.loads(raw)
+                if info.get("failed_over"):
+                    self._failed_over.add(node)
+                    continue
+                det = self._detectors.get(node)
+                if det is not None and det._last_heartbeat_ms is not None \
+                        and det._last_heartbeat_ms >= info["last_heartbeat_ms"]:
+                    continue  # our own view is at least as fresh
+                # stale or absent view (e.g. a re-elected former leader):
+                # re-seed from the journal written by the last leader.
+                # Bootstrap with the real heartbeat cadence — the default
+                # 1s estimate plus journal staleness (<= lease/2) would
+                # read a healthy 3s-cadence node as dead on arrival.
+                det = PhiAccrualFailureDetector(
+                    threshold=self.opts.failure_threshold,
+                    first_heartbeat_estimate_ms=(
+                        self.opts.heartbeat_interval_s * 1000
+                    ),
+                )
+                det.heartbeat(info["last_heartbeat_ms"])
+                self._detectors[node] = det
+                self._node_stats[node] = info.get("stats", {})
+                self._node_regions[node] = {
+                    s["region_id"]: RegionStat(**s)
+                    for s in info.get("regions", [])
+                }
+
+    def _on_leader_change(self, event: str, node_id: str) -> None:
+        if event == "elected":
+            # inherit membership, then resume in-flight procedures
+            # journaled by the previous leader (both live in the shared KV,
+            # so failover/migration state machines continue from their
+            # persisted phase)
+            self._inherit_cluster_state()
+            self.procedures.recover()
 
     # ---------------------------------------------------------------- stats
     def subscribe_invalidation(self, fn: Callable[[str], None]) -> None:
@@ -118,16 +218,34 @@ class Metasrv:
     def handle_heartbeat(self, req: HeartbeatRequest) -> HeartbeatResponse:
         """The heartbeat handler pipeline (meta-srv/src/handler.rs):
         collect_stats → failure detector feed → mailbox drain →
-        region-lease renewal."""
+        region-lease renewal. Followers redirect (handler.rs is_not_leader
+        check → client re-asks the leader)."""
         now_ms = req.now_ms if req.now_ms is not None else time.time() * 1000
+        if self.election is not None:
+            # serving heartbeats doubles as election keep-alive: a busy
+            # leader must not lose the lease between ticks (the reference
+            # keep-alive stream runs independently of the handler loop)
+            if self.election.is_leader():
+                self.election.keep_alive(now_ms)
+            if self.election.leader(now_ms) != self.node_id:
+                # authoritative KV check, not the local flag: a deposed
+                # leader whose flag is stale must not grant leases
+                # (split-brain guard)
+                return HeartbeatResponse(
+                    leader=False, leader_hint=self.election.leader_hint()
+                )
         with self._lock:
             det = self._detectors.setdefault(
                 req.node_id,
                 PhiAccrualFailureDetector(threshold=self.opts.failure_threshold),
             )
             det.heartbeat(now_ms)
-            # a node that re-appears after failover may rejoin empty-handed
-            self._failed_over.discard(req.node_id)
+            # a node that re-appears after failover may rejoin empty-handed;
+            # its journal entry still says failed_over=True — drop the
+            # throttle memo so the clearing write below cannot be skipped
+            if req.node_id in self._failed_over:
+                self._failed_over.discard(req.node_id)
+                self._journal_meta.pop(req.node_id, None)
             self._node_regions[req.node_id] = {s.region_id: s for s in req.region_stats}
             self._node_stats[req.node_id] = {
                 "region_count": len(req.region_stats),
@@ -136,6 +254,8 @@ class Metasrv:
             }
             instructions = self._pending.pop(req.node_id, [])
             lease = now_ms + self.opts.region_lease_s * 1000
+            if self.election is not None:
+                self._persist_node_info(req.node_id, now_ms)
             return HeartbeatResponse(instructions=instructions, lease_deadline_ms=lease)
 
     def send_instruction(self, node_id: str, inst: Instruction) -> None:
@@ -147,8 +267,16 @@ class Metasrv:
     # ------------------------------------------------------- failure detect
     def tick(self, now_ms: Optional[float] = None) -> list[str]:
         """Run failure detection; submit failover for newly-dead nodes.
-        Returns the list of failover procedure ids started."""
+        Returns the list of failover procedure ids started.
+
+        With an election attached this doubles as the keep-alive loop:
+        campaign (acquire or renew the lease) first; followers do nothing —
+        only the leader drives failure detection and failover."""
         now_ms = now_ms if now_ms is not None else time.time() * 1000
+        if self.election is not None:
+            self.election.campaign(now_ms)
+            if not self.election.is_leader():
+                return []
         with self._lock:
             dead = [
                 n
@@ -159,6 +287,11 @@ class Metasrv:
         for node in dead:
             with self._lock:
                 self._failed_over.add(node)
+                if self.election is not None:
+                    # journal the decision: a future leader inheriting the
+                    # node journal must not fail this node over a second
+                    # time (it would orphan the region's current holder)
+                    self._persist_node_info(node, now_ms, failed_over=True)
             regions = list(self._node_regions.get(node, {}).values())
             for stat in regions:
                 if stat.role != "leader":
@@ -179,7 +312,8 @@ class Metasrv:
     # ------------------------------------------------------------ migration
     def migrate_region(self, table: str, region_id: int, to_node: str):
         """Manual region migration (migrate_region() SQL admin function,
-        common/function/src/table/migrate_region.rs)."""
+        common/function/src/table/migrate_region.rs). Leader-only."""
+        self.ensure_leader()
         route = self.routes.get(table)
         if route is None:
             raise KeyError(f"no route for table {table}")

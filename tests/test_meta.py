@@ -5,7 +5,18 @@ import numpy as np
 import pytest
 
 from greptimedb_tpu.catalog.kv import MemoryKv
+from greptimedb_tpu.meta.election import (
+    KvElection,
+    LeaderFollowClient,
+    NotLeaderError,
+)
 from greptimedb_tpu.meta.failure_detector import PhiAccrualFailureDetector
+from greptimedb_tpu.meta.metasrv import (
+    HeartbeatRequest,
+    Metasrv,
+    MetasrvOptions,
+    RegionStat,
+)
 from greptimedb_tpu.meta.route import RegionRoute, TableRoute, TableRouteManager
 from greptimedb_tpu.meta.selector import (
     LeaseBasedSelector,
@@ -147,6 +158,309 @@ class TestRoutes:
         again = mgr.get("1024")
         assert again.region(1).leader_node == "dn-1"
         assert again.version == 1
+
+
+class TestElection:
+    """Metasrv HA: lease-based election over the shared KV
+    (reference src/meta-srv/src/election/etcd.rs)."""
+
+    def _pair(self, lease_s=3.0):
+        kv = MemoryKv()
+        e1 = KvElection(kv, "meta-a", lease_s=lease_s)
+        e2 = KvElection(kv, "meta-b", lease_s=lease_s)
+        return kv, e1, e2
+
+    def test_first_campaigner_wins_second_follows(self):
+        _, e1, e2 = self._pair()
+        assert e1.campaign(0)
+        assert not e2.campaign(0)
+        assert e1.is_leader() and not e2.is_leader()
+        assert e2.leader(0) == "meta-a"
+
+    def test_leader_renews_within_lease(self):
+        _, e1, e2 = self._pair(lease_s=3)
+        e1.campaign(0)
+        e1.campaign(2000)  # renew
+        assert not e2.campaign(4000)  # lease now runs to 5000
+        assert e1.is_leader()
+
+    def test_takeover_after_lease_expiry(self):
+        _, e1, e2 = self._pair(lease_s=3)
+        e1.campaign(0)
+        # meta-a dies: stops campaigning; lease lapses at 3000
+        assert e2.campaign(3500)
+        assert e2.is_leader()
+        # a late renewal from the old leader must fail (CAS mismatch)
+        assert not e1.campaign(3600)
+        assert not e1.is_leader()
+
+    def test_resign_hands_over_immediately(self):
+        _, e1, e2 = self._pair()
+        e1.campaign(0)
+        e1.resign()
+        assert e2.campaign(1)  # no lease wait
+        assert e2.is_leader()
+
+    def test_watchers_fire_on_transitions(self):
+        _, e1, e2 = self._pair(lease_s=3)
+        events = []
+        e1.subscribe(lambda ev, n: events.append((ev, n)))
+        e1.campaign(0)
+        e2.campaign(3500)
+        e1.campaign(3600)  # discovers it lost
+        assert events == [("elected", "meta-a"), ("step_down", "meta-a")]
+
+    def test_candidate_registry(self):
+        kv, e1, e2 = self._pair()
+        e1.register_candidate({"node": "meta-a", "addr": "127.0.0.1:3002"})
+        e2.register_candidate({"node": "meta-b", "addr": "127.0.0.1:3003"})
+        assert {c["node"] for c in e1.all_candidates()} == {"meta-a", "meta-b"}
+
+
+class TestMetasrvHA:
+    """Two metasrvs over one KV: follower redirects, leader-kill failover
+    of the coordinator itself, in-flight procedure resumption."""
+
+    def _cluster(self, lease_s=3.0):
+        kv = MemoryKv()
+        e1 = KvElection(kv, "meta-a", lease_s=lease_s)
+        e2 = KvElection(kv, "meta-b", lease_s=lease_s)
+        opts = MetasrvOptions(region_lease_s=9, heartbeat_interval_s=3)
+        m1 = Metasrv(kv, opts, node_id="meta-a", election=e1)
+        m2 = Metasrv(kv, opts, node_id="meta-b", election=e2)
+        return kv, m1, m2
+
+    def test_follower_redirects_heartbeat(self):
+        _, m1, m2 = self._cluster()
+        m1.tick(0)  # campaigns -> leader
+        m2.tick(0)  # follower
+        resp = m2.handle_heartbeat(HeartbeatRequest("dn-1", now_ms=0))
+        assert not resp.leader
+        assert resp.leader_hint == "meta-a"
+        resp = m1.handle_heartbeat(HeartbeatRequest("dn-1", now_ms=0))
+        assert resp.leader
+        assert resp.lease_deadline_ms > 0
+
+    def test_leader_follow_client_redirects(self):
+        _, m1, m2 = self._cluster()
+        m1.tick(0)
+        m2.tick(0)
+        client = LeaderFollowClient({"meta-a": m1, "meta-b": m2})
+        resp = client.heartbeat(HeartbeatRequest("dn-1", now_ms=0))
+        assert resp.leader
+
+    def test_migrate_region_is_leader_only(self):
+        _, m1, m2 = self._cluster()
+        m1.tick(0)
+        m2.tick(0)
+        with pytest.raises(NotLeaderError) as ei:
+            m2.migrate_region("1024", 1, "dn-2")
+        assert ei.value.leader == "meta-a"
+
+    def test_coordinator_failover_resumes_failover_procedure(self):
+        """Leader starts a region failover, crashes mid-procedure; the
+        follower takes over the lease and finishes it from the shared
+        procedure store."""
+        kv, m1, m2 = self._cluster(lease_s=3)
+        # both metasrvs know the datanodes via heartbeats to the leader
+        m1.tick(0)
+        t = 0.0
+        for _ in range(30):
+            for dn in ("dn-1", "dn-2"):
+                stats = (
+                    [RegionStat(region_id=1, table="1024")]
+                    if dn == "dn-1"
+                    else []
+                )
+                m1.handle_heartbeat(
+                    HeartbeatRequest(dn, region_stats=stats, now_ms=t)
+                )
+            m1.tick(t)
+            t += 1000.0
+        m1.routes.put_new(
+            TableRoute("1024", [RegionRoute(region_id=1, leader_node="dn-1")])
+        )
+        # dn-1 dies; leader detects and submits failover, but "crashes"
+        # after persisting the first phase: simulate by stepping the
+        # procedure store directly without driving (submit drives to
+        # completion here, so instead kill the leader BEFORE tick and let
+        # the follower run the detection+failover after takeover)
+        # leader dies at t; follower campaigns past the lease
+        t_dead = t + 4000
+        m2.tick(t_dead)  # takes the lease, recovers (empty) procedures
+        m1.tick(t_dead)  # old leader campaigns, loses, steps down
+        assert m2.is_leader() and not m1.is_leader()
+        # follower now receives heartbeats (dn-2 alive, dn-1 silent)
+        for _ in range(30):
+            m2.handle_heartbeat(HeartbeatRequest("dn-2", now_ms=t_dead))
+            started = m2.tick(t_dead)
+            if started:
+                break
+            t_dead += 1000.0
+        # dn-1's region failed over to dn-2 by the NEW coordinator
+        route = m2.routes.get("1024")
+        assert route.region(1).leader_node == "dn-2"
+
+    def test_new_leader_recovers_inflight_procedure(self):
+        """A procedure journaled as `running` by the dead leader is driven
+        to completion by the new leader's election callback."""
+        kv, m1, m2 = self._cluster(lease_s=3)
+        m1.tick(0)
+        from greptimedb_tpu.procedure import ProcedureRecord
+
+        # journal a half-done failover as the old leader would have left it
+        m1.routes.put_new(
+            TableRoute("1024", [RegionRoute(region_id=1, leader_node="dn-1")])
+        )
+        rec = ProcedureRecord(
+            procedure_id="p-inflight",
+            type_name="region_failover",
+            state={
+                "table": "1024",
+                "region_id": 1,
+                "from_node": "dn-1",
+                "candidate": "dn-2",
+                "phase": "activate",
+                "now_ms": 0,
+            },
+            status="running",
+        )
+        m1.procedures.store.save(rec)
+        # leader dies; follower takes over -> _on_leader_change -> recover()
+        m2.tick(4000)
+        assert m2.is_leader()
+        got = m2.procedures.store.load("p-inflight")
+        assert got.status == "done"
+        route = m2.routes.get("1024")
+        assert route.region(1).leader_node == "dn-2"
+
+
+class TestMetasrvHAEdgeCases:
+    """Regressions for the coordinator-HA review findings."""
+
+    def _cluster(self, lease_s=3.0):
+        kv = MemoryKv()
+        e1 = KvElection(kv, "meta-a", lease_s=lease_s)
+        e2 = KvElection(kv, "meta-b", lease_s=lease_s)
+        opts = MetasrvOptions(region_lease_s=9, heartbeat_interval_s=3)
+        m1 = Metasrv(kv, opts, node_id="meta-a", election=e1)
+        m2 = Metasrv(kv, opts, node_id="meta-b", election=e2)
+        return kv, m1, m2
+
+    def test_redirect_does_not_zero_region_leases(self):
+        """A leader=False response must not stamp lease deadlines to 0 and
+        self-close the datanode's regions."""
+        from greptimedb_tpu.meta.heartbeat import HeartbeatTask
+
+        _, m1, m2 = self._cluster()
+        m1.tick(0)
+        m2.tick(0)
+        applied = []
+        task = HeartbeatTask(
+            "dn-1", m2, lambda: [RegionStat(region_id=1, table="1024")],
+            applied.append,
+        )
+        task.alive_keeper.renew([1], 9000.0)
+        resp = task.beat(0)
+        assert not resp.leader
+        # lease deadline untouched; region not expired
+        assert task.alive_keeper.expired(5000.0) == []
+
+    def test_heartbeats_keep_election_lease_alive_between_ticks(self):
+        """Serving heartbeats renews the election lease — a busy leader
+        must not redirect its own datanodes just because tick is late."""
+        _, m1, m2 = self._cluster(lease_s=3)
+        m1.tick(0)  # lease runs to 3000
+        # heartbeats keep arriving past the original lease with no tick
+        for t in (1000, 2500, 4000, 5500, 7000):
+            resp = m1.handle_heartbeat(HeartbeatRequest("dn-1", now_ms=t))
+            assert resp.leader, f"redirected own datanode at t={t}"
+
+    def test_reelected_former_leader_refreshes_stale_detectors(self):
+        """m1 leads, loses the lease, m2 leads for a while (receiving
+        heartbeats), then m1 is re-elected: m1 must refresh its detector
+        view from the journal, not declare the healthy node dead."""
+        _, m1, m2 = self._cluster(lease_s=3)
+        m1.tick(0)
+        m1.handle_heartbeat(HeartbeatRequest(
+            "dn-1", region_stats=[RegionStat(1, "1024")], now_ms=0))
+        # m1 pauses; m2 takes over and keeps receiving dn-1 heartbeats
+        m2.tick(4000)
+        t = 4000.0
+        while t < 90_000:
+            m2.handle_heartbeat(HeartbeatRequest(
+                "dn-1", region_stats=[RegionStat(1, "1024")], now_ms=t))
+            t += 3000.0
+        # m2 dies; m1 re-elected at t=95s — its own dn-1 view is 95s stale
+        started = m1.tick(95_000)
+        assert started == [], "spurious failover of a healthy node"
+        assert m1.tick(96_000) == []
+
+    def test_inherited_failed_over_marker_prevents_double_failover(self):
+        """A node the old leader already failed over must not be failed
+        over again by the new leader (it would reroute the region away
+        from its current holder)."""
+        kv, m1, m2 = self._cluster(lease_s=3)
+        m1.tick(0)
+        m1.routes.put_new(
+            TableRoute("1024", [RegionRoute(region_id=1, leader_node="dn-1")])
+        )
+        t = 0.0
+        for _ in range(10):
+            m1.handle_heartbeat(HeartbeatRequest(
+                "dn-1", region_stats=[RegionStat(1, "1024")], now_ms=t))
+            m1.handle_heartbeat(HeartbeatRequest("dn-2", now_ms=t))
+            m1.tick(t)
+            t += 1000.0
+        # dn-1 dies; m1 detects and fails over to dn-2
+        t_fail = t
+        while t_fail < t + 60_000:
+            m1.handle_heartbeat(HeartbeatRequest("dn-2", now_ms=t_fail))
+            if m1.tick(t_fail):
+                break
+            t_fail += 1000.0
+        assert m1.routes.get("1024").region(1).leader_node == "dn-2"
+        # m1 dies; m2 takes over and inherits the journal
+        m2.tick(t_fail + 4000)
+        assert m2.is_leader()
+        for dt in range(0, 30_000, 1000):
+            m2.handle_heartbeat(
+                HeartbeatRequest("dn-2", now_ms=t_fail + 4000 + dt))
+            assert m2.tick(t_fail + 4000 + dt) == [], \
+                "double failover of dn-1 by the new leader"
+        assert m2.routes.get("1024").region(1).leader_node == "dn-2"
+
+
+    def test_rejoining_node_clears_failed_over_journal(self):
+        """A partitioned (not dead) node that re-heartbeats must get its
+        failed_over journal marker cleared immediately — the persistence
+        throttle may not skip the clearing write."""
+        import json as _json
+
+        kv, m1, m2 = self._cluster(lease_s=3)
+        m1.tick(0)
+        t = 0.0
+        for _ in range(10):
+            m1.handle_heartbeat(HeartbeatRequest("dn-1", now_ms=t))
+            m1.handle_heartbeat(HeartbeatRequest("dn-2", now_ms=t))
+            m1.tick(t)
+            t += 1000.0
+        # dn-1 goes silent long enough to be declared dead
+        t_dead = t
+        while t_dead < t + 60_000:
+            m1.handle_heartbeat(HeartbeatRequest("dn-2", now_ms=t_dead))
+            m1.tick(t_dead)
+            if _json.loads(kv.get(Metasrv.NODE_INFO_ROOT + "dn-1"))\
+                    .get("failed_over"):
+                break
+            t_dead += 1000.0
+        assert _json.loads(
+            kv.get(Metasrv.NODE_INFO_ROOT + "dn-1")).get("failed_over")
+        # it was only partitioned: one heartbeat (same empty region set,
+        # within lease/2 of the marker write) must clear the marker
+        m1.handle_heartbeat(HeartbeatRequest("dn-1", now_ms=t_dead + 500))
+        assert not _json.loads(
+            kv.get(Metasrv.NODE_INFO_ROOT + "dn-1")).get("failed_over")
 
 
 class TestPartitionRule:
