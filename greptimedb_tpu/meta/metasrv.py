@@ -111,10 +111,15 @@ class Metasrv:
         """Standalone metasrv (no election) is always the leader."""
         return self.election is None or self.election.is_leader()
 
-    def ensure_leader(self) -> None:
-        if not self.is_leader():
-            hint = self.election.leader_hint() if self.election else None
-            raise NotLeaderError(hint)
+    def ensure_leader(self, now_ms: Optional[float] = None) -> None:
+        """Fence leader-only APIs with the authoritative KV lease check
+        (same as the heartbeat path) — the local flag of a paused,
+        since-deposed leader is stale until its next campaign, and route
+        mutations from it would race the real leader's."""
+        if self.election is None:
+            return
+        if self.election.leader(now_ms) != self.node_id:
+            raise NotLeaderError(self.election.leader_hint())
 
     NODE_INFO_ROOT = "__meta_nodes/"
 
@@ -274,7 +279,7 @@ class Metasrv:
         only the leader drives failure detection and failover."""
         now_ms = now_ms if now_ms is not None else time.time() * 1000
         if self.election is not None:
-            self.election.campaign(now_ms)
+            self.election.keep_alive(now_ms)
             if not self.election.is_leader():
                 return []
         with self._lock:
@@ -310,10 +315,11 @@ class Metasrv:
         return started
 
     # ------------------------------------------------------------ migration
-    def migrate_region(self, table: str, region_id: int, to_node: str):
+    def migrate_region(self, table: str, region_id: int, to_node: str,
+                       now_ms: Optional[float] = None):
         """Manual region migration (migrate_region() SQL admin function,
         common/function/src/table/migrate_region.rs). Leader-only."""
-        self.ensure_leader()
+        self.ensure_leader(now_ms)
         route = self.routes.get(table)
         if route is None:
             raise KeyError(f"no route for table {table}")

@@ -7,6 +7,10 @@ and run SQL against the query engine. Implements the text protocol
 (protocol 41, handshake v10): COM_QUERY, COM_PING, COM_INIT_DB, COM_QUIT,
 plus enough of the federated-query shims (SELECT @@version_comment and
 friends, federated.rs analog) for standard clients to connect cleanly.
+Prepared statements (handler.rs:153 on_prepare/on_execute): binary
+COM_STMT_PREPARE / COM_STMT_EXECUTE / COM_STMT_CLOSE / COM_STMT_RESET
+with typed parameter decoding and binary resultset rows — the default
+path for connector libraries and ORMs.
 
 EOF-style result sets (CLIENT_DEPRECATE_EOF not advertised) keep encoding
 simple and broadly compatible.
@@ -46,11 +50,32 @@ COM_INIT_DB = 0x02
 COM_QUERY = 0x03
 COM_PING = 0x0E
 COM_STMT_PREPARE = 0x16
+COM_STMT_EXECUTE = 0x17
+COM_STMT_CLOSE = 0x19
+COM_STMT_RESET = 0x1A
 
+MYSQL_TYPE_TINY = 1
+MYSQL_TYPE_SHORT = 2
+MYSQL_TYPE_LONG = 3
+MYSQL_TYPE_FLOAT = 4
 MYSQL_TYPE_LONGLONG = 8
+MYSQL_TYPE_INT24 = 9
 MYSQL_TYPE_DOUBLE = 5
+MYSQL_TYPE_NULL = 6
 MYSQL_TYPE_VAR_STRING = 253
+MYSQL_TYPE_STRING = 254
+MYSQL_TYPE_BLOB = 252
+MYSQL_TYPE_TINY_BLOB = 249
+MYSQL_TYPE_MEDIUM_BLOB = 250
+MYSQL_TYPE_LONG_BLOB = 251
 MYSQL_TYPE_TIMESTAMP = 7
+MYSQL_TYPE_DATETIME = 12
+MYSQL_TYPE_DATE = 10
+MYSQL_TYPE_TIME = 11
+MYSQL_TYPE_VARCHAR = 15
+MYSQL_TYPE_YEAR = 13
+MYSQL_TYPE_DECIMAL = 0
+MYSQL_TYPE_NEWDECIMAL = 246
 
 
 def lenc_int(n: int) -> bytes:
@@ -171,6 +196,13 @@ class _Session(socketserver.BaseRequestHandler):
         io.send_packet(_ok())
         from greptimedb_tpu.session import Channel
         ctx = QueryContext(db=db, channel=Channel.MYSQL, user=user_info)
+        # prepared-statement registry, per connection (handler.rs:153
+        # keeps a SqlPlan map keyed by stmt id the same way); the third
+        # slot caches parameter types — libmysqlclient connectors send the
+        # type block only on the FIRST execute (new-params-bound=1) and
+        # omit it on re-executes
+        stmts: dict[int, list] = {}
+        next_stmt_id = 1
         # ---- command loop ----
         while True:
             io.reset_seq()
@@ -188,7 +220,42 @@ class _Session(socketserver.BaseRequestHandler):
                 io.send_packet(_ok())
                 continue
             if cmd == COM_STMT_PREPARE:
-                io.send_packet(_err(1295, "HY000", "prepared statements not supported; use the text protocol"))
+                sql = body.decode("utf-8", "replace").strip().rstrip(";")
+                n_params = _count_params(sql)
+                stmt_id = next_stmt_id
+                next_stmt_id += 1
+                stmts[stmt_id] = [sql, n_params, None]
+                _send_prepare_ok(io, stmt_id, n_params)
+                continue
+            if cmd == COM_STMT_EXECUTE:
+                try:
+                    stmt_id = struct.unpack("<I", body[:4])[0]
+                    if stmt_id not in stmts:
+                        io.send_packet(
+                            _err(1243, "HY000", f"unknown stmt {stmt_id}"))
+                        continue
+                    sql, n_params, cached_types = stmts[stmt_id]
+                    params, types = _decode_exec_params(
+                        body, n_params, cached_types)
+                    stmts[stmt_id][2] = types
+                    bound = _bind_params(sql, params)
+                    result = _dispatch(server.query_engine, bound, ctx)
+                except Exception as e:  # noqa: BLE001 — wire must stay up
+                    io.send_packet(_err(1064, "42000", str(e)[:400]))
+                    continue
+                _send_result(io, result, binary=True)
+                continue
+            if cmd == COM_STMT_CLOSE:
+                stmts.pop(struct.unpack("<I", body[:4])[0], None)
+                continue  # no response, per protocol
+            if cmd == 0x18:  # COM_STMT_SEND_LONG_DATA
+                # protocol: NO response — answering would desync the
+                # connection (client pipelines execute right behind it).
+                # Long-data chunks aren't accumulated; the subsequent
+                # execute fails cleanly if it references the missing param.
+                continue
+            if cmd == COM_STMT_RESET:
+                io.send_packet(_ok())
                 continue
             if cmd != COM_QUERY:
                 io.send_packet(_err(1047, "08S01", f"unknown command {cmd}"))
@@ -250,6 +317,192 @@ _SESSION_VARS = {
 }
 
 
+def _count_params(sql: str) -> int:
+    """Count `?` placeholders outside string literals."""
+    n = 0
+    in_str: Optional[str] = None
+    i = 0
+    while i < len(sql):
+        c = sql[i]
+        if in_str is not None:
+            if c == in_str:
+                # '' escape inside a string stays inside it
+                if i + 1 < len(sql) and sql[i + 1] == in_str:
+                    i += 1
+                else:
+                    in_str = None
+        elif c in ("'", '"'):
+            in_str = c
+        elif c == "?":
+            n += 1
+        i += 1
+    return n
+
+
+def _send_prepare_ok(io: _PacketIO, stmt_id: int, n_params: int) -> None:
+    """COM_STMT_PREPARE_OK. Result-column count is reported as 0 — the
+    execute response carries its own authoritative column metadata, which
+    is what client libraries actually read (the reference defers planning
+    the same way, handler.rs:163 do_describe on a param-less dummy)."""
+    io.send_packet(
+        b"\x00"
+        + struct.pack("<I", stmt_id)
+        + struct.pack("<H", 0)          # columns (see docstring)
+        + struct.pack("<H", n_params)
+        + b"\x00"                        # filler
+        + struct.pack("<H", 0)          # warnings
+    )
+    if n_params:
+        for i in range(n_params):
+            io.send_packet(_coldef(f"?{i}", MYSQL_TYPE_VAR_STRING))
+        io.send_packet(_eof())
+
+
+_LENC_TYPES = frozenset({
+    MYSQL_TYPE_VAR_STRING, MYSQL_TYPE_STRING, MYSQL_TYPE_VARCHAR,
+    MYSQL_TYPE_BLOB, MYSQL_TYPE_TINY_BLOB, MYSQL_TYPE_MEDIUM_BLOB,
+    MYSQL_TYPE_LONG_BLOB, MYSQL_TYPE_DECIMAL, MYSQL_TYPE_NEWDECIMAL,
+})
+
+
+def _read_lenc(buf: bytes, pos: int) -> tuple[int, int]:
+    first = buf[pos]
+    if first < 251:
+        return first, pos + 1
+    if first == 0xFC:
+        return struct.unpack_from("<H", buf, pos + 1)[0], pos + 3
+    if first == 0xFD:
+        return int.from_bytes(buf[pos + 1:pos + 4], "little"), pos + 4
+    return struct.unpack_from("<Q", buf, pos + 1)[0], pos + 9
+
+
+def _decode_exec_params(body: bytes, n_params: int,
+                        cached_types: Optional[list] = None) -> tuple:
+    """Decode COM_STMT_EXECUTE binary parameter values (protocol binary
+    value encoding; the subset real connectors send). Returns
+    (params, types) — callers cache `types` per statement because the
+    type block is only sent when new-params-bound=1 (first execute)."""
+    if n_params == 0:
+        return [], cached_types
+    pos = 4 + 1 + 4  # stmt_id, flags, iteration_count
+    nb_len = (n_params + 7) // 8
+    null_bitmap = body[pos:pos + nb_len]
+    pos += nb_len
+    new_bound = body[pos]
+    pos += 1
+    types = []
+    if new_bound:
+        for _ in range(n_params):
+            types.append((body[pos], body[pos + 1]))
+            pos += 2
+    elif cached_types is not None:
+        types = cached_types
+    else:
+        raise ValueError(
+            "execute with new-params-bound=0 but no types cached")
+    params: list = []
+    for i in range(n_params):
+        if null_bitmap[i // 8] & (1 << (i % 8)):
+            params.append(None)
+            continue
+        ftype, flags = types[i]
+        unsigned = bool(flags & 0x80)
+        if ftype == MYSQL_TYPE_NULL:
+            params.append(None)
+        elif ftype == MYSQL_TYPE_TINY:
+            v = body[pos]
+            params.append(v if unsigned else struct.unpack("<b", body[pos:pos+1])[0])
+            pos += 1
+        elif ftype in (MYSQL_TYPE_SHORT, MYSQL_TYPE_YEAR):
+            fmt = "<H" if unsigned else "<h"
+            params.append(struct.unpack_from(fmt, body, pos)[0])
+            pos += 2
+        elif ftype in (MYSQL_TYPE_LONG, MYSQL_TYPE_INT24):
+            fmt = "<I" if unsigned else "<i"
+            params.append(struct.unpack_from(fmt, body, pos)[0])
+            pos += 4
+        elif ftype == MYSQL_TYPE_LONGLONG:
+            fmt = "<Q" if unsigned else "<q"
+            params.append(struct.unpack_from(fmt, body, pos)[0])
+            pos += 8
+        elif ftype == MYSQL_TYPE_FLOAT:
+            params.append(struct.unpack_from("<f", body, pos)[0])
+            pos += 4
+        elif ftype == MYSQL_TYPE_DOUBLE:
+            params.append(struct.unpack_from("<d", body, pos)[0])
+            pos += 8
+        elif ftype in (MYSQL_TYPE_TIMESTAMP, MYSQL_TYPE_DATETIME,
+                       MYSQL_TYPE_DATE):
+            dlen = body[pos]
+            pos += 1
+            y = mo = d = h = mi = s = us = 0
+            if dlen >= 4:
+                y, mo, d = struct.unpack_from("<HBB", body, pos)
+            if dlen >= 7:
+                h, mi, s = struct.unpack_from("<BBB", body, pos + 4)
+            if dlen >= 11:
+                us = struct.unpack_from("<I", body, pos + 7)[0]
+            pos += dlen
+            if dlen <= 4:
+                params.append(f"{y:04d}-{mo:02d}-{d:02d}")
+            else:
+                frac = f".{us:06d}" if us else ""
+                params.append(
+                    f"{y:04d}-{mo:02d}-{d:02d} {h:02d}:{mi:02d}:{s:02d}{frac}")
+        elif ftype in _LENC_TYPES:
+            ln, pos = _read_lenc(body, pos)
+            params.append(body[pos:pos + ln].decode("utf-8", "replace"))
+            pos += ln
+        else:
+            raise ValueError(f"unsupported parameter type {ftype}")
+    return params, types
+
+
+def _bind_params(sql: str, params: list) -> str:
+    """Substitute decoded values for `?` placeholders (outside string
+    literals), rendering SQL literals with proper quoting."""
+    out = []
+    it = iter(params)
+    in_str: Optional[str] = None
+    i = 0
+    while i < len(sql):
+        c = sql[i]
+        if in_str is not None:
+            out.append(c)
+            if c == in_str:
+                if i + 1 < len(sql) and sql[i + 1] == in_str:
+                    out.append(sql[i + 1])
+                    i += 1
+                else:
+                    in_str = None
+        elif c in ("'", '"'):
+            in_str = c
+            out.append(c)
+        elif c == "?":
+            try:
+                v = next(it)
+            except StopIteration:
+                raise ValueError("not enough parameters bound") from None
+            out.append(_sql_literal(v))
+        else:
+            out.append(c)
+        i += 1
+    return "".join(out)
+
+
+def _sql_literal(v) -> str:
+    if v is None:
+        return "NULL"
+    if isinstance(v, bool):
+        return "TRUE" if v else "FALSE"
+    if isinstance(v, (int, float)):
+        return repr(v)
+    # this dialect's lexer treats backslash as a literal character — the
+    # ONLY escape is the doubled single-quote (sql/lexer.py string regex)
+    s = str(v).replace("'", "''")
+    return f"'{s}'"
+
+
 def _ok(affected: int = 0) -> bytes:
     return b"\x00" + lenc_int(affected) + lenc_int(0) + struct.pack("<H", 0x0002) + struct.pack("<H", 0)
 
@@ -280,7 +533,10 @@ def _coldef(name: str, ftype: int) -> bytes:
     )
 
 
-def _send_result(io: _PacketIO, result) -> None:
+def _send_result(io: _PacketIO, result, binary: bool = False) -> None:
+    """Text resultset for COM_QUERY; binary-protocol rows for
+    COM_STMT_EXECUTE (all columns declared VAR_STRING, so binary values
+    are length-encoded strings — connectors convert from the metadata)."""
     if result is None:
         io.send_packet(_ok())
         return
@@ -293,13 +549,24 @@ def _send_result(io: _PacketIO, result) -> None:
         io.send_packet(_coldef(n, MYSQL_TYPE_VAR_STRING))
     io.send_packet(_eof())
     for row in rows:
-        payload = b""
-        for v in row:
-            if v is None or (isinstance(v, float) and np.isnan(v)):
-                payload += b"\xfb"  # NULL
-            else:
-                payload += lenc_str(_fmt(v).encode())
-        io.send_packet(payload)
+        if binary:
+            # binary row: 0x00 header + null bitmap (offset 2) + values
+            nb = bytearray((len(row) + 7 + 2) // 8)
+            payload = b""
+            for i, v in enumerate(row):
+                if v is None or (isinstance(v, float) and np.isnan(v)):
+                    nb[(i + 2) // 8] |= 1 << ((i + 2) % 8)
+                else:
+                    payload += lenc_str(_fmt(v).encode())
+            io.send_packet(b"\x00" + bytes(nb) + payload)
+        else:
+            payload = b""
+            for v in row:
+                if v is None or (isinstance(v, float) and np.isnan(v)):
+                    payload += b"\xfb"  # NULL
+                else:
+                    payload += lenc_str(_fmt(v).encode())
+            io.send_packet(payload)
     io.send_packet(_eof())
 
 

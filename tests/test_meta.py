@@ -257,6 +257,18 @@ class TestMetasrvHA:
             m2.migrate_region("1024", 1, "dn-2")
         assert ei.value.leader == "meta-a"
 
+    def test_deposed_leader_is_fenced_from_route_mutations(self):
+        """A paused ex-leader whose local flag is stale must fail the
+        authoritative lease check, not mutate routes concurrently with
+        the real leader."""
+        _, m1, m2 = self._cluster(lease_s=3)
+        m1.tick(0)
+        m2.tick(4000)  # m1's lease lapsed; m2 leads
+        assert m1.election.is_leader()  # stale local flag, by design
+        with pytest.raises(NotLeaderError) as ei:
+            m1.migrate_region("1024", 1, "dn-2", now_ms=4500)
+        assert ei.value.leader == "meta-b"
+
     def test_coordinator_failover_resumes_failover_procedure(self):
         """Leader starts a region failover, crashes mid-procedure; the
         follower takes over the lease and finishes it from the shared

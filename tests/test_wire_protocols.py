@@ -112,6 +112,100 @@ class MiniMysql:
         self._send(b"\x0e")
         return self._read_packet()[0] == 0x00
 
+    # -------------------------------------------- binary prepared stmts
+    def prepare(self, sql):
+        self.seq = 0
+        self._send(b"\x16" + sql.encode())
+        first = self._read_packet()
+        if first[0] == 0xFF:
+            code = struct.unpack("<H", first[1:3])[0]
+            raise RuntimeError(f"mysql error {code}: {first[9:].decode()}")
+        assert first[0] == 0x00
+        stmt_id = struct.unpack("<I", first[1:5])[0]
+        ncols, nparams = struct.unpack("<HH", first[5:9])
+        for _ in range(nparams):
+            self._read_packet()  # param defs
+        if nparams:
+            assert self._read_packet()[0] == 0xFE  # EOF
+        for _ in range(ncols):
+            self._read_packet()
+        if ncols:
+            assert self._read_packet()[0] == 0xFE
+        return stmt_id, nparams
+
+    def execute(self, stmt_id, params=(), send_types=True):
+        """send_types=False mimics libmysqlclient re-executes: the type
+        block is sent only on the first execute (new-params-bound=1)."""
+        self.seq = 0
+        body = b"\x17" + struct.pack("<I", stmt_id) + b"\x00" + struct.pack("<I", 1)
+        if params:
+            nb = bytearray((len(params) + 7) // 8)
+            types, values = b"", b""
+            for i, p in enumerate(params):
+                if p is None:
+                    nb[i // 8] |= 1 << (i % 8)
+                    types += bytes([6, 0])  # MYSQL_TYPE_NULL
+                elif isinstance(p, bool):
+                    types += bytes([1, 0])  # TINY
+                    values += struct.pack("<b", int(p))
+                elif isinstance(p, int):
+                    types += bytes([8, 0])  # LONGLONG
+                    values += struct.pack("<q", p)
+                elif isinstance(p, float):
+                    types += bytes([5, 0])  # DOUBLE
+                    values += struct.pack("<d", p)
+                else:
+                    types += bytes([253, 0])  # VAR_STRING
+                    raw = str(p).encode()
+                    values += bytes([len(raw)]) + raw
+            if send_types:
+                body += bytes(nb) + b"\x01" + types + values
+            else:
+                body += bytes(nb) + b"\x00" + values
+        self._send(body)
+        first = self._read_packet()
+        if first[0] == 0x00 and len(first) < 9:
+            return ("ok", first[1])
+        if first[0] == 0x00:
+            return ("ok", first[1])
+        if first[0] == 0xFF:
+            code = struct.unpack("<H", first[1:3])[0]
+            raise RuntimeError(f"mysql error {code}: {first[9:].decode()}")
+        ncols = first[0]
+        cols = []
+        for _ in range(ncols):
+            pkt = self._read_packet()
+            pos = 0
+            for _ in range(4):
+                ln = pkt[pos]; pos += 1 + ln
+            ln = pkt[pos]; pos += 1
+            cols.append(pkt[pos:pos + ln].decode())
+        assert self._read_packet()[0] == 0xFE
+        rows = []
+        while True:
+            pkt = self._read_packet()
+            if pkt[0] == 0xFE and len(pkt) < 9:
+                break
+            assert pkt[0] == 0x00, "binary row header"
+            nb_len = (ncols + 7 + 2) // 8
+            nb = pkt[1:1 + nb_len]
+            pos = 1 + nb_len
+            row = []
+            for i in range(ncols):
+                if nb[(i + 2) // 8] & (1 << ((i + 2) % 8)):
+                    row.append(None)
+                    continue
+                ln = pkt[pos]; pos += 1
+                if ln == 0xFC:
+                    ln = struct.unpack("<H", pkt[pos:pos + 2])[0]; pos += 2
+                row.append(pkt[pos:pos + ln].decode()); pos += ln
+            rows.append(row)
+        return ("rows", cols, rows)
+
+    def stmt_close(self, stmt_id):
+        self.seq = 0
+        self._send(b"\x19" + struct.pack("<I", stmt_id))  # no response
+
     def close(self):
         self.sock.close()
 
@@ -157,6 +251,114 @@ class TestMysqlProtocol:
                 c.query("SELECT nope FROM cpu")
             # connection still usable after an error
             kind, _, rows = c.query("SELECT count(*) FROM cpu")
+            assert rows == [["2"]]
+            c.close()
+        finally:
+            srv.shutdown()
+
+    def test_prepared_statement_roundtrip(self, db):
+        """COM_STMT_PREPARE/EXECUTE with typed params and binary rows
+        (reference handler.rs:153 on_prepare / on_execute)."""
+        srv = MysqlServer(db, port=0)
+        srv.start()
+        try:
+            c = MiniMysql(srv.port)
+            stmt, nparams = c.prepare("SELECT host, usage FROM cpu WHERE usage > ? ORDER BY host")
+            assert nparams == 1
+            kind, cols, rows = c.execute(stmt, (2.0,))
+            assert kind == "rows" and cols == ["host", "usage"]
+            assert rows == [["b", "2.5"]]
+            # re-execute with a different binding — the point of prepare
+            _, _, rows = c.execute(stmt, (0.5,))
+            assert [r[0] for r in rows] == ["a", "b"]
+            c.stmt_close(stmt)
+            c.close()
+        finally:
+            srv.shutdown()
+
+    def test_prepared_insert_and_string_escaping(self, db):
+        srv = MysqlServer(db, port=0)
+        srv.start()
+        try:
+            c = MiniMysql(srv.port)
+            stmt, nparams = c.prepare(
+                "INSERT INTO cpu (host, usage, ts) VALUES (?, ?, ?)")
+            assert nparams == 3
+            kind, n = c.execute(stmt, ("it's-c", 9.5, 3000))
+            assert (kind, n) == ("ok", 1)
+            # NULL param + quoted value round-trip
+            kind, n = c.execute(stmt, ("d", None, 4000))
+            assert (kind, n) == ("ok", 1)
+            _, _, rows = c.query(
+                "SELECT host, usage FROM cpu WHERE ts >= 3000 ORDER BY ts")
+            assert rows == [["it's-c", "9.5"], ["d", None]]
+            c.close()
+        finally:
+            srv.shutdown()
+
+    def test_prepared_statement_errors(self, db):
+        srv = MysqlServer(db, port=0)
+        srv.start()
+        try:
+            c = MiniMysql(srv.port)
+            # execute of an unknown stmt id
+            with pytest.raises(RuntimeError, match="mysql error 1243"):
+                c.execute(999, ())
+            # placeholders inside string literals are not parameters
+            stmt, nparams = c.prepare("SELECT host FROM cpu WHERE host = '?'")
+            assert nparams == 0
+            kind, _, rows = c.execute(stmt, ())
+            assert rows == []
+            # connection still usable
+            assert c.ping()
+            c.close()
+        finally:
+            srv.shutdown()
+
+    def test_reexecute_without_type_block_uses_cached_types(self, db):
+        """libmysqlclient omits the parameter-type block on re-executes
+        (new-params-bound=0); the server must reuse the cached types."""
+        srv = MysqlServer(db, port=0)
+        srv.start()
+        try:
+            c = MiniMysql(srv.port)
+            stmt, _ = c.prepare("SELECT host FROM cpu WHERE usage > ? ORDER BY host")
+            _, _, rows = c.execute(stmt, (2.0,))
+            assert [r[0] for r in rows] == ["b"]
+            _, _, rows = c.execute(stmt, (0.5,), send_types=False)
+            assert [r[0] for r in rows] == ["a", "b"]
+            c.close()
+        finally:
+            srv.shutdown()
+
+    def test_backslash_param_roundtrip(self, db):
+        """Backslash is a literal in this dialect — binding must not
+        double it."""
+        srv = MysqlServer(db, port=0)
+        srv.start()
+        try:
+            c = MiniMysql(srv.port)
+            stmt, _ = c.prepare("INSERT INTO cpu (host, usage, ts) VALUES (?, ?, ?)")
+            c.execute(stmt, ("C:\\tmp", 1.0, 9000))
+            stmt2, _ = c.prepare("SELECT host FROM cpu WHERE host = ?")
+            _, _, rows = c.execute(stmt2, ("C:\\tmp",))
+            assert rows == [["C:\\tmp"]]
+            c.close()
+        finally:
+            srv.shutdown()
+
+    def test_send_long_data_gets_no_response(self, db):
+        """COM_STMT_SEND_LONG_DATA must be consumed silently; an answer
+        would desync the pipelined execute that follows."""
+        srv = MysqlServer(db, port=0)
+        srv.start()
+        try:
+            c = MiniMysql(srv.port)
+            stmt, _ = c.prepare("SELECT count(*) FROM cpu WHERE host != ?")
+            # pipeline: long-data chunk then execute, reading only one reply
+            c.seq = 0
+            c._send(b"\x18" + struct.pack("<IH", stmt, 0) + b"ignored")
+            kind, _, rows = c.execute(stmt, ("zzz",))
             assert rows == [["2"]]
             c.close()
         finally:
