@@ -104,6 +104,14 @@ class FlowEngine:
                 out[info.name] = n
         return out
 
+    def flush(self, name: str, db: str = "public") -> int:
+        """Tick one flow by name NOW; returns rows upserted. The ADMIN
+        flush_flow() surface (reference common/function flush_flow)."""
+        for info in self.list_flows(db):
+            if info.name == name:
+                return self._tick_flow(info)
+        raise KeyError(f"flow {name!r} not found")
+
     def _tick_flow(self, info: FlowInfo) -> int:
         ctx = QueryContext(db=info.db)
         try:

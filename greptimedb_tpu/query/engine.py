@@ -209,6 +209,11 @@ class QueryEngine:
             return QueryResult(names, dtypes, cols)
         info = self._table(sel.table, ctx)
         sel = _subst_session_funcs(sel, ctx)
+        from greptimedb_tpu.query import range_select as rs
+
+        if rs.is_range_select(sel):
+            rplan = rs.plan_range_select(sel, info)
+            return rs.execute_range_select(self.executor, rplan)
         plan = plan_select(sel, info)
         return self.executor.execute(plan)
 
@@ -676,6 +681,14 @@ class QueryEngine:
             else:
                 self.region_engine.compact(rid)
             return QueryResult.of_affected(0)
+        if fn.name == "flush_flow":
+            # tick the named flow now (reference flow flush admin fn,
+            # common/function/src/flush_flow.rs)
+            try:
+                n = self.flow_engine.flush(str(args[0]), ctx.db)
+            except KeyError as e:
+                raise PlanError(str(e)) from None
+            return QueryResult.of_affected(n)
         raise PlanError(f"unknown admin function {fn.name!r}")
 
     # ---- TQL (PromQL embedded in SQL) --------------------------------------
@@ -717,7 +730,7 @@ def _subst_expr(e, ctx):
 def _subst_session_funcs(sel: ast.Select, ctx: QueryContext) -> ast.Select:
     import dataclasses
 
-    items = [ast.SelectItem(_subst_expr(it.expr, ctx), it.alias)
+    items = [dataclasses.replace(it, expr=_subst_expr(it.expr, ctx))
              for it in sel.items]
     return dataclasses.replace(sel, items=items)
 

@@ -109,6 +109,15 @@ def bind_expr(e: ast.Expr, ctx: BindContext) -> ast.Expr:
             lo = ast.Literal(coerce_ts_literal(_lit(e.low), ctx.column_dtype(col.name)))
             hi = ast.Literal(coerce_ts_literal(_lit(e.high), ctx.column_dtype(col.name)))
             return ast.Between(col, lo, hi, e.negated)
+        if isinstance(col, ast.Column) and col.name in ctx.tag_names and \
+                isinstance(e.low, ast.Literal) and isinstance(e.high, ast.Literal):
+            # string BETWEEN on a tag: evaluate against the dictionary on
+            # host (same trick as ordered comparisons above) so the device
+            # only ever sees int32 codes
+            lo, hi = str(e.low.value), str(e.high.value)
+            codes = ctx.codes_matching(col.name, lambda v: lo <= str(v) <= hi)
+            inl = ast.InList(col, tuple(ast.Literal(c) for c in codes))
+            return ast.UnaryOp("not", inl) if e.negated else inl
         return ast.Between(bind_expr(e.expr, ctx), bind_expr(e.low, ctx),
                            bind_expr(e.high, ctx), e.negated)
     if isinstance(e, ast.InList):
@@ -495,6 +504,21 @@ def eval_host(
             dtype = parse_sql_type(t)
             arr = np.atleast_1d(v)
             return np.asarray([coerce_ts_literal(x, dtype) for x in arr], dtype=np.int64)
+        if t in ("boolean", "bool"):
+            arr = np.atleast_1d(v)
+            if arr.dtype.kind in ("U", "O", "S"):
+                def _b(x):
+                    if x is None:
+                        return None
+                    s = str(x).strip().lower()
+                    if s in ("true", "t", "1", "yes"):
+                        return True
+                    if s in ("false", "f", "0", "no"):
+                        return False
+                    raise PlanError(f"invalid boolean literal {x!r}")
+                out = np.asarray([_b(x) for x in arr], dtype=object)
+                return out if np.ndim(v) else out[0]
+            return arr.astype(bool) if np.ndim(v) else bool(arr[0])
         raise PlanError(f"unsupported cast to {e.type_name!r}")
     if isinstance(e, ast.Case):
         whens = e.whens
@@ -587,6 +611,14 @@ def _eval_host_func(e: ast.FuncCall, ev, schema):
     if name in ("date_bin", "time_bucket"):
         interval, ts_expr = e.args[0], e.args[1]
         step = _interval_in_col_unit(interval, ts_expr, schema) if schema else _lit_interval(interval)
+        ts = np.asarray(ev(ts_expr))
+        return ts // step * step
+    if name == "date_trunc":
+        unit_lit, ts_expr = e.args[0], e.args[1]
+        nanos = _TRUNC_UNITS.get(str(_lit(unit_lit)).lower())
+        if nanos is None:
+            raise PlanError(f"date_trunc unit {_lit(unit_lit)!r} unsupported")
+        step = _scale_to_col_unit(nanos, ts_expr, schema) if schema else nanos
         ts = np.asarray(ev(ts_expr))
         return ts // step * step
     if name == "now":

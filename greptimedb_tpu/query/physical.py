@@ -1104,12 +1104,10 @@ class PhysicalExecutor:
 
     # ---- raw (non-aggregate) path ------------------------------------------
 
-    def _execute_raw(self, scan, table, where, project, sort, limit, offset) -> QueryResult:
+    def _filtered_row_indices(self, scan, table, ctx, bound_where) -> np.ndarray:
+        """Row indices surviving WHERE + LWW dedup, computed blockwise on
+        device (shared by the raw scan and RANGE-select paths)."""
         schema = table.schema
-        if scan is None:
-            return _project_empty(project, schema)
-        ctx = BindContext(schema, scan.tag_dicts)
-        bound_where = bind_expr(where, ctx) if where is not None else None
         dedup_mask = self._maybe_dedup(scan, table, ctx)
         n = scan.num_rows
         block = min(block_size_for(n), DEFAULT_BLOCK_ROWS)
@@ -1128,7 +1126,15 @@ class PhysicalExecutor:
                                  where=bound_where,
                                  tag_names=tag_names, schema=schema)
             picked.append(np.flatnonzero(np.asarray(mask)) + start)
-        idx = np.concatenate(picked) if picked else np.empty(0, dtype=np.int64)
+        return np.concatenate(picked) if picked else np.empty(0, dtype=np.int64)
+
+    def _execute_raw(self, scan, table, where, project, sort, limit, offset) -> QueryResult:
+        schema = table.schema
+        if scan is None:
+            return _project_empty(project, schema)
+        ctx = BindContext(schema, scan.tag_dicts)
+        bound_where = bind_expr(where, ctx) if where is not None else None
+        idx = self._filtered_row_indices(scan, table, ctx, bound_where)
 
         # gather + decode on host
         host_cols: dict[str, np.ndarray] = {}

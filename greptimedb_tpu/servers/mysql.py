@@ -318,20 +318,27 @@ _SESSION_VARS = {
 
 
 def _count_params(sql: str) -> int:
-    """Count `?` placeholders outside string literals."""
+    """Count `?` placeholders outside string literals, backtick-quoted
+    identifiers, and `--` comments."""
     n = 0
     in_str: Optional[str] = None
+    in_comment = False
     i = 0
     while i < len(sql):
         c = sql[i]
-        if in_str is not None:
+        if in_comment:
+            if c == "\n":
+                in_comment = False
+        elif in_str is not None:
             if c == in_str:
                 # '' escape inside a string stays inside it
                 if i + 1 < len(sql) and sql[i + 1] == in_str:
                     i += 1
                 else:
                     in_str = None
-        elif c in ("'", '"'):
+        elif c == "-" and sql[i:i + 2] == "--":
+            in_comment = True
+        elif c in ("'", '"', "`"):
             in_str = c
         elif c == "?":
             n += 1
@@ -460,14 +467,20 @@ def _decode_exec_params(body: bytes, n_params: int,
 
 def _bind_params(sql: str, params: list) -> str:
     """Substitute decoded values for `?` placeholders (outside string
-    literals), rendering SQL literals with proper quoting."""
+    literals, backticked identifiers, and `--` comments), rendering SQL
+    literals with proper quoting."""
     out = []
     it = iter(params)
     in_str: Optional[str] = None
+    in_comment = False
     i = 0
     while i < len(sql):
         c = sql[i]
-        if in_str is not None:
+        if in_comment:
+            out.append(c)
+            if c == "\n":
+                in_comment = False
+        elif in_str is not None:
             out.append(c)
             if c == in_str:
                 if i + 1 < len(sql) and sql[i + 1] == in_str:
@@ -475,7 +488,10 @@ def _bind_params(sql: str, params: list) -> str:
                     i += 1
                 else:
                     in_str = None
-        elif c in ("'", '"'):
+        elif c == "-" and sql[i:i + 2] == "--":
+            in_comment = True
+            out.append(c)
+        elif c in ("'", '"', "`"):
             in_str = c
             out.append(c)
         elif c == "?":

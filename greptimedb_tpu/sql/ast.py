@@ -102,6 +102,10 @@ class Statement:
 class SelectItem:
     expr: Expr
     alias: Optional[str] = None
+    # RANGE-select extension (reference range_select): per-item window
+    # width and fill policy — `avg(v) RANGE '10s' FILL PREV`
+    range_interval: Optional["Interval"] = None
+    fill: Optional[object] = None  # 'null' | 'prev' | 'linear' | number
 
 
 @dataclass

@@ -215,9 +215,15 @@ class Parser:
                 sel.align_to = self.parse_expr()
             if self.eat_kw("by"):
                 self.expect_op("(")
-                sel.align_by = [self.parse_expr()]
-                while self.eat_op(","):
-                    sel.align_by.append(self.parse_expr())
+                if self.at_op(")"):
+                    # BY () — aggregate across all series (range_select
+                    # by-empty form); marked with a sentinel literal so
+                    # the planner can tell it from "BY clause absent"
+                    sel.align_by = [ast.Literal(1)]
+                else:
+                    sel.align_by = [self.parse_expr()]
+                    while self.eat_op(","):
+                        sel.align_by.append(self.parse_expr())
                 self.expect_op(")")
             if self.eat_kw("fill"):
                 sel.range_fill = self.ident()
@@ -244,12 +250,30 @@ class Parser:
             self.next()
             return ast.SelectItem(ast.Star())
         expr = self.parse_expr()
+        rng = None
+        fill = None
+        # RANGE '10s' [FILL NULL|PREV|LINEAR|<number>] postfix binds the
+        # window to the item's aggregates (reference range_select grammar)
+        if self.eat_kw("range"):
+            rng = self.parse_interval_literal()
+        if self.eat_kw("fill"):
+            fill = self.parse_fill_policy()
         alias = None
         if self.eat_kw("as"):
             alias = self.ident()
         elif self.peek().kind == "ident":
             alias = self.ident()
-        return ast.SelectItem(expr, alias)
+        return ast.SelectItem(expr, alias, range_interval=rng, fill=fill)
+
+    def parse_fill_policy(self):
+        t = self.peek()
+        if t.kind == "number":
+            self.next()
+            return float(t.value)
+        word = self.ident().lower()
+        if word not in ("null", "prev", "linear"):
+            raise SqlError(f"bad FILL policy {word!r}")
+        return word
 
     def parse_order_item(self) -> ast.OrderByItem:
         expr = self.parse_expr()

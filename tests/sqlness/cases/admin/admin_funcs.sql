@@ -1,0 +1,16 @@
+-- ADMIN maintenance functions: flush + compact survive re-query
+CREATE TABLE m (host STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(host));
+
+INSERT INTO m VALUES ('a', 1.0, 1000), ('b', 2.0, 2000);
+
+ADMIN flush_table('m');
+
+INSERT INTO m VALUES ('c', 3.0, 3000);
+
+ADMIN flush_table('m');
+
+ADMIN compact_table('m');
+
+SELECT host, v FROM m ORDER BY host;
+
+SELECT count(*) FROM m;

@@ -1,0 +1,14 @@
+-- Aggregates without GROUP BY
+CREATE TABLE m (host STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(host));
+
+INSERT INTO m VALUES ('a', 1.0, 1000), ('b', 2.0, 2000), ('c', 3.0, 3000), ('d', 4.0, 4000);
+
+SELECT count(*), sum(v), avg(v), min(v), max(v) FROM m;
+
+SELECT stddev(v), variance(v) FROM m;
+
+SELECT sum(v) FROM m WHERE v > 2.0;
+
+SELECT count(*) FROM m WHERE v > 100.0;
+
+SELECT median(v), percentile(v, 50) FROM m;

@@ -1,0 +1,17 @@
+-- GROUP BY: multi-key, HAVING, group by expression and position
+CREATE TABLE m (host STRING, idc STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(host, idc));
+
+INSERT INTO m VALUES
+    ('a', 'east', 1.0, 1000), ('a', 'west', 2.0, 2000),
+    ('b', 'east', 3.0, 3000), ('b', 'west', 4.0, 4000),
+    ('a', 'east', 5.0, 5000);
+
+SELECT host, sum(v) FROM m GROUP BY host ORDER BY host;
+
+SELECT host, idc, avg(v) FROM m GROUP BY host, idc ORDER BY host, idc;
+
+SELECT idc, count(*) AS n FROM m GROUP BY idc HAVING n > 2 ORDER BY idc;
+
+SELECT host, max(v) - min(v) AS spread FROM m GROUP BY host ORDER BY host;
+
+SELECT date_bin('2 seconds', ts) AS bucket, sum(v) FROM m GROUP BY bucket ORDER BY bucket;

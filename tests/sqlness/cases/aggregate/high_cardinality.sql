@@ -1,0 +1,15 @@
+-- Group-by where the dense key product is large but observed groups few
+-- (exercises the sparse sort-compact path)
+CREATE TABLE wide (t1 STRING, t2 STRING, t3 STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(t1, t2, t3));
+
+INSERT INTO wide VALUES
+    ('a1', 'b1', 'c1', 1.0, 1000),
+    ('a2', 'b2', 'c2', 2.0, 2000),
+    ('a3', 'b3', 'c3', 3.0, 3000),
+    ('a1', 'b1', 'c1', 4.0, 4000);
+
+SELECT t1, t2, t3, sum(v) FROM wide GROUP BY t1, t2, t3 ORDER BY t1;
+
+SELECT count(*) FROM wide;
+
+SELECT t1, count(*) FROM wide GROUP BY t1 ORDER BY t1;

@@ -1,0 +1,16 @@
+-- Order-statistic aggregates: median, percentile, argmax/argmin
+CREATE TABLE m (host STRING, v DOUBLE, w DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(host));
+
+INSERT INTO m VALUES
+    ('a', 1.0, 10.0, 1000), ('a', 2.0, 20.0, 2000), ('a', 3.0, 5.0, 3000),
+    ('b', 10.0, 1.0, 1000), ('b', 30.0, 2.0, 2000);
+
+SELECT median(v) FROM m;
+
+SELECT host, median(v) FROM m GROUP BY host ORDER BY host;
+
+SELECT percentile(v, 90) FROM m;
+
+SELECT host, argmax(w, v) FROM m GROUP BY host ORDER BY host;
+
+SELECT argmin(w, v) FROM m;

@@ -1,0 +1,12 @@
+-- COPY TO / FROM round-trip through server-side files
+CREATE TABLE src (host STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(host));
+
+INSERT INTO src VALUES ('a', 1.5, 1000), ('b', 2.5, 2000);
+
+COPY src TO '/tmp/sqlness_copy_src.parquet';
+
+CREATE TABLE dst (host STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(host));
+
+COPY dst FROM '/tmp/sqlness_copy_src.parquet';
+
+SELECT host, v FROM dst ORDER BY host;

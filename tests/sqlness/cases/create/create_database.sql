@@ -1,0 +1,14 @@
+-- Databases: create, show, duplicate error, use via qualified names
+CREATE DATABASE metrics;
+
+CREATE DATABASE metrics;
+
+SHOW DATABASES;
+
+CREATE TABLE metrics.cpu (host STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(host));
+
+INSERT INTO metrics.cpu VALUES ('a', 1.0, 1000);
+
+SELECT * FROM metrics.cpu;
+
+DROP TABLE metrics.cpu;

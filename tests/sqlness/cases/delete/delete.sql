@@ -1,0 +1,17 @@
+-- DELETE rows; deletes tombstone under LWW semantics
+CREATE TABLE m (host STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(host));
+
+INSERT INTO m VALUES ('a', 1.0, 1000), ('b', 2.0, 2000), ('c', 3.0, 3000);
+
+DELETE FROM m WHERE host = 'b';
+
+SELECT host FROM m ORDER BY host;
+
+DELETE FROM m WHERE v > 2.5;
+
+SELECT host FROM m ORDER BY host;
+
+-- re-insert after delete resurrects the key with the new value
+INSERT INTO m VALUES ('b', 20.0, 2000);
+
+SELECT host, v FROM m ORDER BY host;

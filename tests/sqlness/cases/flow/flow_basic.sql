@@ -1,0 +1,24 @@
+-- Continuous aggregation flows: the sink table is derived from the
+-- flow query's column names on first tick
+CREATE TABLE events (host STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(host));
+
+CREATE FLOW rollup SINK TO sink AS SELECT host, sum(v) AS total, date_bin('1 second', ts) AS bucket FROM events GROUP BY host, bucket;
+
+SHOW FLOWS;
+
+INSERT INTO events VALUES ('a', 1.0, 100), ('a', 2.0, 200), ('b', 5.0, 100);
+
+ADMIN flush_flow('rollup');
+
+SELECT host, total FROM sink ORDER BY host;
+
+-- late data dirties the bucket; next flush recomputes it
+INSERT INTO events VALUES ('a', 10.0, 300);
+
+ADMIN flush_flow('rollup');
+
+SELECT host, total FROM sink ORDER BY host;
+
+DROP FLOW rollup;
+
+SHOW FLOWS;

@@ -1,0 +1,15 @@
+-- Date/time scalar functions over a time-series table
+CREATE TABLE e (k STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(k));
+
+INSERT INTO e VALUES
+    ('x', 1.0, 1667446797450),
+    ('x', 2.0, 1667450397450),
+    ('y', 3.0, 1667446797450);
+
+SELECT k, date_bin('1 hour', ts) AS hour_bucket, sum(v) FROM e GROUP BY k, hour_bucket ORDER BY k, hour_bucket;
+
+SELECT k, date_trunc('hour', ts) AS h, count(*) FROM e GROUP BY k, h ORDER BY k, h;
+
+SELECT k, to_unixtime(ts) AS unix_s FROM e WHERE k = 'y' ORDER BY unix_s;
+
+SELECT time_bucket('30 minutes', ts) AS b, avg(v) FROM e GROUP BY b ORDER BY b;

@@ -1,0 +1,12 @@
+-- Math scalar functions
+SELECT abs(-3.5), ceil(1.2), floor(1.8), round(2.5);
+
+SELECT sqrt(16.0), pow(2, 10), mod(10, 3);
+
+SELECT exp(0.0), ln(1.0), log10(100.0), log2(8.0);
+
+SELECT sin(0.0), cos(0.0), atan2(0.0, 1.0);
+
+SELECT greatest(1, 5, 3), least(1, 5, 3), clamp(10, 0, 5);
+
+SELECT signum(-2.5), trunc(3.9), degrees(0.0), radians(0.0);

@@ -1,0 +1,8 @@
+-- System/session functions
+SELECT database();
+
+SELECT current_schema();
+
+SELECT version();
+
+SELECT timezone();

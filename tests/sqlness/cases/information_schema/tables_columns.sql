@@ -1,0 +1,10 @@
+-- information_schema virtual tables
+CREATE TABLE cpu (host STRING, usage DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(host));
+
+SELECT table_name, table_type FROM information_schema.tables WHERE table_schema = 'public' ORDER BY table_name;
+
+SELECT column_name, data_type, semantic_type FROM information_schema.columns WHERE table_name = 'cpu' ORDER BY column_name;
+
+SELECT schema_name FROM information_schema.schemata ORDER BY schema_name;
+
+SELECT engine, support FROM information_schema.engines ORDER BY engine;

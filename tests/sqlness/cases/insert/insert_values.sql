@@ -1,0 +1,12 @@
+-- INSERT forms: multi-row, explicit column list, NULL values
+CREATE TABLE cpu (host STRING, usage DOUBLE, idle DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(host));
+
+INSERT INTO cpu VALUES ('a', 10.5, 89.5, 1000), ('b', 20.0, 80.0, 2000);
+
+INSERT INTO cpu (host, usage, ts) VALUES ('c', 30.0, 3000);
+
+INSERT INTO cpu (host, usage, idle, ts) VALUES ('d', NULL, NULL, 4000);
+
+SELECT host, usage, idle FROM cpu ORDER BY host;
+
+SELECT count(*), count(usage), count(idle) FROM cpu;

@@ -1,0 +1,19 @@
+-- Last-write-wins upsert on (primary key, timestamp)
+CREATE TABLE m (host STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(host));
+
+INSERT INTO m VALUES ('a', 1.0, 1000);
+
+INSERT INTO m VALUES ('a', 99.0, 1000);
+
+SELECT host, v FROM m;
+
+INSERT INTO m VALUES ('a', 2.0, 2000);
+
+SELECT host, v, ts FROM m ORDER BY ts;
+
+-- flush between writes must not change LWW resolution
+ADMIN flush_table('m');
+
+INSERT INTO m VALUES ('a', 123.0, 1000);
+
+SELECT host, v FROM m WHERE ts = 1000;

@@ -1,0 +1,18 @@
+-- Error surfaces: each statement's error text is part of the contract
+SELECT nocol FROM nosuchtable;
+
+SELEKT 1;
+
+CREATE TABLE bad (v DOUBLE);
+
+CREATE TABLE t (k STRING, ts TIMESTAMP TIME INDEX, PRIMARY KEY(k));
+
+SELECT unknown_col FROM t;
+
+SELECT k, avg(ts) FROM t;
+
+INSERT INTO t VALUES ('only-one-value');
+
+SELECT percentile(ts) FROM t;
+
+DROP TABLE t;

@@ -1,0 +1,12 @@
+-- RANGE ... ALIGN queries (the reference's range_select)
+CREATE TABLE sensor (host STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(host));
+
+INSERT INTO sensor VALUES
+    ('a', 1.0, 0), ('a', 2.0, 5000), ('a', 3.0, 10000), ('a', 4.0, 15000),
+    ('b', 10.0, 0), ('b', 20.0, 5000), ('b', 30.0, 10000);
+
+SELECT ts, host, avg(v) RANGE '10s' FROM sensor ALIGN '10s' ORDER BY host, ts;
+
+SELECT ts, host, max(v) RANGE '10s' FROM sensor ALIGN '5s' ORDER BY host, ts;
+
+SELECT ts, host, sum(v) RANGE '5s' FROM sensor ALIGN '5s' BY (host) ORDER BY host, ts;

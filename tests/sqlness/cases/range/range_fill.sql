@@ -1,0 +1,14 @@
+-- RANGE with FILL policies over a sparse series
+CREATE TABLE s (host STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(host));
+
+INSERT INTO s VALUES ('a', 1.0, 0), ('a', 5.0, 20000), ('b', 7.0, 10000);
+
+SELECT ts, host, avg(v) RANGE '5s' FROM s ALIGN '5s' ORDER BY host, ts;
+
+SELECT ts, host, avg(v) RANGE '5s' FILL NULL FROM s ALIGN '5s' ORDER BY host, ts;
+
+SELECT ts, host, avg(v) RANGE '5s' FILL PREV FROM s ALIGN '5s' ORDER BY host, ts;
+
+SELECT ts, host, avg(v) RANGE '5s' FILL LINEAR FROM s WHERE host = 'a' ALIGN '5s' ORDER BY ts;
+
+SELECT ts, host, avg(v) RANGE '5s' FILL 0 FROM s ALIGN '5s' ORDER BY host, ts;

@@ -1,0 +1,13 @@
+-- ALIGN TO origin shifting and BY () (no keys)
+CREATE TABLE s (host STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(host));
+
+INSERT INTO s VALUES
+    ('a', 1.0, 1000), ('a', 2.0, 6000), ('b', 3.0, 11000), ('b', 4.0, 16000);
+
+SELECT ts, host, sum(v) RANGE '10s' FROM s ALIGN '10s' ORDER BY host, ts;
+
+SELECT ts, host, sum(v) RANGE '10s' FROM s ALIGN '10s' TO 1000 ORDER BY host, ts;
+
+SELECT ts, sum(v) RANGE '10s' FROM s ALIGN '10s' BY () ORDER BY ts;
+
+SELECT ts, count(v) RANGE '20s' FROM s ALIGN '10s' BY () ORDER BY ts;

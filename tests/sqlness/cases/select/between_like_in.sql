@@ -1,0 +1,16 @@
+-- String predicates: LIKE patterns, IN lists, BETWEEN on strings
+CREATE TABLE m (host STRING, dc STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(host, dc));
+
+INSERT INTO m VALUES
+    ('web-01', 'east', 1.0, 1000), ('web-02', 'west', 2.0, 2000),
+    ('db-01', 'east', 3.0, 3000), ('cache-01', 'west', 4.0, 4000);
+
+SELECT host FROM m WHERE host LIKE 'web-%' ORDER BY host;
+
+SELECT host FROM m WHERE host LIKE '%-01' ORDER BY host;
+
+SELECT host FROM m WHERE dc IN ('east') ORDER BY host;
+
+SELECT host FROM m WHERE host BETWEEN 'a' AND 'e' ORDER BY host;
+
+SELECT host FROM m WHERE host NOT LIKE 'web-%' ORDER BY host;

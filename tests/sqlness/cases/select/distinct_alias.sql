@@ -1,0 +1,12 @@
+-- DISTINCT, aliases, arithmetic in the projection
+CREATE TABLE m (host STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(host));
+
+INSERT INTO m VALUES ('a', 1.0, 1000), ('a', 1.0, 2000), ('b', 2.0, 3000);
+
+SELECT DISTINCT host FROM m ORDER BY host;
+
+SELECT host AS h, v * 2 AS doubled, v + 1 AS plus_one FROM m ORDER BY h, doubled;
+
+SELECT 1 + 2;
+
+SELECT 'hello' AS greeting;

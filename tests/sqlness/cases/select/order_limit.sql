@@ -1,0 +1,12 @@
+-- ORDER BY asc/desc, multi-key, LIMIT and OFFSET
+CREATE TABLE m (host STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(host));
+
+INSERT INTO m VALUES ('a', 3.0, 1000), ('b', 1.0, 2000), ('c', 2.0, 3000), ('d', 1.0, 4000);
+
+SELECT host, v FROM m ORDER BY v, host;
+
+SELECT host, v FROM m ORDER BY v DESC, host DESC;
+
+SELECT host FROM m ORDER BY host LIMIT 2;
+
+SELECT host FROM m ORDER BY host LIMIT 2 OFFSET 1;

@@ -1,0 +1,14 @@
+-- Scalar expressions over aggregate results and columns
+CREATE TABLE m (host STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(host));
+
+INSERT INTO m VALUES ('a', 4.0, 1000), ('b', 9.0, 2000), ('c', 16.0, 3000);
+
+SELECT host, sqrt(v) AS root, v * v AS squared FROM m ORDER BY host;
+
+SELECT max(v) - min(v) AS spread FROM m;
+
+SELECT avg(v) * 2 AS doubled_avg, round(avg(v), 1) AS rounded FROM m;
+
+SELECT host, CASE WHEN v > 8.0 THEN 'big' ELSE 'small' END AS size FROM m ORDER BY host;
+
+SELECT sum(v) + count(*) FROM m;

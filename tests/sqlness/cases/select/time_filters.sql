@@ -1,0 +1,18 @@
+-- Time-index predicates: range pruning must not change results
+CREATE TABLE m (host STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(host));
+
+INSERT INTO m VALUES
+    ('a', 1.0, 1000), ('a', 2.0, 2000), ('a', 3.0, 3000),
+    ('a', 4.0, 4000), ('a', 5.0, 5000);
+
+SELECT v FROM m WHERE ts > 2000 ORDER BY v;
+
+SELECT v FROM m WHERE ts >= 2000 AND ts < 4000 ORDER BY v;
+
+SELECT v FROM m WHERE ts = 3000;
+
+SELECT sum(v) FROM m WHERE ts BETWEEN 2000 AND 4000;
+
+ADMIN flush_table('m');
+
+SELECT v FROM m WHERE ts >= 2000 AND ts < 4000 ORDER BY v;

@@ -1,0 +1,18 @@
+-- WHERE: comparisons, boolean operators, IN, BETWEEN, tag and time filters
+CREATE TABLE m (host STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(host));
+
+INSERT INTO m VALUES ('a', 1.0, 1000), ('b', 2.0, 2000), ('c', 3.0, 3000), ('d', 4.0, 4000);
+
+SELECT host, v FROM m WHERE v > 2.0 ORDER BY host;
+
+SELECT host FROM m WHERE v >= 2.0 AND v < 4.0 ORDER BY host;
+
+SELECT host FROM m WHERE host = 'a' OR host = 'd' ORDER BY host;
+
+SELECT host FROM m WHERE host IN ('a', 'c') ORDER BY host;
+
+SELECT host FROM m WHERE v BETWEEN 2.0 AND 3.0 ORDER BY host;
+
+SELECT host FROM m WHERE ts >= 2000 AND ts <= 3000 ORDER BY host;
+
+SELECT host FROM m WHERE host != 'b' AND NOT (v > 3.0) ORDER BY host;

@@ -1,0 +1,17 @@
+-- TQL EVAL: PromQL embedded in SQL
+CREATE TABLE http_requests (job STRING, instance STRING, val DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(job, instance));
+
+INSERT INTO http_requests VALUES
+    ('api', 'i1', 10, 0), ('api', 'i1', 20, 10000), ('api', 'i1', 30, 20000),
+    ('api', 'i2', 5, 0), ('api', 'i2', 15, 10000), ('api', 'i2', 25, 20000),
+    ('web', 'i3', 100, 0), ('web', 'i3', 110, 10000), ('web', 'i3', 120, 20000);
+
+TQL EVAL (0, 20, '10s') http_requests;
+
+TQL EVAL (20, 20, '10s') sum(http_requests);
+
+TQL EVAL (20, 20, '10s') sum by (job) (http_requests);
+
+TQL EVAL (20, 20, '10s') rate(http_requests[20s]);
+
+TQL EVAL (20, 20, '10s') topk(1, http_requests);

@@ -1,0 +1,24 @@
+-- TQL: PromQL function coverage through the SQL gateway
+CREATE TABLE latency (job STRING, le STRING, val DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(job, le));
+
+INSERT INTO latency VALUES
+    ('api', '0.1', 10, 10000), ('api', '0.5', 30, 10000),
+    ('api', '1', 40, 10000), ('api', '+Inf', 50, 10000);
+
+TQL EVAL (10, 10, '10s') histogram_quantile(0.9, latency);
+
+CREATE TABLE g (job STRING, val DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(job));
+
+INSERT INTO g VALUES ('a', 1, 0), ('a', 4, 10000), ('a', 9, 20000), ('b', 2, 0), ('b', 2, 10000), ('b', 2, 20000);
+
+TQL EVAL (20, 20, '10s') sqrt(g);
+
+TQL EVAL (20, 20, '10s') clamp_max(g, 4);
+
+TQL EVAL (20, 20, '10s') delta(g[20s]);
+
+TQL EVAL (20, 20, '10s') avg_over_time(g[20s]);
+
+TQL EVAL (20, 20, '10s') sort_desc(g);
+
+TQL EVAL (20, 20, '10s') absent(nonexistent_metric);

@@ -1,0 +1,16 @@
+-- NULL semantics in filters, aggregates, and sorting
+CREATE TABLE m (host STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(host));
+
+INSERT INTO m (host, v, ts) VALUES ('a', 1.0, 1000), ('b', NULL, 2000), ('c', 3.0, 3000);
+
+SELECT host, v FROM m ORDER BY host;
+
+SELECT count(*), count(v) FROM m;
+
+SELECT sum(v), avg(v), min(v), max(v) FROM m;
+
+SELECT host FROM m WHERE v IS NULL;
+
+SELECT host FROM m WHERE v IS NOT NULL ORDER BY host;
+
+SELECT coalesce(v, -1.0) AS v2, host FROM m ORDER BY host;

@@ -1,0 +1,144 @@
+"""sqlness: golden-file SQL conformance harness.
+
+Mirrors the reference's sqlness runner (tests/runner/src/main.rs +
+tests/cases/standalone/): each `cases/**/*.sql` file is a sequence of SQL
+statements; the runner replays them through the REAL HTTP server
+(`/v1/sql`, the same path a user hits) and renders every result as an
+ASCII table / "Affected Rows: N" / "Error: ..." block. The rendered
+transcript is compared byte-for-byte against the sibling `.result` file.
+
+Regenerate goldens after an intentional behavior change with:
+    SQLNESS_REGEN=1 python -m pytest tests/test_sqlness.py
+then review the `.result` diff like any other code change.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.parse
+import urllib.request
+
+
+def split_statements(text: str) -> list[str]:
+    """Split a .sql file into statements on top-level ';', respecting
+    quotes and `--` comments. Comment-only fragments are dropped;
+    comments attached to a statement are preserved (they document the
+    case in the transcript)."""
+    stmts = []
+    buf: list[str] = []
+    in_str: str | None = None
+    in_comment = False
+    i = 0
+    while i < len(text):
+        c = text[i]
+        if in_comment:
+            buf.append(c)
+            if c == "\n":
+                in_comment = False
+        elif in_str is not None:
+            buf.append(c)
+            if c == in_str:
+                if i + 1 < len(text) and text[i + 1] == in_str:
+                    buf.append(text[i + 1])
+                    i += 1
+                else:
+                    in_str = None
+        elif c == "-" and text[i:i + 2] == "--":
+            in_comment = True
+            buf.append(c)
+        elif c in ("'", '"'):
+            in_str = c
+            buf.append(c)
+        elif c == ";":
+            stmt = "".join(buf).strip()
+            if _has_sql(stmt):
+                stmts.append(stmt)
+            buf = []
+        else:
+            buf.append(c)
+        i += 1
+    tail = "".join(buf).strip()
+    if _has_sql(tail):
+        stmts.append(tail)
+    return stmts
+
+
+def _has_sql(stmt: str) -> bool:
+    return any(
+        line.strip() and not line.strip().startswith("--")
+        for line in stmt.splitlines()
+    )
+
+
+def _fmt_cell(v) -> str:
+    if v is None:
+        return ""
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, float):
+        if v != v:  # NaN renders like NULL, matching engine semantics
+            return ""
+        return repr(v)
+    return str(v)
+
+
+def render_table(names: list[str], rows: list[list]) -> str:
+    cells = [[_fmt_cell(v) for v in row] for row in rows]
+    widths = [
+        max(len(n), *(len(r[i]) for r in cells)) if cells else len(n)
+        for i, n in enumerate(names)
+    ]
+    sep = "+" + "+".join("-" * (w + 2) for w in widths) + "+"
+    out = [sep]
+    out.append("| " + " | ".join(n.ljust(w) for n, w in zip(names, widths)) + " |")
+    out.append(sep)
+    for r in cells:
+        out.append("| " + " | ".join(c.ljust(w) for c, w in zip(r, widths)) + " |")
+    out.append(sep)
+    return "\n".join(out)
+
+
+class HttpSqlClient:
+    """Drives the real HTTP server's /v1/sql endpoint."""
+
+    def __init__(self, port: int, db: str = "public"):
+        self.port = port
+        self.db = db
+
+    def run(self, sql: str) -> str:
+        """Execute one statement; return its rendered transcript block."""
+        data = urllib.parse.urlencode({"sql": sql, "db": self.db}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{self.port}/v1/sql", data=data, method="POST"
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                payload = json.loads(resp.read().decode())
+        except urllib.error.HTTPError as e:
+            try:
+                payload = json.loads(e.read().decode())
+            except Exception:  # noqa: BLE001
+                return f"Error: HTTP {e.code}"
+            msg = payload.get("error", f"HTTP {e.code}")
+            return f"Error: {msg}"
+        outputs = payload.get("output", [])
+        blocks = []
+        for out in outputs:
+            if "records" in out:
+                rec = out["records"]
+                names = [c["name"] for c in rec["schema"]["column_schemas"]]
+                blocks.append(render_table(names, rec["rows"]))
+            else:
+                blocks.append(f"Affected Rows: {out.get('affectedrows', 0)}")
+        return "\n\n".join(blocks) if blocks else "Affected Rows: 0"
+
+
+def run_case(sql_text: str, client: HttpSqlClient) -> str:
+    """Replay a case file; return the full rendered transcript."""
+    parts = []
+    for stmt in split_statements(sql_text):
+        parts.append(stmt + ";")
+        parts.append("")
+        parts.append(client.run(stmt))
+        parts.append("")
+    return "\n".join(parts).rstrip() + "\n"

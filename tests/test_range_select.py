@@ -1,0 +1,119 @@
+"""RANGE ... ALIGN conformance (reference src/query/src/range_select/
+plan.rs semantics: window [T, T+range), step ALIGN, BY-keyed series,
+leading partial windows when range > align)."""
+
+import numpy as np
+import pytest
+
+from greptimedb_tpu.catalog.catalog import Catalog
+from greptimedb_tpu.catalog.kv import MemoryKv
+from greptimedb_tpu.query.engine import QueryEngine
+from greptimedb_tpu.query.expr import PlanError
+from greptimedb_tpu.storage.engine import EngineConfig, RegionEngine
+
+
+@pytest.fixture
+def qe(tmp_path):
+    engine = RegionEngine(EngineConfig(data_dir=str(tmp_path)))
+    q = QueryEngine(Catalog(MemoryKv()), engine)
+    q.execute_one(
+        "CREATE TABLE s (host STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, "
+        "PRIMARY KEY(host))"
+    )
+    q.execute_one(
+        "INSERT INTO s VALUES "
+        "('a', 1.0, 0), ('a', 2.0, 5000), ('a', 3.0, 10000), "
+        "('b', 10.0, 0), ('b', 20.0, 5000)"
+    )
+    yield q
+    engine.close()
+
+
+class TestRangeSemantics:
+    def test_range_equals_align(self, qe):
+        r = qe.execute_one(
+            "SELECT ts, host, avg(v) RANGE '10s' FROM s ALIGN '10s' "
+            "ORDER BY host, ts")
+        assert r.rows() == [
+            [0, "a", 1.5], [10000, "a", 3.0], [0, "b", 15.0]]
+
+    def test_overlapping_windows_include_leading_partials(self, qe):
+        """range > align: windows starting before the first row still
+        cover it (plan.rs:1064 walks align_ts backwards)."""
+        r = qe.execute_one(
+            "SELECT ts, host, sum(v) RANGE '10s' FROM s "
+            "WHERE host = 'a' ALIGN '5s' ORDER BY ts")
+        # window [-5000, 5000) covers the row at ts=0
+        assert r.rows() == [
+            [-5000, "a", 1.0], [0, "a", 3.0], [5000, "a", 5.0],
+            [10000, "a", 3.0]]
+
+    def test_same_aggregate_two_ranges(self, qe):
+        """The same avg(v) with two different RANGEs must be computed
+        twice, not deduped to one window."""
+        r = qe.execute_one(
+            "SELECT ts, avg(v) RANGE '5s' AS a5, avg(v) RANGE '10s' AS a10 "
+            "FROM s WHERE host = 'a' ALIGN '5s' ORDER BY ts")
+        rows = {row[0]: (row[1], row[2]) for row in r.rows()}
+        assert rows[0] == (1.0, 1.5)      # [0,5s) vs [0,10s)
+        assert rows[5000] == (2.0, 2.5)   # [5s,10s) vs [5s,15s)
+
+    def test_align_to_origin(self, qe):
+        r = qe.execute_one(
+            "SELECT ts, sum(v) RANGE '10s' FROM s WHERE host = 'b' "
+            "ALIGN '10s' TO 2000 BY () ORDER BY ts")
+        # origin 2000: window [-8000, 2000) has ts=0; [2000, 12000) has 5000
+        assert r.rows() == [[-8000, 10.0], [2000, 20.0]]
+
+    def test_by_empty_aggregates_across_series(self, qe):
+        r = qe.execute_one(
+            "SELECT ts, sum(v) RANGE '5s' FROM s ALIGN '5s' BY () "
+            "ORDER BY ts")
+        assert r.rows() == [[0, 11.0], [5000, 22.0], [10000, 3.0]]
+
+    def test_expression_over_range_aggs(self, qe):
+        r = qe.execute_one(
+            "SELECT ts, (max(v) - min(v)) RANGE '20s' AS spread FROM s "
+            "ALIGN '20s' BY () ORDER BY ts")
+        assert r.rows() == [[0, 19.0]]
+
+    def test_fill_prev_and_linear(self, qe):
+        qe.execute_one(
+            "INSERT INTO s VALUES ('c', 1.0, 0), ('c', 9.0, 20000)")
+        r = qe.execute_one(
+            "SELECT ts, avg(v) RANGE '5s' FILL PREV FROM s "
+            "WHERE host = 'c' ALIGN '5s' ORDER BY ts")
+        assert [row[1] for row in r.rows()] == [1.0, 1.0, 1.0, 1.0, 9.0]
+        r = qe.execute_one(
+            "SELECT ts, avg(v) RANGE '5s' FILL LINEAR FROM s "
+            "WHERE host = 'c' ALIGN '5s' ORDER BY ts")
+        assert [row[1] for row in r.rows()] == [1.0, 3.0, 5.0, 7.0, 9.0]
+
+    def test_errors(self, qe):
+        with pytest.raises(PlanError, match="multiple of ALIGN"):
+            qe.execute_one(
+                "SELECT ts, avg(v) RANGE '7s' FROM s ALIGN '5s'")
+        with pytest.raises(PlanError, match="ALIGN BY"):
+            qe.execute_one(
+                "SELECT ts, host, avg(v) RANGE '5s' FROM s ALIGN '5s' BY ()")
+        with pytest.raises(PlanError, match="not supported in RANGE"):
+            qe.execute_one(
+                "SELECT ts, median(v) RANGE '5s' FROM s ALIGN '5s'")
+
+    def test_matches_plain_groupby_oracle(self, qe):
+        """range == align must agree with the date_bin GROUP BY engine."""
+        r1 = qe.execute_one(
+            "SELECT ts, host, sum(v) RANGE '10s' FROM s ALIGN '10s' "
+            "ORDER BY host, ts")
+        r2 = qe.execute_one(
+            "SELECT date_bin('10 seconds', ts) AS b, host, sum(v) FROM s "
+            "GROUP BY b, host ORDER BY host, b")
+        assert r1.rows() == r2.rows()
+
+    def test_survives_flush(self, qe):
+        qe.execute_one("ADMIN flush_table('s')")
+        r = qe.execute_one(
+            "SELECT ts, host, avg(v) RANGE '10s' FROM s ALIGN '10s' "
+            "ORDER BY host, ts")
+        assert r.rows() == [
+            [0, "a", 1.5], [10000, "a", 3.0], [0, "b", 15.0]]

@@ -1,0 +1,49 @@
+"""Golden-file SQL conformance tests (the reference's sqlness harness,
+tests/runner/src/main.rs) — every cases/**/*.sql replayed through the real
+HTTP server and compared against its .result transcript.
+
+Regenerate intentionally-changed goldens with SQLNESS_REGEN=1.
+"""
+
+import os
+from pathlib import Path
+
+import pytest
+
+from greptimedb_tpu.catalog.catalog import Catalog
+from greptimedb_tpu.catalog.kv import MemoryKv
+from greptimedb_tpu.query.engine import QueryEngine
+from greptimedb_tpu.servers.http import HttpServer
+from greptimedb_tpu.storage.engine import EngineConfig, RegionEngine
+
+from sqlness.runner import HttpSqlClient, run_case
+
+CASES_DIR = Path(__file__).parent / "sqlness" / "cases"
+CASES = sorted(CASES_DIR.rglob("*.sql"))
+
+
+@pytest.mark.parametrize(
+    "case", CASES, ids=[str(c.relative_to(CASES_DIR))[:-4] for c in CASES]
+)
+def test_sqlness_case(case: Path, tmp_path):
+    engine = RegionEngine(EngineConfig(data_dir=str(tmp_path)))
+    qe = QueryEngine(Catalog(MemoryKv()), engine)
+    srv = HttpServer(qe, port=0)
+    port = srv.start()
+    try:
+        got = run_case(case.read_text(), HttpSqlClient(port))
+        result_path = case.with_suffix(".result")
+        if os.environ.get("SQLNESS_REGEN"):
+            result_path.write_text(got)
+            return
+        assert result_path.exists(), (
+            f"missing golden {result_path.name}; run with SQLNESS_REGEN=1"
+        )
+        expect = result_path.read_text()
+        assert got == expect, (
+            f"sqlness mismatch for {case.name}\n--- expected ---\n"
+            f"{expect}\n--- got ---\n{got}"
+        )
+    finally:
+        srv.stop()
+        engine.close()

@@ -164,9 +164,7 @@ class MiniMysql:
                 body += bytes(nb) + b"\x00" + values
         self._send(body)
         first = self._read_packet()
-        if first[0] == 0x00 and len(first) < 9:
-            return ("ok", first[1])
-        if first[0] == 0x00:
+        if first[0] == 0x00:  # OK packet (a resultset starts with ncols >= 1)
             return ("ok", first[1])
         if first[0] == 0xFF:
             code = struct.unpack("<H", first[1:3])[0]
@@ -360,6 +358,21 @@ class TestMysqlProtocol:
             c._send(b"\x18" + struct.pack("<IH", stmt, 0) + b"ignored")
             kind, _, rows = c.execute(stmt, ("zzz",))
             assert rows == [["2"]]
+            c.close()
+        finally:
+            srv.shutdown()
+
+    def test_question_mark_in_comment_is_not_a_param(self, db):
+        srv = MysqlServer(db, port=0)
+        srv.start()
+        try:
+            c = MiniMysql(srv.port)
+            stmt, nparams = c.prepare(
+                "SELECT host FROM cpu WHERE usage > ? -- retry? see FAQ?\n"
+                "ORDER BY host")
+            assert nparams == 1
+            _, _, rows = c.execute(stmt, (2.0,))
+            assert rows == [["b"]]
             c.close()
         finally:
             srv.shutdown()
