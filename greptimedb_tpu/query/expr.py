@@ -312,12 +312,17 @@ def _eval_device_func(e: ast.FuncCall, ev, cols, schema: Schema):
         return (ts - origin) // step * step + origin
     if name == "date_trunc":
         unit_lit, ts_expr = e.args[0], e.args[1]
-        nanos = _TRUNC_UNITS.get(str(_lit(unit_lit)).lower())
+        unit = str(_lit(unit_lit)).lower()
+        nanos = _TRUNC_UNITS.get(unit)
         if nanos is None:
             raise PlanError(f"date_trunc unit {_lit(unit_lit)!r} unsupported")
         step = _scale_to_col_unit(nanos, ts_expr, schema)
         ts = ev(ts_expr)
-        return ts // step * step
+        # weeks start on Monday (PostgreSQL semantics); the epoch is a
+        # Thursday, so shift by 3 days before flooring
+        shift = _scale_to_col_unit(3 * 86400 * 10**9, ts_expr, schema) \
+            if unit == "week" else 0
+        return (ts + shift) // step * step - shift
     if name in ("pow", "power"):
         return jnp.power(ev(e.args[0]), ev(e.args[1]))
     if name == "round":
@@ -615,12 +620,19 @@ def _eval_host_func(e: ast.FuncCall, ev, schema):
         return ts // step * step
     if name == "date_trunc":
         unit_lit, ts_expr = e.args[0], e.args[1]
-        nanos = _TRUNC_UNITS.get(str(_lit(unit_lit)).lower())
+        unit = str(_lit(unit_lit)).lower()
+        nanos = _TRUNC_UNITS.get(unit)
         if nanos is None:
             raise PlanError(f"date_trunc unit {_lit(unit_lit)!r} unsupported")
         step = _scale_to_col_unit(nanos, ts_expr, schema) if schema else nanos
         ts = np.asarray(ev(ts_expr))
-        return ts // step * step
+        shift = 0
+        if unit == "week":
+            # weeks start on Monday; epoch is a Thursday (device branch)
+            shift_ns = 3 * 86400 * 10**9
+            shift = (_scale_to_col_unit(shift_ns, ts_expr, schema)
+                     if schema else shift_ns)
+        return (ts + shift) // step * step - shift
     if name == "now":
         import time as _time
         return int(_time.time() * 1000)

@@ -86,6 +86,16 @@ def plan_range_select(sel: ast.Select, table: TableInfo) -> RangePlan:
     ts_expr = ast.Column(ts_col.name)
     if sel.align is None:
         raise PlanError("RANGE aggregates need an ALIGN clause")
+    # clauses the range path does not implement are rejected, not
+    # silently dropped (reference range_select has the same restrictions)
+    if sel.group_by:
+        raise PlanError(
+            "GROUP BY is not valid in a RANGE query; series are keyed by "
+            "the ALIGN BY clause")
+    if sel.having is not None:
+        raise PlanError("HAVING is not supported in RANGE queries")
+    if sel.distinct:
+        raise PlanError("DISTINCT is not supported in RANGE queries")
     align_step = _interval_in_col_unit(sel.align, ts_expr, schema)
     origin = 0
     if sel.align_to is not None:
@@ -249,18 +259,25 @@ def execute_range_select(executor, rp: RangePlan):
             ])
     project = lp.Project(None, rp.items)
     sort = lp.Sort(None, rp.order_keys) if rp.order_keys else None
+
+    def empty_result():
+        # zero windows: every projected expression still needs a binding
+        env0: dict = {ast.Column(ts_name): np.empty(0, dtype=np.int64)}
+        for b in rp.by:
+            env0[b] = np.empty(0, dtype=object)
+        for a in rp.aggs:
+            env0[a.key] = np.empty(0, dtype=np.float64)
+        return executor._post_process(env0, None, None, project, sort,
+                                      rp.limit, rp.offset, table, 0)
+
     if scan is None or scan.num_rows == 0:
-        return executor._post_process({}, None, None, project, sort,
-                                      rp.limit, rp.offset, table, 0,
-                                      host_cols={})
+        return empty_result()
 
     ctx = BindContext(schema, scan.tag_dicts)
     bound_where = bind_expr(rp.where, ctx) if rp.where is not None else None
     idx = executor._filtered_row_indices(scan, table, ctx, bound_where)
     if len(idx) == 0:
-        return executor._post_process({}, None, None, project, sort,
-                                      rp.limit, rp.offset, table, 0,
-                                      host_cols={})
+        return empty_result()
 
     # host gather of surviving rows
     host: dict[str, np.ndarray] = {}

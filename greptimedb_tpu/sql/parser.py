@@ -226,7 +226,8 @@ class Parser:
                         sel.align_by.append(self.parse_expr())
                 self.expect_op(")")
             if self.eat_kw("fill"):
-                sel.range_fill = self.ident()
+                # same normalization/validation as the per-item postfix
+                sel.range_fill = self.parse_fill_policy()
         if self.eat_kw("group"):
             self.expect_kw("by")
             sel.group_by.append(self.parse_expr())

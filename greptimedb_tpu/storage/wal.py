@@ -31,6 +31,17 @@ import zlib
 from dataclasses import dataclass
 from typing import Iterator, Optional
 
+
+def _try_native():
+    try:
+        from greptimedb_tpu.native import try_load
+        return try_load()
+    except Exception:  # noqa: BLE001 — WAL must work without a toolchain
+        return None
+
+
+_native = _try_native()
+
 import pyarrow as pa
 
 from greptimedb_tpu.datatypes.recordbatch import RecordBatch
@@ -128,18 +139,27 @@ class Wal:
         for i, (segno, path) in enumerate(segs):
             with open(path, "rb") as f:
                 data = f.read()
-            pos = 0
-            valid_end = 0
             entries = []
-            while pos + _HEADER.size <= len(data):
-                plen, crc, rid, seq, op = _HEADER.unpack_from(data, pos)
-                payload = data[pos + _HEADER.size : pos + _HEADER.size + plen]
-                if len(payload) != plen or zlib.crc32(payload) != crc:
-                    break  # torn tail
-                pos += _HEADER.size + plen
-                valid_end = pos
-                if seq >= from_seq:
-                    entries.append(WalEntry(rid, seq, op, _decode_batch(payload)))
+            if _native is not None:
+                # one native pass: bounds + checksum + record table
+                recs, valid_end = _native.wal_scan(data)
+                for off, plen, rid, seq, op in recs:
+                    if seq >= from_seq:
+                        entries.append(WalEntry(
+                            rid, seq, op,
+                            _decode_batch(data[off:off + plen])))
+            else:
+                pos = 0
+                valid_end = 0
+                while pos + _HEADER.size <= len(data):
+                    plen, crc, rid, seq, op = _HEADER.unpack_from(data, pos)
+                    payload = data[pos + _HEADER.size : pos + _HEADER.size + plen]
+                    if len(payload) != plen or zlib.crc32(payload) != crc:
+                        break  # torn tail
+                    pos += _HEADER.size + plen
+                    valid_end = pos
+                    if seq >= from_seq:
+                        entries.append(WalEntry(rid, seq, op, _decode_batch(payload)))
             if valid_end < len(data):
                 with open(path, "r+b") as f:
                     f.truncate(valid_end)

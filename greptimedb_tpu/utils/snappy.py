@@ -1,11 +1,15 @@
-"""Pure-Python snappy block-format codec.
+"""Snappy block-format codec.
 
-The image ships no snappy library; Prometheus remote write/read bodies are
-snappy-framed protobuf (reference src/servers/src/prom_store.rs uses the
-snap crate). Decompress implements the full block format (literals +
-copy-1/2/4); compress emits valid snappy using literal-only encoding —
-spec-conformant and fast enough for the response path, just without
-back-reference compression.
+Prometheus remote write/read bodies are snappy-framed protobuf
+(reference src/servers/src/prom_store.rs uses the snap crate). The fast
+path is the native C++ codec (greptimedb_tpu/native, real back-reference
+compression, the analog of the reference's snap crate); this module's
+pure-Python implementation is the always-available fallback: decompress
+covers the full block format (literals + copy-1/2/4), compress emits
+valid literal-only snappy.
+
+`compress`/`decompress` below transparently dispatch to native when the
+toolchain built it.
 """
 
 from __future__ import annotations
@@ -13,6 +17,17 @@ from __future__ import annotations
 
 class SnappyError(Exception):
     pass
+
+
+def _try_native():
+    try:
+        from greptimedb_tpu.native import try_load
+        return try_load()
+    except Exception:  # noqa: BLE001 — fallback must never fail
+        return None
+
+
+_NATIVE = _try_native()
 
 
 def _read_varint(data: bytes, pos: int) -> tuple[int, int]:
@@ -43,6 +58,21 @@ def _write_varint(n: int) -> bytes:
 
 
 def decompress(data: bytes) -> bytes:
+    if _NATIVE is not None:
+        try:
+            return _NATIVE.snappy_decompress(data)
+        except ValueError as e:
+            raise SnappyError(str(e)) from None
+    return _py_decompress(data)
+
+
+def compress(data: bytes) -> bytes:
+    if _NATIVE is not None:
+        return _NATIVE.snappy_compress(data)
+    return _py_compress(data)
+
+
+def _py_decompress(data: bytes) -> bytes:
     expected, pos = _read_varint(data, 0)
     out = bytearray()
     n = len(data)
@@ -92,7 +122,7 @@ def decompress(data: bytes) -> bytes:
     return bytes(out)
 
 
-def compress(data: bytes) -> bytes:
+def _py_compress(data: bytes) -> bytes:
     """Literal-only snappy encoding (valid per spec; no back-references)."""
     out = bytearray(_write_varint(len(data)))
     pos = 0

@@ -110,6 +110,39 @@ class TestRangeSemantics:
             "GROUP BY b, host ORDER BY host, b")
         assert r1.rows() == r2.rows()
 
+    def test_empty_scan_returns_empty_frame(self, qe):
+        """A quiet window must yield zero rows, not a planner error."""
+        r = qe.execute_one(
+            "SELECT ts, host, avg(v) RANGE '10s' FROM s "
+            "WHERE host = 'nope' ALIGN '10s'")
+        assert r.rows() == []
+        qe.execute_one(
+            "CREATE TABLE empty_t (k STRING, v DOUBLE, ts TIMESTAMP "
+            "TIME INDEX, PRIMARY KEY(k))")
+        r = qe.execute_one(
+            "SELECT ts, avg(v) RANGE '5s' FROM empty_t ALIGN '5s' BY ()")
+        assert r.rows() == []
+
+    def test_query_level_fill_clause(self, qe):
+        """ALIGN ... FILL PREV applies to every item (and is
+        case-normalized like the per-item form)."""
+        qe.execute_one(
+            "INSERT INTO s VALUES ('d', 1.0, 0), ('d', 9.0, 20000)")
+        r = qe.execute_one(
+            "SELECT ts, avg(v) RANGE '5s' FROM s WHERE host = 'd' "
+            "ALIGN '5s' FILL PREV ORDER BY ts")
+        assert [row[1] for row in r.rows()] == [1.0, 1.0, 1.0, 1.0, 9.0]
+
+    def test_unsupported_clauses_rejected(self, qe):
+        with pytest.raises(PlanError, match="HAVING"):
+            qe.execute_one(
+                "SELECT ts, avg(v) RANGE '5s' FROM s ALIGN '5s' BY () "
+                "HAVING avg(v) > 1")
+        with pytest.raises(PlanError, match="GROUP BY"):
+            qe.execute_one(
+                "SELECT ts, avg(v) RANGE '5s' FROM s ALIGN '5s' BY () "
+                "GROUP BY host")
+
     def test_survives_flush(self, qe):
         qe.execute_one("ADMIN flush_table('s')")
         r = qe.execute_one(
