@@ -126,12 +126,16 @@ class RegionRouter:
         self.metasrv = metasrv
         self.datanodes = datanodes
         self._region_node: dict[int, str] = {}
+        self._agg_executors: dict[int, object] = {}  # per-engine pushdown
         self._lock = threading.Lock()
         metasrv.subscribe_invalidation(self._on_invalidate)
 
     def _on_invalidate(self, table: str) -> None:
         with self._lock:
             self._region_node.clear()
+            # pushdown executors pin their engines (and device caches):
+            # drop them with the routes so failed-over engines can free
+            self._agg_executors.clear()
 
     def _refresh(self) -> None:
         with self._lock:
@@ -211,6 +215,24 @@ class RegionRouter:
         return self._engine_for(region_id).scan_stream(
             region_id, ts_range, projection, tag_predicates
         )
+
+    def partial_agg(self, region_id: int, frag):
+        """Aggregation pushdown: run the Partial step ON the node that
+        owns the region (over Flight in wire mode), so only per-group
+        primitive planes — not raw rows — return to the frontend
+        (reference dist_plan Partial/Final split, analyzer.rs:35)."""
+        eng = self._engine_for(region_id)
+        if hasattr(eng, "partial_agg"):  # RemoteRegionEngine: over the wire
+            return eng.partial_agg(region_id, frag)
+        # in-process datanode: same computation, no serialization
+        from greptimedb_tpu.query.dist_agg import partial_region_agg
+        from greptimedb_tpu.query.physical import PhysicalExecutor
+
+        ex = self._agg_executors.get(id(eng))
+        if ex is None:
+            ex = PhysicalExecutor(eng)
+            self._agg_executors[id(eng)] = ex
+        return partial_region_agg(ex, region_id, frag)
 
     def alter_region_schema(self, region_id: int, schema) -> None:
         self._engine_for(region_id).alter_region_schema(region_id, schema)

@@ -1,0 +1,266 @@
+"""Distributed partial aggregation — the dist_plan / MergeScan analog.
+
+The reference splits commutative aggregates into a Partial step executed
+on each datanode's regions and a Final combine at the frontend
+(query/src/dist_plan/analyzer.rs:35, merge_scan.rs:122). Here:
+
+- `partial_region_agg` runs ON the node owning a region: scan, filter,
+  evaluate group keys + aggregate args, and reduce to primitive planes
+  (sum/count/min/max/first/last/sumsq/rows) with ONE fused device
+  segment reduction. Group keys travel as decoded VALUES, so partials
+  from different regions (with different tag dictionaries) combine by
+  value at the frontend.
+- `combine_partials` merges per-region results: additive planes add,
+  min/max fold, first/last resolve by their companion timestamps.
+
+The fragment itself crosses the wire as JSON (plan_ser.AggFragment —
+the substrait analog) via the Flight `region_agg` ticket.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from greptimedb_tpu.ops.segment import segment_agg
+from greptimedb_tpu.query.expr import BindContext, bind_expr, eval_host
+from greptimedb_tpu.query.plan_ser import AggFragment
+
+
+def partial_region_agg(executor, region_id: int, frag: AggFragment,
+                       schema=None) -> Optional[dict]:
+    """Compute one region's partial aggregate. Returns
+    {"keys": [np.ndarray per key], "planes": {op: [G, F] np.ndarray}}
+    with G = observed groups in this region, or None for an empty scan."""
+    from greptimedb_tpu.datatypes.vector import DictVector
+    from greptimedb_tpu.query.expr import collect_columns
+
+    from types import SimpleNamespace
+
+    from greptimedb_tpu.storage.index import extract_tag_predicates
+
+    ts_range = tuple(frag.ts_range) if frag.ts_range else None
+    # probe the schema first so projection + index pruning match what the
+    # frontend's gather path gets (physical.py execute: scan_node.columns
+    # + extract_tag_predicates)
+    probe = executor.engine.region(region_id)
+    schema = schema or probe.schema
+    ts_name = schema.time_index.name
+    needed: set[str] = {ts_name}
+    collect_columns(frag.where, needed)
+    for _, k in frag.keys:
+        collect_columns(k, needed)
+    for a in frag.args:
+        collect_columns(a, needed)
+    proj = [c for c in schema.names if c in needed]
+    tag_preds = extract_tag_predicates(frag.where, schema) or None
+    scan = executor.engine.scan(region_id, ts_range, proj, tag_preds)
+    if scan is None or scan.num_rows == 0:
+        return None
+
+    ctx = BindContext(schema, scan.tag_dicts)
+    bound_where = bind_expr(frag.where, ctx) if frag.where is not None \
+        else None
+    # _filtered_row_indices only consults .schema and (via dedup)
+    # .append_mode — a region-local shim stands in for the TableInfo the
+    # frontend holds
+    shim = SimpleNamespace(schema=schema, append_mode=frag.append_mode)
+    idx = executor._filtered_row_indices(scan, shim, ctx, bound_where)
+    if len(idx) == 0:
+        return None
+
+    host: dict[str, np.ndarray] = {}
+    for name, arr in scan.columns.items():
+        taken = arr[idx]
+        if name in scan.tag_dicts:
+            taken = DictVector(taken, scan.tag_dicts[name]).decode()
+        host[name] = taken
+    if ts_range is not None:
+        # scan ts_range is coarse (row-group pruning); apply the exact
+        # closed bounds here — the frontend derived them from WHERE
+        lo, hi = ts_range
+        tsv = host[ts_name].astype(np.int64)
+        m = np.ones(len(tsv), dtype=bool)
+        if lo is not None:
+            m &= tsv >= lo
+        if hi is not None:
+            m &= tsv <= hi
+        if not m.all():
+            host = {k: v[m] for k, v in host.items()}
+    n = len(host[ts_name])
+
+    # group keys: evaluate, factorize by VALUE (null-safe: NULL is its
+    # own group, matching the single-node path's semantics)
+    key_uniqs: list[np.ndarray] = []
+    gcode = np.zeros(n, dtype=np.int64)
+    for _, kexpr in frag.keys:
+        vals = np.asarray(eval_host(kexpr, host, schema))
+        if vals.ndim == 0:
+            vals = np.broadcast_to(vals, (n,))
+        uniq, codes = _factorize_with_null(vals)
+        key_uniqs.append(uniq)
+        gcode = gcode * len(uniq) + codes
+    if frag.keys:
+        gids_uniq, gcode = np.unique(gcode, return_inverse=True)
+        num_groups = len(gids_uniq)
+    else:
+        gids_uniq = np.zeros(1, dtype=np.int64)
+        num_groups = 1
+
+    if frag.args:
+        planes = [np.asarray(eval_host(a, host, schema), dtype=np.float64)
+                  for a in frag.args]
+        vals = np.stack([np.broadcast_to(p, (n,)) for p in planes], axis=1)
+    else:
+        vals = np.zeros((n, 1), dtype=np.float64)
+
+    ops = set(frag.ops)
+    need_ts = bool({"first", "last"} & ops)
+    out = segment_agg(
+        jnp.asarray(vals), jnp.asarray(gcode.astype(np.int32)),
+        jnp.ones(n, dtype=bool), num_groups, ops=tuple(sorted(ops)),
+        ts=jnp.asarray(host[ts_name].astype(np.int64)) if need_ts else None,
+    )
+    planes_np = {k: np.asarray(v) for k, v in out.items()}
+
+    # decode each group's key values from the compacted ids
+    key_cols: list[np.ndarray] = []
+    rem = gids_uniq
+    for uniq in reversed(key_uniqs):
+        key_cols.append(uniq[rem % len(uniq)])
+        rem = rem // len(uniq)
+    key_cols.reverse()
+    return {"keys": key_cols, "planes": planes_np}
+
+
+def _factorize_with_null(vals: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """np.unique with NULL support: None (object arrays) and NaN (float
+    arrays) can't be sorted/equality-matched by np.unique, so nulls get
+    their own trailing code with a None marker in the value table."""
+    if vals.dtype == object:
+        null_mask = np.asarray([v is None for v in vals])
+    elif vals.dtype.kind == "f":
+        null_mask = np.isnan(vals)
+    else:
+        null_mask = None
+    if null_mask is None or not null_mask.any():
+        if vals.dtype == object:
+            # None-free object arrays still need a sortable dtype
+            uniq, codes = np.unique(vals.astype(str), return_inverse=True)
+            return uniq.astype(object), codes
+        return np.unique(vals, return_inverse=True)
+    codes = np.empty(len(vals), dtype=np.int64)
+    nn = vals[~null_mask]
+    if vals.dtype == object:
+        uniq_nn, codes_nn = np.unique(nn.astype(str), return_inverse=True)
+        uniq_nn = uniq_nn.astype(object)
+    else:
+        uniq_nn, codes_nn = np.unique(nn, return_inverse=True)
+    codes[~null_mask] = codes_nn
+    codes[null_mask] = len(uniq_nn)
+    uniq = np.empty(len(uniq_nn) + 1, dtype=object)
+    uniq[:len(uniq_nn)] = uniq_nn
+    uniq[len(uniq_nn)] = None
+    return uniq, codes
+
+
+class _NullKey:
+    """Singleton stand-in for NULL in combine index tuples: None and NaN
+    both normalize to it, restoring equality that NaN breaks."""
+
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+
+_NULL = _NullKey()
+
+
+def _norm_key(v):
+    if v is None:
+        return _NULL
+    if isinstance(v, (float, np.floating)) and v != v:
+        return _NULL
+    return v
+
+
+_ADDITIVE = frozenset({"sum", "count", "rows", "sumsq"})
+
+
+def combine_partials(partials: list, n_keys: int, ops: tuple) -> Optional[dict]:
+    """Final combine of per-region partials (merge_scan.rs:122 role).
+    Returns {"keys": [np.ndarray], "planes": {op: [G, F]}} over the union
+    of group keys, or None if every partial was empty."""
+    partials = [p for p in partials if p is not None]
+    if not partials:
+        return None
+    index: dict[tuple, int] = {}
+    rows_keys: list[tuple] = []  # original values (None/NaN preserved)
+    for p in partials:
+        kc = p["keys"]
+        g = len(kc[0]) if kc else 1
+        for i in range(g):
+            kt = tuple(_norm_key(c[i]) for c in kc)
+            if kt not in index:
+                index[kt] = len(rows_keys)
+                rows_keys.append(tuple(c[i] for c in kc))
+    G = len(rows_keys)
+    sample = partials[0]["planes"]
+    acc: dict[str, np.ndarray] = {}
+    for op, plane in sample.items():
+        f = plane.shape[1] if plane.ndim == 2 else 1
+        if op in ("min",):
+            acc[op] = np.full((G, f), np.nan)
+        elif op in ("max",):
+            acc[op] = np.full((G, f), np.nan)
+        elif op in ("first", "last"):
+            acc[op] = np.full((G, f), np.nan)
+        elif op in ("first_ts",):
+            acc[op] = np.full((G, f), np.iinfo(np.int64).max, dtype=np.int64)
+        elif op in ("last_ts",):
+            acc[op] = np.full((G, f), np.iinfo(np.int64).min, dtype=np.int64)
+        else:
+            acc[op] = np.zeros((G, f))
+    for p in partials:
+        kc = p["keys"]
+        g = len(kc[0]) if kc else 1
+        pos = np.fromiter(
+            (index[tuple(_norm_key(c[i]) for c in kc)] for i in range(g)),
+            dtype=np.int64, count=g)
+        planes = {op: (pl if pl.ndim == 2 else pl[:, None])
+                  for op, pl in p["planes"].items()}
+        for op, pl in planes.items():
+            if op in _ADDITIVE:
+                np.add.at(acc[op], pos, pl)
+            elif op == "min":
+                cur = acc[op][pos]
+                acc[op][pos] = np.where(
+                    np.isnan(cur) | (pl < cur), pl, cur)
+            elif op == "max":
+                cur = acc[op][pos]
+                acc[op][pos] = np.where(
+                    np.isnan(cur) | (pl > cur), pl, cur)
+            elif op == "first":
+                ts = planes["first_ts"].astype(np.int64)
+                cur_ts = acc["first_ts"][pos]
+                take = ts < cur_ts
+                acc[op][pos] = np.where(take, pl, acc[op][pos])
+                acc["first_ts"][pos] = np.where(take, ts, cur_ts)
+            elif op == "last":
+                ts = planes["last_ts"].astype(np.int64)
+                cur_ts = acc["last_ts"][pos]
+                take = ts > cur_ts
+                acc[op][pos] = np.where(take, pl, acc[op][pos])
+                acc["last_ts"][pos] = np.where(take, ts, cur_ts)
+            # first_ts / last_ts handled with their value planes
+    key_cols = [np.asarray([kt[i] for kt in rows_keys])
+                for i in range(n_keys)]
+    for op in ("count", "rows"):
+        if op in acc:
+            acc[op] = acc[op].astype(np.int64)
+    return {"keys": key_cols, "planes": acc}

@@ -449,6 +449,17 @@ class PhysicalExecutor:
         tag_preds = extract_tag_predicates(where, table.schema) or None
         from greptimedb_tpu.utils import tracing
 
+        # distributed aggregation pushdown: with multiple regions behind a
+        # router that can run the Partial step remotely, ship the fragment
+        # and combine primitives instead of gathering raw rows
+        # (dist_plan/analyzer.rs:35 + merge_scan.rs:122)
+        if (agg is not None and len(table.region_ids) > 1
+                and hasattr(self.engine, "partial_agg")):
+            res = self._try_agg_pushdown(table, where, agg, having, project,
+                                         sort, limit, offset, ts_range)
+            if res is not None:
+                return res
+
         # beyond-RAM aggregate scans stream: append-mode (no dedup sort),
         # single region, estimated rows over the threshold
         if (agg is not None and table.append_mode
@@ -494,6 +505,57 @@ class PhysicalExecutor:
                           else scan.num_rows):
             return self._execute_raw(scan, table, where, project, sort,
                                      limit, offset)
+
+    # ---- distributed aggregation pushdown ----------------------------------
+
+    def _try_agg_pushdown(self, table, where, agg, having, project, sort,
+                          limit, offset, ts_range) -> Optional[QueryResult]:
+        """Fan the Partial step out to each region's owner and combine
+        primitive planes here (the Final step). Returns None when the
+        plan shape isn't decomposable — caller falls back to the
+        gather-rows path."""
+        from greptimedb_tpu.query.dist_agg import combine_partials
+        from greptimedb_tpu.query.host_agg import HOST_AGGS
+        from greptimedb_tpu.query.plan_ser import AggFragment
+        from greptimedb_tpu.utils import tracing
+
+        if any(s.func in HOST_AGGS for s in agg.aggs):
+            return None  # order statistics need raw values
+        arg_exprs: list[ast.Expr] = []
+        spec_slot: list[Optional[int]] = []
+        for spec in agg.aggs:
+            if spec.arg is None:
+                spec_slot.append(None)
+                continue
+            if spec.arg not in arg_exprs:
+                arg_exprs.append(spec.arg)
+            spec_slot.append(arg_exprs.index(spec.arg))
+        ops: set = {"rows"}
+        for spec in agg.aggs:
+            ops.update(_PRIMITIVES[spec.func])
+        frag = AggFragment(
+            keys=list(agg.keys), args=arg_exprs, ops=sorted(ops),
+            where=where, ts_range=ts_range, append_mode=table.append_mode)
+        partials = []
+        with tracing.span("agg_pushdown", regions=len(table.region_ids)):
+            for rid in table.region_ids:
+                partials.append(self.engine.partial_agg(rid, frag))
+        combined = combine_partials(partials, len(agg.keys),
+                                    tuple(frag.ops))
+        self.last_path = "pushdown"
+        if combined is None:
+            return self._empty_agg_result(table, agg, having, project,
+                                          sort, limit, offset)
+        planes = combined["planes"]
+        g = len(combined["keys"][0]) if agg.keys else 1
+        present = np.arange(g)
+        env: dict = {}
+        for i, (name, kexpr) in enumerate(agg.keys):
+            env[kexpr] = combined["keys"][i]
+        for spec, slot in zip(agg.aggs, spec_slot):
+            env[spec.call] = _finalize_agg(spec.func, planes, slot, present)
+        return self._post_process(env, agg, having, project, sort, limit,
+                                  offset, table, g)
 
     # ---- aggregate path ----------------------------------------------------
 
@@ -1320,8 +1382,9 @@ def _host_sort_order(keys, project, out_names, out_cols, host_cols, schema, env)
 
 
 def _sortable(arr: np.ndarray, asc: bool, nulls_first: Optional[bool]) -> np.ndarray:
-    if arr.dtype == object:
-        mask = np.asarray([v is None for v in arr])
+    if arr.dtype == object or arr.dtype.kind in ("U", "S"):
+        mask = np.asarray([v is None for v in arr]) \
+            if arr.dtype == object else np.zeros(len(arr), dtype=bool)
         filled = np.where(mask, "", arr.astype(str))
         uniq, codes = np.unique(filled, return_inverse=True)
         key = codes.astype(np.float64)

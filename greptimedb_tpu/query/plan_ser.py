@@ -1,0 +1,95 @@
+"""Plan/expression serialization — the substrait analog.
+
+The reference ships DataFusion plans between frontend and datanode as
+substrait bytes (src/common/substrait/src/df_substrait.rs,
+datanode/src/region_server.rs:623-660). Here the exchanged fragment is
+an *aggregation pushdown*: WHERE + group keys + decomposed aggregate
+specs, encoded as JSON over the expression AST (every node is a frozen
+dataclass, so encoding is structural and round-trips exactly).
+
+Security note: `expr_from_json` only instantiates ast.* dataclasses by
+whitelisted name — never arbitrary classes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Optional
+
+from greptimedb_tpu.sql import ast
+
+_NODE_TYPES = {
+    name: cls
+    for name, cls in vars(ast).items()
+    if isinstance(cls, type) and dataclasses.is_dataclass(cls)
+}
+
+
+def expr_to_json(e: Optional[ast.Expr]) -> Any:
+    """Expression AST -> JSON-serializable structure."""
+    if e is None:
+        return None
+    if isinstance(e, (str, int, float, bool)):
+        return e
+    if isinstance(e, (list, tuple)):
+        return [expr_to_json(x) for x in e]
+    if dataclasses.is_dataclass(e):
+        out: dict = {"_t": type(e).__name__}
+        for f in dataclasses.fields(e):
+            out[f.name] = expr_to_json(getattr(e, f.name))
+        return out
+    raise TypeError(f"unserializable plan node {type(e).__name__}")
+
+
+def expr_from_json(obj: Any) -> Any:
+    if obj is None or isinstance(obj, (str, int, float, bool)):
+        return obj
+    if isinstance(obj, list):
+        return tuple(expr_from_json(x) for x in obj)
+    if isinstance(obj, dict):
+        t = obj.get("_t")
+        cls = _NODE_TYPES.get(t)
+        if cls is None:
+            raise ValueError(f"unknown plan node type {t!r}")
+        kwargs = {k: expr_from_json(v) for k, v in obj.items() if k != "_t"}
+        return cls(**kwargs)
+    raise ValueError(f"bad plan JSON {obj!r}")
+
+
+@dataclasses.dataclass
+class AggFragment:
+    """The unit shipped to a datanode: compute per-region PARTIAL
+    aggregates (primitive planes, not finalized values) grouped by the
+    evaluated key expressions. Mirrors the reference's commutativity
+    split (query/src/dist_plan/analyzer.rs:35): Partial runs on the
+    region, Final combines on the frontend."""
+
+    keys: list            # [(name, Expr)]
+    args: list            # positional aggregate argument Exprs
+    ops: list             # primitive op names for segment_agg
+    where: Optional[ast.Expr] = None
+    ts_range: Optional[tuple] = None
+    append_mode: bool = False  # skip LWW dedup on append-only tables
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "keys": [[n, expr_to_json(e)] for n, e in self.keys],
+            "args": [expr_to_json(a) for a in self.args],
+            "ops": list(self.ops),
+            "where": expr_to_json(self.where),
+            "ts_range": list(self.ts_range) if self.ts_range else None,
+            "append_mode": self.append_mode,
+        })
+
+    @staticmethod
+    def from_json(s: str) -> "AggFragment":
+        d = json.loads(s)
+        return AggFragment(
+            keys=[(n, expr_from_json(e)) for n, e in d["keys"]],
+            args=[expr_from_json(a) for a in d["args"]],
+            ops=list(d["ops"]),
+            where=expr_from_json(d["where"]),
+            ts_range=tuple(d["ts_range"]) if d["ts_range"] else None,
+            append_mode=bool(d.get("append_mode", False)),
+        )

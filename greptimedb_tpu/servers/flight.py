@@ -75,6 +75,45 @@ def scan_to_table(scan: ScanData) -> pa.Table:
     return pa.Table.from_arrays(arrays, schema=pa.schema(fields, metadata=meta))
 
 
+def partial_to_table(part: dict) -> pa.Table:
+    """Partial-aggregate result ⇄ Arrow (the wire format of the Final
+    combine's input). Key columns are `__key_<i>`; each primitive plane
+    flattens to `__plane_<op>` FixedSizeList-free float64 columns with
+    the field count in metadata."""
+    arrays, fields = [], []
+    for i, kc in enumerate(part["keys"]):
+        arr = pa.array(kc)
+        arrays.append(arr)
+        fields.append(pa.field(f"__key_{i}", arr.type))
+    meta = {b"n_keys": str(len(part["keys"])).encode()}
+    for op, plane in part["planes"].items():
+        plane2 = plane if plane.ndim == 2 else plane[:, None]
+        meta[f"f_{op}".encode()] = str(plane2.shape[1]).encode()
+        for j in range(plane2.shape[1]):
+            arr = pa.array(plane2[:, j])
+            arrays.append(arr)
+            fields.append(pa.field(f"__plane_{op}_{j}", arr.type))
+    return pa.Table.from_arrays(arrays,
+                                schema=pa.schema(fields, metadata=meta))
+
+
+def table_to_partial(t: pa.Table) -> dict:
+    meta = t.schema.metadata or {}
+    n_keys = int(meta[b"n_keys"])
+    keys = [t.column(f"__key_{i}").to_numpy(zero_copy_only=False)
+            for i in range(n_keys)]
+    planes: dict = {}
+    for k, v in meta.items():
+        if not k.startswith(b"f_"):
+            continue
+        op = k[2:].decode()
+        f = int(v)
+        cols = [t.column(f"__plane_{op}_{j}").to_numpy(zero_copy_only=False)
+                for j in range(f)]
+        planes[op] = np.stack(cols, axis=1)
+    return {"keys": keys, "planes": planes}
+
+
 def table_to_scan(t: pa.Table) -> ScanData:
     meta = t.schema.metadata or {}
     schema = Schema.from_dict(json.loads(meta[b"schema"].decode()))
@@ -186,6 +225,8 @@ class FlightServer(fl.FlightServerBase):
             else (query_engine.region_engine if query_engine else None)
         auth = _BasicServerAuth(user_provider) if user_provider else None
         self._auth = auth
+        # lazy executor for partial-aggregate pushdown tickets
+        self._agg_executor = None
         location = f"grpc://{host}:{port}"
         super().__init__(location, auth_handler=auth)
         self.host = host
@@ -217,6 +258,12 @@ class FlightServer(fl.FlightServerBase):
                 raise fl.FlightUnauthorizedError(
                     f"user {user.username!r} lacks read permission")
             return self._region_scan(req["region_scan"])
+        if "region_agg" in req:
+            user = self._resolve_user(context)
+            if user is not None and not user.can("read"):
+                raise fl.FlightUnauthorizedError(
+                    f"user {user.username!r} lacks read permission")
+            return self._region_agg(req["region_agg"])
         if self.qe is None:
             raise fl.FlightServerError("datanode service: region tickets only")
         ctx = QueryContext(db=req.get("db", "public"), channel=Channel.GRPC,
@@ -265,6 +312,29 @@ class FlightServer(fl.FlightServerBase):
             return fl.RecordBatchStream(pa.Table.from_arrays(
                 [], schema=pa.schema([], metadata={b"empty": b"1"})))
         return fl.RecordBatchStream(scan_to_table(scan))
+
+    def _region_agg(self, req: dict):
+        """Partial-aggregate pushdown: the fragment (plan_ser.AggFragment,
+        the substrait analog) executes against the LOCAL region and only
+        primitive planes cross the wire (reference dist_plan Partial step,
+        query/src/dist_plan/analyzer.rs:35)."""
+        from greptimedb_tpu.query.dist_agg import partial_region_agg
+        from greptimedb_tpu.query.plan_ser import AggFragment
+        from greptimedb_tpu.utils import tracing
+
+        region_id = req["region_id"]
+        frag = AggFragment.from_json(req["fragment"])
+        if req.get("trace_id"):
+            tracing.set_trace(req["trace_id"])
+        if self._agg_executor is None:
+            from greptimedb_tpu.query.physical import PhysicalExecutor
+            self._agg_executor = PhysicalExecutor(self.engine)
+        with tracing.span("region_agg", region=region_id):
+            part = partial_region_agg(self._agg_executor, region_id, frag)
+        if part is None:
+            return fl.RecordBatchStream(pa.Table.from_arrays(
+                [], schema=pa.schema([], metadata={b"empty": b"1"})))
+        return fl.RecordBatchStream(partial_to_table(part))
 
     # -- ingest ----------------------------------------------------------------
 
@@ -543,6 +613,24 @@ class RemoteRegionEngine:
         if (t.schema.metadata or {}).get(b"empty") == b"1":
             return None
         return table_to_scan(t)
+
+    def partial_agg(self, region_id: int, frag) -> Optional[dict]:
+        """Ship an AggFragment; receive this region's partial planes
+        (reference region_server.rs:623-660 — substrait plan in, stream
+        out; only per-group primitives cross the wire, not rows)."""
+        from greptimedb_tpu.utils import tracing
+
+        spec = {"region_id": region_id, "fragment": frag.to_json()}
+        tid = tracing.current_trace_id()
+        if tid:
+            spec["trace_id"] = tid
+        with tracing.span("remote_region_agg", region=region_id,
+                          addr=self.addr):
+            ticket = fl.Ticket(json.dumps({"region_agg": spec}).encode())
+            t = self.client.do_get(ticket).read_all()
+        if (t.schema.metadata or {}).get(b"empty") == b"1":
+            return None
+        return table_to_partial(t)
 
     def scan_stream(self, region_id: int, ts_range=None, projection=None,
                     tag_predicates=None):

@@ -294,6 +294,14 @@ class TestTracingAnalyze:
                   "GROUP BY host")
         text = "\n".join(row[0] for row in r.rows())
         assert "ANALYZE trace=" in text
+        # decomposable multi-region aggregate takes the pushdown path
+        assert "agg_pushdown:" in text
+        assert "execution path: pushdown" in text
+        # a host order-statistic is not decomposable: raw gather path,
+        # with per-region scan spans
+        r = c.sql("EXPLAIN ANALYZE SELECT host, median(usage_user) FROM cpu "
+                  "GROUP BY host")
+        text = "\n".join(row[0] for row in r.rows())
         assert "scan:" in text
         assert "device_agg:" in text
         c.close()
@@ -312,6 +320,14 @@ class TestTracingAnalyze:
         c.frontend.execute_one("SELECT count(*) FROM cpu", ctx)
         spans = tracing.spans_for("feedbeefcafe0001")
         names = {s.name for s in spans}
-        assert "remote_region_scan" in names
-        assert "region_scan" in names  # server-side span, same trace
+        # pushdown path: fragment client span + server-side span
+        assert "remote_region_agg" in names
+        assert "region_agg" in names
+        # non-decomposable aggregate exercises the raw scan transport
+        ctx2 = QueryContext(trace_id="feedbeefcafe0002")
+        c.frontend.execute_one(
+            "SELECT host, median(usage_user) FROM cpu GROUP BY host", ctx2)
+        names2 = {s.name for s in tracing.spans_for("feedbeefcafe0002")}
+        assert "remote_region_scan" in names2
+        assert "region_scan" in names2  # server-side span, same trace
         c.close()
