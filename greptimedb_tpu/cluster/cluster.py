@@ -228,10 +228,11 @@ class RegionRouter:
         from greptimedb_tpu.query.dist_agg import partial_region_agg
         from greptimedb_tpu.query.physical import PhysicalExecutor
 
-        ex = self._agg_executors.get(id(eng))
-        if ex is None:
-            ex = PhysicalExecutor(eng)
-            self._agg_executors[id(eng)] = ex
+        with self._lock:
+            ex = self._agg_executors.get(id(eng))
+            if ex is None:
+                ex = PhysicalExecutor(eng)
+                self._agg_executors[id(eng)] = ex
         return partial_region_agg(ex, region_id, frag)
 
     def alter_region_schema(self, region_id: int, schema) -> None:

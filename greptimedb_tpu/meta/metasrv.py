@@ -98,6 +98,10 @@ class Metasrv:
         self._lock = threading.RLock()
         # cache-invalidation fanout to frontends (cache crate analog)
         self._invalidation_subs: list[Callable[[str], None]] = []
+        # pub/sub plane: heartbeats fan out to subscribed components
+        # (meta-srv/src/pubsub/, e.g. frontend stats caches)
+        from .pubsub import SubscribeManager
+        self.pubsub = SubscribeManager()
         # HA: when an election is attached, leader-only APIs are fenced and
         # a newly-elected leader resumes the shared procedure store
         # (meta-srv/src/metasrv.rs try_start leader-only bootstrap)
@@ -261,7 +265,9 @@ class Metasrv:
             lease = now_ms + self.opts.region_lease_s * 1000
             if self.election is not None:
                 self._persist_node_info(req.node_id, now_ms)
-            return HeartbeatResponse(instructions=instructions, lease_deadline_ms=lease)
+        from .pubsub import TOPIC_HEARTBEAT
+        self.pubsub.publish(TOPIC_HEARTBEAT, req)
+        return HeartbeatResponse(instructions=instructions, lease_deadline_ms=lease)
 
     def send_instruction(self, node_id: str, inst: Instruction) -> None:
         """Queue an instruction for the node's next heartbeat (the mailbox,

@@ -67,7 +67,8 @@ def partial_region_agg(executor, region_id: int, frag: AggFragment,
     # .append_mode — a region-local shim stands in for the TableInfo the
     # frontend holds
     shim = SimpleNamespace(schema=schema, append_mode=frag.append_mode)
-    idx = executor._filtered_row_indices(scan, shim, ctx, bound_where)
+    idx = executor._filtered_row_indices(scan, shim, ctx, bound_where,
+                                         where_unbound=frag.where)
     if len(idx) == 0:
         return None
 
@@ -110,8 +111,17 @@ def partial_region_agg(executor, region_id: int, frag: AggFragment,
         num_groups = 1
 
     if frag.args:
-        planes = [np.asarray(eval_host(a, host, schema), dtype=np.float64)
-                  for a in frag.args]
+        planes = []
+        for a in frag.args:
+            p = np.asarray(eval_host(a, host, schema))
+            if p.dtype == object or p.dtype.kind in ("U", "S"):
+                # string argument: only count() rides pushdown (frontend
+                # gating), which needs just validity — 1.0 per non-null
+                p = np.where(
+                    np.asarray([v is None for v in p.ravel()]).reshape(p.shape)
+                    if p.dtype == object else np.zeros(p.shape, bool),
+                    np.nan, 1.0)
+            planes.append(np.asarray(p, dtype=np.float64))
         vals = np.stack([np.broadcast_to(p, (n,)) for p in planes], axis=1)
     else:
         vals = np.zeros((n, 1), dtype=np.float64)

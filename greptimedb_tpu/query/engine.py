@@ -36,12 +36,14 @@ from greptimedb_tpu.session import QueryContext  # noqa: E402
 
 class QueryEngine:
     def __init__(self, catalog: Catalog, region_engine: RegionEngine,
-                 metric_engine=None):
+                 metric_engine=None, plugins=None):
         from greptimedb_tpu.auth import PermissionChecker
+        from greptimedb_tpu.plugins import default_plugins
 
         self.catalog = catalog
         self.region_engine = region_engine
         self.permission_checker = PermissionChecker()
+        self.plugins = plugins if plugins is not None else default_plugins()
         self.executor = PhysicalExecutor(region_engine)
         self._open_regions: set[int] = set()
         if metric_engine is None and hasattr(region_engine, "register_opener"):
@@ -60,7 +62,18 @@ class QueryEngine:
 
     def execute_sql(self, sql: str, ctx: Optional[QueryContext] = None) -> list[QueryResult]:
         ctx = ctx or QueryContext()
-        return [self.execute_statement(s, ctx) for s in parse_sql(sql)]
+        # plugin interceptors may rewrite or veto the statement before
+        # parsing (reference SqlQueryInterceptor, frontend/src/instance.rs)
+        sql = self.plugins.intercept_sql(sql, ctx)
+        from greptimedb_tpu.plugins import reset_active, set_active
+
+        # expression evaluation resolves plugin scalar functions against
+        # THIS engine's container for the duration of the statement
+        token = set_active(self.plugins)
+        try:
+            return [self.execute_statement(s, ctx) for s in parse_sql(sql)]
+        finally:
+            reset_active(token)
 
     def execute_one(self, sql: str, ctx: Optional[QueryContext] = None) -> QueryResult:
         results = self.execute_sql(sql, ctx)

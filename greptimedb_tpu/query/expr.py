@@ -636,6 +636,12 @@ def _eval_host_func(e: ast.FuncCall, ev, schema):
     if name == "now":
         import time as _time
         return int(_time.time() * 1000)
+    # extension seam: plugin-registered scalar functions (resolved against
+    # the executing engine's container, falling back to the process default)
+    from greptimedb_tpu.plugins import active_plugins
+    plugin_fn = active_plugins().scalar_function(name)
+    if plugin_fn is not None:
+        return plugin_fn(*(ev(a) for a in e.args))
     raise PlanError(f"unsupported host function {name!r}")
 
 

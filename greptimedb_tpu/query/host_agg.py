@@ -144,3 +144,29 @@ def decoded_columns(scan) -> dict:
         else:
             out[name] = np.asarray(col)
     return out
+
+
+def compute_host_agg_str(func: str, gid: np.ndarray, values: np.ndarray,
+                         ts: Optional[np.ndarray], mask: np.ndarray,
+                         num_groups: int) -> np.ndarray:
+    """String-typed first/last/min/max: the device segment kernel only
+    reduces numbers (tag codes are dictionary positions, not orderable
+    values), so these pick per group from the decoded host values.
+    Returns an object array with None for empty groups."""
+    valid = mask & np.asarray([v is not None for v in values])
+    out = np.full(num_groups, None, dtype=object)
+    if not valid.any():
+        return out
+    gid_v = gid[valid]
+    val_v = values[valid]
+    if func in ("first", "last"):
+        ts_v = np.asarray(ts)[valid]
+        order = np.lexsort((ts_v, gid_v))
+    else:  # min / max — lexicographic over the string values
+        order = np.lexsort((val_v.astype(str), gid_v))
+    g_sorted = gid_v[order]
+    last = np.flatnonzero(np.r_[g_sorted[1:] != g_sorted[:-1], True])
+    first = np.r_[0, last[:-1] + 1]
+    pick = first if func in ("first", "min") else last
+    out[g_sorted[pick]] = val_v[order][pick]
+    return out

@@ -34,6 +34,7 @@ from greptimedb_tpu.query.expr import (
     BindContext,
     PlanError,
     bind_expr,
+    collect_columns,
     eval_device,
     eval_host,
 )
@@ -57,6 +58,22 @@ _PRIMITIVES = {
 }
 
 
+def _needs_host_agg(spec, schema) -> bool:
+    """True when a spec cannot ride the numeric device planes: order
+    statistics, or first/last/min/max over STRING-typed arguments (tag
+    codes are dictionary positions — reducing them yields positions, and
+    their order is insertion order, not lexicographic)."""
+    from greptimedb_tpu.query.host_agg import HOST_AGGS
+
+    if spec.func in HOST_AGGS:
+        return True
+    if spec.arg is None:
+        return False
+    dt = _infer_dtype(spec.arg, schema)
+    return (dt is not None and not (dt.is_numeric or dt.is_timestamp)
+            and spec.func in ("first", "last", "min", "max"))
+
+
 @dataclass(frozen=True)
 class DeviceKey:
     """One group-by key computed on device (static under jit)."""
@@ -69,6 +86,21 @@ class DeviceKey:
 
 
 # ---- fused per-block kernel ------------------------------------------------
+
+
+def _value_planes(agg_args, cols, tag_names, schema, shape, acc_dtype):
+    """Aggregate value matrix [N, F]. A tag column used as a VALUE maps
+    its NULL code (-1) to NaN so count()/min()/... skip NULL tags."""
+    vals = []
+    for a in agg_args:
+        v = eval_device(a, cols, tag_names, schema)
+        if jnp.ndim(v) == 0:
+            v = jnp.broadcast_to(v, shape)
+        v = v.astype(acc_dtype)
+        if isinstance(a, ast.Column) and a.name in tag_names:
+            v = jnp.where(cols[a.name] < 0, jnp.nan, v)
+        vals.append(v)
+    return jnp.stack(vals, axis=1)
 
 
 def _agg_block(
@@ -132,13 +164,8 @@ def _agg_block_masked(
     else:
         gid = jnp.zeros(mask.shape[0], dtype=jnp.int32)
     if agg_args:
-        vals = [eval_device(a, cols, tag_names, schema) for a in agg_args]
-        vals = [
-            jnp.broadcast_to(v, mask.shape).astype(acc_dtype)
-            if jnp.ndim(v) == 0 else v.astype(acc_dtype)
-            for v in vals
-        ]
-        values = jnp.stack(vals, axis=1)
+        values = _value_planes(agg_args, cols, tag_names, schema,
+                               mask.shape, acc_dtype)
     else:
         values = jnp.zeros((mask.shape[0], 1), dtype=acc_dtype)
     ts = cols[ts_name] if need_ts else None
@@ -317,13 +344,8 @@ def _agg_scan_sparse(
     ].set(sg, mode="drop")
 
     if agg_args:
-        vals = [eval_device(a, cols, tag_names, schema) for a in agg_args]
-        vals = [
-            jnp.broadcast_to(v, mask.shape).astype(acc_dtype)
-            if jnp.ndim(v) == 0 else v.astype(acc_dtype)
-            for v in vals
-        ]
-        values = jnp.stack(vals, axis=1)[order]
+        values = _value_planes(agg_args, cols, tag_names, schema,
+                               mask.shape, acc_dtype)[order]
     else:
         values = jnp.zeros((mask.shape[0], 1), dtype=acc_dtype)
     ts = cols[ts_name][order] if need_ts else None
@@ -519,8 +541,18 @@ class PhysicalExecutor:
         from greptimedb_tpu.query.plan_ser import AggFragment
         from greptimedb_tpu.utils import tracing
 
-        if any(s.func in HOST_AGGS for s in agg.aggs):
-            return None  # order statistics need raw values
+        if any(_needs_host_agg(s, table.schema) for s in agg.aggs):
+            return None  # needs raw values (order stats / string args)
+        for spec in agg.aggs:
+            if spec.arg is None:
+                continue
+            dt = _infer_dtype(spec.arg, table.schema)
+            if dt is not None and not (dt.is_numeric or dt.is_timestamp):
+                # string-typed argument: only count() decomposes into the
+                # float primitive planes (validity), everything else needs
+                # the raw values — fall back to the gather path
+                if spec.func not in ("count", "rows"):
+                    return None
         arg_exprs: list[ast.Expr] = []
         spec_slot: list[Optional[int]] = []
         for spec in agg.aggs:
@@ -536,10 +568,28 @@ class PhysicalExecutor:
         frag = AggFragment(
             keys=list(agg.keys), args=arg_exprs, ops=sorted(ops),
             where=where, ts_range=ts_range, append_mode=table.append_mode)
-        partials = []
         with tracing.span("agg_pushdown", regions=len(table.region_ids)):
-            for rid in table.region_ids:
-                partials.append(self.engine.partial_agg(rid, frag))
+            rids = list(table.region_ids)
+            if len(rids) > 1:
+                # independent region RPCs: fan out so wall-clock is the
+                # slowest region, not the sum (merge_scan polls all
+                # region streams concurrently for the same reason)
+                from concurrent.futures import ThreadPoolExecutor
+
+                tid = tracing.current_trace_id()
+
+                def one(rid):
+                    # contextvars don't cross thread-pool boundaries:
+                    # re-adopt the request trace in the worker
+                    if tid:
+                        tracing.set_trace(tid)
+                    return self.engine.partial_agg(rid, frag)
+
+                with ThreadPoolExecutor(
+                        max_workers=min(8, len(rids))) as pool:
+                    partials = list(pool.map(one, rids))
+            else:
+                partials = [self.engine.partial_agg(rids[0], frag)]
         combined = combine_partials(partials, len(agg.keys),
                                     tuple(frag.ops))
         self.last_path = "pushdown"
@@ -598,7 +648,7 @@ class PhysicalExecutor:
         arg_exprs: list[ast.Expr] = []
         spec_slot: list[Optional[int]] = []
         for spec in agg.aggs:
-            if spec.arg is None or spec.func in HOST_AGGS:
+            if spec.arg is None or _needs_host_agg(spec, schema):
                 spec_slot.append(None)
                 continue
             b = bind_expr(spec.arg, ctx)
@@ -607,7 +657,7 @@ class PhysicalExecutor:
             spec_slot.append(arg_exprs.index(b))
         ops: set = {"rows"}
         for spec in agg.aggs:
-            if spec.func not in HOST_AGGS:
+            if not _needs_host_agg(spec, schema):
                 ops.update(_PRIMITIVES[spec.func])
         need_ts = bool({"first", "last"} & ops)
 
@@ -648,9 +698,10 @@ class PhysicalExecutor:
             env[kexpr] = col
             key_cols[name] = (col, dtype)
         # aggregate outputs
-        host_specs = [s for s in agg.aggs if s.func in HOST_AGGS]
+        host_specs = [s for s in agg.aggs
+                      if _needs_host_agg(s, table.schema)]
         for spec, slot in zip(agg.aggs, spec_slot):
-            if spec.func in HOST_AGGS:
+            if _needs_host_agg(spec, table.schema):
                 continue
             env[spec.call] = _finalize_agg(spec.func, acc, slot, present)
         if host_specs:
@@ -691,7 +742,7 @@ class PhysicalExecutor:
         arg_exprs: list[ast.Expr] = []
         spec_slot: list[Optional[int]] = []
         for spec in agg.aggs:
-            if spec.func in HOST_AGGS:
+            if _needs_host_agg(spec, schema):
                 raise _NotStreamable(f"host aggregate {spec.func}")
             if spec.arg is None:
                 spec_slot.append(None)
@@ -837,7 +888,28 @@ class PhysicalExecutor:
         mask = ha.host_row_mask(
             scan, bound_where, table.schema, n,
             np.asarray(dmask)[:n] if dmask is not None else None)
+        ts_name = table.schema.time_index.name
         for spec in host_specs:
+            if spec.func not in ha.HOST_AGGS:
+                # string-typed first/last/min/max: decode the argument to
+                # real values and pick per group on host
+                from greptimedb_tpu.datatypes.vector import DictVector
+
+                if isinstance(spec.arg, ast.Column) and \
+                        spec.arg.name in scan.tag_dicts:
+                    vals = DictVector(
+                        scan.columns[spec.arg.name],
+                        scan.tag_dicts[spec.arg.name]).decode()
+                else:
+                    vals = np.asarray(eval_host(
+                        spec.arg, scan.columns, table.schema, None, n),
+                        dtype=object)
+                vals = np.broadcast_to(vals, (n,))
+                per_group = ha.compute_host_agg_str(
+                    spec.func, gid, vals,
+                    scan.columns[ts_name], mask, num_groups)
+                env[spec.call] = per_group[present]
+                continue
             bound_arg = bind_expr(spec.arg, ctx)
             vals = eval_host(bound_arg, scan.columns, table.schema, None, n)
             vals = np.broadcast_to(
@@ -1166,12 +1238,42 @@ class PhysicalExecutor:
 
     # ---- raw (non-aggregate) path ------------------------------------------
 
-    def _filtered_row_indices(self, scan, table, ctx, bound_where) -> np.ndarray:
+    def _filtered_row_indices(self, scan, table, ctx, bound_where,
+                              where_unbound=None) -> np.ndarray:
         """Row indices surviving WHERE + LWW dedup, computed blockwise on
-        device (shared by the raw scan and RANGE-select paths)."""
+        device (shared by the raw scan and RANGE-select paths).
+
+        String FIELD columns (non-tag, so not dict-coded) cannot become
+        device blocks; they stay host-side. A WHERE referencing one flips
+        the whole filter to host numpy evaluation — correct, just not
+        device-accelerated (string fields are metadata-shaped, e.g. the
+        OTLP trace table's span attributes)."""
         schema = table.schema
         dedup_mask = self._maybe_dedup(scan, table, ctx)
         n = scan.num_rows
+        obj_cols = {name for name, arr in scan.columns.items()
+                    if arr.dtype == object and name not in scan.tag_dicts}
+        referenced: set = set()
+        collect_columns(bound_where, referenced)
+        if referenced & obj_cols:
+            from greptimedb_tpu.datatypes.vector import DictVector
+
+            host_cols = {}
+            for name, arr in scan.columns.items():
+                if name in scan.tag_dicts:
+                    host_cols[name] = DictVector(
+                        arr, scan.tag_dicts[name]).decode()
+                else:
+                    host_cols[name] = arr
+            # the BOUND where compares dict codes; host strings need
+            # the original expression
+            w = where_unbound if where_unbound is not None else bound_where
+            m = np.asarray(eval_host(w, host_cols, schema))
+            m = (m if m.dtype == bool else m != 0)
+            m = np.broadcast_to(m, (n,)).copy()
+            if dedup_mask is not None:
+                m &= np.asarray(dedup_mask)[:n]
+            return np.flatnonzero(m)
         block = min(block_size_for(n), DEFAULT_BLOCK_ROWS)
         tag_names = frozenset(ctx.tag_names)
         picked: list[np.ndarray] = []
@@ -1180,6 +1282,7 @@ class PhysicalExecutor:
             cols = {
                 name: self._device_block(scan, name, start, end, block, {}, None)
                 for name in scan.columns
+                if name not in obj_cols
             }
             dmask = None
             if dedup_mask is not None:
@@ -1196,7 +1299,8 @@ class PhysicalExecutor:
             return _project_empty(project, schema)
         ctx = BindContext(schema, scan.tag_dicts)
         bound_where = bind_expr(where, ctx) if where is not None else None
-        idx = self._filtered_row_indices(scan, table, ctx, bound_where)
+        idx = self._filtered_row_indices(scan, table, ctx, bound_where,
+                                         where_unbound=where)
 
         # gather + decode on host
         host_cols: dict[str, np.ndarray] = {}

@@ -275,7 +275,8 @@ def execute_range_select(executor, rp: RangePlan):
 
     ctx = BindContext(schema, scan.tag_dicts)
     bound_where = bind_expr(rp.where, ctx) if rp.where is not None else None
-    idx = executor._filtered_row_indices(scan, table, ctx, bound_where)
+    idx = executor._filtered_row_indices(scan, table, ctx, bound_where,
+                                         where_unbound=rp.where)
     if len(idx) == 0:
         return empty_result()
 

@@ -100,8 +100,16 @@ def partial_to_table(part: dict) -> pa.Table:
 def table_to_partial(t: pa.Table) -> dict:
     meta = t.schema.metadata or {}
     n_keys = int(meta[b"n_keys"])
-    keys = [t.column(f"__key_{i}").to_numpy(zero_copy_only=False)
-            for i in range(n_keys)]
+    keys = []
+    for i in range(n_keys):
+        col = t.column(f"__key_{i}")
+        arr = col.to_numpy(zero_copy_only=False)
+        if arr.dtype.kind == "f" and np.isnan(arr).any():
+            # Arrow materialized a NULL key as NaN; the in-process path
+            # yields None — normalize so results don't depend on transport
+            arr = np.array([None if (isinstance(x, float) and x != x)
+                            else x for x in arr], dtype=object)
+        keys.append(arr)
     planes: dict = {}
     for k, v in meta.items():
         if not k.startswith(b"f_"):

@@ -177,6 +177,8 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._handle_prom_remote_read()
             if path in ("/v1/otlp/v1/metrics",):
                 return self._handle_otlp_metrics()
+            if path in ("/v1/otlp/v1/traces",):
+                return self._handle_otlp_traces()
             if path == "/v1/scripts":
                 return self._handle_scripts()
             if path == "/v1/run-script":
@@ -386,6 +388,15 @@ class _Handler(BaseHTTPRequestHandler):
         body = self._body()
         db = self._params().get("db", "public")
         n = handle_otlp_metrics(self.query_engine, body, db)
+        self._send(200, {"partialSuccess": {}})
+        _ = n
+
+    def _handle_otlp_traces(self):
+        from greptimedb_tpu.servers.otlp import handle_otlp_traces
+
+        body = self._body()
+        db = self._params().get("db", "public")
+        n = handle_otlp_traces(self.query_engine, body, db)
         self._send(200, {"partialSuccess": {}})
         _ = n
 

@@ -171,3 +171,132 @@ def handle_otlp_metrics(query_engine, body: bytes, db: str = "public") -> int:
     n = write_points(query_engine, db, points, precision="ms")
     INGEST_ROWS.inc(n)
     return n
+
+
+# ---------------------------------------------------------------- traces
+
+TRACE_TABLE_NAME = "opentelemetry_traces"
+
+TRACE_ROWS = REGISTRY.counter(
+    "greptime_servers_otlp_trace_rows", "spans ingested via otlp traces"
+)
+
+_SPAN_KINDS = {0: "SPAN_KIND_UNSPECIFIED", 1: "SPAN_KIND_INTERNAL",
+               2: "SPAN_KIND_SERVER", 3: "SPAN_KIND_CLIENT",
+               4: "SPAN_KIND_PRODUCER", 5: "SPAN_KIND_CONSUMER"}
+_STATUS_CODES = {0: "STATUS_CODE_UNSET", 1: "STATUS_CODE_OK",
+                 2: "STATUS_CODE_ERROR"}
+
+
+def _attrs_json(pairs: dict) -> str:
+    import json as _json
+
+    return _json.dumps(pairs, sort_keys=True)
+
+
+def _span_to_point(span: bytes, resource_attrs: dict, scope_name: str,
+                   scope_version: str) -> Point:
+    """One OTLP Span message -> one row (reference
+    servers/src/otlp/trace.rs write_span_to_row: trace ids are tags,
+    everything else fields, time index = span start)."""
+    trace_id = span_id = parent_span_id = ""
+    name = trace_state = ""
+    kind = 0
+    start_ns = end_ns = 0
+    attrs: dict[str, str] = {}
+    status_code, status_msg = 0, ""
+    n_events = n_links = 0
+    for f, _wt, v in pw.iter_fields(span):
+        if f == 1:
+            trace_id = v.hex()
+        elif f == 2:
+            span_id = v.hex()
+        elif f == 3:
+            trace_state = v.decode()
+        elif f == 4:
+            parent_span_id = v.hex()
+        elif f == 5:
+            name = v.decode()
+        elif f == 6:
+            kind = v
+        elif f == 7:
+            start_ns = v
+        elif f == 8:
+            end_ns = v
+        elif f == 9:
+            k, val = _keyvalue(v)
+            attrs[k] = val
+        elif f == 11:
+            n_events += 1
+        elif f == 13:
+            n_links += 1
+        elif f == 15:
+            for f2, _wt2, sv in pw.iter_fields(v):
+                if f2 == 2:
+                    status_msg = sv.decode()
+                elif f2 == 3:
+                    status_code = sv
+    return Point(
+        measurement=TRACE_TABLE_NAME,
+        tags=[("trace_id", trace_id), ("span_id", span_id),
+              ("parent_span_id", parent_span_id)],
+        fields=[
+            ("resource_attributes", _attrs_json(resource_attrs)),
+            ("scope_name", scope_name),
+            ("scope_version", scope_version),
+            ("trace_state", trace_state),
+            ("span_name", name),
+            ("span_kind", _SPAN_KINDS.get(int(kind), str(kind))),
+            ("span_status_code", _STATUS_CODES.get(int(status_code),
+                                                   str(status_code))),
+            ("span_status_message", status_msg),
+            ("span_attributes", _attrs_json(attrs)),
+            ("span_events_count", float(n_events)),
+            ("span_links_count", float(n_links)),
+            ("end", int(end_ns)),
+            ("duration_nano", float(max(end_ns - start_ns, 0))),
+        ],
+        ts=start_ns // 1_000_000,
+    )
+
+
+def parse_traces_request(body: bytes) -> list[Point]:
+    """ExportTraceServiceRequest: resource_spans(1) -> resource(1) +
+    scope_spans(2) -> scope(1) + spans(2)."""
+    out: list[Point] = []
+    for f, _wt, rs in pw.iter_fields(body):
+        if f != 1:
+            continue
+        resource_attrs: dict[str, str] = {}
+        scope_blocks: list[bytes] = []
+        for f2, _wt2, v in pw.iter_fields(rs):
+            if f2 == 1:  # Resource
+                for f3, _wt3, kv in pw.iter_fields(v):
+                    if f3 == 1:
+                        k, val = _keyvalue(kv)
+                        resource_attrs[k] = val
+            elif f2 == 2:  # ScopeSpans
+                scope_blocks.append(v)
+        for block in scope_blocks:
+            scope_name = scope_version = ""
+            spans: list[bytes] = []
+            for f2, _wt2, v in pw.iter_fields(block):
+                if f2 == 1:  # InstrumentationScope
+                    for f3, _wt3, sv in pw.iter_fields(v):
+                        if f3 == 1:
+                            scope_name = sv.decode()
+                        elif f3 == 2:
+                            scope_version = sv.decode()
+                elif f2 == 2:
+                    spans.append(v)
+            for span in spans:
+                out.append(_span_to_point(span, resource_attrs, scope_name,
+                                          scope_version))
+    return out
+
+
+def handle_otlp_traces(query_engine, body: bytes, db: str = "public") -> int:
+    points = parse_traces_request(body)
+    n = write_points(query_engine, db, points, precision="ms")
+    TRACE_ROWS.inc(n)
+    return n

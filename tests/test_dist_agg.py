@@ -139,6 +139,33 @@ class TestPushdownMatchesOracle:
         c.close()
 
 
+class TestStringArguments:
+    def test_count_of_string_column_pushes_down(self, tmp_path):
+        c = Cluster(str(tmp_path), num_datanodes=2, opts=MetasrvOptions())
+        c.create_partitioned_table(CREATE, host_rule("host1"))
+        c.sql("INSERT INTO cpu (host, region, usage_user, usage_system, ts) "
+              "VALUES ('host0', 'r0', 1.0, 1.0, 1000), "
+              "('host0', NULL, 2.0, 1.0, 2000), "
+              "('host2', 'r1', 3.0, 1.0, 1000)")
+        rows = c.sql("SELECT host, count(region) FROM cpu GROUP BY host "
+                     "ORDER BY host").rows()
+        assert rows == [["host0", 1], ["host2", 1]]
+        assert c.frontend.executor.last_path == "pushdown"
+        c.close()
+
+    def test_first_of_string_column_falls_back(self, tmp_path):
+        """first(tag) needs raw values — must fall back, not crash."""
+        c = Cluster(str(tmp_path), num_datanodes=2, opts=MetasrvOptions())
+        c.create_partitioned_table(CREATE, host_rule("host1"))
+        c.sql("INSERT INTO cpu (host, region, usage_user, usage_system, ts) "
+              "VALUES ('host0', 'r0', 1.0, 1.0, 1000), "
+              "('host0', 'r9', 2.0, 1.0, 2000)")
+        rows = c.sql("SELECT host, last(region) FROM cpu GROUP BY host").rows()
+        assert rows == [["host0", "r9"]]
+        assert c.frontend.executor.last_path != "pushdown"
+        c.close()
+
+
 class TestNullGroupKeys:
     @pytest.mark.parametrize("wire", [False, True], ids=["inproc", "wire"])
     def test_null_tag_group_survives_pushdown(self, tmp_path, wire):

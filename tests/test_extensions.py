@@ -1,0 +1,187 @@
+"""Pubsub, plugin system, and OTLP trace ingestion."""
+
+import json
+import struct
+
+import pytest
+
+from greptimedb_tpu.catalog.catalog import Catalog
+from greptimedb_tpu.catalog.kv import MemoryKv
+from greptimedb_tpu.meta.metasrv import HeartbeatRequest, Metasrv, RegionStat
+from greptimedb_tpu.meta.pubsub import TOPIC_HEARTBEAT, SubscribeManager
+from greptimedb_tpu.plugins import Plugins
+from greptimedb_tpu.query.engine import QueryEngine
+from greptimedb_tpu.storage.engine import EngineConfig, RegionEngine
+
+
+@pytest.fixture
+def qe(tmp_path):
+    engine = RegionEngine(EngineConfig(data_dir=str(tmp_path)))
+    q = QueryEngine(Catalog(MemoryKv()), engine)
+    yield q
+    engine.close()
+
+
+class TestPubsub:
+    def test_subscribe_publish_unsubscribe(self):
+        mgr = SubscribeManager()
+        got = []
+        sid = mgr.subscribe("fe-1", [TOPIC_HEARTBEAT],
+                            lambda t, m: got.append((t, m)))
+        assert mgr.publish(TOPIC_HEARTBEAT, {"node": "dn-1"}) == 1
+        assert got == [(TOPIC_HEARTBEAT, {"node": "dn-1"})]
+        assert mgr.publish("other_topic", {}) == 0
+        assert mgr.unsubscribe(sid)
+        assert mgr.publish(TOPIC_HEARTBEAT, {}) == 0
+
+    def test_unsubscribe_all_by_name(self):
+        mgr = SubscribeManager()
+        mgr.subscribe("fe-1", ["a"], lambda t, m: None)
+        mgr.subscribe("fe-1", ["b"], lambda t, m: None)
+        mgr.subscribe("fe-2", ["a"], lambda t, m: None)
+        assert mgr.unsubscribe_all("fe-1") == 2
+        assert len(mgr.subscribers_by_topic("a")) == 1
+
+    def test_failing_subscriber_does_not_block_fanout(self):
+        mgr = SubscribeManager()
+        got = []
+        mgr.subscribe("bad", ["t"], lambda t, m: 1 / 0)
+        mgr.subscribe("good", ["t"], lambda t, m: got.append(m))
+        assert mgr.publish("t", 42) == 1
+        assert got == [42]
+
+    def test_metasrv_publishes_heartbeats(self):
+        m = Metasrv(MemoryKv())
+        seen = []
+        m.pubsub.subscribe("stats-cache", [TOPIC_HEARTBEAT],
+                           lambda t, req: seen.append(req))
+        m.handle_heartbeat(HeartbeatRequest(
+            "dn-1", region_stats=[RegionStat(1, "t")], now_ms=0))
+        assert len(seen) == 1
+        assert seen[0].node_id == "dn-1"
+        assert seen[0].region_stats[0].region_id == 1
+
+
+class TestPlugins:
+    def test_typed_container(self):
+        class MyExt:
+            pass
+
+        p = Plugins()
+        ext = MyExt()
+        p.insert(ext)
+        assert p.get(MyExt) is ext
+        assert p.get(dict) is None
+
+    def test_sql_interceptor_rewrites_and_vetoes(self, qe):
+        seen = []
+
+        def audit(sql, ctx):
+            seen.append(sql)
+            if "forbidden_table" in sql:
+                raise PermissionError("vetoed by plugin")
+            return sql.replace("__MAGIC__", "42")
+
+        qe.plugins.register_sql_interceptor(audit)
+        try:
+            r = qe.execute_one("SELECT __MAGIC__ + 1")
+            assert r.rows() == [[43]]
+            assert seen
+            with pytest.raises(PermissionError, match="vetoed"):
+                qe.execute_one("SELECT * FROM forbidden_table")
+        finally:
+            qe.plugins._sql_interceptors.clear()
+
+    def test_scalar_function_plugin(self, qe):
+        qe.plugins.register_scalar_function(
+            "double_it", lambda v: v * 2)
+        try:
+            qe.execute_one(
+                "CREATE TABLE p (k STRING, v DOUBLE, ts TIMESTAMP TIME "
+                "INDEX, PRIMARY KEY(k))")
+            qe.execute_one("INSERT INTO p VALUES ('a', 3.5, 1000)")
+            r = qe.execute_one("SELECT k, double_it(v) FROM p")
+            assert r.rows() == [["a", 7.0]]
+        finally:
+            qe.plugins._scalar_functions.clear()
+
+    def test_setup_module_loading(self, tmp_path, monkeypatch):
+        mod = tmp_path / "my_plugin.py"
+        mod.write_text(
+            "def setup(plugins):\n"
+            "    plugins.register_scalar_function('forty_two', "
+            "lambda: 42)\n")
+        monkeypatch.syspath_prepend(str(tmp_path))
+        p = Plugins()
+        p.setup_module("my_plugin")
+        assert p.scalar_function("forty_two")() == 42
+
+
+def _varint(n):
+    out = b""
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        out += bytes([b | (0x80 if n else 0)])
+        if not n:
+            return out
+
+
+def _field(tag, wt, payload):
+    head = _varint((tag << 3) | wt)
+    if wt == 2:
+        return head + _varint(len(payload)) + payload
+    if wt == 1:
+        return head + payload
+    return head + _varint(payload)
+
+
+def _kv(key, val):
+    any_value = _field(1, 2, val.encode())
+    return _field(1, 2, key.encode()) + _field(2, 2, any_value)
+
+
+def _make_span(trace_id, span_id, name, start_ns, end_ns, kind=2):
+    body = _field(1, 2, trace_id)
+    body += _field(2, 2, span_id)
+    body += _field(5, 2, name.encode())
+    body += _field(6, 0, kind)
+    body += _field(7, 1, struct.pack("<Q", start_ns))
+    body += _field(8, 1, struct.pack("<Q", end_ns))
+    body += _field(9, 2, _kv("http.method", "GET"))
+    status = _field(3, 0, 1)  # STATUS_CODE_OK
+    body += _field(15, 2, status)
+    return body
+
+
+class TestOtlpTraces:
+    def test_traces_ingest_and_query(self, qe):
+        from greptimedb_tpu.servers.otlp import handle_otlp_traces
+
+        # ResourceSpans.resource -> Resource.attributes -> KeyValue
+        resource = _field(1, 2, _field(1, 2, _kv("service.name", "checkout")))
+        scope = _field(1, 2, _field(1, 2, b"my-lib") + _field(2, 2, b"1.0"))
+        spans = b"".join([
+            _field(2, 2, _make_span(b"\x01" * 16, b"\x0a" * 8, "GET /cart",
+                                    1_000_000_000, 1_250_000_000)),
+            _field(2, 2, _make_span(b"\x01" * 16, b"\x0b" * 8, "SELECT db",
+                                    1_050_000_000, 1_100_000_000, kind=3)),
+        ])
+        scope_spans = _field(2, 2, scope + spans)
+        body = _field(1, 2, resource + scope_spans)
+        n = handle_otlp_traces(qe, body)
+        assert n == 2
+        r = qe.execute_one(
+            "SELECT trace_id, span_name, span_kind, duration_nano "
+            "FROM opentelemetry_traces ORDER BY span_name")
+        rows = r.rows()
+        assert rows[0][0] == "01" * 16
+        assert rows[0][1] == "GET /cart"
+        assert rows[0][2] == "SPAN_KIND_SERVER"
+        assert rows[0][3] == pytest.approx(250_000_000.0)
+        assert rows[1][2] == "SPAN_KIND_CLIENT"
+        # resource attributes survive as JSON
+        r = qe.execute_one(
+            "SELECT resource_attributes FROM opentelemetry_traces LIMIT 1")
+        attrs = json.loads(r.rows()[0][0])
+        assert attrs["service.name"] == "checkout"
