@@ -1,0 +1,218 @@
+"""S3-compatible object-store backend (AWS SigV4 over plain HTTP(S)).
+
+Mirrors reference src/datanode/src/store/s3.rs (+ oss.rs / gcs.rs /
+azblob.rs, selected at store.rs:44-116 via OpenDAL). One REST backend
+covers the practical surface here: AWS S3, MinIO, Ceph RGW, and the
+S3-compatible modes of OSS and GCS all speak this API — endpoint +
+credentials select the vendor (see `from_url`). Implemented with the
+standard library only (urllib + hmac): request signing is AWS Signature
+Version 4 with the payload hash in x-amz-content-sha256.
+
+This environment has no egress, so conformance is tested against an
+in-process fake S3 server that validates the SigV4 signature by
+recomputation (tests/test_objectstore_s3.py).
+"""
+
+from __future__ import annotations
+
+import datetime
+import hashlib
+import hmac
+import os
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Optional
+
+from greptimedb_tpu.objectstore import ObjectStore, ObjectStoreError
+
+
+def _sha256(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def _hmac(key: bytes, msg: str) -> bytes:
+    return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+
+def sign_v4(method: str, url: str, headers: dict, payload_hash: str,
+            access_key: str, secret_key: str, region: str,
+            service: str = "s3",
+            now: Optional[datetime.datetime] = None) -> dict:
+    """Return the headers to add (Authorization, x-amz-date,
+    x-amz-content-sha256) for an AWS SigV4-signed request."""
+    now = now or datetime.datetime.now(datetime.timezone.utc)
+    amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+    datestamp = now.strftime("%Y%m%d")
+    parsed = urllib.parse.urlsplit(url)
+    canonical_uri = urllib.parse.quote(parsed.path or "/", safe="/-_.~")
+    # canonical query: sorted by key, values URI-encoded
+    q = urllib.parse.parse_qsl(parsed.query, keep_blank_values=True)
+    canonical_query = "&".join(
+        f"{urllib.parse.quote(k, safe='-_.~')}="
+        f"{urllib.parse.quote(v, safe='-_.~')}"
+        for k, v in sorted(q))
+    all_headers = {
+        **{k.lower(): v.strip() for k, v in headers.items()},
+        "host": parsed.netloc,
+        "x-amz-content-sha256": payload_hash,
+        "x-amz-date": amz_date,
+    }
+    signed_names = ";".join(sorted(all_headers))
+    canonical_headers = "".join(
+        f"{k}:{all_headers[k]}\n" for k in sorted(all_headers))
+    canonical_request = "\n".join([
+        method, canonical_uri, canonical_query, canonical_headers,
+        signed_names, payload_hash,
+    ])
+    scope = f"{datestamp}/{region}/{service}/aws4_request"
+    string_to_sign = "\n".join([
+        "AWS4-HMAC-SHA256", amz_date, scope,
+        _sha256(canonical_request.encode()),
+    ])
+    k_date = _hmac(b"AWS4" + secret_key.encode(), datestamp)
+    k_region = _hmac(k_date, region)
+    k_service = _hmac(k_region, service)
+    k_signing = _hmac(k_service, "aws4_request")
+    signature = hmac.new(k_signing, string_to_sign.encode(),
+                         hashlib.sha256).hexdigest()
+    auth = (
+        f"AWS4-HMAC-SHA256 Credential={access_key}/{scope}, "
+        f"SignedHeaders={signed_names}, Signature={signature}"
+    )
+    return {
+        "Authorization": auth,
+        "x-amz-date": amz_date,
+        "x-amz-content-sha256": payload_hash,
+    }
+
+
+class S3Store(ObjectStore):
+    """Bucket + key-prefix store over the S3 REST API."""
+
+    def __init__(self, bucket: str, prefix: str = "", *,
+                 endpoint: Optional[str] = None,
+                 access_key: Optional[str] = None,
+                 secret_key: Optional[str] = None,
+                 region: str = "us-east-1"):
+        self.bucket = bucket
+        self.prefix = prefix.strip("/")
+        self.endpoint = (endpoint
+                         or os.environ.get("S3_ENDPOINT")
+                         or f"https://s3.{region}.amazonaws.com").rstrip("/")
+        self.access_key = access_key or os.environ.get("AWS_ACCESS_KEY_ID", "")
+        self.secret_key = secret_key or os.environ.get(
+            "AWS_SECRET_ACCESS_KEY", "")
+        self.region = region
+
+    # ------------------------------------------------------------ plumbing
+    def _key(self, key: str) -> str:
+        key = key.lstrip("/")
+        return f"{self.prefix}/{key}" if self.prefix else key
+
+    def _url(self, key: str = "", query: str = "") -> str:
+        # path-style addressing: endpoint/bucket/key — what MinIO and
+        # S3-compatible vendors accept universally
+        path = f"/{self.bucket}"
+        if key:
+            path += "/" + urllib.parse.quote(self._key(key), safe="/-_.~")
+        return self.endpoint + path + (f"?{query}" if query else "")
+
+    def _request(self, method: str, url: str, data: bytes = b"") -> bytes:
+        payload_hash = _sha256(data)
+        headers = sign_v4(method, url, {}, payload_hash,
+                          self.access_key, self.secret_key, self.region)
+        req = urllib.request.Request(url, data=data or None, method=method,
+                                     headers=headers)
+        try:
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                return resp.read()
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                raise ObjectStoreError(f"not found: {url}") from None
+            raise ObjectStoreError(
+                f"s3 {method} {url}: HTTP {e.code} "
+                f"{e.read()[:200]!r}") from None
+        except urllib.error.URLError as e:
+            raise ObjectStoreError(f"s3 {method} {url}: {e}") from None
+
+    # ------------------------------------------------------------- surface
+    def read(self, key: str) -> bytes:
+        return self._request("GET", self._url(key))
+
+    def write(self, key: str, data: bytes) -> None:
+        self._request("PUT", self._url(key), data)
+
+    def delete(self, key: str) -> None:
+        self._request("DELETE", self._url(key))
+
+    def exists(self, key: str) -> bool:
+        try:
+            self._request("HEAD", self._url(key))
+            return True
+        except ObjectStoreError:
+            return False
+
+    def size(self, key: str) -> int:
+        # HEAD gives no body through urlopen().read(); issue a ranged GET
+        # of zero bytes? Simplest portable: full GET is wasteful, so use
+        # list-objects on the exact key
+        target = self._key(key)
+        for k, sz in self._list_with_sizes(target):
+            if k == target:
+                return sz
+        raise ObjectStoreError(f"not found: {key}")
+
+    def list(self, prefix: str) -> list[str]:
+        full = self._key(prefix)
+        plen = len(self.prefix) + 1 if self.prefix else 0
+        return [k[plen:] for k, _ in self._list_with_sizes(full)]
+
+    def _list_with_sizes(self, full_prefix: str) -> list[tuple[str, int]]:
+        """ListObjectsV2 with continuation (minimal XML scrape — the
+        response schema is stable enough that a parser dependency isn't
+        warranted)."""
+        import re
+
+        out: list[tuple[str, int]] = []
+        token = None
+        while True:
+            q = ("list-type=2&prefix="
+                 + urllib.parse.quote(full_prefix, safe=""))
+            if token:
+                q += "&continuation-token=" + urllib.parse.quote(token,
+                                                                 safe="")
+            body = self._request("GET", self._url("", q)).decode()
+            for m in re.finditer(
+                    r"<Contents>.*?<Key>(.*?)</Key>.*?<Size>(\d+)</Size>"
+                    r".*?</Contents>", body, re.S):
+                out.append((m.group(1), int(m.group(2))))
+            t = re.search(r"<NextContinuationToken>(.*?)"
+                          r"</NextContinuationToken>", body)
+            if not t:
+                return out
+            token = t.group(1)
+
+    def open_input(self, key: str):
+        import pyarrow as pa
+
+        return pa.BufferReader(pa.py_buffer(self.read(key)))
+
+
+def from_url(url: str, **kw) -> ObjectStore:
+    """Backend selection by URL scheme (store.rs:44-116 analog):
+    s3://bucket/prefix, oss://bucket/prefix (via OSS's S3-compatible
+    endpoint), gs://bucket/prefix (GCS XML API)."""
+    p = urllib.parse.urlsplit(url)
+    bucket, prefix = p.netloc, p.path.strip("/")
+    if p.scheme == "s3":
+        return S3Store(bucket, prefix, **kw)
+    if p.scheme == "oss":
+        region = kw.pop("region", os.environ.get("OSS_REGION",
+                                                 "oss-cn-hangzhou"))
+        kw.setdefault("endpoint", f"https://{region}.aliyuncs.com")
+        return S3Store(bucket, prefix, region=region, **kw)
+    if p.scheme == "gs":
+        kw.setdefault("endpoint", "https://storage.googleapis.com")
+        return S3Store(bucket, prefix, **kw)
+    raise ObjectStoreError(f"unsupported object store scheme {p.scheme!r}")

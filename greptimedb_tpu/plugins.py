@@ -54,12 +54,16 @@ def reset_active(token) -> None:
 
 def default_plugins() -> "Plugins":
     """Process-wide default container (what standalone mode threads
-    through engine + servers when no explicit Plugins is passed)."""
+    through engine + servers when no explicit Plugins is passed).
+    Publication happens only after a successful env load — a broken
+    plugin module raises on EVERY call instead of leaving a silently
+    partial container behind."""
     global _default
     with _default_lock:
         if _default is None:
-            _default = Plugins()
-            _default.load_from_env()
+            p = Plugins()
+            p.load_from_env()
+            _default = p
         return _default
 
 

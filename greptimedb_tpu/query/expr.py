@@ -98,7 +98,9 @@ def bind_expr(e: ast.Expr, ctx: BindContext) -> ast.Expr:
                 rx = _like_to_regex(str(r.value))
                 codes = ctx.codes_matching(l.name, lambda v: rx.fullmatch(v) is not None)
                 return ast.InList(l, tuple(ast.Literal(c) for c in codes))
-            raise PlanError("LIKE is only supported on tag columns")
+            # non-tag LIKE (string FIELD columns): pass through — the host
+            # filter path evaluates it; the device path raises at eval
+            return ast.BinaryOp(e.op, bind_expr(l, ctx), bind_expr(r, ctx))
         return ast.BinaryOp(e.op, bind_expr(l, ctx), bind_expr(r, ctx))
     if isinstance(e, ast.UnaryOp):
         return ast.UnaryOp(e.op, bind_expr(e.operand, ctx))
@@ -147,6 +149,21 @@ def _lit(e: ast.Expr):
     if not isinstance(e, ast.Literal):
         raise PlanError(f"expected literal, got {e}")
     return e.value
+
+
+class HostBindContext(BindContext):
+    """Binding for host-side evaluation over DECODED columns: timestamp
+    literals still coerce to the column unit, but tag comparisons stay as
+    string comparisons (no dictionary-code rewriting — host rows carry
+    real strings, not codes)."""
+
+    def __post_init__(self):
+        super().__post_init__()
+        self.tag_names = set()
+
+
+def bind_host_expr(e, schema):
+    return bind_expr(e, HostBindContext(schema, {}))
 
 
 def _tag_side(l, r, ctx):

@@ -1255,25 +1255,20 @@ class PhysicalExecutor:
                     if arr.dtype == object and name not in scan.tag_dicts}
         referenced: set = set()
         collect_columns(bound_where, referenced)
-        if referenced & obj_cols:
-            from greptimedb_tpu.datatypes.vector import DictVector
+        if not referenced & obj_cols:
+            try:
+                return self._device_filtered_indices(
+                    scan, schema, ctx, bound_where, dedup_mask, obj_cols, n)
+            except PlanError:
+                # a WHERE construct the device evaluator doesn't cover
+                # (e.g. a plugin scalar function): host filter below
+                pass
+        return self._host_filtered_indices(
+            scan, schema, bound_where, where_unbound, dedup_mask,
+            referenced, n)
 
-            host_cols = {}
-            for name, arr in scan.columns.items():
-                if name in scan.tag_dicts:
-                    host_cols[name] = DictVector(
-                        arr, scan.tag_dicts[name]).decode()
-                else:
-                    host_cols[name] = arr
-            # the BOUND where compares dict codes; host strings need
-            # the original expression
-            w = where_unbound if where_unbound is not None else bound_where
-            m = np.asarray(eval_host(w, host_cols, schema))
-            m = (m if m.dtype == bool else m != 0)
-            m = np.broadcast_to(m, (n,)).copy()
-            if dedup_mask is not None:
-                m &= np.asarray(dedup_mask)[:n]
-            return np.flatnonzero(m)
+    def _device_filtered_indices(self, scan, schema, ctx, bound_where,
+                                 dedup_mask, obj_cols, n) -> np.ndarray:
         block = min(block_size_for(n), DEFAULT_BLOCK_ROWS)
         tag_names = frozenset(ctx.tag_names)
         picked: list[np.ndarray] = []
@@ -1292,6 +1287,37 @@ class PhysicalExecutor:
                                  tag_names=tag_names, schema=schema)
             picked.append(np.flatnonzero(np.asarray(mask)) + start)
         return np.concatenate(picked) if picked else np.empty(0, dtype=np.int64)
+
+    def _host_filtered_indices(self, scan, schema, bound_where,
+                               where_unbound, dedup_mask, referenced,
+                               n) -> np.ndarray:
+        """Numpy filter over host columns: tags referenced by the WHERE
+        decode to strings (the bound expression's code rewriting doesn't
+        apply here, but timestamp-literal coercion still must — see
+        bind_host_expr)."""
+        from greptimedb_tpu.datatypes.vector import DictVector
+        from greptimedb_tpu.query.expr import bind_host_expr
+
+        host_cols = {}
+        for name, arr in scan.columns.items():
+            if name in scan.tag_dicts:
+                if name not in referenced:
+                    continue  # decoding is O(n) python objects — skip
+                host_cols[name] = DictVector(
+                    arr, scan.tag_dicts[name]).decode()
+            else:
+                host_cols[name] = arr
+        w = bind_host_expr(where_unbound, schema) \
+            if where_unbound is not None else bound_where
+        if w is None:
+            m = np.ones(n, dtype=bool)
+        else:
+            m = np.asarray(eval_host(w, host_cols, schema))
+            m = (m if m.dtype == bool else m != 0)
+            m = np.broadcast_to(m, (n,)).copy()
+        if dedup_mask is not None:
+            m &= np.asarray(dedup_mask)[:n]
+        return np.flatnonzero(m)
 
     def _execute_raw(self, scan, table, where, project, sort, limit, offset) -> QueryResult:
         schema = table.schema

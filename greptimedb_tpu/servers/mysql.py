@@ -35,6 +35,7 @@ CLIENT_PLUGIN_AUTH = 0x00080000
 CLIENT_SECURE_CONNECTION = 0x00008000
 CLIENT_LONG_PASSWORD = 0x00000001
 CLIENT_TRANSACTIONS = 0x00002000
+CLIENT_SSL = 0x00000800
 
 SERVER_CAPS = (
     CLIENT_PROTOCOL_41
@@ -136,6 +137,7 @@ class _Session(socketserver.BaseRequestHandler):
         server: MysqlServer = self.server.owner  # type: ignore[attr-defined]
         # ---- handshake v10 ----
         import secrets
+        caps_offered = SERVER_CAPS | (CLIENT_SSL if server.tls else 0)
         salt = bytes(secrets.choice(range(0x21, 0x7F)) for _ in range(20))
         hs = (
             b"\x0a"  # protocol version 10
@@ -143,10 +145,10 @@ class _Session(socketserver.BaseRequestHandler):
             + struct.pack("<I", threading.get_ident() & 0xFFFFFFFF)
             + salt[:8]
             + b"\x00"
-            + struct.pack("<H", SERVER_CAPS & 0xFFFF)
+            + struct.pack("<H", caps_offered & 0xFFFF)
             + bytes([0x21])  # utf8_general_ci
             + struct.pack("<H", 0x0002)  # status: autocommit
-            + struct.pack("<H", (SERVER_CAPS >> 16) & 0xFFFF)
+            + struct.pack("<H", (caps_offered >> 16) & 0xFFFF)
             + bytes([21])  # auth plugin data len
             + b"\x00" * 10
             + salt[8:]
@@ -156,6 +158,25 @@ class _Session(socketserver.BaseRequestHandler):
         io.send_packet(hs)
         resp = io.read_packet()
         if resp is None:
+            return
+        # SSLRequest: caps with CLIENT_SSL set and NO username — the
+        # client upgrades the connection before re-sending the real
+        # HandshakeResponse over TLS (protocol::connection_phase)
+        tls_active = False
+        if len(resp) >= 4 and len(resp) < 36 \
+                and struct.unpack("<I", resp[:4])[0] & CLIENT_SSL:
+            if server.tls is None:
+                return  # offered no TLS but client demanded it
+            self.request = server.tls_context.wrap_socket(
+                self.request, server_side=True)
+            io.sock = self.request  # sequence id continues
+            tls_active = True
+            resp = io.read_packet()
+            if resp is None:
+                return
+        if server.tls is not None and server.tls.mode == "require" \
+                and not tls_active:
+            io.send_packet(_err(3159, "HY000", "connections must use TLS"))
             return
         # HandshakeResponse41: capabilities(4) maxpkt(4) charset(1) filler(23)
         # then NUL-terminated username
@@ -603,9 +624,11 @@ class MysqlServer:
     """Threaded MySQL server over the shared QueryEngine."""
 
     def __init__(self, query_engine: QueryEngine, host: str = "127.0.0.1",
-                 port: int = 4002, user_provider=None):
+                 port: int = 4002, user_provider=None, tls=None):
         self.query_engine = query_engine
         self.user_provider = user_provider
+        self.tls = tls
+        self.tls_context = tls.make_context() if tls is not None else None
         self._server = _TcpServer((host, port), _Session)
         self._server.owner = self
         self.port = self._server.server_address[1]

@@ -152,11 +152,23 @@ class _Session(socketserver.BaseRequestHandler):
                 return None
             (code,) = struct.unpack("!I", body[:4])
             if code == SSL_REQUEST_CODE:
-                self.request.sendall(b"N")  # no TLS
+                if server.tls is None:
+                    self.request.sendall(b"N")  # no TLS configured
+                    continue
+                # 'S' then upgrade the accepted socket in place
+                self.request.sendall(b"S")
+                self.request = server.tls_context.wrap_socket(
+                    self.request, server_side=True)
+                conn.sock = self.request
+                self._tls_active = True
                 continue
             if code == CANCEL_REQUEST_CODE:
                 return None
             if code != PROTOCOL_3:
+                return None
+            if server.tls is not None and server.tls.mode == "require" \
+                    and not getattr(self, "_tls_active", False):
+                self._error(conn, "server requires TLS (sslmode=require)")
                 return None
             parts = body[4:].split(b"\x00")
             params = {}
@@ -280,9 +292,11 @@ class _TcpServer(socketserver.ThreadingTCPServer):
 
 class PostgresServer:
     def __init__(self, query_engine: QueryEngine, host: str = "127.0.0.1",
-                 port: int = 4003, user_provider=None):
+                 port: int = 4003, user_provider=None, tls=None):
         self.query_engine = query_engine
         self.user_provider = user_provider
+        self.tls = tls
+        self.tls_context = tls.make_context() if tls is not None else None
         self._server = _TcpServer((host, port), _Session)
         self._server.owner = self
         self.port = self._server.server_address[1]

@@ -105,6 +105,32 @@ class TestPlugins:
         finally:
             qe.plugins._scalar_functions.clear()
 
+    def test_plugin_function_in_where_clause(self, qe):
+        """A plugin scalar function inside WHERE routes the filter to
+        host evaluation instead of failing on the device path."""
+        qe.plugins.register_scalar_function("double_it", lambda v: v * 2)
+        try:
+            qe.execute_one(
+                "CREATE TABLE pw (k STRING, v DOUBLE, ts TIMESTAMP TIME "
+                "INDEX, PRIMARY KEY(k))")
+            qe.execute_one(
+                "INSERT INTO pw VALUES ('a', 1.0, 1000), ('b', 2.0, 2000)")
+            r = qe.execute_one("SELECT k FROM pw WHERE double_it(v) > 3")
+            assert r.rows() == [["b"]]
+        finally:
+            qe.plugins._scalar_functions.clear()
+
+    def test_broken_env_plugin_raises_every_time(self, monkeypatch):
+        import greptimedb_tpu.plugins as plug
+
+        monkeypatch.setenv("GREPTIMEDB_TPU_PLUGINS", "no_such_plugin_mod")
+        monkeypatch.setattr(plug, "_default", None)
+        with pytest.raises(ModuleNotFoundError):
+            plug.default_plugins()
+        # not cached as a partial container: still raises
+        with pytest.raises(ModuleNotFoundError):
+            plug.default_plugins()
+
     def test_setup_module_loading(self, tmp_path, monkeypatch):
         mod = tmp_path / "my_plugin.py"
         mod.write_text(
@@ -152,6 +178,27 @@ def _make_span(trace_id, span_id, name, start_ns, end_ns, kind=2):
     status = _field(3, 0, 1)  # STATUS_CODE_OK
     body += _field(15, 2, status)
     return body
+
+
+class TestStringFieldFilters:
+    def test_string_field_where_with_ts_literal(self, qe):
+        """Mixing a string-field predicate with a timestamp comparison:
+        the host filter must still coerce the ts literal to the column
+        unit (bind_host_expr)."""
+        qe.execute_one(
+            "CREATE TABLE notes (k STRING, note STRING, ts TIMESTAMP "
+            "TIME INDEX, PRIMARY KEY(k))")
+        qe.execute_one(
+            "INSERT INTO notes VALUES ('a', 'keep', 1000), "
+            "('b', 'drop', 2000), ('c', 'keep', 3000)")
+        r = qe.execute_one(
+            "SELECT k FROM notes WHERE note = 'keep' AND ts >= 2000 "
+            "ORDER BY k")
+        assert r.rows() == [["c"]]
+        # LIKE over a string FIELD column (not a tag)
+        r = qe.execute_one(
+            "SELECT k FROM notes WHERE note LIKE 'ke%' ORDER BY k")
+        assert r.rows() == [["a"], ["c"]]
 
 
 class TestOtlpTraces:

@@ -1,0 +1,183 @@
+"""TLS on the MySQL and PostgreSQL wire protocols: STARTTLS-style
+upgrades mid-handshake, 'require' mode rejecting plaintext."""
+
+import socket
+import ssl
+import struct
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(0, str(__import__("pathlib").Path(__file__).parent))
+from test_wire_protocols import MiniMysql  # noqa: E402
+
+from greptimedb_tpu.catalog.catalog import Catalog
+from greptimedb_tpu.catalog.kv import MemoryKv
+from greptimedb_tpu.query.engine import QueryEngine
+from greptimedb_tpu.servers.mysql import MysqlServer
+from greptimedb_tpu.servers.postgres import PostgresServer
+from greptimedb_tpu.servers.tls import TlsConfig
+from greptimedb_tpu.storage.engine import EngineConfig, RegionEngine
+
+
+@pytest.fixture(scope="module")
+def certs(tmp_path_factory):
+    d = tmp_path_factory.mktemp("certs")
+    cert, key = str(d / "server.crt"), str(d / "server.key")
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", key, "-out", cert, "-days", "1",
+         "-subj", "/CN=127.0.0.1"],
+        check=True, capture_output=True)
+    return cert, key
+
+
+@pytest.fixture
+def db(tmp_path):
+    engine = RegionEngine(EngineConfig(data_dir=str(tmp_path)))
+    qe = QueryEngine(Catalog(MemoryKv()), engine)
+    qe.execute_one(
+        "CREATE TABLE cpu (host STRING, usage DOUBLE, ts TIMESTAMP "
+        "TIME INDEX, PRIMARY KEY(host))")
+    qe.execute_one("INSERT INTO cpu VALUES ('a', 1.5, 1000)")
+    yield qe
+    engine.close()
+
+
+class TlsMiniMysql(MiniMysql):
+    """MiniMysql that sends SSLRequest and upgrades before auth."""
+
+    def _handshake(self, db):
+        greeting = self._read_packet()
+        assert greeting[0] == 0x0A
+        # greeting advertises CLIENT_SSL (0x800 in the low cap bits)
+        caps_lo = struct.unpack_from("<H", greeting, greeting.index(b"\x00", 1) + 13)[0]
+        assert caps_lo & 0x0800, "server did not offer TLS"
+        caps = 0x0200 | 0x8000 | 0x0800
+        ssl_req = struct.pack("<I", caps) + struct.pack("<I", 1 << 24) \
+            + bytes([0x21]) + b"\x00" * 23
+        self._send(ssl_req)
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+        self.sock = ctx.wrap_socket(self.sock)
+        resp = struct.pack("<I", caps) + struct.pack("<I", 1 << 24) \
+            + bytes([0x21]) + b"\x00" * 23
+        resp += b"testuser\x00" + b"\x00"
+        self._send(resp)
+        ok = self._read_packet()
+        assert ok[0] == 0x00, f"auth failed over TLS: {ok!r}"
+
+
+class TestMysqlTls:
+    def test_query_over_tls(self, db, certs):
+        srv = MysqlServer(db, port=0, tls=TlsConfig(*certs))
+        srv.start()
+        try:
+            c = TlsMiniMysql(srv.port)
+            assert isinstance(c.sock, ssl.SSLSocket)
+            kind, cols, rows = c.query("SELECT host, usage FROM cpu")
+            assert rows == [["a", "1.5"]]
+            # prepared statements work through the TLS socket too
+            stmt, _ = c.prepare("SELECT usage FROM cpu WHERE host = ?")
+            _, _, rows = c.execute(stmt, ("a",))
+            assert rows == [["1.5"]]
+            c.close()
+        finally:
+            srv.shutdown()
+
+    def test_plaintext_allowed_in_prefer_mode(self, db, certs):
+        srv = MysqlServer(db, port=0,
+                          tls=TlsConfig(*certs, mode="prefer"))
+        srv.start()
+        try:
+            c = MiniMysql(srv.port)  # no SSLRequest
+            _, _, rows = c.query("SELECT count(*) FROM cpu")
+            assert rows == [["1"]]
+            c.close()
+        finally:
+            srv.shutdown()
+
+    def test_plaintext_rejected_in_require_mode(self, db, certs):
+        srv = MysqlServer(db, port=0,
+                          tls=TlsConfig(*certs, mode="require"))
+        srv.start()
+        try:
+            with pytest.raises(AssertionError, match="auth failed"):
+                MiniMysql(srv.port)
+        finally:
+            srv.shutdown()
+
+
+class TestPostgresTls:
+    def _ssl_request(self, port):
+        s = socket.create_connection(("127.0.0.1", port), timeout=10)
+        s.sendall(struct.pack("!II", 8, 80877103))
+        return s, s.recv(1)
+
+    def test_ssl_request_accepted_and_query_runs(self, db, certs):
+        srv = PostgresServer(db, port=0, tls=TlsConfig(*certs))
+        srv.start()
+        try:
+            s, answer = self._ssl_request(srv.port)
+            assert answer == b"S"
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+            tls_sock = ctx.wrap_socket(s)
+            # startup over TLS
+            params = b"user\x00tester\x00database\x00public\x00\x00"
+            body = struct.pack("!I", 196608) + params
+            tls_sock.sendall(struct.pack("!I", len(body) + 4) + body)
+            # read until ReadyForQuery ('Z')
+            buf = b""
+            while b"Z" not in buf[:1] and len(buf) < 4096:
+                chunk = tls_sock.recv(4096)
+                if not chunk:
+                    break
+                buf += chunk
+                if buf and buf[-6:-5] == b"Z":
+                    break
+            assert b"server_version" in buf
+            # simple query
+            q = b"SELECT count(*) FROM cpu\x00"
+            tls_sock.sendall(b"Q" + struct.pack("!I", len(q) + 4) + q)
+            out = b""
+            while b"ready" not in out and len(out) < 8192:
+                chunk = tls_sock.recv(4096)
+                if not chunk:
+                    break
+                out += chunk
+                if out[-6:-5] == b"Z":
+                    break
+            assert b"1" in out  # the count value crosses the TLS socket
+            tls_sock.close()
+        finally:
+            srv.shutdown()
+
+    def test_ssl_request_refused_without_config(self, db):
+        srv = PostgresServer(db, port=0)
+        srv.start()
+        try:
+            s, answer = self._ssl_request(srv.port)
+            assert answer == b"N"
+            s.close()
+        finally:
+            srv.shutdown()
+
+    def test_require_mode_rejects_plaintext_startup(self, db, certs):
+        srv = PostgresServer(db, port=0,
+                             tls=TlsConfig(*certs, mode="require"))
+        srv.start()
+        try:
+            s = socket.create_connection(("127.0.0.1", srv.port), timeout=10)
+            params = b"user\x00tester\x00\x00"
+            body = struct.pack("!I", 196608) + params
+            s.sendall(struct.pack("!I", len(body) + 4) + body)
+            got = s.recv(4096)
+            assert got[:1] == b"E"  # ErrorResponse
+            assert b"TLS" in got
+            s.close()
+        finally:
+            srv.shutdown()
