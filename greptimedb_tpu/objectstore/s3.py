@@ -45,7 +45,11 @@ def sign_v4(method: str, url: str, headers: dict, payload_hash: str,
     amz_date = now.strftime("%Y%m%dT%H%M%SZ")
     datestamp = now.strftime("%Y%m%d")
     parsed = urllib.parse.urlsplit(url)
-    canonical_uri = urllib.parse.quote(parsed.path or "/", safe="/-_.~")
+    # the caller's URL path is ALREADY percent-encoded (it is what goes
+    # on the wire); AWS's canonical URI is that single-encoded path —
+    # re-quoting here would double-encode and break the signature for any
+    # key containing characters that need escaping
+    canonical_uri = parsed.path or "/"
     # canonical query: sorted by key, values URI-encoded
     q = urllib.parse.parse_qsl(parsed.query, keep_blank_values=True)
     canonical_query = "&".join(
@@ -129,10 +133,13 @@ class S3Store(ObjectStore):
                 return resp.read()
         except urllib.error.HTTPError as e:
             if e.code == 404:
-                raise ObjectStoreError(f"not found: {url}") from None
-            raise ObjectStoreError(
-                f"s3 {method} {url}: HTTP {e.code} "
-                f"{e.read()[:200]!r}") from None
+                err = ObjectStoreError(f"not found: {url}")
+            else:
+                err = ObjectStoreError(
+                    f"s3 {method} {url}: HTTP {e.code} "
+                    f"{e.read()[:200]!r}")
+            err.http_code = e.code
+            raise err from None
         except urllib.error.URLError as e:
             raise ObjectStoreError(f"s3 {method} {url}: {e}") from None
 
@@ -150,18 +157,25 @@ class S3Store(ObjectStore):
         try:
             self._request("HEAD", self._url(key))
             return True
-        except ObjectStoreError:
-            return False
+        except ObjectStoreError as e:
+            if getattr(e, "http_code", None) == 404:
+                return False
+            raise  # 403/5xx/network errors are NOT "does not exist"
 
     def size(self, key: str) -> int:
-        # HEAD gives no body through urlopen().read(); issue a ranged GET
-        # of zero bytes? Simplest portable: full GET is wasteful, so use
-        # list-objects on the exact key
-        target = self._key(key)
-        for k, sz in self._list_with_sizes(target):
-            if k == target:
-                return sz
-        raise ObjectStoreError(f"not found: {key}")
+        url = self._url(key)
+        payload_hash = _sha256(b"")
+        headers = sign_v4("HEAD", url, {}, payload_hash,
+                          self.access_key, self.secret_key, self.region)
+        req = urllib.request.Request(url, method="HEAD", headers=headers)
+        try:
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                return int(resp.headers.get("Content-Length", 0))
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                raise ObjectStoreError(f"not found: {key}") from None
+            raise ObjectStoreError(
+                f"s3 HEAD {url}: HTTP {e.code}") from None
 
     def list(self, prefix: str) -> list[str]:
         full = self._key(prefix)
@@ -172,6 +186,7 @@ class S3Store(ObjectStore):
         """ListObjectsV2 with continuation (minimal XML scrape — the
         response schema is stable enough that a parser dependency isn't
         warranted)."""
+        import html
         import re
 
         out: list[tuple[str, int]] = []
@@ -186,7 +201,8 @@ class S3Store(ObjectStore):
             for m in re.finditer(
                     r"<Contents>.*?<Key>(.*?)</Key>.*?<Size>(\d+)</Size>"
                     r".*?</Contents>", body, re.S):
-                out.append((m.group(1), int(m.group(2))))
+                # keys come back XML-entity-encoded (& -> &amp;, etc.)
+                out.append((html.unescape(m.group(1)), int(m.group(2))))
             t = re.search(r"<NextContinuationToken>(.*?)"
                           r"</NextContinuationToken>", body)
             if not t:
@@ -210,7 +226,9 @@ def from_url(url: str, **kw) -> ObjectStore:
     if p.scheme == "oss":
         region = kw.pop("region", os.environ.get("OSS_REGION",
                                                  "oss-cn-hangzhou"))
-        kw.setdefault("endpoint", f"https://{region}.aliyuncs.com")
+        # OSS's S3-COMPATIBLE endpoint (the native one expects OSS's own
+        # signature scheme, not SigV4)
+        kw.setdefault("endpoint", f"https://s3.{region}.aliyuncs.com")
         return S3Store(bucket, prefix, region=region, **kw)
     if p.scheme == "gs":
         kw.setdefault("endpoint", "https://storage.googleapis.com")

@@ -132,7 +132,9 @@ class RegionEngine:
                     )
                 return 0
             if req.kind is RequestType.CLOSE:
-                self.regions.pop(req.region_id, None)
+                r = self.regions.pop(req.region_id, None)
+                if r is not None and hasattr(r, "close"):
+                    r.close()
                 self.wal.close_region(req.region_id)
                 return 0
             if req.kind is RequestType.DROP:
@@ -215,4 +217,8 @@ class RegionEngine:
                                                   tag_predicates)
 
     def close(self) -> None:
+        with self._lock:
+            for r in self.regions.values():
+                if hasattr(r, "close"):
+                    r.close()  # drain grace-deferred SST purges
         self.wal.close()

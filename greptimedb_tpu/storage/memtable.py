@@ -31,14 +31,18 @@ class TagRegistry:
     the lifetime of the region (append-only)."""
 
     def __init__(self, tag_names: list[str]):
+        import threading
+
         self.tables: dict[str, dict] = {n: {} for n in tag_names}
         self.values: dict[str, list] = {n: [] for n in tag_names}
+        # encode() is reached from BOTH the write path (region lock held)
+        # and scan-time SST dictionary remapping (no region lock, by
+        # design): the registry guards itself
+        self._lock = threading.Lock()
 
     def encode(self, name: str, strings: np.ndarray) -> np.ndarray:
         """Vectorized: unique the batch (O(n log n) in C), then walk only
         the (small) set of distinct values through the dictionary."""
-        table = self.tables[name]
-        vals = self.values[name]
         arr = np.asarray(strings, dtype=object)
         null_mask = np.frompyfunc(lambda x: x is None, 1, 1)(arr).astype(bool)
         codes = np.full(len(arr), -1, dtype=np.int32)
@@ -46,13 +50,16 @@ class TagRegistry:
         if present.any():
             uniq, inv = np.unique(arr[present].astype(str), return_inverse=True)
             mapping = np.empty(len(uniq), dtype=np.int32)
-            for i, s in enumerate(uniq):
-                c = table.get(s)
-                if c is None:
-                    c = len(vals)
-                    table[s] = c
-                    vals.append(s)
-                mapping[i] = c
+            with self._lock:
+                table = self.tables[name]
+                vals = self.values[name]
+                for i, s in enumerate(uniq):
+                    c = table.get(s)
+                    if c is None:
+                        c = len(vals)
+                        table[s] = c
+                        vals.append(s)
+                    mapping[i] = c
             codes[present] = mapping[inv]
         return codes
 
@@ -61,13 +68,16 @@ class TagRegistry:
         return self.encode(name, file_values)
 
     def dict_array(self, name: str) -> np.ndarray:
-        return np.asarray(self.values[name], dtype=object)
+        with self._lock:
+            return np.asarray(self.values[name], dtype=object)
 
     def cardinality(self, name: str) -> int:
-        return len(self.values[name])
+        with self._lock:
+            return len(self.values[name])
 
     def snapshot(self) -> dict[str, list]:
-        return {k: list(v) for k, v in self.values.items()}
+        with self._lock:
+            return {k: list(v) for k, v in self.values.items()}
 
 
 @dataclass

@@ -14,6 +14,7 @@ edit, WAL truncation.
 from __future__ import annotations
 
 import os
+import threading
 from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Optional, Sequence
@@ -102,6 +103,15 @@ class Region:
         self.memtable = Memtable(schema, self.registry)
         self.next_seq = 0
         self.files: dict[str, FileMeta] = {}
+        # worker-model discipline (reference mito2 region worker,
+        # worker.rs:110-650): one lock serializes this region's mutations;
+        # scans take a consistent snapshot under it and decode outside
+        self._lock = threading.RLock()
+        # compacted-away SSTs are purged only once no reader holds them —
+        # scans pin their snapshot's files (the reference's FilePurger
+        # refcount, mito2/src/sst/file_purger.rs)
+        self._purge_queue: list[tuple[str, float]] = []
+        self._file_refs: dict[str, int] = {}
         # bumped on every mutation; device cache keys include it
         self.data_version = 0
         # host scan cache: decoded-column snapshots keyed by
@@ -143,10 +153,41 @@ class Region:
         return region
 
     def drop(self) -> None:
-        self.wal.delete_region(self.region_id)
-        for fid in list(self.files):
-            self.sst_reader.delete(fid)
-        self.files.clear()
+        with self._lock:
+            self._drain_purge(force=True)
+            self.wal.delete_region(self.region_id)
+            for fid in list(self.files):
+                self.sst_reader.delete(fid)
+            self.files.clear()
+
+    def close(self) -> None:
+        """Release deferred resources (deleted-but-grace-held SSTs)."""
+        with self._lock:
+            self._drain_purge(force=True)
+
+    def _drain_purge(self, force: bool = False) -> None:
+        keep: list[tuple[str, float]] = []
+        for fid, t in self._purge_queue:
+            if self._file_refs.get(fid, 0) > 0 and not force:
+                keep.append((fid, t))  # a reader still holds it
+            else:
+                self.sst_reader.delete(fid)
+        self._purge_queue = keep
+
+    def _pin_files(self, metas) -> None:
+        for m in metas:
+            self._file_refs[m.file_id] = self._file_refs.get(m.file_id, 0) + 1
+
+    def _unpin_files(self, metas) -> None:
+        with self._lock:
+            for m in metas:
+                n = self._file_refs.get(m.file_id, 0) - 1
+                if n <= 0:
+                    self._file_refs.pop(m.file_id, None)
+                else:
+                    self._file_refs[m.file_id] = n
+            if self._purge_queue:
+                self._drain_purge()
 
     # ---- write -------------------------------------------------------------
 
@@ -156,17 +197,23 @@ class Region:
         n = batch.num_rows
         if n == 0:
             return 0
-        seq = self.next_seq
-        self.wal.append(self.region_id, seq, op_type, batch)
-        self.memtable.write(batch, seq, op_type)
-        self.next_seq = seq + n
-        self.data_version += 1
+        with self._lock:
+            seq = self.next_seq
+            self.wal.append(self.region_id, seq, op_type, batch)
+            self.memtable.write(batch, seq, op_type)
+            self.next_seq = seq + n
+            self.data_version += 1
         return n
 
     # ---- flush -------------------------------------------------------------
 
     def flush(self) -> Optional[FileMeta]:
         """Memtable → sorted SST; manifest edit; WAL truncate."""
+        with self._lock:
+            return self._flush_locked()
+
+    def _flush_locked(self) -> Optional[FileMeta]:
+        self._drain_purge()
         data = self.memtable.concat()
         if data is None:
             return None
@@ -259,14 +306,20 @@ class Region:
             cols, tag_dicts, seq[order], op[order], level=1
         )
         removed = [f.file_id for f in group]
-        for fid in removed:
-            self.files.pop(fid, None)
-        self.files[meta.file_id] = meta
-        self.manifest.record_flush([meta], flushed_seq=self.next_seq,
-                                   tag_dicts=self.registry.snapshot(), removed=removed)
-        for fid in removed:
-            self.sst_reader.delete(fid)
-        self.data_version += 1
+        import time as _time
+
+        with self._lock:
+            for fid in removed:
+                self.files.pop(fid, None)
+            self.files[meta.file_id] = meta
+            self.manifest.record_flush(
+                [meta], flushed_seq=self.next_seq,
+                tag_dicts=self.registry.snapshot(), removed=removed)
+            # defer physical deletion: concurrent scans may still hold
+            # the pre-compaction file list
+            now = _time.monotonic()
+            self._purge_queue.extend((fid, now) for fid in removed)
+            self.data_version += 1
         return meta
 
     # ---- scan --------------------------------------------------------------
@@ -288,26 +341,36 @@ class Region:
             if tag_predicates
             else None
         )
-        cache_key = (self.data_version, ts_range, tuple(names), pred_key)
-        cached = self._scan_cache.get(cache_key)
-        if cached is not None:
-            self._scan_cache.move_to_end(cache_key)
-            return cached
+        # snapshot phase under the region lock: version + file list +
+        # memtable rows form one consistent view; SST decode (the slow
+        # part) runs outside, on immutable grace-protected files
+        with self._lock:
+            version = self.data_version
+            cache_key = (version, ts_range, tuple(names), pred_key)
+            cached = self._scan_cache.get(cache_key)
+            if cached is not None:
+                self._scan_cache.move_to_end(cache_key)
+                return cached
+            file_list = list(self.files.values())
+            self._pin_files(file_list)
+            mem = self.memtable.concat(ts_range)
         parts_cols: list[dict[str, np.ndarray]] = []
         parts_seq: list[np.ndarray] = []
         parts_op: list[np.ndarray] = []
 
-        for meta in self.files.values():
-            table = self.sst_reader.read(meta, self.schema, ts_range, names,
-                                         tag_predicates=tag_predicates)
-            if table is None or table.num_rows == 0:
-                continue
-            cols = self._decode_sst(table, names)
-            parts_cols.append(cols)
-            parts_seq.append(table.column(SEQ_COL).to_numpy(zero_copy_only=False).astype(np.int64))
-            parts_op.append(table.column(OP_COL).to_numpy(zero_copy_only=False).astype(np.int8))
+        try:
+            for meta in file_list:
+                table = self.sst_reader.read(meta, self.schema, ts_range, names,
+                                             tag_predicates=tag_predicates)
+                if table is None or table.num_rows == 0:
+                    continue
+                cols = self._decode_sst(table, names)
+                parts_cols.append(cols)
+                parts_seq.append(table.column(SEQ_COL).to_numpy(zero_copy_only=False).astype(np.int64))
+                parts_op.append(table.column(OP_COL).to_numpy(zero_copy_only=False).astype(np.int8))
+        finally:
+            self._unpin_files(file_list)
 
-        mem = self.memtable.concat(ts_range)
         if mem is not None:
             mcols, mseq, mop = mem
             parts_cols.append({n: mcols[n] for n in names})
@@ -332,12 +395,13 @@ class Region:
             tag_dicts=tag_dicts,
             num_rows=len(seq),
             region_id=self.region_id,
-            data_version=self.data_version,
+            data_version=version,
             scan_fingerprint=(ts_range, tuple(names), pred_key),
         )
-        self._scan_cache[cache_key] = result
-        while len(self._scan_cache) > self.scan_cache_entries:
-            self._scan_cache.popitem(last=False)
+        with self._lock:
+            self._scan_cache[cache_key] = result
+            while len(self._scan_cache) > self.scan_cache_entries:
+                self._scan_cache.popitem(last=False)
         return result
 
     def scan_stream(
@@ -350,13 +414,18 @@ class Region:
         """Lazy bounded-memory scan (see ScanStream). Returns None when the
         time range prunes everything."""
         names = self._scan_columns(projection)
+        with self._lock:
+            snapshot_files = list(self.files.values())
+            self._pin_files(snapshot_files)
+            mem = self.memtable.concat(ts_range)
+            stream_version = self.data_version
         files = [
-            meta for meta in self.files.values()
+            meta for meta in snapshot_files
             if ts_range is None
             or (meta.ts_max >= ts_range[0] and meta.ts_min < ts_range[1])
         ]
-        mem = self.memtable.concat(ts_range)
         if not files and mem is None:
+            self._unpin_files(snapshot_files)
             return None
         bounds = [(m.ts_min, m.ts_max) for m in files]
         if mem is not None and len(mem[1]):
@@ -368,15 +437,18 @@ class Region:
         est = sum(m.num_rows for m in files) + (len(mem[1]) if mem else 0)
 
         def gen():
-            for meta in files:
-                for table in self.sst_reader.iter_chunks(
-                        meta, self.schema, ts_range, names,
-                        tag_predicates=tag_predicates,
-                        groups_per_chunk=groups_per_chunk):
-                    if table.num_rows:
-                        yield self._decode_sst(table, names), table.num_rows
-            if mem is not None and len(mem[1]):
-                yield {n: mem[0][n] for n in names}, len(mem[1])
+            try:
+                for meta in files:
+                    for table in self.sst_reader.iter_chunks(
+                            meta, self.schema, ts_range, names,
+                            tag_predicates=tag_predicates,
+                            groups_per_chunk=groups_per_chunk):
+                        if table.num_rows:
+                            yield self._decode_sst(table, names), table.num_rows
+                if mem is not None and len(mem[1]):
+                    yield {n: mem[0][n] for n in names}, len(mem[1])
+            finally:
+                self._unpin_files(snapshot_files)
 
         return ScanStream(
             schema=self.schema,
@@ -385,7 +457,7 @@ class Region:
                 for c in self.schema.tag_columns if c.name in names
             },
             region_id=self.region_id,
-            data_version=self.data_version,
+            data_version=stream_version,
             est_rows=est,
             ts_min=ts_min,
             ts_max=ts_max,

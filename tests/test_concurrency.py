@@ -1,0 +1,146 @@
+"""Write/scan/flush/compact under concurrency — the worker-model
+discipline (reference mito2 region worker, worker.rs:110-650): mutations
+serialize on the region lock, scans snapshot consistently, compacted
+SSTs are purged on a grace delay so in-flight scans can finish."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from greptimedb_tpu.catalog.catalog import Catalog
+from greptimedb_tpu.catalog.kv import MemoryKv
+from greptimedb_tpu.query.engine import QueryEngine
+from greptimedb_tpu.storage.engine import EngineConfig, RegionEngine
+
+
+@pytest.fixture
+def qe(tmp_path):
+    engine = RegionEngine(EngineConfig(data_dir=str(tmp_path)))
+    q = QueryEngine(Catalog(MemoryKv()), engine)
+    q.execute_one(
+        "CREATE TABLE m (host STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, "
+        "PRIMARY KEY(host))")
+    yield q
+    engine.close()
+
+
+def _run_threads(fns, timeout=120):
+    errors = []
+
+    def wrap(fn):
+        try:
+            fn()
+        except Exception as e:  # noqa: BLE001 — collected for the assert
+            errors.append(e)
+
+    threads = [threading.Thread(target=wrap, args=(fn,)) for fn in fns]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout)
+    assert not errors, errors[:3]
+
+
+class TestWriteScanRaces:
+    ROUNDS = 30
+
+    def test_writes_during_scans(self, qe):
+        """Scans racing writes must never crash and every scan must see a
+        consistent snapshot (full rows, monotonic count)."""
+        counts = []
+
+        def writer():
+            for i in range(self.ROUNDS):
+                qe.execute_one(
+                    "INSERT INTO m VALUES " + ", ".join(
+                        f"('h{j}', {i}.0, {i * 100 + j})" for j in range(20)))
+
+        def scanner():
+            for _ in range(self.ROUNDS):
+                r = qe.execute_one("SELECT count(*), count(v) FROM m")
+                total, non_null = r.rows()[0]
+                # a torn scan would show count(*) != count(v) (a row with
+                # ts appended but v missing) — snapshots forbid that
+                assert total == non_null, (total, non_null)
+                counts.append(total)
+
+        _run_threads([writer, scanner, scanner])
+        assert qe.execute_one("SELECT count(*) FROM m").rows()[0][0] == \
+            self.ROUNDS * 20
+        # each scanner saw monotonically non-decreasing counts
+        # (counts interleave between scanners; global sortedness isn't
+        # required — only that nothing went backwards catastrophically
+        # below zero or above the final total)
+        assert all(0 <= c <= self.ROUNDS * 20 for c in counts)
+
+    def test_concurrent_writers_unique_seqs(self, qe):
+        """Parallel INSERTs must not collide on WAL sequences (lost
+        updates); every row must survive a restart replay."""
+        def writer(base):
+            def run():
+                for i in range(self.ROUNDS):
+                    qe.execute_one(
+                        f"INSERT INTO m VALUES ('w{base}', {i}.0, "
+                        f"{base * 1_000_000 + i})")
+            return run
+
+        _run_threads([writer(b) for b in range(4)])
+        assert qe.execute_one("SELECT count(*) FROM m").rows()[0][0] == \
+            4 * self.ROUNDS
+        info = qe.catalog.table("public", "m")
+        rid = info.region_ids[0]
+        region = qe.region_engine.region(rid)
+        # WAL seqs must be unique: replay and count
+        seqs = [e.seq for e in region.wal.replay(rid)]
+        assert len(seqs) == len(set(seqs))
+
+    def test_scans_during_flush_and_compact(self, qe):
+        """Flush + compaction racing scans: file swaps must not break an
+        in-flight scan (grace-deferred purge)."""
+        qe.execute_one(
+            "INSERT INTO m VALUES " + ", ".join(
+                f"('h{j}', 1.0, {j})" for j in range(50)))
+
+        stop = threading.Event()
+
+        def maintainer():
+            for i in range(10):
+                qe.execute_one(
+                    "INSERT INTO m VALUES " + ", ".join(
+                        f"('h{j}', 2.0, {10_000 + i * 100 + j})"
+                        for j in range(20)))
+                qe.execute_one("ADMIN flush_table('m')")
+                qe.execute_one("ADMIN compact_table('m')")
+            stop.set()
+
+        def scanner():
+            while not stop.is_set():
+                r = qe.execute_one(
+                    "SELECT host, count(*) FROM m GROUP BY host "
+                    "ORDER BY host")
+                assert r.num_rows >= 1
+
+        _run_threads([maintainer, scanner, scanner])
+        assert qe.execute_one("SELECT count(*) FROM m").rows()[0][0] == \
+            50 + 10 * 20
+
+    def test_compacted_files_purged_on_close(self, qe, tmp_path):
+        import glob
+
+        qe.execute_one("INSERT INTO m VALUES ('a', 1.0, 1000)")
+        qe.execute_one("ADMIN flush_table('m')")
+        qe.execute_one("INSERT INTO m VALUES ('b', 2.0, 2000)")
+        qe.execute_one("ADMIN flush_table('m')")
+        qe.execute_one("ADMIN compact_table('m')")
+        info = qe.catalog.table("public", "m")
+        region = qe.region_engine.region(info.region_ids[0])
+        # old files grace-held, not yet deleted
+        assert region._purge_queue
+        region.close()
+        assert not region._purge_queue
+        live = set(region.files)
+        on_disk = {p.split("/")[-1].replace(".parquet", "")
+                   for p in glob.glob(str(tmp_path) + "/**/sst/*.parquet",
+                                      recursive=True)}
+        assert on_disk == live
