@@ -34,7 +34,7 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 BASELINE_MS = 2215.44  # BASELINE.md double-groupby-all, local 8c
 
 INIT_RETRIES = int(os.environ.get("BENCH_INIT_RETRIES", "3"))
-INIT_TIMEOUT_S = int(os.environ.get("BENCH_INIT_TIMEOUT_S", "120"))
+INIT_TIMEOUT_S = int(os.environ.get("BENCH_INIT_TIMEOUT_S", "90"))
 
 HOSTS = int(os.environ.get("BENCH_HOSTS", "4000"))
 HOURS = int(os.environ.get("BENCH_HOURS", "12"))
@@ -148,6 +148,7 @@ def probe_backend():
 def main():
     data_dir = tempfile.mkdtemp(prefix="gtpu_bench_")
     try:
+        global HOSTS
         backend = probe_backend()
         import jax
         if backend == "cpu" or os.environ.get("JAX_PLATFORMS") == "cpu":
@@ -156,6 +157,12 @@ def main():
             # actually pins the platform (tests/conftest.py recipe)
             jax.config.update("jax_platforms", "cpu")
             backend = "cpu"
+            if "BENCH_HOSTS" not in os.environ and HOSTS > 1000:
+                # accelerator unavailable: this run's number is a CPU
+                # diagnostic, not a TPU comparison — shrink so it fits the
+                # attempt window instead of timing out at full scale
+                HOSTS = 1000
+                log("cpu fallback: shrinking dataset to 1000 hosts")
         log(f"devices: {jax.devices()}")
         engine, qe = build_db(data_dir)
         t0_ms = 1456790400000  # 2016-03-01T00:00:00Z
@@ -246,8 +253,11 @@ def supervise():
                 capture_output=True, text=True, timeout=attempt_s, env=env,
             )
         except subprocess.TimeoutExpired as e:
-            tail = (e.stderr or "")[-2000:] if isinstance(e.stderr, str) else ""
-            log(f"supervisor: attempt {i} TIMED OUT after {attempt_s:.0f}s\n{tail}")
+            tail = e.stderr or b""
+            if isinstance(tail, bytes):
+                tail = tail.decode(errors="replace")
+            log(f"supervisor: attempt {i} TIMED OUT after {attempt_s:.0f}s\n"
+                f"{tail[-2000:]}")
             last_err = f"bench timed out after {attempt_s:.0f}s ({label})"
             continue
         sys.stderr.write(r.stderr)

@@ -176,6 +176,8 @@ class S3Store(ObjectStore):
                 raise ObjectStoreError(f"not found: {key}") from None
             raise ObjectStoreError(
                 f"s3 HEAD {url}: HTTP {e.code}") from None
+        except urllib.error.URLError as e:
+            raise ObjectStoreError(f"s3 HEAD {url}: {e}") from None
 
     def list(self, prefix: str) -> list[str]:
         full = self._key(prefix)

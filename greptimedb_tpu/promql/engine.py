@@ -299,8 +299,23 @@ class PromqlEngine:
         offset = sel.offset_s
         lo = int((p.start - window - offset) * 1e9) // unit
         hi = int((p.end - offset) * 1e9) // unit + 1
-        scan = qe.region_engine.scan(info.region_ids[0], (lo, hi),
-                                     [field_name])
+        # push =/=~ matchers into the inverted index (reference applies
+        # index predicates at sst/parquet/reader.rs:335-425); != and !~
+        # can't prune (a segment bitmap proves presence, not absence).
+        # The exact matcher masks below still run on everything scanned.
+        from greptimedb_tpu.storage.index import InSet, Regex
+        idx_preds: dict[str, list] = {}
+        tag_set = {c.name for c in schema.tag_columns}
+        for m in rest:
+            if m.label not in tag_set:
+                continue
+            if m.op == "=":
+                idx_preds.setdefault(m.label, []).append(InSet.of([m.value]))
+            elif m.op == "=~":
+                idx_preds.setdefault(m.label, []).append(Regex(m.value))
+        scan = qe.region_engine.scan(
+            info.region_ids[0], (lo, hi), [field_name],
+            tag_predicates={k: tuple(v) for k, v in idx_preds.items()} or None)
         if scan is None or scan.num_rows == 0:
             return None
 

@@ -490,14 +490,20 @@ class PhysicalExecutor:
 
             stream = self.engine.scan_stream(
                 table.region_ids[0], ts_range, scan_node.columns, tag_preds)
-            if (stream is not None
-                    and stream.est_rows >= config.stream_threshold_rows()):
-                try:
-                    return self._execute_agg_stream(
-                        stream, table, where, agg, having, project, sort,
-                        limit, offset, scan_node)
-                except _NotStreamable:
-                    pass  # materialized fallback below
+            if stream is not None:
+                if stream.est_rows >= config.stream_threshold_rows():
+                    try:
+                        return self._execute_agg_stream(
+                            stream, table, where, agg, having, project, sort,
+                            limit, offset, scan_node)
+                    except _NotStreamable:
+                        pass  # materialized fallback below
+                    finally:
+                        # idempotent: releases SST pins if the stream was
+                        # abandoned mid-way (or never started)
+                        stream.close()
+                else:
+                    stream.close()
 
         with tracing.span("scan", table=table.name,
                           regions=len(table.region_ids)):

@@ -306,8 +306,8 @@ class FlightServer(fl.FlightServerBase):
         region_id = req["region_id"]
         ts_range = tuple(req["ts_range"]) if req.get("ts_range") else None
         projection = req.get("projection")
-        preds = {k: set(v) for k, v in (req.get("tag_predicates") or {}).items()} \
-            or None
+        from greptimedb_tpu.storage.index import deserialize_predicates
+        preds = deserialize_predicates(req.get("tag_predicates"))
         if req.get("trace_id"):
             # adopt the caller's trace (region_server.rs:74 analog)
             tracing.set_trace(req["trace_id"])
@@ -607,8 +607,8 @@ class RemoteRegionEngine:
         if projection is not None:
             spec["projection"] = list(projection)
         if tag_predicates:
-            spec["tag_predicates"] = {k: sorted(v)
-                                      for k, v in tag_predicates.items()}
+            from greptimedb_tpu.storage.index import serialize_predicates
+            spec["tag_predicates"] = serialize_predicates(tag_predicates)
         tid = tracing.current_trace_id()
         if tid:
             # W3C-style propagation: the frontend's trace id crosses the
@@ -683,8 +683,8 @@ class RegionFlightClient:
         if projection is not None:
             spec["projection"] = list(projection)
         if tag_predicates:
-            spec["tag_predicates"] = {k: sorted(v)
-                                      for k, v in tag_predicates.items()}
+            from greptimedb_tpu.storage.index import serialize_predicates
+            spec["tag_predicates"] = serialize_predicates(tag_predicates)
         ticket = fl.Ticket(json.dumps({"region_scan": spec}).encode())
         t = self.client.do_get(ticket).read_all()
         if (t.schema.metadata or {}).get(b"empty") == b"1":

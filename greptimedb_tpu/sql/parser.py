@@ -550,22 +550,23 @@ class Parser:
         self.expect_op(",")
         step = self._tql_duration()
         self.expect_op(")")
-        # the rest of the statement (raw text) is PromQL
+        # the rest of the statement (raw text) is PromQL — label matchers
+        # ({host=~"web.*"}), durations ([5m]) and strings all pass through
+        # verbatim; the slice ends at the statement separator
         start_pos = self.peek().pos
-        end_pos = len(self.sql)
         depth = 0
         while self.peek().kind != "eof":
             t = self.peek()
             if t.kind == "op" and t.value == ";" and depth == 0:
-                end_pos = t.pos
                 break
             if t.kind == "op" and t.value == "(":
                 depth += 1
             if t.kind == "op" and t.value == ")":
                 depth -= 1
-            end_pos = t.pos + len(t.value) + (2 if t.kind == "string" else 0)
             self.next()
-        query = self.sql[start_pos:end_pos].strip()
+        # the terminator token's pos is the exact end of the raw text
+        # (the eof token's pos is len(sql))
+        query = self.sql[start_pos:self.peek().pos].strip()
         return ast.Tql(start, end, step, query, analyze=analyze, explain=explain)
 
     def _tql_number(self) -> float:

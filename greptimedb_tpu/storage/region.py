@@ -80,9 +80,17 @@ class ScanStream:
     ts_min: int  # over the pruned file set + memtable (chunk key planning)
     ts_max: int
     _chunks: object  # () -> Iterator[(cols dict, nrows)]
+    _close: object = None  # idempotent; releases file pins
 
     def chunks(self):
         return self._chunks()
+
+    def close(self):
+        """Release the snapshot's SST file pins. Idempotent, and required
+        whenever the stream is abandoned before (or instead of) being
+        iterated — a never-started generator's finally never runs."""
+        if self._close is not None:
+            self._close()
 
 
 class Region:
@@ -336,11 +344,8 @@ class Region:
         predicate rejects — the device filter still runs, pruning is purely
         an IO reduction (never affects correctness)."""
         names = self._scan_columns(projection)
-        pred_key = (
-            tuple(sorted((k, tuple(sorted(v))) for k, v in tag_predicates.items()))
-            if tag_predicates
-            else None
-        )
+        from greptimedb_tpu.storage.index import predicates_cache_key
+        pred_key = predicates_cache_key(tag_predicates)
         # snapshot phase under the region lock: version + file list +
         # memtable rows form one consistent view; SST decode (the slow
         # part) runs outside, on immutable grace-protected files
@@ -436,6 +441,13 @@ class Region:
         ts_max = max(b[1] for b in bounds)
         est = sum(m.num_rows for m in files) + (len(mem[1]) if mem else 0)
 
+        unpinned = [False]
+
+        def unpin_once():
+            if not unpinned[0]:
+                unpinned[0] = True
+                self._unpin_files(snapshot_files)
+
         def gen():
             try:
                 for meta in files:
@@ -448,7 +460,7 @@ class Region:
                 if mem is not None and len(mem[1]):
                     yield {n: mem[0][n] for n in names}, len(mem[1])
             finally:
-                self._unpin_files(snapshot_files)
+                unpin_once()
 
         return ScanStream(
             schema=self.schema,
@@ -462,6 +474,7 @@ class Region:
             ts_min=ts_min,
             ts_max=ts_max,
             _chunks=gen,
+            _close=unpin_once,
         )
 
     def _scan_columns(self, projection: Optional[Sequence[str]]) -> list[str]:

@@ -106,9 +106,15 @@ class SstWriter:
         )
         self.store.write(path, sink.getvalue())  # pa.Buffer, zero extra copy
         # build the per-file inverted index (tag value -> row-group bitmap)
-        from greptimedb_tpu.storage.index import InvertedIndexWriter
+        from greptimedb_tpu.storage.index import (
+            DEFAULT_SEGMENT_ROWS,
+            InvertedIndexWriter,
+        )
 
-        InvertedIndexWriter(self.sst_dir, self.store).write(
+        InvertedIndexWriter(
+            self.sst_dir, self.store,
+            segment_rows=min(DEFAULT_SEGMENT_ROWS, self.row_group_size),
+        ).write(
             file_id,
             {c.name: np.asarray(columns[c.name], dtype=np.int32)
              for c in self.schema.tag_columns},

@@ -162,12 +162,14 @@ class TestInvertedIndex:
 
     def test_extract_tag_predicates(self, qe):
         info = qe.catalog.table("public", "cpu")
+        from greptimedb_tpu.storage.index import InSet
+
         sel = parse_sql("SELECT * FROM cpu WHERE host = 'a' AND ts > 5")[0]
         preds = extract_tag_predicates(sel.where, info.schema)
-        assert preds == {"host": {"a"}}
+        assert preds == {"host": (InSet.of(["a"]),)}
         sel = parse_sql("SELECT * FROM cpu WHERE host IN ('a', 'b')")[0]
         preds = extract_tag_predicates(sel.where, info.schema)
-        assert preds == {"host": {"a", "b"}}
+        assert preds == {"host": (InSet.of(["a", "b"]),)}
         # OR is not restrictive -> no predicates
         sel = parse_sql("SELECT * FROM cpu WHERE host = 'a' OR usage > 1")[0]
         assert extract_tag_predicates(sel.where, info.schema) == {}

@@ -1,0 +1,324 @@
+"""Puffin container + FST-analog inverted index (reference src/puffin +
+src/index/src/inverted_index: format.rs:28, search/index_apply.rs:26-58)."""
+
+import io
+
+import numpy as np
+import pytest
+
+from greptimedb_tpu.objectstore import MemoryStore
+from greptimedb_tpu.storage.index import (
+    IndexApplier,
+    InSet,
+    InvertedIndexWriter,
+    Range,
+    Regex,
+    deserialize_predicates,
+    extract_tag_predicates,
+    normalize_predicates,
+    predicates_cache_key,
+    serialize_predicates,
+)
+from greptimedb_tpu.storage.puffin import PuffinReader, PuffinWriter
+
+
+# ---- container -------------------------------------------------------------
+
+
+def test_puffin_roundtrip():
+    w = PuffinWriter({"num_rows": 42})
+    w.add_blob("type-a", b"hello", {"column": "host"})
+    w.add_blob("type-b", b"\x00\x01\x02" * 100, {"column": "dc"})
+    data = w.finish()
+
+    r = PuffinReader(io.BytesIO(data))
+    assert r.properties == {"num_rows": 42}
+    assert [b.type for b in r.blobs] == ["type-a", "type-b"]
+    assert r.read_blob(r.blobs[0]) == b"hello"
+    assert r.read_blob(r.blobs[1]) == b"\x00\x01\x02" * 100
+    assert r.blobs_of_type("type-b")[0].properties == {"column": "dc"}
+
+
+def test_puffin_rejects_garbage():
+    from greptimedb_tpu.storage.puffin import PuffinError
+
+    with pytest.raises(PuffinError):
+        PuffinReader(io.BytesIO(b"not a puffin file at all"))
+
+
+# ---- index build + applier -------------------------------------------------
+
+
+def make_index(store, codes, values, segment_rows=4, row_group_size=8,
+               tag="host"):
+    n = len(codes)
+    w = InvertedIndexWriter("idx", store, segment_rows=segment_rows)
+    w.write("f1", {tag: np.asarray(codes, dtype=np.int32)},
+            {tag: np.asarray(values, dtype=object)}, row_group_size, n)
+    return IndexApplier("idx", store)
+
+
+def test_eq_pruning_segments_to_row_groups():
+    store = MemoryStore()
+    # 16 rows, segment_rows=4 -> 4 segments; row_group_size=8 -> 2 groups.
+    # 'a' only in rows 0-3 (segment 0 -> group 0)
+    codes = [0] * 4 + [1] * 12
+    ap = make_index(store, codes, ["a", "b"])
+    assert ap.apply("f1", {"host": {"a"}}) == [0]
+    # 'b' misses segment 0 but both row groups still overlap a hit
+    assert ap.apply("f1", {"host": {"b"}}) in (None, [0, 1])
+    assert ap.apply("f1", {"host": {"zz"}}) == []
+    # un-indexed tag: no pruning
+    assert ap.apply("f1", {"other": {"x"}}) is None
+    # file without an index: no pruning
+    assert ap.apply("nope", {"host": {"a"}}) is None
+
+
+def test_in_and_multi_tag_intersection():
+    store = MemoryStore()
+    n = 16
+    host = np.asarray([0, 1, 2, 3] * 4, dtype=np.int32)  # every segment
+    dc = np.asarray([0] * 8 + [1] * 8, dtype=np.int32)   # half each
+    w = InvertedIndexWriter("idx", store, segment_rows=4)
+    w.write("f1",
+            {"host": host, "dc": dc},
+            {"host": np.asarray(["h0", "h1", "h2", "h3"], dtype=object),
+             "dc": np.asarray(["east", "west"], dtype=object)},
+            8, n)
+    ap = IndexApplier("idx", store)
+    assert ap.apply("f1", {"dc": {"west"}}) == [1]
+    assert ap.apply("f1", {"host": {"h1"}, "dc": {"east"}}) == [0]
+    assert ap.apply("f1", {"host": {"h1", "h2"}, "dc": {"bogus"}}) == []
+
+
+def test_range_predicate():
+    store = MemoryStore()
+    # terms sort as a < b < c < d; one value per segment
+    ap = make_index(store, [0] * 4 + [1] * 4 + [2] * 4 + [3] * 4,
+                    ["a", "b", "c", "d"], row_group_size=4)
+    assert ap.apply("f1", {"host": (Range("b", "c"),)}) == [1, 2]
+    assert ap.apply("f1", {"host": (Range("b", "c", lo_inc=False),)}) == [2]
+    assert ap.apply("f1", {"host": (Range(None, "a"),)}) == [0]
+    assert ap.apply("f1", {"host": (Range("e", None),)}) == []
+    assert ap.apply("f1", {"host": (Range("a", "z"),)}) is None
+
+
+def test_regex_predicate_and_null_semantics():
+    store = MemoryStore()
+    # code -1 = NULL rows in the last segment
+    codes = [0] * 4 + [1] * 4 + [2] * 4 + [-1] * 4
+    ap = make_index(store, codes, ["web-1", "web-2", "db-1"],
+                    row_group_size=4)
+    assert ap.apply("f1", {"host": (Regex("web-.*"),)}) == [0, 1]
+    assert ap.apply("f1", {"host": (Regex("db-\\d"),)}) == [2]
+    # a pattern matching the empty string must keep NULL segments
+    # (PromQL: absent label == "")
+    assert ap.apply("f1", {"host": (Regex("(web-1)?"),)}) == [0, 3]
+    # eq "" keeps NULL segments too
+    assert ap.apply("f1", {"host": {""}}) == [3]
+    # invalid regex: never prune
+    assert ap.apply("f1", {"host": (Regex("("),)}) is None
+
+
+def test_pruning_never_drops_matching_rows_randomized():
+    rng = np.random.default_rng(0)
+    store = MemoryStore()
+    values = np.asarray([f"v{i}" for i in range(17)], dtype=object)
+    n = 1000
+    codes = rng.integers(-1, 17, n).astype(np.int32)
+    seg_rows, rg_rows = 32, 128
+    w = InvertedIndexWriter("idx", store, segment_rows=seg_rows)
+    w.write("f1", {"host": codes}, {"host": values}, rg_rows, n)
+    ap = IndexApplier("idx", store)
+    for pred, match in [
+        ({"host": {"v3", "v11"}},
+         lambda c: (c == 3) | (c == 11)),
+        ({"host": (Range("v10", "v16"),)},  # string order: v10..v15,v16
+         lambda c: np.isin(c, [i for i in range(17)
+                               if "v10" <= f"v{i}" <= "v16"])),
+        ({"host": (Regex("v1[0-3]"),)},
+         lambda c: np.isin(c, [10, 11, 12, 13])),
+    ]:
+        groups = ap.apply("f1", pred)
+        if groups is None:
+            continue
+        kept = np.zeros(n, dtype=bool)
+        for g in groups:
+            kept[g * rg_rows:(g + 1) * rg_rows] = True
+        rows_matching = match(codes)
+        assert not (rows_matching & ~kept).any(), pred
+
+
+# ---- predicate plumbing ----------------------------------------------------
+
+
+def test_serialize_roundtrip():
+    preds = {
+        "host": {"a", "b"},
+        "dc": (Range("x", None, lo_inc=False), Regex("e.*")),
+    }
+    wire = serialize_predicates(preds)
+    back = deserialize_predicates(wire)
+    assert normalize_predicates(back) == normalize_predicates(preds)
+    assert predicates_cache_key(back) == predicates_cache_key(preds)
+    # legacy wire form (bare value lists)
+    legacy = deserialize_predicates({"host": ["b", "a"]})
+    assert normalize_predicates(legacy) == {"host": (InSet.of(["a", "b"]),)}
+
+
+def test_extract_tag_predicates_rich():
+    from greptimedb_tpu.datatypes import (
+        ColumnSchema,
+        DataType,
+        Schema,
+        SemanticType,
+    )
+    from greptimedb_tpu.sql import parse_sql
+
+    schema = Schema([
+        ColumnSchema("host", DataType.STRING, SemanticType.TAG),
+        ColumnSchema("dc", DataType.STRING, SemanticType.TAG),
+        ColumnSchema("ts", DataType.TIMESTAMP_MILLISECOND,
+                     SemanticType.TIMESTAMP),
+        ColumnSchema("v", DataType.FLOAT64),
+    ])
+    stmt = parse_sql(
+        "SELECT v FROM t WHERE host = 'a' AND dc IN ('e','w') "
+        "AND host >= 'a' AND host < 'm' AND dc LIKE 'e%' "
+        "AND dc BETWEEN 'd' AND 'f' AND v > 3"
+    )[0]
+    preds = extract_tag_predicates(stmt.where, schema)
+    assert InSet.of(["a"]) in preds["host"]
+    assert Range("a", None, lo_inc=True) in preds["host"]
+    assert Range(None, "m", hi_inc=False) in preds["host"]
+    assert InSet.of(["e", "w"]) in preds["dc"]
+    # LIKE lowers to a (?is) regex: the query-side filter is
+    # case-insensitive, so pruning must be too
+    assert Regex("(?is)e.*") in preds["dc"]
+    assert Range("d", "f") in preds["dc"]
+    assert "v" not in preds
+    assert "ts" not in preds
+
+
+def test_like_pruning_is_case_insensitive(tmp_path):
+    """LIKE 'A%' must not prune files holding 'apple' — the query filter
+    matches case-insensitively (code-review regression)."""
+    from greptimedb_tpu.catalog import Catalog, MemoryKv
+    from greptimedb_tpu.query import QueryEngine
+    from greptimedb_tpu.storage import RegionEngine
+    from greptimedb_tpu.storage.engine import EngineConfig
+
+    engine = RegionEngine(EngineConfig(data_dir=str(tmp_path)))
+    qe = QueryEngine(Catalog(MemoryKv()), engine)
+    qe.execute_one(
+        "CREATE TABLE t (host STRING, ts TIMESTAMP(3) NOT NULL, v DOUBLE,"
+        " TIME INDEX (ts), PRIMARY KEY (host))")
+    qe.execute_one(
+        "INSERT INTO t VALUES ('apple', 1, 1.0), ('banana', 2, 2.0)")
+    engine.flush(qe.catalog.table("public", "t").region_ids[0])
+    r = qe.execute_one("SELECT host FROM t WHERE host LIKE 'A%'")
+    assert list(r.column("host")) == ["apple"]
+    engine.close()
+
+
+def test_scan_stream_close_releases_pins(tmp_path):
+    """An abandoned (never-iterated) stream must not leak file pins
+    (code-review regression)."""
+    import numpy as np
+
+    from greptimedb_tpu.datatypes import (
+        ColumnSchema,
+        DataType,
+        DictVector,
+        RecordBatch,
+        Schema,
+        SemanticType,
+    )
+    from greptimedb_tpu.storage import RegionEngine
+    from greptimedb_tpu.storage.engine import EngineConfig
+
+    schema = Schema([
+        ColumnSchema("ts", DataType.TIMESTAMP_MILLISECOND,
+                     SemanticType.TIMESTAMP),
+        ColumnSchema("host", DataType.STRING, SemanticType.TAG),
+        ColumnSchema("v", DataType.FLOAT64),
+    ])
+    engine = RegionEngine(EngineConfig(data_dir=str(tmp_path)))
+    engine.create_region(1, schema)
+    engine.put(1, RecordBatch(schema, {
+        "ts": np.arange(100, dtype=np.int64),
+        "host": DictVector.encode(["h"] * 100),
+        "v": np.ones(100),
+    }))
+    engine.flush(1)
+    region = engine.region(1)
+
+    stream = region.scan_stream()
+    assert any(region._file_refs.values()) if region._file_refs else False
+    stream.close()
+    assert not any(region._file_refs.values())
+    stream.close()  # idempotent
+
+    # fully-consumed streams unpin via the generator's finally
+    stream = region.scan_stream()
+    total = sum(n for _, n in stream.chunks())
+    assert total == 100
+    assert not any(region._file_refs.values())
+    stream.close()
+    engine.close()
+
+
+def test_sql_e2e_pruning_correctness(tmp_path):
+    """End-to-end: rich predicates through the SQL engine return exactly
+    the same rows with and without the index present."""
+    from greptimedb_tpu.catalog import Catalog, MemoryKv
+    from greptimedb_tpu.query import QueryEngine
+    from greptimedb_tpu.storage import RegionEngine
+    from greptimedb_tpu.storage.engine import EngineConfig
+
+    engine = RegionEngine(EngineConfig(data_dir=str(tmp_path)))
+    qe = QueryEngine(Catalog(MemoryKv()), engine)
+    qe.execute_one(
+        "CREATE TABLE t (host STRING, ts TIMESTAMP(3) NOT NULL, v DOUBLE,"
+        " TIME INDEX (ts), PRIMARY KEY (host))")
+    rows = []
+    for i in range(400):
+        rows.append(f"('h{i % 20}', {1000 + i}, {float(i)})")
+    qe.execute_one(f"INSERT INTO t VALUES {', '.join(rows)}")
+    rid = qe.catalog.table("public", "t").region_ids[0]
+    engine.flush(rid)
+
+    for where in [
+        "host = 'h3'",
+        "host IN ('h1', 'h19')",
+        "host LIKE 'h1%'",
+        "host BETWEEN 'h10' AND 'h19'",
+        "host >= 'h5' AND host < 'h7'",
+    ]:
+        r = qe.execute_one(
+            f"SELECT host, ts, v FROM t WHERE {where} ORDER BY host, ts")
+        import re as _re
+
+        vals = [f"h{i}" for i in range(20)]
+        if "=" in where and "BETWEEN" not in where and ">=" not in where:
+            pass
+        # oracle in python over the same value set
+        def match(h):
+            if where.startswith("host = "):
+                return h == "h3"
+            if where.startswith("host IN"):
+                return h in ("h1", "h19")
+            if where.startswith("host LIKE"):
+                return _re.fullmatch("h1.*", h) is not None
+            if where.startswith("host BETWEEN"):
+                return "h10" <= h <= "h19"
+            return "h5" <= h < "h7"
+
+        expect = sorted(
+            [(f"h{i % 20}", 1000 + i, float(i)) for i in range(400)
+             if match(f"h{i % 20}")],
+            key=lambda r: (r[0], r[1]))
+        got = list(zip(*(r.column(c) for c in ("host", "ts", "v"))))
+        got = [(str(h), int(t), float(v)) for h, t, v in got]
+        assert got == expect, where
+    engine.close()
