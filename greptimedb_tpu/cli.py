@@ -15,30 +15,113 @@ import sys
 import time
 
 
-def build_standalone(data_home: str):
+def build_standalone(data_home: str, opts=None):
     """Assemble the standalone stack (reference cmd/src/standalone.rs:381-530
     wiring: kv backend -> catalog -> region engine -> query engine)."""
+    from greptimedb_tpu import options as optmod
     from greptimedb_tpu.catalog import Catalog, FileKv
     from greptimedb_tpu.query import QueryEngine
     from greptimedb_tpu.storage import RegionEngine
     from greptimedb_tpu.storage.engine import EngineConfig
 
     os.makedirs(data_home, exist_ok=True)
-    engine = RegionEngine(EngineConfig(data_dir=os.path.join(data_home, "data")))
+    if opts is not None:
+        optmod.apply_query_env(opts)
+        cfg = optmod.engine_config(opts, os.path.join(data_home, "data"))
+    else:
+        cfg = EngineConfig(data_dir=os.path.join(data_home, "data"))
+    engine = RegionEngine(cfg)
     catalog = Catalog(FileKv(os.path.join(data_home, "catalog.json")))
     qe = QueryEngine(catalog, engine)
     return engine, qe
 
 
+def _split_addr(addr: str) -> tuple[str, int]:
+    host, _, port = addr.rpartition(":")
+    return host or "127.0.0.1", int(port)
+
+
+def _user_provider(opts):
+    if not opts.auth.static_users:
+        return None
+    from greptimedb_tpu.auth import StaticUserProvider
+
+    pairs = dict(p.split("=", 1) for p in opts.auth.static_users.split(","))
+    return StaticUserProvider(pairs)
+
+
+def _tls(tls_opts):
+    if tls_opts.mode == "disable":
+        return None
+    if not tls_opts.cert_path or not tls_opts.key_path:
+        # never downgrade silently: a config that asks for TLS but can't
+        # provide it must abort boot, not serve plaintext
+        from greptimedb_tpu.options import ConfigError
+
+        raise ConfigError(
+            f"tls.mode = {tls_opts.mode!r} requires cert_path and key_path")
+    from greptimedb_tpu.servers.tls import TlsConfig
+
+    return TlsConfig(cert_path=tls_opts.cert_path,
+                     key_path=tls_opts.key_path,
+                     mode=tls_opts.mode)
+
+
 def cmd_standalone(args):
+    """Boot the full server set per layered options (reference
+    frontend/src/server.rs:174-263 Services::build — always HTTP, optional
+    Flight/MySQL/Postgres, plus the export-metrics self-scrape)."""
+    from greptimedb_tpu.options import load_options
+
+    overrides: dict = {}
+    if args.http_addr:
+        overrides.setdefault("http", {})["addr"] = args.http_addr
+    opts = load_options(args.config_file, overrides=overrides)
+    engine, qe = build_standalone(args.data_home or opts.storage.data_home,
+                                  opts)
+    user_provider = _user_provider(opts)
+    servers = []
     from greptimedb_tpu.servers import HttpServer
 
-    engine, qe = build_standalone(args.data_home)
-    host, _, port = args.http_addr.rpartition(":")
-    server = HttpServer(qe, host or "127.0.0.1", int(port))
-    actual = server.start()
-    print(f"greptimedb_tpu standalone listening on http://{host or '127.0.0.1'}:{actual}",
+    host, port = _split_addr(opts.http.addr)
+    http_server = HttpServer(qe, host, port, user_provider=user_provider)
+    actual = http_server.start()
+    servers.append(http_server)
+    print(f"greptimedb_tpu standalone listening on http://{host}:{actual}",
           flush=True)
+    if opts.grpc.enable:
+        from greptimedb_tpu.servers.flight import FlightServer
+
+        ghost, gport = _split_addr(opts.grpc.addr)
+        fs = FlightServer(qe, ghost, gport, user_provider=user_provider)
+        threading_start(fs)
+        servers.append(fs)
+        print(f"flight on grpc://{ghost}:{fs.port}", flush=True)
+    if opts.mysql.enable:
+        from greptimedb_tpu.servers.mysql import MysqlServer
+
+        mhost, mport = _split_addr(opts.mysql.addr)
+        ms = MysqlServer(qe, mhost, mport, user_provider=user_provider,
+                         tls=_tls(opts.mysql.tls))
+        ms.start()
+        servers.append(ms)
+        print(f"mysql on {mhost}:{ms.port}", flush=True)
+    if opts.postgres.enable:
+        from greptimedb_tpu.servers.postgres import PostgresServer
+
+        phost, pport = _split_addr(opts.postgres.addr)
+        ps = PostgresServer(qe, phost, pport, user_provider=user_provider,
+                            tls=_tls(opts.postgres.tls))
+        ps.start()
+        servers.append(ps)
+        print(f"postgres on {phost}:{ps.port}", flush=True)
+    task = None
+    if opts.metrics.enable:
+        from greptimedb_tpu.utils.export_metrics import ExportMetricsTask
+
+        task = ExportMetricsTask(qe, db=opts.metrics.db,
+                                 interval_s=opts.metrics.write_interval_s)
+        task.start()
     stop = []
     signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
     signal.signal(signal.SIGINT, lambda *a: stop.append(1))
@@ -46,8 +129,27 @@ def cmd_standalone(args):
         while not stop:
             time.sleep(0.2)
     finally:
-        server.stop()
+        if task is not None:
+            task.stop()
+        for s in servers:
+            try:
+                s.stop()
+            except AttributeError:
+                s.shutdown()
         engine.close()
+
+
+def threading_start(flight_server):
+    import threading
+
+    t = threading.Thread(target=flight_server.serve, daemon=True)
+    t.start()
+
+
+def cmd_dump_config(args):
+    from greptimedb_tpu.options import example_toml
+
+    sys.stdout.write(example_toml())
 
 
 def cmd_repl(args):
@@ -86,13 +188,20 @@ def main(argv=None):
     p_sa = sub.add_parser("standalone", help="run the standalone server")
     sa_sub = p_sa.add_subparsers(dest="subcmd", required=True)
     p_start = sa_sub.add_parser("start")
-    p_start.add_argument("--data-home", default="./greptimedb_tpu_data")
-    p_start.add_argument("--http-addr", default="127.0.0.1:4000")
+    p_start.add_argument("--data-home", default="")
+    p_start.add_argument("--http-addr", default="")
+    p_start.add_argument("-c", "--config-file", default=None,
+                         help="layered TOML config (defaults < file < "
+                              "GREPTIMEDB_TPU__* env < flags)")
     p_start.set_defaults(fn=cmd_standalone)
 
     p_repl = sub.add_parser("repl", help="interactive SQL/TQL shell")
     p_repl.add_argument("--data-home", default="./greptimedb_tpu_data")
     p_repl.set_defaults(fn=cmd_repl)
+
+    p_dump = sub.add_parser("dump-config",
+                            help="print the documented example TOML config")
+    p_dump.set_defaults(fn=cmd_dump_config)
 
     args = parser.parse_args(argv)
     args.fn(args)

@@ -259,9 +259,16 @@ def build_store(kind: str = "fs", cache_bytes: int = 0, **kwargs) -> ObjectStore
         store: ObjectStore = FsStore()
     elif kind == "memory":
         store = MemoryStore()
+    elif kind == "s3":
+        from greptimedb_tpu.objectstore.s3 import S3Store
+
+        try:
+            store = S3Store(**kwargs)
+        except TypeError as e:
+            raise ObjectStoreError(f"s3 store misconfigured: {e}") from None
     else:
         raise ObjectStoreError(
-            f"unsupported object store {kind!r} (supported: fs, memory)")
+            f"unsupported object store {kind!r} (supported: fs, memory, s3)")
     if cache_bytes > 0:
         store = LruCacheLayer(store, cache_bytes)
     return store

@@ -307,7 +307,8 @@ class FlightServer(fl.FlightServerBase):
         ts_range = tuple(req["ts_range"]) if req.get("ts_range") else None
         projection = req.get("projection")
         from greptimedb_tpu.storage.index import deserialize_predicates
-        preds = deserialize_predicates(req.get("tag_predicates"))
+        preds = deserialize_predicates(
+            req.get("tag_predicates_v2") or req.get("tag_predicates"))
         if req.get("trace_id"):
             # adopt the caller's trace (region_server.rs:74 analog)
             tracing.set_trace(req["trace_id"])
@@ -607,8 +608,14 @@ class RemoteRegionEngine:
         if projection is not None:
             spec["projection"] = list(projection)
         if tag_predicates:
-            from greptimedb_tpu.storage.index import serialize_predicates
-            spec["tag_predicates"] = serialize_predicates(tag_predicates)
+            from greptimedb_tpu.storage.index import (
+                serialize_predicates,
+                serialize_predicates_legacy,
+            )
+            legacy = serialize_predicates_legacy(tag_predicates)
+            if legacy:  # shape old peers can parse (InSets only)
+                spec["tag_predicates"] = legacy
+            spec["tag_predicates_v2"] = serialize_predicates(tag_predicates)
         tid = tracing.current_trace_id()
         if tid:
             # W3C-style propagation: the frontend's trace id crosses the
@@ -683,8 +690,14 @@ class RegionFlightClient:
         if projection is not None:
             spec["projection"] = list(projection)
         if tag_predicates:
-            from greptimedb_tpu.storage.index import serialize_predicates
-            spec["tag_predicates"] = serialize_predicates(tag_predicates)
+            from greptimedb_tpu.storage.index import (
+                serialize_predicates,
+                serialize_predicates_legacy,
+            )
+            legacy = serialize_predicates_legacy(tag_predicates)
+            if legacy:
+                spec["tag_predicates"] = legacy
+            spec["tag_predicates_v2"] = serialize_predicates(tag_predicates)
         ticket = fl.Ticket(json.dumps({"region_scan": spec}).encode())
         t = self.client.do_get(ticket).read_all()
         if (t.schema.metadata or {}).get(b"empty") == b"1":

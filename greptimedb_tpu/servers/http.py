@@ -149,6 +149,28 @@ class _Handler(BaseHTTPRequestHandler):
                     self.wfile.write(data)
                     HTTP_REQUESTS.inc(path=path, status="401")
                     return
+            if path.startswith("/debug/pprof/"):
+                # on-demand profiling (reference servers/src/http/pprof.rs
+                # + mem_prof.rs) — folded CPU stacks / tracemalloc heap.
+                # Sits BEHIND the auth gate: stack samples and heap
+                # contents are sensitive (only /health and /metrics are
+                # exempt, matching authorize.rs)
+                from greptimedb_tpu.utils import profiling
+
+                qs = urllib.parse.parse_qs(
+                    urllib.parse.urlparse(self.path).query)
+                if path == "/debug/pprof/cpu":
+                    secs = min(float(qs.get("seconds", ["5"])[0]), 60.0)
+                    out = profiling.sample_cpu(seconds=secs)
+                    return self._send(200, out.encode(), "text/plain")
+                if path == "/debug/pprof/mem":
+                    if qs.get("action", [""])[0] == "stop":
+                        out = profiling.mem_profile_stop()
+                    else:
+                        out = profiling.mem_profile(
+                            top=int(qs.get("top", ["50"])[0]))
+                    return self._send(200, out.encode(), "text/plain")
+                return self._send(404, {"error": f"no route {path}"})
             if path == "/v1/sql":
                 return self._handle_sql()
             if path == "/v1/promql":

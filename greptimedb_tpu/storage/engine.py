@@ -65,9 +65,11 @@ class EngineConfig:
     # WriteBufferManager global budget, flush.rs:83-135)
     flush_threshold_bytes: int = 256 << 20
     # object store backend for SSTs/manifest/index (reference
-    # object-store crate; fs|memory, optional LRU read cache)
+    # object-store crate; fs|memory|s3, optional LRU read cache)
     object_store: str = "fs"
     object_store_cache_bytes: int = 0
+    # backend-specific construction args (s3: bucket/endpoint/keys...)
+    object_store_kwargs: dict = field(default_factory=dict)
 
 
 class RegionEngine:
@@ -76,7 +78,8 @@ class RegionEngine:
 
         self.config = config
         self.store = build_store(config.object_store,
-                                 config.object_store_cache_bytes)
+                                 config.object_store_cache_bytes,
+                                 **config.object_store_kwargs)
         os.makedirs(config.data_dir, exist_ok=True)
         if config.wal_backend == "remote":
             from greptimedb_tpu.storage.remote_wal import RemoteWal

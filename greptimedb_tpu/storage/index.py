@@ -126,11 +126,6 @@ def serialize_predicates(preds: Optional[PredicateMap]) -> Optional[dict]:
         return None
     out: dict[str, list] = {}
     for k, pv in normalize_predicates(preds).items():
-        if len(pv) == 1 and isinstance(pv[0], InSet):
-            # bare value list: the pre-Range/Regex wire form, readable by
-            # older peers during a rolling upgrade
-            out[k] = list(pv[0].values)
-            continue
         ser = []
         for p in pv:
             if isinstance(p, InSet):
@@ -141,6 +136,22 @@ def serialize_predicates(preds: Optional[PredicateMap]) -> Optional[dict]:
                 ser.append({"regex": p.pattern})
         out[k] = ser
     return out
+
+
+def serialize_predicates_legacy(preds: Optional[PredicateMap]) \
+        -> Optional[dict]:
+    """Bare value-list wire form — the only shape pre-Range/Regex peers
+    parse. Tags whose predicates aren't a single InSet are DROPPED (losing
+    pruning, never correctness: pruning is advisory, the scan-side filter
+    still runs). Ship alongside serialize_predicates under a separate key
+    so either end of a mixed-version pair finds a form it understands."""
+    if not preds:
+        return None
+    out = {}
+    for k, pv in normalize_predicates(preds).items():
+        if len(pv) == 1 and isinstance(pv[0], InSet):
+            out[k] = list(pv[0].values)
+    return out or None
 
 
 def deserialize_predicates(obj) -> Optional[dict]:

@@ -1,0 +1,86 @@
+"""Self-scrape: periodically write the process's own metrics into tables.
+
+Mirrors the reference's `export_metrics` (servers/src/export_metrics.rs,
+wired at frontend/src/instance.rs:267-277): the DB monitors itself by
+turning every /metrics sample into rows of a `greptime_metrics` database,
+one table per metric, labels as tag columns, so operational history is
+queryable with plain SQL/PromQL."""
+
+from __future__ import annotations
+
+import re
+import threading
+import time
+from collections import defaultdict
+
+import numpy as np
+
+GREPTIME_TIMESTAMP = "greptime_timestamp"
+GREPTIME_VALUE = "greptime_value"
+
+
+def _sanitize(name: str) -> str:
+    return re.sub(r"[^0-9a-zA-Z_]", "_", name)
+
+
+def write_metrics_once(query_engine, db: str = "greptime_metrics") -> int:
+    """One scrape: REGISTRY samples -> rows. Returns rows written."""
+    from greptimedb_tpu.datatypes import DictVector, RecordBatch
+    from greptimedb_tpu.query.engine import QueryContext
+    from greptimedb_tpu.servers.prom_store import _ensure_table
+    from greptimedb_tpu.utils.metrics import REGISTRY
+
+    query_engine.execute_one(f"CREATE DATABASE IF NOT EXISTS {db}")
+    ctx = QueryContext(db=db)
+    now = int(time.time() * 1000)
+    by_table: dict[str, list[tuple[dict, float]]] = defaultdict(list)
+    for name, value, labels in REGISTRY.samples_dict():
+        by_table[_sanitize(name)].append((labels, float(value)))
+    total = 0
+    for table, entries in by_table.items():
+        tag_names = sorted({k for labels, _ in entries for k in labels})
+        info = _ensure_table(query_engine, ctx, table, tag_names)
+        known = [c.name for c in info.schema.tag_columns]
+        cols: dict = {
+            t: DictVector.encode([str(labels.get(t)) if labels.get(t)
+                                  is not None else None
+                                  for labels, _ in entries])
+            for t in known
+        }
+        cols[GREPTIME_TIMESTAMP] = np.full(len(entries), now, dtype=np.int64)
+        cols[GREPTIME_VALUE] = np.asarray([v for _, v in entries],
+                                          dtype=np.float64)
+        batch = RecordBatch(info.schema, cols)
+        total += query_engine._sharded_write(info, batch, delete=False)
+    return total
+
+
+class ExportMetricsTask:
+    """Background self-scrape loop (RepeatedTask analog,
+    common/runtime/src/repeated_task.rs)."""
+
+    def __init__(self, query_engine, db: str = "greptime_metrics",
+                 interval_s: float = 30.0):
+        self.qe = query_engine
+        self.db = db
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.errors = 0
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="export-metrics")
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                write_metrics_once(self.qe, self.db)
+            except Exception:  # noqa: BLE001 — scrape must never kill serving
+                self.errors += 1
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

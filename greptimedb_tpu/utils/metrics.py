@@ -145,24 +145,33 @@ class Registry:
             lines.extend(m.render())
         return "\n".join(lines) + "\n"
 
-    def samples(self):
-        """Flat (metric_name, value, labels) samples — feeds
-        information_schema.runtime_metrics and the self-scrape exporter."""
-        out = []
+    def _iter_samples(self):
+        """(metric_name, value, label-pairs tuple) over every metric."""
         with self._lock:
             metrics = list(self._metrics)
         for m in metrics:
             if isinstance(m, Histogram):
                 with m._lock:
-                    for key in m._count:
-                        out.append((m.name + "_sum", m._sum[key], _labels(key)))
-                        out.append((m.name + "_count", m._count[key], _labels(key)))
+                    items = [(key, m._sum[key], m._count[key])
+                             for key in m._count]
+                for key, s, c in items:
+                    yield m.name + "_sum", s, key
+                    yield m.name + "_count", c, key
             else:
                 with m._lock:
                     items = sorted(m._values.items())
                 for key, v in items:
-                    out.append((m.name, v, _labels(key)))
-        return out
+                    yield m.name, v, key
+
+    def samples(self):
+        """Flat (metric_name, value, rendered labels) samples — feeds
+        information_schema.runtime_metrics."""
+        return [(n, v, _labels(k)) for n, v, k in self._iter_samples()]
+
+    def samples_dict(self):
+        """(metric_name, value, labels dict) — feeds the self-scrape
+        exporter (reference export_metrics writes label columns)."""
+        return [(n, v, dict(k)) for n, v, k in self._iter_samples()]
 
 
 REGISTRY = Registry()
