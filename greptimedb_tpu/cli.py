@@ -25,14 +25,16 @@ def build_standalone(data_home: str, opts=None):
     from greptimedb_tpu.storage.engine import EngineConfig
 
     os.makedirs(data_home, exist_ok=True)
+    tz = "UTC"
     if opts is not None:
         optmod.apply_query_env(opts)
         cfg = optmod.engine_config(opts, os.path.join(data_home, "data"))
+        tz = opts.default_timezone
     else:
         cfg = EngineConfig(data_dir=os.path.join(data_home, "data"))
     engine = RegionEngine(cfg)
     catalog = Catalog(FileKv(os.path.join(data_home, "catalog.json")))
-    qe = QueryEngine(catalog, engine)
+    qe = QueryEngine(catalog, engine, default_timezone=tz)
     return engine, qe
 
 
@@ -45,8 +47,19 @@ def _user_provider(opts):
     if not opts.auth.static_users:
         return None
     from greptimedb_tpu.auth import StaticUserProvider
+    from greptimedb_tpu.options import ConfigError
 
-    pairs = dict(p.split("=", 1) for p in opts.auth.static_users.split(","))
+    pairs = {}
+    for entry in opts.auth.static_users.split(","):
+        user, sep, password = entry.partition("=")
+        if not sep or not user.strip():
+            raise ConfigError(
+                f"auth.static_users entry {entry!r} is not user=password")
+        if user.strip() in pairs:
+            raise ConfigError(
+                f"auth.static_users: duplicate user {user.strip()!r} — "
+                "note passwords may not contain ','")
+        pairs[user.strip()] = password
     return StaticUserProvider(pairs)
 
 
@@ -81,14 +94,16 @@ def cmd_standalone(args):
                                   opts)
     user_provider = _user_provider(opts)
     servers = []
-    from greptimedb_tpu.servers import HttpServer
+    if opts.http.enable:
+        from greptimedb_tpu.servers import HttpServer
 
-    host, port = _split_addr(opts.http.addr)
-    http_server = HttpServer(qe, host, port, user_provider=user_provider)
-    actual = http_server.start()
-    servers.append(http_server)
-    print(f"greptimedb_tpu standalone listening on http://{host}:{actual}",
-          flush=True)
+        host, port = _split_addr(opts.http.addr)
+        http_server = HttpServer(qe, host, port, user_provider=user_provider,
+                                 timeout_s=opts.http.timeout_s)
+        actual = http_server.start()
+        servers.append(http_server)
+        print(f"greptimedb_tpu standalone listening on http://{host}:{actual}",
+              flush=True)
     if opts.grpc.enable:
         from greptimedb_tpu.servers.flight import FlightServer
 

@@ -92,5 +92,15 @@ class DictVector:
     def remap(self, mapping: np.ndarray) -> "DictVector":
         """Rewrite codes through `mapping` (old code -> new code), used when
         merging per-SST dictionaries into a region-global dictionary."""
-        new_codes = np.where(self.codes >= 0, mapping[np.clip(self.codes, 0, None)], -1)
-        return DictVector(new_codes.astype(np.int32), self.values)
+        return DictVector(remap_codes(self.codes, mapping), self.values)
+
+
+def remap_codes(codes: np.ndarray, mapping: np.ndarray) -> np.ndarray:
+    """codes -> mapping[codes] with NULL (-1) preserved. Safe for an empty
+    mapping — an all-NULL tag column has an empty dictionary, and indexing
+    an empty array even with clipped codes raises."""
+    codes = np.asarray(codes)
+    if mapping.size == 0:
+        return np.full(len(codes), -1, dtype=np.int32)
+    return np.where(codes >= 0,
+                    mapping[np.clip(codes, 0, None)], -1).astype(np.int32)

@@ -36,12 +36,14 @@ from greptimedb_tpu.session import QueryContext  # noqa: E402
 
 class QueryEngine:
     def __init__(self, catalog: Catalog, region_engine: RegionEngine,
-                 metric_engine=None, plugins=None):
+                 metric_engine=None, plugins=None,
+                 default_timezone: str = "UTC"):
         from greptimedb_tpu.auth import PermissionChecker
         from greptimedb_tpu.plugins import default_plugins
 
         self.catalog = catalog
         self.region_engine = region_engine
+        self.default_timezone = default_timezone
         self.permission_checker = PermissionChecker()
         self.plugins = plugins if plugins is not None else default_plugins()
         self.executor = PhysicalExecutor(region_engine)
@@ -61,7 +63,7 @@ class QueryEngine:
     # ---- entry points ------------------------------------------------------
 
     def execute_sql(self, sql: str, ctx: Optional[QueryContext] = None) -> list[QueryResult]:
-        ctx = ctx or QueryContext()
+        ctx = ctx or QueryContext(timezone=self.default_timezone)
         # plugin interceptors may rewrite or veto the statement before
         # parsing (reference SqlQueryInterceptor, frontend/src/instance.rs)
         sql = self.plugins.intercept_sql(sql, ctx)

@@ -27,11 +27,13 @@ from greptimedb_tpu.utils.time import unit_to_ns
 
 class HttpServer:
     def __init__(self, query_engine: QueryEngine, host: str = "127.0.0.1",
-                 port: int = 4000, user_provider=None):
+                 port: int = 4000, user_provider=None,
+                 timeout_s: Optional[float] = None):
         self.qe = query_engine
         self.host = host
         self.port = port
         self.user_provider = user_provider
+        self.timeout_s = timeout_s
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -48,6 +50,10 @@ class HttpServer:
         class Handler(_Handler):
             query_engine = qe
             user_provider = provider
+            # socketserver honors this as the per-connection socket
+            # timeout (http.timeout_s option)
+            if self.timeout_s:
+                timeout = self.timeout_s
 
         self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
         self.port = self._httpd.server_address[1]

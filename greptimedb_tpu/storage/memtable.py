@@ -109,9 +109,10 @@ class Memtable:
             col = batch.columns[c.name]
             if c.semantic is SemanticType.TAG:
                 if isinstance(col, DictVector):
+                    from greptimedb_tpu.datatypes.vector import remap_codes
+
                     mapping = self.registry.remap_dict(c.name, col.values)
-                    codes = np.where(col.codes >= 0, mapping[np.clip(col.codes, 0, None)], -1)
-                    cols[c.name] = codes.astype(np.int32)
+                    cols[c.name] = remap_codes(col.codes, mapping)
                 else:
                     cols[c.name] = self.registry.encode(c.name, np.asarray(col, dtype=object))
             elif isinstance(col, DictVector):

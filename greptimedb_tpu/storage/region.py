@@ -515,10 +515,10 @@ class Region:
                 dv = DictVector.from_arrow(
                     arr.combine_chunks() if isinstance(arr, pa.ChunkedArray) else arr
                 )
+                from greptimedb_tpu.datatypes.vector import remap_codes
+
                 mapping = self.registry.remap_dict(c.name, dv.values)
-                codes = np.where(dv.codes >= 0,
-                                 mapping[np.clip(dv.codes, 0, None)], -1)
-                cols[c.name] = codes.astype(np.int32)
+                cols[c.name] = remap_codes(dv.codes, mapping)
             elif c.dtype.is_timestamp:
                 cols[c.name] = arr.to_numpy(zero_copy_only=False).astype(np.int64)
             else:

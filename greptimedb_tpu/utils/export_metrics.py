@@ -8,12 +8,15 @@ queryable with plain SQL/PromQL."""
 
 from __future__ import annotations
 
+import logging
 import re
 import threading
 import time
 from collections import defaultdict
 
 import numpy as np
+
+logger = logging.getLogger(__name__)
 
 GREPTIME_TIMESTAMP = "greptime_timestamp"
 GREPTIME_VALUE = "greptime_value"
@@ -38,20 +41,27 @@ def write_metrics_once(query_engine, db: str = "greptime_metrics") -> int:
         by_table[_sanitize(name)].append((labels, float(value)))
     total = 0
     for table, entries in by_table.items():
-        tag_names = sorted({k for labels, _ in entries for k in labels})
-        info = _ensure_table(query_engine, ctx, table, tag_names)
-        known = [c.name for c in info.schema.tag_columns]
-        cols: dict = {
-            t: DictVector.encode([str(labels.get(t)) if labels.get(t)
-                                  is not None else None
-                                  for labels, _ in entries])
-            for t in known
-        }
-        cols[GREPTIME_TIMESTAMP] = np.full(len(entries), now, dtype=np.int64)
-        cols[GREPTIME_VALUE] = np.asarray([v for _, v in entries],
-                                          dtype=np.float64)
-        batch = RecordBatch(info.schema, cols)
-        total += query_engine._sharded_write(info, batch, delete=False)
+        # one broken metric table (e.g. a label key that appeared after
+        # creation) must not stop the rest of the scrape — skip it loudly
+        try:
+            tag_names = sorted({k for labels, _ in entries for k in labels})
+            info = _ensure_table(query_engine, ctx, table, tag_names)
+            known = [c.name for c in info.schema.tag_columns]
+            cols: dict = {
+                t: DictVector.encode([str(labels.get(t)) if labels.get(t)
+                                      is not None else None
+                                      for labels, _ in entries])
+                for t in known
+            }
+            cols[GREPTIME_TIMESTAMP] = np.full(len(entries), now,
+                                               dtype=np.int64)
+            cols[GREPTIME_VALUE] = np.asarray([v for _, v in entries],
+                                              dtype=np.float64)
+            batch = RecordBatch(info.schema, cols)
+            total += query_engine._sharded_write(info, batch, delete=False)
+        except Exception:  # noqa: BLE001
+            logger.warning("self-scrape: skipping metric table %r",
+                           table, exc_info=True)
     return total
 
 
@@ -79,6 +89,7 @@ class ExportMetricsTask:
                 write_metrics_once(self.qe, self.db)
             except Exception:  # noqa: BLE001 — scrape must never kill serving
                 self.errors += 1
+                logger.warning("self-scrape cycle failed", exc_info=True)
 
     def stop(self) -> None:
         self._stop.set()

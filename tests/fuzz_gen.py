@@ -1,0 +1,187 @@
+"""Grammar-based SQL fuzzing IR (reference tests-fuzz/src/{ir,generator,
+translator,validator}: random DDL/DML generators over a typed IR, executed
+against the real engine and validated against an independent oracle).
+
+The IR is a `TableModel` the generator mutates in lockstep with the DDL it
+emits; DML/queries generated from the model are always schema-valid, so
+every statement must SUCCEED — an error is a finding, not noise. A pandas
+shadow copy of all inserted rows is the differential oracle for SELECTs
+(the validator role)."""
+
+from __future__ import annotations
+
+import random
+import string
+from dataclasses import dataclass, field
+
+TAG_TYPES = ["STRING"]
+FIELD_TYPES = ["DOUBLE", "FLOAT", "BIGINT", "INT", "SMALLINT", "BOOLEAN"]
+TS_TYPES = ["TIMESTAMP(3)", "TIMESTAMP(0)", "TIMESTAMP(6)"]
+
+
+@dataclass
+class Col:
+    name: str
+    sql_type: str
+    semantic: str  # tag | field | ts
+
+
+@dataclass
+class TableModel:
+    name: str
+    cols: list[Col] = field(default_factory=list)
+    append_mode: bool = False
+    next_ts: int = 1_600_000_000_000
+
+    @property
+    def tags(self):
+        return [c for c in self.cols if c.semantic == "tag"]
+
+    @property
+    def fields(self):
+        return [c for c in self.cols if c.semantic == "field"]
+
+    @property
+    def ts_col(self):
+        return next(c for c in self.cols if c.semantic == "ts")
+
+
+class Generator:
+    """Deterministic per-seed statement generator."""
+
+    def __init__(self, seed: int):
+        self.rng = random.Random(seed)
+        self.n_names = 0
+
+    def name(self, prefix: str) -> str:
+        self.n_names += 1
+        suffix = "".join(self.rng.choices(string.ascii_lowercase, k=4))
+        return f"{prefix}_{self.n_names}_{suffix}"
+
+    # ---- DDL ---------------------------------------------------------------
+
+    def gen_create_table(self) -> tuple[TableModel, str]:
+        rng = self.rng
+        model = TableModel(self.name("t"), append_mode=rng.random() < 0.3)
+        n_tags = rng.randint(0, 3)
+        n_fields = rng.randint(1, 6)
+        for _ in range(n_tags):
+            model.cols.append(Col(self.name("tag"), rng.choice(TAG_TYPES),
+                                  "tag"))
+        ts_type = rng.choice(TS_TYPES)
+        model.cols.append(Col(self.name("ts"), ts_type, "ts"))
+        for _ in range(n_fields):
+            model.cols.append(Col(self.name("f"), rng.choice(FIELD_TYPES),
+                                  "field"))
+        rng.shuffle(model.cols)
+        defs = []
+        for c in model.cols:
+            if c.semantic == "ts":
+                defs.append(f"{c.name} {c.sql_type} NOT NULL")
+            else:
+                defs.append(f"{c.name} {c.sql_type}")
+        defs.append(f"TIME INDEX ({model.ts_col.name})")
+        if model.tags:
+            defs.append(
+                "PRIMARY KEY (" + ", ".join(c.name for c in model.tags) + ")")
+        with_clause = " WITH (append_mode = 'true')" if model.append_mode \
+            else ""
+        sql = f"CREATE TABLE {model.name} ({', '.join(defs)}){with_clause}"
+        return model, sql
+
+    def gen_add_column(self, model: TableModel) -> str:
+        col = Col(self.name("f"), self.rng.choice(FIELD_TYPES), "field")
+        model.cols.append(col)
+        return f"ALTER TABLE {model.name} ADD COLUMN {col.name} {col.sql_type}"
+
+    def gen_rename(self, model: TableModel) -> str:
+        new = self.name("t")
+        sql = f"ALTER TABLE {model.name} RENAME TO {new}"
+        model.name = new
+        return sql
+
+    # ---- DML ---------------------------------------------------------------
+
+    def _value(self, c: Col, model: TableModel):
+        rng = self.rng
+        if c.semantic == "ts":
+            # bare integer literals are interpreted in the column's own
+            # unit (utils/time.py coerce_ts_literal), so a monotonically
+            # increasing int is valid for every TIMESTAMP precision
+            model.next_ts += rng.randint(1, 10_000)
+            return model.next_ts
+        if c.semantic == "tag":
+            if rng.random() < 0.1:
+                return None
+            return f"v{rng.randint(0, 5)}"
+        if rng.random() < 0.1:
+            return None
+        if c.sql_type in ("DOUBLE", "FLOAT"):
+            v = round(rng.uniform(-1e6, 1e6), 3)
+            return v
+        if c.sql_type == "BOOLEAN":
+            return rng.random() < 0.5
+        if c.sql_type == "SMALLINT":
+            return rng.randint(-32768, 32767)
+        if c.sql_type == "INT":
+            return rng.randint(-2**31, 2**31 - 1)
+        return rng.randint(-2**40, 2**40)
+
+    def gen_insert(self, model: TableModel, max_rows: int = 20) \
+            -> tuple[str, list[dict]]:
+        rng = self.rng
+        n = rng.randint(1, max_rows)
+        rows = []
+        for _ in range(n):
+            rows.append({c.name: self._value(c, model) for c in model.cols})
+        cols = [c.name for c in model.cols]
+
+        def lit(v):
+            if v is None:
+                return "NULL"
+            if isinstance(v, bool):
+                return "TRUE" if v else "FALSE"
+            if isinstance(v, str):
+                return "'" + v.replace("'", "''") + "'"
+            return repr(v)
+
+        values = ", ".join(
+            "(" + ", ".join(lit(r[c]) for c in cols) + ")" for r in rows)
+        sql = f"INSERT INTO {model.name} ({', '.join(cols)}) VALUES {values}"
+        return sql, rows
+
+    # ---- queries -----------------------------------------------------------
+
+    def gen_count_query(self, model: TableModel) -> str:
+        return f"SELECT count(*) FROM {model.name}"
+
+    def gen_agg_query(self, model: TableModel):
+        """Aggregate over one numeric field, optionally grouped by one tag.
+        Returns (sql, field, tag|None, agg)."""
+        rng = self.rng
+        numeric = [c for c in model.fields
+                   if c.sql_type in ("DOUBLE", "FLOAT", "BIGINT", "INT",
+                                     "SMALLINT")]
+        if not numeric:
+            return None
+        f = rng.choice(numeric)
+        agg = rng.choice(["sum", "min", "max", "count", "avg"])
+        tag = rng.choice(model.tags) if model.tags and rng.random() < 0.7 \
+            else None
+        if tag is not None:
+            sql = (f"SELECT {tag.name}, {agg}({f.name}) FROM {model.name} "
+                   f"GROUP BY {tag.name} ORDER BY {tag.name}")
+        else:
+            sql = f"SELECT {agg}({f.name}) FROM {model.name}"
+        return sql, f, tag, agg
+
+    def gen_filter_query(self, model: TableModel):
+        """Point lookup on a tag (exercises index pruning). Returns
+        (sql, tag, value)."""
+        if not model.tags:
+            return None
+        tag = self.rng.choice(model.tags)
+        v = f"v{self.rng.randint(0, 5)}"
+        sql = (f"SELECT count(*) FROM {model.name} "
+               f"WHERE {tag.name} = '{v}'")
+        return sql, tag, v

@@ -98,6 +98,66 @@ class TestLayering:
         assert cfg.object_store == "memory"
         assert cfg.flush_threshold_bytes == 123
 
+    def test_engine_config_s3_kwargs_construct(self):
+        """[storage] type = 's3' kwargs must match S3Store's signature
+        (code-review regression: root->prefix, access_key_id->access_key).
+        """
+        from greptimedb_tpu.objectstore import LruCacheLayer, build_store
+        from greptimedb_tpu.objectstore.s3 import S3Store
+        from greptimedb_tpu.options import engine_config
+
+        opts = load_options(env={
+            "GREPTIMEDB_TPU__STORAGE__TYPE": "s3",
+            "GREPTIMEDB_TPU__STORAGE__CACHE_BYTES": "1024",
+            "GREPTIMEDB_TPU__STORAGE__S3__BUCKET": "b",
+            "GREPTIMEDB_TPU__STORAGE__S3__ROOT": "data/x",
+            "GREPTIMEDB_TPU__STORAGE__S3__ENDPOINT": "http://127.0.0.1:9",
+            "GREPTIMEDB_TPU__STORAGE__S3__ACCESS_KEY_ID": "ak",
+            "GREPTIMEDB_TPU__STORAGE__S3__SECRET_ACCESS_KEY": "sk",
+        })
+        cfg = engine_config(opts, "/tmp/x")
+        store = build_store(cfg.object_store, cfg.object_store_cache_bytes,
+                            **cfg.object_store_kwargs)
+        assert isinstance(store, LruCacheLayer)
+        inner = store.inner
+        assert isinstance(inner, S3Store)
+        assert inner.bucket == "b"
+        assert inner.prefix == "data/x"
+        assert inner.access_key == "ak"
+        assert inner.secret_key == "sk"
+
+    def test_apply_query_env_does_not_clobber(self, monkeypatch):
+        """Operator-set kernel env vars beat config defaults
+        (code-review regression)."""
+        import os as _os
+
+        from greptimedb_tpu.options import apply_query_env
+
+        monkeypatch.setenv("GREPTIMEDB_TPU_DENSE_GROUPS_MAX", "100")
+        monkeypatch.delenv("GREPTIMEDB_TPU_STREAM_BLOCK_ROWS", raising=False)
+        opts = load_options(env={})  # all defaults
+        apply_query_env(opts)
+        assert _os.environ["GREPTIMEDB_TPU_DENSE_GROUPS_MAX"] == "100"
+        # defaults are not written at all
+        assert "GREPTIMEDB_TPU_STREAM_BLOCK_ROWS" not in _os.environ
+        # non-default config values are written (when env is unset)
+        opts2 = load_options(
+            env={"GREPTIMEDB_TPU__QUERY__STREAM_BLOCK_ROWS": "4096"})
+        apply_query_env(opts2)
+        assert _os.environ["GREPTIMEDB_TPU_STREAM_BLOCK_ROWS"] == "4096"
+        monkeypatch.delenv("GREPTIMEDB_TPU_STREAM_BLOCK_ROWS", raising=False)
+
+    def test_static_users_validation(self):
+        from greptimedb_tpu import cli
+
+        opts = load_options(
+            env={"GREPTIMEDB_TPU__AUTH__STATIC_USERS": "a=x,b=y"})
+        p = cli._user_provider(opts)
+        assert p is not None
+        with pytest.raises(ConfigError, match="not user=password"):
+            cli._user_provider(load_options(
+                env={"GREPTIMEDB_TPU__AUTH__STATIC_USERS": "admin"}))
+
 
 class TestExportMetrics:
     def test_self_scrape_writes_tables(self, tmp_path):
