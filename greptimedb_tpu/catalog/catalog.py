@@ -102,6 +102,7 @@ class Catalog:
         partition_rules: Optional[list] = None,
         column_order: Optional[list] = None,
         region_ids: Optional[list] = None,
+        table_id: Optional[int] = None,
     ) -> TableInfo:
         if not self.database_exists(db):
             raise CatalogError(f"database {db!r} not found")
@@ -110,7 +111,8 @@ class Catalog:
             if if_not_exists:
                 return self.table(db, name)
             raise CatalogError(f"table {db}.{name} already exists")
-        table_id = self.kv.incr("__seq/table_id", start=1023)
+        if table_id is None:
+            table_id = self.kv.incr("__seq/table_id", start=1023)
         if region_ids is None:
             # region id layout mirrors the reference: table_id << 32 | region_number
             region_ids = [(table_id << 32) | i for i in range(num_regions)]

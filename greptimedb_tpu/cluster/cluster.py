@@ -238,6 +238,40 @@ class RegionRouter:
     def alter_region_schema(self, region_id: int, schema) -> None:
         self._engine_for(region_id).alter_region_schema(region_id, schema)
 
+    def drop_region(self, region_id: int) -> None:
+        """Drop a region wherever it lives and forget its route (DDL
+        drop/rollback step, common/meta/src/ddl/drop_table.rs analog).
+
+        Route cleanup needs no live engine and must happen even when the
+        owning datanode is dead — otherwise a later failover tick would
+        resurrect the dropped table's region from the stale route."""
+        from greptimedb_tpu.storage.engine import RegionRequest, RequestType
+
+        try:
+            eng = self._engine_for(region_id)
+        except KeyError:
+            eng = None  # no route, or no live datanode: metadata-only drop
+        if eng is not None:
+            try:
+                eng.region(region_id)
+            except KeyError:
+                try:
+                    eng.open_region(region_id)
+                except Exception:  # noqa: BLE001 — never created on disk
+                    pass
+            try:
+                eng.handle_request(RegionRequest(RequestType.DROP, region_id))
+            except KeyError:
+                pass
+        table_key = str(region_id >> 32)
+        route = self.metasrv.routes.get(table_key)
+        if route is not None:
+            route.regions = [r for r in route.regions
+                             if r.region_id != region_id]
+            self.metasrv.routes.update(route)
+        with self._lock:
+            self._region_node.pop(region_id, None)
+
     def handle_request(self, req: RegionRequest) -> int:
         return self._engine_for(req.region_id).handle_request(req)
 
@@ -263,6 +297,13 @@ class Cluster:
                                                wire=wire_transport)
         self.router = RegionRouter(self.metasrv, self.datanodes)
         self.catalog = Catalog(self.kv)
+        # distributed DDL runs as journaled procedures on the metasrv's
+        # persistent procedure manager (DdlManager, ddl_manager.rs analog);
+        # QueryEngine delegates when the engine exposes one
+        from greptimedb_tpu.meta.ddl import DdlManager
+
+        self.router.ddl_manager = DdlManager(self.metasrv.procedures,
+                                             self.router, self.catalog)
         self.frontend = QueryEngine(self.catalog, self.router)
 
     def beat_all(self, now_ms: Optional[float] = None) -> None:

@@ -1,0 +1,220 @@
+"""Distributed DDL as journaled, crash-recoverable procedures.
+
+Mirrors the reference's `DdlManager` (common/meta/src/ddl_manager.rs) and
+its per-statement procedures (common/meta/src/ddl/{create_table,
+drop_table,alter_table}.rs): every DDL that touches more than one party
+(catalog KV + N datanodes + route table) runs as a persistent state
+machine via the shared procedure framework, so a coordinator crash
+mid-DDL resumes — or rolls back — instead of leaving regions without
+metadata (or metadata without regions).
+
+Phase discipline (matching the reference's ordering):
+- CREATE allocates ids, then creates regions on datanodes, and only then
+  commits the catalog name entry (the compare-and-put is the commit
+  point) — a crash before commit leaves only orphan regions, which
+  rollback or the retried procedure cleans up; readers never see a
+  half-created table.
+- DROP removes the catalog entry FIRST (new queries fail fast), then
+  drops regions and routes; every later phase is idempotent.
+- ALTER updates region schemas first, then commits catalog metadata:
+  regions accept the superset schema while the catalog still serves the
+  old one, which is read-compatible.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from greptimedb_tpu.catalog.catalog import CatalogError
+from greptimedb_tpu.datatypes.schema import Schema
+from greptimedb_tpu.procedure import Procedure, ProcedureManager, Status
+
+
+class DdlError(Exception):
+    pass
+
+
+class CreateTableProcedure(Procedure):
+    type_name = "ddl/create_table"
+
+    def __init__(self, deps, state: dict):
+        super().__init__(state)
+        self.deps = deps
+
+    def step(self, ctx) -> Status:
+        s = self.state
+        phase = s.setdefault("phase", "prepare")
+        catalog, router = self.deps.catalog, self.deps.router
+        if phase == "prepare":
+            if catalog.table_exists(s["db"], s["name"]):
+                if s.get("if_not_exists"):
+                    s["phase"] = "done_existing"
+                    return Status.finished({"existing": True})
+                raise DdlError(f"table {s['db']}.{s['name']} already exists")
+            # allocate ids once; a crash right after incr burns a table id,
+            # which is harmless (reference sequences behave the same)
+            if "table_id" not in s:
+                s["table_id"] = catalog.kv.incr("__seq/table_id", start=1023)
+                n = s.get("num_regions", 1)
+                s["region_ids"] = [(s["table_id"] << 32) | i
+                                   for i in range(n)]
+            s["phase"] = "create_regions"
+            return Status.executing()
+        if phase == "create_regions":
+            schema = Schema.from_dict(s["schema"])
+            for rid in s["region_ids"]:
+                # idempotent: an existing region is a no-op create
+                router.create_region(rid, schema)
+            s["phase"] = "commit_metadata"
+            return Status.executing()
+        if phase == "commit_metadata":
+            schema = Schema.from_dict(s["schema"])
+            try:
+                catalog.create_table(
+                    s["db"], s["name"], schema,
+                    options=s.get("options") or {},
+                    num_regions=len(s["region_ids"]),
+                    partition_rules=s.get("partition_rules"),
+                    column_order=s.get("column_order"),
+                    region_ids=list(s["region_ids"]),
+                    table_id=s["table_id"],
+                )
+            except CatalogError as e:
+                # re-run after a crash inside create_table: if the name
+                # now maps to OUR table id the commit already happened
+                tid = catalog.kv.get(f"__table_name/{s['db']}/{s['name']}")
+                if tid is None or int(tid) != s["table_id"]:
+                    raise DdlError(str(e)) from None
+            s["phase"] = "done"
+            return Status.finished({"table_id": s["table_id"],
+                                    "region_ids": s["region_ids"]})
+        return Status.finished()
+
+    def rollback(self, ctx) -> None:
+        """Undo a create that failed before the metadata commit: drop any
+        regions it managed to create (create_table.rs rollback analog)."""
+        s = self.state
+        if s.get("phase") in (None, "prepare", "done", "done_existing"):
+            return
+        for rid in s.get("region_ids", []):
+            try:
+                self.deps.router.drop_region(rid)
+            except Exception:  # noqa: BLE001 — best-effort, region may not exist
+                pass
+
+
+class DropTableProcedure(Procedure):
+    type_name = "ddl/drop_table"
+
+    def __init__(self, deps, state: dict):
+        super().__init__(state)
+        self.deps = deps
+
+    def step(self, ctx) -> Status:
+        s = self.state
+        phase = s.setdefault("phase", "deregister")
+        catalog, router = self.deps.catalog, self.deps.router
+        if phase == "deregister":
+            try:
+                info = catalog.drop_table(s["db"], s["name"],
+                                          if_exists=s.get("if_exists", False))
+            except CatalogError as e:
+                raise DdlError(str(e)) from None
+            if info is None:  # IF EXISTS on a missing table
+                s["phase"] = "done"
+                return Status.finished({"dropped": False})
+            s["region_ids"] = list(info.region_ids)
+            s["phase"] = "drop_regions"
+            return Status.executing()
+        if phase == "drop_regions":
+            for rid in s.get("region_ids", []):
+                try:
+                    router.drop_region(rid)
+                except Exception:  # noqa: BLE001 — already gone = idempotent
+                    pass
+            s["phase"] = "done"
+            return Status.finished({"dropped": True})
+        return Status.finished()
+
+
+class AlterTableProcedure(Procedure):
+    type_name = "ddl/alter_table"
+
+    def __init__(self, deps, state: dict):
+        super().__init__(state)
+        self.deps = deps
+
+    def step(self, ctx) -> Status:
+        s = self.state
+        phase = s.setdefault("phase", "alter_regions")
+        catalog, router = self.deps.catalog, self.deps.router
+        if phase == "alter_regions":
+            schema = Schema.from_dict(s["new_schema"])
+            for rid in s["region_ids"]:
+                router.alter_region_schema(rid, schema)
+            s["phase"] = "commit_metadata"
+            return Status.executing()
+        if phase == "commit_metadata":
+            info = catalog.table(s["db"], s["name"])
+            info.schema = Schema.from_dict(s["new_schema"])
+            if s.get("column_order") is not None:
+                info.column_order = s["column_order"]
+            catalog.update_table(info)
+            s["phase"] = "done"
+            return Status.finished()
+        return Status.finished()
+
+
+class DdlManager:
+    """Front door for distributed DDL (ddl_manager.rs): builds the
+    procedure, submits it to the shared (persistent) procedure manager,
+    and registers loaders so a recovering coordinator resumes in-flight
+    DDL. One instance per cluster, living next to the metasrv's
+    ProcedureManager."""
+
+    def __init__(self, procedures: ProcedureManager, router, catalog):
+        self.procedures = procedures
+        self.router = router
+        self.catalog = catalog
+        procedures.register_loader(
+            CreateTableProcedure.type_name,
+            lambda st: CreateTableProcedure(self, st))
+        procedures.register_loader(
+            DropTableProcedure.type_name,
+            lambda st: DropTableProcedure(self, st))
+        procedures.register_loader(
+            AlterTableProcedure.type_name,
+            lambda st: AlterTableProcedure(self, st))
+
+    def _run(self, proc: Procedure) -> dict:
+        rec = self.procedures.submit(proc)
+        if rec.status != "done":
+            raise DdlError(
+                f"{proc.type_name} {rec.status}: {rec.error or 'unknown'}")
+        return rec.output or {}
+
+    def create_table(
+        self, db: str, name: str, schema: Schema,
+        options: Optional[dict] = None, if_not_exists: bool = False,
+        num_regions: int = 1, partition_rules: Optional[list] = None,
+        column_order: Optional[list] = None,
+    ):
+        self._run(CreateTableProcedure(self, {
+            "db": db, "name": name, "schema": schema.to_dict(),
+            "options": options or {}, "if_not_exists": if_not_exists,
+            "num_regions": num_regions, "partition_rules": partition_rules,
+            "column_order": column_order,
+        }))
+        return self.catalog.table(db, name)
+
+    def drop_table(self, db: str, name: str, if_exists: bool = False) -> bool:
+        out = self._run(DropTableProcedure(
+            self, {"db": db, "name": name, "if_exists": if_exists}))
+        return bool(out.get("dropped"))
+
+    def alter_table(self, db: str, name: str, new_schema: Schema,
+                    region_ids: list, column_order: Optional[list] = None):
+        self._run(AlterTableProcedure(self, {
+            "db": db, "name": name, "new_schema": new_schema.to_dict(),
+            "region_ids": list(region_ids), "column_order": column_order,
+        }))

@@ -63,7 +63,11 @@ class QueryEngine:
     # ---- entry points ------------------------------------------------------
 
     def execute_sql(self, sql: str, ctx: Optional[QueryContext] = None) -> list[QueryResult]:
-        ctx = ctx or QueryContext(timezone=self.default_timezone)
+        ctx = ctx or QueryContext()
+        if ctx.timezone is None:
+            # every protocol builds its own ctx; the engine-level default
+            # (default_timezone option) applies unless the client set one
+            ctx.timezone = self.default_timezone
         # plugin interceptors may rewrite or veto the statement before
         # parsing (reference SqlQueryInterceptor, frontend/src/instance.rs)
         sql = self.plugins.intercept_sql(sql, ctx)
@@ -209,14 +213,11 @@ class QueryEngine:
                 infoschema.is_information_schema_query(sel.table, ctx.db):
             return infoschema.execute_virtual_select(self, sel, ctx)
         if sel.table is None:
-            # SELECT <literals>
+            # SELECT <literals> — session funcs substitute here too
+            sel = _subst_session_funcs(sel, ctx)
             names, cols, dtypes = [], [], []
             for i, it in enumerate(sel.items):
-                if isinstance(it.expr, ast.FuncCall) and it.expr.name in (
-                        "database", "current_schema", "schema"):
-                    v = ctx.db
-                else:
-                    v = eval_host(it.expr, {}, None, None)
+                v = eval_host(it.expr, {}, None, None)
                 arr = np.asarray([v]) if np.ndim(v) == 0 else np.asarray(v)
                 names.append(it.alias or f"column{i}")
                 dtypes.append(None)
@@ -281,6 +282,25 @@ class QueryEngine:
             raise PlanError("CREATE TABLE requires a column list")
         if stmt.engine == "metric":
             return self._create_metric_table(db, name, schema, stmt, ctx)
+        ddl = getattr(self.region_engine, "ddl_manager", None)
+        if ddl is not None:
+            # cluster mode: DDL is a journaled procedure across datanodes
+            # (DdlManager, common/meta/src/ddl_manager.rs)
+            from greptimedb_tpu.meta.ddl import DdlError
+
+            try:
+                info = ddl.create_table(
+                    db, name, schema, options=dict(stmt.options),
+                    if_not_exists=stmt.if_not_exists,
+                    num_regions=rule.num_regions() if rule is not None else 1,
+                    partition_rules=(json.loads(rule.to_json())
+                                     if rule is not None else None),
+                    column_order=[c.name for c in stmt.columns],
+                )
+            except DdlError as e:
+                raise PlanError(str(e)) from None
+            self._open_regions.update(info.region_ids)
+            return QueryResult.of_affected(0)
         info = self.catalog.create_table(
             db, name, schema, options=dict(stmt.options),
             if_not_exists=stmt.if_not_exists,
@@ -414,6 +434,21 @@ class QueryEngine:
         name = stmt.name
         if "." in name:
             db, name = name.rsplit(".", 1)
+        ddl = getattr(self.region_engine, "ddl_manager", None)
+        if ddl is not None:
+            try:
+                info = self.catalog.table(db, name)
+                engine_kind = info.options.get("engine")
+            except CatalogError:
+                engine_kind = None
+            if engine_kind not in ("metric", "file"):
+                from greptimedb_tpu.meta.ddl import DdlError
+
+                try:
+                    ddl.drop_table(db, name, if_exists=stmt.if_exists)
+                except DdlError as e:
+                    raise PlanError(str(e)) from None
+                return QueryResult.of_affected(0)
         info = self.catalog.drop_table(db, name, stmt.if_exists)
         if info is None:
             return QueryResult.of_affected(0)
@@ -464,25 +499,37 @@ class QueryEngine:
                 + [ColumnSchema(col.name, dtype, SemanticType.FIELD, True,
                                 col.default.value if isinstance(col.default, ast.Literal) else None)]
             )
-            for rid in info.region_ids:
-                self.region_engine.alter_region_schema(rid, new_schema)
-            info.schema = new_schema
             self._refresh_column_order(info, added=col.name)
-            self.catalog.update_table(info)
-            return QueryResult.of_affected(0)
+            return self._apply_alter(info, new_schema)
         if stmt.action == "drop_column":
             cols = [c for c in info.schema.columns if c.name != stmt.column_name]
             dropped = info.schema.column(stmt.column_name)
             if dropped.semantic is not SemanticType.FIELD:
                 raise PlanError("can only DROP field columns")
             new_schema = Schema(cols)
-            for rid in info.region_ids:
-                self.region_engine.alter_region_schema(rid, new_schema)
-            info.schema = new_schema
             self._refresh_column_order(info, dropped=stmt.column_name)
-            self.catalog.update_table(info)
-            return QueryResult.of_affected(0)
+            return self._apply_alter(info, new_schema)
         raise PlanError(f"unsupported ALTER action {stmt.action}")
+
+    def _apply_alter(self, info: TableInfo, new_schema: Schema) -> QueryResult:
+        """Propagate an ALTER: journaled procedure in cluster mode
+        (AlterTableProcedure), direct region+catalog update standalone."""
+        ddl = getattr(self.region_engine, "ddl_manager", None)
+        if ddl is not None:
+            from greptimedb_tpu.meta.ddl import DdlError
+
+            try:
+                ddl.alter_table(info.db, info.name, new_schema,
+                                info.region_ids,
+                                column_order=info.column_order)
+            except DdlError as e:
+                raise PlanError(str(e)) from None
+            return QueryResult.of_affected(0)
+        for rid in info.region_ids:
+            self.region_engine.alter_region_schema(rid, new_schema)
+        info.schema = new_schema
+        self.catalog.update_table(info)
+        return QueryResult.of_affected(0)
 
     # ---- DML ---------------------------------------------------------------
 

@@ -41,7 +41,9 @@ class QueryContext:
     plus the authenticated user)."""
 
     db: str = DEFAULT_DB
-    timezone: str = "UTC"
+    # None = "not set by the client" — QueryEngine.execute_sql resolves it
+    # to the engine's default_timezone option; a client-set value wins
+    timezone: Optional[str] = None
     channel: Channel = Channel.UNKNOWN
     user: Optional[object] = None  # auth.UserInfo when authenticated
     # W3C trace context for cross-process propagation (SURVEY §5)

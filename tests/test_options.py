@@ -254,6 +254,32 @@ class TestPprofAuth:
             engine.close()
 
 
+class TestDefaultTimezone:
+    def test_engine_default_applies_to_protocol_contexts(self, tmp_path):
+        """Server protocols build their own QueryContext; the engine-level
+        default must still reach them (code-review regression)."""
+        from greptimedb_tpu.catalog import Catalog, MemoryKv
+        from greptimedb_tpu.query import QueryEngine
+        from greptimedb_tpu.session import Channel, QueryContext
+        from greptimedb_tpu.storage import RegionEngine
+        from greptimedb_tpu.storage.engine import EngineConfig
+
+        engine = RegionEngine(EngineConfig(data_dir=str(tmp_path)))
+        qe = QueryEngine(Catalog(MemoryKv()), engine,
+                         default_timezone="+08:00")
+        try:
+            # protocol-style ctx (no explicit timezone) -> engine default
+            ctx = QueryContext(db="public", channel=Channel.HTTP)
+            assert qe.execute_one("SELECT timezone()", ctx).rows() == \
+                [["+08:00"]]
+            # client-set timezone wins
+            ctx = QueryContext(db="public", timezone="UTC")
+            assert qe.execute_one("SELECT timezone()", ctx).rows() == \
+                [["UTC"]]
+        finally:
+            engine.close()
+
+
 class TestTlsValidation:
     def test_tls_require_without_cert_aborts(self):
         from greptimedb_tpu import cli
