@@ -167,6 +167,75 @@ def cmd_dump_config(args):
     sys.stdout.write(example_toml())
 
 
+def _qi(name: str) -> str:
+    """Quote an identifier for SQL (reserved words, dashes, ...)."""
+    return '"' + name.replace('"', '""') + '"'
+
+
+def _qs(text: str) -> str:
+    """Quote a string/path literal."""
+    return "'" + text.replace("'", "''") + "'"
+
+
+def cmd_export(args):
+    """Backup: schemas (SHOW CREATE TABLE) + data (COPY DATABASE TO
+    parquet), one subdirectory per database (reference cli export,
+    cmd/src/cli/export.rs:44-119)."""
+    from greptimedb_tpu.query.engine import QueryContext
+
+    engine, qe = build_standalone(args.data_home)
+    try:
+        os.makedirs(args.output_dir, exist_ok=True)
+        dbs = [args.db] if args.db else [
+            r[0] for r in qe.execute_one("SHOW DATABASES").rows()
+            if r[0] != "information_schema"
+        ]
+        for db in dbs:
+            ctx = QueryContext(db=db)
+            out = os.path.join(args.output_dir, db)
+            os.makedirs(out, exist_ok=True)
+            tables = qe.catalog.list_tables(db)
+            ddl = []
+            for t in sorted(tables):
+                r = qe.execute_one(f"SHOW CREATE TABLE {_qi(t)}", ctx)
+                ddl.append(r.rows()[0][1] + ";\n")
+            with open(os.path.join(out, "create_tables.sql"), "w") as f:
+                f.write("\n".join(ddl))
+            n = qe.execute_one(
+                f"COPY DATABASE {_qi(db)} TO {_qs(out)} WITH (format = 'parquet')",
+                ctx).affected_rows
+            print(f"exported {db}: {len(tables)} tables, {n} rows -> {out}")
+    finally:
+        engine.close()
+
+
+def cmd_import(args):
+    """Restore a cli-export dump: run the DDL file, then COPY DATABASE
+    FROM the parquet directory."""
+    from greptimedb_tpu.query.engine import QueryContext
+
+    engine, qe = build_standalone(args.data_home)
+    try:
+        for db in sorted(os.listdir(args.input_dir)):
+            src = os.path.join(args.input_dir, db)
+            if not os.path.isdir(src):
+                continue
+            qe.execute_one(f"CREATE DATABASE IF NOT EXISTS {_qi(db)}")
+            ctx = QueryContext(db=db)
+            ddl_path = os.path.join(src, "create_tables.sql")
+            if os.path.exists(ddl_path):
+                with open(ddl_path) as f:
+                    sql = f.read()
+                if sql.strip():
+                    qe.execute_sql(sql, ctx)
+            n = qe.execute_one(
+                f"COPY DATABASE {_qi(db)} FROM {_qs(src)} WITH (format = 'parquet')",
+                ctx).affected_rows
+            print(f"imported {db}: {n} rows")
+    finally:
+        engine.close()
+
+
 def cmd_repl(args):
     engine, qe = build_standalone(args.data_home)
     print("greptimedb_tpu REPL — SQL or TQL, \\q to quit")
@@ -217,6 +286,18 @@ def main(argv=None):
     p_dump = sub.add_parser("dump-config",
                             help="print the documented example TOML config")
     p_dump.set_defaults(fn=cmd_dump_config)
+
+    p_exp = sub.add_parser("export", help="dump schemas + parquet data")
+    p_exp.add_argument("--data-home", default="./greptimedb_tpu_data")
+    p_exp.add_argument("--output-dir", required=True)
+    p_exp.add_argument("--db", default=None,
+                       help="one database (default: all)")
+    p_exp.set_defaults(fn=cmd_export)
+
+    p_imp = sub.add_parser("import", help="restore a cli-export dump")
+    p_imp.add_argument("--data-home", default="./greptimedb_tpu_data")
+    p_imp.add_argument("--input-dir", required=True)
+    p_imp.set_defaults(fn=cmd_import)
 
     args = parser.parse_args(argv)
     args.fn(args)

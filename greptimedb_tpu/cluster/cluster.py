@@ -169,16 +169,27 @@ class RegionRouter:
     def open_region(self, region_id: int) -> None:
         self._engine_for(region_id).open_region(region_id)
 
-    def create_region(self, region_id: int, schema: Schema) -> None:
-        """Placement: pick a datanode via the metasrv selector, create the
-        region there, and record the route (the CreateTable DDL procedure's
-        region-allocation step, common/meta/src/ddl/create_table.rs analog)."""
+    def select_node(self) -> str:
+        """Datanode placement via the metasrv selector (selector/ role)."""
         node = self.metasrv.selector.select(
             self.metasrv.alive_nodes() or sorted(self.datanodes),
             self.metasrv.node_stats(),
         )
-        if node is None:
-            node = sorted(self.datanodes)[0]
+        return node if node is not None else sorted(self.datanodes)[0]
+
+    def create_region(self, region_id: int, schema: Schema) -> None:
+        """Placement: pick a datanode via the metasrv selector, create the
+        region there, and record the route (the CreateTable DDL procedure's
+        region-allocation step, common/meta/src/ddl/create_table.rs analog).
+
+        NOT idempotent across calls: the stateful selector may pick a
+        different node each time. Journaled DDL must pin the node first
+        (select_node) and call create_region_on — re-running THAT is a
+        datanode-level no-op."""
+        self.create_region_on(self.select_node(), region_id, schema)
+
+    def create_region_on(self, node: str, region_id: int,
+                         schema: Schema) -> None:
         self.datanodes[node].data_engine().create_region(region_id, schema)
         table_key = str(region_id >> 32)
         route = self.metasrv.routes.get(table_key)

@@ -61,10 +61,29 @@ class CreateTableProcedure(Procedure):
             s["phase"] = "create_regions"
             return Status.executing()
         if phase == "create_regions":
+            # two sub-steps so placement survives a crash: (1) pin every
+            # region's datanode and persist, (2) create on the PINNED
+            # nodes — datanode-level create is a no-op when the region
+            # exists, so retries/resumes never double-place (the selector
+            # is stateful; re-selecting on retry would orphan regions)
+            placements = s.setdefault("placements", {})
+            missing = [rid for rid in s["region_ids"]
+                       if str(rid) not in placements]
+            if missing:
+                if hasattr(router, "select_node"):
+                    for rid in missing:
+                        placements[str(rid)] = router.select_node()
+                else:  # single-engine standalone: no placement concept
+                    for rid in missing:
+                        placements[str(rid)] = None
+                return Status.executing()  # persist pins before acting
             schema = Schema.from_dict(s["schema"])
             for rid in s["region_ids"]:
-                # idempotent: an existing region is a no-op create
-                router.create_region(rid, schema)
+                node = placements[str(rid)]
+                if node is not None and hasattr(router, "create_region_on"):
+                    router.create_region_on(node, rid, schema)
+                else:
+                    router.create_region(rid, schema)
             s["phase"] = "commit_metadata"
             return Status.executing()
         if phase == "commit_metadata":
@@ -112,18 +131,34 @@ class DropTableProcedure(Procedure):
 
     def step(self, ctx) -> Status:
         s = self.state
-        phase = s.setdefault("phase", "deregister")
+        phase = s.setdefault("phase", "prepare")
         catalog, router = self.deps.catalog, self.deps.router
+        if phase == "prepare":
+            # capture region ids BEFORE touching the catalog, so a crash
+            # anywhere later still knows what to clean up
+            try:
+                info = catalog.table(s["db"], s["name"])
+            except CatalogError as e:
+                if s.get("if_exists"):
+                    s["phase"] = "done"
+                    return Status.finished({"dropped": False})
+                raise DdlError(str(e)) from None
+            s["table_id"] = info.table_id
+            s["region_ids"] = list(info.region_ids)
+            s["phase"] = "deregister"
+            return Status.executing()
         if phase == "deregister":
             try:
-                info = catalog.drop_table(s["db"], s["name"],
-                                          if_exists=s.get("if_exists", False))
-            except CatalogError as e:
-                raise DdlError(str(e)) from None
-            if info is None:  # IF EXISTS on a missing table
-                s["phase"] = "done"
-                return Status.finished({"dropped": False})
-            s["region_ids"] = list(info.region_ids)
+                catalog.drop_table(s["db"], s["name"], if_exists=False)
+            except CatalogError:
+                # idempotent resume: fine iff OUR table is the one gone —
+                # a different table id under the same name must not be
+                # dropped
+                tid = catalog.kv.get(f"__table_name/{s['db']}/{s['name']}")
+                if tid is not None and int(tid) != s["table_id"]:
+                    raise DdlError(
+                        f"{s['db']}.{s['name']} was concurrently recreated"
+                    ) from None
             s["phase"] = "drop_regions"
             return Status.executing()
         if phase == "drop_regions":
@@ -150,8 +185,12 @@ class AlterTableProcedure(Procedure):
         catalog, router = self.deps.catalog, self.deps.router
         if phase == "alter_regions":
             schema = Schema.from_dict(s["new_schema"])
+            altered = s.setdefault("altered", [])
             for rid in s["region_ids"]:
+                if rid in altered:
+                    continue
                 router.alter_region_schema(rid, schema)
+                altered.append(rid)
             s["phase"] = "commit_metadata"
             return Status.executing()
         if phase == "commit_metadata":
@@ -163,6 +202,23 @@ class AlterTableProcedure(Procedure):
             s["phase"] = "done"
             return Status.finished()
         return Status.finished()
+
+    def rollback(self, ctx) -> None:
+        """Re-apply the pre-alter schema to regions already altered, so a
+        half-failed ALTER (e.g. DROP COLUMN with one datanode down) never
+        leaves region schemas diverging from the catalog's."""
+        s = self.state
+        if s.get("phase") != "commit_metadata" and not s.get("altered"):
+            return
+        old = s.get("old_schema")
+        if old is None:
+            return
+        schema = Schema.from_dict(old)
+        for rid in s.get("altered", []):
+            try:
+                self.deps.router.alter_region_schema(rid, schema)
+            except Exception:  # noqa: BLE001 — best effort per region
+                pass
 
 
 class DdlManager:
@@ -213,8 +269,10 @@ class DdlManager:
         return bool(out.get("dropped"))
 
     def alter_table(self, db: str, name: str, new_schema: Schema,
-                    region_ids: list, column_order: Optional[list] = None):
+                    region_ids: list, column_order: Optional[list] = None,
+                    old_schema: Optional[Schema] = None):
         self._run(AlterTableProcedure(self, {
             "db": db, "name": name, "new_schema": new_schema.to_dict(),
+            "old_schema": old_schema.to_dict() if old_schema else None,
             "region_ids": list(region_ids), "column_order": column_order,
         }))

@@ -266,9 +266,20 @@ def build_store(kind: str = "fs", cache_bytes: int = 0, **kwargs) -> ObjectStore
             store = S3Store(**kwargs)
         except TypeError as e:
             raise ObjectStoreError(f"s3 store misconfigured: {e}") from None
+    elif kind in ("gcs", "azblob"):
+        if kind == "gcs":
+            from greptimedb_tpu.objectstore.gcs import GcsStore as cls
+        else:
+            from greptimedb_tpu.objectstore.azblob import AzblobStore as cls
+        try:
+            store = cls(**kwargs)
+        except TypeError as e:
+            raise ObjectStoreError(
+                f"{kind} store misconfigured: {e}") from None
     else:
         raise ObjectStoreError(
-            f"unsupported object store {kind!r} (supported: fs, memory, s3)")
+            f"unsupported object store {kind!r} "
+            "(supported: fs, memory, s3, gcs, azblob)")
     if cache_bytes > 0:
         store = LruCacheLayer(store, cache_bytes)
     return store

@@ -436,9 +436,11 @@ class QueryEngine:
             db, name = name.rsplit(".", 1)
         ddl = getattr(self.region_engine, "ddl_manager", None)
         if ddl is not None:
+            dropped_rids: list = []
             try:
                 info = self.catalog.table(db, name)
                 engine_kind = info.options.get("engine")
+                dropped_rids = list(info.region_ids)
             except CatalogError:
                 engine_kind = None
             if engine_kind not in ("metric", "file"):
@@ -448,6 +450,8 @@ class QueryEngine:
                     ddl.drop_table(db, name, if_exists=stmt.if_exists)
                 except DdlError as e:
                     raise PlanError(str(e)) from None
+                for rid in dropped_rids:
+                    self._open_regions.discard(rid)
                 return QueryResult.of_affected(0)
         info = self.catalog.drop_table(db, name, stmt.if_exists)
         if info is None:
@@ -521,7 +525,8 @@ class QueryEngine:
             try:
                 ddl.alter_table(info.db, info.name, new_schema,
                                 info.region_ids,
-                                column_order=info.column_order)
+                                column_order=info.column_order,
+                                old_schema=info.schema)
             except DdlError as e:
                 raise PlanError(str(e)) from None
             return QueryResult.of_affected(0)

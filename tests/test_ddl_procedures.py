@@ -183,16 +183,16 @@ class TestRollback:
         pm._max_retries = 1
         pm._retry_delay_s = 0
 
-        orig = cluster.router.create_region
+        orig = cluster.router.create_region_on
         calls = []
 
-        def failing(rid, schema):
+        def failing(node, rid, schema):
             calls.append(rid)
             if len(calls) >= 2:
                 raise RuntimeError("datanode unreachable")
-            return orig(rid, schema)
+            return orig(node, rid, schema)
 
-        cluster.router.create_region = failing
+        cluster.router.create_region_on = failing
         from greptimedb_tpu.datatypes import (
             ColumnSchema,
             DataType,
@@ -207,7 +207,7 @@ class TestRollback:
         ])
         with pytest.raises(DdlError):
             ddl.create_table("public", "rb_t", schema, num_regions=3)
-        cluster.router.create_region = orig
+        cluster.router.create_region_on = orig
         assert not cluster.catalog.table_exists("public", "rb_t")
         # first region (created before the failure) was rolled back
         recs = [r for r in pm.store.list()
