@@ -135,6 +135,13 @@ class Catalog:
     def table_exists(self, db: str, name: str) -> bool:
         return self.kv.get(f"__table_name/{db}/{name}") is not None
 
+    def table_id(self, db: str, name: str) -> Optional[int]:
+        """The id the name currently maps to, or None — lets callers
+        (journaled DDL) distinguish 'our table is gone' from 'a different
+        table took the name' without knowing the key schema."""
+        tid = self.kv.get(f"__table_name/{db}/{name}")
+        return int(tid) if tid is not None else None
+
     def list_tables(self, db: str) -> list[str]:
         return [k.rsplit("/", 1)[1] for k, _ in self.kv.range(f"__table_name/{db}/")]
 

@@ -101,8 +101,7 @@ class CreateTableProcedure(Procedure):
             except CatalogError as e:
                 # re-run after a crash inside create_table: if the name
                 # now maps to OUR table id the commit already happened
-                tid = catalog.kv.get(f"__table_name/{s['db']}/{s['name']}")
-                if tid is None or int(tid) != s["table_id"]:
+                if catalog.table_id(s["db"], s["name"]) != s["table_id"]:
                     raise DdlError(str(e)) from None
             s["phase"] = "done"
             return Status.finished({"table_id": s["table_id"],
@@ -154,8 +153,8 @@ class DropTableProcedure(Procedure):
                 # idempotent resume: fine iff OUR table is the one gone —
                 # a different table id under the same name must not be
                 # dropped
-                tid = catalog.kv.get(f"__table_name/{s['db']}/{s['name']}")
-                if tid is not None and int(tid) != s["table_id"]:
+                tid = catalog.table_id(s["db"], s["name"])
+                if tid is not None and tid != s["table_id"]:
                     raise DdlError(
                         f"{s['db']}.{s['name']} was concurrently recreated"
                     ) from None

@@ -184,7 +184,4 @@ class AzblobStore(ObjectStore):
             if not marker:
                 return out
 
-    def open_input(self, key: str):
-        import io
-
-        return io.BytesIO(self.read(key))
+    # open_input: inherited (pa.BufferReader over read())

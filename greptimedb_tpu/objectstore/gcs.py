@@ -23,8 +23,7 @@ class GcsStore(ObjectStore):
 
     def __init__(self, bucket: str, prefix: str = "", *,
                  endpoint: Optional[str] = None,
-                 token: Optional[str] = None,
-                 scope: str = "https://www.googleapis.com/auth/devstorage.read_write"):
+                 token: Optional[str] = None):
         if not bucket:
             raise ObjectStoreError("gcs store requires a bucket")
         self.bucket = bucket
@@ -32,7 +31,6 @@ class GcsStore(ObjectStore):
         self.endpoint = (endpoint or os.environ.get("GCS_ENDPOINT")
                          or "https://storage.googleapis.com").rstrip("/")
         self.token = token or os.environ.get("GCS_TOKEN", "")
-        self.scope = scope
 
     # ---- helpers -----------------------------------------------------------
 
@@ -125,7 +123,4 @@ class GcsStore(ObjectStore):
             if not page_token:
                 return out
 
-    def open_input(self, key: str):
-        import io
-
-        return io.BytesIO(self.read(key))
+    # open_input: inherited (pa.BufferReader over read())

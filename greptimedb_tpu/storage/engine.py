@@ -64,6 +64,11 @@ class EngineConfig:
     # auto-flush when a memtable exceeds this many bytes (reference
     # WriteBufferManager global budget, flush.rs:83-135)
     flush_threshold_bytes: int = 256 << 20
+    # write worker group size (reference WorkerGroup, worker.rs:110):
+    # 0 = synchronous in-caller writes; -1 = auto (cpu/2); N = N workers.
+    # Workers batch concurrent writes per region into one WAL group
+    # commit and bound in-flight requests (backpressure)
+    write_workers: int = 0
     # object store backend for SSTs/manifest/index (reference
     # object-store crate; fs|memory|s3, optional LRU read cache)
     object_store: str = "fs"
@@ -96,6 +101,12 @@ class RegionEngine:
         # RegionServer multi-engine registration analog (datanode.rs:328)
         self.openers: list = []
         self._lock = threading.RLock()
+        self.workers = None
+        if config.write_workers:
+            from greptimedb_tpu.storage.worker import WorkerGroup
+
+            n = None if config.write_workers < 0 else config.write_workers
+            self.workers = WorkerGroup(self, num_workers=n)
 
     def register_opener(self, fn) -> None:
         self.openers.append(fn)
@@ -112,6 +123,14 @@ class RegionEngine:
     # ---- handle_request (reference region_server.rs:120) -------------------
 
     def handle_request(self, req: RegionRequest) -> int:
+        # the data path skips the engine-wide lock: region-level locking
+        # suffices, and serializing writers here would defeat the worker
+        # group's fsync amortization (reference: writes flow through the
+        # worker mpsc, never the engine mutex)
+        if req.kind is RequestType.PUT:
+            return self._write(req.region_id, req.batch, OP_PUT)
+        if req.kind is RequestType.DELETE:
+            return self._write(req.region_id, req.batch, OP_DELETE)
         with self._lock:
             if req.kind is RequestType.CREATE:
                 assert req.schema is not None
@@ -154,18 +173,24 @@ class RegionEngine:
                 self.region(req.region_id).compact(strategy="full")
                 return 0
 
-            region = self.region(req.region_id)
-            if req.kind is RequestType.PUT:
-                n = region.write(req.batch, OP_PUT)
-            elif req.kind is RequestType.DELETE:
-                n = region.write(req.batch, OP_DELETE)
-            else:
-                raise ValueError(f"unhandled request {req.kind}")
-            if region.memtable_bytes >= self.config.flush_threshold_bytes:
-                region.flush()
-                # TWCS picker no-ops unless window thresholds are exceeded
-                region.compact()
+            raise ValueError(f"unhandled request {req.kind}")
+
+    def _write(self, region_id: int, batch: RecordBatch, op: int) -> int:
+        if self.workers is not None:
+            n = self.workers.write(region_id, batch, op)
+        else:
+            n = self.region(region_id).write(batch, op)
+        try:
+            region = self.region(region_id)
+        except KeyError:
+            # region closed/dropped right after the write committed — the
+            # write itself succeeded; only the flush check is moot
             return n
+        if region.memtable_bytes >= self.config.flush_threshold_bytes:
+            region.flush()
+            # TWCS picker no-ops unless window thresholds are exceeded
+            region.compact()
+        return n
 
     # ---- convenience wrappers ----------------------------------------------
 
@@ -220,6 +245,8 @@ class RegionEngine:
                                                   tag_predicates)
 
     def close(self) -> None:
+        if self.workers is not None:
+            self.workers.stop()  # drain in-flight writes first
         with self._lock:
             for r in self.regions.values():
                 if hasattr(r, "close"):

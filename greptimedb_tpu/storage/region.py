@@ -35,6 +35,10 @@ OP_PUT = 0
 OP_DELETE = 1
 
 
+class RegionDroppedError(RuntimeError):
+    """Write raced a DROP: the region is gone; the write did not happen."""
+
+
 @dataclass
 class ScanData:
     """Host-side scan output: concatenated columns ready for device blocks.
@@ -115,6 +119,12 @@ class Region:
         # worker.rs:110-650): one lock serializes this region's mutations;
         # scans take a consistent snapshot under it and decode outside
         self._lock = threading.RLock()
+        # one compaction at a time per region (reference FlushScheduler /
+        # CompactionScheduler serialize per region); the slow merge runs
+        # outside the main lock so writes keep flowing
+        self._compact_lock = threading.Lock()
+        # set by drop(): late writers must fail, not resurrect WAL/SSTs
+        self.dropped = False
         # compacted-away SSTs are purged only once no reader holds them —
         # scans pin their snapshot's files (the reference's FilePurger
         # refcount, mito2/src/sst/file_purger.rs)
@@ -162,6 +172,7 @@ class Region:
 
     def drop(self) -> None:
         with self._lock:
+            self.dropped = True
             self._drain_purge(force=True)
             self.wal.delete_region(self.region_id)
             for fid in list(self.files):
@@ -202,16 +213,34 @@ class Region:
     def write(self, batch: RecordBatch, op_type: int = OP_PUT) -> int:
         """Durable write: WAL first, then memtable (reference
         region_write_ctx.rs:92-144 + wal.rs:133). Returns affected rows."""
-        n = batch.num_rows
-        if n == 0:
-            return 0
+        return self.write_many([(batch, op_type)])[0]
+
+    def write_many(self, items: list[tuple[RecordBatch, int]]) -> list[int]:
+        """Apply several mutations with ONE WAL group commit (reference
+        RegionWriteCtx batches all of a worker cycle's mutations into one
+        WalWriter write, region_write_ctx.rs:92-144). Returns per-item
+        affected rows."""
+        counts = [b.num_rows for b, _ in items]
+        live = [(b, op) for b, op in items if b.num_rows]
+        if not live:
+            return counts
         with self._lock:
+            if self.dropped:
+                # a write racing DROP must error, not silently append to
+                # (and resurrect) the deleted region's WAL
+                raise RegionDroppedError(
+                    f"region {self.region_id} is dropped")
             seq = self.next_seq
-            self.wal.append(self.region_id, seq, op_type, batch)
-            self.memtable.write(batch, seq, op_type)
-            self.next_seq = seq + n
+            entries = []
+            for batch, op_type in live:
+                entries.append((seq, op_type, batch))
+                seq += batch.num_rows
+            self.wal.append_many(self.region_id, entries)
+            for s, op_type, batch in entries:
+                self.memtable.write(batch, s, op_type)
+            self.next_seq = seq
             self.data_version += 1
-        return n
+        return counts
 
     # ---- flush -------------------------------------------------------------
 
@@ -256,16 +285,19 @@ class Region:
         as query-time dedup, persisted (SURVEY.md §7)."""
         from greptimedb_tpu.storage.compaction import TwcsPicker
 
-        if strategy == "full":
-            groups = [list(self.files.values())] if len(self.files) > 1 else []
-        else:
-            groups = TwcsPicker().pick(list(self.files.values()))
-        out: list[FileMeta] = []
-        for group in groups:
-            meta = self._merge_files(group)
-            if meta is not None:
-                out.append(meta)
-        return out
+        with self._compact_lock:
+            with self._lock:
+                files = list(self.files.values())
+            if strategy == "full":
+                groups = [files] if len(files) > 1 else []
+            else:
+                groups = TwcsPicker().pick(files)
+            out: list[FileMeta] = []
+            for group in groups:
+                meta = self._merge_files(group)
+                if meta is not None:
+                    out.append(meta)
+            return out
 
     def _merge_files(self, group: list[FileMeta]) -> Optional[FileMeta]:
         """Read `group`'s SSTs, sort-dedup on device, rewrite as one L1

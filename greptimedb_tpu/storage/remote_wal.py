@@ -52,6 +52,13 @@ class RemoteWal:
                              seq, op_type)
         self.store.write(self._key(region_id, seq), frame + payload)
 
+    def append_many(self, region_id: int, entries) -> None:
+        """Group-commit analog: one object per entry (object puts are
+        atomic; there is no fsync to amortize), same call shape as the
+        local WAL so the write workers treat both backends alike."""
+        for seq, op_type, batch in entries:
+            self.append(region_id, seq, op_type, batch)
+
     # ---- replay ------------------------------------------------------------
 
     def replay(self, region_id: int, from_seq: int = 0) -> Iterator[WalEntry]:

@@ -70,6 +70,7 @@ class Wal:
         self.wal_dir = wal_dir
         self.sync = sync
         self.segment_bytes = segment_bytes
+        self.sync_count = 0  # fsyncs issued (observability + group-commit tests)
         os.makedirs(wal_dir, exist_ok=True)
         # region -> (segno, open append handle)
         self._files: dict[int, tuple[int, io.BufferedWriter]] = {}
@@ -116,14 +117,27 @@ class Wal:
     # ---- write -------------------------------------------------------------
 
     def append(self, region_id: int, seq: int, op_type: int, batch: RecordBatch) -> None:
-        payload = _encode_batch(batch)
-        frame = _HEADER.pack(len(payload), zlib.crc32(payload), region_id, seq, op_type)
+        self.append_many(region_id, [(seq, op_type, batch)])
+
+    def append_many(self, region_id: int,
+                    entries: list[tuple[int, int, "RecordBatch"]]) -> None:
+        """Append several (seq, op_type, batch) entries with ONE fsync —
+        the group-commit boundary the write workers amortize over
+        (reference WalWriter::write_to_wal batches per flush,
+        mito2/src/wal.rs:133-150)."""
+        if not entries:
+            return
         segno, f = self._writer(region_id)
-        f.write(frame)
-        f.write(payload)
+        for seq, op_type, batch in entries:
+            payload = _encode_batch(batch)
+            frame = _HEADER.pack(len(payload), zlib.crc32(payload),
+                                 region_id, seq, op_type)
+            f.write(frame)
+            f.write(payload)
         f.flush()
         if self.sync:
             os.fsync(f.fileno())  # ← the durability boundary
+            self.sync_count += 1
         if f.tell() >= self.segment_bytes:
             self._roll(region_id)
 

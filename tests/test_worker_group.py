@@ -1,0 +1,138 @@
+"""Write worker group (reference mito2/src/worker.rs actor model:
+sharded bounded queues, ≤64-request cycles, one WAL commit per cycle)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from greptimedb_tpu.datatypes import (
+    ColumnSchema,
+    DataType,
+    DictVector,
+    RecordBatch,
+    Schema,
+    SemanticType,
+)
+from greptimedb_tpu.storage import RegionEngine
+from greptimedb_tpu.storage.engine import EngineConfig
+from greptimedb_tpu.storage.worker import WorkerGroup
+
+
+def schema():
+    return Schema([
+        ColumnSchema("host", DataType.STRING, SemanticType.TAG),
+        ColumnSchema("ts", DataType.TIMESTAMP_MILLISECOND,
+                     SemanticType.TIMESTAMP),
+        ColumnSchema("v", DataType.FLOAT64),
+    ])
+
+
+def batch(s, ts0, n, host="h"):
+    return RecordBatch(s, {
+        "host": DictVector.encode([host] * n),
+        "ts": np.arange(ts0, ts0 + n, dtype=np.int64),
+        "v": np.full(n, float(ts0)),
+    })
+
+
+def test_concurrent_writes_group_commit(tmp_path):
+    """16 threads x 8 writes each through the worker group: every row
+    lands exactly once, and the WAL fsync count is well below the write
+    count (group commit actually grouped)."""
+    engine = RegionEngine(EngineConfig(data_dir=str(tmp_path),
+                                       write_workers=2))
+    s = schema()
+    engine.create_region(1, s)
+    n_threads, per_thread, rows_each = 16, 8, 10
+    start = threading.Barrier(n_threads)
+    errs = []
+
+    def writer(t):
+        try:
+            start.wait()
+            for i in range(per_thread):
+                ts0 = (t * per_thread + i) * rows_each
+                n = engine.put(1, batch(s, ts0, rows_each, host=f"h{t}"))
+                assert n == rows_each
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=writer, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    total = n_threads * per_thread * rows_each
+    scan = engine.scan(1)
+    assert scan.num_rows == total
+    # (host, ts) keys are all distinct -> no dedup losses
+    writes = n_threads * per_thread
+    assert engine.wal.sync_count < writes, (
+        f"{engine.wal.sync_count} fsyncs for {writes} writes — "
+        "no group commit happened")
+    engine.close()
+
+
+def test_worker_path_preserves_lww_order(tmp_path):
+    """Same-key writes submitted in order from one caller keep
+    last-write-wins semantics through the worker queue."""
+    from greptimedb_tpu.catalog import Catalog, MemoryKv
+    from greptimedb_tpu.query import QueryEngine
+
+    engine = RegionEngine(EngineConfig(data_dir=str(tmp_path),
+                                       write_workers=1))
+    qe = QueryEngine(Catalog(MemoryKv()), engine)
+    qe.execute_one(
+        "CREATE TABLE t (host STRING, ts TIMESTAMP(3) NOT NULL, v DOUBLE,"
+        " TIME INDEX (ts), PRIMARY KEY (host))")
+    for v in (1.0, 2.0, 3.0):
+        qe.execute_one(f"INSERT INTO t VALUES ('h', 100, {v})")
+    assert qe.execute_one("SELECT v FROM t").rows() == [[3.0]]
+    engine.close()
+
+
+def test_sharding_is_stable():
+    class _Eng:
+        pass
+
+    wg = WorkerGroup(_Eng(), num_workers=4)
+    try:
+        rid = (7 << 32) | 3
+        assert wg._shard(rid) == wg._shard(rid)
+        shards = {wg._shard((t << 32) | r)
+                  for t in range(8) for r in range(8)}
+        assert shards == {0, 1, 2, 3}  # all workers used
+    finally:
+        wg.stop()
+
+
+def test_write_error_propagates(tmp_path):
+    engine = RegionEngine(EngineConfig(data_dir=str(tmp_path),
+                                       write_workers=1))
+    s = schema()
+    with pytest.raises(KeyError, match="not open"):
+        engine.put(99, batch(s, 0, 1))
+    # the group survives the failure and keeps serving
+    engine.create_region(1, s)
+    assert engine.put(1, batch(s, 0, 5)) == 5
+    engine.close()
+
+
+def test_crash_recovery_through_workers(tmp_path):
+    """Rows acknowledged through the worker path survive reopen (WAL
+    group commit is still WAL-first)."""
+    engine = RegionEngine(EngineConfig(data_dir=str(tmp_path),
+                                       write_workers=2))
+    s = schema()
+    engine.create_region(1, s)
+    for i in range(5):
+        engine.put(1, batch(s, i * 10, 10))
+    # simulate crash: no close/flush — reopen over the same dir
+    engine2 = RegionEngine(EngineConfig(data_dir=str(tmp_path)))
+    engine2.open_region(1)
+    assert engine2.scan(1).num_rows == 50
+    engine2.close()
+    engine.close()
