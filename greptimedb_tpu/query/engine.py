@@ -108,6 +108,10 @@ class QueryEngine:
                 raise CatalogError("'information_schema' is reserved")
             self.catalog.create_database(stmt.name, stmt.if_not_exists)
             return QueryResult.of_affected(1)
+        if isinstance(stmt, ast.SetVar):
+            return self._set_var(stmt, ctx)
+        if isinstance(stmt, ast.Union):
+            return self._union(stmt, ctx)
         if isinstance(stmt, ast.Insert):
             return self._insert(stmt, ctx)
         if isinstance(stmt, ast.Delete):
@@ -212,6 +216,10 @@ class QueryEngine:
         if sel.table is not None and \
                 infoschema.is_information_schema_query(sel.table, ctx.db):
             return infoschema.execute_virtual_select(self, sel, ctx)
+        if sel.joins:
+            from greptimedb_tpu.query.join import execute_join_select
+
+            return execute_join_select(self, sel, ctx)
         if sel.table is None:
             # SELECT <literals> — session funcs substitute here too
             sel = _subst_session_funcs(sel, ctx)
@@ -538,11 +546,104 @@ class QueryEngine:
 
     # ---- DML ---------------------------------------------------------------
 
+    def _set_var(self, stmt: ast.SetVar, ctx: QueryContext) -> QueryResult:
+        """Session variables (reference SetVariables,
+        operator/src/statement.rs): time_zone takes effect; client-compat
+        chatter (NAMES, sql_mode, autocommit, ...) is accepted and
+        recorded but changes nothing."""
+        name = stmt.name.rsplit(".", 1)[-1]  # strip session./global.
+        if name in ("time_zone", "timezone"):
+            # SET TIME ZONE DEFAULT (value None) restores the engine
+            # default rather than the string 'None'
+            ctx.timezone = self.default_timezone if stmt.value is None \
+                else str(stmt.value)
+        else:
+            ctx.extensions[name] = stmt.value
+        return QueryResult.of_affected(0)
+
+    def _union(self, stmt: ast.Union, ctx: QueryContext) -> QueryResult:
+        """UNION [ALL]: concatenate branch results (reference: DataFusion
+        set operations); plain UNION dedups whole rows."""
+        results = [self._select(b, ctx) for b in stmt.branches]
+        first = results[0]
+        width = len(first.names)
+        for r in results[1:]:
+            if len(r.names) != width:
+                raise PlanError(
+                    f"UNION branches have {width} vs {len(r.names)} columns")
+        cols = []
+        for i in range(width):
+            parts = [np.asarray(r.columns[i]) for r in results]
+            if any(p.dtype == object for p in parts):
+                parts = [p.astype(object) for p in parts]
+            cols.append(np.concatenate(parts))
+
+        def row_key(i):
+            # NULL floats are NaN and NaN != NaN — normalize so UNION
+            # treats NULLs as not distinct (SQL semantics)
+            return tuple(
+                None if (isinstance(v, float) and v != v) else v
+                for v in (c[i] for c in cols))
+
+        if not stmt.all and cols and len(cols[0]):
+            seen: set = set()
+            keep = []
+            for i in range(len(cols[0])):
+                row = row_key(i)
+                if row not in seen:
+                    seen.add(row)
+                    keep.append(i)
+            cols = [c[keep] for c in cols]
+        out = QueryResult(list(first.names), list(first.dtypes), cols)
+        # trailing ORDER BY / LIMIT / OFFSET over the whole union
+        n = out.num_rows
+        idx = np.arange(n)
+        for ob in reversed(stmt.order_by):
+            name = ob.expr.name if isinstance(ob.expr, ast.Column) else None
+            if name is None or name not in out.names:
+                raise PlanError(
+                    "UNION ORDER BY must name an output column")
+            col = np.asarray(out.column(name))[idx]
+            try:
+                srt = np.argsort(col, kind="stable")
+            except TypeError:
+                srt = np.asarray(sorted(
+                    range(len(col)),
+                    key=lambda i: (col[i] is None, col[i])), dtype=np.int64)
+            if not ob.asc:
+                srt = srt[::-1]
+            idx = idx[srt]
+        off = stmt.offset or 0
+        stop = off + stmt.limit if stmt.limit is not None else None
+        idx = idx[off:stop]
+        if len(idx) != n or stmt.order_by:
+            out = QueryResult(out.names, out.dtypes,
+                              [np.asarray(c)[idx] for c in out.columns])
+        return out
+
     def _insert(self, stmt: ast.Insert, ctx: QueryContext) -> QueryResult:
         info = self._table(stmt.table, ctx)
         schema = info.schema
         if stmt.select is not None:
-            raise PlanError("INSERT ... SELECT not yet supported")
+            # INSERT ... SELECT: run the query, bind its columns
+            # positionally to the target list (reference
+            # operator/src/statement.rs DML path)
+            from greptimedb_tpu import datasource
+
+            sub = self._select(stmt.select, ctx)
+            target_cols = stmt.columns or info.column_order or schema.names
+            unknown_t = set(target_cols) - set(schema.names)
+            if unknown_t:
+                raise PlanError(
+                    f"unknown insert columns {sorted(unknown_t)}")
+            if len(sub.names) != len(target_cols):
+                raise PlanError(
+                    f"INSERT ... SELECT: {len(sub.names)} source columns "
+                    f"for {len(target_cols)} target columns")
+            t = datasource.result_to_table(sub)
+            t = t.rename_columns(list(target_cols))
+            n = datasource.insert_arrow_table(self, stmt.table, t, ctx)
+            return QueryResult.of_affected(n)
         # positional VALUES bind in the user-declared column order
         col_names = stmt.columns or info.column_order or schema.names
         unknown = set(col_names) - set(schema.names)

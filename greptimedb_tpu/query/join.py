@@ -1,0 +1,423 @@
+"""Host hash-join executor for multi-table SELECTs.
+
+Mirrors the reference's join capability (full SQL via DataFusion's hash
+join). Joins in a TSDB serve metadata/dimension enrichment — modest
+cardinalities off the scan/aggregate hot path — so the TPU-first design
+keeps them on host: materialize each side (each side's scan still uses
+the device path + caches), equi-hash-join, then evaluate the remaining
+select pipeline over the joined columns with the shared host evaluator.
+
+Supported: INNER / LEFT [OUTER] joins, conjunctions of equality
+predicates in ON, qualified (alias.col) and unambiguous bare column
+references, WHERE, projection incl. expressions, GROUP BY aggregates
+(count/sum/avg/min/max), HAVING, ORDER BY, LIMIT/OFFSET, DISTINCT.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+from greptimedb_tpu.query.expr import PlanError, eval_host
+from greptimedb_tpu.query.result import QueryResult
+from greptimedb_tpu.sql import ast
+
+_AGGS = {"count", "sum", "avg", "min", "max"}
+
+
+def execute_join_select(qe, sel: ast.Select, ctx) -> QueryResult:
+    sides = [(sel.table, sel.table_alias or sel.table)]
+    for j in sel.joins:
+        sides.append((j.table, j.alias or j.table))
+    names = [alias for _, alias in sides]
+    if len(set(names)) != len(names):
+        raise PlanError(f"duplicate table alias in join: {names}")
+
+    # materialize each side through the normal single-table path (device
+    # scan + caches); '*' projection keeps every column available
+    mats = []
+    for table, alias in sides:
+        sub = ast.Select(items=[ast.SelectItem(ast.Star())], table=table)
+        r = qe._select(sub, ctx)
+        mats.append({"alias": alias,
+                     "cols": dict(zip(r.names,
+                                      (np.asarray(c) for c in r.columns))),
+                     "dtypes": dict(zip(r.names, r.dtypes))})
+
+    # left-deep fold: joined = base; for each join: hash-join with next
+    joined_cols, joined_dtypes = _qualify(mats[0])
+    for j, mat in zip(sel.joins, mats[1:]):
+        right_cols, right_dtypes = _qualify(mat)
+        pairs = _equi_pairs(j.on, joined_cols, right_cols)
+        joined_cols, joined_dtypes = _hash_join(
+            joined_cols, joined_dtypes, right_cols, right_dtypes,
+            pairs, j.kind)
+
+    # expose unambiguous bare names too
+    bare: dict[str, Optional[str]] = {}
+    for q in joined_cols:
+        b = q.split(".", 1)[1]
+        bare[b] = None if b in bare else q
+    env_cols = dict(joined_cols)
+    for b, q in bare.items():
+        if q is not None:
+            env_cols[b] = joined_cols[q]
+            joined_dtypes[b] = joined_dtypes[q]
+
+    state = {"cols": env_cols,
+             "n": len(next(iter(env_cols.values()))) if env_cols else 0}
+
+    def resolve(e):
+        return _resolve_columns(e, state["cols"])
+
+    def ev(e):
+        return eval_host(resolve(e), state["cols"], None, None, state["n"])
+
+    if sel.where is not None:
+        mask = np.broadcast_to(np.asarray(ev(sel.where), dtype=bool),
+                               (state["n"],))
+        idx = np.nonzero(mask)[0]
+        state["cols"] = {k: v[idx] for k, v in state["cols"].items()}
+        state["n"] = len(idx)
+    env_cols = state["cols"]
+    n = state["n"]
+
+    has_agg = sel.group_by or any(
+        _contains_agg(it.expr) for it in sel.items)
+    if has_agg:
+        return _aggregate(sel, env_cols, joined_dtypes, n, resolve)
+
+    # plain projection
+    out_names, out_cols, out_dtypes = [], [], []
+    for i, it in enumerate(sel.items):
+        if isinstance(it.expr, ast.Star):
+            for q in joined_cols:
+                out_names.append(q)
+                out_cols.append(env_cols[q])
+                out_dtypes.append(joined_dtypes.get(q))
+            continue
+        v = ev(it.expr)
+        arr = np.asarray([v] * n) if np.ndim(v) == 0 else np.asarray(v)
+        out_names.append(it.alias or _expr_name(it.expr))
+        out_cols.append(arr)
+        out_dtypes.append(None)
+    r = QueryResult(out_names, out_dtypes, out_cols)
+    # ORDER BY may reference unprojected columns: evaluate keys over the
+    # full joined namespace, not the projected output
+    return _post(sel, r, resolve, env=env_cols)
+
+
+# ---- helpers ---------------------------------------------------------------
+
+
+def _qualify(mat):
+    cols = {f"{mat['alias']}.{k}": v for k, v in mat["cols"].items()}
+    dtypes = {f"{mat['alias']}.{k}": v for k, v in mat["dtypes"].items()}
+    return cols, dtypes
+
+
+def _resolve_columns(e, cols: dict):
+    """Rewrite Column nodes to the joined namespace: alias-qualified
+    references become 'alias.col'; bare names must be unambiguous."""
+    if isinstance(e, ast.Column):
+        if e.table:
+            q = f"{e.table}.{e.name}"
+            if q not in cols:
+                raise PlanError(f"unknown column {q!r} in join")
+            return ast.Column(q)
+        if e.name in cols:
+            return e
+        matches = [q for q in cols
+                   if "." in q and q.split(".", 1)[1] == e.name]
+        if len(matches) == 1:
+            return ast.Column(matches[0])
+        if len(matches) > 1:
+            raise PlanError(f"ambiguous column {e.name!r}: {matches}")
+        raise PlanError(f"unknown column {e.name!r} in join")
+    if dataclasses.is_dataclass(e) and not isinstance(e, type):
+        changes = {}
+        for f in dataclasses.fields(e):
+            v = getattr(e, f.name)
+            if isinstance(v, ast.Expr):
+                nv = _resolve_columns(v, cols)
+                if nv is not v:
+                    changes[f.name] = nv
+            elif isinstance(v, (list, tuple)):
+                nv = type(v)(
+                    _resolve_columns(x, cols) if isinstance(x, ast.Expr)
+                    else x for x in v)
+                if nv != v:
+                    changes[f.name] = nv
+        if changes:
+            return dataclasses.replace(e, **changes)
+    return e
+
+
+def _equi_pairs(on, left_cols: dict, right_cols: dict):
+    """(left_key, right_key) pairs from a conjunction of equalities."""
+    pairs = []
+
+    def side_of(c: ast.Column):
+        if c.table:
+            q = f"{c.table}.{c.name}"
+            if q in left_cols:
+                return "l", q
+            if q in right_cols:
+                return "r", q
+            raise PlanError(f"unknown column {q!r} in ON")
+        lm = [q for q in left_cols if q.split(".", 1)[1] == c.name]
+        rm = [q for q in right_cols if q.split(".", 1)[1] == c.name]
+        if len(lm) + len(rm) != 1:
+            raise PlanError(
+                f"ambiguous or unknown ON column {c.name!r}")
+        return ("l", lm[0]) if lm else ("r", rm[0])
+
+    def walk(e):
+        if isinstance(e, ast.BinaryOp) and e.op == "and":
+            walk(e.left)
+            walk(e.right)
+            return
+        if (isinstance(e, ast.BinaryOp) and e.op == "="
+                and isinstance(e.left, ast.Column)
+                and isinstance(e.right, ast.Column)):
+            s1, q1 = side_of(e.left)
+            s2, q2 = side_of(e.right)
+            if {s1, s2} != {"l", "r"}:
+                raise PlanError("ON clause must compare the two sides")
+            pairs.append((q1, q2) if s1 == "l" else (q2, q1))
+            return
+        raise PlanError(
+            "only conjunctions of column equalities are supported in ON")
+
+    walk(on)
+    if not pairs:
+        raise PlanError("ON clause has no equality condition")
+    return pairs
+
+
+def _key_tuple(cols: dict, keys: list, i: int):
+    return tuple(None if _is_nan(cols[k][i]) else cols[k][i] for k in keys)
+
+
+def _is_nan(v) -> bool:
+    return isinstance(v, float) and v != v
+
+
+def _hash_join(lcols, ldtypes, rcols, rdtypes, pairs, kind: str):
+    lk = [p[0] for p in pairs]
+    rk = [p[1] for p in pairs]
+    rn = len(next(iter(rcols.values()))) if rcols else 0
+    ln = len(next(iter(lcols.values()))) if lcols else 0
+    table: dict = {}
+    for i in range(rn):
+        key = _key_tuple(rcols, rk, i)
+        if any(k is None for k in key):
+            continue  # NULL never matches in SQL equality
+        table.setdefault(key, []).append(i)
+    li, ri = [], []
+    for i in range(ln):
+        key = _key_tuple(lcols, lk, i)
+        hits = table.get(key) if not any(k is None for k in key) else None
+        if hits:
+            for j in hits:
+                li.append(i)
+                ri.append(j)
+        elif kind == "left":
+            li.append(i)
+            ri.append(-1)  # NULL row
+    li = np.asarray(li, dtype=np.int64)
+    ri = np.asarray(ri, dtype=np.int64)
+    out = {k: np.asarray(v)[li] for k, v in lcols.items()}
+    miss = ri < 0
+    for k, v in rcols.items():
+        v = np.asarray(v)
+        taken = v[np.clip(ri, 0, None)] if len(v) else \
+            np.empty(len(ri), dtype=v.dtype)
+        if miss.any():
+            taken = taken.astype(object)
+            taken[miss] = None
+        out[k] = taken
+    dtypes = {**ldtypes, **rdtypes}
+    return out, dtypes
+
+
+def _contains_agg(e) -> bool:
+    if isinstance(e, ast.FuncCall):
+        if e.name.lower() in _AGGS:
+            return True
+        return any(_contains_agg(a) for a in e.args)
+    if dataclasses.is_dataclass(e) and not isinstance(e, type):
+        for f in dataclasses.fields(e):
+            v = getattr(e, f.name)
+            if isinstance(v, ast.Expr) and _contains_agg(v):
+                return True
+            if isinstance(v, (list, tuple)) and any(
+                    isinstance(x, ast.Expr) and _contains_agg(x)
+                    for x in v):
+                return True
+    return False
+
+
+def _agg_value(name: str, vals: np.ndarray):
+    clean = np.asarray([v for v in vals
+                        if v is not None and not _is_nan(v)])
+    if name == "count":
+        return len(clean)
+    if len(clean) == 0:
+        return None
+    if name == "sum":
+        return float(np.sum(clean.astype(np.float64)))
+    if name == "min":
+        return clean.min()
+    if name == "max":
+        return clean.max()
+    return float(np.mean(clean.astype(np.float64)))
+
+
+def _aggregate(sel, cols, dtypes, n, resolve) -> QueryResult:
+    group_exprs = [resolve(g) for g in sel.group_by]
+    key_arrays = []
+    for g in group_exprs:
+        v = eval_host(g, cols, None, None, n)
+        key_arrays.append(np.asarray([v] * n) if np.ndim(v) == 0
+                          else np.asarray(v))
+    groups: dict = {}
+    if key_arrays:
+        for i in range(n):
+            groups.setdefault(
+                tuple(a[i] for a in key_arrays), []).append(i)
+    else:
+        groups[()] = list(range(n))
+
+    def agg_for(expr, idx):
+        """Evaluate one select item for one group."""
+        def rec(e):
+            if isinstance(e, ast.FuncCall) and e.name.lower() in _AGGS:
+                fname = e.name.lower()
+                if fname == "count" and (not e.args or isinstance(
+                        e.args[0], ast.Star)):
+                    return len(idx)
+                arg = resolve(e.args[0])
+                vals = eval_host(arg, {k: v[idx] for k, v in cols.items()},
+                                 None, None, len(idx))
+                vals = np.asarray([vals] * len(idx)) if np.ndim(vals) == 0 \
+                    else np.asarray(vals)
+                return _agg_value(fname, vals)
+            if isinstance(e, ast.Column):
+                rv = eval_host(resolve(e), cols, None, None, n)
+                return np.asarray(rv)[idx[0]] if len(idx) else None
+            if isinstance(e, ast.Literal):
+                return e.value
+            if isinstance(e, ast.BinaryOp):
+                import operator as op
+
+                if e.op == "and":
+                    return bool(rec(e.left)) and bool(rec(e.right))
+                if e.op == "or":
+                    return bool(rec(e.left)) or bool(rec(e.right))
+                f = {"+": op.add, "-": op.sub, "*": op.mul,
+                     "/": op.truediv, "%": op.mod,
+                     "=": op.eq, "!=": op.ne, "<": op.lt, "<=": op.le,
+                     ">": op.gt, ">=": op.ge}.get(e.op)
+                if f is None:
+                    raise PlanError(
+                        f"unsupported op {e.op!r} over join aggregates")
+                return f(rec(e.left), rec(e.right))
+            raise PlanError(
+                f"unsupported expression over join aggregates: {e}")
+        return rec(expr)
+
+    if group_exprs:
+        # None keys (LEFT JOIN null-extended rows) aren't comparable to
+        # strings — sort NULL groups last, per component
+        keys = sorted(groups, key=lambda k: tuple(
+            (v is None, v) for v in k))
+    else:
+        keys = list(groups)
+    out_names, rows_by_col = [], []
+    for it in sel.items:
+        if isinstance(it.expr, ast.Star):
+            raise PlanError("SELECT * with GROUP BY over a join")
+        out_names.append(it.alias or _expr_name(it.expr))
+    table_rows = []
+    for key in keys:
+        idx = groups[key]
+        if sel.having is not None:
+            hv = agg_for(resolve(sel.having), idx)
+            if not bool(hv):
+                continue
+        table_rows.append([agg_for(it.expr, idx) for it in sel.items])
+    cols_out = [np.asarray([r[i] for r in table_rows], dtype=object)
+                for i in range(len(out_names))] if table_rows else \
+        [np.empty(0, dtype=object) for _ in out_names]
+    # tighten numeric dtypes where possible
+    tightened = []
+    for c in cols_out:
+        try:
+            tightened.append(c.astype(np.float64)
+                             if len(c) and all(isinstance(v, (int, float))
+                                               and v is not None
+                                               for v in c) else c)
+        except (TypeError, ValueError):
+            tightened.append(c)
+    r = QueryResult(out_names, [None] * len(out_names), tightened)
+    return _post(sel, r, resolve)
+
+
+def _post(sel, r: QueryResult, resolve,
+          env: Optional[dict] = None) -> QueryResult:
+    """ORDER BY / DISTINCT / LIMIT / OFFSET. Order keys resolve against
+    the output columns by name first, then (if `env` is given, i.e. rows
+    are still 1:1 with the joined relation) against the full joined
+    namespace — SQL allows ordering by unprojected columns."""
+    n = r.num_rows
+    idx = np.arange(n)
+    if sel.order_by:
+        for ob in reversed(sel.order_by):
+            name = _expr_name(ob.expr)
+            if name in r.names:
+                col = np.asarray(r.column(name))[idx]
+            elif env is not None:
+                full = np.asarray(
+                    eval_host(resolve(ob.expr), env, None, None, n))
+                col = np.broadcast_to(full, (n,))[idx] \
+                    if np.ndim(full) == 0 else full[idx]
+            else:
+                raise PlanError(
+                    f"ORDER BY {name!r} is not an output column")
+            try:
+                srt = np.argsort(col, kind="stable")
+            except TypeError:  # mixed object dtype (None vs str)
+                srt = np.asarray(sorted(
+                    range(len(col)),
+                    key=lambda i: (col[i] is None, col[i])), dtype=np.int64)
+            if not ob.asc:
+                srt = srt[::-1]
+            idx = idx[srt]
+    if sel.distinct and len(idx):
+        seen, keep = set(), []
+        for i in idx:
+            row = tuple(c[i] for c in r.columns)
+            if row not in seen:
+                seen.add(row)
+                keep.append(i)
+        idx = np.asarray(keep, dtype=np.int64)
+    off = sel.offset or 0
+    stop = off + sel.limit if sel.limit is not None else None
+    idx = idx[off:stop]
+    return QueryResult(r.names, r.dtypes,
+                       [np.asarray(c)[idx] for c in r.columns])
+
+
+def _expr_name(e) -> str:
+    if isinstance(e, ast.Column):
+        return e.name
+    if isinstance(e, ast.FuncCall):
+        return f"{e.name}({', '.join(_expr_name(a) for a in e.args)})"
+    if isinstance(e, ast.Star):
+        return "*"
+    if isinstance(e, ast.Literal):
+        return str(e.value)
+    return str(e)

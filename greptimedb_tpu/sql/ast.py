@@ -116,9 +116,21 @@ class OrderByItem:
 
 
 @dataclass
+class Join:
+    """One JOIN clause (kind: inner | left)."""
+
+    table: str
+    alias: Optional[str]
+    kind: str
+    on: "Expr"
+
+
+@dataclass
 class Select(Statement):
     items: list[SelectItem]
-    table: Optional[str] = None  # single-table FROM (joins later)
+    table: Optional[str] = None  # base FROM table
+    table_alias: Optional[str] = None
+    joins: list = field(default_factory=list)  # list[Join]
     distinct: bool = False
     where: Optional[Expr] = None
     group_by: list[Expr] = field(default_factory=list)
@@ -181,6 +193,28 @@ class CopyDatabase(Statement):
 class CreateDatabase(Statement):
     name: str
     if_not_exists: bool = False
+
+
+@dataclass
+class SetVar(Statement):
+    """SET <name> = <value> (session variable; reference handles
+    time_zone and swallows client-compat vars, statement.rs SetVariables)."""
+
+    name: str
+    value: object
+
+
+@dataclass
+class Union(Statement):
+    """UNION [ALL] chain of SELECTs (reference: DataFusion set ops).
+    Trailing ORDER BY/LIMIT/OFFSET bind to the whole union (SQL
+    semantics), lifted off the final branch by the parser."""
+
+    branches: tuple
+    all: bool = False
+    order_by: list = field(default_factory=list)
+    limit: Optional[int] = None
+    offset: Optional[int] = None
 
 
 @dataclass

@@ -15,7 +15,7 @@ KEYWORDS = {
     "interval", "cast", "case", "when", "then", "else", "end", "truncate",
     "alter", "add", "column", "rename", "to", "tql", "eval", "evaluate",
     "align", "range", "fill", "partition", "on", "nulls", "first", "last",
-    "admin", "verbose", "copy", "default", "flow", "flows", "sink",
+    "admin", "verbose", "copy", "default", "flow", "flows", "sink", "set",
     "external",
 }
 

@@ -123,7 +123,10 @@ class Parser:
         if t.kind != "keyword":
             raise SqlError(f"expected statement at {t!r}")
         if t.value == "select":
-            return self.parse_select()
+            sel = self.parse_select()
+            return self._maybe_union(sel)
+        if t.value == "set":
+            return self.parse_set()
         if t.value == "create":
             return self.parse_create()
         if t.value == "insert":
@@ -163,6 +166,88 @@ class Parser:
         if t.value == "copy":
             return self.parse_copy()
         raise SqlError(f"unsupported statement start {t.value!r}")
+
+    def _maybe_union(self, first: ast.Select) -> ast.Statement:
+        """SELECT ... [UNION [ALL] SELECT ...]* — reference set operations
+        (DataFusion). ORDER BY/LIMIT bind per branch."""
+        branches = [first]
+        is_all = None
+        while self.peek().kind == "ident" \
+                and self.peek().value.lower() == "union":
+            self.next()
+            this_all = False
+            if self.peek().kind == "ident" \
+                    and self.peek().value.lower() == "all":
+                self.next()
+                this_all = True
+            elif self.eat_kw("distinct"):
+                pass
+            if is_all is None:
+                is_all = this_all
+            elif is_all != this_all:
+                raise SqlError("mixing UNION and UNION ALL is not supported")
+            self.expect_kw("select")
+            # parse_select expects to consume the SELECT keyword itself
+            self.i -= 1
+            branches.append(self.parse_select())
+        if len(branches) == 1:
+            return first
+        # trailing ORDER BY / LIMIT / OFFSET parsed into the last branch
+        # actually belong to the whole union (SQL semantics)
+        last = branches[-1]
+        order_by, limit, offset = last.order_by, last.limit, last.offset
+        last.order_by, last.limit, last.offset = [], None, None
+        return ast.Union(tuple(branches), all=bool(is_all),
+                         order_by=order_by, limit=limit, offset=offset)
+
+    def parse_set(self) -> ast.SetVar:
+        """SET [SESSION|LOCAL|GLOBAL] <name> (=|TO) <value>,
+        SET TIME ZONE <value>, SET NAMES <charset> (MySQL/PG client
+        compat; reference servers swallow these the same way)."""
+        self.expect_kw("set")
+        t = self.peek()
+        if t.kind == "ident" and t.value.lower() in ("session", "local",
+                                                     "global"):
+            self.next()
+        t = self.peek()
+        if t.kind == "keyword" and t.value == "time":
+            self.next()
+            z = self.ident()
+            if z.lower() != "zone":
+                raise SqlError(f"expected ZONE after SET TIME, got {z!r}")
+            name = "time_zone"
+        else:
+            parts = [self._set_name_part()]
+            while self.eat_op("."):
+                parts.append(self._set_name_part())
+            name = ".".join(parts)
+        if not (self.eat_op("=") or self.eat_kw("to")):
+            # SET NAMES utf8 style: value follows bare
+            pass
+        v = self.peek()
+        if v.kind == "string":
+            self.next()
+            value: object = v.value
+        elif v.kind == "number":
+            self.next()
+            value = float(v.value) if "." in v.value else int(v.value)
+        elif v.kind == "keyword" and v.value in ("true", "false", "null",
+                                                 "default"):
+            self.next()
+            value = {"true": True, "false": False,
+                     "null": None, "default": None}[v.value]
+        else:
+            value = self.ident()
+        return ast.SetVar(name.lower(), value)
+
+    def _set_name_part(self) -> str:
+        t = self.peek()
+        if t.kind == "op" and t.value == "@":
+            # @@session.var / @@var system-variable syntax
+            self.next()
+            self.eat_op("@")
+            return self._set_name_part()
+        return self.ident()
 
     def parse_copy(self) -> ast.Statement:
         """COPY [TABLE] <t> | DATABASE <db>  TO|FROM '<path>' [WITH (...)]"""
@@ -206,6 +291,39 @@ class Parser:
         sel.distinct = distinct
         if self.eat_kw("from"):
             sel.table = self.qualified_name()
+            sel.table_alias = self._table_alias()
+            # [INNER|LEFT [OUTER]] JOIN <table> [AS alias] ON <expr>
+            while True:
+                kind = None
+                t = self.peek()
+                if t.kind == "ident" and t.value.lower() == "inner":
+                    self.next()
+                    kind = "inner"
+                elif t.kind == "ident" and t.value.lower() == "left":
+                    self.next()
+                    if self.peek().kind == "ident" \
+                            and self.peek().value.lower() == "outer":
+                        self.next()
+                    kind = "left"
+                elif t.kind == "ident" and t.value.lower() in (
+                        "right", "full", "cross"):
+                    # must reject loudly: consuming these as table aliases
+                    # would silently run the query as an INNER join
+                    raise SqlError(
+                        f"{t.value.upper()} JOIN is not supported "
+                        "(INNER and LEFT [OUTER] are)")
+                t = self.peek()
+                if t.kind == "ident" and t.value.lower() == "join":
+                    self.next()
+                elif kind is not None:
+                    raise SqlError(f"expected JOIN at {self.peek()!r}")
+                else:
+                    break
+                jt = self.qualified_name()
+                jalias = self._table_alias()
+                self.expect_kw("on")
+                sel.joins.append(
+                    ast.Join(jt, jalias, kind or "inner", self.parse_expr()))
         if self.eat_kw("where"):
             sel.where = self.parse_expr()
         # RANGE ... ALIGN extension: ALIGN <interval> [TO <expr>] [BY (cols)] [FILL x]
@@ -246,6 +364,17 @@ class Parser:
             sel.offset = int(self.next().value)
         return sel
 
+    def _table_alias(self) -> Optional[str]:
+        if self.eat_kw("as"):
+            return self.ident()
+        t = self.peek()
+        if t.kind == "ident" and t.value.lower() not in (
+                "inner", "left", "right", "full", "cross", "outer",
+                "join", "union", "on"):
+            self.next()
+            return t.value
+        return None
+
     def parse_select_item(self) -> ast.SelectItem:
         if self.at_op("*"):
             self.next()
@@ -262,7 +391,10 @@ class Parser:
         alias = None
         if self.eat_kw("as"):
             alias = self.ident()
-        elif self.peek().kind == "ident":
+        elif self.peek().kind == "ident" \
+                and self.peek().value.lower() != "union":
+            # a bare ident is an implicit alias — except UNION, which
+            # chains set operations at the statement level
             alias = self.ident()
         return ast.SelectItem(expr, alias, range_interval=rng, fill=fill)
 

@@ -1,0 +1,16 @@
+-- INNER/LEFT joins with aliases, bare-column resolution, and aggregates
+CREATE TABLE metrics (host STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(host));
+
+CREATE TABLE hosts (host STRING, dc STRING, ts TIMESTAMP TIME INDEX, PRIMARY KEY(host));
+
+INSERT INTO metrics VALUES ('a', 1.0, 1000), ('a', 3.0, 2000), ('b', 10.0, 1000), ('c', 99.0, 1000);
+
+INSERT INTO hosts VALUES ('a', 'east', 0), ('b', 'west', 0);
+
+SELECT metrics.host, metrics.v, hosts.dc FROM metrics JOIN hosts ON metrics.host = hosts.host ORDER BY metrics.v;
+
+SELECT m.host, h.dc FROM metrics m LEFT JOIN hosts h ON m.host = h.host ORDER BY m.host, m.ts;
+
+SELECT dc, sum(v), count(*) FROM metrics JOIN hosts ON metrics.host = hosts.host GROUP BY dc ORDER BY dc;
+
+SELECT v, dc FROM metrics JOIN hosts ON metrics.host = hosts.host WHERE v > 5;

@@ -1,0 +1,157 @@
+"""JOIN execution (reference: full SQL joins via DataFusion's hash join;
+here a host hash join over device-scanned sides — joins serve metadata /
+dimension enrichment off the TPU hot path)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from greptimedb_tpu.catalog import Catalog, MemoryKv
+from greptimedb_tpu.query import QueryEngine
+from greptimedb_tpu.query.expr import PlanError
+from greptimedb_tpu.storage import RegionEngine
+from greptimedb_tpu.storage.engine import EngineConfig
+
+
+@pytest.fixture()
+def db(tmp_path):
+    engine = RegionEngine(EngineConfig(data_dir=str(tmp_path)))
+    qe = QueryEngine(Catalog(MemoryKv()), engine)
+    qe.execute_one(
+        "CREATE TABLE m (host STRING, ts TIMESTAMP(3) NOT NULL, v DOUBLE,"
+        " TIME INDEX (ts), PRIMARY KEY (host))")
+    qe.execute_one(
+        "INSERT INTO m VALUES ('a', 1000, 1.0), ('a', 2000, 3.0),"
+        " ('b', 1000, 10.0), ('c', 1000, 99.0)")
+    qe.execute_one(
+        "CREATE TABLE dim (host STRING, ts TIMESTAMP(3) NOT NULL,"
+        " dc STRING, TIME INDEX (ts), PRIMARY KEY (host))")
+    qe.execute_one(
+        "INSERT INTO dim VALUES ('a', 0, 'east'), ('b', 0, 'west')")
+    yield qe
+    engine.close()
+
+
+class TestInner:
+    def test_basic(self, db):
+        r = db.execute_one(
+            "SELECT m.host, m.v, dim.dc FROM m JOIN dim "
+            "ON m.host = dim.host ORDER BY m.v")
+        assert r.rows() == [["a", 1.0, "east"], ["a", 3.0, "east"],
+                            ["b", 10.0, "west"]]
+
+    def test_aliases_and_where(self, db):
+        r = db.execute_one(
+            "SELECT x.v, y.dc FROM m AS x JOIN dim y ON x.host = y.host "
+            "WHERE x.v > 1 ORDER BY x.v")
+        assert r.rows() == [[3.0, "east"], [10.0, "west"]]
+
+    def test_bare_columns_resolve_when_unambiguous(self, db):
+        r = db.execute_one(
+            "SELECT v, dc FROM m JOIN dim ON m.host = dim.host "
+            "WHERE v > 5")
+        assert r.rows() == [[10.0, "west"]]
+
+    def test_ambiguous_column_rejected(self, db):
+        with pytest.raises(PlanError, match="ambiguous"):
+            db.execute_one(
+                "SELECT host FROM m JOIN dim ON m.host = dim.host")
+
+    def test_star_projects_both_sides(self, db):
+        r = db.execute_one(
+            "SELECT * FROM m JOIN dim ON m.host = dim.host WHERE v > 5")
+        assert r.num_rows == 1
+        assert set(r.names) == {"m.host", "m.ts", "m.v",
+                                "dim.host", "dim.ts", "dim.dc"}
+
+    def test_order_by_unprojected_column(self, db):
+        r = db.execute_one(
+            "SELECT dc FROM m JOIN dim ON m.host = dim.host "
+            "ORDER BY m.v DESC LIMIT 2")
+        assert r.rows() == [["west"], ["east"]]
+
+
+class TestLeft:
+    def test_unmatched_rows_null(self, db):
+        r = db.execute_one(
+            "SELECT m.host, dc FROM m LEFT JOIN dim ON m.host = dim.host "
+            "ORDER BY m.host, m.ts")
+        assert r.rows() == [["a", "east"], ["a", "east"],
+                            ["b", "west"], ["c", None]]
+
+    def test_left_outer_spelling(self, db):
+        r = db.execute_one(
+            "SELECT count(*) FROM m LEFT OUTER JOIN dim "
+            "ON m.host = dim.host")
+        assert r.rows() == [[4]]
+
+
+class TestAggregates:
+    def test_group_by_dimension(self, db):
+        r = db.execute_one(
+            "SELECT dc, sum(v), count(*) FROM m JOIN dim "
+            "ON m.host = dim.host GROUP BY dc ORDER BY dc")
+        assert r.rows() == [["east", 4.0, 2], ["west", 10.0, 1]]
+
+    def test_having(self, db):
+        r = db.execute_one(
+            "SELECT dim.dc, avg(m.v) FROM m INNER JOIN dim "
+            "ON m.host = dim.host GROUP BY dim.dc "
+            "HAVING avg(m.v) > 3 ORDER BY dim.dc")
+        assert r.rows() == [["west", 10.0]]
+
+    def test_ungrouped_aggregate(self, db):
+        r = db.execute_one(
+            "SELECT min(v), max(v) FROM m JOIN dim ON m.host = dim.host")
+        assert r.rows() == [[1.0, 10.0]]
+
+
+class TestThreeWay:
+    def test_two_joins(self, db):
+        db.execute_one(
+            "CREATE TABLE reg (dc STRING, ts TIMESTAMP(3) NOT NULL,"
+            " country STRING, TIME INDEX (ts), PRIMARY KEY (dc))")
+        db.execute_one(
+            "INSERT INTO reg VALUES ('east', 0, 'us'), ('west', 0, 'eu')")
+        r = db.execute_one(
+            "SELECT m.host, reg.country FROM m "
+            "JOIN dim ON m.host = dim.host "
+            "JOIN reg ON dim.dc = reg.dc "
+            "ORDER BY m.host, m.ts")
+        assert r.rows() == [["a", "us"], ["a", "us"], ["b", "eu"]]
+
+
+class TestOracleRandomized:
+    def test_against_pandas(self, tmp_path):
+        rng = np.random.default_rng(3)
+        engine = RegionEngine(EngineConfig(data_dir=str(tmp_path)))
+        qe = QueryEngine(Catalog(MemoryKv()), engine)
+        qe.execute_one(
+            "CREATE TABLE f (k STRING, ts TIMESTAMP(3) NOT NULL,"
+            " x DOUBLE, TIME INDEX (ts), PRIMARY KEY (k))")
+        qe.execute_one(
+            "CREATE TABLE d (k STRING, ts TIMESTAMP(3) NOT NULL,"
+            " y DOUBLE, TIME INDEX (ts), PRIMARY KEY (k))")
+        lk = [f"k{int(i)}" for i in rng.integers(0, 12, 120)]
+        lx = np.round(rng.uniform(0, 100, 120), 3)
+        rows = ", ".join(f"('{k}', {i}, {v})"
+                         for i, (k, v) in enumerate(zip(lk, lx)))
+        qe.execute_one(f"INSERT INTO f VALUES {rows}")
+        rk = [f"k{i}" for i in range(0, 12, 2)]  # half the keys match
+        ry = np.round(rng.uniform(0, 10, len(rk)), 3)
+        rows = ", ".join(f"('{k}', {i}, {v})"
+                         for i, (k, v) in enumerate(zip(rk, ry)))
+        qe.execute_one(f"INSERT INTO d VALUES {rows}")
+
+        got = db_rows = qe.execute_one(
+            "SELECT f.k, x, y FROM f JOIN d ON f.k = d.k "
+            "ORDER BY f.k, f.ts").rows()
+        lf = pd.DataFrame({"k": lk, "ts": range(120), "x": lx})
+        rf = pd.DataFrame({"k": rk, "y": ry})
+        oracle = lf.merge(rf, on="k").sort_values(["k", "ts"])
+        assert len(got) == len(oracle)
+        np.testing.assert_allclose(
+            [r[1] for r in got], oracle.x.values, rtol=1e-9)
+        np.testing.assert_allclose(
+            [r[2] for r in got], oracle.y.values, rtol=1e-9)
+        engine.close()

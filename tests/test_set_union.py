@@ -1,0 +1,142 @@
+"""SET session variables, UNION [ALL], INSERT ... SELECT (reference:
+SetVariables in operator/src/statement.rs, DataFusion set operations,
+and the DML INSERT-from-query path)."""
+
+import pytest
+
+from greptimedb_tpu.catalog import Catalog, MemoryKv
+from greptimedb_tpu.query import QueryEngine
+from greptimedb_tpu.query.engine import QueryContext
+from greptimedb_tpu.query.expr import PlanError
+from greptimedb_tpu.storage import RegionEngine
+from greptimedb_tpu.storage.engine import EngineConfig
+
+
+@pytest.fixture()
+def db(tmp_path):
+    engine = RegionEngine(EngineConfig(data_dir=str(tmp_path)))
+    qe = QueryEngine(Catalog(MemoryKv()), engine)
+    qe.execute_one(
+        "CREATE TABLE t (h STRING, ts TIMESTAMP(3) NOT NULL, v DOUBLE,"
+        " TIME INDEX (ts), PRIMARY KEY (h))")
+    qe.execute_one(
+        "INSERT INTO t VALUES ('a', 1000, 1.0), ('b', 2000, 2.0)")
+    yield qe
+    engine.close()
+
+
+class TestSet:
+    def test_set_time_zone_variants(self, db):
+        ctx = QueryContext(db="public")
+        db.execute_one("SET time_zone = '+08:00'", ctx)
+        assert db.execute_one("SELECT timezone()", ctx).rows() == [["+08:00"]]
+        db.execute_one("SET TIME ZONE 'UTC'", ctx)
+        assert db.execute_one("SELECT timezone()", ctx).rows() == [["UTC"]]
+        db.execute_one("SET SESSION time_zone = '+01:00'", ctx)
+        assert db.execute_one("SELECT timezone()", ctx).rows() == [["+01:00"]]
+
+    def test_client_compat_chatter_accepted(self, db):
+        ctx = QueryContext(db="public")
+        for q in ["SET NAMES utf8mb4",
+                  "SET @@session.sql_mode = 'STRICT_TRANS_TABLES'",
+                  "SET autocommit = 1",
+                  "SET search_path TO public"]:
+            r = db.execute_one(q, ctx)
+            assert r.affected_rows == 0
+        assert ctx.extensions["sql_mode"] == "STRICT_TRANS_TABLES"
+
+
+class TestUnion:
+    def test_union_all(self, db):
+        r = db.execute_one("SELECT v FROM t UNION ALL SELECT v FROM t")
+        assert sorted(x[0] for x in r.rows()) == [1.0, 1.0, 2.0, 2.0]
+
+    def test_union_dedup(self, db):
+        r = db.execute_one(
+            "SELECT h, v FROM t UNION SELECT h, v FROM t")
+        assert sorted(r.rows()) == [["a", 1.0], ["b", 2.0]]
+
+    def test_union_literals(self, db):
+        r = db.execute_one("SELECT 1 UNION ALL SELECT 2 UNION ALL SELECT 3")
+        assert sorted(x[0] for x in r.rows()) == [1, 2, 3]
+
+    def test_union_arity_mismatch(self, db):
+        with pytest.raises(PlanError, match="columns"):
+            db.execute_one("SELECT h, v FROM t UNION ALL SELECT v FROM t")
+
+    def test_union_mixed_all_rejected(self, db):
+        with pytest.raises(Exception, match="mixing"):
+            db.execute_one(
+                "SELECT v FROM t UNION SELECT v FROM t "
+                "UNION ALL SELECT v FROM t")
+
+
+class TestReviewRegressions:
+    def test_right_join_rejected_loudly(self, db):
+        with pytest.raises(Exception, match="RIGHT JOIN is not supported"):
+            db.execute_one(
+                "SELECT * FROM t RIGHT JOIN t ON h = h")
+
+    def test_union_trailing_order_limit_applies_globally(self, db):
+        r = db.execute_one(
+            "SELECT v FROM t UNION ALL SELECT v * 100 FROM t "
+            "ORDER BY v DESC LIMIT 3")
+        assert [x[0] for x in r.rows()] == [200.0, 100.0, 2.0]
+
+    def test_set_time_zone_default_restores_engine_default(self, db):
+        ctx = QueryContext(db="public")
+        db.execute_one("SET time_zone = '+09:00'", ctx)
+        db.execute_one("SET TIME ZONE DEFAULT", ctx)
+        assert db.execute_one("SELECT timezone()", ctx).rows() == [["UTC"]]
+
+    def test_union_dedup_treats_nulls_as_equal(self, db):
+        db.execute_one("CREATE TABLE nt (h STRING, ts TIMESTAMP(3) "
+                       "NOT NULL, v DOUBLE, TIME INDEX (ts), "
+                       "PRIMARY KEY (h))")
+        db.execute_one("INSERT INTO nt VALUES ('x', 1, NULL)")
+        r = db.execute_one(
+            "SELECT h, v FROM nt UNION SELECT h, v FROM nt")
+        assert r.num_rows == 1
+
+    def test_left_join_group_by_null_group(self, db):
+        db.execute_one(
+            "CREATE TABLE dim (h STRING, ts TIMESTAMP(3) NOT NULL,"
+            " dc STRING, TIME INDEX (ts), PRIMARY KEY (h))")
+        db.execute_one("INSERT INTO dim VALUES ('a', 0, 'east')")
+        r = db.execute_one(
+            "SELECT dc, count(*) FROM t LEFT JOIN dim ON t.h = dim.h "
+            "GROUP BY dc ORDER BY dc")
+        # 'b' has no dim row -> NULL group, sorted last
+        assert r.rows() == [["east", 1], [None, 1]]
+
+    def test_insert_select_unknown_target_rejected(self, db):
+        with pytest.raises(PlanError, match="unknown insert columns"):
+            db.execute_one(
+                "INSERT INTO t (h, nope, ts) SELECT h, v, ts FROM t")
+
+
+class TestInsertSelect:
+    def test_roundtrip(self, db):
+        db.execute_one(
+            "CREATE TABLE t2 (h STRING, ts TIMESTAMP(3) NOT NULL, v DOUBLE,"
+            " TIME INDEX (ts), PRIMARY KEY (h))")
+        r = db.execute_one("INSERT INTO t2 SELECT h, ts, v FROM t")
+        assert r.affected_rows == 2
+        assert db.execute_one("SELECT h, v FROM t2 ORDER BY ts").rows() == \
+            [["a", 1.0], ["b", 2.0]]
+
+    def test_transform_and_filter(self, db):
+        db.execute_one(
+            "CREATE TABLE agg (h STRING, ts TIMESTAMP(3) NOT NULL,"
+            " v DOUBLE, TIME INDEX (ts), PRIMARY KEY (h))")
+        db.execute_one(
+            "INSERT INTO agg (h, ts, v) "
+            "SELECT h, ts, v * 10 FROM t WHERE v > 1.5")
+        assert db.execute_one("SELECT h, v FROM agg").rows() == [["b", 20.0]]
+
+    def test_arity_mismatch(self, db):
+        db.execute_one(
+            "CREATE TABLE t3 (h STRING, ts TIMESTAMP(3) NOT NULL, v DOUBLE,"
+            " TIME INDEX (ts), PRIMARY KEY (h))")
+        with pytest.raises(PlanError, match="target columns"):
+            db.execute_one("INSERT INTO t3 SELECT h, ts FROM t")
