@@ -105,4 +105,13 @@ def device_cache_bytes() -> int:
     env = os.environ.get("GREPTIMEDB_TPU_DEVICE_CACHE_BYTES")
     if env:
         return int(env)
-    return 8 << 30 if _platform() in ("tpu", "axon") else 1 << 30
+    if _platform() in ("tpu", "axon"):
+        return 8 << 30
+    # CPU backend: "device" memory IS host RAM — budget a quarter of it
+    # (reference page cache defaults to mem/16; the block cache carries
+    # the whole warm working set here, so it gets more)
+    try:
+        ram = os.sysconf("SC_PAGE_SIZE") * os.sysconf("SC_PHYS_PAGES")
+    except (ValueError, OSError):
+        ram = 8 << 30
+    return max(1 << 30, min(ram // 4, 32 << 30))

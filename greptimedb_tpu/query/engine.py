@@ -213,13 +213,17 @@ class QueryEngine:
     def _select(self, sel: ast.Select, ctx: QueryContext) -> QueryResult:
         from greptimedb_tpu.catalog import information_schema as infoschema
 
-        if sel.table is not None and \
-                infoschema.is_information_schema_query(sel.table, ctx.db):
-            return infoschema.execute_virtual_select(self, sel, ctx)
         if sel.joins:
+            # joins first: an information_schema BASE table with joins
+            # must not fall into the (join-less) virtual executor — the
+            # join executor materializes each side via _select, which
+            # handles infoschema sides itself
             from greptimedb_tpu.query.join import execute_join_select
 
             return execute_join_select(self, sel, ctx)
+        if sel.table is not None and \
+                infoschema.is_information_schema_query(sel.table, ctx.db):
+            return infoschema.execute_virtual_select(self, sel, ctx)
         if sel.table is None:
             # SELECT <literals> — session funcs substitute here too
             sel = _subst_session_funcs(sel, ctx)

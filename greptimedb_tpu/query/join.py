@@ -36,11 +36,33 @@ def execute_join_select(qe, sel: ast.Select, ctx) -> QueryResult:
         raise PlanError(f"duplicate table alias in join: {names}")
 
     # materialize each side through the normal single-table path (device
-    # scan + caches); '*' projection keeps every column available
+    # scan + caches), pushing down single-side WHERE conjuncts and the
+    # referenced-column projection so only the needed slice crosses into
+    # the host join (the reference pushes the same through DataFusion's
+    # join planning)
+    conjuncts = _split_conjuncts(sel.where)
+    side_cols = _referenced_by_side(sel, sides)
     mats = []
     for table, alias in sides:
-        sub = ast.Select(items=[ast.SelectItem(ast.Star())], table=table)
-        r = qe._select(sub, ctx)
+        pushed = [_strip_qualifier(c, alias) for c in conjuncts
+                  if _only_references(c, alias, sides)]
+        where = None
+        for p in pushed:
+            where = p if where is None else ast.BinaryOp("and", where, p)
+        wanted = side_cols.get(alias)
+        if not wanted:  # no map (Star/bare refs) or nothing referenced
+            items = [ast.SelectItem(ast.Star())]
+        else:
+            items = [ast.SelectItem(ast.Column(c)) for c in sorted(wanted)]
+        sub = ast.Select(items=items, table=table, where=where)
+        try:
+            r = qe._select(sub, ctx)
+        except PlanError:
+            # conservative fallback: a pushdown the single-table path
+            # can't evaluate (pruning is an optimization, never required)
+            sub = ast.Select(items=[ast.SelectItem(ast.Star())],
+                             table=table)
+            r = qe._select(sub, ctx)
         mats.append({"alias": alias,
                      "cols": dict(zip(r.names,
                                       (np.asarray(c) for c in r.columns))),
@@ -107,6 +129,101 @@ def execute_join_select(qe, sel: ast.Select, ctx) -> QueryResult:
     # ORDER BY may reference unprojected columns: evaluate keys over the
     # full joined namespace, not the projected output
     return _post(sel, r, resolve, env=env_cols)
+
+
+# ---- pushdown helpers ------------------------------------------------------
+
+
+def _split_conjuncts(where):
+    out = []
+
+    def walk(e):
+        if isinstance(e, ast.BinaryOp) and e.op == "and":
+            walk(e.left)
+            walk(e.right)
+        elif e is not None:
+            out.append(e)
+
+    walk(where)
+    return out
+
+
+def _columns_in(e, out: set):
+    if isinstance(e, ast.Column):
+        out.add((e.table, e.name))
+    elif dataclasses.is_dataclass(e) and not isinstance(e, type):
+        for f in dataclasses.fields(e):
+            v = getattr(e, f.name)
+            if isinstance(v, ast.Expr):
+                _columns_in(v, out)
+            elif isinstance(v, (list, tuple)):
+                for x in v:
+                    if isinstance(x, ast.Expr):
+                        _columns_in(x, out)
+
+
+def _only_references(conjunct, alias: str, sides) -> bool:
+    """True iff every column in the conjunct is qualified with `alias` —
+    safe to evaluate inside that side's scan (bare names are left to the
+    post-join filter; qualification is the pushdown opt-in)."""
+    cols: set = set()
+    _columns_in(conjunct, cols)
+    return bool(cols) and all(t == alias for t, _ in cols)
+
+
+def _strip_qualifier(e, alias: str):
+    if isinstance(e, ast.Column):
+        return ast.Column(e.name) if e.table == alias else e
+    if dataclasses.is_dataclass(e) and not isinstance(e, type):
+        changes = {}
+        for f in dataclasses.fields(e):
+            v = getattr(e, f.name)
+            if isinstance(v, ast.Expr):
+                nv = _strip_qualifier(v, alias)
+                if nv is not v:
+                    changes[f.name] = nv
+            elif isinstance(v, (list, tuple)):
+                nv = type(v)(_strip_qualifier(x, alias)
+                             if isinstance(x, ast.Expr) else x for x in v)
+                if nv != v:
+                    changes[f.name] = nv
+        if changes:
+            return dataclasses.replace(e, **changes)
+    return e
+
+
+def _referenced_by_side(sel, sides) -> dict:
+    """alias -> column-name set to project per side, or {} (meaning: no
+    per-side map — project everything) when a Star or any bare (or
+    unattributable) reference appears."""
+    cols: set = set()
+    star = False
+    for it in sel.items:
+        if isinstance(it.expr, ast.Star):
+            star = True
+        else:
+            _columns_in(it.expr, cols)
+    _columns_in(sel.where, cols)
+    for j in sel.joins:
+        _columns_in(j.on, cols)
+    for g in sel.group_by:
+        _columns_in(g, cols)
+    _columns_in(sel.having, cols)
+    for ob in sel.order_by:
+        _columns_in(ob.expr, cols)
+    if star or any(t is None for t, _ in cols):
+        return {}
+    aliases = {alias for _, alias in sides}
+    if any(t not in aliases for t, _ in cols):
+        return {}
+    out: dict = {}
+    for t, c in cols:
+        out.setdefault(t, set()).add(c)
+    # a side nothing references still needs its join keys (covered above
+    # via ON) — and at least one column to materialize row count
+    for _, alias in sides:
+        out.setdefault(alias, set())
+    return out
 
 
 # ---- helpers ---------------------------------------------------------------
@@ -286,8 +403,11 @@ def _aggregate(sel, cols, dtypes, n, resolve) -> QueryResult:
     groups: dict = {}
     if key_arrays:
         for i in range(n):
-            groups.setdefault(
-                tuple(a[i] for a in key_arrays), []).append(i)
+            # NaN is NULL here and NaN != NaN — normalize so all NULL
+            # rows land in ONE group (SQL GROUP BY semantics)
+            key = tuple(None if _is_nan(a[i]) else a[i]
+                        for a in key_arrays)
+            groups.setdefault(key, []).append(i)
     else:
         groups[()] = list(range(n))
 
@@ -352,14 +472,20 @@ def _aggregate(sel, cols, dtypes, n, resolve) -> QueryResult:
     cols_out = [np.asarray([r[i] for r in table_rows], dtype=object)
                 for i in range(len(out_names))] if table_rows else \
         [np.empty(0, dtype=object) for _ in out_names]
-    # tighten numeric dtypes where possible
+    # tighten numeric dtypes: all-int columns (counts) stay integer like
+    # the single-table path; mixed numerics become float64
     tightened = []
     for c in cols_out:
         try:
-            tightened.append(c.astype(np.float64)
-                             if len(c) and all(isinstance(v, (int, float))
-                                               and v is not None
-                                               for v in c) else c)
+            if len(c) and all(isinstance(v, (int, np.integer))
+                              and not isinstance(v, bool) for v in c):
+                tightened.append(c.astype(np.int64))
+            elif len(c) and all(isinstance(v, (int, float, np.floating,
+                                               np.integer))
+                                and v is not None for v in c):
+                tightened.append(c.astype(np.float64))
+            else:
+                tightened.append(c)
         except (TypeError, ValueError):
             tightened.append(c)
     r = QueryResult(out_names, [None] * len(out_names), tightened)
@@ -377,7 +503,19 @@ def _post(sel, r: QueryResult, resolve,
     if sel.order_by:
         for ob in reversed(sel.order_by):
             name = _expr_name(ob.expr)
-            if name in r.names:
+            qualified = isinstance(ob.expr, ast.Column) and ob.expr.table
+            if qualified and f"{ob.expr.table}.{ob.expr.name}" in r.names:
+                # Star projections emit qualified output names
+                col = np.asarray(
+                    r.column(f"{ob.expr.table}.{ob.expr.name}"))[idx]
+            elif qualified and env is not None:
+                # a qualified key must NOT bind to a bare output alias
+                # that happens to share the column's name
+                full = np.asarray(
+                    eval_host(resolve(ob.expr), env, None, None, n))
+                col = np.broadcast_to(full, (n,))[idx] \
+                    if np.ndim(full) == 0 else full[idx]
+            elif name in r.names:
                 col = np.asarray(r.column(name))[idx]
             elif env is not None:
                 full = np.asarray(

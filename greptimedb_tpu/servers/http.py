@@ -133,6 +133,11 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             if path == "/health" or path == "/ready":
                 return self._send(200, {})
+            if path in ("/dashboard", "/dashboard/"):
+                from greptimedb_tpu.servers.dashboard import PAGE
+
+                return self._send(200, PAGE.encode(),
+                                  "text/html; charset=utf-8")
             if path == "/metrics":
                 return self._send(200, REGISTRY.render().encode(),
                                   "text/plain; version=0.0.4")

@@ -121,6 +121,62 @@ class TestThreeWay:
         assert r.rows() == [["a", "us"], ["a", "us"], ["b", "eu"]]
 
 
+class TestReviewRegressions:
+    def test_order_by_qualified_beats_alias_collision(self, db):
+        """ORDER BY dim.X must not bind to a projected alias named X."""
+        db.execute_one(
+            "CREATE TABLE j2 (host STRING, ts TIMESTAMP(3) NOT NULL,"
+            " w DOUBLE, TIME INDEX (ts), PRIMARY KEY (host))")
+        db.execute_one(
+            "INSERT INTO j2 VALUES ('a', 0, 100.0), ('b', 0, 5.0)")
+        r = db.execute_one(
+            "SELECT m.v AS w FROM m JOIN j2 ON m.host = j2.host "
+            "ORDER BY j2.w, m.ts")
+        # j2.w: b(5.0) < a(100.0) -> b's row (10.0) first
+        assert [x[0] for x in r.rows()] == [10.0, 1.0, 3.0]
+
+    def test_group_by_float_nulls_one_group(self, db):
+        db.execute_one(
+            "CREATE TABLE fg (host STRING, ts TIMESTAMP(3) NOT NULL,"
+            " g DOUBLE, TIME INDEX (ts), PRIMARY KEY (host))")
+        db.execute_one(
+            "INSERT INTO fg VALUES ('a', 0, NULL), ('a', 1, NULL),"
+            " ('a', 2, 7.0)")
+        r = db.execute_one(
+            "SELECT fg.g, count(*) FROM fg JOIN dim ON fg.host = dim.host "
+            "GROUP BY fg.g ORDER BY fg.g")
+        assert r.rows() == [[7.0, 1], [None, 2]]
+
+    def test_count_stays_integer(self, db):
+        r = db.execute_one(
+            "SELECT count(*) FROM m JOIN dim ON m.host = dim.host")
+        v = r.rows()[0][0]
+        assert v == 3 and isinstance(v, int)
+
+    def test_infoschema_join(self, db):
+        r = db.execute_one(
+            "SELECT t.table_name, e.support "
+            "FROM information_schema.tables t "
+            "JOIN information_schema.engines e ON t.engine = e.engine "
+            "WHERE t.table_name = 'm'")
+        assert r.num_rows == 1
+        assert r.rows()[0][0] == "m"
+
+    def test_where_pushdown_correctness(self, db):
+        """Qualified single-side conjuncts push into the side scan; the
+        result must equal the unpushed evaluation."""
+        r = db.execute_one(
+            "SELECT m.host, m.v, dim.dc FROM m JOIN dim "
+            "ON m.host = dim.host "
+            "WHERE m.v > 1 AND dim.dc = 'east' AND m.ts >= 1000")
+        assert r.rows() == [["a", 3.0, "east"]]
+        # LEFT JOIN + right-side predicate == inner-join semantics
+        r = db.execute_one(
+            "SELECT m.host FROM m LEFT JOIN dim ON m.host = dim.host "
+            "WHERE dim.dc = 'west'")
+        assert r.rows() == [["b"]]
+
+
 class TestOracleRandomized:
     def test_against_pandas(self, tmp_path):
         rng = np.random.default_rng(3)
