@@ -42,10 +42,15 @@ def execute_join_select(qe, sel: ast.Select, ctx) -> QueryResult:
     # join planning)
     conjuncts = _split_conjuncts(sel.where)
     side_cols = _referenced_by_side(sel, sides)
+    # the null-supplying side of an outer join must NOT have WHERE
+    # conjuncts pushed into its scan: `WHERE right.x IS NULL` (anti-join)
+    # would drop the very rows whose absence produces the NULLs
+    unpushable = {j.alias or j.table for j in sel.joins if j.kind == "left"}
     mats = []
     for table, alias in sides:
-        pushed = [_strip_qualifier(c, alias) for c in conjuncts
-                  if _only_references(c, alias, sides)]
+        pushed = [] if alias in unpushable else \
+            [_strip_qualifier(c, alias) for c in conjuncts
+             if _only_references(c, alias, sides)]
         where = None
         for p in pushed:
             where = p if where is None else ast.BinaryOp("and", where, p)
@@ -151,15 +156,16 @@ def _split_conjuncts(where):
 def _columns_in(e, out: set):
     if isinstance(e, ast.Column):
         out.add((e.table, e.name))
+    elif isinstance(e, (list, tuple)):
+        # descends into nested containers too — Case.whens is a tuple of
+        # (when_expr, then_expr) tuples
+        for x in e:
+            _columns_in(x, out)
     elif dataclasses.is_dataclass(e) and not isinstance(e, type):
         for f in dataclasses.fields(e):
             v = getattr(e, f.name)
-            if isinstance(v, ast.Expr):
+            if isinstance(v, (ast.Expr, list, tuple)):
                 _columns_in(v, out)
-            elif isinstance(v, (list, tuple)):
-                for x in v:
-                    if isinstance(x, ast.Expr):
-                        _columns_in(x, out)
 
 
 def _only_references(conjunct, alias: str, sides) -> bool:
@@ -172,24 +178,8 @@ def _only_references(conjunct, alias: str, sides) -> bool:
 
 
 def _strip_qualifier(e, alias: str):
-    if isinstance(e, ast.Column):
-        return ast.Column(e.name) if e.table == alias else e
-    if dataclasses.is_dataclass(e) and not isinstance(e, type):
-        changes = {}
-        for f in dataclasses.fields(e):
-            v = getattr(e, f.name)
-            if isinstance(v, ast.Expr):
-                nv = _strip_qualifier(v, alias)
-                if nv is not v:
-                    changes[f.name] = nv
-            elif isinstance(v, (list, tuple)):
-                nv = type(v)(_strip_qualifier(x, alias)
-                             if isinstance(x, ast.Expr) else x for x in v)
-                if nv != v:
-                    changes[f.name] = nv
-        if changes:
-            return dataclasses.replace(e, **changes)
-    return e
+    return _rewrite_columns(
+        e, lambda c: ast.Column(c.name) if c.table == alias else c)
 
 
 def _referenced_by_side(sel, sides) -> dict:
@@ -235,41 +225,47 @@ def _qualify(mat):
     return cols, dtypes
 
 
-def _resolve_columns(e, cols: dict):
-    """Rewrite Column nodes to the joined namespace: alias-qualified
-    references become 'alias.col'; bare names must be unambiguous."""
+def _rewrite_columns(e, repl):
+    """Apply `repl` to every Column node, descending dataclass fields AND
+    nested containers (Case.whens is a tuple of (when, then) tuples)."""
     if isinstance(e, ast.Column):
-        if e.table:
-            q = f"{e.table}.{e.name}"
-            if q not in cols:
-                raise PlanError(f"unknown column {q!r} in join")
-            return ast.Column(q)
-        if e.name in cols:
-            return e
-        matches = [q for q in cols
-                   if "." in q and q.split(".", 1)[1] == e.name]
-        if len(matches) == 1:
-            return ast.Column(matches[0])
-        if len(matches) > 1:
-            raise PlanError(f"ambiguous column {e.name!r}: {matches}")
-        raise PlanError(f"unknown column {e.name!r} in join")
+        return repl(e)
+    if isinstance(e, (list, tuple)):
+        return type(e)(_rewrite_columns(x, repl) for x in e)
     if dataclasses.is_dataclass(e) and not isinstance(e, type):
         changes = {}
         for f in dataclasses.fields(e):
             v = getattr(e, f.name)
-            if isinstance(v, ast.Expr):
-                nv = _resolve_columns(v, cols)
-                if nv is not v:
-                    changes[f.name] = nv
-            elif isinstance(v, (list, tuple)):
-                nv = type(v)(
-                    _resolve_columns(x, cols) if isinstance(x, ast.Expr)
-                    else x for x in v)
+            if isinstance(v, (ast.Expr, list, tuple)):
+                nv = _rewrite_columns(v, repl)
                 if nv != v:
                     changes[f.name] = nv
         if changes:
             return dataclasses.replace(e, **changes)
     return e
+
+
+def _resolve_columns(e, cols: dict):
+    """Rewrite Column nodes to the joined namespace: alias-qualified
+    references become 'alias.col'; bare names must be unambiguous."""
+
+    def repl(c: ast.Column):
+        if c.table:
+            q = f"{c.table}.{c.name}"
+            if q not in cols:
+                raise PlanError(f"unknown column {q!r} in join")
+            return ast.Column(q)
+        if c.name in cols:
+            return c
+        matches = [q for q in cols
+                   if "." in q and q.split(".", 1)[1] == c.name]
+        if len(matches) == 1:
+            return ast.Column(matches[0])
+        if len(matches) > 1:
+            raise PlanError(f"ambiguous column {c.name!r}: {matches}")
+        raise PlanError(f"unknown column {c.name!r} in join")
+
+    return _rewrite_columns(e, repl)
 
 
 def _equi_pairs(on, left_cols: dict, right_cols: dict):

@@ -28,7 +28,12 @@ import numpy as np
 from greptimedb_tpu.datatypes.types import DataType, SemanticType
 from greptimedb_tpu.ops.blocks import DEFAULT_BLOCK_ROWS, block_size_for, make_mask, pad_rows
 from greptimedb_tpu.ops.dedup import sort_dedup
-from greptimedb_tpu.ops.segment import combine_group_ids, segment_agg
+from greptimedb_tpu.ops.segment import (
+    _type_max as _seg_type_max,
+    _type_min as _seg_type_min,
+    combine_group_ids,
+    segment_agg,
+)
 from greptimedb_tpu.query import logical as lp
 from greptimedb_tpu.query.expr import (
     BindContext,
@@ -197,11 +202,14 @@ def _agg_scan_prepared(
     passes the general kernel needs (those dominated the profile: a
     masked segment-sum costs ~4x the plain one on this shape).
 
-    Plane layout: [vals0 | valid | ones] (width 2F+1) when any NaN is
-    present; [vals | ones] (width F+1) for NaN-free scans, where every
-    field's count equals the row count."""
+    Plane layouts (all query-invariant, per reduction class):
+    - "__prep__"     [vals0 | valid | ones] (2F+1 with NaNs, F+1 without)
+      reduced with segment-sum — feeds sum/count/mean/rows
+    - "__prep_min__" vals with NaN -> +inf, reduced with segment-min
+    - "__prep_max__" vals with NaN -> -inf, reduced with segment-max
+    Empty/all-NULL groups come back as +/-inf and convert to NULL."""
     G = num_segments
-    total = None
+    total = tmin = tmax = None
     for i, cols in enumerate(blocks):
         plane = cols["__prep__"]
         mask = jnp.arange(plane.shape[0]) < n_valids[i]
@@ -214,6 +222,14 @@ def _agg_scan_prepared(
         ids = jnp.where(mask, gid, jnp.int32(G))
         part = jax.ops.segment_sum(plane, ids, num_segments=G + 1)[:G]
         total = part if total is None else total + part
+        if "__prep_min__" in cols:
+            p = jax.ops.segment_min(cols["__prep_min__"], ids,
+                                    num_segments=G + 1)[:G]
+            tmin = p if tmin is None else jnp.minimum(tmin, p)
+        if "__prep_max__" in cols:
+            p = jax.ops.segment_max(cols["__prep_max__"], ids,
+                                    num_segments=G + 1)[:G]
+            tmax = p if tmax is None else jnp.maximum(tmax, p)
     sums = total[:, :nf]
     if has_nan:
         cnts = total[:, nf:2 * nf]
@@ -229,6 +245,14 @@ def _agg_scan_prepared(
             acc[k] = cnts
         elif k == "rows":
             acc[k] = rows
+        elif k == "min":
+            # sentinel semantics identical to segment_agg: fills are the
+            # dtype extremes (not inf — real infinities must survive)
+            big = _seg_type_max(tmin.dtype)
+            acc[k] = jnp.where(tmin == big, jnp.nan, tmin)
+        elif k == "max":
+            small = _seg_type_min(tmax.dtype)
+            acc[k] = jnp.where(tmax == small, jnp.nan, tmax)
         else:  # mean — same NULL semantics as segment_agg
             denom = jnp.maximum(cnts, 1.0)
             acc[k] = jnp.where(cnts > 0, sums / denom, jnp.nan)
@@ -1148,6 +1172,14 @@ class PhysicalExecutor:
                     )
                 cols["__prep__"] = self._prep_plane(
                     scan, arg_names, start, end, block, acc_dtype, has_nan)
+                if "min" in ops:
+                    cols["__prep_min__"] = self._prep_extreme_plane(
+                        scan, arg_names, start, end, block, acc_dtype,
+                        "min")
+                if "max" in ops:
+                    cols["__prep_max__"] = self._prep_extreme_plane(
+                        scan, arg_names, start, end, block, acc_dtype,
+                        "max")
                 blocks.append(cols)
                 n_valids.append(end - start)
                 if dmasks is not None:
@@ -1307,7 +1339,7 @@ class PhysicalExecutor:
         min/max/sumsq need per-element masking the plane can't encode)."""
         if int_ops or not arg_exprs:
             return False
-        if not set(ops) <= {"mean", "sum", "count", "rows"}:
+        if not set(ops) <= {"mean", "sum", "count", "rows", "min", "max"}:
             return False
         field_names = {c.name for c in schema.field_columns}
         return all(
@@ -1363,6 +1395,30 @@ class PhysicalExecutor:
             return build()
         key = (scan.region_id, scan.data_version, scan.scan_fingerprint,
                "__prep__", arg_names, start, block, str(acc_dtype), has_nan)
+        return self.cache.get(key, build)
+
+    def _prep_extreme_plane(self, scan, arg_names, start, end, block,
+                            acc_dtype, kind: str):
+        """min/max companion plane: values with NaN (and padding) replaced
+        by the reduction's identity, so the dead-segment id trick is the
+        only masking the query needs."""
+
+        def build():
+            f = len(arg_names)
+            np_acc = np.dtype(str(acc_dtype))
+            fill = np.inf if kind == "min" else -np.inf
+            plane = np.full((block, f), fill, dtype=np_acc)
+            m = end - start
+            for j, name in enumerate(arg_names):
+                src = np.asarray(scan.columns[name][start:end],
+                                 dtype=np.float64)
+                plane[:m, j] = np.where(np.isnan(src), fill, src)
+            return jnp.asarray(plane)
+
+        if scan.region_id < 0:
+            return build()
+        key = (scan.region_id, scan.data_version, scan.scan_fingerprint,
+               f"__prep_{kind}__", arg_names, start, block, str(acc_dtype))
         return self.cache.get(key, build)
 
     def _device_columns(self, scan, bound_where, keys, arg_exprs, ts_name, extra_cols):

@@ -177,6 +177,26 @@ class TestReviewRegressions:
         assert r.rows() == [["b"]]
 
 
+class TestOuterJoinPushdown:
+    def test_anti_join_is_null(self, db):
+        """WHERE right.x IS NULL on a LEFT JOIN (anti-join) must return
+        only unmatched left rows — pushdown into the null-supplying side
+        is forbidden (code-review regression)."""
+        r = db.execute_one(
+            "SELECT m.host FROM m LEFT JOIN dim ON m.host = dim.host "
+            "WHERE dim.dc IS NULL")
+        assert r.rows() == [["c"]]
+
+    def test_case_when_columns_survive_pruning(self, db):
+        """Projection pruning must see columns inside CASE WHEN
+        (code-review regression)."""
+        r = db.execute_one(
+            "SELECT CASE WHEN m.v > 1.5 THEN 'hi' ELSE 'lo' END AS lvl, "
+            "dim.dc FROM m JOIN dim ON m.host = dim.host "
+            "ORDER BY m.v")
+        assert [x[0] for x in r.rows()] == ["lo", "hi", "hi"]
+
+
 class TestOracleRandomized:
     def test_against_pandas(self, tmp_path):
         rng = np.random.default_rng(3)

@@ -162,3 +162,50 @@ class TestSparseGroupby:
         assert set(got) == set(oracle)
         for k in oracle:
             np.testing.assert_allclose(got[k], oracle[k], rtol=1e-12)
+
+
+class TestPreparedPath:
+    """dense_prepared fast path: eligibility + equivalence with the
+    general kernel (sum/count/mean/rows/min/max over field columns)."""
+
+    def test_prepared_matches_general(self, tmp_path):
+        import numpy as np
+
+        from greptimedb_tpu.catalog import Catalog, MemoryKv
+        from greptimedb_tpu.query import QueryEngine
+        from greptimedb_tpu.storage import RegionEngine
+        from greptimedb_tpu.storage.engine import EngineConfig
+
+        engine = RegionEngine(EngineConfig(data_dir=str(tmp_path)))
+        qe = QueryEngine(Catalog(MemoryKv()), engine)
+        qe.execute_one(
+            "CREATE TABLE t (h STRING, ts TIMESTAMP(3) NOT NULL,"
+            " a DOUBLE, TIME INDEX (ts), PRIMARY KEY (h))")
+        rows = []
+        rng = np.random.default_rng(2)
+        for i in range(800):
+            a = "NULL" if i % 9 == 0 else round(rng.uniform(-10, 10), 3)
+            rows.append(f"('h{i % 7}', {i}, {a})")
+        qe.execute_one("INSERT INTO t VALUES " + ", ".join(rows))
+        sql = ("SELECT h, sum(a), count(a), avg(a), min(a), max(a), "
+               "count(*) FROM t GROUP BY h ORDER BY h")
+        r1 = qe.execute_one(sql)
+        assert qe.executor.last_path == "dense_prepared"
+        orig = qe.executor._prepared_ok
+        qe.executor._prepared_ok = lambda *a, **k: False
+        try:
+            r2 = qe.execute_one(sql)
+            assert qe.executor.last_path == "dense"
+        finally:
+            qe.executor._prepared_ok = orig
+        for name, c1, c2 in zip(r1.names, r1.columns, r2.columns):
+            if np.asarray(c1).dtype == object:
+                assert list(c1) == list(c2), name
+            else:
+                np.testing.assert_allclose(
+                    np.asarray(c1, float), np.asarray(c2, float),
+                    rtol=1e-12, err_msg=name)
+        # expression args are NOT eligible (general path handles them)
+        qe.execute_one("SELECT h, sum(a * 2) FROM t GROUP BY h")
+        assert qe.executor.last_path == "dense"
+        engine.close()
