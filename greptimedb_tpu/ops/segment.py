@@ -101,9 +101,19 @@ def segment_agg(
     out: dict[str, jax.Array] = {}
     need_sum = any(o in ops for o in ("sum", "mean"))
     need_count = any(o in ops for o in ("count", "mean"))
+    # variance = (sumsq - sum^2/n) is catastrophically cancellation-prone:
+    # both moments must carry ~2x the data's precision for the subtraction
+    # to survive, so sumsq (and the sum it is differenced against) always
+    # accumulate in f64 — even on the f32 TPU fast path, where only
+    # stddev/variance queries pay the emulation cost
+    moment_vals = values
+    if "sumsq" in ops and jnp.issubdtype(values.dtype, jnp.floating) \
+            and values.dtype != jnp.float64:
+        moment_vals = values.astype(jnp.float64)
     sums = counts = None
-    if need_sum:
-        sums = seg_sum(jnp.where(elem_mask, values, 0).astype(values.dtype))
+    if need_sum or "sumsq" in ops:
+        sums = seg_sum(
+            jnp.where(elem_mask, moment_vals, 0).astype(moment_vals.dtype))
     if need_count:
         # int32: exact per-block (block rows << 2^31); cross-block combine
         # upcasts to int64
@@ -116,13 +126,12 @@ def segment_agg(
         # [G, 1]: per-group, not per-field
         out["rows"] = seg_sum(row_mask.astype(jnp.int32)[:, None])
     if "sumsq" in ops:
-        # NOTE: textbook sum-of-squares is cancellation-prone; acceptable in
-        # f64, but the f32 TPU fast path needs a mean-offset/Welford kernel
-        # before stddev/variance ride it.
-        out["sumsq"] = seg_sum(jnp.where(elem_mask, values * values, 0).astype(values.dtype))
+        out["sumsq"] = seg_sum(
+            jnp.where(elem_mask, moment_vals * moment_vals, 0)
+            .astype(moment_vals.dtype))
     if "mean" in ops:
         denom = jnp.maximum(counts, 1).astype(values.dtype)
-        mean = sums / denom
+        mean = sums.astype(values.dtype) / denom
         out["mean"] = jnp.where(counts > 0, mean, jnp.nan)
     if "min" in ops:
         big = _type_max(values.dtype)

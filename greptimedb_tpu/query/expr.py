@@ -289,6 +289,10 @@ def eval_device(e: ast.Expr, cols: dict, ctx_tags: frozenset, schema: Schema):
             res = jnp.isnan(v) if jnp.issubdtype(v.dtype, jnp.floating) else jnp.zeros(v.shape, bool)
         return ~res if e.negated else res
     if isinstance(e, ast.FuncCall):
+        if e.order_within is not None:
+            raise PlanError(
+                f"ORDER BY inside {e.name}() is only supported for "
+                "first_value/last_value")
         return _eval_device_func(e, ev, cols, schema)
     if isinstance(e, ast.Cast):
         v = ev(e.expr)
@@ -510,6 +514,10 @@ def eval_host(
             res = np.zeros(v.shape, bool)
         return ~res if e.negated else res
     if isinstance(e, ast.FuncCall):
+        if e.order_within is not None:
+            raise PlanError(
+                f"ORDER BY inside {e.name}() is only supported for "
+                "first_value/last_value")
         return _eval_host_func(e, ev, schema)
     if isinstance(e, ast.Cast):
         v = ev(e.expr)

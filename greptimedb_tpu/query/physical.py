@@ -246,8 +246,10 @@ def _agg_scan_prepared(
         elif k == "rows":
             acc[k] = rows
         elif k == "min":
-            # sentinel semantics identical to segment_agg: fills are the
-            # dtype extremes (not inf — real infinities must survive)
+            # sentinel semantics identical to segment_agg: floats fill
+            # with +/-inf, so an all-+inf group reads as NULL (a known,
+            # shared limitation) and jax's +inf empty-segment fill is
+            # covered by the same comparison
             big = _seg_type_max(tmin.dtype)
             acc[k] = jnp.where(tmin == big, jnp.nan, tmin)
         elif k == "max":
@@ -1130,6 +1132,10 @@ class PhysicalExecutor:
         pack_dtype = jnp.dtype(jnp.float64) if num_groups <= 4096 else acc_dtype
         if not jnp.issubdtype(pack_dtype, jnp.floating):
             pack_dtype = jnp.dtype(jnp.float64)
+        if "sumsq" in float_ops:
+            # f32 packing would destroy the precision the f64 moment
+            # accumulation just preserved (see segment_agg)
+            pack_dtype = jnp.dtype(jnp.float64)
 
         from greptimedb_tpu.parallel.mesh import COLLECTIVE_OPS
 
@@ -1335,8 +1341,9 @@ class PhysicalExecutor:
     def _prepared_ok(self, arg_exprs, ops, int_ops, schema,
                      extra_cols) -> bool:
         """Eligibility for the prepared dense path: plain float/int FIELD
-        columns aggregated with sum/count/mean/rows only (first/last/
-        min/max/sumsq need per-element masking the plane can't encode)."""
+        columns aggregated with sum/count/mean/rows/min/max (min/max ride
+        the __prep_min__/__prep_max__ identity-filled planes; first/last/
+        sumsq still need per-element masking the planes can't encode)."""
         if int_ops or not arg_exprs:
             return False
         if not set(ops) <= {"mean", "sum", "count", "rows", "min", "max"}:

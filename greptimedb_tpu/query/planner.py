@@ -130,6 +130,21 @@ def plan_select(sel: ast.Select, table: TableInfo) -> lp.LogicalPlan:
                             "approx_percentile_cont fraction must be in [0, 1]")
                     p *= 100.0
                 extra = (p,)
+            if call.order_within is not None:
+                oexpr, asc = call.order_within
+                if func not in ("first", "last"):
+                    raise PlanError(
+                        f"ORDER BY inside {call.name}() is only supported "
+                        "for first_value/last_value")
+                if not (isinstance(oexpr, ast.Column)
+                        and oexpr.table is None
+                        and oexpr.name == schema.time_index.name):
+                    raise PlanError(
+                        f"{call.name}(... ORDER BY x): only the time "
+                        f"index {schema.time_index.name!r} is supported")
+                if not asc:
+                    # last-by-descending-time IS the chronological first
+                    func = "first" if func == "last" else "last"
             specs.append(lp.AggSpec(_default_name(call), func, arg, call,
                                     extra_args=extra))
         plan = lp.Aggregate(plan, keys, specs)

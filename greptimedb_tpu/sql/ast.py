@@ -49,6 +49,9 @@ class FuncCall(Expr):
     name: str  # lowercased
     args: tuple[Expr, ...] = ()
     distinct: bool = False
+    # `agg(x ORDER BY col [ASC|DESC])` — (col_expr, asc); used by
+    # first_value/last_value (DataFusion / TSBS lastpoint syntax)
+    order_within: Optional[tuple] = None
 
 
 @dataclass(frozen=True)

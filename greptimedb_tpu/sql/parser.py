@@ -896,11 +896,25 @@ class Parser:
                     return ast.FuncCall(name.lower(), (ast.Star(),))
                 distinct = self.eat_kw("distinct")
                 args: list[ast.Expr] = []
+                order_within = None
                 while not self.at_op(")"):
+                    if self.eat_kw("order"):
+                        # agg(x ORDER BY col [ASC|DESC]) — DataFusion /
+                        # TSBS lastpoint first_value/last_value syntax
+                        self.expect_kw("by")
+                        oexpr = self.parse_expr()
+                        asc = True
+                        if self.eat_kw("desc"):
+                            asc = False
+                        else:
+                            self.eat_kw("asc")
+                        order_within = (oexpr, asc)
+                        break
                     args.append(self.parse_expr())
                     self.eat_op(",")
                 self.expect_op(")")
-                return ast.FuncCall(name.lower(), tuple(args), distinct)
+                return ast.FuncCall(name.lower(), tuple(args), distinct,
+                                    order_within=order_within)
             if self.at_op("."):
                 self.next()
                 col = self.ident()

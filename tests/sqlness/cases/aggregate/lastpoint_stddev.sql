@@ -1,0 +1,12 @@
+-- TSBS lastpoint shape (last_value ORDER BY) and stddev/variance
+CREATE TABLE cpu (host STRING, u DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(host));
+
+INSERT INTO cpu VALUES ('a', 1.0, 1000), ('a', 3.0, 3000), ('b', 10.0, 1000), ('b', 20.0, 2000);
+
+SELECT host, last_value(u ORDER BY ts) FROM cpu GROUP BY host ORDER BY host;
+
+SELECT host, last_value(u ORDER BY ts DESC) FROM cpu GROUP BY host ORDER BY host;
+
+SELECT host, first_value(u) FROM cpu GROUP BY host ORDER BY host;
+
+SELECT host, variance(u), stddev(u) FROM cpu GROUP BY host ORDER BY host;
