@@ -349,6 +349,114 @@ def _agg_scan_sharded(
     return step(cols, base_mask)
 
 
+def _build_prep(scan, arg_names, start, end, out_rows, acc_dtype, has_nan,
+                kind) -> np.ndarray:
+    """THE prepared-plane builder — rows [start, end) of the scan into a
+    plane of `out_rows` rows (the single source of truth for the layout;
+    the dense per-block and sharded whole-scan paths both call it).
+
+    kind None -> the sum/count plane: [vals0 | valid | ones] (2F+1) with
+    NaNs present, [vals | ones] (F+1) without. kind "min"/"max" ->
+    identity-filled value planes for segment-min/max. Padding rows are
+    excluded by the base mask; extreme planes still get the identity
+    fill there for safety."""
+    f = len(arg_names)
+    m = end - start
+    np_acc = np.dtype(str(acc_dtype))
+    if kind is None:
+        width = (2 * f + 1) if has_nan else (f + 1)
+        plane = np.zeros((out_rows, width), dtype=np_acc)
+        for j, name in enumerate(arg_names):
+            src = np.asarray(scan.columns[name][start:end],
+                             dtype=np.float64)
+            if has_nan:
+                nan = np.isnan(src)
+                plane[:m, j] = np.where(nan, 0.0, src)
+                plane[:m, f + j] = ~nan
+            else:
+                plane[:m, j] = src
+        plane[:m, width - 1] = 1.0
+        return plane
+    fill = np.inf if kind == "min" else -np.inf
+    plane = np.full((out_rows, f), fill, dtype=np_acc)
+    for j, name in enumerate(arg_names):
+        src = np.asarray(scan.columns[name][start:end], dtype=np.float64)
+        plane[:m, j] = np.where(np.isnan(src), fill, src)
+    return plane
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("mesh", "where", "keys", "nf", "has_nan",
+                     "num_segments", "tag_names", "schema", "float_ops",
+                     "pack_dtype"),
+)
+def _agg_scan_sharded_prepared(
+    cols: dict,  # sharded cols incl. "__prep__" (+ optional min/max planes)
+    base_mask: jax.Array,
+    *,
+    mesh, where, keys, nf, has_nan, num_segments, tag_names, schema,
+    float_ops, pack_dtype,
+):
+    """Sharded twin of _agg_scan_prepared: each shard reduces its slice of
+    the cached planes with the dead-segment id trick, then partials ride
+    ICI (psum/pmin/pmax) — the multi-chip MergeScan with none of the
+    per-query [N, F] masking passes."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    G = num_segments
+    in_specs = ({k: P("shard") for k in cols}, P("shard"))
+
+    @functools.partial(shard_map, mesh=mesh, in_specs=in_specs,
+                       out_specs=P(), check_vma=False)
+    def step(local_cols, local_mask):
+        plane = local_cols["__prep__"]
+        mask = local_mask
+        if where is not None:
+            w = eval_device(where, local_cols, tag_names, schema)
+            mask = mask & (w if w.dtype == jnp.bool_ else w != 0)
+        gid = _group_ids(local_cols, keys, plane.shape[0])
+        ids = jnp.where(mask, gid, jnp.int32(G))
+        total = jax.lax.psum(
+            jax.ops.segment_sum(plane, ids, num_segments=G + 1)[:G],
+            "shard")
+        sums = total[:, :nf]
+        if has_nan:
+            cnts = total[:, nf:2 * nf]
+            rows = total[:, 2 * nf:2 * nf + 1]
+        else:
+            rows = total[:, nf:nf + 1]
+            cnts = jnp.broadcast_to(rows, (G, nf))
+        acc: dict[str, jax.Array] = {}
+        for k in float_ops:
+            if k == "sum":
+                acc[k] = sums
+            elif k == "count":
+                acc[k] = cnts
+            elif k == "rows":
+                acc[k] = rows
+            elif k == "min":
+                tmin = jax.lax.pmin(
+                    jax.ops.segment_min(local_cols["__prep_min__"], ids,
+                                        num_segments=G + 1)[:G], "shard")
+                big = _seg_type_max(tmin.dtype)
+                acc[k] = jnp.where(tmin == big, jnp.nan, tmin)
+            elif k == "max":
+                tmax = jax.lax.pmax(
+                    jax.ops.segment_max(local_cols["__prep_max__"], ids,
+                                        num_segments=G + 1)[:G], "shard")
+                small = _seg_type_min(tmax.dtype)
+                acc[k] = jnp.where(tmax == small, jnp.nan, tmax)
+            else:
+                denom = jnp.maximum(cnts, 1.0)
+                acc[k] = jnp.where(cnts > 0, sums / denom, jnp.nan)
+        return jnp.concatenate(
+            [acc[k].astype(pack_dtype) for k in float_ops], axis=1)
+
+    return step(cols, base_mask)
+
+
 class _NotStreamable(Exception):
     """Query shape the streaming path can't serve (generic group keys,
     host-side order statistics); caller falls back to the materialized
@@ -1291,8 +1399,13 @@ class PhysicalExecutor:
         n_shard = mesh.shape["shard"]
         n_pad = ((n + n_shard - 1) // n_shard) * n_shard
         sharding = NamedSharding(mesh, P("shard"))
+        prepared = self._prepared_ok(arg_exprs, ops, (), schema, extra_cols)
+        names = device_col_names
+        if prepared:
+            names = self._device_columns(scan, bound_where, keys, (),
+                                         ts_name, extra_cols)
         cols = {}
-        for name in device_col_names:
+        for name in names:
             cast = acc_dtype if name in float_fields else None
 
             def build(name=name, cast=cast):
@@ -1314,6 +1427,35 @@ class PhysicalExecutor:
         if dedup_mask is not None:
             base[:n] &= np.asarray(dedup_mask)[:n]
         base_s = jax.device_put(base, sharding)
+        if prepared:
+            self.last_path = "sharded_prepared"
+            arg_names = tuple(a.name for a in arg_exprs)
+            has_nan = self._scan_has_nan(scan, arg_names)
+            nf = len(arg_names)
+            plane_kinds = [("__prep__", None)]
+            if "min" in ops:
+                plane_kinds.append(("__prep_min__", "min"))
+            if "max" in ops:
+                plane_kinds.append(("__prep_max__", "max"))
+            for plane_name, kind in plane_kinds:
+                def build_plane(kind=kind):
+                    whole = _build_prep(scan, arg_names, 0, n, n_pad,
+                                        acc_dtype, has_nan, kind)
+                    return jax.device_put(whole, sharding)
+
+                if scan.region_id < 0:
+                    cols[plane_name] = build_plane()
+                else:
+                    key = (scan.region_id, scan.data_version,
+                           scan.scan_fingerprint, plane_name, arg_names,
+                           "sharded", n_pad, n_shard, str(acc_dtype),
+                           has_nan)
+                    cols[plane_name] = self.cache.get(key, build_plane)
+            return _agg_scan_sharded_prepared(
+                cols, base_s, mesh=mesh, where=bound_where, keys=keys,
+                nf=nf, has_nan=has_nan, num_segments=num_groups,
+                tag_names=tag_names, schema=schema, float_ops=float_ops,
+                pack_dtype=pack_dtype)
         return _agg_scan_sharded(
             cols, base_s, mesh=mesh, where=bound_where, keys=keys,
             agg_args=arg_exprs, ops=ops, num_segments=num_groups,
@@ -1377,26 +1519,11 @@ class PhysicalExecutor:
     def _prep_plane(self, scan, arg_names, start, end, block, acc_dtype,
                     has_nan: bool):
         """Query-invariant value plane for the prepared path, cached in
-        HBM alongside the raw column blocks. NaN-free scans use the
-        narrow [vals | ones] layout (half the bytes)."""
+        HBM alongside the raw column blocks (layout: _build_prep)."""
 
         def build():
-            f = len(arg_names)
-            np_acc = np.dtype(str(acc_dtype))
-            width = (2 * f + 1) if has_nan else (f + 1)
-            plane = np.zeros((block, width), dtype=np_acc)
-            m = end - start
-            for j, name in enumerate(arg_names):
-                src = np.asarray(scan.columns[name][start:end],
-                                 dtype=np.float64)
-                if has_nan:
-                    nan = np.isnan(src)
-                    plane[:m, j] = np.where(nan, 0.0, src)
-                    plane[:m, f + j] = ~nan
-                else:
-                    plane[:m, j] = src
-            plane[:m, width - 1] = 1.0
-            return jnp.asarray(plane)
+            return jnp.asarray(_build_prep(scan, arg_names, start, end,
+                                           block, acc_dtype, has_nan, None))
 
         if scan.region_id < 0:
             return build()
@@ -1411,16 +1538,8 @@ class PhysicalExecutor:
         only masking the query needs."""
 
         def build():
-            f = len(arg_names)
-            np_acc = np.dtype(str(acc_dtype))
-            fill = np.inf if kind == "min" else -np.inf
-            plane = np.full((block, f), fill, dtype=np_acc)
-            m = end - start
-            for j, name in enumerate(arg_names):
-                src = np.asarray(scan.columns[name][start:end],
-                                 dtype=np.float64)
-                plane[:m, j] = np.where(np.isnan(src), fill, src)
-            return jnp.asarray(plane)
+            return jnp.asarray(_build_prep(scan, arg_names, start, end,
+                                           block, acc_dtype, False, kind))
 
         if scan.region_id < 0:
             return build()

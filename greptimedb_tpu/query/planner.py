@@ -137,7 +137,7 @@ def plan_select(sel: ast.Select, table: TableInfo) -> lp.LogicalPlan:
                         f"ORDER BY inside {call.name}() is only supported "
                         "for first_value/last_value")
                 if not (isinstance(oexpr, ast.Column)
-                        and oexpr.table is None
+                        and oexpr.table in (None, table.name)
                         and oexpr.name == schema.time_index.name):
                     raise PlanError(
                         f"{call.name}(... ORDER BY x): only the time "
@@ -164,6 +164,13 @@ def _default_name(e: ast.Expr) -> str:
         return e.name
     if isinstance(e, ast.FuncCall):
         args = ",".join(_default_name(a) for a in e.args)
+        if e.order_within is not None:
+            # the ORDER BY variant must not share a name with (and thus
+            # silently shadow) the plain aggregate in the projection
+            oexpr, asc = e.order_within
+            direction = "" if asc else " desc"
+            return (f"{e.name}({args} order by "
+                    f"{_default_name(oexpr)}{direction})")
         return f"{e.name}({args})"
     if isinstance(e, ast.Literal):
         return str(e.value)
@@ -203,7 +210,7 @@ def _substitute_aliases(e: Optional[ast.Expr], alias_map) -> Optional[ast.Expr]:
         return ast.UnaryOp(e.op, _substitute_aliases(e.operand, alias_map))
     if isinstance(e, ast.FuncCall):
         return ast.FuncCall(e.name, tuple(_substitute_aliases(a, alias_map) for a in e.args),
-                            e.distinct)
+                            e.distinct, order_within=e.order_within)
     if isinstance(e, ast.Between):
         return ast.Between(_substitute_aliases(e.expr, alias_map),
                            _substitute_aliases(e.low, alias_map),

@@ -190,7 +190,8 @@ def _subst_calls(e: ast.Expr, subst: dict) -> ast.Expr:
         return ast.UnaryOp(e.op, _subst_calls(e.operand, subst))
     if isinstance(e, ast.FuncCall):
         return ast.FuncCall(
-            e.name, tuple(_subst_calls(a, subst) for a in e.args), e.distinct)
+            e.name, tuple(_subst_calls(a, subst) for a in e.args),
+            e.distinct, order_within=e.order_within)
     if isinstance(e, ast.Cast):
         return ast.Cast(_subst_calls(e.expr, subst), e.type_name)
     return e

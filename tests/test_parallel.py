@@ -143,3 +143,49 @@ class TestEngineMeshIntegration:
         r = db.execute_one(
             "SELECT host, last(usage) FROM cpu GROUP BY host ORDER BY host")
         assert len(r.rows()) == 8
+
+
+class TestShardedPrepared:
+    """The prepared-plane fast path on the mesh (sharded_prepared):
+    cached planes sharded over ICI, partials combined with
+    psum/pmin/pmax — must match the single-device result exactly."""
+
+    def test_sharded_prepared_matches_dense(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("GREPTIMEDB_TPU_MESH_MIN_ROWS", "1")
+        from greptimedb_tpu.catalog import Catalog, MemoryKv
+        from greptimedb_tpu.query import QueryEngine
+        from greptimedb_tpu.storage import RegionEngine
+        from greptimedb_tpu.storage.engine import EngineConfig
+
+        engine = RegionEngine(EngineConfig(data_dir=str(tmp_path)))
+        qe = QueryEngine(Catalog(MemoryKv()), engine)
+        qe.execute_one(
+            "CREATE TABLE t (h STRING, ts TIMESTAMP(3) NOT NULL,"
+            " a DOUBLE, TIME INDEX (ts), PRIMARY KEY (h))")
+        rng = np.random.default_rng(7)
+        rows = []
+        for i in range(3000):
+            a = "NULL" if i % 11 == 0 else round(rng.uniform(-5, 5), 3)
+            rows.append(f"('h{i % 9}', {i}, {a})")
+        for c in range(0, 3000, 1000):
+            qe.execute_one(
+                "INSERT INTO t VALUES " + ", ".join(rows[c:c + 1000]))
+        sql = ("SELECT h, sum(a), avg(a), count(a), min(a), max(a) "
+               "FROM t GROUP BY h ORDER BY h")
+        r1 = qe.execute_one(sql)
+        assert qe.executor.last_path == "sharded_prepared"
+        mesh = qe.executor.mesh
+        qe.executor.mesh = None
+        try:
+            r2 = qe.execute_one(sql)
+            assert qe.executor.last_path == "dense_prepared"
+        finally:
+            qe.executor.mesh = mesh
+        for name, c1, c2 in zip(r1.names, r1.columns, r2.columns):
+            if np.asarray(c1).dtype == object:
+                assert list(c1) == list(c2), name
+            else:
+                np.testing.assert_allclose(
+                    np.asarray(c1, float), np.asarray(c2, float),
+                    rtol=1e-12, err_msg=name)
+        engine.close()
