@@ -44,6 +44,47 @@ from greptimedb_tpu.promql.parser import (
 from greptimedb_tpu.query.result import QueryResult
 
 
+_CALENDAR = frozenset({
+    "minute", "hour", "day_of_week", "day_of_month", "day_of_year",
+    "days_in_month", "month", "year",
+})
+
+
+def _calendar_field(fn: str, secs: np.ndarray) -> np.ndarray:
+    """UTC calendar field of unix-second values, NaN-preserving
+    (reference functions/: the date helpers PromQL exposes)."""
+    import pandas as pd
+
+    flat = secs.reshape(-1)
+    # out-of-range instants (pandas datetime bounds) become NaN like NaN
+    # inputs, instead of raising — Prometheus accepts any float
+    lo, hi = -2.0e18, 2.0e18
+    nan = np.isnan(flat) | (flat < lo) | (flat > hi)
+    t = pd.to_datetime(np.where(nan, 0.0, flat), unit="s", utc=True)
+    field = {
+        "minute": t.minute, "hour": t.hour,
+        "day_of_week": t.dayofweek,  # pandas: Monday=0
+        "day_of_month": t.day, "day_of_year": t.dayofyear,
+        "days_in_month": t.days_in_month, "month": t.month,
+        "year": t.year,
+    }[fn]
+    out = np.asarray(field, dtype=np.float64)
+    if fn == "day_of_week":
+        out = (out + 1) % 7  # Prometheus: Sunday=0
+    out[nan] = np.nan
+    return out.reshape(secs.shape)
+
+
+def _fmt_prom_value(v: float) -> str:
+    """Shortest positional-decimal float formatting (Go FormatFloat
+    'f', -1): no scientific notation; Inf spelled Prometheus-style."""
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return np.format_float_positional(v, trim="-")
+
+
 @dataclass
 class SeriesMatrix:
     labels: list[dict[str, str]]  # S label sets (no __name__)
@@ -128,6 +169,8 @@ class PromqlEngine:
         if isinstance(node, VectorSelector):
             if node.range_s is not None:
                 raise PromqlError("range vector outside function call")
+            if node.at_s is not None:
+                return self._eval_at(node, p, ctx)
             return self._eval_instant_selector(node, p, ctx)
         if isinstance(node, Call):
             return self._eval_call(node, p, ctx)
@@ -138,6 +181,31 @@ class PromqlEngine:
         raise PromqlError(f"cannot evaluate {type(node).__name__}")
 
     # ---- selectors ---------------------------------------------------------
+
+    @staticmethod
+    def _resolve_at(at, p: EvalParams) -> float:
+        if at == "__start__":
+            return p.start
+        if at == "__end__":
+            return p.end
+        return float(at)
+
+    def _eval_at(self, sel: VectorSelector, p: EvalParams, ctx):
+        """`@ <ts>` / `@ start()` / `@ end()` (Prometheus at-modifier):
+        evaluate the selector at ONE fixed instant, then broadcast that
+        value across every output step."""
+        t_fix = self._resolve_at(sel.at_s, p)
+        pinned = VectorSelector(sel.metric, sel.matchers, sel.range_s,
+                                sel.offset_s, None)
+        p1 = EvalParams(start=t_fix, end=t_fix, step=p.step,
+                        times=np.asarray([t_fix]))
+        v = self._eval_instant_selector(pinned, p1, ctx)
+        return SeriesMatrix(
+            v.labels, jnp.broadcast_to(v.values, (v.values.shape[0], p.T)),
+            v.metric,
+            sample_ts=(jnp.broadcast_to(v.sample_ts,
+                                        (v.values.shape[0], p.T))
+                       if v.sample_ts is not None else None))
 
     def _eval_instant_selector(self, sel: VectorSelector, p: EvalParams, ctx,
                                lookback: float = DEFAULT_LOOKBACK_S):
@@ -380,9 +448,42 @@ class PromqlEngine:
     def _eval_call(self, call: Call, p: EvalParams, ctx):
         fn = call.func
         if fn in _RANGE_FUNCS:
+            # `rate(m[5m] @ T)`: pin the whole range evaluation at T and
+            # broadcast — never silently evaluate on the normal grid
+            sel = next((a for a in call.args
+                        if isinstance(a, VectorSelector)), None)
+            if sel is not None and sel.at_s is not None:
+                t_fix = self._resolve_at(sel.at_s, p)
+                pinned = VectorSelector(sel.metric, sel.matchers,
+                                        sel.range_s, sel.offset_s, None)
+                call2 = Call(call.func, tuple(
+                    pinned if a is sel else a for a in call.args))
+                p1 = EvalParams(start=t_fix, end=t_fix, step=p.step,
+                                times=np.asarray([t_fix]))
+                v = self._eval_range_func(call2, p1, ctx)
+                if isinstance(v, SeriesMatrix):
+                    return SeriesMatrix(
+                        v.labels,
+                        jnp.broadcast_to(v.values,
+                                         (v.values.shape[0], p.T)),
+                        v.metric)
+                return v
             return self._eval_range_func(call, p, ctx)
         if fn == "time":
             return jnp.asarray(p.times)
+        if fn in _CALENDAR:
+            # Prometheus calendar functions: input VALUES are unix
+            # seconds (default vector(time())); output the UTC field
+            if call.args:
+                v = self._eval(call.args[0], p, ctx)
+            else:
+                v = SeriesMatrix([{}], jnp.asarray(p.times)[None, :])
+            if not isinstance(v, SeriesMatrix):
+                v = SeriesMatrix([{}], _broadcast_scalar(v, p)[None, :])
+            vals = np.asarray(v.values, dtype=np.float64)
+            out = _calendar_field(fn, vals)
+            # functions drop __name__ (same as the _map_values path)
+            return SeriesMatrix(v.labels, jnp.asarray(out))
         if fn == "scalar":
             v = self._eval(call.args[0], p, ctx)
             if isinstance(v, SeriesMatrix):
@@ -782,6 +883,35 @@ class PromqlEngine:
                 rows = np.flatnonzero(gidx == g)
                 outs.append(jnp.nanquantile(vals[rows], q, axis=0))
             return SeriesMatrix(glabels, jnp.stack(outs, axis=0))
+
+        if agg.op == "count_values":
+            if not isinstance(agg.param, StringLiteral):
+                raise PromqlError(
+                    "count_values needs a string label parameter")
+            label_name = agg.param.value
+            vn = np.asarray(vals, dtype=np.float64)  # [S, T]
+            S, T = vn.shape
+            valid = ~np.isnan(vn)
+            # one factorization pass: (group, value-id, step) -> count
+            distinct, inv = np.unique(vn[valid], return_inverse=True)
+            D = len(distinct)
+            if D == 0:
+                return SeriesMatrix([], jnp.zeros((0, p.T)))
+            srow, scol = np.nonzero(valid)
+            flat = (gidx[srow].astype(np.int64) * D + inv) * T + scol
+            counts = np.bincount(flat, minlength=G * D * T) \
+                .reshape(G, D, T).astype(np.float64)
+            out_labels2, out_rows = [], []
+            for g in range(G):
+                for d in range(D):
+                    cnt = counts[g, d]
+                    if not cnt.any():
+                        continue
+                    lab = dict(glabels[g])
+                    lab[label_name] = _fmt_prom_value(float(distinct[d]))
+                    out_labels2.append(lab)
+                    out_rows.append(np.where(cnt > 0, cnt, np.nan))
+            return SeriesMatrix(out_labels2, jnp.asarray(np.stack(out_rows)))
 
         raise PromqlError(f"unsupported aggregation {agg.op!r}")
 

@@ -102,7 +102,7 @@ _TOK = re.compile(
     (?P<ws>\s+)
   | (?P<comment>\#[^\n]*)
   | (?P<duration>\d+(?:\.\d+)?(?:ms|s|m|h|d|w|y)(?:\d+(?:\.\d+)?(?:ms|s|m|h|d|w|y))*)
-  | (?P<number>0x[0-9a-fA-F]+|(?:\d+\.?\d*|\.\d+)(?:[eE][+-]?\d+)?|[iI][nN][fF]|[nN][aA][nN])
+  | (?P<number>0x[0-9a-fA-F]+|(?:\d+\.?\d*|\.\d+)(?:[eE][+-]?\d+)?|[iI][nN][fF](?![a-zA-Z0-9_:.])|[nN][aA][nN](?![a-zA-Z0-9_:.]))
   | (?P<string>"(?:\\.|[^"\\])*"|'(?:\\.|[^'\\])*')
   | (?P<ident>[a-zA-Z_:][a-zA-Z0-9_:.]*)
   | (?P<op>=~|!~|!=|==|<=|>=|[-+*/%^(){}\[\],=<>@])
@@ -268,7 +268,20 @@ class _Parser:
                     raise PromqlError("offset on non-selector")
             elif t.kind == "op" and t.value == "@":
                 self.next()
-                at = float(self.expect("number").value)
+                if not isinstance(e, VectorSelector):
+                    raise PromqlError(
+                        "@ modifier is only supported on selectors "
+                        "(not subqueries)")
+                nt = self.peek()
+                if nt.kind == "ident" and nt.value in ("start", "end"):
+                    # @ start() / @ end() resolve to the query range's
+                    # boundaries at eval time (Prometheus preprocessors)
+                    self.next()
+                    self.expect("op", "(")
+                    self.expect("op", ")")
+                    at = f"__{nt.value}__"
+                else:
+                    at = float(self.expect("number").value)
                 e = VectorSelector(e.metric, e.matchers, e.range_s, e.offset_s, at)
             else:
                 return e

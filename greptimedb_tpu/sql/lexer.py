@@ -27,7 +27,7 @@ _TOKEN_RE = re.compile(
   | (?P<string>'(?:[^']|'')*')
   | (?P<qident>"(?:[^"]|"")*"|`(?:[^`]|``)*`)
   | (?P<ident>[A-Za-z_][A-Za-z0-9_]*)
-  | (?P<op><=|>=|!=|<>|=~|!~|\|\||::|[-+*/%(),.=<>;@\[\]{}~])
+  | (?P<op><=|>=|!=|<>|=~|!~|\|\||::|[-+*/%(),.=<>;@\[\]{}~:])
     """,
     re.VERBOSE | re.DOTALL,
 )

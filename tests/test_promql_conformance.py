@@ -271,3 +271,98 @@ class TestSubqueries:
         # samples at T0+220 and T0+280 carry the latest raw sample within
         # lookback: floor(220/15)*15 = 210, floor(280/15)*15 = 270
         np.testing.assert_allclose(np.asarray(sm.values)[0, 0], 270.0)
+
+
+class TestCalendarAtCountValues:
+    """Calendar functions, the @ modifier (incl. start()/end()), and
+    count_values (reference promql/src/functions date helpers + the
+    Prometheus at-modifier preprocessor)."""
+
+    @pytest.fixture()
+    def cal_db(self, tmp_path):
+        engine = RegionEngine(EngineConfig(data_dir=str(tmp_path)))
+        qe = QueryEngine(Catalog(MemoryKv()), engine)
+        qe.execute_one(
+            "CREATE TABLE m (host STRING, ts TIMESTAMP(3) NOT NULL,"
+            " greptime_value DOUBLE, TIME INDEX (ts), PRIMARY KEY (host))")
+        qe.execute_one(
+            "INSERT INTO m VALUES ('a', 0, 1.0), ('a', 60000, 2.0),"
+            " ('b', 0, 1.0), ('b', 60000, 6.0)")
+        yield qe
+        engine.close()
+
+    def _eval(self, qe, q, t="(60, 60, '60')"):
+        return qe.execute_one(f"TQL EVAL {t} {q}").to_pydict()
+
+    def test_calendar_fields(self, cal_db):
+        # 1690000000 = 2023-07-22 04:26:40 UTC (a Saturday)
+        assert self._eval(cal_db, "hour(vector(1690000000))")["value"] == [4.0]
+        assert self._eval(cal_db, "minute(vector(1690000000))")["value"] == [26.0]
+        assert self._eval(cal_db,
+                          "day_of_week(vector(1690000000))")["value"] == [6.0]
+        assert self._eval(cal_db,
+                          "day_of_month(vector(1690000000))")["value"] == [22.0]
+        assert self._eval(cal_db, "month(vector(1690000000))")["value"] == [7.0]
+        assert self._eval(cal_db, "year(vector(1690000000))")["value"] == [2023.0]
+        assert self._eval(cal_db,
+                          "days_in_month(vector(1690000000))")["value"] == [31.0]
+        # no argument = vector(time())
+        assert self._eval(cal_db, "minute()")["value"] == [1.0]
+
+    def test_at_modifier(self, cal_db):
+        # @60 pins evaluation at t=60 for every output step
+        d = self._eval(cal_db, "m @ 60", t="(60, 120, '60')")
+        by_host = {}
+        for h, v in zip(d["host"], d["value"]):
+            by_host.setdefault(h, set()).add(v)
+        assert by_host == {"a": {2.0}, "b": {6.0}}
+        d = self._eval(cal_db, "sum(m @ start())", t="(60, 120, '60')")
+        assert d["value"] == [8.0, 8.0]
+        d = self._eval(cal_db, "sum(m @ end())", t="(60, 120, '60')")
+        assert d["value"] == [8.0, 8.0]
+
+    def test_count_values(self, cal_db):
+        d = self._eval(cal_db, "count_values('v', m)")
+        pairs = sorted(zip(d["v"], d["value"]))
+        assert pairs == [("2", 1.0), ("6", 1.0)]
+        # grouped: both hosts had value 1.0 at t=0 (outside lookback here)
+        d = self._eval(cal_db, "count_values('v', m)", t="(0, 0, '60')")
+        pairs = sorted(zip(d["v"], d["value"]))
+        assert pairs == [("1", 2.0)]
+
+    def test_at_on_range_vector(self, cal_db):
+        """rate(m[...] @ T) pins the range evaluation, never silently
+        evaluating on the normal grid (code-review regression)."""
+        cal_db.execute_one(
+            "INSERT INTO m VALUES ('a', 120000, 3.0)")
+        d = self._eval(cal_db, "max_over_time(m[2m] @ 120)",
+                       t="(60, 180, '60')")
+        a_vals = [v for h, v in zip(d["host"], d["value"]) if h == "a"]
+        assert a_vals == [3.0, 3.0, 3.0]
+
+    def test_subquery_through_tql(self, cal_db):
+        """[range:step] subqueries survive the SQL lexer (':' was
+        rejected before TQL text extraction)."""
+        d = self._eval(cal_db, "max_over_time(m[2m:1m])")
+        assert len(d["value"]) > 0
+
+    def test_at_on_subquery_rejected(self, cal_db):
+        with pytest.raises(Exception, match="only supported on selectors"):
+            self._eval(cal_db, "max_over_time(m[2m:1m] @ 60)")
+
+    def test_count_values_inf_and_decimals(self, cal_db):
+        cal_db.execute_one(
+            "CREATE TABLE infm (host STRING, ts TIMESTAMP(3) NOT NULL,"
+            " greptime_value DOUBLE, TIME INDEX (ts), PRIMARY KEY (host))")
+        cal_db.execute_one(
+            "INSERT INTO infm VALUES ('a', 60000, 0.0000001)")
+        import numpy as np
+
+        # inject +Inf through arithmetic: x/0 -> +Inf
+        d = cal_db.execute_one(
+            "TQL EVAL (60, 60, '60') count_values('v', infm / 0)"
+        ).to_pydict()
+        assert d["v"] == ["+Inf"]
+        d = cal_db.execute_one(
+            "TQL EVAL (60, 60, '60') count_values('v', infm)").to_pydict()
+        assert d["v"] == ["0.0000001"]  # positional, not 1e-07
