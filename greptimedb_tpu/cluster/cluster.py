@@ -39,7 +39,11 @@ class Datanode:
     def __init__(self, node_id: str, shared_dir: str, metasrv: Metasrv,
                  wire: bool = False):
         self.node_id = node_id
-        self.engine = RegionEngine(EngineConfig(data_dir=shared_dir))
+        # datanodes run the worker model like the reference's region
+        # servers (worker.rs WorkerGroup); a small fixed pool — requests
+        # arrive pre-batched from the frontend, workers add group commit
+        self.engine = RegionEngine(EngineConfig(data_dir=shared_dir,
+                                                write_workers=2))
         self.metasrv = metasrv
         self.heartbeat = HeartbeatTask(
             node_id, metasrv, self._region_stats, self._apply_instruction

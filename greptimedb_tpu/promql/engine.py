@@ -52,26 +52,42 @@ _CALENDAR = frozenset({
 
 def _calendar_field(fn: str, secs: np.ndarray) -> np.ndarray:
     """UTC calendar field of unix-second values, NaN-preserving
-    (reference functions/: the date helpers PromQL exposes)."""
-    import pandas as pd
+    (reference functions/: the date helpers PromQL exposes).
 
+    Pure numpy datetime64 arithmetic: no pandas ns-resolution bounds —
+    any float within int64 seconds works; everything else becomes NaN
+    (Prometheus accepts arbitrary floats as input values)."""
     flat = secs.reshape(-1)
-    # out-of-range instants (pandas datetime bounds) become NaN like NaN
-    # inputs, instead of raising — Prometheus accepts any float
-    lo, hi = -2.0e18, 2.0e18
-    nan = np.isnan(flat) | (flat < lo) | (flat > hi)
-    t = pd.to_datetime(np.where(nan, 0.0, flat), unit="s", utc=True)
-    field = {
-        "minute": t.minute, "hour": t.hour,
-        "day_of_week": t.dayofweek,  # pandas: Monday=0
-        "day_of_month": t.day, "day_of_year": t.dayofyear,
-        "days_in_month": t.days_in_month, "month": t.month,
-        "year": t.year,
-    }[fn]
-    out = np.asarray(field, dtype=np.float64)
-    if fn == "day_of_week":
-        out = (out + 1) % 7  # Prometheus: Sunday=0
-    out[nan] = np.nan
+    lim = 9.0e18  # within int64 seconds
+    bad = ~np.isfinite(flat) | (np.abs(flat) > lim)
+    isecs = np.floor(np.where(bad, 0.0, flat)).astype(np.int64)
+    if fn == "minute":
+        out = ((isecs % 3600) // 60).astype(np.float64)
+    elif fn == "hour":
+        out = ((isecs % 86400) // 3600).astype(np.float64)
+    else:
+        dt = isecs.astype("datetime64[s]")
+        days = dt.astype("datetime64[D]")
+        months = dt.astype("datetime64[M]")
+        years = dt.astype("datetime64[Y]")
+        if fn == "day_of_week":
+            # 1970-01-01 was a Thursday; Prometheus: Sunday = 0
+            out = ((days.astype(np.int64) + 4) % 7).astype(np.float64)
+        elif fn == "day_of_month":
+            out = ((days - months.astype("datetime64[D]"))
+                   .astype(np.int64) + 1).astype(np.float64)
+        elif fn == "day_of_year":
+            out = ((days - years.astype("datetime64[D]"))
+                   .astype(np.int64) + 1).astype(np.float64)
+        elif fn == "days_in_month":
+            out = ((months + 1).astype("datetime64[D]")
+                   - months.astype("datetime64[D]")).astype(np.float64)
+        elif fn == "month":
+            out = ((months - years.astype("datetime64[M]"))
+                   .astype(np.int64) + 1).astype(np.float64)
+        else:  # year
+            out = (years.astype(np.int64) + 1970).astype(np.float64)
+    out[bad] = np.nan
     return out.reshape(secs.shape)
 
 
@@ -381,9 +397,14 @@ class PromqlEngine:
                 idx_preds.setdefault(m.label, []).append(InSet.of([m.value]))
             elif m.op == "=~":
                 idx_preds.setdefault(m.label, []).append(Regex(m.value))
-        scan = qe.region_engine.scan(
-            info.region_ids[0], (lo, hi), [field_name],
-            tag_predicates={k: tuple(v) for k, v in idx_preds.items()} or None)
+        from greptimedb_tpu.utils import tracing
+
+        with tracing.span("promql_scan", metric=metric,
+                          field=field_name):
+            scan = qe.region_engine.scan(
+                info.region_ids[0], (lo, hi), [field_name],
+                tag_predicates={k: tuple(v)
+                                for k, v in idx_preds.items()} or None)
         if scan is None or scan.num_rows == 0:
             return None
 
@@ -892,26 +913,27 @@ class PromqlEngine:
             vn = np.asarray(vals, dtype=np.float64)  # [S, T]
             S, T = vn.shape
             valid = ~np.isnan(vn)
-            # one factorization pass: (group, value-id, step) -> count
+            # sparse factorization: memory stays O(samples + series*T),
+            # never a dense [G, D, T] cube (near-unique float values make
+            # D ~ S*T)
             distinct, inv = np.unique(vn[valid], return_inverse=True)
             D = len(distinct)
             if D == 0:
                 return SeriesMatrix([], jnp.zeros((0, p.T)))
             srow, scol = np.nonzero(valid)
-            flat = (gidx[srow].astype(np.int64) * D + inv) * T + scol
-            counts = np.bincount(flat, minlength=G * D * T) \
-                .reshape(G, D, T).astype(np.float64)
-            out_labels2, out_rows = [], []
-            for g in range(G):
-                for d in range(D):
-                    cnt = counts[g, d]
-                    if not cnt.any():
-                        continue
-                    lab = dict(glabels[g])
-                    lab[label_name] = _fmt_prom_value(float(distinct[d]))
-                    out_labels2.append(lab)
-                    out_rows.append(np.where(cnt > 0, cnt, np.nan))
-            return SeriesMatrix(out_labels2, jnp.asarray(np.stack(out_rows)))
+            key = (gidx[srow].astype(np.int64) * D + inv) * T + scol
+            uk, uc = np.unique(key, return_counts=True)
+            gd = uk // T
+            col = (uk % T).astype(np.int64)
+            pairs, pair_inv = np.unique(gd, return_inverse=True)
+            rows_m = np.full((len(pairs), T), np.nan)
+            rows_m[pair_inv, col] = uc.astype(np.float64)
+            out_labels2 = []
+            for pair in pairs:
+                lab = dict(glabels[int(pair // D)])
+                lab[label_name] = _fmt_prom_value(float(distinct[pair % D]))
+                out_labels2.append(lab)
+            return SeriesMatrix(out_labels2, jnp.asarray(rows_m))
 
         raise PromqlError(f"unsupported aggregation {agg.op!r}")
 

@@ -281,7 +281,12 @@ class _Parser:
                     self.expect("op", ")")
                     at = f"__{nt.value}__"
                 else:
-                    at = float(self.expect("number").value)
+                    neg = self.eat("op", "-")
+                    tok = self.expect("number").value.lower()
+                    if tok.startswith("0x") or tok in ("inf", "nan"):
+                        raise PromqlError(
+                            f"@ needs a decimal timestamp, got {tok!r}")
+                    at = float(tok) * (-1.0 if neg else 1.0)
                 e = VectorSelector(e.metric, e.matchers, e.range_s, e.offset_s, at)
             else:
                 return e

@@ -814,24 +814,42 @@ class QueryEngine:
             # authorization (EXPLAIN itself only required read — without
             # this a read-only user could EXPLAIN ANALYZE a DELETE)
             self.permission_checker.check(ctx.user, stmt.inner, ctx.db)
-            tid = tracing.set_trace(ctx.trace_id)
+            lines += self._analyze_run(
+                lambda: self._execute_statement(stmt.inner, ctx),
+                show_path=True)
+        return QueryResult(["plan"], [DataType.STRING],
+                           [np.asarray(lines, dtype=object)])
+
+    def _analyze_run(self, run, show_path: bool = False) -> list[str]:
+        """Execute `run` under a FRESH trace id and report its spans
+        (shared by EXPLAIN ANALYZE and TQL ANALYZE). A fresh id matters:
+        connection-scoped contexts pin one trace id, and reusing it would
+        dump every prior statement's spans into this report. The
+        connection's id is restored afterwards."""
+        import time as _time
+
+        from greptimedb_tpu.utils import tracing
+
+        prev = tracing.current_trace_id()
+        tid = tracing.set_trace(None)
+        try:
             t0 = _time.perf_counter()
-            result = self._execute_statement(stmt.inner, ctx)
+            result = run()
             total_ms = (_time.perf_counter() - t0) * 1000.0
             spans = tracing.spans_for(tid)
-            lines.append("")
-            lines.append(f"ANALYZE trace={tid} total={total_ms:.2f} ms "
-                         f"rows={result.num_rows}")
+        finally:
+            tracing.restore_trace(prev)
+        lines = ["", f"ANALYZE trace={tid} total={total_ms:.2f} ms "
+                     f"rows={result.num_rows}"]
+        if show_path:
             path = getattr(self.executor, "last_path", None)
             if path:
                 lines.append(f"  execution path: {path}")
-            for s in spans:
-                attrs = " ".join(f"{k}={v}" for k, v in s.attrs.items())
-                lines.append(
-                    f"  {s.name}: {s.duration_ms:.2f} ms"
-                    + (f" [{attrs}]" if attrs else ""))
-        return QueryResult(["plan"], [DataType.STRING],
-                           [np.asarray(lines, dtype=object)])
+        for s in spans:
+            attrs = " ".join(f"{k}={v}" for k, v in s.attrs.items())
+            lines.append(f"  {s.name}: {s.duration_ms:.2f} ms"
+                         + (f" [{attrs}]" if attrs else ""))
+        return lines
 
     # ---- admin -------------------------------------------------------------
 
@@ -869,7 +887,66 @@ class QueryEngine:
         from greptimedb_tpu.promql.engine import PromqlEngine
 
         engine = PromqlEngine(self)
+        if stmt.explain or stmt.analyze:
+            # TQL EXPLAIN: the parsed PromQL tree (reference
+            # operator/src/statement/tql.rs); TQL ANALYZE additionally
+            # runs the query and appends per-stage span timings
+            from greptimedb_tpu.promql.parser import parse_promql
+
+            lines = [f"PromQL: {stmt.query}",
+                     _explain_promql(parse_promql(stmt.query))]
+            if stmt.analyze:
+                lines += self._analyze_run(
+                    lambda: engine.eval_range(stmt.query, stmt.start,
+                                              stmt.end, stmt.step, ctx))
+            return QueryResult(["plan"], [DataType.STRING],
+                               [np.asarray(lines, dtype=object)])
         return engine.eval_range(stmt.query, stmt.start, stmt.end, stmt.step, ctx)
+
+
+def _explain_promql(node, indent: int = 0) -> str:
+    """Render the PromQL AST as an operator tree (the reference shows the
+    DataFusion plan of the compiled query; here the evaluation tree IS
+    the plan)."""
+    from greptimedb_tpu.promql import parser as pp
+
+    pad = "  " * indent
+    if isinstance(node, pp.VectorSelector):
+        parts = [node.metric or ""]
+        if node.matchers:
+            parts.append("{" + ",".join(
+                f"{m.label}{m.op}{m.value!r}" for m in node.matchers) + "}")
+        if node.range_s:
+            parts.append(f"[{node.range_s:g}s]")
+        if node.offset_s:
+            parts.append(f" offset {node.offset_s:g}s")
+        if node.at_s is not None:
+            parts.append(f" @ {node.at_s}")
+        return f"{pad}Selector: {''.join(parts)}"
+    if isinstance(node, pp.Call):
+        inner = "\n".join(_explain_promql(a, indent + 1)
+                          for a in node.args)
+        return f"{pad}Call: {node.func}" + ("\n" + inner if inner else "")
+    if isinstance(node, pp.Aggregate):
+        mods = ""
+        if node.by:
+            mods = f" by ({', '.join(node.by)})"
+        elif node.without:
+            mods = f" without ({', '.join(node.without)})"
+        head = f"{pad}Aggregate: {node.op}{mods}"
+        return head + "\n" + _explain_promql(node.expr, indent + 1)
+    if isinstance(node, pp.Binary):
+        return (f"{pad}Binary: {node.op}\n"
+                + _explain_promql(node.lhs, indent + 1) + "\n"
+                + _explain_promql(node.rhs, indent + 1))
+    if isinstance(node, pp.Subquery):
+        return (f"{pad}Subquery: [{node.range_s:g}s:"
+                f"{node.step_s or ''}]"
+                + "\n" + _explain_promql(node.expr, indent + 1))
+    if isinstance(node, pp.Unary):
+        return f"{pad}Unary: {node.op}\n" + _explain_promql(node.expr,
+                                                            indent + 1)
+    return f"{pad}{type(node).__name__}"
 
 
 def _subst_expr(e, ctx):

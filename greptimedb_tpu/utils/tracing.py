@@ -49,6 +49,12 @@ def current_trace_id() -> Optional[str]:
     return _current.get()
 
 
+def restore_trace(trace_id: Optional[str]) -> None:
+    """Put back a previously-saved id verbatim (None clears — unlike
+    set_trace, which would mint a fresh id)."""
+    _current.set(trace_id)
+
+
 @contextlib.contextmanager
 def span(name: str, **attrs):
     t0 = time.perf_counter()

@@ -366,3 +366,17 @@ class TestCalendarAtCountValues:
         d = cal_db.execute_one(
             "TQL EVAL (60, 60, '60') count_values('v', infm)").to_pydict()
         assert d["v"] == ["0.0000001"]  # positional, not 1e-07
+
+    def test_tql_analyze_and_explain(self, cal_db):
+        r = cal_db.execute_one(
+            "TQL EXPLAIN (60, 60, '60') sum by (host) (rate(m[2m]))")
+        text = "\n".join(row[0] for row in r.rows())
+        assert "Aggregate: sum by (host)" in text
+        assert "Call: rate" in text
+        assert "Selector: m[120s]" in text
+        assert "ANALYZE" not in text
+        r = cal_db.execute_one(
+            "TQL ANALYZE (60, 60, '60') sum by (host) (rate(m[2m]))")
+        text = "\n".join(row[0] for row in r.rows())
+        assert "ANALYZE trace=" in text and "total=" in text
+        assert "promql_scan" in text
