@@ -21,6 +21,8 @@ Layer map (mirrors SURVEY.md §1, re-designed TPU-first):
   datatypes/  Arrow-backed type system with time-index metadata
 """
 
+import os as _os
+
 import jax
 
 # Timestamps are int64 nanoseconds end-to-end (reference:
@@ -28,5 +30,20 @@ import jax
 # accumulators on CPU test paths. TPU kernels down-cast hot-loop field data
 # to f32/bf16 explicitly where profitable.
 jax.config.update("jax_enable_x64", True)
+
+# Persistent XLA compilation cache: first-compile of the fused aggregation
+# program costs ~20-40s on TPU; caching it on disk makes every later
+# process (server restarts, the bench, CLI tools) start warm. Opt out with
+# GREPTIMEDB_TPU_COMPILE_CACHE=off, redirect with =<dir>.
+_cc = _os.environ.get("GREPTIMEDB_TPU_COMPILE_CACHE", "")
+if _cc.lower() not in ("off", "0", "none", "false", "no", "disabled"):
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            _cc or _os.path.join(_os.path.expanduser("~"), ".cache",
+                                 "greptimedb_tpu_xla"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:  # noqa: BLE001 — older jax: feature is optional
+        pass
 
 __version__ = "0.1.0"

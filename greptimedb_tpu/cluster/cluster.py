@@ -109,6 +109,12 @@ class Datanode:
         """Simulate process death: stop heartbeating, drop open regions,
         stop serving the wire."""
         self.alive = False
+        if self.engine.workers is not None:
+            # a dead process has no writer threads; without this each
+            # simulated death leaks the worker pool (and a dequeued write
+            # could still land in the shared WAL)
+            self.engine.workers.stop()
+            self.engine.workers = None
         for rid in list(self.engine.regions):
             self.engine.regions.pop(rid, None)
         if self.server is not None:

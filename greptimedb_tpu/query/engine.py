@@ -806,10 +806,6 @@ class QueryEngine:
             # wall time from the trace spans, including remote region
             # spans joined by trace id (reference query/src/analyze.rs +
             # merge_scan.rs:245-259 metrics piggyback)
-            import time as _time
-
-            from greptimedb_tpu.utils import tracing
-
             # the inner statement really runs: it needs its OWN
             # authorization (EXPLAIN itself only required read — without
             # this a read-only user could EXPLAIN ANALYZE a DELETE)
@@ -923,6 +919,10 @@ def _explain_promql(node, indent: int = 0) -> str:
         if node.at_s is not None:
             parts.append(f" @ {node.at_s}")
         return f"{pad}Selector: {''.join(parts)}"
+    if isinstance(node, pp.NumberLiteral):
+        return f"{pad}Number: {node.value:g}"
+    if isinstance(node, pp.StringLiteral):
+        return f"{pad}String: {node.value!r}"
     if isinstance(node, pp.Call):
         inner = "\n".join(_explain_promql(a, indent + 1)
                           for a in node.args)
@@ -934,6 +934,8 @@ def _explain_promql(node, indent: int = 0) -> str:
         elif node.without:
             mods = f" without ({', '.join(node.without)})"
         head = f"{pad}Aggregate: {node.op}{mods}"
+        if node.param is not None:
+            head += "\n" + _explain_promql(node.param, indent + 1)
         return head + "\n" + _explain_promql(node.expr, indent + 1)
     if isinstance(node, pp.Binary):
         return (f"{pad}Binary: {node.op}\n"

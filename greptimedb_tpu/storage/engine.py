@@ -45,6 +45,15 @@ class RegionRequest:
     schema: Optional[Schema] = None
 
 
+def _env_int(name: str, default: int) -> int:
+    """Env-var int with a safe fallback — a malformed value must not
+    abort region open."""
+    try:
+        return int(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
 @dataclass
 class EngineConfig:
     data_dir: str
@@ -69,6 +78,11 @@ class EngineConfig:
     # Workers batch concurrent writes per region into one WAL group
     # commit and bound in-flight requests (backpressure)
     write_workers: int = 0
+    # host scan-cache snapshots kept per region (decoded-page cache
+    # analog); env default so tests/CLI can tune without a config object
+    scan_cache_entries: int = field(
+        default_factory=lambda: _env_int(
+            "GREPTIMEDB_TPU_SCAN_CACHE_ENTRIES", 4))
     # object store backend for SSTs/manifest/index (reference
     # object-store crate; fs|memory|s3, optional LRU read cache)
     object_store: str = "fs"
@@ -136,10 +150,12 @@ class RegionEngine:
                 assert req.schema is not None
                 if req.region_id in self.regions:
                     return 0
-                self.regions[req.region_id] = Region.create(
+                region = Region.create(
                     req.region_id, self._region_dir(req.region_id), req.schema,
                     self.wal, self.store
                 )
+                region.scan_cache_entries = self.config.scan_cache_entries
+                self.regions[req.region_id] = region
                 return 0
             if req.kind is RequestType.OPEN:
                 if req.region_id not in self.regions:
@@ -148,10 +164,13 @@ class RegionEngine:
                         if r is not None:
                             self.regions[req.region_id] = r
                             return 0
-                    self.regions[req.region_id] = Region.open(
-                        req.region_id, self._region_dir(req.region_id), self.wal,
-                        self.store
+                    region = Region.open(
+                        req.region_id, self._region_dir(req.region_id),
+                        self.wal, self.store
                     )
+                    region.scan_cache_entries = \
+                        self.config.scan_cache_entries
+                    self.regions[req.region_id] = region
                 return 0
             if req.kind is RequestType.CLOSE:
                 r = self.regions.pop(req.region_id, None)

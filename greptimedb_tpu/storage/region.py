@@ -137,7 +137,7 @@ class Region:
         # decoded-page cache (mito2/src/cache.rs); repeated dashboard/TSBS
         # queries skip parquet decode entirely
         self._scan_cache: "OrderedDict[tuple, ScanData]" = OrderedDict()
-        self.scan_cache_entries = 2
+        self.scan_cache_entries = 4  # overridden from EngineConfig
 
     # ---- lifecycle ---------------------------------------------------------
 
