@@ -175,6 +175,7 @@ def mysql_native_scramble(password: str, salt: bytes) -> bytes:
 _WRITE_STMTS = frozenset({
     "Insert", "Delete", "CreateTable", "CreateDatabase", "DropTable",
     "TruncateTable", "AlterTable", "CreateFlow", "DropFlow", "AdminFunc",
+    "CreateView", "DropView",
     # COPY FROM writes into tables; COPY TO writes server-side files —
     # both require the write grant
     "CopyTable", "CopyDatabase",

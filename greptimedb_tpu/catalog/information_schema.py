@@ -203,6 +203,19 @@ def _engines(qe, ctx):
     }
 
 
+@_virtual("views")
+def _views(qe, ctx):
+    cols = {"table_catalog": [], "table_schema": [], "table_name": [],
+            "view_definition": []}
+    for db in qe.catalog.list_databases():
+        for name in qe.catalog.list_views(db):
+            cols["table_catalog"].append("greptime")
+            cols["table_schema"].append(db)
+            cols["table_name"].append(name)
+            cols["view_definition"].append(qe.catalog.view(db, name))
+    return cols
+
+
 @_virtual("flows")
 def _flows(qe, ctx):
     cols = {"flow_name": [], "table_catalog": [], "flow_schema": [],

@@ -116,6 +116,32 @@ class QueryEngine:
             return self._insert(stmt, ctx)
         if isinstance(stmt, ast.Delete):
             return self._delete(stmt, ctx)
+        if isinstance(stmt, ast.CreateView):
+            db, name = self._db_and_name(stmt.name, ctx)
+            # the definition must at least parse and name a single query
+            defs = parse_sql(stmt.query_sql)
+            if len(defs) != 1 or not isinstance(defs[0],
+                                                (ast.Select, ast.Union,
+                                                 ast.Tql)):
+                raise PlanError("CREATE VIEW requires a single query")
+            try:
+                self.catalog.create_view(db, name, stmt.query_sql,
+                                         or_replace=stmt.or_replace,
+                                         if_not_exists=stmt.if_not_exists)
+            except CatalogError as e:
+                raise PlanError(str(e)) from None
+            return QueryResult.of_affected(0)
+        if isinstance(stmt, ast.DropView):
+            db, name = self._db_and_name(stmt.name, ctx)
+            try:
+                self.catalog.drop_view(db, name, if_exists=stmt.if_exists)
+            except CatalogError as e:
+                raise PlanError(str(e)) from None
+            return QueryResult.of_affected(0)
+        if isinstance(stmt, ast.ShowViews):
+            views = sorted(self.catalog.list_views(ctx.db))
+            return QueryResult(["Views"], [DataType.STRING],
+                               [np.asarray(views, dtype=object)])
         if isinstance(stmt, ast.DropTable):
             return self._drop_table(stmt, ctx)
         if isinstance(stmt, ast.TruncateTable):
@@ -187,6 +213,49 @@ class QueryEngine:
 
     # ---- table resolution --------------------------------------------------
 
+    def _db_and_name(self, name: str, ctx: QueryContext) -> tuple[str, str]:
+        db = ctx.db
+        if "." in name:
+            candidate_db, rest = name.rsplit(".", 1)
+            if self.catalog.database_exists(candidate_db):
+                return candidate_db, rest
+        return db, name
+
+    def _view_sql(self, name: str, ctx: QueryContext):
+        db, short = self._db_and_name(name, ctx)
+        return self.catalog.view(db, short)
+
+    def _select_view(self, sel: ast.Select, vsql: str,
+                     ctx: QueryContext) -> QueryResult:
+        """SELECT over a view: run the stored defining query through the
+        normal engine (device path and all), then evaluate the outer
+        select over its columns (reference: views inline into the plan;
+        here the view result is the virtual relation)."""
+        from greptimedb_tpu.query.join import execute_select_over
+
+        inner_stmts = parse_sql(vsql)
+        if len(inner_stmts) != 1:
+            raise PlanError("view definition must be a single query")
+        view_db, short = self._db_and_name(sel.table, ctx)
+        # the defining query resolves unqualified names in the VIEW's
+        # database, and nested views are depth-limited (a ↔ b cycles
+        # must be a PlanError, not a RecursionError)
+        inner_ctx = ctx.with_db(view_db)
+        inner_ctx.extensions = dict(ctx.extensions)
+        depth = int(inner_ctx.extensions.get("__view_depth__", 0)) + 1
+        if depth > 16:
+            raise PlanError(
+                f"view nesting deeper than 16 at {view_db}.{short} "
+                "(possible view cycle)")
+        inner_ctx.extensions["__view_depth__"] = depth
+        base = self._execute_statement(inner_stmts[0], inner_ctx)
+        if not base.is_query:
+            raise PlanError("view definition is not a query")
+        cols = dict(zip(base.names, base.columns))
+        dtypes = dict(zip(base.names, base.dtypes))
+        return execute_select_over(self, sel, cols, dtypes,
+                                   alias=sel.table_alias or short)
+
     def _table(self, name: str, ctx: QueryContext) -> TableInfo:
         db = ctx.db
         if "." in name:
@@ -224,6 +293,10 @@ class QueryEngine:
         if sel.table is not None and \
                 infoschema.is_information_schema_query(sel.table, ctx.db):
             return infoschema.execute_virtual_select(self, sel, ctx)
+        if sel.table is not None:
+            vsql = self._view_sql(sel.table, ctx)
+            if vsql is not None:
+                return self._select_view(sel, vsql, ctx)
         if sel.table is None:
             # SELECT <literals> — session funcs substitute here too
             sel = _subst_session_funcs(sel, ctx)
@@ -771,6 +844,17 @@ class QueryEngine:
         )
 
     def _show_create(self, stmt: ast.ShowCreateTable, ctx: QueryContext) -> QueryResult:
+        if stmt.is_view or self._view_sql(stmt.name, ctx) is not None:
+            db, name = self._db_and_name(stmt.name, ctx)
+            vsql = self.catalog.view(db, name)
+            if vsql is None:
+                raise CatalogError(f"view {db}.{name} not found")
+            return QueryResult(
+                ["View", "Create View"],
+                [DataType.STRING, DataType.STRING],
+                [np.asarray([name], dtype=object),
+                 np.asarray([f'CREATE VIEW "{name}" AS {vsql}'],
+                            dtype=object)])
         info = self._table(stmt.name, ctx)
         lines = [f"CREATE TABLE IF NOT EXISTS \"{info.name}\" ("]
         defs = []
@@ -795,9 +879,15 @@ class QueryEngine:
 
     def _explain(self, stmt: ast.Explain, ctx: QueryContext) -> QueryResult:
         if isinstance(stmt.inner, ast.Select) and stmt.inner.table is not None:
-            info = self._table(stmt.inner.table, ctx)
-            plan = plan_select(stmt.inner, info)
-            text = lp.explain_plan(plan)
+            vsql = self._view_sql(stmt.inner.table, ctx) \
+                if not stmt.inner.joins else None
+            if vsql is not None:
+                text = (f"View: {stmt.inner.table} AS {vsql}\n"
+                        "  (outer select evaluates over the view result)")
+            else:
+                info = self._table(stmt.inner.table, ctx)
+                plan = plan_select(stmt.inner, info)
+                text = lp.explain_plan(plan)
         else:
             text = f"{type(stmt.inner).__name__}"
         lines = text.split("\n")

@@ -136,6 +136,57 @@ def execute_join_select(qe, sel: ast.Select, ctx) -> QueryResult:
     return _post(sel, r, resolve, env=env_cols)
 
 
+def execute_select_over(qe, sel: ast.Select, base_cols: dict,
+                        base_dtypes: dict, alias=None) -> QueryResult:
+    """Evaluate a full SELECT pipeline over in-memory columns — the
+    execution path for views (the view query materializes through the
+    normal engine; the outer select then runs here) and any other
+    virtual relation."""
+    env = {k: np.asarray(v) for k, v in base_cols.items()}
+    dtypes = dict(base_dtypes)
+    if alias:
+        for k in list(env):
+            env[f"{alias}.{k}"] = env[k]
+            dtypes[f"{alias}.{k}"] = dtypes.get(k)
+    n = len(next(iter(env.values()))) if env else 0
+
+    state = {"cols": env, "n": n}
+
+    def resolve(e):
+        return _resolve_columns(e, state["cols"])
+
+    def ev(e):
+        return eval_host(resolve(e), state["cols"], None, None, state["n"])
+
+    if sel.where is not None:
+        mask = np.broadcast_to(np.asarray(ev(sel.where), dtype=bool),
+                               (state["n"],))
+        idx = np.nonzero(mask)[0]
+        state["cols"] = {k: v[idx] for k, v in state["cols"].items()}
+        state["n"] = len(idx)
+    env = state["cols"]
+    n = state["n"]
+
+    if sel.group_by or any(_contains_agg(it.expr) for it in sel.items):
+        return _aggregate(sel, env, dtypes, n, resolve)
+
+    out_names, out_cols, out_dtypes = [], [], []
+    for i, it in enumerate(sel.items):
+        if isinstance(it.expr, ast.Star):
+            for k in base_cols:
+                out_names.append(k)
+                out_cols.append(env[k])
+                out_dtypes.append(dtypes.get(k))
+            continue
+        v = ev(it.expr)
+        arr = np.asarray([v] * n) if np.ndim(v) == 0 else np.asarray(v)
+        out_names.append(it.alias or _expr_name(it.expr))
+        out_cols.append(arr)
+        out_dtypes.append(None)
+    r = QueryResult(out_names, out_dtypes, out_cols)
+    return _post(sel, r, resolve, env=env)
+
+
 # ---- pushdown helpers ------------------------------------------------------
 
 

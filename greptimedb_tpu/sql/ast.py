@@ -193,6 +193,28 @@ class CopyDatabase(Statement):
 
 
 @dataclass
+class CreateView(Statement):
+    """CREATE [OR REPLACE] VIEW name AS <query> (reference
+    common/meta view keys + ddl create_view)."""
+
+    name: str
+    query_sql: str  # raw text of the defining query
+    or_replace: bool = False
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropView(Statement):
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
+class ShowViews(Statement):
+    pass
+
+
+@dataclass
 class CreateDatabase(Statement):
     name: str
     if_not_exists: bool = False
@@ -284,6 +306,7 @@ class ShowDatabases(Statement):
 @dataclass
 class ShowCreateTable(Statement):
     name: str
+    is_view: bool = False
 
 
 @dataclass

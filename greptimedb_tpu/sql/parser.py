@@ -432,6 +432,38 @@ class Parser:
             return ast.CreateDatabase(self.ident(), if_not_exists=ine)
         if self.eat_kw("flow"):
             return self._parse_create_flow()
+        or_replace = False
+        if self.eat_kw("or"):
+            r = self.ident()
+            if r.lower() != "replace":
+                raise SqlError(f"expected REPLACE after OR, got {r!r}")
+            or_replace = True
+        if (self.peek().kind == "ident"
+                and self.peek().value.lower() == "view"):
+            self.next()
+            ine = self._if_not_exists()
+            name = self.qualified_name()
+            self.expect_kw("as")
+            # the defining query is kept as raw text (reference stores
+            # view definitions the same way, common/meta view keys)
+            start = self.peek().pos
+            depth = 0
+            while self.peek().kind != "eof":
+                t = self.peek()
+                if t.kind == "op" and t.value == ";" and depth == 0:
+                    break
+                if t.kind == "op" and t.value == "(":
+                    depth += 1
+                if t.kind == "op" and t.value == ")":
+                    depth -= 1
+                self.next()
+            query_sql = self.sql[start:self.peek().pos].strip()
+            if not query_sql:
+                raise SqlError("CREATE VIEW requires a defining query")
+            return ast.CreateView(name, query_sql, or_replace=or_replace,
+                                  if_not_exists=ine)
+        if or_replace:
+            raise SqlError("OR REPLACE is only supported for CREATE VIEW")
         external = self.eat_kw("external")
         self.expect_kw("table")
         ine = self._if_not_exists()
@@ -600,6 +632,15 @@ class Parser:
     def parse_drop(self) -> ast.Statement:
         self.expect_kw("drop")
         is_flow = self.eat_kw("flow")
+        if not is_flow and self.peek().kind == "ident" \
+                and self.peek().value.lower() == "view":
+            self.next()
+            if_exists = False
+            if self.at_kw("if"):
+                self.next()
+                self.expect_kw("exists")
+                if_exists = True
+            return ast.DropView(self.qualified_name(), if_exists)
         if not is_flow:
             self.expect_kw("table")
         if_exists = False
@@ -652,8 +693,17 @@ class Parser:
         if self.eat_kw("flows"):
             return ast.ShowFlows()
         if self.eat_kw("create"):
+            if self.peek().kind == "ident" \
+                    and self.peek().value.lower() == "view":
+                self.next()
+                return ast.ShowCreateTable(self.qualified_name(),
+                                           is_view=True)
             self.expect_kw("table")
             return ast.ShowCreateTable(self.qualified_name())
+        if self.peek().kind == "ident" \
+                and self.peek().value.lower() == "views":
+            self.next()
+            return ast.ShowViews()
         self.expect_kw("tables")
         stmt = ast.ShowTables()
         if self.eat_kw("from") or self.eat_kw("in"):

@@ -162,6 +162,9 @@ class RegionEngine:
                     for opener in self.openers:
                         r = opener(req.region_id)
                         if r is not None:
+                            if hasattr(r, "scan_cache_entries"):
+                                r.scan_cache_entries = \
+                                    self.config.scan_cache_entries
                             self.regions[req.region_id] = r
                             return 0
                     region = Region.open(

@@ -1,0 +1,22 @@
+-- Views: create, select, replace, show, drop
+CREATE TABLE src (h STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(h));
+
+INSERT INTO src VALUES ('a', 1.0, 1000), ('b', 9.0, 2000);
+
+CREATE VIEW big AS SELECT h, v FROM src WHERE v > 5;
+
+SELECT * FROM big;
+
+SELECT count(*) FROM big;
+
+SHOW VIEWS;
+
+SHOW CREATE VIEW big;
+
+CREATE OR REPLACE VIEW big AS SELECT h FROM src;
+
+SELECT count(*) FROM big;
+
+DROP VIEW big;
+
+SHOW VIEWS;

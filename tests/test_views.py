@@ -1,0 +1,132 @@
+"""Views (reference common/meta view keys + ddl create_view/drop_view,
+information_schema views table): CREATE [OR REPLACE] VIEW, SELECT with
+projection/WHERE/aggregates/joins over views, SHOW VIEWS / SHOW CREATE
+VIEW, DROP VIEW."""
+
+import pytest
+
+from greptimedb_tpu.catalog import Catalog, MemoryKv
+from greptimedb_tpu.query import QueryEngine
+from greptimedb_tpu.query.expr import PlanError
+from greptimedb_tpu.storage import RegionEngine
+from greptimedb_tpu.storage.engine import EngineConfig
+
+
+@pytest.fixture()
+def db(tmp_path):
+    engine = RegionEngine(EngineConfig(data_dir=str(tmp_path)))
+    qe = QueryEngine(Catalog(MemoryKv()), engine)
+    qe.execute_one(
+        "CREATE TABLE m (host STRING, ts TIMESTAMP(3) NOT NULL, v DOUBLE,"
+        " TIME INDEX (ts), PRIMARY KEY (host))")
+    qe.execute_one(
+        "INSERT INTO m VALUES ('a', 1000, 1.0), ('a', 2000, 3.0),"
+        " ('b', 1000, 10.0)")
+    qe.execute_one("CREATE VIEW hot AS SELECT host, ts, v FROM m WHERE v > 2")
+    yield qe
+    engine.close()
+
+
+class TestViews:
+    def test_select_filter_agg_star(self, db):
+        assert db.execute_one(
+            "SELECT host, v FROM hot ORDER BY v").rows() == \
+            [["a", 3.0], ["b", 10.0]]
+        assert db.execute_one(
+            "SELECT host, sum(v) FROM hot GROUP BY host ORDER BY host"
+        ).rows() == [["a", 3.0], ["b", 10.0]]
+        assert db.execute_one(
+            "SELECT v FROM hot WHERE host = 'b'").rows() == [[10.0]]
+        assert db.execute_one(
+            "SELECT * FROM hot ORDER BY v LIMIT 1").rows() == \
+            [["a", 2000, 3.0]]
+        # alias-qualified references
+        assert db.execute_one(
+            "SELECT h.v FROM hot h ORDER BY h.v").rows() == [[3.0], [10.0]]
+
+    def test_join_view_with_table(self, db):
+        r = db.execute_one(
+            "SELECT hot.host, m.v FROM hot JOIN m "
+            "ON hot.host = m.host AND hot.ts = m.ts ORDER BY m.v")
+        assert r.rows() == [["a", 3.0], ["b", 10.0]]
+
+    def test_view_over_view(self, db):
+        db.execute_one("CREATE VIEW hotter AS SELECT * FROM hot WHERE v > 5")
+        assert db.execute_one("SELECT host FROM hotter").rows() == [["b"]]
+
+    def test_show_and_information_schema(self, db):
+        assert db.execute_one("SHOW VIEWS").rows() == [["hot"]]
+        r = db.execute_one("SHOW CREATE VIEW hot")
+        assert r.rows()[0][0] == "hot"
+        assert "SELECT host, ts, v FROM m WHERE v > 2" in r.rows()[0][1]
+        r = db.execute_one(
+            "SELECT table_name, view_definition FROM "
+            "information_schema.views")
+        assert r.rows()[0][0] == "hot"
+
+    def test_or_replace_and_conflicts(self, db):
+        with pytest.raises(PlanError, match="already exists"):
+            db.execute_one("CREATE VIEW hot AS SELECT 1")
+        db.execute_one("CREATE VIEW IF NOT EXISTS hot AS SELECT 1")
+        db.execute_one("CREATE OR REPLACE VIEW hot AS SELECT host FROM m")
+        assert db.execute_one("SELECT count(*) FROM hot").rows() == [[3]]
+        with pytest.raises(PlanError, match="exists as a table"):
+            db.execute_one("CREATE VIEW m AS SELECT 1")
+
+    def test_drop(self, db):
+        db.execute_one("DROP VIEW hot")
+        with pytest.raises(Exception, match="not found"):
+            db.execute_one("SELECT * FROM hot")
+        with pytest.raises(PlanError, match="not found"):
+            db.execute_one("DROP VIEW hot")
+        db.execute_one("DROP VIEW IF EXISTS hot")
+
+    def test_invalid_definition_rejected(self, db):
+        with pytest.raises(Exception):
+            db.execute_one("CREATE VIEW bad AS INSERT INTO m VALUES (1)")
+
+
+class TestReviewRegressions:
+    def test_view_cycle_is_plan_error(self, db):
+        db.execute_one("CREATE VIEW va AS SELECT * FROM vb")
+        db.execute_one("CREATE VIEW vb AS SELECT * FROM va")
+        with pytest.raises(PlanError, match="view nesting"):
+            db.execute_one("SELECT * FROM va")
+
+    def test_view_ddl_requires_write(self, db):
+        from greptimedb_tpu.auth import AuthError, UserInfo
+        from greptimedb_tpu.query.engine import QueryContext
+
+        reader = UserInfo("r", grants=frozenset({"read"}))
+        ctx = QueryContext(db="public", user=reader)
+        with pytest.raises(AuthError):
+            db.execute_one("CREATE VIEW nope AS SELECT 1", ctx)
+        with pytest.raises(AuthError):
+            db.execute_one("DROP VIEW hot", ctx)
+
+    def test_cross_db_view_resolves_in_view_db(self, db):
+        db.execute_one("CREATE DATABASE IF NOT EXISTS db2")
+        from greptimedb_tpu.query.engine import QueryContext
+
+        ctx2 = QueryContext(db="db2")
+        db.execute_one(
+            "CREATE TABLE t2 (h STRING, ts TIMESTAMP(3) NOT NULL,"
+            " x DOUBLE, TIME INDEX (ts), PRIMARY KEY (h))", ctx2)
+        db.execute_one("INSERT INTO t2 VALUES ('z', 1, 42.0)", ctx2)
+        # unqualified 't2' in the definition must resolve in db2
+        db.execute_one("CREATE VIEW db2.v2 AS SELECT h, x FROM t2")
+        assert db.execute_one("SELECT x FROM db2.v2").rows() == [[42.0]]
+
+    def test_create_table_rejects_existing_view_name(self, db):
+        with pytest.raises(Exception, match="exists as a view"):
+            db.execute_one(
+                "CREATE TABLE hot (h STRING, ts TIMESTAMP(3) NOT NULL,"
+                " TIME INDEX (ts), PRIMARY KEY (h))")
+
+    def test_explain_over_view(self, db):
+        r = db.execute_one("EXPLAIN SELECT * FROM hot")
+        text = "\n".join(row[0] for row in r.rows())
+        assert "View: hot AS" in text
+        r = db.execute_one("EXPLAIN ANALYZE SELECT host FROM hot")
+        text = "\n".join(row[0] for row in r.rows())
+        assert "ANALYZE trace=" in text
