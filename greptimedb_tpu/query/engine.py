@@ -117,6 +117,13 @@ class QueryEngine:
         if isinstance(stmt, ast.Delete):
             return self._delete(stmt, ctx)
         if isinstance(stmt, ast.CreateView):
+            if "." in stmt.name:
+                prefix = stmt.name.rsplit(".", 1)[0]
+                if not self.catalog.database_exists(prefix):
+                    # DDL must not silently fold a typo'd db prefix into
+                    # the view name (reads tolerate dotted names; DDL
+                    # creating new objects must be strict)
+                    raise PlanError(f"database {prefix!r} not found")
             db, name = self._db_and_name(stmt.name, ctx)
             # the definition must at least parse and name a single query
             defs = parse_sql(stmt.query_sql)
@@ -231,8 +238,15 @@ class QueryEngine:
         normal engine (device path and all), then evaluate the outer
         select over its columns (reference: views inline into the plan;
         here the view result is the virtual relation)."""
+        from greptimedb_tpu.query import range_select as rs
         from greptimedb_tpu.query.join import execute_select_over
 
+        if rs.is_range_select(sel):
+            # RANGE/ALIGN needs the base table's time-index machinery —
+            # refusing beats silently dropping the alignment semantics
+            raise PlanError(
+                "RANGE ... ALIGN over a view is not supported; query the "
+                "underlying table (or fold the RANGE into the view)")
         inner_stmts = parse_sql(vsql)
         if len(inner_stmts) != 1:
             raise PlanError("view definition must be a single query")
@@ -251,19 +265,21 @@ class QueryEngine:
         base = self._execute_statement(inner_stmts[0], inner_ctx)
         if not base.is_query:
             raise PlanError("view definition is not a query")
+        if len(set(base.names)) != len(base.names):
+            dupes = sorted({n for n in base.names
+                            if base.names.count(n) > 1})
+            raise PlanError(
+                f"view {view_db}.{short} produces duplicate column "
+                f"name(s) {dupes}; alias them in the view definition")
         cols = dict(zip(base.names, base.columns))
         dtypes = dict(zip(base.names, base.dtypes))
         return execute_select_over(self, sel, cols, dtypes,
                                    alias=sel.table_alias or short)
 
     def _table(self, name: str, ctx: QueryContext) -> TableInfo:
-        db = ctx.db
-        if "." in name:
-            # db.table only when the prefix names a real database —
-            # otherwise it's a table name containing dots ("sys.cpu")
-            candidate_db, rest = name.rsplit(".", 1)
-            if self.catalog.database_exists(candidate_db):
-                db, name = candidate_db, rest
+        # db.table only when the prefix names a real database — otherwise
+        # it's a table name containing dots ("sys.cpu")
+        db, name = self._db_and_name(name, ctx)
         info = self.catalog.table(db, name)
         self._ensure_open(info)
         return info
@@ -878,9 +894,13 @@ class QueryEngine:
         )
 
     def _explain(self, stmt: ast.Explain, ctx: QueryContext) -> QueryResult:
-        if isinstance(stmt.inner, ast.Select) and stmt.inner.table is not None:
-            vsql = self._view_sql(stmt.inner.table, ctx) \
-                if not stmt.inner.joins else None
+        if isinstance(stmt.inner, ast.Select) and stmt.inner.joins:
+            sides = [stmt.inner.table] + [j.table for j in stmt.inner.joins]
+            text = "Join: " + " ⋈ ".join(
+                f"{t} (view)" if self._view_sql(t, ctx) is not None else t
+                for t in sides) + "\n  (host hash join over device scans)"
+        elif isinstance(stmt.inner, ast.Select) and stmt.inner.table is not None:
+            vsql = self._view_sql(stmt.inner.table, ctx)
             if vsql is not None:
                 text = (f"View: {stmt.inner.table} AS {vsql}\n"
                         "  (outer select evaluates over the view result)")

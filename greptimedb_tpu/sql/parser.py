@@ -118,6 +118,24 @@ class Parser:
             stmts.append(self.parse_statement())
         return stmts
 
+    def _raw_statement_text(self) -> str:
+        """Consume tokens up to the statement separator (a top-level ';'
+        or eof) and return the raw source slice — used where a statement
+        embeds another language (TQL's PromQL, CREATE VIEW's query). The
+        terminator token's pos is the exact end (eof pos is len(sql))."""
+        start = self.peek().pos
+        depth = 0
+        while self.peek().kind != "eof":
+            t = self.peek()
+            if t.kind == "op" and t.value == ";" and depth == 0:
+                break
+            if t.kind == "op" and t.value == "(":
+                depth += 1
+            if t.kind == "op" and t.value == ")":
+                depth -= 1
+            self.next()
+        return self.sql[start:self.peek().pos].strip()
+
     def parse_statement(self) -> ast.Statement:
         t = self.peek()
         if t.kind != "keyword":
@@ -446,18 +464,7 @@ class Parser:
             self.expect_kw("as")
             # the defining query is kept as raw text (reference stores
             # view definitions the same way, common/meta view keys)
-            start = self.peek().pos
-            depth = 0
-            while self.peek().kind != "eof":
-                t = self.peek()
-                if t.kind == "op" and t.value == ";" and depth == 0:
-                    break
-                if t.kind == "op" and t.value == "(":
-                    depth += 1
-                if t.kind == "op" and t.value == ")":
-                    depth -= 1
-                self.next()
-            query_sql = self.sql[start:self.peek().pos].strip()
+            query_sql = self._raw_statement_text()
             if not query_sql:
                 raise SqlError("CREATE VIEW requires a defining query")
             return ast.CreateView(name, query_sql, or_replace=or_replace,
@@ -735,20 +742,7 @@ class Parser:
         # the rest of the statement (raw text) is PromQL — label matchers
         # ({host=~"web.*"}), durations ([5m]) and strings all pass through
         # verbatim; the slice ends at the statement separator
-        start_pos = self.peek().pos
-        depth = 0
-        while self.peek().kind != "eof":
-            t = self.peek()
-            if t.kind == "op" and t.value == ";" and depth == 0:
-                break
-            if t.kind == "op" and t.value == "(":
-                depth += 1
-            if t.kind == "op" and t.value == ")":
-                depth -= 1
-            self.next()
-        # the terminator token's pos is the exact end of the raw text
-        # (the eof token's pos is len(sql))
-        query = self.sql[start_pos:self.peek().pos].strip()
+        query = self._raw_statement_text()
         return ast.Tql(start, end, step, query, analyze=analyze, explain=explain)
 
     def _tql_number(self) -> float:

@@ -246,7 +246,12 @@ def _encode_batch(batch: RecordBatch) -> bytes:
             metadata=schema.metadata,
         ),
     )
-    with pa.ipc.new_stream(sink, arrow.schema) as w:
+    # LZ4-frame body compression: the IPC stream records it, so replay
+    # transparently reads both compressed and legacy uncompressed frames.
+    # Halves WAL bytes at the fsync boundary (the ingest bottleneck) for
+    # ~GB/s compression cost.
+    opts = pa.ipc.IpcWriteOptions(compression="lz4")
+    with pa.ipc.new_stream(sink, arrow.schema, options=opts) as w:
         w.write_batch(arrow)
     return sink.getvalue().to_pybytes()
 

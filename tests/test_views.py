@@ -123,6 +123,26 @@ class TestReviewRegressions:
                 "CREATE TABLE hot (h STRING, ts TIMESTAMP(3) NOT NULL,"
                 " TIME INDEX (ts), PRIMARY KEY (h))")
 
+    def test_range_over_view_rejected(self, db):
+        with pytest.raises(PlanError, match="RANGE.*view"):
+            db.execute_one(
+                "SELECT ts, max(v) RANGE '5s' FROM hot ALIGN '5s'")
+
+    def test_duplicate_view_columns_rejected(self, db):
+        db.execute_one("CREATE VIEW dup AS SELECT host, host FROM m")
+        with pytest.raises(PlanError, match="duplicate column"):
+            db.execute_one("SELECT * FROM dup")
+
+    def test_create_view_bad_db_prefix(self, db):
+        with pytest.raises(PlanError, match="database 'nodb' not found"):
+            db.execute_one("CREATE VIEW nodb.v AS SELECT 1")
+
+    def test_explain_join_with_view(self, db):
+        r = db.execute_one(
+            "EXPLAIN SELECT * FROM hot JOIN m ON hot.host = m.host")
+        text = "\n".join(row[0] for row in r.rows())
+        assert "hot (view)" in text and "Join:" in text
+
     def test_explain_over_view(self, db):
         r = db.execute_one("EXPLAIN SELECT * FROM hot")
         text = "\n".join(row[0] for row in r.rows())
