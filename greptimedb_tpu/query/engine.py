@@ -9,6 +9,7 @@ layer.
 
 from __future__ import annotations
 
+import dataclasses
 import json
 from typing import Optional, Union
 
@@ -218,6 +219,129 @@ class QueryEngine:
             self._flow_engine = FlowEngine(self)
         return self._flow_engine
 
+    # ---- CTEs / subqueries -------------------------------------------------
+
+    def _with_ctes(self, ctes, ctx: QueryContext) -> QueryContext:
+        """Execute each CTE once and register it as a virtual relation in
+        a copied context; CTEs shadow real tables and are visible to
+        later CTEs, derived tables, and join sides."""
+        ctx2 = ctx.with_db(ctx.db)
+        ctx2.extensions = dict(ctx.extensions)
+        vmap = dict(ctx2.extensions.get("__virtual_tables__") or {})
+        ctx2.extensions["__virtual_tables__"] = vmap
+        for name, stmt, col_names in ctes:
+            r = self._execute_statement(stmt, ctx2)
+            if not r.is_query:
+                raise PlanError(f"CTE {name!r} must be a query")
+            names = list(col_names) if col_names else list(r.names)
+            if col_names and len(col_names) != len(r.names):
+                raise PlanError(
+                    f"CTE {name!r} declares {len(col_names)} columns but "
+                    f"its query returns {len(r.names)}")
+            if len(set(names)) != len(names):
+                raise PlanError(
+                    f"CTE {name!r} produces duplicate column names; "
+                    "alias them in the CTE query")
+            vmap[name.lower()] = (names, list(r.dtypes),
+                                  [np.asarray(c) for c in r.columns])
+        return ctx2
+
+    def _virtual_table(self, table: Optional[str], ctx: QueryContext):
+        if table is None:
+            return None
+        vmap = ctx.extensions.get("__virtual_tables__")
+        return vmap.get(table.lower()) if vmap else None
+
+    def _fold_tree(self, e, ctx: QueryContext):
+        """Replace uncorrelated ast.Subquery nodes with literals by
+        executing them now. Correlated subqueries fail naturally inside
+        with 'unknown column'."""
+        if isinstance(e, ast.Subquery):
+            stmt = e.stmt
+            if e.exists and isinstance(stmt, (ast.Select, ast.Union)) \
+                    and stmt.limit is None:
+                # only row existence matters — don't materialize the rest
+                stmt = dataclasses.replace(stmt, limit=1)
+            r = self._execute_statement(stmt, ctx)
+            if not r.is_query:
+                raise PlanError("subquery must be a query")
+            if e.exists:
+                return ast.Literal(bool(r.num_rows))
+            if len(r.names) != 1:
+                raise PlanError(
+                    "scalar subquery must return exactly one column")
+            if r.num_rows == 0:
+                return ast.Literal(None)
+            if r.num_rows > 1:
+                raise PlanError("scalar subquery returned more than one row")
+            v = r.columns[0][0]
+            v = v.item() if isinstance(v, np.generic) else v
+            return ast.Literal(None if _is_nan_scalar(v) else v)
+        if isinstance(e, ast.InList) and len(e.items) == 1 \
+                and isinstance(e.items[0], ast.Subquery):
+            r = self._execute_statement(e.items[0].stmt, ctx)
+            if len(r.names) != 1:
+                raise PlanError("IN subquery must return exactly one column")
+            vals = [v.item() if isinstance(v, np.generic) else v
+                    for v in r.columns[0].tolist()]
+            nonnull = [v for v in vals
+                       if v is not None and not _is_nan_scalar(v)]
+            if e.negated and len(nonnull) != len(vals):
+                # NOT IN over a list containing NULL is never TRUE
+                # (matched → FALSE, unmatched → UNKNOWN): excludes all rows
+                return ast.Literal(False)
+            expr = self._fold_tree(e.expr, ctx)
+            if not nonnull:
+                # x IN (empty) is FALSE; NOT IN (empty) is TRUE
+                return ast.Literal(bool(e.negated))
+            return ast.InList(expr, tuple(ast.Literal(v) for v in nonnull),
+                              e.negated)
+        if isinstance(e, (list, tuple)):
+            return type(e)(self._fold_tree(x, ctx) for x in e)
+        # descend any expression-carrying dataclass (incl. non-Expr
+        # carriers like WindowSpec) but never into embedded statements —
+        # those execute atomically via the Subquery branch above
+        if dataclasses.is_dataclass(e) and not isinstance(e, type) \
+                and not isinstance(e, ast.Statement):
+            changes = {}
+            for f in dataclasses.fields(e):
+                v = getattr(e, f.name)
+                if isinstance(v, (ast.Expr, list, tuple)) or (
+                        dataclasses.is_dataclass(v)
+                        and not isinstance(v, (type, ast.Statement))):
+                    nv = self._fold_tree(v, ctx)
+                    if nv != v:
+                        changes[f.name] = nv
+            return dataclasses.replace(e, **changes) if changes else e
+        return e
+
+    def _fold_select_subqueries(self, sel: ast.Select,
+                                ctx: QueryContext) -> ast.Select:
+        if not _has_subquery(sel):
+            return sel
+        changes: dict = {
+            "items": [dataclasses.replace(it,
+                                          expr=self._fold_tree(it.expr, ctx))
+                      for it in sel.items]}
+        if sel.where is not None:
+            changes["where"] = self._fold_tree(sel.where, ctx)
+        if sel.having is not None:
+            changes["having"] = self._fold_tree(sel.having, ctx)
+        if sel.group_by:
+            changes["group_by"] = [self._fold_tree(g, ctx)
+                                   for g in sel.group_by]
+        if sel.order_by:
+            changes["order_by"] = [
+                dataclasses.replace(ob, expr=self._fold_tree(ob.expr, ctx))
+                for ob in sel.order_by]
+        if sel.joins:
+            changes["joins"] = [
+                dataclasses.replace(
+                    j, on=self._fold_tree(j.on, ctx)
+                    if j.on is not None else None)
+                for j in sel.joins]
+        return dataclasses.replace(sel, **changes)
+
     # ---- table resolution --------------------------------------------------
 
     def _db_and_name(self, name: str, ctx: QueryContext) -> tuple[str, str]:
@@ -297,7 +421,32 @@ class QueryEngine:
 
     def _select(self, sel: ast.Select, ctx: QueryContext) -> QueryResult:
         from greptimedb_tpu.catalog import information_schema as infoschema
+        from greptimedb_tpu.query.join import execute_select_over
 
+        if sel.ctes:
+            # WITH ...: run each CTE once, visible to later CTEs and the
+            # body (reference: DataFusion CTE planning)
+            ctx = self._with_ctes(sel.ctes, ctx)
+            sel = dataclasses.replace(sel, ctes=[])
+        # uncorrelated scalar/IN/EXISTS subqueries fold to literals
+        # before planning (reference: DataFusion subquery decorrelation)
+        sel = self._fold_select_subqueries(sel, ctx)
+        if sel.from_subquery is not None and not sel.joins:
+            # FROM (SELECT ...) alias — materialize the derived table,
+            # evaluate the outer pipeline over its columns (view path)
+            base = self._execute_statement(sel.from_subquery, ctx)
+            if not base.is_query:
+                raise PlanError("derived table must be a query")
+            return execute_select_over(
+                self, sel, dict(zip(base.names, base.columns)),
+                dict(zip(base.names, base.dtypes)), alias=sel.table_alias)
+        vt = self._virtual_table(sel.table, ctx)
+        if vt is not None and not sel.joins:
+            names, vdtypes, vcols = vt
+            return execute_select_over(
+                self, sel, dict(zip(names, vcols)),
+                dict(zip(names, vdtypes)),
+                alias=sel.table_alias or sel.table)
         if sel.joins:
             # joins first: an information_schema BASE table with joins
             # must not fall into the (join-less) virtual executor — the
@@ -327,7 +476,19 @@ class QueryEngine:
         info = self._table(sel.table, ctx)
         sel = _subst_session_funcs(sel, ctx)
         from greptimedb_tpu.query import range_select as rs
+        from greptimedb_tpu.query.window import select_has_window
 
+        if select_has_window(sel):
+            # window functions: device scan+filter materializes the base
+            # relation, windows evaluate on host over the filtered rows
+            base_sel = ast.Select(items=[ast.SelectItem(ast.Star())],
+                                  table=sel.table, where=sel.where)
+            base = self._select(base_sel, ctx)
+            outer = dataclasses.replace(sel, where=None, table=None)
+            return execute_select_over(
+                self, outer, dict(zip(base.names, base.columns)),
+                dict(zip(base.names, base.dtypes)),
+                alias=sel.table_alias or sel.table)
         if rs.is_range_select(sel):
             rplan = rs.plan_range_select(sel, info)
             return rs.execute_range_select(self.executor, rplan)
@@ -657,6 +818,8 @@ class QueryEngine:
     def _union(self, stmt: ast.Union, ctx: QueryContext) -> QueryResult:
         """UNION [ALL]: concatenate branch results (reference: DataFusion
         set operations); plain UNION dedups whole rows."""
+        if stmt.ctes:
+            ctx = self._with_ctes(stmt.ctes, ctx)
         results = [self._select(b, ctx) for b in stmt.branches]
         first = results[0]
         width = len(first.names)
@@ -1121,3 +1284,32 @@ def _render_type(dt: DataType) -> str:
 
 def _is_nan_scalar(v) -> bool:
     return isinstance(v, float) and v != v
+
+
+def _expr_has_subquery(e) -> bool:
+    if isinstance(e, ast.Subquery):
+        return True
+    if isinstance(e, (list, tuple)):
+        return any(_expr_has_subquery(x) for x in e)
+    if dataclasses.is_dataclass(e) and not isinstance(e, type) \
+            and isinstance(e, ast.Expr):
+        for f in dataclasses.fields(e):
+            v = getattr(e, f.name)
+            if isinstance(v, (ast.Expr, list, tuple)) \
+                    and _expr_has_subquery(v):
+                return True
+    return False
+
+
+def _has_subquery(sel: ast.Select) -> bool:
+    if any(_expr_has_subquery(it.expr) for it in sel.items):
+        return True
+    for e in (sel.where, sel.having):
+        if e is not None and _expr_has_subquery(e):
+            return True
+    if any(_expr_has_subquery(g) for g in sel.group_by):
+        return True
+    if any(_expr_has_subquery(ob.expr) for ob in sel.order_by):
+        return True
+    return any(j.on is not None and _expr_has_subquery(j.on)
+               for j in sel.joins)

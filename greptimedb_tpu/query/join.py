@@ -28,10 +28,16 @@ _AGGS = {"count", "sum", "avg", "min", "max"}
 
 
 def execute_join_select(qe, sel: ast.Select, ctx) -> QueryResult:
-    sides = [(sel.table, sel.table_alias or sel.table)]
+    # each side: (table_name_or_None, alias, derived_subquery_or_None)
+    if sel.from_subquery is not None:
+        if sel.table_alias is None:
+            raise PlanError("derived table in a join requires an alias")
+        sides = [(None, sel.table_alias, sel.from_subquery)]
+    else:
+        sides = [(sel.table, sel.table_alias or sel.table, None)]
     for j in sel.joins:
-        sides.append((j.table, j.alias or j.table))
-    names = [alias for _, alias in sides]
+        sides.append((j.table, j.alias or j.table, j.subquery))
+    names = [alias for _, alias, _ in sides]
     if len(set(names)) != len(names):
         raise PlanError(f"duplicate table alias in join: {names}")
 
@@ -42,12 +48,26 @@ def execute_join_select(qe, sel: ast.Select, ctx) -> QueryResult:
     # join planning)
     conjuncts = _split_conjuncts(sel.where)
     side_cols = _referenced_by_side(sel, sides)
-    # the null-supplying side of an outer join must NOT have WHERE
-    # conjuncts pushed into its scan: `WHERE right.x IS NULL` (anti-join)
-    # would drop the very rows whose absence produces the NULLs
+    # the null-supplying side(s) of an outer join must NOT have WHERE
+    # conjuncts pushed into their scan: `WHERE right.x IS NULL`
+    # (anti-join) would drop the very rows whose absence produces the
+    # NULLs. LEFT → right side; RIGHT/FULL → conservatively all sides
+    # (the accumulated left is a composite).
     unpushable = {j.alias or j.table for j in sel.joins if j.kind == "left"}
+    if any(j.kind in ("right", "full") for j in sel.joins):
+        unpushable = set(names)
     mats = []
-    for table, alias in sides:
+    for table, alias, subq in sides:
+        if subq is not None:
+            r = qe._execute_statement(subq, ctx)
+            if not r.is_query:
+                raise PlanError("derived table must be a query")
+            mats.append({"alias": alias,
+                         "cols": dict(zip(r.names,
+                                          (np.asarray(c)
+                                           for c in r.columns))),
+                         "dtypes": dict(zip(r.names, r.dtypes))})
+            continue
         pushed = [] if alias in unpushable else \
             [_strip_qualifier(c, alias) for c in conjuncts
              if _only_references(c, alias, sides)]
@@ -77,7 +97,8 @@ def execute_join_select(qe, sel: ast.Select, ctx) -> QueryResult:
     joined_cols, joined_dtypes = _qualify(mats[0])
     for j, mat in zip(sel.joins, mats[1:]):
         right_cols, right_dtypes = _qualify(mat)
-        pairs = _equi_pairs(j.on, joined_cols, right_cols)
+        pairs = [] if j.kind == "cross" else \
+            _equi_pairs(j.on, joined_cols, right_cols)
         joined_cols, joined_dtypes = _hash_join(
             joined_cols, joined_dtypes, right_cols, right_dtypes,
             pairs, j.kind)
@@ -110,6 +131,10 @@ def execute_join_select(qe, sel: ast.Select, ctx) -> QueryResult:
         state["n"] = len(idx)
     env_cols = state["cols"]
     n = state["n"]
+
+    from greptimedb_tpu.query.window import rewrite_select, select_has_window
+    if select_has_window(sel):
+        sel = rewrite_select(sel, env_cols, n, resolve)
 
     has_agg = sel.group_by or any(
         _contains_agg(it.expr) for it in sel.items)
@@ -167,6 +192,10 @@ def execute_select_over(qe, sel: ast.Select, base_cols: dict,
     env = state["cols"]
     n = state["n"]
 
+    from greptimedb_tpu.query.window import rewrite_select, select_has_window
+    if select_has_window(sel):
+        sel = rewrite_select(sel, env, n, resolve)
+
     if sel.group_by or any(_contains_agg(it.expr) for it in sel.items):
         return _aggregate(sel, env, dtypes, n, resolve)
 
@@ -215,7 +244,10 @@ def _columns_in(e, out: set):
     elif dataclasses.is_dataclass(e) and not isinstance(e, type):
         for f in dataclasses.fields(e):
             v = getattr(e, f.name)
-            if isinstance(v, (ast.Expr, list, tuple)):
+            # non-Expr expression carriers descend too: FuncCall.over is
+            # a WindowSpec whose PARTITION BY/ORDER BY reference columns
+            if isinstance(v, (ast.Expr, list, tuple)) or (
+                    dataclasses.is_dataclass(v) and not isinstance(v, type)):
                 _columns_in(v, out)
 
 
@@ -254,7 +286,7 @@ def _referenced_by_side(sel, sides) -> dict:
         _columns_in(ob.expr, cols)
     if star or any(t is None for t, _ in cols):
         return {}
-    aliases = {alias for _, alias in sides}
+    aliases = {alias for _, alias, _ in sides}
     if any(t not in aliases for t, _ in cols):
         return {}
     out: dict = {}
@@ -262,7 +294,7 @@ def _referenced_by_side(sel, sides) -> dict:
         out.setdefault(t, set()).add(c)
     # a side nothing references still needs its join keys (covered above
     # via ON) — and at least one column to materialize row count
-    for _, alias in sides:
+    for _, alias, _ in sides:
         out.setdefault(alias, set())
     return out
 
@@ -278,7 +310,8 @@ def _qualify(mat):
 
 def _rewrite_columns(e, repl):
     """Apply `repl` to every Column node, descending dataclass fields AND
-    nested containers (Case.whens is a tuple of (when, then) tuples)."""
+    nested containers (Case.whens is a tuple of (when, then) tuples;
+    FuncCall.over is a WindowSpec carrying PARTITION BY/ORDER BY exprs)."""
     if isinstance(e, ast.Column):
         return repl(e)
     if isinstance(e, (list, tuple)):
@@ -287,7 +320,8 @@ def _rewrite_columns(e, repl):
         changes = {}
         for f in dataclasses.fields(e):
             v = getattr(e, f.name)
-            if isinstance(v, (ast.Expr, list, tuple)):
+            if isinstance(v, (ast.Expr, list, tuple)) or (
+                    dataclasses.is_dataclass(v) and not isinstance(v, type)):
                 nv = _rewrite_columns(v, repl)
                 if nv != v:
                     changes[f.name] = nv
@@ -370,45 +404,66 @@ def _is_nan(v) -> bool:
 
 
 def _hash_join(lcols, ldtypes, rcols, rdtypes, pairs, kind: str):
-    lk = [p[0] for p in pairs]
-    rk = [p[1] for p in pairs]
+    """Hash join of two qualified column dicts. kinds: inner, left,
+    right, full (null-extended on the respective side), cross
+    (cartesian, no pairs)."""
     rn = len(next(iter(rcols.values()))) if rcols else 0
     ln = len(next(iter(lcols.values()))) if lcols else 0
-    table: dict = {}
-    for i in range(rn):
-        key = _key_tuple(rcols, rk, i)
-        if any(k is None for k in key):
-            continue  # NULL never matches in SQL equality
-        table.setdefault(key, []).append(i)
-    li, ri = [], []
-    for i in range(ln):
-        key = _key_tuple(lcols, lk, i)
-        hits = table.get(key) if not any(k is None for k in key) else None
-        if hits:
-            for j in hits:
-                li.append(i)
-                ri.append(j)
-        elif kind == "left":
-            li.append(i)
-            ri.append(-1)  # NULL row
-    li = np.asarray(li, dtype=np.int64)
-    ri = np.asarray(ri, dtype=np.int64)
-    out = {k: np.asarray(v)[li] for k, v in lcols.items()}
-    miss = ri < 0
-    for k, v in rcols.items():
-        v = np.asarray(v)
-        taken = v[np.clip(ri, 0, None)] if len(v) else \
-            np.empty(len(ri), dtype=v.dtype)
-        if miss.any():
-            taken = taken.astype(object)
-            taken[miss] = None
-        out[k] = taken
+    if kind == "cross":
+        li = np.repeat(np.arange(ln, dtype=np.int64), rn)
+        ri = np.tile(np.arange(rn, dtype=np.int64), ln)
+    else:
+        lk = [p[0] for p in pairs]
+        rk = [p[1] for p in pairs]
+        table: dict = {}
+        for i in range(rn):
+            key = _key_tuple(rcols, rk, i)
+            if any(k is None for k in key):
+                continue  # NULL never matches in SQL equality
+            table.setdefault(key, []).append(i)
+        li_l, ri_l = [], []
+        matched_r = np.zeros(rn, dtype=bool)
+        for i in range(ln):
+            key = _key_tuple(lcols, lk, i)
+            hits = table.get(key) if not any(k is None for k in key) else None
+            if hits:
+                for j in hits:
+                    li_l.append(i)
+                    ri_l.append(j)
+                    matched_r[j] = True
+            elif kind in ("left", "full"):
+                li_l.append(i)
+                ri_l.append(-1)  # NULL right row
+        if kind in ("right", "full"):
+            for j in np.flatnonzero(~matched_r):
+                li_l.append(-1)  # NULL left row
+                ri_l.append(int(j))
+        li = np.asarray(li_l, dtype=np.int64)
+        ri = np.asarray(ri_l, dtype=np.int64)
+
+    def take(cols: dict, idx: np.ndarray) -> dict:
+        miss = idx < 0
+        out = {}
+        for k, v in cols.items():
+            v = np.asarray(v)
+            taken = v[np.clip(idx, 0, None)] if len(v) else \
+                np.empty(len(idx), dtype=v.dtype)
+            if miss.any():
+                taken = taken.astype(object)
+                taken[miss] = None
+            out[k] = taken
+        return out
+
+    out = take(lcols, li)
+    out.update(take(rcols, ri))
     dtypes = {**ldtypes, **rdtypes}
     return out, dtypes
 
 
 def _contains_agg(e) -> bool:
     if isinstance(e, ast.FuncCall):
+        if e.over is not None:
+            return False  # sum(x) OVER (...) is a window, not an aggregate
         if e.name.lower() in _AGGS:
             return True
         return any(_contains_agg(a) for a in e.args)

@@ -31,19 +31,22 @@ class QueryResult:
     def of_affected(n: int) -> "QueryResult":
         return QueryResult(affected_rows=n)
 
+    def _format_col(self, dt, col, format_timestamps: bool) -> list:
+        if format_timestamps and dt is not None and dt.is_timestamp:
+            return [None if v is None else format_ts(v, dt)
+                    for v in col.tolist()]
+        return [None if _is_nan(v) else v for v in col.tolist()]
+
     def to_pydict(self, format_timestamps: bool = False) -> dict[str, list]:
-        out: dict[str, list] = {}
-        for name, dt, col in zip(self.names, self.dtypes, self.columns):
-            if format_timestamps and dt is not None and dt.is_timestamp:
-                out[name] = [None if v is None else format_ts(v, dt) for v in col.tolist()]
-            else:
-                vals = col.tolist()
-                out[name] = [None if _is_nan(v) else v for v in vals]
-        return out
+        return {name: self._format_col(dt, col, format_timestamps)
+                for name, dt, col in zip(self.names, self.dtypes,
+                                         self.columns)}
 
     def rows(self) -> list[list]:
-        d = self.to_pydict()
-        cols = [d[n] for n in self.names]
+        # no dict round-trip: duplicate output names (SELECT a.x, b.x)
+        # must stay distinct columns
+        cols = [self._format_col(dt, col, False)
+                for dt, col in zip(self.dtypes, self.columns)]
         return [list(r) for r in zip(*cols)] if cols else []
 
     def column(self, name: str) -> np.ndarray:

@@ -45,6 +45,19 @@ class UnaryOp(Expr):
 
 
 @dataclass(frozen=True)
+class WindowSpec:
+    """OVER (PARTITION BY ... ORDER BY ...) — window functions
+    (reference: DataFusion window exec via sqlparser-rs OVER clause)."""
+
+    partition_by: tuple = ()  # tuple[Expr, ...]
+    order_by: tuple = ()  # tuple[(Expr, asc: bool), ...]
+    # frame text is accepted and normalized but only the two SQL-default
+    # behaviors are executed: whole-partition (no ORDER BY) and
+    # running-to-current-row (with ORDER BY)
+    frame: Optional[str] = None
+
+
+@dataclass(frozen=True)
 class FuncCall(Expr):
     name: str  # lowercased
     args: tuple[Expr, ...] = ()
@@ -52,6 +65,19 @@ class FuncCall(Expr):
     # `agg(x ORDER BY col [ASC|DESC])` — (col_expr, asc); used by
     # first_value/last_value (DataFusion / TSBS lastpoint syntax)
     order_within: Optional[tuple] = None
+    # OVER (...) turns an aggregate/ranking call into a window function
+    over: Optional[WindowSpec] = None
+
+
+@dataclass(frozen=True)
+class Subquery(Expr):
+    """(SELECT ...) in expression position — scalar subquery, IN
+    (SELECT ...), or EXISTS (SELECT ...). Uncorrelated only: the engine
+    folds it to literal(s) before planning (reference: DataFusion
+    subquery decorrelation; TSDB workloads use the uncorrelated forms)."""
+
+    stmt: object  # Select | Union
+    exists: bool = False
 
 
 @dataclass(frozen=True)
@@ -120,12 +146,14 @@ class OrderByItem:
 
 @dataclass
 class Join:
-    """One JOIN clause (kind: inner | left)."""
+    """One JOIN clause (kind: inner | left | right | full | cross).
+    `table` is None when the side is a derived table (`subquery`)."""
 
-    table: str
+    table: Optional[str]
     alias: Optional[str]
     kind: str
-    on: "Expr"
+    on: Optional["Expr"]  # None for CROSS JOIN
+    subquery: Optional["Statement"] = None
 
 
 @dataclass
@@ -146,6 +174,10 @@ class Select(Statement):
     align_to: Optional[Expr] = None
     align_by: list[Expr] = field(default_factory=list)
     range_fill: Optional[str] = None
+    # WITH name AS (...) CTEs in scope for this (outermost) select
+    ctes: list = field(default_factory=list)  # list[(name, Statement)]
+    # FROM (SELECT ...) [AS] alias — derived table; `table` is None
+    from_subquery: Optional["Statement"] = None
 
 
 @dataclass
@@ -240,6 +272,7 @@ class Union(Statement):
     order_by: list = field(default_factory=list)
     limit: Optional[int] = None
     offset: Optional[int] = None
+    ctes: list = field(default_factory=list)  # list[(name, Statement)]
 
 
 @dataclass

@@ -11,6 +11,7 @@ SQL, reference sql TQL extension).
 
 from __future__ import annotations
 
+import dataclasses
 import re
 from typing import Optional
 
@@ -106,6 +107,13 @@ class Parser:
             parts.append(self.ident())
         return ".".join(parts)
 
+    def _at_subquery(self) -> bool:
+        """True when positioned at '(' SELECT|WITH — an expression-level
+        or FROM-level subquery rather than a parenthesized expression."""
+        return (self.peek().kind == "op" and self.peek().value == "("
+                and self.peek(1).kind == "keyword"
+                and self.peek(1).value in ("select", "with"))
+
     # ---- entry -------------------------------------------------------------
 
     def parse_statements(self) -> list[ast.Statement]:
@@ -143,6 +151,8 @@ class Parser:
         if t.value == "select":
             sel = self.parse_select()
             return self._maybe_union(sel)
+        if t.value == "with":
+            return self.parse_with()
         if t.value == "set":
             return self.parse_set()
         if t.value == "create":
@@ -184,6 +194,48 @@ class Parser:
         if t.value == "copy":
             return self.parse_copy()
         raise SqlError(f"unsupported statement start {t.value!r}")
+
+    def parse_with(self) -> ast.Statement:
+        """WITH name [(col, ...)] AS (query), ... SELECT ... — common
+        table expressions (reference: DataFusion CTEs via sqlparser-rs).
+        Each CTE is executed once and visible to later CTEs and the
+        outer query; stored as (name, statement, column_names|None)."""
+        self.expect_kw("with")
+        ctes = []
+        while True:
+            name = self.ident()
+            col_names = None
+            if self.at_op("("):
+                self.next()
+                col_names = []
+                while not self.at_op(")"):
+                    col_names.append(self.ident())
+                    self.eat_op(",")
+                self.expect_op(")")
+            self.expect_kw("as")
+            ctes.append((name, self._parse_subquery_statement(), col_names))
+            if not self.eat_op(","):
+                break
+        if not self.at_kw("select", "with"):
+            raise SqlError(f"expected SELECT after WITH at {self.peek()!r}")
+        stmt = self.parse_statement()
+        if not isinstance(stmt, (ast.Select, ast.Union)):
+            raise SqlError("WITH must introduce a SELECT/UNION query")
+        stmt.ctes = ctes + list(stmt.ctes)
+        return stmt
+
+    def _parse_subquery_statement(self) -> ast.Statement:
+        """'(' SELECT ... | WITH ... ')' — the query inside a derived
+        table, CTE body, or expression subquery."""
+        self.expect_op("(")
+        if self.at_kw("with"):
+            q = self.parse_with()
+        else:
+            if not self.at_kw("select"):
+                raise SqlError(f"expected SELECT at {self.peek()!r}")
+            q = self._maybe_union(self.parse_select())
+        self.expect_op(")")
+        return q
 
     def _maybe_union(self, first: ast.Select) -> ast.Statement:
         """SELECT ... [UNION [ALL] SELECT ...]* — reference set operations
@@ -308,28 +360,32 @@ class Parser:
         sel = ast.Select(items=items)
         sel.distinct = distinct
         if self.eat_kw("from"):
-            sel.table = self.qualified_name()
-            sel.table_alias = self._table_alias()
-            # [INNER|LEFT [OUTER]] JOIN <table> [AS alias] ON <expr>
+            if self.at_op("("):
+                # FROM (SELECT ...) [AS] alias — derived table
+                sel.from_subquery = self._parse_subquery_statement()
+                self.eat_kw("as")
+                sel.table_alias = self._table_alias()
+            else:
+                sel.table = self.qualified_name()
+                sel.table_alias = self._table_alias()
+            # [INNER|LEFT|RIGHT|FULL [OUTER]|CROSS] JOIN <table|(subquery)>
+            #   [AS alias] [ON <expr>]
             while True:
                 kind = None
                 t = self.peek()
-                if t.kind == "ident" and t.value.lower() == "inner":
+                w = t.value.lower() if t.kind == "ident" else ""
+                if w == "inner":
                     self.next()
                     kind = "inner"
-                elif t.kind == "ident" and t.value.lower() == "left":
+                elif w in ("left", "right", "full"):
                     self.next()
                     if self.peek().kind == "ident" \
                             and self.peek().value.lower() == "outer":
                         self.next()
-                    kind = "left"
-                elif t.kind == "ident" and t.value.lower() in (
-                        "right", "full", "cross"):
-                    # must reject loudly: consuming these as table aliases
-                    # would silently run the query as an INNER join
-                    raise SqlError(
-                        f"{t.value.upper()} JOIN is not supported "
-                        "(INNER and LEFT [OUTER] are)")
+                    kind = w
+                elif w == "cross":
+                    self.next()
+                    kind = "cross"
                 t = self.peek()
                 if t.kind == "ident" and t.value.lower() == "join":
                     self.next()
@@ -337,11 +393,23 @@ class Parser:
                     raise SqlError(f"expected JOIN at {self.peek()!r}")
                 else:
                     break
-                jt = self.qualified_name()
+                jsub = None
+                jt = None
+                if self.at_op("("):
+                    jsub = self._parse_subquery_statement()
+                else:
+                    jt = self.qualified_name()
+                self.eat_kw("as")
                 jalias = self._table_alias()
-                self.expect_kw("on")
+                if jsub is not None and jalias is None:
+                    raise SqlError("derived table in JOIN requires an alias")
+                if kind == "cross":
+                    on = None
+                else:
+                    self.expect_kw("on")
+                    on = self.parse_expr()
                 sel.joins.append(
-                    ast.Join(jt, jalias, kind or "inner", self.parse_expr()))
+                    ast.Join(jt, jalias, kind or "inner", on, subquery=jsub))
         if self.eat_kw("where"):
             sel.where = self.parse_expr()
         # RANGE ... ALIGN extension: ALIGN <interval> [TO <expr>] [BY (cols)] [FILL x]
@@ -821,6 +889,11 @@ class Parser:
                 left = ast.Between(left, low, high)
             elif self.at_kw("in"):
                 self.next()
+                if self._at_subquery():
+                    left = ast.InList(
+                        left,
+                        (ast.Subquery(self._parse_subquery_statement()),))
+                    continue
                 self.expect_op("(")
                 items = [self.parse_expr()]
                 while self.eat_op(","):
@@ -835,6 +908,12 @@ class Parser:
                 inner = self.peek().value
                 if inner == "in":
                     self.next()
+                    if self._at_subquery():
+                        left = ast.InList(
+                            left,
+                            (ast.Subquery(self._parse_subquery_statement()),),
+                            negated=True)
+                        continue
                     self.expect_op("(")
                     items = [self.parse_expr()]
                     while self.eat_op(","):
@@ -894,11 +973,18 @@ class Parser:
             self.next()
             return ast.Literal(t.value)
         if t.kind == "op" and t.value == "(":
+            if self._at_subquery():
+                return ast.Subquery(self._parse_subquery_statement())
             self.next()
             e = self.parse_expr()
             self.expect_op(")")
             return e
         if t.kind == "keyword":
+            if t.value == "exists" and self.peek(1).kind == "op" \
+                    and self.peek(1).value == "(":
+                self.next()
+                return ast.Subquery(self._parse_subquery_statement(),
+                                    exists=True)
             if t.value == "null":
                 self.next()
                 return ast.Literal(None)
@@ -937,7 +1023,8 @@ class Parser:
                 if self.at_op("*"):
                     self.next()
                     self.expect_op(")")
-                    return ast.FuncCall(name.lower(), (ast.Star(),))
+                    return self._maybe_over(
+                        ast.FuncCall(name.lower(), (ast.Star(),)))
                 distinct = self.eat_kw("distinct")
                 args: list[ast.Expr] = []
                 order_within = None
@@ -957,14 +1044,59 @@ class Parser:
                     args.append(self.parse_expr())
                     self.eat_op(",")
                 self.expect_op(")")
-                return ast.FuncCall(name.lower(), tuple(args), distinct,
-                                    order_within=order_within)
+                return self._maybe_over(
+                    ast.FuncCall(name.lower(), tuple(args), distinct,
+                                 order_within=order_within))
             if self.at_op("."):
                 self.next()
                 col = self.ident()
                 return ast.Column(col, table=name)
             return ast.Column(name)
         raise SqlError(f"unexpected token {t!r} in expression")
+
+    def _maybe_over(self, fc: ast.FuncCall) -> ast.FuncCall:
+        """fc OVER (PARTITION BY ... ORDER BY ... [frame]) — window
+        function call (reference: DataFusion window functions)."""
+        t = self.peek()
+        if not (t.kind == "ident" and t.value.lower() == "over"):
+            return fc
+        self.next()
+        self.expect_op("(")
+        partition_by: list[ast.Expr] = []
+        order_by: list[tuple] = []
+        if self.eat_kw("partition"):
+            self.expect_kw("by")
+            partition_by.append(self.parse_expr())
+            while self.eat_op(","):
+                partition_by.append(self.parse_expr())
+        if self.eat_kw("order"):
+            self.expect_kw("by")
+            while True:
+                it = self.parse_order_item()
+                order_by.append((it.expr, it.asc))
+                if not self.eat_op(","):
+                    break
+        frame = None
+        t = self.peek()
+        if (t.kind in ("ident", "keyword")
+                and t.value.lower() in ("rows", "range", "groups")):
+            # frame clause: keep the raw text; execution honors the two
+            # SQL-default behaviors plus explicit unbounded-following
+            start = t.pos
+            depth = 0
+            while not (self.at_op(")") and depth == 0):
+                if self.at_op("("):
+                    depth += 1
+                elif self.at_op(")"):
+                    depth -= 1
+                if self.peek().kind == "eof":
+                    raise SqlError("unterminated window frame clause")
+                self.next()
+            frame = self.sql[start:self.peek().pos].strip().lower()
+        self.expect_op(")")
+        return dataclasses.replace(
+            fc, over=ast.WindowSpec(tuple(partition_by), tuple(order_by),
+                                    frame))
 
     def parse_interval_literal(self) -> ast.Interval:
         t = self.next()

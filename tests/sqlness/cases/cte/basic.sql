@@ -1,0 +1,21 @@
+-- WITH: common table expressions (reference: DataFusion CTEs)
+CREATE TABLE cpu (host STRING, usage_user DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(host));
+
+INSERT INTO cpu VALUES ('a', 10.0, 1000), ('a', 20.0, 2000), ('b', 5.0, 1000), ('b', 50.0, 2000), ('c', 7.0, 1000);
+
+WITH hot AS (SELECT host, usage_user FROM cpu WHERE usage_user > 9)
+SELECT host, count(*) AS c FROM hot GROUP BY host ORDER BY host;
+
+-- a CTE can rename columns and reference an earlier CTE
+WITH t(h, u) AS (SELECT host, usage_user FROM cpu WHERE ts = 1000),
+     m AS (SELECT max(u) AS mu FROM t)
+SELECT mu FROM m;
+
+-- CTEs shadow real tables
+WITH cpu AS (SELECT 1 AS one) SELECT * FROM cpu;
+
+-- CTE joined against a base table
+WITH agg AS (SELECT host, max(usage_user) AS mx FROM cpu GROUP BY host)
+SELECT agg.host, agg.mx FROM agg JOIN cpu ON agg.host = cpu.host AND agg.mx = cpu.usage_user ORDER BY agg.host;
+
+DROP TABLE cpu;

@@ -1,0 +1,21 @@
+-- RIGHT / FULL / CROSS joins
+CREATE TABLE m (host STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(host));
+
+CREATE TABLE d (host STRING, dc STRING, ts TIMESTAMP TIME INDEX, PRIMARY KEY(host));
+
+INSERT INTO m VALUES ('a', 1.0, 1000), ('c', 3.0, 1000);
+
+INSERT INTO d VALUES ('a', 'east', 0), ('z', 'north', 0);
+
+SELECT m.host, d.host, d.dc FROM m RIGHT JOIN d ON m.host = d.host ORDER BY d.host;
+
+SELECT m.host, d.host FROM m FULL OUTER JOIN d ON m.host = d.host ORDER BY m.v;
+
+SELECT count(*) AS n FROM m CROSS JOIN d;
+
+-- anti-join: rows on the right with no left match
+SELECT d.host FROM m RIGHT JOIN d ON m.host = d.host WHERE m.host IS NULL;
+
+DROP TABLE m;
+
+DROP TABLE d;

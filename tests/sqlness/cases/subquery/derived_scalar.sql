@@ -1,0 +1,26 @@
+-- derived tables + scalar/IN/EXISTS subqueries
+CREATE TABLE cpu (host STRING, usage_user DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(host));
+
+INSERT INTO cpu VALUES ('a', 10.0, 1000), ('a', 20.0, 2000), ('b', 5.0, 1000), ('b', 50.0, 2000), ('c', 7.0, 1000);
+
+-- FROM (SELECT ...) alias: TSBS groupby-orderby-limit shape
+SELECT * FROM (SELECT host, avg(usage_user) AS au FROM cpu GROUP BY host) x ORDER BY au DESC LIMIT 2;
+
+-- scalar subquery in WHERE
+SELECT host, usage_user FROM cpu WHERE usage_user = (SELECT max(usage_user) FROM cpu);
+
+-- scalar subquery in projection
+SELECT (SELECT min(usage_user) FROM cpu) + 1 AS lo;
+
+-- IN / NOT IN subqueries
+SELECT DISTINCT host FROM cpu WHERE host IN (SELECT host FROM cpu WHERE usage_user > 15) ORDER BY host;
+
+SELECT DISTINCT host FROM cpu WHERE host NOT IN (SELECT host FROM cpu WHERE usage_user > 15) ORDER BY host;
+
+-- EXISTS
+SELECT count(*) AS n FROM cpu WHERE EXISTS (SELECT 1 FROM cpu WHERE usage_user > 40);
+
+-- scalar subquery with more than one row is an error
+SELECT 1 WHERE 1 = (SELECT usage_user FROM cpu);
+
+DROP TABLE cpu;

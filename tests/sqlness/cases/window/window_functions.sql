@@ -1,0 +1,35 @@
+-- window functions (reference: DataFusion WindowAggExec)
+CREATE TABLE cpu (host STRING, usage_user DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(host));
+
+INSERT INTO cpu VALUES ('a', 10.0, 1000), ('a', 20.0, 2000), ('a', 30.0, 3000), ('b', 5.0, 1000), ('b', 50.0, 2000);
+
+SELECT host, usage_user, row_number() OVER (PARTITION BY host ORDER BY ts) AS rn FROM cpu ORDER BY host, rn;
+
+-- lastpoint via row_number in a derived table
+SELECT host, usage_user FROM (
+  SELECT host, usage_user, row_number() OVER (PARTITION BY host ORDER BY ts DESC) AS rn FROM cpu
+) t WHERE rn = 1 ORDER BY host;
+
+-- running sum and whole-partition average
+SELECT ts, sum(usage_user) OVER (PARTITION BY host ORDER BY ts) AS rs FROM cpu WHERE host = 'a' ORDER BY ts;
+
+SELECT DISTINCT host, avg(usage_user) OVER (PARTITION BY host) AS pa FROM cpu ORDER BY host;
+
+-- lag / lead navigation
+SELECT ts, lag(usage_user) OVER (PARTITION BY host ORDER BY ts) AS prev,
+       lead(usage_user) OVER (PARTITION BY host ORDER BY ts) AS nxt
+FROM cpu WHERE host = 'a' ORDER BY ts;
+
+-- rank with ties
+CREATE TABLE s (v DOUBLE, ts TIMESTAMP TIME INDEX);
+
+INSERT INTO s VALUES (10.0, 1), (10.0, 2), (20.0, 3);
+
+SELECT v, rank() OVER (ORDER BY v) AS rk, dense_rank() OVER (ORDER BY v) AS dr FROM s ORDER BY ts;
+
+-- window + GROUP BY in one select is rejected
+SELECT host, row_number() OVER (ORDER BY host) FROM cpu GROUP BY host;
+
+DROP TABLE s;
+
+DROP TABLE cpu;

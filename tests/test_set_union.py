@@ -72,8 +72,10 @@ class TestUnion:
 
 
 class TestReviewRegressions:
-    def test_right_join_rejected_loudly(self, db):
-        with pytest.raises(Exception, match="RIGHT JOIN is not supported"):
+    def test_self_join_without_alias_rejected(self, db):
+        # RIGHT JOIN is supported now; a self-join still needs distinct
+        # aliases so column references are unambiguous
+        with pytest.raises(Exception, match="duplicate table alias"):
             db.execute_one(
                 "SELECT * FROM t RIGHT JOIN t ON h = h")
 

@@ -1,0 +1,317 @@
+"""CTEs, derived tables, window functions, FULL/RIGHT/CROSS joins,
+and expression subqueries (reference: DataFusion SQL surface via the
+forked sqlparser-rs, src/query/src/datafusion.rs:66)."""
+
+import math
+
+import pytest
+
+from greptimedb_tpu.catalog import Catalog, MemoryKv
+from greptimedb_tpu.query import QueryEngine
+from greptimedb_tpu.query.expr import PlanError
+from greptimedb_tpu.storage import RegionEngine
+from greptimedb_tpu.storage.engine import EngineConfig
+
+
+@pytest.fixture()
+def db(tmp_path):
+    engine = RegionEngine(EngineConfig(data_dir=str(tmp_path)))
+    qe = QueryEngine(Catalog(MemoryKv()), engine)
+    qe.execute_one(
+        "CREATE TABLE cpu (host STRING, ts TIMESTAMP(3) NOT NULL,"
+        " usage DOUBLE, TIME INDEX (ts), PRIMARY KEY (host))")
+    qe.execute_one(
+        "INSERT INTO cpu VALUES"
+        " ('a', 1000, 10.0), ('a', 2000, 20.0), ('a', 3000, 30.0),"
+        " ('b', 1000, 5.0), ('b', 2000, 50.0), ('c', 1000, 7.0)")
+    qe.execute_one(
+        "CREATE TABLE dim (host STRING, ts TIMESTAMP(3) NOT NULL,"
+        " dc STRING, TIME INDEX (ts), PRIMARY KEY (host))")
+    qe.execute_one(
+        "INSERT INTO dim VALUES ('a', 0, 'east'), ('b', 0, 'west'),"
+        " ('z', 0, 'north')")
+    yield qe
+    engine.close()
+
+
+class TestCte:
+    def test_basic(self, db):
+        r = db.execute_one(
+            "WITH hot AS (SELECT host, usage FROM cpu WHERE usage > 15) "
+            "SELECT host, count(*) c FROM hot GROUP BY host ORDER BY host")
+        assert r.rows() == [["a", 2], ["b", 1]]
+
+    def test_cte_column_rename(self, db):
+        r = db.execute_one(
+            "WITH t(h, u) AS (SELECT host, usage FROM cpu WHERE ts = 1000) "
+            "SELECT h, u FROM t ORDER BY h")
+        assert r.rows() == [["a", 10.0], ["b", 5.0], ["c", 7.0]]
+
+    def test_cte_sees_earlier_cte(self, db):
+        r = db.execute_one(
+            "WITH t AS (SELECT usage FROM cpu WHERE host = 'a'), "
+            "u AS (SELECT max(usage) m FROM t) SELECT m FROM u")
+        assert r.rows() == [[30.0]]
+
+    def test_cte_shadows_table(self, db):
+        r = db.execute_one(
+            "WITH cpu AS (SELECT 1 one) SELECT * FROM cpu")
+        assert r.rows() == [[1]]
+
+    def test_cte_in_join(self, db):
+        r = db.execute_one(
+            "WITH agg AS (SELECT host, max(usage) mx FROM cpu GROUP BY host) "
+            "SELECT agg.host, agg.mx, dim.dc FROM agg JOIN dim "
+            "ON agg.host = dim.host ORDER BY agg.host")
+        assert r.rows() == [["a", 30.0, "east"], ["b", 50.0, "west"]]
+
+    def test_cte_union_body(self, db):
+        r = db.execute_one(
+            "WITH t AS (SELECT 1 a) SELECT a FROM t UNION ALL "
+            "SELECT a FROM t")
+        assert r.rows() == [[1], [1]]
+
+
+class TestDerivedTable:
+    def test_from_subquery(self, db):
+        r = db.execute_one(
+            "SELECT d.host, d.mx FROM "
+            "(SELECT host, max(usage) mx FROM cpu GROUP BY host) d "
+            "WHERE d.mx > 10 ORDER BY d.mx")
+        assert r.rows() == [["a", 30.0], ["b", 50.0]]
+
+    def test_from_subquery_agg_over(self, db):
+        # TSBS groupby-orderby-limit shape: aggregate, then outer
+        # order/limit over the derived relation
+        r = db.execute_one(
+            "SELECT * FROM (SELECT host, avg(usage) au FROM cpu "
+            "GROUP BY host) x ORDER BY au DESC LIMIT 2")
+        assert r.rows() == [["b", 27.5], ["a", 20.0]]
+
+    def test_join_derived_side(self, db):
+        r = db.execute_one(
+            "SELECT dim.dc, t.mx FROM dim JOIN "
+            "(SELECT host, max(usage) mx FROM cpu GROUP BY host) t "
+            "ON dim.host = t.host ORDER BY t.mx")
+        assert r.rows() == [["east", 30.0], ["west", 50.0]]
+
+    def test_nested_derived(self, db):
+        r = db.execute_one(
+            "SELECT * FROM (SELECT * FROM (SELECT host FROM cpu "
+            "WHERE usage > 40) a) b")
+        assert r.rows() == [["b"]]
+
+
+class TestSubqueryExprs:
+    def test_scalar_subquery(self, db):
+        r = db.execute_one(
+            "SELECT host, usage FROM cpu "
+            "WHERE usage = (SELECT max(usage) FROM cpu)")
+        assert r.rows() == [["b", 50.0]]
+
+    def test_scalar_subquery_in_projection(self, db):
+        r = db.execute_one("SELECT (SELECT min(usage) FROM cpu) + 1")
+        assert r.rows() == [[6.0]]
+
+    def test_in_subquery(self, db):
+        r = db.execute_one(
+            "SELECT DISTINCT host FROM cpu WHERE host IN "
+            "(SELECT host FROM dim WHERE dc = 'east') ORDER BY host")
+        assert r.rows() == [["a"]]
+
+    def test_not_in_subquery(self, db):
+        r = db.execute_one(
+            "SELECT DISTINCT host FROM cpu WHERE host NOT IN "
+            "(SELECT host FROM dim) ORDER BY host")
+        assert r.rows() == [["c"]]
+
+    def test_in_empty_subquery(self, db):
+        r = db.execute_one(
+            "SELECT count(*) c FROM cpu WHERE host IN "
+            "(SELECT host FROM dim WHERE dc = 'nope')")
+        assert r.rows() == [[0]]
+
+    def test_exists(self, db):
+        r = db.execute_one(
+            "SELECT count(*) c FROM cpu WHERE EXISTS "
+            "(SELECT 1 FROM dim WHERE dc = 'east')")
+        assert r.rows() == [[6]]
+
+    def test_scalar_subquery_multirow_rejected(self, db):
+        with pytest.raises(PlanError, match="more than one row"):
+            db.execute_one(
+                "SELECT 1 WHERE 1 = (SELECT usage FROM cpu)")
+
+
+class TestOuterJoins:
+    def test_right_join(self, db):
+        r = db.execute_one(
+            "SELECT dim.host, dim.dc, cpu.usage FROM cpu "
+            "RIGHT JOIN dim ON cpu.host = dim.host "
+            "WHERE cpu.usage IS NULL")
+        assert r.rows() == [["z", "north", None]]
+
+    def test_full_join(self, db):
+        r = db.execute_one(
+            "SELECT count(*) c FROM cpu FULL OUTER JOIN dim "
+            "ON cpu.host = dim.host")
+        # 6 cpu rows (a,b matched; c unmatched) + unmatched dim row z
+        assert r.rows() == [[7]]
+
+    def test_full_join_unmatched_both(self, db):
+        r = db.execute_one(
+            "SELECT cpu.host, dim.host FROM cpu FULL JOIN dim "
+            "ON cpu.host = dim.host "
+            "WHERE cpu.host IS NULL OR dim.host IS NULL")
+        rows = r.rows()
+        assert [None, "z"] in rows
+        assert ["c", None] in rows
+
+    def test_cross_join(self, db):
+        r = db.execute_one(
+            "SELECT count(*) c FROM cpu CROSS JOIN dim")
+        assert r.rows() == [[18]]
+
+
+class TestWindowFunctions:
+    def test_row_number(self, db):
+        r = db.execute_one(
+            "SELECT host, usage, row_number() OVER "
+            "(PARTITION BY host ORDER BY ts) rn FROM cpu "
+            "ORDER BY host, rn")
+        assert r.rows() == [
+            ["a", 10.0, 1], ["a", 20.0, 2], ["a", 30.0, 3],
+            ["b", 5.0, 1], ["b", 50.0, 2], ["c", 7.0, 1]]
+
+    def test_row_number_desc_limit(self, db):
+        # lastpoint shape: newest row per series via row_number
+        r = db.execute_one(
+            "SELECT host, usage FROM ("
+            "SELECT host, usage, row_number() OVER "
+            "(PARTITION BY host ORDER BY ts DESC) rn FROM cpu) t "
+            "WHERE rn = 1 ORDER BY host")
+        assert r.rows() == [["a", 30.0], ["b", 50.0], ["c", 7.0]]
+
+    def test_rank_dense_rank(self, db):
+        db.execute_one(
+            "CREATE TABLE s (ts TIMESTAMP(3) NOT NULL, v DOUBLE,"
+            " TIME INDEX (ts))")
+        db.execute_one(
+            "INSERT INTO s VALUES (1, 10.), (2, 10.), (3, 20.), (4, 30.)")
+        r = db.execute_one(
+            "SELECT v, rank() OVER (ORDER BY v) rk, "
+            "dense_rank() OVER (ORDER BY v) dr FROM s ORDER BY ts")
+        assert r.rows() == [[10.0, 1, 1], [10.0, 1, 1],
+                            [20.0, 3, 2], [30.0, 4, 3]]
+
+    def test_lag_lead(self, db):
+        r = db.execute_one(
+            "SELECT ts, lag(usage) OVER (PARTITION BY host ORDER BY ts) "
+            "prev, lead(usage) OVER (PARTITION BY host ORDER BY ts) nxt "
+            "FROM cpu WHERE host = 'a' ORDER BY ts")
+        assert r.rows() == [[1000, None, 20.0], [2000, 10.0, 30.0],
+                            [3000, 20.0, None]]
+
+    def test_lag_offset_default(self, db):
+        r = db.execute_one(
+            "SELECT lag(usage, 2, -1) OVER (ORDER BY ts, host) l "
+            "FROM cpu WHERE host = 'a' ORDER BY ts")
+        assert r.rows() == [[-1], [-1], [10.0]]
+
+    def test_running_sum(self, db):
+        r = db.execute_one(
+            "SELECT ts, sum(usage) OVER (PARTITION BY host ORDER BY ts) s "
+            "FROM cpu WHERE host = 'a' ORDER BY ts")
+        assert r.rows() == [[1000, 10.0], [2000, 30.0], [3000, 60.0]]
+
+    def test_whole_partition_agg(self, db):
+        r = db.execute_one(
+            "SELECT DISTINCT host, avg(usage) OVER (PARTITION BY host) a "
+            "FROM cpu ORDER BY host")
+        assert r.rows() == [["a", 20.0], ["b", 27.5], ["c", 7.0]]
+
+    def test_unbounded_following_frame(self, db):
+        r = db.execute_one(
+            "SELECT ts, sum(usage) OVER (PARTITION BY host ORDER BY ts "
+            "ROWS BETWEEN UNBOUNDED PRECEDING AND UNBOUNDED FOLLOWING) s "
+            "FROM cpu WHERE host = 'a' ORDER BY ts")
+        assert r.rows() == [[1000, 60.0], [2000, 60.0], [3000, 60.0]]
+
+    def test_first_last_value(self, db):
+        r = db.execute_one(
+            "SELECT ts, first_value(usage) OVER (PARTITION BY host "
+            "ORDER BY ts) f, last_value(usage) OVER (PARTITION BY host "
+            "ORDER BY ts ROWS BETWEEN UNBOUNDED PRECEDING AND UNBOUNDED "
+            "FOLLOWING) l FROM cpu WHERE host = 'a' ORDER BY ts")
+        assert r.rows() == [[1000, 10.0, 30.0], [2000, 10.0, 30.0],
+                            [3000, 10.0, 30.0]]
+
+    def test_peer_sharing_range_frame(self, db):
+        db.execute_one(
+            "CREATE TABLE p (ts TIMESTAMP(3) NOT NULL, k BIGINT,"
+            " v DOUBLE, TIME INDEX (ts))")
+        db.execute_one(
+            "INSERT INTO p VALUES (1, 1, 1.), (2, 1, 2.), (3, 2, 4.)")
+        # default RANGE frame: peers (same ORDER BY key) share the sum
+        r = db.execute_one(
+            "SELECT ts, sum(v) OVER (ORDER BY k) s FROM p ORDER BY ts")
+        assert r.rows() == [[1, 3.0], [2, 3.0], [3, 7.0]]
+
+    def test_window_over_view(self, db):
+        db.execute_one("CREATE VIEW va AS SELECT host, ts, usage FROM cpu")
+        r = db.execute_one(
+            "SELECT host, row_number() OVER (PARTITION BY host "
+            "ORDER BY ts) rn FROM va WHERE host = 'b' ORDER BY rn")
+        assert r.rows() == [["b", 1], ["b", 2]]
+
+    def test_window_with_group_by_rejected(self, db):
+        with pytest.raises(PlanError, match="GROUP BY"):
+            db.execute_one(
+                "SELECT host, row_number() OVER (ORDER BY host) FROM cpu "
+                "GROUP BY host")
+
+    def test_ntile(self, db):
+        r = db.execute_one(
+            "SELECT usage, ntile(2) OVER (ORDER BY usage) b FROM cpu "
+            "ORDER BY usage")
+        assert [row[1] for row in r.rows()] == [1, 1, 1, 2, 2, 2]
+
+    def test_windowed_count_star(self, db):
+        r = db.execute_one(
+            "SELECT DISTINCT host, count(*) OVER (PARTITION BY host) c "
+            "FROM cpu ORDER BY host")
+        assert r.rows() == [["a", 3], ["b", 2], ["c", 1]]
+
+    def test_window_in_join_prunes_over_columns(self, db):
+        # PARTITION BY/ORDER BY columns referenced only inside OVER()
+        # must survive join-side column pruning
+        r = db.execute_one(
+            "SELECT cpu.ts, sum(cpu.usage) OVER (PARTITION BY cpu.host "
+            "ORDER BY cpu.ts) s FROM cpu JOIN dim ON cpu.host = dim.host "
+            "WHERE cpu.host = 'a' ORDER BY cpu.ts")
+        assert [row[1] for row in r.rows()] == [10.0, 30.0, 60.0]
+
+    def test_unsupported_frame_rejected(self, db):
+        # executing a moving-window frame as a running frame would be
+        # silently wrong — it must error instead
+        with pytest.raises(PlanError, match="frame"):
+            db.execute_one(
+                "SELECT sum(usage) OVER (ORDER BY ts ROWS BETWEEN 1 "
+                "PRECEDING AND CURRENT ROW) FROM cpu")
+
+    def test_nth_value_bad_position(self, db):
+        with pytest.raises(PlanError, match="nth_value"):
+            db.execute_one(
+                "SELECT nth_value(usage, 0) OVER (ORDER BY ts) FROM cpu")
+
+    def test_not_in_subquery_with_null(self, db):
+        # NOT IN over a list containing NULL is never TRUE (SQL
+        # three-valued logic): all rows excluded
+        db.execute_one(
+            "CREATE TABLE nn (ts TIMESTAMP(3) NOT NULL, x DOUBLE,"
+            " TIME INDEX (ts))")
+        db.execute_one("INSERT INTO nn VALUES (1, 10.0), (2, NULL)")
+        r = db.execute_one(
+            "SELECT count(*) c FROM cpu WHERE usage NOT IN "
+            "(SELECT x FROM nn)")
+        assert r.rows() == [[0]]
