@@ -1,11 +1,17 @@
 #!/usr/bin/env python
-"""TSBS-style benchmark: double-groupby-all (the north-star metric,
-BASELINE.md — reference GreptimeDB v0.8.0: 2215.44 ms on 8-core local).
+"""TSBS-style benchmark suite covering every BASELINE.json tracked config.
 
-Workload (mirrors TSBS devops `cpu-only` double-groupby-all): `cpu` table
-with 10 DOUBLE usage fields; query = avg of all 10 fields GROUP BY
-(hour bucket, hostname) over a 12h window. Dataset: HOSTS hosts sampled
-every 10s for 12h (default 4000 hosts -> 17.28M rows x 10 fields).
+Headline metric stays double-groupby-all (the north star, BASELINE.md —
+reference GreptimeDB v0.8.0: 2215.44 ms local). The other tracked axes
+run in the same process and land in detail.configs:
+
+  1. single_groupby_1_1_1  — 1 field, 1 host, 1h @1m buckets (15.70 ms ref)
+  2. double_groupby_all    — avg of 10 fields by (hour, hostname) (2215.44)
+  3. lastpoint             — newest row per host via last_value (6756.12)
+  4. high_cpu_all          — full-scan filter usage_user > 90 (5402.31)
+  5. promql_rate           — TQL rate() over PROM_SERIES series @15s
+  6. high_cardinality      — segment-sum over HC_COMBOS tag combos
+  7. compaction_reencode   — L0→L1 merge re-encode throughput (rows/s)
 
 Pipeline measured end-to-end through the SQL engine: SQL parse -> plan ->
 region scan (SST/memtable) -> device blocks -> fused filter+group+segment
@@ -13,8 +19,12 @@ reduction kernel -> host result assembly. Median of repeated runs after one
 warm-up, matching the reference's warm-page-cache TSBS methodology (here
 the warm cache is HBM-resident column blocks).
 
+When the accelerator backend is live, one double-groupby run is captured
+under jax.profiler (trace dir in detail.profile_dir) for MFU/bandwidth
+analysis.
+
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "ms", "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": "ms", "vs_baseline": N, "detail": ...}
 vs_baseline > 1 means faster than the reference's 2215.44 ms.
 """
 
@@ -31,7 +41,12 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-BASELINE_MS = 2215.44  # BASELINE.md double-groupby-all, local 8c
+# BASELINE.md reference numbers (v0.8.0, local 8-core)
+BASELINE_MS = 2215.44           # double-groupby-all
+BASE_SINGLE_MS = 15.70          # single-groupby-1-1-1
+BASE_LASTPOINT_MS = 6756.12     # lastpoint
+BASE_HIGH_CPU_MS = 5402.31      # high-cpu-all
+BASE_INGEST_ROWS_S = 315369.66  # TSBS ingest rate
 
 INIT_RETRIES = int(os.environ.get("BENCH_INIT_RETRIES", "3"))
 INIT_TIMEOUT_S = int(os.environ.get("BENCH_INIT_TIMEOUT_S", "90"))
@@ -40,13 +55,26 @@ HOSTS = int(os.environ.get("BENCH_HOSTS", "4000"))
 HOURS = int(os.environ.get("BENCH_HOURS", "12"))
 STEP_S = int(os.environ.get("BENCH_STEP_S", "10"))
 REPEATS = int(os.environ.get("BENCH_REPEATS", "3"))
+PROM_SERIES = int(os.environ.get("BENCH_PROM_SERIES", "10000"))
+PROM_HOURS = int(os.environ.get("BENCH_PROM_HOURS", "4"))
+HC_COMBOS = int(os.environ.get("BENCH_HC_COMBOS", "1000000"))
+HC_POINTS = int(os.environ.get("BENCH_HC_POINTS", "10"))
+COMPACT_ROWS = int(os.environ.get("BENCH_COMPACT_ROWS", "4000000"))
+# comma-separated subset, e.g. BENCH_CONFIGS=double_groupby_all,lastpoint
+CONFIGS = [c for c in os.environ.get("BENCH_CONFIGS", "").split(",") if c]
 FIELDS = [f"usage_{n}" for n in (
     "user", "system", "idle", "nice", "iowait", "irq", "softirq",
     "steal", "guest", "guest_nice")]
 
+T0_MS = 1456790400000  # 2016-03-01T00:00:00Z
+
 
 def log(msg):
     print(msg, file=sys.stderr, flush=True)
+
+
+def enabled(name):
+    return not CONFIGS or name in CONFIGS
 
 
 def build_db(data_dir):
@@ -105,6 +133,212 @@ def ingest(engine, qe, t0_ms):
     return rows_total, ingest_s
 
 
+def timed_sql(qe, sql, repeats=None, expect_rows=None):
+    """Warm-up once (compile + HBM cache fill), then median of repeats.
+    The warm-up runs under a fresh trace so its cost decomposes into
+    engine spans (scan/aggregate/...) — distinguishing XLA compile time
+    from SST read + decode when diagnosing cold starts."""
+    from greptimedb_tpu.session import QueryContext
+    from greptimedb_tpu.utils import tracing
+
+    tid = tracing.new_trace_id()
+    t = time.perf_counter()
+    r = qe.execute_one(sql, QueryContext(trace_id=tid))
+    warm_ms = (time.perf_counter() - t) * 1000
+    spans = {}
+    for s in tracing.spans_for(tid):
+        spans[s.name] = round(spans.get(s.name, 0.0) + s.duration_ms, 1)
+    if expect_rows is not None:
+        assert r.num_rows == expect_rows, (r.num_rows, expect_rows)
+    times = []
+    for _ in range(repeats or REPEATS):
+        t = time.perf_counter()
+        qe.execute_one(sql)
+        times.append((time.perf_counter() - t) * 1000)
+    return float(np.median(times)), warm_ms, r.num_rows, spans
+
+
+def bench_cpu_suite(qe, results):
+    t_end_ms = T0_MS + HOURS * 3600 * 1000
+
+    if enabled("single_groupby_1_1_1"):
+        sql = (
+            "SELECT date_bin(INTERVAL '1 minute', ts) AS minute, "
+            "max(usage_user) FROM cpu "
+            f"WHERE hostname = 'host_0' AND ts >= {T0_MS} "
+            f"AND ts < {T0_MS + 3600 * 1000} "
+            "GROUP BY minute ORDER BY minute"
+        )
+        p50, warm, nrows, _ = timed_sql(qe, sql, expect_rows=60)
+        log(f"single-groupby-1-1-1: {p50:.1f} ms (warm-up {warm:.0f} ms)")
+        results["single_groupby_1_1_1"] = {
+            "p50_ms": round(p50, 2), "baseline_ms": BASE_SINGLE_MS,
+            "vs_baseline": round(BASE_SINGLE_MS / p50, 3)}
+
+    if enabled("double_groupby_all"):
+        avg_list = ", ".join(f"avg({f})" for f in FIELDS)
+        sql = (
+            f"SELECT date_bin(INTERVAL '1 hour', ts) AS hour, hostname, "
+            f"{avg_list} FROM cpu WHERE ts >= {T0_MS} AND ts < {t_end_ms} "
+            f"GROUP BY hour, hostname ORDER BY hour, hostname"
+        )
+        p50, warm, nrows, wspans = timed_sql(qe, sql,
+                                             expect_rows=HOSTS * HOURS)
+        log(f"double-groupby-all: {p50:.1f} ms (warm-up {warm:.0f} ms, "
+            f"{nrows} groups)")
+        results["double_groupby_all"] = {
+            "p50_ms": round(p50, 2), "warmup_ms": round(warm, 1),
+            "groups": nrows, "warmup_spans_ms": wspans,
+            "baseline_ms": BASELINE_MS,
+            "vs_baseline": round(BASELINE_MS / p50, 3)}
+
+    if enabled("lastpoint"):
+        lv_list = ", ".join(
+            f"last_value({f} ORDER BY ts)" for f in FIELDS)
+        sql = f"SELECT hostname, {lv_list} FROM cpu GROUP BY hostname"
+        p50, warm, nrows, _ = timed_sql(qe, sql, expect_rows=HOSTS)
+        log(f"lastpoint: {p50:.1f} ms (warm-up {warm:.0f} ms)")
+        results["lastpoint"] = {
+            "p50_ms": round(p50, 2), "baseline_ms": BASE_LASTPOINT_MS,
+            "vs_baseline": round(BASE_LASTPOINT_MS / p50, 3)}
+
+    if enabled("high_cpu_all"):
+        sql = (
+            f"SELECT * FROM cpu WHERE usage_user > 90.0 "
+            f"AND ts >= {T0_MS} AND ts < {t_end_ms}"
+        )
+        p50, warm, nrows, _ = timed_sql(qe, sql)
+        log(f"high-cpu-all: {p50:.1f} ms ({nrows} rows out)")
+        results["high_cpu_all"] = {
+            "p50_ms": round(p50, 2), "rows_out": nrows,
+            "baseline_ms": BASE_HIGH_CPU_MS,
+            "vs_baseline": round(BASE_HIGH_CPU_MS / p50, 3)}
+
+
+def bench_promql(engine, qe, results):
+    """Config 3: PromQL rate()/avg_over_time over PROM_SERIES @15s."""
+    from greptimedb_tpu.datatypes import DictVector, RecordBatch
+
+    qe.execute_one(
+        "CREATE TABLE prom_cpu (host STRING, val DOUBLE, "
+        "ts TIMESTAMP(3) NOT NULL, TIME INDEX (ts), PRIMARY KEY (host)) "
+        "WITH (append_mode = 'true')")
+    info = qe.catalog.table("public", "prom_cpu")
+    rid = info.region_ids[0]
+    rng = np.random.default_rng(11)
+    points = PROM_HOURS * 3600 // 15
+    names = np.asarray([f"s{i}" for i in range(PROM_SERIES)], dtype=object)
+    slice_points = max(1, (1 << 21) // PROM_SERIES)
+    t_start = time.perf_counter()
+    rows = 0
+    # counter-style: per-series monotone increments so rate() is realistic
+    for p0 in range(0, points, slice_points):
+        p1 = min(p0 + slice_points, points)
+        npts = p1 - p0
+        n = npts * PROM_SERIES
+        codes = np.tile(np.arange(PROM_SERIES, dtype=np.int32), npts)
+        ts = np.repeat(
+            T0_MS + np.arange(p0, p1, dtype=np.int64) * 15000, PROM_SERIES)
+        base = np.repeat(
+            np.arange(p0, p1, dtype=np.float64) * 50.0, PROM_SERIES)
+        vals = base + rng.uniform(0, 50.0, n)
+        batch = RecordBatch(info.schema, {
+            "host": DictVector(codes, names), "ts": ts, "val": vals})
+        engine.put(rid, batch)
+        rows += n
+    log(f"prom ingest: {rows} rows in {time.perf_counter() - t_start:.1f}s")
+    engine.flush(rid)
+    t0_s = T0_MS // 1000
+    t_end_s = t0_s + PROM_HOURS * 3600
+    tql = (f"TQL EVAL ({t_end_s - 600}, {t_end_s}, '60s') "
+           "sum(rate(prom_cpu[2m]))")
+    p50, warm, nrows, _ = timed_sql(qe, tql)
+    log(f"promql rate: {p50:.1f} ms (warm-up {warm:.0f} ms)")
+    results["promql_rate"] = {
+        "p50_ms": round(p50, 2), "series": PROM_SERIES,
+        "hours": PROM_HOURS, "rows": rows, "baseline_ms": None,
+        "vs_baseline": None}
+
+
+def bench_high_cardinality(engine, qe, results):
+    """Config 5: segment-sum over HC_COMBOS distinct tag combos."""
+    from greptimedb_tpu.datatypes import DictVector, RecordBatch
+
+    qe.execute_one(
+        "CREATE TABLE hc (tag STRING, v DOUBLE, ts TIMESTAMP(3) NOT NULL, "
+        "TIME INDEX (ts), PRIMARY KEY (tag)) WITH (append_mode = 'true')")
+    info = qe.catalog.table("public", "hc")
+    rid = info.region_ids[0]
+    rng = np.random.default_rng(13)
+    names = np.asarray([f"t{i:07d}" for i in range(HC_COMBOS)], dtype=object)
+    t_start = time.perf_counter()
+    rows = 0
+    combos_per_slice = max(1, (1 << 21) // HC_POINTS)
+    for c0 in range(0, HC_COMBOS, combos_per_slice):
+        c1 = min(c0 + combos_per_slice, HC_COMBOS)
+        ncomb = c1 - c0
+        n = ncomb * HC_POINTS
+        codes = np.repeat(np.arange(ncomb, dtype=np.int32), HC_POINTS)
+        ts = np.tile(
+            T0_MS + np.arange(HC_POINTS, dtype=np.int64) * 1000, ncomb)
+        batch = RecordBatch(info.schema, {
+            "tag": DictVector(codes, names[c0:c1]), "ts": ts,
+            "v": rng.uniform(0, 1, n)})
+        engine.put(rid, batch)
+        rows += n
+    log(f"hc ingest: {rows} rows in {time.perf_counter() - t_start:.1f}s")
+    engine.flush(rid)
+    sql = "SELECT tag, sum(v) FROM hc GROUP BY tag"
+    p50, warm, nrows, _ = timed_sql(qe, sql,
+                                    repeats=max(1, REPEATS - 1),
+                                    expect_rows=HC_COMBOS)
+    rps = rows / (p50 / 1000.0)
+    log(f"high-cardinality: {p50:.1f} ms ({nrows} groups, "
+        f"{rps / 1e6:.1f}M rows/s)")
+    results["high_cardinality"] = {
+        "p50_ms": round(p50, 2), "combos": HC_COMBOS, "rows": rows,
+        "scan_rows_per_s": round(rps), "baseline_ms": None,
+        "vs_baseline": None}
+
+
+def bench_compaction(engine, qe, results):
+    """Config 4 analog: L0→L1 TWCS merge re-encode throughput."""
+    from greptimedb_tpu.datatypes import DictVector, RecordBatch
+
+    qe.execute_one(
+        "CREATE TABLE comp (host STRING, v DOUBLE, ts TIMESTAMP(3) NOT "
+        "NULL, TIME INDEX (ts), PRIMARY KEY (host))")
+    info = qe.catalog.table("public", "comp")
+    rid = info.region_ids[0]
+    rng = np.random.default_rng(17)
+    n_hosts = 1000
+    names = np.asarray([f"h{i}" for i in range(n_hosts)], dtype=object)
+    n_files = 4
+    per_file = COMPACT_ROWS // n_files
+    for f in range(n_files):
+        pts = per_file // n_hosts
+        codes = np.tile(np.arange(n_hosts, dtype=np.int32), pts)
+        # overlapping time ranges across files force a real merge
+        ts = np.repeat(
+            T0_MS + f * 500 + np.arange(pts, dtype=np.int64) * 1000, n_hosts)
+        batch = RecordBatch(info.schema, {
+            "host": DictVector(codes, names), "ts": ts,
+            "v": rng.uniform(0, 1, pts * n_hosts)})
+        engine.put(rid, batch)
+        engine.flush(rid)
+    rows = n_files * per_file // n_hosts * n_hosts
+    t = time.perf_counter()
+    engine.compact(rid)
+    dt = time.perf_counter() - t
+    rps = rows / dt
+    log(f"compaction re-encode: {rows} rows in {dt:.2f}s "
+        f"({rps / 1e6:.2f}M rows/s)")
+    results["compaction_reencode"] = {
+        "seconds": round(dt, 2), "rows": rows,
+        "reencode_rows_per_s": round(rps), "baseline_ms": None,
+        "vs_baseline": None}
+
+
 def probe_backend():
     """Verify jax backend init in a throwaway subprocess before touching it
     in-process. TPU plugin init is flaky (round-1 BENCH_r01 rc=1: UNAVAILABLE
@@ -145,6 +379,25 @@ def probe_backend():
     return "cpu"
 
 
+def capture_profile(qe, sql):
+    """jax.profiler trace of one hot-path run (only on a real
+    accelerator: the trace is for MFU/HBM-bandwidth tuning)."""
+    import jax
+
+    profile_dir = os.environ.get(
+        "BENCH_PROFILE_DIR", os.path.join(tempfile.gettempdir(),
+                                          "gtpu_profile"))
+    try:
+        with jax.profiler.trace(profile_dir):
+            qe.execute_one(sql)
+        n_files = sum(len(fs) for _, _, fs in os.walk(profile_dir))
+        log(f"profiler trace captured -> {profile_dir} ({n_files} files)")
+        return profile_dir
+    except Exception as e:  # profiling must never sink the bench
+        log(f"profiler capture failed: {e}")
+        return None
+
+
 def main():
     data_dir = tempfile.mkdtemp(prefix="gtpu_bench_")
     try:
@@ -157,52 +410,53 @@ def main():
             jax.config.update("jax_platforms", "cpu")
             backend = "cpu"
         log(f"devices: {jax.devices()}")
+        platform = jax.devices()[0].platform
         engine, qe = build_db(data_dir)
-        t0_ms = 1456790400000  # 2016-03-01T00:00:00Z
         log(f"ingesting {HOSTS} hosts x {HOURS}h @{STEP_S}s ...")
-        rows, ingest_s = ingest(engine, qe, t0_ms)
+        rows, ingest_s = ingest(engine, qe, T0_MS)
+        ingest_rps = rows / ingest_s
         log(f"ingested {rows} rows in {ingest_s:.1f}s "
-            f"({rows / ingest_s:,.0f} rows/s)")
+            f"({ingest_rps:,.0f} rows/s)")
         engine.flush(qe.catalog.table("public", "cpu").region_ids[0])
         log("flushed to SST")
 
-        t_end_ms = t0_ms + HOURS * 3600 * 1000
-        avg_list = ", ".join(f"avg({f})" for f in FIELDS)
-        sql = (
-            f"SELECT date_bin(INTERVAL '1 hour', ts) AS hour, hostname, {avg_list} "
-            f"FROM cpu WHERE ts >= {t0_ms} AND ts < {t_end_ms} "
-            f"GROUP BY hour, hostname ORDER BY hour, hostname"
-        )
-        # warm-up: compile + fill the HBM block cache
-        t = time.perf_counter()
-        r = qe.execute_one(sql)
-        log(f"warm-up run: {(time.perf_counter() - t) * 1000:.1f} ms, "
-            f"{r.num_rows} groups")
-        assert r.num_rows == HOSTS * HOURS, r.num_rows
+        results = {}
+        bench_cpu_suite(qe, results)
+        if enabled("promql_rate"):
+            bench_promql(engine, qe, results)
+        if enabled("high_cardinality"):
+            bench_high_cardinality(engine, qe, results)
+        if enabled("compaction_reencode"):
+            bench_compaction(engine, qe, results)
 
-        times = []
-        for i in range(REPEATS):
-            t = time.perf_counter()
-            r = qe.execute_one(sql)
-            dt = (time.perf_counter() - t) * 1000
-            times.append(dt)
-            log(f"run {i + 1}: {dt:.1f} ms")
-        value = float(np.median(times))
+        profile_dir = None
+        if platform not in ("cpu",) and "double_groupby_all" in results:
+            avg_list = ", ".join(f"avg({f})" for f in FIELDS)
+            t_end_ms = T0_MS + HOURS * 3600 * 1000
+            profile_dir = capture_profile(qe, (
+                f"SELECT date_bin(INTERVAL '1 hour', ts) AS hour, "
+                f"hostname, {avg_list} FROM cpu WHERE ts >= {T0_MS} "
+                f"AND ts < {t_end_ms} GROUP BY hour, hostname"))
+
+        dg = results.get("double_groupby_all", {})
+        value = dg.get("p50_ms")
         print(json.dumps({
             "metric": "tsbs_double_groupby_all_p50_ms",
-            "value": round(value, 2),
+            "value": value,
             "unit": "ms",
-            "vs_baseline": round(BASELINE_MS / value, 3),
+            "vs_baseline": dg.get("vs_baseline"),
             "detail": {
-                "backend": jax.devices()[0].platform,
+                "backend": platform,
                 "rows": rows,
                 "hosts": HOSTS,
                 "hours": HOURS,
                 "fields": len(FIELDS),
-                "groups": HOSTS * HOURS,
-                "ingest_rows_per_s": round(rows / ingest_s),
+                "ingest_rows_per_s": round(ingest_rps),
+                "ingest_vs_baseline": round(
+                    ingest_rps / BASE_INGEST_ROWS_S, 3),
                 "baseline_ms": BASELINE_MS,
-                "runs_ms": [round(t, 1) for t in times],
+                "profile_dir": profile_dir,
+                "configs": results,
             },
         }))
         engine.close()
