@@ -237,6 +237,18 @@ class RegionRouter:
             region_id, ts_range, projection, tag_predicates
         )
 
+    def _local_executor_for(self, eng):
+        """Per-engine pushdown executor cache (holds device caches; the
+        invalidation hook drops them with the routes)."""
+        from greptimedb_tpu.query.physical import PhysicalExecutor
+
+        with self._lock:
+            ex = self._agg_executors.get(id(eng))
+            if ex is None:
+                ex = PhysicalExecutor(eng)
+                self._agg_executors[id(eng)] = ex
+        return ex
+
     def partial_agg(self, region_id: int, frag):
         """Aggregation pushdown: run the Partial step ON the node that
         owns the region (over Flight in wire mode), so only per-group
@@ -247,14 +259,20 @@ class RegionRouter:
             return eng.partial_agg(region_id, frag)
         # in-process datanode: same computation, no serialization
         from greptimedb_tpu.query.dist_agg import partial_region_agg
-        from greptimedb_tpu.query.physical import PhysicalExecutor
 
-        with self._lock:
-            ex = self._agg_executors.get(id(eng))
-            if ex is None:
-                ex = PhysicalExecutor(eng)
-                self._agg_executors[id(eng)] = ex
-        return partial_region_agg(ex, region_id, frag)
+        return partial_region_agg(self._local_executor_for(eng), region_id,
+                                  frag)
+
+    def partial_topk(self, region_id: int, frag):
+        """Sort/limit pushdown: each region returns only its k candidate
+        rows (TopkFragment), instead of the raw scan crossing the wire."""
+        eng = self._engine_for(region_id)
+        if hasattr(eng, "partial_topk"):  # RemoteRegionEngine: over the wire
+            return eng.partial_topk(region_id, frag)
+        from greptimedb_tpu.query.dist_agg import partial_region_topk
+
+        return partial_region_topk(self._local_executor_for(eng), region_id,
+                                   frag)
 
     def alter_region_schema(self, region_id: int, schema) -> None:
         self._engine_for(region_id).alter_region_schema(region_id, schema)

@@ -252,10 +252,11 @@ class QueryEngine:
         vmap = ctx.extensions.get("__virtual_tables__")
         return vmap.get(table.lower()) if vmap else None
 
-    def _fold_tree(self, e, ctx: QueryContext):
+    def _fold_tree(self, e, ctx: QueryContext, predicate: bool = False):
         """Replace uncorrelated ast.Subquery nodes with literals by
         executing them now. Correlated subqueries fail naturally inside
-        with 'unknown column'."""
+        with 'unknown column'. `predicate` marks WHERE/HAVING/ON position,
+        where UNKNOWN (NULL) may legally collapse to FALSE."""
         if isinstance(e, ast.Subquery):
             stmt = e.stmt
             if e.exists and isinstance(stmt, (ast.Select, ast.Union)) \
@@ -286,18 +287,29 @@ class QueryEngine:
                     for v in r.columns[0].tolist()]
             nonnull = [v for v in vals
                        if v is not None and not _is_nan_scalar(v)]
+            expr = self._fold_tree(e.expr, ctx, predicate)
             if e.negated and len(nonnull) != len(vals):
-                # NOT IN over a list containing NULL is never TRUE
-                # (matched → FALSE, unmatched → UNKNOWN): excludes all rows
-                return ast.Literal(False)
-            expr = self._fold_tree(e.expr, ctx)
+                # NOT IN over a list containing NULL is never TRUE:
+                # matched → FALSE, unmatched → UNKNOWN. In predicate
+                # position both exclude the row, so FALSE is exact; in
+                # projection position preserve the FALSE/NULL split
+                if predicate:
+                    return ast.Literal(False)
+                if not nonnull:  # every element NULL: always UNKNOWN
+                    return ast.Literal(None)
+                return ast.Case(
+                    None,
+                    ((ast.InList(expr, tuple(ast.Literal(v)
+                                             for v in nonnull)),
+                      ast.Literal(False)),),
+                    ast.Literal(None))
             if not nonnull:
                 # x IN (empty) is FALSE; NOT IN (empty) is TRUE
                 return ast.Literal(bool(e.negated))
             return ast.InList(expr, tuple(ast.Literal(v) for v in nonnull),
                               e.negated)
         if isinstance(e, (list, tuple)):
-            return type(e)(self._fold_tree(x, ctx) for x in e)
+            return type(e)(self._fold_tree(x, ctx, predicate) for x in e)
         # descend any expression-carrying dataclass (incl. non-Expr
         # carriers like WindowSpec) but never into embedded statements —
         # those execute atomically via the Subquery branch above
@@ -309,7 +321,7 @@ class QueryEngine:
                 if isinstance(v, (ast.Expr, list, tuple)) or (
                         dataclasses.is_dataclass(v)
                         and not isinstance(v, (type, ast.Statement))):
-                    nv = self._fold_tree(v, ctx)
+                    nv = self._fold_tree(v, ctx, predicate)
                     if nv != v:
                         changes[f.name] = nv
             return dataclasses.replace(e, **changes) if changes else e
@@ -324,9 +336,11 @@ class QueryEngine:
                                           expr=self._fold_tree(it.expr, ctx))
                       for it in sel.items]}
         if sel.where is not None:
-            changes["where"] = self._fold_tree(sel.where, ctx)
+            changes["where"] = self._fold_tree(sel.where, ctx,
+                                               predicate=True)
         if sel.having is not None:
-            changes["having"] = self._fold_tree(sel.having, ctx)
+            changes["having"] = self._fold_tree(sel.having, ctx,
+                                                predicate=True)
         if sel.group_by:
             changes["group_by"] = [self._fold_tree(g, ctx)
                                    for g in sel.group_by]
@@ -337,7 +351,7 @@ class QueryEngine:
         if sel.joins:
             changes["joins"] = [
                 dataclasses.replace(
-                    j, on=self._fold_tree(j.on, ctx)
+                    j, on=self._fold_tree(j.on, ctx, predicate=True)
                     if j.on is not None else None)
                 for j in sel.joins]
         return dataclasses.replace(sel, **changes)
@@ -480,9 +494,28 @@ class QueryEngine:
 
         if select_has_window(sel):
             # window functions: device scan+filter materializes the base
-            # relation, windows evaluate on host over the filtered rows
-            base_sel = ast.Select(items=[ast.SelectItem(ast.Star())],
-                                  table=sel.table, where=sel.where)
+            # relation, windows evaluate on host over the filtered rows.
+            # Project only referenced columns (a Star or an unresolvable
+            # qualifier falls back to everything).
+            base_items = [ast.SelectItem(ast.Star())]
+            if not any(isinstance(it.expr, ast.Star) for it in sel.items):
+                from greptimedb_tpu.query.join import _columns_in
+
+                refs: set = set()
+                for it in sel.items:
+                    _columns_in(it.expr, refs)
+                for ob in sel.order_by:
+                    _columns_in(ob.expr, refs)
+                _columns_in(sel.where, refs)
+                alias = sel.table_alias or sel.table
+                names = {c for t, c in refs if t in (None, alias, sel.table)}
+                qual_ok = all(t in (None, alias, sel.table)
+                              for t, _ in refs)
+                if qual_ok and names <= set(info.schema.names):
+                    base_items = [ast.SelectItem(ast.Column(c))
+                                  for c in sorted(names)]
+            base_sel = ast.Select(items=base_items, table=sel.table,
+                                  where=sel.where)
             base = self._select(base_sel, ctx)
             outer = dataclasses.replace(sel, where=None, table=None)
             return execute_select_over(

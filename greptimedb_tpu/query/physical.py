@@ -681,6 +681,17 @@ class PhysicalExecutor:
             if res is not None:
                 return res
 
+        # sort+limit (top-k) pushdown for raw scans: each region returns
+        # only k candidates instead of its full scan (Limit is
+        # PartialCommutative over MergeScan, commutativity.rs:27-52)
+        if (agg is None and sort is not None and limit is not None
+                and len(table.region_ids) > 1
+                and hasattr(self.engine, "partial_topk")):
+            res = self._try_topk_pushdown(table, where, project, sort,
+                                          limit, offset, ts_range, scan_node)
+            if res is not None:
+                return res
+
         # beyond-RAM aggregate scans stream: append-mode (no dedup sort),
         # single region, estimated rows over the threshold
         if (agg is not None and table.append_mode
@@ -811,6 +822,54 @@ class PhysicalExecutor:
             env[spec.call] = _finalize_agg(spec.func, planes, slot, present)
         return self._post_process(env, agg, having, project, sort, limit,
                                   offset, table, g)
+
+    def _try_topk_pushdown(self, table, where, project, sort, limit,
+                           offset, ts_range,
+                           scan_node) -> Optional[QueryResult]:
+        """Ship a TopkFragment to each region's owner; merge the ≤k
+        candidates per region and run the final sort/limit here. Returns
+        None when the sort shape can't be replicated region-side —
+        caller falls back to the gather path."""
+        from greptimedb_tpu.query.dist_agg import merge_topk
+        from greptimedb_tpu.query.expr import collect_columns
+        from greptimedb_tpu.query.plan_ser import TopkFragment
+        from greptimedb_tpu.utils import tracing
+
+        sort_keys = []
+        needed: set = set()
+        for ob in sort.keys:
+            if ob.nulls_first is not None:
+                return None  # NULLS FIRST/LAST isn't replicated region-side
+            sort_keys.append((ob.expr, ob.asc))
+            collect_columns(ob.expr, needed)
+        if not all(c in table.schema.names for c in needed):
+            return None  # sort key references a projection alias
+        k = int(limit) + int(offset or 0)
+        frag = TopkFragment(
+            sort_keys=sort_keys, k=k, columns=scan_node.columns,
+            where=where, ts_range=ts_range, append_mode=table.append_mode)
+        with tracing.span("topk_pushdown", regions=len(table.region_ids),
+                          k=k):
+            rids = list(table.region_ids)
+            from concurrent.futures import ThreadPoolExecutor
+
+            tid = tracing.current_trace_id()
+
+            def one(rid):
+                if tid:
+                    tracing.set_trace(tid)
+                return self.engine.partial_topk(rid, frag)
+
+            with ThreadPoolExecutor(max_workers=min(8, len(rids))) as pool:
+                partials = list(pool.map(one, rids))
+        merged = merge_topk(partials)
+        self.last_path = "topk_pushdown"
+        if merged is None:
+            return _project_empty(project, table.schema)
+        host_cols = merged["cols"]
+        nrows = len(next(iter(host_cols.values()))) if host_cols else 0
+        return self._post_process({}, None, None, project, sort, limit,
+                                  offset, table, nrows, host_cols=host_cols)
 
     # ---- aggregate path ----------------------------------------------------
 

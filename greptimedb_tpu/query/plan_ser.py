@@ -93,3 +93,44 @@ class AggFragment:
             ts_range=tuple(d["ts_range"]) if d["ts_range"] else None,
             append_mode=bool(d.get("append_mode", False)),
         )
+
+
+@dataclasses.dataclass
+class TopkFragment:
+    """Sort/limit pushdown for non-aggregate scans: each region filters,
+    sorts by `sort_keys` and returns only its top `k` rows; the frontend
+    merges the per-region candidates and applies the final sort+limit.
+    Mirrors the reference's commutativity classification — Sort+Limit
+    commute with MergeScan when every region pre-truncates to k
+    (query/src/dist_plan/commutativity.rs:27-52: Limit is
+    PartialCommutative)."""
+
+    sort_keys: list       # [(Expr, asc: bool)]
+    k: int                # limit + offset: candidates each region returns
+    columns: Optional[list] = None  # projection (None = all)
+    where: Optional[ast.Expr] = None
+    ts_range: Optional[tuple] = None
+    append_mode: bool = False
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "sort_keys": [[expr_to_json(e), asc] for e, asc in self.sort_keys],
+            "k": self.k,
+            "columns": list(self.columns) if self.columns else None,
+            "where": expr_to_json(self.where),
+            "ts_range": list(self.ts_range) if self.ts_range else None,
+            "append_mode": self.append_mode,
+        })
+
+    @staticmethod
+    def from_json(s: str) -> "TopkFragment":
+        d = json.loads(s)
+        return TopkFragment(
+            sort_keys=[(expr_from_json(e), bool(asc))
+                       for e, asc in d["sort_keys"]],
+            k=int(d["k"]),
+            columns=list(d["columns"]) if d["columns"] else None,
+            where=expr_from_json(d["where"]),
+            ts_range=tuple(d["ts_range"]) if d["ts_range"] else None,
+            append_mode=bool(d.get("append_mode", False)),
+        )

@@ -275,6 +275,8 @@ def _compute(fc, name, ev, order, n, pid_s, new_seg, seg_id, run_id,
         return np.minimum(out, seg_len).astype(np.int64)
 
     vals = _arg_values(fc, ev, order, n)
+    if vals is None and name != "count":
+        raise PlanError(f"window function {name}() requires an argument")
     if name in ("lag", "lead"):
         k = int(_lit(fc.args[1] if len(fc.args) > 1 else None, 1))
         default = _lit(fc.args[2] if len(fc.args) > 2 else None, None)

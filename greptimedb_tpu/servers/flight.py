@@ -272,6 +272,12 @@ class FlightServer(fl.FlightServerBase):
                 raise fl.FlightUnauthorizedError(
                     f"user {user.username!r} lacks read permission")
             return self._region_agg(req["region_agg"])
+        if "region_topk" in req:
+            user = self._resolve_user(context)
+            if user is not None and not user.can("read"):
+                raise fl.FlightUnauthorizedError(
+                    f"user {user.username!r} lacks read permission")
+            return self._region_topk(req["region_topk"])
         if self.qe is None:
             raise fl.FlightServerError("datanode service: region tickets only")
         ctx = QueryContext(db=req.get("db", "public"), channel=Channel.GRPC,
@@ -344,6 +350,31 @@ class FlightServer(fl.FlightServerBase):
             return fl.RecordBatchStream(pa.Table.from_arrays(
                 [], schema=pa.schema([], metadata={b"empty": b"1"})))
         return fl.RecordBatchStream(partial_to_table(part))
+
+    def _region_topk(self, req: dict):
+        """Sort/limit pushdown: only k candidate rows per region cross
+        the wire (TopkFragment; reference commutativity.rs Limit =
+        PartialCommutative over MergeScan)."""
+        from greptimedb_tpu.query.dist_agg import partial_region_topk
+        from greptimedb_tpu.query.plan_ser import TopkFragment
+        from greptimedb_tpu.utils import tracing
+
+        region_id = req["region_id"]
+        frag = TopkFragment.from_json(req["fragment"])
+        if req.get("trace_id"):
+            tracing.set_trace(req["trace_id"])
+        if self._agg_executor is None:
+            from greptimedb_tpu.query.physical import PhysicalExecutor
+            self._agg_executor = PhysicalExecutor(self.engine)
+        with tracing.span("region_topk", region=region_id):
+            part = partial_region_topk(self._agg_executor, region_id, frag)
+        if part is None:
+            return fl.RecordBatchStream(pa.Table.from_arrays(
+                [], schema=pa.schema([], metadata={b"empty": b"1"})))
+        cols = part["cols"]
+        arrays = [pa.array(cols[name]) for name in cols]
+        return fl.RecordBatchStream(pa.Table.from_arrays(
+            arrays, names=list(cols)))
 
     # -- ingest ----------------------------------------------------------------
 
@@ -646,6 +677,28 @@ class RemoteRegionEngine:
         if (t.schema.metadata or {}).get(b"empty") == b"1":
             return None
         return table_to_partial(t)
+
+    def partial_topk(self, region_id: int, frag) -> Optional[dict]:
+        """Ship a TopkFragment; receive this region's k candidate rows."""
+        from greptimedb_tpu.utils import tracing
+
+        spec = {"region_id": region_id, "fragment": frag.to_json()}
+        tid = tracing.current_trace_id()
+        if tid:
+            spec["trace_id"] = tid
+        with tracing.span("remote_region_topk", region=region_id,
+                          addr=self.addr):
+            ticket = fl.Ticket(json.dumps({"region_topk": spec}).encode())
+            t = self.client.do_get(ticket).read_all()
+        if (t.schema.metadata or {}).get(b"empty") == b"1":
+            return None
+        t = t.combine_chunks()
+        cols = {}
+        for i, name in enumerate(t.column_names):
+            col = t.column(i)
+            arr = col.to_numpy(zero_copy_only=False)
+            cols[name] = arr
+        return {"cols": cols}
 
     def scan_stream(self, region_id: int, ts_range=None, projection=None,
                     tag_predicates=None):

@@ -304,6 +304,26 @@ class TestWindowFunctions:
             db.execute_one(
                 "SELECT nth_value(usage, 0) OVER (ORDER BY ts) FROM cpu")
 
+    def test_windowed_agg_without_arg_rejected(self, db):
+        with pytest.raises(PlanError, match="requires an argument"):
+            db.execute_one("SELECT lag() OVER (ORDER BY ts) FROM cpu")
+
+    def test_not_in_null_projection_is_unknown(self, db):
+        # in projection position, NOT IN over a NULL-bearing list keeps
+        # the SQL FALSE/NULL split (matched -> FALSE, unmatched -> NULL)
+        db.execute_one(
+            "CREATE TABLE pn (ts TIMESTAMP(3) NOT NULL, x DOUBLE,"
+            " TIME INDEX (ts))")
+        db.execute_one("INSERT INTO pn VALUES (1, 10.0), (2, NULL)")
+        r = db.execute_one(
+            "SELECT usage, usage NOT IN (SELECT x FROM pn) m FROM cpu "
+            "WHERE host = 'a' ORDER BY ts")
+        got = [row[1] for row in r.rows()]
+        # usage=10.0 matches the non-null element -> FALSE; 20/30 don't
+        # match but NULL is in the list -> UNKNOWN (NULL)
+        assert bool(got[0]) is False and got[0] is not None
+        assert got[1] is None and got[2] is None
+
     def test_not_in_subquery_with_null(self, db):
         # NOT IN over a list containing NULL is never TRUE (SQL
         # three-valued logic): all rows excluded
