@@ -206,6 +206,8 @@ def combine_partial_aggs(
     """
     out = {}
     for op, v in partials.items():
+        if op in ("first", "last", "first_ts", "last_ts"):
+            continue  # combined below by (value, ts) pairing
         if op in ("count", "rows"):
             out[op] = jax.lax.psum(v.astype(jnp.int64), axis_name)
         elif op in ("sum", "sumsq"):
@@ -220,6 +222,32 @@ def combine_partial_aggs(
             out[op] = jnp.where(mx == small, _null_of(v.dtype), mx)
         else:
             raise ValueError(f"non-commutative partial agg: {op}")
+    # first/last ARE collective-combinable once paired with their
+    # companion timestamps: the shard holding the globally oldest/newest
+    # ts per group wins; exact-ts ties break deterministically by shard
+    # index (lowest wins for first, highest for last). Empty groups keep
+    # ts sentinels and so never beat a shard with data; an all-empty
+    # group's winner contributes its NaN value, which psum propagates.
+    for op, ts_op, pick_last in (("first", "first_ts", False),
+                                 ("last", "last_ts", True)):
+        if op not in partials:
+            continue
+        ts = partials[ts_op]
+        idx = jax.lax.axis_index(axis_name).astype(ts.dtype)
+        if pick_last:
+            best = jax.lax.pmax(ts, axis_name)
+            wrank = jax.lax.pmax(
+                jnp.where(ts == best, idx, jnp.asarray(-1, ts.dtype)),
+                axis_name)
+        else:
+            best = jax.lax.pmin(ts, axis_name)
+            hi = jnp.asarray(jnp.iinfo(jnp.int32).max, ts.dtype)
+            wrank = jax.lax.pmin(jnp.where(ts == best, idx, hi), axis_name)
+        sel = (ts == best) & (idx == wrank)
+        v = partials[op]
+        selv = jnp.broadcast_to(sel, v.shape)
+        out[op] = jax.lax.psum(jnp.where(selv, v, 0.0), axis_name)
+        out[ts_op] = best
     if with_mean and "sum" in out and "count" in out:
         denom = jnp.maximum(out["count"], 1).astype(out["sum"].dtype)
         out["mean"] = jnp.where(out["count"] > 0, out["sum"] / denom, jnp.nan)

@@ -22,9 +22,12 @@ from jax import shard_map
 
 from greptimedb_tpu.ops.segment import segment_agg
 
-# ops whose partials combine with a collective (first/last need ts pairing,
-# handled only in the single-chip streaming path for now)
-COLLECTIVE_OPS = ("sum", "count", "min", "max", "rows", "sumsq")
+# ops whose partials combine with a collective. first/last pair each
+# group's value with its timestamp: the shard holding the global
+# oldest/newest ts wins (combine_partial_aggs), so lastpoint-class
+# queries ride the mesh too.
+COLLECTIVE_OPS = ("sum", "count", "min", "max", "rows", "sumsq",
+                  "first", "last")
 
 
 def make_mesh(
@@ -57,32 +60,49 @@ def sharded_segment_agg(
     num_segments: int,
     ops: tuple[str, ...],
     mesh: Mesh,
+    ts: Optional[jax.Array] = None,  # [N] int64, required for first/last
 ) -> dict[str, jax.Array]:
     """Masked segment reduction over a (shard, field) mesh: per-shard dense
-    partials, then psum/pmin/pmax along "shard". Result is replicated along
-    "shard" and left sharded along "field"."""
+    partials, then psum/pmin/pmax along "shard" (first/last resolve by
+    their companion timestamps). Result is replicated along "shard" and
+    left sharded along "field"."""
     for op in ops:
         if op not in COLLECTIVE_OPS:
             raise ValueError(f"op {op!r} has no collective combiner")
+    need_ts = bool({"first", "last"} & set(ops))
+    if need_ts and ts is None:
+        raise ValueError("first/last need the ts row array")
+    out_ops = tuple(ops) + tuple(
+        op + "_ts" for op in ("first", "last") if op in ops)
+
+    in_specs = [P("shard", "field"), P("shard"), P("shard")]
+    if need_ts:
+        in_specs.append(P("shard"))
+
+    # value planes stay field-sharded; the [G, 1] ts planes are replicated
+    out_specs = tuple(P(None, None) if op.endswith("_ts")
+                      else P(None, "field") for op in out_ops)
 
     @functools.partial(
         shard_map,
         mesh=mesh,
-        in_specs=(P("shard", "field"), P("shard"), P("shard")),
-        out_specs=P(None, "field"),
+        in_specs=tuple(in_specs),
+        out_specs=out_specs,
         check_vma=False,
     )
-    def step(v, g, m):
+    def step(v, g, m, *rest):
         from greptimedb_tpu.ops.segment import combine_partial_aggs
 
-        part = segment_agg(v, g, m, num_segments, ops=ops)
+        part = segment_agg(v, g, m, num_segments, ops=ops,
+                           ts=rest[0] if rest else None)
         part = {op: (x if x.ndim > 1 else x[:, None])
                 for op, x in part.items()}
         out = combine_partial_aggs(part, "shard")
-        return tuple(out[op] for op in ops)
+        return tuple(out[op] for op in out_ops)
 
-    res = step(values, seg_ids, mask)
-    return dict(zip(ops, res))
+    args = (values, seg_ids, mask) + ((ts,) if need_ts else ())
+    res = step(*args)
+    return dict(zip(out_ops, res))
 
 
 def pad_to_multiple(arr: np.ndarray, multiple: int, fill=0) -> np.ndarray:

@@ -196,10 +196,10 @@ def _factorize_with_null(vals: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
 _ADDITIVE = frozenset({"sum", "count", "rows", "sumsq"})
 
 
-def _concat_keys(partials: list, j: int) -> np.ndarray:
-    """Concatenate key column j across partials, preserving a common
-    non-object dtype when possible (date_bin keys stay int64)."""
-    cols = [np.asarray(p["keys"][j]) for p in partials]
+def _concat_union(cols: list[np.ndarray]) -> np.ndarray:
+    """Concatenate arrays preserving a common non-object dtype when
+    possible (date_bin keys stay int64), widening to object otherwise."""
+    cols = [np.asarray(c) for c in cols]
     dtypes = {c.dtype for c in cols}
     if len(dtypes) == 1 and cols[0].dtype != object:
         return np.concatenate(cols)
@@ -226,7 +226,8 @@ def combine_partials(partials: list, n_keys: int, ops: tuple) -> Optional[dict]:
         # factorize each key column over the stacked values; composite
         # codes identify groups across regions by VALUE (dictionaries
         # differ per region)
-        stacks = [_concat_keys(partials, j) for j in range(n_keys)]
+        stacks = [_concat_union([p["keys"][j] for p in partials])
+                  for j in range(n_keys)]
         gc = np.zeros(R, dtype=np.int64)
         for s in stacks:
             uniq, codes = _factorize_with_null(s)
@@ -278,30 +279,31 @@ def combine_partials(partials: list, n_keys: int, ops: tuple) -> Optional[dict]:
         if op not in stacked:
             continue
         pl = stacked[op]
-        ts = stacked[ts_op]
+        ts = stacked[ts_op][:, 0]  # ONE ts per group (segment_agg emits
+        # a single per-group ts shared by every value field)
         f = pl.shape[1]
         vout = np.full((G, f), np.nan)
         tsout = np.full(
-            (G, f),
+            (G, 1),
             np.iinfo(np.int64).min if pick_last else np.iinfo(np.int64).max,
             dtype=np.int64)
-        for c in range(f):
-            # sort by (group, ts): the first/last row of each group run is
-            # the oldest/newest partial — empty-region sentinels sort to
-            # the never-picked end automatically
-            o = np.lexsort((ts[:, c], pos))
-            boundary = np.empty(R, dtype=bool)
-            if R:
-                boundary[0] = True
-                boundary[1:] = pos[o][1:] != pos[o][:-1]
-            if pick_last:
-                picks = np.append(np.flatnonzero(boundary)[1:] - 1, R - 1) \
-                    if R else np.empty(0, dtype=np.int64)
-            else:
-                picks = np.flatnonzero(boundary)
-            rows = o[picks]
-            vout[pos[rows], c] = pl[rows, c]
-            tsout[pos[rows], c] = ts[rows, c]
+        # sort by (group, ts): the first/last row of each group run is
+        # the oldest/newest partial — empty-region sentinels sort to the
+        # never-picked end automatically; the winner row is shared by all
+        # value fields
+        o = np.lexsort((ts, pos))
+        boundary = np.empty(R, dtype=bool)
+        if R:
+            boundary[0] = True
+            boundary[1:] = pos[o][1:] != pos[o][:-1]
+        if pick_last:
+            picks = np.append(np.flatnonzero(boundary)[1:] - 1, R - 1) \
+                if R else np.empty(0, dtype=np.int64)
+        else:
+            picks = np.flatnonzero(boundary)
+        rows = o[picks]
+        vout[pos[rows], :] = pl[rows, :]
+        tsout[pos[rows], 0] = ts[rows]
         acc[op] = vout
         acc[ts_op] = tsout
     for op in ("count", "rows"):
@@ -364,12 +366,6 @@ def merge_topk(partials: list) -> Optional[dict]:
     if not partials:
         return None
     names = list(partials[0]["cols"])
-    out: dict[str, np.ndarray] = {}
-    for name in names:
-        cols = [np.asarray(p["cols"][name]) for p in partials]
-        dtypes = {c.dtype for c in cols}
-        if len(dtypes) == 1 and cols[0].dtype != object:
-            out[name] = np.concatenate(cols)
-        else:
-            out[name] = np.concatenate([c.astype(object) for c in cols])
-    return {"cols": out}
+    return {"cols": {name: _concat_union([p["cols"][name]
+                                          for p in partials])
+                     for name in names}}

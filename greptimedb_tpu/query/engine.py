@@ -308,6 +308,11 @@ class QueryEngine:
                 return ast.Literal(bool(e.negated))
             return ast.InList(expr, tuple(ast.Literal(v) for v in nonnull),
                               e.negated)
+        # UNKNOWN ≡ FALSE survives only through AND/OR conjunctions; any
+        # other enclosing operator (NOT, IS NULL, CASE, comparisons) can
+        # distinguish them, so the flag resets before descending
+        child_pred = (predicate and isinstance(e, ast.BinaryOp)
+                      and e.op in ("and", "or"))
         if isinstance(e, (list, tuple)):
             return type(e)(self._fold_tree(x, ctx, predicate) for x in e)
         # descend any expression-carrying dataclass (incl. non-Expr
@@ -321,7 +326,7 @@ class QueryEngine:
                 if isinstance(v, (ast.Expr, list, tuple)) or (
                         dataclasses.is_dataclass(v)
                         and not isinstance(v, (type, ast.Statement))):
-                    nv = self._fold_tree(v, ctx, predicate)
+                    nv = self._fold_tree(v, ctx, child_pred)
                     if nv != v:
                         changes[f.name] = nv
             return dataclasses.replace(e, **changes) if changes else e
@@ -507,6 +512,9 @@ class QueryEngine:
                 for ob in sel.order_by:
                     _columns_in(ob.expr, refs)
                 _columns_in(sel.where, refs)
+                for g in sel.group_by:
+                    _columns_in(g, refs)
+                _columns_in(sel.having, refs)
                 alias = sel.table_alias or sel.table
                 names = {c for t, c in refs if t in (None, alias, sel.table)}
                 qual_ok = all(t in (None, alias, sel.table)

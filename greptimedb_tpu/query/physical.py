@@ -209,7 +209,7 @@ def _agg_scan_prepared(
     - "__prep_max__" vals with NaN -> -inf, reduced with segment-max
     Empty/all-NULL groups come back as +/-inf and convert to NULL."""
     G = num_segments
-    total = tmin = tmax = None
+    total = tmin = tmax = tsq = None
     for i, cols in enumerate(blocks):
         plane = cols["__prep__"]
         mask = jnp.arange(plane.shape[0]) < n_valids[i]
@@ -230,6 +230,10 @@ def _agg_scan_prepared(
             p = jax.ops.segment_max(cols["__prep_max__"], ids,
                                     num_segments=G + 1)[:G]
             tmax = p if tmax is None else jnp.maximum(tmax, p)
+        if "__prep_sq__" in cols:
+            p = jax.ops.segment_sum(cols["__prep_sq__"], ids,
+                                    num_segments=G + 1)[:G]
+            tsq = p if tsq is None else tsq + p
     sums = total[:, :nf]
     if has_nan:
         cnts = total[:, nf:2 * nf]
@@ -255,6 +259,8 @@ def _agg_scan_prepared(
         elif k == "max":
             small = _seg_type_min(tmax.dtype)
             acc[k] = jnp.where(tmax == small, jnp.nan, tmax)
+        elif k == "sumsq":
+            acc[k] = tsq
         else:  # mean — same NULL semantics as segment_agg
             denom = jnp.maximum(cnts, 1.0)
             acc[k] = jnp.where(cnts > 0, sums / denom, jnp.nan)
@@ -322,12 +328,15 @@ def _agg_scan_sharded(
     along the "shard" axis — the collective MergeScan (reference
     query/src/dist_plan/analyzer.rs:35 splits plans at commutativity
     boundaries and gathers at merge_scan.rs:122; here the combine rides ICI
-    instead of point-to-point Flight). first/last are non-commutative over
-    unordered shards and stay on the single-device path."""
+    instead of point-to-point Flight). first/last pair (value, ts) and the
+    shard with the global extreme ts wins (combine_partial_aggs), so
+    lastpoint-class queries stay on the mesh; the *_ts planes never leave
+    the collective."""
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     in_specs = ({k: P("shard") for k in cols}, P("shard"))
+    need_ts = bool({"first", "last"} & set(ops))
 
     @functools.partial(shard_map, mesh=mesh, in_specs=in_specs,
                        out_specs=P(), check_vma=False)
@@ -338,7 +347,7 @@ def _agg_scan_sharded(
             local_cols, local_mask, where=where, keys=keys,
             agg_args=agg_args, ops=ops, num_segments=num_segments,
             ts_name=ts_name, tag_names=tag_names, schema=schema,
-            need_ts=False, acc_dtype=acc_dtype,
+            need_ts=need_ts, acc_dtype=acc_dtype,
         )
         part = {op: (v if v.ndim > 1 else v[:, None])
                 for op, v in part.items()}
@@ -357,9 +366,11 @@ def _build_prep(scan, arg_names, start, end, out_rows, acc_dtype, has_nan,
 
     kind None -> the sum/count plane: [vals0 | valid | ones] (2F+1) with
     NaNs present, [vals | ones] (F+1) without. kind "min"/"max" ->
-    identity-filled value planes for segment-min/max. Padding rows are
-    excluded by the base mask; extreme planes still get the identity
-    fill there for safety."""
+    identity-filled value planes for segment-min/max. kind "sq" ->
+    squared values with NaN -> 0 (zero contribution), always f64: the
+    stddev/variance cancellation needs full precision (see segment_agg).
+    Padding rows are excluded by the base mask; extreme planes still get
+    the identity fill there for safety."""
     f = len(arg_names)
     m = end - start
     np_acc = np.dtype(str(acc_dtype))
@@ -376,6 +387,13 @@ def _build_prep(scan, arg_names, start, end, out_rows, acc_dtype, has_nan,
             else:
                 plane[:m, j] = src
         plane[:m, width - 1] = 1.0
+        return plane
+    if kind == "sq":
+        plane = np.zeros((out_rows, f), dtype=np.float64)
+        for j, name in enumerate(arg_names):
+            src = np.asarray(scan.columns[name][start:end],
+                             dtype=np.float64)
+            plane[:m, j] = np.where(np.isnan(src), 0.0, src * src)
         return plane
     fill = np.inf if kind == "min" else -np.inf
     plane = np.full((out_rows, f), fill, dtype=np_acc)
@@ -448,6 +466,10 @@ def _agg_scan_sharded_prepared(
                                         num_segments=G + 1)[:G], "shard")
                 small = _seg_type_min(tmax.dtype)
                 acc[k] = jnp.where(tmax == small, jnp.nan, tmax)
+            elif k == "sumsq":
+                acc[k] = jax.lax.psum(
+                    jax.ops.segment_sum(local_cols["__prep_sq__"], ids,
+                                        num_segments=G + 1)[:G], "shard")
             else:
                 denom = jnp.maximum(cnts, 1.0)
                 acc[k] = jnp.where(cnts > 0, sums / denom, jnp.nan)
@@ -455,6 +477,45 @@ def _agg_scan_sharded_prepared(
             [acc[k].astype(pack_dtype) for k in float_ops], axis=1)
 
     return step(cols, base_mask)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("where", "keys", "num_segments", "tag_names", "schema"),
+)
+def _prep_stream_step(acc, cols, n_valid, *, where, keys, num_segments,
+                      tag_names, schema):
+    """One streaming step on the PREPARED planes: a single dead-segment
+    segment-sum per chunk folded into the device accumulator — the
+    streaming twin of _agg_scan_prepared (none of the [N, F] masking
+    passes of the general streaming kernel)."""
+    G = num_segments
+    plane = cols["__prep__"]
+    mask = jnp.arange(plane.shape[0]) < n_valid
+    if where is not None:
+        w = eval_device(where, cols, tag_names, schema)
+        mask = mask & (w if w.dtype == jnp.bool_ else w != 0)
+    gid = _group_ids(cols, keys, plane.shape[0])
+    ids = jnp.where(mask, gid, jnp.int32(G))
+    out = {"total": jax.ops.segment_sum(plane, ids, num_segments=G + 1)[:G]}
+    if "__prep_min__" in cols:
+        out["min"] = jax.ops.segment_min(cols["__prep_min__"], ids,
+                                         num_segments=G + 1)[:G]
+    if "__prep_max__" in cols:
+        out["max"] = jax.ops.segment_max(cols["__prep_max__"], ids,
+                                         num_segments=G + 1)[:G]
+    if "__prep_sq__" in cols:
+        out["sq"] = jax.ops.segment_sum(cols["__prep_sq__"], ids,
+                                        num_segments=G + 1)[:G]
+    if acc is not None:
+        out["total"] = out["total"] + acc["total"]
+        if "min" in out:
+            out["min"] = jnp.minimum(out["min"], acc["min"])
+        if "max" in out:
+            out["max"] = jnp.maximum(out["max"], acc["max"])
+        if "sq" in out:
+            out["sq"] = out["sq"] + acc["sq"]
+    return out
 
 
 class _NotStreamable(Exception):
@@ -1049,6 +1110,15 @@ class PhysicalExecutor:
         names = sorted(needed)
 
         block = config.stream_block_rows()
+        if not need_ts and self._prepared_ok(arg_exprs, ops, (), schema, {}):
+            # streaming twin of the prepared dense path: the chunk's
+            # value/validity plane is built once host-side and folded with
+            # ONE dead-segment segment-sum — no per-query [N, F] masking
+            self.last_path = "stream_prepared"
+            return self._fold_stream_prepared(
+                stream, bound_where, keys, arg_exprs, ops, num_groups,
+                tag_names, float_fields, schema, block, acc_dtype,
+                max(nf, 1))
         kw = dict(where=bound_where, keys=keys, agg_args=arg_exprs, ops=ops,
                   num_segments=num_groups, ts_name=ts_name,
                   tag_names=tag_names, schema=schema, need_ts=need_ts,
@@ -1088,6 +1158,89 @@ class PhysicalExecutor:
         for k in ("count", "rows"):
             if k in acc:
                 acc[k] = acc[k].astype(np.int64)
+        return acc
+
+    def _fold_stream_prepared(self, stream, bound_where, keys, arg_exprs,
+                              ops, num_groups, tag_names, float_fields,
+                              schema, block, acc_dtype, nf):
+        """Prepared-plane streaming fold (see _prep_stream_step). Plane
+        NaN-handling is conservative (`has_nan=True`): a stream can't
+        pre-scan its chunks for NULLs the way the materialized path can."""
+        from types import SimpleNamespace
+
+        from greptimedb_tpu.query.expr import collect_columns
+
+        arg_names = tuple(a.name for a in arg_exprs)
+        aux: set[str] = set()
+        collect_columns(bound_where, aux)
+        for k in keys:
+            aux.add(k.column)
+        aux_names = sorted(aux)
+        prep_dtype = jnp.dtype(jnp.float64) if "sumsq" in ops else acc_dtype
+        kw = dict(where=bound_where, keys=keys, num_segments=num_groups,
+                  tag_names=tag_names, schema=schema)
+        acc_dev = None
+        for cols_np, nrows in stream.chunks():
+            shim = SimpleNamespace(columns=cols_np)
+            for start in range(0, nrows, block):
+                end = min(start + block, nrows)
+                dev = {}
+                for name in aux_names:
+                    arr = pad_rows(np.asarray(cols_np[name][start:end]),
+                                   block)
+                    if name in float_fields and arr.dtype != acc_dtype:
+                        arr = arr.astype(acc_dtype)
+                    dev[name] = jnp.asarray(arr)
+                dev["__prep__"] = jnp.asarray(_build_prep(
+                    shim, arg_names, start, end, block, prep_dtype, True,
+                    None))
+                if "min" in ops:
+                    dev["__prep_min__"] = jnp.asarray(_build_prep(
+                        shim, arg_names, start, end, block, acc_dtype,
+                        False, "min"))
+                if "max" in ops:
+                    dev["__prep_max__"] = jnp.asarray(_build_prep(
+                        shim, arg_names, start, end, block, acc_dtype,
+                        False, "max"))
+                if "sumsq" in ops:
+                    dev["__prep_sq__"] = jnp.asarray(_build_prep(
+                        shim, arg_names, start, end, block, prep_dtype,
+                        False, "sq"))
+                acc_dev = _prep_stream_step(acc_dev, dev,
+                                            jnp.asarray(end - start), **kw)
+        G = num_groups
+        acc: dict[str, np.ndarray] = {}
+        if acc_dev is None:
+            # pruned-empty stream: identity planes
+            for op in ops:
+                if op == "rows":
+                    acc[op] = np.zeros((G, 1), dtype=np.int64)
+                elif op == "count":
+                    acc[op] = np.zeros((G, nf), dtype=np.int64)
+                elif op in ("sum", "sumsq"):
+                    acc[op] = np.zeros((G, nf))
+                else:
+                    acc[op] = np.full((G, nf), np.nan)
+            return acc
+        total = np.asarray(acc_dev["total"])
+        sums = total[:, :nf]
+        cnts = total[:, nf:2 * nf]
+        rows = total[:, 2 * nf:2 * nf + 1]
+        for op in ops:
+            if op == "sum":
+                acc[op] = sums
+            elif op == "count":
+                acc[op] = cnts.astype(np.int64)
+            elif op == "rows":
+                acc[op] = rows.astype(np.int64)
+            elif op == "min":
+                tmin = np.asarray(acc_dev["min"])
+                acc[op] = np.where(np.isposinf(tmin), np.nan, tmin)
+            elif op == "max":
+                tmax = np.asarray(acc_dev["max"])
+                acc[op] = np.where(np.isneginf(tmax), np.nan, tmax)
+            elif op == "sumsq":
+                acc[op] = np.asarray(acc_dev["sq"])
         return acc
 
     def _plan_key_stream(self, i, kexpr, ctx, stream, scan_node):
@@ -1314,7 +1467,11 @@ class PhysicalExecutor:
                 tag_names, schema, float_ops, int_ops, widths, pack_dtype)
 
         mesh = self.mesh
-        if (mesh is not None and not int_ops
+        # first/last produce int *_ts planes, but those are consumed
+        # INSIDE the collective combine — only value planes leave the mesh
+        ts_only_ints = bool(int_ops) and all(k.endswith("_ts")
+                                             for k in int_ops)
+        if (mesh is not None and (not int_ops or ts_only_ints)
                 and set(ops) <= set(COLLECTIVE_OPS)
                 and n >= config.mesh_min_rows()):
             self.last_path = "sharded"
@@ -1323,6 +1480,7 @@ class PhysicalExecutor:
                 acc_dtype, dedup_mask, bound_where, keys, arg_exprs, ops,
                 num_groups, ts_name, tag_names, schema, float_ops, pack_dtype)
             packed_i = None
+            int_ops = ()
         elif self._prepared_ok(arg_exprs, ops, int_ops, schema, extra_cols):
             # fast dense path: query-invariant [N, 2F+1] value/validity
             # planes are HBM-cached; per query only [N] masks/keys run
@@ -1335,6 +1493,11 @@ class PhysicalExecutor:
             n_valids = []
             arg_names = tuple(a.name for a in arg_exprs)
             has_nan = self._scan_has_nan(scan, arg_names)
+            # variance/stddev difference two moments: BOTH must carry f64
+            # even on the f32 fast path (see segment_agg) — the sum plane
+            # included, or the cancellation eats the f64 sq plane's work
+            prep_dtype = jnp.dtype(jnp.float64) if "sumsq" in ops \
+                else acc_dtype
             for start in range(0, n, block):
                 end = min(start + block, n)
                 cols = {}
@@ -1344,7 +1507,7 @@ class PhysicalExecutor:
                         acc_dtype if name in float_fields else None,
                     )
                 cols["__prep__"] = self._prep_plane(
-                    scan, arg_names, start, end, block, acc_dtype, has_nan)
+                    scan, arg_names, start, end, block, prep_dtype, has_nan)
                 if "min" in ops:
                     cols["__prep_min__"] = self._prep_extreme_plane(
                         scan, arg_names, start, end, block, acc_dtype,
@@ -1353,6 +1516,10 @@ class PhysicalExecutor:
                     cols["__prep_max__"] = self._prep_extreme_plane(
                         scan, arg_names, start, end, block, acc_dtype,
                         "max")
+                if "sumsq" in ops:
+                    cols["__prep_sq__"] = self._prep_extreme_plane(
+                        scan, arg_names, start, end, block, prep_dtype,
+                        "sq")
                 blocks.append(cols)
                 n_valids.append(end - start)
                 if dmasks is not None:
@@ -1491,15 +1658,21 @@ class PhysicalExecutor:
             arg_names = tuple(a.name for a in arg_exprs)
             has_nan = self._scan_has_nan(scan, arg_names)
             nf = len(arg_names)
-            plane_kinds = [("__prep__", None)]
+            # sum + sq moments both need f64 for stddev/variance (see the
+            # dense branch note)
+            prep_dtype = jnp.dtype(jnp.float64) if "sumsq" in ops \
+                else acc_dtype
+            plane_kinds = [("__prep__", None, prep_dtype)]
             if "min" in ops:
-                plane_kinds.append(("__prep_min__", "min"))
+                plane_kinds.append(("__prep_min__", "min", acc_dtype))
             if "max" in ops:
-                plane_kinds.append(("__prep_max__", "max"))
-            for plane_name, kind in plane_kinds:
-                def build_plane(kind=kind):
+                plane_kinds.append(("__prep_max__", "max", acc_dtype))
+            if "sumsq" in ops:
+                plane_kinds.append(("__prep_sq__", "sq", prep_dtype))
+            for plane_name, kind, pdt in plane_kinds:
+                def build_plane(kind=kind, pdt=pdt):
                     whole = _build_prep(scan, arg_names, 0, n, n_pad,
-                                        acc_dtype, has_nan, kind)
+                                        pdt, has_nan, kind)
                     return jax.device_put(whole, sharding)
 
                 if scan.region_id < 0:
@@ -1507,7 +1680,7 @@ class PhysicalExecutor:
                 else:
                     key = (scan.region_id, scan.data_version,
                            scan.scan_fingerprint, plane_name, arg_names,
-                           "sharded", n_pad, n_shard, str(acc_dtype),
+                           "sharded", n_pad, n_shard, str(pdt),
                            has_nan)
                     cols[plane_name] = self.cache.get(key, build_plane)
             return _agg_scan_sharded_prepared(
@@ -1542,12 +1715,14 @@ class PhysicalExecutor:
     def _prepared_ok(self, arg_exprs, ops, int_ops, schema,
                      extra_cols) -> bool:
         """Eligibility for the prepared dense path: plain float/int FIELD
-        columns aggregated with sum/count/mean/rows/min/max (min/max ride
-        the __prep_min__/__prep_max__ identity-filled planes; first/last/
-        sumsq still need per-element masking the planes can't encode)."""
+        columns aggregated with sum/count/mean/rows/min/max/sumsq
+        (min/max ride identity-filled planes, sumsq a squared-values
+        plane; first/last still need the ts pairing the planes can't
+        encode)."""
         if int_ops or not arg_exprs:
             return False
-        if not set(ops) <= {"mean", "sum", "count", "rows", "min", "max"}:
+        if not set(ops) <= {"mean", "sum", "count", "rows", "min", "max",
+                            "sumsq"}:
             return False
         field_names = {c.name for c in schema.field_columns}
         return all(
@@ -1592,9 +1767,10 @@ class PhysicalExecutor:
 
     def _prep_extreme_plane(self, scan, arg_names, start, end, block,
                             acc_dtype, kind: str):
-        """min/max companion plane: values with NaN (and padding) replaced
-        by the reduction's identity, so the dead-segment id trick is the
-        only masking the query needs."""
+        """min/max/sq companion plane: values with NaN (and padding)
+        replaced by the reduction's identity (±inf for extremes, 0 for
+        the squared-sum plane), so the dead-segment id trick is the only
+        masking the query needs."""
 
         def build():
             return jnp.asarray(_build_prep(scan, arg_names, start, end,

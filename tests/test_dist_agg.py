@@ -325,3 +325,23 @@ class TestCombineVectorized:
         assert out["planes"]["first"][ia, 0] == 2.0   # ts 50 oldest
         assert out["planes"]["last"][ia, 0] == 2.0    # ts 150 newest
         assert out["planes"]["first"][ib, 0] == 9.0
+
+    def test_combine_first_last_multi_arg(self):
+        """first/last over 2+ argument columns: the ts plane is [R, 1]
+        (one ts per group) while value planes are [R, F] — the combine
+        must broadcast, not index ts per field."""
+        from greptimedb_tpu.query.dist_agg import combine_partials
+
+        def part(key, vals, ts):
+            return {
+                "keys": [np.asarray([key], dtype=object)],
+                "planes": {
+                    "first": np.asarray([vals]),
+                    "first_ts": np.asarray([ts], dtype=np.int64),
+                },
+            }
+
+        out = combine_partials(
+            [part("a", [1.0, 10.0], 100), part("a", [2.0, 20.0], 50)],
+            1, ("first",))
+        np.testing.assert_allclose(out["planes"]["first"][0], [2.0, 20.0])

@@ -137,12 +137,29 @@ class TestEngineMeshIntegration:
             assert a[0] == b[0]
             np.testing.assert_allclose(a[1], b[1], rtol=1e-9)
 
-    def test_first_last_falls_back(self, db):
-        # non-commutative over unordered shards -> single-device path; must
-        # still be correct (falls through the mesh gate)
-        r = db.execute_one(
-            "SELECT host, last(usage) FROM cpu GROUP BY host ORDER BY host")
-        assert len(r.rows()) == 8
+    def test_first_last_on_mesh(self, db, monkeypatch):
+        # first/last ride the mesh now: (value, ts) pairing picks the
+        # shard holding the global oldest/newest row per group
+        sql = ("SELECT host, first(usage), last(usage), last(mem) FROM cpu "
+               "GROUP BY host ORDER BY host")
+        sharded = db.execute_one(sql).rows()
+        assert db.executor.last_path == "sharded"
+        single = self._oracle(db, sql, monkeypatch)
+        assert len(sharded) == 8
+        for a, b in zip(sharded, single):
+            assert a[0] == b[0]
+            np.testing.assert_allclose(a[1:], b[1:], rtol=1e-12)
+
+    def test_lastpoint_shape_on_mesh(self, db, monkeypatch):
+        # TSBS lastpoint: last_value(x ORDER BY ts) per series
+        sql = ("SELECT host, last_value(usage ORDER BY ts) FROM cpu "
+               "GROUP BY host ORDER BY host")
+        sharded = db.execute_one(sql).rows()
+        assert db.executor.last_path == "sharded"
+        single = self._oracle(db, sql, monkeypatch)
+        for a, b in zip(sharded, single):
+            assert a[0] == b[0]
+            np.testing.assert_allclose(a[1], b[1], rtol=1e-12)
 
 
 class TestShardedPrepared:

@@ -335,3 +335,18 @@ class TestWindowFunctions:
             "SELECT count(*) c FROM cpu WHERE usage NOT IN "
             "(SELECT x FROM nn)")
         assert r.rows() == [[0]]
+
+
+class TestNotInNullContexts:
+    def test_not_wrapping_not_in_respects_unknown(self, db):
+        # WHERE NOT (x NOT IN (list with NULL)): unmatched rows evaluate
+        # NOT(UNKNOWN) = UNKNOWN and must be EXCLUDED, not returned
+        db.execute_one(
+            "CREATE TABLE nn2 (ts TIMESTAMP(3) NOT NULL, x DOUBLE,"
+            " TIME INDEX (ts))")
+        db.execute_one("INSERT INTO nn2 VALUES (1, 10.0), (2, NULL)")
+        r = db.execute_one(
+            "SELECT count(*) c FROM cpu WHERE NOT "
+            "(usage NOT IN (SELECT x FROM nn2))")
+        # only usage=10.0 matches -> NOT(FALSE) = TRUE for that row only
+        assert r.rows() == [[1]]

@@ -59,7 +59,8 @@ class TestStreamingScan:
     def test_stream_path_taken(self, db, monkeypatch):
         _fill(db)
         db.execute_one("SELECT host, avg(usage) FROM cpu GROUP BY host")
-        assert db.executor.last_path == "stream"
+        # plain field aggregates take the prepared streaming fold
+        assert db.executor.last_path == "stream_prepared"
 
     def test_double_groupby_matches(self, db, monkeypatch):
         _fill(db)
@@ -67,7 +68,7 @@ class TestStreamingScan:
                "avg(usage), count(usage), min(mem), max(mem), sum(usage) "
                "FROM cpu GROUP BY host, m ORDER BY host, m")
         streamed = db.execute_one(sql).rows()
-        assert db.executor.last_path == "stream"
+        assert db.executor.last_path == "stream_prepared"
         mat = _materialized(db, sql, monkeypatch)
         assert len(streamed) == len(mat) > 0
         for a, b in zip(streamed, mat):
@@ -79,6 +80,7 @@ class TestStreamingScan:
         sql = ("SELECT sum(usage), count(mem), max(ts) FROM cpu "
                "WHERE host IN ('h1', 'h2') AND ts >= 100000")
         streamed = db.execute_one(sql).rows()
+        # max(ts) aggregates the time index (not a field) -> general path
         assert db.executor.last_path == "stream"
         mat = _materialized(db, sql, monkeypatch)
         np.testing.assert_allclose(streamed, mat, rtol=1e-12)
@@ -88,6 +90,7 @@ class TestStreamingScan:
         sql = ("SELECT host, last(usage), first(mem) FROM cpu "
                "GROUP BY host ORDER BY host")
         streamed = db.execute_one(sql).rows()
+        # first/last need ts pairing -> general streaming kernel
         assert db.executor.last_path == "stream"
         mat = _materialized(db, sql, monkeypatch)
         assert streamed == mat
@@ -167,3 +170,33 @@ class TestScanStreamUnit:
         assert len(sizes) > 1  # actually chunked
         assert max(sizes) <= 8 * 1000  # groups_per_chunk * row_group_size
         eng.close()
+
+
+class TestStreamPrepared:
+    """The prepared-plane streaming fold (stream_prepared): one
+    dead-segment segment-sum per chunk, matching the materialized path
+    bit-for-bit on sums and to f64 tolerance on moments."""
+
+    def test_stddev_streams_prepared(self, db, monkeypatch):
+        _fill(db)
+        sql = ("SELECT host, stddev(usage), variance(mem) FROM cpu "
+               "GROUP BY host ORDER BY host")
+        streamed = db.execute_one(sql).rows()
+        assert db.executor.last_path == "stream_prepared"
+        mat = _materialized(db, sql, monkeypatch)
+        assert len(streamed) == len(mat) > 0
+        for a, b in zip(streamed, mat):
+            assert a[0] == b[0]
+            np.testing.assert_allclose(a[1:], b[1:], rtol=1e-9)
+
+    def test_first_last_stays_general(self, db, monkeypatch):
+        _fill(db)
+        sql = ("SELECT host, last(usage) FROM cpu GROUP BY host "
+               "ORDER BY host")
+        streamed = db.execute_one(sql).rows()
+        # first/last need ts pairing -> general streaming kernel
+        assert db.executor.last_path == "stream"
+        mat = _materialized(db, sql, monkeypatch)
+        for a, b in zip(streamed, mat):
+            assert a[0] == b[0]
+            np.testing.assert_allclose(a[1], b[1], rtol=1e-12)
