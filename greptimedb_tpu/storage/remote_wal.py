@@ -4,29 +4,61 @@ Mirrors the reference's `KafkaLogStore` (src/log-store/src/kafka/
 log_store.rs — a shared-topic remote WAL so a failover candidate can
 replay a dead datanode's unflushed writes from durable shared storage).
 The TPU build's shared medium is the object store (fs/memory/S3): each
-acknowledged append is one immutable object keyed by sequence, so any
-node that can see the store can replay the region — no access to the
-failed node's local disk required.
+acknowledged append is one immutable object visible to any node, so a
+failover candidate can replay the region — no access to the failed
+node's local disk required.
 
-Key layout: `wal/<region_id>/<seq:020d>` → CRC-framed Arrow IPC payload
-(same frame as the local WAL, so torn/corrupt objects are detected).
-`append` is durable once the object write returns (the object store is
-the fsync). `obsolete` deletes keys below the flushed sequence —
-per-object, no rewrite. Listing is ordered by the zero-padded key, which
-IS sequence order.
+Batching: `append_many` writes ONE segment object per group-commit
+cycle, with every entry CRC-framed back-to-back inside it — the analog
+of the reference batching records per Kafka producer
+(src/log-store/src/kafka/client_manager.rs). On real object stores this
+turns a round-trip per entry into a round-trip per commit cycle, which
+is what makes group commit effective on exactly the backend that needs
+it.
+
+Key layout: `wal/<region_id>/<first_seq:020d>` → one or more CRC-framed
+Arrow IPC payloads (same frame as the local WAL, so torn/corrupt tails
+are detected). Listing order of the zero-padded keys IS sequence order.
+A per-region in-memory segment index (seeded with one listing, then
+maintained by append/obsolete) keeps steady-state `obsolete` free of
+listings; replay on a fresh node lists once, which is unavoidable.
 """
 
 from __future__ import annotations
 
 import struct
+import threading
 import zlib
-from typing import Iterator
+from typing import Iterator, Optional
 
 from greptimedb_tpu.datatypes.recordbatch import RecordBatch
 from greptimedb_tpu.objectstore import ObjectStore, ObjectStoreError
 from greptimedb_tpu.storage.wal import WalEntry, _decode_batch, _encode_batch
 
 _HEADER = struct.Struct("<IIQQB")  # payload_len, crc32, region_id, seq, op_type
+
+
+def _encode_entries(region_id: int, entries) -> bytes:
+    parts = []
+    for seq, op_type, batch in entries:
+        payload = _encode_batch(batch)
+        parts.append(_HEADER.pack(len(payload), zlib.crc32(payload),
+                                  region_id, seq, op_type))
+        parts.append(payload)
+    return b"".join(parts)
+
+
+def _decode_entries(data: bytes) -> Iterator[WalEntry]:
+    """Parse back-to-back frames; stop at the first torn/corrupt frame
+    (nothing after it is trustworthy)."""
+    off = 0
+    while off + _HEADER.size <= len(data):
+        plen, crc, rid, seq, op = _HEADER.unpack_from(data, off)
+        payload = data[off + _HEADER.size:off + _HEADER.size + plen]
+        if len(payload) != plen or zlib.crc32(payload) != crc:
+            return
+        yield WalEntry(rid, seq, op, _decode_batch(payload))
+        off += _HEADER.size + plen
 
 
 class RemoteWal:
@@ -36,6 +68,10 @@ class RemoteWal:
     def __init__(self, store: ObjectStore, prefix: str = "wal"):
         self.store = store
         self.prefix = prefix.rstrip("/")
+        # region -> sorted list of (first_seq, last_seq, key); None until
+        # seeded by one listing
+        self._segments: dict[int, list] = {}
+        self._lock = threading.Lock()
 
     def _key(self, region_id: int, seq: int) -> str:
         return f"{self.prefix}/{region_id}/{seq:020d}"
@@ -43,55 +79,115 @@ class RemoteWal:
     def _region_prefix(self, region_id: int) -> str:
         return f"{self.prefix}/{region_id}/"
 
+    def _list_segments(self, region_id: int) -> list:
+        """(first_seq, key) pairs in sequence order, from one listing.
+        last_seq is unknown without reading the object; recorded as None
+        and resolved lazily (only `obsolete` cares, and only to decide
+        deletability — an unknown last_seq is simply kept)."""
+        out = []
+        for key in sorted(self.store.list(self._region_prefix(region_id))):
+            try:
+                first = int(key.rsplit("/", 1)[-1])
+            except ValueError:
+                continue
+            out.append((first, None, key))
+        return out
+
+    def _seeded(self, region_id: int) -> list:
+        segs = self._segments.get(region_id)
+        if segs is None:
+            segs = self._list_segments(region_id)
+            self._segments[region_id] = segs
+        return segs
+
     # ---- write -------------------------------------------------------------
 
     def append(self, region_id: int, seq: int, op_type: int,
                batch: RecordBatch) -> None:
-        payload = _encode_batch(batch)
-        frame = _HEADER.pack(len(payload), zlib.crc32(payload), region_id,
-                             seq, op_type)
-        self.store.write(self._key(region_id, seq), frame + payload)
+        self.append_many(region_id, [(seq, op_type, batch)])
 
     def append_many(self, region_id: int, entries) -> None:
-        """Group-commit analog: one object per entry (object puts are
-        atomic; there is no fsync to amortize), same call shape as the
-        local WAL so the write workers treat both backends alike."""
-        for seq, op_type, batch in entries:
-            self.append(region_id, seq, op_type, batch)
+        """Group-commit: ONE segment object per call, all entries framed
+        inside (durable once the object write returns — the object store
+        is the fsync)."""
+        entries = list(entries)
+        if not entries:
+            return
+        first = entries[0][0]
+        last = entries[-1][0]
+        key = self._key(region_id, first)
+        self.store.write(key, _encode_entries(region_id, entries))
+        with self._lock:
+            self._seeded(region_id).append((first, last, key))
 
     # ---- replay ------------------------------------------------------------
 
     def replay(self, region_id: int, from_seq: int = 0) -> Iterator[WalEntry]:
+        segs = []
         for key in sorted(self.store.list(self._region_prefix(region_id))):
-            seq_str = key.rsplit("/", 1)[-1]
             try:
-                seq = int(seq_str)
+                segs.append((int(key.rsplit("/", 1)[-1]), key))
             except ValueError:
                 continue
-            if seq < from_seq:
+        for i, (first, key) in enumerate(segs):
+            # a segment can be skipped WITHOUT reading it when the next
+            # segment starts at-or-below from_seq (its entries all
+            # precede the next first_seq)
+            if i + 1 < len(segs) and segs[i + 1][0] <= from_seq:
                 continue
             data = self.store.read(key)
-            if len(data) < _HEADER.size:
-                break  # torn object: nothing after it is trustworthy
-            plen, crc, rid, hseq, op = _HEADER.unpack_from(data, 0)
-            payload = data[_HEADER.size:_HEADER.size + plen]
-            if len(payload) != plen or zlib.crc32(payload) != crc:
-                break
-            yield WalEntry(rid, hseq, op, _decode_batch(payload))
+            for entry in _decode_entries(data):
+                if entry.seq >= from_seq:
+                    yield entry
 
     # ---- truncation --------------------------------------------------------
 
     def obsolete(self, region_id: int, up_to_seq: int) -> None:
-        for key in self.store.list(self._region_prefix(region_id)):
-            try:
-                seq = int(key.rsplit("/", 1)[-1])
-            except ValueError:
-                continue
-            if seq < up_to_seq:
-                try:
-                    self.store.delete(key)
-                except ObjectStoreError:
-                    pass
+        """Delete segments whose every entry is below the flushed
+        sequence. Uses the in-memory segment index (no listing in steady
+        state); a segment with unknown extent (pre-existing object seen
+        only via listing) resolves its last entry by reading the object
+        once."""
+        with self._lock:
+            segs = list(self._seeded(region_id))
+        resolved = []  # (first, last, key) with last resolved
+        deleted: set[str] = set()
+        for first, last, key in segs:
+            if first < up_to_seq:
+                if last is None:
+                    last = self._segment_last_seq(key, first)
+                if last < up_to_seq:
+                    try:
+                        self.store.delete(key)
+                        deleted.add(key)
+                    except ObjectStoreError:
+                        pass
+            resolved.append((first, last, key))
+        resolved_by_key = {key: (first, last)
+                           for first, last, key in resolved}
+        with self._lock:
+            # merge against the CURRENT list: segments appended
+            # concurrently must survive, and a region removed by
+            # delete_region/close_region must not be resurrected
+            current = self._segments.get(region_id)
+            if current is not None:
+                self._segments[region_id] = [
+                    (resolved_by_key.get(key, (first, last))[0],
+                     resolved_by_key.get(key, (first, last))[1], key)
+                    for first, last, key in current if key not in deleted]
+
+    def _segment_last_seq(self, key: str, first: int) -> int:
+        try:
+            data = self.store.read(key)
+        except ObjectStoreError:
+            # unreadable (transient store error): report "infinite" so
+            # the caller KEEPS the segment — deleting on a read failure
+            # could drop unflushed entries a failover still needs
+            return (1 << 62)
+        last = first
+        for entry in _decode_entries(data):
+            last = entry.seq
+        return last
 
     def delete_region(self, region_id: int) -> None:
         for key in self.store.list(self._region_prefix(region_id)):
@@ -99,11 +195,14 @@ class RemoteWal:
                 self.store.delete(key)
             except ObjectStoreError:
                 pass
+        with self._lock:
+            self._segments.pop(region_id, None)
 
     # ---- lifecycle (no per-region handles to manage) ------------------------
 
     def close_region(self, region_id: int) -> None:
-        pass
+        with self._lock:
+            self._segments.pop(region_id, None)
 
     def close(self) -> None:
         pass
