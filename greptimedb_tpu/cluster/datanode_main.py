@@ -1,0 +1,51 @@
+"""Standalone datanode process entrypoint.
+
+`python -m greptimedb_tpu.cluster.datanode_main <shared_dir> <port_file>`
+builds a RegionEngine over the SHARED data dir with the remote
+(object-store) WAL and serves it over Flight — the real process shape of
+a reference datanode (datanode/src/datanode.rs: region server behind
+gRPC, WAL on shared storage so failover candidates can replay it).
+
+The process writes its bound port to <port_file> and then serves until
+killed; `kill -9` is the expected shutdown in the failover harness
+(tests-integration/src/cluster.rs kills real processes the same way).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+
+def main() -> None:
+    # never touch a TPU tunnel from a datanode child: pin CPU before any
+    # backend init (the env var alone is overridden by sitecustomize)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    shared_dir, port_file = sys.argv[1], sys.argv[2]
+    write_workers = int(sys.argv[3]) if len(sys.argv) > 3 else 2
+
+    from greptimedb_tpu.servers.flight import FlightServer
+    from greptimedb_tpu.storage.engine import EngineConfig, RegionEngine
+
+    engine = RegionEngine(EngineConfig(
+        data_dir=shared_dir, wal_backend="remote",
+        write_workers=write_workers))
+    server = FlightServer(None, port=0, region_engine=engine)
+    tmp = port_file + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(str(server.port))
+    os.replace(tmp, port_file)  # atomic: readers never see a partial file
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

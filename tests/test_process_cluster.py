@@ -1,0 +1,90 @@
+"""Multi-process cluster: real datanode OS processes, kill -9 failover
+(reference tests-integration/src/cluster.rs:66-135 +
+tests/region_failover.rs — the harness kills real processes and asserts
+data survives via the shared-storage WAL)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from greptimedb_tpu.cluster.process_cluster import ProcessCluster
+from greptimedb_tpu.meta.metasrv import MetasrvOptions
+
+CREATE = (
+    "CREATE TABLE m (host STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, "
+    "PRIMARY KEY(host))"
+)
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    c = ProcessCluster(str(tmp_path), num_datanodes=2,
+                       opts=MetasrvOptions())
+    yield c
+    c.close()
+
+
+def test_datanodes_are_real_processes(cluster):
+    import os
+
+    pids = [dn.proc.pid for dn in cluster.datanodes.values()]
+    assert len(set(pids)) == 2
+    for pid in pids:
+        assert pid != os.getpid()
+        os.kill(pid, 0)  # raises if not a live process
+
+
+def test_sql_over_process_boundary(cluster):
+    t0 = time.time() * 1000
+    cluster.beat_all(t0)
+    cluster.sql(CREATE)
+    cluster.sql("INSERT INTO m VALUES ('a', 1.0, 1000), ('b', 2.0, 2000)")
+    r = cluster.sql("SELECT host, v FROM m ORDER BY host")
+    assert r.rows() == [["a", 1.0], ["b", 2.0]]
+
+
+def test_kill9_failover_replays_remote_wal(cluster):
+    """kill -9 the owning datanode with UNFLUSHED writes; failover must
+    reopen the region on the survivor and replay them from the shared
+    object-store WAL."""
+    t = 0.0
+    for _ in range(5):  # train the failure detector's interval history
+        cluster.beat_all(t)
+        t += 3000.0
+    cluster.sql(CREATE)
+    info = cluster.catalog.table("public", "m")
+    rid = info.region_ids[0]
+    owner = cluster.metasrv.routes.get(str(rid >> 32)).regions[0].leader_node
+
+    # acknowledged writes that never flush: they exist ONLY in the
+    # remote WAL when the process dies
+    rows = ", ".join(f"('h{i}', {float(i)}, {1000 * (i + 1)})"
+                     for i in range(20))
+    cluster.sql(f"INSERT INTO m VALUES {rows}")
+    for _ in range(5):  # the owner reports the region before dying
+        cluster.beat_all(t)
+        t += 3000.0
+
+    cluster.kill_datanode(owner)
+    assert not cluster.datanodes[owner].alive
+
+    # survivors keep beating; the dead node's beats stop and the
+    # metasrv's failure detector expires it
+    for _ in range(20):
+        cluster.beat_all(t)
+        t += 3000.0
+    failed = cluster.tick(t)
+    assert failed, "failover should start for the dead node's region"
+    # deliver the OPEN_REGION instruction to the failover target
+    cluster.beat_all(t)
+
+    r = cluster.sql("SELECT host, v FROM m ORDER BY host")
+    got = r.rows()
+    assert len(got) == 20
+    np.testing.assert_allclose(sorted(row[1] for row in got),
+                               [float(i) for i in range(20)])
+    # and the region now lives on the survivor
+    new_owner = cluster.metasrv.routes.get(
+        str(rid >> 32)).regions[0].leader_node
+    assert new_owner != owner
