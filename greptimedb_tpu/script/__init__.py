@@ -24,7 +24,11 @@ scripts table, src/script/src/manager.rs).
 
 from __future__ import annotations
 
+import builtins
+import ctypes
 import json
+import os
+import threading
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
@@ -37,6 +41,105 @@ SCRIPT_PREFIX = "__script/"
 
 class ScriptError(Exception):
     pass
+
+
+class ScriptTimeout(ScriptError):
+    pass
+
+
+class _Killed(BaseException):
+    """Injected into a runaway script thread. Derives BaseException so a
+    script's own `except Exception` handler cannot swallow it and keep
+    spinning."""
+
+
+# ---- sandbox ---------------------------------------------------------------
+#
+# The reference embeds a RustPython VM, which is a hard boundary
+# (src/script/Cargo.toml:9-20). Executing natively we settle for
+# defense-in-depth: a curated builtins table (no open/exec/eval, no
+# arbitrary __import__) plus a wall-clock limit. This blocks the
+# straightforward file/network/runaway-loop abuse an authenticated
+# script user could attempt; it is NOT a security boundary against a
+# determined attacker (CPython introspection escapes exist), which is
+# why script endpoints also sit behind auth. Opt out with
+# GREPTIMEDB_TPU_SCRIPT_SANDBOX=off for trusted deployments that want
+# full-power scripts.
+
+_ALLOWED_MODULES = {
+    "numpy", "jax", "math", "statistics", "json", "datetime", "itertools",
+    "functools", "collections", "re", "bisect", "heapq", "random",
+}
+
+_SAFE_BUILTIN_NAMES = (
+    "abs", "all", "any", "bool", "bytes", "callable", "chr", "complex",
+    "dict", "divmod", "enumerate", "filter", "float", "format",
+    "frozenset", "hash", "hex", "int", "isinstance", "issubclass", "iter",
+    "len", "list", "map", "max", "min", "next", "object", "oct", "ord",
+    "pow", "print", "range", "repr", "reversed", "round", "set", "slice",
+    "sorted", "str", "sum", "tuple", "zip",
+    # exceptions scripts legitimately raise/catch
+    "ArithmeticError", "AttributeError", "BaseException", "Exception",
+    "IndexError", "KeyError", "LookupError", "NameError",
+    "NotImplementedError", "OverflowError", "RuntimeError",
+    "StopIteration", "TypeError", "ValueError", "ZeroDivisionError",
+)
+
+
+def _guarded_import(name, globals=None, locals=None, fromlist=(), level=0):
+    root = name.split(".")[0]
+    if root not in _ALLOWED_MODULES:
+        raise ScriptError(
+            f"import of {name!r} is not allowed in scripts (allowed: "
+            f"{', '.join(sorted(_ALLOWED_MODULES))})")
+    return __import__(name, globals, locals, fromlist, level)
+
+
+def _safe_builtins() -> dict:
+    table = {n: getattr(builtins, n) for n in _SAFE_BUILTIN_NAMES}
+    table["__import__"] = _guarded_import
+    return table
+
+
+def _sandbox_enabled() -> bool:
+    return os.environ.get("GREPTIMEDB_TPU_SCRIPT_SANDBOX", "on").lower() \
+        not in ("off", "0", "false", "no", "disabled")
+
+
+def _script_timeout_s() -> float:
+    return float(os.environ.get("GREPTIMEDB_TPU_SCRIPT_TIMEOUT_S", "30"))
+
+
+def _run_limited(fn, timeout_s: float):
+    """Run `fn` under a wall-clock cap. A runaway pure-Python loop is
+    interrupted with an async exception (PyThreadState_SetAsyncExc);
+    code stuck inside a C call cannot be interrupted and the worker
+    thread is abandoned (daemon) after the caller gets its timeout."""
+    out: dict = {}
+
+    def worker():
+        try:
+            out["value"] = fn()
+        except BaseException as e:  # noqa: BLE001 — reported to caller
+            out["error"] = e
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        tid = t.ident
+        if tid is not None:
+            ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                ctypes.c_long(tid), ctypes.py_object(_Killed))
+        t.join(1.0)
+        raise ScriptTimeout(
+            f"script exceeded the {timeout_s:.0f}s wall-clock limit")
+    if "error" in out:
+        err = out["error"]
+        if isinstance(err, _Killed):
+            raise ScriptTimeout("script exceeded the wall-clock limit")
+        raise err
+    return out.get("value")
 
 
 @dataclass
@@ -117,7 +220,16 @@ class ScriptEngine:
                 if a not in params:
                     raise ScriptError(f"missing param {a!r}")
                 arg_values.append(params[a])
-        out = copr_meta.fn(*arg_values)
+        try:
+            if _sandbox_enabled():
+                out = _run_limited(lambda: copr_meta.fn(*arg_values),
+                                   _script_timeout_s())
+            else:
+                out = copr_meta.fn(*arg_values)
+        except ScriptError:
+            raise
+        except Exception as e:  # noqa: BLE001 — user code boundary
+            raise ScriptError(f"script failed: {e}") from e
         return self._wrap(out, copr_meta)
 
     def _compile(self, code: str) -> Coprocessor:
@@ -129,8 +241,21 @@ class ScriptEngine:
             "np": np, "numpy": np, "jax": jax, "jnp": jnp,
             "query": self._query_api,
         }
-        try:
+        sandboxed = _sandbox_enabled()
+        if sandboxed:
+            # restricted builtins bind to the module namespace, so the
+            # coprocessor function body stays restricted when it runs
+            # later (its __globals__ IS this namespace)
+            namespace["__builtins__"] = _safe_builtins()
+
+        def run():
             exec(compile(code, "<script>", "exec"), namespace)  # noqa: S102 — server-side scripting is the feature
+
+        try:
+            if sandboxed:
+                _run_limited(run, _script_timeout_s())
+            else:
+                run()
         except ScriptError:
             raise
         except Exception as e:  # noqa: BLE001 — user code boundary

@@ -142,3 +142,91 @@ class TestHttpScripts:
     def test_run_missing(self, server):
         st, body = self._post(server.port, "/v1/run-script?name=nope")
         assert st == 400
+
+
+class TestSandbox:
+    """Defense-in-depth for user scripts (the reference embeds a
+    RustPython VM, script/Cargo.toml:9-20): file and network access are
+    blocked, runaway loops are bounded, numpy/jax/query still work."""
+
+    def test_open_blocked(self, se):
+        with pytest.raises(ScriptError, match="open|not allowed|defined"):
+            se.execute(
+                "@coprocessor(returns=['x'])\n"
+                "def f():\n"
+                "    return open('/etc/passwd').read()\n")
+
+    def test_import_os_blocked(self, se):
+        with pytest.raises(ScriptError, match="not allowed"):
+            se.execute(
+                "import os\n"
+                "@coprocessor(returns=['x'])\n"
+                "def f():\n"
+                "    return 1\n")
+
+    def test_import_socket_blocked_inside_fn(self, se):
+        with pytest.raises(ScriptError, match="not allowed"):
+            se.execute(
+                "@coprocessor(returns=['x'])\n"
+                "def f():\n"
+                "    import socket\n"
+                "    return 1\n")
+
+    def test_eval_exec_unavailable(self, se):
+        with pytest.raises(ScriptError, match="defined|eval"):
+            se.execute(
+                "@coprocessor(returns=['x'])\n"
+                "def f():\n"
+                "    return eval('1+1')\n")
+
+    def test_numpy_math_and_query_still_work(self, se, qe):
+        qe.execute_one(
+            "CREATE TABLE st (h STRING, v DOUBLE, ts TIMESTAMP TIME INDEX,"
+            " PRIMARY KEY(h))")
+        qe.execute_one("INSERT INTO st VALUES ('a', 2.0, 1), ('a', 4.0, 2)")
+        r = se.execute(
+            "import math\n"
+            "@coprocessor(returns=['s'])\n"
+            "def f():\n"
+            "    cols = query('SELECT v FROM st')\n"
+            "    return np.sum(cols['v']) * math.sqrt(4.0)\n")
+        assert r.rows() == [[12.0]]
+
+    def test_runaway_loop_times_out(self, se, monkeypatch):
+        monkeypatch.setenv("GREPTIMEDB_TPU_SCRIPT_TIMEOUT_S", "1")
+        from greptimedb_tpu.script import ScriptTimeout
+
+        with pytest.raises(ScriptTimeout):
+            se.execute(
+                "@coprocessor(returns=['x'])\n"
+                "def f():\n"
+                "    i = 0\n"
+                "    while True:\n"
+                "        i += 1\n"
+                "    return i\n")
+
+    def test_sandbox_opt_out(self, se, monkeypatch):
+        monkeypatch.setenv("GREPTIMEDB_TPU_SCRIPT_SANDBOX", "off")
+        r = se.execute(
+            "import os\n"
+            "@coprocessor(returns=['x'])\n"
+            "def f():\n"
+            "    return float(len(os.getcwd()) > 0)\n")
+        assert r.rows() == [[1.0]]
+
+    def test_timeout_survives_except_exception(self, se, monkeypatch):
+        """A script catching `except Exception` around its loop must not
+        swallow the kill signal (it derives BaseException)."""
+        monkeypatch.setenv("GREPTIMEDB_TPU_SCRIPT_TIMEOUT_S", "1")
+        from greptimedb_tpu.script import ScriptTimeout
+
+        with pytest.raises(ScriptTimeout):
+            se.execute(
+                "@coprocessor(returns=['x'])\n"
+                "def f():\n"
+                "    i = 0\n"
+                "    while True:\n"
+                "        try:\n"
+                "            i += 1\n"
+                "        except Exception:\n"
+                "            pass\n")
