@@ -287,7 +287,9 @@ class QueryEngine:
                     for v in r.columns[0].tolist()]
             nonnull = [v for v in vals
                        if v is not None and not _is_nan_scalar(v)]
-            expr = self._fold_tree(e.expr, ctx, predicate)
+            # the LHS is a comparison OPERAND: UNKNOWN≡FALSE never
+            # applies inside it, whatever position the IN itself holds
+            expr = self._fold_tree(e.expr, ctx, False)
             if e.negated and len(nonnull) != len(vals):
                 # NOT IN over a list containing NULL is never TRUE:
                 # matched → FALSE, unmatched → UNKNOWN. In predicate
