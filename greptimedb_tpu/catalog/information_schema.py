@@ -234,6 +234,101 @@ def _flows(qe, ctx):
 # ---- host-side mini executor ------------------------------------------------
 
 
+@_virtual("key_column_usage")
+def _key_column_usage(qe, ctx):
+    """Primary-key / time-index membership per column (reference
+    catalog/src/information_schema/key_column_usage.rs:40-55)."""
+    cols = {k: [] for k in (
+        "constraint_catalog", "constraint_schema", "constraint_name",
+        "table_catalog", "table_schema", "table_name", "column_name",
+        "ordinal_position")}
+    from greptimedb_tpu.datatypes.types import SemanticType
+
+    def add(db, name, constraint, col, pos):
+        cols["constraint_catalog"].append("def")
+        cols["constraint_schema"].append(db)
+        cols["constraint_name"].append(constraint)
+        cols["table_catalog"].append("def")
+        cols["table_schema"].append(db)
+        cols["table_name"].append(name)
+        cols["column_name"].append(col)
+        cols["ordinal_position"].append(pos)
+
+    for db in qe.catalog.list_databases():
+        for name in qe.catalog.list_tables(db):
+            info = qe.catalog.table(db, name)
+            pos = 1
+            for c in info.schema.columns:
+                if c.semantic is SemanticType.TAG:
+                    add(db, name, "PRIMARY", c.name, pos)
+                    pos += 1
+            ti = info.schema.time_index
+            if ti is not None:
+                add(db, name, "TIME INDEX", ti.name, 1)
+    return cols
+
+
+@_virtual("table_constraints")
+def _table_constraints(qe, ctx):
+    """PRIMARY KEY + TIME INDEX constraints per table (reference
+    catalog/src/information_schema/table_constraints.rs)."""
+    cols = {k: [] for k in (
+        "constraint_catalog", "constraint_schema", "constraint_name",
+        "table_schema", "table_name", "constraint_type")}
+    for db in qe.catalog.list_databases():
+        for name in qe.catalog.list_tables(db):
+            info = qe.catalog.table(db, name)
+            entries = []
+            if info.schema.tag_columns:
+                entries.append(("PRIMARY", "PRIMARY KEY"))
+            if info.schema.time_index is not None:
+                entries.append(("TIME INDEX", "TIME INDEX"))
+            for cname, ctype in entries:
+                cols["constraint_catalog"].append("def")
+                cols["constraint_schema"].append(db)
+                cols["constraint_name"].append(cname)
+                cols["table_schema"].append(db)
+                cols["table_name"].append(name)
+                cols["constraint_type"].append(ctype)
+    return cols
+
+
+@_virtual("character_sets")
+def _character_sets(qe, ctx):
+    # utf8-only, like the reference (memory_table/tables.rs CHARACTER_SETS)
+    return {
+        "character_set_name": ["utf8"],
+        "default_collate_name": ["utf8_bin"],
+        "description": ["UTF-8 Unicode"],
+        "maxlen": [4],
+    }
+
+
+@_virtual("collations")
+def _collations(qe, ctx):
+    return {
+        "collation_name": ["utf8_bin"],
+        "character_set_name": ["utf8"],
+        "id": [1],
+        "is_default": ["Yes"],
+        "is_compiled": ["Yes"],
+        "sortlen": [1],
+    }
+
+
+@_virtual("build_info")
+def _build_info(qe, ctx):
+    import greptimedb_tpu
+
+    return {
+        "git_branch": ["main"],
+        "git_commit": ["unknown"],
+        "git_commit_short": ["unknown"],
+        "git_dirty": ["false"],
+        "pkg_version": [greptimedb_tpu.__version__],
+    }
+
+
 def execute_virtual_select(qe, sel: ast.Select, ctx) -> QueryResult:
     """SELECT over an information_schema table: materialize, then apply
     WHERE / projection / ORDER BY / LIMIT on host."""

@@ -1,0 +1,14 @@
+-- key_column_usage / table_constraints / character_sets / collations / build_info
+CREATE TABLE kt (host STRING, az STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(host, az));
+
+SELECT constraint_name, column_name, ordinal_position FROM information_schema.key_column_usage WHERE table_name = 'kt' ORDER BY constraint_name, ordinal_position;
+
+SELECT constraint_name, constraint_type FROM information_schema.table_constraints WHERE table_name = 'kt' ORDER BY constraint_name;
+
+SELECT * FROM information_schema.character_sets;
+
+SELECT collation_name, character_set_name, is_default FROM information_schema.collations;
+
+SELECT pkg_version FROM information_schema.build_info;
+
+DROP TABLE kt;
