@@ -31,10 +31,25 @@ from greptimedb_tpu.query.plan_ser import AggFragment
 
 def _region_host_columns(executor, region_id: int, where, ts_range,
                          needed: set, append_mode: bool,
-                         schema=None) -> Optional[dict]:
+                         schema=None, tz=None) -> Optional[dict]:
     """Shared Partial-step prologue: scan (projected + index-pruned),
     LWW-dedup/filter, decode tags, apply the exact ts bounds. Returns the
-    filtered host column dict, or None for an empty result."""
+    filtered host column dict, or None for an empty result. `tz` is the
+    FRONTEND's session timezone: naive ts literals in the shipped WHERE
+    must coerce identically on the region."""
+    from greptimedb_tpu.query.expr import reset_session_tz, set_session_tz
+
+    tz_token = set_session_tz(tz)
+    try:
+        return _region_host_columns_inner(
+            executor, region_id, where, ts_range, needed, append_mode,
+            schema)
+    finally:
+        reset_session_tz(tz_token)
+
+
+def _region_host_columns_inner(executor, region_id, where, ts_range, needed,
+                               append_mode, schema):
     from types import SimpleNamespace
 
     from greptimedb_tpu.datatypes.vector import DictVector
@@ -104,7 +119,8 @@ def partial_region_agg(executor, region_id: int, frag: AggFragment,
     for a in frag.args:
         collect_columns(a, needed)
     host = _region_host_columns(executor, region_id, frag.where, ts_range,
-                                needed, frag.append_mode, schema)
+                                needed, frag.append_mode, schema,
+                                tz=frag.tz)
     if host is None:
         return None
     n = len(host[ts_name])
@@ -351,7 +367,8 @@ def partial_region_topk(executor, region_id: int, frag,
     else:
         needed.update(frag.columns)
     host = _region_host_columns(executor, region_id, frag.where, ts_range,
-                                needed, frag.append_mode, schema)
+                                needed, frag.append_mode, schema,
+                                tz=frag.tz)
     if host is None:
         return None
     n = len(host[ts_name])

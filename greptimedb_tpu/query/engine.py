@@ -95,9 +95,17 @@ class QueryEngine:
         from greptimedb_tpu.utils import tracing
         from greptimedb_tpu.utils.metrics import STMT_DURATION
         ctx.trace_id = tracing.set_trace(ctx.trace_id)
-        with STMT_DURATION.time(stmt=type(stmt).__name__), \
-                tracing.span(f"stmt:{type(stmt).__name__}"):
-            return self._execute_statement(stmt, ctx)
+        from greptimedb_tpu.query.expr import reset_session_tz, set_session_tz
+
+        # naive timestamp literals — WHERE, BETWEEN, CAST, INSERT —
+        # coerce in the session timezone everywhere in this statement
+        tz_token = set_session_tz(ctx.timezone or self.default_timezone)
+        try:
+            with STMT_DURATION.time(stmt=type(stmt).__name__), \
+                    tracing.span(f"stmt:{type(stmt).__name__}"):
+                return self._execute_statement(stmt, ctx)
+        finally:
+            reset_session_tz(tz_token)
 
     def _execute_statement(self, stmt: ast.Statement, ctx: QueryContext) -> QueryResult:
         if isinstance(stmt, ast.Select):
@@ -851,9 +859,18 @@ class QueryEngine:
         name = stmt.name.rsplit(".", 1)[-1]  # strip session./global.
         if name in ("time_zone", "timezone"):
             # SET TIME ZONE DEFAULT (value None) restores the engine
-            # default rather than the string 'None'
-            ctx.timezone = self.default_timezone if stmt.value is None \
-                else str(stmt.value)
+            # default rather than the string 'None'. Validate NOW: a
+            # typo'd zone must fail at SET, not on a later INSERT
+            if stmt.value is None:
+                ctx.timezone = self.default_timezone
+            else:
+                from greptimedb_tpu.utils.time import tzinfo_for
+
+                try:
+                    tzinfo_for(str(stmt.value))
+                except ValueError as e:
+                    raise PlanError(str(e)) from None
+                ctx.timezone = str(stmt.value)
         else:
             ctx.extensions[name] = stmt.value
         return QueryResult.of_affected(0)
@@ -971,7 +988,8 @@ class QueryEngine:
                 for v in vals:
                     if v is None:
                         raise PlanError(f"time index {c.name} cannot be NULL")
-                    coerced.append(coerce_ts_literal(v, c.dtype))
+                    coerced.append(
+                        coerce_ts_literal(v, c.dtype, ctx.timezone))
                 batch_cols[c.name] = np.asarray(coerced, dtype=np.int64)
             elif c.dtype.is_string:
                 batch_cols[c.name] = DictVector.encode(

@@ -28,7 +28,36 @@ import numpy as np
 from greptimedb_tpu.datatypes.schema import Schema
 from greptimedb_tpu.datatypes.types import DataType, SemanticType
 from greptimedb_tpu.sql import ast
-from greptimedb_tpu.utils.time import coerce_ts_literal, parse_timestamp_ns
+import contextvars
+
+from greptimedb_tpu.utils.time import (
+    coerce_ts_literal as _coerce_ts_literal_raw,
+    parse_timestamp_ns,
+)
+
+# session timezone for naive timestamp-literal coercion. A contextvar —
+# not a parameter — because coercion happens at every depth of binding,
+# host eval, and ts-bound extraction; the engine installs it per
+# statement and region-side fragment execution re-installs the
+# frontend's value (it travels inside the fragment).
+_SESSION_TZ: contextvars.ContextVar = contextvars.ContextVar(
+    "gtpu_session_tz", default=None)
+
+
+def set_session_tz(tz):
+    return _SESSION_TZ.set(tz)
+
+
+def reset_session_tz(token) -> None:
+    _SESSION_TZ.reset(token)
+
+
+def current_session_tz():
+    return _SESSION_TZ.get()
+
+
+def coerce_ts_literal(value, dtype, tz=None):
+    return _coerce_ts_literal_raw(value, dtype, tz or _SESSION_TZ.get())
 
 MISSING_CODE = -2  # literal not present in the tag dictionary: matches nothing
 
@@ -661,6 +690,35 @@ def _eval_host_func(e: ast.FuncCall, ev, schema):
     if name == "now":
         import time as _time
         return int(_time.time() * 1000)
+    if name == "date_part":
+        # date_part('year', ts) / EXTRACT(year FROM ts) — calendar field
+        # extraction (reference: DataFusion date_part)
+        import datetime as _dt
+        unit = str(_lit(e.args[0])).lower()
+        ts_expr = e.args[1]
+        col_unit = _col_unit_nanos(ts_expr, schema) if schema else 10**6
+        vals = np.atleast_1d(np.asarray(ev(ts_expr), dtype=np.int64))
+        secs = vals * col_unit / 1e9
+        getters = {
+            "year": lambda d: d.year, "month": lambda d: d.month,
+            "day": lambda d: d.day, "hour": lambda d: d.hour,
+            "minute": lambda d: d.minute, "second": lambda d: d.second,
+            "dow": lambda d: (d.weekday() + 1) % 7,  # Sunday = 0
+            "doy": lambda d: d.timetuple().tm_yday,
+            "week": lambda d: d.isocalendar()[1],
+            "quarter": lambda d: (d.month - 1) // 3 + 1,
+            "epoch": None,
+        }
+        if unit not in getters:
+            raise PlanError(f"date_part unit {unit!r} unsupported")
+        if unit == "epoch":
+            return secs
+        get = getters[unit]
+        return np.asarray([
+            get(_dt.datetime.fromtimestamp(s, _dt.timezone.utc))
+            for s in secs.tolist()], dtype=np.int64)
+    if name in _STRING_FUNCS:
+        return _STRING_FUNCS[name](e, ev)
     # extension seam: plugin-registered scalar functions (resolved against
     # the executing engine's container, falling back to the process default)
     from greptimedb_tpu.plugins import active_plugins
@@ -668,6 +726,87 @@ def _eval_host_func(e: ast.FuncCall, ev, schema):
     if plugin_fn is not None:
         return plugin_fn(*(ev(a) for a in e.args))
     raise PlanError(f"unsupported host function {name!r}")
+
+
+def _obj_col(v) -> np.ndarray:
+    return np.atleast_1d(np.asarray(v, dtype=object))
+
+
+def _str_map(fn):
+    """Element-wise NULL-preserving string transform."""
+    def apply(e, ev):
+        vals = _obj_col(ev(e.args[0]))
+        return np.asarray(
+            [None if v is None else fn(str(v)) for v in vals], dtype=object)
+    return apply
+
+
+def _fn_concat(e, ev):
+    # DataFusion concat skips NULL arguments (the reference's behavior)
+    cols = [_obj_col(ev(a)) for a in e.args]
+    n = max(len(c) for c in cols)
+    cols = [np.broadcast_to(c, (n,)) if len(c) != n else c for c in cols]
+    return np.asarray(
+        ["".join(str(c[i]) for c in cols if c[i] is not None)
+         for i in range(n)], dtype=object)
+
+
+def _fn_length(e, ev):
+    vals = _obj_col(ev(e.args[0]))
+    return np.asarray(
+        [None if v is None else len(str(v)) for v in vals], dtype=object)
+
+
+def _fn_substr(e, ev):
+    vals = _obj_col(ev(e.args[0]))
+    start = int(_lit(e.args[1]))
+    ln = int(_lit(e.args[2])) if len(e.args) > 2 else None
+    # SQL substr is 1-based and the length window anchors at the TRUE
+    # start even when it is <= 0 (substr('alphabet', 0, 3) = 'al')
+    i0 = max(start - 1, 0)
+    i1 = None if ln is None else max(start - 1 + ln, 0)
+    return np.asarray(
+        [None if v is None else str(v)[i0:i1] for v in vals], dtype=object)
+
+
+def _fn_replace(e, ev):
+    vals = _obj_col(ev(e.args[0]))
+    old, new = str(_lit(e.args[1])), str(_lit(e.args[2]))
+    return np.asarray(
+        [None if v is None else str(v).replace(old, new) for v in vals],
+        dtype=object)
+
+
+def _fn_affix(method):
+    def apply(e, ev):
+        vals = _obj_col(ev(e.args[0]))
+        probe = str(_lit(e.args[1]))
+        # NULL input stays NULL (three-valued logic), not FALSE
+        return np.asarray(
+            [None if v is None else getattr(str(v), method)(probe)
+             for v in vals], dtype=object)
+    return apply
+
+
+#: string scalar functions (reference: DataFusion string fns used by the
+#: sqlness suites — lower/upper/trim/length/concat/substr/replace/...)
+_STRING_FUNCS = {
+    "lower": _str_map(str.lower),
+    "upper": _str_map(str.upper),
+    "trim": _str_map(str.strip),
+    "ltrim": _str_map(str.lstrip),
+    "rtrim": _str_map(str.rstrip),
+    "reverse": _str_map(lambda s: s[::-1]),
+    "length": _fn_length,
+    "char_length": _fn_length,
+    "character_length": _fn_length,
+    "concat": _fn_concat,
+    "substr": _fn_substr,
+    "substring": _fn_substr,
+    "replace": _fn_replace,
+    "starts_with": _fn_affix("startswith"),
+    "ends_with": _fn_affix("endswith"),
+}
 
 
 def _lit_interval(e):

@@ -153,7 +153,24 @@ def compute_host_agg_str(func: str, gid: np.ndarray, values: np.ndarray,
     reduces numbers (tag codes are dictionary positions, not orderable
     values), so these pick per group from the decoded host values.
     Returns an object array with None for empty groups."""
-    valid = mask & np.asarray([v is not None for v in values])
+    valid = mask & np.asarray(
+        [v is not None and not (isinstance(v, float) and v != v)
+         for v in values])
+    if func == "count":
+        # count of non-NULL string values per group (the device planes
+        # only count numerics)
+        return np.bincount(gid[valid], minlength=num_groups)[
+            :num_groups].astype(np.int64)
+    if func == "count_distinct":
+        out_i = np.zeros(num_groups, dtype=np.int64)
+        if valid.any():
+            gid_v = gid[valid]
+            key = np.asarray([str(v) for v in values[valid]])
+            order = np.lexsort((key, gid_v))
+            g_s, k_s = gid_v[order], key[order]
+            new = np.r_[True, (g_s[1:] != g_s[:-1]) | (k_s[1:] != k_s[:-1])]
+            np.add.at(out_i, g_s[new], 1)
+        return out_i
     out = np.full(num_groups, None, dtype=object)
     if not valid.any():
         return out

@@ -70,13 +70,25 @@ def _needs_host_agg(spec, schema) -> bool:
     their order is insertion order, not lexicographic)."""
     from greptimedb_tpu.query.host_agg import HOST_AGGS
 
-    if spec.func in HOST_AGGS:
+    if spec.func in HOST_AGGS or spec.func == "count_distinct":
         return True
     if spec.arg is None:
         return False
     dt = _infer_dtype(spec.arg, schema)
-    return (dt is not None and not (dt.is_numeric or dt.is_timestamp)
-            and spec.func in ("first", "last", "min", "max"))
+    if dt is None or dt.is_numeric or dt.is_timestamp:
+        return False
+    if spec.func in ("first", "last", "min", "max"):
+        return True
+    if spec.func == "count":
+        # count over a string TAG rides the device (codes, NULL = -1);
+        # a string FIELD scans as decoded objects and must count on host
+        from greptimedb_tpu.datatypes.types import SemanticType
+
+        return (isinstance(spec.arg, ast.Column)
+                and spec.arg.name in schema.names
+                and schema.column(spec.arg.name).semantic
+                is not SemanticType.TAG)
+    return False
 
 
 @dataclass(frozen=True)
@@ -842,9 +854,12 @@ class PhysicalExecutor:
         ops: set = {"rows"}
         for spec in agg.aggs:
             ops.update(_PRIMITIVES[spec.func])
+        from greptimedb_tpu.query.expr import current_session_tz
+
         frag = AggFragment(
             keys=list(agg.keys), args=arg_exprs, ops=sorted(ops),
-            where=where, ts_range=ts_range, append_mode=table.append_mode)
+            where=where, ts_range=ts_range, append_mode=table.append_mode,
+            tz=current_session_tz())
         with tracing.span("agg_pushdown", regions=len(table.region_ids)):
             rids = list(table.region_ids)
             if len(rids) > 1:
@@ -905,10 +920,13 @@ class PhysicalExecutor:
             collect_columns(ob.expr, needed)
         if not all(c in table.schema.names for c in needed):
             return None  # sort key references a projection alias
+        from greptimedb_tpu.query.expr import current_session_tz
+
         k = int(limit) + int(offset or 0)
         frag = TopkFragment(
             sort_keys=sort_keys, k=k, columns=scan_node.columns,
-            where=where, ts_range=ts_range, append_mode=table.append_mode)
+            where=where, ts_range=ts_range, append_mode=table.append_mode,
+            tz=current_session_tz())
         with tracing.span("topk_pushdown", regions=len(table.region_ids),
                           k=k):
             rids = list(table.region_ids)

@@ -71,6 +71,7 @@ class AggFragment:
     where: Optional[ast.Expr] = None
     ts_range: Optional[tuple] = None
     append_mode: bool = False  # skip LWW dedup on append-only tables
+    tz: Optional[str] = None  # session timezone for naive ts literals
 
     def to_json(self) -> str:
         return json.dumps({
@@ -80,6 +81,7 @@ class AggFragment:
             "where": expr_to_json(self.where),
             "ts_range": list(self.ts_range) if self.ts_range else None,
             "append_mode": self.append_mode,
+            "tz": self.tz,
         })
 
     @staticmethod
@@ -92,6 +94,7 @@ class AggFragment:
             where=expr_from_json(d["where"]),
             ts_range=tuple(d["ts_range"]) if d["ts_range"] else None,
             append_mode=bool(d.get("append_mode", False)),
+            tz=d.get("tz"),
         )
 
 
@@ -111,6 +114,7 @@ class TopkFragment:
     where: Optional[ast.Expr] = None
     ts_range: Optional[tuple] = None
     append_mode: bool = False
+    tz: Optional[str] = None  # session timezone for naive ts literals
 
     def to_json(self) -> str:
         return json.dumps({
@@ -120,6 +124,7 @@ class TopkFragment:
             "where": expr_to_json(self.where),
             "ts_range": list(self.ts_range) if self.ts_range else None,
             "append_mode": self.append_mode,
+            "tz": self.tz,
         })
 
     @staticmethod
@@ -133,4 +138,5 @@ class TopkFragment:
             where=expr_from_json(d["where"]),
             ts_range=tuple(d["ts_range"]) if d["ts_range"] else None,
             append_mode=bool(d.get("append_mode", False)),
+            tz=d.get("tz"),
         )

@@ -102,7 +102,12 @@ def plan_select(sel: ast.Select, table: TableInfo) -> lp.LogicalPlan:
             if func is None:
                 raise PlanError(f"unsupported aggregate {call.name!r}")
             if call.distinct:
-                raise PlanError("DISTINCT aggregates not yet supported")
+                # COUNT(DISTINCT x) needs the full value multiset per
+                # group → host pass (reference: DataFusion distinct agg)
+                if func != "count":
+                    raise PlanError(
+                        "DISTINCT is only supported for COUNT(DISTINCT x)")
+                func = "count_distinct"
             arg: Optional[ast.Expr]
             if len(call.args) == 1 and isinstance(call.args[0], ast.Star):
                 if func != "count":

@@ -116,8 +116,18 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _ctx(self, params: dict) -> QueryContext:
         from greptimedb_tpu.session import Channel
+        # X-Greptime-Timezone: per-request session timezone (reference
+        # servers/src/http — HTTP is stateless, so SET TIME ZONE can't
+        # persist; clients pin it per request via this header)
+        tz = self.headers.get("X-Greptime-Timezone") or \
+            params.get("timezone")
+        if tz:
+            from greptimedb_tpu.utils.time import tzinfo_for
+
+            tzinfo_for(tz)  # fail fast on a typo'd zone name
         return QueryContext(db=params.get("db", "public"),
                             channel=Channel.HTTP,
+                            timezone=tz or None,
                             user=getattr(self, "_user", None))
 
     # ---- routing -----------------------------------------------------------

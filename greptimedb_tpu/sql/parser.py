@@ -1020,6 +1020,15 @@ class Parser:
             name = self.ident()
             if self.at_op("("):
                 self.next()
+                if name.lower() == "extract":
+                    # EXTRACT(unit FROM expr) — SQL-standard spelling,
+                    # normalized to date_part(unit, expr)
+                    unit = self.ident().lower()
+                    self.expect_kw("from")
+                    inner = self.parse_expr()
+                    self.expect_op(")")
+                    return ast.FuncCall(
+                        "date_part", (ast.Literal(unit), inner))
                 if self.at_op("*"):
                     self.next()
                     self.expect_op(")")

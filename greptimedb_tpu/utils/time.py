@@ -26,14 +26,35 @@ _FORMATS = (
 )
 
 
-def parse_timestamp_ns(text: str) -> int:
-    """Parse an ISO-ish timestamp string to epoch nanoseconds (UTC default)."""
+def tzinfo_for(name: Optional[str]) -> dt.tzinfo:
+    """Session timezone name → tzinfo: '+08:00'/'-05:30' fixed offsets,
+    IANA names via zoneinfo, None/UTC → UTC (reference
+    common/time timezone.rs parse precedence)."""
+    if not name or name.upper() == "UTC":
+        return dt.timezone.utc
+    m = re.fullmatch(r"([+-])(\d{1,2}):?(\d{2})?", name.strip())
+    if m:
+        sign = 1 if m.group(1) == "+" else -1
+        minutes = int(m.group(2)) * 60 + int(m.group(3) or 0)
+        return dt.timezone(sign * dt.timedelta(minutes=minutes))
+    try:
+        from zoneinfo import ZoneInfo
+
+        return ZoneInfo(name)
+    except Exception as exc:  # noqa: BLE001 — bad tz name is a user error
+        raise ValueError(f"unknown time zone {name!r}") from exc
+
+
+def parse_timestamp_ns(text: str, tz: Optional[str] = None) -> int:
+    """Parse an ISO-ish timestamp string to epoch nanoseconds. Naive
+    strings are interpreted in `tz` (the session timezone), UTC when
+    unset; an explicit offset in the string always wins."""
     t = text.strip().replace("Z", "+0000")
     for fmt in _FORMATS:
         try:
             d = dt.datetime.strptime(t, fmt)
             if d.tzinfo is None:
-                d = d.replace(tzinfo=dt.timezone.utc)
+                d = d.replace(tzinfo=tzinfo_for(tz))
             epoch = d.timestamp()
             # avoid float precision loss: split seconds/micros
             whole = int(epoch // 1)
@@ -54,14 +75,16 @@ def unit_to_ns(value: int, unit: TimeUnit) -> int:
     return value * unit.nanos_per_unit
 
 
-def coerce_ts_literal(value, dtype: DataType) -> int:
+def coerce_ts_literal(value, dtype: DataType,
+                      tz: Optional[str] = None) -> int:
     """Coerce a SQL literal (string or int) to the storage unit of `dtype`.
 
     Integer literals are interpreted in the column's own unit (matching the
-    reference's behavior for bare numeric timestamp comparisons)."""
+    reference's behavior for bare numeric timestamp comparisons); naive
+    strings in the session timezone `tz`."""
     unit = dtype.time_unit
     if isinstance(value, str):
-        return ns_to_unit(parse_timestamp_ns(value), unit)
+        return ns_to_unit(parse_timestamp_ns(value, tz), unit)
     if isinstance(value, dt.datetime):
         # Arrow timestamp columns round-trip as datetime objects
         tz = value if value.tzinfo else value.replace(tzinfo=dt.timezone.utc)
