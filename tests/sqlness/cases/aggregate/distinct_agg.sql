@@ -1,0 +1,10 @@
+-- COUNT(DISTINCT x) and grouped variants
+CREATE TABLE da (host STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(host));
+
+INSERT INTO da VALUES ('a', 1.0, 1), ('a', 1.0, 2), ('a', 2.0, 3), ('b', 1.0, 1);
+
+SELECT count(DISTINCT v) AS dv FROM da;
+
+SELECT host, count(DISTINCT v) AS dv FROM da GROUP BY host ORDER BY host;
+
+DROP TABLE da;

@@ -1,0 +1,10 @@
+-- first/last by time order, incl. last_value(x ORDER BY ts)
+CREATE TABLE fl (host STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(host));
+
+INSERT INTO fl VALUES ('a', 1.0, 100), ('a', 9.0, 300), ('a', 5.0, 200), ('b', 7.0, 100);
+
+SELECT host, first(v) AS f, last(v) AS l FROM fl GROUP BY host ORDER BY host;
+
+SELECT host, last_value(v ORDER BY ts) AS lv FROM fl GROUP BY host ORDER BY host;
+
+DROP TABLE fl;

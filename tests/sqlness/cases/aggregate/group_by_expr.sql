@@ -1,0 +1,10 @@
+-- GROUP BY expressions and positional-style aliases
+CREATE TABLE ge (host STRING, v DOUBLE, ts TIMESTAMP(3) TIME INDEX, PRIMARY KEY(host));
+
+INSERT INTO ge VALUES ('web-1', 1.0, 0), ('web-2', 2.0, 0), ('db-1', 4.0, 0);
+
+SELECT CASE WHEN host LIKE 'web%' THEN 'web' ELSE 'db' END AS tier, sum(v) AS s FROM ge GROUP BY tier ORDER BY tier;
+
+SELECT date_bin(INTERVAL '1 hour', ts) AS h, count(*) AS n FROM ge GROUP BY h;
+
+DROP TABLE ge;

@@ -1,0 +1,10 @@
+-- HAVING over aggregate expressions
+CREATE TABLE hv (host STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(host));
+
+INSERT INTO hv VALUES ('a', 1.0, 1), ('a', 2.0, 2), ('b', 10.0, 1), ('c', 3.0, 1);
+
+SELECT host, sum(v) AS s FROM hv GROUP BY host HAVING sum(v) > 2.5 ORDER BY host;
+
+SELECT host, count(*) AS n FROM hv GROUP BY host HAVING n = 1 ORDER BY host;
+
+DROP TABLE hv;

@@ -1,0 +1,8 @@
+-- stddev / variance, incl. single-sample NULL semantics
+CREATE TABLE mo (host STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(host));
+
+INSERT INTO mo VALUES ('a', 2.0, 1), ('a', 4.0, 2), ('a', 6.0, 3), ('b', 9.0, 1);
+
+SELECT host, variance(v) AS var, stddev(v) AS sd FROM mo GROUP BY host ORDER BY host;
+
+DROP TABLE mo;

@@ -1,0 +1,13 @@
+-- multi-stage CTE pipelines
+CREATE TABLE cc (host STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(host));
+
+INSERT INTO cc VALUES ('a', 1.0, 1), ('a', 9.0, 2), ('b', 5.0, 1), ('c', 2.0, 1);
+
+WITH sums AS (SELECT host, sum(v) AS s FROM cc GROUP BY host),
+     ranked AS (SELECT host, s, rank() OVER (ORDER BY s DESC) AS r FROM sums)
+SELECT host, s, r FROM ranked WHERE r <= 2 ORDER BY r, host;
+
+WITH a AS (SELECT 1 AS x), b AS (SELECT x + 1 AS y FROM a)
+SELECT a.x, b.y FROM a CROSS JOIN b;
+
+DROP TABLE cc;

@@ -1,0 +1,10 @@
+-- DELETE by field predicate resolves key rows first
+CREATE TABLE dv (host STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(host));
+
+INSERT INTO dv VALUES ('a', 1.0, 1), ('b', 99.0, 1), ('c', 2.0, 1);
+
+DELETE FROM dv WHERE v > 50;
+
+SELECT host, v FROM dv ORDER BY host;
+
+DROP TABLE dv;

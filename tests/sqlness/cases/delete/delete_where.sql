@@ -1,0 +1,18 @@
+-- DELETE with predicates; tombstones hold across flush
+CREATE TABLE dw (host STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(host));
+
+INSERT INTO dw VALUES ('a', 1.0, 1), ('a', 2.0, 2), ('b', 3.0, 1);
+
+DELETE FROM dw WHERE host = 'a' AND ts = 1;
+
+SELECT host, v FROM dw ORDER BY host, ts;
+
+ADMIN flush_table('dw');
+
+SELECT host, v FROM dw ORDER BY host, ts;
+
+DELETE FROM dw;
+
+SELECT count(*) AS n FROM dw;
+
+DROP TABLE dw;

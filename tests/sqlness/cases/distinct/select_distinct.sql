@@ -1,0 +1,12 @@
+-- SELECT DISTINCT over rows and expressions
+CREATE TABLE sd (host STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(host));
+
+INSERT INTO sd VALUES ('a', 1.0, 1), ('a', 1.0, 2), ('b', 1.0, 1), ('b', 2.0, 2);
+
+SELECT DISTINCT host FROM sd ORDER BY host;
+
+SELECT DISTINCT host, v FROM sd ORDER BY host, v;
+
+SELECT DISTINCT v * 10 AS x FROM sd ORDER BY x;
+
+DROP TABLE sd;

@@ -1,0 +1,10 @@
+-- NULL-handling scalars
+CREATE TABLE cn (a DOUBLE, b DOUBLE, ts TIMESTAMP TIME INDEX);
+
+INSERT INTO cn VALUES (NULL, 2.0, 1), (1.0, NULL, 2), (3.0, 4.0, 3);
+
+SELECT coalesce(a, b) AS c FROM cn ORDER BY ts;
+
+SELECT coalesce(a, b, 0.0) AS c FROM cn ORDER BY ts;
+
+DROP TABLE cn;

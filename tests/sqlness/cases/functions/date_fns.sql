@@ -1,0 +1,10 @@
+-- date/time scalar functions
+CREATE TABLE df (v DOUBLE, ts TIMESTAMP(3) TIME INDEX);
+
+INSERT INTO df VALUES (1.0, '2024-03-15 13:45:30');
+
+SELECT date_trunc('hour', ts) AS h FROM df;
+
+SELECT extract(year FROM ts) AS y, extract(month FROM ts) AS m FROM df;
+
+DROP TABLE df;

@@ -1,0 +1,8 @@
+-- math scalar functions
+SELECT abs(-3.5) AS a, ceil(1.2) AS c, floor(1.8) AS f, round(2.567, 2) AS r;
+
+SELECT sqrt(16.0) AS sq, power(2, 10) AS p, ln(1.0) AS l;
+
+SELECT greatest(1, 5, 3) AS g, least(1, 5, 3) AS ls;
+
+SELECT CASE WHEN 1 < 2 THEN 'yes' ELSE 'no' END AS c;

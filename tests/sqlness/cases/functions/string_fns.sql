@@ -1,0 +1,12 @@
+-- string scalar functions over a table
+CREATE TABLE sf (host STRING, ts TIMESTAMP TIME INDEX, PRIMARY KEY(host));
+
+INSERT INTO sf VALUES ('Web-01', 1), ('db-02', 2);
+
+SELECT lower(host) AS lo, upper(host) AS up FROM sf ORDER BY host;
+
+SELECT length(host) AS n FROM sf ORDER BY host;
+
+SELECT concat(host, ':9090') AS addr FROM sf ORDER BY host;
+
+DROP TABLE sf;

@@ -1,0 +1,12 @@
+-- malformed inserts error cleanly
+CREATE TABLE ae (v DOUBLE, ts TIMESTAMP TIME INDEX);
+
+INSERT INTO ae VALUES (1.0);
+
+INSERT INTO ae (v, ts, nope) VALUES (1.0, 1, 2);
+
+INSERT INTO ae VALUES (1.0, 1);
+
+SELECT count(*) AS n FROM ae;
+
+DROP TABLE ae;

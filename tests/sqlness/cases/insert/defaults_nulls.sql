@@ -1,0 +1,10 @@
+-- column defaults and NULL fills on partial inserts
+CREATE TABLE dn (host STRING, v DOUBLE DEFAULT 7.5, note STRING, ts TIMESTAMP TIME INDEX, PRIMARY KEY(host));
+
+INSERT INTO dn (host, ts) VALUES ('a', 1);
+
+INSERT INTO dn (host, v, ts) VALUES ('b', 2.5, 2);
+
+SELECT host, v, note FROM dn ORDER BY host;
+
+DROP TABLE dn;

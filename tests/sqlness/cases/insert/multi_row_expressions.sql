@@ -1,0 +1,8 @@
+-- expression values and negative numbers in VALUES
+CREATE TABLE mre (v DOUBLE, ts TIMESTAMP TIME INDEX);
+
+INSERT INTO mre VALUES (1 + 2.5, 1), (-4.5, 2), (2 * 3, 3);
+
+SELECT v FROM mre ORDER BY ts;
+
+DROP TABLE mre;

@@ -1,0 +1,16 @@
+-- GROUP BY over joined relations
+CREATE TABLE jm (host STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(host));
+
+CREATE TABLE jd (host STRING, dc STRING, ts TIMESTAMP TIME INDEX, PRIMARY KEY(host));
+
+INSERT INTO jm VALUES ('a', 1.0, 1), ('a', 3.0, 2), ('b', 10.0, 1), ('c', 5.0, 1);
+
+INSERT INTO jd VALUES ('a', 'east', 0), ('b', 'west', 0), ('c', 'east', 0);
+
+SELECT jd.dc, sum(jm.v) AS s FROM jm JOIN jd ON jm.host = jd.host GROUP BY jd.dc ORDER BY jd.dc;
+
+SELECT jd.dc, count(*) AS n FROM jm LEFT JOIN jd ON jm.host = jd.host GROUP BY jd.dc ORDER BY jd.dc;
+
+DROP TABLE jm;
+
+DROP TABLE jd;

@@ -1,0 +1,10 @@
+-- multi-key ordering with mixed directions
+CREATE TABLE mk (host STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(host));
+
+INSERT INTO mk VALUES ('a', 2.0, 1), ('a', 1.0, 2), ('b', 2.0, 1), ('b', 1.0, 2);
+
+SELECT host, v FROM mk ORDER BY host ASC, v DESC;
+
+SELECT host, v FROM mk ORDER BY v DESC, host DESC;
+
+DROP TABLE mk;

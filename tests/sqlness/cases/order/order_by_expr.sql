@@ -1,0 +1,10 @@
+-- ORDER BY an expression and an unprojected column
+CREATE TABLE oe (host STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(host));
+
+INSERT INTO oe VALUES ('a', 3.0, 1), ('b', 1.0, 2), ('c', 2.0, 3);
+
+SELECT host FROM oe ORDER BY v * -1;
+
+SELECT host, v * 2 AS d FROM oe ORDER BY d;
+
+DROP TABLE oe;

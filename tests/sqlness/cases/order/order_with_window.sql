@@ -1,0 +1,8 @@
+-- ORDER BY a window expression
+CREATE TABLE ow (host STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(host));
+
+INSERT INTO ow VALUES ('a', 3.0, 1), ('b', 1.0, 1), ('c', 2.0, 1);
+
+SELECT host, rank() OVER (ORDER BY v DESC) AS r FROM ow ORDER BY r;
+
+DROP TABLE ow;

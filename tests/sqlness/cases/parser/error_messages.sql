@@ -1,0 +1,10 @@
+-- parse errors surface cleanly, not as crashes
+SELEKT 1;
+
+SELECT FROM nothing;
+
+SELECT 1 +;
+
+CREATE TABLE no_time_index (v DOUBLE);
+
+SELECT * FROM does_not_exist;

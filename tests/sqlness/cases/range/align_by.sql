@@ -1,0 +1,10 @@
+-- ALIGN ... BY grouping and BY () across-series form
+CREATE TABLE ab (host STRING, v DOUBLE, ts TIMESTAMP(3) TIME INDEX, PRIMARY KEY(host));
+
+INSERT INTO ab VALUES ('a', 2.0, 0), ('b', 4.0, 0), ('a', 6.0, 10000), ('b', 8.0, 10000);
+
+SELECT ts, host, max(v) RANGE '10s' FROM ab ALIGN '10s' BY (host) ORDER BY ts, host;
+
+SELECT ts, sum(v) RANGE '10s' FROM ab ALIGN '10s' BY () ORDER BY ts;
+
+DROP TABLE ab;

@@ -1,0 +1,10 @@
+-- CASE in projection and WHERE
+CREATE TABLE cw (v DOUBLE, ts TIMESTAMP TIME INDEX);
+
+INSERT INTO cw VALUES (10.0, 1), (55.0, 2), (91.0, 3);
+
+SELECT v, CASE WHEN v > 90 THEN 'high' WHEN v > 50 THEN 'mid' ELSE 'low' END AS band FROM cw ORDER BY v;
+
+SELECT count(*) AS n FROM cw WHERE CASE WHEN v > 50 THEN true ELSE false END;
+
+DROP TABLE cw;

@@ -1,0 +1,18 @@
+-- db-qualified and aliased table references
+CREATE DATABASE qdb;
+
+CREATE TABLE qdb.qt (v DOUBLE, ts TIMESTAMP TIME INDEX);
+
+INSERT INTO qdb.qt VALUES (5.0, 1);
+
+SELECT v FROM qdb.qt;
+
+SELECT q.v FROM qdb.qt AS q;
+
+USE qdb;
+
+SELECT v FROM qt;
+
+USE public;
+
+DROP TABLE qdb.qt;

@@ -1,0 +1,14 @@
+-- projection arithmetic, aliases, literals
+CREATE TABLE se (v DOUBLE, ts TIMESTAMP TIME INDEX);
+
+INSERT INTO se VALUES (2.0, 1), (4.0, 2);
+
+SELECT v, v * 2 AS dbl, v + v AS ss, 100 AS k FROM se ORDER BY v;
+
+SELECT 1 + 1;
+
+SELECT 'text' AS t, 3.14 AS pi;
+
+SELECT v % 3 AS m, -v AS neg FROM se ORDER BY v;
+
+DROP TABLE se;

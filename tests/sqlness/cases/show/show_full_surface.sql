@@ -1,0 +1,14 @@
+-- SHOW surface: databases / tables / views / flows
+CREATE DATABASE showdb;
+
+CREATE TABLE showdb.s1 (v DOUBLE, ts TIMESTAMP TIME INDEX);
+
+SHOW TABLES FROM showdb;
+
+SHOW DATABASES;
+
+SHOW VIEWS;
+
+SHOW FLOWS;
+
+DROP TABLE showdb.s1;

@@ -1,0 +1,14 @@
+-- IN/EXISTS/scalar subqueries in more positions
+CREATE TABLE sv (host STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(host));
+
+INSERT INTO sv VALUES ('a', 1.0, 1), ('b', 5.0, 1), ('c', 9.0, 1);
+
+SELECT host FROM sv WHERE v > (SELECT avg(v) FROM sv) ORDER BY host;
+
+SELECT host, v >= (SELECT max(v) FROM sv) AS is_max FROM sv ORDER BY host;
+
+SELECT count(*) AS n FROM sv WHERE NOT EXISTS (SELECT 1 FROM sv WHERE v > 100);
+
+SELECT host FROM sv WHERE host IN (SELECT host FROM sv WHERE v < 6) AND v > 2;
+
+DROP TABLE sv;

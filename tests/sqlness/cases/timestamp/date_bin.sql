@@ -1,0 +1,10 @@
+-- date_bin bucketing at several widths
+CREATE TABLE db (v DOUBLE, ts TIMESTAMP(3) TIME INDEX);
+
+INSERT INTO db VALUES (1.0, 0), (2.0, 30000), (3.0, 60000), (4.0, 90000), (5.0, 3600000);
+
+SELECT date_bin(INTERVAL '1 minute', ts) AS m, sum(v) AS s FROM db GROUP BY m ORDER BY m;
+
+SELECT date_bin(INTERVAL '1 hour', ts) AS h, count(*) AS n FROM db GROUP BY h ORDER BY h;
+
+DROP TABLE db;

@@ -1,0 +1,10 @@
+-- timestamp string literals coerce on insert and in predicates
+CREATE TABLE tl (v DOUBLE, ts TIMESTAMP(3) TIME INDEX);
+
+INSERT INTO tl VALUES (1.0, '2024-01-01 00:00:00'), (2.0, '2024-01-01 00:01:00');
+
+SELECT count(*) AS n FROM tl WHERE ts >= '2024-01-01 00:00:30';
+
+SELECT v FROM tl WHERE ts = '2024-01-01 00:00:00';
+
+DROP TABLE tl;

@@ -1,0 +1,8 @@
+-- the time index cannot be NULL
+CREATE TABLE tn (v DOUBLE, ts TIMESTAMP TIME INDEX);
+
+INSERT INTO tn VALUES (1.0, NULL);
+
+SELECT count(*) AS n FROM tn;
+
+DROP TABLE tn;

@@ -1,0 +1,16 @@
+-- timestamp-string inserts honor the session timezone
+CREATE TABLE tic (v DOUBLE, ts TIMESTAMP(3) TIME INDEX);
+
+SET TIME ZONE '+00:00';
+
+INSERT INTO tic VALUES (1.0, '2024-01-01 00:00:00');
+
+SET TIME ZONE '+02:00';
+
+INSERT INTO tic VALUES (2.0, '2024-01-01 02:00:00');
+
+SET TIME ZONE DEFAULT;
+
+SELECT count(DISTINCT ts) AS distinct_instants FROM tic;
+
+DROP TABLE tic;

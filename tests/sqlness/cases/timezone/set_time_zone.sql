@@ -1,0 +1,16 @@
+-- SET TIME ZONE round-trips; HTTP JSON returns epoch ms (rendering is client-side)
+CREATE TABLE tz (v DOUBLE, ts TIMESTAMP(3) TIME INDEX);
+
+INSERT INTO tz VALUES (1.0, '2024-06-01 12:00:00');
+
+SELECT ts FROM tz;
+
+SET TIME ZONE '+08:00';
+
+SELECT ts FROM tz;
+
+SET TIME ZONE DEFAULT;
+
+SELECT ts FROM tz;
+
+DROP TABLE tz;

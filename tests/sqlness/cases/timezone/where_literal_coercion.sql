@@ -1,0 +1,23 @@
+-- the session timezone applies to WHERE/BETWEEN literals, not just INSERT
+CREATE TABLE wl (v DOUBLE, ts TIMESTAMP(3) TIME INDEX);
+
+SET TIME ZONE '+08:00';
+
+INSERT INTO wl VALUES (1.0, '2024-01-01 08:00:00');
+
+-- the same literal that inserted the row must find it again
+SELECT v FROM wl WHERE ts = '2024-01-01 08:00:00';
+
+SELECT count(*) AS n FROM wl WHERE ts BETWEEN '2024-01-01 07:59:00' AND '2024-01-01 08:01:00';
+
+SET TIME ZONE DEFAULT;
+
+-- in UTC the stored instant is 2024-01-01T00:00:00Z
+SELECT v FROM wl WHERE ts = '2024-01-01 00:00:00';
+
+SELECT count(*) AS n FROM wl WHERE ts = '2024-01-01 08:00:00';
+
+-- a typo'd zone fails at SET, not on a later statement
+SET TIME ZONE 'Nope/Zone';
+
+DROP TABLE wl;

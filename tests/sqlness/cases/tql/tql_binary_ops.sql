@@ -1,0 +1,12 @@
+-- TQL binary operations between vectors and scalars
+CREATE TABLE g2 (job STRING, val DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(job));
+
+INSERT INTO g2 VALUES ('a', 4, 10000), ('b', 9, 10000);
+
+TQL EVAL (10, 10, '10s') g2 * 2;
+
+TQL EVAL (10, 10, '10s') g2 > 5;
+
+TQL EVAL (10, 10, '10s') g2 + g2;
+
+DROP TABLE g2;

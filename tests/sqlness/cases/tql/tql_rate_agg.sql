@@ -1,0 +1,12 @@
+-- TQL rate + aggregation over a counter-shaped series
+CREATE TABLE reqs (job STRING, val DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(job));
+
+INSERT INTO reqs VALUES ('a', 0, 0), ('a', 60, 60000), ('a', 120, 120000), ('b', 0, 0), ('b', 30, 60000), ('b', 60, 120000);
+
+TQL EVAL (120, 120, '60s') rate(reqs[2m]);
+
+TQL EVAL (120, 120, '60s') sum(rate(reqs[2m]));
+
+TQL EVAL (120, 120, '60s') avg_over_time(reqs[2m]);
+
+DROP TABLE reqs;

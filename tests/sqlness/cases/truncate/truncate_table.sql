@@ -1,0 +1,14 @@
+-- TRUNCATE drops rows, keeps schema
+CREATE TABLE tt (host STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(host));
+
+INSERT INTO tt VALUES ('a', 1.0, 1), ('b', 2.0, 2);
+
+TRUNCATE TABLE tt;
+
+SELECT count(*) AS n FROM tt;
+
+INSERT INTO tt VALUES ('c', 3.0, 3);
+
+SELECT host, v FROM tt;
+
+DROP TABLE tt;

@@ -1,0 +1,10 @@
+-- BOOLEAN columns
+CREATE TABLE bt (ok BOOLEAN, ts TIMESTAMP TIME INDEX);
+
+INSERT INTO bt VALUES (true, 1), (false, 2), (true, 3);
+
+SELECT ok FROM bt ORDER BY ts;
+
+SELECT count(*) AS n FROM bt WHERE ok;
+
+DROP TABLE bt;

@@ -1,0 +1,12 @@
+-- float specials: NULL vs NaN handling in aggregates
+CREATE TABLE fe (v DOUBLE, ts TIMESTAMP TIME INDEX);
+
+INSERT INTO fe VALUES (1.5, 1), (NULL, 2), (2.5, 3);
+
+SELECT count(*) AS rows_n, count(v) AS vals_n FROM fe;
+
+SELECT sum(v) AS s, avg(v) AS a, min(v) AS lo, max(v) AS hi FROM fe;
+
+SELECT v IS NULL AS isn FROM fe ORDER BY ts;
+
+DROP TABLE fe;

@@ -1,0 +1,12 @@
+-- integer column widths store and round-trip
+CREATE TABLE iw (a TINYINT, b SMALLINT, c INT, d BIGINT, ts TIMESTAMP TIME INDEX);
+
+INSERT INTO iw VALUES (1, 300, 70000, 5000000000, 1);
+
+INSERT INTO iw VALUES (-1, -300, -70000, -5000000000, 2);
+
+SELECT a, b, c, d FROM iw ORDER BY ts;
+
+SELECT sum(d) AS s FROM iw;
+
+DROP TABLE iw;

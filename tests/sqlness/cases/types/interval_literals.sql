@@ -1,0 +1,12 @@
+-- interval literal forms
+CREATE TABLE il (v DOUBLE, ts TIMESTAMP(3) TIME INDEX);
+
+INSERT INTO il VALUES (1.0, 0), (2.0, 90000);
+
+SELECT date_bin(INTERVAL '1 minute', ts) AS m, count(*) AS n FROM il GROUP BY m ORDER BY m;
+
+SELECT date_bin(INTERVAL '90 seconds', ts) AS m, count(*) AS n FROM il GROUP BY m ORDER BY m;
+
+SELECT date_bin(INTERVAL '1h30m', ts) AS m, count(*) AS n FROM il GROUP BY m ORDER BY m;
+
+DROP TABLE il;

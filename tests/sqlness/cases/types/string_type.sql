@@ -1,0 +1,12 @@
+-- STRING fields (not tags): store, filter, NULL
+CREATE TABLE st (msg STRING, ts TIMESTAMP TIME INDEX);
+
+INSERT INTO st VALUES ('hello', 1), (NULL, 2), ('world', 3);
+
+SELECT msg FROM st ORDER BY ts;
+
+SELECT count(msg) AS n FROM st;
+
+SELECT msg FROM st WHERE msg LIKE 'w%';
+
+DROP TABLE st;

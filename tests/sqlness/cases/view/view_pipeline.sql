@@ -1,0 +1,24 @@
+-- views over aggregates, views over views, SHOW/replace/drop
+CREATE TABLE vt (host STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(host));
+
+INSERT INTO vt VALUES ('a', 1.0, 1), ('a', 3.0, 2), ('b', 10.0, 1);
+
+CREATE VIEW v_sum AS SELECT host, sum(v) AS s FROM vt GROUP BY host;
+
+SELECT * FROM v_sum ORDER BY host;
+
+CREATE VIEW v_top AS SELECT * FROM v_sum WHERE s > 2;
+
+SELECT * FROM v_top ORDER BY host;
+
+CREATE OR REPLACE VIEW v_top AS SELECT * FROM v_sum WHERE s > 5;
+
+SELECT * FROM v_top;
+
+SHOW VIEWS;
+
+DROP VIEW v_top;
+
+DROP VIEW v_sum;
+
+DROP TABLE vt;

@@ -1,0 +1,12 @@
+-- NOT binding and parenthesized boolean logic
+CREATE TABLE np (v DOUBLE, ts TIMESTAMP TIME INDEX);
+
+INSERT INTO np VALUES (1.0, 1), (2.0, 2), (3.0, 3);
+
+SELECT v FROM np WHERE NOT v = 2 ORDER BY v;
+
+SELECT v FROM np WHERE NOT (v = 1 OR v = 2);
+
+SELECT v FROM np WHERE v = 1 OR v = 2 AND v = 3 ORDER BY v;
+
+DROP TABLE np;

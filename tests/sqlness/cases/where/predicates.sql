@@ -1,0 +1,18 @@
+-- BETWEEN / IN / LIKE / IS NULL / boolean combinations
+CREATE TABLE wp (host STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(host));
+
+INSERT INTO wp VALUES ('web-1', 1.0, 1), ('web-2', 5.0, 2), ('db-1', 9.0, 3), ('db-2', NULL, 4);
+
+SELECT host FROM wp WHERE v BETWEEN 2 AND 9 ORDER BY host;
+
+SELECT host FROM wp WHERE v NOT BETWEEN 2 AND 9 ORDER BY host;
+
+SELECT host FROM wp WHERE host IN ('web-1', 'db-1') ORDER BY host;
+
+SELECT host FROM wp WHERE host LIKE 'web-%' ORDER BY host;
+
+SELECT host FROM wp WHERE v IS NULL;
+
+SELECT host FROM wp WHERE v IS NOT NULL AND (v < 2 OR v > 8) ORDER BY host;
+
+DROP TABLE wp;

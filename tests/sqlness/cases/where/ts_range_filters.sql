@@ -1,0 +1,12 @@
+-- time-index range predicates prune correctly
+CREATE TABLE tr (v DOUBLE, ts TIMESTAMP(3) TIME INDEX);
+
+INSERT INTO tr VALUES (1.0, 1000), (2.0, 2000), (3.0, 3000), (4.0, 4000);
+
+SELECT v FROM tr WHERE ts > 1000 AND ts < 4000 ORDER BY ts;
+
+SELECT v FROM tr WHERE ts >= 2000 AND ts <= 3000 ORDER BY ts;
+
+SELECT count(*) AS n FROM tr WHERE ts >= 5000;
+
+DROP TABLE tr;

@@ -1,0 +1,10 @@
+-- navigation windows: lead/lag offsets, first/nth value
+CREATE TABLE nv (host STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(host));
+
+INSERT INTO nv VALUES ('a', 1.0, 1), ('a', 2.0, 2), ('a', 3.0, 3), ('a', 4.0, 4);
+
+SELECT ts, lag(v, 2) OVER (ORDER BY ts) AS l2, lead(v, 1, -1.0) OVER (ORDER BY ts) AS ld FROM nv ORDER BY ts;
+
+SELECT ts, first_value(v) OVER (ORDER BY ts) AS fv, nth_value(v, 2) OVER (ORDER BY ts) AS n2 FROM nv ORDER BY ts;
+
+DROP TABLE nv;

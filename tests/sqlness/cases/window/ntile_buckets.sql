@@ -1,0 +1,10 @@
+-- ntile bucketing
+CREATE TABLE nt (v DOUBLE, ts TIMESTAMP TIME INDEX);
+
+INSERT INTO nt VALUES (1.0, 1), (2.0, 2), (3.0, 3), (4.0, 4), (5.0, 5);
+
+SELECT v, ntile(2) OVER (ORDER BY v) AS b FROM nt ORDER BY v;
+
+SELECT v, ntile(3) OVER (ORDER BY v) AS b FROM nt ORDER BY v;
+
+DROP TABLE nt;

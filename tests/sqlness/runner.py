@@ -104,13 +104,46 @@ class HttpSqlClient:
     def __init__(self, port: int, db: str = "public"):
         self.port = port
         self.db = db
+        self.timezone = None
 
     def run(self, sql: str) -> str:
-        """Execute one statement; return its rendered transcript block."""
+        """Execute one statement; return its rendered transcript block.
+        USE <db> and SET TIME ZONE are session state (the reference
+        runner holds a connection); HTTP is stateless, so the runner
+        tracks them and pins each later request via the ?db= parameter /
+        X-Greptime-Timezone header."""
+        code_lines = [ln for ln in sql.splitlines()
+                      if ln.strip() and not ln.strip().startswith("--")]
+        bare = " ".join(code_lines).strip().rstrip(";").split()
+        if len(bare) == 2 and bare[0].lower() == "use":
+            self.db = bare[1].strip('"`')
+            return "Affected Rows: 0"
+        low = [w.lower() for w in bare]
+        tz_val = None
+        if low[:3] == ["set", "time", "zone"] and len(bare) == 4:
+            tz_val = bare[3]
+        elif len(low) >= 2 and low[0] == "set" \
+                and low[1].split("=")[0] in ("time_zone", "timezone"):
+            # MySQL spelling: SET time_zone = '+08:00'
+            tz_val = bare[-1].split("=")[-1]
+        if tz_val is not None:
+            # run the SET through the server (its validation + transcript
+            # are part of the case), and only keep the zone for later
+            # statements when it was accepted
+            out = self._post(sql)
+            if not out.startswith("Error"):
+                val = tz_val.strip("'\"")
+                self.timezone = None if val.lower() == "default" else val
+            return out
+        return self._post(sql)
+
+    def _post(self, sql: str) -> str:
         data = urllib.parse.urlencode({"sql": sql, "db": self.db}).encode()
         req = urllib.request.Request(
             f"http://127.0.0.1:{self.port}/v1/sql", data=data, method="POST"
         )
+        if self.timezone:
+            req.add_header("X-Greptime-Timezone", self.timezone)
         try:
             with urllib.request.urlopen(req, timeout=60) as resp:
                 payload = json.loads(resp.read().decode())
