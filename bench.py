@@ -301,6 +301,57 @@ def bench_high_cardinality(engine, qe, results):
         "vs_baseline": None}
 
 
+def bench_stream_large(engine, qe, results):
+    """Opt-in (BENCH_CONFIGS=stream_large): bigger-than-RAM streaming
+    aggregate at BENCH_STREAM_ROWS (default 100M) rows. The prepared
+    streaming fold double-buffers SST reads + plane builds + H2D copies
+    against the device fold, so wall-clock approaches
+    max(transfer, compute) — the 1B-row north-star shape at reduced
+    scale (raise BENCH_STREAM_ROWS on hardware with the headroom)."""
+    from greptimedb_tpu.datatypes import DictVector, RecordBatch
+
+    rows_target = int(os.environ.get("BENCH_STREAM_ROWS", "100000000"))
+    n_hosts = 2000
+    qe.execute_one(
+        "CREATE TABLE big (host STRING, v DOUBLE, ts TIMESTAMP(3) NOT "
+        "NULL, TIME INDEX (ts), PRIMARY KEY (host)) "
+        "WITH (append_mode = 'true')")
+    info = qe.catalog.table("public", "big")
+    rid = info.region_ids[0]
+    rng = np.random.default_rng(23)
+    names = np.asarray([f"h{i}" for i in range(n_hosts)], dtype=object)
+    points = rows_target // n_hosts
+    slice_points = max(1, (1 << 21) // n_hosts)
+    rows = 0
+    t_start = time.perf_counter()
+    for p0 in range(0, points, slice_points):
+        p1 = min(p0 + slice_points, points)
+        n = (p1 - p0) * n_hosts
+        codes = np.tile(np.arange(n_hosts, dtype=np.int32), p1 - p0)
+        ts = np.repeat(
+            T0_MS + np.arange(p0, p1, dtype=np.int64) * 1000, n_hosts)
+        batch = RecordBatch(info.schema, {
+            "host": DictVector(codes, names), "ts": ts,
+            "v": rng.uniform(0, 100.0, n)})
+        engine.put(rid, batch)
+        rows += n
+        if rows % (20 * slice_points * n_hosts) == 0:
+            engine.flush(rid)  # bound memtable growth during ingest
+    engine.flush(rid)
+    log(f"stream ingest: {rows} rows in {time.perf_counter() - t_start:.0f}s")
+    sql = ("SELECT host, avg(v), min(v), max(v) FROM big GROUP BY host")
+    p50, warm, nrows, wspans = timed_sql(qe, sql, repeats=1,
+                                         expect_rows=n_hosts)
+    path = qe.executor.last_path
+    rps = rows / (p50 / 1000.0)
+    log(f"stream-large: {p50:.0f} ms over {rows} rows "
+        f"({rps / 1e6:.0f}M rows/s, path={path})")
+    results["stream_large"] = {
+        "p50_ms": round(p50, 1), "rows": rows, "path": path,
+        "scan_rows_per_s": round(rps), "warmup_spans_ms": wspans,
+        "baseline_ms": None, "vs_baseline": None}
+
+
 def bench_compaction(engine, qe, results):
     """Config 4 analog: L0→L1 TWCS merge re-encode throughput."""
     from greptimedb_tpu.datatypes import DictVector, RecordBatch
@@ -428,6 +479,8 @@ def main():
             bench_high_cardinality(engine, qe, results)
         if enabled("compaction_reencode"):
             bench_compaction(engine, qe, results)
+        if CONFIGS and "stream_large" in CONFIGS:  # opt-in only: 100M rows
+            bench_stream_large(engine, qe, results)
 
         profile_dir = None
         if platform not in ("cpu",) and "double_groupby_all" in results:

@@ -530,6 +530,70 @@ def _prep_stream_step(acc, cols, n_valid, *, where, keys, num_segments,
     return out
 
 
+def _prefetch(items, depth: int = 2):
+    """Double-buffered pipeline: a producer thread runs the host-side
+    work of the NEXT chunk (SST page reads, plane building, the H2D
+    copy) while the device folds the current one. JAX dispatch is
+    already async on the device side; this overlaps the HOST side too,
+    so streaming wall-clock approaches max(transfer, compute) instead of
+    their sum (SURVEY §7 hard part 4 — bigger-than-HBM scans).
+
+    `depth` bounds the queue; up to depth+2 chunks can coexist (queued,
+    one blocked in the producer's put, one being folded) — the real
+    memory ceiling for 100M+-row scans."""
+    import queue
+    import threading
+
+    q: queue.Queue = queue.Queue(maxsize=depth)
+    sentinel = object()
+    stop = threading.Event()
+    err: list = []
+
+    def producer():
+        try:
+            for item in items:
+                while not stop.is_set():
+                    try:
+                        q.put(item, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if stop.is_set():
+                    return  # consumer abandoned: skip the rest of the scan
+        except BaseException as e:  # noqa: BLE001 — re-raised on consumer
+            err.append(e)
+        finally:
+            # the sentinel MUST land (a dropped sentinel deadlocks the
+            # consumer's get) — retry until it fits or we were cancelled
+            while not stop.is_set():
+                try:
+                    q.put(sentinel, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is sentinel:
+                break
+            yield item
+        if err:
+            raise err[0]
+    finally:
+        # cancel the producer (exception/close downstream): it stops at
+        # its next put instead of building the rest of the scan
+        stop.set()
+        try:
+            while True:
+                q.get_nowait()
+        except queue.Empty:
+            pass
+        t.join(timeout=5)
+
+
 class _NotStreamable(Exception):
     """Query shape the streaming path can't serve (generic group keys,
     host-side order statistics); caller falls back to the materialized
@@ -1141,21 +1205,25 @@ class PhysicalExecutor:
                   num_segments=num_groups, ts_name=ts_name,
                   tag_names=tag_names, schema=schema, need_ts=need_ts,
                   acc_dtype=acc_dtype)
+        def build_blocks():
+            for cols_np, nrows in stream.chunks():
+                for start in range(0, nrows, block):
+                    end = min(start + block, nrows)
+                    dev = {}
+                    for name in names:
+                        arr = pad_rows(np.asarray(cols_np[name][start:end]),
+                                       block)
+                        if name in float_fields and arr.dtype != acc_dtype:
+                            arr = arr.astype(acc_dtype)
+                        dev[name] = jnp.asarray(arr)
+                    yield dev, jnp.asarray(end - start)
+
         acc_dev = None
-        for cols_np, nrows in stream.chunks():
-            for start in range(0, nrows, block):
-                end = min(start + block, nrows)
-                dev = {}
-                for name in names:
-                    arr = pad_rows(np.asarray(cols_np[name][start:end]), block)
-                    if name in float_fields and arr.dtype != acc_dtype:
-                        arr = arr.astype(acc_dtype)
-                    dev[name] = jnp.asarray(arr)
-                n_valid = jnp.asarray(end - start)
-                if acc_dev is None:
-                    acc_dev = _agg_block_jit(dev, n_valid, None, **kw)
-                else:
-                    acc_dev = _agg_step(acc_dev, dev, n_valid, **kw)
+        for dev, n_valid in _prefetch(build_blocks()):
+            if acc_dev is None:
+                acc_dev = _agg_block_jit(dev, n_valid, None, **kw)
+            else:
+                acc_dev = _agg_step(acc_dev, dev, n_valid, **kw)
         nf = max(nf, 1)
         if acc_dev is None:
             # pruned-empty stream: identity planes
@@ -1197,35 +1265,40 @@ class PhysicalExecutor:
         prep_dtype = jnp.dtype(jnp.float64) if "sumsq" in ops else acc_dtype
         kw = dict(where=bound_where, keys=keys, num_segments=num_groups,
                   tag_names=tag_names, schema=schema)
-        acc_dev = None
-        for cols_np, nrows in stream.chunks():
-            shim = SimpleNamespace(columns=cols_np)
-            for start in range(0, nrows, block):
-                end = min(start + block, nrows)
-                dev = {}
-                for name in aux_names:
-                    arr = pad_rows(np.asarray(cols_np[name][start:end]),
-                                   block)
-                    if name in float_fields and arr.dtype != acc_dtype:
-                        arr = arr.astype(acc_dtype)
-                    dev[name] = jnp.asarray(arr)
-                dev["__prep__"] = jnp.asarray(_build_prep(
-                    shim, arg_names, start, end, block, prep_dtype, True,
-                    None))
-                if "min" in ops:
-                    dev["__prep_min__"] = jnp.asarray(_build_prep(
-                        shim, arg_names, start, end, block, acc_dtype,
-                        False, "min"))
-                if "max" in ops:
-                    dev["__prep_max__"] = jnp.asarray(_build_prep(
-                        shim, arg_names, start, end, block, acc_dtype,
-                        False, "max"))
-                if "sumsq" in ops:
-                    dev["__prep_sq__"] = jnp.asarray(_build_prep(
+        def build_blocks():
+            for cols_np, nrows in stream.chunks():
+                shim = SimpleNamespace(columns=cols_np)
+                for start in range(0, nrows, block):
+                    end = min(start + block, nrows)
+                    dev = {}
+                    for name in aux_names:
+                        arr = pad_rows(np.asarray(cols_np[name][start:end]),
+                                       block)
+                        if name in float_fields and arr.dtype != acc_dtype:
+                            arr = arr.astype(acc_dtype)
+                        dev[name] = jnp.asarray(arr)
+                    dev["__prep__"] = jnp.asarray(_build_prep(
                         shim, arg_names, start, end, block, prep_dtype,
-                        False, "sq"))
-                acc_dev = _prep_stream_step(acc_dev, dev,
-                                            jnp.asarray(end - start), **kw)
+                        True, None))
+                    if "min" in ops:
+                        dev["__prep_min__"] = jnp.asarray(_build_prep(
+                            shim, arg_names, start, end, block, acc_dtype,
+                            False, "min"))
+                    if "max" in ops:
+                        dev["__prep_max__"] = jnp.asarray(_build_prep(
+                            shim, arg_names, start, end, block, acc_dtype,
+                            False, "max"))
+                    if "sumsq" in ops:
+                        dev["__prep_sq__"] = jnp.asarray(_build_prep(
+                            shim, arg_names, start, end, block, prep_dtype,
+                            False, "sq"))
+                    yield dev, jnp.asarray(end - start)
+
+        acc_dev = None
+        # double-buffered: the next chunk's SST read + plane build + H2D
+        # copy overlap the device fold of the current one
+        for dev, n_valid in _prefetch(build_blocks()):
+            acc_dev = _prep_stream_step(acc_dev, dev, n_valid, **kw)
         G = num_groups
         acc: dict[str, np.ndarray] = {}
         if acc_dev is None:
