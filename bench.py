@@ -231,8 +231,11 @@ def bench_promql(engine, qe, results):
     slice_points = max(1, (1 << 21) // PROM_SERIES)
     t_start = time.perf_counter()
     rows = 0
-    # counter-style: per-series monotone increments so rate() is realistic
-    for p0 in range(0, points, slice_points):
+    flush_every = max(1, points // (slice_points * 8))
+    # counter-style: per-series monotone increments so rate() is
+    # realistic. Periodic flushes produce time-bounded SST files (the
+    # shape continuous ingestion creates), so scans prune by time.
+    for i, p0 in enumerate(range(0, points, slice_points)):
         p1 = min(p0 + slice_points, points)
         npts = p1 - p0
         n = npts * PROM_SERIES
@@ -246,6 +249,8 @@ def bench_promql(engine, qe, results):
             "host": DictVector(codes, names), "ts": ts, "val": vals})
         engine.put(rid, batch)
         rows += n
+        if (i + 1) % flush_every == 0:
+            engine.flush(rid)
     log(f"prom ingest: {rows} rows in {time.perf_counter() - t_start:.1f}s")
     engine.flush(rid)
     t0_s = T0_MS // 1000

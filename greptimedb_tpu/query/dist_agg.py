@@ -86,14 +86,15 @@ def _region_host_columns_inner(executor, region_id, where, ts_range, needed,
         host[name] = taken
     if ts_range is not None:
         # scan ts_range is coarse (row-group pruning); apply the exact
-        # closed bounds here — the frontend derived them from WHERE
+        # [lo, hi) bounds here (extract_ts_bounds emits half-open upper
+        # bounds) — the frontend derived them from WHERE
         lo, hi = ts_range
         tsv = host[ts_name].astype(np.int64)
         m = np.ones(len(tsv), dtype=bool)
         if lo is not None:
             m &= tsv >= lo
         if hi is not None:
-            m &= tsv <= hi
+            m &= tsv < hi
         if not m.all():
             host = {k: v[m] for k, v in host.items()}
     if len(host[ts_name]) == 0:

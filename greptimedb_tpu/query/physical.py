@@ -591,7 +591,9 @@ def _prefetch(items, depth: int = 2):
                 q.get_nowait()
         except queue.Empty:
             pass
-        t.join(timeout=5)
+        # unbounded: the producer exits after its CURRENT read; waiting
+        # keeps SST file pins valid until no thread touches the files
+        t.join()
 
 
 class _NotStreamable(Exception):
@@ -1219,11 +1221,18 @@ class PhysicalExecutor:
                     yield dev, jnp.asarray(end - start)
 
         acc_dev = None
-        for dev, n_valid in _prefetch(build_blocks()):
-            if acc_dev is None:
-                acc_dev = _agg_block_jit(dev, n_valid, None, **kw)
-            else:
-                acc_dev = _agg_step(acc_dev, dev, n_valid, **kw)
+        gen = _prefetch(build_blocks())
+        try:
+            for dev, n_valid in gen:
+                if acc_dev is None:
+                    acc_dev = _agg_block_jit(dev, n_valid, None, **kw)
+                else:
+                    acc_dev = _agg_step(acc_dev, dev, n_valid, **kw)
+        finally:
+            # stop the producer BEFORE the caller's stream.close() drops
+            # SST pins: a generator left suspended would only clean up at
+            # GC, racing the producer's reads against file purge
+            gen.close()
         nf = max(nf, 1)
         if acc_dev is None:
             # pruned-empty stream: identity planes
@@ -1297,8 +1306,12 @@ class PhysicalExecutor:
         acc_dev = None
         # double-buffered: the next chunk's SST read + plane build + H2D
         # copy overlap the device fold of the current one
-        for dev, n_valid in _prefetch(build_blocks()):
-            acc_dev = _prep_stream_step(acc_dev, dev, n_valid, **kw)
+        gen = _prefetch(build_blocks())
+        try:
+            for dev, n_valid in gen:
+                acc_dev = _prep_stream_step(acc_dev, dev, n_valid, **kw)
+        finally:
+            gen.close()  # see _fold_stream: producer must die before unpin
         G = num_groups
         acc: dict[str, np.ndarray] = {}
         if acc_dev is None:

@@ -395,6 +395,7 @@ class Region:
         parts_seq: list[np.ndarray] = []
         parts_op: list[np.ndarray] = []
 
+        ts_name = self.schema.time_index.name
         try:
             for meta in file_list:
                 table = self.sst_reader.read(meta, self.schema, ts_range, names,
@@ -402,9 +403,32 @@ class Region:
                 if table is None or table.num_rows == 0:
                     continue
                 cols = self._decode_sst(table, names)
+                seq_col = table.column(SEQ_COL).to_numpy(
+                    zero_copy_only=False).astype(np.int64)
+                op_col = table.column(OP_COL).to_numpy(
+                    zero_copy_only=False).astype(np.int8)
+                if ts_range is not None:
+                    # exact row filter: SSTs sort by (pk, ts), so a row
+                    # group from one large flush can span the whole time
+                    # range and row-group stats cannot prune it — drop
+                    # out-of-range rows here so downstream (device
+                    # transfer + kernels) only sees the queried window.
+                    # All versions/tombstones of an instant share its ts,
+                    # so LWW dedup still sees every candidate.
+                    tsv = cols[ts_name]
+                    # [lo, hi) — extract_ts_bounds emits half-open upper
+                    # bounds (ts <= v becomes hi = v+1), matching every
+                    # other pruner here (sst/memtable/scan_stream)
+                    m = (tsv >= ts_range[0]) & (tsv < ts_range[1])
+                    if not m.all():
+                        if not m.any():
+                            continue
+                        cols = {n: v[m] for n, v in cols.items()}
+                        seq_col = seq_col[m]
+                        op_col = op_col[m]
                 parts_cols.append(cols)
-                parts_seq.append(table.column(SEQ_COL).to_numpy(zero_copy_only=False).astype(np.int64))
-                parts_op.append(table.column(OP_COL).to_numpy(zero_copy_only=False).astype(np.int8))
+                parts_seq.append(seq_col)
+                parts_op.append(op_col)
         finally:
             self._unpin_files(file_list)
 
