@@ -46,6 +46,8 @@ BASELINE_MS = 2215.44           # double-groupby-all
 BASE_SINGLE_MS = 15.70          # single-groupby-1-1-1
 BASE_LASTPOINT_MS = 6756.12     # lastpoint
 BASE_HIGH_CPU_MS = 5402.31      # high-cpu-all
+BASE_GBOL_MS = 754.50           # groupby-orderby-limit
+BASE_MAX_ALL_8_MS = 51.69       # cpu-max-all-8
 BASE_INGEST_ROWS_S = 315369.66  # TSBS ingest rate
 
 INIT_RETRIES = int(os.environ.get("BENCH_INIT_RETRIES", "3"))
@@ -191,6 +193,37 @@ def bench_cpu_suite(qe, results):
             "groups": nrows, "warmup_spans_ms": wspans,
             "baseline_ms": BASELINE_MS,
             "vs_baseline": round(BASELINE_MS / p50, 3)}
+
+    if enabled("groupby_orderby_limit"):
+        # TSBS groupby-orderby-limit: last 5 minute-buckets of max before
+        # a cutoff inside the range
+        cutoff = T0_MS + (HOURS * 3600 * 1000) * 3 // 4
+        sql = (
+            "SELECT date_bin(INTERVAL '1 minute', ts) AS minute, "
+            f"max(usage_user) FROM cpu WHERE ts < {cutoff} "
+            "GROUP BY minute ORDER BY minute DESC LIMIT 5"
+        )
+        p50, warm, nrows, _ = timed_sql(qe, sql, expect_rows=5)
+        log(f"groupby-orderby-limit: {p50:.1f} ms")
+        results["groupby_orderby_limit"] = {
+            "p50_ms": round(p50, 2), "baseline_ms": BASE_GBOL_MS,
+            "vs_baseline": round(BASE_GBOL_MS / p50, 3)}
+
+    if enabled("cpu_max_all_8"):
+        # TSBS cpu-max-all-8: max of all 10 fields for 8 hosts over 8h
+        max_list = ", ".join(f"max({f})" for f in FIELDS)
+        hosts8 = ", ".join(f"'host_{i}'" for i in range(8))
+        sql = (
+            f"SELECT date_bin(INTERVAL '1 hour', ts) AS hour, {max_list} "
+            f"FROM cpu WHERE hostname IN ({hosts8}) "
+            f"AND ts >= {T0_MS} AND ts < {T0_MS + 8 * 3600 * 1000} "
+            "GROUP BY hour ORDER BY hour"
+        )
+        p50, warm, nrows, _ = timed_sql(qe, sql, expect_rows=min(8, HOURS))
+        log(f"cpu-max-all-8: {p50:.1f} ms")
+        results["cpu_max_all_8"] = {
+            "p50_ms": round(p50, 2), "baseline_ms": BASE_MAX_ALL_8_MS,
+            "vs_baseline": round(BASE_MAX_ALL_8_MS / p50, 3)}
 
     if enabled("lastpoint"):
         lv_list = ", ".join(

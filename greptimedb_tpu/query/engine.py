@@ -387,22 +387,28 @@ class QueryEngine:
 
     def _select_view(self, sel: ast.Select, vsql: str,
                      ctx: QueryContext) -> QueryResult:
-        """SELECT over a view: run the stored defining query through the
-        normal engine (device path and all), then evaluate the outer
-        select over its columns (reference: views inline into the plan;
-        here the view result is the virtual relation)."""
+        """SELECT over a view. Simple views (single-table
+        projection/filter) INLINE into the outer query — the reference's
+        approach — so the merged query keeps the device scan path,
+        distributed pushdown, and RANGE ... ALIGN. Complex views
+        (aggregates, joins, limits) materialize through the normal
+        engine and the outer select evaluates over their columns."""
         from greptimedb_tpu.query import range_select as rs
         from greptimedb_tpu.query.join import execute_select_over
 
+        inner_stmts = parse_sql(vsql)
+        if len(inner_stmts) != 1:
+            raise PlanError("view definition must be a single query")
+        inlined = self._try_inline_view(sel, inner_stmts[0], ctx)
+        if inlined is not None:
+            return self._select(inlined, ctx)
         if rs.is_range_select(sel):
             # RANGE/ALIGN needs the base table's time-index machinery —
             # refusing beats silently dropping the alignment semantics
             raise PlanError(
-                "RANGE ... ALIGN over a view is not supported; query the "
-                "underlying table (or fold the RANGE into the view)")
-        inner_stmts = parse_sql(vsql)
-        if len(inner_stmts) != 1:
-            raise PlanError("view definition must be a single query")
+                "RANGE ... ALIGN is only supported over simple "
+                "(projection/filter) views; query the underlying table "
+                "or fold the RANGE into the view")
         view_db, short = self._db_and_name(sel.table, ctx)
         # the defining query resolves unqualified names in the VIEW's
         # database, and nested views are depth-limited (a ↔ b cycles
@@ -428,6 +434,120 @@ class QueryEngine:
         dtypes = dict(zip(base.names, base.dtypes))
         return execute_select_over(self, sel, cols, dtypes,
                                    alias=sel.table_alias or short)
+
+    def _try_inline_view(self, sel: ast.Select, inner,
+                         ctx: QueryContext) -> Optional[ast.Select]:
+        """Merge the outer select into a SIMPLE view definition
+        (single table, projection + filter only): outer column refs
+        substitute to the view's defining expressions, WHEREs conjoin,
+        and the merged query plans against the base table. Returns None
+        when the view is too complex to inline."""
+        if not isinstance(inner, ast.Select):
+            return None
+        if (inner.joins or inner.group_by or inner.having or inner.distinct
+                or inner.order_by or inner.limit is not None or inner.offset
+                or inner.ctes or inner.from_subquery is not None
+                or inner.table is None or inner.align is not None):
+            return None
+        from greptimedb_tpu.query.expr import has_aggregate
+        from greptimedb_tpu.query.window import select_has_window
+
+        if select_has_window(inner):
+            return None
+        if any(has_aggregate(it.expr) for it in inner.items):
+            return None  # aggregate-only view (no GROUP BY): materialize
+        if any(_expr_has_subquery(it.expr) for it in inner.items) or (
+                inner.where is not None
+                and _expr_has_subquery(inner.where)):
+            return None
+        # resolve the base table's schema in the VIEW's database
+        view_db, _ = self._db_and_name(sel.table, ctx)
+        inner_ctx = ctx.with_db(view_db)
+        try:
+            info = self._table(inner.table, inner_ctx)
+        except (CatalogError, PlanError):
+            return None
+        # exposed name -> defining expression, in the VIEW's item order
+        # (Star expands in place so positional clients see the view's
+        # declared column order)
+        mapping: dict[str, ast.Expr] = {}
+        for it in inner.items:
+            if isinstance(it.expr, ast.Star):
+                for c in info.schema.names:
+                    if c in mapping:
+                        return None  # duplicate: materialize path errors
+                    mapping[c] = ast.Column(c)
+                continue
+            name = it.alias or (it.expr.name
+                                if isinstance(it.expr, ast.Column)
+                                else None)
+            if name is None:
+                return None  # unnamed computed column: can't reference it
+            if name in mapping:
+                # duplicate output name: let the materialize path raise
+                # its duplicate-column error
+                return None
+            mapping[name] = it.expr
+        alias = sel.table_alias or sel.table
+
+        class _Unmappable(Exception):
+            pass
+
+        def leaf(e):
+            if isinstance(e, ast.Column):
+                if e.table not in (None, alias, sel.table):
+                    raise _Unmappable()
+                if e.name not in mapping:
+                    raise _Unmappable()
+                return mapping[e.name]
+            return NotImplemented
+
+        def subst(e):
+            return _rewrite_tree(e, leaf)
+
+        def item_sub(it):
+            if isinstance(it.expr, ast.Star):
+                return it
+            new_expr = subst(it.expr)
+            alias = it.alias
+            # keep the VIEW-level spelling when substitution changed the
+            # expression: sum(dbl) must not surface as "sum(v * 2)"
+            if alias is None and new_expr != it.expr:
+                from greptimedb_tpu.query.planner import _default_name
+
+                alias = _default_name(it.expr)
+            return dataclasses.replace(it, expr=new_expr, alias=alias)
+
+        try:
+            items = []
+            for it in sel.items:
+                if isinstance(it.expr, ast.Star):
+                    # SELECT * over the view projects the VIEW's outputs
+                    for name, expr in mapping.items():
+                        items.append(ast.SelectItem(expr, alias=name))
+                else:
+                    items.append(item_sub(it))
+            where = subst(sel.where) if sel.where is not None else None
+            if inner.where is not None:
+                where = inner.where if where is None else \
+                    ast.BinaryOp("and", where, inner.where)
+            merged = dataclasses.replace(
+                sel, items=items, table=inner.table, table_alias=None,
+                where=where,
+                group_by=[subst(g) for g in sel.group_by],
+                having=subst(sel.having) if sel.having is not None else None,
+                order_by=[dataclasses.replace(ob, expr=subst(ob.expr))
+                          for ob in sel.order_by],
+                align_by=[subst(a) for a in sel.align_by],
+                align_to=subst(sel.align_to)
+                if sel.align_to is not None else None)
+        except _Unmappable:
+            return None
+        # run in the view's database so the base table resolves there
+        if view_db != ctx.db:
+            merged = dataclasses.replace(merged, table=f"{view_db}.{inner.table}") \
+                if "." not in inner.table else merged
+        return merged
 
     def _table(self, name: str, ctx: QueryContext) -> TableInfo:
         # db.table only when the prefix names a real database — otherwise
@@ -1345,6 +1465,31 @@ def _render_type(dt: DataType) -> str:
 
 def _is_nan_scalar(v) -> bool:
     return isinstance(v, float) and v != v
+
+
+def _rewrite_tree(e, leaf):
+    """Generic expression rewrite: `leaf(node)` returns a replacement or
+    NotImplemented to descend. Descends containers and any
+    expression-carrying dataclass (incl. non-Expr carriers like
+    WindowSpec) but never into embedded statements."""
+    out = leaf(e)
+    if out is not NotImplemented:
+        return out
+    if isinstance(e, (list, tuple)):
+        return type(e)(_rewrite_tree(x, leaf) for x in e)
+    if dataclasses.is_dataclass(e) and not isinstance(e, type) \
+            and not isinstance(e, ast.Statement):
+        changes = {}
+        for f in dataclasses.fields(e):
+            v = getattr(e, f.name)
+            if isinstance(v, (ast.Expr, list, tuple)) or (
+                    dataclasses.is_dataclass(v)
+                    and not isinstance(v, (type, ast.Statement))):
+                nv = _rewrite_tree(v, leaf)
+                if nv != v:
+                    changes[f.name] = nv
+        return dataclasses.replace(e, **changes) if changes else e
+    return e
 
 
 def _expr_has_subquery(e) -> bool:

@@ -1,0 +1,16 @@
+-- simple views inline: RANGE/device path work against the base table
+CREATE TABLE vm (host STRING, v DOUBLE, ts TIMESTAMP(3) TIME INDEX, PRIMARY KEY(host));
+
+INSERT INTO vm VALUES ('a', 1.0, 0), ('a', 3.0, 5000), ('b', 2.0, 0), ('b', 4.0, 5000);
+
+CREATE VIEW vs AS SELECT host AS h, v * 2 AS dbl, ts FROM vm WHERE v > 1;
+
+SELECT h, dbl FROM vs ORDER BY h, dbl;
+
+SELECT h, max(dbl) AS mx FROM vs GROUP BY h ORDER BY h;
+
+SELECT ts, sum(dbl) RANGE '5s' FROM vs ALIGN '5s' BY () ORDER BY ts;
+
+DROP VIEW vs;
+
+DROP TABLE vm;

@@ -123,10 +123,21 @@ class TestReviewRegressions:
                 "CREATE TABLE hot (h STRING, ts TIMESTAMP(3) NOT NULL,"
                 " TIME INDEX (ts), PRIMARY KEY (h))")
 
-    def test_range_over_view_rejected(self, db):
-        with pytest.raises(PlanError, match="RANGE.*view"):
+    def test_range_over_simple_view_inlines(self, db):
+        # simple views inline into the outer plan (reference behavior),
+        # so RANGE ... ALIGN works against the base table's time index
+        r = db.execute_one(
+            "SELECT ts, max(v) RANGE '5s' FROM hot ALIGN '5s' "
+            "BY () ORDER BY ts")
+        assert r.num_rows > 0
+
+    def test_range_over_complex_view_rejected(self, db):
+        db.execute_one(
+            "CREATE VIEW agg_v AS SELECT host, max(v) mx FROM m "
+            "GROUP BY host")
+        with pytest.raises(PlanError, match="RANGE"):
             db.execute_one(
-                "SELECT ts, max(v) RANGE '5s' FROM hot ALIGN '5s'")
+                "SELECT ts, max(mx) RANGE '5s' FROM agg_v ALIGN '5s'")
 
     def test_duplicate_view_columns_rejected(self, db):
         db.execute_one("CREATE VIEW dup AS SELECT host, host FROM m")
@@ -150,3 +161,68 @@ class TestReviewRegressions:
         r = db.execute_one("EXPLAIN ANALYZE SELECT host FROM hot")
         text = "\n".join(row[0] for row in r.rows())
         assert "ANALYZE trace=" in text
+
+
+class TestViewInlining:
+    """Simple views merge into the outer plan (the reference inlines
+    views at plan time), keeping the device scan path."""
+
+    def test_inlined_view_uses_device_path(self, db):
+        db.execute_one(
+            "CREATE VIEW simple_v AS SELECT host, v, ts FROM m "
+            "WHERE v > 0")
+        db.executor.last_path = None
+        r = db.execute_one(
+            "SELECT host, avg(v) FROM simple_v GROUP BY host ORDER BY host")
+        assert r.num_rows > 0
+        # the MERGED aggregate ran on a device path; the materialize
+        # path would leave last_path at the inner raw scan (None)
+        assert db.executor.last_path in (
+            "dense", "dense_prepared", "sparse", "sharded",
+            "sharded_prepared", "stream", "stream_prepared")
+
+    def test_aggregate_only_view_not_inlined(self, db):
+        # SELECT count(*) over an agg view counts the VIEW's rows (1),
+        # not the base table's
+        db.execute_one("CREATE VIEW topv AS SELECT max(v) AS mx FROM m")
+        assert db.execute_one("SELECT count(*) c FROM topv").rows() == [[1]]
+        assert db.execute_one(
+            "SELECT mx FROM topv WHERE mx > 0").rows() == [[10.0]]
+
+    def test_star_position_preserved(self, db):
+        db.execute_one("CREATE VIEW wv AS SELECT v * 2 AS d, * FROM m")
+        r = db.execute_one("SELECT * FROM wv LIMIT 1")
+        assert r.names == ["d", "host", "ts", "v"]
+
+    def test_composite_expr_keeps_view_names(self, db):
+        db.execute_one(
+            "CREATE VIEW cv2 AS SELECT host AS h, v * 2 AS dbl, ts FROM m")
+        r = db.execute_one("SELECT h, sum(dbl) FROM cv2 GROUP BY h "
+                           "ORDER BY h")
+        assert r.names == ["h", "sum(dbl)"]
+
+    def test_rename_view_keeps_outer_names(self, db):
+        db.execute_one(
+            "CREATE VIEW ren_v AS SELECT host AS h, v AS val, ts FROM m")
+        r = db.execute_one("SELECT h, val FROM ren_v ORDER BY h LIMIT 1")
+        assert r.names == ["h", "val"]
+
+    def test_view_where_conjoins_with_outer(self, db):
+        db.execute_one(
+            "CREATE VIEW big_v AS SELECT host, v, ts FROM m WHERE v >= 2")
+        all_rows = db.execute_one("SELECT count(*) c FROM big_v").rows()
+        narrowed = db.execute_one(
+            "SELECT count(*) c FROM big_v WHERE v <= 2").rows()
+        assert narrowed[0][0] <= all_rows[0][0]
+        only2 = db.execute_one(
+            "SELECT v FROM big_v WHERE v <= 2").rows()
+        assert all(row[0] == 2.0 for row in only2)
+
+    def test_computed_column_view(self, db):
+        db.execute_one(
+            "CREATE VIEW calc_v AS SELECT host, v * 10 AS v10, ts FROM m")
+        r = db.execute_one(
+            "SELECT host, max(v10) FROM calc_v GROUP BY host ORDER BY host")
+        base = db.execute_one(
+            "SELECT host, max(v) * 10 FROM m GROUP BY host ORDER BY host")
+        assert r.rows() == base.rows()
