@@ -362,6 +362,54 @@ class Region:
             self.data_version += 1
         return meta
 
+    def _tag_inset_mask(self, tag_predicates, columns):
+        """Row mask for the InSet (=/IN) parts of the tag predicates over
+        global-code columns, or None when no InSet applies. Regex/Range
+        predicates stay with the device filter."""
+        from greptimedb_tpu.storage.index import InSet, normalize_predicates
+
+        keep = None
+        for tag, preds in normalize_predicates(tag_predicates).items():
+            if tag not in columns:
+                continue
+            allowed = None
+            for p in preds:
+                if isinstance(p, InSet):
+                    s = set(p.values)
+                    allowed = s if allowed is None else (allowed & s)
+            if allowed is None:
+                continue
+            d = self.registry.dict_array(tag)
+            codes = [c for v in allowed
+                     for c in np.flatnonzero(d == v).tolist()]
+            m = np.isin(columns[tag], np.asarray(codes, dtype=np.int64))
+            keep = m if keep is None else (keep & m)
+        return keep
+
+    def _widen_covering_range(self, ts_range):
+        """None when `ts_range` covers at least half of the region's
+        data span (see scan: canonical-cache sharing), else unchanged."""
+        if ts_range is None:
+            return None
+        lo, hi = ts_range
+        with self._lock:
+            # metadata-only snapshot under the lock: flush mutates
+            # self.files and swaps self.memtable concurrently
+            mins = [m.ts_min for m in self.files.values()]
+            maxs = [m.ts_max for m in self.files.values()]
+            mem = self.memtable
+            mem_min, mem_max = mem.ts_min, mem.ts_max
+        if mem_min is not None and mem_max is not None:
+            mins.append(mem_min)
+            maxs.append(mem_max)
+        if not mins:
+            return ts_range
+        glo, ghi = min(mins), max(maxs)
+        if lo <= glo and hi > ghi:
+            return None  # covers everything: exactly the full scan
+        covered = min(hi, ghi + 1) - max(lo, glo)
+        return None if 2 * covered >= (ghi + 1 - glo) else ts_range
+
     # ---- scan --------------------------------------------------------------
 
     def scan(
@@ -378,6 +426,18 @@ class Region:
         names = self._scan_columns(projection)
         from greptimedb_tpu.storage.index import predicates_cache_key
         pred_key = predicates_cache_key(tag_predicates)
+        # wide windows (>= half the region's time span) serve the
+        # CANONICAL full scan instead of a range-keyed copy: every
+        # distinct ts_range otherwise caches its own host columns AND
+        # its own HBM blocks (the fingerprint keys them), so a handful
+        # of overlapping dashboards would hold several copies of the
+        # table. Kernels mask exactly either way; narrow windows still
+        # get a filtered copy (that is where filtering pays), and
+        # tag-predicated scans keep their exact range — the inverted
+        # index already shrank them, so the copy is cheap and computing
+        # over the shared full rows would cost more than it saves.
+        if not tag_predicates:
+            ts_range = self._widen_covering_range(ts_range)
         # snapshot phase under the region lock: version + file list +
         # memtable rows form one consistent view; SST decode (the slow
         # part) runs outside, on immutable grace-protected files
@@ -443,6 +503,24 @@ class Region:
         columns = {n: np.concatenate([p[n] for p in parts_cols]) for n in names}
         seq = np.concatenate(parts_seq)
         op = np.concatenate(parts_op)
+        if tag_predicates:
+            # exact row filter for equality/IN tag predicates: the
+            # inverted index prunes row groups, but one row group holds
+            # hundreds of series — dropping non-matching rows here keeps
+            # the cached scan (and device compute) proportional to the
+            # SELECTED series. Whole series keep/drop together, so LWW
+            # dedup and tombstones stay intact; the device WHERE still
+            # evaluates the predicate exactly (incl. NULL semantics).
+            keep = self._tag_inset_mask(tag_predicates, columns)
+            if keep is not None and not keep.all():
+                idx = np.flatnonzero(keep)
+                if idx.size == 0:
+                    # preserve the "no rows" contract: consumers
+                    # None-check, they never expect a 0-row ScanData
+                    return None
+                columns = {n: v[idx] for n, v in columns.items()}
+                seq = seq[idx]
+                op = op[idx]
         tag_dicts = {
             c.name: self.registry.dict_array(c.name)
             for c in self.schema.tag_columns

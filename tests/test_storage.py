@@ -423,3 +423,59 @@ class TestRemoteWal:
         wal.store.read = lambda k: (reads.append(k), inner(k))[1]
         assert [e.seq for e in wal.replay(7, from_seq=2)] == [2]
         assert len(reads) == 1  # only the live segment was fetched
+
+
+class TestScanPredicateFilter:
+    """Exact row filtering at scan assembly (ts range + InSet tags)."""
+
+    def test_unmatched_tag_on_memtable_rows_returns_none(self, engine):
+        """An InSet predicate matching nothing must yield 'no rows'
+        (None), not a 0-row ScanData that crashes None-checking
+        consumers."""
+        s = cpu_schema()
+        engine.create_region(1, s)
+        engine.put(1, make_batch(s, ["a", "b"], [10, 20], [1.0, 2.0]))
+        from greptimedb_tpu.storage.index import InSet
+
+        scan = engine.scan(1, tag_predicates={
+            "hostname": (InSet.of(["nope"]),)})
+        assert scan is None
+
+    def test_inset_filter_drops_other_series(self, engine):
+        s = cpu_schema()
+        engine.create_region(1, s)
+        engine.put(1, make_batch(s, ["a", "b", "c"], [10, 20, 30],
+                                 [1.0, 2.0, 3.0]))
+        engine.flush(1)
+        from greptimedb_tpu.storage.index import InSet
+
+        scan = engine.scan(1, tag_predicates={
+            "hostname": (InSet.of(["b"]),)})
+        assert scan.num_rows == 1
+        code = scan.columns["hostname"][0]
+        assert scan.tag_dicts["hostname"][code] == "b"
+
+    def test_plain_set_predicate_form_filters(self, engine):
+        """The documented plain-set predicate form (metric engine uses
+        it) must filter too."""
+        s = cpu_schema()
+        engine.create_region(1, s)
+        engine.put(1, make_batch(s, ["a", "b"], [10, 20], [1.0, 2.0]))
+        scan = engine.scan(1, tag_predicates={"hostname": {"a"}})
+        assert scan.num_rows == 1
+
+    def test_sql_query_with_unmatched_tag(self, tmp_path):
+        from greptimedb_tpu.catalog import Catalog, MemoryKv
+        from greptimedb_tpu.query import QueryEngine
+
+        eng = RegionEngine(EngineConfig(data_dir=str(tmp_path / "q")))
+        qe = QueryEngine(Catalog(MemoryKv()), eng)
+        qe.execute_one(
+            "CREATE TABLE t (h STRING, v DOUBLE, ts TIMESTAMP(3) "
+            "TIME INDEX, PRIMARY KEY(h))")
+        qe.execute_one("INSERT INTO t VALUES ('a', 1.0, 1000)")
+        r = qe.execute_one(
+            "SELECT date_bin(INTERVAL '5 minutes', ts) b, avg(v) "
+            "FROM t WHERE h = 'nope' GROUP BY b")
+        assert r.num_rows == 0
+        eng.close()
