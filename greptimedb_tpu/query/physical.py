@@ -49,6 +49,11 @@ from greptimedb_tpu.storage.engine import RegionEngine
 from greptimedb_tpu.storage.region import ScanData
 
 # primitive kernel ops backing each SQL aggregate
+# boundary first/last gather only pays when it shrinks the scan: above
+# this candidate fraction the subset would roughly duplicate the cached
+# columns for no kernel savings (tests patch this to force the path on)
+_BOUNDARY_MAX_FRACTION = 0.5
+
 _PRIMITIVES = {
     "sum": ("sum", "count"),  # count detects all-NULL groups -> NULL sum
     "count": ("count",),
@@ -386,32 +391,51 @@ def _build_prep(scan, arg_names, start, end, out_rows, acc_dtype, has_nan,
     f = len(arg_names)
     m = end - start
     np_acc = np.dtype(str(acc_dtype))
+    # layout note: writes go through a feature-major [F, m] staging
+    # buffer and ONE transpose-assign into the [rows, width] plane.
+    # Column-at-a-time writes (plane[:m, j] = src) touch every 64B cache
+    # line of the plane once per field — a read-modify-write of the
+    # whole plane F times over; the transpose-assign streams the
+    # destination sequentially while reading F sequential sources, so
+    # the build runs at copy bandwidth (first-query warm-up was
+    # dominated by exactly this at TSBS scale).
+    def staged():
+        src = np.empty((f, m), dtype=np.float64)
+        for j, name in enumerate(arg_names):
+            src[j] = scan.columns[name][start:end]
+        return src
+
     if kind is None:
         width = (2 * f + 1) if has_nan else (f + 1)
-        plane = np.zeros((out_rows, width), dtype=np_acc)
-        for j, name in enumerate(arg_names):
-            src = np.asarray(scan.columns[name][start:end],
-                             dtype=np.float64)
-            if has_nan:
-                nan = np.isnan(src)
-                plane[:m, j] = np.where(nan, 0.0, src)
-                plane[:m, f + j] = ~nan
-            else:
-                plane[:m, j] = src
+        plane = np.empty((out_rows, width), dtype=np_acc)
+        if out_rows > m:
+            plane[m:] = 0.0
+        src = staged()
+        if has_nan:
+            nan = np.isnan(src)
+            np.copyto(src, 0.0, where=nan)
+            plane[:m, :f] = src.T
+            plane[:m, f:2 * f] = (~nan).T
+        else:
+            plane[:m, :f] = src.T
         plane[:m, width - 1] = 1.0
         return plane
     if kind == "sq":
-        plane = np.zeros((out_rows, f), dtype=np.float64)
-        for j, name in enumerate(arg_names):
-            src = np.asarray(scan.columns[name][start:end],
-                             dtype=np.float64)
-            plane[:m, j] = np.where(np.isnan(src), 0.0, src * src)
+        plane = np.empty((out_rows, f), dtype=np.float64)
+        if out_rows > m:
+            plane[m:] = 0.0
+        src = staged()
+        np.multiply(src, src, out=src)
+        np.copyto(src, 0.0, where=np.isnan(src))
+        plane[:m] = src.T
         return plane
     fill = np.inf if kind == "min" else -np.inf
-    plane = np.full((out_rows, f), fill, dtype=np_acc)
-    for j, name in enumerate(arg_names):
-        src = np.asarray(scan.columns[name][start:end], dtype=np.float64)
-        plane[:m, j] = np.where(np.isnan(src), fill, src)
+    plane = np.empty((out_rows, f), dtype=np_acc)
+    if out_rows > m:
+        plane[m:] = fill
+    src = staged()
+    np.copyto(src, fill, where=np.isnan(src))
+    plane[:m] = src.T
     return plane
 
 
@@ -1070,9 +1094,15 @@ class PhysicalExecutor:
                 ops.update(_PRIMITIVES[spec.func])
         need_ts = bool({"first", "last"} & ops)
 
+        reduced = self._boundary_firstlast(scan, table, agg, bound_where,
+                                           keys, extra_cols)
+        if reduced is not None:
+            scan = reduced
         acc, sparse_gids = self._stream_agg(
             scan, table, bound_where, tuple(keys), tuple(arg_exprs),
             tuple(sorted(ops)), num_groups, ts_name, ctx, extra_cols, sparse)
+        if reduced is not None:
+            self.last_path = "boundary+" + (self.last_path or "")
         host_info = (scan, extra_cols, bound_where, ctx, num_groups)
         return self._agg_tail(acc, sparse_gids, agg, keys, decoders,
                               spec_slot, host_info, having, project, sort,
@@ -1121,6 +1151,93 @@ class PhysicalExecutor:
 
         return self._post_process(env, agg, having, project, sort, limit, offset,
                                   table, len(present))
+
+    def _boundary_firstlast(self, scan, table, agg, bound_where, keys,
+                            extra_cols) -> Optional[ScanData]:
+        """Lastpoint-class fast path: when every aggregate is first/last
+        (by time index) and grouping is by tag columns only, the winners
+        can only sit at per-series run boundaries of the (tags..., ts,
+        seq)-sorted SST segments — gather those few rows on host and run
+        the normal kernel over the tiny subset instead of reducing the
+        whole scan (reference reads the same order per file,
+        mito2/src/read/merge.rs; TSBS `lastpoint` is the headline user).
+
+        Correctness sketch (LWW): within one sorted segment the last row
+        of a series' run carries its max ts and, among duplicates of that
+        ts, the max seq; the global max-seq version of the max-ts instant
+        lives in SOME segment where it is that segment's boundary row, so
+        the candidate set always contains the LWW winner and the subset
+        dedup selects it. Mirrored for `first` via the end of the first
+        (tags, ts) sub-run. Memtable rows are unsorted and are included
+        wholesale. DELETE tombstones void the argument (the newest row
+        may be a tombstone, making an interior row the answer) — any
+        tombstone in the scan disables the path."""
+        offsets = scan.sorted_part_offsets
+        if len(offsets) < 2 or offsets[-1] == 0:
+            return None
+        if bound_where is not None or extra_cols:
+            return None
+        if not agg.aggs or any(
+                spec.func not in ("first", "last")
+                or _needs_host_agg(spec, table.schema)
+                for spec in agg.aggs):
+            return None
+        if not all(k.kind == "tag" for k in keys):
+            return None
+        cached = getattr(scan, "_boundary_fl_cache", None)
+        if cached is not None:
+            return cached if cached is not False else None
+        has_delete = getattr(scan, "_has_delete", None)
+        if has_delete is None:
+            from greptimedb_tpu.storage.region import OP_PUT
+
+            has_delete = bool((scan.op_type != OP_PUT).any())
+            scan._has_delete = has_delete
+        if has_delete:
+            scan._boundary_fl_cache = False
+            return None
+
+        n = scan.num_rows
+        send = offsets[-1]  # end of the sorted region
+        # row i starts a new series run when any tag code differs from
+        # row i-1, or i is a segment seam (sortedness restarts there)
+        new_run = np.zeros(send, dtype=bool)
+        new_run[0] = True
+        for c in table.schema.tag_columns:
+            col = scan.columns[c.name]
+            new_run[1:] |= col[1:send] != col[: send - 1]
+        seams = np.asarray(offsets[1:-1], dtype=np.int64)
+        new_run[seams[seams < send]] = True
+        ts = scan.columns[table.schema.time_index.name]
+        new_sub = new_run.copy()
+        new_sub[1:] |= ts[1:send] != ts[: send - 1]
+        run_start = np.flatnonzero(new_run)
+        run_end = np.append(run_start[1:] - 1, send - 1)
+        # ends of (tags, ts) sub-runs: max-seq row of each instant
+        sub_end = np.flatnonzero(np.append(new_sub[1:], True))
+        # `first` winner candidate: end of the FIRST sub-run in each run
+        first_end = sub_end[np.searchsorted(sub_end, run_start)]
+        parts = [run_start, run_end, first_end]
+        if send < n:
+            parts.append(np.arange(send, n))
+        idx = np.unique(np.concatenate(parts))
+        if idx.size >= n * _BOUNDARY_MAX_FRACTION:
+            scan._boundary_fl_cache = False
+            return None
+        reduced = ScanData(
+            schema=scan.schema,
+            columns={k: v[idx] for k, v in scan.columns.items()},
+            seq=scan.seq[idx],
+            op_type=scan.op_type[idx],
+            tag_dicts=scan.tag_dicts,
+            num_rows=idx.size,
+            needs_dedup=scan.needs_dedup,
+            region_id=scan.region_id,
+            data_version=scan.data_version,
+            scan_fingerprint=scan.scan_fingerprint + ("__boundary_fl__",),
+        )
+        scan._boundary_fl_cache = reduced
+        return reduced
 
     def _execute_agg_stream(self, stream, table, where, agg, having, project,
                             sort, limit, offset, scan_node) -> QueryResult:
