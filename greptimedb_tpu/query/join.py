@@ -134,7 +134,14 @@ def execute_join_select(qe, sel: ast.Select, ctx) -> QueryResult:
 
     from greptimedb_tpu.query.window import rewrite_select, select_has_window
     if select_has_window(sel):
-        sel = rewrite_select(sel, env_cols, n, resolve)
+        if _has_grouping_aggs(sel):
+            # SQL evaluation order: group first, windows over the groups
+            inner, outer = split_groupby_window(sel)
+            r = _aggregate(inner, env_cols, joined_dtypes, n, resolve)
+            return execute_select_over(
+                qe, outer, dict(zip(r.names, r.columns)),
+                dict(zip(r.names, r.dtypes)))
+        sel = rewrite_select(sel, env_cols, n, resolve, joined_dtypes)
 
     has_agg = sel.group_by or any(
         _contains_agg(it.expr) for it in sel.items)
@@ -194,7 +201,13 @@ def execute_select_over(qe, sel: ast.Select, base_cols: dict,
 
     from greptimedb_tpu.query.window import rewrite_select, select_has_window
     if select_has_window(sel):
-        sel = rewrite_select(sel, env, n, resolve)
+        if _has_grouping_aggs(sel):
+            inner, outer = split_groupby_window(sel)
+            r = _aggregate(inner, env, dtypes, n, resolve)
+            return execute_select_over(
+                qe, outer, dict(zip(r.names, r.columns)),
+                dict(zip(r.names, r.dtypes)))
+        sel = rewrite_select(sel, env, n, resolve, dtypes)
 
     if sel.group_by or any(_contains_agg(it.expr) for it in sel.items):
         return _aggregate(sel, env, dtypes, n, resolve)
@@ -458,6 +471,152 @@ def _hash_join(lcols, ldtypes, rcols, rdtypes, pairs, kind: str):
     out.update(take(rcols, ri))
     dtypes = {**ldtypes, **rdtypes}
     return out, dtypes
+
+
+def _has_grouping_aggs(sel: ast.Select) -> bool:
+    """True when the SELECT needs an aggregation pass before windows:
+    GROUP BY, or any non-window aggregate call — INCLUDING one appearing
+    only inside an OVER clause (e.g. rank() OVER (ORDER BY avg(v)):
+    valid SQL, one implicit group)."""
+    if sel.group_by:
+        return True
+    from greptimedb_tpu.query.planner import _FUNC_CANON
+
+    found = [False]
+
+    def walk(e):
+        if found[0]:
+            return
+        if isinstance(e, ast.FuncCall):
+            if e.over is None and e.name.lower() in _FUNC_CANON:
+                found[0] = True
+                return
+            for a in e.args:
+                walk(a)
+            if e.over is not None:
+                walk(e.over.partition_by)
+                for o, _ in e.over.order_by:
+                    walk(o)
+            return
+        if isinstance(e, (list, tuple)):
+            for x in e:
+                walk(x)
+        elif dataclasses.is_dataclass(e) and not isinstance(e, type):
+            for f in dataclasses.fields(e):
+                v = getattr(e, f.name)
+                if isinstance(v, (ast.Expr, list, tuple)):
+                    walk(v)
+
+    for it in sel.items:
+        walk(it.expr)
+    for ob in sel.order_by:
+        walk(ob.expr)
+    return found[0]
+
+
+def split_groupby_window(sel: ast.Select):
+    """SELECT mixing GROUP BY (or plain aggregates) with window
+    functions: SQL evaluates windows AFTER grouping, over the grouped
+    relation (reference: DataFusion plans WindowAggExec above
+    AggregateExec). Returns (inner, outer): `inner` is the window-free
+    aggregate — group keys under their display names, each distinct
+    aggregate call as __ga_i — and `outer` re-expresses the original
+    items over inner's output with the window calls intact. The caller
+    runs inner through the normal (device) aggregate path, then the
+    window machinery over its G-row result."""
+    from greptimedb_tpu.query.planner import _FUNC_CANON
+
+    aggs: list[ast.FuncCall] = []
+
+    def collect(e):
+        if isinstance(e, ast.FuncCall):
+            if e.over is None and e.name.lower() in _FUNC_CANON:
+                if e not in aggs:
+                    aggs.append(e)
+                return
+            for a in e.args:
+                collect(a)
+            if e.over is not None:
+                collect(e.over.partition_by)
+                for o, _ in e.over.order_by:
+                    collect(o)
+            return
+        if isinstance(e, (list, tuple)):
+            for x in e:
+                collect(x)
+        elif dataclasses.is_dataclass(e) and not isinstance(e, type):
+            for f in dataclasses.fields(e):
+                v = getattr(e, f.name)
+                if isinstance(v, (ast.Expr, list, tuple)):
+                    collect(v)
+
+    for it in sel.items:
+        collect(it.expr)
+    for ob in sel.order_by:
+        collect(ob.expr)
+
+    repl: list[tuple] = []
+    inner_items: list[ast.SelectItem] = []
+    alias_to_expr = {it.alias: it.expr for it in sel.items if it.alias}
+    for i, k in enumerate(sel.group_by):
+        if isinstance(k, ast.Column) and k.name in alias_to_expr:
+            # GROUP BY <item alias>: group by the aliased expression and
+            # surface it under the user's alias
+            expr = alias_to_expr[k.name]
+            inner_items.append(ast.SelectItem(expr, alias=k.name))
+            repl.append((expr, ast.Column(k.name)))
+            continue
+        if isinstance(k, ast.Column):
+            inner_items.append(ast.SelectItem(k))
+            repl.append((k, ast.Column(k.name)))
+        else:
+            nm = next((it.alias for it in sel.items
+                       if it.alias and it.expr == k), None) or f"__gk_{i}"
+            inner_items.append(ast.SelectItem(k, alias=nm))
+            repl.append((k, ast.Column(nm)))
+    for i, a in enumerate(aggs):
+        nm = f"__ga_{i}"
+        inner_items.append(ast.SelectItem(a, alias=nm))
+        repl.append((a, ast.Column(nm)))
+
+    def replace(e):
+        for orig, col in repl:
+            if e == orig:
+                return col
+        if isinstance(e, (list, tuple)):
+            return type(e)(replace(x) for x in e)
+        if dataclasses.is_dataclass(e) and not isinstance(e, type) \
+                and isinstance(e, (ast.Expr, ast.WindowSpec)):
+            changes = {}
+            for f in dataclasses.fields(e):
+                v = getattr(e, f.name)
+                if isinstance(v, (ast.Expr, ast.WindowSpec, list, tuple)):
+                    nv = replace(v)
+                    if nv != v:
+                        changes[f.name] = nv
+            if changes:
+                return dataclasses.replace(e, **changes)
+        return e
+
+    out_items = []
+    for it in sel.items:
+        ne = replace(it.expr)
+        alias = it.alias
+        if alias is None and ne != it.expr:
+            # keep the user-visible column header (e.g. "avg(v)") when
+            # the expression collapsed to an internal alias
+            alias = _expr_name(it.expr)
+        out_items.append(dataclasses.replace(it, expr=ne, alias=alias))
+    out_order = [dataclasses.replace(ob, expr=replace(ob.expr))
+                 for ob in sel.order_by]
+    inner = dataclasses.replace(
+        sel, items=inner_items, order_by=[], limit=None, offset=None,
+        distinct=False)
+    outer = dataclasses.replace(
+        sel, items=out_items, table=None, table_alias=None, joins=[],
+        where=None, group_by=[], having=None, order_by=out_order,
+        ctes=[], from_subquery=None)
+    return inner, outer
 
 
 def _contains_agg(e) -> bool:

@@ -12,28 +12,30 @@ Semantics implemented:
 - navigation: lag(x[,k[,default]]), lead, first_value, last_value,
   nth_value(x, k)
 - aggregates over the window: count, sum, avg/mean, min, max
-- frames: the two SQL defaults — whole-partition when there is no ORDER
-  BY, running-to-current-row (RANGE, peer-sharing) when there is — plus
-  an explicit `... BETWEEN UNBOUNDED PRECEDING AND UNBOUNDED FOLLOWING`
-  (treated as whole-partition) and `ROWS` (strict per-row running).
+- frames: the SQL defaults — whole-partition when there is no ORDER BY,
+  running-to-current-row (RANGE, peer-sharing) when there is — plus
+  explicit `ROWS|RANGE` frames with `UNBOUNDED PRECEDING`, `k PRECEDING`
+  (numeric, or an INTERVAL for RANGE over a timestamp order key),
+  `CURRENT ROW` and `UNBOUNDED FOLLOWING` bounds. Sliding aggregates run
+  as cumulative-sum differences; sliding min/max as a vectorized sparse
+  table — no per-row Python, so moving averages over a million rows stay
+  array-speed (reference gets the same frames from DataFusion's
+  WindowAggExec).
+- windows over GROUP BY output in the same SELECT (SQL evaluation
+  order: aggregate first, windows over the grouped relation) — see
+  split_groupby_window.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import re
 from typing import Optional
 
 import numpy as np
 
 from greptimedb_tpu.query.expr import PlanError, eval_host
 from greptimedb_tpu.sql import ast
-
-_SUPPORTED_FRAMES = {
-    f"{u} {b}" for u in ("rows", "range")
-    for b in ("unbounded preceding",
-              "between unbounded preceding and current row",
-              "between unbounded preceding and unbounded following")
-}
 
 _RANKING = {"row_number", "rank", "dense_rank", "ntile"}
 _NAV = {"lag", "lead", "first_value", "last_value", "nth_value"}
@@ -61,15 +63,26 @@ def select_has_window(sel: ast.Select) -> bool:
             or any(contains_window(ob.expr) for ob in sel.order_by))
 
 
-def rewrite_select(sel: ast.Select, cols: dict, n: int, resolve):
+def rewrite_select(sel: ast.Select, cols: dict, n: int, resolve,
+                   dtypes: Optional[dict] = None):
     """Compute every window call in `sel` over `cols` (mutated: one
     `__win_i` array per distinct call is added) and return a copy of
     `sel` with those calls replaced by column references. The caller's
-    normal projection/order machinery then just reads the arrays."""
+    normal projection/order machinery then just reads the arrays.
+    `dtypes` (column name -> DataType) lets INTERVAL frame offsets
+    resolve against timestamp order keys. A SELECT that still carries
+    GROUP BY must go through split_groupby_window first."""
     if sel.group_by:
         raise PlanError(
             "window functions cannot be combined with GROUP BY in one "
             "SELECT; aggregate in a subquery or CTE first")
+
+    def dtype_of(e):
+        r = resolve(e)
+        if isinstance(r, ast.Column) and dtypes:
+            return dtypes.get(r.name)
+        return None
+
     calls: list[ast.FuncCall] = []
 
     def collect(e):
@@ -95,7 +108,7 @@ def rewrite_select(sel: ast.Select, cols: dict, n: int, resolve):
     mapping: list[tuple[ast.FuncCall, ast.Column]] = []
     for i, fc in enumerate(calls):
         name = f"__win_{i}"
-        cols[name] = _eval_window(fc, cols, n, resolve)
+        cols[name] = _eval_window(fc, cols, n, resolve, dtype_of)
         mapping.append((fc, ast.Column(name)))
 
     def replace(e):
@@ -119,8 +132,17 @@ def rewrite_select(sel: ast.Select, cols: dict, n: int, resolve):
                 return dataclasses.replace(e, **changes)
         return e
 
-    items = [dataclasses.replace(it, expr=replace(it.expr))
-             for it in sel.items]
+    from greptimedb_tpu.query.join import _expr_name
+
+    items = []
+    for it in sel.items:
+        ne = replace(it.expr)
+        alias = it.alias
+        if alias is None and ne != it.expr:
+            # keep the user-visible header when the window call collapsed
+            # to an internal __win_i column reference
+            alias = _expr_name(it.expr)
+        items.append(dataclasses.replace(it, expr=ne, alias=alias))
     order_by = [dataclasses.replace(ob, expr=replace(ob.expr))
                 for ob in sel.order_by]
     return dataclasses.replace(sel, items=items, order_by=order_by)
@@ -175,7 +197,8 @@ def _as_column(v, n: int) -> np.ndarray:
     return arr
 
 
-def _eval_window(fc: ast.FuncCall, cols: dict, n: int, resolve) -> np.ndarray:
+def _eval_window(fc: ast.FuncCall, cols: dict, n: int, resolve,
+                 dtype_of=None) -> np.ndarray:
     name = fc.name
     if name not in SUPPORTED:
         raise PlanError(f"unsupported window function {name!r}")
@@ -213,24 +236,153 @@ def _eval_window(fc: ast.FuncCall, cols: dict, n: int, resolve) -> np.ndarray:
     rn = (np.arange(n) - seg_starts[seg_id] + 1) if n \
         else np.zeros(0, dtype=np.int64)
 
-    frame = " ".join((spec.frame or "").split())
-    if frame and frame not in _SUPPORTED_FRAMES:
-        # executing an unsupported frame as a different one would return
-        # silently wrong numbers (e.g. a moving average as a running sum)
-        raise PlanError(
-            f"unsupported window frame {spec.frame!r}; supported: "
-            "default, [ROWS|RANGE] UNBOUNDED PRECEDING, and "
-            "[ROWS|RANGE] BETWEEN UNBOUNDED PRECEDING AND "
-            "[CURRENT ROW|UNBOUNDED FOLLOWING]")
-    whole = (not spec.order_by) or "unbounded following" in frame
-    rows_frame = frame.startswith("rows")
+    unit, fstart, fend = _parse_frame(spec.frame, bool(spec.order_by))
+    seg_ends = np.append(seg_starts[1:] - 1, n - 1) if n else seg_starts
+    idx = np.arange(n)
+    # per-row frame bounds [st, en] (inclusive, sorted positions)
+    if fstart[0] == "unbounded":
+        st = seg_starts[seg_id] if n else idx
+    elif unit == "rows":
+        if isinstance(fstart[1], tuple):
+            raise PlanError("ROWS frames take a row count, not an INTERVAL")
+        st = np.maximum(seg_starts[seg_id], idx - int(fstart[1]))
+    else:
+        st = _range_frame_starts(spec, fstart[1], ev, order, seg_starts,
+                                 seg_id, n, dtype_of)
+    if fend[0] == "unbounded":
+        en = seg_ends[seg_id] if n else idx
+    elif unit == "rows":
+        en = idx
+    else:
+        # RANGE ... CURRENT ROW includes the current row's peers
+        en = run_ends[run_id] if n else idx
 
-    out_s = _compute(fc, name, ev, order, n, pid_s, new_seg, seg_id,
-                     run_id, seg_starts, run_starts, run_ends, rn,
-                     whole, rows_frame)
+    out_s = _compute(fc, name, ev, order, n, pid_s, seg_id, run_id,
+                     seg_starts, run_starts, seg_ends, rn, st, en)
     out = np.empty(n, dtype=out_s.dtype)
     out[order] = out_s
     return out
+
+
+_BOUND_RE = re.compile(r"^(.*?)\s+(preceding|following)$")
+
+
+def _parse_frame(frame: Optional[str], has_order: bool):
+    """Frame text -> (unit, start, end). unit "rows"|"range"; start
+    ("unbounded",) or ("preceding", k) with k a number or ("interval",
+    nanos); end ("current",) or ("unbounded",). No frame text means the
+    SQL defaults: whole partition without ORDER BY, RANGE UNBOUNDED
+    PRECEDING .. CURRENT ROW with it. Unsupported shapes raise — running
+    a moving average as a running sum would be silently wrong."""
+    if not frame:
+        return (("range", ("unbounded",), ("current",)) if has_order
+                else ("rows", ("unbounded",), ("unbounded",)))
+    text = " ".join(frame.split())
+    m = re.match(r"^(rows|range|groups)\s+(.*)$", text)
+    if not m:
+        raise PlanError(f"unsupported window frame {frame!r}")
+    unit, rest = m.group(1), m.group(2)
+    if unit == "groups":
+        raise PlanError("GROUPS window frames are not supported")
+    if rest.startswith("between "):
+        m2 = re.match(r"^between\s+(.*?)\s+and\s+(.*)$", rest)
+        if m2 is None:
+            raise PlanError(f"unsupported window frame {frame!r}")
+        b1, b2 = m2.group(1), m2.group(2)
+    else:
+        b1, b2 = rest, "current row"
+    start = _parse_bound(b1, frame, is_end=False)
+    end = _parse_bound(b2, frame, is_end=True)
+    if start[0] == "preceding" and not has_order:
+        raise PlanError(
+            "a window frame with an offset requires ORDER BY")
+    return unit, start, end
+
+
+def _parse_bound(s: str, frame: str, is_end: bool):
+    s = s.strip()
+    if s == "unbounded preceding" and not is_end:
+        return ("unbounded",)
+    if s == "current row" and is_end:
+        return ("current",)
+    if s == "unbounded following" and is_end:
+        return ("unbounded",)
+    if not is_end:
+        m = _BOUND_RE.match(s)
+        if m is not None and m.group(2) == "preceding":
+            val = m.group(1).strip()
+            im = re.match(r"^interval\s+'([^']*)'$", val)
+            if im is not None:
+                from greptimedb_tpu.sql.parser import Parser
+
+                iv = Parser(f"INTERVAL '{im.group(1)}'").parse_expr()
+                return ("preceding", ("interval", iv.nanos))
+            try:
+                return ("preceding", float(val))
+            except ValueError:
+                pass
+    raise PlanError(
+        f"unsupported window frame bound {s!r} in {frame!r}; supported: "
+        "UNBOUNDED PRECEDING / <n> PRECEDING / INTERVAL '...' PRECEDING "
+        "starts and CURRENT ROW / UNBOUNDED FOLLOWING ends")
+
+
+def _range_frame_starts(spec, value, ev, order, seg_starts, seg_id, n,
+                        dtype_of):
+    """Window start indices for RANGE <delta> PRECEDING: first row of the
+    current segment whose order-key value >= current - delta. Order keys
+    are ascending within each sorted segment, so one global searchsorted
+    over a segment-shifted encoding answers every row at once."""
+    if len(spec.order_by) != 1:
+        raise PlanError(
+            "RANGE offset frames require exactly one ORDER BY key")
+    oexpr, asc = spec.order_by[0]
+    if isinstance(value, tuple):  # ("interval", nanos)
+        dt = dtype_of(oexpr) if dtype_of is not None else None
+        if dt is None or not getattr(dt, "is_timestamp", False):
+            raise PlanError(
+                "INTERVAL frame offsets need a timestamp ORDER BY key "
+                "of known type; use a numeric offset instead")
+        delta = float(value[1] // dt.time_unit.nanos_per_unit)
+    else:
+        delta = float(value)
+    if delta < 0:
+        raise PlanError("window frame offsets must be non-negative")
+    if n == 0:
+        return np.zeros(0, dtype=np.int64)
+    vals = np.asarray(ev(oexpr))
+    if vals.dtype == object or vals.dtype.kind not in "iuf":
+        raise PlanError("RANGE offset frames need a numeric or timestamp "
+                        "ORDER BY key")
+    # integer order keys (timestamps) stay in int64: a float64 detour
+    # loses sub-256ns resolution at epoch-ns magnitudes and the
+    # segment-shift encoding compounds it
+    exact = vals.dtype.kind in "iu" and float(delta).is_integer()
+    v = vals[order].astype(np.int64 if exact else np.float64)
+    if not exact and np.isnan(v).any():
+        raise PlanError("RANGE offset frames need a non-NULL ORDER BY key")
+    if not asc:
+        v = -v  # descending: preceding means larger values
+    # segment-shifted monotone encoding: strictly increasing across
+    # segment seams because the shift exceeds the global value span
+    nseg = int(seg_id[-1]) + 1
+    if exact:
+        d = int(delta)
+        # Python-int arithmetic: an int64 subtraction could itself wrap
+        span = (int(v.max()) - int(v.min())) if n else 0
+        shift = span + d + 1
+        if nseg * shift < (1 << 62):  # headroom against int64 overflow
+            base = v - int(v.min())
+            b = base + seg_id * shift
+            starts = np.searchsorted(b, b - d, side="left")
+            return np.maximum(starts, seg_starts[seg_id])
+        v = v.astype(np.float64)  # astronomically wide: approximate
+    delta = float(delta)
+    span = float(v.max() - v.min()) if n else 0.0
+    shift = span + delta + 1.0
+    b = v + seg_id.astype(np.float64) * shift
+    starts = np.searchsorted(b, b - delta, side="left")
+    return np.maximum(starts, seg_starts[seg_id])
 
 
 def _arg_values(fc, ev, order, n):
@@ -250,8 +402,38 @@ def _lit(e, default=None):
     raise PlanError("window offset/default arguments must be literals")
 
 
-def _compute(fc, name, ev, order, n, pid_s, new_seg, seg_id, run_id,
-             seg_starts, run_starts, run_ends, rn, whole, rows_frame):
+def _range_extreme(mv: np.ndarray, st: np.ndarray, en: np.ndarray, op):
+    """min/max over arbitrary inclusive index ranges [st, en] via a
+    sparse table: level j holds op over blocks of 2^j, a query combines
+    the two blocks covering the range — O(n log n) build, O(n) query,
+    all vectorized (the frame machinery's RMQ; no per-row Python)."""
+    n = len(mv)
+    if n == 0:
+        return mv
+    length = en - st + 1
+    max_level = max(int(np.max(length)).bit_length() - 1, 0)
+    tables = [mv]
+    for j in range(1, max_level + 1):
+        prev = tables[-1]
+        half = 1 << (j - 1)
+        m_len = len(prev) - half  # level j covers n - 2^j + 1 positions
+        tables.append(op(prev[:m_len], prev[half:half + m_len]))
+    j = np.maximum(
+        np.frexp(length.astype(np.float64))[1] - 1, 0).astype(np.int64)
+    out = np.empty(n, dtype=mv.dtype)
+    for lvl in range(max_level + 1):
+        rows = np.flatnonzero(j == lvl)
+        if rows.size == 0:
+            continue
+        t = tables[lvl]
+        a = st[rows]
+        b = en[rows] - (1 << lvl) + 1
+        out[rows] = op(t[a], t[b])
+    return out
+
+
+def _compute(fc, name, ev, order, n, pid_s, seg_id, run_id, seg_starts,
+             run_starts, seg_ends, rn, st, en):
     if name == "row_number":
         return rn.astype(np.int64)
     if name == "rank":
@@ -262,7 +444,6 @@ def _compute(fc, name, ev, order, n, pid_s, new_seg, seg_id, run_id,
         k = int(_lit(fc.args[0] if fc.args else None, 1))
         if k <= 0:
             raise PlanError("ntile() requires a positive bucket count")
-        seg_ends = np.append(seg_starts[1:] - 1, n - 1) if n else seg_starts
         seg_len = (seg_ends - seg_starts + 1)[seg_id]
         # SQL ntile: first (len % k) buckets get ceil(len/k) rows
         base, rem = seg_len // k, seg_len % k
@@ -282,96 +463,59 @@ def _compute(fc, name, ev, order, n, pid_s, new_seg, seg_id, run_id,
         default = _lit(fc.args[2] if len(fc.args) > 2 else None, None)
         if name == "lead":
             k = -k
-        out = np.empty(n, dtype=object)
         idx = np.arange(n) - k
         valid = (idx >= 0) & (idx < n)
         src = np.clip(idx, 0, max(n - 1, 0))
         valid &= pid_s[src] == pid_s  # stay within the partition
-        for i in range(n):
-            out[i] = vals[src[i]] if valid[i] else default
+        out = np.asarray(vals, dtype=object)[src]
+        out[~valid] = default
         return out
+    if n == 0:
+        return np.empty(0, dtype=object)
+    # frame-positional navigation: first/last/nth read directly at the
+    # frame bounds (with the default frames these reduce to the classic
+    # partition-start / running-end behaviors)
     if name == "first_value":
-        return np.asarray(vals, dtype=object)[seg_starts[seg_id]] if n \
-            else np.empty(0, dtype=object)
+        return np.asarray(vals, dtype=object)[st]
+    if name == "last_value":
+        return np.asarray(vals, dtype=object)[en]
     if name == "nth_value":
         k = int(_lit(fc.args[1] if len(fc.args) > 1 else None, 1))
         if k < 1:
             raise PlanError("nth_value() position must be >= 1")
-        pos = seg_starts[seg_id] + (k - 1)
-        seg_ends = np.append(seg_starts[1:] - 1, n - 1) if n else seg_starts
-        ok = pos <= seg_ends[seg_id]
-        out = np.empty(n, dtype=object)
-        for i in range(n):
-            out[i] = vals[pos[i]] if ok[i] else None
+        pos = st + (k - 1)
+        ok = pos <= en
+        out = np.asarray(vals, dtype=object)[np.minimum(pos, en)]
+        out[~ok] = None
         return out
-    if name == "last_value":
-        if n == 0:
-            return np.empty(0, dtype=object)
-        seg_ends = np.append(seg_starts[1:] - 1, n - 1)
-        if whole:
-            return np.asarray(vals, dtype=object)[seg_ends[seg_id]]
-        if rows_frame:
-            return np.asarray(vals, dtype=object)
-        return np.asarray(vals, dtype=object)[run_ends[run_id]]
 
-    # windowed aggregates
+    # windowed aggregates over [st, en]: cumulative-sum differences for
+    # sum/count/avg, sparse-table range queries for min/max
     if name == "count" and vals is None:
         fv = np.ones(n, dtype=np.float64)
         valid = np.ones(n, dtype=bool)
     else:
-        fv = np.asarray(
-            [np.nan if v is None or _is_nan(v) else float(v)
-             for v in vals], dtype=np.float64)
+        if vals.dtype == object:
+            fv = np.asarray(
+                [np.nan if v is None or _is_nan(v) else float(v)
+                 for v in vals], dtype=np.float64)
+        else:
+            fv = vals.astype(np.float64)
         valid = ~np.isnan(fv)
         fv = np.where(valid, fv, 0.0)
-    if whole:
-        nseg = len(seg_starts)
-        s = np.zeros(nseg)
-        cnt = np.zeros(nseg)
-        np.add.at(s, seg_id, fv)
-        np.add.at(cnt, seg_id, valid.astype(np.float64))
-        if name == "count":
-            return cnt[seg_id].astype(np.int64)
-        if name == "sum":
-            return np.where(cnt[seg_id] > 0, s[seg_id], np.nan)
-        if name in ("avg", "mean"):
-            return np.where(cnt[seg_id] > 0,
-                            s[seg_id] / np.maximum(cnt[seg_id], 1), np.nan)
-        # min / max per segment
-        init = np.inf if name == "min" else -np.inf
-        m = np.full(nseg, init)
-        mv = np.where(valid, fv, init)
-        (np.minimum if name == "min" else np.maximum).at(m, seg_id, mv)
-        return np.where(cnt[seg_id] > 0, m[seg_id], np.nan)
-    # running frame: cumulative within segment (peer-shared unless ROWS)
-    csum = np.cumsum(fv)
-    ccnt = np.cumsum(valid.astype(np.float64))
-    base_sum = np.where(seg_starts > 0, csum[seg_starts - 1], 0.0)
-    base_cnt = np.where(seg_starts > 0, ccnt[seg_starts - 1], 0.0)
-    run_sum = csum - base_sum[seg_id]
-    run_cnt = ccnt - base_cnt[seg_id]
     if name in ("min", "max"):
         op = np.minimum if name == "min" else np.maximum
         init = np.inf if name == "min" else -np.inf
         mv = np.where(valid, fv, init)
-        run_m = np.empty(n, dtype=np.float64)
-        for s0 in seg_starts:
-            e0 = n
-            nxt = np.searchsorted(seg_starts, s0 + 1)
-            if nxt < len(seg_starts):
-                e0 = seg_starts[nxt]
-            run_m[s0:e0] = op.accumulate(mv[s0:e0])
-        run_val = np.where(np.isfinite(run_m), run_m, np.nan)
-    elif name == "count":
-        run_val = run_cnt
-    elif name == "sum":
-        run_val = np.where(run_cnt > 0, run_sum, np.nan)
-    else:  # avg / mean
-        run_val = np.where(run_cnt > 0, run_sum / np.maximum(run_cnt, 1),
-                           np.nan)
-    if not rows_frame:
-        # RANGE default frame: peers share the value at the peer-run end
-        run_val = run_val[run_ends[run_id]]
+        m = _range_extreme(mv, st, en, op)
+        has = _range_extreme(valid.astype(np.float64), st, en, np.maximum)
+        return np.where(has > 0, m, np.nan)
+    csum = np.concatenate([[0.0], np.cumsum(fv)])
+    ccnt = np.concatenate([[0.0], np.cumsum(valid.astype(np.float64))])
+    wsum = csum[en + 1] - csum[st]
+    wcnt = ccnt[en + 1] - ccnt[st]
     if name == "count":
-        return run_val.astype(np.int64)
-    return run_val
+        return wcnt.astype(np.int64)
+    if name == "sum":
+        return np.where(wcnt > 0, wsum, np.nan)
+    return np.where(wcnt > 0, wsum / np.maximum(wcnt, 1), np.nan)

@@ -166,16 +166,146 @@ copr = coprocessor
 
 
 class ScriptEngine:
-    """Compile, persist, and run scripts against the query engine."""
+    """Compile, persist, and run scripts against the query engine.
+
+    Sandboxed (default): scripts execute in a separate worker PROCESS
+    (script/worker.py) — the address-space boundary the reference gets
+    from its embedded RustPython VM. A CPython introspection escape
+    lands in the worker, which holds no engine state; a timeout kills
+    the worker outright, so no runaway loop survives. The worker stays
+    warm between runs and is respawned after a kill. `query(...)` calls
+    from scripts are serviced by the parent over the pipe. Sandbox off
+    (GREPTIMEDB_TPU_SCRIPT_SANDBOX=off): scripts run in-process with
+    full power (direct accelerator access)."""
 
     def __init__(self, query_engine):
         self.qe = query_engine
         self.kv = query_engine.catalog.kv
+        self._worker = None  # (Process, Connection)
+        self._worker_lock = threading.Lock()
+
+    # ---- sandbox worker lifecycle ------------------------------------------
+
+    def _ensure_worker(self):
+        if self._worker is not None and self._worker[0].poll() is None:
+            return self._worker
+        # an explicit subprocess (`python -m greptimedb_tpu.script.worker`)
+        # rather than multiprocessing spawn: spawn re-imports the parent's
+        # __main__, which re-runs CLI entrypoints and breaks entirely for
+        # stdin-launched servers; a fork would inherit the initialized
+        # jax/XLA runtime whose threads don't survive forking. The worker
+        # dials back over an authenticated unix socket.
+        import subprocess
+        import sys
+        import tempfile
+        import uuid
+        from multiprocessing.connection import Listener
+
+        addr = os.path.join(tempfile.gettempdir(),
+                            f"gtpu_script_{os.getpid()}_{uuid.uuid4().hex}")
+        authkey = os.urandom(16)
+        listener = Listener(addr, family="AF_UNIX", authkey=authkey)
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        env = dict(os.environ,
+                   GTPU_SCRIPT_AUTHKEY=authkey.hex(),
+                   JAX_PLATFORMS="cpu",
+                   PYTHONPATH=os.pathsep.join(
+                       p for p in (repo_root,
+                                   os.environ.get("PYTHONPATH")) if p))
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "greptimedb_tpu.script.worker", addr,
+             str(_script_timeout_s())],
+            env=env)
+        try:
+            listener._listener._socket.settimeout(60)
+            conn = listener.accept()
+        except Exception as e:
+            proc.kill()
+            raise ScriptError(
+                f"script worker failed to start: {e}") from e
+        finally:
+            listener.close()
+            try:
+                os.unlink(addr)
+            except OSError:
+                pass
+        self._worker = (proc, conn)
+        return self._worker
+
+    def _kill_worker(self):
+        if self._worker is None:
+            return
+        proc, conn = self._worker
+        self._worker = None
+        try:
+            conn.close()
+        except OSError:
+            pass
+        proc.kill()
+        try:
+            proc.wait(5)
+        except Exception:  # noqa: BLE001 — best-effort reap
+            pass
+
+    def close(self):
+        self._kill_worker()
+
+    def _rpc(self, msg, db: str):
+        """One request to the sandbox worker under the wall-clock cap,
+        servicing `query` callbacks; kills the worker on timeout (the
+        post-timeout CPU-burn fix — a dead process cannot spin)."""
+        import time as _time
+
+        from greptimedb_tpu.session import Channel, QueryContext
+
+        timeout_s = _script_timeout_s()
+        with self._worker_lock:
+            proc, conn = self._ensure_worker()
+            deadline = _time.monotonic() + timeout_s
+            try:
+                conn.send(msg)
+            except (OSError, ValueError) as e:
+                self._kill_worker()
+                raise ScriptError(f"script worker unavailable: {e}") from e
+            while True:
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    self._kill_worker()
+                    raise ScriptTimeout(
+                        f"script exceeded the {timeout_s:.0f}s "
+                        "wall-clock limit")
+                if proc.poll() is not None:
+                    self._kill_worker()
+                    raise ScriptError("script worker died")
+                if not conn.poll(min(remaining, 0.05)):
+                    continue
+                try:
+                    resp = conn.recv()
+                except (EOFError, OSError):
+                    self._kill_worker()
+                    raise ScriptError("script worker died")
+                if resp[0] == "query":
+                    ctx = QueryContext(db=db, channel=Channel.HTTP)
+                    try:
+                        r = self.qe.execute_one(resp[1], ctx)
+                        conn.send(("cols", dict(zip(r.names, r.columns))))
+                    except Exception as e:  # noqa: BLE001 — reported into the script
+                        conn.send(("err", str(e)))
+                    continue
+                return resp
 
     # ---- persistence (reference scripts table, manager.rs) -----------------
 
     def insert_script(self, db: str, name: str, code: str) -> None:
-        self._compile(code)  # validate before persisting
+        # validate before persisting — in the sandbox worker, because
+        # validation EXECUTES the script's top level
+        if _sandbox_enabled():
+            resp = self._rpc(("validate", code), db)
+            if resp[0] == "err":
+                raise ScriptError(f"script failed to compile/run: {resp[1]}")
+        else:
+            self._compile(code)
         self.kv.put(f"{SCRIPT_PREFIX}{db}/{name}", json.dumps({"code": code}))
 
     def get_script(self, db: str, name: str) -> Optional[str]:
@@ -200,6 +330,12 @@ class ScriptEngine:
 
     def execute(self, code: str, db: str = "public",
                 params: Optional[dict] = None) -> QueryResult:
+        if _sandbox_enabled():
+            resp = self._rpc(("run", code, params), db)
+            if resp[0] == "err":
+                raise ScriptError(f"script failed: {resp[1]}")
+            _, out, returns = resp
+            return self._wrap(out, returns)
         copr_meta = self._compile(code)
         from greptimedb_tpu.session import Channel, QueryContext
 
@@ -221,16 +357,12 @@ class ScriptEngine:
                     raise ScriptError(f"missing param {a!r}")
                 arg_values.append(params[a])
         try:
-            if _sandbox_enabled():
-                out = _run_limited(lambda: copr_meta.fn(*arg_values),
-                                   _script_timeout_s())
-            else:
-                out = copr_meta.fn(*arg_values)
+            out = copr_meta.fn(*arg_values)
         except ScriptError:
             raise
         except Exception as e:  # noqa: BLE001 — user code boundary
             raise ScriptError(f"script failed: {e}") from e
-        return self._wrap(out, copr_meta)
+        return self._wrap(out, copr_meta.returns)
 
     def _compile(self, code: str) -> Coprocessor:
         import jax
@@ -272,7 +404,7 @@ class ScriptEngine:
         result = self.qe.execute_one(sql)
         return dict(zip(result.names, result.columns))
 
-    def _wrap(self, out, meta: Coprocessor) -> QueryResult:
+    def _wrap(self, out, returns) -> QueryResult:
         if isinstance(out, QueryResult):
             return out
         if not isinstance(out, tuple):
@@ -286,7 +418,7 @@ class ScriptEngine:
             cols.append(arr)
             n = max(n or 0, len(arr))
         cols = [np.resize(c, n) if len(c) != n else c for c in cols]
-        names = meta.returns or [f"col{i}" for i in range(len(cols))]
+        names = returns or [f"col{i}" for i in range(len(cols))]
         if len(names) != len(cols):
             raise ScriptError(
                 f"script returned {len(cols)} columns, "

@@ -55,7 +55,14 @@ class HttpServer:
             if self.timeout_s:
                 timeout = self.timeout_s
 
-        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        class Server(ThreadingHTTPServer):
+            # default backlog (5) resets connections under benchmark-level
+            # concurrency (50 clients connecting at once); daemon threads
+            # so a hung handler can't block process exit
+            request_queue_size = 128
+            daemon_threads = True
+
+        self._httpd = Server((self.host, self.port), Handler)
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True)

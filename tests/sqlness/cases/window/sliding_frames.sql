@@ -1,0 +1,33 @@
+-- sliding window frames: ROWS/RANGE k PRECEDING (moving aggregates),
+-- INTERVAL offsets over the time index, frame-positional navigation
+CREATE TABLE sf (host STRING, v DOUBLE, ts TIMESTAMP(3) TIME INDEX, PRIMARY KEY(host));
+
+INSERT INTO sf VALUES ('a', 1.0, 1000), ('a', 2.0, 2000), ('a', 3.0, 3000), ('a', 4.0, 4000), ('b', 10.0, 1000), ('b', 20.0, 3000), ('b', 30.0, 6000);
+
+-- moving average over the last 3 rows per host
+SELECT host, ts, avg(v) OVER (PARTITION BY host ORDER BY ts ROWS BETWEEN 2 PRECEDING AND CURRENT ROW) AS ma FROM sf ORDER BY host, ts;
+
+-- moving sum over a 2-second value window (RANGE, numeric offset in ms)
+SELECT host, ts, sum(v) OVER (PARTITION BY host ORDER BY ts RANGE BETWEEN 2000 PRECEDING AND CURRENT ROW) AS s2 FROM sf ORDER BY host, ts;
+
+-- same window via INTERVAL against the timestamp order key
+SELECT host, ts, sum(v) OVER (PARTITION BY host ORDER BY ts RANGE BETWEEN INTERVAL '2 seconds' PRECEDING AND CURRENT ROW) AS s2 FROM sf ORDER BY host, ts;
+
+-- sliding min/max (sparse-table range queries)
+SELECT ts, min(v) OVER (ORDER BY ts ROWS BETWEEN 1 PRECEDING AND CURRENT ROW) AS mn, max(v) OVER (ORDER BY ts ROWS BETWEEN 1 PRECEDING AND CURRENT ROW) AS mx FROM sf WHERE host = 'a' ORDER BY ts;
+
+-- navigation reads the frame bounds
+SELECT ts, first_value(v) OVER (ORDER BY ts ROWS BETWEEN 1 PRECEDING AND CURRENT ROW) AS fv FROM sf WHERE host = 'b' ORDER BY ts;
+
+-- windows over GROUP BY output: rank hosts by grouped average
+SELECT host, avg(v) AS a, rank() OVER (ORDER BY avg(v) DESC) AS rk FROM sf GROUP BY host ORDER BY host;
+
+-- moving average over grouped time buckets
+SELECT date_bin(INTERVAL '2 seconds', ts) AS b, avg(v) AS a, avg(avg(v)) OVER (ORDER BY date_bin(INTERVAL '2 seconds', ts) ROWS BETWEEN 1 PRECEDING AND CURRENT ROW) AS ma FROM sf GROUP BY b ORDER BY b;
+
+-- unsupported shapes error instead of silently degrading
+SELECT sum(v) OVER (ORDER BY ts ROWS BETWEEN 1 PRECEDING AND 1 FOLLOWING) FROM sf;
+
+SELECT sum(v) OVER (ORDER BY ts GROUPS BETWEEN 1 PRECEDING AND CURRENT ROW) FROM sf;
+
+DROP TABLE sf;

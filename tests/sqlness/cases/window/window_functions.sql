@@ -27,7 +27,7 @@ INSERT INTO s VALUES (10.0, 1), (10.0, 2), (20.0, 3);
 
 SELECT v, rank() OVER (ORDER BY v) AS rk, dense_rank() OVER (ORDER BY v) AS dr FROM s ORDER BY ts;
 
--- window + GROUP BY in one select is rejected
+-- window over GROUP BY output (SQL evaluation order)
 SELECT host, row_number() OVER (ORDER BY host) FROM cpu GROUP BY host;
 
 DROP TABLE s;

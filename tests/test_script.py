@@ -230,3 +230,80 @@ class TestSandbox:
                 "            i += 1\n"
                 "        except Exception:\n"
                 "            pass\n")
+
+
+class TestProcessIsolation:
+    """The sandbox is a separate OS process (script/worker.py), the
+    address-space boundary the reference gets from its embedded
+    RustPython VM. These prove the two escapes the in-process sandbox
+    could not stop: CPython attribute-walk introspection, and
+    post-timeout CPU burn."""
+
+    def test_attribute_walk_cannot_touch_server_process(self, se):
+        # the classic curated-builtins escape: walk object.__subclasses__
+        # to reach os and mutate process state. Inside the worker it can
+        # only mutate the WORKER's environment — the server process (this
+        # test) must be unaffected.
+        import os
+
+        marker = "GTPU_PWNED_MARKER"
+        assert marker not in os.environ
+        script = '''
+@coprocessor(returns=["x"])
+def pwn():
+    found = None
+    for c in ().__class__.__bases__[0].__subclasses__():
+        try:
+            g = c.__init__.__globals__
+            o = g["os"]
+            o.environ
+        except Exception:
+            continue
+        found = o
+        break
+    if found is not None:
+        found.environ["GTPU_PWNED_MARKER"] = "1"
+        return 1.0
+    return 0.0
+'''
+        r = se.execute(script)
+        # whether or not the walk found os INSIDE the worker, the server
+        # process environment must remain untouched
+        assert marker not in os.environ
+
+    def test_timeout_kills_worker_no_cpu_burn(self, se, monkeypatch):
+        import os
+
+        from greptimedb_tpu.script import ScriptTimeout
+
+        monkeypatch.setenv("GREPTIMEDB_TPU_SCRIPT_TIMEOUT_S", "2")
+        script = '''
+@coprocessor(returns=["x"])
+def spin():
+    while True:
+        pass
+'''
+        with pytest.raises(ScriptTimeout):
+            se.execute(script)
+        # the worker process must be DEAD, not an abandoned thread
+        assert se._worker is None
+        # and a fresh run works on a respawned worker
+        monkeypatch.setenv("GREPTIMEDB_TPU_SCRIPT_TIMEOUT_S", "30")
+        r = se.execute('''
+@coprocessor(returns=["x"])
+def ok():
+    return 7.0
+''')
+        assert r.rows() == [[7.0]]
+
+    def test_close_kills_worker(self, se):
+        se.execute('''
+@coprocessor(returns=["x"])
+def ok():
+    return 1.0
+''')
+        proc = se._worker[0]
+        assert proc.poll() is None
+        se.close()
+        proc.wait(5)
+        assert proc.poll() is not None

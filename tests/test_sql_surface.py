@@ -264,11 +264,24 @@ class TestWindowFunctions:
             "ORDER BY ts) rn FROM va WHERE host = 'b' ORDER BY rn")
         assert r.rows() == [["b", 1], ["b", 2]]
 
-    def test_window_with_group_by_rejected(self, db):
-        with pytest.raises(PlanError, match="GROUP BY"):
-            db.execute_one(
-                "SELECT host, row_number() OVER (ORDER BY host) FROM cpu "
-                "GROUP BY host")
+    def test_window_over_group_by_output(self, db):
+        # SQL evaluation order: windows run over the grouped relation
+        r = db.execute_one(
+            "SELECT host, row_number() OVER (ORDER BY host) FROM cpu "
+            "GROUP BY host ORDER BY host")
+        assert [tuple(row) for row in r.rows()] == [
+            ("a", 1), ("b", 2), ("c", 3)]
+
+    def test_window_ranks_grouped_aggregates(self, db):
+        r = db.execute_one(
+            "SELECT host, avg(usage) AS a, "
+            "rank() OVER (ORDER BY avg(usage) DESC) AS rk "
+            "FROM cpu GROUP BY host ORDER BY host")
+        rows = [tuple(row) for row in r.rows()]
+        assert [x[0] for x in rows] == ["a", "b", "c"]
+        assert sorted(x[2] for x in rows) == [1, 2, 3]
+        higher = max(rows, key=lambda x: x[1])
+        assert higher[2] == 1  # rank 1 = highest grouped average
 
     def test_ntile(self, db):
         r = db.execute_one(
@@ -291,13 +304,26 @@ class TestWindowFunctions:
             "WHERE cpu.host = 'a' ORDER BY cpu.ts")
         assert [row[1] for row in r.rows()] == [10.0, 30.0, 60.0]
 
+    def test_sliding_rows_frame(self, db):
+        r = db.execute_one(
+            "SELECT sum(usage) OVER (PARTITION BY host ORDER BY ts ROWS "
+            "BETWEEN 1 PRECEDING AND CURRENT ROW) AS s FROM cpu "
+            "WHERE host = 'a' ORDER BY ts")
+        vals = [row[0] for row in r.rows()]
+        # each value = current + previous within the partition
+        r2 = db.execute_one(
+            "SELECT usage FROM cpu WHERE host = 'a' ORDER BY ts")
+        u = [row[0] for row in r2.rows()]
+        expect = [u[0]] + [u[i - 1] + u[i] for i in range(1, len(u))]
+        assert vals == pytest.approx(expect)
+
     def test_unsupported_frame_rejected(self, db):
-        # executing a moving-window frame as a running frame would be
+        # executing an unimplemented frame as a different one would be
         # silently wrong — it must error instead
         with pytest.raises(PlanError, match="frame"):
             db.execute_one(
                 "SELECT sum(usage) OVER (ORDER BY ts ROWS BETWEEN 1 "
-                "PRECEDING AND CURRENT ROW) FROM cpu")
+                "PRECEDING AND 1 FOLLOWING) FROM cpu")
 
     def test_nth_value_bad_position(self, db):
         with pytest.raises(PlanError, match="nth_value"):

@@ -1,0 +1,174 @@
+"""Sliding window frames vs numpy oracles (randomized differential,
+the SURVEY.md §4 strategy). Covers ROWS/RANGE k PRECEDING frames,
+NULL handling, descending RANGE, frame-positional navigation, and the
+window-over-GROUP-BY split."""
+
+import numpy as np
+import pytest
+
+from greptimedb_tpu.catalog import Catalog, MemoryKv
+from greptimedb_tpu.query import QueryEngine
+from greptimedb_tpu.storage import RegionEngine
+from greptimedb_tpu.storage.engine import EngineConfig
+
+
+@pytest.fixture
+def db(tmp_path):
+    engine = RegionEngine(EngineConfig(data_dir=str(tmp_path / "data")))
+    qe = QueryEngine(Catalog(MemoryKv()), engine)
+    yield qe
+    engine.close()
+
+
+def _seed(db, n=400, hosts=7, null_every=11):
+    from greptimedb_tpu.datatypes import DictVector, RecordBatch
+
+    db.execute_one(
+        "CREATE TABLE w (host STRING, v DOUBLE, ts TIMESTAMP(3) NOT NULL, "
+        "TIME INDEX (ts), PRIMARY KEY (host)) WITH (append_mode='true')")
+    rng = np.random.default_rng(5)
+    info = db.catalog.table("public", "w")
+    codes = rng.integers(0, hosts, n).astype(np.int32)
+    v = rng.uniform(0, 100, n)
+    v[::null_every] = np.nan
+    # irregular, unique timestamps per host
+    ts = rng.permutation(n).astype(np.int64) * 137
+    names = np.asarray([f"h{i}" for i in range(hosts)], dtype=object)
+    db.region_engine.put(info.region_ids[0], RecordBatch(
+        info.schema, {"host": DictVector(codes, names), "v": v, "ts": ts}))
+    return codes, v, ts, names
+
+
+def _per_host(codes, v, ts, h):
+    sel = codes == h
+    order = np.argsort(ts[sel], kind="stable")
+    return v[sel][order], ts[sel][order]
+
+
+def _rows_window(vals, i, k):
+    return vals[max(0, i - k): i + 1]
+
+
+def _range_window(vals, tss, i, delta):
+    lo = tss[i] - delta
+    m = (tss >= lo) & (tss <= tss[i]) & (np.arange(len(tss)) <= i)
+    return vals[m]
+
+
+def _clean(w):
+    return w[~np.isnan(w)]
+
+
+@pytest.mark.parametrize("func,red", [
+    ("sum", np.sum), ("avg", np.mean), ("min", np.min), ("max", np.max),
+    ("count", len),
+])
+def test_rows_frame_oracle(db, func, red):
+    codes, v, ts, names = _seed(db)
+    k = 5
+    r = db.execute_one(
+        f"SELECT host, ts, {func}(v) OVER (PARTITION BY host ORDER BY ts "
+        f"ROWS BETWEEN {k} PRECEDING AND CURRENT ROW) AS x FROM w "
+        "ORDER BY host, ts")
+    rows = r.rows()
+    pos = 0
+    for h in range(len(names)):
+        vals, tss = _per_host(codes, v, ts, h)
+        for i in range(len(vals)):
+            host, t, got = rows[pos]
+            assert host == f"h{h}" and t == tss[i]
+            w = _clean(_rows_window(vals, i, k))
+            if func == "count":
+                assert got == len(w)
+            elif len(w) == 0:
+                assert got is None or (isinstance(got, float) and np.isnan(got))
+            else:
+                assert got == pytest.approx(float(red(w)), rel=1e-12)
+            pos += 1
+    assert pos == len(rows)
+
+
+def test_range_frame_oracle(db):
+    codes, v, ts, names = _seed(db)
+    delta = 137 * 40
+    r = db.execute_one(
+        f"SELECT host, ts, sum(v) OVER (PARTITION BY host ORDER BY ts "
+        f"RANGE BETWEEN {delta} PRECEDING AND CURRENT ROW) AS x FROM w "
+        "ORDER BY host, ts")
+    rows = r.rows()
+    pos = 0
+    for h in range(len(names)):
+        vals, tss = _per_host(codes, v, ts, h)
+        for i in range(len(vals)):
+            _, _, got = rows[pos]
+            w = _clean(_range_window(vals, tss, i, delta))
+            if len(w) == 0:
+                assert got is None or np.isnan(got)
+            else:
+                assert got == pytest.approx(float(np.sum(w)), rel=1e-12)
+            pos += 1
+
+
+def test_range_frame_descending(db):
+    codes, v, ts, names = _seed(db, n=100, hosts=2)
+    delta = 137 * 10
+    r = db.execute_one(
+        f"SELECT host, ts, count(v) OVER (PARTITION BY host ORDER BY ts "
+        f"DESC RANGE BETWEEN {delta} PRECEDING AND CURRENT ROW) AS c "
+        "FROM w ORDER BY host, ts DESC")
+    rows = r.rows()
+    pos = 0
+    for h in range(2):
+        vals, tss = _per_host(codes, v, ts, h)
+        vals, tss = vals[::-1], tss[::-1]  # descending order
+        for i in range(len(vals)):
+            _, _, got = rows[pos]
+            # descending: "preceding" = larger ts, window ts in
+            # [ts_i, ts_i + delta] among rows at or before i
+            m = (tss <= tss[i] + delta) & (tss >= tss[i]) \
+                & (np.arange(len(tss)) <= i)
+            assert got == len(_clean(vals[m]))
+            pos += 1
+
+
+def _eqv(got, want):
+    if want is None or (isinstance(want, float) and np.isnan(want)):
+        return got is None or (isinstance(got, float) and np.isnan(got))
+    return got == pytest.approx(want)
+
+
+def test_nav_frame_bounds(db):
+    codes, v, ts, names = _seed(db, n=60, hosts=3, null_every=7)
+    r = db.execute_one(
+        "SELECT host, ts, first_value(v) OVER (PARTITION BY host ORDER BY "
+        "ts ROWS BETWEEN 3 PRECEDING AND CURRENT ROW) AS fv, "
+        "nth_value(v, 2) OVER (PARTITION BY host ORDER BY ts ROWS "
+        "BETWEEN 3 PRECEDING AND CURRENT ROW) AS n2 FROM w "
+        "ORDER BY host, ts")
+    rows = r.rows()
+    pos = 0
+    for h in range(3):
+        vals, tss = _per_host(codes, v, ts, h)
+        for i in range(len(vals)):
+            _, _, fv, n2 = rows[pos]
+            w = _rows_window(vals, i, 3)
+            assert _eqv(fv, float(w[0]))
+            if len(w) >= 2:
+                assert _eqv(n2, float(w[1]))
+            else:
+                assert n2 is None
+            pos += 1
+
+
+def test_groupby_window_split_matches_subquery(db):
+    codes, v, ts, names = _seed(db)
+    one = db.execute_one(
+        "SELECT host, avg(v) AS a, rank() OVER (ORDER BY avg(v) DESC) rk "
+        "FROM w GROUP BY host ORDER BY host").rows()
+    two = db.execute_one(
+        "WITH g AS (SELECT host, avg(v) AS a FROM w GROUP BY host) "
+        "SELECT host, a, rank() OVER (ORDER BY a DESC) rk FROM g "
+        "ORDER BY host").rows()
+    assert [r[0] for r in one] == [r[0] for r in two]
+    assert [r[1] for r in one] == pytest.approx([r[1] for r in two])
+    assert [r[2] for r in one] == [r[2] for r in two]
