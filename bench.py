@@ -428,6 +428,39 @@ def bench_compaction(engine, qe, results):
         "vs_baseline": None}
 
 
+def bench_sql_insert(qe, results, rows_total=None, per_stmt=500):
+    """SQL INSERT path (parse -> bind -> region write incl. WAL), the
+    slower sibling of the bulk RecordBatch route the headline ingest
+    number uses — reported separately so both write paths are tracked."""
+    rows_total = rows_total or int(
+        os.environ.get("BENCH_SQL_INSERT_ROWS", "50000"))
+    rng = np.random.default_rng(11)
+    t_ms = T0_MS + 365 * 24 * 3600 * 1000  # far from the scan data
+    done = 0
+    t_start = time.perf_counter()
+    while done < rows_total:
+        n = min(per_stmt, rows_total - done)
+        vals = ", ".join(
+            f"('host_{int(h)}', {t_ms + i}, " +
+            ", ".join(f"{v:.3f}" for v in row) + ")"
+            for i, (h, row) in enumerate(zip(
+                rng.integers(0, HOSTS, n),
+                rng.uniform(0.0, 100.0, (n, len(FIELDS)))))
+        )
+        qe.execute_one(
+            f"INSERT INTO cpu (hostname, ts, {', '.join(FIELDS)}) "
+            f"VALUES {vals}")
+        t_ms += n
+        done += n
+    dt = time.perf_counter() - t_start
+    rps = done / dt
+    log(f"sql insert: {done} rows in {dt:.1f}s ({rps:,.0f} rows/s)")
+    results["sql_insert"] = {
+        "rows": done, "rows_per_s": round(rps),
+        "vs_bulk_note": "statement path; headline ingest uses bulk "
+                        "RecordBatch puts"}
+
+
 def bench_qps(qe, results, clients=None, requests_total=None):
     """Config: concurrent query throughput over real HTTP (reference
     tracks 1165.73 qps @50 clients on single-groupby-1-1-1,
@@ -679,6 +712,8 @@ def main():
 
         results = {}
         bench_cpu_suite(qe, results)
+        if enabled("sql_insert"):
+            bench_sql_insert(qe, results)
         if enabled("qps_single_groupby"):
             bench_qps(qe, results)
         if enabled("promql_rate"):

@@ -95,6 +95,11 @@ class QueryEngine:
         only mutated during parsing; every post-parse transform copies
         via dataclasses.replace (reference caches at the same layer with
         its prepared-statement plans)."""
+        if len(sql) > 2048:
+            # bulk INSERT texts never repeat — caching their (large)
+            # ASTs would pin hundreds of MB for a zero hit rate; the
+            # cache exists for short repeated dashboard SELECTs
+            return parse_sql(sql)
         cache = self._stmt_cache
         with self._stmt_cache_lock:
             stmts = cache.get(sql)
@@ -1126,24 +1131,40 @@ class QueryEngine:
         if unknown:
             raise PlanError(f"unknown insert columns {sorted(unknown)}")
         nrows = len(stmt.rows)
-        by_col: dict[str, list] = {n: [] for n in col_names}
-        for row in stmt.rows:
-            if len(row) != len(col_names):
-                raise PlanError("INSERT row arity mismatch")
-            for n, e in zip(col_names, row):
-                v = eval_host(e, {}, schema, None) if not isinstance(e, ast.Literal) else e.value
-                v = None if _is_nan_scalar(v) else v
-                by_col[n].append(v)
+        ncols = len(col_names)
+        by_col: dict[str, list] = {}
+        # bulk-load fast path: plain literal tuples (the overwhelming
+        # VALUES shape) transpose column-wise without per-value dispatch
+        if all(len(row) == ncols and all(type(e) is ast.Literal
+                                         for e in row)
+               for row in stmt.rows):
+            for name, col in zip(col_names, zip(*stmt.rows)):
+                by_col[name] = [None if (v := e.value) != v else v
+                                for e in col]
+        else:
+            by_col = {n: [] for n in col_names}
+            for row in stmt.rows:
+                if len(row) != ncols:
+                    raise PlanError("INSERT row arity mismatch")
+                for n, e in zip(col_names, row):
+                    v = eval_host(e, {}, schema, None) \
+                        if not isinstance(e, ast.Literal) else e.value
+                    v = None if _is_nan_scalar(v) else v
+                    by_col[n].append(v)
         batch_cols: dict = {}
         for c in schema.columns:
             vals = by_col.get(c.name)
             if vals is None:
                 vals = [c.default] * nrows
             if c.semantic is SemanticType.TAG:
-                batch_cols[c.name] = DictVector.encode(
-                    [None if v is None else str(v) for v in vals]
-                )
+                if not all(type(v) is str for v in vals):
+                    vals = [None if v is None else str(v) for v in vals]
+                batch_cols[c.name] = DictVector.encode(vals)
             elif c.dtype.is_timestamp:
+                if all(type(v) is int for v in vals):
+                    # integer literals are already in the column's unit
+                    batch_cols[c.name] = np.asarray(vals, dtype=np.int64)
+                    continue
                 coerced = []
                 for v in vals:
                     if v is None:
@@ -1156,10 +1177,14 @@ class QueryEngine:
                     [None if v is None else str(v) for v in vals]
                 )
             elif c.dtype.is_float:
-                batch_cols[c.name] = np.asarray(
-                    [np.nan if v is None else float(v) for v in vals],
-                    dtype=c.dtype.to_numpy(),
-                )
+                try:
+                    batch_cols[c.name] = np.asarray(
+                        vals, dtype=c.dtype.to_numpy())
+                except (TypeError, ValueError):  # Nones / mixed types
+                    batch_cols[c.name] = np.asarray(
+                        [np.nan if v is None else float(v) for v in vals],
+                        dtype=c.dtype.to_numpy(),
+                    )
             elif c.dtype is DataType.BOOL:
                 batch_cols[c.name] = np.asarray(
                     [False if v is None else bool(v) for v in vals]

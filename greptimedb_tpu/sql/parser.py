@@ -684,11 +684,43 @@ class Parser:
         if self.at_kw("select"):
             return ast.Insert(table, columns, rows=[], select=self.parse_select())
         self.expect_kw("values")
+        # literal fast path: bulk INSERTs are overwhelmingly plain
+        # number/string/NULL tuples, and full precedence descent per
+        # value dominates statement cost at TSBS load rates — peek one
+        # token ahead and build the Literal directly; anything else
+        # (expressions, casts, intervals) falls back to parse_expr
         rows = []
+        toks = self.tokens
         while True:
             self.expect_op("(")
             row = []
             while not self.at_op(")"):
+                t = toks[self.i]
+                nxt = self.peek(1)  # clamps at eof: truncated statements
+                # must fall through to parse_expr's clean SqlError
+                if nxt.kind == "op" and (nxt.value == ","
+                                         or nxt.value == ")"):
+                    if t.kind == "number":
+                        txt = t.value
+                        self.i += 1
+                        row.append(ast.Literal(
+                            float(txt) if ("." in txt or "e" in txt
+                                           or "E" in txt) else int(txt)))
+                        self.eat_op(",")
+                        continue
+                    if t.kind == "string":
+                        self.i += 1
+                        row.append(ast.Literal(t.value))
+                        self.eat_op(",")
+                        continue
+                    if t.kind == "keyword" and t.value in ("null", "true",
+                                                           "false"):
+                        self.i += 1
+                        row.append(ast.Literal(
+                            None if t.value == "null"
+                            else t.value == "true"))
+                        self.eat_op(",")
+                        continue
                 row.append(self.parse_expr())
                 self.eat_op(",")
             self.expect_op(")")

@@ -100,6 +100,11 @@ class RegionEngine:
                                  config.object_store_cache_bytes,
                                  **config.object_store_kwargs)
         os.makedirs(config.data_dir, exist_ok=True)
+        from greptimedb_tpu.storage.format import check_and_stamp
+
+        # refuse dirs written by a NEWER build; stamp ours (round-3 dirs
+        # carry no stamp and read as version 1 — see storage/format.py)
+        self.format_versions = check_and_stamp(config.data_dir)
         if config.wal_backend == "remote":
             from greptimedb_tpu.storage.remote_wal import RemoteWal
 

@@ -1,0 +1,52 @@
+"""On-disk format versioning (the reference's cross-version compatibility
+contract, tests/compat/test-compat.sh: data written by version N must open
+under version N+1, and incompatibility must fail loudly, never corrupt).
+
+A `FORMAT.json` stamp at the data-dir root records the layout versions the
+writing build used. Open-time check: a dir stamped with a NEWER version
+than this build understands refuses to open (downgrade protection); a dir
+with no stamp predates versioning (round-3 builds) and reads as version 1
+— every v1 reader path tolerates those files (parquet self-describes its
+codec, manifest actions default missing fields, WAL framing is unchanged).
+
+Bump a component's version when its reader can no longer parse what an
+older writer produced; keep readers accepting ALL versions <= current.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+#: current writer versions, per component
+FORMAT_VERSIONS = {"layout": 1, "sst": 1, "wal": 1, "manifest": 1}
+
+_STAMP = "FORMAT.json"
+
+
+class FormatError(RuntimeError):
+    """Data dir written by an incompatible (newer) build."""
+
+
+def check_and_stamp(data_dir: str) -> dict:
+    """Validate `data_dir`'s format stamp against this build and (re)write
+    the stamp. Returns the versions the dir was written with."""
+    path = os.path.join(data_dir, _STAMP)
+    found = dict.fromkeys(FORMAT_VERSIONS, 1)  # unstamped = version 1
+    if os.path.exists(path):
+        try:
+            with open(path, encoding="utf-8") as f:
+                found.update(json.load(f).get("versions", {}))
+        except (OSError, ValueError) as e:
+            raise FormatError(f"unreadable format stamp {path}: {e}") from e
+    newer = {k: v for k, v in found.items()
+             if v > FORMAT_VERSIONS.get(k, 0)}
+    if newer:
+        raise FormatError(
+            f"data dir {data_dir} was written by a newer build "
+            f"({newer}); this build supports {FORMAT_VERSIONS}")
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump({"versions": FORMAT_VERSIONS}, f)
+    os.replace(tmp, path)
+    return found

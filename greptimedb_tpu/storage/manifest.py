@@ -34,6 +34,15 @@ class RegionManifestState:
     tag_dicts: dict[str, list] = field(default_factory=dict)
 
     def apply(self, action: dict) -> None:
+        from greptimedb_tpu.storage.format import FORMAT_VERSIONS, FormatError
+
+        # absent stamp = v1 (pre-versioning rounds); newer than this
+        # build understands must refuse, not misinterpret
+        fmt = action.get("format", 1)
+        if fmt > FORMAT_VERSIONS["manifest"]:
+            raise FormatError(
+                f"manifest action format v{fmt}; this build reads "
+                f"<= v{FORMAT_VERSIONS['manifest']}")
         kind = action["kind"]
         if kind == "change":
             self.schema = Schema.from_dict(action["schema"])
@@ -88,6 +97,9 @@ class ManifestManager:
     # ---- append ------------------------------------------------------------
 
     def append(self, action: dict) -> None:
+        from greptimedb_tpu.storage.format import FORMAT_VERSIONS
+
+        action.setdefault("format", FORMAT_VERSIONS["manifest"])
         v = self.state.manifest_version + 1
         # FsStore.write is atomic (tmp + rename)
         self.store.write(self._path(v), json.dumps(action).encode())
@@ -97,8 +109,11 @@ class ManifestManager:
             self._checkpoint()
 
     def _checkpoint(self) -> None:
+        from greptimedb_tpu.storage.format import FORMAT_VERSIONS
+
         st = self.state
         action = {
+            "format": FORMAT_VERSIONS["manifest"],
             "kind": "checkpoint",
             "schema": st.schema.to_dict() if st.schema else None,
             "files": [f.to_dict() for f in st.files.values()],
