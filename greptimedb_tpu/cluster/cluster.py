@@ -249,30 +249,20 @@ class RegionRouter:
                 self._agg_executors[id(eng)] = ex
         return ex
 
-    def partial_agg(self, region_id: int, frag):
-        """Aggregation pushdown: run the Partial step ON the node that
-        owns the region (over Flight in wire mode), so only per-group
-        primitive planes — not raw rows — return to the frontend
+    def execute_fragment(self, region_id: int, frag):
+        """Plan-fragment pushdown: run the region-side stage pipeline ON
+        the node that owns the region (over Flight in wire mode), so
+        only the terminal stage's output — partial planes, top-k
+        candidates, or filtered rows — returns to the frontend
         (reference dist_plan Partial/Final split, analyzer.rs:35)."""
         eng = self._engine_for(region_id)
-        if hasattr(eng, "partial_agg"):  # RemoteRegionEngine: over the wire
-            return eng.partial_agg(region_id, frag)
+        if hasattr(eng, "execute_fragment"):  # RemoteRegionEngine: wire
+            return eng.execute_fragment(region_id, frag)
         # in-process datanode: same computation, no serialization
-        from greptimedb_tpu.query.dist_agg import partial_region_agg
+        from greptimedb_tpu.query.dist_agg import execute_region_fragment
 
-        return partial_region_agg(self._local_executor_for(eng), region_id,
-                                  frag)
-
-    def partial_topk(self, region_id: int, frag):
-        """Sort/limit pushdown: each region returns only its k candidate
-        rows (TopkFragment), instead of the raw scan crossing the wire."""
-        eng = self._engine_for(region_id)
-        if hasattr(eng, "partial_topk"):  # RemoteRegionEngine: over the wire
-            return eng.partial_topk(region_id, frag)
-        from greptimedb_tpu.query.dist_agg import partial_region_topk
-
-        return partial_region_topk(self._local_executor_for(eng), region_id,
-                                   frag)
+        return execute_region_fragment(self._local_executor_for(eng),
+                                       region_id, frag)
 
     def alter_region_schema(self, region_id: int, schema) -> None:
         self._engine_for(region_id).alter_region_schema(region_id, schema)

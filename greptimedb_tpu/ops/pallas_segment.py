@@ -1,0 +1,106 @@
+"""Pallas TPU kernel: fused dense segment-sum as a one-hot matmul.
+
+The dense prepared aggregation path (physical.py::_agg_scan_prepared)
+reduces a query-invariant [N, W] plane with one dead-segment segment-sum
+per block. XLA lowers `jax.ops.segment_sum` to a scatter-add — serialized
+row updates that leave the MXU idle. For the dashboard-shaped group
+counts (G up to a few thousand: per-minute buckets, host subsets,
+bucket x host grids) the same reduction is a matmul:
+
+    out[G, W] = onehot[G, Nb] @ plane[Nb, W]
+
+with the one-hot built in-register from an iota comparison — which is
+exactly the systolic array's shape (SURVEY.md §7's fused
+filter+bucket+reduce design: the filter arrives as dead-segment ids, the
+bucket as the group id, the reduce as the matmul). The grid walks row
+blocks sequentially, accumulating into a VMEM-resident [G, W] output
+(TPU grids execute in order, so read-modify-write accumulation across
+grid steps is the standard reduction pattern, pallas_guide.md).
+
+Selection: ops/segment.py::dense_segment_sum auto-picks this kernel on
+TPU backends for eligible shapes and falls back to XLA's scatter
+otherwise; GREPTIMEDB_TPU_PALLAS=on forces it (interpret mode off-TPU,
+which is how the differential tests run on CPU), =off disables.
+
+Reference analog: DataFusion's row-hash GroupedHashAggregateStream
+(src/query — the CPU bottleneck of TSBS double-groupby); this kernel is
+its MXU-native replacement for the dense-id case, no hashing at all.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+#: widest plane the kernel accepts (lane tile); prepared planes are
+#: 2F+1 <= 21 for TSBS's 10 fields
+MAX_WIDTH = 128
+#: largest padded segment count: out[G, 128] f32 must sit in VMEM with
+#: the one-hot block and the plane block
+MAX_SEGMENTS = 4096
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def _kernel(ids_ref, plane_ref, out_ref):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    ids = ids_ref[...]  # [1, Nb] int32
+    gp = out_ref.shape[0]
+    nb = ids.shape[1]
+    # [Gp, Nb] one-hot from an iota comparison — built in registers,
+    # never materialized in HBM
+    onehot = (jax.lax.broadcasted_iota(jnp.int32, (gp, nb), 0)
+              == ids).astype(plane_ref.dtype)
+    out_ref[...] += jnp.dot(onehot, plane_ref[...],
+                            preferred_element_type=out_ref.dtype)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_segments", "block_rows",
+                                    "interpret"))
+def pallas_dense_segment_sum(
+    plane: jax.Array,  # [N, W] float values (zeros on invalid rows)
+    ids: jax.Array,  # [N] int32 segment ids (dead rows -> num_segments-1)
+    num_segments: int,
+    block_rows: int = 512,
+    interpret: bool = False,
+) -> jax.Array:
+    """segment_sum(plane, ids, num_segments) on the MXU. Caller must
+    pre-check eligible(); padding rows are appended with zero values
+    into the last segment (harmless by construction — the dense
+    prepared path's dead segment)."""
+    n, w = plane.shape
+    wp = MAX_WIDTH
+    gp = _round_up(max(num_segments, 8), 8)
+    npad = _round_up(max(n, 1), block_rows)
+    plane_p = jnp.pad(plane, ((0, npad - n), (0, wp - w)))
+    ids_p = jnp.pad(ids.astype(jnp.int32), (0, npad - n),
+                    constant_values=num_segments - 1)[None, :]
+    out = pl.pallas_call(
+        _kernel,
+        grid=(npad // block_rows,),
+        in_specs=[
+            pl.BlockSpec((1, block_rows), lambda i: (0, i)),
+            pl.BlockSpec((block_rows, wp), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((gp, wp), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((gp, wp), plane.dtype),
+        interpret=interpret,
+    )(ids_p, plane_p)
+    return out[:num_segments, :w]
+
+
+def eligible(shape: tuple, num_segments: int) -> bool:
+    """Shapes the kernel handles; everything else takes XLA's scatter."""
+    return (len(shape) == 2 and 0 < shape[1] <= MAX_WIDTH
+            and 0 < num_segments <= MAX_SEGMENTS)

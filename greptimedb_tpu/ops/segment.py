@@ -59,6 +59,44 @@ def _masked(values: jax.Array, mask: jax.Array, fill) -> jax.Array:
     return jnp.where(mask, values, jnp.asarray(fill, dtype=values.dtype))
 
 
+def _pallas_mode() -> str:
+    import os
+
+    return os.environ.get("GREPTIMEDB_TPU_PALLAS", "auto").lower()
+
+
+def dense_segment_sum(plane: jax.Array, ids: jax.Array,
+                      num_segments: int, finite: bool = False) -> jax.Array:
+    """segment_sum for the dense prepared planes, MXU-routed when it
+    pays: on TPU backends (or GREPTIMEDB_TPU_PALLAS=on, interpret mode
+    elsewhere — how the CPU differential tests drive it) eligible shapes
+    run the pallas one-hot-matmul kernel (ops/pallas_segment.py);
+    everything else takes XLA's scatter-add. =off pins the scatter.
+
+    The mode is read at TRACE time and baked into the enclosing jit
+    cache — set the env var before the engine starts, not per-query.
+
+    `finite` (static, from the caller's cached plane scan): the one-hot
+    matmul computes 0*x for every row outside a group, so a single
+    +/-Inf value would poison EVERY group with NaN — callers must prove
+    the plane finite (the same host pass that detects NaNs) before the
+    kernel is allowed. f64 planes only ride in interpret mode: Mosaic
+    cannot lower f64 matmuls on the chip."""
+    mode = _pallas_mode()
+    if mode != "off" and finite and plane.ndim == 2:
+        from greptimedb_tpu.ops import pallas_segment as ps
+
+        backend = jax.default_backend()
+        use = mode == "on" or (mode == "auto" and backend == "tpu")
+        dtype_ok = plane.dtype in (jnp.float32, jnp.bfloat16) \
+            or backend != "tpu"
+        if use and dtype_ok and ps.eligible(plane.shape, num_segments):
+            return ps.pallas_dense_segment_sum(
+                plane, ids, num_segments,
+                interpret=backend != "tpu")
+    return jax.ops.segment_sum(plane, ids, num_segments=num_segments)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("num_segments", "ops", "indices_are_sorted"),

@@ -13,12 +13,15 @@ on each datanode's regions and a Final combine at the frontend
 - `combine_partials` merges per-region results: additive planes add,
   min/max fold, first/last resolve by their companion timestamps.
 
-The fragment itself crosses the wire as JSON (plan_ser.AggFragment —
-the substrait analog) via the Flight `region_agg` ticket.
+The fragment itself crosses the wire as JSON (plan_ser.PlanFragment —
+the substrait analog) via the Flight `region_frag` ticket;
+`execute_region_fragment` is the region-side interpreter dispatching to
+the partial-agg / top-k / filtered-rows pipelines by terminal stage.
 """
 
 from __future__ import annotations
 
+from types import SimpleNamespace
 from typing import Optional
 
 import jax.numpy as jnp
@@ -26,7 +29,72 @@ import numpy as np
 
 from greptimedb_tpu.ops.segment import segment_agg
 from greptimedb_tpu.query.expr import BindContext, bind_expr, eval_host
-from greptimedb_tpu.query.plan_ser import AggFragment
+from greptimedb_tpu.query.plan_ser import PlanFragment
+
+
+def execute_region_fragment(executor, region_id: int, frag: PlanFragment,
+                            schema=None) -> Optional[dict]:
+    """Interpret a PlanFragment over one region's rows. Returns
+    {"keys": ..., "planes": ...} for a partial_agg terminal, or
+    {"cols": {...}} of candidate/filtered rows otherwise; None when the
+    region contributes nothing."""
+    filt = frag.stage("filter")
+    where = filt["expr"] if filt else None
+    agg = frag.stage("partial_agg")
+    common = dict(where=where, ts_range=frag.ts_range,
+                  append_mode=frag.append_mode, tz=frag.tz)
+    if agg is not None:
+        shim = SimpleNamespace(keys=agg["keys"], args=agg["args"],
+                               ops=agg["ops"], **common)
+        return partial_region_agg(executor, region_id, shim, schema)
+    sort = frag.stage("sort")
+    limit = frag.stage("limit")
+    prune = frag.stage("prune")
+    columns = list(prune["columns"]) if prune else None
+    if sort is not None and limit is not None:
+        shim = SimpleNamespace(sort_keys=sort["keys"], k=limit["k"],
+                               columns=columns, **common)
+        return partial_region_topk(executor, region_id, shim, schema)
+    return partial_region_rows(executor, region_id, columns,
+                               limit["k"] if limit else None,
+                               schema=schema, **common)
+
+
+def partial_region_rows(executor, region_id: int, columns, k,
+                        *, where, ts_range, append_mode, tz,
+                        schema=None) -> Optional[dict]:
+    """Filter/prune(/limit) pushdown for plain scans: only the rows that
+    survive WHERE — projected to the referenced columns — cross the
+    wire, instead of the raw region scan (filter and projection are
+    Commutative in the reference's classification,
+    commutativity.rs:27-52; the frontend re-evaluates nothing but the
+    final projection expressions)."""
+    from greptimedb_tpu.query.expr import collect_columns
+
+    probe = executor.engine.region(region_id)
+    schema = schema or probe.schema
+    ts_name = schema.time_index.name
+    ts_range = tuple(ts_range) if ts_range else None
+    needed: set[str] = {ts_name}
+    collect_columns(where, needed)
+    if columns is None:
+        needed.update(schema.names)
+    else:
+        needed.update(columns)
+    host = _region_host_columns(executor, region_id, where, ts_range,
+                                needed, append_mode, schema, tz=tz)
+    if host is None:
+        return None
+    if columns is not None:
+        # the filter already ran here — filter-only columns would be
+        # dead weight on the wire; ship exactly the pruned projection
+        host = {name: arr for name, arr in host.items()
+                if name in columns}
+    if k is not None and host:
+        n = len(next(iter(host.values())))
+        if n > k:
+            host = {name: arr[:k] for name, arr in host.items()}
+    return {"cols": host}
 
 
 def _region_host_columns(executor, region_id: int, where, ts_range,
@@ -102,7 +170,7 @@ def _region_host_columns_inner(executor, region_id, where, ts_range, needed,
     return host
 
 
-def partial_region_agg(executor, region_id: int, frag: AggFragment,
+def partial_region_agg(executor, region_id: int, frag,
                        schema=None) -> Optional[dict]:
     """Compute one region's partial aggregate. Returns
     {"keys": [np.ndarray per key], "planes": {op: [G, F] np.ndarray}}
@@ -351,7 +419,7 @@ def partial_region_topk(executor, region_id: int, frag,
                         schema=None) -> Optional[dict]:
     """One region's top-k candidates for a sort+limit scan: filter, sort
     locally, truncate to k rows. Only k rows — not the raw scan — return
-    to the frontend (TopkFragment; the reference classifies Limit as
+    to the frontend (sort+limit stages; the reference classifies Limit as
     PartialCommutative over MergeScan, commutativity.rs:27-52)."""
     from greptimedb_tpu.query.expr import collect_columns
 

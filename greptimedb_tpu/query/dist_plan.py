@@ -1,0 +1,101 @@
+"""Commutativity classification of the plan prefix for distributed
+execution — the dist_plan analyzer analog.
+
+The reference walks the optimized plan bottom-up, classifies every node
+(Commutative / PartialCommutative / ConditionalCommutative /
+NonCommutative) and pushes the whole commutative prefix below MergeScan
+(query/src/dist_plan/analyzer.rs:35, commutativity.rs:27-52). The same
+taxonomy here, over this engine's plan parts:
+
+| node            | class                 | region side        | frontend |
+|-----------------|-----------------------|--------------------|----------|
+| Filter (WHERE)  | Commutative           | filter stage       | nothing  |
+| Projection      | Commutative (columns) | prune stage        | exprs    |
+| Sort + Limit    | PartialCommutative    | sort+limit to k    | re-sort  |
+| bare Limit      | PartialCommutative    | limit to k         | re-limit |
+| Aggregate       | Partial/Final split   | partial_agg planes | combine  |
+| Sort w/o Limit  | NonCommutative        | (filter/prune only)| sort     |
+| host aggs       | NonCommutative        | —                  | gather   |
+
+`classify_prefix` returns (PlanFragment, mode) — mode tells the
+frontend which Final step to run over what comes back: "agg" combines
+partial planes, "topk" re-sorts candidate rows, "rows" treats the union
+of filtered rows as the scan relation. None means nothing pushes and
+the caller gathers scans (MergeScan fallback)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from greptimedb_tpu.query.expr import collect_columns, current_session_tz
+from greptimedb_tpu.query.plan_ser import PlanFragment
+from greptimedb_tpu.sql import ast
+
+
+def classify_prefix(table, where, agg, project, sort, limit, offset,
+                    ts_range, scan_node,
+                    needs_host_agg, infer_dtype,
+                    primitives) -> Optional[tuple[PlanFragment, str]]:
+    """Build the largest region-side-executable PlanFragment for this
+    plan, or None when only a raw gather works. `needs_host_agg` /
+    `infer_dtype` / `primitives` come from the physical layer (shared
+    with single-node planning so eligibility matches exactly)."""
+    tz = current_session_tz()
+    base = dict(ts_range=ts_range, append_mode=table.append_mode, tz=tz)
+    stages: list = []
+    if where is not None:
+        stages.append({"op": "filter", "expr": where})
+
+    if agg is not None:
+        if any(needs_host_agg(s, table.schema) for s in agg.aggs):
+            return None  # order statistics / string args need raw values
+        for spec in agg.aggs:
+            if spec.arg is None:
+                continue
+            dt = infer_dtype(spec.arg, table.schema)
+            if dt is not None and not (dt.is_numeric or dt.is_timestamp):
+                # string argument: only count() decomposes into the
+                # validity plane; everything else needs the raw values
+                if spec.func not in ("count", "rows"):
+                    return None
+        arg_exprs: list[ast.Expr] = []
+        for spec in agg.aggs:
+            if spec.arg is not None and spec.arg not in arg_exprs:
+                arg_exprs.append(spec.arg)
+        ops: set = {"rows"}
+        for spec in agg.aggs:
+            ops.update(primitives[spec.func])
+        stages.append({"op": "partial_agg", "keys": list(agg.keys),
+                       "args": arg_exprs, "ops": sorted(ops)})
+        return PlanFragment(stages=stages, **base), "agg"
+
+    # non-aggregate scans: prune to the referenced columns
+    columns = scan_node.columns
+    if columns is not None:
+        stages.append({"op": "prune", "columns": list(columns)})
+
+    if sort is not None and limit is not None:
+        sort_keys = []
+        needed: set = set()
+        for ob in sort.keys:
+            if ob.nulls_first is not None:
+                return None  # NULLS FIRST/LAST isn't replicated region-side
+            sort_keys.append((ob.expr, ob.asc))
+            collect_columns(ob.expr, needed)
+        if not all(c in table.schema.names for c in needed):
+            return None  # sort key references a projection alias
+        stages.append({"op": "sort", "keys": sort_keys})
+        stages.append({"op": "limit", "k": int(limit) + int(offset or 0)})
+        return PlanFragment(stages=stages, **base), "topk"
+
+    if limit is not None and sort is None:
+        # bare LIMIT: any k rows per region satisfy it
+        stages.append({"op": "limit", "k": int(limit) + int(offset or 0)})
+        return PlanFragment(stages=stages, **base), "rows"
+
+    if where is not None:
+        # filter+prune-only fragment: ship the filtered rows, not the
+        # scan. Without a WHERE there is nothing to reduce region-side —
+        # the gather path (with its scan caches) is strictly better.
+        return PlanFragment(stages=stages, **base), "rows"
+    return None

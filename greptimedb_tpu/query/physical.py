@@ -32,6 +32,7 @@ from greptimedb_tpu.ops.segment import (
     _type_max as _seg_type_max,
     _type_min as _seg_type_min,
     combine_group_ids,
+    dense_segment_sum,
     segment_agg,
 )
 from greptimedb_tpu.query import logical as lp
@@ -200,16 +201,17 @@ def _agg_block_masked(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("where", "keys", "nf", "has_nan", "num_segments",
-                     "tag_names", "schema", "float_ops", "pack_dtype"),
+    static_argnames=("where", "keys", "nf", "has_nan", "finite",
+                     "num_segments", "tag_names", "schema", "float_ops",
+                     "pack_dtype"),
 )
 def _agg_scan_prepared(
     blocks: tuple,  # per-block col dicts incl. "__prep__"
     n_valids: jax.Array,
     dedup_masks,
     *,
-    where, keys, nf, has_nan, num_segments, tag_names, schema, float_ops,
-    pack_dtype,
+    where, keys, nf, has_nan, finite, num_segments, tag_names, schema,
+    float_ops, pack_dtype,
 ):
     """Dense fast path for sum/count/mean/rows over plain field columns.
 
@@ -237,7 +239,7 @@ def _agg_scan_prepared(
             mask = mask & (w if w.dtype == jnp.bool_ else w != 0)
         gid = _group_ids(cols, keys, plane.shape[0])
         ids = jnp.where(mask, gid, jnp.int32(G))
-        part = jax.ops.segment_sum(plane, ids, num_segments=G + 1)[:G]
+        part = dense_segment_sum(plane, ids, G + 1, finite=finite)[:G]
         total = part if total is None else total + part
         if "__prep_min__" in cols:
             p = jax.ops.segment_min(cols["__prep_min__"], ids,
@@ -248,8 +250,8 @@ def _agg_scan_prepared(
                                     num_segments=G + 1)[:G]
             tmax = p if tmax is None else jnp.maximum(tmax, p)
         if "__prep_sq__" in cols:
-            p = jax.ops.segment_sum(cols["__prep_sq__"], ids,
-                                    num_segments=G + 1)[:G]
+            p = dense_segment_sum(cols["__prep_sq__"], ids, G + 1,
+                                  finite=finite)[:G]
             tsq = p if tsq is None else tsq + p
     sums = total[:, :nf]
     if has_nan:
@@ -833,25 +835,15 @@ class PhysicalExecutor:
         tag_preds = extract_tag_predicates(where, table.schema) or None
         from greptimedb_tpu.utils import tracing
 
-        # distributed aggregation pushdown: with multiple regions behind a
-        # router that can run the Partial step remotely, ship the fragment
-        # and combine primitives instead of gathering raw rows
-        # (dist_plan/analyzer.rs:35 + merge_scan.rs:122)
-        if (agg is not None and len(table.region_ids) > 1
-                and hasattr(self.engine, "partial_agg")):
-            res = self._try_agg_pushdown(table, where, agg, having, project,
-                                         sort, limit, offset, ts_range)
-            if res is not None:
-                return res
-
-        # sort+limit (top-k) pushdown for raw scans: each region returns
-        # only k candidates instead of its full scan (Limit is
-        # PartialCommutative over MergeScan, commutativity.rs:27-52)
-        if (agg is None and sort is not None and limit is not None
-                and len(table.region_ids) > 1
-                and hasattr(self.engine, "partial_topk")):
-            res = self._try_topk_pushdown(table, where, project, sort,
-                                          limit, offset, ts_range, scan_node)
+        # distributed plan-fragment pushdown: classify the plan prefix
+        # (dist_plan.classify_prefix, the commutativity.rs analog) and
+        # ship it as one PlanFragment per region — partial-agg planes,
+        # top-k candidates, or filtered rows come back, never raw scans
+        if (len(table.region_ids) > 1
+                and hasattr(self.engine, "execute_fragment")):
+            res = self._try_fragment_pushdown(
+                table, where, agg, having, project, sort, limit, offset,
+                ts_range, scan_node)
             if res is not None:
                 return res
 
@@ -909,48 +901,27 @@ class PhysicalExecutor:
 
     # ---- distributed aggregation pushdown ----------------------------------
 
-    def _try_agg_pushdown(self, table, where, agg, having, project, sort,
-                          limit, offset, ts_range) -> Optional[QueryResult]:
-        """Fan the Partial step out to each region's owner and combine
-        primitive planes here (the Final step). Returns None when the
-        plan shape isn't decomposable — caller falls back to the
-        gather-rows path."""
-        from greptimedb_tpu.query.dist_agg import combine_partials
-        from greptimedb_tpu.query.host_agg import HOST_AGGS
-        from greptimedb_tpu.query.plan_ser import AggFragment
+    def _try_fragment_pushdown(self, table, where, agg, having, project,
+                               sort, limit, offset, ts_range,
+                               scan_node) -> Optional[QueryResult]:
+        """Classify the plan prefix, fan one PlanFragment out to each
+        region's owner, and run the Final step over what returns:
+        combine partial planes ("agg"), merge-and-resort candidates
+        ("topk"), or treat the filtered-row union as the relation
+        ("rows"). Returns None when nothing pushes — caller falls back
+        to the gather-rows MergeScan path."""
+        from greptimedb_tpu.query.dist_agg import combine_partials, merge_topk
+        from greptimedb_tpu.query.dist_plan import classify_prefix
         from greptimedb_tpu.utils import tracing
 
-        if any(_needs_host_agg(s, table.schema) for s in agg.aggs):
-            return None  # needs raw values (order stats / string args)
-        for spec in agg.aggs:
-            if spec.arg is None:
-                continue
-            dt = _infer_dtype(spec.arg, table.schema)
-            if dt is not None and not (dt.is_numeric or dt.is_timestamp):
-                # string-typed argument: only count() decomposes into the
-                # float primitive planes (validity), everything else needs
-                # the raw values — fall back to the gather path
-                if spec.func not in ("count", "rows"):
-                    return None
-        arg_exprs: list[ast.Expr] = []
-        spec_slot: list[Optional[int]] = []
-        for spec in agg.aggs:
-            if spec.arg is None:
-                spec_slot.append(None)
-                continue
-            if spec.arg not in arg_exprs:
-                arg_exprs.append(spec.arg)
-            spec_slot.append(arg_exprs.index(spec.arg))
-        ops: set = {"rows"}
-        for spec in agg.aggs:
-            ops.update(_PRIMITIVES[spec.func])
-        from greptimedb_tpu.query.expr import current_session_tz
-
-        frag = AggFragment(
-            keys=list(agg.keys), args=arg_exprs, ops=sorted(ops),
-            where=where, ts_range=ts_range, append_mode=table.append_mode,
-            tz=current_session_tz())
-        with tracing.span("agg_pushdown", regions=len(table.region_ids)):
+        out = classify_prefix(table, where, agg, project, sort, limit,
+                              offset, ts_range, scan_node,
+                              _needs_host_agg, _infer_dtype, _PRIMITIVES)
+        if out is None:
+            return None
+        frag, mode = out
+        with tracing.span("fragment_pushdown", mode=mode,
+                          regions=len(table.region_ids)):
             rids = list(table.region_ids)
             if len(rids) > 1:
                 # independent region RPCs: fan out so wall-clock is the
@@ -965,82 +936,48 @@ class PhysicalExecutor:
                     # re-adopt the request trace in the worker
                     if tid:
                         tracing.set_trace(tid)
-                    return self.engine.partial_agg(rid, frag)
+                    return self.engine.execute_fragment(rid, frag)
 
                 with ThreadPoolExecutor(
                         max_workers=min(8, len(rids))) as pool:
                     partials = list(pool.map(one, rids))
             else:
-                partials = [self.engine.partial_agg(rids[0], frag)]
-        combined = combine_partials(partials, len(agg.keys),
-                                    tuple(frag.ops))
-        self.last_path = "pushdown"
-        if combined is None:
-            return self._empty_agg_result(table, agg, having, project,
-                                          sort, limit, offset)
-        planes = combined["planes"]
-        g = len(combined["keys"][0]) if agg.keys else 1
-        present = np.arange(g)
-        env: dict = {}
-        for i, (name, kexpr) in enumerate(agg.keys):
-            env[kexpr] = combined["keys"][i]
-        for spec, slot in zip(agg.aggs, spec_slot):
-            env[spec.call] = _finalize_agg(spec.func, planes, slot, present)
-        return self._post_process(env, agg, having, project, sort, limit,
-                                  offset, table, g)
+                partials = [self.engine.execute_fragment(rids[0], frag)]
 
-    def _try_topk_pushdown(self, table, where, project, sort, limit,
-                           offset, ts_range,
-                           scan_node) -> Optional[QueryResult]:
-        """Ship a TopkFragment to each region's owner; merge the ≤k
-        candidates per region and run the final sort/limit here. Returns
-        None when the sort shape can't be replicated region-side —
-        caller falls back to the gather path."""
-        from greptimedb_tpu.query.dist_agg import merge_topk
-        from greptimedb_tpu.query.expr import collect_columns
-        from greptimedb_tpu.query.plan_ser import TopkFragment
-        from greptimedb_tpu.utils import tracing
+        if mode == "agg":
+            agg_stage = frag.stage("partial_agg")
+            spec_slot: list[Optional[int]] = []
+            for spec in agg.aggs:
+                spec_slot.append(
+                    None if spec.arg is None
+                    else agg_stage["args"].index(spec.arg))
+            combined = combine_partials(partials, len(agg.keys),
+                                        tuple(agg_stage["ops"]))
+            self.last_path = "pushdown"
+            if combined is None:
+                return self._empty_agg_result(table, agg, having, project,
+                                              sort, limit, offset)
+            planes = combined["planes"]
+            g = len(combined["keys"][0]) if agg.keys else 1
+            present = np.arange(g)
+            env: dict = {}
+            for i, (name, kexpr) in enumerate(agg.keys):
+                env[kexpr] = combined["keys"][i]
+            for spec, slot in zip(agg.aggs, spec_slot):
+                env[spec.call] = _finalize_agg(spec.func, planes, slot,
+                                               present)
+            return self._post_process(env, agg, having, project, sort,
+                                      limit, offset, table, g)
 
-        sort_keys = []
-        needed: set = set()
-        for ob in sort.keys:
-            if ob.nulls_first is not None:
-                return None  # NULLS FIRST/LAST isn't replicated region-side
-            sort_keys.append((ob.expr, ob.asc))
-            collect_columns(ob.expr, needed)
-        if not all(c in table.schema.names for c in needed):
-            return None  # sort key references a projection alias
-        from greptimedb_tpu.query.expr import current_session_tz
-
-        k = int(limit) + int(offset or 0)
-        frag = TopkFragment(
-            sort_keys=sort_keys, k=k, columns=scan_node.columns,
-            where=where, ts_range=ts_range, append_mode=table.append_mode,
-            tz=current_session_tz())
-        with tracing.span("topk_pushdown", regions=len(table.region_ids),
-                          k=k):
-            rids = list(table.region_ids)
-            from concurrent.futures import ThreadPoolExecutor
-
-            tid = tracing.current_trace_id()
-
-            def one(rid):
-                if tid:
-                    tracing.set_trace(tid)
-                return self.engine.partial_topk(rid, frag)
-
-            with ThreadPoolExecutor(max_workers=min(8, len(rids))) as pool:
-                partials = list(pool.map(one, rids))
         merged = merge_topk(partials)
-        self.last_path = "topk_pushdown"
+        self.last_path = "topk_pushdown" if mode == "topk" \
+            else "rows_pushdown"
         if merged is None:
             return _project_empty(project, table.schema)
         host_cols = merged["cols"]
         nrows = len(next(iter(host_cols.values()))) if host_cols else 0
         return self._post_process({}, None, None, project, sort, limit,
                                   offset, table, nrows, host_cols=host_cols)
-
-    # ---- aggregate path ----------------------------------------------------
 
     def _execute_agg(self, scan, table, where, agg, having, project, sort,
                      limit, offset, scan_node) -> QueryResult:
@@ -1750,6 +1687,7 @@ class PhysicalExecutor:
                 tuple(blocks), jnp.asarray(np.asarray(n_valids)),
                 tuple(dmasks) if dmasks is not None else None,
                 where=bound_where, keys=keys, nf=nf, has_nan=has_nan,
+                finite=not self._scan_has_inf(scan, arg_names),
                 num_segments=num_groups,
                 tag_names=tag_names, schema=schema, float_ops=float_ops,
                 pack_dtype=pack_dtype,
@@ -1966,6 +1904,26 @@ class PhysicalExecutor:
             if f is None:
                 col = np.asarray(scan.columns[name])
                 f = bool(np.isnan(col).any()) \
+                    if col.dtype.kind == "f" else False
+                flags[name] = f
+            out = out or f
+        return out
+
+    def _scan_has_inf(self, scan, arg_names: tuple) -> bool:
+        """Whether any aggregated column holds +/-Inf — the pallas
+        one-hot matmul kernel would turn one Inf into NaN for every
+        group (0*inf), so only provably finite planes may ride it.
+        Memoized on the ScanData snapshot like _scan_has_nan."""
+        flags = getattr(scan, "_inf_flags", None)
+        if flags is None:
+            flags = {}
+            scan._inf_flags = flags
+        out = False
+        for name in arg_names:
+            f = flags.get(name)
+            if f is None:
+                col = np.asarray(scan.columns[name])
+                f = bool(np.isinf(col).any()) \
                     if col.dtype.kind == "f" else False
                 flags[name] = f
             out = out or f

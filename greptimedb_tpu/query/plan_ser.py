@@ -2,9 +2,10 @@
 
 The reference ships DataFusion plans between frontend and datanode as
 substrait bytes (src/common/substrait/src/df_substrait.rs,
-datanode/src/region_server.rs:623-660). Here the exchanged fragment is
-an *aggregation pushdown*: WHERE + group keys + decomposed aggregate
-specs, encoded as JSON over the expression AST (every node is a frozen
+datanode/src/region_server.rs:623-660). Here the exchanged unit is a
+PlanFragment — an ordered stage pipeline (filter / prune / sort / limit
+/ partial-agg) covering the region-side-commutative prefix of the plan —
+encoded as JSON over the expression AST (every node is a frozen
 dataclass, so encoding is structural and round-trips exactly).
 
 Security note: `expr_from_json` only instantiates ast.* dataclasses by
@@ -57,85 +58,98 @@ def expr_from_json(obj: Any) -> Any:
     raise ValueError(f"bad plan JSON {obj!r}")
 
 
-@dataclasses.dataclass
-class AggFragment:
-    """The unit shipped to a datanode: compute per-region PARTIAL
-    aggregates (primitive planes, not finalized values) grouped by the
-    evaluated key expressions. Mirrors the reference's commutativity
-    split (query/src/dist_plan/analyzer.rs:35): Partial runs on the
-    region, Final combines on the frontend."""
+#: stage shapes of the plan IR — each stage is a plain dict whose expr
+#: fields are AST nodes host-side and expr_to_json structures on the wire:
+#:   {"op": "filter",      "expr": Expr}
+#:   {"op": "prune",       "columns": [name, ...]}         # col projection
+#:   {"op": "sort",        "keys": [(Expr, asc), ...]}
+#:   {"op": "limit",       "k": int}
+#:   {"op": "partial_agg", "keys": [(name, Expr)], "args": [Expr],
+#:                         "ops": [primitive op]}           # terminal
 
-    keys: list            # [(name, Expr)]
-    args: list            # positional aggregate argument Exprs
-    ops: list             # primitive op names for segment_agg
-    where: Optional[ast.Expr] = None
+
+def _stage_to_json(st: dict) -> dict:
+    op = st["op"]
+    out = {"op": op}
+    if op == "filter":
+        out["expr"] = expr_to_json(st["expr"])
+    elif op == "prune":
+        out["columns"] = list(st["columns"])
+    elif op == "sort":
+        out["keys"] = [[expr_to_json(e), bool(asc)]
+                       for e, asc in st["keys"]]
+    elif op == "limit":
+        out["k"] = int(st["k"])
+    elif op == "partial_agg":
+        out["keys"] = [[n, expr_to_json(e)] for n, e in st["keys"]]
+        out["args"] = [expr_to_json(a) for a in st["args"]]
+        out["ops"] = list(st["ops"])
+    else:
+        raise ValueError(f"unknown fragment stage {op!r}")
+    return out
+
+
+def _stage_from_json(d: dict) -> dict:
+    op = d["op"]
+    if op == "filter":
+        return {"op": op, "expr": expr_from_json(d["expr"])}
+    if op == "prune":
+        return {"op": op, "columns": list(d["columns"])}
+    if op == "sort":
+        return {"op": op, "keys": [(expr_from_json(e), bool(asc))
+                                   for e, asc in d["keys"]]}
+    if op == "limit":
+        return {"op": op, "k": int(d["k"])}
+    if op == "partial_agg":
+        return {"op": op,
+                "keys": [(n, expr_from_json(e)) for n, e in d["keys"]],
+                "args": [expr_from_json(a) for a in d["args"]],
+                "ops": list(d["ops"])}
+    raise ValueError(f"unknown fragment stage {op!r}")
+
+
+@dataclasses.dataclass
+class PlanFragment:
+    """The unit shipped to a datanode: an ordered pipeline of plan
+    stages the region executes over its own rows, classified by the
+    frontend as region-side-commutative (the reference classifies every
+    plan node the same way and pushes the whole commutative prefix,
+    query/src/dist_plan/analyzer.rs:35 + commutativity.rs:27-52):
+
+    - filter / prune are Commutative: they run fully region-side
+    - sort + limit are PartialCommutative: regions pre-truncate to k
+      candidates, the frontend re-sorts and re-limits the union
+    - partial_agg is the Partial half of the Partial/Final aggregate
+      split: regions return primitive planes, the frontend combines
+
+    What returns over the wire is the terminal stage's output — partial
+    planes, k candidate rows, or filtered/pruned rows — never a raw
+    region scan."""
+
+    stages: list          # ordered stage dicts, see _stage_to_json
     ts_range: Optional[tuple] = None
     append_mode: bool = False  # skip LWW dedup on append-only tables
     tz: Optional[str] = None  # session timezone for naive ts literals
 
+    def stage(self, op: str) -> Optional[dict]:
+        for st in self.stages:
+            if st["op"] == op:
+                return st
+        return None
+
     def to_json(self) -> str:
         return json.dumps({
-            "keys": [[n, expr_to_json(e)] for n, e in self.keys],
-            "args": [expr_to_json(a) for a in self.args],
-            "ops": list(self.ops),
-            "where": expr_to_json(self.where),
+            "stages": [_stage_to_json(st) for st in self.stages],
             "ts_range": list(self.ts_range) if self.ts_range else None,
             "append_mode": self.append_mode,
             "tz": self.tz,
         })
 
     @staticmethod
-    def from_json(s: str) -> "AggFragment":
+    def from_json(s: str) -> "PlanFragment":
         d = json.loads(s)
-        return AggFragment(
-            keys=[(n, expr_from_json(e)) for n, e in d["keys"]],
-            args=[expr_from_json(a) for a in d["args"]],
-            ops=list(d["ops"]),
-            where=expr_from_json(d["where"]),
-            ts_range=tuple(d["ts_range"]) if d["ts_range"] else None,
-            append_mode=bool(d.get("append_mode", False)),
-            tz=d.get("tz"),
-        )
-
-
-@dataclasses.dataclass
-class TopkFragment:
-    """Sort/limit pushdown for non-aggregate scans: each region filters,
-    sorts by `sort_keys` and returns only its top `k` rows; the frontend
-    merges the per-region candidates and applies the final sort+limit.
-    Mirrors the reference's commutativity classification — Sort+Limit
-    commute with MergeScan when every region pre-truncates to k
-    (query/src/dist_plan/commutativity.rs:27-52: Limit is
-    PartialCommutative)."""
-
-    sort_keys: list       # [(Expr, asc: bool)]
-    k: int                # limit + offset: candidates each region returns
-    columns: Optional[list] = None  # projection (None = all)
-    where: Optional[ast.Expr] = None
-    ts_range: Optional[tuple] = None
-    append_mode: bool = False
-    tz: Optional[str] = None  # session timezone for naive ts literals
-
-    def to_json(self) -> str:
-        return json.dumps({
-            "sort_keys": [[expr_to_json(e), asc] for e, asc in self.sort_keys],
-            "k": self.k,
-            "columns": list(self.columns) if self.columns else None,
-            "where": expr_to_json(self.where),
-            "ts_range": list(self.ts_range) if self.ts_range else None,
-            "append_mode": self.append_mode,
-            "tz": self.tz,
-        })
-
-    @staticmethod
-    def from_json(s: str) -> "TopkFragment":
-        d = json.loads(s)
-        return TopkFragment(
-            sort_keys=[(expr_from_json(e), bool(asc))
-                       for e, asc in d["sort_keys"]],
-            k=int(d["k"]),
-            columns=list(d["columns"]) if d["columns"] else None,
-            where=expr_from_json(d["where"]),
+        return PlanFragment(
+            stages=[_stage_from_json(st) for st in d["stages"]],
             ts_range=tuple(d["ts_range"]) if d["ts_range"] else None,
             append_mode=bool(d.get("append_mode", False)),
             tz=d.get("tz"),

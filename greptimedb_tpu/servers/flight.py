@@ -266,18 +266,12 @@ class FlightServer(fl.FlightServerBase):
                 raise fl.FlightUnauthorizedError(
                     f"user {user.username!r} lacks read permission")
             return self._region_scan(req["region_scan"])
-        if "region_agg" in req:
+        if "region_frag" in req:
             user = self._resolve_user(context)
             if user is not None and not user.can("read"):
                 raise fl.FlightUnauthorizedError(
                     f"user {user.username!r} lacks read permission")
-            return self._region_agg(req["region_agg"])
-        if "region_topk" in req:
-            user = self._resolve_user(context)
-            if user is not None and not user.can("read"):
-                raise fl.FlightUnauthorizedError(
-                    f"user {user.username!r} lacks read permission")
-            return self._region_topk(req["region_topk"])
+            return self._region_frag(req["region_frag"])
         if self.qe is None:
             raise fl.FlightServerError("datanode service: region tickets only")
         ctx = QueryContext(db=req.get("db", "public"), channel=Channel.GRPC,
@@ -328,53 +322,39 @@ class FlightServer(fl.FlightServerBase):
                 [], schema=pa.schema([], metadata={b"empty": b"1"})))
         return fl.RecordBatchStream(scan_to_table(scan))
 
-    def _region_agg(self, req: dict):
-        """Partial-aggregate pushdown: the fragment (plan_ser.AggFragment,
-        the substrait analog) executes against the LOCAL region and only
-        primitive planes cross the wire (reference dist_plan Partial step,
-        query/src/dist_plan/analyzer.rs:35)."""
-        from greptimedb_tpu.query.dist_agg import partial_region_agg
-        from greptimedb_tpu.query.plan_ser import AggFragment
+    def _region_frag(self, req: dict):
+        """Plan-fragment pushdown: the PlanFragment (the substrait
+        analog) executes against the LOCAL region and only the terminal
+        stage's output crosses the wire — partial planes (tagged
+        kind=partial) or candidate/filtered rows (kind=rows), never the
+        raw scan (reference dist_plan Partial step, analyzer.rs:35)."""
+        from greptimedb_tpu.query.dist_agg import execute_region_fragment
+        from greptimedb_tpu.query.plan_ser import PlanFragment
         from greptimedb_tpu.utils import tracing
 
         region_id = req["region_id"]
-        frag = AggFragment.from_json(req["fragment"])
+        frag = PlanFragment.from_json(req["fragment"])
         if req.get("trace_id"):
             tracing.set_trace(req["trace_id"])
         if self._agg_executor is None:
             from greptimedb_tpu.query.physical import PhysicalExecutor
             self._agg_executor = PhysicalExecutor(self.engine)
-        with tracing.span("region_agg", region=region_id):
-            part = partial_region_agg(self._agg_executor, region_id, frag)
+        with tracing.span("region_frag", region=region_id):
+            part = execute_region_fragment(self._agg_executor, region_id,
+                                           frag)
         if part is None:
             return fl.RecordBatchStream(pa.Table.from_arrays(
                 [], schema=pa.schema([], metadata={b"empty": b"1"})))
-        return fl.RecordBatchStream(partial_to_table(part))
-
-    def _region_topk(self, req: dict):
-        """Sort/limit pushdown: only k candidate rows per region cross
-        the wire (TopkFragment; reference commutativity.rs Limit =
-        PartialCommutative over MergeScan)."""
-        from greptimedb_tpu.query.dist_agg import partial_region_topk
-        from greptimedb_tpu.query.plan_ser import TopkFragment
-        from greptimedb_tpu.utils import tracing
-
-        region_id = req["region_id"]
-        frag = TopkFragment.from_json(req["fragment"])
-        if req.get("trace_id"):
-            tracing.set_trace(req["trace_id"])
-        if self._agg_executor is None:
-            from greptimedb_tpu.query.physical import PhysicalExecutor
-            self._agg_executor = PhysicalExecutor(self.engine)
-        with tracing.span("region_topk", region=region_id):
-            part = partial_region_topk(self._agg_executor, region_id, frag)
-        if part is None:
-            return fl.RecordBatchStream(pa.Table.from_arrays(
-                [], schema=pa.schema([], metadata={b"empty": b"1"})))
+        if "planes" in part:
+            return fl.RecordBatchStream(partial_to_table(part))
         cols = part["cols"]
         arrays = [pa.array(cols[name]) for name in cols]
         return fl.RecordBatchStream(pa.Table.from_arrays(
-            arrays, names=list(cols)))
+            arrays,
+            schema=pa.schema(
+                [pa.field(name, a.type)
+                 for name, a in zip(cols, arrays)],
+                metadata={b"kind": b"rows"})))
 
     # -- ingest ----------------------------------------------------------------
 
@@ -660,45 +640,32 @@ class RemoteRegionEngine:
             return None
         return table_to_scan(t)
 
-    def partial_agg(self, region_id: int, frag) -> Optional[dict]:
-        """Ship an AggFragment; receive this region's partial planes
-        (reference region_server.rs:623-660 — substrait plan in, stream
-        out; only per-group primitives cross the wire, not rows)."""
+    def execute_fragment(self, region_id: int, frag) -> Optional[dict]:
+        """Ship a PlanFragment; receive the terminal stage's output —
+        partial planes or candidate/filtered rows, distinguished by the
+        response's kind metadata (reference region_server.rs:623-660 —
+        substrait plan in, stream out; raw scans never cross here)."""
         from greptimedb_tpu.utils import tracing
 
         spec = {"region_id": region_id, "fragment": frag.to_json()}
         tid = tracing.current_trace_id()
         if tid:
             spec["trace_id"] = tid
-        with tracing.span("remote_region_agg", region=region_id,
+        with tracing.span("remote_region_frag", region=region_id,
                           addr=self.addr):
-            ticket = fl.Ticket(json.dumps({"region_agg": spec}).encode())
+            ticket = fl.Ticket(json.dumps({"region_frag": spec}).encode())
             t = self.client.do_get(ticket).read_all()
-        if (t.schema.metadata or {}).get(b"empty") == b"1":
+        md = t.schema.metadata or {}
+        if md.get(b"empty") == b"1":
             return None
+        if md.get(b"kind") == b"rows":
+            t = t.combine_chunks()
+            cols = {}
+            for i, name in enumerate(t.column_names):
+                col = t.column(i)
+                cols[name] = col.to_numpy(zero_copy_only=False)
+            return {"cols": cols}
         return table_to_partial(t)
-
-    def partial_topk(self, region_id: int, frag) -> Optional[dict]:
-        """Ship a TopkFragment; receive this region's k candidate rows."""
-        from greptimedb_tpu.utils import tracing
-
-        spec = {"region_id": region_id, "fragment": frag.to_json()}
-        tid = tracing.current_trace_id()
-        if tid:
-            spec["trace_id"] = tid
-        with tracing.span("remote_region_topk", region=region_id,
-                          addr=self.addr):
-            ticket = fl.Ticket(json.dumps({"region_topk": spec}).encode())
-            t = self.client.do_get(ticket).read_all()
-        if (t.schema.metadata or {}).get(b"empty") == b"1":
-            return None
-        t = t.combine_chunks()
-        cols = {}
-        for i, name in enumerate(t.column_names):
-            col = t.column(i)
-            arr = col.to_numpy(zero_copy_only=False)
-            cols[name] = arr
-        return {"cols": cols}
 
     def scan_stream(self, region_id: int, ts_range=None, projection=None,
                     tag_predicates=None):

@@ -295,7 +295,7 @@ class TestTracingAnalyze:
         text = "\n".join(row[0] for row in r.rows())
         assert "ANALYZE trace=" in text
         # decomposable multi-region aggregate takes the pushdown path
-        assert "agg_pushdown:" in text
+        assert "fragment_pushdown:" in text
         assert "execution path: pushdown" in text
         # a host order-statistic is not decomposable: raw gather path,
         # with per-region scan spans
@@ -321,8 +321,8 @@ class TestTracingAnalyze:
         spans = tracing.spans_for("feedbeefcafe0001")
         names = {s.name for s in spans}
         # pushdown path: fragment client span + server-side span
-        assert "remote_region_agg" in names
-        assert "region_agg" in names
+        assert "remote_region_frag" in names
+        assert "region_frag" in names
         # non-decomposable aggregate exercises the raw scan transport
         ctx2 = QueryContext(trace_id="feedbeefcafe0002")
         c.frontend.execute_one(
