@@ -9,9 +9,14 @@ run in the same process and land in detail.configs:
   2. double_groupby_all    — avg of 10 fields by (hour, hostname) (2215.44)
   3. lastpoint             — newest row per host via last_value (6756.12)
   4. high_cpu_all          — full-scan filter usage_user > 90 (5402.31)
-  5. promql_rate           — TQL rate() over PROM_SERIES series @15s
+  5. promql_rate           — TQL rate() over PROM_SERIES series @15s,
+                             full ingested span + trailing-10m window
   6. high_cardinality      — segment-sum over HC_COMBOS tag combos
   7. compaction_reencode   — L0→L1 merge re-encode throughput (rows/s)
+  8. sql_insert            — durable SQL INSERT statement path (rows/s)
+  9. qps_single_groupby    — 50 keep-alive HTTP clients (ref 1165.73 qps)
+ 10. stream_large          — 100M-row streaming groupby (runs when the
+                             wall-clock budget allows; BENCH_STREAM_ROWS)
 
 Pipeline measured end-to-end through the SQL engine: SQL parse -> plan ->
 region scan (SST/memtable) -> device blocks -> fused filter+group+segment
@@ -288,12 +293,24 @@ def bench_promql(engine, qe, results):
     engine.flush(rid)
     t0_s = T0_MS // 1000
     t_end_s = t0_s + PROM_HOURS * 3600
-    tql = (f"TQL EVAL ({t_end_s - 600}, {t_end_s}, '60s') "
+    # evaluate over the FULL ingested span at the dashboard step (the
+    # tracked config is rate over the whole retention window, not a
+    # trailing slice — round-3 verdict weak #5), plus the trailing
+    # 10-minute window every dashboard refresh issues
+    step_s = max(60, PROM_HOURS * 3600 // 240)  # ~240 eval points
+    tql = (f"TQL EVAL ({t0_s}, {t_end_s}, '{step_s}s') "
            "sum(rate(prom_cpu[2m]))")
     p50, warm, nrows, _ = timed_sql(qe, tql)
-    log(f"promql rate: {p50:.1f} ms (warm-up {warm:.0f} ms)")
+    tql_tail = (f"TQL EVAL ({t_end_s - 600}, {t_end_s}, '60s') "
+                "sum(rate(prom_cpu[2m]))")
+    p50_tail, _, _, _ = timed_sql(qe, tql_tail)
+    log(f"promql rate: full-span {p50:.1f} ms, trailing-10m "
+        f"{p50_tail:.1f} ms (warm-up {warm:.0f} ms)")
     results["promql_rate"] = {
-        "p50_ms": round(p50, 2), "series": PROM_SERIES,
+        "p50_ms": round(p50, 2), "span": "full",
+        "eval_points": (t_end_s - t0_s) // step_s,
+        "tail_10m_p50_ms": round(p50_tail, 2),
+        "series": PROM_SERIES,
         "hours": PROM_HOURS, "rows": rows, "baseline_ms": None,
         "vs_baseline": None}
 
@@ -798,11 +815,14 @@ def supervise():
         if remaining <= 60:
             last_err = f"total budget {total_s}s exhausted before attempt {i}"
             break
-        env = dict(os.environ, BENCH_CHILD="1", **extra_env)
         label = "default backend" if not extra_env else "cpu fallback"
         # non-final attempts may not starve the fallback: reserve it a slice
         attempt_s = remaining if i == len(attempts) \
             else max(60, remaining - 900)
+        # the child sizes opt-in configs (stream_large) against its OWN
+        # budget — hand it the attempt deadline, not the global default
+        env = dict(os.environ, BENCH_CHILD="1",
+                   BENCH_TOTAL_TIMEOUT_S=str(int(attempt_s)), **extra_env)
         log(f"supervisor: attempt {i}/{len(attempts)} ({label}), "
             f"timeout {attempt_s:.0f}s")
         try:

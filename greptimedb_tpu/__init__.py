@@ -31,6 +31,15 @@ import jax
 # to f32/bf16 explicitly where profitable.
 jax.config.update("jax_enable_x64", True)
 
+# Operability escape hatch: pin the jax platform regardless of what the
+# host's sitecustomize forces (JAX_PLATFORMS alone is overridden there).
+# A server on a box whose accelerator tunnel is down would otherwise
+# hang forever inside backend init — GREPTIMEDB_TPU_PLATFORM=cpu keeps
+# it serving on the host tier.
+_plat = _os.environ.get("GREPTIMEDB_TPU_PLATFORM")
+if _plat:
+    jax.config.update("jax_platforms", _plat)
+
 # Persistent XLA compilation cache: first-compile of the fused aggregation
 # program costs ~20-40s on TPU; caching it on disk makes every later
 # process (server restarts, the bench, CLI tools) start warm. Opt out with

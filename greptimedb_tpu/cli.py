@@ -85,7 +85,12 @@ def cmd_standalone(args):
     frontend/src/server.rs:174-263 Services::build — always HTTP, optional
     Flight/MySQL/Postgres, plus the export-metrics self-scrape)."""
     from greptimedb_tpu.options import load_options
+    from greptimedb_tpu.parallel.mesh import init_distributed
 
+    # cross-host mesh: must join the jax.distributed job BEFORE the
+    # first backend touch so jax.devices() is the global device list
+    # (no-op unless GREPTIMEDB_TPU_COORDINATOR is configured)
+    init_distributed()
     overrides: dict = {}
     if args.http_addr:
         overrides.setdefault("http", {})["addr"] = args.http_addr

@@ -30,6 +30,59 @@ COLLECTIVE_OPS = ("sum", "count", "min", "max", "rows", "sumsq",
                   "first", "last")
 
 
+def init_distributed(coordinator: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> bool:
+    """Join this process to a cross-host jax.distributed job so the mesh
+    spans every host's chips — the multi-host analog of the reference's
+    NCCL/MPI data plane (SURVEY §2.6 item 6: collectives ride ICI inside
+    a pod and DCN across pods; XLA picks the transport per mesh axis).
+
+    Configuration (args override env):
+      GREPTIMEDB_TPU_COORDINATOR   host:port of process 0
+      GREPTIMEDB_TPU_NUM_PROCESSES total host processes in the job
+      GREPTIMEDB_TPU_PROCESS_ID    this process's rank
+
+    Returns True when a multi-process runtime was initialized; False for
+    the single-host default (nothing configured — jax.devices() already
+    sees every local chip, so the mesh machinery works unchanged). Call
+    BEFORE the first backend touch (the servers call it at startup);
+    after it, `jax.devices()` returns the GLOBAL device list and
+    make_mesh() lays shard axes across hosts — keep the "field" axis
+    within a host so its all-gathers stay on ICI while the "shard"
+    psum crosses DCN once per query (the partial-combine is tiny:
+    [G, F] planes, not rows)."""
+    import os
+
+    coordinator = coordinator or os.environ.get(
+        "GREPTIMEDB_TPU_COORDINATOR")
+    if not coordinator:
+        return False
+    if num_processes is None:
+        env_n = os.environ.get("GREPTIMEDB_TPU_NUM_PROCESSES")
+        num_processes = int(env_n) if env_n else None  # None: auto-detect
+    if process_id is None:
+        env_p = os.environ.get("GREPTIMEDB_TPU_PROCESS_ID")
+        process_id = int(env_p) if env_p else None
+    already = getattr(jax.distributed, "is_initialized", None)
+    if already is not None and already():
+        return True  # idempotent: embedding + multiple server entries
+    import logging
+
+    # initialize() blocks until the job assembles (up to its 300s
+    # timeout) — say what we are waiting on BEFORE the silence
+    logging.getLogger(__name__).info(
+        "joining jax.distributed job: coordinator=%s processes=%s rank=%s",
+        coordinator, num_processes if num_processes is not None else "auto",
+        process_id if process_id is not None else "auto")
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return True
+
+
 def make_mesh(
     devices: Optional[Sequence] = None,
     shape: Optional[tuple[int, int]] = None,

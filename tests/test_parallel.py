@@ -206,3 +206,43 @@ class TestShardedPrepared:
                     np.asarray(c1, float), np.asarray(c2, float),
                     rtol=1e-12, err_msg=name)
         engine.close()
+
+
+class TestDistributedInit:
+    """Cross-host mesh bootstrap (parallel/mesh.py::init_distributed):
+    single-host is a no-op; configuration comes from env or args; the
+    global mesh machinery is exactly the local one after init."""
+
+    def test_noop_without_coordinator(self, monkeypatch):
+        from greptimedb_tpu.parallel.mesh import init_distributed
+
+        monkeypatch.delenv("GREPTIMEDB_TPU_COORDINATOR", raising=False)
+        assert init_distributed() is False  # backend untouched
+
+    def test_env_config_parsed(self, monkeypatch):
+        import greptimedb_tpu.parallel.mesh as m
+
+        calls = {}
+
+        def fake_init(coordinator_address, num_processes, process_id):
+            calls.update(addr=coordinator_address, n=num_processes,
+                         pid=process_id)
+
+        monkeypatch.setenv("GREPTIMEDB_TPU_COORDINATOR", "10.0.0.1:8476")
+        monkeypatch.setenv("GREPTIMEDB_TPU_NUM_PROCESSES", "4")
+        monkeypatch.setenv("GREPTIMEDB_TPU_PROCESS_ID", "2")
+        monkeypatch.setattr(m.jax.distributed, "initialize", fake_init)
+        assert m.init_distributed() is True
+        assert calls == {"addr": "10.0.0.1:8476", "n": 4, "pid": 2}
+
+    def test_args_override_env(self, monkeypatch):
+        import greptimedb_tpu.parallel.mesh as m
+
+        calls = {}
+        monkeypatch.setenv("GREPTIMEDB_TPU_COORDINATOR", "env:1")
+        monkeypatch.setattr(
+            m.jax.distributed, "initialize",
+            lambda coordinator_address, num_processes, process_id:
+            calls.update(addr=coordinator_address))
+        assert m.init_distributed("arg:2", 1, 0) is True
+        assert calls["addr"] == "arg:2"
