@@ -43,9 +43,15 @@ def query_mesh():
     if env.lower() in ("off", "0", "none"):
         return None
     try:
-        n = jax.device_count()
+        # LOCAL devices only: the scan data plane device_puts
+        # process-local arrays, which cannot target another host's
+        # chips. Cross-host distribution rides the PlanFragment
+        # pushdown over Flight instead (parallel/mesh.init_distributed
+        # docstring has the division of labor).
+        local = jax.local_devices()
     except Exception:
         return None
+    n = len(local)
     from greptimedb_tpu.parallel.mesh import make_mesh
 
     if env not in ("auto", ""):
@@ -53,10 +59,10 @@ def query_mesh():
         shape = (int(s), int(f or 1))
         if shape[0] * shape[1] > n:
             raise ValueError(f"mesh {shape} needs {shape[0]*shape[1]} devices, have {n}")
-        return make_mesh(jax.devices()[: shape[0] * shape[1]], shape)
+        return make_mesh(local[: shape[0] * shape[1]], shape)
     if n <= 1:
         return None
-    return make_mesh()
+    return make_mesh(local)
 
 
 def dense_groups_max() -> int:

@@ -44,14 +44,19 @@ def init_distributed(coordinator: Optional[str] = None,
       GREPTIMEDB_TPU_PROCESS_ID    this process's rank
 
     Returns True when a multi-process runtime was initialized; False for
-    the single-host default (nothing configured — jax.devices() already
-    sees every local chip, so the mesh machinery works unchanged). Call
-    BEFORE the first backend touch (the servers call it at startup);
-    after it, `jax.devices()` returns the GLOBAL device list and
-    make_mesh() lays shard axes across hosts — keep the "field" axis
-    within a host so its all-gathers stay on ICI while the "shard"
-    psum crosses DCN once per query (the partial-combine is tiny:
-    [G, F] planes, not rows)."""
+    the single-host default. Call BEFORE the first backend touch (the
+    standalone CLI does, at startup).
+
+    Division of labor after init: the QUERY mesh stays over this host's
+    local chips (config.query_mesh uses jax.local_devices() — the data
+    plane feeds it process-local arrays, which cannot target another
+    host's devices), while CROSS-host distribution continues to ride the
+    region-level PlanFragment pushdown over Flight: each host reduces
+    its own regions on its own mesh and ships [G, F] partial planes, so
+    only the tiny Final combine crosses DCN — the same Partial/Final
+    economics the reference gets from its datanode RPC fan-out. A future
+    full-SPMD scan (jax.make_array_from_process_local_data + a global
+    mesh) would slot in behind the same sharded_segment_agg contract."""
     import os
 
     coordinator = coordinator or os.environ.get(
@@ -67,14 +72,16 @@ def init_distributed(coordinator: Optional[str] = None,
     already = getattr(jax.distributed, "is_initialized", None)
     if already is not None and already():
         return True  # idempotent: embedding + multiple server entries
-    import logging
+    import sys
 
     # initialize() blocks until the job assembles (up to its 300s
-    # timeout) — say what we are waiting on BEFORE the silence
-    logging.getLogger(__name__).info(
-        "joining jax.distributed job: coordinator=%s processes=%s rank=%s",
-        coordinator, num_processes if num_processes is not None else "auto",
-        process_id if process_id is not None else "auto")
+    # timeout) — say what we are waiting on BEFORE the silence. stderr,
+    # not logging: nothing configures a logging handler at startup.
+    print(
+        f"joining jax.distributed job: coordinator={coordinator} "
+        f"processes={num_processes if num_processes is not None else 'auto'}"
+        f" rank={process_id if process_id is not None else 'auto'}",
+        file=sys.stderr, flush=True)
     jax.distributed.initialize(
         coordinator_address=coordinator,
         num_processes=num_processes,
