@@ -46,11 +46,38 @@ if _plat:
 # GREPTIMEDB_TPU_COMPILE_CACHE=off, redirect with =<dir>.
 _cc = _os.environ.get("GREPTIMEDB_TPU_COMPILE_CACHE", "")
 if _cc.lower() not in ("off", "0", "none", "false", "no", "disabled"):
+    def _host_salt() -> str:
+        """CPU-feature fingerprint in the cache path: XLA's cache key
+        ignores the host microarchitecture, and on shared VMs that
+        MIGRATE between machine types it loads AOT results compiled for
+        the other profile (observed: +prefer-no-scatter executables
+        running the slow non-scatter codegen here, with a cpu_aot_loader
+        'could lead to SIGILL' warning). A per-profile directory means a
+        mismatched executable is never loaded."""
+        try:
+            import hashlib
+
+            keep = ("flags", "model name", "model\t", "cpu family",
+                    "stepping", "vendor_id")
+            lines = []
+            with open("/proc/cpuinfo", encoding="utf-8") as f:
+                for line in f:
+                    if line.startswith(keep):
+                        lines.append(line)
+                    if line.strip() == "" and lines:
+                        break  # first core is representative
+            if lines:
+                return hashlib.sha256(
+                    "".join(lines).encode()).hexdigest()[:12]
+        except OSError:
+            pass
+        return "noflags"
+
     try:
         jax.config.update(
             "jax_compilation_cache_dir",
             _cc or _os.path.join(_os.path.expanduser("~"), ".cache",
-                                 "greptimedb_tpu_xla"))
+                                 f"greptimedb_tpu_xla_{_host_salt()}"))
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
     except Exception:  # noqa: BLE001 — older jax: feature is optional
         pass

@@ -210,13 +210,22 @@ def segment_agg(
                 ids, num_segments=gsz, indices_are_sorted=indices_are_sorted,
             )
             at_best = row_mask & (ts == best_ts[ids])
-            best_idx = jax.ops.segment_max(
-                jnp.where(at_best, idx, -1), ids, num_segments=gsz,
-                indices_are_sorted=indices_are_sorted,
+            # tie-break by MIN row index: the earliest-positioned sample
+            # of the earliest instant. Symmetric with `last` (max ts, max
+            # idx) and identical to the sorted-input bucketization in
+            # ops/window.py — the two flavors must match bit-for-bit or
+            # CPU and TPU backends would answer `first` differently for
+            # samples sharing a millisecond. (SQL ties can only arise in
+            # append-mode tables, where the winner is undefined; LWW
+            # dedup removes same-(series, ts) rows everywhere else.)
+            best_idx = jax.ops.segment_min(
+                jnp.where(at_best, idx, jnp.int64(n)), ids,
+                num_segments=gsz, indices_are_sorted=indices_are_sorted,
             )
             safe = jnp.clip(best_idx, 0, n - 1)
             vals = values[safe]
-            out["first"] = jnp.where(best_idx[:, None] >= 0, vals, _null_of(values.dtype))
+            out["first"] = jnp.where(best_idx[:, None] < n, vals,
+                                     _null_of(values.dtype))
             out["first_ts"] = best_ts
 
     # drop the dead padding segment; restore caller's rank

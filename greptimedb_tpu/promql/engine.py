@@ -232,7 +232,8 @@ class PromqlEngine:
         w = max(1, int(math.ceil(lookback / p.step)))
         st = window_stats(sidx, ts, chans, ~jnp.isnan(chans[:, 0]),
                           p.start, p.step, len(labels), p.T, w,
-                          stats=("count", "last"))
+                          stats=("count", "last"),
+                          sorted_input=_sorted_ws())
         vals = st["last"][:, :, 0]
         lts = st["last_ts"]
         # exact lookback: bucket window may overcover; validate sample ts
@@ -261,7 +262,8 @@ class PromqlEngine:
             return None
         sidx, ts, chans, labels, metric = loaded
         st = window_stats(sidx, ts, chans, ~jnp.isnan(chans[:, 0]),
-                          p.start, p.step, len(labels), p.T, w, stats=stats)
+                          p.start, p.step, len(labels), p.T, w,
+                          stats=stats, sorted_input=_sorted_ws())
         return st, labels, metric, w, range_s
 
     def _load_any(self, sel, p: EvalParams, ctx, window: float,
@@ -408,6 +410,32 @@ class PromqlEngine:
         if scan is None or scan.num_rows == 0:
             return None
 
+        # loaded-series cache: everything below (matcher masks, series
+        # factorization + label decode, the 9.6M-row device lexsort,
+        # channel building) is query-invariant for a given scan snapshot
+        # + selector — the PromQL analog of the prepared planes. Keyed on
+        # the scan identity, so data_version changes invalidate; "deriv"
+        # channels embed p.start and key on it.
+        ex = getattr(self.qe, "executor", None)
+        lcache = None
+        ckey = None
+        if ex is not None and scan.region_id >= 0:
+            lcache = getattr(ex, "_promql_load_cache", None)
+            if lcache is None:
+                from collections import OrderedDict
+
+                lcache = ex._promql_load_cache = OrderedDict()
+            ckey = (scan.region_id, scan.data_version,
+                    scan.scan_fingerprint, field_name, offset,
+                    tuple(sorted((m.label, m.op, m.value) for m in rest)),
+                    tuple(extra_channels), not info.append_mode,
+                    p.start if "deriv" in extra_channels else None)
+            hit = lcache.get(ckey)
+            if hit is not None:
+                lcache.move_to_end(ckey)
+                d_sidx, d_ts, channels, labels = hit
+                return d_sidx, d_ts, channels, labels, metric
+
         tag_names = [c.name for c in schema.tag_columns]
         mask = np.ones(scan.num_rows, dtype=bool)
         for m in rest:
@@ -462,6 +490,10 @@ class PromqlEngine:
 
         channels = self._make_channels(d_sidx, d_ts, d_vals,
                                        extra_channels, p)
+        if lcache is not None:
+            lcache[ckey] = (d_sidx, d_ts, channels, labels)
+            while len(lcache) > 4:
+                lcache.popitem(last=False)
         return d_sidx, d_ts, channels, labels, metric
 
     # ---- calls -------------------------------------------------------------
@@ -820,7 +852,8 @@ class PromqlEngine:
         chans = jnp.concatenate([chans, chans[:, :1] ** 2], axis=1)
         st = window_stats(sidx, ts, chans, ~jnp.isnan(chans[:, 0]),
                           p.start, p.step, len(labels), p.T, w,
-                          stats=("sum", "count"))
+                          stats=("sum", "count"),
+                          sorted_input=_sorted_ws())
         return st, labels, metric, w, range_s
 
     # ---- aggregation -------------------------------------------------------
@@ -1144,6 +1177,17 @@ def _absent_labels(node) -> dict:
         return {m.label: m.value for m in sel.matchers
                 if m.op == "=" and m.label not in ("__name__", "__field__")}
     return {}
+
+
+def _sorted_ws() -> bool:
+    """Bucketization flavor for window_stats: XLA lowers scatter-adds
+    fine on CPU (measured 2.8x faster than the searchsorted/cumsum path
+    at 9.6M samples), but on TPU scatters serialize row-by-row — there
+    the sorted-input boundary path wins. Inputs are (series, ts)-sorted
+    either way (_load lexsorts)."""
+    import jax
+
+    return jax.default_backend() in ("tpu", "axon")
 
 
 def _matcher_mask(m: Matcher, scan, tag_names) -> np.ndarray:

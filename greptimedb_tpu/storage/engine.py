@@ -250,6 +250,10 @@ class RegionEngine:
     ) -> Optional[ScanData]:
         return self.region(region_id).scan(ts_range, projection, tag_predicates)
 
+    def ts_extent(self, region_id: int):
+        """(min, max) data timestamps from metadata only (no data read)."""
+        return self.region(region_id).ts_extent()
+
     def alter_region_schema(self, region_id: int, schema: Schema) -> None:
         """Apply an ALTER'd schema to a region: flush under the old schema,
         then swap and record (reference worker/handle_alter.rs)."""

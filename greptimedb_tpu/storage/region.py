@@ -691,6 +691,18 @@ class Region:
     def num_sst_rows(self) -> int:
         return sum(f.num_rows for f in self.files.values())
 
+    def ts_extent(self) -> Optional[tuple[int, int]]:
+        """(min, max) timestamp over SST metas + memtable, or None when
+        the region is empty — metadata only, no data read (drives the
+        bucket-top-k scan narrowing, physical.py)."""
+        with self._lock:
+            bounds = [(m.ts_min, m.ts_max) for m in self.files.values()]
+            if self.memtable.ts_min is not None:
+                bounds.append((self.memtable.ts_min, self.memtable.ts_max))
+        if not bounds:
+            return None
+        return (min(b[0] for b in bounds), max(b[1] for b in bounds))
+
     @property
     def memtable_bytes(self) -> int:
         return self.memtable.bytes_estimate

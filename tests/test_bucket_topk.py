@@ -1,0 +1,138 @@
+"""Bucket-top-k scan narrowing (physical.py::_bucket_topk_ranges):
+`GROUP BY date_bin(...) ORDER BY <bucket> DESC LIMIT k` scans only the
+newest k buckets, widening geometrically when data is sparse. Every case
+cross-checks against the un-narrowed execution."""
+
+import numpy as np
+import pytest
+
+from greptimedb_tpu.catalog import Catalog, MemoryKv
+from greptimedb_tpu.query import QueryEngine
+from greptimedb_tpu.query.physical import PhysicalExecutor
+from greptimedb_tpu.storage import RegionEngine
+from greptimedb_tpu.storage.engine import EngineConfig
+
+
+@pytest.fixture
+def db(tmp_path):
+    engine = RegionEngine(EngineConfig(data_dir=str(tmp_path / "data")))
+    qe = QueryEngine(Catalog(MemoryKv()), engine)
+    yield qe
+    engine.close()
+
+
+def _seed(db, minutes=120, per_min=20, gap=None):
+    db.execute_one(
+        "CREATE TABLE m (host STRING, v DOUBLE, ts TIMESTAMP(3) NOT NULL, "
+        "TIME INDEX (ts), PRIMARY KEY (host)) WITH (append_mode='true')")
+    from greptimedb_tpu.datatypes import DictVector, RecordBatch
+
+    info = db.catalog.table("public", "m")
+    rng = np.random.default_rng(2)
+    rows = []
+    for mi in range(minutes):
+        if gap and gap[0] <= mi < gap[1]:
+            continue  # sparse stretch: no data at all
+        for p in range(per_min):
+            rows.append((mi * 60000 + p * 2000,
+                         round(float(rng.uniform(0, 100)), 6)))
+    ts = np.asarray([r[0] for r in rows], dtype=np.int64)
+    v = np.asarray([r[1] for r in rows])
+    codes = np.zeros(len(rows), dtype=np.int32)
+    db.region_engine.put(info.region_ids[0], RecordBatch(info.schema, {
+        "host": DictVector(codes, np.asarray(["h0"], dtype=object)),
+        "v": v, "ts": ts}))
+    db.region_engine.flush(info.region_ids[0])
+    return rows
+
+
+def _run_both(db, sql):
+    fast = db.execute_one(sql)
+    used = (db.executor.last_path or "").startswith("bucket_topk+")
+    orig = PhysicalExecutor._bucket_topk_ranges
+    PhysicalExecutor._bucket_topk_ranges = lambda self, *a, **k: None
+    try:
+        slow = db.execute_one(sql)
+    finally:
+        PhysicalExecutor._bucket_topk_ranges = orig
+    return fast.rows(), slow.rows(), used
+
+
+DESC_SQL = ("SELECT date_bin(INTERVAL '1 minute', ts) AS minute, max(v), "
+            "count(*) FROM m GROUP BY minute ORDER BY minute DESC LIMIT 5")
+
+
+def test_desc_limit_matches_full(db):
+    _seed(db)
+    fast, slow, used = _run_both(db, DESC_SQL)
+    assert used
+    assert fast == slow
+    assert len(fast) == 5
+    assert fast[0][0] == 119 * 60000  # newest bucket first
+
+
+def test_with_ts_upper_bound(db):
+    _seed(db)
+    cutoff = 90 * 60000
+    sql = ("SELECT date_bin(INTERVAL '1 minute', ts) AS minute, max(v) "
+           f"FROM m WHERE ts < {cutoff} GROUP BY minute "
+           "ORDER BY minute DESC LIMIT 5")
+    fast, slow, used = _run_both(db, sql)
+    assert used
+    assert fast == slow
+    assert fast[0][0] == 89 * 60000
+
+
+def test_sparse_data_widens(db):
+    # newest 40 minutes empty: the first narrow attempt finds nothing
+    # and the widening loop must still produce the right 5 buckets
+    _seed(db, minutes=120, gap=(80, 120))
+    fast, slow, used = _run_both(db, DESC_SQL)
+    assert fast == slow
+    assert len(fast) == 5
+    assert fast[0][0] == 79 * 60000
+
+
+def test_asc_limit(db):
+    _seed(db)
+    sql = ("SELECT date_bin(INTERVAL '1 minute', ts) AS minute, avg(v) "
+           "FROM m GROUP BY minute ORDER BY minute ASC LIMIT 3")
+    fast, slow, used = _run_both(db, sql)
+    assert used
+    assert fast == slow
+    assert [r[0] for r in fast] == [0, 60000, 120000]
+
+
+def test_offset_counts_toward_k(db):
+    _seed(db)
+    sql = ("SELECT date_bin(INTERVAL '1 minute', ts) AS minute, max(v) "
+           "FROM m GROUP BY minute ORDER BY minute DESC LIMIT 4 OFFSET 3")
+    fast, slow, used = _run_both(db, sql)
+    assert fast == slow
+    assert fast[0][0] == (119 - 3) * 60000
+
+
+def test_fewer_buckets_than_limit(db):
+    _seed(db, minutes=3)
+    fast, slow, used = _run_both(db, DESC_SQL)
+    assert fast == slow
+    assert len(fast) == 3  # all of them, full range covered
+
+
+def test_non_bucket_order_not_narrowed(db):
+    _seed(db, minutes=20)
+    sql = ("SELECT date_bin(INTERVAL '1 minute', ts) AS minute, max(v) AS "
+           "mx FROM m GROUP BY minute ORDER BY mx DESC LIMIT 5")
+    fast, slow, used = _run_both(db, sql)
+    assert not used  # ordering by the aggregate needs every bucket
+    assert fast == slow
+
+
+def test_having_disables(db):
+    _seed(db, minutes=20)
+    sql = ("SELECT date_bin(INTERVAL '1 minute', ts) AS minute, count(*) "
+           "AS c FROM m GROUP BY minute HAVING c > 0 "
+           "ORDER BY minute DESC LIMIT 5")
+    fast, slow, used = _run_both(db, sql)
+    assert not used
+    assert fast == slow
