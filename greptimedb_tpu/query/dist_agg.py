@@ -50,7 +50,12 @@ def execute_region_fragment(executor, region_id: int, frag: PlanFragment,
     sort = frag.stage("sort")
     limit = frag.stage("limit")
     prune = frag.stage("prune")
+    window = frag.stage("window")
     columns = list(prune["columns"]) if prune else None
+    if window is not None:
+        return partial_region_window(executor, region_id, columns,
+                                     window["calls"], schema=schema,
+                                     **common)
     if sort is not None and limit is not None:
         shim = SimpleNamespace(sort_keys=sort["keys"], k=limit["k"],
                                columns=columns, **common)
@@ -94,6 +99,54 @@ def partial_region_rows(executor, region_id: int, columns, k,
         n = len(next(iter(host.values())))
         if n > k:
             host = {name: arr[:k] for name, arr in host.items()}
+    return {"cols": host}
+
+
+def partial_region_window(executor, region_id: int, columns, calls,
+                          *, where, ts_range, append_mode, tz,
+                          schema=None) -> Optional[dict]:
+    """Window-partition pushdown: when every OVER clause's PARTITION BY
+    covers the table's partition-rule columns, each region holds its
+    window partitions WHOLE, so the entire window computation commutes
+    with MergeScan (the reference's ConditionalCommutative class,
+    commutativity.rs) — the wire carries filtered rows plus the computed
+    window columns, never raw scans gathered for a frontend-only pass."""
+    from greptimedb_tpu.query.expr import collect_columns
+    from greptimedb_tpu.query.window import _eval_window
+
+    probe = executor.engine.region(region_id)
+    schema = schema or probe.schema
+    ts_name = schema.time_index.name
+    ts_range = tuple(ts_range) if ts_range else None
+    needed: set[str] = {ts_name}
+    collect_columns(where, needed)
+    for _, call in calls:
+        collect_columns(call, needed)
+    if columns is None:
+        needed.update(schema.names)
+    else:
+        needed.update(columns)
+    host = _region_host_columns(executor, region_id, where, ts_range,
+                                needed, append_mode, schema, tz=tz)
+    if host is None:
+        return None
+    n = len(host[ts_name])
+
+    def resolve(e):
+        return e
+
+    def dtype_of(e):
+        from greptimedb_tpu.sql import ast as _ast
+
+        if isinstance(e, _ast.Column) and e.name in schema.names:
+            return schema.column(e.name).dtype
+        return None
+
+    for name, call in calls:
+        host[name] = _eval_window(call, host, n, resolve, dtype_of)
+    if columns is not None:
+        keep = set(columns) | {name for name, _ in calls}
+        host = {k: v for k, v in host.items() if k in keep}
     return {"cols": host}
 
 

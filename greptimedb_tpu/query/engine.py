@@ -673,6 +673,13 @@ class QueryEngine:
                 return execute_select_over(
                     self, outer, dict(zip(base.names, base.columns)),
                     dict(zip(base.names, base.dtypes)))
+            # window-partition pushdown: PARTITION BY covering the
+            # table's partition-rule columns means each region holds its
+            # window partitions whole — compute the windows region-side
+            # and ship filtered rows + window columns, not raw scans
+            res = self._try_window_pushdown(sel, info, ctx)
+            if res is not None:
+                return res
             # window functions: device scan+filter materializes the base
             # relation, windows evaluate on host over the filtered rows.
             # Project only referenced columns (a Star or an unresolvable
@@ -710,6 +717,107 @@ class QueryEngine:
             return rs.execute_range_select(self.executor, rplan)
         plan = plan_select(sel, info)
         return self.executor.execute(plan)
+
+    def _try_window_pushdown(self, sel: ast.Select, info, ctx):
+        """Ship [filter, prune, window] PlanFragments when every window
+        call's PARTITION BY covers the partition-rule columns (rows of
+        one window partition never span regions — the reference's
+        ConditionalCommutative classification, commutativity.rs). The
+        union of per-region rows + computed window columns feeds the
+        normal outer select. Returns None when the shape doesn't
+        commute — caller falls back to the gather path."""
+        eng = self.region_engine
+        if (len(info.region_ids) <= 1 or not info.partition_rules
+                or not hasattr(eng, "execute_fragment")
+                or sel.having is not None):
+            return None
+        from greptimedb_tpu.partition.rule import RangePartitionRule
+        from greptimedb_tpu.query.expr import extract_ts_bounds
+        from greptimedb_tpu.query.join import _columns_in, execute_select_over
+        from greptimedb_tpu.query.plan_ser import PlanFragment
+        from greptimedb_tpu.query.window import (
+            SUPPORTED,
+            collect_window_calls,
+            substitute_window_calls,
+        )
+
+        rule = info.partition_rules
+        if not isinstance(rule, RangePartitionRule):
+            rule = RangePartitionRule.from_json(json.dumps(rule))
+        rule_cols = set(rule.columns)
+        calls = collect_window_calls(sel)
+        if not calls:
+            return None
+        schema = info.schema
+        names_set = set(schema.names)
+        for fc in calls:
+            if fc.name not in SUPPORTED:
+                return None
+            part_cols = {p.name for p in fc.over.partition_by
+                         if isinstance(p, ast.Column)}
+            if not rule_cols <= part_cols:
+                return None
+        refs: set = set()
+        for it in sel.items:
+            if isinstance(it.expr, ast.Star):
+                return None  # projection set must be statically known
+            _columns_in(it.expr, refs)
+        for ob in sel.order_by:
+            _columns_in(ob.expr, refs)
+        _columns_in(sel.where, refs)
+        alias = sel.table_alias or sel.table
+        if not all(t in (None, alias, sel.table) for t, _ in refs):
+            return None
+        cols = {c for _, c in refs}
+        if not cols <= names_set:
+            return None
+        from greptimedb_tpu.query.expr import current_session_tz
+
+        ts_col = schema.time_index
+        ts_range = extract_ts_bounds(sel.where, ts_col.name, ts_col.dtype)
+        mapping = [(fc, ast.Column(f"__win_{i}"))
+                   for i, fc in enumerate(calls)]
+        stages: list = []
+        if sel.where is not None:
+            stages.append({"op": "filter", "expr": sel.where})
+        stages.append({"op": "prune", "columns": sorted(cols)})
+        stages.append({"op": "window",
+                       "calls": [(col.name, fc) for fc, col in mapping]})
+        frag = PlanFragment(stages=stages, ts_range=ts_range,
+                            append_mode=info.append_mode,
+                            tz=current_session_tz())
+        from concurrent.futures import ThreadPoolExecutor
+
+        from greptimedb_tpu.query.dist_agg import merge_topk
+        from greptimedb_tpu.utils import tracing
+
+        with tracing.span("window_pushdown", regions=len(info.region_ids)):
+            tid = tracing.current_trace_id()
+
+            def one(rid):
+                if tid:
+                    tracing.set_trace(tid)
+                return eng.execute_fragment(rid, frag)
+
+            with ThreadPoolExecutor(
+                    max_workers=min(8, len(info.region_ids))) as pool:
+                partials = list(pool.map(one, info.region_ids))
+        merged = merge_topk(partials)  # column-wise union of region rows
+        outer = substitute_window_calls(
+            dataclasses.replace(sel, where=None, table=None,
+                                table_alias=None),
+            mapping)
+        self.executor.last_path = "window_pushdown"
+        base_cols = merged["cols"] if merged else \
+            {name: np.empty(0, dtype=object)
+             for name in sorted(cols) + [c.name for _, c in mapping]}
+        return execute_select_over(
+            self, outer, base_cols,
+            {c.name: c.dtype for c in schema.columns
+             if c.name in base_cols},
+            # qualified references (alias.col / table.col) passed the
+            # gate; the relation must expose them like the gather path
+            alias=alias)
 
     # ---- DDL ---------------------------------------------------------------
 

@@ -66,6 +66,10 @@ def expr_from_json(obj: Any) -> Any:
 #:   {"op": "limit",       "k": int}
 #:   {"op": "partial_agg", "keys": [(name, Expr)], "args": [Expr],
 #:                         "ops": [primitive op]}           # terminal
+#:   {"op": "window",      "calls": [(name, FuncCall-with-over)]}
+#:       — window functions whose PARTITION BY covers the table's
+#:       partition-rule columns: each region holds its partitions whole,
+#:       so the whole window computation commutes with MergeScan
 
 
 def _stage_to_json(st: dict) -> dict:
@@ -84,6 +88,8 @@ def _stage_to_json(st: dict) -> dict:
         out["keys"] = [[n, expr_to_json(e)] for n, e in st["keys"]]
         out["args"] = [expr_to_json(a) for a in st["args"]]
         out["ops"] = list(st["ops"])
+    elif op == "window":
+        out["calls"] = [[n, expr_to_json(e)] for n, e in st["calls"]]
     else:
         raise ValueError(f"unknown fragment stage {op!r}")
     return out
@@ -105,6 +111,9 @@ def _stage_from_json(d: dict) -> dict:
                 "keys": [(n, expr_from_json(e)) for n, e in d["keys"]],
                 "args": [expr_from_json(a) for a in d["args"]],
                 "ops": list(d["ops"])}
+    if op == "window":
+        return {"op": op,
+                "calls": [(n, expr_from_json(e)) for n, e in d["calls"]]}
     raise ValueError(f"unknown fragment stage {op!r}")
 
 

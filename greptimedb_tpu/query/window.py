@@ -83,13 +83,27 @@ def rewrite_select(sel: ast.Select, cols: dict, n: int, resolve,
             return dtypes.get(r.name)
         return None
 
+    calls = collect_window_calls(sel)
+    if not calls:
+        return sel
+    mapping: list[tuple[ast.FuncCall, ast.Column]] = []
+    for i, fc in enumerate(calls):
+        name = f"__win_{i}"
+        cols[name] = _eval_window(fc, cols, n, resolve, dtype_of)
+        mapping.append((fc, ast.Column(name)))
+    return substitute_window_calls(sel, mapping)
+
+
+def collect_window_calls(sel: ast.Select) -> list:
+    """Distinct window calls in SELECT items and ORDER BY, in first-seen
+    order (window args cannot themselves be windows, per SQL)."""
     calls: list[ast.FuncCall] = []
 
     def collect(e):
         if isinstance(e, ast.FuncCall) and e.over is not None:
             if e not in calls:
                 calls.append(e)
-            return  # window args cannot themselves be windows (SQL)
+            return
         if isinstance(e, (list, tuple)):
             for x in e:
                 collect(x)
@@ -103,13 +117,13 @@ def rewrite_select(sel: ast.Select, cols: dict, n: int, resolve,
         collect(it.expr)
     for ob in sel.order_by:
         collect(ob.expr)
-    if not calls:
-        return sel
-    mapping: list[tuple[ast.FuncCall, ast.Column]] = []
-    for i, fc in enumerate(calls):
-        name = f"__win_{i}"
-        cols[name] = _eval_window(fc, cols, n, resolve, dtype_of)
-        mapping.append((fc, ast.Column(name)))
+    return calls
+
+
+def substitute_window_calls(sel: ast.Select, mapping) -> ast.Select:
+    """Replace each (call, column) pair in items/ORDER BY, keeping the
+    user-visible header when an unaliased call collapses to an internal
+    column reference."""
 
     def replace(e):
         if isinstance(e, ast.FuncCall) and e.over is not None:
@@ -139,8 +153,6 @@ def rewrite_select(sel: ast.Select, cols: dict, n: int, resolve,
         ne = replace(it.expr)
         alias = it.alias
         if alias is None and ne != it.expr:
-            # keep the user-visible header when the window call collapsed
-            # to an internal __win_i column reference
             alias = _expr_name(it.expr)
         items.append(dataclasses.replace(it, expr=ne, alias=alias))
     order_by = [dataclasses.replace(ob, expr=replace(ob.expr))
