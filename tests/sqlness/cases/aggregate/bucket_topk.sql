@@ -1,0 +1,20 @@
+-- bucket-top-k narrowing: ORDER BY <time bucket> LIMIT k scans only the
+-- newest/oldest k buckets (physical.py::_bucket_topk_ranges); results
+-- must be indistinguishable from the full aggregate
+CREATE TABLE bt (host STRING, v DOUBLE, ts TIMESTAMP(3) TIME INDEX, PRIMARY KEY(host));
+
+INSERT INTO bt VALUES ('a', 1.0, 0), ('a', 2.0, 30000), ('a', 3.0, 60000), ('b', 4.0, 90000), ('b', 5.0, 150000), ('a', 6.0, 210000), ('b', 7.0, 211000), ('a', 8.0, 330000);
+
+-- newest 3 minute-buckets (bucket 5 = 330000, 3 = 210000/211000, 2 = 150000)
+SELECT date_bin(INTERVAL '1 minute', ts) AS minute, max(v), count(*) FROM bt GROUP BY minute ORDER BY minute DESC LIMIT 3;
+
+-- oldest 2 buckets
+SELECT date_bin(INTERVAL '1 minute', ts) AS minute, min(v) FROM bt GROUP BY minute ORDER BY minute ASC LIMIT 2;
+
+-- with an upper ts bound and an offset
+SELECT date_bin(INTERVAL '1 minute', ts) AS minute, avg(v) FROM bt WHERE ts < 300000 GROUP BY minute ORDER BY minute DESC LIMIT 2 OFFSET 1;
+
+-- limit beyond the bucket count returns everything
+SELECT date_bin(INTERVAL '2 minutes', ts) AS b, count(*) FROM bt GROUP BY b ORDER BY b DESC LIMIT 50;
+
+DROP TABLE bt;
