@@ -1,8 +1,8 @@
 """External file formats (mirrors reference `common/datasource`,
 src/common/datasource/src/file_format.rs:57-61: CSV / JSON(ndjson) /
 Parquet / ORC, with compression) — backs COPY TO/FROM and the file
-engine. ORC is not in this environment's pyarrow build; it is reported
-as unsupported rather than stubbed silently.
+engine. ORC rides pyarrow.orc; like parquet it is a container format,
+so the .gz wrapper applies only to the text formats.
 """
 
 from __future__ import annotations
@@ -19,7 +19,7 @@ import pyarrow as pa
 from greptimedb_tpu.datatypes.types import DataType, SemanticType
 from greptimedb_tpu.query.result import QueryResult
 
-FORMATS = ("csv", "json", "parquet")
+FORMATS = ("csv", "json", "parquet", "orc")
 
 
 class DataSourceError(Exception):
@@ -54,6 +54,9 @@ def read_file(path: str, fmt: Optional[str] = None) -> pa.Table:
     if fmt == "parquet":
         import pyarrow.parquet as pq
         return pq.read_table(path)
+    if fmt == "orc":
+        import pyarrow.orc as po
+        return po.read_table(path)
     raw = open(path, "rb").read()
     if path.endswith(".gz"):
         raw = gzip.decompress(raw)
@@ -73,6 +76,10 @@ def write_file(table: pa.Table, path: str, fmt: Optional[str] = None) -> int:
     if fmt == "parquet":
         import pyarrow.parquet as pq
         pq.write_table(table, path)
+        return table.num_rows
+    if fmt == "orc":
+        import pyarrow.orc as po
+        po.write_table(table, path)
         return table.num_rows
     buf = io.BytesIO()
     if fmt == "csv":

@@ -29,11 +29,25 @@ its MXU-native replacement for the dense-id case, no hashing at all.
 
 from __future__ import annotations
 
+import contextlib
 import functools
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+
+try:
+    # The engine runs under jax_enable_x64 (int64 timestamps), but x64
+    # tracing poisons Mosaic lowering of ANY gridded pallas_call on real
+    # TPU ("failed to legalize operation 'func.return'" from the AOT
+    # compile helper — reproduced 2026-07-31 on v5e; interpret mode never
+    # sees it). Tracing the pallas_call under an x64-off scope keeps the
+    # grid/index arithmetic i32 and compiles clean. All kernel operands
+    # are explicit f32/i32, so no semantics change.
+    from jax._src.config import enable_x64 as _enable_x64
+except ImportError:  # private API — degrade to "hope x64 is off"
+    def _enable_x64(_v):
+        return contextlib.nullcontext()
 
 #: widest plane the kernel accepts (lane tile); prepared planes are
 #: 2F+1 <= 21 for TSBS's 10 fields
@@ -61,8 +75,12 @@ def _kernel(ids_ref, plane_ref, out_ref):
     # never materialized in HBM
     onehot = (jax.lax.broadcasted_iota(jnp.int32, (gp, nb), 0)
               == ids).astype(plane_ref.dtype)
+    # HIGHEST: the MXU's default f32 matmul is a single bf16 pass
+    # (~1e-2 abs error on segment sums — observed on v5e); multi-pass
+    # recovers f32 accuracy and this workload is bandwidth-bound anyway
     out_ref[...] += jnp.dot(onehot, plane_ref[...],
-                            preferred_element_type=out_ref.dtype)
+                            preferred_element_type=out_ref.dtype,
+                            precision=jax.lax.Precision.HIGHEST)
 
 
 @functools.partial(jax.jit,
@@ -86,17 +104,23 @@ def pallas_dense_segment_sum(
     plane_p = jnp.pad(plane, ((0, npad - n), (0, wp - w)))
     ids_p = jnp.pad(ids.astype(jnp.int32), (0, npad - n),
                     constant_values=num_segments - 1)[None, :]
-    out = pl.pallas_call(
-        _kernel,
-        grid=(npad // block_rows,),
-        in_specs=[
-            pl.BlockSpec((1, block_rows), lambda i: (0, i)),
-            pl.BlockSpec((block_rows, wp), lambda i: (i, 0)),
-        ],
-        out_specs=pl.BlockSpec((gp, wp), lambda i: (0, 0)),
-        out_shape=jax.ShapeDtypeStruct((gp, wp), plane.dtype),
-        interpret=interpret,
-    )(ids_p, plane_p)
+    # x64-off only for the 32-bit chip path: under it, tracing would
+    # canonicalize the f64 interpret-mode planes (CPU differential
+    # tests) down to f32 and break the kernel's ref dtypes
+    ctx = _enable_x64(False) if plane.dtype != jnp.float64 \
+        else contextlib.nullcontext()
+    with ctx:
+        out = pl.pallas_call(
+            _kernel,
+            grid=(npad // block_rows,),
+            in_specs=[
+                pl.BlockSpec((1, block_rows), lambda i: (0, i)),
+                pl.BlockSpec((block_rows, wp), lambda i: (i, 0)),
+            ],
+            out_specs=pl.BlockSpec((gp, wp), lambda i: (0, 0)),
+            out_shape=jax.ShapeDtypeStruct((gp, wp), plane.dtype),
+            interpret=interpret,
+        )(ids_p, plane_p)
     return out[:num_segments, :w]
 
 
@@ -104,3 +128,25 @@ def eligible(shape: tuple, num_segments: int) -> bool:
     """Shapes the kernel handles; everything else takes XLA's scatter."""
     return (len(shape) == 2 and 0 < shape[1] <= MAX_WIDTH
             and 0 < num_segments <= MAX_SEGMENTS)
+
+
+_TPU_COMPILE_OK: bool | None = None
+
+
+def tpu_compile_ok() -> bool:
+    """One-shot canary: Mosaic compilation through this host's compile
+    path (on tunneled setups, a remote AOT helper) can fail in ways
+    interpret mode never exercises — round-5 incident: x64 tracing made
+    every gridded kernel unlowerable and sank the whole query instead
+    of degrading. `auto` mode consults this before routing planes to
+    the kernel; on failure the XLA scatter path serves instead."""
+    global _TPU_COMPILE_OK
+    if _TPU_COMPILE_OK is None:
+        try:
+            out = pallas_dense_segment_sum(
+                jnp.ones((8, 2), jnp.float32),
+                jnp.zeros(8, jnp.int32), 2)
+            _TPU_COMPILE_OK = abs(float(out[0, 0]) - 8.0) < 1e-6
+        except Exception:  # noqa: BLE001 — any compile failure means "don't"
+            _TPU_COMPILE_OK = False
+    return _TPU_COMPILE_OK

@@ -87,10 +87,13 @@ def dense_segment_sum(plane: jax.Array, ids: jax.Array,
         from greptimedb_tpu.ops import pallas_segment as ps
 
         backend = jax.default_backend()
-        use = mode == "on" or (mode == "auto" and backend == "tpu")
         dtype_ok = plane.dtype in (jnp.float32, jnp.bfloat16) \
             or backend != "tpu"
-        if use and dtype_ok and ps.eligible(plane.shape, num_segments):
+        # cheap pure checks first: the canary costs one Mosaic compile,
+        # so consult it only for planes that could actually route here
+        if dtype_ok and ps.eligible(plane.shape, num_segments) and (
+                mode == "on" or (mode == "auto" and backend == "tpu"
+                                 and ps.tpu_compile_ok())):
             return ps.pallas_dense_segment_sum(
                 plane, ids, num_segments,
                 interpret=backend != "tpu")

@@ -1,0 +1,12 @@
+-- COPY TO / FROM round-trip in ORC (reference file_format.rs:57-61)
+CREATE TABLE src_orc (host STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(host));
+
+INSERT INTO src_orc VALUES ('a', 1.5, 1000), ('b', 2.5, 2000);
+
+COPY src_orc TO '/tmp/sqlness_copy_src.orc';
+
+CREATE TABLE dst_orc (host STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(host));
+
+COPY dst_orc FROM '/tmp/sqlness_copy_src.orc' WITH (format = 'orc');
+
+SELECT host, v FROM dst_orc ORDER BY host;
