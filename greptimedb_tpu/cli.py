@@ -142,15 +142,28 @@ def cmd_standalone(args):
         task = ExportMetricsTask(qe, db=opts.metrics.db,
                                  interval_s=opts.metrics.write_interval_s)
         task.start()
-    stop = []
-    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
-    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    telemetry = None
+    if opts.telemetry.enable:
+        from greptimedb_tpu.utils.telemetry import TelemetryTask
+
+        home = args.data_home or opts.storage.data_home
+        post = None
+        if not opts.telemetry.url:
+            # no endpoint configured: log the payload locally so the
+            # operator can see exactly what WOULD be sent
+            def post(_url, body):
+                print(f"telemetry: {body.decode()}", flush=True)
+        telemetry = TelemetryTask(opts.telemetry.url, "standalone", home,
+                                  interval_s=opts.telemetry.interval_s,
+                                  post=post)
+        telemetry.start()
     try:
-        while not stop:
-            time.sleep(0.2)
+        _wait_stop()
     finally:
         if task is not None:
             task.stop()
+        if telemetry is not None:
+            telemetry.stop()
         for s in servers:
             try:
                 s.stop()
@@ -170,6 +183,142 @@ def cmd_dump_config(args):
     from greptimedb_tpu.options import example_toml
 
     sys.stdout.write(example_toml())
+
+
+def _wait_stop():
+    stop = []
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    while not stop:
+        time.sleep(0.2)
+
+
+def _write_port_file(path: str, value) -> None:
+    """Atomic port-file publish: readers never see a partial file."""
+    if not path:
+        return
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(str(value))
+    os.replace(tmp, path)
+
+
+def cmd_metasrv(args):
+    """Metadata-plane service process (reference cmd/src/metasrv.rs):
+    FileKv-durable Metasrv + the networked KV/heartbeat HTTP service +
+    a real-clock tick loop driving failure detection and failover."""
+    from greptimedb_tpu.catalog.kv import FileKv
+    from greptimedb_tpu.meta.kv_service import MetaHttpService, MetasrvTicker
+    from greptimedb_tpu.meta.metasrv import Metasrv, MetasrvOptions
+
+    os.makedirs(args.data_home, exist_ok=True)
+    kv = FileKv(os.path.join(args.data_home, "meta_kv.json"))
+    opts = MetasrvOptions(
+        region_lease_s=args.region_lease,
+        heartbeat_interval_s=args.heartbeat_interval,
+        failure_threshold=args.failure_threshold)
+    metasrv = Metasrv(kv, opts)
+    host, port = _split_addr(args.bind_addr)
+    service = MetaHttpService(metasrv, host, port)
+    service.start()
+    ticker = MetasrvTicker(metasrv, interval_s=min(
+        1.0, opts.heartbeat_interval_s))
+    ticker.start()
+    print(f"greptimedb_tpu metasrv listening on http://{service.addr}",
+          flush=True)
+    _write_port_file(args.port_file, str(service.port))
+    try:
+        _wait_stop()
+    finally:
+        ticker.stop()
+        service.stop()
+
+
+def cmd_datanode(args):
+    """Region-server service process with its OWN heartbeat task +
+    region alive-keeper (reference cmd/src/datanode.rs +
+    datanode/src/heartbeat.rs:47-183, alive_keeper.rs:49-112)."""
+    # a datanode never touches the accelerator tunnel: scans execute on
+    # the frontend's device; pin CPU before any backend init
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from greptimedb_tpu.cluster.datanode_service import DatanodeService
+    from greptimedb_tpu.storage.engine import EngineConfig, RegionEngine
+
+    engine = RegionEngine(EngineConfig(
+        data_dir=args.data_home, wal_backend="remote", write_workers=2))
+    host, port = _split_addr(args.rpc_addr)
+    svc = DatanodeService(args.node_id, engine, args.metasrv,
+                          rpc_host=host, rpc_port=port,
+                          heartbeat_interval_s=args.heartbeat_interval)
+    svc.start()
+    print(f"greptimedb_tpu datanode {args.node_id} serving regions on "
+          f"grpc://{svc.addr} (metasrv {args.metasrv})", flush=True)
+    _write_port_file(args.port_file, str(svc.server.port))
+    try:
+        _wait_stop()
+    finally:
+        svc.stop()
+
+
+def cmd_flownode(args):
+    """Continuous-aggregation service process (reference
+    cmd/src/flownode.rs + flow/src/adapter.rs:507-527 run_available
+    loop): builds a frontend-style engine over the remote metadata
+    plane and ticks every flow on an interval. Flows created through
+    any frontend are visible here via the shared KV."""
+    import threading
+
+    from greptimedb_tpu.cluster.frontend import build_frontend
+
+    qe, nodes = build_frontend(args.metasrv)
+    flow = qe.flow_engine
+    stop = threading.Event()
+
+    def loop():
+        while not stop.wait(args.tick_interval):
+            try:
+                for db_row in qe.execute_one("SHOW DATABASES").rows():
+                    out = flow.run_available(db=db_row[0])
+                    if out:
+                        print(f"flownode: ticked {out}", flush=True)
+            except Exception:  # noqa: BLE001 — loop must never die
+                import traceback
+
+                traceback.print_exc()
+
+    t = threading.Thread(target=loop, daemon=True)
+    t.start()
+    print(f"greptimedb_tpu flownode ticking every {args.tick_interval}s "
+          f"(metasrv {args.metasrv})", flush=True)
+    _write_port_file(args.port_file, "0")
+    try:
+        _wait_stop()
+    finally:
+        stop.set()
+        nodes.close()
+
+
+def cmd_frontend(args):
+    """Stateless query-serving process over remote metadata + remote
+    regions (reference cmd/src/frontend.rs)."""
+    from greptimedb_tpu.cluster.frontend import build_frontend
+    from greptimedb_tpu.servers import HttpServer
+
+    qe, nodes = build_frontend(args.metasrv)
+    host, port = _split_addr(args.http_addr)
+    http_server = HttpServer(qe, host, port)
+    actual = http_server.start()
+    print(f"greptimedb_tpu frontend listening on http://{host}:{actual} "
+          f"(metasrv {args.metasrv})", flush=True)
+    _write_port_file(args.port_file, str(actual))
+    try:
+        _wait_stop()
+    finally:
+        http_server.stop()
+        nodes.close()
 
 
 def _qi(name: str) -> str:
@@ -283,6 +432,49 @@ def main(argv=None):
                          help="layered TOML config (defaults < file < "
                               "GREPTIMEDB_TPU__* env < flags)")
     p_start.set_defaults(fn=cmd_standalone)
+
+    p_ms = sub.add_parser("metasrv", help="run the metadata-plane service")
+    ms_sub = p_ms.add_subparsers(dest="subcmd", required=True)
+    p_ms_start = ms_sub.add_parser("start")
+    p_ms_start.add_argument("--data-home", required=True)
+    p_ms_start.add_argument("--bind-addr", default="127.0.0.1:4002")
+    p_ms_start.add_argument("--region-lease", type=float, default=9.0)
+    p_ms_start.add_argument("--heartbeat-interval", type=float, default=3.0)
+    p_ms_start.add_argument("--failure-threshold", type=float, default=8.0)
+    p_ms_start.add_argument("--port-file", default="")
+    p_ms_start.set_defaults(fn=cmd_metasrv)
+
+    p_dn = sub.add_parser("datanode", help="run a region-server datanode")
+    dn_sub = p_dn.add_subparsers(dest="subcmd", required=True)
+    p_dn_start = dn_sub.add_parser("start")
+    p_dn_start.add_argument("--node-id", required=True)
+    p_dn_start.add_argument("--metasrv", required=True,
+                            help="metasrv HTTP addr, host:port")
+    p_dn_start.add_argument("--data-home", required=True,
+                            help="SHARED storage path (object-store "
+                                 "deployment shape; WAL is remote)")
+    p_dn_start.add_argument("--rpc-addr", default="127.0.0.1:0")
+    p_dn_start.add_argument("--heartbeat-interval", type=float, default=3.0)
+    p_dn_start.add_argument("--port-file", default="",
+                            help="write the bound Flight port here")
+    p_dn_start.set_defaults(fn=cmd_datanode)
+
+    p_fe = sub.add_parser("frontend", help="run a query-serving frontend")
+    fe_sub = p_fe.add_subparsers(dest="subcmd", required=True)
+    p_fe_start = fe_sub.add_parser("start")
+    p_fe_start.add_argument("--metasrv", required=True)
+    p_fe_start.add_argument("--http-addr", default="127.0.0.1:4000")
+    p_fe_start.add_argument("--port-file", default="")
+    p_fe_start.set_defaults(fn=cmd_frontend)
+
+    p_fn = sub.add_parser("flownode",
+                          help="run a continuous-aggregation flownode")
+    fn_sub = p_fn.add_subparsers(dest="subcmd", required=True)
+    p_fn_start = fn_sub.add_parser("start")
+    p_fn_start.add_argument("--metasrv", required=True)
+    p_fn_start.add_argument("--tick-interval", type=float, default=1.0)
+    p_fn_start.add_argument("--port-file", default="")
+    p_fn_start.set_defaults(fn=cmd_flownode)
 
     p_repl = sub.add_parser("repl", help="interactive SQL/TQL shell")
     p_repl.add_argument("--data-home", default="./greptimedb_tpu_data")

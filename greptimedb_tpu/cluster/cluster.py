@@ -226,9 +226,10 @@ class RegionRouter:
         self._engine_for(region_id).compact(region_id)
 
     def scan(self, region_id: int, ts_range=None, projection=None,
-             tag_predicates=None):
+             tag_predicates=None, seq_min=None):
         return self._engine_for(region_id).scan(
-            region_id, ts_range, projection, tag_predicates
+            region_id, ts_range, projection, tag_predicates,
+            seq_min=seq_min
         )
 
     def scan_stream(self, region_id: int, ts_range=None, projection=None,

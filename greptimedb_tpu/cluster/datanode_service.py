@@ -1,0 +1,119 @@
+"""Deployable datanode role: region server + SELF-OWNED heartbeat task.
+
+`python -m greptimedb_tpu datanode start --node-id dn-0
+    --metasrv 127.0.0.1:4002 --data-home /shared --rpc-addr 127.0.0.1:0`
+
+Mirrors reference src/datanode/src/datanode.rs (region server behind
+gRPC) + heartbeat.rs:47-183 (the datanode's own HeartbeatTask reporting
+RegionStats and applying returned Instructions) + alive_keeper.rs:49-112
+(lease countdown per region; when the metasrv stops renewing — network
+partition, or this node was failed over — the region self-closes: the
+split-brain guard). Unlike the test harness (`process_cluster.py`, where
+the parent beats on behalf of children), the heartbeat loop lives HERE,
+in the datanode process, crossing a real wire to the metasrv.
+
+Storage is the shared-object-store deployment shape: data + remote WAL
+on a shared path so a failover candidate can replay this node's WAL.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from ..meta.heartbeat import HeartbeatTask
+from ..meta.instruction import Instruction, InstructionKind
+from ..meta.kv_service import MetaClient, MetaServiceError
+from ..meta.metasrv import RegionStat
+from ..storage.engine import RegionEngine, RegionRequest, RequestType
+
+
+class DatanodeService:
+    """Engine + Flight server + heartbeat/alive-keeper loop."""
+
+    def __init__(self, node_id: str, engine: RegionEngine,
+                 metasrv_addr: str, rpc_host: str = "127.0.0.1",
+                 rpc_port: int = 0, heartbeat_interval_s: float = 3.0):
+        from ..servers.flight import FlightServer
+
+        self.node_id = node_id
+        self.engine = engine
+        self.server = FlightServer(None, host=rpc_host, port=rpc_port,
+                                   region_engine=engine)
+        self.addr = f"{rpc_host}:{self.server.port}"
+        self.meta = MetaClient(metasrv_addr, node_addr=self.addr)
+        self.heartbeat = HeartbeatTask(node_id, self.meta,
+                                       self._region_stats,
+                                       self._apply_instruction)
+        self.interval_s = heartbeat_interval_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ----------------------------------------------------------- heartbeat
+    def _region_stats(self) -> list[RegionStat]:
+        stats = []
+        for rid, region in self.engine.regions.items():
+            stats.append(RegionStat(
+                region_id=rid, table=str(rid >> 32),
+                memtable_bytes=region.memtable_bytes))
+        return stats
+
+    def _apply_instruction(self, inst: Instruction) -> None:
+        if inst.kind in (InstructionKind.OPEN_REGION,
+                         InstructionKind.UPGRADE_REGION):
+            self.engine.open_region(inst.region_id)
+        elif inst.kind is InstructionKind.CLOSE_REGION:
+            try:
+                self.engine.handle_request(
+                    RegionRequest(RequestType.CLOSE, inst.region_id))
+            except KeyError:
+                pass  # already closed
+        elif inst.kind is InstructionKind.DOWNGRADE_REGION:
+            pass  # writes fence at the router via route state
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.heartbeat.beat()
+            except MetaServiceError:
+                # metasrv unreachable: keep serving; the alive-keeper
+                # below closes regions when the lease actually lapses
+                pass
+            except Exception:  # noqa: BLE001 — loop must never die
+                import traceback
+
+                traceback.print_exc()
+            for rid in self.heartbeat.alive_keeper.expired():
+                # lease lapsed ⇒ the metasrv may have given the region
+                # away; serving writes now would split-brain
+                try:
+                    self.engine.handle_request(
+                        RegionRequest(RequestType.CLOSE, rid))
+                except Exception:  # noqa: BLE001 — already gone
+                    pass
+                self.heartbeat.alive_keeper.forget(rid)
+
+    # ------------------------------------------------------------- control
+    def start(self) -> None:
+        try:
+            self.heartbeat.beat()  # register immediately (addr publish)
+        except MetaServiceError:
+            pass  # metasrv not up yet; the loop retries
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def serve_forever(self) -> None:
+        """Block until killed (the CLI entrypoint's main thread)."""
+        try:
+            while not self._stop.is_set():
+                time.sleep(0.2)
+        except KeyboardInterrupt:
+            pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self.server.shutdown()
+        self.engine.close()

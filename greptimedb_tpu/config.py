@@ -121,3 +121,20 @@ def device_cache_bytes() -> int:
     except (ValueError, OSError):
         ram = 8 << 30
     return max(1 << 30, min(ram // 4, 32 << 30))
+
+
+def host_tier_mode() -> str:
+    """Tiered execution policy: "auto" routes interactive queries to the
+    host (CPU) tier when the accelerator link is remote/slow (probed at
+    first query — physical.accelerator_link()), "off" pins everything to
+    the default backend. A TPU reached through a network tunnel costs
+    tens of ms per result readback; a co-located chip costs ~0."""
+    return os.environ.get("GREPTIMEDB_TPU_HOST_TIER", "auto").lower()
+
+
+def device_tier_rows() -> int:
+    """Aggregate scans at or above this row count run on the accelerator
+    even over a slow link (the resident-plane fold amortizes readback);
+    smaller interactive queries take the host tier."""
+    return int(os.environ.get("GREPTIMEDB_TPU_DEVICE_TIER_ROWS",
+                              str(4 << 20)))

@@ -1,0 +1,326 @@
+"""Networked metadata plane: the metasrv process serves its KvBackend and
+heartbeat pipeline over HTTP; frontends and datanodes connect with thin
+clients.
+
+This is the deployment-shaped analog of the reference's etcd-backed
+metadata plane (src/common/meta/src/kv_backend/etcd.rs: every frontend /
+datanode talks to a remote KV; src/meta-srv/src/election/etcd.rs: leader
+election through remote CAS+lease; src/meta-client/: the RPC client every
+other role embeds). The TPU-native redesign keeps the single ordered-KV
+abstraction (catalog keys, table routes, procedure journal, election
+leases all live in one KvBackend) and puts ONE wire in front of it:
+
+  POST /kv/get|put|delete|range|cas     JSON bodies, the KvBackend ops
+  POST /heartbeat                       datanode RegionStats -> lease +
+                                        Instructions (mailbox drain)
+  POST /admin/alive_nodes|node_stats|migrate_region|tick
+  GET  /health
+
+Served by `MetaHttpService` inside the metasrv process; consumed by
+`HttpKv` (a KvBackend — so Catalog / TableRouteManager / ProcedureManager
+/ KvElection work over the wire unchanged) and `MetaClient` (the
+meta-client analog: handle_heartbeat for HeartbeatTask compatibility plus
+the few admin calls frontends need).
+
+Single-writer note: the metasrv process owns the FileKv; all remote
+mutations funnel through its HTTP service, so CAS atomicity holds
+process-wide (the reference gets the same from etcd transactions).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ..catalog.kv import KvBackend
+from .instruction import Instruction, InstructionKind
+from .metasrv import (HeartbeatRequest, HeartbeatResponse, Metasrv,
+                      RegionStat)
+
+NODE_ADDR_ROOT = "__meta_node_addr/"
+
+
+class MetaHttpService:
+    """HTTP front for a Metasrv: its kv, heartbeats, and admin calls."""
+
+    def __init__(self, metasrv: Metasrv, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.metasrv = metasrv
+        service = self
+        self._addr_cache: dict[str, str] = {}
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"  # keep-alive for client reuse
+
+            def log_message(self, *a):  # quiet; errors surface to clients
+                pass
+
+            def _reply(self, obj, code=200):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/health":
+                    self._reply({"ok": True,
+                                 "leader": service.metasrv.is_leader()})
+                else:
+                    self._reply({"error": "not found"}, 404)
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", "0"))
+                try:
+                    req = json.loads(self.rfile.read(n) or b"{}")
+                    out = service._dispatch(self.path, req)
+                except Exception as e:  # noqa: BLE001 — wire boundary
+                    self._reply({"error": f"{type(e).__name__}: {e}"}, 500)
+                    return
+                self._reply(out)
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.httpd.daemon_threads = True
+        self.port = self.httpd.server_address[1]
+        self.addr = f"{host}:{self.port}"
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------- dispatch
+    def _dispatch(self, path: str, req: dict) -> dict:
+        kv = self.metasrv.kv
+        if path == "/kv/get":
+            return {"value": kv.get(req["key"])}
+        if path == "/kv/put":
+            kv.put(req["key"], req["value"])
+            return {"ok": True}
+        if path == "/kv/delete":
+            return {"deleted": kv.delete(req["key"])}
+        if path == "/kv/range":
+            return {"items": list(kv.range(req["prefix"]))}
+        if path == "/kv/cas":
+            return {"ok": kv.compare_and_put(
+                req["key"], req.get("expect"), req["value"])}
+        if path == "/heartbeat":
+            return self._heartbeat(req)
+        if path == "/admin/alive_nodes":
+            return {"nodes": self.metasrv.alive_nodes(req.get("now_ms"))}
+        if path == "/admin/node_stats":
+            return {"stats": self.metasrv.node_stats()}
+        if path == "/admin/migrate_region":
+            rec = self.metasrv.migrate_region(
+                req["table"], req["region_id"], req["to_node"])
+            return {"procedure_id": rec.procedure_id}
+        if path == "/admin/tick":
+            return {"started": self.metasrv.tick(req.get("now_ms"))}
+        raise KeyError(f"unknown path {path}")
+
+    def _heartbeat(self, req: dict) -> dict:
+        node_id = req["node_id"]
+        addr = req.get("addr")
+        if addr and self._addr_cache.get(node_id) != addr:
+            # registry for frontends: node_id -> Flight addr. Written
+            # only on change — a FileKv put rewrites+fsyncs the store
+            self.metasrv.kv.put(NODE_ADDR_ROOT + node_id, addr)
+            self._addr_cache[node_id] = addr
+        stats = [RegionStat(**s) for s in req.get("region_stats", [])]
+        resp = self.metasrv.handle_heartbeat(HeartbeatRequest(
+            node_id=node_id, region_stats=stats, now_ms=req.get("now_ms")))
+        return {
+            "leader": resp.leader,
+            "leader_hint": resp.leader_hint,
+            "lease_deadline_ms": resp.lease_deadline_ms,
+            "instructions": [
+                {"kind": i.kind.value, "region_id": i.region_id,
+                 "table": i.table, "payload": i.payload}
+                for i in resp.instructions
+            ],
+        }
+
+    # -------------------------------------------------------------- control
+    def start(self) -> int:
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+class _HttpJson:
+    """Minimal keep-alive JSON-POST client (per-thread connections —
+    http.client connections are not thread-safe)."""
+
+    def __init__(self, addr: str, timeout_s: float = 10.0):
+        self.host, _, port = addr.partition(":")
+        self.port = int(port)
+        self.timeout_s = timeout_s
+        self._local = threading.local()
+
+    def _conn(self):
+        import http.client
+
+        c = getattr(self._local, "conn", None)
+        if c is None:
+            c = http.client.HTTPConnection(self.host, self.port,
+                                           timeout=self.timeout_s)
+            self._local.conn = c
+        return c
+
+    def post(self, path: str, body: dict, idempotent: bool = True) -> dict:
+        """`idempotent=False` (CAS and other effectful ops) never
+        retries: a transport error after the server applied the op
+        would make a blind retry observe its OWN effect and report
+        failure (e.g. an election winner believing it lost) — raising
+        'outcome unknown' is the honest answer."""
+        data = json.dumps(body).encode()
+        last = None
+        attempts = 2 if idempotent else 1  # reconnect on stale keep-alive
+        for _ in range(attempts):
+            c = self._conn()
+            try:
+                c.request("POST", path, body=data,
+                          headers={"Content-Type": "application/json"})
+                r = c.getresponse()
+                raw = r.read()
+                if r.status != 200:
+                    raise MetaServiceError(
+                        f"{path}: HTTP {r.status}: {raw[:200]!r}")
+                return json.loads(raw)
+            except MetaServiceError:
+                raise
+            except Exception as e:  # noqa: BLE001 — transport layer
+                last = e
+                self._local.conn = None
+                try:
+                    c.close()
+                except Exception:  # noqa: BLE001
+                    pass
+        raise MetaServiceError(f"{path}: {last}")
+
+
+class MetaServiceError(Exception):
+    pass
+
+
+class HttpKv(KvBackend):
+    """KvBackend over a MetaHttpService — the remote-KV client every
+    non-metasrv role uses (reference kv_backend/etcd.rs analog)."""
+
+    def __init__(self, addr: str, timeout_s: float = 10.0):
+        self._http = _HttpJson(addr, timeout_s)
+
+    def get(self, key):
+        return self._http.post("/kv/get", {"key": key})["value"]
+
+    def put(self, key, value):
+        self._http.post("/kv/put", {"key": key, "value": value})
+
+    def delete(self, key):
+        return self._http.post("/kv/delete", {"key": key})["deleted"]
+
+    def range(self, prefix):
+        for k, v in self._http.post("/kv/range", {"prefix": prefix})["items"]:
+            yield k, v
+
+    def compare_and_put(self, key, expect, value):
+        return self._http.post(
+            "/kv/cas", {"key": key, "expect": expect, "value": value},
+            idempotent=False)["ok"]
+
+
+class MetaClient:
+    """The meta-client analog (reference src/meta-client/): heartbeats +
+    admin calls against a remote metasrv. `handle_heartbeat` matches the
+    in-process Metasrv signature so `HeartbeatTask` runs unchanged in a
+    datanode process."""
+
+    def __init__(self, addr: str, node_addr: Optional[str] = None,
+                 timeout_s: float = 10.0):
+        self.addr = addr
+        self.node_addr = node_addr  # this node's Flight addr (datanodes)
+        self._http = _HttpJson(addr, timeout_s)
+        self.kv = HttpKv(addr, timeout_s)
+
+    def handle_heartbeat(self, req: HeartbeatRequest) -> HeartbeatResponse:
+        out = self._http.post("/heartbeat", {
+            "node_id": req.node_id,
+            "addr": self.node_addr,
+            "now_ms": req.now_ms,
+            "region_stats": [dataclasses.asdict(s)
+                             for s in req.region_stats],
+        })
+        return HeartbeatResponse(
+            leader=out.get("leader", True),
+            leader_hint=out.get("leader_hint"),
+            lease_deadline_ms=out.get("lease_deadline_ms", 0.0),
+            instructions=[
+                Instruction(InstructionKind(i["kind"]), i["region_id"],
+                            i.get("table"), payload=i.get("payload"))
+                for i in out.get("instructions", [])
+            ],
+        )
+
+    def alive_nodes(self, now_ms: Optional[float] = None) -> list[str]:
+        return self._http.post("/admin/alive_nodes",
+                               {"now_ms": now_ms})["nodes"]
+
+    def node_stats(self) -> dict:
+        return self._http.post("/admin/node_stats", {})["stats"]
+
+    def migrate_region(self, table: str, region_id: int,
+                       to_node: str) -> str:
+        return self._http.post("/admin/migrate_region", {
+            "table": table, "region_id": region_id,
+            "to_node": to_node})["procedure_id"]
+
+    def node_addrs(self) -> dict[str, str]:
+        """node_id -> Flight addr registry (written on heartbeat)."""
+        return {k[len(NODE_ADDR_ROOT):]: v
+                for k, v in self.kv.range(NODE_ADDR_ROOT)}
+
+    def health(self) -> bool:
+        try:
+            import http.client
+
+            host, _, port = self.addr.partition(":")
+            c = http.client.HTTPConnection(host, int(port), timeout=2.0)
+            c.request("GET", "/health")
+            ok = c.getresponse().status == 200
+            c.close()
+            return ok
+        except Exception:  # noqa: BLE001 — health probe
+            return False
+
+
+class MetasrvTicker:
+    """Real-clock tick loop for a deployed metasrv (the deterministic
+    test harness calls tick() explicitly; a service process needs the
+    wall clock to drive failure detection + failover)."""
+
+    def __init__(self, metasrv: Metasrv, interval_s: float = 1.0):
+        self.metasrv = metasrv
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.metasrv.tick()
+            except Exception:  # noqa: BLE001 — tick must never die
+                import traceback
+
+                traceback.print_exc()
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)

@@ -152,25 +152,31 @@ def partial_region_window(executor, region_id: int, columns, calls,
 
 def _region_host_columns(executor, region_id: int, where, ts_range,
                          needed: set, append_mode: bool,
-                         schema=None, tz=None) -> Optional[dict]:
+                         schema=None, tz=None, seq_min=None,
+                         stats_out=None) -> Optional[dict]:
     """Shared Partial-step prologue: scan (projected + index-pruned),
     LWW-dedup/filter, decode tags, apply the exact ts bounds. Returns the
     filtered host column dict, or None for an empty result. `tz` is the
     FRONTEND's session timezone: naive ts literals in the shipped WHERE
-    must coerce identically on the region."""
+    must coerce identically on the region. `seq_min` restricts to rows
+    written after that sequence (the incremental-flow fold boundary);
+    `stats_out` (a dict) receives {"rows", "max_seq"} of the RAW scan —
+    pre-filter, so the caller's boundary advances past rows WHERE
+    rejects and never rescans them."""
     from greptimedb_tpu.query.expr import reset_session_tz, set_session_tz
 
     tz_token = set_session_tz(tz)
     try:
         return _region_host_columns_inner(
             executor, region_id, where, ts_range, needed, append_mode,
-            schema)
+            schema, seq_min=seq_min, stats_out=stats_out)
     finally:
         reset_session_tz(tz_token)
 
 
 def _region_host_columns_inner(executor, region_id, where, ts_range, needed,
-                               append_mode, schema):
+                               append_mode, schema, seq_min=None,
+                               stats_out=None):
     from types import SimpleNamespace
 
     from greptimedb_tpu.datatypes.vector import DictVector
@@ -184,7 +190,20 @@ def _region_host_columns_inner(executor, region_id, where, ts_range, needed,
     ts_name = schema.time_index.name
     proj = [c for c in schema.names if c in needed]
     tag_preds = extract_tag_predicates(where, schema) or None
-    scan = executor.engine.scan(region_id, ts_range, proj, tag_preds)
+    if seq_min is not None:
+        scan = executor.engine.scan(region_id, ts_range, proj, tag_preds,
+                                    seq_min=seq_min)
+    else:
+        scan = executor.engine.scan(region_id, ts_range, proj, tag_preds)
+    if stats_out is not None:
+        stats_out["rows"] = 0 if scan is None else int(scan.num_rows)
+        if scan is None or scan.num_rows == 0:
+            stats_out["max_seq"] = None
+            stats_out["max_ts"] = None
+        else:
+            stats_out["max_seq"] = int(np.max(scan.seq))
+            stats_out["max_ts"] = int(np.max(
+                scan.columns[schema.time_index.name]))
     if scan is None or scan.num_rows == 0:
         return None
 
@@ -224,10 +243,16 @@ def _region_host_columns_inner(executor, region_id, where, ts_range, needed,
 
 
 def partial_region_agg(executor, region_id: int, frag,
-                       schema=None) -> Optional[dict]:
+                       schema=None, seq_min=None,
+                       stats_out=None) -> Optional[dict]:
     """Compute one region's partial aggregate. Returns
     {"keys": [np.ndarray per key], "planes": {op: [G, F] np.ndarray}}
-    with G = observed groups in this region, or None for an empty scan."""
+    with G = observed groups in this region, or None for an empty scan.
+
+    `seq_min` folds only rows written after that sequence (incremental
+    flow ticks); `stats_out` (a dict) then receives {"rows": raw scan
+    row count, "max_seq": highest sequence scanned} for the caller's
+    boundary bookkeeping."""
     from greptimedb_tpu.query.expr import collect_columns
 
     probe = executor.engine.region(region_id)
@@ -242,7 +267,8 @@ def partial_region_agg(executor, region_id: int, frag,
         collect_columns(a, needed)
     host = _region_host_columns(executor, region_id, frag.where, ts_range,
                                 needed, frag.append_mode, schema,
-                                tz=frag.tz)
+                                tz=frag.tz, seq_min=seq_min,
+                                stats_out=stats_out)
     if host is None:
         return None
     n = len(host[ts_name])

@@ -15,13 +15,15 @@ taxonomy here, over this engine's plan parts:
 | bare Limit      | PartialCommutative    | limit to k         | re-limit |
 | Aggregate       | Partial/Final split   | partial_agg planes | combine  |
 | Sort w/o Limit  | NonCommutative        | (filter/prune only)| sort     |
-| host aggs       | NonCommutative        | —                  | gather   |
+| host aggs       | input Commutative     | filter+prune rows  | full agg |
 
 `classify_prefix` returns (PlanFragment, mode) — mode tells the
 frontend which Final step to run over what comes back: "agg" combines
 partial planes, "topk" re-sorts candidate rows, "rows" treats the union
-of filtered rows as the scan relation. None means nothing pushes and
-the caller gathers scans (MergeScan fallback)."""
+of filtered rows as the scan relation, "rows_agg" re-enters the device
+aggregation over the filtered-row union (non-decomposable aggregates
+whose input still commutes). None means nothing pushes and the caller
+gathers scans (MergeScan fallback)."""
 
 from __future__ import annotations
 
@@ -47,27 +49,50 @@ def classify_prefix(table, where, agg, project, sort, limit, offset,
         stages.append({"op": "filter", "expr": where})
 
     if agg is not None:
-        if any(needs_host_agg(s, table.schema) for s in agg.aggs):
-            return None  # order statistics / string args need raw values
+        decomposable = not any(needs_host_agg(s, table.schema)
+                               for s in agg.aggs)
+        if decomposable:
+            for spec in agg.aggs:
+                if spec.arg is None:
+                    continue
+                dt = infer_dtype(spec.arg, table.schema)
+                if dt is not None and not (dt.is_numeric or dt.is_timestamp):
+                    # string argument: only count() decomposes into the
+                    # validity plane; everything else needs raw values
+                    if spec.func not in ("count", "rows"):
+                        decomposable = False
+                        break
+        if decomposable:
+            arg_exprs: list[ast.Expr] = []
+            for spec in agg.aggs:
+                if spec.arg is not None and spec.arg not in arg_exprs:
+                    arg_exprs.append(spec.arg)
+            ops: set = {"rows"}
+            for spec in agg.aggs:
+                ops.update(primitives[spec.func])
+            stages.append({"op": "partial_agg", "keys": list(agg.keys),
+                           "args": arg_exprs, "ops": sorted(ops)})
+            return PlanFragment(stages=stages, **base), "agg"
+        # Non-decomposable aggregates (order statistics / string args):
+        # the aggregate itself is NonCommutative, but its INPUT still
+        # commutes — push filter + projection-to-needed-columns and
+        # re-enter the normal device aggregation over the row union at
+        # the frontend (round-4 verdict #7; the reference ships the
+        # same shape as MergeScan below a frontend-only aggregate,
+        # commutativity.rs:27-52). Without a WHERE the gather path's
+        # scan caches win, except when the projection drops columns —
+        # then the wire saving still pays.
+        needed: set = {table.schema.time_index.name}
+        for _, kexpr in agg.keys:
+            collect_columns(kexpr, needed)
         for spec in agg.aggs:
-            if spec.arg is None:
-                continue
-            dt = infer_dtype(spec.arg, table.schema)
-            if dt is not None and not (dt.is_numeric or dt.is_timestamp):
-                # string argument: only count() decomposes into the
-                # validity plane; everything else needs the raw values
-                if spec.func not in ("count", "rows"):
-                    return None
-        arg_exprs: list[ast.Expr] = []
-        for spec in agg.aggs:
-            if spec.arg is not None and spec.arg not in arg_exprs:
-                arg_exprs.append(spec.arg)
-        ops: set = {"rows"}
-        for spec in agg.aggs:
-            ops.update(primitives[spec.func])
-        stages.append({"op": "partial_agg", "keys": list(agg.keys),
-                       "args": arg_exprs, "ops": sorted(ops)})
-        return PlanFragment(stages=stages, **base), "agg"
+            if spec.arg is not None:
+                collect_columns(spec.arg, needed)
+        cols = sorted(c for c in needed if c in table.schema.names)
+        if where is None and len(cols) >= len(table.schema.names):
+            return None
+        stages.append({"op": "prune", "columns": cols})
+        return PlanFragment(stages=stages, **base), "rows_agg"
 
     # non-aggregate scans: prune to the referenced columns
     columns = scan_node.columns

@@ -1508,6 +1508,26 @@ class QueryEngine:
 
     def _tql(self, stmt: ast.Tql, ctx: QueryContext) -> QueryResult:
         from greptimedb_tpu.promql.engine import PromqlEngine
+        from greptimedb_tpu.query.physical import (_TierCtx,
+                                                   accelerator_link)
+        from greptimedb_tpu import config as _cfg
+        import jax as _jax
+
+        # PromQL evaluation materializes intermediate series matrices on
+        # host between stages — over a remote accelerator link that
+        # readback dominates every evaluation, so the whole TQL pipeline
+        # takes the host tier unless the chip is co-located (same policy
+        # as PhysicalExecutor.tier_for)
+        tier = "device"
+        if _jax.default_backend() != "cpu" \
+                and _cfg.host_tier_mode() != "off" \
+                and not accelerator_link()["colocated"]:
+            tier = "host"
+        with _TierCtx(tier):
+            return self._tql_inner(stmt, ctx)
+
+    def _tql_inner(self, stmt: ast.Tql, ctx: QueryContext) -> QueryResult:
+        from greptimedb_tpu.promql.engine import PromqlEngine
 
         engine = PromqlEngine(self)
         if stmt.explain or stmt.analyze:

@@ -315,7 +315,7 @@ class FlightServer(fl.FlightServerBase):
         with tracing.span("region_scan", region=region_id):
             scan = self.engine.scan(
                 region_id, ts_range=ts_range, projection=projection,
-                tag_predicates=preds)
+                tag_predicates=preds, seq_min=req.get("seq_min"))
         if scan is None:
             # empty marker: zero-column table with metadata flag
             return fl.RecordBatchStream(pa.Table.from_arrays(
@@ -610,10 +610,12 @@ class RemoteRegionEngine:
     # -- read ----------------------------------------------------------------
 
     def scan(self, region_id: int, ts_range=None, projection=None,
-             tag_predicates=None) -> Optional[ScanData]:
+             tag_predicates=None, seq_min=None) -> Optional[ScanData]:
         from greptimedb_tpu.utils import tracing
 
         spec = {"region_id": region_id}
+        if seq_min is not None:
+            spec["seq_min"] = int(seq_min)
         if ts_range is not None:
             spec["ts_range"] = list(ts_range)
         if projection is not None:

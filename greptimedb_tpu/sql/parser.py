@@ -1188,5 +1188,113 @@ def _parse_tql_time(text: str) -> float:
     raise SqlError(f"cannot parse TQL time {text!r}")
 
 
+# ---- INSERT fast path -------------------------------------------------------
+#
+# The statement-ingest hot loop is the generic char-level lexer: a
+# 500-row INSERT spends ~70% of its wall time tokenizing + precedence
+# descent (round-5 profile: parse 43 ms of 63 ms total). Bulk VALUES
+# are overwhelmingly literal tuples, so one compiled regex scans the
+# whole tail; anything it doesn't recognize (expressions, casts,
+# comments, multiple statements) falls back to the full parser.
+
+_INSERT_HEAD = re.compile(
+    r"\s*INSERT\s+INTO\s+([A-Za-z_][\w]*(?:\.[A-Za-z_][\w]*)?)\s*"
+    r"(?:\(([^()]*)\))?\s*VALUES\s*", re.IGNORECASE)
+
+_VALUES_TOKEN = re.compile(
+    r"\s*(?:"
+    r"(?P<str>'(?:[^']|'')*')"
+    r"|(?P<num>[-+]?(?:\d+\.?\d*|\.\d+)(?:[eE][-+]?\d+)?)"
+    r"|(?P<kw>[A-Za-z_]+)"
+    r"|(?P<punc>[(),;])"
+    r")")
+
+_NUM_IS_FLOAT = re.compile(r"[.eE]")
+
+
+def _fast_parse_insert(sql: str):
+    """Parse `INSERT INTO t [(cols)] VALUES (lit, ...), ...` without the
+    generic lexer. Returns [ast.Insert] or None to fall back."""
+    m = _INSERT_HEAD.match(sql)
+    if m is None:
+        return None
+    table = m.group(1)
+    columns = []
+    if m.group(2) is not None:
+        columns = [c.strip().strip('"') for c in m.group(2).split(",")]
+        if not all(c and re.fullmatch(r"[\w]+", c) for c in columns):
+            return None
+    rows: list = []
+    row: list = []
+    pos = m.end()
+    n = len(sql)
+    in_row = False
+    expect_value = False
+    Literal = ast.Literal
+    while pos < n:
+        tm = _VALUES_TOKEN.match(sql, pos)
+        if tm is None:
+            break  # trailing whitespace handled after the loop
+        pos = tm.end()
+        text = tm.lastgroup
+        if text == "punc":
+            p = tm.group("punc")
+            if p == "(":
+                if in_row:
+                    return None  # nested parens: an expression
+                in_row, row = True, []
+                expect_value = True
+            elif p == ")":
+                if not in_row or expect_value:
+                    return None
+                in_row = False
+                rows.append(row)
+            elif p == ",":
+                if in_row:
+                    if expect_value:
+                        return None
+                    expect_value = True
+                # between rows: nothing to do
+            else:  # ';' — end of statement
+                if in_row:
+                    return None
+                rest = sql[pos:]
+                if rest.strip():
+                    return None  # multiple statements: full parser
+                pos = n
+                break
+        elif not in_row or not expect_value:
+            return None
+        elif text == "str":
+            row.append(Literal(tm.group("str")[1:-1].replace("''", "'")))
+            expect_value = False
+        elif text == "num":
+            t = tm.group("num")
+            row.append(Literal(
+                float(t) if _NUM_IS_FLOAT.search(t) else int(t)))
+            expect_value = False
+        else:  # keyword literal
+            kw = tm.group("kw").lower()
+            if kw == "null":
+                row.append(Literal(None))
+            elif kw == "true":
+                row.append(Literal(True))
+            elif kw == "false":
+                row.append(Literal(False))
+            else:
+                return None  # function call / identifier: full parser
+            expect_value = False
+    if in_row or not rows or sql[pos:].strip():
+        return None
+    ncols = len(rows[0])
+    if any(len(r) != ncols for r in rows):
+        return None  # let the full parser raise its arity error
+    return [ast.Insert(table, columns, rows)]
+
+
 def parse_sql(sql: str) -> list[ast.Statement]:
+    if len(sql) > 64 and sql.lstrip()[:6].upper() == "INSERT":
+        fast = _fast_parse_insert(sql)
+        if fast is not None:
+            return fast
     return Parser(sql).parse_statements()

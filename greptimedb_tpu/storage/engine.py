@@ -247,8 +247,10 @@ class RegionEngine:
         ts_range: Optional[tuple[int, int]] = None,
         projection: Optional[Sequence[str]] = None,
         tag_predicates: Optional[dict[str, set]] = None,
+        seq_min: Optional[int] = None,
     ) -> Optional[ScanData]:
-        return self.region(region_id).scan(ts_range, projection, tag_predicates)
+        return self.region(region_id).scan(ts_range, projection,
+                                           tag_predicates, seq_min=seq_min)
 
     def ts_extent(self, region_id: int):
         """(min, max) data timestamps from metadata only (no data read)."""

@@ -156,7 +156,15 @@ class LogicalRegion:
         ts_range: Optional[tuple[int, int]] = None,
         projection: Optional[Sequence[str]] = None,
         tag_predicates: Optional[dict[str, set]] = None,
+        seq_min: Optional[int] = None,
     ) -> Optional[ScanData]:
+        if seq_min is not None:
+            # logical regions share a physical region: a sequence
+            # boundary over the shared store is not table-scoped, so
+            # incremental consumers must fall back to full scans
+            raise NotImplementedError(
+                "seq_min scans are not supported on metric-engine "
+                "logical regions")
         phys = self.engine.region(self.meta.physical_region)
         # push the table selector down; label predicates are mapped to
         # label-set values that contain the wanted pair (dictionary-sized)

@@ -426,15 +426,24 @@ class Region:
         ts_range: Optional[tuple[int, int]] = None,
         projection: Optional[Sequence[str]] = None,
         tag_predicates: Optional[dict[str, set]] = None,
+        seq_min: Optional[int] = None,
     ) -> Optional[ScanData]:
         """Collect memtable + pruned SSTs into concatenated host columns.
         `tag_predicates` (tag -> allowed values) drives inverted-index
         row-group pruning; the scan result may then contain rows the
         predicate rejects — the device filter still runs, pruning is purely
-        an IO reduction (never affects correctness)."""
+        an IO reduction (never affects correctness).
+
+        `seq_min`: return only rows written AFTER that sequence — the
+        incremental-consumer scan (flow ticks fold each row exactly
+        once). Prunes whole SSTs by FileMeta.max_seq, so the IO cost is
+        O(new data + files that straddle the boundary), not O(table)."""
         names = self._scan_columns(projection)
         from greptimedb_tpu.storage.index import predicates_cache_key
         pred_key = predicates_cache_key(tag_predicates)
+        if seq_min is not None:
+            return self._scan_since(seq_min, ts_range, names,
+                                    tag_predicates)
         # wide windows (>= half the region's time span) serve the
         # CANONICAL full scan instead of a range-keyed copy: every
         # distinct ts_range otherwise caches its own host columns AND
@@ -568,6 +577,82 @@ class Region:
             while len(self._scan_cache) > self.scan_cache_entries:
                 self._scan_cache.popitem(last=False)
         return result
+
+    def _scan_since(self, seq_min: int, ts_range, names,
+                    tag_predicates) -> Optional[ScanData]:
+        """The seq_min slice of scan(): rows with seq > seq_min only.
+        Uncached (each consumer's boundary differs and moves every
+        tick); SSTs whose max_seq <= seq_min never leave disk."""
+        ts_name = self.schema.time_index.name
+        with self._lock:
+            version = self.data_version
+            file_list = [m for m in self.files.values()
+                         if m.max_seq > seq_min]
+            self._pin_files(file_list)
+            mem = self.memtable.concat(ts_range)
+        parts_cols: list[dict] = []
+        parts_seq: list[np.ndarray] = []
+        parts_op: list[np.ndarray] = []
+        sst_part_lens: list[int] = []
+        try:
+            for meta in file_list:
+                table = self.sst_reader.read(meta, self.schema, ts_range,
+                                             names,
+                                             tag_predicates=tag_predicates)
+                if table is None or table.num_rows == 0:
+                    continue
+                cols = self._decode_sst(table, names)
+                seq_col = table.column(SEQ_COL).to_numpy(
+                    zero_copy_only=False).astype(np.int64)
+                op_col = table.column(OP_COL).to_numpy(
+                    zero_copy_only=False).astype(np.int8)
+                m = seq_col > seq_min
+                if ts_range is not None:
+                    tsv = cols[ts_name]
+                    m &= (tsv >= ts_range[0]) & (tsv < ts_range[1])
+                if not m.all():
+                    if not m.any():
+                        continue
+                    cols = {n: v[m] for n, v in cols.items()}
+                    seq_col = seq_col[m]
+                    op_col = op_col[m]
+                parts_cols.append(cols)
+                parts_seq.append(seq_col)
+                parts_op.append(op_col)
+                sst_part_lens.append(len(seq_col))
+        finally:
+            self._unpin_files(file_list)
+        if mem is not None:
+            mcols, mseq, mop = mem
+            m = mseq > seq_min
+            if m.any():
+                parts_cols.append({n: mcols[n][m] for n in names})
+                parts_seq.append(mseq[m])
+                parts_op.append(mop[m])
+        if not parts_cols:
+            return None
+        if len(parts_cols) == 1:
+            columns = dict(parts_cols[0])
+            seq = parts_seq[0]
+            op = parts_op[0]
+        else:
+            columns = {n: np.concatenate([p[n] for p in parts_cols])
+                       for n in names}
+            seq = np.concatenate(parts_seq)
+            op = np.concatenate(parts_op)
+        part_offsets = np.cumsum([0] + sst_part_lens)
+        tag_dicts = {
+            c.name: self.registry.dict_array(c.name)
+            for c in self.schema.tag_columns
+            if c.name in names
+        }
+        return ScanData(
+            schema=self.schema, columns=columns, seq=seq, op_type=op,
+            tag_dicts=tag_dicts, num_rows=len(seq),
+            region_id=self.region_id, data_version=version,
+            scan_fingerprint=(ts_range, tuple(names), "seq", int(seq_min)),
+            sorted_part_offsets=tuple(int(o) for o in part_offsets),
+        )
 
     def scan_stream(
         self,

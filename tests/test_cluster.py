@@ -297,12 +297,13 @@ class TestTracingAnalyze:
         # decomposable multi-region aggregate takes the pushdown path
         assert "fragment_pushdown:" in text
         assert "execution path: pushdown" in text
-        # a host order-statistic is not decomposable: raw gather path,
-        # with per-region scan spans
+        # a host order-statistic is not decomposable, but its INPUT
+        # commutes: filtered-row pushdown + frontend aggregation
+        # (mode=rows_agg), never a raw scan gather
         r = c.sql("EXPLAIN ANALYZE SELECT host, median(usage_user) FROM cpu "
                   "GROUP BY host")
         text = "\n".join(row[0] for row in r.rows())
-        assert "scan:" in text
+        assert "mode=rows_agg" in text
         assert "device_agg:" in text
         c.close()
 
@@ -323,10 +324,13 @@ class TestTracingAnalyze:
         # pushdown path: fragment client span + server-side span
         assert "remote_region_frag" in names
         assert "region_frag" in names
-        # non-decomposable aggregate exercises the raw scan transport
+        # a full projection with no WHERE/LIMIT has nothing to reduce
+        # region-side (even median rides rows_agg pushdown now) — it
+        # exercises the raw scan transport
         ctx2 = QueryContext(trace_id="feedbeefcafe0002")
         c.frontend.execute_one(
-            "SELECT host, median(usage_user) FROM cpu GROUP BY host", ctx2)
+            "SELECT host, region, usage_user, usage_system, ts FROM cpu",
+            ctx2)
         names2 = {s.name for s in tracing.spans_for("feedbeefcafe0002")}
         assert "remote_region_scan" in names2
         assert "region_scan" in names2  # server-side span, same trace

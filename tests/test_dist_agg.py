@@ -430,6 +430,97 @@ class TestRowsPushdown:
         c.close()
 
 
+class TestRowsAggPushdown:
+    """Non-decomposable aggregates (order statistics): the aggregate is
+    NonCommutative but its input commutes — regions ship filtered,
+    projected rows and the frontend re-enters the device aggregation
+    over the union (mode "rows_agg"), never gathering raw scans
+    (commutativity.rs:27-52; round-4 verdict #7)."""
+
+    @pytest.mark.parametrize("wire", [False, True], ids=["inproc", "wire"])
+    def test_percentile_matches_oracle(self, tmp_path, wire):
+        from greptimedb_tpu.catalog import Catalog, MemoryKv
+        from greptimedb_tpu.query import QueryEngine
+        from greptimedb_tpu.storage import RegionEngine
+        from greptimedb_tpu.storage.engine import EngineConfig
+
+        c = Cluster(str(tmp_path / "c"), num_datanodes=3,
+                    opts=MetasrvOptions(), wire_transport=wire)
+        c.create_partitioned_table(CREATE, host_rule("host2", "host4"))
+        seed(c)
+        oracle_engine = RegionEngine(
+            EngineConfig(data_dir=str(tmp_path / "oracle")))
+        oracle = QueryEngine(Catalog(MemoryKv()), oracle_engine)
+        oracle.execute_one(CREATE)
+        rng = np.random.default_rng(42)
+        rows = []
+        for h in range(6):
+            for t in range(5):
+                rows.append(
+                    f"('host{h}', 'r{h % 2}', {rng.uniform(0, 100):.4f}, "
+                    f"{rng.uniform(0, 50):.4f}, {1000 * (t + 1)})")
+        oracle.execute_one(
+            "INSERT INTO cpu (host, region, usage_user, usage_system, ts) "
+            "VALUES " + ", ".join(rows))
+
+        shipped = []
+        orig = c.frontend.executor.engine.execute_fragment
+
+        def spy(rid, frag):
+            out = orig(rid, frag)
+            if out is not None and "cols" in out:
+                shipped.append(len(next(iter(out["cols"].values()))))
+            return out
+
+        c.frontend.executor.engine.execute_fragment = spy
+        queries = [
+            "SELECT host, percentile(usage_user, 50) FROM cpu "
+            "WHERE usage_user > 20.0 GROUP BY host ORDER BY host",
+            "SELECT host, median(usage_user) FROM cpu "
+            "WHERE region = 'r1' GROUP BY host ORDER BY host",
+            "SELECT median(usage_user) FROM cpu WHERE usage_user < 80.0",
+            # NOT argmax: it returns scan-order row indices, which are
+            # legitimately different between physical plans
+            "SELECT host, percentile(usage_system, 90) FROM cpu "
+            "WHERE usage_user > 10.0 GROUP BY host ORDER BY host",
+        ]
+        for q in queries:
+            shipped.clear()
+            got = c.sql(q).rows()
+            want = oracle.execute_one(q).rows()
+            _rows_close(got, want)
+            assert c.frontend.executor.last_path.startswith("rows_agg+"), q
+            # the wire carried only rows surviving WHERE, not raw scans
+            n_match = oracle.execute_one(
+                "SELECT count(*) FROM cpu WHERE " + q.split("WHERE ")[1]
+                .split(" GROUP")[0]).rows()[0][0]
+            assert sum(shipped) == n_match, q
+        # last(tag) takes the same route: raw string values needed
+        shipped.clear()
+        got = c.sql("SELECT host, last(region) FROM cpu "
+                    "WHERE usage_user > 0.0 GROUP BY host "
+                    "ORDER BY host").rows()
+        want = oracle.execute_one(
+            "SELECT host, last(region) FROM cpu WHERE usage_user > 0.0 "
+            "GROUP BY host ORDER BY host").rows()
+        _rows_close(got, want)
+        assert c.frontend.executor.last_path.startswith("rows_agg+")
+        oracle_engine.close()
+        c.close()
+
+    def test_projection_only_rows_agg_without_where(self, tmp_path):
+        """No WHERE but the aggregate touches a column subset: the
+        pruned-column row union still beats gathering full scans."""
+        c = Cluster(str(tmp_path), num_datanodes=2, opts=MetasrvOptions())
+        c.create_partitioned_table(CREATE, host_rule("host1"))
+        seed(c, n_hosts=4)
+        got = c.sql("SELECT host, median(usage_user) FROM cpu "
+                    "GROUP BY host ORDER BY host").rows()
+        assert len(got) == 4
+        assert c.frontend.executor.last_path.startswith("rows_agg+")
+        c.close()
+
+
 class TestWindowPushdown:
     """Window-partition pushdown: OVER (PARTITION BY <rule cols> ...)
     computes region-side (partitions never span regions); the wire

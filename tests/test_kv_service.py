@@ -1,0 +1,92 @@
+"""Networked metadata plane unit tests: MetaHttpService + HttpKv +
+MetaClient against an in-process Metasrv (reference kv_backend/etcd.rs +
+meta-client semantics, without OS-process weight — test_deploy.py covers
+the real-process shape)."""
+
+from __future__ import annotations
+
+import pytest
+
+from greptimedb_tpu.catalog.kv import MemoryKv
+from greptimedb_tpu.meta.kv_service import (HttpKv, MetaClient,
+                                            MetaHttpService)
+from greptimedb_tpu.meta.metasrv import (HeartbeatRequest, Metasrv,
+                                         MetasrvOptions, RegionStat)
+
+
+@pytest.fixture
+def service():
+    metasrv = Metasrv(MemoryKv(), MetasrvOptions(region_lease_s=9.0))
+    svc = MetaHttpService(metasrv, port=0)
+    svc.start()
+    yield svc
+    svc.stop()
+
+
+class TestHttpKv:
+    def test_get_put_delete(self, service):
+        kv = HttpKv(service.addr)
+        assert kv.get("k") is None
+        kv.put("k", "v1")
+        assert kv.get("k") == "v1"
+        assert kv.delete("k") is True
+        assert kv.delete("k") is False
+
+    def test_range_ordered(self, service):
+        kv = HttpKv(service.addr)
+        for k in ["p/b", "p/a", "q/x", "p/c"]:
+            kv.put(k, k.upper())
+        assert list(kv.range("p/")) == [
+            ("p/a", "P/A"), ("p/b", "P/B"), ("p/c", "P/C")]
+
+    def test_cas(self, service):
+        kv = HttpKv(service.addr)
+        assert kv.compare_and_put("c", None, "1") is True
+        assert kv.compare_and_put("c", None, "2") is False
+        assert kv.compare_and_put("c", "1", "2") is True
+        assert kv.get("c") == "2"
+
+    def test_incr_sequence(self, service):
+        kv = HttpKv(service.addr)
+        assert [kv.incr("seq") for _ in range(3)] == [1, 2, 3]
+
+
+class TestMetaClient:
+    def test_heartbeat_lease_and_registry(self, service):
+        client = MetaClient(service.addr, node_addr="127.0.0.1:5555")
+        resp = client.handle_heartbeat(HeartbeatRequest(
+            node_id="dn-9", now_ms=1000.0,
+            region_stats=[RegionStat(region_id=7, table="1")]))
+        assert resp.leader is True
+        assert resp.lease_deadline_ms == 1000.0 + 9000.0
+        assert client.node_addrs() == {"dn-9": "127.0.0.1:5555"}
+        assert "dn-9" in client.alive_nodes(now_ms=2000.0)
+        assert client.node_stats()["dn-9"]["region_count"] == 1
+
+    def test_instruction_mailbox_roundtrip(self, service):
+        from greptimedb_tpu.meta.instruction import (Instruction,
+                                                     InstructionKind)
+
+        client = MetaClient(service.addr)
+        client.handle_heartbeat(HeartbeatRequest(node_id="dn-1",
+                                                 now_ms=1000.0))
+        service.metasrv.send_instruction(
+            "dn-1", Instruction(InstructionKind.OPEN_REGION, 42, "t",
+                                payload={"replay_wal": True}))
+        resp = client.handle_heartbeat(HeartbeatRequest(node_id="dn-1",
+                                                        now_ms=2000.0))
+        [inst] = resp.instructions
+        assert inst.kind is InstructionKind.OPEN_REGION
+        assert inst.region_id == 42
+        assert inst.payload == {"replay_wal": True}
+
+    def test_health(self, service):
+        assert MetaClient(service.addr).health() is True
+        assert MetaClient("127.0.0.1:1").health() is False
+
+    def test_error_surfaces(self, service):
+        from greptimedb_tpu.meta.kv_service import MetaServiceError
+
+        client = MetaClient(service.addr)
+        with pytest.raises(MetaServiceError):
+            client.migrate_region("missing_table", 1, "dn-0")

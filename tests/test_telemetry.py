@@ -1,0 +1,46 @@
+"""Opt-out usage telemetry (reference common/greptimedb-telemetry):
+payload shape, uuid persistence, failure isolation."""
+
+import json
+
+from greptimedb_tpu.utils import telemetry
+
+
+class TestStatisticData:
+    def test_payload_shape(self, tmp_path):
+        d = telemetry.statistic_data("standalone", str(tmp_path), nodes=3)
+        assert d["mode"] == "standalone"
+        assert d["nodes"] == 3
+        assert d["os"] and d["arch"] and d["version"]
+        assert len(d["uuid"]) == 32
+
+    def test_uuid_persists_across_restarts(self, tmp_path):
+        a = telemetry.load_or_create_uuid(str(tmp_path))
+        b = telemetry.load_or_create_uuid(str(tmp_path))
+        assert a == b
+        assert (tmp_path / telemetry.UUID_FILE_NAME).exists()
+
+
+class TestTelemetryTask:
+    def test_report_once_posts_payload(self, tmp_path):
+        sent = []
+        task = telemetry.TelemetryTask(
+            "http://example.invalid/stats", "distributed", str(tmp_path),
+            nodes_fn=lambda: 5, post=lambda url, body: sent.append(
+                (url, json.loads(body))))
+        assert task.report_once() is True
+        url, payload = sent[0]
+        assert url.endswith("/stats")
+        assert payload["mode"] == "distributed"
+        assert payload["nodes"] == 5
+        assert task.reports_sent == 1
+
+    def test_post_failure_is_swallowed(self, tmp_path):
+        def boom(url, body):
+            raise OSError("no egress")
+
+        task = telemetry.TelemetryTask(
+            "http://example.invalid", "standalone", str(tmp_path),
+            post=boom)
+        assert task.report_once() is False
+        assert task.reports_sent == 0
