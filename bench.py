@@ -172,7 +172,9 @@ def timed_sql(qe, sql, repeats=None, expect_rows=None):
     """Warm-up once (compile + HBM cache fill), then median of repeats.
     The warm-up runs under a fresh trace so its cost decomposes into
     engine spans (scan/aggregate/...) — distinguishing XLA compile time
-    from SST read + decode when diagnosing cold starts."""
+    from SST read + decode when diagnosing cold starts. The execution
+    tier that served the query (device | host — physical.tier_for)
+    rides back in the spans dict under "tier"."""
     from greptimedb_tpu.session import QueryContext
     from greptimedb_tpu.utils import tracing
 
@@ -183,6 +185,7 @@ def timed_sql(qe, sql, repeats=None, expect_rows=None):
     spans = {}
     for s in tracing.spans_for(tid):
         spans[s.name] = round(spans.get(s.name, 0.0) + s.duration_ms, 1)
+    spans["tier"] = getattr(qe.executor, "last_tier", None)
     if expect_rows is not None:
         assert r.num_rows == expect_rows, (r.num_rows, expect_rows)
     times = []
@@ -207,7 +210,7 @@ def bench_cpu_suite(qe, results):
         p50, warm, nrows, _ = timed_sql(qe, sql, expect_rows=60)
         log(f"single-groupby-1-1-1: {p50:.1f} ms (warm-up {warm:.0f} ms)")
         results["single_groupby_1_1_1"] = {
-            "p50_ms": round(p50, 2), "baseline_ms": BASE_SINGLE_MS,
+            "p50_ms": round(p50, 2), "tier": qe.executor.last_tier, "baseline_ms": BASE_SINGLE_MS,
             "vs_baseline": round(BASE_SINGLE_MS / p50, 3)}
 
     if enabled("double_groupby_all"):
@@ -222,10 +225,28 @@ def bench_cpu_suite(qe, results):
         log(f"double-groupby-all: {p50:.1f} ms (warm-up {warm:.0f} ms, "
             f"{nrows} groups)")
         results["double_groupby_all"] = {
-            "p50_ms": round(p50, 2), "warmup_ms": round(warm, 1),
+            "p50_ms": round(p50, 2), "tier": qe.executor.last_tier, "warmup_ms": round(warm, 1),
             "groups": nrows, "warmup_spans_ms": wspans,
             "baseline_ms": BASELINE_MS,
             "vs_baseline": round(BASELINE_MS / p50, 3)}
+        if qe.executor.last_tier == "device":
+            # A/B the tiers on the headline: over a tunneled link the
+            # [G,F] result readback can dominate the device run — the
+            # host-tier number shows what the link costs (and what a
+            # co-located chip would beat)
+            prev = os.environ.get("GREPTIMEDB_TPU_HOST_TIER")
+            os.environ["GREPTIMEDB_TPU_HOST_TIER"] = "force"
+            try:
+                p50_h, _, _, _ = timed_sql(qe, sql, repeats=2,
+                                           expect_rows=HOSTS * HOURS)
+            finally:
+                if prev is None:
+                    os.environ.pop("GREPTIMEDB_TPU_HOST_TIER", None)
+                else:
+                    os.environ["GREPTIMEDB_TPU_HOST_TIER"] = prev
+            log(f"double-groupby-all host-tier A/B: {p50_h:.1f} ms")
+            results["double_groupby_all"]["host_tier_p50_ms"] = \
+                round(p50_h, 2)
 
     if enabled("groupby_orderby_limit"):
         # TSBS groupby-orderby-limit: last 5 minute-buckets of max before
@@ -239,7 +260,7 @@ def bench_cpu_suite(qe, results):
         p50, warm, nrows, _ = timed_sql(qe, sql, expect_rows=5)
         log(f"groupby-orderby-limit: {p50:.1f} ms")
         results["groupby_orderby_limit"] = {
-            "p50_ms": round(p50, 2), "baseline_ms": BASE_GBOL_MS,
+            "p50_ms": round(p50, 2), "tier": qe.executor.last_tier, "baseline_ms": BASE_GBOL_MS,
             "vs_baseline": round(BASE_GBOL_MS / p50, 3)}
 
     if enabled("cpu_max_all_8"):
@@ -255,7 +276,7 @@ def bench_cpu_suite(qe, results):
         p50, warm, nrows, _ = timed_sql(qe, sql, expect_rows=min(8, HOURS))
         log(f"cpu-max-all-8: {p50:.1f} ms")
         results["cpu_max_all_8"] = {
-            "p50_ms": round(p50, 2), "baseline_ms": BASE_MAX_ALL_8_MS,
+            "p50_ms": round(p50, 2), "tier": qe.executor.last_tier, "baseline_ms": BASE_MAX_ALL_8_MS,
             "vs_baseline": round(BASE_MAX_ALL_8_MS / p50, 3)}
 
     if enabled("lastpoint"):
@@ -265,7 +286,7 @@ def bench_cpu_suite(qe, results):
         p50, warm, nrows, _ = timed_sql(qe, sql, expect_rows=HOSTS)
         log(f"lastpoint: {p50:.1f} ms (warm-up {warm:.0f} ms)")
         results["lastpoint"] = {
-            "p50_ms": round(p50, 2), "baseline_ms": BASE_LASTPOINT_MS,
+            "p50_ms": round(p50, 2), "tier": qe.executor.last_tier, "baseline_ms": BASE_LASTPOINT_MS,
             "vs_baseline": round(BASE_LASTPOINT_MS / p50, 3)}
 
     if enabled("high_cpu_all"):
@@ -276,7 +297,7 @@ def bench_cpu_suite(qe, results):
         p50, warm, nrows, _ = timed_sql(qe, sql)
         log(f"high-cpu-all: {p50:.1f} ms ({nrows} rows out)")
         results["high_cpu_all"] = {
-            "p50_ms": round(p50, 2), "rows_out": nrows,
+            "p50_ms": round(p50, 2), "tier": qe.executor.last_tier, "rows_out": nrows,
             "baseline_ms": BASE_HIGH_CPU_MS,
             "vs_baseline": round(BASE_HIGH_CPU_MS / p50, 3)}
 
@@ -288,7 +309,12 @@ def bench_promql(engine, qe, results, ingest_rps=300000.0):
     cannot fit the full day's ingest."""
     from greptimedb_tpu.datatypes import DictVector, RecordBatch
 
-    affordable = affordable_rows(180, ingest_rps, width_factor=2.0)
+    # width_factor 1.0 ON PURPOSE despite the narrow rows: the numpy
+    # anchor re-reads and pivots the whole series set (~half the ingest
+    # cost again) and the full-span evals pay XLA compiles — treating
+    # the effective rate as the plain ingest rate covers both
+    # (round-5: the 24h shape overran the window twice without this)
+    affordable = affordable_rows(300, ingest_rps, width_factor=1.0)
     hours = PROM_HOURS
     while hours > 1 and hours * 3600 // 15 * PROM_SERIES > affordable:
         hours //= 2
@@ -512,7 +538,7 @@ def bench_high_cardinality(engine, qe, results, ingest_rps=300000.0):
     log(f"high-cardinality: {p50:.1f} ms ({nrows} groups, "
         f"{rps / 1e6:.1f}M rows/s)")
     results["high_cardinality"] = {
-        "p50_ms": round(p50, 2), "combos": HC_COMBOS, "rows": rows,
+        "p50_ms": round(p50, 2), "tier": qe.executor.last_tier, "combos": HC_COMBOS, "rows": rows,
         "target_rows": target_rows, "at_spec": rows >= target_rows,
         "scan_rows_per_s": round(rps), "baseline_ms": None,
         "vs_baseline": None}
@@ -530,8 +556,11 @@ def bench_double_groupby_100m(engine, qe, results, ingest_rps):
     rows_target = int(os.environ.get("BENCH_STREAM_ROWS", "100000000"))
     n_hosts = 4000
     # reserve for the query itself (~120 s warm + runs) and the
-    # remaining tracked configs (promql/hc/compaction, ~480 s)
-    affordable = affordable_rows(600, ingest_rps)
+    # remaining tracked configs (promql/hc/compaction, ~480 s). The
+    # extra 0.4 derate is measured, not cautious: the 17M calibration
+    # ingest ran at 590k rows/s but the 100M sustained 190k — flush
+    # and L0 debt compound at scale
+    affordable = affordable_rows(600, ingest_rps * 0.4)
     rows_planned = min(rows_target, affordable)
     if rows_planned < 10_000_000:
         log(f"double_groupby_100m skipped: budget affords only "
@@ -585,7 +614,7 @@ def bench_double_groupby_100m(engine, qe, results, ingest_rps):
     log(f"double-groupby-100m: {p50:.0f} ms over {rows} rows, "
         f"{nrows} groups ({rps / 1e6:.0f}M rows/s, path={path})")
     results["double_groupby_100m"] = {
-        "p50_ms": round(p50, 1), "warmup_ms": round(warm, 1),
+        "p50_ms": round(p50, 1), "tier": qe.executor.last_tier, "warmup_ms": round(warm, 1),
         "rows": rows, "target_rows": rows_target,
         "at_spec": rows >= rows_target, "hosts": n_hosts,
         "sim_hours": hours, "groups": nrows, "path": path,
@@ -1055,6 +1084,18 @@ def emit_result(platform, probe_attempts, results, rows, ingest_rps,
     value = dg.get("p50_ms")
     mfu = roofline_detail(platform, results, rows)
     last_probe = probe_attempts[-1] if probe_attempts else {}
+    # measured host<->accelerator link profile: the context every
+    # device-tier number needs (a tunneled chip pays ~66 ms readback +
+    # MB/s-class D2H per query — costs a co-located deployment of the
+    # same code does not have)
+    try:
+        from greptimedb_tpu.query.physical import accelerator_link
+
+        link = accelerator_link()
+        link = {k: (None if v == float("inf") else v)
+                for k, v in link.items()}
+    except Exception:  # noqa: BLE001 — proof must always emit
+        link = None
     print(json.dumps({
         "metric": "tsbs_double_groupby_all_p50_ms",
         "value": value,
@@ -1083,8 +1124,10 @@ def emit_result(platform, probe_attempts, results, rows, ingest_rps,
             "probe_outcome": str(last_probe.get("outcome", ""))[:120],
             "probe_attempts": len(probe_attempts),
             "headline_p50_ms": value,
+            "headline_tier": dg.get("tier"),
             "vs_baseline": dg.get("vs_baseline"),
             "warmup_ms": dg.get("warmup_ms"),
+            "link": link,
             "mfu": mfu,
         },
     }), flush=True)
@@ -1113,11 +1156,13 @@ def supervise():
             last_err = f"total budget {total_s}s exhausted before attempt {i}"
             break
         label = "default backend" if not extra_env else "cpu fallback"
-        # non-final attempts may not starve the fallback: reserve it a
-        # slice (600 s runs the core suite on CPU — the budget-gated big
-        # shapes self-cut to fit whatever remains)
+        # non-final attempts may not starve the fallback — but the
+        # fallback matters less now that a timed-out attempt's
+        # PRELIMINARY line is salvaged (the fallback only covers "the
+        # accelerator attempt died before the quick configs finished"),
+        # so the reserve is one CPU run up to its own preliminary emit
         attempt_s = remaining if i == len(attempts) \
-            else max(60, remaining - 600)
+            else max(60, remaining - 300)
         # the child sizes the big tracked configs against its OWN
         # budget — hand it the attempt deadline, not the global default
         env = dict(os.environ, BENCH_CHILD="1",

@@ -208,11 +208,12 @@ def cmd_metasrv(args):
     FileKv-durable Metasrv + the networked KV/heartbeat HTTP service +
     a real-clock tick loop driving failure detection and failover."""
     from greptimedb_tpu.catalog.kv import FileKv
-    from greptimedb_tpu.meta.kv_service import MetaHttpService, MetasrvTicker
+    from greptimedb_tpu.meta.kv_service import (MetaHttpService,
+                                                MetasrvTicker, NotifyingKv)
     from greptimedb_tpu.meta.metasrv import Metasrv, MetasrvOptions
 
     os.makedirs(args.data_home, exist_ok=True)
-    kv = FileKv(os.path.join(args.data_home, "meta_kv.json"))
+    kv = NotifyingKv(FileKv(os.path.join(args.data_home, "meta_kv.json")))
     opts = MetasrvOptions(
         region_lease_s=args.region_lease,
         heartbeat_interval_s=args.heartbeat_interval,

@@ -116,6 +116,7 @@ class RemoteNodeMap:
         self._handles: dict[str, RemoteNode] = {}
         self._checked: dict[str, float] = {}
         self._lock = threading.Lock()
+        self.closed = False
 
     def __getitem__(self, node_id: str) -> RemoteNode:
         now = time.monotonic()
@@ -154,6 +155,7 @@ class RemoteNodeMap:
             return list(self._handles.values())
 
     def close(self) -> None:
+        self.closed = True
         for h in self.values():
             h.close()
 
@@ -162,6 +164,7 @@ def build_frontend(metasrv_addr: str, default_timezone: str = "UTC"):
     """Assemble a frontend QueryEngine against a remote metasrv: returns
     (query_engine, node_map) — close the node_map on shutdown."""
     from ..meta.ddl import DdlManager
+    from ..meta.route import ROUTE_PREFIX
     from ..query.engine import QueryEngine
 
     meta = MetaClient(metasrv_addr)
@@ -171,4 +174,27 @@ def build_frontend(metasrv_addr: str, default_timezone: str = "UTC"):
     catalog = Catalog(meta.kv)
     router.ddl_manager = DdlManager(remote_meta.procedures, router, catalog)
     qe = QueryEngine(catalog, router, default_timezone=default_timezone)
+
+    # push-based invalidation: long-poll the metasrv's watch on the
+    # route prefix; a failover/migration route swap clears the router's
+    # caches within one poll round-trip instead of a liveness-TTL miss
+    # (the reference's cache-invalidation channel, src/cache)
+    def _watch_loop():
+        rev = 0
+        while not nodes.closed:
+            try:
+                out = meta.watch(ROUTE_PREFIX, rev, timeout_s=20.0)
+                new_rev = out.get("rev", rev)
+                if new_rev < rev:
+                    # metasrv restarted: its in-memory revision reset —
+                    # resync from the new counter and invalidate once
+                    # (routes may have moved while we were blind)
+                    remote_meta.invalidate_caches("")
+                elif out.get("changed"):
+                    remote_meta.invalidate_caches("")
+                rev = new_rev
+            except Exception:  # noqa: BLE001 — metasrv briefly away
+                time.sleep(1.0)
+
+    threading.Thread(target=_watch_loop, daemon=True).start()
     return qe, nodes

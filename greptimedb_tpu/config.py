@@ -127,8 +127,10 @@ def host_tier_mode() -> str:
     """Tiered execution policy: "auto" routes interactive queries to the
     host (CPU) tier when the accelerator link is remote/slow (probed at
     first query — physical.accelerator_link()), "off" pins everything to
-    the default backend. A TPU reached through a network tunnel costs
-    tens of ms per result readback; a co-located chip costs ~0."""
+    the default backend, "force" pins everything to the host tier
+    (A/B measurement + emergency bypass). A TPU reached through a
+    network tunnel costs tens of ms per result readback; a co-located
+    chip costs ~0."""
     return os.environ.get("GREPTIMEDB_TPU_HOST_TIER", "auto").lower()
 
 

@@ -43,6 +43,47 @@ from .metasrv import (HeartbeatRequest, HeartbeatResponse, Metasrv,
 NODE_ADDR_ROOT = "__meta_node_addr/"
 
 
+class NotifyingKv(KvBackend):
+    """KvBackend decorator that fires subscribers on every mutation —
+    wrap the metasrv's store in this BEFORE building the Metasrv so
+    watch long-polls also wake for the coordinator's own writes
+    (failover route swaps, procedure journal steps), not just the
+    mutations that arrive over HTTP."""
+
+    def __init__(self, inner: KvBackend):
+        self.inner = inner
+        self._subs: list = []
+
+    def subscribe(self, fn) -> None:
+        self._subs.append(fn)
+
+    def _notify(self) -> None:
+        for fn in self._subs:
+            fn()
+
+    def get(self, key):
+        return self.inner.get(key)
+
+    def range(self, prefix):
+        return self.inner.range(prefix)
+
+    def put(self, key, value):
+        self.inner.put(key, value)
+        self._notify()
+
+    def delete(self, key):
+        out = self.inner.delete(key)
+        if out:
+            self._notify()
+        return out
+
+    def compare_and_put(self, key, expect, value):
+        ok = self.inner.compare_and_put(key, expect, value)
+        if ok:
+            self._notify()
+        return ok
+
+
 class MetaHttpService:
     """HTTP front for a Metasrv: its kv, heartbeats, and admin calls."""
 
@@ -51,6 +92,17 @@ class MetaHttpService:
         self.metasrv = metasrv
         service = self
         self._addr_cache: dict[str, str] = {}
+        # watch plane: a monotone service-wide revision bumped on every
+        # mutation + a condition long-pollers wait on (the minimal
+        # etcd-watch analog — no per-key history, watchers re-range)
+        self._rev = 0
+        self._rev_cond = threading.Condition()
+        self._kv_notifies = isinstance(metasrv.kv, NotifyingKv)
+        if self._kv_notifies:
+            # coordinator-internal writes (failover route swaps, DDL
+            # journal) wake watchers too — and the dispatch-level bumps
+            # below are skipped so mutations don't double-wake watchers
+            metasrv.kv.subscribe(self._bump)
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"  # keep-alive for client reuse
@@ -89,6 +141,11 @@ class MetaHttpService:
         self.addr = f"{host}:{self.port}"
         self._thread: Optional[threading.Thread] = None
 
+    def _bump(self) -> None:
+        with self._rev_cond:
+            self._rev += 1
+            self._rev_cond.notify_all()
+
     # ------------------------------------------------------------- dispatch
     def _dispatch(self, path: str, req: dict) -> dict:
         kv = self.metasrv.kv
@@ -96,14 +153,39 @@ class MetaHttpService:
             return {"value": kv.get(req["key"])}
         if path == "/kv/put":
             kv.put(req["key"], req["value"])
+            if not self._kv_notifies:
+                self._bump()
             return {"ok": True}
         if path == "/kv/delete":
-            return {"deleted": kv.delete(req["key"])}
+            deleted = kv.delete(req["key"])
+            if deleted and not self._kv_notifies:
+                self._bump()
+            return {"deleted": deleted}
         if path == "/kv/range":
             return {"items": list(kv.range(req["prefix"]))}
         if path == "/kv/cas":
-            return {"ok": kv.compare_and_put(
-                req["key"], req.get("expect"), req["value"])}
+            ok = kv.compare_and_put(
+                req["key"], req.get("expect"), req["value"])
+            if ok and not self._kv_notifies:
+                self._bump()
+            return {"ok": ok}
+        if path == "/kv/watch":
+            # long-poll: block until the service revision passes
+            # since_rev (any mutation), then return the fresh range —
+            # the client diffs/re-reads (etcd-watch semantics minus
+            # per-key event history)
+            since = int(req.get("since_rev", 0))
+            deadline = __import__("time").monotonic() + float(
+                req.get("timeout_s", 30.0))
+            with self._rev_cond:
+                while self._rev <= since:
+                    left = deadline - __import__("time").monotonic()
+                    if left <= 0:
+                        break
+                    self._rev_cond.wait(timeout=left)
+                rev = self._rev
+            return {"rev": rev, "changed": rev > since,
+                    "items": list(kv.range(req.get("prefix", "")))}
         if path == "/heartbeat":
             return self._heartbeat(req)
         if path == "/admin/alive_nodes":
@@ -233,6 +315,38 @@ class HttpKv(KvBackend):
             "/kv/cas", {"key": key, "expect": expect, "value": value},
             idempotent=False)["ok"]
 
+    def watch(self, prefix: str, since_rev: int = 0,
+              timeout_s: float = 30.0) -> dict:
+        """Long-poll until any mutation past `since_rev`; returns
+        {"rev", "changed", "items"} — re-issue with the returned rev to
+        keep watching (the etcd-watch analog frontends use for route
+        invalidation instead of per-query polling).
+
+        Dedicated one-shot connection: the server HOLDS the request up
+        to `timeout_s`, so the keep-alive pool's fixed socket timeout
+        would kill every idle poll."""
+        import http.client
+
+        c = http.client.HTTPConnection(self._http.host, self._http.port,
+                                       timeout=timeout_s + 10.0)
+        try:
+            c.request("POST", "/kv/watch", json.dumps(
+                {"prefix": prefix, "since_rev": since_rev,
+                 "timeout_s": timeout_s}).encode(),
+                {"Content-Type": "application/json"})
+            r = c.getresponse()
+            raw = r.read()
+            if r.status != 200:
+                raise MetaServiceError(
+                    f"/kv/watch: HTTP {r.status}: {raw[:200]!r}")
+            return json.loads(raw)
+        except MetaServiceError:
+            raise
+        except Exception as e:  # noqa: BLE001 — transport layer
+            raise MetaServiceError(f"/kv/watch: {e}") from None
+        finally:
+            c.close()
+
 
 class MetaClient:
     """The meta-client analog (reference src/meta-client/): heartbeats +
@@ -278,6 +392,10 @@ class MetaClient:
         return self._http.post("/admin/migrate_region", {
             "table": table, "region_id": region_id,
             "to_node": to_node})["procedure_id"]
+
+    def watch(self, prefix: str, since_rev: int = 0,
+              timeout_s: float = 30.0) -> dict:
+        return self.kv.watch(prefix, since_rev, timeout_s)
 
     def node_addrs(self) -> dict[str, str]:
         """node_id -> Flight addr registry (written on heartbeat)."""

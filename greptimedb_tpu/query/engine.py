@@ -1242,10 +1242,15 @@ class QueryEngine:
         ncols = len(col_names)
         by_col: dict[str, list] = {}
         # bulk-load fast path: plain literal tuples (the overwhelming
-        # VALUES shape) transpose column-wise without per-value dispatch
-        if all(len(row) == ncols and all(type(e) is ast.Literal
-                                         for e in row)
-               for row in stmt.rows):
+        # VALUES shape) transpose column-wise without per-value dispatch;
+        # the parser's INSERT fast path pre-certifies all-literal rows of
+        # UNIFORM length — the arity against THIS table's column list
+        # must still hold here (the parser doesn't know the schema)
+        if (getattr(stmt, "all_literal_rows", False)
+                and stmt.rows and len(stmt.rows[0]) == ncols) or \
+                all(len(row) == ncols and all(type(e) is ast.Literal
+                                              for e in row)
+                    for row in stmt.rows):
             for name, col in zip(col_names, zip(*stmt.rows)):
                 by_col[name] = [None if (v := e.value) != v else v
                                 for e in col]
@@ -1517,12 +1522,14 @@ class QueryEngine:
         # host between stages — over a remote accelerator link that
         # readback dominates every evaluation, so the whole TQL pipeline
         # takes the host tier unless the chip is co-located (same policy
-        # as PhysicalExecutor.tier_for)
+        # as PhysicalExecutor.tier_for, including mode force/off)
         tier = "device"
-        if _jax.default_backend() != "cpu" \
-                and _cfg.host_tier_mode() != "off" \
-                and not accelerator_link()["colocated"]:
-            tier = "host"
+        if _jax.default_backend() != "cpu":
+            mode = _cfg.host_tier_mode()
+            if mode == "force":
+                tier = "host"
+            elif mode != "off" and not accelerator_link()["colocated"]:
+                tier = "host"
         with _TierCtx(tier):
             return self._tql_inner(stmt, ctx)
 

@@ -894,9 +894,13 @@ class PhysicalExecutor:
         device."""
         from greptimedb_tpu import config
 
-        if jax.default_backend() == "cpu" or self.mesh is not None \
-                or config.host_tier_mode() == "off":
+        if jax.default_backend() == "cpu" or self.mesh is not None:
             return "device"
+        mode = config.host_tier_mode()
+        if mode == "off":
+            return "device"
+        if mode == "force":
+            return "host"
         if accelerator_link()["colocated"]:
             return "device"
         if not streaming and agg is not None \
@@ -1006,10 +1010,9 @@ class PhysicalExecutor:
 
             nrows = 0 if scan is None else scan.num_rows
             if agg is not None:
-                tier = self.tier_for(agg, nrows)
-                self.last_tier = tier
-                with tracing.span("aggregate", rows=nrows, tier=tier), \
-                        _TierCtx(tier):
+                # tier decision happens INSIDE _execute_agg, after the
+                # boundary fast path has (possibly) shrunk the scan
+                with tracing.span("aggregate", rows=nrows):
                     return self._execute_agg(scan, table, where, agg,
                                              having, project, sort, limit,
                                              offset, scan_node)
@@ -1256,9 +1259,17 @@ class PhysicalExecutor:
                                            keys, extra_cols)
         if reduced is not None:
             scan = reduced
-        acc, sparse_gids = self._stream_agg(
-            scan, table, bound_where, tuple(keys), tuple(arg_exprs),
-            tuple(sorted(ops)), num_groups, ts_name, ctx, extra_cols, sparse)
+        # tier re-decision on the POST-reduction row count: the
+        # boundary fast path shrinks a 17M-row lastpoint to a few
+        # thousand candidate rows — routing those to a remote chip
+        # would pay the link RTT for microseconds of compute
+        tier = self.tier_for(agg, scan.num_rows)
+        self.last_tier = tier
+        with _TierCtx(tier):
+            acc, sparse_gids = self._stream_agg(
+                scan, table, bound_where, tuple(keys), tuple(arg_exprs),
+                tuple(sorted(ops)), num_groups, ts_name, ctx, extra_cols,
+                sparse)
         if reduced is not None:
             self.last_path = "boundary+" + (self.last_path or "")
         host_info = (scan, extra_cols, bound_where, ctx, num_groups)

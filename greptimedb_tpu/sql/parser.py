@@ -1231,10 +1231,12 @@ def _fast_parse_insert(sql: str):
     in_row = False
     expect_value = False
     Literal = ast.Literal
-    while pos < n:
-        tm = _VALUES_TOKEN.match(sql, pos)
-        if tm is None:
-            break  # trailing whitespace handled after the loop
+    # one C-driven finditer sweep; contiguity check per token (finditer
+    # would silently SKIP an unmatched char — a gap means a construct
+    # the fast path doesn't know, so fall back)
+    for tm in _VALUES_TOKEN.finditer(sql, pos):
+        if tm.start() != pos:
+            return None
         pos = tm.end()
         text = tm.lastgroup
         if text == "punc":
@@ -1289,7 +1291,14 @@ def _fast_parse_insert(sql: str):
     ncols = len(rows[0])
     if any(len(r) != ncols for r in rows):
         return None  # let the full parser raise its arity error
-    return [ast.Insert(table, columns, rows)]
+    ins = ast.Insert(table, columns, rows)
+    try:
+        # every row is literal tuples BY CONSTRUCTION — let the engine
+        # skip its per-value re-verification on the bulk path
+        ins.all_literal_rows = True
+    except Exception:  # noqa: BLE001 — frozen ast: flag is optional
+        pass
+    return [ins]
 
 
 def parse_sql(sql: str) -> list[ast.Statement]:

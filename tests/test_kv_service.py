@@ -51,6 +51,60 @@ class TestHttpKv:
         assert [kv.incr("seq") for _ in range(3)] == [1, 2, 3]
 
 
+class TestWatch:
+    def test_watch_wakes_on_mutation(self, service):
+        import threading
+
+        client = MetaClient(service.addr)
+        kv = HttpKv(service.addr)
+        out = []
+
+        def watcher():
+            out.append(client.watch("w/", since_rev=0, timeout_s=10.0))
+
+        t = threading.Thread(target=watcher)
+        t.start()
+        import time
+
+        time.sleep(0.2)
+        kv.put("w/a", "1")
+        t.join(timeout=10)
+        assert out and out[0]["changed"] is True
+        assert ("w/a", "1") in [tuple(i) for i in out[0]["items"]]
+
+    def test_watch_times_out_quietly(self, service):
+        client = MetaClient(service.addr)
+        rev = client.watch("x/", since_rev=0, timeout_s=0.2)["rev"]
+        out = client.watch("x/", since_rev=rev, timeout_s=0.2)
+        assert out["changed"] is False
+
+    def test_watch_sees_coordinator_internal_writes(self):
+        """Failover route swaps bypass HTTP — NotifyingKv wakes
+        watchers for them too."""
+        import threading
+        import time
+
+        from greptimedb_tpu.meta.kv_service import NotifyingKv
+
+        kv = NotifyingKv(MemoryKv())
+        metasrv = Metasrv(kv, MetasrvOptions())
+        svc = MetaHttpService(metasrv, port=0)
+        svc.start()
+        try:
+            client = MetaClient(svc.addr)
+            out = []
+            t = threading.Thread(target=lambda: out.append(
+                client.watch("__meta/table_route/", 0, timeout_s=10.0)))
+            t.start()
+            time.sleep(0.2)
+            # an internal write, as the failover procedure would do
+            metasrv.kv.put("__meta/table_route/t1", "{}")
+            t.join(timeout=10)
+            assert out and out[0]["changed"] is True
+        finally:
+            svc.stop()
+
+
 class TestMetaClient:
     def test_heartbeat_lease_and_registry(self, service):
         client = MetaClient(service.addr, node_addr="127.0.0.1:5555")
