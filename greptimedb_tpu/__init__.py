@@ -66,9 +66,26 @@ if _cc.lower() not in ("off", "0", "none", "false", "no", "disabled"):
                         lines.append(line)
                     if line.strip() == "" and lines:
                         break  # first core is representative
-            if lines:
-                return hashlib.sha256(
-                    "".join(lines).encode()).hexdigest()[:12]
+            joined = "".join(lines)
+            # cloud VMs MASK the microarch ("Intel(R) Xeon(R) Processor
+            # @ 2.10GHz" on every profile) — then cpuinfo cannot
+            # distinguish machine types that XLA's CPUID probe can, and
+            # a migration poisons the cache anyway (round-5: cpuinfo
+            # hash identical across a profile swap; +prefer-no-scatter
+            # executables ran ~3x slow here). With a masked model, tie
+            # the cache to the BOOT instead: still warm across process
+            # restarts, never stale across a migration (which reboots).
+            masked = "model name" not in joined or \
+                "Processor @" in joined
+            if masked:
+                try:
+                    with open("/proc/sys/kernel/random/boot_id",
+                              encoding="utf-8") as f:
+                        joined += f.read()
+                except OSError:
+                    pass
+            if joined:
+                return hashlib.sha256(joined.encode()).hexdigest()[:12]
         except OSError:
             pass
         return "noflags"

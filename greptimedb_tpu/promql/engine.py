@@ -261,10 +261,59 @@ class PromqlEngine:
         if loaded is None:
             return None
         sidx, ts, chans, labels, metric = loaded
-        st = window_stats(sidx, ts, chans, ~jnp.isnan(chans[:, 0]),
-                          p.start, p.step, len(labels), p.T, w,
-                          stats=stats, sorted_input=_sorted_ws())
+        st = None
+        if set(stats) <= {"count", "first", "last"} \
+                and not isinstance(sel, Subquery) \
+                and _edges_enabled():
+            # rate-family fast path: scrape-aligned series share ONE
+            # complete sample grid, so window edges are T probes into
+            # the grid + column gathers from a pivoted [S, P, C] matrix
+            # (ops/window.py window_edges_grid — the asymmetry the
+            # numpy straw-man anchor exploits, now on device). The
+            # pivot (plus its NaN-free check: LWW tombstones ride as
+            # NaN the probes cannot mask) is cached with the loaded
+            # series, so repeated evals pay only the probes.
+            pivot = self._grid_pivot(sidx, ts, chans, len(labels))
+            if pivot is not None:
+                from greptimedb_tpu.ops.window import window_edges_grid
+
+                grid, mat = pivot
+                st = window_edges_grid(grid, mat, p.start, p.step,
+                                       p.T, w)
+        if st is None:
+            st = window_stats(sidx, ts, chans, ~jnp.isnan(chans[:, 0]),
+                              p.start, p.step, len(labels), p.T, w,
+                              stats=stats, sorted_input=_sorted_ws())
         return st, labels, metric, w, range_s
+
+    def _grid_pivot(self, sidx, ts, chans, n_series):
+        """(grid [P], mat [S, P, C]) when every series has exactly the
+        same complete, NaN-free sample grid; None otherwise. Identity-
+        cached against the loaded arrays (which the load cache pins),
+        so detection + pivot run once per scan snapshot."""
+        ex = getattr(self.qe, "executor", None)
+        cache = getattr(ex, "_promql_pivot_cache", None) if ex else None
+        if cache is None and ex is not None:
+            cache = ex._promql_pivot_cache = []
+        if cache is not None:
+            for c_sidx, c_chans, result in cache:
+                if c_sidx is sidx and c_chans is chans:
+                    return result
+        result = None
+        n = int(chans.shape[0])
+        S = n_series
+        if S > 0 and n % S == 0:
+            P = n // S
+            ts_np = np.asarray(ts)
+            grid = ts_np[:P]
+            if (ts_np.reshape(S, P) == grid[None, :]).all() \
+                    and not bool(jnp.isnan(chans).any()):
+                result = (jnp.asarray(grid), chans.reshape(S, P,
+                                                           chans.shape[1]))
+        if cache is not None:
+            cache.append((sidx, chans, result))
+            del cache[:-2]  # two live scans at most (load cache holds 4)
+        return result
 
     def _load_any(self, sel, p: EvalParams, ctx, window: float,
                   extra_channels=()):
@@ -1188,6 +1237,15 @@ def _sorted_ws() -> bool:
     import jax
 
     return jax.default_backend() in ("tpu", "axon")
+
+
+def _edges_enabled() -> bool:
+    """Rate-family boundary evaluation (window_edges). On by default;
+    =off pins the dense window_stats path (differential debugging)."""
+    import os
+
+    return os.environ.get("GREPTIMEDB_TPU_PROMQL_EDGES",
+                          "on").lower() not in ("off", "0", "false")
 
 
 def _matcher_mask(m: Matcher, scan, tag_names) -> np.ndarray:

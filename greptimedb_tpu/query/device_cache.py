@@ -11,6 +11,7 @@ version, so stale blocks simply stop being referenced and age out via LRU.
 
 from __future__ import annotations
 
+import threading
 from collections import OrderedDict
 from typing import Callable
 
@@ -20,30 +21,42 @@ from greptimedb_tpu import config
 
 
 class DeviceCache:
+    """Thread-safe: concurrent server threads (and the executor's
+    background device warm-up) build/evict under one lock; `build`
+    itself runs outside it, so duplicate concurrent builds are possible
+    but accounting never double-counts (last writer wins)."""
+
     def __init__(self, budget_bytes: int | None = None):
         self.budget = budget_bytes if budget_bytes is not None else config.device_cache_bytes()
         self._lru: OrderedDict[tuple, jax.Array] = OrderedDict()
         self._bytes = 0
+        self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
 
     def get(self, key: tuple, build: Callable[[], jax.Array]) -> jax.Array:
-        hit = self._lru.get(key)
-        if hit is not None:
-            self._lru.move_to_end(key)
-            self.hits += 1
-            return hit
-        self.misses += 1
+        with self._lock:
+            hit = self._lru.get(key)
+            if hit is not None:
+                self._lru.move_to_end(key)
+                self.hits += 1
+                return hit
+            self.misses += 1
         arr = build()
         nbytes = arr.nbytes
         if nbytes <= self.budget:
-            self._lru[key] = arr
-            self._bytes += nbytes
-            while self._bytes > self.budget and self._lru:
-                _, old = self._lru.popitem(last=False)
-                self._bytes -= old.nbytes
+            with self._lock:
+                old = self._lru.pop(key, None)
+                if old is not None:
+                    self._bytes -= old.nbytes
+                self._lru[key] = arr
+                self._bytes += nbytes
+                while self._bytes > self.budget and self._lru:
+                    _, evicted = self._lru.popitem(last=False)
+                    self._bytes -= evicted.nbytes
         return arr
 
     def clear(self) -> None:
-        self._lru.clear()
-        self._bytes = 0
+        with self._lock:
+            self._lru.clear()
+            self._bytes = 0

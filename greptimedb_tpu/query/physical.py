@@ -19,6 +19,7 @@ from __future__ import annotations
 import functools
 import itertools
 import math
+import threading
 from dataclasses import dataclass
 from typing import Optional
 
@@ -875,11 +876,36 @@ class PhysicalExecutor:
         # multi-device: row-shard the scan over the mesh and combine
         # partial aggregates with collectives (None on a single chip)
         self.mesh = config.query_mesh()
-        # which aggregate path served the last query (dense | sparse |
-        # sharded | stream) — observability for EXPLAIN/tests
-        self.last_path = None
-        # which execution tier ran it (device | host) — see tier_for
-        self.last_tier = "device"
+        # last_path (which aggregate path served the last query:
+        # dense | sparse | sharded | stream) and last_tier live behind
+        # thread-local properties below
+        # hedged device warm-up: shape keys whose device executable is
+        # compiled (first-touch queries serve host-side while the
+        # ~25 s accelerator compile runs in the background)
+        self._device_warm: set = set()
+        self._device_warming: set = set()
+        self._device_warm_failed: set = set()
+        self._warm_lock = threading.Lock()
+        # last_path/last_tier are THREAD-LOCAL: the background warm
+        # thread runs the same _stream_agg machinery and must not
+        # clobber the foreground query's reported path/tier
+        self._tls = threading.local()
+
+    @property
+    def last_path(self):
+        return getattr(self._tls, "last_path", None)
+
+    @last_path.setter
+    def last_path(self, v):
+        self._tls.last_path = v
+
+    @property
+    def last_tier(self):
+        return getattr(self._tls, "last_tier", "device")
+
+    @last_tier.setter
+    def last_tier(self, v):
+        self._tls.last_tier = v
 
     def tier_for(self, agg, num_rows: int, streaming: bool = False) -> str:
         """Tiered execution (round-5 redesign): over a REMOTE
@@ -1264,12 +1290,13 @@ class PhysicalExecutor:
         # thousand candidate rows — routing those to a remote chip
         # would pay the link RTT for microseconds of compute
         tier = self.tier_for(agg, scan.num_rows)
+        stream_args = (scan, table, bound_where, tuple(keys),
+                       tuple(arg_exprs), tuple(sorted(ops)), num_groups,
+                       ts_name, ctx, extra_cols, sparse)
+        tier = self._hedge_device_warmup(tier, stream_args)
         self.last_tier = tier
         with _TierCtx(tier):
-            acc, sparse_gids = self._stream_agg(
-                scan, table, bound_where, tuple(keys), tuple(arg_exprs),
-                tuple(sorted(ops)), num_groups, ts_name, ctx, extra_cols,
-                sparse)
+            acc, sparse_gids = self._stream_agg(*stream_args)
         if reduced is not None:
             self.last_path = "boundary+" + (self.last_path or "")
         host_info = (scan, extra_cols, bound_where, ctx, num_groups)
@@ -1320,6 +1347,61 @@ class PhysicalExecutor:
 
         return self._post_process(env, agg, having, project, sort, limit, offset,
                                   table, len(present))
+
+    def _hedge_device_warmup(self, tier: str, stream_args) -> str:
+        """First-touch hedge: an accelerator's first compile of a query
+        shape costs tens of seconds (measured ~25 s on v5e through the
+        remote compile helper) — blocking the first query on it is the
+        round-4 verdict's 40 s cold-start. Instead, kick the device
+        fold on a background thread and serve THIS query host-side;
+        once the background compile lands, the shape joins
+        `_device_warm` and later queries run on the chip. Applies only
+        in auto mode on a real accelerator backend (explicit mode=off
+        means the caller wants the device NOW and will wait)."""
+        from greptimedb_tpu import config
+
+        if tier != "device" or jax.default_backend() == "cpu" \
+                or self.mesh is not None \
+                or config.host_tier_mode() != "auto":
+            return tier
+        scan = stream_args[0]
+        # repr() folds the full query shape in: WHERE expression, group
+        # keys, and arg expressions each change the compiled HLO — a
+        # key missing them would declare a DIFFERENT program warm and
+        # block the foreground on its cold compile
+        wkey = (scan.region_id, scan.data_version, scan.scan_fingerprint,
+                repr(stream_args[2]), repr(stream_args[3]),
+                repr(stream_args[4]), stream_args[5], stream_args[6],
+                stream_args[10])
+        with self._warm_lock:
+            if wkey in self._device_warm:
+                return "device"
+            if wkey in self._device_warm_failed:
+                return "host"  # don't re-kick a known-failing compile
+            already = wkey in self._device_warming
+            if not already:
+                self._device_warming.add(wkey)
+        if not already:
+            def warm():
+                try:
+                    with _TierCtx("device"):
+                        self._stream_agg(*stream_args)
+                    with self._warm_lock:
+                        self._device_warm.add(wkey)
+                except Exception:  # noqa: BLE001 — hedge must not raise
+                    import traceback
+
+                    traceback.print_exc()
+                    print("device warm-up failed for this query shape; "
+                          "it stays on the host tier", flush=True)
+                    with self._warm_lock:
+                        self._device_warm_failed.add(wkey)
+                finally:
+                    with self._warm_lock:
+                        self._device_warming.discard(wkey)
+
+            threading.Thread(target=warm, daemon=True).start()
+        return "host"
 
     def _boundary_firstlast(self, scan, table, agg, bound_where, keys,
                             extra_cols) -> Optional[ScanData]:
