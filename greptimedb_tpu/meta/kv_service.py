@@ -106,6 +106,7 @@ class MetaHttpService:
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"  # keep-alive for client reuse
+            disable_nagle_algorithm = True  # heartbeats are latency-bound
 
             def log_message(self, *a):  # quiet; errors surface to clients
                 pass

@@ -133,6 +133,11 @@ class _PacketIO:
 
 class _Session(socketserver.BaseRequestHandler):
     def handle(self):
+        import socket as _socket
+
+        # wire-protocol packets go out in several send()s per response;
+        # Nagle + delayed-ACK adds ~40 ms per round-trip otherwise
+        self.request.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
         io = _PacketIO(self.request)
         server: MysqlServer = self.server.owner  # type: ignore[attr-defined]
         # ---- handshake v10 ----

@@ -42,6 +42,8 @@ def parse_put_line(line: str):
 
 
 class _Session(socketserver.StreamRequestHandler):
+    disable_nagle_algorithm = True
+
     def handle(self):
         server: OpentsdbServer = self.server.owner  # type: ignore[attr-defined]
         from greptimedb_tpu.servers.influx import Point, write_points

@@ -79,6 +79,9 @@ class _Conn:
 
 class _Session(socketserver.BaseRequestHandler):
     def handle(self):
+        import socket as _socket
+
+        self.request.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
         conn = _Conn(self.request)
         server: PostgresServer = self.server.owner  # type: ignore[attr-defined]
         # ---- startup ----
