@@ -97,8 +97,7 @@ def window_stats(
 
     if "sum" in stats:
         bsum = grid(per_bucket["sum"], C)
-        cs = jnp.concatenate([jnp.zeros((S, 1, C), bsum.dtype),
-                              jnp.cumsum(bsum, axis=1)], axis=1)
+        cs = exclusive_cumsum(bsum)
         out["sum"] = cs[:, w:w + T] - cs[:, 0:T]
     if "count" in stats:
         cc = jnp.concatenate([jnp.zeros((S, 1, C), jnp.int64),
@@ -366,3 +365,38 @@ def window_edges_grid(
         count.astype(jnp.int64)[None, :, None], (S, T, 1))
     return {"first": first, "first_ts": first_ts, "last": last,
             "last_ts": last_ts, "count": count_st}
+
+
+@functools.partial(jax.jit, static_argnames=("num_steps", "w"))
+def window_sums_grid(
+    grid: jax.Array,  # [P] float64 shared sample grid (seconds, sorted)
+    cs: jax.Array,  # [S, P+1, C] exclusive prefix sums over the pivot
+    t0,
+    step,
+    num_steps: int,
+    w: int,
+) -> dict[str, jax.Array]:
+    """Window sums/counts on a complete shared grid: one cumulative sum
+    over the pivot (cached by the caller), then every (window, series)
+    sum is a two-gather difference — the sum_over_time/avg_over_time
+    analog of window_edges_grid. Window j covers
+    (t0 + (j-w)·step, t0 + j·step], matching window_stats."""
+    S = cs.shape[0]
+    T = num_steps
+    j = jnp.arange(T, dtype=jnp.float64)
+    i0 = jnp.searchsorted(grid, t0 + (j - w) * step, side="right")
+    i1 = jnp.searchsorted(grid, t0 + j * step, side="right")
+    count = i1 - i0
+    out_sum = cs[:, i1, :] - cs[:, i0, :]  # [S, T, C]
+    count_st = jnp.broadcast_to(
+        count.astype(jnp.int64)[None, :, None], (S, T, 1))
+    return {"sum": out_sum, "count": count_st}
+
+
+def exclusive_cumsum(mat: jax.Array) -> jax.Array:
+    """[S, P, C] -> [S, P+1, C] exclusive prefix sums along axis 1 (the
+    shared idiom of window_stats' window sums and window_sums_grid)."""
+    S, _, C = mat.shape
+    return jnp.concatenate(
+        [jnp.zeros((S, 1, C), mat.dtype), jnp.cumsum(mat, axis=1)],
+        axis=1)

@@ -262,7 +262,22 @@ class PromqlEngine:
             return None
         sidx, ts, chans, labels, metric = loaded
         st = None
-        if set(stats) <= {"count", "first", "last"} \
+        if "sum" in stats and set(stats) <= {"sum", "count"} \
+                and not isinstance(sel, Subquery) \
+                and _edges_enabled():
+            # sum/avg_over_time fast path: one cached cumulative sum
+            # over the pivot turns every window sum into a two-gather
+            # difference (window_sums_grid). Count-only stats skip this
+            # — the edges path below derives counts from probes alone,
+            # without materializing a pivot-sized cumsum.
+            pivot = self._grid_pivot(sidx, ts, chans, len(labels))
+            if pivot is not None:
+                from greptimedb_tpu.ops.window import window_sums_grid
+
+                grid, mat = pivot
+                st = window_sums_grid(grid, self._grid_cumsum(mat),
+                                      p.start, p.step, p.T, w)
+        if st is None and set(stats) <= {"count", "first", "last"} \
                 and not isinstance(sel, Subquery) \
                 and _edges_enabled():
             # rate-family fast path: scrape-aligned series share ONE
@@ -314,6 +329,31 @@ class PromqlEngine:
             cache.append((sidx, chans, result))
             del cache[:-2]  # two live scans at most (load cache holds 4)
         return result
+
+    #: pivots larger than this don't cache their prefix sums (the
+    #: cumsum doubles the pivot's memory; recompute instead)
+    _CUMSUM_CACHE_BYTES = 512 << 20
+
+    def _grid_cumsum(self, mat):
+        """Exclusive prefix sums [S, P+1, C] over a pivoted matrix,
+        identity-cached beside the pivot (window_sums_grid consumes
+        them). Oversized pivots compute fresh each eval rather than
+        doubling resident memory."""
+        from greptimedb_tpu.ops.window import exclusive_cumsum
+
+        ex = getattr(self.qe, "executor", None)
+        cache = getattr(ex, "_promql_cumsum_cache", None) if ex else None
+        if cache is None and ex is not None:
+            cache = ex._promql_cumsum_cache = []
+        if cache is not None:
+            for c_mat, cs in cache:
+                if c_mat is mat:
+                    return cs
+        cs = exclusive_cumsum(mat)
+        if cache is not None and cs.nbytes <= self._CUMSUM_CACHE_BYTES:
+            cache.append((mat, cs))
+            del cache[:-2]
+        return cs
 
     def _load_any(self, sel, p: EvalParams, ctx, window: float,
                   extra_channels=()):

@@ -289,6 +289,39 @@ class TestWindowStatsSorted:
                                        err_msg=k)
 
     @pytest.mark.parametrize("seed,S,T,w,step", [
+        (8, 6, 10, 2, 10.0), (9, 3, 24, 4, 30.0),
+    ])
+    def test_window_sums_grid_matches_dense(self, seed, S, T, w, step):
+        """The cumsum-difference window sums (sum/avg_over_time fast
+        path) must match the dense bucketization exactly."""
+        import numpy as np
+
+        from greptimedb_tpu.ops.window import (window_stats,
+                                               window_sums_grid)
+
+        rng = np.random.default_rng(seed)
+        P = int(T * step // 5) + 7
+        grid = -step * (w - 1) + np.arange(P) * 5.0
+        ch = rng.uniform(-5, 5, (S, P, 2))
+        sidx = np.repeat(np.arange(S, dtype=np.int32), P)
+        ts = np.tile(grid, S)
+        dense = window_stats(
+            jnp.asarray(sidx), jnp.asarray(ts),
+            jnp.asarray(ch.reshape(S * P, 2)),
+            jnp.ones(S * P, dtype=bool), 0.0, step, S, T, w,
+            stats=("sum", "count"), sorted_input=False)
+        cs = jnp.concatenate(
+            [jnp.zeros((S, 1, 2)), jnp.cumsum(jnp.asarray(ch), axis=1)],
+            axis=1)
+        sums = window_sums_grid(jnp.asarray(grid), cs, 0.0, step, T, w)
+        np.testing.assert_array_equal(
+            np.asarray(sums["count"])[:, :, 0],
+            np.asarray(dense["count"])[:, :, 0])
+        np.testing.assert_allclose(
+            np.asarray(sums["sum"]), np.asarray(dense["sum"]),
+            rtol=1e-9, atol=1e-9)
+
+    @pytest.mark.parametrize("seed,S,T,w,step", [
         (5, 6, 10, 2, 10.0), (6, 1, 7, 1, 15.0), (7, 11, 24, 4, 60.0),
     ])
     def test_window_edges_grid_matches_dense(self, seed, S, T, w, step):
