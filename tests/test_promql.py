@@ -240,6 +240,29 @@ class TestTql:
         np.testing.assert_allclose(by_host["a"], 2.0, rtol=1e-9)
         np.testing.assert_allclose(by_host["b"], 4.0, rtol=1e-9)
 
+    def test_lww_overwrite_and_tombstone_on_non_append(self, db):
+        """Non-append tables: the highest-SEQ version of a (series, ts)
+        wins regardless of scan concat order (flush boundaries force
+        multi-SST concat), and a delete tombstone suppresses the sample
+        entirely."""
+        db.execute_one(
+            "CREATE TABLE g (host STRING, ts TIMESTAMP(3) NOT NULL, "
+            "val DOUBLE, TIME INDEX (ts), PRIMARY KEY (host))")
+        rid = db.catalog.table("public", "g").region_ids[0]
+        t_ms = (T0 + 60) * 1000
+        db.execute_one(f"INSERT INTO g VALUES ('a', {t_ms}, 5.0), "
+                       f"('b', {t_ms}, 1.0)")
+        db.region_engine.flush(rid)
+        db.execute_one(f"INSERT INTO g VALUES ('a', {t_ms}, 7.0)")
+        db.region_engine.flush(rid)
+        r = db.execute_one(f"TQL EVAL ({T0 + 60}, {T0 + 60}, '1') g")
+        got = {h: v for h, v in zip(r.to_pydict()["host"],
+                                    r.to_pydict()["value"])}
+        assert got == {"a": 7.0, "b": 1.0}  # overwrite wins by seq
+        db.execute_one(f"DELETE FROM g WHERE host = 'b'")
+        r = db.execute_one(f"TQL EVAL ({T0 + 60}, {T0 + 60}, '1') g")
+        assert sorted(r.to_pydict()["host"]) == ["a"]  # tombstoned
+
     def test_tql_label_output(self, db):
         seed_counter(db)
         r = db.execute_one(f"TQL EVAL ({T0 + 300}, {T0 + 300}, '1') http_requests")
