@@ -68,22 +68,19 @@ if _cc.lower() not in ("off", "0", "none", "false", "no", "disabled"):
                         break  # first core is representative
             joined = "".join(lines)
             # cloud VMs MASK the microarch ("Intel(R) Xeon(R) Processor
-            # @ 2.10GHz" on every profile) — then cpuinfo cannot
-            # distinguish machine types that XLA's CPUID probe can, and
-            # a migration poisons the cache anyway (round-5: cpuinfo
-            # hash identical across a profile swap; +prefer-no-scatter
-            # executables ran ~3x slow here). With a masked model, tie
-            # the cache to the BOOT instead: still warm across process
-            # restarts, never stale across a migration (which reboots).
+            # @ 2.10GHz" on every profile) AND live-migrate between
+            # physical hosts WITHOUT rebooting — cpuinfo and boot_id
+            # both stay constant while XLA's CPUID probe sees a
+            # different machine, so no salt can keep a persistent
+            # XLA:CPU executable valid (round-5: +prefer-no-scatter
+            # entries compiled hours earlier in the SAME boot loaded
+            # onto a migrated host and ran ~3x slow). On masked hosts
+            # the cache is unsalvageable: disable it (returning None)
+            # — the executor's hedged warm-up absorbs cold compiles.
             masked = "model name" not in joined or \
                 "Processor @" in joined
             if masked:
-                try:
-                    with open("/proc/sys/kernel/random/boot_id",
-                              encoding="utf-8") as f:
-                        joined += f.read()
-                except OSError:
-                    pass
+                return None
             if joined:
                 return hashlib.sha256(joined.encode()).hexdigest()[:12]
         except OSError:
@@ -91,11 +88,18 @@ if _cc.lower() not in ("off", "0", "none", "false", "no", "disabled"):
         return "noflags"
 
     try:
-        jax.config.update(
-            "jax_compilation_cache_dir",
-            _cc or _os.path.join(_os.path.expanduser("~"), ".cache",
-                                 f"greptimedb_tpu_xla_{_host_salt()}"))
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        _salt = _host_salt()
+        if _cc or _salt is not None:
+            jax.config.update(
+                "jax_compilation_cache_dir",
+                _cc or _os.path.join(_os.path.expanduser("~"), ".cache",
+                                     f"greptimedb_tpu_xla_{_salt}"))
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 0.5)
+        # masked-microarch host and no explicit dir: persistent cache
+        # stays OFF (see _host_salt) — explicitly setting
+        # GREPTIMEDB_TPU_COMPILE_CACHE=<dir> overrides for operators
+        # who know their fleet doesn't live-migrate
     except Exception:  # noqa: BLE001 — older jax: feature is optional
         pass
 
