@@ -71,16 +71,29 @@ if _cc.lower() not in ("off", "0", "none", "false", "no", "disabled"):
             # @ 2.10GHz" on every profile) AND live-migrate between
             # physical hosts WITHOUT rebooting — cpuinfo and boot_id
             # both stay constant while XLA's CPUID probe sees a
-            # different machine, so no salt can keep a persistent
-            # XLA:CPU executable valid (round-5: +prefer-no-scatter
-            # entries compiled hours earlier in the SAME boot loaded
-            # onto a migrated host and ran ~3x slow). On masked hosts
-            # the cache is unsalvageable: disable it (returning None)
-            # — the executor's hedged warm-up absorbs cold compiles.
+            # different machine, so no salt keeps a persistent XLA:CPU
+            # executable valid (round-5: +prefer-no-scatter entries
+            # compiled hours earlier in the SAME boot loaded onto a
+            # migrated host and ran ~3x slow). Policy on masked hosts:
+            # - CPU-pinned process: DISABLE the cache (every cached
+            #   executable is an XLA:CPU one at risk); the hedged
+            #   warm-up absorbs cold compiles.
+            # - accelerator-capable process: keep a BOOT-salted cache —
+            #   TPU executables target the chip, not the host CPU, and
+            #   first-compiles through a remote helper cost ~25 s each.
             masked = "model name" not in joined or \
                 "Processor @" in joined
             if masked:
-                return None
+                cpu_pinned = _plat == "cpu" or \
+                    _os.environ.get("JAX_PLATFORMS", "") == "cpu"
+                if cpu_pinned:
+                    return None
+                try:
+                    with open("/proc/sys/kernel/random/boot_id",
+                              encoding="utf-8") as f:
+                        joined += f.read()
+                except OSError:
+                    pass
             if joined:
                 return hashlib.sha256(joined.encode()).hexdigest()[:12]
         except OSError:
