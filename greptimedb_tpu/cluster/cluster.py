@@ -23,6 +23,7 @@ from typing import Optional
 from ..catalog.catalog import Catalog, TableInfo
 from ..catalog.kv import KvBackend, MemoryKv
 from ..datatypes.schema import Schema
+from ..fault import FAULTS, FaultError, Unavailable, is_transient
 from ..meta.heartbeat import HeartbeatTask
 from ..meta.instruction import Instruction, InstructionKind
 from ..meta.metasrv import Metasrv, MetasrvOptions, RegionStat
@@ -30,6 +31,37 @@ from ..meta.route import RegionRoute, TableRoute
 from ..partition.rule import RangePartitionRule
 from ..query.engine import QueryContext, QueryEngine
 from ..storage.engine import EngineConfig, RegionEngine, RegionRequest, RequestType
+from ..utils.metrics import DEGRADED
+
+
+#: Flight error class names the router may fix by re-resolving the
+#: route. FlightServerError is included deliberately: a stale route over
+#: the wire surfaces as the REMOTE engine's KeyError wrapped in it.
+#: Auth errors and Arrow data errors (ArrowInvalid etc.) are excluded —
+#: re-routing cannot fix them and must not mask them as Unavailable.
+_RECOVERABLE_FLIGHT = frozenset({
+    "FlightUnavailableError", "FlightTimedOutError",
+    "FlightInternalError", "FlightServerError",
+})
+
+
+def _recoverable(e: BaseException, region_id: int) -> bool:
+    """Errors the router may fix by re-resolving the route: stale routes
+    (KeyError naming this region, from an engine/router that no longer
+    owns it — a KeyError about anything else is a programming error and
+    must surface), injected or self-described transient failures, and
+    Flight transport errors after the client's own retries are
+    exhausted."""
+    if isinstance(e, KeyError):
+        # every ownership-contract KeyError (engine "region N not open",
+        # router "no route for region N" / "region N has no live
+        # datanode") names the region with this exact phrase; a KeyError
+        # about anything else (a column, a dict key) does not
+        return f"region {region_id}" in str(e)
+    if isinstance(e, FaultError) or is_transient(e):
+        return True
+    return type(e).__module__.startswith("pyarrow") \
+        and type(e).__name__ in _RECOVERABLE_FLIGHT
 
 
 class Datanode:
@@ -93,8 +125,14 @@ class Datanode:
             self.engine.open_region(inst.region_id)
 
     def beat(self, now_ms: Optional[float] = None) -> None:
-        if self.alive:
-            self.heartbeat.beat(now_ms)
+        if not self.alive:
+            return
+        try:
+            FAULTS.fire("datanode.crash", node=self.node_id)
+        except FaultError:
+            self.kill()  # the chaos schedule chose this beat to die on
+            return
+        self.heartbeat.beat(now_ms)
 
     def enforce_leases(self, now_ms: Optional[float] = None) -> list[int]:
         """RegionAliveKeeper: self-close regions whose lease expired
@@ -225,18 +263,47 @@ class RegionRouter:
     def compact(self, region_id: int) -> None:
         self._engine_for(region_id).compact(region_id)
 
+    def _with_failover(self, region_id: int, op):
+        """Graceful degradation for the read path: when the engine's own
+        retries are exhausted (or the route is stale), re-resolve the
+        route — picking up any failover that moved the region — and try
+        once on the new owner; only then surface a typed `Unavailable`
+        instead of a transport stack trace."""
+        try:
+            return op(self._engine_for(region_id))
+        except Exception as e:  # noqa: BLE001 — predicate filters below
+            if not _recoverable(e, region_id):
+                raise
+            DEGRADED.inc(point="router.scan")
+            with self._lock:
+                self._region_node.pop(region_id, None)
+            self._refresh()
+            try:
+                return op(self._engine_for(region_id))
+            except Exception as e2:  # noqa: BLE001
+                if not _recoverable(e2, region_id):
+                    raise
+                raise Unavailable(
+                    f"region {region_id} unavailable after retries "
+                    "and route refresh", e2) from e2
+
     def scan(self, region_id: int, ts_range=None, projection=None,
              tag_predicates=None, seq_min=None):
-        return self._engine_for(region_id).scan(
-            region_id, ts_range, projection, tag_predicates,
-            seq_min=seq_min
-        )
+        return self._with_failover(
+            region_id,
+            lambda eng: eng.scan(region_id, ts_range, projection,
+                                 tag_predicates, seq_min=seq_min))
 
     def scan_stream(self, region_id: int, ts_range=None, projection=None,
                     tag_predicates=None):
-        return self._engine_for(region_id).scan_stream(
-            region_id, ts_range, projection, tag_predicates
-        )
+        # degradation covers stream CONSTRUCTION only: chunks read
+        # lazily after return cannot be replayed on a refreshed route
+        # without duplicating data (they lean on the objectstore seam's
+        # own retries instead)
+        return self._with_failover(
+            region_id,
+            lambda eng: eng.scan_stream(region_id, ts_range, projection,
+                                        tag_predicates))
 
     def _local_executor_for(self, eng):
         """Per-engine pushdown executor cache (holds device caches; the
@@ -256,14 +323,15 @@ class RegionRouter:
         only the terminal stage's output — partial planes, top-k
         candidates, or filtered rows — returns to the frontend
         (reference dist_plan Partial/Final split, analyzer.rs:35)."""
-        eng = self._engine_for(region_id)
-        if hasattr(eng, "execute_fragment"):  # RemoteRegionEngine: wire
-            return eng.execute_fragment(region_id, frag)
-        # in-process datanode: same computation, no serialization
-        from greptimedb_tpu.query.dist_agg import execute_region_fragment
+        def op(eng):
+            if hasattr(eng, "execute_fragment"):  # RemoteRegionEngine: wire
+                return eng.execute_fragment(region_id, frag)
+            # in-process datanode: same computation, no serialization
+            from greptimedb_tpu.query.dist_agg import execute_region_fragment
 
-        return execute_region_fragment(self._local_executor_for(eng),
-                                       region_id, frag)
+            return execute_region_fragment(self._local_executor_for(eng),
+                                           region_id, frag)
+        return self._with_failover(region_id, op)
 
     def alter_region_schema(self, region_id: int, schema) -> None:
         self._engine_for(region_id).alter_region_schema(region_id, schema)

@@ -29,10 +29,12 @@ import time
 from typing import Iterator
 
 from ..catalog.catalog import Catalog
+from ..fault import retry_call
 from ..meta.kv_service import MetaClient
 from ..meta.route import TableRouteManager
 from ..meta.selector import SELECTORS
 from ..procedure import ProcedureManager
+from ..utils.metrics import DEGRADED
 from .cluster import RegionRouter
 
 ALIVE_TTL_S = 0.5
@@ -60,7 +62,25 @@ class RemoteMetasrv:
             ts, nodes = self._alive
             if time.monotonic() - ts < ALIVE_TTL_S:
                 return nodes
-        nodes = self.meta.alive_nodes(now_ms)
+        from ..meta.kv_service import MetaServiceError
+
+        try:
+            nodes = retry_call(lambda: self.meta.alive_nodes(now_ms),
+                               point="meta.rpc",
+                               retryable=(OSError, MetaServiceError))
+        except (OSError, MetaServiceError):  # metasrv briefly away
+            # degrade to the last-known liveness view: stale beats none
+            # (the router re-resolves routes on any stale-route error);
+            # anything else — a programming error — must propagate.
+            if ts == 0.0:
+                raise  # never reached the metasrv: surface the error,
+                # don't masquerade it as an empty cluster
+            # re-stamp the cache so callers don't each re-pay the full
+            # retry budget while the metasrv stays down
+            DEGRADED.inc(point="meta.rpc")
+            with self._lock:
+                self._alive = (time.monotonic(), nodes)
+            return nodes
         with self._lock:
             self._alive = (time.monotonic(), nodes)
         return nodes

@@ -27,6 +27,7 @@ from typing import Optional
 
 from ..catalog.catalog import Catalog
 from ..catalog.kv import KvBackend, MemoryKv
+from ..fault import FAULTS, FaultError
 from ..meta.instruction import Instruction, InstructionKind
 from ..meta.metasrv import HeartbeatRequest, Metasrv, MetasrvOptions
 from ..query.engine import QueryContext, QueryEngine
@@ -71,7 +72,14 @@ class ProcDatanode:
                     f"{self._stderr_tail()}")
             if os.path.exists(self.port_file):
                 with open(self.port_file) as f:
-                    port = int(f.read().strip())
+                    raw = f.read().strip()
+                try:
+                    port = int(raw)
+                except ValueError:
+                    # empty/partial write (non-atomic filesystems, or a
+                    # child mid-write): not ready yet, keep polling
+                    time.sleep(0.05)
+                    continue
                 self.remote = RemoteRegionEngine(f"127.0.0.1:{port}")
                 return
             time.sleep(0.05)
@@ -151,6 +159,15 @@ class ProcessCluster:
         for node_id, dn in self.datanodes.items():
             if not dn.alive:
                 continue
+            try:
+                FAULTS.fire("datanode.crash", node=node_id)
+            except FaultError:
+                dn.kill()  # the chaos schedule SIGKILLs this child now
+                continue
+            try:
+                FAULTS.fire("heartbeat.send", node=node_id)
+            except FaultError:
+                continue  # dropped: the metasrv never hears this beat
             resp = self.metasrv.handle_heartbeat(
                 HeartbeatRequest(node_id=node_id,
                                  region_stats=self._region_stats_for(

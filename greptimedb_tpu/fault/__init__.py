@@ -1,0 +1,293 @@
+"""Deterministic fault injection ("chaos") + unified resilience layer.
+
+The reference survives node death because failures are *routine*: every
+I/O edge retries transient errors, and the metadata plane converts node
+loss into failover (PAPER.md §1). This package supplies both halves for
+the reproduction:
+
+- `FaultRegistry` (`FAULTS`, process-wide): named injection points armed
+  with deterministic, seed-driven schedules. The I/O seams call
+  `FAULTS.fire(point)` (control-path faults: fail / latency) or
+  `FAULTS.mangle(point, data)` (data-path faults: torn writes / short
+  reads) at their boundaries. Unarmed points cost ONE dict lookup —
+  production builds pay nothing else.
+- `RetryPolicy` / `retry_call` (fault.retry): capped exponential backoff
+  with full jitter and a deadline, shared by every call site that used
+  to fail hard (object store backends, WAL append/replay, Flight RPC,
+  the region router).
+
+Injection points (the fault matrix, see README "Robustness & chaos
+testing"):
+
+    objectstore.read   objectstore.write
+    wal.append         wal.replay
+    flight.do_get      flight.do_put
+    heartbeat.send     datanode.crash
+
+Arming is programmatic (`FAULTS.arm("wal.append", Fault(...))`) or via
+env so child datanode processes inherit the schedule:
+
+    GTPU_CHAOS="objectstore.read=fail,nth:3;flight.do_get=latency,arg:0.05,prob:0.5"
+    GTPU_CHAOS_SEED=42
+
+Every probabilistic schedule draws from its own `random.Random` seeded
+by `GTPU_CHAOS_SEED` (xor'd with the crc32 of the point name at arm
+time, so different points fire independently), and the same seed
+reproduces the same fault schedule call-for-call. Every injection
+is counted in `greptimedb_tpu_fault_injections_total{point,kind}`
+(utils/metrics.py) and rendered at /metrics.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from greptimedb_tpu.utils.metrics import FAULT_INJECTIONS
+
+from .retry import (  # noqa: F401 — the package's public resilience surface
+    DEFAULT_POLICY,
+    RetryPolicy,
+    Unavailable,
+    retry_call,
+)
+
+#: canonical injection points — arming anything else is a typo guard
+POINTS = frozenset({
+    "objectstore.read", "objectstore.write",
+    "wal.append", "wal.replay",
+    "flight.do_get", "flight.do_put",
+    "heartbeat.send", "datanode.crash",
+})
+
+#: fault kinds a schedule can produce
+KINDS = frozenset({"fail", "latency", "torn", "short_read"})
+
+
+def chaos_seed() -> int:
+    """The run's chaos seed (GTPU_CHAOS_SEED, default 0). Printed by the
+    chaos test harness on failure so any red run is replayable."""
+    try:
+        return int(os.environ.get("GTPU_CHAOS_SEED", "0"))
+    except ValueError:
+        return 0
+
+
+class FaultError(Exception):
+    """An injected fault. `transient=True` faults model retryable I/O
+    errors; torn writes are non-transient (they model a crash mid-write —
+    the bytes are already partially down, a retry is not what a dead
+    process does)."""
+
+    def __init__(self, point: str, kind: str = "fail",
+                 transient: bool = True):
+        super().__init__(f"injected {kind} fault at {point!r}")
+        self.point = point
+        self.kind = kind
+        self.transient = transient
+
+
+@dataclass
+class Fault:
+    """One armed schedule: WHAT to inject (`kind` + `arg`) and WHEN
+    (`nth`/`times` for fail-Nth, `prob` for seeded coin flips, neither
+    for every call).
+
+    kind: fail | latency | torn | short_read
+    arg:  latency seconds, or the fraction of bytes KEPT by torn/short
+    """
+
+    kind: str = "fail"
+    arg: float = 0.0
+    nth: Optional[int] = None  # fire on the nth call (1-based)...
+    times: int = 1             # ...and the following times-1 calls
+    prob: float = 0.0          # or per-call probability (seed-driven)
+    seed: Optional[int] = None
+    #: only fire when the call site's labels match (Jepsen-style nemesis
+    #: targeting, e.g. {"node": "dn-1"} drops ONE node's heartbeats);
+    #: non-matching calls do not consume the schedule
+    match: Optional[dict] = None
+
+    calls: int = field(default=0, init=False)
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r}")
+        import random
+
+        self._rng = random.Random(
+            self.seed if self.seed is not None else chaos_seed())
+        # fire/mangle run on server threads (Flight handlers, HTTP pool):
+        # unsynchronized counter/rng draws would break nth schedules and
+        # the seed-replay guarantee
+        self._lock = threading.Lock()
+
+    def matches(self, labels: dict) -> bool:
+        return not self.match or all(
+            labels.get(k) == v for k, v in self.match.items())
+
+    def should_fire(self) -> bool:
+        with self._lock:
+            self.calls += 1
+            if self.nth is not None:
+                return self.nth <= self.calls < self.nth + self.times
+            if self.prob:
+                return self._rng.random() < self.prob
+            return True
+
+
+class FaultRegistry:
+    """Process-wide named injection points. Disarmed points cost one
+    dict lookup; `reset()` between chaos tests restores production
+    behavior."""
+
+    def __init__(self):
+        self._points: dict[str, Fault] = {}
+        self._lock = threading.Lock()
+
+    # ---- arming -------------------------------------------------------------
+
+    def arm(self, point: str, fault: Fault) -> None:
+        if point not in POINTS:
+            raise ValueError(
+                f"unknown injection point {point!r} (have: {sorted(POINTS)})")
+        if fault.seed is None:
+            # default seeding decorrelates points (crc32, stable across
+            # processes — hash() is salted) while staying replayable
+            # from GTPU_CHAOS_SEED alone; an explicit seed wins
+            import random
+            import zlib
+
+            fault._rng = random.Random(
+                chaos_seed() ^ zlib.crc32(point.encode()))
+        with self._lock:
+            self._points[point] = fault
+
+    def disarm(self, point: str) -> None:
+        with self._lock:
+            self._points.pop(point, None)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._points.clear()
+
+    def armed(self, point: str) -> bool:
+        return point in self._points
+
+    def arm_from_env(self, spec: Optional[str] = None) -> None:
+        """Parse GTPU_CHAOS and arm each entry. Grammar (`;`-separated):
+
+            point=kind[,nth:N][,times:T][,prob:P][,arg:F][,seed:S][,@label:value]
+
+        `@label:value` tokens restrict the fault to matching call sites
+        (e.g. `heartbeat.send=fail,@node:dn-1`). A malformed spec raises
+        — silently ignoring a chaos schedule would make a green run
+        meaningless."""
+        spec = spec if spec is not None else os.environ.get("GTPU_CHAOS", "")
+        for entry in filter(None, (s.strip() for s in spec.split(";"))):
+            point, _, rhs = entry.partition("=")
+            if not rhs:
+                raise ValueError(f"bad GTPU_CHAOS entry {entry!r}")
+            tokens = [t.strip() for t in rhs.split(",") if t.strip()]
+            kw: dict = {"kind": tokens[0]}
+            for tok in tokens[1:]:
+                k, _, v = tok.partition(":")
+                if k.startswith("@"):
+                    kw.setdefault("match", {})[k[1:]] = v
+                elif k in ("nth", "times", "seed"):
+                    kw[k] = int(v)
+                elif k in ("prob", "arg"):
+                    kw[k] = float(v)
+                else:
+                    raise ValueError(
+                        f"bad GTPU_CHAOS token {tok!r} in {entry!r}")
+            self.arm(point.strip(), Fault(**kw))
+
+    # ---- firing -------------------------------------------------------------
+
+    def fire(self, point: str, **labels) -> None:
+        """Control-path hook: may raise FaultError or sleep. Data-kind
+        faults (torn/short_read) armed on a control-only point degrade
+        to plain failures."""
+        fault = self._points.get(point)  # the one production dict lookup
+        if fault is None or not fault.matches(labels):
+            return
+        self._apply(point, fault)
+
+    def mangle(self, point: str, data: bytes,
+               **labels) -> tuple[bytes, bool]:
+        """Data-path hook: returns (possibly truncated bytes, fail_after).
+        `fail_after=True` means the caller must surface an error AFTER
+        persisting the mangled bytes — the torn-write shape: partial
+        bytes down, no acknowledgement. `@label` matchers apply here the
+        same as in fire(): a non-matching call neither fires nor
+        consumes the schedule."""
+        fault = self._points.get(point)
+        if fault is None or not fault.matches(labels):
+            return data, False
+        if not fault.should_fire():
+            return data, False
+        FAULT_INJECTIONS.inc(point=point, kind=fault.kind)
+        if fault.kind == "latency":
+            time.sleep(fault.arg)
+            return data, False
+        if fault.kind == "fail":
+            raise FaultError(point)
+        keep = max(0, min(len(data),
+                          int(len(data) * (fault.arg or 0.5))))
+        if fault.kind == "torn":
+            return data[:keep], True
+        return data[:keep], False  # short_read: silent truncation
+
+    def mangled_write(self, point: str, data: bytes, sink,
+                      **labels) -> None:
+        """The shared data-path WRITE template: mangle, hand the
+        (possibly truncated) bytes to `sink`, then surface the torn-write
+        error — partial bytes persisted, call unacknowledged,
+        non-retryable. Every durable-write seam (object store, local WAL,
+        remote WAL) goes through here so torn semantics stay identical."""
+        mangled, fail_after = self.mangle(point, data, **labels)
+        sink(mangled)
+        if fail_after or len(mangled) < len(data):
+            # ANY truncation of a durable write must surface: silently
+            # acknowledging short bytes (e.g. short_read armed on a
+            # write seam) would be acknowledged-write loss by design
+            raise FaultError(point, kind="torn", transient=False)
+
+    def mangled_read(self, point: str, data: bytes, **labels) -> bytes:
+        """The shared data-path READ template: a torn fault on a read
+        means the bytes came back partial AND the error must surface —
+        never silently serve the truncated data (that is `short_read`)."""
+        mangled, fail_after = self.mangle(point, data, **labels)
+        if fail_after:
+            raise FaultError(point, kind="torn", transient=False)
+        return mangled
+
+    def _apply(self, point: str, fault: Fault) -> None:
+        if not fault.should_fire():
+            return
+        FAULT_INJECTIONS.inc(point=point, kind=fault.kind)
+        if fault.kind == "latency":
+            time.sleep(fault.arg)
+            return
+        raise FaultError(point, kind=fault.kind,
+                         transient=fault.kind != "torn")
+
+
+def is_transient(exc: BaseException) -> bool:
+    """Shared retry/degradation predicate: injected transient faults,
+    errors self-describing as transient (ObjectStoreError from a 5xx),
+    and network-shaped stdlib errors."""
+    if isinstance(exc, FaultError):
+        return exc.transient
+    if getattr(exc, "transient", False):
+        return True
+    return isinstance(exc, (ConnectionError, TimeoutError))
+
+
+#: the process-wide registry every I/O seam consults
+FAULTS = FaultRegistry()
+FAULTS.arm_from_env()

@@ -1,0 +1,101 @@
+"""Shared retry policy: capped exponential backoff + full jitter +
+deadline (the AWS full-jitter shape; reference retries live per-crate —
+e.g. object-store's RetryLayer and meta-client's retry loop — here one
+policy serves every seam so chaos runs exercise a single code path).
+
+`retry_call(op, point=...)` is the only entry point; call sites pass the
+exception classes worth retrying on top of the shared transience
+predicate. Every retry and every exhaustion increments a labeled counter
+(utils/metrics.py) so chaos runs can assert behavior through /metrics.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional, Sequence
+
+from greptimedb_tpu.utils.metrics import RETRY_ATTEMPTS, RETRY_EXHAUSTED
+
+
+class Unavailable(Exception):
+    """Typed terminal error: retries AND degradation (route re-resolve)
+    exhausted. Servers map this to a 503-shaped response instead of a
+    stack trace."""
+
+    def __init__(self, what: str, cause: Optional[BaseException] = None):
+        super().__init__(what if cause is None else f"{what}: {cause}")
+        self.cause = cause
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """max_attempts total tries; sleep_i = U(0, min(cap, base * 2^i));
+    the deadline bounds the whole call including sleeps."""
+
+    max_attempts: int = 3
+    base_s: float = 0.02
+    cap_s: float = 0.5
+    deadline_s: float = 10.0
+
+    @staticmethod
+    def from_env() -> "RetryPolicy":
+        return RetryPolicy(
+            max_attempts=int(_env_float("GTPU_RETRY_MAX_ATTEMPTS", 3)),
+            base_s=_env_float("GTPU_RETRY_BASE_S", 0.02),
+            cap_s=_env_float("GTPU_RETRY_CAP_S", 0.5),
+            deadline_s=_env_float("GTPU_RETRY_DEADLINE_S", 10.0),
+        )
+
+    def backoff_s(self, attempt: int, rng: random.Random) -> float:
+        return rng.uniform(0.0, min(self.cap_s, self.base_s * (2 ** attempt)))
+
+
+#: process-wide default, env-tunable (GTPU_RETRY_*)
+DEFAULT_POLICY = RetryPolicy.from_env()
+
+# jitter is seeded by the chaos seed so a chaos run's timing is as
+# replayable as its fault schedule (seed 0 when chaos is off)
+_jitter_rng = random.Random(
+    int(os.environ.get("GTPU_CHAOS_SEED", "0") or 0) ^ 0x5EED)
+
+
+def retry_call(op: Callable, *, point: str,
+               policy: Optional[RetryPolicy] = None,
+               retryable: Sequence[type] = (),
+               rng: Optional[random.Random] = None):
+    """Run `op()` under the retry policy. An exception retries when the
+    shared transience predicate says so (injected faults, self-described
+    transient errors) or it is an instance of `retryable`. Non-transient
+    errors (not-found, auth, torn writes) surface immediately."""
+    from greptimedb_tpu.fault import is_transient  # late: sibling module
+
+    policy = policy or DEFAULT_POLICY
+    rng = rng or _jitter_rng
+    deadline = time.monotonic() + policy.deadline_s
+    attempt = 0
+    while True:
+        try:
+            return op()
+        except Exception as e:  # noqa: BLE001 — predicate filters below
+            if not (is_transient(e) or isinstance(e, tuple(retryable))):
+                raise
+            attempt += 1
+            if attempt >= policy.max_attempts \
+                    or time.monotonic() >= deadline:
+                RETRY_EXHAUSTED.inc(point=point)
+                raise
+            RETRY_ATTEMPTS.inc(point=point)
+            delay = policy.backoff_s(attempt - 1, rng)
+            if delay > 0:
+                time.sleep(min(delay, max(0.0,
+                                          deadline - time.monotonic())))

@@ -30,6 +30,7 @@ style incremental dataflow VM, ticks fold through one of two paths:
 from __future__ import annotations
 
 import json
+import logging
 import threading
 import time
 from dataclasses import dataclass, field
@@ -42,6 +43,9 @@ from greptimedb_tpu.datatypes.types import SemanticType
 from greptimedb_tpu.query.engine import QueryContext, QueryEngine
 from greptimedb_tpu.query.result import QueryResult
 from greptimedb_tpu.sql import ast, parse_sql
+from greptimedb_tpu.utils.metrics import FLOW_TICK_ERRORS
+
+logger = logging.getLogger(__name__)
 
 FLOW_PREFIX = "__flow/"
 
@@ -270,9 +274,13 @@ class FlowEngine:
                 return self._tick_incremental(info, src, ctx, plan,
                                               version)
             except Exception:  # noqa: BLE001 — retry next tick
-                import traceback
-
-                traceback.print_exc()
+                # observable, not printed: chaos runs assert on the
+                # counter, operators see the log — the boundary did not
+                # advance, so the next tick retries the same rows
+                FLOW_TICK_ERRORS.inc(flow=info.name)
+                logger.warning(
+                    "flow %s: incremental tick failed; retrying next tick",
+                    info.name, exc_info=True)
                 return 0
         # dirty-horizon restriction: only recompute buckets that new data
         # can touch (watermark minus the expire horizon)

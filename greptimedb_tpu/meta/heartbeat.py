@@ -13,6 +13,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
+from ..fault import FAULTS, FaultError
 from .instruction import Instruction, InstructionKind
 from .metasrv import HeartbeatRequest, HeartbeatResponse, Metasrv, RegionStat
 
@@ -56,8 +57,14 @@ class HeartbeatTask:
         self.on_instruction = on_instruction
         self.alive_keeper = RegionAliveKeeper()
 
-    def beat(self, now_ms: Optional[float] = None) -> HeartbeatResponse:
+    def beat(self, now_ms: Optional[float] = None) -> Optional[HeartbeatResponse]:
         now_ms = now_ms if now_ms is not None else time.time() * 1000
+        try:
+            FAULTS.fire("heartbeat.send", node=self.node_id)
+        except FaultError:
+            # dropped on the (virtual) wire: the metasrv never hears it —
+            # no lease renewal, the failure detector's phi keeps climbing
+            return None
         stats = self.stats_fn()
         resp = self.metasrv.handle_heartbeat(
             HeartbeatRequest(node_id=self.node_id, region_stats=stats, now_ms=now_ms)

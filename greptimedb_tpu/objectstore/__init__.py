@@ -13,6 +13,13 @@ where remote-read economics happen anyway.
 the analog of the reference's disk cache for object-store reads. SST
 reads go through `open_input`, which returns a zero-copy reader:
 memory-mapped for fs, buffer-backed for memory/cached stores.
+
+Resilience: the base class owns read/write/open_input as templates over
+backend `_do_*` primitives, wrapping every call with the shared fault
+hooks (`FAULTS.fire`/`mangle` at `objectstore.read`/`objectstore.write`)
+and `retry_call` backoff (reference object-store RetryLayer analog).
+Backends raise `ObjectStoreError` with `transient=True` for errors a
+retry can fix (5xx, network); not-found stays non-transient.
 """
 
 from __future__ import annotations
@@ -24,6 +31,7 @@ from typing import Optional
 
 import pyarrow as pa
 
+from greptimedb_tpu.fault import FAULTS, retry_call
 from greptimedb_tpu.utils.metrics import REGISTRY
 
 OBJECT_STORE_READS = REGISTRY.counter(
@@ -35,19 +43,36 @@ OBJECT_STORE_BYTES = REGISTRY.counter(
 
 
 class ObjectStoreError(Exception):
-    pass
+    #: True when a retry could plausibly succeed (5xx, network reset);
+    #: not-found/misconfiguration stay False and surface immediately
+    transient = False
 
 
 class ObjectStore:
     """Five-method contract: read / write / delete / exists / list,
-    plus `open_input` for zero-copy columnar reads."""
+    plus `open_input` for zero-copy columnar reads. Backends implement
+    `_do_read`/`_do_write`; the base templates add fault injection and
+    retry uniformly."""
 
     name = "base"
 
     def read(self, key: str) -> bytes:
-        raise NotImplementedError
+        def op():
+            return FAULTS.mangled_read("objectstore.read",
+                                       self._do_read(key))
+        return retry_call(op, point="objectstore.read")
 
     def write(self, key: str, data: bytes) -> None:
+        retry_call(
+            lambda: FAULTS.mangled_write(
+                "objectstore.write", data,
+                lambda blob: self._do_write(key, blob)),
+            point="objectstore.write")
+
+    def _do_read(self, key: str) -> bytes:
+        raise NotImplementedError
+
+    def _do_write(self, key: str, data: bytes) -> None:
         raise NotImplementedError
 
     def delete(self, key: str) -> None:
@@ -73,7 +98,7 @@ class FsStore(ObjectStore):
 
     name = "fs"
 
-    def read(self, key: str) -> bytes:
+    def _do_read(self, key: str) -> bytes:
         OBJECT_STORE_READS.inc(backend="fs", outcome="read")
         try:
             with open(key, "rb") as f:
@@ -83,7 +108,7 @@ class FsStore(ObjectStore):
         OBJECT_STORE_BYTES.inc(len(data))
         return data
 
-    def write(self, key: str, data: bytes) -> None:
+    def _do_write(self, key: str, data: bytes) -> None:
         parent = os.path.dirname(key)
         if parent:
             os.makedirs(parent, exist_ok=True)
@@ -115,11 +140,14 @@ class FsStore(ObjectStore):
             and os.path.isfile(os.path.join(d, n)))
 
     def open_input(self, key: str):
-        OBJECT_STORE_READS.inc(backend="fs", outcome="mmap")
-        try:
-            return pa.memory_map(key, "rb")
-        except FileNotFoundError as e:
-            raise ObjectStoreError(f"object {key!r} not found") from e
+        def op():
+            FAULTS.fire("objectstore.read")
+            OBJECT_STORE_READS.inc(backend="fs", outcome="mmap")
+            try:
+                return pa.memory_map(key, "rb")
+            except FileNotFoundError as e:
+                raise ObjectStoreError(f"object {key!r} not found") from e
+        return retry_call(op, point="objectstore.read")
 
     def size(self, key: str) -> int:
         return os.path.getsize(key)
@@ -134,14 +162,14 @@ class MemoryStore(ObjectStore):
         self._data: dict[str, bytes] = {}
         self._lock = threading.Lock()
 
-    def read(self, key: str) -> bytes:
+    def _do_read(self, key: str) -> bytes:
         OBJECT_STORE_READS.inc(backend="memory", outcome="read")
         with self._lock:
             if key not in self._data:
                 raise ObjectStoreError(f"object {key!r} not found")
             return self._data[key]
 
-    def write(self, key: str, data: bytes) -> None:
+    def _do_write(self, key: str, data: bytes) -> None:
         with self._lock:
             self._data[key] = bytes(data)
 

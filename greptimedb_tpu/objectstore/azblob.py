@@ -121,13 +121,16 @@ class AzblobStore(ObjectStore):
             err = ObjectStoreError(
                 f"azblob {method} {url}: HTTP {e.code} {e.read()[:200]!r}")
             err.http_code = e.code
+            err.transient = e.code >= 500 or e.code == 429
             raise err from None
         except urllib.error.URLError as e:
-            raise ObjectStoreError(f"azblob {method} {url}: {e}") from None
+            err = ObjectStoreError(f"azblob {method} {url}: {e}")
+            err.transient = True
+            raise err from None
 
     # ---- surface -----------------------------------------------------------
 
-    def read(self, key: str) -> bytes:
+    def _do_read(self, key: str) -> bytes:
         try:
             return self._request("GET", self._url(key))
         except ObjectStoreError as e:
@@ -135,7 +138,7 @@ class AzblobStore(ObjectStore):
                 raise ObjectStoreError(f"not found: {key}") from None
             raise
 
-    def write(self, key: str, data: bytes) -> None:
+    def _do_write(self, key: str, data: bytes) -> None:
         self._request("PUT", self._url(key), data=data,
                       extra_headers={"x-ms-blob-type": "BlockBlob",
                                      "Content-Type":

@@ -58,13 +58,16 @@ class GcsStore(ObjectStore):
             err = ObjectStoreError(
                 f"gcs {method} {url}: HTTP {e.code} {e.read()[:200]!r}")
             err.http_code = e.code
+            err.transient = e.code >= 500 or e.code == 429
             raise err from None
         except urllib.error.URLError as e:
-            raise ObjectStoreError(f"gcs {method} {url}: {e}") from None
+            err = ObjectStoreError(f"gcs {method} {url}: {e}")
+            err.transient = True
+            raise err from None
 
     # ---- surface -----------------------------------------------------------
 
-    def read(self, key: str) -> bytes:
+    def _do_read(self, key: str) -> bytes:
         try:
             return self._request("GET", self._object_url(key, media=True))
         except ObjectStoreError as e:
@@ -72,7 +75,7 @@ class GcsStore(ObjectStore):
                 raise ObjectStoreError(f"not found: {key}") from None
             raise
 
-    def write(self, key: str, data: bytes) -> None:
+    def _do_write(self, key: str, data: bytes) -> None:
         name = urllib.parse.quote(self._key(key), safe="")
         url = (f"{self.endpoint}/upload/storage/v1/b/{self.bucket}/o"
                f"?uploadType=media&name={name}")

@@ -138,16 +138,21 @@ class S3Store(ObjectStore):
                 err = ObjectStoreError(
                     f"s3 {method} {url}: HTTP {e.code} "
                     f"{e.read()[:200]!r}")
+                # 5xx/throttling are worth the shared retry policy;
+                # 4xx (auth, missing) are not
+                err.transient = e.code >= 500 or e.code == 429
             err.http_code = e.code
             raise err from None
         except urllib.error.URLError as e:
-            raise ObjectStoreError(f"s3 {method} {url}: {e}") from None
+            err = ObjectStoreError(f"s3 {method} {url}: {e}")
+            err.transient = True  # network-shaped: retryable
+            raise err from None
 
     # ------------------------------------------------------------- surface
-    def read(self, key: str) -> bytes:
+    def _do_read(self, key: str) -> bytes:
         return self._request("GET", self._url(key))
 
-    def write(self, key: str, data: bytes) -> None:
+    def _do_write(self, key: str, data: bytes) -> None:
         self._request("PUT", self._url(key), data)
 
     def delete(self, key: str) -> None:
@@ -210,12 +215,6 @@ class S3Store(ObjectStore):
             if not t:
                 return out
             token = t.group(1)
-
-    def open_input(self, key: str):
-        import pyarrow as pa
-
-        return pa.BufferReader(pa.py_buffer(self.read(key)))
-
 
 def from_url(url: str, **kw) -> ObjectStore:
     """Backend selection by URL scheme (store.rs:44-116 analog):

@@ -18,7 +18,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+
+try:  # jax >= 0.6: top-level shard_map, replication check kwarg is check_vma
+    from jax import shard_map
+    _SHARD_MAP_KW = {"check_vma": False}
+except ImportError:  # older jax: experimental module, kwarg is check_rep
+    from jax.experimental.shard_map import shard_map
+    _SHARD_MAP_KW = {"check_rep": False}
 
 from greptimedb_tpu.ops.segment import segment_agg
 
@@ -148,7 +154,7 @@ def sharded_segment_agg(
         mesh=mesh,
         in_specs=tuple(in_specs),
         out_specs=out_specs,
-        check_vma=False,
+        **_SHARD_MAP_KW,
     )
     def step(v, g, m, *rest):
         from greptimedb_tpu.ops.segment import combine_partial_aggs

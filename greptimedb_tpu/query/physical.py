@@ -353,14 +353,15 @@ def _agg_scan_sharded(
     shard with the global extreme ts wins (combine_partial_aggs), so
     lastpoint-class queries stay on the mesh; the *_ts planes never leave
     the collective."""
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from greptimedb_tpu.parallel.mesh import _SHARD_MAP_KW, shard_map
 
     in_specs = ({k: P("shard") for k in cols}, P("shard"))
     need_ts = bool({"first", "last"} & set(ops))
 
     @functools.partial(shard_map, mesh=mesh, in_specs=in_specs,
-                       out_specs=P(), check_vma=False)
+                       out_specs=P(), **_SHARD_MAP_KW)
     def step(local_cols, local_mask):
         from greptimedb_tpu.ops.segment import combine_partial_aggs
 
@@ -460,14 +461,15 @@ def _agg_scan_sharded_prepared(
     the cached planes with the dead-segment id trick, then partials ride
     ICI (psum/pmin/pmax) — the multi-chip MergeScan with none of the
     per-query [N, F] masking passes."""
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from greptimedb_tpu.parallel.mesh import _SHARD_MAP_KW, shard_map
 
     G = num_segments
     in_specs = ({k: P("shard") for k in cols}, P("shard"))
 
     @functools.partial(shard_map, mesh=mesh, in_specs=in_specs,
-                       out_specs=P(), check_vma=False)
+                       out_specs=P(), **_SHARD_MAP_KW)
     def step(local_cols, local_mask):
         plane = local_cols["__prep__"]
         mask = local_mask

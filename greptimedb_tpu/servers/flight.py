@@ -33,12 +33,18 @@ import pyarrow.flight as fl
 
 from greptimedb_tpu.datatypes.schema import Schema
 from greptimedb_tpu.datatypes.types import DataType, SemanticType
+from greptimedb_tpu.fault import FAULTS, retry_call
 from greptimedb_tpu.query.result import QueryResult
 from greptimedb_tpu.session import Channel, QueryContext
 from greptimedb_tpu.storage.region import ScanData
 
 SEQ_COL = "__seq"
 OP_COL = "__op_type"
+
+#: Flight errors the shared RetryPolicy may fix (server briefly away,
+#: timeout, transient internal) — auth/arg errors surface immediately
+RETRYABLE_FLIGHT = (fl.FlightUnavailableError, fl.FlightTimedOutError,
+                    fl.FlightInternalError)
 
 
 # ---- QueryResult ⇄ Arrow: shared converters live in datasource ------------
@@ -545,11 +551,26 @@ class RemoteRegionEngine:
         if user is not None:
             self.client.authenticate(_BasicClientAuth(user, password or ""))
 
+    def _rpc(self, point: str, fn):
+        """Every wire call crosses here: chaos injection point + the
+        shared retry policy over transient Flight errors. Writes retried
+        after a mid-stream failure are at-least-once; the LSM's
+        key+timestamp LWW collapses the duplicates (append-mode tables
+        trade exactness for availability, as the reference's gRPC retry
+        does)."""
+        def op():
+            FAULTS.fire(point, addr=self.addr)
+            return fn()
+        return retry_call(op, point=point, retryable=RETRYABLE_FLIGHT)
+
     # -- control -------------------------------------------------------------
 
     def _admin(self, op: str, region_id: int, **extra) -> dict:
         body = json.dumps({"op": op, "region_id": region_id, **extra}).encode()
-        res = list(self.client.do_action(fl.Action("region_admin", body)))
+        point = "flight.do_get" if op in ("exists", "info") \
+            else "flight.do_put"
+        res = self._rpc(point, lambda: list(
+            self.client.do_action(fl.Action("region_admin", body))))
         return json.loads(res[0].body.to_pybytes().decode())
 
     def create_region(self, region_id: int, schema) -> None:
@@ -590,16 +611,25 @@ class RemoteRegionEngine:
     def _write(self, region_id: int, batch, op: str) -> int:
         desc = fl.FlightDescriptor.for_path("__region__", str(region_id), op)
         arrow = batch.to_arrow()
-        writer, reader = self.client.do_put(desc, arrow.schema)
-        writer.write_batch(arrow)
-        writer.done_writing()
-        ack_buf = reader.read()
-        if ack_buf is None:
-            writer.close()
-            raise fl.FlightServerError("no ack from region server")
-        ack = json.loads(ack_buf.to_pybytes().decode())
-        writer.close()
-        return ack["affected_rows"]
+
+        def put_once():
+            writer, reader = self.client.do_put(desc, arrow.schema)
+            try:
+                writer.write_batch(arrow)
+                writer.done_writing()
+                ack_buf = reader.read()
+                if ack_buf is None:
+                    raise fl.FlightServerError("no ack from region server")
+                return json.loads(ack_buf.to_pybytes().decode())[
+                    "affected_rows"]
+            finally:
+                # close on EVERY path: a failed put that leaks its stream
+                # would accumulate one half-open stream per retry attempt
+                try:
+                    writer.close()
+                except Exception:  # noqa: BLE001 — stream already dead
+                    pass
+        return self._rpc("flight.do_put", put_once)
 
     def put(self, region_id: int, batch) -> int:
         return self._write(region_id, batch, "put")
@@ -637,7 +667,8 @@ class RemoteRegionEngine:
         with tracing.span("remote_region_scan", region=region_id,
                           addr=self.addr):
             ticket = fl.Ticket(json.dumps({"region_scan": spec}).encode())
-            t = self.client.do_get(ticket).read_all()
+            t = self._rpc("flight.do_get",
+                          lambda: self.client.do_get(ticket).read_all())
         if (t.schema.metadata or {}).get(b"empty") == b"1":
             return None
         return table_to_scan(t)
@@ -656,7 +687,8 @@ class RemoteRegionEngine:
         with tracing.span("remote_region_frag", region=region_id,
                           addr=self.addr):
             ticket = fl.Ticket(json.dumps({"region_frag": spec}).encode())
-            t = self.client.do_get(ticket).read_all()
+            t = self._rpc("flight.do_get",
+                          lambda: self.client.do_get(ticket).read_all())
         md = t.schema.metadata or {}
         if md.get(b"empty") == b"1":
             return None

@@ -19,6 +19,7 @@ from typing import Optional
 
 import numpy as np
 
+from greptimedb_tpu.fault import Unavailable
 from greptimedb_tpu.query.engine import QueryContext, QueryEngine
 from greptimedb_tpu.query.result import QueryResult
 from greptimedb_tpu.utils.metrics import HTTP_REQUESTS, QUERY_DURATION, REGISTRY
@@ -239,6 +240,11 @@ class _Handler(BaseHTTPRequestHandler):
             if path == "/v1/run-script":
                 return self._handle_run_script()
             return self._send(404, {"error": f"no route {path}"})
+        except Unavailable as e:
+            # typed degradation (retries + route refresh exhausted): a
+            # 503 the client should back off on, not a stack trace
+            self._send(503, {"code": 5003, "error": str(e),
+                             "execution_time_ms": 0})
         except Exception as e:  # noqa: BLE001 — wire boundary
             traceback.print_exc()
             self._send(400, {"code": 3000, "error": str(e),

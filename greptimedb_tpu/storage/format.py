@@ -45,8 +45,14 @@ def check_and_stamp(data_dir: str) -> dict:
         raise FormatError(
             f"data dir {data_dir} was written by a newer build "
             f"({newer}); this build supports {FORMAT_VERSIONS}")
-    tmp = path + ".tmp"
+    # pid-unique tmp: N datanode processes stamp a SHARED dir at startup,
+    # and a fixed tmp name makes their rename calls race (one renames the
+    # other's tmp away → FileNotFoundError aborts startup)
+    tmp = f"{path}.{os.getpid()}.tmp"
     with open(tmp, "w", encoding="utf-8") as f:
         json.dump({"versions": FORMAT_VERSIONS}, f)
-    os.replace(tmp, path)
+    try:
+        os.replace(tmp, path)
+    except FileNotFoundError:
+        pass  # a concurrent process already stamped the same versions
     return found

@@ -32,6 +32,7 @@ import zlib
 from typing import Iterator, Optional
 
 from greptimedb_tpu.datatypes.recordbatch import RecordBatch
+from greptimedb_tpu.fault import FAULTS, retry_call
 from greptimedb_tpu.objectstore import ObjectStore, ObjectStoreError
 from greptimedb_tpu.storage.wal import WalEntry, _decode_batch, _encode_batch
 
@@ -116,13 +117,25 @@ class RemoteWal:
         first = entries[0][0]
         last = entries[-1][0]
         key = self._key(region_id, first)
-        self.store.write(key, _encode_entries(region_id, entries))
+        blob = _encode_entries(region_id, entries)
+
+        # a torn write here is SAFE to leave in place: segments are
+        # separate immutable objects, so a corrupt tail in this one
+        # never shadows later acknowledged segments at replay
+        retry_call(
+            lambda: FAULTS.mangled_write(
+                "wal.append", blob,
+                lambda mangled: self.store.write(key, mangled)),
+            point="wal.append")
         with self._lock:
             self._seeded(region_id).append((first, last, key))
 
     # ---- replay ------------------------------------------------------------
 
     def replay(self, region_id: int, from_seq: int = 0) -> Iterator[WalEntry]:
+        # transient replay faults retry like the local WAL's; the object
+        # reads below carry their own retry at the objectstore seam
+        retry_call(lambda: FAULTS.fire("wal.replay"), point="wal.replay")
         segs = []
         for key in sorted(self.store.list(self._region_prefix(region_id))):
             try:

@@ -46,6 +46,7 @@ import pyarrow as pa
 
 from greptimedb_tpu.datatypes.recordbatch import RecordBatch
 from greptimedb_tpu.datatypes.schema import Schema
+from greptimedb_tpu.fault import FAULTS, FaultError, retry_call
 
 _HEADER = struct.Struct("<IIQQB")  # payload_len, crc32, region_id, seq, op_type
 
@@ -128,16 +129,38 @@ class Wal:
         if not entries:
             return
         segno, f = self._writer(region_id)
+        parts = []
         for seq, op_type, batch in entries:
             payload = _encode_batch(batch)
-            frame = _HEADER.pack(len(payload), zlib.crc32(payload),
-                                 region_id, seq, op_type)
-            f.write(frame)
-            f.write(payload)
-        f.flush()
-        if self.sync:
-            os.fsync(f.fileno())  # ← the durability boundary
-            self.sync_count += 1
+            parts.append(_HEADER.pack(len(payload), zlib.crc32(payload),
+                                      region_id, seq, op_type))
+            parts.append(payload)
+        blob = b"".join(parts)
+
+        def sink(mangled: bytes) -> None:
+            f.write(mangled)
+            f.flush()
+            if self.sync:
+                os.fsync(f.fileno())  # ← the durability boundary
+                self.sync_count += 1
+
+        def attempt():
+            start = f.tell()
+            try:
+                FAULTS.mangled_write("wal.append", blob, sink)
+            except BaseException:
+                # crash-consistency repair: an append lands whole or not
+                # at all. A partial tail left in place would orphan every
+                # LATER acknowledged frame at replay (replay stops at the
+                # first corrupt frame).
+                try:
+                    f.flush()
+                    f.truncate(start)
+                    f.seek(start)
+                except OSError:
+                    pass
+                raise
+        retry_call(attempt, point="wal.append")
         if f.tell() >= self.segment_bytes:
             self._roll(region_id)
 
@@ -151,8 +174,17 @@ class Wal:
         self.close_region(region_id)
         segs = self._segments(region_id)
         for i, (segno, path) in enumerate(segs):
-            with open(path, "rb") as f:
-                data = f.read()
+            def read_segment(path=path):
+                with open(path, "rb") as f:
+                    raw = f.read()
+                mangled, _ = FAULTS.mangle("wal.replay", raw)
+                if len(mangled) < len(raw):
+                    # injected short read: surfacing the truncated bytes
+                    # would truncate DURABLE frames below — treat as a
+                    # transient I/O error and re-read
+                    raise FaultError("wal.replay", kind="short_read")
+                return raw
+            data = retry_call(read_segment, point="wal.replay")
             entries = []
             if _native is not None:
                 # one native pass: bounds + checksum + record table

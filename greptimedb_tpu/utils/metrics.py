@@ -186,3 +186,22 @@ INGEST_ROWS = REGISTRY.counter("greptimedb_tpu_ingest_rows_total",
 STMT_DURATION = REGISTRY.histogram(
     "greptimedb_tpu_statement_duration_seconds",
     "Statement execution latency by statement kind")
+
+# resilience plane (fault/ package): every injected fault, every retry,
+# every exhaustion, and every degradation is observable at /metrics so
+# chaos runs assert behavior instead of eyeballing logs
+FAULT_INJECTIONS = REGISTRY.counter(
+    "greptimedb_tpu_fault_injections_total",
+    "Injected faults by injection point and kind")
+RETRY_ATTEMPTS = REGISTRY.counter(
+    "greptimedb_tpu_retry_attempts_total",
+    "Retries after a transient failure, by injection point")
+RETRY_EXHAUSTED = REGISTRY.counter(
+    "greptimedb_tpu_retry_exhausted_total",
+    "Operations that exhausted their retry budget, by injection point")
+DEGRADED = REGISTRY.counter(
+    "greptimedb_tpu_degraded_total",
+    "Graceful degradations (route re-resolution after retry exhaustion)")
+FLOW_TICK_ERRORS = REGISTRY.counter(
+    "greptimedb_tpu_flow_tick_errors_total",
+    "Flow engine tick failures deferred to the next tick, by flow")

@@ -25,3 +25,23 @@ import pytest  # noqa: E402
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "chaos: deterministic fault-injection tests (schedule + jitter "
+        "seeded by GTPU_CHAOS_SEED; the seed is printed on failure)")
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    outcome = yield
+    rep = outcome.get_result()
+    if rep.failed and item.get_closest_marker("chaos") is not None:
+        # any red chaos run must be replayable: surface the seed that
+        # drove this run's fault schedule
+        seed = os.environ.get("GTPU_CHAOS_SEED", "0")
+        rep.sections.append(
+            ("chaos seed",
+             f"replay this failure with GTPU_CHAOS_SEED={seed}"))

@@ -1,0 +1,575 @@
+"""Seeded, replayable chaos suite over the fault-injection layer
+(greptimedb_tpu/fault): deterministic schedules at the I/O seams, the
+shared retry/backoff policy, graceful router degradation, and the
+Jepsen-style cluster scenarios — datanode death mid-write, dropped
+heartbeats until phi fires, injected object-store errors mid-scan —
+asserting zero acknowledged-write loss and correct post-recovery query
+results.
+
+Every test is marked `chaos`; a failing run prints the GTPU_CHAOS_SEED
+that drove its schedule (tests/conftest.py) so any red run replays
+exactly."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from greptimedb_tpu.cluster import Cluster
+from greptimedb_tpu.datatypes import (
+    ColumnSchema,
+    DataType,
+    DictVector,
+    RecordBatch,
+    Schema,
+    SemanticType,
+)
+from greptimedb_tpu.fault import (
+    FAULTS,
+    Fault,
+    FaultError,
+    FaultRegistry,
+    Unavailable,
+    retry_call,
+)
+from greptimedb_tpu.meta.metasrv import MetasrvOptions
+from greptimedb_tpu.objectstore import MemoryStore, ObjectStoreError
+from greptimedb_tpu.partition.rule import PartitionBound, RangePartitionRule
+from greptimedb_tpu.utils.metrics import (
+    DEGRADED,
+    FAULT_INJECTIONS,
+    REGISTRY,
+    RETRY_ATTEMPTS,
+    RETRY_EXHAUSTED,
+)
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def clean_faults():
+    """Chaos schedules must never leak across tests."""
+    FAULTS.reset()
+    yield
+    FAULTS.reset()
+
+
+# ---- schedule determinism + env arming --------------------------------------
+
+
+class TestFaultSchedules:
+    def test_same_seed_same_schedule(self):
+        a = Fault(kind="fail", prob=0.3, seed=1234)
+        b = Fault(kind="fail", prob=0.3, seed=1234)
+        sa = [a.should_fire() for _ in range(200)]
+        sb = [b.should_fire() for _ in range(200)]
+        assert sa == sb
+        assert any(sa) and not all(sa)
+        # a different seed produces a different schedule
+        c = Fault(kind="fail", prob=0.3, seed=1235)
+        assert [c.should_fire() for _ in range(200)] != sa
+
+    def test_fail_nth_window(self):
+        f = Fault(kind="fail", nth=3, times=2)
+        assert [f.should_fire() for _ in range(6)] == \
+            [False, False, True, True, False, False]
+
+    def test_env_grammar(self):
+        r = FaultRegistry()
+        r.arm_from_env(
+            "objectstore.read=fail,nth:3,times:2;"
+            "flight.do_get=latency,arg:0.05,prob:0.5,seed:7;"
+            "heartbeat.send=fail,@node:dn-1")
+        assert r.armed("objectstore.read")
+        assert r.armed("flight.do_get")
+        assert r._points["heartbeat.send"].match == {"node": "dn-1"}
+        with pytest.raises(ValueError):
+            r.arm_from_env("no.such.point=fail")
+        with pytest.raises(ValueError):
+            r.arm_from_env("wal.append=fail,bogus:1")
+
+    def test_match_labels_do_not_consume_schedule(self):
+        FAULTS.arm("heartbeat.send",
+                   Fault(kind="fail", nth=1, match={"node": "dn-1"}))
+        FAULTS.fire("heartbeat.send", node="dn-0")  # no match: no draw
+        with pytest.raises(FaultError):
+            FAULTS.fire("heartbeat.send", node="dn-1")
+
+    def test_unarmed_point_is_free(self):
+        FAULTS.fire("objectstore.read")  # no-op, no counter
+        data, fail_after = FAULTS.mangle("objectstore.write", b"x")
+        assert data == b"x" and not fail_after
+
+    def test_match_applies_to_data_path_too(self):
+        # a @node matcher on a data point must not fire for unlabeled
+        # (or differently-labeled) calls — and must not consume the draw
+        FAULTS.arm("wal.append", Fault(kind="fail", nth=1,
+                                       match={"node": "dn-1"}))
+        data, fail_after = FAULTS.mangle("wal.append", b"x")
+        assert data == b"x" and not fail_after
+        with pytest.raises(FaultError):
+            FAULTS.mangle("wal.append", b"x", node="dn-1")
+
+
+# ---- retry policy + object store seam ---------------------------------------
+
+
+class TestRetryAndObjectStore:
+    def test_fail_nth_is_absorbed_by_retry(self):
+        store = MemoryStore()
+        store.write("k", b"payload")
+        before = RETRY_ATTEMPTS.get(point="objectstore.read")
+        FAULTS.arm("objectstore.read", Fault(kind="fail", nth=1))
+        assert store.read("k") == b"payload"
+        assert RETRY_ATTEMPTS.get(point="objectstore.read") == before + 1
+
+    def test_persistent_failure_exhausts_and_counts(self):
+        store = MemoryStore()
+        store.write("k", b"payload")
+        before = RETRY_EXHAUSTED.get(point="objectstore.read")
+        FAULTS.arm("objectstore.read", Fault(kind="fail"))
+        with pytest.raises(FaultError):
+            store.read("k")
+        assert RETRY_EXHAUSTED.get(point="objectstore.read") == before + 1
+
+    def test_not_found_is_not_retried(self):
+        store = MemoryStore()
+        before = RETRY_ATTEMPTS.get(point="objectstore.read")
+        with pytest.raises(ObjectStoreError):
+            store.read("missing")
+        assert RETRY_ATTEMPTS.get(point="objectstore.read") == before
+
+    def test_torn_write_persists_partial_and_raises(self):
+        store = MemoryStore()
+        FAULTS.arm("objectstore.write", Fault(kind="torn", arg=0.4, nth=1))
+        with pytest.raises(FaultError) as ei:
+            store.write("t", b"0123456789")
+        assert not ei.value.transient
+        FAULTS.reset()
+        assert store.read("t") == b"0123"  # the torn object is real
+
+    def test_torn_read_surfaces_error_never_truncated_bytes(self):
+        store = MemoryStore()
+        store.write("k", b"0123456789")
+        FAULTS.arm("objectstore.read", Fault(kind="torn", arg=0.5, nth=1))
+        with pytest.raises(FaultError) as ei:
+            store.read("k")
+        assert not ei.value.transient
+        FAULTS.reset()
+        assert store.read("k") == b"0123456789"  # backing data untouched
+
+    def test_every_counter_renders_at_metrics(self):
+        store = MemoryStore()
+        store.write("k", b"v")
+        FAULTS.arm("objectstore.read", Fault(kind="fail", nth=1))
+        store.read("k")
+        text = REGISTRY.render()
+        assert 'greptimedb_tpu_fault_injections_total{' \
+            'kind="fail",point="objectstore.read"}' in text
+        assert 'greptimedb_tpu_retry_attempts_total{' \
+            'point="objectstore.read"}' in text
+
+    def test_retry_call_deadline(self):
+        from greptimedb_tpu.fault import RetryPolicy
+
+        calls = []
+
+        def op():
+            calls.append(1)
+            raise FaultError("flight.do_get")
+        t0 = time.monotonic()
+        with pytest.raises(FaultError):
+            retry_call(op, point="flight.do_get",
+                       policy=RetryPolicy(max_attempts=100, base_s=0.05,
+                                          cap_s=0.05, deadline_s=0.2))
+        assert time.monotonic() - t0 < 2.0
+        assert 2 <= len(calls) < 100
+
+
+# ---- WAL seams --------------------------------------------------------------
+
+
+def _wal_schema():
+    return Schema([
+        ColumnSchema("ts", DataType.TIMESTAMP_MILLISECOND,
+                     SemanticType.TIMESTAMP),
+        ColumnSchema("hostname", DataType.STRING, SemanticType.TAG),
+        ColumnSchema("v", DataType.FLOAT64),
+    ])
+
+
+def _wal_batch(schema, i):
+    return RecordBatch(schema, {
+        "ts": np.asarray([i], dtype=np.int64),
+        "hostname": DictVector.encode(["h"]),
+        "v": np.asarray([float(i)], dtype=np.float64)})
+
+
+class TestWalChaos:
+    def test_torn_append_unacked_and_later_writes_survive(self, tmp_path):
+        """A torn local-WAL append must NOT be acknowledged, and must not
+        orphan later acknowledged frames at replay (self-repair)."""
+        from greptimedb_tpu.storage.wal import Wal
+
+        s = _wal_schema()
+        w = Wal(str(tmp_path), sync=False)
+        w.append(1, 0, 0, _wal_batch(s, 0))
+        FAULTS.arm("wal.append", Fault(kind="torn", arg=0.5, nth=1))
+        with pytest.raises(FaultError):
+            w.append(1, 1, 0, _wal_batch(s, 1))
+        FAULTS.reset()
+        w.append(1, 1, 0, _wal_batch(s, 2))  # acked after the torn one
+        entries = list(w.replay(1))
+        assert [e.seq for e in entries] == [0, 1]
+        assert entries[1].batch.columns["v"].tolist() == [2.0]
+
+    def test_replay_short_read_is_retried_not_truncated(self, tmp_path):
+        """An injected short read during replay must not be mistaken for
+        a torn tail: durable frames survive and replay retries."""
+        from greptimedb_tpu.storage.wal import Wal
+
+        s = _wal_schema()
+        w = Wal(str(tmp_path), sync=False)
+        for i in range(4):
+            w.append(1, i, 0, _wal_batch(s, i))
+        w.close()
+        w2 = Wal(str(tmp_path), sync=False)
+        FAULTS.arm("wal.replay", Fault(kind="short_read", arg=0.3, nth=1))
+        assert [e.seq for e in w2.replay(1)] == [0, 1, 2, 3]
+
+    def test_remote_wal_torn_segment_isolated(self):
+        """Remote-WAL segments are separate immutable objects: a torn
+        (unacked) segment never shadows later acknowledged segments."""
+        from greptimedb_tpu.storage.remote_wal import RemoteWal
+
+        s = _wal_schema()
+        rw = RemoteWal(MemoryStore())
+        rw.append(5, 0, 0, _wal_batch(s, 0))
+        FAULTS.arm("wal.append", Fault(kind="torn", arg=0.5, nth=1))
+        with pytest.raises(FaultError):
+            rw.append(5, 1, 0, _wal_batch(s, 1))
+        FAULTS.reset()
+        rw.append(5, 1, 0, _wal_batch(s, 2))
+        assert [e.seq for e in rw.replay(5)] == [0, 1]
+
+
+# ---- flow tick errors (satellite) -------------------------------------------
+
+
+class TestFlowTickErrors:
+    def test_incremental_tick_failure_is_counted_not_printed(self):
+        from types import SimpleNamespace
+
+        from greptimedb_tpu.catalog.kv import MemoryKv
+        from greptimedb_tpu.flow.engine import FlowEngine, FlowInfo
+        from greptimedb_tpu.utils.metrics import FLOW_TICK_ERRORS
+
+        eng = FlowEngine.__new__(FlowEngine)
+        eng.kv = MemoryKv()
+        src = SimpleNamespace(region_ids=[1], append_mode=True)
+        eng.qe = SimpleNamespace(
+            _table=lambda name, ctx: src,
+            region_engine=SimpleNamespace(
+                region=lambda rid: SimpleNamespace(data_version=1)))
+        info = FlowInfo(name="chaos_flow", db="public", sink_table="s",
+                        source_table="t", sql="SELECT v FROM t",
+                        incremental=True)
+        before = FLOW_TICK_ERRORS.get(flow="chaos_flow")
+        assert eng._tick_flow(info) == 0  # failure deferred to next tick
+        assert FLOW_TICK_ERRORS.get(flow="chaos_flow") == before + 1
+        # the fold boundary did NOT advance: next tick retries
+        assert info.last_version == -1
+
+
+# ---- in-process cluster scenarios -------------------------------------------
+
+CREATE = (
+    "CREATE TABLE cpu (host STRING, region STRING, usage_user DOUBLE, "
+    "usage_system DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(host, region))"
+)
+
+
+def _make_cluster(tmp_path, n=3):
+    return Cluster(str(tmp_path), num_datanodes=n, opts=MetasrvOptions())
+
+
+def _host_rule(*splits):
+    bounds = [PartitionBound((s,)) for s in splits] + [PartitionBound(())]
+    return RangePartitionRule(["host"], bounds)
+
+
+def _seed_rows(cluster, n_hosts=6, points_per_host=4):
+    rows = []
+    for h in range(n_hosts):
+        for t in range(points_per_host):
+            rows.append(f"('host{h}', 'us-west', {10.0 + h}, {1.0 * t}, "
+                        f"{1000 * (t + 1)})")
+    cluster.sql(
+        "INSERT INTO cpu (host, region, usage_user, usage_system, ts) "
+        "VALUES " + ", ".join(rows))
+
+
+class TestClusterChaos:
+    def test_scan_survives_injected_sst_read_errors(self, tmp_path):
+        """Object-store errors mid-scan are absorbed by the retry layer:
+        the query answers correctly and the retries are observable."""
+        c = _make_cluster(tmp_path)
+        try:
+            info = c.create_partitioned_table(CREATE,
+                                              _host_rule("host2", "host4"))
+            _seed_rows(c)
+            for rid in info.region_ids:
+                c.router.flush(rid)  # data must come back from SSTs
+            before = RETRY_ATTEMPTS.get(point="objectstore.read")
+            FAULTS.arm("objectstore.read", Fault(kind="fail", nth=1))
+            res = c.sql("SELECT count(*) FROM cpu")
+            assert res.rows()[0][0] == 24
+            assert RETRY_ATTEMPTS.get(point="objectstore.read") == before + 1
+        finally:
+            c.close()
+
+    def test_dropped_heartbeats_until_phi_fires_failover(self, tmp_path):
+        """Nemesis-targeted heartbeat drops: ONE node's beats vanish, phi
+        climbs, failover moves its regions, data stays queryable —
+        without killing the process (the asymmetric-partition shape)."""
+        c = _make_cluster(tmp_path)
+        try:
+            info = c.create_partitioned_table(CREATE,
+                                              _host_rule("host2", "host4"))
+            _seed_rows(c)
+            for rid in info.region_ids:
+                c.router.flush(rid)
+            t = 0.0
+            for _ in range(10):
+                c.beat_all(t)
+                t += 3000.0
+            rid0 = info.region_ids[0]
+            victim = c.metasrv.routes.get(
+                str(rid0 >> 32)).region(rid0).leader_node
+            FAULTS.arm("heartbeat.send",
+                       Fault(kind="fail", match={"node": victim}))
+            for _ in range(20):
+                c.beat_all(t)
+                t += 3000.0
+            assert FAULT_INJECTIONS.get(point="heartbeat.send",
+                                        kind="fail") >= 20
+            started = c.tick(t)
+            assert started, "phi should fire for the silenced node"
+            c.beat_all(t)  # deliver OPEN_REGION to the survivors
+            route = c.metasrv.routes.get(str(rid0 >> 32))
+            assert route.region(rid0).leader_node != victim
+            assert c.sql("SELECT count(*) FROM cpu").rows()[0][0] == 24
+        finally:
+            c.close()
+
+    def test_stale_route_degrades_and_recovers(self, tmp_path):
+        """A stale route (engine no longer owns the region) re-resolves
+        transparently instead of surfacing a KeyError."""
+        c = _make_cluster(tmp_path)
+        try:
+            info = c.create_partitioned_table(CREATE,
+                                              _host_rule("host2", "host4"))
+            _seed_rows(c)
+            rid = info.region_ids[0]
+            owner = c.metasrv.routes.get(str(rid >> 32)).region(rid).leader_node
+            wrong = next(n for n in c.datanodes if n != owner)
+            before = DEGRADED.get(point="router.scan")
+            with c.router._lock:
+                c.router._region_node[rid] = wrong
+            scan = c.router.scan(rid)
+            assert scan is not None and scan.num_rows == 8
+            assert DEGRADED.get(point="router.scan") == before + 1
+        finally:
+            c.close()
+
+    def test_no_live_datanode_surfaces_typed_unavailable(self, tmp_path):
+        c = _make_cluster(tmp_path, n=2)
+        try:
+            info = c.create_partitioned_table(CREATE, _host_rule("host2"))
+            _seed_rows(c)
+            rid = info.region_ids[0]
+            for dn in c.datanodes.values():
+                dn.kill()
+            with pytest.raises(Unavailable):
+                c.router.scan(rid)
+        finally:
+            c.close()
+
+    def test_seeded_datanode_crash_schedule(self, tmp_path):
+        """`datanode.crash` armed with a deterministic schedule kills a
+        node at a chosen beat; failover restores full query results."""
+        c = _make_cluster(tmp_path)
+        try:
+            info = c.create_partitioned_table(CREATE,
+                                              _host_rule("host2", "host4"))
+            _seed_rows(c)
+            for rid in info.region_ids:
+                c.router.flush(rid)
+            t = 0.0
+            for _ in range(10):
+                c.beat_all(t)
+                t += 3000.0
+            # beat_all visits dn-0, dn-1, dn-2 per round: call 31 is the
+            # first node of round 11 — exactly one node dies, chosen by
+            # the schedule, not the test
+            FAULTS.arm("datanode.crash", Fault(kind="fail", nth=31))
+            dead = None
+            for _ in range(20):
+                c.beat_all(t)
+                t += 3000.0
+                if dead is None:
+                    dead = next((n for n, d in c.datanodes.items()
+                                 if not d.alive), None)
+            assert dead is not None, "the crash schedule should have fired"
+            c.tick(t)
+            c.beat_all(t)
+            assert c.sql("SELECT count(*) FROM cpu").rows()[0][0] == 24
+        finally:
+            c.close()
+
+
+# ---- procedure crash-recovery (satellite) -----------------------------------
+
+
+class TestFailoverProcedureCrashRecovery:
+    """Crash the coordinator after EACH persisted step of a
+    RegionFailoverProcedure and re-drive from the stored state via the
+    procedure runner: completion must be idempotent — route swapped
+    exactly once, no orphan region routes."""
+
+    N_PHASES = 5  # start→select→activate→update_metadata→invalidate→end
+
+    def _seeded_metasrv(self):
+        from greptimedb_tpu.catalog.kv import MemoryKv
+        from greptimedb_tpu.meta.metasrv import HeartbeatRequest, Metasrv
+        from greptimedb_tpu.meta.route import RegionRoute, TableRoute
+
+        kv = MemoryKv()
+        ms = Metasrv(kv, MetasrvOptions())
+        rid = (7 << 32) | 1
+        ms.routes.put_new(TableRoute(table="7", regions=[
+            RegionRoute(region_id=rid, leader_node="dn-0")]))
+        t = 0.0
+        for _ in range(5):
+            for n in ("dn-0", "dn-1", "dn-2"):
+                ms.handle_heartbeat(HeartbeatRequest(node_id=n, now_ms=t))
+            t += 3000.0
+        return kv, ms, rid, t - 3000.0
+
+    @pytest.mark.parametrize("crash_after", range(6))
+    def test_crash_after_each_persisted_step(self, crash_after):
+        from greptimedb_tpu.meta.metasrv import (
+            HeartbeatRequest,
+            Metasrv,
+            RegionFailoverProcedure,
+        )
+        from greptimedb_tpu.procedure.procedure import (
+            ProcedureContext,
+            ProcedureRecord,
+        )
+
+        kv, ms, rid, t = self._seeded_metasrv()
+        proc = RegionFailoverProcedure(ms, state={
+            "table": "7", "region_id": rid, "from_node": "dn-0",
+            "now_ms": t})
+        pid = ms.procedures.next_id()
+        rec = ProcedureRecord(procedure_id=pid, type_name=proc.type_name,
+                              state=proc.state, status="running")
+        ms.procedures.store.save(rec)
+        ctx = ProcedureContext(procedure_id=pid, manager=ms.procedures)
+        for _ in range(crash_after):
+            status = proc.step(ctx)
+            rec.state = proc.state
+            ms.procedures.store.save(rec)  # the crash-recovery point
+            if status.done:
+                break
+        # CRASH: a new coordinator over the same shared KV; survivors
+        # keep heartbeating it, then it recovers in-flight procedures
+        ms2 = Metasrv(kv, MetasrvOptions())
+        for n in ("dn-1", "dn-2"):
+            ms2.handle_heartbeat(HeartbeatRequest(node_id=n, now_ms=t))
+        recovered = {r.procedure_id: r for r in ms2.procedures.recover()}
+        assert recovered[pid].status == "done"
+        route = ms2.routes.get("7")
+        entries = [r for r in route.regions if r.region_id == rid]
+        assert len(entries) == 1, "exactly one route entry — no orphans"
+        leader = entries[0].leader_node
+        assert leader in ("dn-1", "dn-2") and leader != "dn-0"
+        # idempotent completion: recovering again re-drives nothing and
+        # the route does not swap a second time
+        assert all(r.procedure_id != pid for r in ms2.procedures.recover())
+        assert ms2.routes.get("7").region(rid).leader_node == leader
+
+
+# ---- the full seeded scenario over real OS processes ------------------------
+
+
+class TestProcessClusterChaos:
+    def test_seeded_chaos_zero_acked_write_loss(self, tmp_path, monkeypatch):
+        """The acceptance scenario: a 3-datanode ProcessCluster under a
+        seeded schedule — datanode SIGKILL mid-write-stream, a fraction
+        of heartbeats dropped, object-store read errors injected inside
+        every child (via GTPU_CHAOS env inheritance) — finishes with
+        zero acknowledged-write loss and correct post-recovery results."""
+        from greptimedb_tpu.cluster.process_cluster import ProcessCluster
+
+        seed = int(os.environ.get("GTPU_CHAOS_SEED", "0")) or 1234
+        monkeypatch.setenv("GTPU_CHAOS_SEED", str(seed))
+        # children arm from env at import: transient read errors under
+        # every SST/WAL/manifest object read, absorbed by their retries
+        monkeypatch.setenv(
+            "GTPU_CHAOS",
+            f"objectstore.read=fail,prob:0.02,seed:{seed}")
+        # parent-side nemesis: drop a tenth of all heartbeats
+        FAULTS.arm("heartbeat.send",
+                   Fault(kind="fail", prob=0.1, seed=seed))
+        c = ProcessCluster(str(tmp_path), num_datanodes=3,
+                           opts=MetasrvOptions())
+        try:
+            t = 0.0
+            for _ in range(5):
+                c.beat_all(t)
+                t += 3000.0
+            c.sql("CREATE TABLE m (host STRING, v DOUBLE, "
+                  "ts TIMESTAMP TIME INDEX, PRIMARY KEY(host))")
+            rid = c.catalog.table("public", "m").region_ids[0]
+            owner = c.metasrv.routes.get(
+                str(rid >> 32)).regions[0].leader_node
+            for _ in range(3):
+                c.beat_all(t)
+                t += 3000.0
+            acked = []
+            for i in range(12):
+                if i == 6:
+                    # SIGKILL the owner in the middle of the write
+                    # stream: rows 0..5 are acknowledged and unflushed —
+                    # they exist ONLY in the shared remote WAL
+                    c.kill_datanode(owner)
+                try:
+                    c.sql(f"INSERT INTO m VALUES ('h{i:02d}', {float(i)}, "
+                          f"{1000 * (i + 1)})")
+                    acked.append(i)
+                except Exception:  # noqa: BLE001 — unacked writes may fail
+                    pass
+            assert 6 <= len(acked) < 12, "kill must land mid-stream"
+            # survivors keep beating (minus the dropped ones); the dead
+            # node's silence drives phi over the threshold
+            for _ in range(30):
+                c.beat_all(t)
+                t += 3000.0
+            assert c.tick(t), "failover should start"
+            c.beat_all(t)  # deliver OPEN_REGION to the failover target
+            rows = c.sql("SELECT host, v FROM m ORDER BY host").rows()
+            got = {r[0]: r[1] for r in rows}
+            for i in acked:
+                assert got.get(f"h{i:02d}") == float(i), \
+                    f"acknowledged write h{i:02d} lost"
+            new_owner = c.metasrv.routes.get(
+                str(rid >> 32)).regions[0].leader_node
+            assert new_owner != owner
+            # the run was observable: injected heartbeat drops counted
+            assert FAULT_INJECTIONS.get(point="heartbeat.send",
+                                        kind="fail") > 0
+        finally:
+            c.close()
