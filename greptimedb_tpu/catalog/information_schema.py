@@ -189,6 +189,32 @@ def _runtime_metrics(qe, ctx):
     return cols
 
 
+@_virtual("slow_queries")
+def _slow_queries(qe, ctx):
+    """Slow-query ring (utils/slow_query.py), newest first — the system
+    table surface of the slow-query log (the reference exposes its slow
+    queries the same way)."""
+    from greptimedb_tpu.utils import slow_query
+
+    cols = {k: [] for k in (
+        "trace_id", "kind", "query", "db", "duration_ms", "threshold_ms",
+        "rows", "execution_path", "started_at", "stages")}
+    for rec in slow_query.records():
+        cols["trace_id"].append(rec.trace_id)
+        cols["kind"].append(rec.kind)
+        cols["query"].append(rec.query)
+        cols["db"].append(rec.db)
+        cols["duration_ms"].append(round(rec.duration_ms, 3))
+        cols["threshold_ms"].append(rec.threshold_ms)
+        cols["rows"].append(rec.rows)
+        cols["execution_path"].append(rec.execution_path or "")
+        cols["started_at"].append(int(rec.started_at * 1000))
+        cols["stages"].append("; ".join(
+            f"{'' if n == 'local' else '[' + str(n) + '] '}{s}={d:.2f}ms"
+            for n, s, d in rec.stages))
+    return cols
+
+
 @_virtual("engines")
 def _engines(qe, ctx):
     names = ["mito", "metric", "file"]

@@ -28,6 +28,7 @@ def build_standalone(data_home: str, opts=None):
     tz = "UTC"
     if opts is not None:
         optmod.apply_query_env(opts)
+        optmod.apply_observability(opts)
         cfg = optmod.engine_config(opts, os.path.join(data_home, "data"))
         tz = opts.default_timezone
     else:
@@ -498,6 +499,11 @@ def main(argv=None):
     p_imp.set_defaults(fn=cmd_import)
 
     args = parser.parse_args(argv)
+    # every service role stamps trace_id= on its log records so logs,
+    # metrics, and spans join on one id
+    from greptimedb_tpu.utils.tracing import install_trace_logging
+
+    install_trace_logging()
     args.fn(args)
 
 

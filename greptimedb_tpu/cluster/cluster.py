@@ -91,7 +91,8 @@ class Datanode:
             from ..servers.flight import FlightServer, RemoteRegionEngine
 
             self.server = FlightServer(None, port=0,
-                                       region_engine=self.engine)
+                                       region_engine=self.engine,
+                                       node_id=node_id)
             self.remote = RemoteRegionEngine(f"127.0.0.1:{self.server.port}")
 
     def data_engine(self):

@@ -31,7 +31,9 @@ def main() -> None:
 
     from greptimedb_tpu.servers.flight import FlightServer
     from greptimedb_tpu.storage.engine import EngineConfig, RegionEngine
+    from greptimedb_tpu.utils.tracing import install_trace_logging
 
+    install_trace_logging()
     engine = RegionEngine(EngineConfig(
         data_dir=shared_dir, wal_backend="remote",
         write_workers=write_workers))

@@ -40,7 +40,7 @@ class DatanodeService:
         self.node_id = node_id
         self.engine = engine
         self.server = FlightServer(None, host=rpc_host, port=rpc_port,
-                                   region_engine=engine)
+                                   region_engine=engine, node_id=node_id)
         self.addr = f"{rpc_host}:{self.server.port}"
         self.meta = MetaClient(metasrv_addr, node_addr=self.addr)
         self.heartbeat = HeartbeatTask(node_id, self.meta,

@@ -50,7 +50,11 @@ class ProcDatanode:
             [sys.executable, "-m", "greptimedb_tpu.cluster.datanode_main",
              shared_dir, self.port_file],
             stdout=subprocess.DEVNULL, stderr=self._stderr_f,
-            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            # GTPU_NODE_ID: identity stamped on the spans the child
+            # piggybacks on its Flight responses (EXPLAIN ANALYZE
+            # attribution)
+            env={**os.environ, "JAX_PLATFORMS": "cpu",
+                 "GTPU_NODE_ID": node_id},
         )
         self.remote = None  # connected lazily once the port file appears
 

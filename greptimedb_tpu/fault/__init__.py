@@ -23,6 +23,8 @@ testing"):
     wal.append         wal.replay
     flight.do_get      flight.do_put
     heartbeat.send     datanode.crash
+    metasrv.kv         (KV ops over the kv_service HTTP seam; per-op
+                        targeting via @op:get|put|cas|range|delete|watch)
 
 Arming is programmatic (`FAULTS.arm("wal.append", Fault(...))`) or via
 env so child datanode processes inherit the schedule:
@@ -61,6 +63,10 @@ POINTS = frozenset({
     "wal.append", "wal.replay",
     "flight.do_get", "flight.do_put",
     "heartbeat.send", "datanode.crash",
+    # metadata-plane KV over the kv_service HTTP seam (ROADMAP fault
+    # matrix): fired per dispatched op with an `op` label, so chaos runs
+    # can target (and count) get/put/cas/range/delete independently
+    "metasrv.kv",
 })
 
 #: fault kinds a schedule can produce
@@ -211,11 +217,13 @@ class FaultRegistry:
     def fire(self, point: str, **labels) -> None:
         """Control-path hook: may raise FaultError or sleep. Data-kind
         faults (torn/short_read) armed on a control-only point degrade
-        to plain failures."""
+        to plain failures. Call-site labels ride into the
+        fault_injections counter, so chaos assertions can distinguish
+        e.g. which KV op or node the schedule actually hit."""
         fault = self._points.get(point)  # the one production dict lookup
         if fault is None or not fault.matches(labels):
             return
-        self._apply(point, fault)
+        self._apply(point, fault, labels)
 
     def mangle(self, point: str, data: bytes,
                **labels) -> tuple[bytes, bool]:
@@ -266,10 +274,12 @@ class FaultRegistry:
             raise FaultError(point, kind="torn", transient=False)
         return mangled
 
-    def _apply(self, point: str, fault: Fault) -> None:
+    def _apply(self, point: str, fault: Fault,
+               labels: Optional[dict] = None) -> None:
         if not fault.should_fire():
             return
-        FAULT_INJECTIONS.inc(point=point, kind=fault.kind)
+        FAULT_INJECTIONS.inc(point=point, kind=fault.kind,
+                             **{k: str(v) for k, v in (labels or {}).items()})
         if fault.kind == "latency":
             time.sleep(fault.arg)
             return

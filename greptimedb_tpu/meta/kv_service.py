@@ -150,6 +150,14 @@ class MetaHttpService:
     # ------------------------------------------------------------- dispatch
     def _dispatch(self, path: str, req: dict) -> dict:
         kv = self.metasrv.kv
+        if path.startswith("/kv/"):
+            # metadata-plane chaos seam (fault matrix: metasrv.kv): a
+            # fail surfaces as HTTP 500 -> MetaServiceError at every
+            # client; the op label makes injections per-op countable in
+            # greptimedb_tpu_fault_injections_total
+            from greptimedb_tpu.fault import FAULTS
+
+            FAULTS.fire("metasrv.kv", op=path[len("/kv/"):])
         if path == "/kv/get":
             return {"value": kv.get(req["key"])}
         if path == "/kv/put":

@@ -12,6 +12,12 @@ from greptimedb_tpu.ops.blocks import pad_rows, block_size_for
 from greptimedb_tpu.ops.segment import segment_agg, combine_group_ids, time_bucket
 from greptimedb_tpu.ops.dedup import sort_dedup
 
+# every jit entry point below feeds XLA compile count/duration metrics
+# through one jax.monitoring listener (utils/device_telemetry)
+from greptimedb_tpu.utils import device_telemetry as _device_telemetry
+
+_device_telemetry.install()
+
 __all__ = [
     "pad_rows",
     "block_size_for",

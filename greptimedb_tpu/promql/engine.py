@@ -161,11 +161,20 @@ class PromqlEngine:
                     ctx=None):
         if step <= 0:
             raise PromqlError("step must be positive")
-        node = parse_promql(query)
-        n_steps = int(math.floor((end - start) / step)) + 1
-        times = start + np.arange(n_steps) * step
-        params = EvalParams(start, end, step, times)
-        result = self._eval(node, params, ctx)
+        from greptimedb_tpu.utils import slow_query
+
+        # slow-query watch for the direct PromQL HTTP entry points; a
+        # TQL statement arrives under execute_sql's watch, where this
+        # one is a no-op (the re-entrancy guard)
+        with slow_query.watch("promql", query,
+                              getattr(ctx, "db", None) or "public") as w:
+            node = parse_promql(query)
+            n_steps = int(math.floor((end - start) / step)) + 1
+            times = start + np.arange(n_steps) * step
+            params = EvalParams(start, end, step, times)
+            result = self._eval(node, params, ctx)
+            if isinstance(result, SeriesMatrix):
+                w.rows = len(result.labels)
         return times, result
 
     def eval_instant(self, query: str, t: float, ctx=None):

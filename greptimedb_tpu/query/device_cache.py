@@ -18,6 +18,8 @@ from typing import Callable
 import jax
 
 from greptimedb_tpu import config
+from greptimedb_tpu.utils import device_telemetry
+from greptimedb_tpu.utils.metrics import DEVICE_CACHE_EVENTS
 
 
 class DeviceCache:
@@ -33,6 +35,8 @@ class DeviceCache:
         self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
+        # scrape-time residency gauge sums _bytes over live caches
+        device_telemetry.register_cache(self)
 
     def get(self, key: tuple, build: Callable[[], jax.Array]) -> jax.Array:
         with self._lock:
@@ -40,11 +44,17 @@ class DeviceCache:
             if hit is not None:
                 self._lru.move_to_end(key)
                 self.hits += 1
+                DEVICE_CACHE_EVENTS.inc(event="hit")
                 return hit
             self.misses += 1
+        DEVICE_CACHE_EVENTS.inc(event="miss")
         arr = build()
         nbytes = arr.nbytes
+        # a cache-miss build materializes the block on device: that IS
+        # the H2D upload this cache exists to amortize
+        device_telemetry.count_h2d(nbytes)
         if nbytes <= self.budget:
+            evictions = 0
             with self._lock:
                 old = self._lru.pop(key, None)
                 if old is not None:
@@ -54,6 +64,9 @@ class DeviceCache:
                 while self._bytes > self.budget and self._lru:
                     _, evicted = self._lru.popitem(last=False)
                     self._bytes -= evicted.nbytes
+                    evictions += 1
+            if evictions:
+                DEVICE_CACHE_EVENTS.inc(float(evictions), event="evict")
         return arr
 
     def clear(self) -> None:

@@ -82,9 +82,26 @@ class QueryEngine:
         # expression evaluation resolves plugin scalar functions against
         # THIS engine's container for the duration of the statement
         token = set_active(self.plugins)
+        from greptimedb_tpu.utils import slow_query
+
         try:
-            return [self.execute_statement(s, ctx)
-                    for s in self._parse_cached(sql)]
+            # slow-query watch: crosses the threshold -> structured
+            # record (trace id, text, duration, rows, path, stage
+            # breakdown) in the ring behind
+            # information_schema.slow_queries and /v1/slow_queries
+            with slow_query.watch("sql", sql, ctx.db) as w:
+                # last_path is thread-local and only the aggregate paths
+                # assign it — clear it so a non-aggregate slow statement
+                # doesn't inherit the previous query's path
+                self.executor.last_path = None
+                results = [self.execute_statement(s, ctx)
+                           for s in self._parse_cached(sql)]
+                last = results[-1] if results else None
+                if last is not None:
+                    w.rows = last.num_rows if last.is_query \
+                        else last.affected_rows
+                w.execution_path = self.executor.last_path
+                return results
         finally:
             reset_active(token)
 
@@ -792,12 +809,8 @@ class QueryEngine:
         from greptimedb_tpu.utils import tracing
 
         with tracing.span("window_pushdown", regions=len(info.region_ids)):
-            tid = tracing.current_trace_id()
-
-            def one(rid):
-                if tid:
-                    tracing.set_trace(tid)
-                return eng.execute_fragment(rid, frag)
+            one = tracing.propagate(
+                lambda rid: eng.execute_fragment(rid, frag))
 
             with ThreadPoolExecutor(
                     max_workers=min(8, len(info.region_ids))) as pool:
@@ -1473,10 +1486,25 @@ class QueryEngine:
             path = getattr(self.executor, "last_path", None)
             if path:
                 lines.append(f"  execution path: {path}")
-        for s in spans:
+
+        def fmt(s, indent="  "):
             attrs = " ".join(f"{k}={v}" for k, v in s.attrs.items())
-            lines.append(f"  {s.name}: {s.duration_ms:.2f} ms"
-                         + (f" [{attrs}]" if attrs else ""))
+            return (f"{indent}{s.name}: {s.duration_ms:.2f} ms"
+                    + (f" [{attrs}]" if attrs else ""))
+
+        # per-process span tree: this process's spans first (recorded
+        # order), then one section per remote node whose spans rode back
+        # on the region wire protocol (merge_scan.rs:245-259 piggyback)
+        for s in spans:
+            if s.node is None:
+                lines.append(fmt(s))
+        by_node: dict = {}
+        for s in spans:
+            if s.node is not None:
+                by_node.setdefault(s.node, []).append(s)
+        for node in sorted(by_node):
+            lines.append(f"  [{node}]")
+            lines.extend(fmt(s, "    ") for s in by_node[node])
         return lines
 
     # ---- admin -------------------------------------------------------------

@@ -50,6 +50,20 @@ from greptimedb_tpu.query.result import QueryResult
 from greptimedb_tpu.sql import ast
 from greptimedb_tpu.storage.engine import RegionEngine
 from greptimedb_tpu.storage.region import ScanData
+from greptimedb_tpu.utils import device_telemetry
+
+# XLA compile + device memory telemetry rides jax.monitoring: one
+# listener covers every jax.jit entry point in this module and ops/
+device_telemetry.install()
+
+
+def _readback(x) -> np.ndarray:
+    """D2H result materialization, counted (over a remote accelerator
+    link this is THE interactive-latency bottleneck — it must show up
+    at /metrics, not only in anecdotes)."""
+    arr = np.asarray(x)
+    device_telemetry.count_d2h(arr.nbytes)
+    return arr
 
 # primitive kernel ops backing each SQL aggregate
 # boundary first/last gather only pays when it shrinks the scan: above
@@ -1165,14 +1179,8 @@ class PhysicalExecutor:
                 # region streams concurrently for the same reason)
                 from concurrent.futures import ThreadPoolExecutor
 
-                tid = tracing.current_trace_id()
-
-                def one(rid):
-                    # contextvars don't cross thread-pool boundaries:
-                    # re-adopt the request trace in the worker
-                    if tid:
-                        tracing.set_trace(tid)
-                    return self.engine.execute_fragment(rid, frag)
+                one = tracing.propagate(
+                    lambda rid: self.engine.execute_fragment(rid, frag))
 
                 with ThreadPoolExecutor(
                         max_workers=min(8, len(rids))) as pool:
@@ -1594,6 +1602,8 @@ class PhysicalExecutor:
         gen = _prefetch(build_blocks())
         try:
             for dev, n_valid in gen:
+                device_telemetry.count_h2d(
+                    sum(a.nbytes for a in dev.values()))
                 if acc_dev is None:
                     acc_dev = _agg_block_jit(dev, n_valid, None, **kw)
                 else:
@@ -1619,7 +1629,7 @@ class PhysicalExecutor:
                     if op in ("first", "last"):
                         acc[op + "_ts"] = np.zeros(num_groups, dtype=np.int64)
             return acc
-        acc = {k: np.asarray(v) for k, v in acc_dev.items()}
+        acc = {k: _readback(v) for k, v in acc_dev.items()}
         for k in ("count", "rows"):
             if k in acc:
                 acc[k] = acc[k].astype(np.int64)
@@ -1679,6 +1689,8 @@ class PhysicalExecutor:
         gen = _prefetch(build_blocks())
         try:
             for dev, n_valid in gen:
+                device_telemetry.count_h2d(
+                    sum(a.nbytes for a in dev.values()))
                 acc_dev = _prep_stream_step(acc_dev, dev, n_valid, **kw)
         finally:
             gen.close()  # see _fold_stream: producer must die before unpin
@@ -1696,7 +1708,7 @@ class PhysicalExecutor:
                 else:
                     acc[op] = np.full((G, nf), np.nan)
             return acc
-        total = np.asarray(acc_dev["total"])
+        total = _readback(acc_dev["total"])
         sums = total[:, :nf]
         cnts = total[:, nf:2 * nf]
         rows = total[:, 2 * nf:2 * nf + 1]
@@ -2119,6 +2131,7 @@ class PhysicalExecutor:
 
             if scan.region_id < 0 or name in extra_cols:
                 cols[name] = build()
+                device_telemetry.count_h2d(cols[name].nbytes)
             else:
                 key = (_ACTIVE_TIER_VAR.get(), scan.region_id, scan.data_version,
                        scan.scan_fingerprint, name, "sharded", n_pad,
@@ -2182,7 +2195,10 @@ class PhysicalExecutor:
             return jnp.asarray(arr)
 
         if scan.region_id < 0 or name in extra_cols:
-            return build()
+            out = build()
+            # uncached upload (the cache counts its own miss-builds)
+            device_telemetry.count_h2d(out.nbytes)
+            return out
         key = (_ACTIVE_TIER_VAR.get(), scan.region_id, scan.data_version, scan.scan_fingerprint,
                name, start, block, str(cast_dtype))
         return self.cache.get(key, build)
@@ -2489,8 +2505,9 @@ def _pad_device_mask(mask: jax.Array, start: int, end: int, block: int) -> jax.A
 
 
 def _unpack_acc(packed_f, packed_i, float_ops, int_ops, widths):
-    """Split the kernel's packed output matrix back into per-op planes."""
-    host_f = np.asarray(packed_f)
+    """Split the kernel's packed output matrix back into per-op planes.
+    This is the dense/prepared paths' D2H readback boundary."""
+    host_f = _readback(packed_f)
     acc: dict[str, np.ndarray] = {}
     off = 0
     for k in float_ops:
@@ -2501,7 +2518,7 @@ def _unpack_acc(packed_f, packed_i, float_ops, int_ops, widths):
             sl = sl.astype(np.int64)
         acc[k] = sl
     if int_ops:
-        host_i = np.asarray(packed_i)
+        host_i = _readback(packed_i)
         for j, k in enumerate(int_ops):
             acc[k] = host_i[:, j]
     return acc

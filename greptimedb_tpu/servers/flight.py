@@ -24,6 +24,7 @@ servers/src/grpc/flight.rs).
 from __future__ import annotations
 
 import json
+import os
 import secrets
 from typing import Optional
 
@@ -233,7 +234,8 @@ class FlightServer(fl.FlightServerBase):
     """
 
     def __init__(self, query_engine, host: str = "127.0.0.1", port: int = 0,
-                 user_provider=None, region_engine=None):
+                 user_provider=None, region_engine=None,
+                 node_id: Optional[str] = None):
         self.qe = query_engine
         self.engine = region_engine if region_engine is not None \
             else (query_engine.region_engine if query_engine else None)
@@ -244,6 +246,11 @@ class FlightServer(fl.FlightServerBase):
         location = f"grpc://{host}:{port}"
         super().__init__(location, auth_handler=auth)
         self.host = host
+        # identity stamped on piggybacked spans so a distributed EXPLAIN
+        # ANALYZE attributes each stage to its process (reference tags
+        # RecordBatchMetrics per peer, merge_scan.rs:245-259)
+        self.node_id = node_id or os.environ.get("GTPU_NODE_ID") \
+            or f"{host}:{self.port}"
 
     def _resolve_user(self, context):
         """Map the Flight peer identity (set by _BasicServerAuth.is_valid)
@@ -303,6 +310,18 @@ class FlightServer(fl.FlightServerBase):
             table = result_to_table(result)
         return fl.RecordBatchStream(table)
 
+    def _piggyback(self, table: pa.Table, sink) -> pa.Table:
+        """Attach this request's spans (+ the serving node's identity) to
+        the response schema metadata — the RecordBatchMetrics piggyback
+        (merge_scan.rs:245-259): the caller merges them into its own ring
+        so one EXPLAIN ANALYZE covers every process the query touched."""
+        from greptimedb_tpu.utils import tracing
+
+        meta = dict(table.schema.metadata or {})
+        meta[b"spans"] = json.dumps(tracing.spans_to_wire(sink)).encode()
+        meta[b"node"] = str(self.node_id).encode()
+        return table.replace_schema_metadata(meta)
+
     def _region_scan(self, req: dict):
         """Datanode region service (reference region_server.rs:39-92 —
         Substrait plan in, Flight stream out; here the scan spec is the
@@ -318,15 +337,25 @@ class FlightServer(fl.FlightServerBase):
         if req.get("trace_id"):
             # adopt the caller's trace (region_server.rs:74 analog)
             tracing.set_trace(req["trace_id"])
-        with tracing.span("region_scan", region=region_id):
-            scan = self.engine.scan(
-                region_id, ts_range=ts_range, projection=projection,
-                tag_predicates=preds, seq_min=req.get("seq_min"))
-        if scan is None:
-            # empty marker: zero-column table with metadata flag
-            return fl.RecordBatchStream(pa.Table.from_arrays(
-                [], schema=pa.schema([], metadata={b"empty": b"1"})))
-        return fl.RecordBatchStream(scan_to_table(scan))
+        with tracing.collect_spans() as sink:
+            with tracing.span("region_scan", region=region_id) as attrs:
+                scan = self.engine.scan(
+                    region_id, ts_range=ts_range, projection=projection,
+                    tag_predicates=preds, seq_min=req.get("seq_min"))
+                # scan stats ride the span: rows served, SST pruning,
+                # host scan-cache reuse (reference RecordBatchMetrics
+                # carries the same per-stage counters)
+                attrs["rows"] = 0 if scan is None else scan.num_rows
+                if scan is not None and scan.stats:
+                    attrs.update(scan.stats)
+            if scan is None:
+                # empty marker: zero-column table with metadata flag
+                table = pa.Table.from_arrays(
+                    [], schema=pa.schema([], metadata={b"empty": b"1"}))
+            else:
+                table = scan_to_table(scan)
+                attrs["bytes"] = table.nbytes
+        return fl.RecordBatchStream(self._piggyback(table, sink))
 
     def _region_frag(self, req: dict):
         """Plan-fragment pushdown: the PlanFragment (the substrait
@@ -345,22 +374,26 @@ class FlightServer(fl.FlightServerBase):
         if self._agg_executor is None:
             from greptimedb_tpu.query.physical import PhysicalExecutor
             self._agg_executor = PhysicalExecutor(self.engine)
-        with tracing.span("region_frag", region=region_id):
-            part = execute_region_fragment(self._agg_executor, region_id,
-                                           frag)
-        if part is None:
-            return fl.RecordBatchStream(pa.Table.from_arrays(
-                [], schema=pa.schema([], metadata={b"empty": b"1"})))
-        if "planes" in part:
-            return fl.RecordBatchStream(partial_to_table(part))
-        cols = part["cols"]
-        arrays = [pa.array(cols[name]) for name in cols]
-        return fl.RecordBatchStream(pa.Table.from_arrays(
-            arrays,
-            schema=pa.schema(
-                [pa.field(name, a.type)
-                 for name, a in zip(cols, arrays)],
-                metadata={b"kind": b"rows"})))
+        with tracing.collect_spans() as sink:
+            with tracing.span("region_frag", region=region_id,
+                              stages=len(frag.stages)):
+                part = execute_region_fragment(self._agg_executor,
+                                               region_id, frag)
+            if part is None:
+                table = pa.Table.from_arrays(
+                    [], schema=pa.schema([], metadata={b"empty": b"1"}))
+            elif "planes" in part:
+                table = partial_to_table(part)
+            else:
+                cols = part["cols"]
+                arrays = [pa.array(cols[name]) for name in cols]
+                table = pa.Table.from_arrays(
+                    arrays,
+                    schema=pa.schema(
+                        [pa.field(name, a.type)
+                         for name, a in zip(cols, arrays)],
+                        metadata={b"kind": b"rows"}))
+        return fl.RecordBatchStream(self._piggyback(table, sink))
 
     # -- ingest ----------------------------------------------------------------
 
@@ -377,23 +410,36 @@ class FlightServer(fl.FlightServerBase):
             if user is not None and not user.can("write"):
                 raise fl.FlightUnauthorizedError(
                     f"user {user.username!r} lacks write permission")
+            from greptimedb_tpu.utils import tracing
+
             rid = int(path[1])
             op = path[2] if len(path) > 2 else "put"
-            t = reader.read_all()
-            from greptimedb_tpu.datatypes.recordbatch import RecordBatch
+            # the caller's trace id rides the descriptor path tail so
+            # write-side spans join the same trace (do_get carries it in
+            # the ticket; do_put has only the descriptor)
+            if len(path) > 3 and path[3]:
+                tracing.set_trace(path[3])
+            with tracing.collect_spans() as sink:
+                with tracing.span("region_write", region=rid,
+                                  op=op) as attrs:
+                    t = reader.read_all()
+                    from greptimedb_tpu.datatypes.recordbatch import RecordBatch
 
-            region = self.engine.region(rid)
-            if t.num_rows:
-                arrow = t.combine_chunks().to_batches()[0]
-            else:
-                arrow = pa.RecordBatch.from_pydict(
-                    {f.name: [] for f in t.schema}, schema=t.schema)
-            batch = RecordBatch.from_arrow(arrow, region.schema)
-            if op == "delete":
-                n = self.engine.delete(rid, batch)
-            else:
-                n = self.engine.put(rid, batch)
-            writer.write(json.dumps({"affected_rows": n}).encode())
+                    region = self.engine.region(rid)
+                    if t.num_rows:
+                        arrow = t.combine_chunks().to_batches()[0]
+                    else:
+                        arrow = pa.RecordBatch.from_pydict(
+                            {f.name: [] for f in t.schema}, schema=t.schema)
+                    batch = RecordBatch.from_arrow(arrow, region.schema)
+                    if op == "delete":
+                        n = self.engine.delete(rid, batch)
+                    else:
+                        n = self.engine.put(rid, batch)
+                    attrs["rows"] = n
+            writer.write(json.dumps({
+                "affected_rows": n, "node": self.node_id,
+                "spans": tracing.spans_to_wire(sink)}).encode())
             return
         if self.qe is None:
             raise fl.FlightServerError("datanode service: region writes only")
@@ -563,6 +609,28 @@ class RemoteRegionEngine:
             return fn()
         return retry_call(op, point=point, retryable=RETRYABLE_FLIGHT)
 
+    def _merge_remote_spans(self, meta) -> None:
+        """Fold the response's piggybacked datanode spans into the local
+        ring, tagged with the source node (merge_scan.rs:245-259 analog:
+        sub-stage metrics ride the Flight stream back). `meta` is either
+        a pa.Table schema-metadata dict or a decoded JSON ack."""
+        from greptimedb_tpu.utils import tracing
+
+        if meta is None:
+            return
+        try:
+            if isinstance(meta, dict) and b"spans" in meta:
+                wire = json.loads(meta[b"spans"].decode())
+                node = meta.get(b"node", b"").decode() or self.addr
+            elif isinstance(meta, dict) and "spans" in meta:
+                wire = meta["spans"]
+                node = meta.get("node") or self.addr
+            else:
+                return
+            tracing.merge_spans(wire, node=node)
+        except (ValueError, KeyError, AttributeError):
+            pass  # a mangled piggyback must never fail the query
+
     # -- control -------------------------------------------------------------
 
     def _admin(self, op: str, region_id: int, **extra) -> dict:
@@ -609,7 +677,13 @@ class RemoteRegionEngine:
     # -- write ---------------------------------------------------------------
 
     def _write(self, region_id: int, batch, op: str) -> int:
-        desc = fl.FlightDescriptor.for_path("__region__", str(region_id), op)
+        from greptimedb_tpu.utils import tracing
+
+        tid = tracing.current_trace_id()
+        # trace id rides the descriptor path tail (do_put has no ticket);
+        # old servers ignore the extra element
+        path = ["__region__", str(region_id), op] + ([tid] if tid else [])
+        desc = fl.FlightDescriptor.for_path(*path)
         arrow = batch.to_arrow()
 
         def put_once():
@@ -620,8 +694,9 @@ class RemoteRegionEngine:
                 ack_buf = reader.read()
                 if ack_buf is None:
                     raise fl.FlightServerError("no ack from region server")
-                return json.loads(ack_buf.to_pybytes().decode())[
-                    "affected_rows"]
+                ack = json.loads(ack_buf.to_pybytes().decode())
+                self._merge_remote_spans(ack)
+                return ack["affected_rows"]
             finally:
                 # close on EVERY path: a failed put that leaks its stream
                 # would accumulate one half-open stream per retry attempt
@@ -669,6 +744,7 @@ class RemoteRegionEngine:
             ticket = fl.Ticket(json.dumps({"region_scan": spec}).encode())
             t = self._rpc("flight.do_get",
                           lambda: self.client.do_get(ticket).read_all())
+        self._merge_remote_spans(t.schema.metadata)
         if (t.schema.metadata or {}).get(b"empty") == b"1":
             return None
         return table_to_scan(t)
@@ -689,6 +765,7 @@ class RemoteRegionEngine:
             ticket = fl.Ticket(json.dumps({"region_frag": spec}).encode())
             t = self._rpc("flight.do_get",
                           lambda: self.client.do_get(ticket).read_all())
+        self._merge_remote_spans(t.schema.metadata)
         md = t.schema.metadata or {}
         if md.get(b"empty") == b"1":
             return None

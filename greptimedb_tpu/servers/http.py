@@ -205,6 +205,17 @@ class _Handler(BaseHTTPRequestHandler):
                             top=int(qs.get("top", ["50"])[0]))
                     return self._send(200, out.encode(), "text/plain")
                 return self._send(404, {"error": f"no route {path}"})
+            if path == "/v1/slow_queries":
+                # debug surface of the slow-query ring; behind the auth
+                # gate (query text is sensitive, unlike /metrics)
+                from greptimedb_tpu.utils import slow_query
+
+                params = self._params()
+                n = int(params.get("limit", "50"))
+                return self._send(200, {
+                    "slow_queries": [r.to_dict()
+                                     for r in slow_query.records(n)],
+                    "threshold_ms": slow_query.threshold_ms()})
             if path == "/v1/sql":
                 return self._handle_sql()
             if path == "/v1/promql":

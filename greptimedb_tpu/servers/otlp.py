@@ -19,7 +19,7 @@ from greptimedb_tpu.utils import protowire as pw
 from greptimedb_tpu.utils.metrics import REGISTRY
 
 INGEST_ROWS = REGISTRY.counter(
-    "greptime_servers_otlp_rows", "rows ingested via otlp metrics"
+    "greptimedb_tpu_otlp_rows_total", "Rows ingested via OTLP metrics"
 )
 
 
@@ -178,7 +178,7 @@ def handle_otlp_metrics(query_engine, body: bytes, db: str = "public") -> int:
 TRACE_TABLE_NAME = "opentelemetry_traces"
 
 TRACE_ROWS = REGISTRY.counter(
-    "greptime_servers_otlp_trace_rows", "spans ingested via otlp traces"
+    "greptimedb_tpu_otlp_trace_rows_total", "Spans ingested via OTLP traces"
 )
 
 _SPAN_KINDS = {0: "SPAN_KIND_UNSPECIFIED", 1: "SPAN_KIND_INTERNAL",

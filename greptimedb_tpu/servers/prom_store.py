@@ -30,7 +30,8 @@ GREPTIME_TIMESTAMP = "greptime_timestamp"
 GREPTIME_VALUE = "greptime_value"
 
 INGEST_ROWS = REGISTRY.counter(
-    "greptime_servers_prom_store_rows", "rows ingested via prometheus remote write"
+    "greptimedb_tpu_prom_store_rows_total",
+    "Rows ingested via Prometheus remote write"
 )
 
 

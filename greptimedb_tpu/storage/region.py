@@ -69,6 +69,12 @@ class ScanData:
     # last-row semantics in its merge reader, mito2/src/read/merge.rs).
     # () means "no sortedness information" (merged/remote scans).
     sorted_part_offsets: tuple = ()
+    # observability: how this snapshot was built (ssts considered /
+    # pruned, scan-cache reuse count) — piggybacked on the region wire
+    # protocol so distributed EXPLAIN ANALYZE shows datanode-side IO.
+    # None for synthetic/merged scans. Mutated only under the region
+    # lock (cache_hits bumps on each cached reuse).
+    stats: Optional[dict] = None
 
     @property
     def tag_cardinalities(self) -> dict[str, int]:
@@ -465,6 +471,8 @@ class Region:
             cached = self._scan_cache.get(cache_key)
             if cached is not None:
                 self._scan_cache.move_to_end(cache_key)
+                if cached.stats is not None:
+                    cached.stats["cache_hits"] += 1
                 return cached
             file_list = list(self.files.values())
             self._pin_files(file_list)
@@ -571,6 +579,9 @@ class Region:
             data_version=version,
             scan_fingerprint=(ts_range, tuple(names), pred_key),
             sorted_part_offsets=tuple(int(o) for o in part_offsets),
+            stats={"ssts": len(file_list),
+                   "ssts_pruned": len(file_list) - len(sst_part_lens),
+                   "cache_hits": 0},
         )
         with self._lock:
             self._scan_cache[cache_key] = result

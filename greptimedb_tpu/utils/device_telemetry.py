@@ -1,0 +1,112 @@
+"""TPU runtime telemetry: XLA compile, device memory, and link traffic.
+
+The reference exposes per-subsystem prometheus registries (SURVEY §5);
+the TPU-native equivalent must also surface what the ACCELERATOR is
+doing — a 25 s XLA recompile or an HBM cache that stopped fitting is
+invisible in query latency histograms alone. Three feeds:
+
+- **Compiles**: `jax.monitoring` emits a duration event per backend
+  compile (`/jax/core/compile/backend_compile_duration`) for every
+  `jax.jit` entry point in ops/ and query/physical.py — one listener
+  covers them all without wrapping call sites.
+- **Device memory**: a render-time collector reads the PJRT allocator's
+  `memory_stats()` (bytes_in_use / bytes_limit on TPU; the CPU backend
+  reports none) plus the device block cache's own pinned-bytes
+  accounting, which works on every backend.
+- **Transfers**: `count_h2d`/`count_d2h` are called at the scan-block
+  upload and result-readback seams in query/physical.py and
+  query/device_cache.py.
+
+`install()` is idempotent and cheap; importing query/physical.py wires
+everything.
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+
+from greptimedb_tpu.utils.metrics import (
+    DEVICE_MEMORY,
+    DEVICE_TRANSFER_BYTES,
+    REGISTRY,
+    XLA_COMPILE_SECONDS,
+    XLA_COMPILES,
+)
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_install_lock = threading.Lock()
+_installed = False
+
+#: live DeviceCache instances (registered by DeviceCache.__init__) —
+#: the memory collector sums their pinned bytes at scrape time
+_caches: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def register_cache(cache) -> None:
+    _caches.add(cache)
+
+
+def count_h2d(nbytes: int) -> None:
+    if nbytes:
+        DEVICE_TRANSFER_BYTES.inc(float(nbytes), direction="h2d")
+
+
+def count_d2h(nbytes: int) -> None:
+    if nbytes:
+        DEVICE_TRANSFER_BYTES.inc(float(nbytes), direction="d2h")
+
+
+def _on_event_duration(event: str, duration_secs: float, **kwargs) -> None:
+    if event != _COMPILE_EVENT:
+        return
+    import jax
+
+    try:
+        backend = jax.default_backend()
+    except Exception:  # noqa: BLE001 — never let telemetry break a compile
+        backend = "unknown"
+    XLA_COMPILES.inc(backend=backend)
+    XLA_COMPILE_SECONDS.observe(float(duration_secs), backend=backend)
+
+
+def _collect_device_memory() -> None:
+    """Scrape-time gauge refresh (registered on REGISTRY)."""
+    cache_bytes = 0
+    for cache in list(_caches):
+        cache_bytes += getattr(cache, "_bytes", 0)
+    DEVICE_MEMORY.set(float(cache_bytes), kind="cache")
+    try:
+        import jax
+
+        stats = jax.devices()[0].memory_stats()
+    except Exception:  # noqa: BLE001 — backend may not be initialized yet
+        stats = None
+    if stats:
+        if "bytes_in_use" in stats:
+            DEVICE_MEMORY.set(float(stats["bytes_in_use"]), kind="in_use")
+        if "bytes_limit" in stats:
+            DEVICE_MEMORY.set(float(stats["bytes_limit"]), kind="limit")
+    else:
+        # CPU backend (no PJRT allocator stats): the block cache's pinned
+        # bytes ARE the device working set — report them so the series
+        # exists with meaning on every backend
+        DEVICE_MEMORY.set(float(cache_bytes), kind="in_use")
+
+
+def install() -> None:
+    """Wire the jax.monitoring listener + the memory collector. Safe to
+    call from several modules; only the first call does work."""
+    global _installed
+    with _install_lock:
+        if _installed:
+            return
+        _installed = True
+    try:
+        from jax import monitoring
+
+        monitoring.register_event_duration_secs_listener(_on_event_duration)
+    except Exception:  # noqa: BLE001 — older jax without monitoring
+        pass
+    REGISTRY.register_collector(_collect_device_memory)

@@ -23,7 +23,19 @@ class Counter:
             self._values[key] += value
 
     def get(self, **labels) -> float:
-        return self._values.get(tuple(sorted(labels.items())), 0.0)
+        # under the lock: a bare dict read races concurrent inc/set
+        # (resize mid-read) and could observe a half-applied update
+        with self._lock:
+            return self._values.get(tuple(sorted(labels.items())), 0.0)
+
+    def total(self, **labels) -> float:
+        """Sum over every series whose labels are a superset of the
+        given ones (PromQL `sum by` analog) — assertions stay valid
+        when a call site starts attaching extra labels."""
+        want = set(labels.items())
+        with self._lock:
+            return sum(v for key, v in self._values.items()
+                       if want <= set(key))
 
     def render(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
@@ -108,18 +120,43 @@ class _Timer:
         self.hist.observe(time.perf_counter() - self.t0, **self.labels)
 
 
+def _escape_label_value(v) -> str:
+    """Prometheus exposition-format escaping: backslash, double-quote and
+    newline must be escaped inside label values (a raw newline would
+    split the sample line and corrupt the whole scrape)."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _labels(key: tuple, **extra) -> str:
     items = list(key) + sorted(extra.items())
     if not items:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in items)
+    inner = ",".join(f'{k}="{_escape_label_value(v)}"' for k, v in items)
     return "{" + inner + "}"
 
 
 class Registry:
     def __init__(self):
         self._metrics: list = []
+        self._collectors: list = []
         self._lock = threading.Lock()
+
+    def register_collector(self, fn) -> None:
+        """Register a callback run before every render/sample pass —
+        for gauges whose truth lives elsewhere (device memory stats,
+        cache residency) and is only worth reading at scrape time."""
+        with self._lock:
+            self._collectors.append(fn)
+
+    def _collect(self) -> None:
+        with self._lock:
+            collectors = list(self._collectors)
+        for fn in collectors:
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — a scrape must never fail
+                pass
 
     def counter(self, name, help_="") -> Counter:
         m = Counter(name, help_)
@@ -140,13 +177,17 @@ class Registry:
         return m
 
     def render(self) -> str:
+        self._collect()
+        with self._lock:
+            metrics = list(self._metrics)
         lines = []
-        for m in self._metrics:
+        for m in metrics:
             lines.extend(m.render())
         return "\n".join(lines) + "\n"
 
     def _iter_samples(self):
         """(metric_name, value, label-pairs tuple) over every metric."""
+        self._collect()
         with self._lock:
             metrics = list(self._metrics)
         for m in metrics:
@@ -205,3 +246,29 @@ DEGRADED = REGISTRY.counter(
 FLOW_TICK_ERRORS = REGISTRY.counter(
     "greptimedb_tpu_flow_tick_errors_total",
     "Flow engine tick failures deferred to the next tick, by flow")
+
+# TPU runtime telemetry (SURVEY §5: the north star is unfalsifiable
+# without per-device numbers): XLA compiles, device memory, link
+# traffic, and HBM block-cache behavior — wired by
+# utils/device_telemetry.py, rendered at /metrics, self-scraped by
+# utils/export_metrics.py like every other series
+XLA_COMPILES = REGISTRY.counter(
+    "greptimedb_tpu_xla_compile_total",
+    "XLA compilations observed via jax.monitoring, by backend")
+XLA_COMPILE_SECONDS = REGISTRY.histogram(
+    "greptimedb_tpu_xla_compile_duration_seconds",
+    "XLA backend-compile wall time per compilation, by backend")
+DEVICE_MEMORY = REGISTRY.gauge(
+    "greptimedb_tpu_device_memory_bytes",
+    "Accelerator memory by kind (in_use/limit from the PJRT allocator "
+    "when available, cache = bytes pinned by the device block cache)")
+DEVICE_TRANSFER_BYTES = REGISTRY.counter(
+    "greptimedb_tpu_device_transfer_bytes_total",
+    "Host<->device bytes moved by the query engine, by direction "
+    "(h2d uploads of scan blocks, d2h result readbacks)")
+DEVICE_CACHE_EVENTS = REGISTRY.counter(
+    "greptimedb_tpu_device_cache_events_total",
+    "HBM block cache events by kind (hit/miss/evict)")
+SLOW_QUERIES = REGISTRY.counter(
+    "greptimedb_tpu_slow_queries_total",
+    "Statements slower than the slow-query threshold, by kind")

@@ -6,13 +6,22 @@ servers/src/grpc/region_server.rs:74).
 A request's trace id lives in a contextvar; spans record wall-time per
 stage into a bounded ring buffer. EXPLAIN ANALYZE and the region wire
 protocol both ride this: the frontend's trace id crosses Flight inside
-the scan spec, so one query's spans line up across processes.
+the scan spec, so one query's spans line up across processes — and the
+datanode's spans ride BACK on the Flight response (the RecordBatchMetrics
+piggyback, merge_scan.rs:245-259 analog), tagged with the source node,
+so a distributed EXPLAIN ANALYZE renders the whole per-process span tree
+instead of only frontend-local time.
+
+Logs join the same id: `TraceIdFilter` stamps every log record with the
+current trace id (`trace_id=<id>`), so logs, metrics, and spans correlate
+on one key.
 """
 
 from __future__ import annotations
 
 import contextlib
 import contextvars
+import logging
 import time
 import uuid
 from collections import deque
@@ -21,6 +30,12 @@ from typing import Optional
 
 _current: contextvars.ContextVar[Optional[str]] = contextvars.ContextVar(
     "gtpu_trace_id", default=None)
+
+#: request-scoped span sink (see collect_spans): lets a server handler
+#: capture exactly the spans ITS request produced, concurrency-safe,
+#: without diffing the shared ring
+_collector: contextvars.ContextVar[Optional[list]] = contextvars.ContextVar(
+    "gtpu_span_collector", default=None)
 
 _SPANS: deque = deque(maxlen=4096)
 
@@ -32,6 +47,8 @@ class Span:
     duration_ms: float
     started_at: float
     attrs: dict = field(default_factory=dict)
+    #: source process for piggybacked remote spans (None = this process)
+    node: Optional[str] = None
 
 
 def new_trace_id() -> str:
@@ -55,21 +72,159 @@ def restore_trace(trace_id: Optional[str]) -> None:
     _current.set(trace_id)
 
 
+def _record(span: Span) -> None:
+    _SPANS.append(span)
+    sink = _collector.get()
+    if sink is not None:
+        sink.append(span)
+
+
 @contextlib.contextmanager
 def span(name: str, **attrs):
+    """Record a timed span. Yields the (mutable) attrs dict so the body
+    can attach result stats it only knows at the end (rows, bytes,
+    pruning counts) — they land on the recorded span."""
     t0 = time.perf_counter()
     started = time.time()
     try:
-        yield
+        yield attrs
     finally:
-        _SPANS.append(Span(_current.get(), name,
-                           (time.perf_counter() - t0) * 1000.0,
-                           started, attrs))
+        _record(Span(_current.get(), name,
+                     (time.perf_counter() - t0) * 1000.0,
+                     started, attrs))
+
+
+@contextlib.contextmanager
+def collect_spans():
+    """Yield a list that receives every span recorded in this context
+    (on top of the shared ring). Used by the Flight region service to
+    piggyback exactly ITS request's spans on the response, and by the
+    slow-query log to capture a statement's per-stage breakdown. Nesting
+    installs the innermost sink only — the outer one resumes on exit."""
+    sink: list[Span] = []
+    token = _collector.set(sink)
+    try:
+        yield sink
+    finally:
+        _collector.reset(token)
+
+
+def propagate(fn):
+    """Carry the caller's trace id AND span sink across a thread-pool
+    boundary (contextvars don't cross threads): the returned wrapper
+    re-installs both around each invocation. The sink is appended from
+    worker threads — list.append is atomic, so concurrent region RPCs
+    interleave safely."""
+    tid = _current.get()
+    sink = _collector.get()
+
+    def wrapper(*args, **kwargs):
+        t1 = _current.set(tid)
+        t2 = _collector.set(sink)
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            _collector.reset(t2)
+            _current.reset(t1)
+    return wrapper
+
+
+# ---- cross-process piggyback ------------------------------------------------
+
+
+def spans_to_wire(spans: list[Span]) -> list[dict]:
+    """JSON-serializable span records for the Flight response metadata
+    (the RecordBatchMetrics payload analog)."""
+    return [
+        {"name": s.name, "duration_ms": round(s.duration_ms, 4),
+         "started_at": s.started_at, "attrs": _wire_attrs(s.attrs)}
+        for s in spans
+    ]
+
+
+def _wire_attrs(attrs: dict) -> dict:
+    out = {}
+    for k, v in attrs.items():
+        out[str(k)] = v if isinstance(v, (int, float, bool, str,
+                                          type(None))) else str(v)
+    return out
+
+
+def merge_spans(wire: list[dict], node: Optional[str] = None,
+                trace_id: Optional[str] = None) -> list[Span]:
+    """Merge piggybacked remote spans into the local ring, tagged with
+    their source node and attributed to the CURRENT trace (the remote
+    process recorded them under the same propagated id; using the local
+    id keeps them joined even if the peer was mid-rollout and dropped
+    it). When the 'remote' service actually shares this process (the
+    in-process wire-mode cluster), its handler already recorded the
+    same spans into this ring — those piggybacked copies are skipped,
+    not double-reported. Returns the merged spans."""
+    tid = trace_id or _current.get()
+    # snapshot first: concurrent region RPC workers append to the ring
+    # while this merge runs, and iterating a deque under mutation
+    # raises (list(deque) is a single C-level copy, safe under the GIL)
+    existing = {(s.name, s.started_at, round(s.duration_ms, 4))
+                for s in list(_SPANS) if s.trace_id == tid}
+    merged = []
+    for w in wire:
+        try:
+            s = Span(tid, str(w["name"]), float(w["duration_ms"]),
+                     float(w.get("started_at", 0.0)),
+                     dict(w.get("attrs") or {}), node=node)
+        except (KeyError, TypeError, ValueError):
+            continue  # a mangled record must not kill the query
+        if (s.name, s.started_at, s.duration_ms) in existing:
+            continue
+        _record(s)
+        merged.append(s)
+    return merged
 
 
 def spans_for(trace_id: str) -> list[Span]:
-    return [s for s in _SPANS if s.trace_id == trace_id]
+    # list() snapshot: see merge_spans — readers race ring appends
+    return [s for s in list(_SPANS) if s.trace_id == trace_id]
 
 
 def recent_spans(n: int = 100) -> list[Span]:
     return list(_SPANS)[-n:]
+
+
+# ---- log correlation --------------------------------------------------------
+
+
+class TraceIdFilter(logging.Filter):
+    """Stamp every record with the context's trace id so log lines join
+    metrics and spans on one key (reference: its tracing subscriber puts
+    the trace id on every event)."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        record.trace_id = _current.get() or "-"
+        return True
+
+
+#: format fragment including the trace id (used by install_trace_logging
+#: and any service that builds its own handler)
+TRACE_LOG_FORMAT = ("%(asctime)s %(levelname)s %(name)s "
+                    "trace_id=%(trace_id)s %(message)s")
+
+
+def install_trace_logging(level: Optional[int] = None) -> TraceIdFilter:
+    """Attach a TraceIdFilter to the root logger's handlers (creating a
+    basicConfig handler with TRACE_LOG_FORMAT if none exist yet) so every
+    log record carries `trace_id=`. Idempotent."""
+    root = logging.getLogger()
+    if not root.handlers:
+        logging.basicConfig(format=TRACE_LOG_FORMAT,
+                            level=level if level is not None else logging.INFO)
+    elif level is not None:
+        root.setLevel(level)
+    filt = None
+    for h in root.handlers:
+        existing = [f for f in h.filters if isinstance(f, TraceIdFilter)]
+        if existing:
+            filt = existing[0]
+            continue
+        filt = filt or TraceIdFilter()
+        h.addFilter(filt)
+    return filt or TraceIdFilter()

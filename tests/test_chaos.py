@@ -352,8 +352,8 @@ class TestClusterChaos:
             for _ in range(20):
                 c.beat_all(t)
                 t += 3000.0
-            assert FAULT_INJECTIONS.get(point="heartbeat.send",
-                                        kind="fail") >= 20
+            assert FAULT_INJECTIONS.total(point="heartbeat.send",
+                                          kind="fail") >= 20
             started = c.tick(t)
             assert started, "phi should fire for the silenced node"
             c.beat_all(t)  # deliver OPEN_REGION to the survivors
@@ -569,7 +569,7 @@ class TestProcessClusterChaos:
                 str(rid >> 32)).regions[0].leader_node
             assert new_owner != owner
             # the run was observable: injected heartbeat drops counted
-            assert FAULT_INJECTIONS.get(point="heartbeat.send",
-                                        kind="fail") > 0
+            assert FAULT_INJECTIONS.total(point="heartbeat.send",
+                                          kind="fail") > 0
         finally:
             c.close()

@@ -1,0 +1,409 @@
+"""End-to-end query observability (the PR 2 plane): Prometheus
+exposition-format validity, cross-process span piggyback, the slow-query
+log, TPU runtime telemetry, trace-id log correlation, and the metasrv.kv
+fault-matrix extension."""
+
+import json
+import logging
+import re
+import time
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+from greptimedb_tpu.catalog import Catalog, MemoryKv
+from greptimedb_tpu.query import QueryEngine
+from greptimedb_tpu.query.engine import QueryContext
+from greptimedb_tpu.storage import RegionEngine
+from greptimedb_tpu.storage.engine import EngineConfig
+from greptimedb_tpu.utils import slow_query, tracing
+from greptimedb_tpu.utils.metrics import (
+    DEVICE_CACHE_EVENTS,
+    DEVICE_MEMORY,
+    REGISTRY,
+    XLA_COMPILES,
+    Counter,
+    Histogram,
+    Registry,
+)
+
+
+@pytest.fixture
+def qe(tmp_path):
+    engine = RegionEngine(EngineConfig(data_dir=str(tmp_path / "data")))
+    qe = QueryEngine(Catalog(MemoryKv()), engine)
+    yield qe
+    engine.close()
+
+
+def _seed(qe, rows=64):
+    qe.execute_one(
+        "CREATE TABLE cpu (host STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, "
+        "PRIMARY KEY(host))")
+    vals = ", ".join(f"('h{i % 4}', {float(i)}, {1000 * (i + 1)})"
+                     for i in range(rows))
+    qe.execute_one(f"INSERT INTO cpu VALUES {vals}")
+
+
+# ---- Prometheus exposition-format validator --------------------------------
+
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?P<labels>\{[^{}]*\})? (?P<value>[^ ]+)$")
+_LABEL_RE = re.compile(
+    r'(?P<key>[a-zA-Z_][a-zA-Z0-9_]*)="(?P<val>(?:[^"\\]|\\["\\n])*)"')
+
+
+def _parse_exposition(text: str):
+    """Parse exposition text into samples; raises on malformed lines."""
+    samples = []
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        assert m, f"malformed sample line: {line!r}"
+        labels = {}
+        raw = m.group("labels")
+        if raw:
+            body = raw[1:-1]
+            parsed = _LABEL_RE.findall(body)
+            # every byte of the label body must be consumed by valid
+            # key="escaped value" pairs — a stray quote/newline would
+            # corrupt the scrape
+            reconstructed = ",".join(f'{k}="{v}"' for k, v in parsed)
+            assert reconstructed == body, f"bad label escaping in: {line!r}"
+            labels = dict(parsed)
+        samples.append((m.group("name"), labels, float(m.group("value"))))
+    return samples
+
+
+class TestExpositionFormat:
+    def test_every_metrics_line_parses(self, qe):
+        _seed(qe)
+        qe.execute_one("SELECT host, avg(v) FROM cpu GROUP BY host")
+        assert _parse_exposition(REGISTRY.render())
+
+    def test_label_values_are_escaped(self):
+        reg = Registry()
+        c = reg.counter("greptimedb_tpu_test_escape_total", "escape test")
+        c.inc(q='say "hi"\nback\\slash')
+        samples = _parse_exposition(reg.render())
+        (name, labels, value), = samples
+        assert value == 1.0
+        # unescape round-trips to the original value
+        unescaped = (labels["q"].replace("\\n", "\n")
+                     .replace('\\"', '"').replace("\\\\", "\\"))
+        assert unescaped == 'say "hi"\nback\\slash'
+
+    def test_histogram_buckets_monotone_and_inf_equals_count(self, qe):
+        _seed(qe)
+        qe.execute_one("SELECT count(*) FROM cpu")
+        text = REGISTRY.render()
+        samples = _parse_exposition(text)
+        by_series: dict = {}
+        counts: dict = {}
+        for name, labels, value in samples:
+            key = tuple(sorted((k, v) for k, v in labels.items()
+                               if k != "le"))
+            if name.endswith("_bucket"):
+                by_series.setdefault((name[:-7], key), []).append(
+                    (labels["le"], value))
+            elif name.endswith("_count"):
+                counts[(name[:-6], key)] = value
+        assert by_series, "no histograms rendered"
+        for (hname, key), buckets in by_series.items():
+            def le_key(le):
+                return float("inf") if le == "+Inf" else float(le)
+            ordered = sorted(buckets, key=lambda b: le_key(b[0]))
+            values = [v for _, v in ordered]
+            assert values == sorted(values), \
+                f"{hname}{key}: buckets not cumulative-monotone"
+            assert ordered[-1][0] == "+Inf"
+            assert ordered[-1][1] == counts[(hname, key)], \
+                f"{hname}{key}: le=+Inf bucket != count"
+
+    def test_counter_get_is_locked_and_total_sums_subsets(self):
+        c = Counter("greptimedb_tpu_test_total", "t")
+        c.inc(point="p", node="a")
+        c.inc(point="p", node="b")
+        c.inc(2.0, point="q")
+        assert c.get(point="p", node="a") == 1.0
+        assert c.get(point="p") == 0.0  # exact-match get
+        assert c.total(point="p") == 2.0  # subset-match sum
+        assert c.total() == 4.0
+
+
+# ---- span piggyback primitives ---------------------------------------------
+
+
+class TestSpanPiggyback:
+    def test_collect_spans_captures_only_inner(self):
+        with tracing.span("outer_before"):
+            pass
+        with tracing.collect_spans() as sink:
+            with tracing.span("inner", rows=3):
+                pass
+        assert [s.name for s in sink] == ["inner"]
+        assert sink[0].attrs == {"rows": 3}
+
+    def test_span_yields_mutable_attrs(self):
+        with tracing.collect_spans() as sink:
+            with tracing.span("scan") as attrs:
+                attrs["rows"] = 42
+        assert sink[0].attrs["rows"] == 42
+
+    def test_wire_round_trip_tags_node(self):
+        tid = tracing.set_trace(None)
+        with tracing.collect_spans() as sink:
+            with tracing.span("region_scan", region=7):
+                pass
+        wire = json.loads(json.dumps(tracing.spans_to_wire(sink)))
+        tracing.set_trace(None)  # a different local trace
+        merged = tracing.merge_spans(wire, node="dn-1")
+        assert len(merged) == 1
+        assert merged[0].node == "dn-1"
+        assert merged[0].trace_id == tracing.current_trace_id()
+        assert merged[0].trace_id != tid
+        assert tracing.spans_for(merged[0].trace_id)[0].attrs == {"region": 7}
+
+    def test_merge_dedupes_same_process_spans(self):
+        tracing.set_trace(None)
+        with tracing.collect_spans() as sink:
+            with tracing.span("region_scan"):
+                pass
+        wire = tracing.spans_to_wire(sink)
+        # the 'remote' handler shared this ring (in-process wire mode):
+        # merging its piggyback must not double-report
+        assert tracing.merge_spans(wire, node="dn-0") == []
+
+    def test_propagate_carries_trace_and_sink_across_threads(self):
+        from concurrent.futures import ThreadPoolExecutor
+
+        tid = tracing.set_trace(None)
+        with tracing.collect_spans() as sink:
+            def work(i):
+                with tracing.span(f"job{i}"):
+                    return tracing.current_trace_id()
+            with ThreadPoolExecutor(max_workers=2) as pool:
+                tids = list(pool.map(tracing.propagate(work), range(2)))
+        assert tids == [tid, tid]
+        assert sorted(s.name for s in sink) == ["job0", "job1"]
+
+
+# ---- trace-id log correlation ----------------------------------------------
+
+
+class TestTraceLogFilter:
+    def test_filter_stamps_current_trace(self):
+        filt = tracing.TraceIdFilter()
+        rec = logging.LogRecord("x", logging.INFO, "f", 1, "m", (), None)
+        tid = tracing.set_trace(None)
+        assert filt.filter(rec) is True
+        assert rec.trace_id == tid
+        tracing.restore_trace(None)
+        rec2 = logging.LogRecord("x", logging.INFO, "f", 1, "m", (), None)
+        filt.filter(rec2)
+        assert rec2.trace_id == "-"
+
+    def test_install_is_idempotent(self):
+        h = logging.StreamHandler()
+        root = logging.getLogger()
+        root.addHandler(h)
+        try:
+            tracing.install_trace_logging()
+            tracing.install_trace_logging()
+            assert sum(isinstance(f, tracing.TraceIdFilter)
+                       for f in h.filters) == 1
+        finally:
+            root.removeHandler(h)
+
+
+# ---- slow-query log ---------------------------------------------------------
+
+
+class TestSlowQueryLog:
+    @pytest.fixture(autouse=True)
+    def _fast_threshold(self, monkeypatch):
+        monkeypatch.setenv("GTPU_SLOW_QUERY_MS", "0.0001")
+        slow_query.clear()
+        yield
+        slow_query.clear()
+
+    def test_records_structured_entry(self, qe):
+        _seed(qe)
+        qe.execute_one("SELECT host, avg(v) FROM cpu GROUP BY host")
+        recs = slow_query.records()
+        sel = [r for r in recs if r.query.startswith("SELECT host")]
+        assert sel, [r.query for r in recs]
+        r = sel[0]
+        assert r.kind == "sql" and r.db == "public"
+        assert r.trace_id != "-" and len(r.trace_id) == 16
+        assert r.rows == 4
+        assert r.execution_path  # device path name
+        assert r.duration_ms >= 0
+        assert any(name == "scan" for _, name, _ in r.stages)
+        assert slow_query.records(1)[0] is recs[0]  # newest first
+
+    def test_threshold_disables_at_zero(self, qe, monkeypatch):
+        monkeypatch.setenv("GTPU_SLOW_QUERY_MS", "0")
+        _seed(qe)
+        qe.execute_one("SELECT count(*) FROM cpu")
+        assert slow_query.records() == []
+
+    def test_slow_failure_still_recorded(self, qe):
+        _seed(qe)
+        with pytest.raises(Exception):
+            qe.execute_one("SELECT nope FROM cpu")
+        assert any("nope" in r.query for r in slow_query.records())
+
+    def test_information_schema_surface(self, qe):
+        _seed(qe)
+        qe.execute_one("SELECT host, avg(v) FROM cpu GROUP BY host")
+        r = qe.execute_one(
+            "SELECT kind, query, duration_ms, rows, stages FROM "
+            "information_schema.slow_queries WHERE kind = 'sql'")
+        assert r.num_rows >= 1
+        assert any("GROUP BY" in row[1] for row in r.rows())
+
+    def test_promql_entry_records_once(self, qe):
+        from greptimedb_tpu.promql.engine import PromqlEngine
+
+        _seed(qe)
+        PromqlEngine(qe).eval_matrix("cpu", 0.0, 10.0, 1.0,
+                                     QueryContext())
+        kinds = [r.kind for r in slow_query.records()]
+        assert kinds.count("promql") == 1
+
+    def test_tql_records_as_sql_not_twice(self, qe):
+        _seed(qe)
+        qe.execute_one("TQL EVAL (0, 10, '1s') cpu")
+        recs = [r for r in slow_query.records() if "TQL" in r.query
+                or r.kind == "promql"]
+        assert len(recs) == 1 and recs[0].kind == "sql"
+
+    def test_ring_is_bounded(self, qe):
+        slow_query.configure(ring_size=4)
+        try:
+            _seed(qe)
+            for i in range(8):
+                qe.execute_one(f"SELECT count(*) + {i} FROM cpu")
+            assert len(slow_query.records()) == 4
+        finally:
+            slow_query.configure(ring_size=slow_query.DEFAULT_RING)
+
+    def test_http_debug_route(self, qe):
+        from greptimedb_tpu.servers import HttpServer
+
+        _seed(qe)
+        qe.execute_one("SELECT count(*) FROM cpu")
+        srv = HttpServer(qe, port=0)
+        port = srv.start()
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/v1/slow_queries?limit=5") as resp:
+                out = json.loads(resp.read())
+        finally:
+            srv.stop()
+        assert out["threshold_ms"] == pytest.approx(0.0001)
+        assert out["slow_queries"]
+        rec = out["slow_queries"][0]
+        assert {"trace_id", "kind", "query", "duration_ms",
+                "stages"} <= set(rec)
+
+
+# ---- TPU runtime telemetry --------------------------------------------------
+
+
+class TestDeviceTelemetry:
+    def test_metrics_nonzero_after_query(self, qe):
+        """Acceptance: /metrics exposes XLA compile, device-memory, and
+        device-cache hit/miss series with nonzero values after a query."""
+        _seed(qe)
+        q = "SELECT host, avg(v) FROM cpu GROUP BY host"
+        qe.execute_one(q)
+        qe.execute_one(q)  # second run: cache hits
+        text = REGISTRY.render()
+        samples = {(n, tuple(sorted(l.items()))): v
+                   for n, l, v in _parse_exposition(text)}
+
+        def total(name, **labels):
+            want = set(labels.items())
+            return sum(v for (n, key), v in samples.items()
+                       if n == name and want <= set(key))
+
+        assert total("greptimedb_tpu_xla_compile_total") > 0
+        assert total("greptimedb_tpu_xla_compile_duration_seconds_count") > 0
+        assert total("greptimedb_tpu_device_memory_bytes", kind="in_use") > 0
+        assert total("greptimedb_tpu_device_cache_events_total",
+                     event="hit") > 0
+        assert total("greptimedb_tpu_device_cache_events_total",
+                     event="miss") > 0
+        assert total("greptimedb_tpu_device_transfer_bytes_total",
+                     direction="h2d") > 0
+        assert total("greptimedb_tpu_device_transfer_bytes_total",
+                     direction="d2h") > 0
+
+    def test_cache_eviction_counted(self):
+        import jax.numpy as jnp
+
+        from greptimedb_tpu.query.device_cache import DeviceCache
+
+        before = DEVICE_CACHE_EVENTS.get(event="evict")
+        c = DeviceCache(budget_bytes=100)
+        c.get(("a",), lambda: jnp.ones(10, jnp.float64))  # 80 bytes
+        c.get(("b",), lambda: jnp.ones(10, jnp.float64))  # evicts a
+        assert DEVICE_CACHE_EVENTS.get(event="evict") >= before + 1
+
+
+# ---- metasrv.kv fault point -------------------------------------------------
+
+
+class TestMetasrvKvFault:
+    def test_injected_fault_surfaces_and_counts(self, tmp_path):
+        from greptimedb_tpu.fault import FAULTS, Fault
+        from greptimedb_tpu.meta.kv_service import (HttpKv,
+                                                    MetaHttpService,
+                                                    MetaServiceError)
+        from greptimedb_tpu.meta.metasrv import Metasrv
+        from greptimedb_tpu.utils.metrics import FAULT_INJECTIONS
+
+        service = MetaHttpService(Metasrv(MemoryKv()), port=0)
+        service.start()
+        try:
+            kv = HttpKv(service.addr)
+            kv.put("k", "v")
+            before = FAULT_INJECTIONS.total(point="metasrv.kv")
+            FAULTS.arm("metasrv.kv", Fault(kind="fail", nth=1, times=1))
+            with pytest.raises(MetaServiceError):
+                kv.get("k")
+            # the schedule is spent: the plane recovers
+            assert kv.get("k") == "v"
+            assert FAULT_INJECTIONS.get(point="metasrv.kv", kind="fail",
+                                        op="get") >= 1
+            assert FAULT_INJECTIONS.total(point="metasrv.kv") == before + 1
+        finally:
+            FAULTS.disarm("metasrv.kv")
+            service.stop()
+
+    def test_op_targeted_fault_skips_other_ops(self, tmp_path):
+        from greptimedb_tpu.fault import FAULTS, Fault
+        from greptimedb_tpu.meta.kv_service import (HttpKv,
+                                                    MetaHttpService,
+                                                    MetaServiceError)
+        from greptimedb_tpu.meta.metasrv import Metasrv
+
+        service = MetaHttpService(Metasrv(MemoryKv()), port=0)
+        service.start()
+        try:
+            FAULTS.arm("metasrv.kv",
+                       Fault(kind="fail", match={"op": "cas"}))
+            kv = HttpKv(service.addr)
+            kv.put("a", "1")          # not cas: passes
+            assert kv.get("a") == "1"
+            with pytest.raises(MetaServiceError):
+                kv.compare_and_put("a", "1", "2")
+        finally:
+            FAULTS.disarm("metasrv.kv")
+            service.stop()

@@ -44,6 +44,31 @@ def test_sql_over_process_boundary(cluster):
     assert r.rows() == [["a", 1.0], ["b", 2.0]]
 
 
+def test_explain_analyze_shows_datanode_spans(cluster):
+    """Acceptance (ISSUE 2): on a 2-datanode ProcessCluster, the
+    datanode's spans ride BACK over Flight and EXPLAIN ANALYZE
+    attributes at least one region_scan to its real child process —
+    before the piggyback, datanode spans died in the child's local ring
+    and distributed ANALYZE reported only frontend time."""
+    cluster.beat_all(time.time() * 1000)
+    cluster.sql(CREATE)
+    cluster.sql("INSERT INTO m VALUES ('a', 1.0, 1000), ('b', 2.0, 2000)")
+    r = cluster.sql("EXPLAIN ANALYZE SELECT host, v FROM m ORDER BY host")
+    lines = [row[0] for row in r.rows()]
+    text = "\n".join(lines)
+    assert "ANALYZE trace=" in text
+    # a [dn-N] section exists and contains the datanode-side scan span
+    node_headers = [ln for ln in lines if ln.strip().startswith("[dn-")]
+    assert node_headers, text
+    node = node_headers[0].strip().strip("[]")
+    idx = lines.index(node_headers[0])
+    section = "\n".join(lines[idx:])
+    assert "region_scan" in section, text
+    assert node in ("dn-0", "dn-1")
+    # scan stats piggybacked with the span
+    assert "rows=" in section, text
+
+
 def test_kill9_failover_replays_remote_wal(cluster):
     """kill -9 the owning datanode with UNFLUSHED writes; failover must
     reopen the region on the survivor and replay them from the shared

@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""Metrics lint (run as a tier-1 test, tests/test_check_metrics.py):
+every metric registered on the process-wide REGISTRY must
+
+- carry the `greptimedb_tpu_` prefix (one namespace at /metrics — an
+  unprefixed name collides with whatever else the operator scrapes),
+- have non-empty help text (`# HELP` is the only documentation a scrape
+  consumer gets), and
+- appear in grafana/greptimedb_tpu.json (a metric nobody charts is a
+  metric nobody watches; the dashboard ships with the repo like the
+  reference's grafana/greptimedb.json).
+
+Exit code 0 = clean; 1 = violations (printed one per line).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+PREFIX = "greptimedb_tpu_"
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DASHBOARD = os.path.join(REPO_ROOT, "grafana", "greptimedb_tpu.json")
+
+
+#: every module that registers metrics on the process-wide REGISTRY —
+#: imported so the lint sees the full surface, not just utils.metrics
+METRIC_MODULES = (
+    "greptimedb_tpu.utils.metrics",
+    "greptimedb_tpu.objectstore",
+    "greptimedb_tpu.servers.otlp",
+    "greptimedb_tpu.servers.prom_store",
+)
+
+
+def registered_metrics():
+    """Import the metric-defining modules and return the live registry
+    contents (importing the query layer would drag jax in for
+    nothing)."""
+    import importlib
+
+    sys.path.insert(0, REPO_ROOT)
+    for mod in METRIC_MODULES:
+        importlib.import_module(mod)
+    from greptimedb_tpu.utils.metrics import REGISTRY
+
+    return list(REGISTRY._metrics)
+
+
+def check(metrics, dashboard_text: str) -> list[str]:
+    problems = []
+    seen = set()
+    for m in metrics:
+        if m.name in seen:
+            problems.append(f"{m.name}: registered twice")
+        seen.add(m.name)
+        if not m.name.startswith(PREFIX):
+            problems.append(
+                f"{m.name}: missing the {PREFIX!r} namespace prefix")
+        if not (m.help or "").strip():
+            problems.append(f"{m.name}: empty help text")
+        if m.name not in dashboard_text:
+            problems.append(
+                f"{m.name}: not referenced by any panel in "
+                f"grafana/greptimedb_tpu.json")
+    return problems
+
+
+def main() -> int:
+    with open(DASHBOARD) as f:
+        dashboard_text = f.read()
+    json.loads(dashboard_text)  # the dashboard must at least be valid JSON
+    problems = check(registered_metrics(), dashboard_text)
+    for p in problems:
+        print(f"check_metrics: {p}")
+    if problems:
+        print(f"check_metrics: {len(problems)} problem(s)")
+        return 1
+    print("check_metrics: ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
