@@ -215,6 +215,49 @@ def _slow_queries(qe, ctx):
     return cols
 
 
+@_virtual("cluster_faults")
+def _cluster_faults(qe, ctx):
+    """Armed chaos state + fire counts (fault/ package): one row per
+    (armed point × observed counter series), so a chaos run can SELECT
+    which node/edge a schedule actually hit, plus one row per installed
+    network partition. Empty when chaos is off — the debuggability
+    surface for 'the scenario is red, what was armed and what fired?'."""
+    from greptimedb_tpu.fault import FAULTS, chaos_seed
+    from greptimedb_tpu.utils.metrics import FAULT_INJECTIONS
+
+    cols = {k: [] for k in ("point", "kind", "schedule", "matchers",
+                            "edge", "node", "fires", "chaos_seed")}
+    seed = chaos_seed()
+
+    def add(point, kind, schedule, matchers, edge, node, fires):
+        cols["point"].append(point)
+        cols["kind"].append(kind)
+        cols["schedule"].append(schedule)
+        cols["matchers"].append(matchers)
+        cols["edge"].append(edge)
+        cols["node"].append(node)
+        cols["fires"].append(fires)
+        cols["chaos_seed"].append(seed)
+
+    for f in FAULTS.describe():
+        matchers = ",".join(f"{k}:{v}" for k, v in sorted(f["match"].items()))
+        edges = f["edges"] or [""]
+        fired = FAULT_INJECTIONS.series(point=f["point"], kind=f["kind"])
+        if not fired:
+            for edge in edges:
+                add(f["point"], f["kind"], f["schedule"], matchers, edge,
+                    "", 0.0)
+            continue
+        for labels, count in fired:
+            add(f["point"], f["kind"], f["schedule"], matchers,
+                labels.get("edge", edges[0]), labels.get("node", ""),
+                count)
+    for edge in FAULTS.partitions():
+        add("partition", "partition", "installed", "", edge, "",
+            FAULT_INJECTIONS.total(kind="partition", edge=edge))
+    return cols
+
+
 @_virtual("engines")
 def _engines(qe, ctx):
     names = ["mito", "metric", "file"]

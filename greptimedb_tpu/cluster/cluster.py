@@ -93,7 +93,8 @@ class Datanode:
             self.server = FlightServer(None, port=0,
                                        region_engine=self.engine,
                                        node_id=node_id)
-            self.remote = RemoteRegionEngine(f"127.0.0.1:{self.server.port}")
+            self.remote = RemoteRegionEngine(f"127.0.0.1:{self.server.port}",
+                                             peer=node_id)
 
     def data_engine(self):
         """What the frontend router talks to: the Flight client in wire
@@ -394,6 +395,12 @@ class Cluster:
             node_id = f"dn-{i}"
             self.datanodes[node_id] = Datanode(node_id, shared, self.metasrv,
                                                wire=wire_transport)
+        # topology for the fault layer: per-edge specs naming a node
+        # outside this set are typos and fail at arm time. The
+        # coordinator is registered under its REAL node id (the identity
+        # heartbeat/kv edges carry), not a role alias that never matches
+        FAULTS.register_nodes([*self.datanodes, "frontend",
+                               self.metasrv.node_id])
         self.router = RegionRouter(self.metasrv, self.datanodes)
         self.catalog = Catalog(self.kv)
         # distributed DDL runs as journaled procedures on the metasrv's

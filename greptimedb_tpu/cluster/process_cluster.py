@@ -84,7 +84,10 @@ class ProcDatanode:
                     # child mid-write): not ready yet, keep polling
                     time.sleep(0.05)
                     continue
-                self.remote = RemoteRegionEngine(f"127.0.0.1:{port}")
+                # peer identity makes every RPC an edge the fault layer
+                # can cut: (frontend, <this node>) partitions
+                self.remote = RemoteRegionEngine(f"127.0.0.1:{port}",
+                                                 peer=self.node_id)
                 return
             time.sleep(0.05)
         raise TimeoutError(f"datanode {self.node_id} did not come up")
@@ -118,20 +121,41 @@ class ProcessCluster:
 
     def __init__(self, data_dir: str, num_datanodes: int = 3,
                  kv: Optional[KvBackend] = None,
-                 opts: Optional[MetasrvOptions] = None):
+                 opts: Optional[MetasrvOptions] = None,
+                 election=None, metasrv_node_id: str = "metasrv-0"):
         self.kv = kv or MemoryKv()
-        self.metasrv = Metasrv(self.kv, opts)
+        # an attached election makes the parent metasrv one HA candidate
+        # among peers over the shared KV — the lease-loss chaos scenarios
+        # run a standby Metasrv beside it and force re-election
+        self.metasrv = Metasrv(self.kv, opts, node_id=metasrv_node_id,
+                               election=election)
         self.run_dir = os.path.join(data_dir, "run")
         os.makedirs(self.run_dir, exist_ok=True)
         shared = os.path.join(data_dir, "shared")
         os.makedirs(shared, exist_ok=True)
         self.datanodes: dict[str, ProcDatanode] = {}
-        for i in range(num_datanodes):
-            node_id = f"dn-{i}"
-            self.datanodes[node_id] = ProcDatanode(node_id, shared,
-                                                   self.run_dir)
-        for dn in self.datanodes.values():
-            dn.wait_ready()
+        try:
+            for i in range(num_datanodes):
+                node_id = f"dn-{i}"
+                self.datanodes[node_id] = ProcDatanode(node_id, shared,
+                                                       self.run_dir)
+            for dn in self.datanodes.values():
+                dn.wait_ready()
+        except BaseException:
+            # a failed bring-up (startup timeout, chaos hitting a boot
+            # path) must not orphan the children already spawned — the
+            # caller never gets a handle to close them
+            for dn in self.datanodes.values():
+                try:
+                    dn.close()
+                except Exception:  # noqa: BLE001 — best-effort reap
+                    pass
+            raise
+        # topology for the fault layer's per-edge typo guard: the
+        # coordinator under its real node id (what heartbeat/kv edges
+        # carry), never a role alias that would validate but not match
+        FAULTS.register_nodes([*self.datanodes, "frontend",
+                               metasrv_node_id])
         self.router = RegionRouter(self.metasrv, self.datanodes)
         self.catalog = Catalog(self.kv)
         from ..meta.ddl import DdlManager
@@ -156,10 +180,14 @@ class ProcessCluster:
                                             table=route.table))
         return stats
 
-    def beat_all(self, now_ms: Optional[float] = None) -> None:
+    def beat_all(self, now_ms: Optional[float] = None,
+                 metasrv: Optional[Metasrv] = None) -> None:
         """Heartbeat the metasrv for every child whose PROCESS is alive,
-        applying returned instructions over the wire."""
+        applying returned instructions over the wire. `metasrv` overrides
+        the target coordinator — the HA scenarios beat whichever peer
+        currently holds the election lease."""
         now_ms = now_ms if now_ms is not None else time.time() * 1000
+        target = metasrv if metasrv is not None else self.metasrv
         for node_id, dn in self.datanodes.items():
             if not dn.alive:
                 continue
@@ -169,10 +197,14 @@ class ProcessCluster:
                 dn.kill()  # the chaos schedule SIGKILLs this child now
                 continue
             try:
-                FAULTS.fire("heartbeat.send", node=node_id)
+                # src/dst: a (node, <metasrv id>) partition silences
+                # this one — dst names the coordinator actually targeted
+                # so HA scenarios can cut a node from ONE metasrv peer
+                FAULTS.fire("heartbeat.send", node=node_id,
+                            src=node_id, dst=target.node_id)
             except FaultError:
                 continue  # dropped: the metasrv never hears this beat
-            resp = self.metasrv.handle_heartbeat(
+            resp = target.handle_heartbeat(
                 HeartbeatRequest(node_id=node_id,
                                  region_stats=self._region_stats_for(
                                      node_id),
@@ -190,8 +222,9 @@ class ProcessCluster:
             dn.remote.handle_request(
                 RegionRequest(RequestType.CLOSE, inst.region_id))
 
-    def tick(self, now_ms: Optional[float] = None) -> list[str]:
-        return self.metasrv.tick(now_ms)
+    def tick(self, now_ms: Optional[float] = None,
+             metasrv: Optional[Metasrv] = None) -> list[str]:
+        return (metasrv if metasrv is not None else self.metasrv).tick(now_ms)
 
     def sql(self, sql: str, db: str = "public"):
         return self.frontend.execute_one(sql, QueryContext(db=db))

@@ -25,12 +25,31 @@ testing"):
     heartbeat.send     datanode.crash
     metasrv.kv         (KV ops over the kv_service HTTP seam; per-op
                         targeting via @op:get|put|cas|range|delete|watch)
+    election.lease     (forced lease expiry in meta/election.py)
 
 Arming is programmatic (`FAULTS.arm("wal.append", Fault(...))`) or via
 env so child datanode processes inherit the schedule:
 
     GTPU_CHAOS="objectstore.read=fail,nth:3;flight.do_get=latency,arg:0.05,prob:0.5"
     GTPU_CHAOS_SEED=42
+
+Network partitions (Jepsen-style nemesis): faults can be scoped to a
+(src-node, dst-node) EDGE on the points that cross a process boundary
+(`flight.do_get`/`do_put`, `heartbeat.send`, `metasrv.kv`), and the
+registry holds partition state installable without arming a schedule:
+
+    FAULTS.install_partition("frontend", "dn-1")      # symmetric
+    FAULTS.heal_partition("frontend", "dn-1")
+    GTPU_CHAOS="partition=frontend<->dn-1"            # same, via env
+    GTPU_CHAOS="heartbeat.send=fail,@edge:dn-1->metasrv-0"  # asymmetric
+
+(coordinator-bound edges name the metasrv's real node id — default
+"metasrv-0" — so HA runs can cut a node from ONE metasrv peer)
+
+Every partitioned call raises a transient FaultError(kind="partition")
+and counts under `fault_injections_total{kind="partition",edge="a->b"}`
+— the retry + degradation layers treat it exactly like a dropped
+packet.
 
 Every probabilistic schedule draws from its own `random.Random` seeded
 by `GTPU_CHAOS_SEED` (xor'd with the crc32 of the point name at arm
@@ -67,10 +86,20 @@ POINTS = frozenset({
     # matrix): fired per dispatched op with an `op` label, so chaos runs
     # can target (and count) get/put/cas/range/delete independently
     "metasrv.kv",
+    # election lease loss (meta/election.py): a fired fault force-expires
+    # the held lease so elections churn under test (GC-pause analog)
+    "election.lease",
 })
 
-#: fault kinds a schedule can produce
-KINDS = frozenset({"fail", "latency", "torn", "short_read"})
+#: points that cross a process boundary and therefore have a peer: the
+#: only points a (src, dst) edge matcher or a partition can apply to
+EDGE_POINTS = frozenset({
+    "flight.do_get", "flight.do_put", "heartbeat.send", "metasrv.kv",
+})
+
+#: fault kinds a schedule can produce ("partition" is registry state,
+#: not an armable schedule kind — see install_partition)
+KINDS = frozenset({"fail", "latency", "torn", "short_read", "enospc"})
 
 
 def chaos_seed() -> int:
@@ -82,11 +111,43 @@ def chaos_seed() -> int:
         return 0
 
 
+def local_node() -> str:
+    """This process's node identity for edge-scoped faults: datanode
+    children carry GTPU_NODE_ID (stamped at spawn); the parent process
+    plays the frontend role."""
+    return os.environ.get("GTPU_NODE_ID") or "frontend"
+
+
+def _parse_edge(spec: str) -> list[tuple[str, str]]:
+    """'a->b' (asymmetric) or 'a<->b' (symmetric) → directed edge list
+    (a symmetric spec is simply both directions)."""
+    if "<->" in spec:
+        a, _, b = spec.partition("<->")
+        sym = True
+    elif "->" in spec:
+        a, _, b = spec.partition("->")
+        sym = False
+    else:
+        raise ValueError(f"bad edge spec {spec!r} (want 'a->b' or 'a<->b')")
+    a, b = a.strip(), b.strip()
+    if not a or not b:
+        raise ValueError(f"bad edge spec {spec!r}: empty endpoint")
+    if any("," in e or "->" in e for e in (a, b)):
+        # "partition=a<->b,c" or "a->b<->c" would otherwise install an
+        # inert cut whose endpoint literally contains the junk — a
+        # malformed spec must raise, never yield a meaningless green run
+        raise ValueError(
+            f"bad edge spec {spec!r}: one edge per entry "
+            "(separate entries with ';')")
+    return [(a, b), (b, a)] if sym else [(a, b)]
+
+
 class FaultError(Exception):
     """An injected fault. `transient=True` faults model retryable I/O
-    errors; torn writes are non-transient (they model a crash mid-write —
-    the bytes are already partially down, a retry is not what a dead
-    process does)."""
+    errors (including partition drops — a healed cut makes the retry
+    meaningful); torn writes and enospc are non-transient (a crash
+    mid-write already put partial bytes down; a full disk does not
+    un-fill itself inside a retry budget)."""
 
     def __init__(self, point: str, kind: str = "fail",
                  transient: bool = True):
@@ -116,6 +177,10 @@ class Fault:
     #: targeting, e.g. {"node": "dn-1"} drops ONE node's heartbeats);
     #: non-matching calls do not consume the schedule
     match: Optional[dict] = None
+    #: only fire on these directed (src, dst) edges — faults scoped to a
+    #: node PAIR rather than a point (asymmetric/symmetric partitions);
+    #: valid only on EDGE_POINTS, checked at arm time
+    edges: Optional[list] = None
 
     calls: int = field(default=0, init=False)
 
@@ -132,6 +197,17 @@ class Fault:
         self._lock = threading.Lock()
 
     def matches(self, labels: dict) -> bool:
+        if self.edges is not None and \
+                (labels.get("src"), labels.get("dst")) not in self.edges:
+            return False
+        if labels.get("side") == "server" and \
+                (not self.match or "side" not in self.match):
+            # a flight.* call now has TWO seams (client RPC + inside the
+            # server's scan span). Schedules without an explicit @side
+            # keep their PR-1 meaning — the client seam only — so
+            # existing nth/prob specs replay call-for-call; @side:server
+            # opts into the in-server seam
+            return False
         return not self.match or all(
             labels.get(k) == v for k, v in self.match.items())
 
@@ -152,7 +228,60 @@ class FaultRegistry:
 
     def __init__(self):
         self._points: dict[str, Fault] = {}
+        #: installed network partitions: directed (src, dst) edges every
+        #: EDGE_POINTS call is checked against, armed schedule or not
+        self._partitions: set = set()
+        #: cluster topology registered by the harnesses — when non-empty,
+        #: edge/@node specs naming an unknown node fail at arm time (the
+        #: typo guard that matches the canonical-point check)
+        self._known_nodes: set = set()
         self._lock = threading.Lock()
+
+    # ---- topology -----------------------------------------------------------
+
+    def register_nodes(self, node_ids) -> None:
+        """Declare the run's node identities (datanodes + 'frontend' +
+        'metasrv' + any metasrv election ids) so per-edge specs are
+        validated against real topology."""
+        with self._lock:
+            self._known_nodes.update(str(n) for n in node_ids)
+
+    def _check_node(self, node: str, what: str) -> None:
+        if self._known_nodes and node not in self._known_nodes:
+            raise ValueError(
+                f"unknown node {node!r} in {what} "
+                f"(known: {sorted(self._known_nodes)})")
+
+    # ---- partitions ----------------------------------------------------------
+
+    def install_partition(self, a: str, b: str,
+                          symmetric: bool = True) -> None:
+        """Sever the network between two nodes: every EDGE_POINTS call
+        whose (src, dst) crosses the cut raises a transient
+        FaultError(kind="partition"). Symmetric by default; pass
+        symmetric=False to cut only the a→b direction."""
+        for n in (a, b):
+            self._check_node(n, "install_partition")
+        with self._lock:
+            self._partitions.add((a, b))
+            if symmetric:
+                self._partitions.add((b, a))
+
+    def heal_partition(self, a: str, b: str,
+                       symmetric: bool = True) -> None:
+        with self._lock:
+            self._partitions.discard((a, b))
+            if symmetric:
+                self._partitions.discard((b, a))
+
+    def heal_partitions(self) -> None:
+        with self._lock:
+            self._partitions.clear()
+
+    def partitions(self) -> list[str]:
+        """Installed cuts as 'src->dst' strings (debug surfaces)."""
+        with self._lock:
+            return sorted(f"{a}->{b}" for a, b in self._partitions)
 
     # ---- arming -------------------------------------------------------------
 
@@ -160,6 +289,16 @@ class FaultRegistry:
         if point not in POINTS:
             raise ValueError(
                 f"unknown injection point {point!r} (have: {sorted(POINTS)})")
+        if fault.edges is not None:
+            if point not in EDGE_POINTS:
+                raise ValueError(
+                    f"point {point!r} has no peer concept — @edge "
+                    f"matchers apply only to {sorted(EDGE_POINTS)}")
+            for a, b in fault.edges:
+                self._check_node(a, f"@edge on {point}")
+                self._check_node(b, f"@edge on {point}")
+        if fault.match and "node" in fault.match:
+            self._check_node(fault.match["node"], f"@node on {point}")
         if fault.seed is None:
             # default seeding decorrelates points (crc32, stable across
             # processes — hash() is salted) while staying replayable
@@ -179,29 +318,64 @@ class FaultRegistry:
     def reset(self) -> None:
         with self._lock:
             self._points.clear()
+            self._partitions.clear()
+            self._known_nodes.clear()
 
     def armed(self, point: str) -> bool:
         return point in self._points
+
+    def describe(self) -> list[dict]:
+        """Armed schedules as dicts (the debug surface behind
+        information_schema.cluster_faults and /v1/faults)."""
+        with self._lock:
+            out = []
+            for point, f in sorted(self._points.items()):
+                sched = "always"
+                if f.nth is not None:
+                    sched = f"nth:{f.nth}" + \
+                        (f",times:{f.times}" if f.times != 1 else "")
+                elif f.prob:
+                    sched = f"prob:{f.prob}"
+                out.append({
+                    "point": point, "kind": f.kind, "schedule": sched,
+                    "arg": f.arg,
+                    "match": dict(f.match) if f.match else {},
+                    "edges": sorted(f"{a}->{b}" for a, b in f.edges)
+                    if f.edges else [],
+                    "calls": f.calls,
+                })
+            return out
 
     def arm_from_env(self, spec: Optional[str] = None) -> None:
         """Parse GTPU_CHAOS and arm each entry. Grammar (`;`-separated):
 
             point=kind[,nth:N][,times:T][,prob:P][,arg:F][,seed:S][,@label:value]
+            partition=a<->b | a->b
 
         `@label:value` tokens restrict the fault to matching call sites
-        (e.g. `heartbeat.send=fail,@node:dn-1`). A malformed spec raises
-        — silently ignoring a chaos schedule would make a green run
-        meaningless."""
+        (e.g. `heartbeat.send=fail,@node:dn-1`); `@edge:a->b` (or
+        `a<->b`) restricts to a node pair on the points that have one.
+        A `partition=` entry installs registry-level partition state —
+        no schedule needed, every matching call drops. A malformed spec
+        raises — silently ignoring a chaos schedule would make a green
+        run meaningless."""
         spec = spec if spec is not None else os.environ.get("GTPU_CHAOS", "")
         for entry in filter(None, (s.strip() for s in spec.split(";"))):
             point, _, rhs = entry.partition("=")
             if not rhs:
                 raise ValueError(f"bad GTPU_CHAOS entry {entry!r}")
+            point = point.strip()
+            if point == "partition":
+                for a, b in _parse_edge(rhs.strip()):
+                    self.install_partition(a, b, symmetric=False)
+                continue
             tokens = [t.strip() for t in rhs.split(",") if t.strip()]
             kw: dict = {"kind": tokens[0]}
             for tok in tokens[1:]:
                 k, _, v = tok.partition(":")
-                if k.startswith("@"):
+                if k == "@edge":
+                    kw["edges"] = _parse_edge(v)
+                elif k.startswith("@"):
                     kw.setdefault("match", {})[k[1:]] = v
                 elif k in ("nth", "times", "seed"):
                     kw[k] = int(v)
@@ -210,56 +384,94 @@ class FaultRegistry:
                 else:
                     raise ValueError(
                         f"bad GTPU_CHAOS token {tok!r} in {entry!r}")
-            self.arm(point.strip(), Fault(**kw))
+            self.arm(point, Fault(**kw))
 
     # ---- firing -------------------------------------------------------------
+
+    @staticmethod
+    def _counter_labels(labels: Optional[dict]) -> dict:
+        """Collapse src/dst into the `edge` label the observability
+        surfaces key on (keeps counter cardinality at #edges, not
+        #src × #dst)."""
+        out = {k: str(v) for k, v in (labels or {}).items()
+               if k not in ("src", "dst")}
+        if labels and "src" in labels and "dst" in labels:
+            out["edge"] = f"{labels['src']}->{labels['dst']}"
+        return out
+
+    def _check_partition(self, point: str, labels: dict) -> None:
+        if not self._partitions or point not in EDGE_POINTS:
+            return
+        edge = (labels.get("src"), labels.get("dst"))
+        if edge in self._partitions:
+            FAULT_INJECTIONS.inc(point=point, kind="partition",
+                                 edge=f"{edge[0]}->{edge[1]}")
+            raise FaultError(point, kind="partition")
 
     def fire(self, point: str, **labels) -> None:
         """Control-path hook: may raise FaultError or sleep. Data-kind
         faults (torn/short_read) armed on a control-only point degrade
         to plain failures. Call-site labels ride into the
         fault_injections counter, so chaos assertions can distinguish
-        e.g. which KV op or node the schedule actually hit."""
+        e.g. which KV op, node, or edge the schedule actually hit."""
+        self._check_partition(point, labels)
         fault = self._points.get(point)  # the one production dict lookup
         if fault is None or not fault.matches(labels):
             return
         self._apply(point, fault, labels)
 
     def mangle(self, point: str, data: bytes,
-               **labels) -> tuple[bytes, bool]:
-        """Data-path hook: returns (possibly truncated bytes, fail_after).
-        `fail_after=True` means the caller must surface an error AFTER
+               **labels) -> tuple[bytes, Optional[str]]:
+        """Data-path hook: returns (possibly truncated bytes, fail_kind).
+        fail_kind "torn" means the caller must surface an error AFTER
         persisting the mangled bytes — the torn-write shape: partial
-        bytes down, no acknowledgement. `@label` matchers apply here the
+        bytes down, no acknowledgement. fail_kind "enospc" means the
+        device is full: partial bytes may reach STAGING but must never
+        become the durable object (mangled_write routes them through the
+        caller's cleanup path). `@label`/`@edge` matchers apply here the
         same as in fire(): a non-matching call neither fires nor
         consumes the schedule."""
         fault = self._points.get(point)
         if fault is None or not fault.matches(labels):
-            return data, False
+            return data, None
         if not fault.should_fire():
-            return data, False
-        FAULT_INJECTIONS.inc(point=point, kind=fault.kind)
+            return data, None
+        FAULT_INJECTIONS.inc(point=point, kind=fault.kind,
+                             **self._counter_labels(labels))
         if fault.kind == "latency":
             time.sleep(fault.arg)
-            return data, False
+            return data, None
         if fault.kind == "fail":
             raise FaultError(point)
         keep = max(0, min(len(data),
                           int(len(data) * (fault.arg or 0.5))))
         if fault.kind == "torn":
-            return data[:keep], True
-        return data[:keep], False  # short_read: silent truncation
+            return data[:keep], "torn"
+        if fault.kind == "enospc":
+            return data[:keep], "enospc"
+        return data[:keep], None  # short_read: silent truncation
 
     def mangled_write(self, point: str, data: bytes, sink,
-                      **labels) -> None:
+                      spill=None, **labels) -> None:
         """The shared data-path WRITE template: mangle, hand the
         (possibly truncated) bytes to `sink`, then surface the torn-write
         error — partial bytes persisted, call unacknowledged,
         non-retryable. Every durable-write seam (object store, local WAL,
-        remote WAL) goes through here so torn semantics stay identical."""
-        mangled, fail_after = self.mangle(point, data, **labels)
+        remote WAL) goes through here so torn semantics stay identical.
+
+        enospc (disk full mid-write) differs from torn in WHERE the
+        partial bytes land: they reach the seam's staging area via
+        `spill(partial)` — an appended file tail, a tmp object — and the
+        caller's crash-consistency path must erase them before the error
+        surfaces (chaos tests verify no partial file survives). With no
+        spill hook, nothing is persisted at all (atomic backends)."""
+        mangled, fail_kind = self.mangle(point, data, **labels)
+        if fail_kind == "enospc":
+            if spill is not None:
+                spill(mangled)
+            raise FaultError(point, kind="enospc", transient=False)
         sink(mangled)
-        if fail_after or len(mangled) < len(data):
+        if fail_kind or len(mangled) < len(data):
             # ANY truncation of a durable write must surface: silently
             # acknowledging short bytes (e.g. short_read armed on a
             # write seam) would be acknowledged-write loss by design
@@ -269,9 +481,9 @@ class FaultRegistry:
         """The shared data-path READ template: a torn fault on a read
         means the bytes came back partial AND the error must surface —
         never silently serve the truncated data (that is `short_read`)."""
-        mangled, fail_after = self.mangle(point, data, **labels)
-        if fail_after:
-            raise FaultError(point, kind="torn", transient=False)
+        mangled, fail_kind = self.mangle(point, data, **labels)
+        if fail_kind:  # torn or enospc: never serve partial bytes
+            raise FaultError(point, kind=fail_kind, transient=False)
         return mangled
 
     def _apply(self, point: str, fault: Fault,
@@ -279,12 +491,12 @@ class FaultRegistry:
         if not fault.should_fire():
             return
         FAULT_INJECTIONS.inc(point=point, kind=fault.kind,
-                             **{k: str(v) for k, v in (labels or {}).items()})
+                             **self._counter_labels(labels))
         if fault.kind == "latency":
             time.sleep(fault.arg)
             return
         raise FaultError(point, kind=fault.kind,
-                         transient=fault.kind != "torn")
+                         transient=fault.kind not in ("torn", "enospc"))
 
 
 def is_transient(exc: BaseException) -> bool:

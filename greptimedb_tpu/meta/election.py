@@ -23,6 +23,7 @@ import time
 from typing import Callable, Optional
 
 from ..catalog.kv import KvBackend
+from ..fault import FAULTS, FaultError
 
 ELECTION_KEY = "__meta_election/leader"
 CANDIDATES_ROOT = "__meta_election/candidates/"
@@ -50,6 +51,30 @@ class KvElection:
         self._is_leader = False
         self._lease_until_ms = 0.0
         self._watchers: list[Callable[[str, str], None]] = []
+        #: injectable clock skew (chaos): this node's view of "now" is
+        #: shifted by this many ms — a skewed-forward node believes
+        #: leases (its own included) expire early and churns elections,
+        #: the Jepsen clock nemesis
+        self.clock_skew_ms = 0.0
+
+    def _resolve_now(self, now_ms: Optional[float]) -> float:
+        now_ms = now_ms if now_ms is not None else time.time() * 1000
+        return now_ms + self.clock_skew_ms
+
+    def _lease_chaos(self) -> bool:
+        """The election.lease fault point: a fired fault force-expires
+        the held lease (models a GC pause / lost keep-alive stream —
+        etcd would count the lease down server-side while this process
+        was stalled). Returns True when the campaign round is lost."""
+        try:
+            FAULTS.fire("election.lease", node=self.node_id)
+        except FaultError:
+            # resign has exactly the forced-expiry semantics: zero the
+            # lease on the KV so any candidate's next campaign takes
+            # over immediately, and step down locally
+            self.resign()
+            return True
+        return False
 
     # ------------------------------------------------------------ watchers
     def subscribe(self, fn: Callable[[str, str], None]) -> None:
@@ -66,8 +91,9 @@ class KvElection:
         return json.loads(raw) if raw is not None else None
 
     def leader(self, now_ms: Optional[float] = None) -> Optional[str]:
-        """Current leader's node id, or None if the lease lapsed."""
-        now_ms = now_ms if now_ms is not None else time.time() * 1000
+        """Current leader's node id, or None if the lease lapsed (as
+        judged by THIS node's possibly-skewed clock)."""
+        now_ms = self._resolve_now(now_ms)
         cur = self._read()
         if cur is None or now_ms > cur["lease_until_ms"]:
             return None
@@ -87,24 +113,33 @@ class KvElection:
     def campaign(self, now_ms: Optional[float] = None) -> bool:
         """Try to acquire or renew leadership; returns is-leader after.
         Fires 'elected' on acquisition and 'step_down' on loss."""
-        now_ms = now_ms if now_ms is not None else time.time() * 1000
+        now_ms = self._resolve_now(now_ms)
+        if self._lease_chaos():
+            return False
+        return self._campaign(now_ms)
+
+    def _campaign(self, now_ms: float) -> bool:
         value = json.dumps(
             {"node": self.node_id, "lease_until_ms": now_ms + self.lease_s * 1000}
         )
         raw = self.kv.get(ELECTION_KEY)
         cur = json.loads(raw) if raw is not None else None
+        was = self._is_leader
         won = False
         renewal = False
         if cur is None:
             won = self.kv.compare_and_put(ELECTION_KEY, None, value)
         elif cur["node"] == self.node_id:
             # renewal must CAS against the exact value we hold: if another
-            # node took over and we missed it, the CAS fails and we step down
+            # node took over and we missed it, the CAS fails and we step
+            # down. Only a renewal while we still believed we led:
+            # re-acquiring our own ZEROED lease (resign / chaos-forced
+            # expiry) is a genuine new term and must re-fire 'elected' so
+            # the leader-only bootstrap re-runs
             won = self.kv.compare_and_put(ELECTION_KEY, raw, value)
-            renewal = won
+            renewal = won and was
         elif now_ms > cur["lease_until_ms"]:
             won = self.kv.compare_and_put(ELECTION_KEY, raw, value)
-        was = self._is_leader
         self._is_leader = won
         self._lease_until_ms = now_ms + self.lease_s * 1000 if won else 0.0
         # 'elected' fires on every genuine acquisition — including a former
@@ -123,11 +158,15 @@ class KvElection:
         whose value changes (on FileKv: a full-store rewrite + fsync), so
         calling campaign() per heartbeat would turn keep-alive into the
         dominant I/O load; this bounds it to ~2 writes per lease."""
-        now_ms = now_ms if now_ms is not None else time.time() * 1000
+        now_ms = self._resolve_now(now_ms)
+        if self._lease_chaos():
+            # forced expiry applies even mid-lease: the short-circuit
+            # below must not shield a stalled leader from losing it
+            return False
         if self._is_leader and \
                 now_ms < self._lease_until_ms - self.lease_s * 500:
             return True
-        return self.campaign(now_ms)
+        return self._campaign(now_ms)
 
     def resign(self) -> None:
         """Voluntarily release leadership (etcd.rs resign): zero the lease

@@ -60,7 +60,14 @@ class HeartbeatTask:
     def beat(self, now_ms: Optional[float] = None) -> Optional[HeartbeatResponse]:
         now_ms = now_ms if now_ms is not None else time.time() * 1000
         try:
-            FAULTS.fire("heartbeat.send", node=self.node_id)
+            # src/dst make this an edge: a (node, <metasrv id>) partition
+            # drops exactly this node's beats — dst is the REAL
+            # coordinator identity so per-peer cuts work under HA
+            # (MetaClient targets carry no node_id; they fall back to
+            # the generic role name)
+            FAULTS.fire("heartbeat.send", node=self.node_id,
+                        src=self.node_id,
+                        dst=getattr(self.metasrv, "node_id", "metasrv"))
         except FaultError:
             # dropped on the (virtual) wire: the metasrv never hears it —
             # no lease renewal, the failure detector's phi keeps climbing

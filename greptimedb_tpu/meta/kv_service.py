@@ -130,7 +130,9 @@ class MetaHttpService:
                 n = int(self.headers.get("Content-Length", "0"))
                 try:
                     req = json.loads(self.rfile.read(n) or b"{}")
-                    out = service._dispatch(self.path, req)
+                    out = service._dispatch(
+                        self.path, req,
+                        src=self.headers.get("X-GTPU-Src"))
                 except Exception as e:  # noqa: BLE001 — wire boundary
                     self._reply({"error": f"{type(e).__name__}: {e}"}, 500)
                     return
@@ -148,16 +150,21 @@ class MetaHttpService:
             self._rev_cond.notify_all()
 
     # ------------------------------------------------------------- dispatch
-    def _dispatch(self, path: str, req: dict) -> dict:
+    def _dispatch(self, path: str, req: dict,
+                  src: Optional[str] = None) -> dict:
         kv = self.metasrv.kv
         if path.startswith("/kv/"):
             # metadata-plane chaos seam (fault matrix: metasrv.kv): a
             # fail surfaces as HTTP 500 -> MetaServiceError at every
             # client; the op label makes injections per-op countable in
-            # greptimedb_tpu_fault_injections_total
+            # greptimedb_tpu_fault_injections_total. The caller's node
+            # identity (X-GTPU-Src header) makes each op an edge, so a
+            # (node, metasrv) partition cuts ONE role's KV access while
+            # the rest of the cluster keeps talking.
             from greptimedb_tpu.fault import FAULTS
 
-            FAULTS.fire("metasrv.kv", op=path[len("/kv/"):])
+            FAULTS.fire("metasrv.kv", op=path[len("/kv/"):],
+                        src=src or "?", dst=self.metasrv.node_id)
         if path == "/kv/get":
             return {"value": kv.get(req["key"])}
         if path == "/kv/put":
@@ -223,6 +230,10 @@ class MetaHttpService:
         return {
             "leader": resp.leader,
             "leader_hint": resp.leader_hint,
+            # the coordinator's real identity: clients adopt it as the
+            # dst of their heartbeat edges, so @edge/partition specs
+            # naming the metasrv's node id match over the wire too
+            "node_id": self.metasrv.node_id,
             "lease_deadline_ms": resp.lease_deadline_ms,
             "instructions": [
                 {"kind": i.kind.value, "region_id": i.region_id,
@@ -269,14 +280,19 @@ class _HttpJson:
         would make a blind retry observe its OWN effect and report
         failure (e.g. an election winner believing it lost) — raising
         'outcome unknown' is the honest answer."""
+        from greptimedb_tpu.fault import local_node
+
         data = json.dumps(body).encode()
         last = None
         attempts = 2 if idempotent else 1  # reconnect on stale keep-alive
         for _ in range(attempts):
             c = self._conn()
             try:
+                # identity header: lets the service's metasrv.kv fault
+                # seam scope injections/partitions to one caller edge
                 c.request("POST", path, body=data,
-                          headers={"Content-Type": "application/json"})
+                          headers={"Content-Type": "application/json",
+                                   "X-GTPU-Src": local_node()})
                 r = c.getresponse()
                 raw = r.read()
                 if r.status != 200:
@@ -339,10 +355,13 @@ class HttpKv(KvBackend):
         c = http.client.HTTPConnection(self._http.host, self._http.port,
                                        timeout=timeout_s + 10.0)
         try:
+            from greptimedb_tpu.fault import local_node
+
             c.request("POST", "/kv/watch", json.dumps(
                 {"prefix": prefix, "since_rev": since_rev,
                  "timeout_s": timeout_s}).encode(),
-                {"Content-Type": "application/json"})
+                {"Content-Type": "application/json",
+                 "X-GTPU-Src": local_node()})
             r = c.getresponse()
             raw = r.read()
             if r.status != 200:
@@ -364,9 +383,15 @@ class MetaClient:
     datanode process."""
 
     def __init__(self, addr: str, node_addr: Optional[str] = None,
-                 timeout_s: float = 10.0):
+                 timeout_s: float = 10.0,
+                 metasrv_node_id: str = "metasrv"):
         self.addr = addr
         self.node_addr = node_addr  # this node's Flight addr (datanodes)
+        #: the coordinator identity this client's heartbeat edges carry
+        #: (HeartbeatTask reads it as dst): configure it with the remote
+        #: metasrv's real node id so @edge/partition specs naming that
+        #: id match over the wire; the default is the generic role name
+        self.node_id = metasrv_node_id
         self._http = _HttpJson(addr, timeout_s)
         self.kv = HttpKv(addr, timeout_s)
 
@@ -378,6 +403,11 @@ class MetaClient:
             "region_stats": [dataclasses.asdict(s)
                              for s in req.region_stats],
         })
+        if out.get("node_id"):
+            # adopt the coordinator's real identity (first beat still
+            # carries the generic role default — steady-state edges
+            # match the documented node-id form)
+            self.node_id = out["node_id"]
         return HeartbeatResponse(
             leader=out.get("leader", True),
             leader_hint=out.get("leader_hint"),

@@ -66,7 +66,8 @@ class ObjectStore:
         retry_call(
             lambda: FAULTS.mangled_write(
                 "objectstore.write", data,
-                lambda blob: self._do_write(key, blob)),
+                lambda blob: self._do_write(key, blob),
+                spill=lambda blob: self._spill_partial(key, blob)),
             point="objectstore.write")
 
     def _do_read(self, key: str) -> bytes:
@@ -74,6 +75,13 @@ class ObjectStore:
 
     def _do_write(self, key: str, data: bytes) -> None:
         raise NotImplementedError
+
+    def _spill_partial(self, key: str, partial: bytes) -> None:
+        """ENOSPC staging contract: the bytes that reached the backend
+        before the device filled. Backends with on-disk staging (fs tmp
+        files, multipart uploads) must erase it here — a leaked partial
+        is the bug the enospc chaos shape exists to catch. Atomic
+        backends have nothing staged: default no-op."""
 
     def delete(self, key: str) -> None:
         raise NotImplementedError
@@ -118,6 +126,24 @@ class FsStore(ObjectStore):
             f.flush()
             os.fsync(f.fileno())  # durable before rename (manifest contract)
         os.replace(tmp, key)
+
+    def _spill_partial(self, key: str, partial: bytes) -> None:
+        """A real mid-write ENOSPC dies inside the tmp write above, so
+        the visible object is never partial — but the tmp file is, and
+        leaking one per failed flush would fill the disk for good. Stage
+        the partial exactly where _do_write would, then erase it."""
+        parent = os.path.dirname(key)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        tmp = key + ".tmp"
+        try:
+            with open(tmp, "wb") as f:
+                f.write(partial)
+        finally:
+            try:
+                os.remove(tmp)
+            except FileNotFoundError:
+                pass
 
     def delete(self, key: str) -> None:
         try:

@@ -34,7 +34,7 @@ import pyarrow.flight as fl
 
 from greptimedb_tpu.datatypes.schema import Schema
 from greptimedb_tpu.datatypes.types import DataType, SemanticType
-from greptimedb_tpu.fault import FAULTS, retry_call
+from greptimedb_tpu.fault import FAULTS, local_node, retry_call
 from greptimedb_tpu.query.result import QueryResult
 from greptimedb_tpu.session import Channel, QueryContext
 from greptimedb_tpu.storage.region import ScanData
@@ -339,6 +339,13 @@ class FlightServer(fl.FlightServerBase):
             tracing.set_trace(req["trace_id"])
         with tracing.collect_spans() as sink:
             with tracing.span("region_scan", region=region_id) as attrs:
+                # server-side injection INSIDE the scan span: latency
+                # armed here (e.g. via GTPU_CHAOS inherited by a child
+                # datanode, @side:server) lands in the span duration the
+                # frontend's merged tree renders — the end-to-end proof
+                # the ROADMAP fault-matrix item asked for
+                FAULTS.fire("flight.do_get", side="server",
+                            node=local_node(), op="region_scan")
                 scan = self.engine.scan(
                     region_id, ts_range=ts_range, projection=projection,
                     tag_predicates=preds, seq_min=req.get("seq_min"))
@@ -377,6 +384,8 @@ class FlightServer(fl.FlightServerBase):
         with tracing.collect_spans() as sink:
             with tracing.span("region_frag", region=region_id,
                               stages=len(frag.stages)):
+                FAULTS.fire("flight.do_get", side="server",
+                            node=local_node(), op="region_frag")
                 part = execute_region_fragment(self._agg_executor,
                                                region_id, frag)
             if part is None:
@@ -422,6 +431,12 @@ class FlightServer(fl.FlightServerBase):
             with tracing.collect_spans() as sink:
                 with tracing.span("region_write", region=rid,
                                   op=op) as attrs:
+                    # server-side seam inside the write span (the do_put
+                    # mirror of the do_get scan-span injection);
+                    # @side:server opts in, plain schedules stay
+                    # client-only
+                    FAULTS.fire("flight.do_put", side="server",
+                                node=local_node(), op="region_write")
                     t = reader.read_all()
                     from greptimedb_tpu.datatypes.recordbatch import RecordBatch
 
@@ -591,8 +606,13 @@ class RemoteRegionEngine:
     region request through this client instead of in-process calls)."""
 
     def __init__(self, addr: str, user: Optional[str] = None,
-                 password: Optional[str] = None):
+                 password: Optional[str] = None,
+                 peer: Optional[str] = None):
         self.addr = addr
+        #: the peer's NODE identity (dn-N): with it, every RPC carries a
+        #: (src, dst) edge the fault layer can match or partition; an
+        #: addr-only client still works, it just has no edge
+        self.peer = peer
         self.client = fl.FlightClient(f"grpc://{addr}")
         if user is not None:
             self.client.authenticate(_BasicClientAuth(user, password or ""))
@@ -605,7 +625,8 @@ class RemoteRegionEngine:
         trade exactness for availability, as the reference's gRPC retry
         does)."""
         def op():
-            FAULTS.fire(point, addr=self.addr)
+            FAULTS.fire(point, addr=self.addr, side="client",
+                        src=local_node(), dst=self.peer or self.addr)
             return fn()
         return retry_call(op, point=point, retryable=RETRYABLE_FLIGHT)
 

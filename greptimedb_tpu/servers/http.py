@@ -205,6 +205,20 @@ class _Handler(BaseHTTPRequestHandler):
                             top=int(qs.get("top", ["50"])[0]))
                     return self._send(200, out.encode(), "text/plain")
                 return self._send(404, {"error": f"no route {path}"})
+            if path == "/v1/faults":
+                # chaos-state debug surface: armed points, partitions,
+                # and per-series fire counts — what a red scenario run
+                # pulls first to see which schedule actually hit
+                from greptimedb_tpu.fault import FAULTS, chaos_seed
+                from greptimedb_tpu.utils.metrics import FAULT_INJECTIONS
+
+                return self._send(200, {
+                    "chaos_seed": chaos_seed(),
+                    "faults": FAULTS.describe(),
+                    "partitions": FAULTS.partitions(),
+                    "fired": [{"labels": labels, "count": count}
+                              for labels, count in
+                              FAULT_INJECTIONS.series()]})
             if path == "/v1/slow_queries":
                 # debug surface of the slow-query ring; behind the auth
                 # gate (query text is sensitive, unlike /metrics)

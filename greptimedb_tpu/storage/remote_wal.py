@@ -26,13 +26,16 @@ listings; replay on a fresh node lists once, which is unavoidable.
 
 from __future__ import annotations
 
+import logging
 import struct
 import threading
 import zlib
 from typing import Iterator, Optional
 
+logger = logging.getLogger(__name__)
+
 from greptimedb_tpu.datatypes.recordbatch import RecordBatch
-from greptimedb_tpu.fault import FAULTS, retry_call
+from greptimedb_tpu.fault import FAULTS, FaultError, retry_call
 from greptimedb_tpu.objectstore import ObjectStore, ObjectStoreError
 from greptimedb_tpu.storage.wal import WalEntry, _decode_batch, _encode_batch
 
@@ -122,13 +125,44 @@ class RemoteWal:
         # a torn write here is SAFE to leave in place: segments are
         # separate immutable objects, so a corrupt tail in this one
         # never shadows later acknowledged segments at replay
-        retry_call(
-            lambda: FAULTS.mangled_write(
-                "wal.append", blob,
-                lambda mangled: self.store.write(key, mangled)),
-            point="wal.append")
+        def attempt():
+            try:
+                FAULTS.mangled_write(
+                    "wal.append", blob,
+                    lambda mangled: self.store.write(key, mangled),
+                    # ENOSPC spill: the partial segment lands as a real
+                    # object (the multipart-upload-interrupted shape)...
+                    spill=lambda mangled: self.store.write(key, mangled))
+            except FaultError as e:
+                if e.kind == "enospc":
+                    # ...and must NOT survive: the unacknowledged
+                    # partial's intact leading frames would replay as
+                    # phantom writes on a failover candidate
+                    self._erase_partial(key)
+                raise
+        retry_call(attempt, point="wal.append")
         with self._lock:
             self._seeded(region_id).append((first, last, key))
+
+    def _erase_partial(self, key: str) -> None:
+        """A spilled partial segment must not remain readable: its
+        intact leading frames would replay as acknowledged rows. Delete
+        it; if the delete ALSO fails, neutralize by overwriting with an
+        empty object (zero frames replay as nothing); if even that
+        fails, log loudly — silence here is acknowledged-write
+        corruption waiting for a failover."""
+        try:
+            self.store.delete(key)
+            return
+        except ObjectStoreError:
+            pass
+        try:
+            self.store.write(key, b"")
+        except Exception:  # noqa: BLE001 — last resort is the log line
+            logger.error(
+                "remote WAL: failed to erase partial segment %s after "
+                "ENOSPC — unacknowledged frames may replay as phantom "
+                "writes", key)
 
     # ---- replay ------------------------------------------------------------
 

@@ -147,12 +147,16 @@ class Wal:
         def attempt():
             start = f.tell()
             try:
-                FAULTS.mangled_write("wal.append", blob, sink)
+                # spill=sink: an injected ENOSPC lands its partial bytes
+                # in the file tail first (what a real full disk does to
+                # an append) — the repair below must erase them
+                FAULTS.mangled_write("wal.append", blob, sink, spill=sink)
             except BaseException:
                 # crash-consistency repair: an append lands whole or not
                 # at all. A partial tail left in place would orphan every
                 # LATER acknowledged frame at replay (replay stops at the
-                # first corrupt frame).
+                # first corrupt frame); a partial ENOSPC tail is the same
+                # shape and takes the same truncate.
                 try:
                     f.flush()
                     f.truncate(start)

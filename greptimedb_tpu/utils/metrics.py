@@ -37,6 +37,16 @@ class Counter:
             return sum(v for key, v in self._values.items()
                        if want <= set(key))
 
+    def series(self, **labels) -> list:
+        """Every (labels dict, value) series whose labels are a superset
+        of the given ones — feeds per-node/per-edge breakdowns in debug
+        surfaces (information_schema.cluster_faults, /v1/faults)."""
+        want = set(labels.items())
+        with self._lock:
+            return [(dict(key), v)
+                    for key, v in sorted(self._values.items())
+                    if want <= set(key)]
+
     def render(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
         with self._lock:

@@ -32,6 +32,11 @@ def pytest_configure(config):
         "markers",
         "chaos: deterministic fault-injection tests (schedule + jitter "
         "seeded by GTPU_CHAOS_SEED; the seed is printed on failure)")
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from tier-1 (-m 'not slow') — the full "
+        "compound-fault scenario matrix; run via pytest -m slow or "
+        "tools/run_scenarios.py")
 
 
 @pytest.hookimpl(hookwrapper=True)

@@ -217,6 +217,111 @@ class TestElection:
         assert {c["node"] for c in e1.all_candidates()} == {"meta-a", "meta-b"}
 
 
+class TestElectionEdges:
+    """The edges the compound-fault scenarios lean on (ISSUE 3
+    satellite): mid-renew expiry, the concurrent-CAS takeover race,
+    resign-then-recampaign, and NotLeaderError redirects."""
+
+    def _pair(self, lease_s=3.0):
+        kv = MemoryKv()
+        return (kv, KvElection(kv, "meta-a", lease_s=lease_s),
+                KvElection(kv, "meta-b", lease_s=lease_s))
+
+    def test_lease_expiry_mid_renew(self):
+        """The holder reads its own value, then the lease lapses and a
+        peer takes over BEFORE the renewal CAS lands: the stale-valued
+        CAS must fail and the old holder steps down — never splits."""
+        kv, e1, e2 = self._pair(lease_s=3)
+        e1.campaign(0)
+
+        class _MidRenewKv:
+            """Delegate that lets meta-b take over between meta-a's
+            renewal read and its CAS (the interleaving itself)."""
+
+            def __init__(self, inner):
+                self._inner = inner
+                self._armed = True
+
+            def get(self, key):
+                raw = self._inner.get(key)
+                if self._armed:
+                    self._armed = False
+                    e2.campaign(3500)  # expiry + takeover mid-renew
+                return raw
+
+            def __getattr__(self, name):
+                return getattr(self._inner, name)
+
+        e1.kv = _MidRenewKv(kv)
+        assert e1.campaign(3600) is False
+        assert not e1.is_leader() and e2.is_leader()
+        assert e1.leader(3600) == "meta-b"
+
+    def test_concurrent_cas_takeover_race_single_winner(self):
+        """After expiry, N candidates campaign at the same instant from
+        real threads: the CAS admits exactly one."""
+        import threading
+
+        kv = MemoryKv()
+        first = KvElection(kv, "meta-z", lease_s=3)
+        first.campaign(0)  # then dies silently; lease lapses at 3000
+        candidates = [KvElection(kv, f"meta-{i}", lease_s=3)
+                      for i in range(4)]
+        barrier = threading.Barrier(len(candidates))
+        results = {}
+
+        def race(e):
+            barrier.wait()
+            results[e.node_id] = e.campaign(5000)
+
+        threads = [threading.Thread(target=race, args=(e,))
+                   for e in candidates]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        winners = [n for n, won in results.items() if won]
+        assert len(winners) == 1
+        assert candidates[0].leader(5000) == winners[0]
+
+    def test_resign_then_recampaign(self):
+        """A resigned leader that campaigns again re-acquires (nobody
+        else claimed the zeroed lease) and 'elected' fires again — the
+        controlled-restart shape."""
+        _, e1, e2 = self._pair()
+        events = []
+        e1.subscribe(lambda ev, n: events.append(ev))
+        e1.campaign(0)
+        e1.resign()
+        assert not e1.is_leader()
+        assert e1.campaign(100)  # zeroed lease: immediate re-acquisition
+        assert e1.is_leader()
+        assert events == ["elected", "step_down", "elected"]
+        # and a peer's later campaign within the fresh lease loses
+        assert not e2.campaign(200)
+
+    def test_not_leader_error_carries_new_leader(self):
+        """A follower's fence names the CURRENT holder so clients can
+        redirect — including after a takeover changed it."""
+        from greptimedb_tpu.meta.metasrv import Metasrv, MetasrvOptions
+
+        kv = MemoryKv()
+        e1 = KvElection(kv, "meta-a", lease_s=3)
+        e2 = KvElection(kv, "meta-b", lease_s=3)
+        m2 = Metasrv(kv, MetasrvOptions(), node_id="meta-b", election=e2)
+        e1.campaign(0)
+        with pytest.raises(NotLeaderError) as ei:
+            m2.ensure_leader(100)
+        assert ei.value.leader == "meta-a"
+        # takeover flips the redirect target
+        e2.campaign(3500)
+        m1 = Metasrv(kv, MetasrvOptions(), node_id="meta-a", election=e1)
+        e1.campaign(3600)  # discovers loss
+        with pytest.raises(NotLeaderError) as ei:
+            m1.ensure_leader(3700)
+        assert ei.value.leader == "meta-b"
+
+
 class TestMetasrvHA:
     """Two metasrvs over one KV: follower redirects, leader-kill failover
     of the coordinator itself, in-flight procedure resumption."""

@@ -380,8 +380,9 @@ class TestMetasrvKvFault:
                 kv.get("k")
             # the schedule is spent: the plane recovers
             assert kv.get("k") == "v"
-            assert FAULT_INJECTIONS.get(point="metasrv.kv", kind="fail",
-                                        op="get") >= 1
+            # total(): the call site also stamps the (src, metasrv) edge
+            assert FAULT_INJECTIONS.total(point="metasrv.kv", kind="fail",
+                                          op="get") >= 1
             assert FAULT_INJECTIONS.total(point="metasrv.kv") == before + 1
         finally:
             FAULTS.disarm("metasrv.kv")

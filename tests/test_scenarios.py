@@ -1,0 +1,465 @@
+"""Compound-fault chaos scenarios + the per-edge/partition/ENOSPC fault
+surface (greptimedb_tpu/fault/scenarios.py and the PR's fault-matrix
+extensions).
+
+Tier-1 covers the fault primitives (edge matchers, partition state,
+enospc cleanup, election lease loss, chaos debug surfaces) plus ONE
+smoke scenario on a live 2-datanode ProcessCluster. The full 3-datanode
+matrix is `slow`-marked — run it with `pytest -m slow tests/test_scenarios.py`
+or `python tools/run_scenarios.py`; every red run prints its
+GTPU_CHAOS/GTPU_CHAOS_SEED reproduction line."""
+
+import os
+import re
+import time
+
+import pytest
+
+from greptimedb_tpu.fault import (
+    EDGE_POINTS,
+    FAULTS,
+    Fault,
+    FaultError,
+    FaultRegistry,
+    local_node,
+)
+from greptimedb_tpu.fault.scenarios import (
+    DEFAULT_SEED,
+    SCENARIOS,
+    InvariantViolation,
+    ScenarioRun,
+    run_scenario,
+)
+from greptimedb_tpu.utils.metrics import FAULT_INJECTIONS
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def clean_faults():
+    FAULTS.reset()
+    yield
+    FAULTS.reset()
+
+
+# ---- per-edge matchers + partition state ------------------------------------
+
+
+class TestEdgeMatchers:
+    def test_edge_fault_fires_only_on_its_edge(self):
+        FAULTS.arm("flight.do_get",
+                   Fault(kind="fail", edges=[("frontend", "dn-1")]))
+        FAULTS.fire("flight.do_get", src="frontend", dst="dn-0")  # no match
+        FAULTS.fire("flight.do_get", src="dn-1", dst="frontend")  # reverse
+        with pytest.raises(FaultError):
+            FAULTS.fire("flight.do_get", src="frontend", dst="dn-1")
+
+    def test_env_grammar_symmetric_and_asymmetric(self):
+        r = FaultRegistry()
+        r.arm_from_env("flight.do_put=fail,@edge:frontend<->dn-1;"
+                       "heartbeat.send=fail,@edge:dn-0->metasrv")
+        assert set(r._points["flight.do_put"].edges) == {
+            ("frontend", "dn-1"), ("dn-1", "frontend")}
+        assert r._points["heartbeat.send"].edges == [("dn-0", "metasrv")]
+        with pytest.raises(ValueError):
+            r.arm_from_env("flight.do_get=fail,@edge:nonsense")
+
+    def test_edge_on_peerless_point_is_arm_time_error(self):
+        """The typo guard (satellite): wal.append has no peer concept."""
+        with pytest.raises(ValueError, match="no peer concept"):
+            FAULTS.arm("wal.append",
+                       Fault(kind="fail", edges=[("a", "b")]))
+
+    def test_unknown_node_in_edge_is_arm_time_error(self):
+        FAULTS.register_nodes(["dn-0", "dn-1", "frontend", "metasrv"])
+        with pytest.raises(ValueError, match="unknown node 'dn-9'"):
+            FAULTS.arm("flight.do_get",
+                       Fault(kind="fail", edges=[("frontend", "dn-9")]))
+        with pytest.raises(ValueError, match="unknown node"):
+            FAULTS.arm("heartbeat.send",
+                       Fault(kind="fail", match={"node": "dn-7"}))
+        # known topology passes
+        FAULTS.arm("flight.do_get",
+                   Fault(kind="fail", edges=[("frontend", "dn-1")]))
+
+    def test_unknown_node_in_partition_is_error(self):
+        FAULTS.register_nodes(["dn-0", "frontend"])
+        with pytest.raises(ValueError, match="unknown node"):
+            FAULTS.install_partition("frontend", "dn-3")
+
+    def test_partition_state_drops_and_heals(self):
+        FAULTS.install_partition("frontend", "dn-1")
+        with pytest.raises(FaultError) as ei:
+            FAULTS.fire("flight.do_get", src="frontend", dst="dn-1")
+        assert ei.value.kind == "partition" and ei.value.transient
+        with pytest.raises(FaultError):  # symmetric: reverse direction too
+            FAULTS.fire("heartbeat.send", src="dn-1", dst="frontend")
+        FAULTS.fire("flight.do_get", src="frontend", dst="dn-0")  # other edge
+        # non-edge points never partition
+        FAULTS.fire("datanode.crash", src="frontend", dst="dn-1")
+        FAULTS.heal_partition("frontend", "dn-1")
+        FAULTS.fire("flight.do_get", src="frontend", dst="dn-1")
+
+    def test_asymmetric_partition_cuts_one_direction(self):
+        FAULTS.install_partition("dn-0", "metasrv", symmetric=False)
+        with pytest.raises(FaultError):
+            FAULTS.fire("heartbeat.send", src="dn-0", dst="metasrv")
+        FAULTS.fire("heartbeat.send", src="metasrv", dst="dn-0")
+
+    def test_partition_env_entry_and_edge_counter(self):
+        FAULTS.arm_from_env("partition=frontend<->dn-1")
+        assert FAULTS.partitions() == ["dn-1->frontend", "frontend->dn-1"]
+        before = FAULT_INJECTIONS.total(kind="partition",
+                                        edge="frontend->dn-1")
+        with pytest.raises(FaultError):
+            FAULTS.fire("flight.do_put", src="frontend", dst="dn-1")
+        assert FAULT_INJECTIONS.total(
+            kind="partition", edge="frontend->dn-1") == before + 1
+
+    def test_edge_points_is_the_peered_subset(self):
+        assert EDGE_POINTS == {"flight.do_get", "flight.do_put",
+                               "heartbeat.send", "metasrv.kv"}
+
+    def test_local_node_defaults_to_frontend(self, monkeypatch):
+        monkeypatch.delenv("GTPU_NODE_ID", raising=False)
+        assert local_node() == "frontend"
+        monkeypatch.setenv("GTPU_NODE_ID", "dn-3")
+        assert local_node() == "dn-3"
+
+
+# ---- enospc fault kind -------------------------------------------------------
+
+
+class TestEnospc:
+    def test_wal_append_enospc_truncates_partial_tail(self, tmp_path):
+        """Partial-write-then-ENOSPC on the local WAL: the spilled tail
+        is truncated away (no orphaned bytes), the write is unacked, and
+        the error is non-transient (no retry storm on a full disk)."""
+        import numpy as np
+
+        from greptimedb_tpu.datatypes import (
+            ColumnSchema,
+            DataType,
+            DictVector,
+            RecordBatch,
+            Schema,
+            SemanticType,
+        )
+        from greptimedb_tpu.storage.wal import Wal
+
+        s = Schema([
+            ColumnSchema("ts", DataType.TIMESTAMP_MILLISECOND,
+                         SemanticType.TIMESTAMP),
+            ColumnSchema("hostname", DataType.STRING, SemanticType.TAG),
+            ColumnSchema("v", DataType.FLOAT64),
+        ])
+
+        def batch(i):
+            return RecordBatch(s, {
+                "ts": np.asarray([i], dtype=np.int64),
+                "hostname": DictVector.encode(["h"]),
+                "v": np.asarray([float(i)], dtype=np.float64)})
+
+        w = Wal(str(tmp_path), sync=False)
+        w.append(1, 0, 0, batch(0))
+        _, f = w._files[1]
+        f.flush()
+        size_before = os.path.getsize(w._seg_path(1, 0))
+        FAULTS.arm("wal.append", Fault(kind="enospc", arg=0.5, nth=1))
+        with pytest.raises(FaultError) as ei:
+            w.append(1, 1, 0, batch(1))
+        assert ei.value.kind == "enospc" and not ei.value.transient
+        f.flush()
+        assert os.path.getsize(w._seg_path(1, 0)) == size_before, \
+            "partial ENOSPC tail must be truncated away"
+        FAULTS.reset()
+        w.append(1, 1, 0, batch(2))  # the disk "recovered"
+        assert [e.seq for e in w.replay(1)] == [0, 1]
+
+    def test_remote_wal_enospc_deletes_partial_segment(self):
+        import numpy as np
+
+        from greptimedb_tpu.datatypes import (
+            ColumnSchema,
+            DataType,
+            DictVector,
+            RecordBatch,
+            Schema,
+            SemanticType,
+        )
+        from greptimedb_tpu.objectstore import MemoryStore
+        from greptimedb_tpu.storage.remote_wal import RemoteWal
+
+        s = Schema([
+            ColumnSchema("ts", DataType.TIMESTAMP_MILLISECOND,
+                         SemanticType.TIMESTAMP),
+            ColumnSchema("hostname", DataType.STRING, SemanticType.TAG),
+            ColumnSchema("v", DataType.FLOAT64),
+        ])
+        b = RecordBatch(s, {
+            "ts": np.asarray([1], dtype=np.int64),
+            "hostname": DictVector.encode(["h"]),
+            "v": np.asarray([1.0], dtype=np.float64)})
+        store = MemoryStore()
+        rw = RemoteWal(store)
+        rw.append(5, 0, 0, b)
+        FAULTS.arm("wal.append", Fault(kind="enospc", arg=0.5, nth=1))
+        with pytest.raises(FaultError):
+            rw.append(5, 1, 0, b)
+        FAULTS.reset()
+        # the partial segment object did NOT survive — its intact
+        # leading frames would replay as phantom acknowledged writes
+        assert store.list("wal/5/") == ["wal/5/" + "0" * 20]
+        assert [e.seq for e in rw.replay(5)] == [0]
+
+    def test_objectstore_enospc_leaves_no_object_and_no_tmp(self, tmp_path):
+        from greptimedb_tpu.objectstore import FsStore
+
+        key = str(tmp_path / "sst" / "obj")
+        FAULTS.arm("objectstore.write",
+                   Fault(kind="enospc", arg=0.4, nth=1))
+        store = FsStore()
+        with pytest.raises(FaultError) as ei:
+            store.write(key, b"0123456789")
+        assert ei.value.kind == "enospc"
+        assert not os.path.exists(key)
+        assert not os.path.exists(key + ".tmp"), \
+            "staging tmp file leaked after ENOSPC"
+        FAULTS.reset()
+        store.write(key, b"0123456789")
+        assert store.read(key) == b"0123456789"
+
+    def test_enospc_on_read_path_never_serves_partial(self):
+        from greptimedb_tpu.objectstore import MemoryStore
+
+        store = MemoryStore()
+        store.write("k", b"0123456789")
+        FAULTS.arm("objectstore.read", Fault(kind="enospc", nth=1))
+        with pytest.raises(FaultError):
+            store.read("k")
+        FAULTS.reset()
+        assert store.read("k") == b"0123456789"
+
+
+# ---- election lease-loss chaos ----------------------------------------------
+
+
+class TestElectionLeaseChaos:
+    def test_forced_expiry_steps_down_and_peer_takes_over(self):
+        from greptimedb_tpu.catalog.kv import MemoryKv
+        from greptimedb_tpu.meta.election import KvElection
+
+        kv = MemoryKv()
+        e1 = KvElection(kv, "meta-a", lease_s=3.0)
+        e2 = KvElection(kv, "meta-b", lease_s=3.0)
+        events = []
+        e1.subscribe(lambda ev, node: events.append(ev))
+        assert e1.campaign(0.0)
+        FAULTS.arm("election.lease",
+                   Fault(kind="fail", nth=1, match={"node": "meta-a"}))
+        # forced expiry applies even mid-lease, through keep_alive's
+        # short-circuit
+        assert e1.keep_alive(100.0) is False
+        assert not e1.is_leader()
+        assert events == ["elected", "step_down"]
+        # the zeroed lease lets the standby take over immediately
+        assert e2.campaign(200.0)
+
+    def test_clock_skew_churns_views(self):
+        from greptimedb_tpu.catalog.kv import MemoryKv
+        from greptimedb_tpu.meta.election import KvElection
+
+        kv = MemoryKv()
+        e1 = KvElection(kv, "meta-a", lease_s=3.0)
+        e2 = KvElection(kv, "meta-b", lease_s=3.0)
+        assert e1.campaign(0.0)
+        # a skewed-forward observer believes the lease already lapsed —
+        # and may legally steal it (its own clock IS its truth)
+        e2.clock_skew_ms = 10_000.0
+        assert e2.leader(100.0) is None
+        assert e2.campaign(100.0)
+        # the unskewed holder discovers the loss at its next campaign
+        assert e1.campaign(200.0) is False
+        assert not e1.is_leader()
+
+
+# ---- chaos state debug surfaces (satellite) ---------------------------------
+
+
+class TestChaosDebugSurfaces:
+    def test_cluster_faults_lists_armed_and_fired(self, tmp_path):
+        from greptimedb_tpu.catalog.kv import MemoryKv
+        from greptimedb_tpu.query.engine import QueryContext, QueryEngine
+
+        FAULTS.arm("heartbeat.send",
+                   Fault(kind="fail", nth=2, times=3,
+                         match={"node": "dn-1"}))
+        FAULTS.install_partition("frontend", "dn-0")
+        with pytest.raises(FaultError):
+            FAULTS.fire("flight.do_get", src="frontend", dst="dn-0")
+        from greptimedb_tpu.catalog.catalog import Catalog
+        from greptimedb_tpu.storage.engine import EngineConfig, RegionEngine
+
+        qe = QueryEngine(Catalog(MemoryKv()),
+                         RegionEngine(EngineConfig(
+                             data_dir=str(tmp_path), write_workers=0)))
+        res = qe.execute_one(
+            "SELECT point, kind, schedule, matchers, edge, fires "
+            "FROM information_schema.cluster_faults ORDER BY point",
+            QueryContext())
+        rows = res.rows()
+        by_point = {r[0]: r for r in rows}
+        assert by_point["heartbeat.send"][1] == "fail"
+        assert by_point["heartbeat.send"][2] == "nth:2,times:3"
+        assert by_point["heartbeat.send"][3] == "node:dn-1"
+        part = by_point["partition"]
+        assert part[4] in ("frontend->dn-0", "dn-0->frontend")
+        assert any(r[0] == "partition" and r[5] >= 1 for r in rows), \
+            "partition fire count missing"
+
+    def test_v1_faults_endpoint(self, tmp_path):
+        import json
+        import urllib.request
+
+        from greptimedb_tpu.catalog.catalog import Catalog
+        from greptimedb_tpu.catalog.kv import MemoryKv
+        from greptimedb_tpu.query.engine import QueryEngine
+        from greptimedb_tpu.servers.http import HttpServer
+        from greptimedb_tpu.storage.engine import EngineConfig, RegionEngine
+
+        FAULTS.arm("metasrv.kv", Fault(kind="latency", arg=0.0, prob=0.5))
+        FAULTS.install_partition("frontend", "dn-1")
+        qe = QueryEngine(Catalog(MemoryKv()),
+                         RegionEngine(EngineConfig(
+                             data_dir=str(tmp_path), write_workers=0)))
+        srv = HttpServer(qe, port=0)
+        port = srv.start()
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/v1/faults", timeout=10) as r:
+                out = json.loads(r.read())
+        finally:
+            srv.stop()
+        assert out["partitions"] == ["dn-1->frontend", "frontend->dn-1"]
+        points = {f["point"]: f for f in out["faults"]}
+        assert points["metasrv.kv"]["schedule"] == "prob:0.5"
+        assert "chaos_seed" in out
+
+
+# ---- the ROADMAP latency gap: injected delay inside a CHILD datanode --------
+
+
+class TestChildScanLatencyEndToEnd:
+    def test_latency_lands_in_merged_span_tree(self, tmp_path, monkeypatch):
+        """Closes the ROADMAP gap 'latency injection inside child
+        datanode scan paths asserted end-to-end': the schedule rides
+        GTPU_CHAOS env inheritance into the child, fires server-side
+        INSIDE the region_scan span, and the frontend's merged span tree
+        (EXPLAIN ANALYZE) shows the delay attributed to the child."""
+        from greptimedb_tpu.cluster.process_cluster import ProcessCluster
+        from greptimedb_tpu.meta.metasrv import MetasrvOptions
+
+        monkeypatch.setenv("GTPU_CHAOS",
+                           "flight.do_get=latency,arg:0.25,@side:server")
+        monkeypatch.setenv("GTPU_CHAOS_SEED", "42")
+        c = ProcessCluster(str(tmp_path), num_datanodes=2,
+                           opts=MetasrvOptions())
+        try:
+            c.beat_all(time.time() * 1000)
+            c.sql("CREATE TABLE m (host STRING, v DOUBLE, "
+                  "ts TIMESTAMP TIME INDEX, PRIMARY KEY(host))")
+            c.sql("INSERT INTO m VALUES ('a', 1.0, 1000)")
+            r = c.sql("EXPLAIN ANALYZE SELECT host, v FROM m")
+            lines = [row[0] for row in r.rows()]
+            text = "\n".join(lines)
+            # find the child section and its region_scan duration
+            idx = next(i for i, ln in enumerate(lines)
+                       if ln.strip().startswith("[dn-"))
+            section = lines[idx:]
+            scan_line = next(ln for ln in section if "region_scan" in ln)
+            ms = float(re.search(r"region_scan: ([0-9.]+) ms",
+                                 scan_line).group(1))
+            assert ms >= 250.0, \
+                f"injected 250 ms not visible in child span: {text}"
+        finally:
+            c.close()
+
+
+# ---- scenario harness plumbing ----------------------------------------------
+
+
+class TestScenarioHarness:
+    def test_invariant_violation_carries_repro_line(self):
+        run = ScenarioRun("wal_enospc", 77,
+                          chaos_env="wal.append=enospc,nth:4")
+        with pytest.raises(InvariantViolation) as ei:
+            run.check(False, "acked write h03 lost")
+        msg = str(ei.value)
+        assert "GTPU_CHAOS_SEED=77" in msg
+        assert 'GTPU_CHAOS="wal.append=enospc,nth:4"' in msg
+        assert "python tools/run_scenarios.py wal_enospc" in msg
+
+    def test_epoch_overlap_is_flagged(self):
+        from greptimedb_tpu.fault.scenarios import (
+            ElectionEpochJournal,
+            verify_epochs,
+        )
+
+        j = ElectionEpochJournal.__new__(ElectionEpochJournal)
+        j.epochs = [
+            {"node": "meta-a", "lease_until_ms": 9000.0, "prev": None},
+            # meta-b "granted" at t=3000 while meta-a's lease ran to 9000
+            {"node": "meta-b", "lease_until_ms": 12000.0, "prev": None},
+        ]
+        run = ScenarioRun("lease_loss_reelection", 1)
+        with pytest.raises(InvariantViolation, match="epoch overlap"):
+            verify_epochs(run, j, lease_s=9.0)
+        # a takeover AFTER expiry passes
+        j.epochs[1]["lease_until_ms"] = 19000.0  # granted at t=10000
+        verify_epochs(run, j, lease_s=9.0)
+
+    def test_matrix_registry_complete(self):
+        assert {"smoke_partition_heal", "partition_heal",
+                "partition_crash_failover", "lease_loss_reelection",
+                "wal_enospc"} <= set(SCENARIOS)
+        with pytest.raises(KeyError):
+            run_scenario("no_such_scenario")
+
+
+# ---- live scenarios ----------------------------------------------------------
+
+
+class TestSmokeScenario:
+    def test_smoke_partition_heal_two_datanodes(self, tmp_path):
+        """Tier-1 smoke (satellite): single partition + heal on a live
+        2-datanode ProcessCluster, all invariants checked."""
+        report = run_scenario("smoke_partition_heal", str(tmp_path),
+                              seed=DEFAULT_SEED)
+        assert report["acked"] == 7
+        assert report["partition_drops"] > 0
+
+
+@pytest.mark.slow
+class TestFullScenarioMatrix:
+    """The acceptance matrix: 4 compound scenarios against a live
+    3-datanode ProcessCluster, each replayable bit-for-bit from its
+    printed seed (pytest -m slow, or tools/run_scenarios.py)."""
+
+    def test_partition_heal(self, tmp_path):
+        report = run_scenario("partition_heal", str(tmp_path))
+        assert report["acked"] == 7
+
+    def test_partition_crash_failover(self, tmp_path):
+        report = run_scenario("partition_crash_failover", str(tmp_path))
+        assert report["failover_rounds"] <= 30
+        assert report["acked"] == 8
+
+    def test_lease_loss_reelection(self, tmp_path):
+        report = run_scenario("lease_loss_reelection", str(tmp_path))
+        assert report["final_leader"] == "meta-b"
+        assert report["lease_epochs"] >= 3
+
+    def test_wal_enospc(self, tmp_path):
+        report = run_scenario("wal_enospc", str(tmp_path))
+        assert report["failed_write"] == 3
+        assert report["wal_objects_checked"] > 0

@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""Run the compound-fault chaos scenario matrix against live
+ProcessClusters (greptimedb_tpu/fault/scenarios.py).
+
+    python tools/run_scenarios.py                 # the full matrix
+    python tools/run_scenarios.py wal_enospc      # one scenario
+    python tools/run_scenarios.py --seed 99 --list
+
+Each scenario is deterministic under its seed; on an invariant
+violation the failure message carries the exact GTPU_CHAOS /
+GTPU_CHAOS_SEED reproduction line. Exit code 1 when anything fails.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from greptimedb_tpu.fault.scenarios import (
+        DEFAULT_SEED,
+        SCENARIOS,
+        InvariantViolation,
+        run_scenario,
+    )
+
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("scenarios", nargs="*",
+                   help="scenario names (default: the full matrix)")
+    p.add_argument("--seed", type=int, default=None,
+                   help="chaos seed (default: GTPU_CHAOS_SEED or "
+                        f"{DEFAULT_SEED})")
+    p.add_argument("--list", action="store_true",
+                   help="list scenario names and exit")
+    args = p.parse_args()
+
+    if args.list:
+        for name, fn in sorted(SCENARIOS.items()):
+            print(f"{name:28s} {(fn.__doc__ or '').strip().splitlines()[0]}")
+        return 0
+
+    names = args.scenarios or [n for n in SCENARIOS
+                               if not n.startswith("smoke_")]
+    failed = []
+    for name in names:
+        t0 = time.monotonic()
+        try:
+            report = run_scenario(name, seed=args.seed)
+        except InvariantViolation as e:
+            print(f"FAIL {name} ({time.monotonic() - t0:.1f}s)\n{e}")
+            failed.append(name)
+        except KeyError as e:
+            print(f"FAIL {name}: {e}")
+            failed.append(name)
+        except Exception:  # noqa: BLE001 — one crash must not hide the rest
+            import traceback
+
+            print(f"FAIL {name} ({time.monotonic() - t0:.1f}s) — "
+                  "unexpected error:")
+            traceback.print_exc()
+            failed.append(name)
+        else:
+            detail = " ".join(f"{k}={v}" for k, v in report.items()
+                              if k != "name")
+            print(f"PASS {name} ({time.monotonic() - t0:.1f}s) {detail}")
+    if failed:
+        print(f"\n{len(failed)}/{len(names)} scenarios failed: "
+              f"{', '.join(failed)}")
+        return 1
+    print(f"\nall {len(names)} scenarios passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
