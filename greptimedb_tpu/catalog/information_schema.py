@@ -258,6 +258,38 @@ def _cluster_faults(qe, ctx):
     return cols
 
 
+@_virtual("maintenance_jobs")
+def _maintenance_jobs(qe, ctx):
+    """Background maintenance plane job queue + recent history
+    (maintenance/scheduler.py), newest first. Empty when the engine has
+    no plane (frontend routers, maintenance_workers=0)."""
+    import json as _json
+
+    cols = {k: [] for k in (
+        "job_id", "kind", "region_id", "state", "priority", "error",
+        "detail", "queued_at", "started_at", "finished_at",
+        "duration_ms")}
+    maint = getattr(qe.region_engine, "maintenance", None)
+    for job in (maint.jobs() if maint is not None else []):
+        d = job.to_dict()
+        cols["job_id"].append(d["job_id"])
+        cols["kind"].append(d["kind"])
+        cols["region_id"].append(d["region_id"])
+        cols["state"].append(d["state"])
+        cols["priority"].append(d["priority"])
+        cols["error"].append(d["error"])
+        cols["detail"].append(_json.dumps(d["detail"], sort_keys=True))
+        cols["queued_at"].append(int(d["queued_at"] * 1000))
+        cols["started_at"].append(
+            None if d["started_at"] is None else int(d["started_at"] * 1000))
+        cols["finished_at"].append(
+            None if d["finished_at"] is None
+            else int(d["finished_at"] * 1000))
+        cols["duration_ms"].append(
+            None if d["duration_ms"] is None else round(d["duration_ms"], 3))
+    return cols
+
+
 @_virtual("engines")
 def _engines(qe, ctx):
     names = ["mito", "metric", "file"]

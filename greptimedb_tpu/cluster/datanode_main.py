@@ -34,9 +34,22 @@ def main() -> None:
     from greptimedb_tpu.utils.tracing import install_trace_logging
 
     install_trace_logging()
+
+    def _env_num(name, default, cast):
+        try:
+            return cast(os.environ.get(name, default))
+        except (TypeError, ValueError):
+            return default
+
+    # the background maintenance plane is per-datanode; harnesses tune
+    # it via env (spawned children inherit) — GTPU_MAINT_WORKERS=0
+    # restores inline flush for tests that need the pre-plane shape
     engine = RegionEngine(EngineConfig(
         data_dir=shared_dir, wal_backend="remote",
-        write_workers=write_workers))
+        write_workers=write_workers,
+        maintenance_workers=_env_num("GTPU_MAINT_WORKERS", 1, int),
+        maintenance_tick_s=_env_num("GTPU_MAINT_TICK_S", 0.0, float),
+        retention_ttl_ms=_env_num("GTPU_MAINT_TTL_MS", 0, int)))
     server = FlightServer(None, port=0, region_engine=engine)
     tmp = port_file + ".tmp"
     with open(tmp, "w") as f:

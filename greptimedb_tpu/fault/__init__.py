@@ -89,6 +89,12 @@ POINTS = frozenset({
     # election lease loss (meta/election.py): a fired fault force-expires
     # the held lease so elections churn under test (GC-pause analog)
     "election.lease",
+    # background maintenance plane (maintenance/scheduler.py): fired at
+    # job start (labels op=flush|compact|rollup|expire, phase=start) and
+    # at each job's manifest/coverage swap boundary (phase=swap) — chaos
+    # runs crash a compaction mid-swap and assert the pre-compaction
+    # file list stays readable
+    "maintenance.job",
 })
 
 #: points that cross a process boundary and therefore have a peer: the

@@ -1,0 +1,36 @@
+"""Background maintenance plane (datanode-local).
+
+The reference mito2 engine never compacts or flushes on the foreground
+write path: `FlushScheduler` (mito2/src/flush.rs) and the compaction
+scheduler (compaction/scheduler) own all maintenance, writers only stall
+at a hard limit. This package is that plane for the reproduction, plus
+the two maintenance workloads the reference schedules elsewhere:
+
+- `MaintenanceScheduler` (scheduler.py): a bounded priority queue and a
+  small worker pool per datanode. Per-region jobs serialize (one running
+  job per region; the merge itself still holds the region's
+  `_compact_lock`); priority is flush > compaction > downsample > expiry;
+  the write path only stalls when a region's memtable bytes or L0 file
+  count cross a hard threshold (`greptimedb_tpu_write_stall_seconds_total`
+  counts every stalled second).
+- rollup/downsample jobs (rollup.py): inactive-window SSTs re-encoded
+  into coarser-resolution plane SSTs (min/max/sum/count per field) that
+  the query engine substitutes for eligible coarse-bucket aggregates.
+- retention expiry (retention.py): TTL drops whole expired SSTs via one
+  atomic manifest edit.
+
+Job visibility: every job carries an id; ADMIN flush_table/compact_table/
+rollup_table return it, ADMIN maintenance_status(job_id) polls it, and
+`information_schema.maintenance_jobs` / `/v1/maintenance` list the live
+queue + recent history. Chaos hooks: the `maintenance.job` fault point
+fires at job start (labels op=kind, phase=start) and again at each job's
+manifest-swap boundary (phase=swap), so a seeded schedule can crash a
+compaction mid-swap and the tests assert the pre-compaction file list
+stays readable.
+"""
+
+from __future__ import annotations
+
+from .scheduler import Job, MaintenanceScheduler, PRIORITY, parse_duration_ms
+
+__all__ = ["Job", "MaintenanceScheduler", "PRIORITY", "parse_duration_ms"]

@@ -1,0 +1,59 @@
+"""Retention expiry: TTL drops whole expired SSTs.
+
+The cheap half of retention (the reference's TTL handling in its
+compaction picker): an SST whose ts_max is older than `now - ttl` can be
+dropped wholesale — one manifest edit removes the files atomically, the
+purge queue deletes the bytes once no scan pins them. Rows inside a
+straddling SST are NOT trimmed (that would be a rewrite, i.e. a
+compaction's job); expiry is deliberately metadata-only."""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+
+def ms_to_units(ms: int, dtype) -> int:
+    """Milliseconds -> the timestamp column's native unit (floor)."""
+    nanos = dtype.time_unit.nanos_per_unit
+    return int(ms * 1_000_000 // nanos)
+
+
+def run_expiry(region, ttl_ms: int,
+               now_ms: Optional[int] = None) -> dict:
+    """Drop every SST fully older than the TTL from `region` with one
+    atomic manifest edit. Returns {"removed": n, "cutoff": units}."""
+    if ttl_ms <= 0:
+        return {"removed": 0, "cutoff": None}
+    from greptimedb_tpu.fault import FAULTS
+
+    now = int(time.time() * 1000) if now_ms is None else int(now_ms)
+    dtype = region.schema.time_index.dtype
+    cutoff = ms_to_units(now - ttl_ms, dtype)
+    # _compact_lock: a concurrent merge (inline stall-escape compaction
+    # bypasses the scheduler's per-region serialization) reads its input
+    # SSTs outside region._lock — expiry purging one mid-merge would
+    # fail the merge or resurrect expired rows via the merged output
+    with region._compact_lock, region._lock:
+        expired = [f for f in region.files.values() if f.ts_max < cutoff]
+        if not expired:
+            return {"removed": 0, "cutoff": cutoff}
+        # chaos seam: a crash here must leave the pre-expiry file list
+        # fully readable (the manifest edit below is the atomic swap)
+        FAULTS.fire("maintenance.job", op="expire", phase="swap")
+        removed = [f.file_id for f in expired]
+        for fid in removed:
+            region.files.pop(fid, None)
+        # flushed_seq=None: expiry persists nothing from the memtable;
+        # advancing flushed_seq would drop unflushed writes on replay
+        region.manifest.record_flush(
+            [], flushed_seq=None,
+            tag_dicts=region.registry.snapshot(), removed=removed)
+        now_mono = time.monotonic()
+        region._purge_queue.extend((fid, now_mono) for fid in removed)
+        region.data_version += 1
+        region._drain_purge()
+    from greptimedb_tpu.utils.metrics import EXPIRED_SSTS
+
+    EXPIRED_SSTS.inc(len(removed))
+    return {"removed": len(removed), "cutoff": cutoff}

@@ -22,7 +22,7 @@ from greptimedb_tpu.datatypes.schema import ColumnSchema, Schema
 from greptimedb_tpu.datatypes.types import DataType, SemanticType, parse_sql_type
 from greptimedb_tpu.datatypes.vector import DictVector
 from greptimedb_tpu.query import logical as lp
-from greptimedb_tpu.query.expr import PlanError, eval_host
+from greptimedb_tpu.query.expr import PlanError, eval_host, has_aggregate
 from greptimedb_tpu.query.physical import PhysicalExecutor
 from greptimedb_tpu.query.planner import plan_select
 from greptimedb_tpu.query.result import QueryResult
@@ -732,6 +732,15 @@ class QueryEngine:
         if rs.is_range_select(sel):
             rplan = rs.plan_range_select(sel, info)
             return rs.execute_range_select(self.executor, rplan)
+        if sel.group_by or any(has_aggregate(it.expr) for it in sel.items):
+            # rollup substitution: eligible coarse-bucket aggregates are
+            # served from downsampled plane SSTs (maintenance/rollup.py);
+            # None = ineligible/uncovered, fall through to the raw scan
+            from greptimedb_tpu.maintenance.rollup import try_substitute
+
+            res = try_substitute(self, sel, info, ctx)
+            if res is not None:
+                return res
         plan = plan_select(sel, info)
         return self.executor.execute(plan)
 
@@ -1065,6 +1074,7 @@ class QueryEngine:
                 self.file_engine.drop_file_table(rid)
                 self._open_regions.discard(rid)
             return QueryResult.of_affected(0)
+        from greptimedb_tpu.maintenance.rollup import drop_companions
         from greptimedb_tpu.storage.engine import RegionRequest, RequestType
         for rid in info.region_ids:
             try:
@@ -1072,6 +1082,9 @@ class QueryEngine:
             except KeyError:
                 self.region_engine.open_region(rid)
             self.region_engine.handle_request(RegionRequest(RequestType.DROP, rid))
+            # rollup planes must die with the raw data, or substituted
+            # aggregates would resurrect the dropped table's rows
+            drop_companions(self.region_engine, rid)
             self._open_regions.discard(rid)
         return QueryResult.of_affected(0)
 
@@ -1084,9 +1097,12 @@ class QueryEngine:
         if engine_kind == "metric":
             raise PlanError("TRUNCATE is not supported on metric engine "
                             "logical tables")
+        from greptimedb_tpu.maintenance.rollup import drop_companions
         from greptimedb_tpu.storage.engine import RegionRequest, RequestType
         for rid in info.region_ids:
             self.region_engine.handle_request(RegionRequest(RequestType.DROP, rid))
+            # coverage claims over truncated data must go with it
+            drop_companions(self.region_engine, rid)
             self.region_engine.create_region(rid, info.schema)
         return QueryResult.of_affected(0)
 
@@ -1509,19 +1525,83 @@ class QueryEngine:
 
     # ---- admin -------------------------------------------------------------
 
+    #: ADMIN fn name -> maintenance job kind (the async job-id flow)
+    _ADMIN_JOBS = {"flush_table": "flush", "compact_table": "compact",
+                   "rollup_table": "rollup", "expire_table": "expire"}
+
     def _admin(self, stmt: ast.AdminFunc, ctx: QueryContext) -> QueryResult:
         fn = stmt.func
         args = [a.value if isinstance(a, ast.Literal) else None for a in fn.args]
-        if fn.name in ("flush_table", "compact_table"):
+        maint = getattr(self.region_engine, "maintenance", None)
+        if fn.name in self._ADMIN_JOBS:
             info = self._table(str(args[0]), ctx)
-            for rid in info.region_ids:
-                if fn.name == "flush_table":
-                    self.region_engine.flush(rid)
+            kind = self._ADMIN_JOBS[fn.name]
+            if maint is None:
+                # no plane (maintenance_workers=0, or a frontend router):
+                # flush/compact keep their pre-plane synchronous shape
+                if kind == "flush":
+                    for rid in info.region_ids:
+                        self.region_engine.flush(rid)
+                elif kind == "compact":
+                    for rid in info.region_ids:
+                        self.region_engine.compact(rid)
                 else:
-                    self.region_engine.compact(rid)
-            return QueryResult.of_affected(0)
+                    raise PlanError(
+                        f"{fn.name} needs the maintenance plane "
+                        "(engine.maintenance_workers > 0)")
+                return QueryResult.of_affected(0)
+            params: dict = {}
+            if kind == "compact":
+                # manual compaction is a full merge (reference manual
+                # strict-window strategy); background TWCS stays windowed
+                params["strategy"] = "full"
+            if kind == "rollup":
+                from greptimedb_tpu.maintenance import parse_duration_ms
+
+                res_ms = parse_duration_ms(args[1]) if len(args) > 1 \
+                    else (maint.rollup_rules[0].resolution_ms
+                          if maint.rollup_rules else 60_000)
+                maint.rule_for(res_ms)  # register ad-hoc resolutions
+                params["resolution"] = res_ms
+            elif kind == "expire" and len(args) > 1:
+                from greptimedb_tpu.maintenance import parse_duration_ms
+
+                params["ttl_ms"] = parse_duration_ms(args[1])
+            job_ids = [maint.submit(kind, rid, params).job_id
+                       for rid in info.region_ids]
+            return QueryResult(["job_id"], [DataType.INT64],
+                               [np.asarray(job_ids, dtype=np.int64)])
+        if fn.name == "maintenance_status":
+            if maint is None:
+                raise PlanError("maintenance plane is disabled")
+            job = maint.job(int(args[0]))
+            if job is None:
+                raise PlanError(f"unknown maintenance job {args[0]}")
+            d = job.to_dict()
+            names = ["job_id", "kind", "region_id", "state", "error",
+                     "duration_ms", "detail"]
+            dtypes = [DataType.INT64, DataType.STRING, DataType.INT64,
+                      DataType.STRING, DataType.STRING, DataType.FLOAT64,
+                      DataType.STRING]
+            cols = [np.asarray([d["job_id"]], dtype=np.int64),
+                    np.asarray([d["kind"]], dtype=object),
+                    np.asarray([d["region_id"]], dtype=np.int64),
+                    np.asarray([d["state"]], dtype=object),
+                    np.asarray([d["error"]], dtype=object),
+                    np.asarray([d["duration_ms"] if d["duration_ms"]
+                                is not None else np.nan]),
+                    np.asarray([json.dumps(d["detail"],
+                                           sort_keys=True)],
+                               dtype=object)]
+            return QueryResult(names, dtypes, cols)
         if fn.name in ("flush_region", "compact_region"):
             rid = int(args[0])
+            if maint is not None:
+                kind = "flush" if fn.name == "flush_region" else "compact"
+                job = maint.submit(kind, rid)
+                return QueryResult(["job_id"], [DataType.INT64],
+                                   [np.asarray([job.job_id],
+                                               dtype=np.int64)])
             if fn.name == "flush_region":
                 self.region_engine.flush(rid)
             else:

@@ -219,6 +219,28 @@ class _Handler(BaseHTTPRequestHandler):
                     "fired": [{"labels": labels, "count": count}
                               for labels, count in
                               FAULT_INJECTIONS.series()]})
+            if path == "/v1/maintenance":
+                # background maintenance plane debug surface: queue
+                # depth + job list (newest first) + stall counters
+                from greptimedb_tpu.utils.metrics import (
+                    WRITE_STALL_SECONDS,
+                )
+
+                maint = getattr(self.query_engine.region_engine,
+                                "maintenance", None)
+                params = self._params()
+                n = int(params.get("limit", "100"))
+                return self._send(200, {
+                    "enabled": maint is not None,
+                    "queue_depth": maint.queue_depth() if maint else 0,
+                    "rollup_rules": [
+                        {"resolution_ms": r.resolution_ms,
+                         "fields": list(r.fields), "auto": r.auto}
+                        for r in (maint.rollup_rules if maint else [])],
+                    "write_stall_seconds": WRITE_STALL_SECONDS.total(),
+                    "jobs": [j.to_dict()
+                             for j in (maint.jobs() if maint else [])[:n]],
+                })
             if path == "/v1/slow_queries":
                 # debug surface of the slow-query ring; behind the auth
                 # gate (query text is sensitive, unlike /metrics)

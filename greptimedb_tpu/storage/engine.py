@@ -89,6 +89,27 @@ class EngineConfig:
     object_store_cache_bytes: int = 0
     # backend-specific construction args (s3: bucket/endpoint/keys...)
     object_store_kwargs: dict = field(default_factory=dict)
+    # ---- background maintenance plane (maintenance/ package) ----
+    # worker pool size; 0 disables the plane (flush/compact run inline
+    # on the writer, the pre-plane behavior)
+    maintenance_workers: int = 1
+    maintenance_queue: int = 64
+    # periodic sweep submitting threshold flushes / compactions /
+    # rollups / expiry; 0 = event-driven only (writes + ADMIN)
+    maintenance_tick_s: float = 0.0
+    # hard write-stall thresholds (reference flush.rs stall semantics):
+    # writers block once a region's memtable bytes or L0 count cross
+    # these; 0 bytes = 2x flush_threshold_bytes
+    stall_memtable_bytes: int = 0
+    stall_l0_files: int = 32
+    # give up stalling after this long and flush inline (memory safety
+    # beats latency when the plane is wedged)
+    stall_timeout_s: float = 30.0
+    # engine-wide TTL for retention expiry jobs; 0 = never expire
+    retention_ttl_ms: int = 0
+    # [[maintenance.rollup]] rules as dicts: {"resolution_ms": 60000,
+    # "fields": [...], "auto": True}
+    rollup_rules: list = field(default_factory=list)
 
 
 class RegionEngine:
@@ -126,6 +147,20 @@ class RegionEngine:
 
             n = None if config.write_workers < 0 else config.write_workers
             self.workers = WorkerGroup(self, num_workers=n)
+        # background maintenance plane: owns every flush/compaction/
+        # rollup/expiry off the write path (maintenance/scheduler.py)
+        self.maintenance = None
+        if config.maintenance_workers > 0:
+            from greptimedb_tpu.maintenance import MaintenanceScheduler
+
+            self.maintenance = MaintenanceScheduler(
+                self,
+                workers=config.maintenance_workers,
+                queue_size=config.maintenance_queue,
+                tick_interval_s=config.maintenance_tick_s,
+                retention_ttl_ms=config.retention_ttl_ms,
+                rollup_rules=config.rollup_rules,
+            )
 
     def register_opener(self, fn) -> None:
         self.openers.append(fn)
@@ -214,10 +249,69 @@ class RegionEngine:
             # write itself succeeded; only the flush check is moot
             return n
         if region.memtable_bytes >= self.config.flush_threshold_bytes:
-            region.flush()
-            # TWCS picker no-ops unless window thresholds are exceeded
-            region.compact()
+            if self.maintenance is not None:
+                # async plane: the writer only SUBMITS; it stalls below
+                # only when a hard threshold is crossed
+                self.maintenance.submit("flush", region_id)
+                self._maybe_stall(region_id, region)
+            else:
+                region.flush()
+                # TWCS picker no-ops unless window thresholds are exceeded
+                region.compact()
         return n
+
+    def _stall_threshold_bytes(self) -> int:
+        return self.config.stall_memtable_bytes or \
+            2 * self.config.flush_threshold_bytes
+
+    def _maybe_stall(self, region_id: int, region: Region) -> None:
+        """Write-stall backpressure (reference flush.rs:83-135 write
+        buffer stall): block the writer while the region sits past the
+        HARD memtable/L0 limits, crediting every stalled second to
+        greptimedb_tpu_write_stall_seconds_total. After stall_timeout_s
+        the writer flushes inline — memory safety beats latency when the
+        plane is wedged or saturated."""
+        import time as _time
+
+        from greptimedb_tpu.utils.metrics import (
+            WRITE_STALL_SECONDS,
+            WRITE_STALL_TIMEOUTS,
+        )
+
+        hard_bytes = self._stall_threshold_bytes()
+        hard_l0 = self.config.stall_l0_files
+
+        def over() -> Optional[str]:
+            if region.memtable_bytes >= hard_bytes:
+                return "memtable"
+            if hard_l0 and region.l0_count >= hard_l0:
+                return "l0"
+            return None
+
+        reason = over()
+        if reason is None:
+            return
+        if reason == "l0":
+            self.maintenance.submit("compact", region_id)
+        deadline = _time.monotonic() + self.config.stall_timeout_s
+        cv = self.maintenance._cv
+        while True:
+            t0 = _time.monotonic()
+            if t0 >= deadline:
+                WRITE_STALL_TIMEOUTS.inc()
+                # inline escape hatch matched to the stall reason: a
+                # flush cannot relieve L0 pressure (it ADDS an L0 file)
+                if reason == "l0":
+                    region.compact()
+                else:
+                    region.flush()
+                return
+            with cv:
+                cv.wait(min(0.05, deadline - t0))
+            WRITE_STALL_SECONDS.inc(_time.monotonic() - t0, reason=reason)
+            reason = over()
+            if reason is None:
+                return
 
     # ---- convenience wrappers ----------------------------------------------
 
@@ -280,6 +374,10 @@ class RegionEngine:
     def close(self) -> None:
         if self.workers is not None:
             self.workers.stop()  # drain in-flight writes first
+        if self.maintenance is not None:
+            # after write workers (they submit jobs), before region close
+            # (a running compaction still touches region state)
+            self.maintenance.stop()
         with self._lock:
             for r in self.regions.values():
                 if hasattr(r, "close"):

@@ -136,10 +136,15 @@ class ManifestManager:
     def record_flush(
         self,
         added: list[FileMeta],
-        flushed_seq: int,
+        flushed_seq: Optional[int],
         tag_dicts: dict[str, list],
         removed: Optional[list[str]] = None,
     ) -> None:
+        """Record a file-set edit. `flushed_seq` must be None unless the
+        memtable was actually persisted up to that sequence — replay
+        skips WAL entries below it, so a compaction/expiry edit passing
+        next_seq here would silently drop unflushed acknowledged writes
+        on the next open."""
         self.append(
             {
                 "kind": "edit",

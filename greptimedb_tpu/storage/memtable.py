@@ -96,6 +96,9 @@ class Memtable:
         self.bytes_estimate = 0
         self.ts_min: Optional[int] = None
         self.ts_max: Optional[int] = None
+        # newest write sequence held (rollup staleness checks compare
+        # this against a job's as_of_seq; -1 = empty)
+        self.max_seq: int = -1
 
     def write(self, batch: RecordBatch, seq_start: int, op_type: int) -> int:
         """Append a batch; returns the number of rows written. Tags are
@@ -132,6 +135,7 @@ class Memtable:
         lo, hi = int(ts.min()), int(ts.max())
         self.ts_min = lo if self.ts_min is None else min(self.ts_min, lo)
         self.ts_max = hi if self.ts_max is None else max(self.ts_max, hi)
+        self.max_seq = max(self.max_seq, seq_start + n - 1)
         return n
 
     def is_empty(self) -> bool:

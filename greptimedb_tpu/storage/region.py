@@ -363,12 +363,21 @@ class Region:
         removed = [f.file_id for f in group]
         import time as _time
 
+        from greptimedb_tpu.fault import FAULTS
+
+        # chaos seam: a crash HERE (new SST durable, manifest not yet
+        # edited) must leave the pre-compaction file list authoritative —
+        # the new file is an unreferenced orphan, never a half-swap
+        FAULTS.fire("maintenance.job", op="compact", phase="swap")
         with self._lock:
             for fid in removed:
                 self.files.pop(fid, None)
             self.files[meta.file_id] = meta
+            # flushed_seq=None: this edit persists NO memtable rows —
+            # advancing it here would mark concurrent unflushed writes
+            # replay-obsolete (acked-write loss on crash)
             self.manifest.record_flush(
-                [meta], flushed_seq=self.next_seq,
+                [meta], flushed_seq=None,
                 tag_dicts=self.registry.snapshot(), removed=removed)
             # defer physical deletion: concurrent scans may still hold
             # the pre-compaction file list
@@ -802,3 +811,10 @@ class Region:
     @property
     def memtable_bytes(self) -> int:
         return self.memtable.bytes_estimate
+
+    @property
+    def l0_count(self) -> int:
+        """Unmerged flush outputs — the write-stall backpressure signal
+        (the reference stalls writers on L0 pressure the same way)."""
+        with self._lock:
+            return sum(1 for f in self.files.values() if f.level == 0)

@@ -282,3 +282,33 @@ DEVICE_CACHE_EVENTS = REGISTRY.counter(
 SLOW_QUERIES = REGISTRY.counter(
     "greptimedb_tpu_slow_queries_total",
     "Statements slower than the slow-query threshold, by kind")
+
+# background maintenance plane (maintenance/ package): job throughput,
+# queue pressure, writer stalls, and the rollup/retention outcomes —
+# the observability contract of "the write path never does maintenance"
+MAINTENANCE_JOBS = REGISTRY.counter(
+    "greptimedb_tpu_maintenance_jobs_total",
+    "Maintenance jobs by kind (flush/compact/rollup/expire) and "
+    "terminal status (done/failed)")
+MAINTENANCE_QUEUE_DEPTH = REGISTRY.gauge(
+    "greptimedb_tpu_maintenance_queue_depth",
+    "Maintenance jobs currently queued (bounded; excess submissions "
+    "run inline on the caller)")
+MAINTENANCE_JOB_SECONDS = REGISTRY.histogram(
+    "greptimedb_tpu_maintenance_job_duration_seconds",
+    "Maintenance job execution wall time by kind")
+WRITE_STALL_SECONDS = REGISTRY.counter(
+    "greptimedb_tpu_write_stall_seconds_total",
+    "Seconds writers spent stalled at the hard memtable/L0 backpressure "
+    "threshold, by reason (memtable/l0)")
+WRITE_STALL_TIMEOUTS = REGISTRY.counter(
+    "greptimedb_tpu_write_stall_timeouts_total",
+    "Stalls that hit stall_timeout_s and fell back to an inline flush "
+    "(the maintenance plane is wedged or saturated)")
+ROLLUP_SUBSTITUTIONS = REGISTRY.counter(
+    "greptimedb_tpu_maintenance_rollup_substitutions_total",
+    "Aggregate queries served from rollup plane SSTs instead of raw "
+    "data, by table and resolution")
+EXPIRED_SSTS = REGISTRY.counter(
+    "greptimedb_tpu_maintenance_expired_ssts_total",
+    "SSTs dropped whole by retention (TTL) expiry")

@@ -1,0 +1,17 @@
+-- ADMIN maintenance-plane job flow: every maintenance ADMIN returns the
+-- submitted job id; queries stay correct while jobs run in background
+CREATE TABLE mj (host STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(host));
+
+INSERT INTO mj VALUES ('a', 1.0, 60000), ('b', 2.0, 61000), ('a', 3.0, 121000);
+
+ADMIN flush_table('mj');
+
+ADMIN rollup_table('mj', '1m');
+
+ADMIN expire_table('mj', '100000d');
+
+ADMIN compact_table('mj');
+
+SELECT host, count(*) FROM mj GROUP BY host ORDER BY host;
+
+SELECT count(*) FROM mj;

@@ -132,7 +132,12 @@ class TestWriteScanRaces:
         qe.execute_one("ADMIN flush_table('m')")
         qe.execute_one("INSERT INTO m VALUES ('b', 2.0, 2000)")
         qe.execute_one("ADMIN flush_table('m')")
-        qe.execute_one("ADMIN compact_table('m')")
+        r = qe.execute_one("ADMIN compact_table('m')")
+        # ADMIN is async job submission now — wait for the compact job
+        # before asserting its side effects
+        maint = qe.region_engine.maintenance
+        for row in r.rows():
+            maint.wait(int(row[0]), timeout=30)
         info = qe.catalog.table("public", "m")
         region = qe.region_engine.region(info.region_ids[0])
         # old files grace-held, not yet deleted
