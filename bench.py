@@ -224,10 +224,22 @@ def timed_sql(qe, sql, repeats=None, expect_rows=None):
     return float(np.median(times)), warm_ms, r.num_rows, spans
 
 
-def bench_cpu_suite(qe, results):
+def bench_cpu_suite(qe, results, guard=None, checkpoint=None):
+    """Quick TSBS configs. Each config runs isolated (`guard`) and the
+    salvageable summary refreshes after every one (`checkpoint`) —
+    r01/r04 ended rc=0 with `parsed: null` because one config crashing
+    inside this suite sank every result before the first checkpoint."""
     t_end_ms = T0_MS + HOURS * 3600 * 1000
 
-    if enabled("single_groupby_1_1_1"):
+    def _run(name, fn):
+        if guard is not None:
+            guard(name, fn)
+        elif enabled(name):
+            fn()
+        if checkpoint is not None:
+            checkpoint()
+
+    def _single_groupby():
         sql = (
             "SELECT date_bin(INTERVAL '1 minute', ts) AS minute, "
             "max(usage_user) FROM cpu "
@@ -241,7 +253,9 @@ def bench_cpu_suite(qe, results):
             "p50_ms": round(p50, 2), "tier": qe.executor.last_tier, "baseline_ms": BASE_SINGLE_MS,
             "vs_baseline": round(BASE_SINGLE_MS / p50, 3)}
 
-    if enabled("double_groupby_all"):
+    _run("single_groupby_1_1_1", _single_groupby)
+
+    def _double_groupby():
         avg_list = ", ".join(f"avg({f})" for f in FIELDS)
         sql = (
             f"SELECT date_bin(INTERVAL '1 hour', ts) AS hour, hostname, "
@@ -284,7 +298,9 @@ def bench_cpu_suite(qe, results):
             results["double_groupby_all"]["host_tier_p50_ms"] = \
                 round(p50_h, 2)
 
-    if enabled("groupby_orderby_limit"):
+    _run("double_groupby_all", _double_groupby)
+
+    def _gbol():
         # TSBS groupby-orderby-limit: last 5 minute-buckets of max before
         # a cutoff inside the range
         cutoff = T0_MS + (HOURS * 3600 * 1000) * 3 // 4
@@ -299,7 +315,9 @@ def bench_cpu_suite(qe, results):
             "p50_ms": round(p50, 2), "tier": qe.executor.last_tier, "baseline_ms": BASE_GBOL_MS,
             "vs_baseline": round(BASE_GBOL_MS / p50, 3)}
 
-    if enabled("cpu_max_all_8"):
+    _run("groupby_orderby_limit", _gbol)
+
+    def _max_all_8():
         # TSBS cpu-max-all-8: max of all 10 fields for 8 hosts over 8h
         max_list = ", ".join(f"max({f})" for f in FIELDS)
         hosts8 = ", ".join(f"'host_{i}'" for i in range(8))
@@ -315,17 +333,25 @@ def bench_cpu_suite(qe, results):
             "p50_ms": round(p50, 2), "tier": qe.executor.last_tier, "baseline_ms": BASE_MAX_ALL_8_MS,
             "vs_baseline": round(BASE_MAX_ALL_8_MS / p50, 3)}
 
-    if enabled("lastpoint"):
+    _run("cpu_max_all_8", _max_all_8)
+
+    def _lastpoint():
         lv_list = ", ".join(
             f"last_value({f} ORDER BY ts)" for f in FIELDS)
         sql = f"SELECT hostname, {lv_list} FROM cpu GROUP BY hostname"
         p50, warm, nrows, _ = timed_sql(qe, sql, expect_rows=HOSTS)
-        log(f"lastpoint: {p50:.1f} ms (warm-up {warm:.0f} ms)")
+        path = qe.executor.last_path or ""
+        log(f"lastpoint: {p50:.1f} ms (warm-up {warm:.0f} ms, "
+            f"path={path})")
         results["lastpoint"] = {
-            "p50_ms": round(p50, 2), "tier": qe.executor.last_tier, "baseline_ms": BASE_LASTPOINT_MS,
+            "p50_ms": round(p50, 2), "tier": qe.executor.last_tier,
+            "path": path,  # "lastscan+..." = newest-first pruning hit
+            "baseline_ms": BASE_LASTPOINT_MS,
             "vs_baseline": round(BASE_LASTPOINT_MS / p50, 3)}
 
-    if enabled("high_cpu_all"):
+    _run("lastpoint", _lastpoint)
+
+    def _high_cpu():
         sql = (
             f"SELECT * FROM cpu WHERE usage_user > 90.0 "
             f"AND ts >= {T0_MS} AND ts < {t_end_ms}"
@@ -336,6 +362,8 @@ def bench_cpu_suite(qe, results):
             "p50_ms": round(p50, 2), "tier": qe.executor.last_tier, "rows_out": nrows,
             "baseline_ms": BASE_HIGH_CPU_MS,
             "vs_baseline": round(BASE_HIGH_CPU_MS / p50, 3)}
+
+    _run("high_cpu_all", _high_cpu)
 
 
 def bench_promql(engine, qe, results, ingest_rps=300000.0):
@@ -608,8 +636,9 @@ def bench_double_groupby_100m(engine, qe, results, ingest_rps):
     affordable = affordable_rows(600, ingest_rps * 0.4)
     rows_planned = min(rows_target, affordable)
     if rows_planned < 10_000_000:
+        left = budget_left_s()
         log(f"double_groupby_100m skipped: budget affords only "
-            f"{rows_planned} rows ({budget_left_s():.0f}s left)")
+            f"{rows_planned} rows ({left:.0f}s left)")
         results["double_groupby_100m"] = {
             "skipped": f"budget ({left:.0f}s left)",
             "target_rows": rows_target, "at_spec": False}
@@ -859,6 +888,110 @@ def bench_maintenance(engine, qe, results):
         f"{rollup_ms:.0f} ms -> {results['maintenance']['rollup_rows_out']}"
         f" plane rows, coarse query {raw_p50:.1f} -> {sub_p50:.1f} ms "
         f"(substituted={substituted})")
+
+
+def bench_scan_pipeline(engine, qe, results):
+    """Scan-pipeline micro-phase (ISSUE 5): the cold double-groupby-
+    shaped scan through the parallel decode pool vs the sequential
+    path (bit-for-bit checked), the warm per-file-cache scan, and the
+    post-flush incremental scan that must decode ONLY the new file."""
+    from greptimedb_tpu.datatypes import DictVector, RecordBatch
+
+    rows_target = int(os.environ.get("BENCH_SCANPIPE_ROWS", "4000000"))
+    n_files, n_hosts = 4, 1000
+    field_defs = ", ".join(f"{f} DOUBLE" for f in FIELDS)
+    qe.execute_one(
+        f"CREATE TABLE scanp (hostname STRING, ts TIMESTAMP(3) NOT NULL, "
+        f"{field_defs}, TIME INDEX (ts), PRIMARY KEY (hostname)) "
+        "WITH (append_mode = 'true')")
+    info = qe.catalog.table("public", "scanp")
+    rid = info.region_ids[0]
+    rng = np.random.default_rng(29)
+    names = np.asarray([f"host_{i}" for i in range(n_hosts)], dtype=object)
+    per_file = rows_target // n_files
+    pts = per_file // n_hosts
+    for f in range(n_files):
+        codes = np.tile(np.arange(n_hosts, dtype=np.int32), pts)
+        ts = np.repeat(
+            T0_MS + (f * pts + np.arange(pts, dtype=np.int64)) * 1000,
+            n_hosts)
+        cols = {"hostname": DictVector(codes, names), "ts": ts}
+        for fld in FIELDS:
+            cols[fld] = rng.uniform(0.0, 100.0, pts * n_hosts)
+        engine.put(rid, RecordBatch(info.schema, cols))
+        engine.flush(rid)
+    region = engine.region(rid)
+
+    def clear_caches(parts=True):
+        with region._lock:
+            region._scan_cache.clear()
+            if parts:
+                region._part_cache.clear()
+                region._part_cache_bytes = 0
+
+    def cold_scan(threads):
+        clear_caches()
+        prev = os.environ.get("GREPTIMEDB_TPU_SCAN_DECODE_THREADS")
+        os.environ["GREPTIMEDB_TPU_SCAN_DECODE_THREADS"] = str(threads)
+        try:
+            t0 = time.perf_counter()
+            scan = engine.scan(rid)
+            ms = (time.perf_counter() - t0) * 1000
+        finally:
+            if prev is None:
+                os.environ.pop("GREPTIMEDB_TPU_SCAN_DECODE_THREADS", None)
+            else:
+                os.environ["GREPTIMEDB_TPU_SCAN_DECODE_THREADS"] = prev
+        return ms, scan
+
+    seq_ms, seq_scan = cold_scan(1)
+    par_ms, par_scan = cold_scan(0)
+    identical = (
+        seq_scan.num_rows == par_scan.num_rows
+        and seq_scan.sorted_part_offsets == par_scan.sorted_part_offsets
+        and all(np.array_equal(np.asarray(seq_scan.columns[k]),
+                               np.asarray(par_scan.columns[k]))
+                for k in seq_scan.columns)
+        and np.array_equal(seq_scan.seq, par_scan.seq)
+        and np.array_equal(seq_scan.op_type, par_scan.op_type))
+    # warm: whole-scan cache cleared, per-file parts kept -> 0 decodes
+    clear_caches(parts=False)
+    t0 = time.perf_counter()
+    warm_scan = engine.scan(rid)
+    warm_ms = (time.perf_counter() - t0) * 1000
+    # incremental: one small flush -> exactly ONE file decoded
+    small = 10 * n_hosts
+    codes = np.tile(np.arange(n_hosts, dtype=np.int32), 10)
+    ts = np.repeat(
+        T0_MS + (n_files * pts + np.arange(10, dtype=np.int64)) * 1000,
+        n_hosts)
+    cols = {"hostname": DictVector(codes, names), "ts": ts}
+    for fld in FIELDS:
+        cols[fld] = rng.uniform(0.0, 100.0, small)
+    engine.put(rid, RecordBatch(info.schema, cols))
+    engine.flush(rid)
+    t0 = time.perf_counter()
+    incr_scan = engine.scan(rid)
+    incr_ms = (time.perf_counter() - t0) * 1000
+    speedup = seq_ms / par_ms if par_ms > 0 else None
+    log(f"scan-pipeline: cold seq {seq_ms:.0f} ms -> parallel "
+        f"{par_ms:.0f} ms ({speedup:.2f}x, identical={identical}), "
+        f"part-warm {warm_ms:.0f} ms "
+        f"({warm_scan.stats['files_decoded']} decodes), post-flush "
+        f"{incr_ms:.0f} ms ({incr_scan.stats['files_decoded']} decodes)")
+    results["scan_pipeline"] = {
+        "rows": int(seq_scan.num_rows),
+        "files": n_files,
+        "cold_sequential_ms": round(seq_ms, 1),
+        "cold_parallel_ms": round(par_ms, 1),
+        "parallel_speedup": round(speedup, 2) if speedup else None,
+        "bit_for_bit_identical": bool(identical),
+        "decode_workers": par_scan.stats.get("decode_workers"),
+        "warm_part_cache_ms": round(warm_ms, 1),
+        "warm_files_decoded": warm_scan.stats["files_decoded"],
+        "post_flush_ms": round(incr_ms, 1),
+        "post_flush_files_decoded": incr_scan.stats["files_decoded"],
+        "baseline_ms": None, "vs_baseline": None}
 
 
 def bench_sql_insert(qe, results, rows_total=None, per_stmt=500):
@@ -1171,7 +1304,14 @@ def main():
             emit_result(platform, probe_attempts, results, rows,
                         ingest_rps, None, preliminary=True)
 
-        bench_cpu_suite(qe, results)
+        # first salvageable line BEFORE any query config runs: even a
+        # crash inside the cpu suite leaves a parsed artifact carrying
+        # the ingest numbers (r01/r04 exited with `parsed: null`
+        # because everything before the first checkpoint sank together)
+        checkpoint()
+        bench_cpu_suite(qe, results, guard=guarded, checkpoint=checkpoint)
+        guarded("scan_pipeline",
+                lambda: bench_scan_pipeline(engine, qe, results))
         checkpoint()
         guarded("anchor_pyarrow_double_groupby",
                 lambda: bench_anchor(engine, qe, results))

@@ -44,6 +44,9 @@ def run_expiry(region, ttl_ms: int,
         removed = [f.file_id for f in expired]
         for fid in removed:
             region.files.pop(fid, None)
+        # expired files' decoded scan parts go with them (per-file
+        # scan cache, storage/region.py)
+        region._invalidate_file_parts(removed)
         # flushed_seq=None: expiry persists nothing from the memtable;
         # advancing flushed_seq would drop unflushed writes on replay
         region.manifest.record_flush(

@@ -7,10 +7,19 @@ host->HBM transfer is the scan bottleneck (SURVEY.md §7 hard part #4), so
 hot blocks stay pinned in HBM keyed by (region, data version, column,
 block window, dtype). Any write/flush/compact bumps the region's data
 version, so stale blocks simply stop being referenced and age out via LRU.
+
+Upload/compute overlap: `prefetch(key, build)` schedules the NEXT
+block's host-side build (pad + cast + H2D dispatch) on a single
+background worker while the caller consumes the current one — double
+buffering, so cold dense aggregation approaches max(host build, device
+work) instead of their sum. A later `get` joins the in-flight build;
+the cumulative hit ratio lands on the
+greptimedb_tpu_scan_pipeline_overlap gauge.
 """
 
 from __future__ import annotations
 
+import os
 import threading
 from collections import OrderedDict
 from typing import Callable
@@ -19,7 +28,17 @@ import jax
 
 from greptimedb_tpu import config
 from greptimedb_tpu.utils import device_telemetry
-from greptimedb_tpu.utils.metrics import DEVICE_CACHE_EVENTS
+from greptimedb_tpu.utils.metrics import (
+    DEVICE_CACHE_EVENTS,
+    SCAN_PIPELINE_OVERLAP,
+)
+
+
+def upload_prefetch_enabled() -> bool:
+    """Double-buffered block upload knob ([scan] upload_prefetch /
+    GREPTIMEDB_TPU_UPLOAD_PREFETCH); on by default."""
+    return os.environ.get("GREPTIMEDB_TPU_UPLOAD_PREFETCH", "1") \
+        not in ("0", "false", "off")
 
 
 class DeviceCache:
@@ -35,6 +54,13 @@ class DeviceCache:
         self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
+        # double-buffer prefetch: in-flight background builds by key;
+        # ONE worker on purpose — the pipeline is host-build of block
+        # i+1 against consumption of block i, not a second fan-out
+        self._inflight: dict[tuple, object] = {}
+        self._prefetch_pool = None
+        self.prefetch_issued = 0
+        self.prefetch_joined = 0
         # scrape-time residency gauge sums _bytes over live caches
         device_telemetry.register_cache(self)
 
@@ -46,28 +72,76 @@ class DeviceCache:
                 self.hits += 1
                 DEVICE_CACHE_EVENTS.inc(event="hit")
                 return hit
+            fut = self._inflight.get(key)
+        if fut is not None:
+            try:
+                arr = fut.result()
+            except Exception:  # noqa: BLE001 — prefetch is best-effort
+                arr = None
+            if arr is not None:
+                # a joined prefetch is NOT a miss: the upload happened,
+                # just off-thread — counting it as one would make
+                # steady-state double buffering read as a broken cache
+                DEVICE_CACHE_EVENTS.inc(event="prefetch_join")
+                with self._lock:
+                    self.prefetch_joined += 1
+                    issued = self.prefetch_issued
+                    joined = self.prefetch_joined
+                SCAN_PIPELINE_OVERLAP.set(joined / max(issued, 1))
+                return arr
+        with self._lock:
             self.misses += 1
         DEVICE_CACHE_EVENTS.inc(event="miss")
         arr = build()
-        nbytes = arr.nbytes
         # a cache-miss build materializes the block on device: that IS
         # the H2D upload this cache exists to amortize
-        device_telemetry.count_h2d(nbytes)
-        if nbytes <= self.budget:
-            evictions = 0
-            with self._lock:
-                old = self._lru.pop(key, None)
-                if old is not None:
-                    self._bytes -= old.nbytes
-                self._lru[key] = arr
-                self._bytes += nbytes
-                while self._bytes > self.budget and self._lru:
-                    _, evicted = self._lru.popitem(last=False)
-                    self._bytes -= evicted.nbytes
-                    evictions += 1
-            if evictions:
-                DEVICE_CACHE_EVENTS.inc(float(evictions), event="evict")
+        device_telemetry.count_h2d(arr.nbytes)
+        self._store(key, arr)
         return arr
+
+    def prefetch(self, key: tuple, build: Callable[[], jax.Array]) -> None:
+        """Schedule `build` on the background worker so a later `get`
+        finds the block resident (or joins the in-flight build). No-op
+        when the key is already cached or being built."""
+        with self._lock:
+            if key in self._lru or key in self._inflight:
+                return
+            if self._prefetch_pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                self._prefetch_pool = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="gtpu-hbm-prefetch")
+            self.prefetch_issued += 1
+            self._inflight[key] = self._prefetch_pool.submit(
+                self._build_prefetched, key, build)
+
+    def _build_prefetched(self, key: tuple, build):
+        try:
+            arr = build()
+            device_telemetry.count_h2d(arr.nbytes)
+            self._store(key, arr)
+            return arr
+        finally:
+            with self._lock:
+                self._inflight.pop(key, None)
+
+    def _store(self, key: tuple, arr) -> None:
+        nbytes = arr.nbytes
+        if nbytes > self.budget:
+            return
+        evictions = 0
+        with self._lock:
+            old = self._lru.pop(key, None)
+            if old is not None:
+                self._bytes -= old.nbytes
+            self._lru[key] = arr
+            self._bytes += nbytes
+            while self._bytes > self.budget and self._lru:
+                _, evicted = self._lru.popitem(last=False)
+                self._bytes -= evicted.nbytes
+                evictions += 1
+        if evictions:
+            DEVICE_CACHE_EVENTS.inc(float(evictions), event="evict")
 
     def clear(self) -> None:
         with self._lock:

@@ -991,6 +991,27 @@ class PhysicalExecutor:
         from greptimedb_tpu.utils import tracing
 
         def run(ts_range):
+            # lastpoint pruning: an all-`last` aggregate grouped by one
+            # tag only needs each series' newest rows — the region walks
+            # SSTs newest-first and stops early (Region.scan_last) in
+            # place of decoding the full table. Falls through to the
+            # normal paths whenever the region can't serve it exactly
+            # (tombstones, router engines, no files).
+            lp_tag = self._lastpoint_tag(table, where, agg, ts_range)
+            if (lp_tag is not None and len(table.region_ids) == 1
+                    and hasattr(self.engine, "scan_last")):
+                with tracing.span("scan", table=table.name, regions=1,
+                                  lastpoint=True):
+                    pruned = self.engine.scan_last(
+                        table.region_ids[0], lp_tag, scan_node.columns)
+                if pruned is not None:
+                    with tracing.span("aggregate", rows=pruned.num_rows):
+                        res = self._execute_agg(
+                            pruned, table, where, agg, having, project,
+                            sort, limit, offset, scan_node)
+                    self.last_path = "lastscan+" + (self.last_path or "")
+                    return res
+
             # distributed plan-fragment pushdown: classify the plan prefix
             # (dist_plan.classify_prefix, the commutativity.rs analog) and
             # ship it as one PlanFragment per region — partial-agg planes,
@@ -1081,6 +1102,27 @@ class PhysicalExecutor:
         return run(ts_range)
 
     # ---- distributed aggregation pushdown ----------------------------------
+
+    def _lastpoint_tag(self, table, where, agg, ts_range):
+        """The group tag name when this query is lastpoint-shaped —
+        every aggregate is chronological `last` on the device path, the
+        single group key is a plain tag column, and nothing (WHERE,
+        time range) restricts the row set the newest-first termination
+        argument reasons over. None otherwise."""
+        if agg is None or not agg.aggs or where is not None \
+                or ts_range is not None:
+            return None
+        if any(spec.func != "last" or _needs_host_agg(spec, table.schema)
+               for spec in agg.aggs):
+            return None
+        if len(agg.keys) != 1:
+            return None
+        _, kexpr = agg.keys[0]
+        if not isinstance(kexpr, ast.Column):
+            return None
+        schema = table.schema
+        tag_names = {c.name for c in schema.tag_columns}
+        return kexpr.name if kexpr.name in tag_names else None
 
     def _bucket_topk_ranges(self, table, agg, sort, limit, offset, having,
                             ts_range) -> Optional[list]:
@@ -1984,28 +2026,42 @@ class PhysicalExecutor:
             # included, or the cancellation eats the f64 sq plane's work
             prep_dtype = jnp.dtype(jnp.float64) if "sumsq" in ops \
                 else acc_dtype
-            for start in range(0, n, block):
+
+            def fetch_block(start, prefetch_only=False):
                 end = min(start + block, n)
                 cols = {}
                 for name in aux_names:
                     cols[name] = self._device_block(
                         scan, name, start, end, block, extra_cols,
                         acc_dtype if name in float_fields else None,
+                        prefetch_only=prefetch_only,
                     )
                 cols["__prep__"] = self._prep_plane(
-                    scan, arg_names, start, end, block, prep_dtype, has_nan)
+                    scan, arg_names, start, end, block, prep_dtype,
+                    has_nan, prefetch_only=prefetch_only)
                 if "min" in ops:
                     cols["__prep_min__"] = self._prep_extreme_plane(
                         scan, arg_names, start, end, block, acc_dtype,
-                        "min")
+                        "min", prefetch_only=prefetch_only)
                 if "max" in ops:
                     cols["__prep_max__"] = self._prep_extreme_plane(
                         scan, arg_names, start, end, block, acc_dtype,
-                        "max")
+                        "max", prefetch_only=prefetch_only)
                 if "sumsq" in ops:
                     cols["__prep_sq__"] = self._prep_extreme_plane(
                         scan, arg_names, start, end, block, prep_dtype,
-                        "sq")
+                        "sq", prefetch_only=prefetch_only)
+                return cols, end
+
+            starts = list(range(0, n, block))
+            do_prefetch = self._upload_prefetch_ok(scan)
+            for i, start in enumerate(starts):
+                if do_prefetch and i + 1 < len(starts):
+                    # double buffering: the background worker builds and
+                    # uploads block i+1 while this thread assembles
+                    # block i (and the device chews on what's queued)
+                    fetch_block(starts[i + 1], prefetch_only=True)
+                cols, end = fetch_block(start)
                 blocks.append(cols)
                 n_valids.append(end - start)
                 if dmasks is not None:
@@ -2028,8 +2084,19 @@ class PhysicalExecutor:
             blocks = []
             dmasks = [] if dedup_mask is not None else None
             n_valids = []
-            for start in range(0, n, block):
+            starts = list(range(0, n, block))
+            do_prefetch = self._upload_prefetch_ok(scan)
+            for i, start in enumerate(starts):
                 end = min(start + block, n)
+                for name in device_col_names:
+                    if do_prefetch and i + 1 < len(starts):
+                        self._device_block(
+                            scan, name, starts[i + 1],
+                            min(starts[i + 1] + block, n), block,
+                            extra_cols,
+                            acc_dtype if name in float_fields else None,
+                            prefetch_only=True,
+                        )
                 cols = {}
                 for name in device_col_names:
                     cols[name] = self._device_block(
@@ -2182,10 +2249,23 @@ class PhysicalExecutor:
             ts_name=ts_name, tag_names=tag_names, schema=schema,
             acc_dtype=acc_dtype, float_ops=float_ops, pack_dtype=pack_dtype)
 
+    def _upload_prefetch_ok(self, scan) -> bool:
+        """Whether the dense block loops should double-buffer uploads:
+        the knob is on, the scan is cacheable (prefetch parks results in
+        the HBM cache), and the host tier is not active — the tier's
+        jax.default_device context is thread-scoped, so a background
+        build would land on the wrong device."""
+        from greptimedb_tpu.query.device_cache import upload_prefetch_enabled
+
+        return (upload_prefetch_enabled() and scan.region_id >= 0
+                and _ACTIVE_TIER_VAR.get() != "host")
+
     def _device_block(self, scan: ScanData, name, start, end, block,
-                      extra_cols, cast_dtype):
+                      extra_cols, cast_dtype, prefetch_only=False):
         """Fetch one padded column block, through the HBM block cache when
-        the scan snapshot is cacheable (named region + stable version)."""
+        the scan snapshot is cacheable (named region + stable version).
+        `prefetch_only`: schedule the build on the cache's background
+        worker (upload/compute double buffering) and return None."""
 
         def build():
             src = extra_cols[name] if name in extra_cols else scan.columns[name]
@@ -2195,12 +2275,17 @@ class PhysicalExecutor:
             return jnp.asarray(arr)
 
         if scan.region_id < 0 or name in extra_cols:
+            if prefetch_only:
+                return None  # uncacheable: nowhere to park the result
             out = build()
             # uncached upload (the cache counts its own miss-builds)
             device_telemetry.count_h2d(out.nbytes)
             return out
         key = (_ACTIVE_TIER_VAR.get(), scan.region_id, scan.data_version, scan.scan_fingerprint,
                name, start, block, str(cast_dtype))
+        if prefetch_only:
+            self.cache.prefetch(key, build)
+            return None
         return self.cache.get(key, build)
 
     def _prepared_ok(self, arg_exprs, ops, int_ops, schema,
@@ -2262,7 +2347,7 @@ class PhysicalExecutor:
         return out
 
     def _prep_plane(self, scan, arg_names, start, end, block, acc_dtype,
-                    has_nan: bool):
+                    has_nan: bool, prefetch_only=False):
         """Query-invariant value plane for the prepared path, cached in
         HBM alongside the raw column blocks (layout: _build_prep)."""
 
@@ -2271,13 +2356,16 @@ class PhysicalExecutor:
                                            block, acc_dtype, has_nan, None))
 
         if scan.region_id < 0:
-            return build()
+            return None if prefetch_only else build()
         key = (_ACTIVE_TIER_VAR.get(), scan.region_id, scan.data_version, scan.scan_fingerprint,
                "__prep__", arg_names, start, block, str(acc_dtype), has_nan)
+        if prefetch_only:
+            self.cache.prefetch(key, build)
+            return None
         return self.cache.get(key, build)
 
     def _prep_extreme_plane(self, scan, arg_names, start, end, block,
-                            acc_dtype, kind: str):
+                            acc_dtype, kind: str, prefetch_only=False):
         """min/max/sq companion plane: values with NaN (and padding)
         replaced by the reduction's identity (±inf for extremes, 0 for
         the squared-sum plane), so the dead-segment id trick is the only
@@ -2288,9 +2376,12 @@ class PhysicalExecutor:
                                            block, acc_dtype, False, kind))
 
         if scan.region_id < 0:
-            return build()
+            return None if prefetch_only else build()
         key = (_ACTIVE_TIER_VAR.get(), scan.region_id, scan.data_version, scan.scan_fingerprint,
                f"__prep_{kind}__", arg_names, start, block, str(acc_dtype))
+        if prefetch_only:
+            self.cache.prefetch(key, build)
+            return None
         return self.cache.get(key, build)
 
     def _device_columns(self, scan, bound_where, keys, arg_exprs, ts_name, extra_cols):

@@ -83,6 +83,14 @@ class EngineConfig:
     scan_cache_entries: int = field(
         default_factory=lambda: _env_int(
             "GREPTIMEDB_TPU_SCAN_CACHE_ENTRIES", 4))
+    # ---- scan pipeline ([scan] options) ----
+    # SST decode fan-out per scan; 0 = auto (min(8, cpu)), 1 = the
+    # sequential pre-pipeline path (storage/scan_pool.py; the env var
+    # GREPTIMEDB_TPU_SCAN_DECODE_THREADS overrides at scan time)
+    scan_decode_threads: int = 0
+    # byte budget for the per-file decoded-part LRU (incremental scan
+    # cache: a flush re-decodes only the files it added)
+    scan_part_cache_bytes: int = 1 << 30
     # object store backend for SSTs/manifest/index (reference
     # object-store crate; fs|memory|s3, optional LRU read cache)
     object_store: str = "fs"
@@ -174,6 +182,17 @@ class RegionEngine:
             raise KeyError(f"region {region_id} not open")
         return r
 
+    def _apply_scan_config(self, region) -> None:
+        """Push the engine's scan knobs onto a freshly opened region
+        (hasattr-guarded: alternate engines register non-Region
+        objects via openers)."""
+        for attr, value in (
+                ("scan_cache_entries", self.config.scan_cache_entries),
+                ("decode_threads", self.config.scan_decode_threads),
+                ("part_cache_budget", self.config.scan_part_cache_bytes)):
+            if hasattr(region, attr):
+                setattr(region, attr, value)
+
     # ---- handle_request (reference region_server.rs:120) -------------------
 
     def handle_request(self, req: RegionRequest) -> int:
@@ -194,7 +213,7 @@ class RegionEngine:
                     req.region_id, self._region_dir(req.region_id), req.schema,
                     self.wal, self.store
                 )
-                region.scan_cache_entries = self.config.scan_cache_entries
+                self._apply_scan_config(region)
                 self.regions[req.region_id] = region
                 return 0
             if req.kind is RequestType.OPEN:
@@ -202,17 +221,14 @@ class RegionEngine:
                     for opener in self.openers:
                         r = opener(req.region_id)
                         if r is not None:
-                            if hasattr(r, "scan_cache_entries"):
-                                r.scan_cache_entries = \
-                                    self.config.scan_cache_entries
+                            self._apply_scan_config(r)
                             self.regions[req.region_id] = r
                             return 0
                     region = Region.open(
                         req.region_id, self._region_dir(req.region_id),
                         self.wal, self.store
                     )
-                    region.scan_cache_entries = \
-                        self.config.scan_cache_entries
+                    self._apply_scan_config(region)
                     self.regions[req.region_id] = region
                 return 0
             if req.kind is RequestType.CLOSE:
@@ -345,6 +361,16 @@ class RegionEngine:
     ) -> Optional[ScanData]:
         return self.region(region_id).scan(ts_range, projection,
                                            tag_predicates, seq_min=seq_min)
+
+    def scan_last(self, region_id: int, group_tag: str,
+                  projection: Optional[Sequence[str]] = None,
+                  ) -> Optional[ScanData]:
+        """Lastpoint-pruned newest-first scan (see Region.scan_last);
+        None when the region type or data shape cannot serve it — the
+        caller falls back to the full scan."""
+        region = self.region(region_id)
+        fn = getattr(region, "scan_last", None)
+        return None if fn is None else fn(group_tag, projection)
 
     def ts_extent(self, region_id: int):
         """(min, max) data timestamps from metadata only (no data read)."""

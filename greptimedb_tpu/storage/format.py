@@ -19,7 +19,11 @@ import json
 import os
 
 #: current writer versions, per component
-FORMAT_VERSIONS = {"layout": 1, "sst": 1, "wal": 1, "manifest": 1}
+#: manifest v2: FileMeta grew `null_tags` (lastpoint NULL-group
+#: metadata) — v2 readers default it when absent, but a v1 reader's
+#: FileMeta(**d) would crash on the unknown key, so v2-written dirs
+#: must refuse cleanly under v1 builds
+FORMAT_VERSIONS = {"layout": 1, "sst": 1, "wal": 1, "manifest": 2}
 
 _STAMP = "FORMAT.json"
 

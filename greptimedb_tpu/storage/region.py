@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Optional, Sequence
@@ -37,6 +38,25 @@ OP_DELETE = 1
 
 class RegionDroppedError(RuntimeError):
     """Write raced a DROP: the region is gone; the write did not happen."""
+
+
+@dataclass
+class _PartEntry:
+    """One per-file decoded scan part: `part` is (cols, seq, op) for the
+    rows an SST contributes under a (ts_range, names, predicates) shape,
+    or None when the file prunes to nothing under that shape (cached too
+    — re-proving emptiness costs a parquet footer read)."""
+
+    part: Optional[tuple]
+    nbytes: int
+
+
+def _part_nbytes(part: Optional[tuple]) -> int:
+    if part is None:
+        return 64  # bookkeeping floor for cached pruned-empty entries
+    cols, seq, op = part
+    return sum(int(a.nbytes) for a in cols.values()) \
+        + int(seq.nbytes) + int(op.nbytes)
 
 
 @dataclass
@@ -153,6 +173,20 @@ class Region:
         # queries skip parquet decode entirely
         self._scan_cache: "OrderedDict[tuple, ScanData]" = OrderedDict()
         self.scan_cache_entries = 4  # overridden from EngineConfig
+        # per-file decoded-part cache: (file_id, ts_range, names, preds)
+        # -> _PartEntry, byte-budgeted LRU. SSTs are immutable, so an
+        # entry stays valid for the file's whole life — a flush only
+        # adds files, meaning a post-flush scan decodes ONLY the new
+        # file and concats the rest from here (the monolithic
+        # data_version-keyed cache above threw everything away on every
+        # mutation). Entries die with their file: compaction swap,
+        # retention expiry, and DROP/TRUNCATE call
+        # _invalidate_file_parts.
+        self._part_cache: "OrderedDict[tuple, _PartEntry]" = OrderedDict()
+        self._part_cache_bytes = 0
+        self.part_cache_budget = 1 << 30  # overridden from EngineConfig
+        # SST decode fan-out cap; 0 = auto (storage/scan_pool.py)
+        self.decode_threads = 0
 
     # ---- lifecycle ---------------------------------------------------------
 
@@ -192,7 +226,9 @@ class Region:
             self.wal.delete_region(self.region_id)
             for fid in list(self.files):
                 self.sst_reader.delete(fid)
+            self._invalidate_file_parts(list(self.files))
             self.files.clear()
+            self._scan_cache.clear()
 
     def close(self) -> None:
         """Release deferred resources (deleted-but-grace-held SSTs)."""
@@ -222,6 +258,187 @@ class Region:
                     self._file_refs[m.file_id] = n
             if self._purge_queue:
                 self._drain_purge()
+
+    # ---- per-file decoded-part cache + parallel decode ---------------------
+
+    def _part_cache_put(self, key: tuple, ent: _PartEntry) -> None:
+        """Insert under the byte budget (caller holds self._lock)."""
+        from greptimedb_tpu.utils.metrics import SCAN_PART_CACHE_EVENTS
+
+        if ent.nbytes > self.part_cache_budget:
+            return  # one oversized part must not wipe the whole cache
+        old = self._part_cache.pop(key, None)
+        if old is not None:
+            self._part_cache_bytes -= old.nbytes
+        self._part_cache[key] = ent
+        self._part_cache_bytes += ent.nbytes
+        evicted = 0
+        while self._part_cache_bytes > self.part_cache_budget \
+                and self._part_cache:
+            _, e = self._part_cache.popitem(last=False)
+            self._part_cache_bytes -= e.nbytes
+            evicted += 1
+        if evicted:
+            SCAN_PART_CACHE_EVENTS.inc(float(evicted), event="evict")
+
+    def _invalidate_file_parts(self, file_ids) -> None:
+        """Drop part-cache entries for removed SSTs (compaction swap,
+        retention expiry, DROP/TRUNCATE). Caller holds self._lock."""
+        gone = set(file_ids)
+        for k in [k for k in self._part_cache if k[0] in gone]:
+            ent = self._part_cache.pop(k)
+            self._part_cache_bytes -= ent.nbytes
+
+    def _decode_file_part(self, meta: FileMeta, ts_range, names,
+                          tag_predicates) -> Optional[tuple]:
+        """Read+decode one SST into host columns (the per-file body the
+        old scan loop ran serially). Returns (cols, seq, op) or None
+        when pruning/filtering leaves nothing."""
+        from greptimedb_tpu.utils.metrics import (
+            SCAN_DECODE_BYTES,
+            SCAN_DECODE_SECONDS,
+        )
+
+        ts_name = self.schema.time_index.name
+        with SCAN_DECODE_SECONDS.time():
+            table = self.sst_reader.read(meta, self.schema, ts_range, names,
+                                         tag_predicates=tag_predicates)
+            if table is None or table.num_rows == 0:
+                return None
+            cols = self._decode_sst(table, names)
+            seq_col = table.column(SEQ_COL).to_numpy(
+                zero_copy_only=False).astype(np.int64)
+            op_col = table.column(OP_COL).to_numpy(
+                zero_copy_only=False).astype(np.int8)
+            if ts_range is not None:
+                # exact row filter: SSTs sort by (pk, ts), so a row
+                # group from one large flush can span the whole time
+                # range and row-group stats cannot prune it — drop
+                # out-of-range rows here so downstream (device
+                # transfer + kernels) only sees the queried window.
+                # All versions/tombstones of an instant share its ts,
+                # so LWW dedup still sees every candidate.
+                tsv = cols[ts_name]
+                # [lo, hi) — extract_ts_bounds emits half-open upper
+                # bounds (ts <= v becomes hi = v+1), matching every
+                # other pruner here (sst/memtable/scan_stream)
+                m = (tsv >= ts_range[0]) & (tsv < ts_range[1])
+                if not m.all():
+                    if not m.any():
+                        return None
+                    cols = {n: v[m] for n, v in cols.items()}
+                    seq_col = seq_col[m]
+                    op_col = op_col[m]
+        part = (cols, seq_col, op_col)
+        SCAN_DECODE_BYTES.inc(float(_part_nbytes(part)))
+        return part
+
+    def _decode_parts(self, metas, ts_range, names,
+                      tag_predicates) -> tuple[list, int]:
+        """Decode several SSTs, fanning across the shared per-datanode
+        pool (storage/scan_pool.py). Returns (parts in `metas` order,
+        distinct workers observed). decode_threads=1 — or a single file
+        — decodes inline, byte-for-byte the sequential path.
+
+        Fault discipline: every submitted future is WAITED ON before
+        this returns or raises, so no worker touches SST bytes after
+        the caller's unpin; the first error in file order propagates
+        (typed FaultError/Unavailable from objectstore.read included),
+        exactly as the serial loop raised it."""
+        from greptimedb_tpu.storage import scan_pool
+
+        threads = scan_pool.resolve(self.decode_threads, len(metas))
+        if threads <= 1 or len(metas) <= 1:
+            return ([self._decode_file_part(m, ts_range, names,
+                                            tag_predicates)
+                     for m in metas], 1)
+        pool = scan_pool.get(threads)
+        seen: set = set()
+
+        def work(meta):
+            seen.add(threading.get_ident())
+            return self._decode_file_part(meta, ts_range, names,
+                                          tag_predicates)
+
+        futs = [pool.submit(work, m) for m in metas]
+        results: list = []
+        first_err = None
+        for f in futs:
+            try:
+                results.append(f.result())
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                results.append(None)
+                if first_err is None:
+                    first_err = e
+        if first_err is not None:
+            raise first_err
+        return results, max(1, len(seen))
+
+    def _concat_columns(self, names, parts_cols) -> dict:
+        """Assemble the whole-scan columns from per-file parts. Columns
+        are independent, so the concat copies fan across the decode
+        pool too (numpy releases the GIL for the memcpy) — on the
+        incremental path this copy IS the remaining scan cost."""
+        from greptimedb_tpu.storage import scan_pool
+
+        threads = scan_pool.resolve(self.decode_threads, len(names))
+        if threads <= 1 or len(names) <= 1:
+            return {n: np.concatenate([p[n] for p in parts_cols])
+                    for n in names}
+        pool = scan_pool.get(threads)
+        futs = {n: pool.submit(
+            np.concatenate, [p[n] for p in parts_cols]) for n in names}
+        return {n: f.result() for n, f in futs.items()}
+
+    def _cached_parts(self, file_list, ts_range, names, pred_key,
+                      tag_predicates, insert: bool = True
+                      ) -> tuple[list, dict]:
+        """Per-file decoded parts for `file_list` (which the caller has
+        pinned), through the part cache; misses decode in parallel.
+        `insert=False` reuses hits but keeps misses out of the cache
+        (compaction reads its soon-to-be-removed inputs once — caching
+        them would evict warm query parts for zero retained value).
+        Returns (list of _PartEntry aligned with file_list, stats)."""
+        from greptimedb_tpu.utils.metrics import SCAN_PART_CACHE_EVENTS
+
+        keys = [(m.file_id, ts_range, tuple(names), pred_key)
+                for m in file_list]
+        parts: list = [None] * len(file_list)
+        hits = 0
+        with self._lock:
+            for i, k in enumerate(keys):
+                ent = self._part_cache.get(k)
+                if ent is not None:
+                    self._part_cache.move_to_end(k)
+                    parts[i] = ent
+                    hits += 1
+        missing = [i for i in range(len(file_list)) if parts[i] is None]
+        workers = 0
+        t0 = time.perf_counter()
+        if missing:
+            decoded, workers = self._decode_parts(
+                [file_list[i] for i in missing], ts_range, names,
+                tag_predicates)
+            with self._lock:
+                for i, part in zip(missing, decoded):
+                    ent = _PartEntry(part, _part_nbytes(part))
+                    parts[i] = ent
+                    # a scan races compaction/expiry: its pinned files
+                    # may have been removed (and invalidated) while it
+                    # decoded — inserting then would strand dead
+                    # entries in the budget forever
+                    if insert and file_list[i].file_id in self.files:
+                        self._part_cache_put(keys[i], ent)
+        if hits:
+            SCAN_PART_CACHE_EVENTS.inc(float(hits), event="hit")
+        if missing:
+            SCAN_PART_CACHE_EVENTS.inc(float(len(missing)), event="miss")
+        return parts, {
+            "part_hits": hits,
+            "files_decoded": len(missing),
+            "decode_workers": workers,
+            "decode_s": round(time.perf_counter() - t0, 4),
+        }
 
     # ---- write -------------------------------------------------------------
 
@@ -318,17 +535,31 @@ class Region:
         """Read `group`'s SSTs, sort-dedup on device, rewrite as one L1
         file, swap in the manifest (compaction/task.rs analog)."""
         names = self.schema.names
+        from greptimedb_tpu.storage.index import predicates_cache_key
+
+        # the merge reads full files with no range/predicates — exactly
+        # the shape a full scan caches, so compaction REUSES warm scan
+        # parts and decodes cold inputs in parallel; insert=False keeps
+        # its one-shot inputs from evicting warm query entries
+        with self._lock:
+            self._pin_files(group)
+        try:
+            entries, _ = self._cached_parts(
+                group, None, names, predicates_cache_key(None), None,
+                insert=False)
+        finally:
+            self._unpin_files(group)
         parts_cols, parts_seq, parts_op = [], [], []
-        for meta in group:
-            table = self.sst_reader.read(meta, self.schema, None, names)
-            if table is None or table.num_rows == 0:
+        for ent in entries:
+            if ent.part is None:
                 continue
-            parts_cols.append(self._decode_sst(table, names))
-            parts_seq.append(table.column(SEQ_COL).to_numpy(zero_copy_only=False).astype(np.int64))
-            parts_op.append(table.column(OP_COL).to_numpy(zero_copy_only=False).astype(np.int8))
+            cols_p, seq_p, op_p = ent.part
+            parts_cols.append(cols_p)
+            parts_seq.append(seq_p)
+            parts_op.append(op_p)
         if not parts_cols:
             return None
-        columns = {n: np.concatenate([p[n] for p in parts_cols]) for n in names}
+        columns = self._concat_columns(names, parts_cols)
         seq = np.concatenate(parts_seq)
         op = np.concatenate(parts_op)
         n_rows = len(seq)
@@ -373,6 +604,9 @@ class Region:
             for fid in removed:
                 self.files.pop(fid, None)
             self.files[meta.file_id] = meta
+            # the inputs' decoded parts die with them — a later scan
+            # must decode the merged output, never concat stale inputs
+            self._invalidate_file_parts(removed)
             # flushed_seq=None: this edit persists NO memtable rows —
             # advancing it here would mark concurrent unflushed writes
             # replay-obsolete (acked-write loss on crash)
@@ -486,48 +720,28 @@ class Region:
             file_list = list(self.files.values())
             self._pin_files(file_list)
             mem = self.memtable.concat(ts_range)
+        # parallel decode through the per-file part cache: misses fan
+        # across the shared pool, hits are free, and the assembly below
+        # preserves the exact serial part order (so LWW dedup, the
+        # sorted part_offsets contract, and fault propagation order all
+        # behave as the old one-file-at-a-time loop did)
+        try:
+            part_entries, decode_stats = self._cached_parts(
+                file_list, ts_range, names, pred_key, tag_predicates)
+        finally:
+            self._unpin_files(file_list)
         parts_cols: list[dict[str, np.ndarray]] = []
         parts_seq: list[np.ndarray] = []
         parts_op: list[np.ndarray] = []
         sst_part_lens: list[int] = []
-
-        ts_name = self.schema.time_index.name
-        try:
-            for meta in file_list:
-                table = self.sst_reader.read(meta, self.schema, ts_range, names,
-                                             tag_predicates=tag_predicates)
-                if table is None or table.num_rows == 0:
-                    continue
-                cols = self._decode_sst(table, names)
-                seq_col = table.column(SEQ_COL).to_numpy(
-                    zero_copy_only=False).astype(np.int64)
-                op_col = table.column(OP_COL).to_numpy(
-                    zero_copy_only=False).astype(np.int8)
-                if ts_range is not None:
-                    # exact row filter: SSTs sort by (pk, ts), so a row
-                    # group from one large flush can span the whole time
-                    # range and row-group stats cannot prune it — drop
-                    # out-of-range rows here so downstream (device
-                    # transfer + kernels) only sees the queried window.
-                    # All versions/tombstones of an instant share its ts,
-                    # so LWW dedup still sees every candidate.
-                    tsv = cols[ts_name]
-                    # [lo, hi) — extract_ts_bounds emits half-open upper
-                    # bounds (ts <= v becomes hi = v+1), matching every
-                    # other pruner here (sst/memtable/scan_stream)
-                    m = (tsv >= ts_range[0]) & (tsv < ts_range[1])
-                    if not m.all():
-                        if not m.any():
-                            continue
-                        cols = {n: v[m] for n, v in cols.items()}
-                        seq_col = seq_col[m]
-                        op_col = op_col[m]
-                parts_cols.append(cols)
-                parts_seq.append(seq_col)
-                parts_op.append(op_col)
-                sst_part_lens.append(len(seq_col))
-        finally:
-            self._unpin_files(file_list)
+        for ent in part_entries:
+            if ent.part is None:
+                continue
+            cols, seq_col, op_col = ent.part
+            parts_cols.append(cols)
+            parts_seq.append(seq_col)
+            parts_op.append(op_col)
+            sst_part_lens.append(len(seq_col))
 
         if mem is not None:
             mcols, mseq, mop = mem
@@ -545,8 +759,7 @@ class Region:
             seq = parts_seq[0]
             op = parts_op[0]
         else:
-            columns = {n: np.concatenate([p[n] for p in parts_cols])
-                       for n in names}
+            columns = self._concat_columns(names, parts_cols)
             seq = np.concatenate(parts_seq)
             op = np.concatenate(parts_op)
         part_offsets = np.cumsum([0] + sst_part_lens)
@@ -590,7 +803,196 @@ class Region:
             sorted_part_offsets=tuple(int(o) for o in part_offsets),
             stats={"ssts": len(file_list),
                    "ssts_pruned": len(file_list) - len(sst_part_lens),
-                   "cache_hits": 0},
+                   "cache_hits": 0,
+                   **decode_stats},
+        )
+        with self._lock:
+            self._scan_cache[cache_key] = result
+            while len(self._scan_cache) > self.scan_cache_entries:
+                self._scan_cache.popitem(last=False)
+        return result
+
+    def scan_last(self, group_tag: str,
+                  projection: Optional[Sequence[str]] = None,
+                  ) -> Optional[ScanData]:
+        """Lastpoint-pruned scan: visit SSTs NEWEST-FIRST (FileMeta
+        ts_max order) and stop once every series grouped by `group_tag`
+        provably holds its last row in the visited set — instead of
+        decoding the whole table for a handful of winner rows (TSBS
+        `lastpoint` is the user; the reference's merge reader gets the
+        same effect from per-file last-row semantics).
+
+        Termination argument: files are visited in descending ts_max,
+        so every unvisited file only holds rows with ts <= the next
+        file's ts_max. Once a series has a candidate with ts STRICTLY
+        above that bound (strict: an equal ts in an older file could
+        carry a higher seq and win LWW), no unvisited file can hold its
+        winner — or any version of the winning instant, so the subset
+        dedup picks the true row. The known-series set is the tag
+        registry's value list (a superset of live values; codes with no
+        surviving rows block early stop, which costs pruning, never
+        correctness). NULL-tag rows form a group the registry cannot
+        name: FileMeta.null_tags says which files may hold them
+        (None = pre-upgrade file, assumed to), and termination also
+        waits for the NULL group whenever an unvisited file might
+        contribute to it.
+
+        Returns None when the path cannot serve the query exactly —
+        any DELETE tombstone in the visited rows or memtable (the
+        newest row may be a tombstone, making an interior row the
+        answer) — and the caller falls back to the full scan."""
+        names = self._scan_columns(projection)
+        tag_names = [c.name for c in self.schema.tag_columns]
+        if group_tag not in tag_names or group_tag not in names:
+            return None
+        from greptimedb_tpu.storage.index import predicates_cache_key
+        pred_key = predicates_cache_key(None)
+        ts_name = self.schema.time_index.name
+        with self._lock:
+            version = self.data_version
+            cache_key = ("lastpoint", version, group_tag, tuple(names))
+            cached = self._scan_cache.get(cache_key)
+            if cached is not None:
+                self._scan_cache.move_to_end(cache_key)
+                if cached.stats is not None:
+                    cached.stats["cache_hits"] += 1
+                return cached
+            # deterministic newest-first order (ties broken by id so
+            # parallel and serial runs visit identical prefixes)
+            file_list = sorted(
+                self.files.values(),
+                key=lambda m: (m.ts_max, m.max_seq, m.file_id),
+                reverse=True)
+            self._pin_files(file_list)
+            mem = self.memtable.concat(None)
+            card = self.registry.cardinality(group_tag)
+        # suffix_null[i]: may any of file_list[i:] hold NULL group_tag?
+        suffix_null = [False] * (len(file_list) + 1)
+        for i in range(len(file_list) - 1, -1, -1):
+            m = file_list[i]
+            has = m.null_tags is None or group_tag in m.null_tags
+            suffix_null[i] = suffix_null[i + 1] or has
+        # best[0] = newest ts seen for the NULL group, best[1 + code]
+        # for each registry code; int64 min = "never seen"
+        floor = np.iinfo(np.int64).min
+        best = np.full(card + 1, floor, dtype=np.int64)
+
+        def fold(codes: np.ndarray, ts: np.ndarray) -> None:
+            nonlocal best
+            if codes.size == 0:
+                return
+            slot = codes.astype(np.int64) + 1
+            mx = int(slot.max())
+            if mx >= best.size:
+                # a file dictionary introduced values the registry
+                # snapshot predates — grow; they were seen here, so
+                # their termination entries are live
+                best = np.concatenate(
+                    [best, np.full(mx + 1 - best.size, floor,
+                                   dtype=np.int64)])
+            np.maximum.at(best, slot, ts.astype(np.int64))
+
+        aborted = False
+        if mem is not None:
+            mcols, _mseq, mop = mem
+            if bool((mop != OP_PUT).any()):
+                aborted = True
+            else:
+                fold(np.asarray(mcols[group_tag]),
+                     np.asarray(mcols[ts_name]))
+        visited_entries: list = []
+        visited = 0
+        part_hits = files_decoded = 0
+        workers = 1
+        try:
+            from greptimedb_tpu.storage import scan_pool
+
+            while not aborted and visited < len(file_list):
+                # decode in waves of the pool width: parallelism inside
+                # a wave, the early-stop check between waves (a wave may
+                # over-read at most threads-1 files past the stop point)
+                threads = scan_pool.resolve(self.decode_threads,
+                                            len(file_list) - visited)
+                wave = file_list[visited:visited + max(1, threads)]
+                parts, st = self._cached_parts(wave, None, names,
+                                               pred_key, None)
+                part_hits += st["part_hits"]
+                files_decoded += st["files_decoded"]
+                workers = max(workers, st["decode_workers"])
+                for ent in parts:
+                    visited_entries.append(ent)
+                    if ent.part is None:
+                        continue
+                    cols, _seq_col, op_col = ent.part
+                    if bool((op_col != OP_PUT).any()):
+                        aborted = True
+                        break
+                    fold(np.asarray(cols[group_tag]),
+                         np.asarray(cols[ts_name]))
+                visited += len(wave)
+                if aborted or visited >= len(file_list):
+                    break
+                nxt = file_list[visited].ts_max
+                if bool((best[1:] > nxt).all()) and \
+                        (not suffix_null[visited] or best[0] > nxt):
+                    break
+        finally:
+            self._unpin_files(file_list)
+        if aborted:
+            return None  # tombstones: caller runs the full scan
+        parts_cols: list = []
+        parts_seq: list = []
+        parts_op: list = []
+        sst_part_lens: list = []
+        for ent in visited_entries:
+            if ent.part is None:
+                continue
+            cols, seq_col, op_col = ent.part
+            parts_cols.append(cols)
+            parts_seq.append(seq_col)
+            parts_op.append(op_col)
+            sst_part_lens.append(len(seq_col))
+        if mem is not None:
+            mcols, mseq, mop = mem
+            parts_cols.append({n: mcols[n] for n in names})
+            parts_seq.append(mseq)
+            parts_op.append(mop)
+        if not parts_cols:
+            return None
+        if len(parts_cols) == 1:
+            columns = dict(parts_cols[0])
+            seq = parts_seq[0]
+            op = parts_op[0]
+        else:
+            columns = self._concat_columns(names, parts_cols)
+            seq = np.concatenate(parts_seq)
+            op = np.concatenate(parts_op)
+        part_offsets = np.cumsum([0] + sst_part_lens)
+        tag_dicts = {
+            c.name: self.registry.dict_array(c.name)
+            for c in self.schema.tag_columns
+            if c.name in names
+        }
+        result = ScanData(
+            schema=self.schema,
+            columns=columns,
+            seq=seq,
+            op_type=op,
+            tag_dicts=tag_dicts,
+            num_rows=len(seq),
+            region_id=self.region_id,
+            data_version=version,
+            # distinct from any full scan: the row set is pruned, so
+            # device blocks must never be shared with full-scan keys
+            scan_fingerprint=("lastpoint", group_tag, tuple(names)),
+            sorted_part_offsets=tuple(int(o) for o in part_offsets),
+            stats={"ssts": len(file_list),
+                   "ssts_pruned": len(file_list) - visited,
+                   "cache_hits": 0,
+                   "lastpoint_visited": visited,
+                   "part_hits": part_hits,
+                   "files_decoded": files_decoded,
+                   "decode_workers": workers},
         )
         with self._lock:
             self._scan_cache[cache_key] = result
@@ -656,8 +1058,7 @@ class Region:
             seq = parts_seq[0]
             op = parts_op[0]
         else:
-            columns = {n: np.concatenate([p[n] for p in parts_cols])
-                       for n in names}
+            columns = self._concat_columns(names, parts_cols)
             seq = np.concatenate(parts_seq)
             op = np.concatenate(parts_op)
         part_offsets = np.cumsum([0] + sst_part_lens)

@@ -49,6 +49,13 @@ class FileMeta:
     max_seq: int
     level: int = 0
     size_bytes: int = 0
+    # tag columns holding any NULL (-1) code in this file, or None when
+    # unknown (files written before this field existed). The lastpoint
+    # newest-first pruner needs it: NULL-tag rows form a group the
+    # registry's cardinality cannot account for, so a file that might
+    # hold them blocks early termination unless the NULL group already
+    # has a newer candidate.
+    null_tags: Optional[list] = None
 
     def to_dict(self) -> dict:
         return self.__dict__.copy()
@@ -152,6 +159,11 @@ class SstWriter:
             n,
         )
         ts = np.asarray(columns[ts_name])
+        null_tags = [
+            c.name for c in self.schema.tag_columns
+            if n and bool((np.asarray(columns[c.name],
+                                      dtype=np.int32) < 0).any())
+        ]
         return FileMeta(
             file_id=file_id,
             num_rows=n,
@@ -160,6 +172,7 @@ class SstWriter:
             max_seq=int(np.max(seq)) if n else 0,
             level=level,
             size_bytes=self.store.size(path),
+            null_tags=null_tags,
         )
 
 

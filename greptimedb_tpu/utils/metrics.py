@@ -278,10 +278,32 @@ DEVICE_TRANSFER_BYTES = REGISTRY.counter(
     "(h2d uploads of scan blocks, d2h result readbacks)")
 DEVICE_CACHE_EVENTS = REGISTRY.counter(
     "greptimedb_tpu_device_cache_events_total",
-    "HBM block cache events by kind (hit/miss/evict)")
+    "HBM block cache events by kind (hit/miss/evict/prefetch_join — a "
+    "join is an upload the background prefetch worker already did)")
 SLOW_QUERIES = REGISTRY.counter(
     "greptimedb_tpu_slow_queries_total",
     "Statements slower than the slow-query threshold, by kind")
+
+# scan pipeline (storage/region.py + query/device_cache.py): the cold
+# scan is the wall on first-touch queries (BENCH r03: 20.2s of a 27.5s
+# statement inside scan) — these series prove the three pipeline stages
+# (parallel SST decode, per-file part cache, upload prefetch) are doing
+# their jobs
+SCAN_DECODE_SECONDS = REGISTRY.histogram(
+    "greptimedb_tpu_scan_decode_seconds",
+    "Per-SST parquet read+decode wall time inside the region scan "
+    "(cache misses only; parallel decodes observe concurrently)")
+SCAN_PART_CACHE_EVENTS = REGISTRY.counter(
+    "greptimedb_tpu_scan_part_cache_events_total",
+    "Per-file decoded-part scan cache events by kind (hit/miss/evict)")
+SCAN_DECODE_BYTES = REGISTRY.counter(
+    "greptimedb_tpu_scan_decode_bytes_total",
+    "Host bytes materialized by SST scan decode (part-cache misses)")
+SCAN_PIPELINE_OVERLAP = REGISTRY.gauge(
+    "greptimedb_tpu_scan_pipeline_overlap",
+    "Fraction of prefetched device block uploads already built when the "
+    "query asked for them (1.0 = host build fully hidden behind "
+    "upload/compute; cumulative ratio since process start)")
 
 # background maintenance plane (maintenance/ package): job throughput,
 # queue pressure, writer stalls, and the rollup/retention outcomes —

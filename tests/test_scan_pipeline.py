@@ -1,0 +1,460 @@
+"""Pipelined parallel scan (ISSUE 5): concurrent SST decode through the
+shared pool, the per-file decoded-part cache under mutation
+(flush/compaction/expiry/DELETE/TRUNCATE), typed degradation under
+injected objectstore.read faults, upload prefetch double buffering, and
+the lastpoint newest-first pruned scan."""
+
+import os
+
+import numpy as np
+import pytest
+
+from greptimedb_tpu.datatypes import (
+    ColumnSchema,
+    DataType,
+    DictVector,
+    RecordBatch,
+    Schema,
+    SemanticType,
+)
+from greptimedb_tpu.storage import RegionEngine
+from greptimedb_tpu.storage.engine import EngineConfig
+
+
+def schema3():
+    return Schema([
+        ColumnSchema("ts", DataType.TIMESTAMP_MILLISECOND,
+                     SemanticType.TIMESTAMP),
+        ColumnSchema("host", DataType.STRING, SemanticType.TAG),
+        ColumnSchema("v", DataType.FLOAT64),
+    ])
+
+
+def make_batch(schema, hosts, ts, vals):
+    return RecordBatch(schema, {
+        "ts": np.asarray(ts, dtype=np.int64),
+        "host": DictVector.encode(hosts),
+        "v": np.asarray(vals, dtype=np.float64),
+    })
+
+
+@pytest.fixture
+def engine(tmp_path):
+    eng = RegionEngine(EngineConfig(data_dir=str(tmp_path / "data"),
+                                    maintenance_workers=0))
+    yield eng
+    eng.close()
+
+
+def fill_files(engine, rid, n_files=4, rows_per_file=300, hosts=6,
+               t0=0):
+    """n_files time-disjoint SSTs, every host in every file."""
+    schema = engine.region(rid).schema
+    for f in range(n_files):
+        names = [f"h{i % hosts}" for i in range(rows_per_file)]
+        ts = (t0 + f * 1_000_000
+              + np.arange(rows_per_file, dtype=np.int64) * 10)
+        vals = np.arange(rows_per_file, dtype=np.float64) + f * 1000
+        engine.put(rid, make_batch(schema, names, ts, vals))
+        engine.flush(rid)
+
+
+def clear_scan_caches(region):
+    with region._lock:
+        region._scan_cache.clear()
+        region._part_cache.clear()
+        region._part_cache_bytes = 0
+
+
+def scans_equal(a, b) -> bool:
+    if a.num_rows != b.num_rows:
+        return False
+    if a.sorted_part_offsets != b.sorted_part_offsets:
+        return False
+    for k in a.columns:
+        if not np.array_equal(np.asarray(a.columns[k]),
+                              np.asarray(b.columns[k])):
+            return False
+    return (np.array_equal(a.seq, b.seq)
+            and np.array_equal(a.op_type, b.op_type))
+
+
+class TestParallelDecode:
+    def test_parallel_matches_sequential_bit_for_bit(self, engine,
+                                                     monkeypatch):
+        engine.create_region(1, schema3())
+        fill_files(engine, 1)
+        region = engine.region(1)
+        monkeypatch.setenv("GREPTIMEDB_TPU_SCAN_DECODE_THREADS", "1")
+        clear_scan_caches(region)
+        seq = engine.scan(1)
+        assert seq.stats["decode_workers"] == 1
+        monkeypatch.setenv("GREPTIMEDB_TPU_SCAN_DECODE_THREADS", "4")
+        clear_scan_caches(region)
+        par = engine.scan(1)
+        assert scans_equal(seq, par)
+        # ts-ranged and projected scans too
+        for kwargs in ({"ts_range": (1_000_000, 2_000_500)},
+                       {"projection": ["v"]}):
+            monkeypatch.setenv("GREPTIMEDB_TPU_SCAN_DECODE_THREADS", "1")
+            clear_scan_caches(region)
+            a = engine.scan(1, **kwargs)
+            monkeypatch.setenv("GREPTIMEDB_TPU_SCAN_DECODE_THREADS", "4")
+            clear_scan_caches(region)
+            b = engine.scan(1, **kwargs)
+            assert scans_equal(a, b)
+
+    def test_decode_pool_actually_exercised(self, engine, monkeypatch):
+        """Tier-1 speed guard: a multi-SST region's cold scan must run
+        on >1 pool worker — a refactor silently re-serializing the
+        path fails here, not in a bench round."""
+        engine.create_region(1, schema3())
+        fill_files(engine, 1, n_files=6)
+        monkeypatch.setenv("GREPTIMEDB_TPU_SCAN_DECODE_THREADS", "4")
+        region = engine.region(1)
+        # a couple of attempts: tiny decodes can legitimately finish on
+        # one worker before the second picks a task up
+        for _ in range(5):
+            clear_scan_caches(region)
+            scan = engine.scan(1)
+            if scan.stats["decode_workers"] > 1:
+                break
+        assert scan.stats["decode_workers"] > 1, scan.stats
+        assert scan.stats["files_decoded"] == 6
+
+    def test_compaction_reads_through_part_cache(self, engine):
+        engine.create_region(1, schema3())
+        fill_files(engine, 1)
+        warm = engine.scan(1)  # fills per-file parts
+        from greptimedb_tpu.utils.metrics import SCAN_PART_CACHE_EVENTS
+
+        before = SCAN_PART_CACHE_EVENTS.get(event="hit")
+        engine.compact(1)
+        assert SCAN_PART_CACHE_EVENTS.get(event="hit") >= before + 4
+        # merged output equals the pre-compaction rows (append region)
+        after = engine.scan(1)
+        assert after.num_rows == warm.num_rows
+
+
+class TestPartCacheMutation:
+    def test_parts_survive_unrelated_flush(self, engine):
+        engine.create_region(1, schema3())
+        fill_files(engine, 1, n_files=3)
+        region = engine.region(1)
+        engine.scan(1)
+        assert len(region._part_cache) == 3
+        # unrelated flush: a NEW file appears, old entries stay
+        engine.put(1, make_batch(region.schema, ["h0"], [99_000_000],
+                                 [5.0]))
+        engine.flush(1)
+        scan = engine.scan(1)
+        assert scan.stats["files_decoded"] == 1
+        assert scan.stats["part_hits"] == 3
+        # and the incremental assembly is correct
+        assert scan.num_rows == 3 * 300 + 1
+
+    def test_compaction_invalidates_input_parts(self, engine):
+        engine.create_region(1, schema3())
+        fill_files(engine, 1, n_files=3)
+        region = engine.region(1)
+        engine.scan(1)
+        old_ids = set(region.files)
+        engine.compact(1)  # full merge
+        cached_files = {k[0] for k in region._part_cache}
+        assert not (cached_files & old_ids)
+        scan = engine.scan(1)
+        assert scan.num_rows == 3 * 300
+
+    def test_expiry_invalidates_parts(self, engine):
+        from greptimedb_tpu.maintenance.retention import run_expiry
+
+        engine.create_region(1, schema3())
+        fill_files(engine, 1, n_files=3)
+        region = engine.region(1)
+        engine.scan(1)
+        assert len(region._part_cache) == 3
+        # cutoff between file 0 and file 1 (file ts in units of ms)
+        ttl_ms = 1
+        newest = max(m.ts_max for m in region.files.values())
+        res = run_expiry(region, ttl_ms,
+                         now_ms=newest - 1_000_000 + ttl_ms)
+        assert res["removed"] >= 1
+        cached_files = {k[0] for k in region._part_cache}
+        assert cached_files <= set(region.files)
+        scan = engine.scan(1)
+        assert scan.stats["ssts"] == len(region.files)
+
+    def test_delete_served_from_memtable_delta(self, engine):
+        """DELETE writes tombstones to the memtable: cached per-file
+        parts stay valid and the scan's memtable delta carries the
+        tombstone (LWW dedup applies it downstream)."""
+        from greptimedb_tpu.storage.region import OP_DELETE
+
+        engine.create_region(1, schema3())
+        fill_files(engine, 1, n_files=2)
+        region = engine.region(1)
+        engine.scan(1)
+        engine.delete(1, make_batch(region.schema, ["h0"], [0], [0.0]))
+        scan = engine.scan(1)
+        assert scan.stats["files_decoded"] == 0  # parts reused
+        assert (scan.op_type == OP_DELETE).sum() == 1
+
+    def test_truncate_drop_clears_caches(self, engine):
+        engine.create_region(1, schema3())
+        fill_files(engine, 1, n_files=2)
+        region = engine.region(1)
+        engine.scan(1)
+        assert region._part_cache
+        from greptimedb_tpu.storage.engine import RegionRequest, RequestType
+
+        engine.handle_request(RegionRequest(RequestType.DROP, 1))
+        assert not region._part_cache
+        assert not region._scan_cache
+
+    def test_byte_budget_evicts_lru(self, engine):
+        engine.create_region(1, schema3())
+        fill_files(engine, 1, n_files=4)
+        region = engine.region(1)
+        full = engine.scan(1)
+        one_part = region._part_cache[next(iter(region._part_cache))]
+        # budget for ~2 parts: older entries must age out
+        region.part_cache_budget = one_part.nbytes * 2 + 1
+        from greptimedb_tpu.utils.metrics import SCAN_PART_CACHE_EVENTS
+
+        before = SCAN_PART_CACHE_EVENTS.get(event="evict")
+        clear_scan_caches(region)
+        scan = engine.scan(1)
+        assert SCAN_PART_CACHE_EVENTS.get(event="evict") > before
+        assert region._part_cache_bytes <= region.part_cache_budget
+        assert scan.num_rows == full.num_rows  # eviction never drops rows
+
+
+@pytest.mark.chaos
+class TestFaultedDecode:
+    def test_read_fault_degrades_typed_and_unpins(self, engine,
+                                                  monkeypatch):
+        from greptimedb_tpu.fault import FAULTS, Fault, FaultError
+
+        engine.create_region(1, schema3())
+        fill_files(engine, 1, n_files=4)
+        region = engine.region(1)
+        monkeypatch.setenv("GREPTIMEDB_TPU_SCAN_DECODE_THREADS", "4")
+        clear_scan_caches(region)
+        # retries exhaust: every read of one schedule's window fails
+        FAULTS.arm("objectstore.read", Fault(kind="fail", prob=1.0))
+        try:
+            with pytest.raises(FaultError):
+                engine.scan(1)
+        finally:
+            FAULTS.disarm("objectstore.read")
+        # pin discipline: every worker finished before the unpin; no
+        # file is left pinned by the failed scan
+        assert not region._file_refs
+        # disarmed: the same scan succeeds (and decodes all files)
+        clear_scan_caches(region)
+        scan = engine.scan(1)
+        assert scan.stats["files_decoded"] == 4
+
+    def test_latency_fault_keeps_results_identical(self, engine,
+                                                   monkeypatch):
+        from greptimedb_tpu.fault import FAULTS, Fault
+
+        engine.create_region(1, schema3())
+        fill_files(engine, 1, n_files=4)
+        region = engine.region(1)
+        monkeypatch.setenv("GREPTIMEDB_TPU_SCAN_DECODE_THREADS", "1")
+        clear_scan_caches(region)
+        oracle = engine.scan(1)
+        monkeypatch.setenv("GREPTIMEDB_TPU_SCAN_DECODE_THREADS", "4")
+        FAULTS.arm("objectstore.read",
+                   Fault(kind="latency", arg=0.01, prob=0.5, seed=7))
+        try:
+            clear_scan_caches(region)
+            jittered = engine.scan(1)
+        finally:
+            FAULTS.disarm("objectstore.read")
+        assert scans_equal(oracle, jittered)
+
+
+class TestScanLast:
+    def test_visits_only_newest_needed(self, engine, monkeypatch):
+        # threads=1 -> decode waves of one file: the stop condition is
+        # checked after every file, so exactly ONE file is visited
+        monkeypatch.setenv("GREPTIMEDB_TPU_SCAN_DECODE_THREADS", "1")
+        engine.create_region(1, schema3())
+        fill_files(engine, 1, n_files=4)  # every host in every file
+        scan = engine.scan_last(1, "host")
+        assert scan is not None
+        assert scan.stats["lastpoint_visited"] == 1
+        assert scan.stats["ssts"] == 4
+
+    def test_series_only_in_old_file_forces_deeper_visit(self, engine):
+        engine.create_region(1, schema3())
+        region = engine.region(1)
+        s = region.schema
+        engine.put(1, make_batch(s, ["h_old"], [100], [1.0]))
+        engine.flush(1)
+        fill_files(engine, 1, n_files=2, t0=1_000_000)
+        scan = engine.scan_last(1, "host")
+        # h_old only exists in the oldest file: every file visited
+        assert scan.stats["lastpoint_visited"] == 3
+        codes = np.asarray(scan.columns["host"])
+        d = region.registry.dict_array("host")
+        assert "h_old" in set(d[codes[codes >= 0]])
+
+    def test_matches_full_scan_winners(self, engine):
+        engine.create_region(1, schema3())
+        fill_files(engine, 1, n_files=3)
+        region = engine.region(1)
+        full = engine.scan(1)
+        pruned = engine.scan_last(1, "host")
+        ts_f = np.asarray(full.columns["ts"])
+        ts_p = np.asarray(pruned.columns["ts"])
+        for c in range(region.registry.cardinality("host")):
+            mf = np.asarray(full.columns["host"]) == c
+            mp = np.asarray(pruned.columns["host"]) == c
+            assert ts_f[mf].max() == ts_p[mp].max()
+
+    def test_tombstone_falls_back(self, engine):
+        engine.create_region(1, schema3())
+        fill_files(engine, 1, n_files=2)
+        region = engine.region(1)
+        # delete the NEWEST instant of h0: the tombstone could BE the
+        # winner, so the pruned path must refuse — from the memtable...
+        newest = max(m.ts_max for m in region.files.values())
+        engine.delete(1, make_batch(region.schema, ["h0"], [newest],
+                                    [0.0]))
+        assert engine.scan_last(1, "host") is None
+        engine.flush(1)  # ...and from the (now newest) SST
+        assert engine.scan_last(1, "host") is None
+
+    def test_tombstone_in_irrelevant_old_file_keeps_pruning(self, engine):
+        """A tombstone whose file the stop condition proves irrelevant
+        (every series has a strictly newer candidate) does NOT void
+        the pruned path."""
+        engine.create_region(1, schema3())
+        region = engine.region(1)
+        s = region.schema
+        engine.put(1, make_batch(s, ["h0", "h1"], [10, 20], [1.0, 2.0]))
+        engine.delete(1, make_batch(s, ["h0"], [10], [1.0]))
+        engine.flush(1)  # old file with a ts=10 tombstone
+        fill_files(engine, 1, n_files=2, t0=1_000_000, hosts=2)
+        scan = engine.scan_last(1, "host")
+        assert scan is not None
+        # terminated before reaching the tombstone file
+        assert scan.stats["lastpoint_visited"] < scan.stats["ssts"]
+
+    def test_null_tag_group_blocks_early_stop(self, engine):
+        """A NULL-host row only in an OLD file: FileMeta.null_tags
+        must force the visit deep enough that the NULL group's winner
+        is in the result."""
+        engine.create_region(1, schema3())
+        region = engine.region(1)
+        s = region.schema
+        engine.put(1, make_batch(s, [None, "h0"], [100, 110],
+                                 [1.0, 2.0]))
+        engine.flush(1)
+        fill_files(engine, 1, n_files=2, t0=1_000_000)
+        scan = engine.scan_last(1, "host")
+        assert scan.stats["lastpoint_visited"] == 3
+        codes = np.asarray(scan.columns["host"])
+        assert (codes < 0).any()  # the NULL row made it into the set
+
+
+class TestUploadPrefetch:
+    def test_prefetch_builds_and_get_joins(self):
+        import time
+
+        import jax.numpy as jnp
+
+        from greptimedb_tpu.query.device_cache import DeviceCache
+
+        cache = DeviceCache(budget_bytes=1 << 24)
+        built = []
+
+        def mk(i):
+            def build():
+                time.sleep(0.005)
+                built.append(i)
+                return jnp.arange(16) + i
+            return build
+
+        cache.prefetch(("blk", 1), mk(1))
+        cache.prefetch(("blk", 1), mk(1))  # dedup: no double build
+        a = cache.get(("blk", 1), mk(1))
+        assert int(a[0]) == 1
+        assert built == [1]
+        assert cache.prefetch_issued == 1
+        # a failing prefetch degrades to the inline build
+        def boom():
+            raise RuntimeError("prefetch build failed")
+
+        cache.prefetch(("blk", 2), boom)
+        b = cache.get(("blk", 2), mk(2))
+        assert int(b[0]) == 2
+
+    def test_prefetch_disabled_by_env(self, monkeypatch):
+        from greptimedb_tpu.query.device_cache import (
+            upload_prefetch_enabled,
+        )
+
+        assert upload_prefetch_enabled()
+        monkeypatch.setenv("GREPTIMEDB_TPU_UPLOAD_PREFETCH", "0")
+        assert not upload_prefetch_enabled()
+
+
+@pytest.mark.chaos
+def test_process_cluster_parallel_decode_parity(tmp_path):
+    """Acceptance (ISSUE 5): over a live ProcessCluster with
+    objectstore.read latency chaos injected in the datanode children,
+    query results are bit-for-bit identical between decode_threads=1
+    and the default parallel pool. The two clusters replay the same
+    seeded fault schedule (GTPU_CHAOS_SEED)."""
+    import time
+
+    from greptimedb_tpu.cluster.process_cluster import ProcessCluster
+    from greptimedb_tpu.meta.metasrv import MetasrvOptions
+
+    def run(threads: str, root: str):
+        old = {
+            k: os.environ.get(k)
+            for k in ("GREPTIMEDB_TPU_SCAN_DECODE_THREADS", "GTPU_CHAOS",
+                      "GTPU_CHAOS_SEED")
+        }
+        os.environ["GREPTIMEDB_TPU_SCAN_DECODE_THREADS"] = threads
+        os.environ["GTPU_CHAOS"] = \
+            "objectstore.read=latency,arg:0.005,prob:0.3"
+        os.environ["GTPU_CHAOS_SEED"] = "1234"
+        c = None
+        try:
+            c = ProcessCluster(root, num_datanodes=2,
+                               opts=MetasrvOptions())
+            c.beat_all(time.time() * 1000)
+            c.sql("CREATE TABLE m (host STRING, v DOUBLE, ts TIMESTAMP "
+                  "TIME INDEX, PRIMARY KEY(host))")
+            for f in range(3):
+                vals = ", ".join(
+                    f"('h{i % 5}', {f * 100 + i}.5, {f * 10_000 + i})"
+                    for i in range(50))
+                c.sql(f"INSERT INTO m VALUES {vals}")
+                info = c.catalog.table("public", "m")
+                for rid in info.region_ids:
+                    c.router.flush(rid)
+            rows = c.sql(
+                "SELECT host, count(*), sum(v), max(ts) FROM m "
+                "GROUP BY host ORDER BY host").rows()
+            raw = c.sql("SELECT host, v, ts FROM m "
+                        "ORDER BY host, ts").rows()
+            return rows, raw
+        finally:
+            if c is not None:
+                c.close()
+            for k, v in old.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    seq = run("1", str(tmp_path / "seq"))
+    par = run("0", str(tmp_path / "par"))
+    assert seq == par

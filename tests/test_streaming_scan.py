@@ -191,7 +191,9 @@ class TestStreamPrepared:
 
     def test_first_last_stays_general(self, db, monkeypatch):
         _fill(db)
-        sql = ("SELECT host, last(usage) FROM cpu GROUP BY host "
+        # first(): the all-`last` shape is served by the lastpoint
+        # newest-first pruned scan instead of streaming at all
+        sql = ("SELECT host, first(usage) FROM cpu GROUP BY host "
                "ORDER BY host")
         streamed = db.execute_one(sql).rows()
         # first/last need ts pairing -> general streaming kernel
