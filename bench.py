@@ -19,6 +19,9 @@ run in the same process and land in detail.configs:
   9. qps_single_groupby    — 50 keep-alive HTTP clients (ref 1165.73 qps)
  10. double_groupby_100m   — the headline query at tracked config #2
                              scale (100M rows / 4k hosts), budget-sized
+ 11. qps_mixed_tenants     — 3-tenant mixed workload (dashboard /
+                             point lastpoint / high-card groupby) with
+                             per-tenant p99/p999 + plan-cache hit rate
 
 Pipeline measured end-to-end through the SQL engine: SQL parse -> plan ->
 region scan (SST/memtable) -> device blocks -> fused filter+group+segment
@@ -1049,6 +1052,11 @@ def bench_qps(qe, results, clients=None, requests_total=None):
         f"max(usage_user) FROM cpu WHERE hostname = 'host_1' "
         f"AND ts >= {T0_MS} AND ts < {T0_MS + 3600 * 1000} GROUP BY minute"
     )
+    from greptimedb_tpu.utils.metrics import (
+        PLAN_CACHE_EVENTS,
+        QUERY_BATCH_EVENTS,
+    )
+
     srv = HttpServer(qe, host="127.0.0.1", port=0)
     try:
         port = srv.start()
@@ -1057,6 +1065,10 @@ def bench_qps(qe, results, clients=None, requests_total=None):
         # warm once (compile + cache) before the clock starts
         urllib.request.urlopen(
             urllib.request.Request(url, data=body), timeout=60)
+        cache0 = (PLAN_CACHE_EVENTS.get(event="hit"),
+                  PLAN_CACHE_EVENTS.get(event="miss"))
+        batch0 = (QUERY_BATCH_EVENTS.get(event="coalesced"),
+                  QUERY_BATCH_EVENTS.get(event="stacked"))
 
         per_client = max(1, requests_total // clients)
         latencies = [[] for _ in range(clients)]
@@ -1111,19 +1123,192 @@ def bench_qps(qe, results, clients=None, requests_total=None):
             "qps": 0.0, "clients": clients, "requests": 0, "errors": n_err}
         return
     qps = done / wall
+    d_hit = PLAN_CACHE_EVENTS.get(event="hit") - cache0[0]
+    d_miss = PLAN_CACHE_EVENTS.get(event="miss") - cache0[1]
+    hit_rate = d_hit / (d_hit + d_miss) if (d_hit + d_miss) else None
+    batched = (QUERY_BATCH_EVENTS.get(event="coalesced") - batch0[0]
+               + QUERY_BATCH_EVENTS.get(event="stacked") - batch0[1])
     log(f"qps: {qps:.0f} qps @{clients} clients "
         f"(mean {lats.mean() * 1000:.1f} ms, p99 "
-        f"{np.percentile(lats, 99) * 1000:.1f} ms, {n_err} errors)")
+        f"{np.percentile(lats, 99) * 1000:.1f} ms, {n_err} errors, "
+        f"plan-cache hit rate "
+        f"{-1.0 if hit_rate is None else hit_rate:.3f}, "
+        f"{batched:.0f} batched)")
     results["qps_single_groupby"] = {
         "qps": round(qps, 1), "clients": clients, "requests": done,
         "errors": n_err,
         "mean_ms": round(float(lats.mean() * 1000), 2),
         "p99_ms": round(float(np.percentile(lats, 99) * 1000), 2),
+        "p999_ms": round(float(np.percentile(lats, 99.9) * 1000), 2),
+        # the ISSUE-6 acceptance: the repeated-dashboard workload must
+        # serve >90% of plans from the shape-keyed cache
+        "plan_cache_hit_rate": (None if hit_rate is None
+                                else round(hit_rate, 4)),
+        "batched_queries": int(batched),
         "baseline_qps": 1165.73,
         "vs_baseline": round(qps / 1165.73, 3),
         "note": ("clients run in-process; baseline is the reference on "
                  "8 cores, this box has "
                  f"{os.cpu_count()} — compare per-core")}
+
+
+def bench_qps_mixed(qe, results, clients_per_tenant=None,
+                    requests_total=None):
+    """Config: multi-tenant mixed workload over real HTTP (ISSUE-6
+    satellite) — the concurrency plane measured, not asserted. Three
+    tenants with distinct shapes run concurrently through the full
+    frontend path (admission -> plan cache -> batcher):
+
+      dash      repeated single-groupby dashboards, rotating host +
+                window literals (the plan-cache + stacking workload)
+      ops       point lastpoint per host (cheap, shape-cached)
+      analytics high-cardinality groupby over every host (the heavy
+                neighbor fairness protects the others from)
+
+    Per-tenant p50/p99/p999 says whether a heavy tenant starves a light
+    one; the plan-cache hit rate says whether shapes actually shared."""
+    import http.client
+    import threading
+    import urllib.parse
+    import urllib.request
+
+    from greptimedb_tpu.servers.http import HttpServer
+    from greptimedb_tpu.utils.metrics import (
+        ADMISSION_EVENTS,
+        PLAN_CACHE_EVENTS,
+        QUERY_BATCH_EVENTS,
+    )
+
+    clients_per_tenant = clients_per_tenant or int(
+        os.environ.get("BENCH_QPS_MIXED_CLIENTS_PER_TENANT", "10"))
+    requests_total = requests_total or int(
+        os.environ.get("BENCH_QPS_MIXED_REQUESTS", "3000"))
+    hour_ms = 3600 * 1000
+
+    def dash_sql(i):
+        lo = T0_MS + (i % max(1, HOURS - 1)) * hour_ms
+        return (f"SELECT date_bin(INTERVAL '1 minute', ts) AS minute, "
+                f"max(usage_user) FROM cpu "
+                f"WHERE hostname = 'host_{i % min(HOSTS, 64)}' "
+                f"AND ts >= {lo} AND ts < {lo + hour_ms} GROUP BY minute")
+
+    def ops_sql(i):
+        return (f"SELECT last_value(usage_user ORDER BY ts) FROM cpu "
+                f"WHERE hostname = 'host_{i % min(HOSTS, 256)}'")
+
+    def analytics_sql(i):
+        lo = T0_MS + (i % max(1, HOURS - 1)) * hour_ms
+        return (f"SELECT hostname, max(usage_user), avg(usage_system) "
+                f"FROM cpu WHERE ts >= {lo} AND ts < {lo + hour_ms} "
+                f"GROUP BY hostname")
+
+    tenants = [("dash", dash_sql), ("ops", ops_sql),
+               ("analytics", analytics_sql)]
+    srv = HttpServer(qe, host="127.0.0.1", port=0)
+    try:
+        port = srv.start()
+        url = f"http://127.0.0.1:{port}/v1/sql"
+        for _, gen in tenants:  # one warm compile per shape
+            urllib.request.urlopen(urllib.request.Request(
+                url, data=urllib.parse.urlencode(
+                    {"sql": gen(0)}).encode()), timeout=120)
+        cache0 = (PLAN_CACHE_EVENTS.get(event="hit"),
+                  PLAN_CACHE_EVENTS.get(event="miss"))
+        batch0 = (QUERY_BATCH_EVENTS.get(event="coalesced"),
+                  QUERY_BATCH_EVENTS.get(event="stacked"))
+        rej0 = ADMISSION_EVENTS.total(event="reject_full") \
+            + ADMISSION_EVENTS.total(event="reject_timeout")
+
+        per_client = max(1, requests_total
+                         // (3 * clients_per_tenant))
+        lat = {name: [[] for _ in range(clients_per_tenant)]
+               for name, _ in tenants}
+        errors = {name: [0] * clients_per_tenant for name, _ in tenants}
+
+        def client(tenant, gen, i):
+            conn = http.client.HTTPConnection("127.0.0.1", port,
+                                              timeout=120)
+            headers = {"Content-Type":
+                       "application/x-www-form-urlencoded",
+                       "X-Greptime-Tenant": tenant}
+            try:
+                for k in range(per_client):
+                    body = urllib.parse.urlencode(
+                        {"sql": gen(i * per_client + k)}).encode()
+                    t0 = time.perf_counter()
+                    try:
+                        conn.request("POST", "/v1/sql", body=body,
+                                     headers=headers)
+                        resp = conn.getresponse()
+                        resp.read()
+                        if resp.status != 200:
+                            errors[tenant][i] += 1
+                            continue
+                    except Exception:
+                        errors[tenant][i] += 1
+                        conn.close()
+                        continue
+                    lat[tenant][i].append(time.perf_counter() - t0)
+            finally:
+                conn.close()
+
+        threads = [
+            threading.Thread(target=client, args=(name, gen, i))
+            for name, gen in tenants for i in range(clients_per_tenant)
+        ]
+        t_start = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t_start
+    except Exception as e:
+        log(f"qps_mixed bench failed: {e!r}")
+        results["qps_mixed_tenants"] = {"error": repr(e)[:200]}
+        return
+    finally:
+        srv.stop()
+
+    d_hit = PLAN_CACHE_EVENTS.get(event="hit") - cache0[0]
+    d_miss = PLAN_CACHE_EVENTS.get(event="miss") - cache0[1]
+    hit_rate = d_hit / (d_hit + d_miss) if (d_hit + d_miss) else None
+    batched = (QUERY_BATCH_EVENTS.get(event="coalesced") - batch0[0]
+               + QUERY_BATCH_EVENTS.get(event="stacked") - batch0[1])
+    rejected = (ADMISSION_EVENTS.total(event="reject_full")
+                + ADMISSION_EVENTS.total(event="reject_timeout") - rej0)
+    per_tenant = {}
+    done = 0
+    for name, _ in tenants:
+        ls = np.asarray([x for l in lat[name] for x in l])
+        n_err = sum(errors[name])
+        done += len(ls)
+        if len(ls) == 0:
+            per_tenant[name] = {"requests": 0, "errors": n_err}
+            continue
+        per_tenant[name] = {
+            "requests": int(len(ls)), "errors": n_err,
+            "p50_ms": round(float(np.percentile(ls, 50) * 1000), 2),
+            "p99_ms": round(float(np.percentile(ls, 99) * 1000), 2),
+            "p999_ms": round(float(np.percentile(ls, 99.9) * 1000), 2),
+        }
+    qps = done / wall if wall > 0 else 0.0
+    log(f"qps_mixed: {qps:.0f} qps @3x{clients_per_tenant} clients, "
+        f"plan-cache hit rate "
+        f"{-1.0 if hit_rate is None else hit_rate:.3f}, "
+        f"{batched:.0f} batched, {rejected:.0f} rejected; " + ", ".join(
+            f"{n} p99 {per_tenant[n].get('p99_ms', '?')} ms"
+            for n, _ in tenants))
+    results["qps_mixed_tenants"] = {
+        "qps": round(qps, 1),
+        "clients_per_tenant": clients_per_tenant,
+        "tenants": per_tenant,
+        "plan_cache_hit_rate": (None if hit_rate is None
+                                else round(hit_rate, 4)),
+        "batched_queries": int(batched),
+        "admission_rejections": int(rejected),
+        "note": "3 tenants (dashboard/point-lastpoint/high-card "
+                "groupby) through HTTP concurrently; per-tenant tails "
+                "measure cross-tenant interference"}
 
 
 def roofline_detail(platform, results, rows):
@@ -1318,6 +1503,8 @@ def main():
         checkpoint()
         guarded("sql_insert", lambda: bench_sql_insert(qe, results))
         guarded("qps_single_groupby", lambda: bench_qps(qe, results))
+        guarded("qps_mixed_tenants",
+                lambda: bench_qps_mixed(qe, results))
         guarded("maintenance",
                 lambda: bench_maintenance(engine, qe, results))
         # PRELIMINARY emit: the quick configs are done — if a big tracked

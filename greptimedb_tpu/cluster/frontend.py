@@ -195,6 +195,15 @@ def build_frontend(metasrv_addr: str, default_timezone: str = "UTC"):
     router.ddl_manager = DdlManager(remote_meta.procedures, router, catalog)
     qe = QueryEngine(catalog, router, default_timezone=default_timezone)
 
+    # remote DDL / route swaps must evict this frontend's cached plan
+    # shapes too — the same channel the router uses for its route cache
+    # ("" = can't tell which table: flush every shape)
+    def _drop_plans(table: str) -> None:
+        name = table.rsplit(".", 1)[-1] if table else None
+        qe.concurrency.invalidate_table(name=name or None)
+
+    remote_meta.subscribe_invalidation(_drop_plans)
+
     # push-based invalidation: long-poll the metasrv's watch on the
     # route prefix; a failover/migration route swap clears the router's
     # caches within one poll round-trip instead of a liveness-TTL miss

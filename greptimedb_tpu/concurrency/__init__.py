@@ -1,0 +1,155 @@
+"""Frontend concurrency plane (the ISSUE-6 subsystem).
+
+A genuinely new layer between the protocol servers (L6/L5) and the
+query engine (L3) that makes fleet-scale concurrent traffic cheap:
+
+- `plan_cache`   — shape-keyed parameterized logical-plan cache (one
+                   plan + one XLA executable shared by thousands of
+                   near-identical dashboard queries), invalidated on
+                   DDL/schema/rollup-state change;
+- `admission`    — bounded admission queue + per-tenant weighted fair
+                   scheduling with typed `Overloaded` rejection;
+- `batcher`      — a short collection window that coalesces identical
+                   statements and stacks shape-compatible small
+                   aggregates into one device dispatch, demuxed
+                   bit-for-bit.
+
+`QueryEngine` routes every statement through the plane; configuration
+comes from the `[concurrency]` options section via `configure()` (env
+vars prefixed GTPU_ override for benches/tests).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from contextlib import contextmanager
+from dataclasses import dataclass
+
+from greptimedb_tpu.concurrency.admission import (  # noqa: F401
+    AdmissionController,
+    Overloaded,
+    parse_weights,
+)
+from greptimedb_tpu.concurrency.batcher import QueryBatcher
+from greptimedb_tpu.concurrency.plan_cache import PlanCache
+
+__all__ = ["ConcurrencyConfig", "ConcurrencyPlane", "Overloaded",
+           "configure", "current_config"]
+
+
+@dataclass
+class ConcurrencyConfig:
+    enabled: bool = True
+    #: concurrent statements in execution; 0 = auto (max(32, 4*cpu))
+    max_concurrency: int = 0
+    queue_size: int = 512
+    queue_timeout_s: float = 30.0
+    #: "tenantA=3,tenantB=1" weighted round-robin shares; unlisted = 1
+    tenant_weights: str = ""
+    plan_cache_entries: int = 512
+    batching: bool = True
+    batch_window_ms: float = 2.0
+    batch_max_queries: int = 64
+    #: stacked dispatch only below this estimated row count (single
+    #: kernel dispatch keeps float parity provable); 0 = no bound
+    batch_max_rows: int = 4 << 20
+
+
+_config = ConcurrencyConfig()
+_config_lock = threading.Lock()
+
+
+def configure(cfg: ConcurrencyConfig) -> None:
+    """Install the process-wide default config (options layer calls
+    this; engines built afterwards pick it up)."""
+    global _config
+    with _config_lock:
+        _config = cfg
+
+
+def _env_num(name, cur, cast):
+    v = os.environ.get(name)
+    if not v:
+        return cur
+    try:
+        return cast(v)
+    except ValueError:
+        return cur
+
+
+def current_config() -> ConcurrencyConfig:
+    """The installed config with env overrides applied (benches/tests
+    A/B the plane without an options object)."""
+    with _config_lock:
+        cfg = ConcurrencyConfig(**vars(_config))
+    cfg.enabled = _env_num("GTPU_CONCURRENCY", int(cfg.enabled), int) != 0
+    cfg.max_concurrency = _env_num("GTPU_MAX_CONCURRENCY",
+                                   cfg.max_concurrency, int)
+    cfg.plan_cache_entries = _env_num("GTPU_PLAN_CACHE_ENTRIES",
+                                      cfg.plan_cache_entries, int)
+    cfg.batching = _env_num("GTPU_QUERY_BATCHING", int(cfg.batching),
+                            int) != 0
+    cfg.batch_window_ms = _env_num("GTPU_BATCH_WINDOW_MS",
+                                   cfg.batch_window_ms, float)
+    return cfg
+
+
+class ConcurrencyPlane:
+    def __init__(self, cfg: ConcurrencyConfig | None = None):
+        cfg = cfg or current_config()
+        self.cfg = cfg
+        limit = cfg.max_concurrency
+        if limit <= 0:
+            limit = max(32, 4 * (os.cpu_count() or 8))
+        self.admission = AdmissionController(
+            limit, cfg.queue_size, cfg.queue_timeout_s,
+            parse_weights(cfg.tenant_weights),
+            enabled=cfg.enabled)
+        self.plan_cache = PlanCache(
+            cfg.plan_cache_entries if cfg.enabled else 0)
+        self.batcher = QueryBatcher(
+            window_s=cfg.batch_window_ms / 1000.0,
+            max_queries=cfg.batch_max_queries,
+            max_rows=cfg.batch_max_rows,
+            enabled=cfg.enabled and cfg.batching)
+        self._tls = threading.local()
+
+    # ---- batching gate -----------------------------------------------------
+
+    @contextmanager
+    def suppress_batching(self):
+        """EXPLAIN/TQL ANALYZE must observe ITS execution's spans —
+        riding another leader's run would report an empty trace."""
+        prev = getattr(self._tls, "no_batch", False)
+        self._tls.no_batch = True
+        try:
+            yield
+        finally:
+            self._tls.no_batch = prev
+
+    def execute_select(self, qe, sel, info, ctx):
+        """Route one table SELECT: batch when this is a top-level
+        statement on a busy server, else straight through."""
+        if (not self.batcher.enabled
+                or self.admission.depth() != 1
+                or getattr(self._tls, "no_batch", False)):
+            return qe._select_table(sel, info, ctx)
+        return self.batcher.execute(qe, sel, info, ctx,
+                                    busy=self.admission.active > 1)
+
+    # ---- tenancy -----------------------------------------------------------
+
+    @staticmethod
+    def tenant_of(ctx) -> str:
+        t = getattr(ctx, "tenant", None)
+        if t:
+            return str(t)
+        user = getattr(ctx, "user", None)
+        name = getattr(user, "username", None)
+        return name or "default"
+
+    # ---- invalidation ------------------------------------------------------
+
+    def invalidate_table(self, db=None, name=None) -> int:
+        return self.plan_cache.invalidate_table(db, name)

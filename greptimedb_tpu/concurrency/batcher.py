@@ -1,0 +1,378 @@
+"""Cross-query batching: one device dispatch for a window of queries.
+
+Under fleet-scale dashboard traffic the engine used to serialize 50
+concurrent single-groupby queries into 50 kernel launches over the same
+resident blocks. The batcher opens a short collection window when the
+server is busy, groups admitted SELECTs by shape, and serves a group
+with less work than member-by-member execution:
+
+- **coalescing**: members whose statements are identical (same shape,
+  same parameters, same db/timezone) share ONE execution — the common
+  case for dashboard fan-out, trivially bit-for-bit.
+- **stacked dispatch**: members identical except for the value of one
+  tag-equality predicate (`... WHERE host = ? ...`) rewrite into a
+  single combined query — the selector tag becomes the leading group
+  key and the predicate becomes `host IN (v1..vN)` — so one stacked
+  segment-aggregate dispatch over the shared scan computes every
+  member's groups. Demultiplexing slices each member's rows back out
+  of the combined result. Per (tag, bucket) group the kernel folds
+  exactly the member's rows in the member's row order, and excluded
+  rows contribute the exact additive/extremal identity, so results are
+  bit-for-bit identical to serial execution (tier-1 asserts this).
+
+Only aggregate shapes whose parity is provable stack (plain
+sum/count/min/max/avg over columns, non-empty GROUP BY, a conjunctive
+WHERE); everything else falls back to coalescing or per-member serial
+execution inside the same admission slot. The collection window only
+opens when other queries are in flight — an idle client never pays it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from greptimedb_tpu.query.result import QueryResult
+from greptimedb_tpu.sql import ast
+from greptimedb_tpu.utils.metrics import (
+    QUERY_BATCH_EVENTS,
+    QUERY_BATCH_SIZE,
+)
+
+#: aggregate functions whose masked/stacked evaluation is exactly the
+#: serial evaluation (order-insensitive, or identity-element exact)
+SAFE_FUNCS = frozenset(
+    {"sum", "count", "min", "max", "avg", "mean"})
+
+BATCH_TAG = "__batch_tag"
+
+
+def _replace_node(e, target, repl):
+    """Rebuild `e` with the node `target` (by identity) replaced."""
+    if e is target:
+        return repl
+    if isinstance(e, (list, tuple)):
+        return type(e)(_replace_node(x, target, repl) for x in e)
+    if dataclasses.is_dataclass(e) and not isinstance(e, type) \
+            and not isinstance(e, ast.Statement):
+        changes = {}
+        for f in dataclasses.fields(e):
+            v = getattr(e, f.name)
+            if isinstance(v, (ast.Expr, list, tuple)) or (
+                    dataclasses.is_dataclass(v)
+                    and not isinstance(v, (type, ast.Statement))):
+                nv = _replace_node(v, target, repl)
+                if nv is not v:
+                    changes[f.name] = nv
+        return dataclasses.replace(e, **changes) if changes else e
+    return e
+
+
+def _conjuncts(e) -> list:
+    from greptimedb_tpu.query.expr import split_conjuncts
+
+    return split_conjuncts(e)
+
+
+class BatchShape:
+    """Analysis of one stack-eligible SELECT: which tag selects the
+    member, its value, and the statement with that value masked (the
+    group key — members share it iff they differ ONLY in the value)."""
+
+    __slots__ = ("tag", "value", "conjunct", "masked")
+
+    def __init__(self, tag, value, conjunct, masked):
+        self.tag = tag
+        self.value = value
+        self.conjunct = conjunct
+        self.masked = masked
+
+
+def analyze(sel: ast.Select, info) -> Optional[BatchShape]:
+    """None when the statement can't join a stacked group (it may still
+    coalesce with byte-identical statements)."""
+    if (sel.joins or sel.ctes or sel.from_subquery is not None
+            or sel.distinct or sel.having is not None or sel.order_by
+            or sel.limit is not None or sel.offset
+            or sel.align is not None or not sel.group_by
+            or sel.where is None):
+        return None
+    from greptimedb_tpu.query import range_select as rs
+    from greptimedb_tpu.query.expr import collect_columns, has_aggregate
+    from greptimedb_tpu.query.planner import _FUNC_CANON
+    from greptimedb_tpu.query.window import select_has_window
+
+    if rs.is_range_select(sel) or select_has_window(sel):
+        return None
+    n_aggs = 0
+    for it in sel.items:
+        e = it.expr
+        if isinstance(e, ast.Star):
+            return None
+        if not has_aggregate(e):
+            continue  # a group key expression: shared across members
+        if not isinstance(e, ast.FuncCall) or e.distinct \
+                or e.order_within is not None:
+            return None
+        func = _FUNC_CANON.get(e.name)
+        if func not in SAFE_FUNCS:
+            return None
+        if len(e.args) == 1 and isinstance(e.args[0], ast.Star):
+            if func != "count":
+                return None
+        elif len(e.args) != 1 or not isinstance(e.args[0], ast.Column):
+            return None
+        n_aggs += 1
+    if n_aggs == 0:
+        return None
+    schema = info.schema
+    tag_names = {c.name for c in schema.tag_columns}
+    # the selector must not feed the output relation: a tag that is
+    # also a group key / projected column changes shape when batched
+    used: set = set()
+    for it in sel.items:
+        collect_columns(it.expr, used)
+    for g in sel.group_by:
+        collect_columns(g, used)
+    conj = _conjuncts(sel.where)
+    selector = None
+    for c in conj:
+        if not (isinstance(c, ast.BinaryOp) and c.op == "="):
+            continue
+        col, lit = c.left, c.right
+        if isinstance(col, ast.Literal) and isinstance(lit, ast.Column):
+            col, lit = lit, col
+        if not (isinstance(col, ast.Column) and isinstance(lit, ast.Literal)):
+            continue
+        if col.table not in (None, sel.table, sel.table_alias):
+            continue
+        if col.name in tag_names and col.name not in used \
+                and isinstance(lit.value, str):
+            selector = (c, col.name, lit.value)
+            break
+    if selector is None:
+        return None
+    conjunct, tag, value = selector
+    marker = ast.BinaryOp("=", ast.Column(tag),
+                          ast.Literal("__gtpu_batch_value__"))
+    masked = repr(dataclasses.replace(
+        sel, where=_replace_node(sel.where, conjunct, marker)))
+    return BatchShape(tag, value, conjunct, masked)
+
+
+def combined_select(base: ast.Select, shape: BatchShape,
+                    values: list[str]) -> ast.Select:
+    """The stacked rewrite: selector eq -> IN over every member value,
+    selector tag prepended as the leading group key (leading so each
+    member's groups come back as one contiguous, serial-ordered run)
+    and appended to the projection for demux."""
+    tagcol = ast.Column(shape.tag)
+    in_list = ast.InList(tagcol, tuple(ast.Literal(v) for v in values))
+    new_where = _replace_node(base.where, shape.conjunct, in_list)
+    items = list(base.items) + [ast.SelectItem(tagcol, alias=BATCH_TAG)]
+    group_by = [tagcol] + list(base.group_by)
+    return dataclasses.replace(base, items=items, group_by=group_by,
+                               where=new_where)
+
+
+def demux(combined: QueryResult, value: str) -> QueryResult:
+    """One member's slice of the combined result, BATCH_TAG dropped.
+    combined_select APPENDS its tag column, so the demux key is the
+    LAST occurrence — a user column aliased __batch_tag sits earlier
+    and must come back in the member's result, not be used as the key."""
+    tag_idx = (len(combined.names) - 1
+               - combined.names[::-1].index(BATCH_TAG))
+    tagcol = np.asarray(combined.columns[tag_idx])
+    idx = np.flatnonzero(tagcol == value)
+    keep = [i for i in range(len(combined.names)) if i != tag_idx]
+    return QueryResult(
+        [combined.names[i] for i in keep],
+        [combined.dtypes[i] for i in keep],
+        [np.asarray(combined.columns[i])[idx] for i in keep])
+
+
+class _Member:
+    __slots__ = ("event", "result", "error", "path", "value", "sel")
+
+    def __init__(self, value, sel):
+        self.event = threading.Event()
+        self.result = None
+        self.error = None
+        self.path = None
+        self.value = value
+        self.sel = sel
+
+
+class _Group:
+    __slots__ = ("members", "closed", "shape", "sel", "value")
+
+    def __init__(self, sel, shape):
+        self.members: list[_Member] = []
+        self.closed = False
+        self.shape = shape
+        self.sel = sel
+        self.value = shape.value if shape is not None else None
+
+
+def _copy(r: QueryResult) -> QueryResult:
+    # column arrays shared (read-only downstream); the container is
+    # per-caller so one member's post-processing can't surprise another
+    return QueryResult(list(r.names), list(r.dtypes), list(r.columns))
+
+
+class QueryBatcher:
+    def __init__(self, window_s: float = 0.002, max_queries: int = 64,
+                 max_rows: int = 4 << 20, enabled: bool = True):
+        self.window_s = window_s
+        self.max_queries = max_queries
+        self.max_rows = max_rows
+        self.enabled = enabled
+        self._lock = threading.Lock()
+        self._open: dict[tuple, _Group] = {}
+
+    # ---- entry -------------------------------------------------------------
+
+    def execute(self, qe, sel: ast.Select, info, ctx, busy: bool) -> QueryResult:
+        """Join or lead a batch group for `sel`. `busy` gates the
+        collection window: an idle server executes immediately."""
+        if not busy and not self._open:
+            # idle server with no leader collecting: no group could be
+            # joined or led, so skip the analyze/repr bookkeeping
+            # entirely (single-client traffic must not pay batching
+            # overhead on the parse/plan hot path). Racy read on
+            # purpose: a group opening concurrently only costs a missed
+            # join, never correctness.
+            return qe._select_table(sel, info, ctx)
+        shape = analyze(sel, info)
+        gkey = (info.db, info.table_id, ctx.timezone,
+                shape.masked if shape is not None else repr(sel))
+        with self._lock:
+            g = self._open.get(gkey)
+            if g is not None and not g.closed \
+                    and len(g.members) < self.max_queries:
+                m = _Member(shape.value if shape is not None else None, sel)
+                g.members.append(m)
+                QUERY_BATCH_EVENTS.inc(event="join")
+                joined = True
+            else:
+                g = _Group(sel, shape)
+                self._open[gkey] = g
+                joined = False
+        if joined:
+            return self._await(qe, m)
+        interrupted = None
+        try:
+            if busy and self.window_s > 0:
+                time.sleep(self.window_s)
+        except BaseException as e:  # noqa: BLE001 — members must not hang
+            interrupted = e
+        finally:
+            with self._lock:
+                g.closed = True
+                if self._open.get(gkey) is g:
+                    del self._open[gkey]
+        if interrupted is not None:
+            for m in g.members:
+                m.error = interrupted
+                m.event.set()
+            raise interrupted
+        return self._lead(qe, g, info, ctx)
+
+    def _await(self, qe, m: _Member) -> QueryResult:
+        # wait as long as the leader runs: its execution IS this
+        # member's execution, so a slow leader means a slow query, not
+        # an overload (the leader sets every member's event in a
+        # finally on ALL exit paths — see execute()/_lead). The
+        # periodic wakeup exists only so a wedged process shows a live
+        # thread doing something diagnosable instead of parking forever.
+        while not m.event.wait(30.0):
+            pass
+        if m.error is not None:
+            raise m.error
+        qe.executor.last_path = m.path
+        return _copy(m.result)
+
+    # ---- leader ------------------------------------------------------------
+
+    def _lead(self, qe, g: _Group, info, ctx) -> QueryResult:
+        run = lambda s: qe._select_table(s, info, ctx)  # noqa: E731
+        if not g.members:
+            return run(g.sel)
+        QUERY_BATCH_SIZE.observe(float(1 + len(g.members)))
+        try:
+            by_value: dict = {}
+            if g.shape is None:
+                # every member is statement-identical: one execution
+                res = run(g.sel)
+                path = qe.executor.last_path
+                QUERY_BATCH_EVENTS.inc(float(len(g.members)),
+                                       event="coalesced")
+                for m in g.members:
+                    m.result, m.path = res, path
+                    m.event.set()
+                return _copy(res)
+            order: list = [g.value]
+            for m in g.members:
+                if m.value not in order:
+                    order.append(m.value)
+            if len(order) == 1:
+                res = run(g.sel)
+                path = qe.executor.last_path
+                by_value[g.value] = (res, path)
+                QUERY_BATCH_EVENTS.inc(float(len(g.members)),
+                                       event="coalesced")
+            elif self._stack_ok(qe, info):
+                combined = combined_select(g.sel, g.shape, sorted(order))
+                full = run(combined)
+                path = (qe.executor.last_path or "") + "+stacked"
+                for v in order:
+                    by_value[v] = (demux(full, v), path)
+                QUERY_BATCH_EVENTS.inc(float(len(order)), event="stacked")
+            else:
+                # too big to stack safely: serial per distinct value,
+                # duplicates still coalesce
+                for v in order:
+                    one = g.sel if v == g.value else _replace_node(
+                        g.sel, g.shape.conjunct,
+                        ast.BinaryOp("=", ast.Column(g.shape.tag),
+                                     ast.Literal(v)))
+                    by_value[v] = (run(one), qe.executor.last_path)
+                QUERY_BATCH_EVENTS.inc(float(len(order)),
+                                       event="serial_fallback")
+            for m in g.members:
+                m.result, m.path = by_value[m.value]
+                m.event.set()
+            res, path = by_value[g.value]
+            qe.executor.last_path = path
+            return _copy(res)
+        except BaseException as e:
+            for m in g.members:
+                if not m.event.is_set():
+                    m.error = e
+                    m.event.set()
+            raise
+
+    def _stack_ok(self, qe, info) -> bool:
+        """Stacked parity needs the whole scan in one kernel dispatch:
+        estimate rows from region metadata; routers/remote engines
+        can't say, so they stack only when no bound is configured."""
+        if self.max_rows <= 0:
+            return True
+        est = 0
+        for rid in info.region_ids:
+            try:
+                region = qe.region_engine.region(rid)
+            except Exception:  # noqa: BLE001 — remote/unrouted region
+                return False
+            num = getattr(region, "num_sst_rows", None)
+            if num is None:
+                return False
+            est += int(num)
+            mem = getattr(region, "memtable", None)
+            if mem is not None:
+                est += int(getattr(mem, "bytes_estimate", 0) // 64)
+        return est <= self.max_rows

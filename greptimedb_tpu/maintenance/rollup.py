@@ -142,6 +142,23 @@ _STATE_TTL_S = 2.0
 _state_cache: dict = {}
 _state_lock = threading.Lock()
 
+#: bumped on every rollup-substitution state change (a finished roll, a
+#: dropped companion). The frontend plan cache stamps its memoized
+#: "substitution ineligible — skip the probe" decisions with this
+#: version, so a state change evicts those stale shapes immediately.
+_sub_state_version = 0
+
+
+def substitution_state_version() -> int:
+    with _state_lock:
+        return _sub_state_version
+
+
+def _bump_substitution_state() -> None:
+    global _sub_state_version
+    with _state_lock:
+        _sub_state_version += 1
+
 
 def read_state(store, region_dir: str) -> Optional[dict]:
     path = _state_path(region_dir)
@@ -164,6 +181,7 @@ def write_state(store, region_dir: str, state: dict) -> None:
     store.write(path, json.dumps(state).encode())
     with _state_lock:
         _state_cache[path] = (time.monotonic() + _STATE_TTL_S, dict(state))
+    _bump_substitution_state()
 
 
 # ---- the job ---------------------------------------------------------------
@@ -233,6 +251,8 @@ def drop_companions(engine, raw_rid: int) -> int:
             _state_cache.pop(state_path, None)
             _state_cache.pop(f"open-miss:{rrid}", None)
         n += 1
+    if n:
+        _bump_substitution_state()
     return n
 
 
@@ -512,13 +532,9 @@ def substitution_enabled() -> bool:
 
 
 def _conjuncts(e) -> list:
-    from greptimedb_tpu.sql import ast
+    from greptimedb_tpu.query.expr import split_conjuncts
 
-    if e is None:
-        return []
-    if isinstance(e, ast.BinaryOp) and e.op == "and":
-        return _conjuncts(e.left) + _conjuncts(e.right)
-    return [e]
+    return split_conjuncts(e)
 
 
 def _where_ok(where, schema) -> bool:
@@ -696,14 +712,25 @@ def _rewrite_aggs(sel, info, rule: RollupRule):
     )
 
 
-def try_substitute(qe, sel, info, ctx):
+def try_substitute(qe, sel, info, ctx, shape_note=None):
     """Serve an eligible aggregate SELECT from rollup planes instead of
     raw SSTs. Returns a QueryResult, or None to fall through to the raw
-    path. Never raises for ineligibility — any doubt means raw."""
+    path. Never raises for ineligibility — any doubt means raw.
+
+    `shape_note` (optional dict): on a None return,
+    shape_note["memoizable"] says whether the fall-through was
+    STRUCTURAL — no parameter values could make this statement shape
+    substitute under the current rollup state. The frontend plan cache
+    may memoize only those: coverage/alignment/late-data failures
+    depend on the query's literal values (one probe over the live
+    uncovered hour must not disable substitution for the same shape
+    over fully-rolled history)."""
     from greptimedb_tpu.query.expr import extract_ts_bounds
     from greptimedb_tpu.query.planner import plan_select
     from greptimedb_tpu.storage.region import Region
 
+    if shape_note is not None:
+        shape_note["memoizable"] = True
     engine = qe.region_engine
     maint = getattr(engine, "maintenance", None)
     if maint is None or not maint.rollup_rules or not substitution_enabled():
@@ -720,15 +747,23 @@ def try_substitute(qe, sel, info, ctx):
         return None
     lo, hi = int(bounds[0]), int(bounds[1])
 
+    def value_dependent():
+        # this shape COULD substitute with other literal values (or
+        # after transient region/coverage state settles): the negative
+        # outcome must not be memoized against the shape
+        if shape_note is not None:
+            shape_note["memoizable"] = False
+
     # coarsest eligible rule wins: fewest plane rows scanned
     rules = sorted(maint.rollup_rules, key=lambda r: -r.resolution_ms)
     for rule in rules:
         rule_idx = rule_slot(rule.resolution_ms)
         r_units = max(1, ms_to_units(rule.resolution_ms, dtype))
-        if lo % r_units or hi % r_units:
-            continue
         steps = _group_keys_ok(sel, info, r_units)
         if steps is None:
+            continue
+        if lo % r_units or hi % r_units:
+            value_dependent()
             continue
         rollup_rids = []
         ok = True
@@ -736,6 +771,7 @@ def try_substitute(qe, sel, info, ctx):
             try:
                 region = engine.region(rid)
             except Exception:  # noqa: BLE001 — remote/unroutable region
+                value_dependent()  # transient routing: re-probe later
                 return None
             if not isinstance(region, Region):
                 return None  # frontend router: planes live datanode-side
@@ -769,11 +805,13 @@ def try_substitute(qe, sel, info, ctx):
                 ok = False
                 break
             if not (state["cov_lo"] <= lo and hi <= state["cov_hi"]):
-                ok = False
+                ok = False  # THESE bounds uncovered; others may be
+                value_dependent()
                 break
             if _late_data_since(region, lo, hi,
                                 state.get("as_of_seq", -1)):
                 ok = False  # out-of-order write not yet re-rolled
+                value_dependent()
                 break
             rollup_rids.append(rrid)
         if not ok:
@@ -791,7 +829,8 @@ def try_substitute(qe, sel, info, ctx):
             plan = plan_select(new_sel, rollup_info)
             res = qe.executor.execute(plan)
         except Exception:  # noqa: BLE001 — odd rewrite / schema drift:
-            continue       # the raw path is always correct
+            value_dependent()  # any doubt: raw now, but re-probe
+            continue           # the raw path is always correct
         from greptimedb_tpu.utils.metrics import ROLLUP_SUBSTITUTIONS
 
         ROLLUP_SUBSTITUTIONS.inc(table=info.name,

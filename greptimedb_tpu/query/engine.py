@@ -39,8 +39,9 @@ from greptimedb_tpu.session import QueryContext  # noqa: E402
 class QueryEngine:
     def __init__(self, catalog: Catalog, region_engine: RegionEngine,
                  metric_engine=None, plugins=None,
-                 default_timezone: str = "UTC"):
+                 default_timezone: str = "UTC", concurrency=None):
         from greptimedb_tpu.auth import PermissionChecker
+        from greptimedb_tpu.concurrency import ConcurrencyPlane
         from greptimedb_tpu.plugins import default_plugins
 
         self.catalog = catalog
@@ -49,6 +50,11 @@ class QueryEngine:
         self.permission_checker = PermissionChecker()
         self.plugins = plugins if plugins is not None else default_plugins()
         self.executor = PhysicalExecutor(region_engine)
+        # frontend concurrency plane (concurrency/ package): admission
+        # control + plan cache + cross-query batching; every statement
+        # routes through it (pass concurrency= to inject a tuned one)
+        self.concurrency = concurrency if concurrency is not None \
+            else ConcurrencyPlane()
         from collections import OrderedDict
 
         self._stmt_cache: "OrderedDict[str, list]" = OrderedDict()
@@ -94,8 +100,15 @@ class QueryEngine:
                 # assign it — clear it so a non-aggregate slow statement
                 # doesn't inherit the previous query's path
                 self.executor.last_path = None
-                results = [self.execute_statement(s, ctx)
-                           for s in self._parse_cached(sql)]
+                stmts = self._parse_cached(sql)
+                # bounded admission + per-tenant fair scheduling: wait
+                # time counts into the slow-query watch (queueing IS
+                # part of the latency the operator debugs); nested
+                # statements ride their top-level slot
+                with self.concurrency.admission.slot(
+                        self.concurrency.tenant_of(ctx)):
+                    results = [self.execute_statement(s, ctx)
+                               for s in stmts]
                 last = results[-1] if results else None
                 if last is not None:
                     w.rows = last.num_rows if last.is_query \
@@ -672,6 +685,18 @@ class QueryEngine:
             return QueryResult(names, dtypes, cols)
         info = self._table(sel.table, ctx)
         sel = _subst_session_funcs(sel, ctx)
+        # concurrency plane: a top-level SELECT on a busy server may
+        # coalesce/stack with shape-compatible concurrent queries; the
+        # plane always lands back in _select_table below
+        return self.concurrency.execute_select(self, sel, info, ctx)
+
+    def _select_table(self, sel: ast.Select, info: TableInfo,
+                      ctx: QueryContext) -> QueryResult:
+        """The single-table SELECT pipeline below the concurrency plane
+        (window pushdown, RANGE..ALIGN, rollup substitution, the plan
+        cache, device execution). Batch leaders re-enter here with the
+        combined statement."""
+        from greptimedb_tpu.query.join import execute_select_over
         from greptimedb_tpu.query import range_select as rs
         from greptimedb_tpu.query.window import select_has_window
 
@@ -680,10 +705,7 @@ class QueryEngine:
                 # SQL evaluation order: aggregate first (full device agg
                 # path — all aggregate functions), then windows over the
                 # G-row grouped relation
-                from greptimedb_tpu.query.join import (
-                    execute_select_over,
-                    split_groupby_window,
-                )
+                from greptimedb_tpu.query.join import split_groupby_window
 
                 inner, outer = split_groupby_window(sel)
                 base = self._select(inner, ctx)
@@ -732,16 +754,43 @@ class QueryEngine:
         if rs.is_range_select(sel):
             rplan = rs.plan_range_select(sel, info)
             return rs.execute_range_select(self.executor, rplan)
+        # shape-keyed plan cache: repeated dashboard statements re-bind
+        # a cached validated plan instead of re-planning; the entry also
+        # memoizes a negative rollup-substitution probe (version-stamped
+        # — any rollup state change re-probes)
+        plan, entry, binding = self.concurrency.plan_cache.lookup(sel, info)
+        # non-aggregate statements never probe, so their memo is
+        # trivially safe; a probed shape may memoize the negative
+        # outcome only when it was STRUCTURAL (shape_note) — coverage /
+        # alignment failures depend on this query's literal values and
+        # must not disable substitution for sibling parameter bindings
+        sub_note = {"memoizable": True}
+        sub_stamp = None
         if sel.group_by or any(has_aggregate(it.expr) for it in sel.items):
             # rollup substitution: eligible coarse-bucket aggregates are
             # served from downsampled plane SSTs (maintenance/rollup.py);
             # None = ineligible/uncovered, fall through to the raw scan
-            from greptimedb_tpu.maintenance.rollup import try_substitute
+            if entry is None or not entry.skip_substitution():
+                from greptimedb_tpu.concurrency.plan_cache import (
+                    substitution_stamp,
+                )
+                from greptimedb_tpu.maintenance.rollup import try_substitute
 
-            res = try_substitute(self, sel, info, ctx)
-            if res is not None:
-                return res
-        plan = plan_select(sel, info)
+                # pre-probe stamp: a roll finishing mid-probe must not
+                # lend its fresher version to this negative outcome
+                sub_stamp = substitution_stamp()
+                res = try_substitute(self, sel, info, ctx,
+                                     shape_note=sub_note)
+                if res is not None:
+                    return res
+                if entry is not None and sub_note.get("memoizable"):
+                    entry.mark_sub_ineligible(sub_stamp)
+        if plan is None:
+            plan = plan_select(sel, info)
+            entry = self.concurrency.plan_cache.store(binding, sel, info,
+                                                      plan)
+            if entry is not None and sub_note.get("memoizable"):
+                entry.mark_sub_ineligible(sub_stamp)
         return self.executor.execute(plan)
 
     def _try_window_pushdown(self, sel: ast.Select, info, ctx):
@@ -850,6 +899,13 @@ class QueryEngine:
         PARTITION ON COLUMNS clause, partition/src/multi_dim.rs)."""
         return self._create_table(stmt, ctx, rule=rule)
 
+    def _invalidate_plans(self, db: str, name: str) -> None:
+        """DDL changed `db.name`: evict its cached plan shapes (the
+        content-comparison safety net would also catch it, but explicit
+        eviction keeps the cache from serving a doomed rebind and makes
+        the invalidation observable in gtpu_plan_cache_events_total)."""
+        self.concurrency.invalidate_table(db, name)
+
     def _create_table(
         self, stmt: ast.CreateTable, ctx: QueryContext, rule=None
     ) -> QueryResult:
@@ -861,6 +917,8 @@ class QueryEngine:
         name = stmt.name
         if "." in name:
             db, name = name.rsplit(".", 1)
+        # a DROP+CREATE cycle must not serve the old table's shapes
+        self._invalidate_plans(db, name)
         time_index = stmt.time_index
         pks = list(stmt.primary_keys)
         for c in stmt.columns:
@@ -1042,6 +1100,7 @@ class QueryEngine:
         name = stmt.name
         if "." in name:
             db, name = name.rsplit(".", 1)
+        self._invalidate_plans(db, name)
         ddl = getattr(self.region_engine, "ddl_manager", None)
         if ddl is not None:
             dropped_rids: list = []
@@ -1090,6 +1149,7 @@ class QueryEngine:
 
     def _truncate(self, stmt: ast.TruncateTable, ctx: QueryContext) -> QueryResult:
         info = self._table(stmt.name, ctx)
+        self._invalidate_plans(info.db, info.name)
         engine_kind = info.options.get("engine")
         if engine_kind == "file":
             raise PlanError("file engine tables are read-only; "
@@ -1108,6 +1168,7 @@ class QueryEngine:
 
     def _alter(self, stmt: ast.AlterTable, ctx: QueryContext) -> QueryResult:
         info = self._table(stmt.name, ctx)
+        self._invalidate_plans(info.db, info.name)
         if stmt.action == "add_column":
             col = stmt.column
             dtype = parse_sql_type(col.type_name)
@@ -1491,7 +1552,10 @@ class QueryEngine:
         tid = tracing.set_trace(None)
         try:
             t0 = _time.perf_counter()
-            result = run()
+            # ANALYZE must run ITS OWN execution: riding a batch
+            # leader's run would report someone else's (empty) trace
+            with self.concurrency.suppress_batching():
+                result = run()
             total_ms = (_time.perf_counter() - t0) * 1000.0
             spans = tracing.spans_for(tid)
         finally:

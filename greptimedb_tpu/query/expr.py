@@ -893,6 +893,17 @@ def extract_ts_bounds(
     return lo, hi
 
 
+def split_conjuncts(where) -> list:
+    """The AND-conjunction atoms of a WHERE clause (None -> []) — the
+    one splitter shared by join pushdown, rollup eligibility, and the
+    cross-query batcher, so their notion of 'a conjunct' can't drift."""
+    if where is None:
+        return []
+    if isinstance(where, ast.BinaryOp) and where.op == "and":
+        return split_conjuncts(where.left) + split_conjuncts(where.right)
+    return [where]
+
+
 def collect_columns(e: Optional[ast.Expr], out: set[str]) -> set[str]:
     """All column names referenced by an expression."""
     if e is None:

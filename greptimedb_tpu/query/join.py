@@ -233,17 +233,9 @@ def execute_select_over(qe, sel: ast.Select, base_cols: dict,
 
 
 def _split_conjuncts(where):
-    out = []
+    from greptimedb_tpu.query.expr import split_conjuncts
 
-    def walk(e):
-        if isinstance(e, ast.BinaryOp) and e.op == "and":
-            walk(e.left)
-            walk(e.right)
-        elif e is not None:
-            out.append(e)
-
-    walk(where)
-    return out
+    return split_conjuncts(where)
 
 
 def _columns_in(e, out: set):

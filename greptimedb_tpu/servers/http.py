@@ -138,10 +138,17 @@ class _Handler(BaseHTTPRequestHandler):
             from greptimedb_tpu.utils.time import tzinfo_for
 
             tzinfo_for(tz)  # fail fast on a typo'd zone name
+        user = getattr(self, "_user", None)
+        # X-Greptime-Tenant: admission-control identity for fair
+        # scheduling; falls back to the authenticated user, then the db
+        tenant = self.headers.get("X-Greptime-Tenant") \
+            or params.get("tenant") \
+            or getattr(user, "username", None)
         return QueryContext(db=params.get("db", "public"),
                             channel=Channel.HTTP,
                             timezone=tz or None,
-                            user=getattr(self, "_user", None))
+                            tenant=tenant,
+                            user=user)
 
     # ---- routing -----------------------------------------------------------
 

@@ -26,6 +26,7 @@ from typing import Optional
 
 import numpy as np
 
+from greptimedb_tpu.fault import Unavailable
 from greptimedb_tpu.query.engine import QueryContext, QueryEngine
 from greptimedb_tpu.utils.metrics import REGISTRY
 
@@ -221,7 +222,9 @@ class _Session(socketserver.BaseRequestHandler):
                 return
         io.send_packet(_ok())
         from greptimedb_tpu.session import Channel
-        ctx = QueryContext(db=db, channel=Channel.MYSQL, user=user_info)
+        ctx = QueryContext(db=db, channel=Channel.MYSQL, user=user_info,
+                           tenant=getattr(user_info, "username", None)
+                           or (user or None))
         # prepared-statement registry, per connection (handler.rs:153
         # keeps a SqlPlan map keyed by stmt id the same way); the third
         # slot caches parameter types — libmysqlclient connectors send the
@@ -266,6 +269,11 @@ class _Session(socketserver.BaseRequestHandler):
                     stmts[stmt_id][2] = types
                     bound = _bind_params(sql, params)
                     result = _dispatch(server.query_engine, bound, ctx)
+                except Unavailable as e:
+                    # typed overload/degradation: 1040 tells clients to
+                    # back off and retry, not report a syntax error
+                    io.send_packet(_err(1040, "08004", str(e)[:400]))
+                    continue
                 except Exception as e:  # noqa: BLE001 — wire must stay up
                     io.send_packet(_err(1064, "42000", str(e)[:400]))
                     continue
@@ -289,6 +297,9 @@ class _Session(socketserver.BaseRequestHandler):
             sql = body.decode("utf-8", "replace").strip().rstrip(";")
             try:
                 result = _dispatch(server.query_engine, sql, ctx)
+            except Unavailable as e:
+                io.send_packet(_err(1040, "08004", str(e)[:400]))
+                continue
             except Exception as e:  # noqa: BLE001 — wire must stay up
                 io.send_packet(_err(1064, "42000", str(e)[:400]))
                 continue

@@ -46,6 +46,10 @@ class QueryContext:
     timezone: Optional[str] = None
     channel: Channel = Channel.UNKNOWN
     user: Optional[object] = None  # auth.UserInfo when authenticated
+    # fair-scheduling identity for the admission controller; servers
+    # stamp it from X-Greptime-Tenant / the authenticated user, falling
+    # back to "default" (concurrency/admission.py)
+    tenant: Optional[str] = None
     # W3C trace context for cross-process propagation (SURVEY §5)
     trace_id: Optional[str] = None
     extensions: dict = field(default_factory=dict)
@@ -57,5 +61,6 @@ class QueryContext:
     def with_db(self, db: str) -> "QueryContext":
         return QueryContext(db=db, timezone=self.timezone,
                             channel=self.channel, user=self.user,
+                            tenant=self.tenant,
                             trace_id=self.trace_id,
                             extensions=self.extensions)

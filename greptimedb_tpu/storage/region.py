@@ -51,6 +51,21 @@ class _PartEntry:
     nbytes: int
 
 
+def _scan_nbytes(sd: "ScanData") -> int:
+    """Host bytes a whole-scan snapshot holds (column arrays + seq/op).
+    Object columns undercount their string payload — the budget errs
+    permissive there, like the part cache does."""
+    n = 0
+    for v in sd.columns.values():
+        if isinstance(v, np.ndarray):
+            n += v.nbytes
+    if isinstance(sd.seq, np.ndarray):
+        n += sd.seq.nbytes
+    if isinstance(sd.op_type, np.ndarray):
+        n += sd.op_type.nbytes
+    return n
+
+
 def _part_nbytes(part: Optional[tuple]) -> int:
     if part is None:
         return 64  # bookkeeping floor for cached pruned-empty entries
@@ -173,6 +188,15 @@ class Region:
         # queries skip parquet decode entirely
         self._scan_cache: "OrderedDict[tuple, ScanData]" = OrderedDict()
         self.scan_cache_entries = 4  # overridden from EngineConfig
+        # whole-scan snapshots and per-file parts draw on ONE shared
+        # byte budget (part_cache_budget): the snapshot is a concat
+        # COPY of the parts, so accounting them separately
+        # double-counted host RAM (ROADMAP carry-over). The NEWEST
+        # snapshot is exempt from the budget — refusing to cache the
+        # working set of the current dashboard would trade a bounded
+        # overshoot for re-decoding the table every query.
+        self._scan_cache_sizes: dict[tuple, int] = {}
+        self._scan_cache_bytes = 0
         # per-file decoded-part cache: (file_id, ts_range, names, preds)
         # -> _PartEntry, byte-budgeted LRU. SSTs are immutable, so an
         # entry stays valid for the file's whole life — a flush only
@@ -229,6 +253,8 @@ class Region:
             self._invalidate_file_parts(list(self.files))
             self.files.clear()
             self._scan_cache.clear()
+            self._scan_cache_sizes.clear()
+            self._scan_cache_bytes = 0
 
     def close(self) -> None:
         """Release deferred resources (deleted-but-grace-held SSTs)."""
@@ -261,24 +287,75 @@ class Region:
 
     # ---- per-file decoded-part cache + parallel decode ---------------------
 
+    @property
+    def _host_cache_bytes(self) -> int:
+        """Bytes the part cache AND the whole-scan snapshots hold —
+        the one number the shared budget bounds."""
+        return self._part_cache_bytes + self._scan_cache_bytes
+
     def _part_cache_put(self, key: tuple, ent: _PartEntry) -> None:
-        """Insert under the byte budget (caller holds self._lock)."""
+        """Insert under the SHARED byte budget (caller holds self._lock):
+        parts and whole-scan snapshots compete for the same bytes; a
+        part insert evicts older parts, never snapshots (the snapshot is
+        the hotter end product)."""
         from greptimedb_tpu.utils.metrics import SCAN_PART_CACHE_EVENTS
 
-        if ent.nbytes > self.part_cache_budget:
-            return  # one oversized part must not wipe the whole cache
+        # parts get whatever the resident snapshots leave over; when a
+        # budget-exempt newest snapshot alone exceeds the budget there
+        # is nothing left — refuse the insert instead of thrash-evicting
+        # every part (including this one) on every decode
+        avail = self.part_cache_budget - self._scan_cache_bytes
+        if ent.nbytes > avail:
+            return  # an entry that can never fit must not wipe the cache
         old = self._part_cache.pop(key, None)
         if old is not None:
             self._part_cache_bytes -= old.nbytes
         self._part_cache[key] = ent
         self._part_cache_bytes += ent.nbytes
         evicted = 0
-        while self._part_cache_bytes > self.part_cache_budget \
+        while self._part_cache_bytes > avail \
                 and self._part_cache:
             _, e = self._part_cache.popitem(last=False)
             self._part_cache_bytes -= e.nbytes
             evicted += 1
         if evicted:
+            SCAN_PART_CACHE_EVENTS.inc(float(evicted), event="evict")
+
+    def _scan_cache_put(self, key: tuple, result: "ScanData") -> None:
+        """Cache a whole-scan snapshot against the shared budget
+        (caller holds self._lock): evict older snapshots beyond the
+        entry-count limit, then cold parts, then older snapshots until
+        the total fits — the newest snapshot itself always caches (it
+        is live in the caller regardless; bounded overshoot beats
+        re-decoding the active dashboard's table every query)."""
+        nb = _scan_nbytes(result)
+        old = self._scan_cache.pop(key, None)
+        if old is not None:
+            self._scan_cache_bytes -= self._scan_cache_sizes.pop(key, 0)
+        from greptimedb_tpu.utils.metrics import SCAN_PART_CACHE_EVENTS
+
+        self._scan_cache[key] = result
+        self._scan_cache_sizes[key] = nb
+        self._scan_cache_bytes += nb
+        evicted = 0
+        while len(self._scan_cache) > self.scan_cache_entries:
+            k, _ = self._scan_cache.popitem(last=False)
+            self._scan_cache_bytes -= self._scan_cache_sizes.pop(k, 0)
+            evicted += 1
+        while self._host_cache_bytes > self.part_cache_budget \
+                and self._part_cache:
+            _, e = self._part_cache.popitem(last=False)
+            self._part_cache_bytes -= e.nbytes
+            evicted += 1
+        while self._host_cache_bytes > self.part_cache_budget \
+                and len(self._scan_cache) > 1:
+            k, _ = self._scan_cache.popitem(last=False)
+            self._scan_cache_bytes -= self._scan_cache_sizes.pop(k, 0)
+            evicted += 1
+        if evicted:
+            # snapshot evictions count here too: both caches spend the
+            # ONE shared budget, so the operator's evict series must
+            # show all of its churn, not just the part half
             SCAN_PART_CACHE_EVENTS.inc(float(evicted), event="evict")
 
     def _invalidate_file_parts(self, file_ids) -> None:
@@ -807,9 +884,7 @@ class Region:
                    **decode_stats},
         )
         with self._lock:
-            self._scan_cache[cache_key] = result
-            while len(self._scan_cache) > self.scan_cache_entries:
-                self._scan_cache.popitem(last=False)
+            self._scan_cache_put(cache_key, result)
         return result
 
     def scan_last(self, group_tag: str,
@@ -995,17 +1070,21 @@ class Region:
                    "decode_workers": workers},
         )
         with self._lock:
-            self._scan_cache[cache_key] = result
-            while len(self._scan_cache) > self.scan_cache_entries:
-                self._scan_cache.popitem(last=False)
+            self._scan_cache_put(cache_key, result)
         return result
 
     def _scan_since(self, seq_min: int, ts_range, names,
                     tag_predicates) -> Optional[ScanData]:
         """The seq_min slice of scan(): rows with seq > seq_min only.
-        Uncached (each consumer's boundary differs and moves every
-        tick); SSTs whose max_seq <= seq_min never leave disk."""
-        ts_name = self.schema.time_index.name
+        The whole-scan result is uncached (each consumer's boundary
+        differs and moves every tick), but the per-file decode rides
+        the shared part cache + decode pool — a boundary-straddling
+        file decodes once, not once per tick, and misses fan out in
+        parallel exactly like scan(); SSTs whose max_seq <= seq_min
+        never leave disk."""
+        from greptimedb_tpu.storage.index import predicates_cache_key
+
+        pred_key = predicates_cache_key(tag_predicates)
         with self._lock:
             version = self.data_version
             file_list = [m for m in self.files.values()
@@ -1017,33 +1096,27 @@ class Region:
         parts_op: list[np.ndarray] = []
         sst_part_lens: list[int] = []
         try:
-            for meta in file_list:
-                table = self.sst_reader.read(meta, self.schema, ts_range,
-                                             names,
-                                             tag_predicates=tag_predicates)
-                if table is None or table.num_rows == 0:
-                    continue
-                cols = self._decode_sst(table, names)
-                seq_col = table.column(SEQ_COL).to_numpy(
-                    zero_copy_only=False).astype(np.int64)
-                op_col = table.column(OP_COL).to_numpy(
-                    zero_copy_only=False).astype(np.int8)
-                m = seq_col > seq_min
-                if ts_range is not None:
-                    tsv = cols[ts_name]
-                    m &= (tsv >= ts_range[0]) & (tsv < ts_range[1])
-                if not m.all():
-                    if not m.any():
-                        continue
-                    cols = {n: v[m] for n, v in cols.items()}
-                    seq_col = seq_col[m]
-                    op_col = op_col[m]
-                parts_cols.append(cols)
-                parts_seq.append(seq_col)
-                parts_op.append(op_col)
-                sst_part_lens.append(len(seq_col))
+            part_entries, _stats = self._cached_parts(
+                file_list, ts_range, names, pred_key, tag_predicates)
         finally:
             self._unpin_files(file_list)
+        for ent in part_entries:
+            if ent.part is None:
+                continue
+            # parts are ts-filtered already; the seq boundary applies on
+            # COPIES — cached entries must stay whole for full scans
+            cols, seq_col, op_col = ent.part
+            m = seq_col > seq_min
+            if not m.any():
+                continue
+            if not m.all():
+                cols = {n: v[m] for n, v in cols.items()}
+                seq_col = seq_col[m]
+                op_col = op_col[m]
+            parts_cols.append(cols)
+            parts_seq.append(seq_col)
+            parts_op.append(op_col)
+            sst_part_lens.append(len(seq_col))
         if mem is not None:
             mcols, mseq, mop = mem
             m = mseq > seq_min
@@ -1115,14 +1188,25 @@ class Region:
                 self._unpin_files(snapshot_files)
 
         def gen():
+            from greptimedb_tpu.storage import scan_pool
+
+            workers = scan_pool.resolve(self.decode_threads, len(files))
             try:
-                for meta in files:
-                    for table in self.sst_reader.iter_chunks(
-                            meta, self.schema, ts_range, names,
-                            tag_predicates=tag_predicates,
-                            groups_per_chunk=groups_per_chunk):
-                        if table.num_rows:
-                            yield self._decode_sst(table, names), table.num_rows
+                if workers <= 1 or len(files) <= 1:
+                    # decode_threads=1: byte-for-byte the sequential
+                    # pre-pipeline path (parity tests compare to it)
+                    for meta in files:
+                        for table in self.sst_reader.iter_chunks(
+                                meta, self.schema, ts_range, names,
+                                tag_predicates=tag_predicates,
+                                groups_per_chunk=groups_per_chunk):
+                            if table.num_rows:
+                                yield (self._decode_sst(table, names),
+                                       table.num_rows)
+                else:
+                    yield from self._stream_files_parallel(
+                        files, ts_range, names, tag_predicates,
+                        groups_per_chunk, workers)
                 if mem is not None and len(mem[1]):
                     yield {n: mem[0][n] for n in names}, len(mem[1])
             finally:
@@ -1142,6 +1226,111 @@ class Region:
             _chunks=gen,
             _close=unpin_once,
         )
+
+    def _stream_files_parallel(self, files, ts_range, names,
+                               tag_predicates, groups_per_chunk,
+                               workers: int):
+        """Streaming-scan decode pipeline: up to `workers` files decode
+        concurrently, each producing into its own small bounded queue;
+        the consumer drains queues in file order, so chunks come out in
+        EXACTLY the serial order (file order, chunk order within a file
+        — the bit-for-bit parity contract) while later files decode in
+        the background. Host memory stays bounded: workers x (queue of
+        2 + 1 in-flight) chunks. Errors surface at the failing file's
+        position in the consumption order, like the serial loop raised
+        them.
+
+        Producers run on a PER-STREAM executor, not the shared scan
+        pool: a stream is consumer-paced — a client that pauses between
+        chunks parks its producers against their full queues for
+        arbitrarily long, and on the shared pool those parked workers
+        would starve every other scan's decode on the datanode. The
+        worker COUNT still honors the [scan] decode_threads sizing."""
+        import queue as _queue
+        from concurrent.futures import ThreadPoolExecutor
+        from concurrent.futures import TimeoutError as _FutTimeout
+
+        pool = ThreadPoolExecutor(
+            max_workers=workers,
+            thread_name_prefix="gtpu-stream-decode")
+        stop = threading.Event()
+
+        def produce(meta, out):
+            try:
+                for table in self.sst_reader.iter_chunks(
+                        meta, self.schema, ts_range, names,
+                        tag_predicates=tag_predicates,
+                        groups_per_chunk=groups_per_chunk):
+                    if stop.is_set():
+                        return
+                    if not table.num_rows:
+                        continue
+                    item = ("chunk",
+                            (self._decode_sst(table, names),
+                             table.num_rows))
+                    while not stop.is_set():
+                        try:
+                            out.put(item, timeout=0.05)
+                            break
+                        except _queue.Full:
+                            continue
+            except BaseException as e:  # noqa: BLE001 — shipped in order
+                while not stop.is_set():
+                    try:
+                        out.put(("error", e), timeout=0.05)
+                        return
+                    except _queue.Full:
+                        continue
+            finally:
+                while not stop.is_set():
+                    try:
+                        out.put(("end", None), timeout=0.05)
+                        return
+                    except _queue.Full:
+                        continue
+
+        queues: dict[int, _queue.Queue] = {}
+        futs = []
+        nxt = 0
+        try:
+            for i in range(len(files)):
+                while nxt < len(files) and nxt < i + workers:
+                    q = _queue.Queue(maxsize=2)
+                    queues[nxt] = q
+                    futs.append(pool.submit(produce, files[nxt], q))
+                    nxt += 1
+                q = queues.pop(i)
+                while True:
+                    kind, payload = q.get()
+                    if kind == "end":
+                        break
+                    if kind == "error":
+                        raise payload
+                    yield payload
+        finally:
+            # producers poll `stop` on every put/iteration; wait for
+            # every submitted future so no worker touches SST bytes
+            # after the caller's unpin
+            stop.set()
+            for q in queues.values():
+                try:
+                    while True:
+                        q.get_nowait()
+                except _queue.Empty:
+                    pass
+            for f in futs:
+                while True:
+                    try:
+                        f.result(timeout=30)
+                        break
+                    except _FutTimeout:
+                        # a producer wedged in a slow read still holds
+                        # SST handles — the caller's unpin MUST wait it
+                        # out, or compaction could delete bytes mid-read
+                        continue
+                    except Exception:  # noqa: BLE001 — already surfaced
+                        break
+            pool.shutdown(wait=False)
 
     def _scan_columns(self, projection: Optional[Sequence[str]]) -> list[str]:
         ts_name = self.schema.time_index.name

@@ -295,7 +295,9 @@ SCAN_DECODE_SECONDS = REGISTRY.histogram(
     "(cache misses only; parallel decodes observe concurrently)")
 SCAN_PART_CACHE_EVENTS = REGISTRY.counter(
     "greptimedb_tpu_scan_part_cache_events_total",
-    "Per-file decoded-part scan cache events by kind (hit/miss/evict)")
+    "Per-file decoded-part scan cache events by kind (hit/miss/evict; "
+    "evict includes whole-scan snapshots aged out of the shared host "
+    "byte budget)")
 SCAN_DECODE_BYTES = REGISTRY.counter(
     "greptimedb_tpu_scan_decode_bytes_total",
     "Host bytes materialized by SST scan decode (part-cache misses)")
@@ -327,6 +329,34 @@ WRITE_STALL_TIMEOUTS = REGISTRY.counter(
     "greptimedb_tpu_write_stall_timeouts_total",
     "Stalls that hit stall_timeout_s and fell back to an inline flush "
     "(the maintenance plane is wedged or saturated)")
+# frontend concurrency plane (concurrency/ package): the shape-keyed
+# plan cache, admission control, and cross-query batching that carry
+# fleet-scale dashboard traffic (ISSUE 6) — hit rates and rejection
+# behavior are asserted from these series, not eyeballed
+PLAN_CACHE_EVENTS = REGISTRY.counter(
+    "greptimedb_tpu_plan_cache_events_total",
+    "Shape-keyed logical-plan cache events by kind (hit/miss/evict/"
+    "invalidate — invalidations come from DDL, schema drift, and "
+    "rollup-substitution state changes)")
+ADMISSION_EVENTS = REGISTRY.counter(
+    "greptimedb_tpu_admission_events_total",
+    "Admission control decisions by kind (admit/queue/reject_full/"
+    "reject_timeout; rejections carry the tenant label)")
+ADMISSION_QUEUE_DEPTH = REGISTRY.gauge(
+    "greptimedb_tpu_admission_queue_depth",
+    "Statements currently waiting in the bounded admission queue")
+ADMISSION_WAIT_SECONDS = REGISTRY.histogram(
+    "greptimedb_tpu_admission_wait_seconds",
+    "Time queued statements waited for an execution slot")
+QUERY_BATCH_EVENTS = REGISTRY.counter(
+    "greptimedb_tpu_query_batch_events_total",
+    "Cross-query batching events by kind (join/coalesced/stacked/"
+    "serial_fallback — coalesced and stacked members skipped their own "
+    "device dispatch)")
+QUERY_BATCH_SIZE = REGISTRY.histogram(
+    "greptimedb_tpu_query_batch_size",
+    "Queries served per batch group (leader + members)")
+
 ROLLUP_SUBSTITUTIONS = REGISTRY.counter(
     "greptimedb_tpu_maintenance_rollup_substitutions_total",
     "Aggregate queries served from rollup plane SSTs instead of raw "

@@ -62,6 +62,8 @@ def fill_files(engine, rid, n_files=4, rows_per_file=300, hosts=6,
 def clear_scan_caches(region):
     with region._lock:
         region._scan_cache.clear()
+        region._scan_cache_sizes.clear()
+        region._scan_cache_bytes = 0
         region._part_cache.clear()
         region._part_cache_bytes = 0
 
@@ -227,6 +229,37 @@ class TestPartCacheMutation:
         assert SCAN_PART_CACHE_EVENTS.get(event="evict") > before
         assert region._part_cache_bytes <= region.part_cache_budget
         assert scan.num_rows == full.num_rows  # eviction never drops rows
+
+    def test_snapshot_and_parts_share_one_budget(self, engine):
+        """ISSUE-6 satellite (ROADMAP carry-over): the whole-scan
+        snapshot is a concat COPY of the parts — accounting them
+        separately double-counted host RAM. Both draw on
+        part_cache_budget; when a snapshot lands, cold parts age out so
+        the SHARED total fits (the newest snapshot itself is exempt:
+        bounded overshoot beats re-decoding the live table)."""
+        engine.create_region(1, schema3())
+        fill_files(engine, 1, n_files=4)
+        region = engine.region(1)
+        engine.scan(1)
+        assert region._scan_cache_bytes > 0  # snapshots are accounted
+        assert region._host_cache_bytes == (region._part_cache_bytes
+                                            + region._scan_cache_bytes)
+        # budget below one snapshot: every cold part must age out, the
+        # newest snapshot (still exempt) is the only resident entry
+        region.part_cache_budget = max(1, region._scan_cache_bytes // 2)
+        clear_scan_caches(region)
+        region._scan_cache_sizes.clear()
+        region._scan_cache_bytes = 0
+        scan = engine.scan(1)
+        assert scan.num_rows == 1200
+        assert not region._part_cache
+        assert len(region._scan_cache) == 1
+        # dropping the snapshot returns its bytes
+        with region._lock:
+            region._scan_cache.clear()
+            region._scan_cache_sizes.clear()
+            region._scan_cache_bytes = 0
+        assert region._host_cache_bytes == 0
 
 
 @pytest.mark.chaos
@@ -401,6 +434,131 @@ class TestUploadPrefetch:
         assert upload_prefetch_enabled()
         monkeypatch.setenv("GREPTIMEDB_TPU_UPLOAD_PREFETCH", "0")
         assert not upload_prefetch_enabled()
+
+
+class TestStreamAndSeqMinParallel:
+    """ISSUE-6 satellite: scan_stream and the seq_min slice ride the
+    decode pool too — bit-for-bit parity vs the serial path."""
+
+    def _stream_chunks(self, engine, rid, **kwargs):
+        stream = engine.scan_stream(rid, **kwargs)
+        assert stream is not None
+        out = []
+        try:
+            for cols, n in stream.chunks():
+                out.append(({k: np.asarray(v).copy()
+                             for k, v in cols.items()}, n))
+        finally:
+            stream.close()
+        return out
+
+    @staticmethod
+    def _chunks_equal(a, b):
+        if [n for _, n in a] != [n for _, n in b]:
+            return False
+        for (ca, _), (cb, _) in zip(a, b):
+            if set(ca) != set(cb):
+                return False
+            for k in ca:
+                if not np.array_equal(ca[k], cb[k]):
+                    return False
+        return True
+
+    def test_scan_stream_parallel_matches_serial_bit_for_bit(
+            self, engine, monkeypatch):
+        """Chunk ORDER matters, not just content: the parallel pipeline
+        must emit file order, chunk order within a file — exactly the
+        serial loop's sequence."""
+        engine.create_region(1, schema3())
+        fill_files(engine, 1, n_files=6)
+        for kwargs in ({}, {"ts_range": (1_000_000, 4_000_500)},
+                       {"projection": ["v"]}):
+            monkeypatch.setenv("GREPTIMEDB_TPU_SCAN_DECODE_THREADS", "1")
+            serial = self._stream_chunks(engine, 1, **kwargs)
+            monkeypatch.setenv("GREPTIMEDB_TPU_SCAN_DECODE_THREADS", "4")
+            par = self._stream_chunks(engine, 1, **kwargs)
+            assert self._chunks_equal(serial, par), kwargs
+        assert sum(n for _, n in serial) > 0
+
+    def test_scan_stream_memtable_tail_after_parallel_files(
+            self, engine, monkeypatch):
+        engine.create_region(1, schema3())
+        fill_files(engine, 1, n_files=4)
+        schema = engine.region(1).schema
+        # unflushed rows ride the stream's tail chunk
+        engine.put(1, make_batch(schema, ["h9"] * 3,
+                                 [9_000_000, 9_000_010, 9_000_020],
+                                 [1.0, 2.0, 3.0]))
+        monkeypatch.setenv("GREPTIMEDB_TPU_SCAN_DECODE_THREADS", "1")
+        serial = self._stream_chunks(engine, 1)
+        monkeypatch.setenv("GREPTIMEDB_TPU_SCAN_DECODE_THREADS", "4")
+        par = self._stream_chunks(engine, 1)
+        assert self._chunks_equal(serial, par)
+
+    def test_scan_stream_abandoned_midway_unpins(self, engine,
+                                                 monkeypatch):
+        """Abandoning a parallel stream must stop the producers and
+        release every file pin (the compaction path depends on it)."""
+        engine.create_region(1, schema3())
+        fill_files(engine, 1, n_files=6)
+        import time
+
+        monkeypatch.setenv("GREPTIMEDB_TPU_SCAN_DECODE_THREADS", "4")
+        region = engine.region(1)
+        stream = engine.scan_stream(1)
+        it = stream.chunks()
+        next(it)  # consume one chunk, then walk away
+        it.close()
+        stream.close()
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            with region._lock:
+                if not region._file_refs:
+                    return
+            time.sleep(0.01)
+        raise AssertionError("file pins leaked after abandoned stream")
+
+    def test_seq_min_parallel_matches_serial_bit_for_bit(
+            self, engine, monkeypatch):
+        engine.create_region(1, schema3())
+        fill_files(engine, 1, n_files=5)
+        region = engine.region(1)
+        full = engine.scan(1)
+        boundaries = [0, int(full.seq.min()),
+                      int(np.median(full.seq)), int(full.seq.max())]
+        for s in boundaries:
+            monkeypatch.setenv("GREPTIMEDB_TPU_SCAN_DECODE_THREADS", "1")
+            clear_scan_caches(region)
+            serial = engine.scan(1, seq_min=s)
+            monkeypatch.setenv("GREPTIMEDB_TPU_SCAN_DECODE_THREADS", "4")
+            clear_scan_caches(region)
+            par = engine.scan(1, seq_min=s)
+            if serial is None or par is None:
+                assert serial is None and par is None, s
+                continue
+            assert scans_equal(serial, par), s
+
+    def test_seq_min_rides_the_part_cache(self, engine, monkeypatch):
+        """A boundary-straddling file decodes ONCE, not once per tick:
+        the second seq_min scan over the same files is all part-cache
+        hits, and the seq filter applies on copies (a later FULL scan
+        still sees every row)."""
+        from greptimedb_tpu.utils.metrics import SCAN_PART_CACHE_EVENTS
+
+        engine.create_region(1, schema3())
+        fill_files(engine, 1, n_files=3)
+        monkeypatch.setenv("GREPTIMEDB_TPU_SCAN_DECODE_THREADS", "4")
+        region = engine.region(1)
+        clear_scan_caches(region)
+        first = engine.scan(1, seq_min=1)
+        hits0 = SCAN_PART_CACHE_EVENTS.get(event="hit")
+        miss0 = SCAN_PART_CACHE_EVENTS.get(event="miss")
+        again = engine.scan(1, seq_min=1)
+        assert SCAN_PART_CACHE_EVENTS.get(event="hit") > hits0
+        assert SCAN_PART_CACHE_EVENTS.get(event="miss") == miss0
+        assert scans_equal(first, again)
+        full = engine.scan(1)
+        assert full.num_rows == 900  # cached parts stayed whole
 
 
 @pytest.mark.chaos
