@@ -997,6 +997,118 @@ def bench_scan_pipeline(engine, qe, results):
         "baseline_ms": None, "vs_baseline": None}
 
 
+def bench_device_tier(engine, qe, results):
+    """Device-tier micro-phase (ISSUE 7): the headline double-groupby
+    shape pinned to the device tier — cold (empty hot set) vs hot-set-
+    warm p50, warmup compile seconds, per-query H2D bytes from the
+    transfer-counter deltas, MEASURED hbm utilization from the
+    allocator (not the analytic roofline), and the post-flush query
+    that must re-upload ONLY the new file's blocks."""
+    import jax
+    from greptimedb_tpu.datatypes import DictVector, RecordBatch
+    from greptimedb_tpu.utils.metrics import (
+        DEVICE_HOT_SET_BYTES,
+        DEVICE_TRANSFER_BYTES,
+        PALLAS_DISPATCHES,
+        XLA_COMPILE_SECONDS,
+    )
+
+    avg_list = ", ".join(f"avg({f})" for f in FIELDS)
+    t_end_ms = T0_MS + HOURS * 3600 * 1000
+    sql = (
+        f"SELECT date_bin(INTERVAL '1 hour', ts) AS hour, hostname, "
+        f"{avg_list} FROM cpu WHERE ts >= {T0_MS} AND ts < {t_end_ms} "
+        f"GROUP BY hour, hostname ORDER BY hour, hostname"
+    )
+    ex = qe.executor
+
+    def h2d():
+        return DEVICE_TRANSFER_BYTES.get(direction="h2d")
+
+    def compile_s():
+        with XLA_COMPILE_SECONDS._lock:
+            return sum(XLA_COMPILE_SECONDS._sum.values())
+
+    def fused_dispatches():
+        return PALLAS_DISPATCHES.get(kernel="fused_agg")
+
+    prev = os.environ.get("GREPTIMEDB_TPU_HOST_TIER")
+    os.environ["GREPTIMEDB_TPU_HOST_TIER"] = "off"  # pin the device tier
+    try:
+        ex.cache.clear()  # cold: nothing resident in HBM
+        c0, b0, f0 = compile_s(), h2d(), fused_dispatches()
+        t0 = time.perf_counter()
+        qe.execute_one(sql)
+        cold_ms = (time.perf_counter() - t0) * 1000
+        warmup_compile_s = compile_s() - c0
+        cold_h2d = h2d() - b0
+        path = ex.last_path
+        # hot-set-warm: every block is already HBM-resident, so the
+        # steady-state dashboard repeat should pay ~zero H2D
+        reps = max(REPEATS, 5)
+        times, b1 = [], h2d()
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            qe.execute_one(sql)
+            times.append((time.perf_counter() - t0) * 1000)
+        warm_ms = float(np.median(times))
+        warm_h2d_per_q = (h2d() - b1) / reps
+        # post-flush incremental: the file-anchored hot set keeps the
+        # old files' blocks, so the re-upload is the new file only
+        info = qe.catalog.table("public", "cpu")
+        rid = info.region_ids[0]
+        small = 200
+        names = np.asarray([f"host_{i}" for i in range(small)],
+                           dtype=object)
+        # INSIDE the queried window: an out-of-range flush would be
+        # pruned outright and the "new file only" H2D claim would
+        # measure nothing
+        cols = {"hostname": DictVector(
+                    np.arange(small, dtype=np.int32), names),
+                "ts": np.full(small, t_end_ms - 1000, dtype=np.int64)}
+        rng = np.random.default_rng(31)
+        for fld in FIELDS:
+            cols[fld] = rng.uniform(0.0, 100.0, small)
+        engine.put(rid, RecordBatch(info.schema, cols))
+        engine.flush(rid)
+        b2 = h2d()
+        t0 = time.perf_counter()
+        qe.execute_one(sql)
+        incr_ms = (time.perf_counter() - t0) * 1000
+        incr_h2d = h2d() - b2
+        hot_bytes = DEVICE_HOT_SET_BYTES.get()
+        fused_served = fused_dispatches() - f0
+    finally:
+        if prev is None:
+            os.environ.pop("GREPTIMEDB_TPU_HOST_TIER", None)
+        else:
+            os.environ["GREPTIMEDB_TPU_HOST_TIER"] = prev
+    # measured residency, not the analytic roofline: what the allocator
+    # says is actually living in HBM after the warm queries
+    stats = jax.devices()[0].memory_stats() or {}
+    in_use, limit = stats.get("bytes_in_use"), stats.get("bytes_limit")
+    hbm_util = (round(in_use / limit, 4)
+                if in_use and limit else None)
+    log(f"device-tier: cold {cold_ms:.0f} ms ({cold_h2d / 1e6:.0f} MB "
+        f"H2D, compile {warmup_compile_s:.1f}s) -> warm {warm_ms:.1f} ms "
+        f"({warm_h2d_per_q / 1e6:.2f} MB/query), post-flush "
+        f"{incr_ms:.0f} ms ({incr_h2d / 1e6:.1f} MB), path={path}, "
+        f"hot set {hot_bytes / 1e6:.0f} MB, hbm_util={hbm_util}")
+    results["device_tier"] = {
+        "path": path,
+        "cold_ms": round(cold_ms, 1),
+        "warm_p50_ms": round(warm_ms, 2),
+        "warmup_compile_s": round(warmup_compile_s, 2),
+        "cold_h2d_bytes": int(cold_h2d),
+        "warm_h2d_bytes_per_query": int(warm_h2d_per_q),
+        "post_flush_ms": round(incr_ms, 1),
+        "post_flush_h2d_bytes": int(incr_h2d),
+        "hot_set_bytes": int(hot_bytes),
+        "fused_kernel_dispatches": int(fused_served),
+        "hbm_utilization_measured": hbm_util,
+        "baseline_ms": None, "vs_baseline": None}
+
+
 def bench_sql_insert(qe, results, rows_total=None, per_stmt=500):
     """SQL INSERT path (parse -> bind -> region write incl. WAL), the
     slower sibling of the bulk RecordBatch route the headline ingest
@@ -1500,6 +1612,11 @@ def main():
         checkpoint()
         guarded("anchor_pyarrow_double_groupby",
                 lambda: bench_anchor(engine, qe, results))
+        checkpoint()
+        # AFTER the anchor: this phase flushes a small extra SST into
+        # the cpu table, which must not perturb the anchor's file set
+        guarded("device_tier",
+                lambda: bench_device_tier(engine, qe, results))
         checkpoint()
         guarded("sql_insert", lambda: bench_sql_insert(qe, results))
         guarded("qps_single_groupby", lambda: bench_qps(qe, results))

@@ -134,6 +134,39 @@ def host_tier_mode() -> str:
     return os.environ.get("GREPTIMEDB_TPU_HOST_TIER", "auto").lower()
 
 
+def compilation_cache_dir() -> str:
+    """Directory for JAX's persistent compilation cache, or "" when
+    disabled. Default: on for accelerator platforms (the ~25 s Mosaic/
+    XLA warmup compile becomes a once-per-cluster cost), off on CPU
+    (tests and dev shells churn shapes for no reuse). Override with
+    GREPTIMEDB_TPU_COMPILATION_CACHE_DIR=<dir> (off/0/none disables)."""
+    env = os.environ.get("GREPTIMEDB_TPU_COMPILATION_CACHE_DIR")
+    if env is not None:
+        return "" if env.lower() in ("off", "0", "none", "") else env
+    if _platform() in ("tpu", "axon"):
+        return os.path.expanduser("~/.cache/greptimedb_tpu/xla-cache")
+    return ""
+
+
+def prewarm_enabled() -> bool:
+    """Background pre-warm of the dominant Pallas kernel shapes at
+    executor construction (region-open time), so first-query latency
+    stops hiding the Mosaic compile. GREPTIMEDB_TPU_PREWARM=off
+    disables; default on for accelerator platforms only."""
+    env = os.environ.get("GREPTIMEDB_TPU_PREWARM")
+    if env is not None:
+        return env.lower() not in ("0", "false", "off")
+    return _platform() in ("tpu", "axon")
+
+
+def tier_adaptive() -> bool:
+    """Measured tier routing: consult per-tier latency history so a
+    tier that is losing stops being chosen (GREPTIMEDB_TPU_TIER_ADAPTIVE
+    =off pins the static heuristic — the benching override)."""
+    return os.environ.get("GREPTIMEDB_TPU_TIER_ADAPTIVE", "on").lower() \
+        not in ("0", "false", "off")
+
+
 def device_tier_rows() -> int:
     """Aggregate scans at or above this row count run on the accelerator
     even over a slow link (the resident-plane fold amortizes readback);

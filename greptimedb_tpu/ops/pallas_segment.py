@@ -55,6 +55,9 @@ MAX_WIDTH = 128
 #: largest padded segment count: out[G, 128] f32 must sit in VMEM with
 #: the one-hot block and the plane block
 MAX_SEGMENTS = 4096
+#: fused kernel: raw value lanes F padded to 8, [vals | valid | rows]
+#: layout 2*FW+1 must fit the 128-lane output tile
+MAX_FUSED_FIELDS = 56
 
 
 def _round_up(x: int, m: int) -> int:
@@ -130,7 +133,174 @@ def eligible(shape: tuple, num_segments: int) -> bool:
             and 0 < num_segments <= MAX_SEGMENTS)
 
 
+# ---- fused scan→filter→bucket→aggregate kernel ------------------------------
+#
+# The dense prepared path pays one host-built [N, 2F+1] plane upload plus
+# one segment-sum, one segment-min, and one segment-max dispatch per block
+# (each an XLA scatter off the MXU). The fused kernel below replaces the
+# whole chain with ONE pallas_call over the RAW value columns: validity
+# (NaN) masks, the [vals | valid | rows] reduction plane, and the one-hot
+# group matrix are all built in-register — none of them ever exists in
+# HBM — and min/max reduce in the same pass. The HBM-resident hot set
+# therefore caches only the F raw value lanes per block instead of the
+# 2F+1 sum plane plus two F-wide identity-filled extreme planes.
+
+
+def _fused_kernel(ids_ref, vals_ref, *out_refs, nf, fw, want_min, want_max):
+    i = pl.program_id(0)
+    sum_ref = out_refs[0]
+    min_ref = out_refs[1] if want_min else None
+    max_ref = out_refs[1 + bool(want_min)] if want_max else None
+    dt = vals_ref.dtype
+
+    @pl.when(i == 0)
+    def _init():
+        sum_ref[...] = jnp.zeros_like(sum_ref)
+        if want_min:
+            min_ref[...] = jnp.full_like(min_ref, jnp.inf)
+        if want_max:
+            max_ref[...] = jnp.full_like(max_ref, -jnp.inf)
+
+    ids = ids_ref[...]    # [1, Nb] int32 (masked/padding rows -> dead id)
+    vals = vals_ref[...]  # [Nb, FW] raw values, NaN = NULL
+    gp = sum_ref.shape[0]
+    nb = ids.shape[1]
+    onehot_b = (jax.lax.broadcasted_iota(jnp.int32, (gp, nb), 0) == ids)
+    valid = ~jnp.isnan(vals)                       # [Nb, FW] in-register
+    zeroed = jnp.where(valid, vals, jnp.asarray(0, dt))
+    pad_w = sum_ref.shape[1] - 2 * fw
+    # [zeroed | valid | rows-one | 0-pad]: the prepared-plane layout,
+    # assembled in VMEM registers instead of host RAM + H2D
+    rows_col = (jax.lax.broadcasted_iota(jnp.int32, (nb, pad_w), 1)
+                == 0).astype(dt)
+    plane = jnp.concatenate([zeroed, valid.astype(dt), rows_col], axis=1)
+    # see _kernel: HIGHEST recovers f32 accuracy from the bf16 MXU passes
+    sum_ref[...] += jnp.dot(onehot_b.astype(dt), plane,
+                            preferred_element_type=dt,
+                            precision=jax.lax.Precision.HIGHEST)
+    if want_min or want_max:
+        # only the nf real field lanes; fw-nf padding lanes stay at the
+        # _init identities (the [:nf] unpack slice discards them anyway)
+        mins, maxs = [], []
+        for f in range(nf):
+            col = vals[:, f][None, :]              # [1, Nb]
+            sel = onehot_b & ~jnp.isnan(col)       # NaN: SQL NULL skip
+            if want_min:
+                mins.append(jnp.min(
+                    jnp.where(sel, col, jnp.asarray(jnp.inf, dt)), axis=1))
+            if want_max:
+                maxs.append(jnp.max(
+                    jnp.where(sel, col, jnp.asarray(-jnp.inf, dt)), axis=1))
+
+        def _lanes(cols, ident):
+            stacked = jnp.stack(cols, axis=1)      # [gp, nf]
+            if fw > nf:                            # full-width store: pad
+                stacked = jnp.concatenate(         # identity lanes back on
+                    [stacked, jnp.full((gp, fw - nf), ident, dt)], axis=1)
+            return stacked
+
+        if want_min:
+            min_ref[...] = jnp.minimum(min_ref[...],
+                                       _lanes(mins, jnp.inf))
+        if want_max:
+            max_ref[...] = jnp.maximum(max_ref[...],
+                                       _lanes(maxs, -jnp.inf))
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_segments", "want_min", "want_max",
+                                    "block_rows", "interpret"))
+def pallas_fused_segment_agg(
+    vals: jax.Array,  # [N, F] raw field values (NaN = NULL)
+    ids: jax.Array,  # [N] int32 group ids (masked rows -> num_segments-1)
+    num_segments: int,
+    want_min: bool = False,
+    want_max: bool = False,
+    block_rows: int = 512,
+    interpret: bool = False,
+) -> dict:
+    """Fused masked segment aggregation on the MXU/VPU: one pallas_call
+    emits {"sum" [G, F], "count" [G, F], "rows" [G], "min"/"max" [G, F]}.
+    Caller must pre-check fused_eligible() and prove the values finite
+    (Inf would poison the 0*x matmul — same contract as the sum kernel);
+    NaN is handled in-register as SQL NULL. Masked rows arrive encoded
+    into the dead segment num_segments-1, exactly like the sum kernel;
+    empty/all-NULL groups come back as 0 counts and ±inf extremes."""
+    n, nf = vals.shape
+    fw = _round_up(max(nf, 1), 8)
+    gp = _round_up(max(num_segments, 8), 8)
+    npad = _round_up(max(n, 1), block_rows)
+    vals_p = jnp.pad(vals, ((0, npad - n), (0, fw - nf)))
+    ids_p = jnp.pad(ids.astype(jnp.int32), (0, npad - n),
+                    constant_values=num_segments - 1)[None, :]
+    out_shapes = [jax.ShapeDtypeStruct((gp, MAX_WIDTH), vals.dtype)]
+    out_specs = [pl.BlockSpec((gp, MAX_WIDTH), lambda i: (0, 0))]
+    if want_min:
+        out_shapes.append(jax.ShapeDtypeStruct((gp, fw), vals.dtype))
+        out_specs.append(pl.BlockSpec((gp, fw), lambda i: (0, 0)))
+    if want_max:
+        out_shapes.append(jax.ShapeDtypeStruct((gp, fw), vals.dtype))
+        out_specs.append(pl.BlockSpec((gp, fw), lambda i: (0, 0)))
+    kern = functools.partial(_fused_kernel, nf=nf, fw=fw,
+                             want_min=want_min, want_max=want_max)
+    ctx = _enable_x64(False) if vals.dtype != jnp.float64 \
+        else contextlib.nullcontext()
+    with ctx:
+        outs = pl.pallas_call(
+            kern,
+            grid=(npad // block_rows,),
+            in_specs=[
+                pl.BlockSpec((1, block_rows), lambda i: (0, i)),
+                pl.BlockSpec((block_rows, fw), lambda i: (i, 0)),
+            ],
+            out_specs=out_specs,
+            out_shape=out_shapes,
+            interpret=interpret,
+        )(ids_p, vals_p)
+    total = outs[0]
+    g = num_segments
+    out = {
+        "sum": total[:g, :nf],
+        "count": total[:g, fw:fw + nf],
+        "rows": total[:g, 2 * fw],
+    }
+    k = 1
+    if want_min:
+        out["min"] = outs[k][:g, :nf]
+        k += 1
+    if want_max:
+        out["max"] = outs[k][:g, :nf]
+    return out
+
+
+def fused_eligible(nf: int, num_segments: int) -> bool:
+    """Shapes the fused kernel handles; everything else takes the
+    prepared-plane path (XLA scatter reductions)."""
+    return 0 < nf <= MAX_FUSED_FIELDS and 0 < num_segments <= MAX_SEGMENTS
+
+
 _TPU_COMPILE_OK: bool | None = None
+_FUSED_COMPILE_OK: bool | None = None
+
+
+def fused_tpu_compile_ok() -> bool:
+    """One-shot Mosaic canary for the FUSED kernel (min/max loop + the
+    in-register plane assembly exercise lowering paths the plain sum
+    kernel never touches): auto mode consults this before routing a
+    query, so a chip that cannot compile the fused program degrades to
+    the prepared-plane path instead of sinking the query."""
+    global _FUSED_COMPILE_OK
+    if _FUSED_COMPILE_OK is None:
+        try:
+            out = pallas_fused_segment_agg(
+                jnp.ones((8, 2), jnp.float32), jnp.zeros(8, jnp.int32), 2,
+                want_min=True, want_max=True)
+            _FUSED_COMPILE_OK = (
+                abs(float(out["sum"][0, 0]) - 8.0) < 1e-6
+                and abs(float(out["min"][0, 0]) - 1.0) < 1e-6)
+        except Exception:  # noqa: BLE001 — any compile failure means "don't"
+            _FUSED_COMPILE_OK = False
+    return _FUSED_COMPILE_OK
 
 
 def tpu_compile_ok() -> bool:

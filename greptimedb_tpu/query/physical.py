@@ -19,9 +19,11 @@ from __future__ import annotations
 import functools
 import itertools
 import math
+import os
 import threading
+import time
 from dataclasses import dataclass
-from typing import Optional
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -122,6 +124,60 @@ class DeviceKey:
     size: int
     step: int = 0  # bucket width in the column's storage unit
     base: int = 0  # minimum bucket index (offsets ids to 0)
+
+
+class _BlockEntry(NamedTuple):
+    """One device block of the scan: rows [start, end) padded to
+    `block`. `pkey` is the immutable SST part the rows belong to
+    ((file_id, ts_range, pred_key) from ScanData.part_keys) or None for
+    memtable/synthetic rows; `part_start` anchors the block offset
+    inside its part so hot-set keys stay stable across versions."""
+
+    pkey: Optional[tuple]
+    part_start: int
+    start: int
+    end: int
+    block: int
+
+
+#: ceiling on part-aligned plan fan-out: a region with hundreds of tiny
+#: unmerged flush files would otherwise unroll hundreds of kernel
+#: dispatches into one jit — beyond this the scan falls back to the
+#: uniform (version-keyed) block layout and lets compaction catch up
+_MAX_PLAN_BLOCKS = 64
+
+
+def _block_plan(scan) -> list[_BlockEntry]:
+    """Part-aligned device block plan: blocks never straddle SST part
+    seams, so every block's content is a pure function of its immutable
+    file (+ window/predicate key) and its HBM upload survives
+    data-version bumps — a flush uploads ONLY its new file's blocks.
+    Scans without per-part identity (merged/synthetic/seq-sliced) get
+    the classic uniform layout keyed by data version."""
+    n = scan.num_rows
+    offs = scan.sorted_part_offsets
+    pkeys = getattr(scan, "part_keys", ())
+    segs: list[tuple] = []
+    if pkeys and len(offs) == len(pkeys) + 1 and offs[-1] <= n:
+        segs = [(pkeys[i], offs[i], offs[i + 1]) for i in range(len(pkeys))]
+        if offs[-1] < n:  # memtable tail: version-keyed, no part identity
+            segs.append((None, offs[-1], n))
+        est = sum(
+            -(-max(s1 - s0, 1) // min(block_size_for(s1 - s0),
+                                      DEFAULT_BLOCK_ROWS))
+            for _, s0, s1 in segs if s1 > s0)
+        if est > _MAX_PLAN_BLOCKS:
+            segs = []
+    if not segs:
+        segs = [(None, 0, n)]
+    plan: list[_BlockEntry] = []
+    for pk, s0, s1 in segs:
+        if s1 <= s0:
+            continue
+        pb = min(block_size_for(s1 - s0), DEFAULT_BLOCK_ROWS)
+        for st in range(s0, s1, pb):
+            plan.append(_BlockEntry(pk, s0, st, min(st + pb, s1), pb))
+    return plan
 
 
 # ---- fused per-block kernel ------------------------------------------------
@@ -276,6 +332,15 @@ def _agg_scan_prepared(
     else:
         rows = total[:, nf:nf + 1]
         cnts = jnp.broadcast_to(rows, (G, nf))
+    packed_f = _pack_float_ops(sums, cnts, rows, tmin, tmax, tsq,
+                               float_ops, pack_dtype)
+    return packed_f, jnp.zeros((0,), jnp.int64)
+
+
+def _pack_float_ops(sums, cnts, rows, tmin, tmax, tsq, float_ops,
+                    pack_dtype):
+    """Finalize + pack the prepared/fused accumulator planes into the
+    one packed_f matrix both paths ship back over the link."""
     acc: dict[str, jax.Array] = {}
     for k in float_ops:
         if k == "sum":
@@ -300,8 +365,64 @@ def _agg_scan_prepared(
             denom = jnp.maximum(cnts, 1.0)
             acc[k] = jnp.where(cnts > 0, sums / denom, jnp.nan)
     parts = [acc[k].astype(pack_dtype) for k in float_ops]
-    packed_f = jnp.concatenate(parts, axis=1)
-    return packed_f, jnp.zeros((0,), jnp.int64)
+    return jnp.concatenate(parts, axis=1)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("where", "keys", "arg_names", "num_segments",
+                     "tag_names", "schema", "float_ops", "pack_dtype",
+                     "acc_dtype", "want_min", "want_max", "interpret"),
+)
+def _agg_scan_fused(
+    blocks: tuple,  # per-block dicts of RAW column arrays (hot set)
+    n_valids: jax.Array,
+    dedup_masks,
+    *,
+    where, keys, arg_names, num_segments, tag_names, schema, float_ops,
+    pack_dtype, acc_dtype, want_min, want_max, interpret,
+):
+    """Fused-kernel twin of _agg_scan_prepared: the hot set holds only
+    the RAW value columns — validity masks, the [vals|valid|rows]
+    reduction plane, and the min/max identity fills are all built
+    in-register by ops/pallas_segment.pallas_fused_segment_agg, so the
+    HBM footprint per block is F lanes instead of 2F+1 (+F +F when
+    min/max ride along) and each block costs ONE kernel dispatch."""
+    from greptimedb_tpu.ops import pallas_segment as ps
+
+    G = num_segments
+    # smaller row tile when the min/max lanes ride along: the [Gp, Nb]
+    # select temporaries double, so halve Nb to stay inside VMEM
+    block_rows = 256 if (want_min or want_max) else 512
+    tsum = tcnt = trow = tmin = tmax = None
+    for i, cols in enumerate(blocks):
+        some = cols[arg_names[0]]
+        nrows = some.shape[0]
+        mask = jnp.arange(nrows) < n_valids[i]
+        if dedup_masks is not None:
+            mask = mask & dedup_masks[i]
+        if where is not None:
+            w = eval_device(where, cols, tag_names, schema)
+            mask = mask & (w if w.dtype == jnp.bool_ else w != 0)
+        gid = _group_ids(cols, keys, nrows)
+        ids = jnp.where(mask, gid, jnp.int32(G))
+        vals = jnp.stack([cols[a].astype(acc_dtype) for a in arg_names],
+                         axis=1)
+        out = ps.pallas_fused_segment_agg(
+            vals, ids, G + 1, want_min=want_min, want_max=want_max,
+            block_rows=block_rows, interpret=interpret)
+        s, c, r = out["sum"][:G], out["count"][:G], out["rows"][:G][:, None]
+        tsum = s if tsum is None else tsum + s
+        tcnt = c if tcnt is None else tcnt + c
+        trow = r if trow is None else trow + r
+        if want_min:
+            m = out["min"][:G]
+            tmin = m if tmin is None else jnp.minimum(tmin, m)
+        if want_max:
+            m = out["max"][:G]
+            tmax = m if tmax is None else jnp.maximum(tmax, m)
+    return _pack_float_ops(tsum, tcnt, trow, tmin, tmax, None,
+                           float_ops, pack_dtype)
 
 
 @functools.partial(
@@ -535,12 +656,8 @@ def _agg_scan_sharded_prepared(
     return step(cols, base_mask)
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("where", "keys", "num_segments", "tag_names", "schema"),
-)
-def _prep_stream_step(acc, cols, n_valid, *, where, keys, num_segments,
-                      tag_names, schema):
+def _prep_stream_step_impl(acc, cols, n_valid, *, where, keys, num_segments,
+                           tag_names, schema):
     """One streaming step on the PREPARED planes: a single dead-segment
     segment-sum per chunk folded into the device accumulator — the
     streaming twin of _agg_scan_prepared (none of the [N, F] masking
@@ -572,6 +689,32 @@ def _prep_stream_step(acc, cols, n_valid, *, where, keys, num_segments,
         if "sq" in out:
             out["sq"] = out["sq"] + acc["sq"]
     return out
+
+
+_PREP_STREAM_STATICS = ("where", "keys", "num_segments", "tag_names",
+                        "schema")
+_prep_stream_step = functools.partial(
+    jax.jit, static_argnames=_PREP_STREAM_STATICS)(_prep_stream_step_impl)
+# donated twin: the chunked bigger-than-HBM fold reuses the accumulator
+# AND the spent chunk's upload buffers instead of doubling peak HBM —
+# XLA aliases the output planes over the donated inputs and frees the
+# chunk at dispatch, so steady-state residency is one chunk + one
+# accumulator no matter how many chunks stream through
+_prep_stream_step_donated = functools.partial(
+    jax.jit, static_argnames=_PREP_STREAM_STATICS,
+    donate_argnums=(0, 1))(_prep_stream_step_impl)
+
+
+def _donate_stream_buffers() -> bool:
+    """Buffer donation knob for the streaming folds. Default: on for
+    accelerator backends, off on CPU (XLA:CPU cannot alias these
+    buffers and warns on every trace). GREPTIMEDB_TPU_DONATE=on forces
+    it anywhere (the parity tests); =off pins the copying behavior for
+    A/B."""
+    env = os.environ.get("GREPTIMEDB_TPU_DONATE")
+    if env is not None:
+        return env.lower() not in ("0", "false", "off")
+    return jax.default_backend() != "cpu"
 
 
 def _prefetch(items, depth: int = 2):
@@ -654,14 +797,9 @@ _agg_block_jit = functools.partial(
 )(_agg_block)
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("where", "keys", "agg_args", "ops", "num_segments",
-                     "ts_name", "tag_names", "schema", "need_ts",
-                     "acc_dtype"),
-)
-def _agg_step(acc, cols, n_valid, *, where, keys, agg_args, ops,
-              num_segments, ts_name, tag_names, schema, need_ts, acc_dtype):
+def _agg_step_impl(acc, cols, n_valid, *, where, keys, agg_args, ops,
+                   num_segments, ts_name, tag_names, schema, need_ts,
+                   acc_dtype):
     """One streaming step: fold a chunk's partial aggregate into the
     device-resident accumulator (constant HBM; one dispatch per chunk)."""
     part = _agg_block(cols, n_valid, None, where=where, keys=keys,
@@ -669,6 +807,17 @@ def _agg_step(acc, cols, n_valid, *, where, keys, agg_args, ops,
                       ts_name=ts_name, tag_names=tag_names, schema=schema,
                       need_ts=need_ts, acc_dtype=acc_dtype)
     return _combine_partials(acc, part)
+
+
+_AGG_STEP_STATICS = ("where", "keys", "agg_args", "ops", "num_segments",
+                     "ts_name", "tag_names", "schema", "need_ts",
+                     "acc_dtype")
+_agg_step = functools.partial(
+    jax.jit, static_argnames=_AGG_STEP_STATICS)(_agg_step_impl)
+# see _prep_stream_step_donated: accumulator + chunk buffers reused
+_agg_step_donated = functools.partial(
+    jax.jit, static_argnames=_AGG_STEP_STATICS,
+    donate_argnums=(0, 1))(_agg_step_impl)
 
 
 _GID_SENTINEL = (1 << 62)  # > any real combined group id (product guarded)
@@ -798,6 +947,21 @@ def _combine_partials(acc: Optional[dict], p: dict) -> dict:
 
 # ---- execution tiers -------------------------------------------------------
 
+#: fused-kernel runtime-failure latch (dict so tests can reset it): one
+#: mid-query kernel failure routes this and every later query to the
+#: XLA scatter path instead of re-failing per query
+_FUSED_DISABLED = {"flag": False}
+
+
+def _snap_version(scan) -> tuple:
+    """Snapshot identity for snap-anchored hot-set keys: (incarnation,
+    data_version). TRUNCATE recreates the region and resets its
+    data_version, so the version alone can collide with a pre-truncate
+    snapshot taken by a query still in flight; the region incarnation
+    (0 for remote/synthetic scans) breaks the tie, and the tuple still
+    orders lexicographically for the cache's generation retirement."""
+    return (getattr(scan, "incarnation", 0), scan.data_version)
+
 _LINK: Optional[dict] = None
 # contextvar, NOT a module global: queries run concurrently under the
 # threaded servers, and jax.default_device is itself thread-local — the
@@ -847,6 +1011,38 @@ def accelerator_link() -> dict:
              "d2h_mbps": round(d2h_mbps, 1),
              "colocated": rtt_ms < 5.0 and d2h_mbps > 500.0}
     return _LINK
+
+
+_COMPILE_CACHE_WIRED = {"done": False}
+
+
+def enable_compilation_cache() -> bool:
+    """Wire JAX's persistent compilation cache (idempotent). The r05
+    capture hid a 27.8 s compile-dominated warmup inside the first
+    query; with the cache on, that cost is paid once per cluster, not
+    once per process start. Enabled by default on accelerator
+    platforms; GREPTIMEDB_TPU_COMPILATION_CACHE_DIR overrides the
+    location (off/0/none disables)."""
+    if _COMPILE_CACHE_WIRED["done"]:
+        return True
+    from greptimedb_tpu import config
+
+    d = config.compilation_cache_dir()
+    if not d:
+        return False
+    try:
+        os.makedirs(d, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", d)
+        jax.config.update("jax_enable_compilation_cache", True)
+        # cache even fast compiles: the dense path compiles one
+        # executable per (block plan, query shape) and the long tail of
+        # 1-2 s compiles adds up across a dashboard fleet
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          1.0)
+    except Exception:  # noqa: BLE001 — cache is an optimization, never fatal
+        return False
+    _COMPILE_CACHE_WIRED["done"] = True
+    return True
 
 
 @functools.lru_cache(maxsize=1)
@@ -906,6 +1102,23 @@ class PhysicalExecutor:
         # thread runs the same _stream_agg machinery and must not
         # clobber the foreground query's reported path/tier
         self._tls = threading.local()
+        # measured per-tier latency history (the span-ring feed): keyed
+        # by (tier, log2 rows bucket) so the router can stop choosing a
+        # tier that is measurably losing for a workload class
+        from collections import deque as _deque
+
+        self._tier_hist: dict[tuple, "_deque"] = {}
+        self._tier_explore: dict[int, int] = {}
+        self._tier_lock = threading.Lock()
+        # warmup amortization: the persistent XLA compilation cache
+        # turns the ~25 s first-compile into a once-per-cluster cost,
+        # and a background kernel pre-warm compiles the dominant Pallas
+        # shapes at open time instead of under the first query
+        enable_compilation_cache()
+        if config.prewarm_enabled() and jax.default_backend() != "cpu":
+            threading.Thread(target=self._prewarm_kernels,
+                             daemon=True,
+                             name="gtpu-device-prewarm").start()
 
     @property
     def last_path(self):
@@ -922,6 +1135,78 @@ class PhysicalExecutor:
     @last_tier.setter
     def last_tier(self, v):
         self._tls.last_tier = v
+
+    def _prewarm_kernels(self) -> None:
+        """Background compile of the dominant Pallas kernel shapes
+        (GREPTIMEDB_TPU_PREWARM_SHAPES, "G,F;G,F" pairs — defaults to
+        the single-groupby and double-groupby classes) so the first
+        dashboard query pays HLO-level compile only, not Mosaic. Best
+        effort: a failing shape flips the canaries and the scatter path
+        serves."""
+        try:
+            from greptimedb_tpu.ops import pallas_segment as ps
+
+            ps.tpu_compile_ok()
+            ps.fused_tpu_compile_ok()
+            # NB: G is the query's GROUP count — the kernels get G+1
+            # segments (dead segment), so the largest routable G is
+            # MAX_SEGMENTS-1 (4095), not 4096; an ineligible shape
+            # would burn Mosaic compile on an executable _fused_ok can
+            # never route
+            shapes = os.environ.get("GREPTIMEDB_TPU_PREWARM_SHAPES",
+                                    "64,10;4095,10")
+            for part in shapes.split(";"):
+                g, f = (int(x) for x in part.split(","))
+                if ps.fused_eligible(f, g + 1):
+                    ps.pallas_fused_segment_agg(
+                        jnp.zeros((512, f), jnp.float32),
+                        jnp.zeros(512, jnp.int32), g + 1,
+                        want_min=True, want_max=True, block_rows=256)
+                    ps.pallas_fused_segment_agg(
+                        jnp.zeros((512, f), jnp.float32),
+                        jnp.zeros(512, jnp.int32), g + 1)
+                if ps.eligible((512, 2 * f + 1), g + 1):
+                    ps.pallas_dense_segment_sum(
+                        jnp.zeros((512, 2 * f + 1), jnp.float32),
+                        jnp.zeros(512, jnp.int32), g + 1)
+        except Exception:  # noqa: BLE001 — pre-warm must never take a node down
+            pass
+
+    def _note_tier(self, tier: str, num_rows: int, seconds: float) -> None:
+        """Feed one measured execution into the per-tier history ring
+        (the device_agg span's duration, bucketed by scan size)."""
+        if tier not in ("device", "host"):
+            return
+        from collections import deque as _deque
+
+        b = max(int(num_rows), 1).bit_length()
+        with self._tier_lock:
+            self._tier_hist.setdefault((tier, b),
+                                       _deque(maxlen=16)).append(seconds)
+
+    def _tier_from_history(self, num_rows: int) -> Optional[str]:
+        """Measured-routing verdict for this scan-size class, or None
+        when either tier lacks samples. Every 16th decision explores
+        the losing tier so a regression (or recovery) on the unused
+        tier is re-measured instead of frozen in."""
+        from greptimedb_tpu import config
+
+        if not config.tier_adaptive():
+            return None
+        b = max(int(num_rows), 1).bit_length()
+        with self._tier_lock:
+            dev = sorted(self._tier_hist.get(("device", b), ()))
+            host = sorted(self._tier_hist.get(("host", b), ()))
+            if len(dev) < 3 or len(host) < 3:
+                return None
+            med_d = dev[len(dev) // 2]
+            med_h = host[len(host) // 2]
+            winner = "device" if med_d <= med_h else "host"
+            n = self._tier_explore.get(b, 0) + 1
+            self._tier_explore[b] = n
+        if n % 16 == 0:
+            return "host" if winner == "device" else "device"
+        return winner
 
     def tier_for(self, agg, num_rows: int, streaming: bool = False) -> str:
         """Tiered execution (round-5 redesign): over a REMOTE
@@ -943,6 +1228,16 @@ class PhysicalExecutor:
             return "device"
         if mode == "force":
             return "host"
+        # measured routing beats the static heuristic: when both tiers
+        # have real samples for this scan-size class, the one that is
+        # actually losing stops being chosen (ISSUE 7: the heuristic
+        # used to pin double_groupby_all to a device tier that measured
+        # slower than its own host tier). GREPTIMEDB_TPU_TIER_ADAPTIVE
+        # =off restores the pure heuristic for A/B benching.
+        if agg is not None and not streaming:
+            adv = self._tier_from_history(num_rows)
+            if adv is not None:
+                return adv
         if accelerator_link()["colocated"]:
             return "device"
         if not streaming and agg is not None \
@@ -1347,8 +1642,13 @@ class PhysicalExecutor:
                        ts_name, ctx, extra_cols, sparse)
         tier = self._hedge_device_warmup(tier, stream_args)
         self.last_tier = tier
+        t0 = time.perf_counter()
         with _TierCtx(tier):
             acc, sparse_gids = self._stream_agg(*stream_args)
+        # measured-routing feed: what this tier actually cost for this
+        # scan size (results are materialized host-side by here, so the
+        # clock covers upload + kernels + readback)
+        self._note_tier(tier, scan.num_rows, time.perf_counter() - t0)
         if reduced is not None:
             self.last_path = "boundary+" + (self.last_path or "")
         host_info = (scan, extra_cols, bound_where, ctx, num_groups)
@@ -1436,8 +1736,15 @@ class PhysicalExecutor:
         if not already:
             def warm():
                 try:
+                    t0 = time.perf_counter()
                     with _TierCtx("device"):
                         self._stream_agg(*stream_args)
+                    # first device sample includes the compile; later
+                    # foreground runs will pull the median down — but a
+                    # device tier that stays slow now shows up in the
+                    # router's history instead of being assumed fast
+                    self._note_tier("device", stream_args[0].num_rows,
+                                    time.perf_counter() - t0)
                     with self._warm_lock:
                         self._device_warm.add(wkey)
                 except Exception:  # noqa: BLE001 — hedge must not raise
@@ -1641,6 +1948,7 @@ class PhysicalExecutor:
                     yield dev, jnp.asarray(end - start)
 
         acc_dev = None
+        step = _agg_step_donated if _donate_stream_buffers() else _agg_step
         gen = _prefetch(build_blocks())
         try:
             for dev, n_valid in gen:
@@ -1649,7 +1957,7 @@ class PhysicalExecutor:
                 if acc_dev is None:
                     acc_dev = _agg_block_jit(dev, n_valid, None, **kw)
                 else:
-                    acc_dev = _agg_step(acc_dev, dev, n_valid, **kw)
+                    acc_dev = step(acc_dev, dev, n_valid, **kw)
         finally:
             # stop the producer BEFORE the caller's stream.close() drops
             # SST pins: a generator left suspended would only clean up at
@@ -1726,6 +2034,8 @@ class PhysicalExecutor:
                     yield dev, jnp.asarray(end - start)
 
         acc_dev = None
+        step = _prep_stream_step_donated if _donate_stream_buffers() \
+            else _prep_stream_step
         # double-buffered: the next chunk's SST read + plane build + H2D
         # copy overlap the device fold of the current one
         gen = _prefetch(build_blocks())
@@ -1733,7 +2043,7 @@ class PhysicalExecutor:
             for dev, n_valid in gen:
                 device_telemetry.count_h2d(
                     sum(a.nbytes for a in dev.values()))
-                acc_dev = _prep_stream_step(acc_dev, dev, n_valid, **kw)
+                acc_dev = step(acc_dev, dev, n_valid, **kw)
         finally:
             gen.close()  # see _fold_stream: producer must die before unpin
         G = num_groups
@@ -2010,16 +2320,27 @@ class PhysicalExecutor:
             packed_i = None
             int_ops = ()
         elif self._prepared_ok(arg_exprs, ops, int_ops, schema, extra_cols):
+            arg_names = tuple(a.name for a in arg_exprs)
+            aux_names = self._device_columns(
+                scan, bound_where, keys, (), ts_name, extra_cols)
+            plan = _block_plan(scan)
+            if self._fused_ok(ops, arg_names, num_groups, scan):
+                # fused Pallas path: ONE kernel per block over the RAW
+                # hot-set columns — mask/validity/plane assembly never
+                # touch HBM (ops/pallas_segment.py); degrades to the
+                # prepared scatter path below on any kernel failure
+                packed_f = self._dense_fused_scan(
+                    scan, plan, aux_names, arg_names, extra_cols,
+                    float_fields, acc_dtype, dedup_mask, bound_where,
+                    keys, ops, num_groups, tag_names, schema, float_ops,
+                    pack_dtype)
+                if packed_f is not None:
+                    self.last_path = "dense_fused"
+                    return (_unpack_acc(packed_f, None, float_ops, (),
+                                        widths), None)
             # fast dense path: query-invariant [N, 2F+1] value/validity
             # planes are HBM-cached; per query only [N] masks/keys run
             self.last_path = "dense_prepared"
-            block = min(block_size_for(n), DEFAULT_BLOCK_ROWS)
-            aux_names = self._device_columns(
-                scan, bound_where, keys, (), ts_name, extra_cols)
-            blocks = []
-            dmasks = [] if dedup_mask is not None else None
-            n_valids = []
-            arg_names = tuple(a.name for a in arg_exprs)
             has_nan = self._scan_has_nan(scan, arg_names)
             # variance/stddev difference two moments: BOTH must carry f64
             # even on the f32 fast path (see segment_agg) — the sum plane
@@ -2027,51 +2348,39 @@ class PhysicalExecutor:
             prep_dtype = jnp.dtype(jnp.float64) if "sumsq" in ops \
                 else acc_dtype
 
-            def fetch_block(start, prefetch_only=False):
-                end = min(start + block, n)
+            def fetch_block(entry, prefetch_only=False):
                 cols = {}
                 for name in aux_names:
                     cols[name] = self._device_block(
-                        scan, name, start, end, block, extra_cols,
+                        scan, name, entry, extra_cols,
                         acc_dtype if name in float_fields else None,
                         prefetch_only=prefetch_only,
                     )
                 cols["__prep__"] = self._prep_plane(
-                    scan, arg_names, start, end, block, prep_dtype,
+                    scan, arg_names, entry, prep_dtype,
                     has_nan, prefetch_only=prefetch_only)
                 if "min" in ops:
                     cols["__prep_min__"] = self._prep_extreme_plane(
-                        scan, arg_names, start, end, block, acc_dtype,
+                        scan, arg_names, entry, acc_dtype,
                         "min", prefetch_only=prefetch_only)
                 if "max" in ops:
                     cols["__prep_max__"] = self._prep_extreme_plane(
-                        scan, arg_names, start, end, block, acc_dtype,
+                        scan, arg_names, entry, acc_dtype,
                         "max", prefetch_only=prefetch_only)
                 if "sumsq" in ops:
                     cols["__prep_sq__"] = self._prep_extreme_plane(
-                        scan, arg_names, start, end, block, prep_dtype,
+                        scan, arg_names, entry, prep_dtype,
                         "sq", prefetch_only=prefetch_only)
-                return cols, end
+                return cols
 
-            starts = list(range(0, n, block))
-            do_prefetch = self._upload_prefetch_ok(scan)
-            for i, start in enumerate(starts):
-                if do_prefetch and i + 1 < len(starts):
-                    # double buffering: the background worker builds and
-                    # uploads block i+1 while this thread assembles
-                    # block i (and the device chews on what's queued)
-                    fetch_block(starts[i + 1], prefetch_only=True)
-                cols, end = fetch_block(start)
-                blocks.append(cols)
-                n_valids.append(end - start)
-                if dmasks is not None:
-                    dmasks.append(_pad_device_mask(dedup_mask, start, end,
-                                                   block))
+            blocks, n_valids, dmasks = self._gather_blocks(
+                scan, plan, fetch_block, dedup_mask)
             packed_f, packed_i = _agg_scan_prepared(
                 tuple(blocks), jnp.asarray(np.asarray(n_valids)),
                 tuple(dmasks) if dmasks is not None else None,
                 where=bound_where, keys=keys, nf=nf, has_nan=has_nan,
-                finite=not self._scan_has_inf(scan, arg_names),
+                finite=not self._scan_has_inf(scan, arg_names,
+                                              dtype=prep_dtype),
                 num_segments=num_groups,
                 tag_names=tag_names, schema=schema, float_ops=float_ops,
                 pack_dtype=pack_dtype,
@@ -2080,34 +2389,20 @@ class PhysicalExecutor:
                                 widths), None)
         else:
             self.last_path = "dense"
-            block = min(block_size_for(n), DEFAULT_BLOCK_ROWS)
-            blocks = []
-            dmasks = [] if dedup_mask is not None else None
-            n_valids = []
-            starts = list(range(0, n, block))
-            do_prefetch = self._upload_prefetch_ok(scan)
-            for i, start in enumerate(starts):
-                end = min(start + block, n)
-                for name in device_col_names:
-                    if do_prefetch and i + 1 < len(starts):
-                        self._device_block(
-                            scan, name, starts[i + 1],
-                            min(starts[i + 1] + block, n), block,
-                            extra_cols,
-                            acc_dtype if name in float_fields else None,
-                            prefetch_only=True,
-                        )
+            plan = _block_plan(scan)
+
+            def fetch_block(entry, prefetch_only=False):
                 cols = {}
                 for name in device_col_names:
                     cols[name] = self._device_block(
-                        scan, name, start, end, block, extra_cols,
+                        scan, name, entry, extra_cols,
                         acc_dtype if name in float_fields else None,
+                        prefetch_only=prefetch_only,
                     )
-                blocks.append(cols)
-                n_valids.append(end - start)
-                if dmasks is not None:
-                    dmasks.append(_pad_device_mask(dedup_mask, start, end, block))
+                return cols
 
+            blocks, n_valids, dmasks = self._gather_blocks(
+                scan, plan, fetch_block, dedup_mask)
             packed_f, packed_i = _agg_scan(
                 tuple(blocks), jnp.asarray(np.asarray(n_valids)),
                 tuple(dmasks) if dmasks is not None else None,
@@ -2145,8 +2440,10 @@ class PhysicalExecutor:
             if scan.region_id < 0 or name in extra_cols:
                 cols[name] = build()
             else:
-                key = (_ACTIVE_TIER_VAR.get(), scan.region_id, scan.data_version,
-                       scan.scan_fingerprint, name, "whole", n_pad, str(cast))
+                # whole-scan arrays cannot be file-anchored: snapshot key
+                key = ("snap", scan.region_id, _snap_version(scan),
+                       _ACTIVE_TIER_VAR.get(), scan.scan_fingerprint,
+                       name, "whole", n_pad, str(cast))
                 cols[name] = self.cache.get(key, build)
         base = np.arange(n_pad) < n
         if dedup_mask is not None:
@@ -2200,9 +2497,9 @@ class PhysicalExecutor:
                 cols[name] = build()
                 device_telemetry.count_h2d(cols[name].nbytes)
             else:
-                key = (_ACTIVE_TIER_VAR.get(), scan.region_id, scan.data_version,
-                       scan.scan_fingerprint, name, "sharded", n_pad,
-                       n_shard, str(cast))
+                key = ("snap", scan.region_id, _snap_version(scan),
+                       _ACTIVE_TIER_VAR.get(), scan.scan_fingerprint,
+                       name, "sharded", n_pad, n_shard, str(cast))
                 cols[name] = self.cache.get(key, build)
         base = np.arange(n_pad) < n
         if dedup_mask is not None:
@@ -2233,10 +2530,10 @@ class PhysicalExecutor:
                 if scan.region_id < 0:
                     cols[plane_name] = build_plane()
                 else:
-                    key = (_ACTIVE_TIER_VAR.get(), scan.region_id, scan.data_version,
-                           scan.scan_fingerprint, plane_name, arg_names,
-                           "sharded", n_pad, n_shard, str(pdt),
-                           has_nan)
+                    key = ("snap", scan.region_id, _snap_version(scan),
+                           _ACTIVE_TIER_VAR.get(), scan.scan_fingerprint,
+                           plane_name, arg_names, "sharded", n_pad,
+                           n_shard, str(pdt), has_nan)
                     cols[plane_name] = self.cache.get(key, build_plane)
             return _agg_scan_sharded_prepared(
                 cols, base_s, mesh=mesh, where=bound_where, keys=keys,
@@ -2260,12 +2557,116 @@ class PhysicalExecutor:
         return (upload_prefetch_enabled() and scan.region_id >= 0
                 and _ACTIVE_TIER_VAR.get() != "host")
 
-    def _device_block(self, scan: ScanData, name, start, end, block,
+    def _gather_blocks(self, scan, plan, fetch, dedup_mask):
+        """Walk the block plan through `fetch`, double-buffering block
+        i+1's host build + H2D behind block i's assembly (the upload
+        prefetch worker). Returns (blocks, n_valids, dedup block masks)."""
+        blocks, n_valids = [], []
+        dmasks = [] if dedup_mask is not None else None
+        do_prefetch = self._upload_prefetch_ok(scan)
+        for i, entry in enumerate(plan):
+            if do_prefetch and i + 1 < len(plan):
+                # double buffering: the background worker builds and
+                # uploads block i+1 while this thread assembles
+                # block i (and the device chews on what's queued)
+                fetch(plan[i + 1], prefetch_only=True)
+            blocks.append(fetch(entry))
+            n_valids.append(entry.end - entry.start)
+            if dmasks is not None:
+                dmasks.append(_pad_device_mask(dedup_mask, entry.start,
+                                               entry.end, entry.block))
+        return blocks, n_valids, dmasks
+
+    def _fused_ok(self, ops, arg_names, num_groups, scan) -> bool:
+        """Route to the fused Pallas kernel? Mode/backend gates mirror
+        dense_segment_sum (on = force incl. interpret mode off-TPU, how
+        the CPU differential tests drive it; auto = real TPU device
+        tier only, behind the Mosaic canary), plus the kernel's own
+        shape envelope, a finite-values proof (Inf would poison the
+        0*x matmul), and the runtime-failure latch the chaos test
+        trips."""
+        from greptimedb_tpu import config
+        from greptimedb_tpu.ops import pallas_segment as ps
+        from greptimedb_tpu.ops.segment import _pallas_mode
+
+        if _FUSED_DISABLED["flag"]:
+            return False
+        if not set(ops) <= {"sum", "count", "mean", "rows", "min", "max"}:
+            return False
+        if not ps.fused_eligible(len(arg_names), num_groups + 1):
+            return False
+        acc_dtype = jnp.dtype(config.compute_dtype())
+        if acc_dtype not in (jnp.dtype(jnp.float32), jnp.dtype(jnp.float64)):
+            return False
+        if self._scan_has_inf(scan, arg_names, dtype=acc_dtype):
+            return False
+        mode = _pallas_mode()
+        if mode == "on":
+            return True
+        backend = jax.default_backend()
+        return (mode == "auto" and backend == "tpu"
+                and _ACTIVE_TIER_VAR.get() != "host"
+                and ps.fused_tpu_compile_ok())
+
+    def _dense_fused_scan(self, scan, plan, aux_names, arg_names,
+                          extra_cols, float_fields, acc_dtype, dedup_mask,
+                          bound_where, keys, ops, num_groups, tag_names,
+                          schema, float_ops, pack_dtype):
+        """Run the fused-kernel aggregation; returns packed_f, or None
+        after latching the kernel off when anything in the fused program
+        fails (trace, Mosaic compile, or execution) — the caller then
+        serves the same query through the XLA scatter path, so a kernel
+        regression degrades throughput, never availability."""
+        from greptimedb_tpu.utils.metrics import PALLAS_DISPATCHES
+
+        need_cols = sorted(set(aux_names) | set(arg_names))
+
+        def fetch_block(entry, prefetch_only=False):
+            cols = {}
+            for name in need_cols:
+                cols[name] = self._device_block(
+                    scan, name, entry, extra_cols,
+                    acc_dtype if name in float_fields else None,
+                    prefetch_only=prefetch_only,
+                )
+            return cols
+
+        blocks, n_valids, dmasks = self._gather_blocks(
+            scan, plan, fetch_block, dedup_mask)
+        try:
+            packed_f = _agg_scan_fused(
+                tuple(blocks), jnp.asarray(np.asarray(n_valids)),
+                tuple(dmasks) if dmasks is not None else None,
+                where=bound_where, keys=keys, arg_names=arg_names,
+                num_segments=num_groups, tag_names=tag_names,
+                schema=schema, float_ops=float_ops, pack_dtype=pack_dtype,
+                acc_dtype=acc_dtype, want_min="min" in ops,
+                want_max="max" in ops,
+                interpret=jax.default_backend() != "tpu")
+            # surface async execution errors HERE, inside the latch —
+            # the result is consumed immediately downstream anyway
+            packed_f.block_until_ready()
+        except Exception:  # noqa: BLE001 — any kernel failure must degrade
+            import traceback
+
+            traceback.print_exc()
+            print("fused pallas kernel failed; serving this and later "
+                  "queries through the XLA scatter path", flush=True)
+            _FUSED_DISABLED["flag"] = True
+            PALLAS_DISPATCHES.inc(kernel="fused_agg_failed")
+            return None
+        PALLAS_DISPATCHES.inc(float(len(blocks)), kernel="fused_agg")
+        return packed_f
+
+    def _device_block(self, scan: ScanData, name, entry: _BlockEntry,
                       extra_cols, cast_dtype, prefetch_only=False):
-        """Fetch one padded column block, through the HBM block cache when
-        the scan snapshot is cacheable (named region + stable version).
-        `prefetch_only`: schedule the build on the cache's background
-        worker (upload/compute double buffering) and return None."""
+        """Fetch one padded column block through the HBM hot set.
+        Blocks of an immutable SST part are keyed by the FILE identity
+        (entry.pkey) and survive flushes/data-version bumps; memtable
+        and synthetic rows key by snapshot version. `prefetch_only`:
+        schedule the build on the cache's background worker
+        (upload/compute double buffering) and return None."""
+        start, end, block = entry.start, entry.end, entry.block
 
         def build():
             src = extra_cols[name] if name in extra_cols else scan.columns[name]
@@ -2281,12 +2682,28 @@ class PhysicalExecutor:
             # uncached upload (the cache counts its own miss-builds)
             device_telemetry.count_h2d(out.nbytes)
             return out
-        key = (_ACTIVE_TIER_VAR.get(), scan.region_id, scan.data_version, scan.scan_fingerprint,
-               name, start, block, str(cast_dtype))
+        key = self._hot_key(scan, entry, name, str(cast_dtype))
         if prefetch_only:
             self.cache.prefetch(key, build)
             return None
         return self.cache.get(key, build)
+
+    def _hot_key(self, scan, entry: _BlockEntry, name, extra) -> tuple:
+        """Hot-set key for one block. File-anchored blocks carry the
+        (file_id, ts_range, pred_key) part identity + the block offset
+        INSIDE the part, so a dashboard's steady-state uploads are
+        invalidated by file death (compaction/expiry/DROP), not by every
+        memtable write; everything else is snapshot-anchored and retires
+        with its data version."""
+        tier = _ACTIVE_TIER_VAR.get()
+        if entry.pkey is not None:
+            fid, ts_r, pred_key = entry.pkey
+            return ("file", scan.region_id, fid, tier, ts_r, pred_key,
+                    name, entry.start - entry.part_start, entry.block,
+                    extra)
+        return ("snap", scan.region_id, _snap_version(scan), tier,
+                scan.scan_fingerprint, name, entry.start, entry.block,
+                extra)
 
     def _prepared_ok(self, arg_exprs, ops, int_ops, schema,
                      extra_cols) -> bool:
@@ -2326,45 +2743,57 @@ class PhysicalExecutor:
             out = out or f
         return out
 
-    def _scan_has_inf(self, scan, arg_names: tuple) -> bool:
+    def _scan_has_inf(self, scan, arg_names: tuple, dtype=None) -> bool:
         """Whether any aggregated column holds +/-Inf — the pallas
         one-hot matmul kernel would turn one Inf into NaN for every
         group (0*inf), so only provably finite planes may ride it.
+        `dtype` is the dtype the kernel will actually compute in: a
+        finite f64 value that overflows the f64->f32 cast reaches the
+        matmul as Inf all the same, so the proof must run post-cast.
         Memoized on the ScanData snapshot like _scan_has_nan."""
         flags = getattr(scan, "_inf_flags", None)
         if flags is None:
             flags = {}
             scan._inf_flags = flags
+        dt = np.dtype(dtype) if dtype is not None else None
         out = False
         for name in arg_names:
-            f = flags.get(name)
+            key = (name, dt.str if dt is not None else None)
+            f = flags.get(key)
             if f is None:
                 col = np.asarray(scan.columns[name])
-                f = bool(np.isinf(col).any()) \
-                    if col.dtype.kind == "f" else False
-                flags[name] = f
+                if col.dtype.kind == "f":
+                    if (dt is not None and dt.kind == "f"
+                            and dt.itemsize < col.dtype.itemsize):
+                        with np.errstate(over="ignore"):
+                            col = col.astype(dt)
+                    f = bool(np.isinf(col).any())
+                else:
+                    f = False
+                flags[key] = f
             out = out or f
         return out
 
-    def _prep_plane(self, scan, arg_names, start, end, block, acc_dtype,
+    def _prep_plane(self, scan, arg_names, entry: _BlockEntry, acc_dtype,
                     has_nan: bool, prefetch_only=False):
         """Query-invariant value plane for the prepared path, cached in
         HBM alongside the raw column blocks (layout: _build_prep)."""
 
         def build():
-            return jnp.asarray(_build_prep(scan, arg_names, start, end,
-                                           block, acc_dtype, has_nan, None))
+            return jnp.asarray(_build_prep(scan, arg_names, entry.start,
+                                           entry.end, entry.block,
+                                           acc_dtype, has_nan, None))
 
         if scan.region_id < 0:
             return None if prefetch_only else build()
-        key = (_ACTIVE_TIER_VAR.get(), scan.region_id, scan.data_version, scan.scan_fingerprint,
-               "__prep__", arg_names, start, block, str(acc_dtype), has_nan)
+        key = self._hot_key(scan, entry, ("__prep__",) + arg_names,
+                            (str(acc_dtype), has_nan))
         if prefetch_only:
             self.cache.prefetch(key, build)
             return None
         return self.cache.get(key, build)
 
-    def _prep_extreme_plane(self, scan, arg_names, start, end, block,
+    def _prep_extreme_plane(self, scan, arg_names, entry: _BlockEntry,
                             acc_dtype, kind: str, prefetch_only=False):
         """min/max/sq companion plane: values with NaN (and padding)
         replaced by the reduction's identity (±inf for extremes, 0 for
@@ -2372,13 +2801,14 @@ class PhysicalExecutor:
         masking the query needs."""
 
         def build():
-            return jnp.asarray(_build_prep(scan, arg_names, start, end,
-                                           block, acc_dtype, False, kind))
+            return jnp.asarray(_build_prep(scan, arg_names, entry.start,
+                                           entry.end, entry.block,
+                                           acc_dtype, False, kind))
 
         if scan.region_id < 0:
             return None if prefetch_only else build()
-        key = (_ACTIVE_TIER_VAR.get(), scan.region_id, scan.data_version, scan.scan_fingerprint,
-               f"__prep_{kind}__", arg_names, start, block, str(acc_dtype))
+        key = self._hot_key(scan, entry, (f"__prep_{kind}__",) + arg_names,
+                            str(acc_dtype))
         if prefetch_only:
             self.cache.prefetch(key, build)
             return None
@@ -2461,13 +2891,12 @@ class PhysicalExecutor:
 
     def _device_filtered_indices(self, scan, schema, ctx, bound_where,
                                  dedup_mask, obj_cols, n) -> np.ndarray:
-        block = min(block_size_for(n), DEFAULT_BLOCK_ROWS)
         tag_names = frozenset(ctx.tag_names)
         picked: list[np.ndarray] = []
-        for start in range(0, n, block):
-            end = min(start + block, n)
+        for entry in _block_plan(scan):
+            start, end, block = entry.start, entry.end, entry.block
             cols = {
-                name: self._device_block(scan, name, start, end, block, {}, None)
+                name: self._device_block(scan, name, entry, {}, None)
                 for name in scan.columns
                 if name not in obj_cols
             }

@@ -187,17 +187,19 @@ class SstReader:
     def path(self, file_id: str) -> str:
         return os.path.join(self.sst_dir, f"{file_id}.parquet")
 
-    def read(
+    def plan_groups(
         self,
         meta: FileMeta,
         schema: Schema,
         ts_range: Optional[tuple[int, int]] = None,
         projection: Optional[Sequence[str]] = None,
         tag_predicates: Optional[dict[str, set]] = None,
-    ) -> Optional[pa.Table]:
-        """Read an SST with row-group pruning on the time index (reference
-        reader.rs:427-447 min/max stats pruning). Returns None if fully
-        pruned. Internal columns are always materialized."""
+    ) -> Optional[tuple]:
+        """Pruning phase of `read`, factored out so the scan layer can
+        split the surviving row groups across decode workers (one huge
+        SST no longer serializes the parallel decode stage). Returns
+        (ParquetFile, row-group indices, projected column names) or
+        None when pruning rules the whole file out."""
         if ts_range is not None and (meta.ts_max < ts_range[0] or meta.ts_min >= ts_range[1]):
             return None
         # inverted-index pruning first: may rule the file out with no
@@ -222,8 +224,34 @@ class SstReader:
             # tolerate schema evolution: drop columns the file predates
             avail = set(pf.schema_arrow.names)
             cols = [c for c in cols if c in avail]
-        table = pf.read_row_groups(groups, columns=cols)
-        return table
+        return pf, groups, cols
+
+    def read(
+        self,
+        meta: FileMeta,
+        schema: Schema,
+        ts_range: Optional[tuple[int, int]] = None,
+        projection: Optional[Sequence[str]] = None,
+        tag_predicates: Optional[dict[str, set]] = None,
+    ) -> Optional[pa.Table]:
+        """Read an SST with row-group pruning on the time index (reference
+        reader.rs:427-447 min/max stats pruning). Returns None if fully
+        pruned. Internal columns are always materialized."""
+        plan = self.plan_groups(meta, schema, ts_range, projection,
+                                tag_predicates)
+        if plan is None:
+            return None
+        pf, groups, cols = plan
+        return pf.read_row_groups(groups, columns=cols)
+
+    def read_groups(self, meta: FileMeta, groups: Sequence[int],
+                    columns: Optional[Sequence[str]]) -> pa.Table:
+        """Read specific row groups through a FRESH ParquetFile handle —
+        concurrent workers each open their own (pyarrow readers are not
+        safe for concurrent reads on one handle). `groups`/`columns`
+        come from a prior `plan_groups` call."""
+        pf = pq.ParquetFile(self.store.open_input(self.path(meta.file_id)))
+        return pf.read_row_groups(list(groups), columns=columns)
 
     def iter_chunks(
         self,

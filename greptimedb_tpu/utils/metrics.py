@@ -280,6 +280,20 @@ DEVICE_CACHE_EVENTS = REGISTRY.counter(
     "greptimedb_tpu_device_cache_events_total",
     "HBM block cache events by kind (hit/miss/evict/prefetch_join — a "
     "join is an upload the background prefetch worker already did)")
+DEVICE_HOT_SET_EVENTS = REGISTRY.counter(
+    "greptimedb_tpu_device_hot_set_events_total",
+    "HBM-resident columnar hot set events by kind (hit/miss/evict/pin — "
+    "pin = a file-anchored column block entered HBM residency and stays "
+    "across queries and data versions until its file dies)")
+DEVICE_HOT_SET_BYTES = REGISTRY.gauge(
+    "greptimedb_tpu_device_hot_set_bytes",
+    "Bytes currently pinned in HBM by the device columnar hot set")
+PALLAS_DISPATCHES = REGISTRY.counter(
+    "greptimedb_tpu_pallas_dispatch_total",
+    "Pallas TPU kernel dispatches by kernel (fused_agg = the fused "
+    "scan/filter/bucket/aggregate kernel, segment_sum = the one-hot "
+    "matmul segment-sum; fused_agg_failed = mid-query degradations to "
+    "the XLA scatter path)")
 SLOW_QUERIES = REGISTRY.counter(
     "greptimedb_tpu_slow_queries_total",
     "Statements slower than the slow-query threshold, by kind")

@@ -105,9 +105,11 @@ batch = RecordBatch(info.schema, {
     "ts": np.arange(n, dtype=np.int64) * 250})
 engine.put(info.region_ids[0], batch)
 engine.flush(info.region_ids[0])
-r = db.execute_one("SELECT host, date_bin(INTERVAL '1 second', ts) AS s, "
-                   "avg(a), sum(b), count(a) FROM t GROUP BY host, s "
-                   "ORDER BY host, s LIMIT 2000")
+# 1-minute buckets keep host x bucket inside the fused kernel's 4096-
+# segment envelope (1-second buckets were 200k groups — never eligible)
+r = db.execute_one("SELECT host, date_bin(INTERVAL '1 minute', ts) AS s, "
+                   "avg(a), sum(b), count(a), min(a), max(b) FROM t "
+                   "GROUP BY host, s ORDER BY host, s LIMIT 2000")
 path = db.executor.last_path
 print(json.dumps({"path": path, "rows": [[str(x) for x in row]
                                           for row in r.rows()]}))
@@ -129,7 +131,9 @@ def test_sql_pallas_vs_scatter_subprocess():
                            env=env)
         assert r.returncode == 0, r.stderr[-2000:]
         outs[mode] = json.loads(r.stdout.splitlines()[-1])
-    assert outs["on"]["path"] == "dense_prepared"
+    # =on routes the whole chain through the FUSED kernel (raw-column
+    # hot set, in-register masks); =off pins the prepared scatter path
+    assert outs["on"]["path"] == "dense_fused"
     assert outs["off"]["path"] == "dense_prepared"
     def norm(v):
         if v in ("None", "nan"):
