@@ -116,4 +116,13 @@ if _cc.lower() not in ("off", "0", "none", "false", "no", "disabled"):
     except Exception:  # noqa: BLE001 — older jax: feature is optional
         pass
 
+# Runtime lock-order validation (lint/lockdep.py): GTPU_LOCKDEP=1
+# wraps threading.Lock/RLock *before* any repo module constructs one,
+# so every lock the storage/concurrency/maintenance planes create is
+# tracked and tier-1 can assert the observed nesting stays acyclic.
+if _os.environ.get("GTPU_LOCKDEP") == "1":
+    from greptimedb_tpu.lint import lockdep as _lockdep
+
+    _lockdep.install()
+
 __version__ = "0.1.0"

@@ -47,9 +47,6 @@ class UserInfo:
         return self.grants is None or permission in self.grants
 
 
-DEFAULT_USER = UserInfo("greptime")
-
-
 class UserProvider:
     """Base provider (reference `UserProvider` trait,
     src/auth/src/user_provider.rs). Subclasses implement `lookup`."""

@@ -10,7 +10,6 @@ host-side column dicts; a small host evaluator applies WHERE / projection
 from __future__ import annotations
 
 import time
-from typing import Optional
 
 import numpy as np
 

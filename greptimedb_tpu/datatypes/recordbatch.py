@@ -16,7 +16,6 @@ import numpy as np
 import pyarrow as pa
 
 from greptimedb_tpu.datatypes.schema import Schema
-from greptimedb_tpu.datatypes.types import DataType
 from greptimedb_tpu.datatypes.vector import DictVector
 
 Column = Union[np.ndarray, DictVector]

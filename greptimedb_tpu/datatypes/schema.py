@@ -8,8 +8,8 @@ carries the time-index column in arrow metadata, and region metadata
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
-from typing import Optional, Sequence
+from dataclasses import dataclass
+from typing import Sequence
 
 import pyarrow as pa
 

@@ -32,14 +32,12 @@ from __future__ import annotations
 import json
 import logging
 import threading
-import time
 from dataclasses import dataclass, field
 from typing import Optional
 
 import numpy as np
 
 from greptimedb_tpu.catalog.kv import KvBackend
-from greptimedb_tpu.datatypes.types import SemanticType
 from greptimedb_tpu.query.engine import QueryContext, QueryEngine
 from greptimedb_tpu.query.result import QueryResult
 from greptimedb_tpu.sql import ast, parse_sql

@@ -1,0 +1,262 @@
+"""gtpu-lint: repo-invariant static analysis (run via tools/gtpu_lint.py).
+
+Seven PRs of this reproduction accumulated cross-cutting invariants that
+existed only as convention — the reference enforces the analogous ones
+with Rust's type system and clippy lints. This package machine-checks
+them over the repo's own AST so the next PR cannot silently regress:
+
+  fault-seam    direct file/socket I/O in storage/wal/cluster/objectstore
+                must route through the FaultRegistry seams
+  jax-import    storage-only processes must not (non-lazily) import jax
+                beyond the documented platform-pinning bootstrap
+  tracer        jit/pallas/donated functions must stay traceable: no
+                Python control flow on traced values, no host coercions,
+                no wall-clock/RNG, no reuse of donated buffers
+  typed-error   wire boundaries must map typed Unavailable/Overloaded
+                before any broad `except Exception`
+  lockdep       the static lock-acquisition graph across the concurrency
+                plane must stay acyclic (runtime twin: lint.lockdep,
+                GTPU_LOCKDEP=1)
+  deadcode      unused imports / unused module-level names / unreachable
+                statements
+  metrics       every registered metric is prefixed, documented, charted
+                (folds tools/check_metrics.py in as a pass)
+  options       options.py dataclasses <-> config/standalone.example.toml
+                stay in sync, every scalar option is documented
+
+Escape hatch: `lint_allow.toml` at the repo root — every entry names a
+checker, a path glob, a match substring, and a one-line reason. Unused
+entries are themselves findings, so the allowlist cannot rot.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+import os
+from dataclasses import dataclass, field
+from typing import Callable, Iterable, Optional
+
+from greptimedb_tpu.options import tomllib
+
+#: directories under the repo root that the source-level checkers walk
+SOURCE_ROOTS = ("greptimedb_tpu", "tools")
+
+
+@dataclass
+class Finding:
+    checker: str
+    path: str          # repo-relative, forward slashes
+    line: int
+    message: str
+    allowed: bool = False
+    allow_reason: str = ""
+
+    def render(self) -> str:
+        tag = f" [allowed: {self.allow_reason}]" if self.allowed else ""
+        return f"{self.path}:{self.line}: [{self.checker}] {self.message}{tag}"
+
+    def as_json(self) -> dict:
+        return {"checker": self.checker, "path": self.path,
+                "line": self.line, "message": self.message,
+                "allowed": self.allowed, "allow_reason": self.allow_reason}
+
+
+@dataclass
+class SourceFile:
+    """One parsed module. Checkers never re-read or re-parse; tests feed
+    synthetic instances via `Repo(files=[...])` to exercise a checker on
+    a fixture snippet without touching disk."""
+
+    path: str          # repo-relative, forward slashes
+    text: str
+    tree: ast.Module
+
+    @classmethod
+    def from_text(cls, path: str, text: str) -> "SourceFile":
+        return cls(path=path, text=text, tree=ast.parse(text))
+
+    @property
+    def module(self) -> str:
+        """Dotted module name ('' for non-package files)."""
+        p = self.path[:-3] if self.path.endswith(".py") else self.path
+        parts = p.split("/")
+        if parts[-1] == "__init__":
+            parts = parts[:-1]
+        return ".".join(parts)
+
+
+@dataclass
+class Repo:
+    root: str = ""
+    files: list = field(default_factory=list)
+
+    def by_path(self, path: str) -> Optional[SourceFile]:
+        for f in self.files:
+            if f.path == path:
+                return f
+        return None
+
+    def modules(self) -> dict:
+        return {f.module: f for f in self.files if f.module}
+
+
+def repo_root() -> str:
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.dirname(here)
+
+
+def load_repo(root: Optional[str] = None) -> Repo:
+    """Parse every repo source file once, shared by all checkers.
+
+    Always the full file set — the import-graph and lock-graph
+    checkers need it to stay sound; --changed-only restriction applies
+    at *reporting* time (run_checkers' changed_only), not here.
+    """
+    root = root or repo_root()
+    files = []
+    for src_root in SOURCE_ROOTS:
+        base = os.path.join(root, src_root)
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for name in sorted(filenames):
+                if not name.endswith(".py"):
+                    continue
+                full = os.path.join(dirpath, name)
+                rel = os.path.relpath(full, root).replace(os.sep, "/")
+                with open(full, encoding="utf-8") as f:
+                    text = f.read()
+                try:
+                    tree = ast.parse(text)
+                except SyntaxError as e:  # a broken file is itself a finding
+                    tree = ast.Module(body=[], type_ignores=[])
+                    files.append(SourceFile(rel, text, tree))
+                    files[-1]._syntax_error = e  # type: ignore[attr-defined]
+                    continue
+                files.append(SourceFile(rel, text, tree))
+    return Repo(root=root, files=files)
+
+
+# ---- allowlist --------------------------------------------------------------
+
+
+@dataclass
+class AllowEntry:
+    checker: str
+    path: str            # fnmatch glob over the repo-relative path
+    match: str           # substring of the finding message ('' = any)
+    reason: str
+    used: int = 0
+
+
+def load_allowlist(root: str) -> list:
+    path = os.path.join(root, "lint_allow.toml")
+    if not os.path.exists(path):
+        return []
+    with open(path, "rb") as f:
+        data = tomllib.load(f)
+    entries = []
+    for i, raw in enumerate(data.get("allow", [])):
+        reason = (raw.get("reason") or "").strip()
+        if not reason:
+            raise ValueError(
+                f"lint_allow.toml entry {i}: every allow entry needs a "
+                "non-empty 'reason' (that's the point of the allowlist)")
+        entries.append(AllowEntry(
+            checker=raw.get("checker", "*"),
+            path=raw.get("path", "*"),
+            match=raw.get("match", ""),
+            reason=reason))
+    return entries
+
+
+def apply_allowlist(findings: Iterable[Finding],
+                    entries: list) -> list:
+    out = []
+    for f in findings:
+        for e in entries:
+            if e.checker not in ("*", f.checker):
+                continue
+            if not fnmatch.fnmatch(f.path, e.path):
+                continue
+            if e.match and e.match not in f.message:
+                continue
+            f.allowed = True
+            f.allow_reason = e.reason
+            e.used += 1
+            break
+        out.append(f)
+    return out
+
+
+# ---- registry ---------------------------------------------------------------
+
+#: name -> callable(Repo) -> list[Finding]; populated by the checker
+#: modules at import time via @checker
+CHECKERS: dict = {}
+
+
+def checker(name: str) -> Callable:
+    def register(fn):
+        CHECKERS[name] = fn
+        fn.checker_name = name
+        return fn
+    return register
+
+
+def _import_checkers() -> None:
+    # imported lazily so `from greptimedb_tpu.lint import lockdep` (the
+    # runtime validator, installed at interpreter start under
+    # GTPU_LOCKDEP=1) doesn't pay for the static-analysis modules
+    from greptimedb_tpu.lint import (  # noqa: F401
+        deadcode,
+        fault_seam,
+        jax_imports,
+        lockgraph,
+        metrics_options,
+        tracer,
+        typed_errors,
+    )
+
+
+def run_checkers(repo: Optional[Repo] = None,
+                 names: Optional[Iterable[str]] = None,
+                 changed_only: Optional[set] = None) -> list:
+    """Run the selected checkers (default: all) and apply the allowlist.
+
+    Returns every finding, allowed ones flagged. When `changed_only`
+    (a set of repo-relative paths) is given, whole-repo checkers still
+    analyze everything — soundness needs the full import/lock graphs —
+    but findings outside the changed set are dropped, and the
+    unused-allowlist audit is skipped (entries for unchanged files
+    legitimately go unused)."""
+    _import_checkers()
+    repo = repo or load_repo()
+    selected = list(names) if names else sorted(CHECKERS)
+    unknown = [n for n in selected if n not in CHECKERS]
+    if unknown:
+        raise ValueError(f"unknown checker(s): {', '.join(unknown)} "
+                         f"(have: {', '.join(sorted(CHECKERS))})")
+    findings: list = []
+    for f in repo.files:
+        err = getattr(f, "_syntax_error", None)
+        if err is not None:
+            findings.append(Finding("parse", f.path, err.lineno or 1,
+                                    f"syntax error: {err.msg}"))
+    for name in selected:
+        findings.extend(CHECKERS[name](repo))
+    entries = load_allowlist(repo.root) if repo.root else []
+    findings = apply_allowlist(findings, entries)
+    if changed_only is not None:
+        findings = [f for f in findings if f.path in changed_only]
+    elif repo.root and not names:
+        # full run: a stale allowlist entry is itself a finding
+        for e in entries:
+            if e.used == 0:
+                findings.append(Finding(
+                    "allowlist", "lint_allow.toml", 1,
+                    f"unused allow entry (checker={e.checker!r} "
+                    f"path={e.path!r} match={e.match!r}): remove it or "
+                    "fix the pattern"))
+    findings.sort(key=lambda f: (f.path, f.line, f.checker))
+    return findings

@@ -1,0 +1,112 @@
+"""metrics + options: the observable/config surface stays documented.
+
+`metrics` folds tools/check_metrics.py in as a lint pass: every metric
+registered on the process-wide REGISTRY carries the repo namespace
+prefix, has help text, and is charted in the Grafana dashboard.
+
+`options` keeps the config surface honest three ways:
+- `config/standalone.example.toml` is byte-identical to
+  `options.example_toml()` (the generator is the source of truth —
+  regenerate the file after changing a dataclass);
+- every scalar option path in the StandaloneOptions tree has a `_DOC`
+  entry (the example file is the only config documentation operators
+  get);
+- every `_DOC` key still names a real option (stale docs are findings
+  too).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+from greptimedb_tpu.lint import Finding, Repo, checker
+
+
+@checker("metrics")
+def check_metrics_pass(repo: Repo) -> list:
+    if not repo.root:
+        return []  # fixture repos have no live registry to import
+    import importlib.util
+    import json
+
+    # tools/ is not a package; load the lint's metrics pass the same way
+    # tests/test_check_metrics.py does
+    path = os.path.join(repo.root, "tools", "check_metrics.py")
+    spec = importlib.util.spec_from_file_location("check_metrics", path)
+    cm = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cm)
+
+    findings = []
+    try:
+        with open(cm.DASHBOARD, encoding="utf-8") as f:
+            dashboard_text = f.read()
+        json.loads(dashboard_text)
+    except (OSError, ValueError) as e:
+        return [Finding("metrics", "grafana/greptimedb_tpu.json", 1,
+                        f"dashboard unreadable: {e}")]
+    for problem in cm.check(cm.registered_metrics(), dashboard_text):
+        findings.append(Finding(
+            "metrics", "greptimedb_tpu/utils/metrics.py", 1, problem))
+    return findings
+
+
+def _scalar_paths(obj, prefix: str = ""):
+    for f in dataclasses.fields(obj):
+        value = getattr(obj, f.name)
+        path = f"{prefix}{f.name}"
+        if dataclasses.is_dataclass(value):
+            yield from _scalar_paths(value, path + ".")
+        else:
+            # scalars AND array-of-tables fields: each needs one doc
+            # line (element fields are emitted commented, undocumented)
+            yield path
+
+
+@checker("options")
+def check_options(repo: Repo) -> list:
+    if not repo.root:
+        return []
+    from greptimedb_tpu.options import _DOC, StandaloneOptions, example_toml
+
+    findings = []
+    opts_path = "greptimedb_tpu/options.py"
+    example_path = os.path.join(repo.root, "config",
+                                "standalone.example.toml")
+    try:
+        with open(example_path, encoding="utf-8") as f:
+            on_disk = f.read()
+    except OSError as e:
+        return [Finding("options", "config/standalone.example.toml", 1,
+                        f"example config unreadable: {e}")]
+    generated = example_toml()
+    if generated != on_disk:
+        gen_lines = generated.splitlines()
+        disk_lines = on_disk.splitlines()
+        where, what = len(gen_lines), "trailing content differs"
+        for i, line in enumerate(gen_lines, 1):
+            if i > len(disk_lines) or disk_lines[i - 1] != line:
+                where, what = i, f"expected {line!r}"
+                break
+        else:
+            if len(disk_lines) > len(gen_lines):
+                where = len(gen_lines) + 1
+                what = f"unexpected extra line {disk_lines[len(gen_lines)]!r}"
+        findings.append(Finding(
+            "options", "config/standalone.example.toml", where,
+            "drifted from options.example_toml() (first difference at "
+            f"line {where}: {what}) — regenerate: python -c \"from "
+            "greptimedb_tpu.options import example_toml; "
+            "print(example_toml(), end='')\" "
+            "> config/standalone.example.toml"))
+    paths = set(_scalar_paths(StandaloneOptions()))
+    for path in sorted(paths - set(_DOC)):
+        findings.append(Finding(
+            "options", opts_path, 1,
+            f"option '{path}' has no _DOC entry — the generated "
+            "example config is the operator documentation"))
+    for key in sorted(set(_DOC) - paths):
+        findings.append(Finding(
+            "options", opts_path, 1,
+            f"_DOC entry '{key}' names no existing option — stale doc"))
+    return findings

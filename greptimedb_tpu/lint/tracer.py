@@ -1,0 +1,333 @@
+"""tracer: jit/pallas/donated functions must stay traceable.
+
+Inside a function that jax traces (`@jax.jit`, `g = jax.jit(f)`,
+`pl.pallas_call(kernel, ...)`), Python-level branching on a traced
+value raises at trace time in the best case and silently bakes in one
+branch in the worst (the tracer sees an abstract value, not data).
+Host coercions (`float()`/`int()`/`.item()`) force a device sync and
+break under jit; wall-clock/RNG calls freeze one sample into the
+compiled executable. And an argument donated via `donate_argnums` is
+DEALLOCATED by the call — reusing the Python reference afterwards
+reads a dead buffer (PR 7's donation twins exist precisely so call
+sites rebind: `acc = fold(acc, chunk)`).
+
+Heuristics (tuned against ops/ and query/physical.py, escape hatch =
+lint_allow.toml): traced names are the function's parameters plus
+names assigned from expressions over traced names; tests touching only
+`.shape`/`.ndim`/`.dtype`/`.size`/`len()`/`isinstance`/`is None` are
+static and exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from greptimedb_tpu.lint import Finding, Repo, checker
+from greptimedb_tpu.lint.astutil import call_name, decorator_names, dotted
+
+TRACE_WRAPPERS = ("jax.jit", "jit", "pallas_call", "pl.pallas_call",
+                  "jax.pmap", "pmap", "checkify.checkify")
+
+HOST_CALLS = {
+    "time.time", "time.perf_counter", "time.monotonic", "time.sleep",
+    "datetime.now", "datetime.datetime.now", "datetime.utcnow",
+}
+
+STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
+
+
+def _is_trace_wrapper(name: Optional[str]) -> bool:
+    return bool(name) and (name in TRACE_WRAPPERS
+                           or name.endswith(".jit")
+                           or name.endswith("pallas_call"))
+
+
+def _traced_functions(tree: ast.Module):
+    """function node -> static param names, for every function the
+    module traces: decorated, wrapped via assignment, or passed as a
+    pallas kernel. `static_argnames`/`static_argnums` params are NOT
+    traced — Python branching on them is exactly how trace-time
+    specialization works."""
+    by_name = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            by_name.setdefault(node.name, node)
+    out = {}
+
+    def static_of(call: ast.Call, fn) -> set:
+        names: set = set()
+        for kw in call.keywords:
+            if kw.arg == "static_argnames":
+                vals = kw.value.elts if isinstance(
+                    kw.value, (ast.Tuple, ast.List)) else [kw.value]
+                names |= {c.value for c in vals
+                          if isinstance(c, ast.Constant)}
+            elif kw.arg == "static_argnums" and fn is not None \
+                    and not isinstance(fn, ast.Lambda):
+                nums = kw.value.elts if isinstance(
+                    kw.value, (ast.Tuple, ast.List)) else [kw.value]
+                params = [a.arg for a in fn.args.posonlyargs
+                          + fn.args.args]
+                for c in nums:
+                    if isinstance(c, ast.Constant) and \
+                            isinstance(c.value, int) and \
+                            c.value < len(params):
+                        names.add(params[c.value])
+        return names
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            names = decorator_names(node)
+            if any(_is_trace_wrapper(n) for n in names):
+                static: set = set()
+                for dec in node.decorator_list:
+                    if isinstance(dec, ast.Call):
+                        static |= static_of(dec, node)
+                out[node] = static
+        elif isinstance(node, ast.Call) and _is_trace_wrapper(
+                call_name(node)):
+            for arg in node.args[:1]:
+                target = None
+                if isinstance(arg, ast.Name) and arg.id in by_name:
+                    target = by_name[arg.id]
+                elif isinstance(arg, ast.Lambda):
+                    target = arg
+                if target is not None:
+                    out[target] = out.get(target, set()) \
+                        | static_of(node, target)
+    return out
+
+
+def _param_names(fn) -> set:
+    args = fn.args
+    names = [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs]
+    if args.vararg:
+        names.append(args.vararg.arg)
+    if args.kwarg:
+        names.append(args.kwarg.arg)
+    if isinstance(fn, ast.Lambda):
+        return set(names)
+    return set(names) - {"self", "cls"}
+
+
+def _traced_names(fn, static: set) -> set:
+    """Parameters (minus the static ones) plus names assigned from
+    expressions over traced *data* (one forward pass). An assignment
+    whose value only touches traced names through shape/dtype/len()
+    stays static — `squeeze = values.ndim == 1` is a Python bool."""
+    traced = _param_names(fn) - set(static)
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if not isinstance(node, (ast.Assign, ast.AugAssign,
+                                     ast.AnnAssign)):
+                continue
+            value = node.value
+            if value is None:
+                continue
+            mentions = False
+            for n in ast.walk(value):
+                if isinstance(n, ast.Name) and n.id in traced:
+                    mentions = True
+                elif isinstance(n, ast.Call):
+                    cn = call_name(n) or ""
+                    if cn.startswith(("jnp.", "jax.", "lax.", "pl.")):
+                        mentions = True
+            if not mentions or _test_is_static(value, traced):
+                continue
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                for n in ast.walk(t):
+                    if isinstance(n, ast.Name):
+                        traced.add(n.id)
+    return traced
+
+
+def _parents(root: ast.AST) -> dict:
+    out = {}
+    for node in ast.walk(root):
+        for child in ast.iter_child_nodes(node):
+            out[child] = node
+    return out
+
+
+def _test_is_static(test: ast.expr, traced: set) -> bool:
+    """True when the condition never touches traced *data*: every
+    traced-name read sits under a shape/dtype attribute, len(), or
+    isinstance/is-None check."""
+    parents = _parents(test)
+    for node in ast.walk(test):
+        if not (isinstance(node, ast.Name)
+                and isinstance(node.ctx, ast.Load)
+                and node.id in traced):
+            continue
+        cur, exempt = node, False
+        while cur is not None:
+            if isinstance(cur, ast.Attribute) and cur.attr in STATIC_ATTRS:
+                exempt = True
+                break
+            if isinstance(cur, ast.Call):
+                cn = call_name(cur) or ""
+                if cn in ("len", "isinstance", "getattr", "hasattr",
+                          "type", "id"):
+                    exempt = True
+                    break
+            if isinstance(cur, ast.Compare) and any(
+                    isinstance(op, (ast.Is, ast.IsNot))
+                    for op in cur.ops):
+                exempt = True
+                break
+            cur = parents.get(cur)
+        if not exempt:
+            return False
+    return True
+
+
+@checker("tracer")
+def check(repo: Repo) -> list:
+    findings = []
+    for f in repo.files:
+        if not f.path.startswith("greptimedb_tpu/"):
+            continue
+        traced_fns = _traced_functions(f.tree)
+        for fn, static in traced_fns.items():
+            label = getattr(fn, "name", "<lambda>")
+            traced = _traced_names(fn, static)
+            body = fn.body if isinstance(fn.body, list) else [fn.body]
+            for stmt in body:
+                for node in ast.walk(stmt):
+                    if isinstance(node, (ast.If, ast.While)) and \
+                            not _test_is_static(node.test, traced):
+                        findings.append(Finding(
+                            "tracer", f.path, node.lineno,
+                            f"Python {'while' if isinstance(node, ast.While) else 'if'} "
+                            f"on a traced value inside traced function "
+                            f"{label}() — use jnp.where/lax.cond"))
+                    elif isinstance(node, ast.Call):
+                        cn = call_name(node) or ""
+                        if cn in HOST_CALLS or cn.startswith(
+                                ("random.", "np.random.",
+                                 "numpy.random.")):
+                            findings.append(Finding(
+                                "tracer", f.path, node.lineno,
+                                f"host wall-clock/RNG call {cn}() inside "
+                                f"traced function {label}() — the result "
+                                "is frozen into the compiled executable"))
+                        elif isinstance(node.func, ast.Attribute) and \
+                                node.func.attr == "item" and not node.args:
+                            findings.append(Finding(
+                                "tracer", f.path, node.lineno,
+                                f".item() inside traced function "
+                                f"{label}() forces a host sync and "
+                                "fails under jit"))
+                        elif cn in ("float", "int", "bool") and \
+                                len(node.args) == 1 and not (
+                                    isinstance(node.args[0], ast.Constant)
+                                    or _test_is_static(node.args[0],
+                                                       traced)):
+                            findings.append(Finding(
+                                "tracer", f.path, node.lineno,
+                                f"{cn}() coercion of a traced value "
+                                f"inside traced function {label}()"))
+        findings.extend(_check_donation(f))
+    return findings
+
+
+def _check_donation(f) -> list:
+    """Reuse of a donated buffer after the donating call."""
+    findings = []
+    donated_callables = {}
+    for node in ast.walk(f.tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            cn = call_name(node.value) or ""
+            if not _is_trace_wrapper(cn):
+                continue
+            nums = None
+            for kw in node.value.keywords:
+                if kw.arg == "donate_argnums":
+                    if isinstance(kw.value, ast.Tuple):
+                        nums = tuple(c.value for c in kw.value.elts
+                                     if isinstance(c, ast.Constant))
+                    elif isinstance(kw.value, ast.Constant):
+                        nums = (kw.value.value,)
+            if nums:
+                for t in node.targets:
+                    name = dotted(t)
+                    if name:
+                        donated_callables[name] = nums
+    if not donated_callables:
+        return findings
+    for fn in ast.walk(f.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        parents: dict = {}
+        for node in ast.walk(fn):
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+
+        def branch_arms(node) -> dict:
+            """{If-node-id: 'body'|'orelse'} for every If ancestor —
+            two nodes in different arms of the same If can never both
+            execute, so a load there is NOT a reuse."""
+            arms = {}
+            cur = node
+            while cur in parents:
+                parent = parents[cur]
+                if isinstance(parent, ast.If):
+                    if any(cur is s or _contains(s, cur)
+                           for s in parent.orelse):
+                        arms[id(parent)] = "orelse"
+                    else:
+                        arms[id(parent)] = "body"
+                cur = parent
+            return arms
+
+        def _contains(root, target) -> bool:
+            return any(n is target for n in ast.walk(root))
+
+        stores: list = []
+        loads: list = []
+        calls: list = []
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name):
+                (stores if isinstance(node.ctx, (ast.Store, ast.Del))
+                 else loads).append(node)
+            elif isinstance(node, ast.Call):
+                cn = dotted(node.func)
+                if cn in donated_callables:
+                    calls.append((node, donated_callables[cn], cn))
+        for call, nums, cn in calls:
+            # a donating call inside a `return`/`raise` statement exits
+            # the function — no later load is on the same path
+            stmt = call
+            while stmt in parents and not isinstance(stmt, ast.stmt):
+                stmt = parents[stmt]
+            if isinstance(stmt, (ast.Return, ast.Raise)):
+                continue
+            call_arms = branch_arms(call)
+            for idx in nums:
+                if not isinstance(idx, int) or idx >= len(call.args):
+                    continue
+                arg = call.args[idx]
+                if not isinstance(arg, ast.Name):
+                    continue
+                rebound = [n.lineno for n in stores
+                           if n.id == arg.id and n.lineno >= call.lineno]
+                for load in loads:
+                    if load.id != arg.id or load.lineno <= call.lineno:
+                        continue
+                    if any(st <= load.lineno for st in rebound):
+                        continue  # rebound before this read
+                    load_arms = branch_arms(load)
+                    if any(load_arms.get(k) not in (None, v)
+                           for k, v in call_arms.items()):
+                        continue  # mutually exclusive If arms
+                    findings.append(Finding(
+                        "tracer", f.path, load.lineno,
+                        f"buffer {arg.id!r} reused after being donated "
+                        f"to {cn}() at line {call.lineno} — donation "
+                        "deallocates it; rebind the result instead"))
+                    break
+    return findings

@@ -37,7 +37,7 @@ import json
 import os
 import threading
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Optional
 
 import numpy as np
@@ -50,7 +50,6 @@ from greptimedb_tpu.maintenance.retention import ms_to_units
 #: flag can't collide with a real region.
 ROLLUP_RID_FLAG = 1 << 30
 ROWS_COL = "rows__count"
-PLANES = ("min", "max", "sum", "count")
 
 _STATE_FILE = "rollup_state.json"
 

@@ -10,7 +10,6 @@ failed over — the region closes itself; the split-brain guard).
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 from ..fault import FAULTS, FaultError

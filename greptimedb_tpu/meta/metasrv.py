@@ -34,7 +34,7 @@ from ..procedure import Procedure, ProcedureManager, Status
 from .election import KvElection, NotLeaderError
 from .failure_detector import PhiAccrualFailureDetector
 from .instruction import Instruction, InstructionKind
-from .route import RegionRoute, TableRoute, TableRouteManager
+from .route import TableRouteManager
 from .selector import SELECTORS, Selector
 
 

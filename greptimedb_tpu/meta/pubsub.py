@@ -12,8 +12,8 @@ metadata plane), with the same subscribe/unsubscribe-by-name surface.
 from __future__ import annotations
 
 import threading
-from dataclasses import dataclass, field
-from typing import Callable, Optional
+from dataclasses import dataclass
+from typing import Callable
 
 TOPIC_HEARTBEAT = "heartbeat"
 

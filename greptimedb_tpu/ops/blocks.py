@@ -11,7 +11,6 @@ they mask.
 from __future__ import annotations
 
 import math
-from typing import Optional
 
 import numpy as np
 

@@ -15,7 +15,7 @@ Bounds use the reference's semantics: region i covers
 from __future__ import annotations
 
 import json
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Optional, Sequence
 
 import numpy as np

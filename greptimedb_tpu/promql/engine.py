@@ -17,15 +17,15 @@ from __future__ import annotations
 
 import math
 import re
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from greptimedb_tpu.datatypes.types import DataType, SemanticType
-from greptimedb_tpu.ops.segment import combine_group_ids, segment_agg
+from greptimedb_tpu.datatypes.types import DataType
+from greptimedb_tpu.ops.segment import segment_agg
 from greptimedb_tpu.ops.window import counter_adjust, extrapolated_delta, window_stats
 from greptimedb_tpu.promql.parser import (
     DEFAULT_LOOKBACK_S,

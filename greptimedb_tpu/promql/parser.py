@@ -8,7 +8,7 @@ aggregations with by/without, functions, subqueries `expr[range:step]`).
 from __future__ import annotations
 
 import re
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Optional
 
 DEFAULT_LOOKBACK_S = 300.0  # 5m, reference InstantManipulate lookback

@@ -5,9 +5,22 @@ QueryStatement::{Sql, Promql}, query/src/parser.rs:46-48); physical
 execution composes jit-compiled device kernels over padded column blocks:
 filter masks -> group ids -> segment reductions, with host numpy only at
 the edges (result assembly, ORDER BY over group counts).
+
+`QueryEngine` is exported lazily (PEP 562): importing a light sibling
+like `query.result` (the Flight server needs only the QueryResult
+container) must NOT execute `query.engine` — that module pulls jax and
+the whole kernel stack, which a storage-only datanode process never
+needs (gtpu-lint `jax-import` guards this).
 """
 
-from greptimedb_tpu.query.engine import QueryEngine
 from greptimedb_tpu.query.result import QueryResult
 
 __all__ = ["QueryEngine", "QueryResult"]
+
+
+def __getattr__(name: str):
+    if name == "QueryEngine":
+        from greptimedb_tpu.query.engine import QueryEngine
+
+        return QueryEngine
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

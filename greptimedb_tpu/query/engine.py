@@ -12,11 +12,11 @@ from __future__ import annotations
 import dataclasses
 import json
 import threading
-from typing import Optional, Union
+from typing import Optional
 
 import numpy as np
 
-from greptimedb_tpu.catalog.catalog import Catalog, CatalogError, DEFAULT_DB, TableInfo
+from greptimedb_tpu.catalog.catalog import Catalog, CatalogError, TableInfo
 from greptimedb_tpu.datatypes.recordbatch import RecordBatch
 from greptimedb_tpu.datatypes.schema import ColumnSchema, Schema
 from greptimedb_tpu.datatypes.types import DataType, SemanticType, parse_sql_type

@@ -16,23 +16,19 @@ projection) via an identity-keyed env.
 
 from __future__ import annotations
 
-import math
+import contextvars
 import re
 from dataclasses import dataclass
 from typing import Callable, Optional
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
 from greptimedb_tpu.datatypes.schema import Schema
-from greptimedb_tpu.datatypes.types import DataType, SemanticType
+from greptimedb_tpu.datatypes.types import DataType
 from greptimedb_tpu.sql import ast
-import contextvars
-
 from greptimedb_tpu.utils.time import (
     coerce_ts_literal as _coerce_ts_literal_raw,
-    parse_timestamp_ns,
 )
 
 # session timezone for naive timestamp-literal coercion. A contextvar —

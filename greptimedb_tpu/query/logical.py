@@ -7,7 +7,7 @@ Both the SQL planner and the PromQL compiler lower into this algebra
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Optional
 
 from greptimedb_tpu.catalog.catalog import TableInfo

@@ -18,7 +18,6 @@ from __future__ import annotations
 
 import functools
 import itertools
-import math
 import os
 import threading
 import time
@@ -30,7 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from greptimedb_tpu.datatypes.types import DataType, SemanticType
-from greptimedb_tpu.ops.blocks import DEFAULT_BLOCK_ROWS, block_size_for, make_mask, pad_rows
+from greptimedb_tpu.ops.blocks import DEFAULT_BLOCK_ROWS, block_size_for, pad_rows
 from greptimedb_tpu.ops.dedup import sort_dedup
 from greptimedb_tpu.ops.segment import (
     _type_max as _seg_type_max,

@@ -4,8 +4,20 @@ tonic gRPC/Flight, MySQL, Postgres wire...).
 Round 1 surface: the HTTP server — /v1/sql, the Prometheus query API,
 InfluxDB line-protocol and OpenTSDB ingestion, /metrics. gRPC/Flight and
 the MySQL/Postgres wire protocols follow in later rounds.
+
+`HttpServer` is exported lazily (PEP 562): the HTTP frontend imports
+the full query engine (jax + kernels), but a storage-only datanode
+imports only the sibling `servers.flight` — executing `servers.http`
+from this package init would drag the device stack into every datanode
+child (gtpu-lint `jax-import` guards this).
 """
 
-from greptimedb_tpu.servers.http import HttpServer
-
 __all__ = ["HttpServer"]
+
+
+def __getattr__(name: str):
+    if name == "HttpServer":
+        from greptimedb_tpu.servers.http import HttpServer
+
+        return HttpServer
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

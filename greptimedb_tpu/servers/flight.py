@@ -33,7 +33,6 @@ import pyarrow as pa
 import pyarrow.flight as fl
 
 from greptimedb_tpu.datatypes.schema import Schema
-from greptimedb_tpu.datatypes.types import DataType, SemanticType
 from greptimedb_tpu.fault import FAULTS, local_node, retry_call
 from greptimedb_tpu.query.result import QueryResult
 from greptimedb_tpu.session import Channel, QueryContext

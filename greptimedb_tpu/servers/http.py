@@ -19,11 +19,11 @@ from typing import Optional
 
 import numpy as np
 
-from greptimedb_tpu.fault import Unavailable
+from greptimedb_tpu.catalog.catalog import CatalogError
+from greptimedb_tpu.fault import FaultError, Unavailable
 from greptimedb_tpu.query.engine import QueryContext, QueryEngine
 from greptimedb_tpu.query.result import QueryResult
 from greptimedb_tpu.utils.metrics import HTTP_REQUESTS, QUERY_DURATION, REGISTRY
-from greptimedb_tpu.utils.time import unit_to_ns
 
 
 class HttpServer:
@@ -393,8 +393,8 @@ class _Handler(BaseHTTPRequestHandler):
         for t in tables:
             try:
                 info = qe.catalog.table(ctx.db, _metric_of(t))
-            except Exception:
-                continue
+            except CatalogError:
+                continue  # matcher named a non-existent metric: skip it
             labels.update(c.name for c in info.schema.tag_columns)
         self._send(200, {"status": "success", "data": sorted(labels)})
 
@@ -409,7 +409,11 @@ class _Handler(BaseHTTPRequestHandler):
         for t in qe.catalog.list_tables(ctx.db):
             try:
                 info = qe._table(t, ctx)
-            except Exception:
+            except (CatalogError, Unavailable, FaultError,
+                    OSError, ValueError):
+                # dropped concurrently, or its region failed to open
+                # (WAL replay / manifest read): label discovery skips
+                # the broken table instead of failing the endpoint
                 continue
             if label not in {c.name for c in info.schema.tag_columns}:
                 continue

@@ -28,7 +28,6 @@ import numpy as np
 
 from greptimedb_tpu.fault import Unavailable
 from greptimedb_tpu.query.engine import QueryContext, QueryEngine
-from greptimedb_tpu.utils.metrics import REGISTRY
 
 CLIENT_PROTOCOL_41 = 0x00000200
 CLIENT_CONNECT_WITH_DB = 0x00000008
@@ -73,7 +72,6 @@ MYSQL_TYPE_LONG_BLOB = 251
 MYSQL_TYPE_TIMESTAMP = 7
 MYSQL_TYPE_DATETIME = 12
 MYSQL_TYPE_DATE = 10
-MYSQL_TYPE_TIME = 11
 MYSQL_TYPE_VARCHAR = 15
 MYSQL_TYPE_YEAR = 13
 MYSQL_TYPE_DECIMAL = 0

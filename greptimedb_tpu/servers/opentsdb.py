@@ -15,6 +15,7 @@ import socketserver
 import threading
 from typing import Optional
 
+from greptimedb_tpu.fault.retry import Unavailable
 from greptimedb_tpu.query.engine import QueryEngine
 from greptimedb_tpu.utils.metrics import INGEST_ROWS
 
@@ -68,6 +69,11 @@ class _Session(socketserver.StreamRequestHandler):
                 n = write_points(server.query_engine, server.db, [point],
                                  precision="ms")
                 INGEST_ROWS.inc(n, protocol="opentsdb")
+            except Unavailable as e:
+                # typed backpressure: the telnet protocol has no status
+                # codes, but "unavailable" is what tcollector-style
+                # clients pattern-match to back off and retry
+                self.wfile.write(f"put: unavailable: {e}\n".encode())
             except Exception as e:  # noqa: BLE001 — wire boundary
                 self.wfile.write(f"put: {e}\n".encode())
 

@@ -12,7 +12,6 @@ produce.
 from __future__ import annotations
 
 import struct
-from collections import defaultdict
 
 from greptimedb_tpu.servers.influx import Point, write_points
 from greptimedb_tpu.utils import protowire as pw

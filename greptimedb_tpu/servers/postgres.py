@@ -18,11 +18,11 @@ from typing import Optional
 import numpy as np
 
 from greptimedb_tpu.datatypes.types import DataType
+from greptimedb_tpu.fault.retry import Unavailable
 from greptimedb_tpu.query.engine import QueryContext, QueryEngine
 
 OID_BOOL = 16
 OID_INT8 = 20
-OID_INT4 = 23
 OID_FLOAT8 = 701
 OID_TEXT = 25
 OID_TIMESTAMP = 1114
@@ -231,8 +231,10 @@ class _Session(socketserver.BaseRequestHandler):
     def _ready(self, conn: _Conn) -> None:
         conn.send(b"Z", b"I")
 
-    def _error(self, conn: _Conn, msg: str) -> None:
-        body = b"SERROR\x00" + b"C42601\x00" + b"M" + msg.encode()[:900] + b"\x00\x00"
+    def _error(self, conn: _Conn, msg: str,
+               sqlstate: bytes = b"42601") -> None:
+        body = b"SERROR\x00" + b"C" + sqlstate + b"\x00" \
+            + b"M" + msg.encode()[:900] + b"\x00\x00"
         conn.send(b"E", body)
 
     def _run_simple(self, conn: _Conn, engine: QueryEngine, sql: str,
@@ -247,6 +249,12 @@ class _Session(socketserver.BaseRequestHandler):
             return
         try:
             res = engine.execute_one(sql, QueryContext(db=ctx.db))
+        except Unavailable as e:
+            # typed backpressure/degradation: SQLSTATE 53300
+            # (too_many_connections) tells drivers to back off —
+            # NOT the 42601 syntax-error a generic failure maps to
+            self._error(conn, str(e), sqlstate=b"53300")
+            return
         except Exception as e:  # noqa: BLE001 — wire must stay up
             self._error(conn, str(e))
             return

@@ -32,7 +32,6 @@ Blob binary layout (little-endian, blob type "gtpu-inverted-index-v1"):
 
 from __future__ import annotations
 
-import json
 import os
 import re
 import struct
@@ -46,7 +45,6 @@ from greptimedb_tpu.storage.puffin import PuffinReader, PuffinWriter
 
 BLOB_TYPE = "gtpu-inverted-index-v1"
 DEFAULT_SEGMENT_ROWS = 8192
-_NULL_SENTINEL = "\x00null"  # kept only for wire compat with old callers
 
 
 # ---- predicates ------------------------------------------------------------

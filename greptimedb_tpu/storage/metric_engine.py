@@ -32,8 +32,8 @@ from greptimedb_tpu.datatypes.recordbatch import RecordBatch
 from greptimedb_tpu.datatypes.schema import ColumnSchema, Schema
 from greptimedb_tpu.datatypes.types import DataType, SemanticType
 from greptimedb_tpu.datatypes.vector import DictVector
-from greptimedb_tpu.storage.engine import RegionEngine, RegionRequest, RequestType
-from greptimedb_tpu.storage.region import OP_PUT, ScanData
+from greptimedb_tpu.storage.engine import RegionEngine
+from greptimedb_tpu.storage.region import ScanData
 
 TABLE_COL = "__table"
 LABELS_COL = "__labels"

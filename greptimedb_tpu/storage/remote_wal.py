@@ -30,7 +30,7 @@ import logging
 import struct
 import threading
 import zlib
-from typing import Iterator, Optional
+from typing import Iterator
 
 logger = logging.getLogger(__name__)
 

@@ -17,16 +17,15 @@ from __future__ import annotations
 import json
 import os
 import uuid
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Optional, Sequence
 
 import numpy as np
 import pyarrow as pa
 import pyarrow.parquet as pq
 
-from greptimedb_tpu.datatypes.recordbatch import RecordBatch
-from greptimedb_tpu.datatypes.schema import ColumnSchema, Schema
-from greptimedb_tpu.datatypes.types import DataType, SemanticType
+from greptimedb_tpu.datatypes.schema import Schema
+from greptimedb_tpu.datatypes.types import SemanticType
 from greptimedb_tpu.datatypes.vector import DictVector
 from greptimedb_tpu.objectstore import default_store
 

@@ -29,7 +29,7 @@ import re
 import struct
 import zlib
 from dataclasses import dataclass
-from typing import Iterator, Optional
+from typing import Iterator
 
 
 def _try_native():

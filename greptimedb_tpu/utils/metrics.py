@@ -7,7 +7,6 @@ from __future__ import annotations
 import threading
 import time
 from collections import defaultdict
-from typing import Optional
 
 
 class Counter:
@@ -378,3 +377,11 @@ ROLLUP_SUBSTITUTIONS = REGISTRY.counter(
 EXPIRED_SSTS = REGISTRY.counter(
     "greptimedb_tpu_maintenance_expired_ssts_total",
     "SSTs dropped whole by retention (TTL) expiry")
+
+# ---- static analysis (tools/gtpu_lint.py, tier-1) --------------------------
+
+LINT_FINDINGS = REGISTRY.gauge(
+    "greptimedb_tpu_lint_findings_total",
+    "gtpu-lint findings per checker from the latest lint run "
+    "(allowlisted included) — the machine-checked invariant surface; "
+    "anything unallowed fails tier-1")

@@ -1,0 +1,572 @@
+"""gtpu-lint (greptimedb_tpu/lint + tools/gtpu_lint.py) as a tier-1
+gate.
+
+Two layers: fixture snippets proving each checker fires on known-bad
+code and stays quiet on the near-miss it must NOT flag, and the
+repo-wide run asserting zero unallowed findings (the invariant surface
+itself). The runtime lockdep twin (GTPU_LOCKDEP=1) is exercised in a
+subprocess over the real multithreaded scan-pool + admission path and
+must observe an acyclic lock order.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from greptimedb_tpu.lint import (
+    AllowEntry,
+    Repo,
+    SourceFile,
+    apply_allowlist,
+    load_repo,
+    run_checkers,
+)
+from greptimedb_tpu.lint import lockdep as rt_lockdep
+from greptimedb_tpu.lint.deadcode import check as deadcode_check
+from greptimedb_tpu.lint.fault_seam import check as fault_seam_check
+from greptimedb_tpu.lint.jax_imports import check as jax_import_check
+from greptimedb_tpu.lint.lockgraph import check as lockdep_check
+from greptimedb_tpu.lint.tracer import check as tracer_check
+from greptimedb_tpu.lint.typed_errors import check as typed_error_check
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def fixture_repo(*files) -> Repo:
+    """Repo of (path, source) fixtures; root='' disables allowlist and
+    the import-the-live-process checkers."""
+    return Repo(root="", files=[SourceFile.from_text(p, s)
+                                for p, s in files])
+
+
+# ---- fault-seam -------------------------------------------------------------
+
+
+def test_fault_seam_fires_on_raw_io():
+    repo = fixture_repo(("greptimedb_tpu/storage/foo.py", """
+def save(path, data):
+    with open(path, "wb") as f:
+        f.write(data)
+"""))
+    found = fault_seam_check(repo)
+    assert len(found) == 1 and "open()" in found[0].message
+
+
+def test_fault_seam_quiet_in_seam_module_and_out_of_scope():
+    # the module fires the registry itself -> it IS the seam
+    seam = ("greptimedb_tpu/storage/bar.py", """
+from greptimedb_tpu.fault import FAULTS
+
+def append(path, blob):
+    FAULTS.fire("wal.append")
+    with open(path, "ab") as f:
+        f.write(blob)
+""")
+    # same raw I/O outside the storage plane is not this checker's beat
+    elsewhere = ("greptimedb_tpu/servers/baz.py", """
+def dump(path, data):
+    with open(path, "w") as f:
+        f.write(data)
+""")
+    assert fault_seam_check(fixture_repo(seam, elsewhere)) == []
+
+
+def test_fault_seam_quiet_in_seam_subclass():
+    base = ("greptimedb_tpu/objectstore/base.py", """
+from greptimedb_tpu.fault import FAULTS
+
+class ObjStoreBase:
+    def read(self, key):
+        FAULTS.fire("objectstore.read")
+        return self._read_impl(key)
+""")
+    backend = ("greptimedb_tpu/objectstore/mys3.py", """
+import urllib.request
+
+from greptimedb_tpu.objectstore.base import ObjStoreBase
+
+class MyS3(ObjStoreBase):
+    def _read_impl(self, key):
+        with urllib.request.urlopen(key) as r:
+            return r.read()
+""")
+    assert fault_seam_check(fixture_repo(base, backend)) == []
+
+
+# ---- jax-import -------------------------------------------------------------
+
+
+def test_jax_import_fires_on_toplevel_jax_in_storage():
+    repo = fixture_repo(("greptimedb_tpu/storage/kern.py", """
+import jax
+
+def f(x):
+    return jax.numpy.sum(x)
+"""))
+    found = jax_import_check(repo)
+    assert any("top-level imports jax" in f.message for f in found)
+
+
+def test_jax_import_quiet_on_lazy_import():
+    repo = fixture_repo(("greptimedb_tpu/storage/kern.py", """
+def f(x):
+    import jax.numpy as jnp
+
+    return jnp.sum(x)
+"""))
+    assert jax_import_check(repo) == []
+
+
+def test_jax_import_walks_reachability_from_datanode_entry():
+    entry = ("greptimedb_tpu/cluster/datanode_main.py", """
+def main():
+    from greptimedb_tpu.helper import serve
+
+    serve()
+""")
+    helper = ("greptimedb_tpu/helper.py", """
+import jax
+
+def serve():
+    pass
+""")
+    found = jax_import_check(fixture_repo(entry, helper))
+    assert any("reachable from storage-only entry" in f.message
+               and f.path == "greptimedb_tpu/helper.py" for f in found)
+    # near-miss: the helper imports jax lazily -> clean
+    helper_lazy = ("greptimedb_tpu/helper.py", """
+def serve():
+    import jax
+""")
+    assert jax_import_check(fixture_repo(entry, helper_lazy)) == []
+
+
+# ---- tracer -----------------------------------------------------------------
+
+TRACED_BAD_IF = ("greptimedb_tpu/ops/k.py", """
+import jax
+
+@jax.jit
+def f(x):
+    if x > 0:
+        return x
+    return -x
+""")
+
+TRACED_STATIC_OK = ("greptimedb_tpu/ops/k.py", """
+import functools
+
+import jax
+
+@functools.partial(jax.jit, static_argnames=("mode",))
+def f(x, mode="sum"):
+    squeeze = x.ndim == 1
+    if squeeze:
+        x = x[None, :]
+    if mode == "sum":
+        return x.sum()
+    if x.shape[0] > 4:
+        return x[:4].sum()
+    return x.mean()
+""")
+
+
+def test_tracer_fires_on_python_branch_over_traced_value():
+    found = tracer_check(fixture_repo(TRACED_BAD_IF))
+    assert len(found) == 1 and "Python if" in found[0].message
+
+
+def test_tracer_quiet_on_static_specialization():
+    assert tracer_check(fixture_repo(TRACED_STATIC_OK)) == []
+
+
+def test_tracer_fires_on_host_calls_and_item():
+    repo = fixture_repo(("greptimedb_tpu/ops/k.py", """
+import time
+
+import jax
+
+@jax.jit
+def f(x):
+    t = time.time()
+    v = x.sum().item()
+    return v + t
+"""))
+    msgs = [f.message for f in tracer_check(repo)]
+    assert any("time.time" in m for m in msgs)
+    assert any(".item()" in m for m in msgs)
+
+
+def test_tracer_donation_reuse_fires_and_rebind_is_clean():
+    bad = ("greptimedb_tpu/query/k.py", """
+import jax
+
+def _step(acc, x):
+    return acc + x
+
+fold = jax.jit(_step, donate_argnums=(0,))
+
+def run(acc, xs):
+    out = fold(acc, xs[0])
+    return acc + out
+""")
+    found = tracer_check(fixture_repo(bad))
+    assert any("donated" in f.message for f in found)
+    good = ("greptimedb_tpu/query/k.py", """
+import jax
+
+def _step(acc, x):
+    return acc + x
+
+fold = jax.jit(_step, donate_argnums=(0,))
+
+def run(acc, xs):
+    for x in xs:
+        acc = fold(acc, x)
+    return acc
+""")
+    assert not [f for f in tracer_check(fixture_repo(good))
+                if "donated" in f.message]
+    # mutually exclusive If arms are not a reuse: the donating call
+    # returns from one branch, the read lives in the fallback
+    branches = ("greptimedb_tpu/query/k.py", """
+import jax
+
+def _step(acc, x):
+    return acc + x
+
+fold = jax.jit(_step, donate_argnums=(0,))
+
+def run(acc, xs, cold):
+    if cold:
+        return fold(acc, xs[0])
+    return acc + 1
+""")
+    assert not [f for f in tracer_check(fixture_repo(branches))
+                if "donated" in f.message]
+
+
+# ---- typed-error ------------------------------------------------------------
+
+
+def test_typed_error_fires_on_broad_except():
+    repo = fixture_repo(("greptimedb_tpu/servers/h.py", """
+def handle(self, req):
+    try:
+        return self.engine.execute(req)
+    except Exception as e:
+        return self.send(400, str(e))
+"""))
+    found = typed_error_check(repo)
+    assert len(found) == 1 and "broad `except Exception`" in found[0].message
+
+
+def test_typed_error_quiet_with_typed_branch_or_reraise():
+    ok = ("greptimedb_tpu/servers/h.py", """
+from greptimedb_tpu.fault import Unavailable
+
+def handle(self, req):
+    try:
+        return self.engine.execute(req)
+    except Unavailable as e:
+        return self.send(503, str(e))
+    except Exception as e:
+        return self.send(400, str(e))
+
+def passthrough(self, req):
+    try:
+        return self.engine.execute(req)
+    except Exception:
+        self.log()
+        raise
+""")
+    assert typed_error_check(fixture_repo(ok)) == []
+
+
+def test_typed_error_fires_on_bare_except():
+    repo = fixture_repo(("greptimedb_tpu/servers/h.py", """
+def handle(self, req):
+    try:
+        return self.engine.execute(req)
+    except:
+        return None
+"""))
+    assert any("bare `except:`" in f.message
+               for f in typed_error_check(repo))
+
+
+# ---- lockdep (static) -------------------------------------------------------
+
+LOCK_CYCLE = ("greptimedb_tpu/concurrency/pair.py", """
+import threading
+
+_lock_a = threading.Lock()
+_lock_b = threading.Lock()
+
+def fa():
+    with _lock_a:
+        grab_b()
+
+def grab_b():
+    with _lock_b:
+        pass
+
+def fb():
+    with _lock_b:
+        grab_a()
+
+def grab_a():
+    with _lock_a:
+        pass
+""")
+
+
+def test_lockdep_static_finds_cycle():
+    found = lockdep_check(fixture_repo(LOCK_CYCLE))
+    assert any("lock-order cycle" in f.message for f in found)
+
+
+def test_lockdep_static_quiet_on_consistent_order():
+    # B's type is inferred from the constructor call, so the A -> B
+    # edge IS resolved — and a one-directional order is clean
+    ok = ("greptimedb_tpu/concurrency/pair.py", """
+import threading
+
+class B:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def poke(self):
+        with self._lock:
+            pass
+
+class A:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._b = B()
+
+    def do(self):
+        with self._lock:
+            self._b.poke()
+""")
+    from greptimedb_tpu.lint.lockgraph import build_edges
+
+    repo = fixture_repo(ok)
+    edges, _, _ = build_edges(repo)
+    assert ("pair.A._lock", "pair.B._lock") in edges  # edge resolved...
+    assert lockdep_check(repo) == []                  # ...and acyclic
+
+
+def test_lockdep_static_flags_nonreentrant_self_nesting():
+    bad = ("greptimedb_tpu/concurrency/selfdead.py", """
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def do(self):
+        with self._lock:
+            with self._lock:
+                pass
+""")
+    found = lockdep_check(fixture_repo(bad))
+    assert any("self-deadlock" in f.message for f in found)
+
+
+# ---- deadcode ---------------------------------------------------------------
+
+
+def test_deadcode_unused_import_fires_noqa_quiet():
+    bad = ("greptimedb_tpu/x.py", "import os\nimport sys\n\nprint(sys.argv)\n")
+    found = deadcode_check(fixture_repo(bad))
+    assert any("unused import 'os'" in f.message for f in found)
+    ok = ("greptimedb_tpu/x.py",
+          "import os  # noqa: F401 — re-export\nimport sys\n\nprint(sys.argv)\n")
+    assert deadcode_check(fixture_repo(ok)) == []
+
+
+def test_deadcode_unreachable_statement_fires():
+    bad = ("greptimedb_tpu/x.py", """
+def f():
+    return 1
+    print("never")
+""")
+    found = deadcode_check(fixture_repo(bad))
+    assert any("unreachable" in f.message for f in found)
+
+
+def test_deadcode_cross_module_use_keeps_name_alive():
+    a = ("greptimedb_tpu/a.py", "ZQXW_CONST = 7\n")
+    b = ("greptimedb_tpu/b.py",
+         "from greptimedb_tpu.a import ZQXW_CONST\n\nprint(ZQXW_CONST)\n")
+    assert not [f for f in deadcode_check(fixture_repo(a, b))
+                if "ZQXW_CONST" in f.message]
+    alone = fixture_repo(("greptimedb_tpu/a.py", "ZQXW_CONST = 7\n"))
+    assert any("ZQXW_CONST" in f.message for f in deadcode_check(alone))
+
+
+# ---- allowlist mechanics ----------------------------------------------------
+
+
+def test_allowlist_suppresses_and_requires_match():
+    repo = fixture_repo(("greptimedb_tpu/servers/h.py", """
+def handle(self, req):
+    try:
+        return 1
+    except Exception:
+        return None
+"""))
+    found = typed_error_check(repo)
+    assert found and not found[0].allowed
+    entry = AllowEntry(checker="typed-error",
+                       path="greptimedb_tpu/servers/*.py",
+                       match="in handle()", reason="fixture")
+    out = apply_allowlist(found, [entry])
+    assert out[0].allowed and entry.used == 1
+    miss = AllowEntry(checker="typed-error", path="greptimedb_tpu/servers/*.py",
+                      match="in other()", reason="fixture")
+    found2 = typed_error_check(repo)
+    out2 = apply_allowlist(found2, [miss])
+    assert not out2[0].allowed and miss.used == 0
+
+
+# ---- options drift ----------------------------------------------------------
+
+
+def test_options_checker_catches_trailing_drift(tmp_path, monkeypatch):
+    """Extra lines appended to the example config (generated output is
+    a strict prefix) must still count as drift."""
+    from greptimedb_tpu.lint.metrics_options import check_options
+    from greptimedb_tpu.options import example_toml
+
+    cfg = tmp_path / "config"
+    cfg.mkdir()
+    (cfg / "standalone.example.toml").write_text(
+        example_toml() + "# hand-edited note\n")
+    repo = Repo(root=str(tmp_path), files=[])
+    found = check_options(repo)
+    assert any("drifted" in f.message and "unexpected extra line"
+               in f.message for f in found)
+    # byte-identical copy is clean (doc-coverage findings aside)
+    (cfg / "standalone.example.toml").write_text(example_toml())
+    assert not [f for f in check_options(repo) if "drifted" in f.message]
+
+
+# ---- the repo itself --------------------------------------------------------
+
+
+def test_repo_has_zero_unallowed_findings():
+    """The tentpole gate: every checker over the real repo, allowlist
+    applied, nothing unallowed. This is `tools/gtpu_lint.py --all`
+    in-process."""
+    findings = run_checkers(load_repo(REPO_ROOT))
+    bad = [f.render() for f in findings if not f.allowed]
+    assert bad == [], "\n".join(bad)
+    # the escape hatch stays tight: every allow entry earned its keep
+    assert not [f for f in findings if f.checker == "allowlist"]
+
+
+def test_changed_only_filters_to_given_paths():
+    findings = run_checkers(
+        load_repo(REPO_ROOT),
+        changed_only={"greptimedb_tpu/storage/region.py"})
+    assert all(f.path == "greptimedb_tpu/storage/region.py"
+               for f in findings)
+
+
+def test_cli_json_output():
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "tools", "gtpu_lint.py"),
+         "--all", "--json", "--verbose"],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert res.returncode == 0, res.stdout + res.stderr
+    payload = json.loads(res.stdout[res.stdout.index("["):])
+    assert all(f["allowed"] for f in payload)
+    assert {f["checker"] for f in payload} >= {"jax-import", "fault-seam"}
+
+
+# ---- runtime lockdep --------------------------------------------------------
+
+
+def test_runtime_lockdep_reversal_detection():
+    """Unit-level: simulated A->B then B->A nesting is a violation."""
+    rt_lockdep.reset()
+    try:
+        rt_lockdep._on_acquired("a.py:1")
+        rt_lockdep._on_acquired("b.py:2")   # A -> B
+        rt_lockdep._on_released("b.py:2")
+        rt_lockdep._on_released("a.py:1")
+        rt_lockdep.assert_acyclic()         # consistent so far
+        rt_lockdep._on_acquired("b.py:2")
+        rt_lockdep._on_acquired("a.py:1")   # B -> A: reversal
+        rt_lockdep._on_released("a.py:1")
+        rt_lockdep._on_released("b.py:2")
+        with pytest.raises(rt_lockdep.LockOrderViolation):
+            rt_lockdep.assert_acyclic()
+    finally:
+        rt_lockdep.reset()
+
+
+_LOCKDEP_SCRIPT = """
+import tempfile, threading
+import greptimedb_tpu
+from greptimedb_tpu.lint import lockdep
+assert lockdep.enabled(), "GTPU_LOCKDEP=1 did not install"
+
+from greptimedb_tpu.catalog import Catalog, MemoryKv
+from greptimedb_tpu.query import QueryEngine
+from greptimedb_tpu.session import QueryContext
+from greptimedb_tpu.storage.engine import EngineConfig, RegionEngine
+
+with tempfile.TemporaryDirectory() as d:
+    eng = RegionEngine(EngineConfig(data_dir=d, scan_decode_threads=2))
+    qe = QueryEngine(Catalog(MemoryKv()), eng)
+    ctx = QueryContext(db="public")
+    qe.execute_sql("CREATE TABLE t (host STRING, ts TIMESTAMP TIME INDEX,"
+                   " v DOUBLE, PRIMARY KEY(host))", ctx)
+    for start in (1700000000000, 1700000100000):
+        vals = ",".join(f"('h{i % 3}', {start + i}, {i * 0.5})"
+                        for i in range(120))
+        qe.execute_sql(f"INSERT INTO t VALUES {vals}", ctx)
+        qe.execute_sql("ADMIN flush_table('t')", ctx)
+    errs = []
+    def worker():
+        try:
+            for _ in range(4):
+                qe.execute_sql("SELECT host, count(*), avg(v) FROM t"
+                               " GROUP BY host", ctx)
+        except Exception as e:
+            errs.append(e)
+    threads = [threading.Thread(target=worker) for _ in range(6)]
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+    assert not errs, errs
+
+rep = lockdep.assert_acyclic()
+repo_edges = [e for e in rep["edges"]
+              if all("greptimedb_tpu" in s for s in e)]
+assert repo_edges, "no repo lock nesting observed"
+assert any("admission.py" in a for a, b in repo_edges), repo_edges
+print(f"LOCKDEP_EDGES={len(repo_edges)}")
+"""
+
+
+def test_runtime_lockdep_under_scan_pool_and_admission():
+    """GTPU_LOCKDEP=1 over the real multithreaded path: 6 threads of
+    GROUP BY queries through admission slots and the 2-worker scan
+    decode pool; the observed lock nesting must be acyclic and must
+    include the admission controller's lock."""
+    res = subprocess.run(
+        [sys.executable, "-c", _LOCKDEP_SCRIPT],
+        capture_output=True, text=True, timeout=480, cwd=REPO_ROOT,
+        env={**os.environ, "JAX_PLATFORMS": "cpu", "GTPU_LOCKDEP": "1",
+             "GTPU_SLOW_QUERY_MS": "600000"})
+    assert res.returncode == 0, res.stdout + "\n" + res.stderr
+    assert "LOCKDEP_EDGES=" in res.stdout
