@@ -95,6 +95,13 @@ POINTS = frozenset({
     # runs crash a compaction mid-swap and assert the pre-compaction
     # file list stays readable
     "maintenance.job",
+    # per-region group-commit ingest pipeline (storage/group_commit.py):
+    # fired when a leader starts draining the queue (op=drain), before
+    # the WAL append+fsync (op=append), and between the durable append
+    # and the memtable apply (op=apply) — chaos runs kill a leader
+    # mid-drain and assert no acknowledged write is lost and no torn
+    # WAL frame survives; @op targets one phase
+    "ingest.commit",
 })
 
 #: points that cross a process boundary and therefore have a peer: the

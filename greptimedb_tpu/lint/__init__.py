@@ -17,6 +17,9 @@ them over the repo's own AST so the next PR cannot silently regress:
   lockdep       the static lock-acquisition graph across the concurrency
                 plane must stay acyclic (runtime twin: lint.lockdep,
                 GTPU_LOCKDEP=1)
+  blocking      no blocking syscall (sleep/fsync/socket/subprocess)
+                while holding a lock — the group-commit pipeline's
+                fsync-outside-the-region-lock contract, machine-checked
   deadcode      unused imports / unused module-level names / unreachable
                 statements
   metrics       every registered metric is prefixed, documented, charted
@@ -209,6 +212,7 @@ def _import_checkers() -> None:
     # runtime validator, installed at interpreter start under
     # GTPU_LOCKDEP=1) doesn't pay for the static-analysis modules
     from greptimedb_tpu.lint import (  # noqa: F401
+        blocking,
         deadcode,
         fault_seam,
         jax_imports,

@@ -43,6 +43,8 @@ SCOPE_FILES = (
     "greptimedb_tpu/storage/engine.py",
     "greptimedb_tpu/storage/worker.py",
     "greptimedb_tpu/storage/memtable.py",
+    "greptimedb_tpu/storage/wal.py",
+    "greptimedb_tpu/storage/group_commit.py",
     "greptimedb_tpu/query/device_cache.py",
 )
 
@@ -86,16 +88,37 @@ class _Model:
                         for t in node.targets:
                             if isinstance(t, ast.Name):
                                 self.locks[f"{mod}.{t.id}"] = kind
-        # instance locks + attribute types (one pass over all methods)
+        # instance locks + attribute types (one pass over all methods):
+        # `self.x = KnownClass(...)` types x by construction; `self.x =
+        # param` with an annotated parameter (`param: KnownClass`) types
+        # it by declaration — injected collaborators (Region's `wal:
+        # Wal`) resolve the same as constructed ones
         for fid, (f, cls, fn) in self.functions.items():
             if cls is None:
                 continue
             mod = fid.split(":")[0]
-            for node in ast.walk(fn):
-                if not (isinstance(node, ast.Assign)
-                        and isinstance(node.value, ast.Call)):
+            ann = {}
+            for a in fn.args.args + fn.args.kwonlyargs:
+                t = a.annotation
+                if isinstance(t, ast.Constant) and isinstance(t.value, str):
+                    name = t.value.strip('"')
+                elif t is not None:
+                    name = (dotted(t) or "").split(".")[-1]
+                else:
                     continue
-                cn = call_name(node.value) or ""
+                if name in self.classes:
+                    ann[a.arg] = name
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Assign):
+                    continue
+                cn = ""
+                param_cls = None
+                if isinstance(node.value, ast.Call):
+                    cn = call_name(node.value) or ""
+                elif isinstance(node.value, ast.Name):
+                    param_cls = ann.get(node.value.id)
+                else:
+                    continue
                 for t in node.targets:
                     if not (isinstance(t, ast.Attribute)
                             and isinstance(t.value, ast.Name)
@@ -104,7 +127,7 @@ class _Model:
                     kind = LOCK_CTORS.get(cn)
                     if kind:
                         self.locks[f"{mod}.{cls.name}.{t.attr}"] = kind
-                    base = cn.split(".")[-1]
+                    base = param_cls or cn.split(".")[-1]
                     if base in self.classes:
                         self.attr_types[(cls.name, t.attr)] = base
 

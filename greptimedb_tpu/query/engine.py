@@ -1399,6 +1399,9 @@ class QueryEngine:
                 )
         batch = RecordBatch(schema, batch_cols)
         n = self._sharded_write(info, batch, delete=False)
+        from greptimedb_tpu.utils.metrics import INGEST_ROWS
+
+        INGEST_ROWS.inc(n, protocol="sql")
         return QueryResult.of_affected(n)
 
     def _sharded_write(self, info: TableInfo, batch: RecordBatch, delete: bool) -> int:

@@ -460,14 +460,23 @@ class _Handler(BaseHTTPRequestHandler):
     # ---- write protocols ---------------------------------------------------
 
     def _handle_influx_write(self):
-        from greptimedb_tpu.servers.influx import parse_line_protocol, write_points
+        from greptimedb_tpu.servers.influx import (
+            LineProtocolError,
+            write_lines,
+        )
 
         params = self._form_or_query()
         body = getattr(self, "_raw_body", b"") or self._body()
         db = params.get("db") or params.get("bucket") or "public"
         precision = params.get("precision", "ns")
-        points = parse_line_protocol(body.decode())
-        n = write_points(self.query_engine, db, points, precision)
+        try:
+            n = write_lines(self.query_engine, db, body.decode(), precision)
+        except LineProtocolError as e:
+            # typed 4xx naming the bad line numbers: a torn/partial line
+            # from a crashed client must fail loudly, never silently
+            # sink the rest of the batch (_send counts the request)
+            return self._send(400, {"code": 1004, "error": str(e),
+                                    "lines": e.lines})
         self.send_response(204)
         self.send_header("Content-Length", "0")
         self.end_headers()

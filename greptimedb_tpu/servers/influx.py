@@ -5,25 +5,39 @@ operator Inserter auto-create, src/operator/src/insert.rs:112).
 
 Tables are auto-created on first write (tags -> TAG STRING columns, fields
 typed from the first-seen value, `ts` time index); later writes with new
-fields auto-ALTER.
+fields auto-ALTER (all new columns in one schema swap).
+
+Hot path: `write_lines` parses straight into per-table column slabs
+(greptimedb_tpu/ingest.py) — escape-free lines (the overwhelming
+Telegraf/TSBS shape) take a split-based fast lane, escaped/quoted lines
+fall back to the char-walking parser — and lands as one RecordBatch per
+table on the bulk write path. Malformed lines reject the request with a
+typed error naming every bad line NUMBER (a torn half-line from a
+crashed client must 4xx loudly, not vanish with the rest of the batch).
 """
 
 from __future__ import annotations
 
+import math
 from dataclasses import dataclass
 from typing import Optional
 
 import numpy as np
 
-from greptimedb_tpu.catalog.catalog import CatalogError
-from greptimedb_tpu.datatypes import (
-    ColumnSchema, DataType, DictVector, RecordBatch, Schema, SemanticType,
-)
+from greptimedb_tpu.ingest import TableSlab, write_slabs
 from greptimedb_tpu.utils.metrics import INGEST_ROWS
+
+__all__ = ["LineProtocolError", "Point", "parse_line_protocol",
+           "parse_lines_columnar", "write_lines", "write_points"]
 
 
 class LineProtocolError(Exception):
-    pass
+    """Malformed line-protocol input. `lines` carries the 1-based line
+    numbers at fault (the HTTP layer renders them in its 400 body)."""
+
+    def __init__(self, msg: str, lines: Optional[list[int]] = None):
+        super().__init__(msg)
+        self.lines = lines or []
 
 
 @dataclass
@@ -83,7 +97,10 @@ def _parse_line(line: str) -> Point:
     # split into measurement+tags | fields | timestamp on unescaped spaces
     sections = _split_unescaped(line, " ", ", ")
     sections = [s for s in sections if s != ""]
-    if len(sections) < 2:
+    if len(sections) < 2 or len(sections) > 3:
+        # > 3: trailing junk after the timestamp — rejecting matches the
+        # fast/fused lanes (silently dropping sections would make the
+        # lanes diverge on escaped lines)
         raise LineProtocolError(f"malformed line: {line!r}")
     head = sections[0]
     fields_part = sections[1]
@@ -119,9 +136,18 @@ def _parse_field_value(v: str):
         return True
     if v in ("f", "F", "false", "False", "FALSE"):
         return False
-    if v.endswith("i") or v.endswith("u"):
-        return int(v[:-1])
-    return float(v)
+    try:
+        if v.endswith("i") or v.endswith("u"):
+            return int(v[:-1])
+        out = float(v)
+    except ValueError:
+        raise LineProtocolError(f"bad field value {v!r}") from None
+    if not math.isfinite(out):
+        # the wire protocol has no NaN/inf literals — Python's float()
+        # accepting "NaN"/"inf" silently would store poison values a
+        # SUM/AVG then spreads over the whole window
+        raise LineProtocolError(f"non-finite field value {v!r}")
+    return out
 
 
 # precision -> (numerator, denominator) for exact integer ts -> ms
@@ -131,9 +157,333 @@ _PRECISION_TO_MS = {"ns": (1, 1_000_000), "u": (1, 1000), "us": (1, 1000),
                     "h": (3_600_000, 1)}
 
 
-def write_points(query_engine, db: str, points: list[Point],
-                 precision: str = "ns") -> int:
-    """Group points per measurement, auto-create/alter tables, write."""
+_NUM_LEAD = frozenset("0123456789-+.")
+
+
+def _parse_line_fast(line: str):
+    """Escape-free fast lane: plain str.split + an inlined numeric
+    field decode — no char walking, no per-value function call for the
+    overwhelming float case. Lines carrying backslashes or quotes take
+    the full escape-aware parser. Returns
+    (measurement, tags, fields, raw_ts)."""
+    if "\\" in line or '"' in line:
+        p = _parse_line(line)
+        return p.measurement, p.tags, p.fields, p.ts
+    sections = line.split(" ")
+    ns = len(sections)
+    if ns == 3:
+        head, fields_part, ts_part = sections
+        try:
+            ts = int(ts_part)
+        except ValueError:
+            raise LineProtocolError(
+                f"bad timestamp in {line!r}") from None
+    elif ns == 2:
+        head, fields_part = sections
+        ts = None
+    else:
+        # consecutive unescaped spaces (or a lone measurement): re-split
+        # tolerantly, then re-validate
+        sections = [s for s in sections if s]
+        if len(sections) < 2 or len(sections) > 3:
+            raise LineProtocolError(f"malformed line: {line!r}")
+        return _parse_line_fast(" ".join(sections))
+    head_parts = head.split(",")
+    measurement = head_parts[0]
+    if not measurement:
+        raise LineProtocolError(f"missing measurement in {line!r}")
+    tags = []
+    for t in head_parts[1:]:
+        k, sep, v = t.partition("=")
+        if not sep or not k:
+            raise LineProtocolError(f"bad tag {t!r}")
+        tags.append((k, v))
+    fields = []
+    for fkv in fields_part.split(","):
+        k, sep, v = fkv.partition("=")
+        if not sep or not k or not v:
+            raise LineProtocolError(f"bad field {fkv!r}")
+        if v[0] in _NUM_LEAD:
+            try:
+                if v[-1] in "iu":
+                    fv = int(v[:-1])
+                else:
+                    fv = float(v)
+                    if not math.isfinite(fv):
+                        raise LineProtocolError(
+                            f"non-finite field value {v!r}")
+            except ValueError:
+                raise LineProtocolError(
+                    f"bad field value {v!r}") from None
+        else:
+            # bools, quoted strings, and float() spellings like "inf"
+            # that must be rejected with the right message
+            fv = _parse_field_value(v)
+        fields.append((k, fv))
+    return measurement, tags, fields, ts
+
+
+def parse_lines_columnar(text: str, precision: str = "ns",
+                         now_ms: Optional[int] = None
+                         ) -> dict[str, TableSlab]:
+    """Parse a whole request body straight into per-measurement column
+    slabs. ANY malformed line rejects the request with a typed error
+    listing every bad line number — partial/torn lines must never
+    silently drop (or silently take the batch down with them).
+
+    The regular shape (no escapes/quotes, 2-3 space-separated sections
+    — the entire Telegraf/TSBS stream) takes a FUSED lane: split,
+    numeric decode, and column append happen in one pass with no
+    per-line function call and no intermediate (key, value) tuples.
+    Irregular lines fall back to `_parse_line_fast` (which itself falls
+    back to the escape-aware char walker); both lanes produce identical
+    rows — the parse-fuzz suite pins that."""
+    import time as _time
+
+    scale = _PRECISION_TO_MS.get(precision)
+    if scale is None:
+        raise LineProtocolError(f"bad precision {precision!r}")
+    num, den = scale
+    if now_ms is None:
+        now_ms = int(_time.time() * 1000)
+    slabs: dict[str, TableSlab] = {}
+    bad: list[tuple[int, str]] = []
+
+    def slow_lane(line: str, line_no: int) -> None:
+        try:
+            measurement, tags, fields, ts = _parse_line_fast(line)
+        except LineProtocolError as e:
+            bad.append((line_no, str(e)))
+            return
+        slab = slabs.get(measurement)
+        if slab is None:
+            slab = slabs[measurement] = TableSlab()
+        slab.add_row(tags, fields,
+                     now_ms if ts is None else ts * num // den)
+
+    for line_no, raw in enumerate(text.split("\n"), 1):
+        line = raw.strip()
+        if not line or line[0] == "#":
+            continue
+        if "\\" in line or '"' in line:
+            slow_lane(line, line_no)
+            continue
+        sections = line.split(" ")
+        ns = len(sections)
+        if ns == 3:
+            head, fields_part, ts_part = sections
+            try:
+                ts_ms = int(ts_part) * num // den
+            except ValueError:
+                bad.append((line_no, f"bad timestamp in {line!r}"))
+                continue
+        elif ns == 2:
+            head, fields_part = sections
+            ts_ms = now_ms
+        else:
+            slow_lane(line, line_no)  # double spaces / lone measurement
+            continue
+        head_parts = head.split(",")
+        measurement = head_parts[0]
+        if not measurement:
+            bad.append((line_no, f"missing measurement in {line!r}"))
+            continue
+        slab = slabs.get(measurement)
+        if slab is None:
+            slab = slabs[measurement] = TableSlab()
+        r = slab.rows
+        tag_cols = slab.tags
+        field_cols = slab.fields
+        appended = 0
+        nfields = 0
+        err = None
+        for t in head_parts[1:]:
+            k, sep, v = t.partition("=")
+            if not sep or not k:
+                err = f"bad tag {t!r}"
+                break
+            col = tag_cols.get(k)
+            if col is None:
+                col = tag_cols[k] = [None] * r
+            if len(col) == r:
+                col.append(v)
+                appended += 1
+            else:
+                col[-1] = v
+        if err is None:
+            for fkv in fields_part.split(","):
+                k, sep, v = fkv.partition("=")
+                if not sep or not k or not v:
+                    err = f"bad field {fkv!r}"
+                    break
+                if v[0] in _NUM_LEAD:
+                    try:
+                        if v[-1] in "iu":
+                            fv = int(v[:-1])
+                        else:
+                            fv = float(v)
+                            if not math.isfinite(fv):
+                                err = f"non-finite field value {v!r}"
+                                break
+                    except ValueError:
+                        err = f"bad field value {v!r}"
+                        break
+                else:
+                    try:
+                        fv = _parse_field_value(v)
+                    except LineProtocolError as e:
+                        err = str(e)
+                        break
+                nfields += 1
+                col = field_cols.get(k)
+                if col is None:
+                    col = field_cols[k] = [None] * r
+                if len(col) == r:
+                    col.append(fv)
+                    appended += 1
+                else:
+                    col[-1] = fv
+        if err is None and nfields == 0:
+            err = f"no fields in {line!r}"
+        if err is not None:
+            # roll the partial row back out of the slab columns
+            for col in tag_cols.values():
+                if len(col) > r:
+                    col.pop()
+            for col in field_cols.values():
+                if len(col) > r:
+                    col.pop()
+            bad.append((line_no, err))
+            continue
+        slab.ts.append(ts_ms)
+        slab.rows = r + 1
+        if appended != len(tag_cols) + len(field_cols):
+            for col in tag_cols.values():
+                if len(col) != slab.rows:
+                    col.append(None)
+            for col in field_cols.values():
+                if len(col) != slab.rows:
+                    col.append(None)
+    if bad:
+        shown = "; ".join(f"line {n}: {m}" for n, m in bad[:5])
+        more = f" (+{len(bad) - 5} more)" if len(bad) > 5 else ""
+        raise LineProtocolError(
+            f"rejected {len(bad)} bad line(s): {shown}{more}",
+            lines=[n for n, _ in bad])
+    return slabs
+
+
+def _vector_parse(text: str, num: int, den: int, now_ms: int):
+    """Zero-copy columnar lane for the regular single-measurement shape
+    (the entire Telegraf/TSBS stream): rewrite the body's section
+    separators to commas and hand it to Arrow's C CSV reader, then
+    validate + strip the `key=` prefixes and decode values with
+    vectorized kernels — the whole parse runs at memory bandwidth,
+    releases the GIL, and lands directly in dictionary/float columns.
+
+    Returns {measurement: VectorSlab} or None when ANY precondition
+    fails (escapes, quotes, comments, mixed measurements, ragged rows,
+    non-float fields, non-finite values, inconsistent key order) — the
+    Python lanes then re-parse with exact per-line diagnostics. The
+    parity test pins both lanes to identical batches."""
+    if "\\" in text or '"' in text or "#" in text:
+        return None
+    body = text.strip()
+    if not body:
+        return None
+    # single-measurement precheck at C speed BEFORE paying the CSV
+    # parse: every line must open with the first line's measurement (a
+    # typical Telegraf batch mixes cpu/mem/disk... — those bodies must
+    # not pay a full Arrow pass that is guaranteed to be discarded)
+    meas_end = min((body + ",").find(","), (body + " ").find(" "))
+    meas = body[:meas_end]
+    if not meas:
+        return None
+    nl = body.count("\n")
+    if body.count("\n" + meas + ",") + body.count("\n" + meas + " ") != nl:
+        return None
+    import pyarrow as pa
+    from pyarrow import compute as pc
+    from pyarrow import csv as pacsv
+
+    from greptimedb_tpu.datatypes.vector import DictVector
+    from greptimedb_tpu.ingest import VectorSlab
+
+    head = body.split("\n", 1)[0]
+    try:
+        measurement, first_tags, first_fields, first_ts = \
+            _parse_line_fast(head)
+    except LineProtocolError:
+        return None
+    if not first_fields or any(not isinstance(v, float)
+                               for _, v in first_fields):
+        return None  # int/bool/string fields: the Python lanes decode
+    try:
+        table = pacsv.read_csv(
+            pa.BufferReader(body.replace(" ", ",").encode()),
+            read_options=pacsv.ReadOptions(
+                autogenerate_column_names=True),
+            parse_options=pacsv.ParseOptions(delimiter=","))
+    except pa.ArrowInvalid:
+        return None  # ragged rows (mixed shapes / torn lines)
+    ncols = table.num_columns
+    has_ts = first_ts is not None
+    nkv = len(first_tags) + len(first_fields)
+    if ncols != 1 + nkv + (1 if has_ts else 0):
+        return None
+    n = table.num_rows
+    c0 = table.column(0)
+    if not (pa.types.is_string(c0.type)
+            and pc.all(pc.equal(c0, measurement)).as_py()):
+        return None
+    if has_ts:
+        ts_col = table.column(ncols - 1)
+        if not pa.types.is_integer(ts_col.type):
+            return None
+        raw = ts_col.to_numpy(zero_copy_only=False).astype(np.int64)
+        if ts_col.null_count:
+            return None
+        ts = raw * num // den if (num, den) != (1, 1) else raw
+    else:
+        ts = np.full(n, now_ms, dtype=np.int64)
+    tags: dict = {}
+    fields: dict = {}
+    keys = [k for k, _ in first_tags] + [k for k, _ in first_fields]
+    for i, key in enumerate(keys, start=1):
+        col = table.column(i)
+        if not pa.types.is_string(col.type) or col.null_count:
+            return None
+        col = col.combine_chunks()
+        prefix = key + "="
+        if not pc.all(pc.starts_with(col, prefix)).as_py():
+            return None  # key order varies across lines
+        vals = pc.utf8_slice_codeunits(col, start=len(prefix),
+                                       stop=1 << 30)
+        if i <= len(first_tags):
+            d = vals.dictionary_encode()
+            tags[key] = DictVector(
+                d.indices.to_numpy(zero_copy_only=False).astype(
+                    np.int32),
+                d.dictionary.to_numpy(zero_copy_only=False).astype(
+                    object))
+        else:
+            try:
+                f = pc.cast(vals, pa.float64())
+            except pa.ArrowInvalid:
+                return None  # suffixed ints / bools mid-column
+            if f.null_count or not pc.all(pc.is_finite(f)).as_py():
+                # Arrow parses "inf"/"nan" silently — the Python lane
+                # must produce the line-numbered rejection instead
+                return None
+            fields[key] = f.to_numpy(zero_copy_only=False)
+    return {measurement: VectorSlab(n, tags, fields, ts)}
+
+
+def write_lines(query_engine, db: str, text: str,
+                precision: str = "ns") -> int:
+    """The line-protocol front door: columnar parse + bulk write (one
+    RecordBatch per measurement, one partition scatter, group-committed
+    WAL). Raises LineProtocolError (HTTP 400) on any malformed line."""
     import time as _time
 
     from greptimedb_tpu.query.engine import QueryContext
@@ -141,101 +491,36 @@ def write_points(query_engine, db: str, points: list[Point],
     scale = _PRECISION_TO_MS.get(precision)
     if scale is None:
         raise LineProtocolError(f"bad precision {precision!r}")
-    ctx = QueryContext(db=db)
-    by_table: dict[str, list[Point]] = {}
-    for p in points:
-        by_table.setdefault(p.measurement, []).append(p)
-    total = 0
     now_ms = int(_time.time() * 1000)
-    for table_name, pts in by_table.items():
-        info = _ensure_table(query_engine, ctx, table_name, pts)
-        schema = info.schema
-        n = len(pts)
-        tag_names = [c.name for c in schema.tag_columns]
-        field_names = [c.name for c in schema.field_columns]
-        cols: dict = {}
-        for t in tag_names:
-            cols[t] = DictVector.encode(
-                [dict(p.tags).get(t) for p in pts]
-            )
-        num, den = scale
-        ts_vals = np.asarray(
-            [now_ms if p.ts is None else int(p.ts) * num // den for p in pts],
-            dtype=np.int64,
-        )
-        cols[schema.time_index.name] = ts_vals
-        for fn in field_names:
-            c = schema.column(fn)
-            vals = [dict(p.fields).get(fn) for p in pts]
-            if c.dtype.is_float:
-                cols[fn] = np.asarray(
-                    [np.nan if v is None else float(v) for v in vals])
-            elif c.dtype is DataType.BOOL:
-                cols[fn] = np.asarray([bool(v) for v in vals])
-            elif c.dtype.is_string:
-                cols[fn] = DictVector.encode(
-                    [None if v is None else str(v) for v in vals])
-            else:
-                cols[fn] = np.asarray(
-                    [0 if v is None else int(v) for v in vals], dtype=np.int64)
-        batch = RecordBatch(schema, cols)
-        # route through the partition-aware write sharding so line-protocol
-        # and SQL writes agree on row→region placement
-        total += query_engine._sharded_write(info, batch, delete=False)
+    slabs = _vector_parse(text, scale[0], scale[1], now_ms)
+    if slabs is None:
+        slabs = parse_lines_columnar(text, precision, now_ms=now_ms)
+    total = write_slabs(query_engine, QueryContext(db=db), slabs)
     INGEST_ROWS.inc(total, protocol="influxdb")
     return total
 
 
-def _ensure_table(query_engine, ctx, name: str, pts: list[Point]):
-    qe = query_engine
-    tags_seen = list(dict.fromkeys(k for p in pts for k, _ in p.tags))
-    fields_seen: dict[str, object] = {}
-    for p in pts:
-        for k, v in p.fields:
-            fields_seen.setdefault(k, v)
-    try:
-        info = qe._table(name, ctx)
-    except CatalogError:
-        cols = [ColumnSchema(t, DataType.STRING, SemanticType.TAG) for t in tags_seen]
-        cols.append(ColumnSchema("ts", DataType.TIMESTAMP_MILLISECOND,
-                                 SemanticType.TIMESTAMP, nullable=False))
-        for fn, v in fields_seen.items():
-            cols.append(ColumnSchema(fn, _field_type(v), SemanticType.FIELD))
-        schema = Schema(cols)
-        info = qe.catalog.create_table(ctx.db, name, schema, options={},
-                                       if_not_exists=True)
-        for rid in info.region_ids:
-            qe.region_engine.create_region(rid, schema)
-            qe._open_regions.add(rid)
-        return info
-    # auto-ALTER for new field columns (reference insert.rs:112
-    # create_or_alter_tables_on_demand)
-    missing = [fn for fn in fields_seen if fn not in info.schema]
-    missing_tags = [t for t in tags_seen if t not in info.schema]
-    if missing_tags:
-        raise LineProtocolError(
-            f"new tag column(s) {missing_tags} on existing table {name!r} "
-            "are not supported")
-    if missing:
-        from greptimedb_tpu.sql import ast
-        for fn in missing:
-            dt = _field_type(fields_seen[fn])
-            type_name = {"float64": "DOUBLE", "int64": "BIGINT",
-                         "bool": "BOOLEAN", "string": "STRING"}[dt.value]
-            qe.execute_statement(
-                ast.AlterTable(name, "add_column",
-                               column=ast.ColumnDef(fn, type_name)), ctx)
-        info = qe._table(name, ctx)
-    return info
+def write_points(query_engine, db: str, points: list[Point],
+                 precision: str = "ns") -> int:
+    """Point-object write surface (OTLP/OpenTSDB build Points
+    programmatically): funnels into the same columnar bulk path as
+    `write_lines`."""
+    import time as _time
 
+    from greptimedb_tpu.query.engine import QueryContext
 
-def _field_type(v) -> DataType:
-    if isinstance(v, bool):
-        return DataType.BOOL
-    if isinstance(v, int):
-        # stored as FLOAT64: integer columns have no NULL representation in
-        # the columnar store yet, and sparse influx fields need NULLs
-        return DataType.FLOAT64
-    if isinstance(v, str):
-        return DataType.STRING
-    return DataType.FLOAT64
+    scale = _PRECISION_TO_MS.get(precision)
+    if scale is None:
+        raise LineProtocolError(f"bad precision {precision!r}")
+    num, den = scale
+    now_ms = int(_time.time() * 1000)
+    slabs: dict[str, TableSlab] = {}
+    for p in points:
+        slab = slabs.get(p.measurement)
+        if slab is None:
+            slab = slabs[p.measurement] = TableSlab()
+        slab.add_row(p.tags, p.fields,
+                     now_ms if p.ts is None else int(p.ts) * num // den)
+    total = write_slabs(query_engine, QueryContext(db=db), slabs)
+    INGEST_ROWS.inc(total, protocol="influxdb")
+    return total

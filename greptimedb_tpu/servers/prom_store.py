@@ -13,13 +13,7 @@ from __future__ import annotations
 import re
 from collections import defaultdict
 
-import numpy as np
-
 from greptimedb_tpu.catalog.catalog import CatalogError
-from greptimedb_tpu.datatypes.recordbatch import RecordBatch
-from greptimedb_tpu.datatypes.schema import ColumnSchema, Schema
-from greptimedb_tpu.datatypes.types import DataType, SemanticType
-from greptimedb_tpu.datatypes.vector import DictVector
 from greptimedb_tpu.query.engine import QueryContext
 from greptimedb_tpu.utils import protowire as pw
 from greptimedb_tpu.utils import snappy
@@ -69,36 +63,40 @@ def parse_write_request(body: bytes) -> list[tuple[dict, list[tuple[float, int]]
 
 
 def handle_remote_write(query_engine, body: bytes, db: str = "public") -> int:
-    """Decode and ingest a remote-write body. Returns rows written."""
+    """Decode and ingest a remote-write body. Returns rows written.
+
+    Columnar fast path: each decoded series bulk-extends its metric's
+    column slab (a series' samples share ONE label set, so tag columns
+    extend with a repeated value instead of per-sample appends), and
+    each metric table gets one RecordBatch through the partition
+    scatter onto the bulk write path."""
+    from greptimedb_tpu.ingest import TableSlab, ensure_table
+
     series = parse_write_request(body)
     ctx = QueryContext(db=db)
-    # group series by metric name -> rows
-    by_table: dict[str, list[tuple[dict, list]]] = defaultdict(list)
+    slabs: dict[str, TableSlab] = {}
     for labels, samples in series:
-        metric = labels.get("__name__", "unknown_metric")
-        table = _sanitize(metric)
-        by_table[table].append((labels, samples))
+        table = _sanitize(labels.get("__name__", "unknown_metric"))
+        slab = slabs.get(table)
+        if slab is None:
+            slab = slabs[table] = TableSlab()
+        n = len(samples)
+        for k, v in labels.items():
+            if k != "__name__":
+                slab.extend_column("tag", k, [v] * n)
+        slab.extend_column("field", GREPTIME_VALUE,
+                           [value for value, _ in samples])
+        slab.extend_rows([ts for _, ts in samples])
     total = 0
-    for table, entries in by_table.items():
-        tag_names = sorted(
-            {k for labels, _ in entries for k in labels if k != "__name__"}
-        )
-        info = _ensure_table(query_engine, ctx, table, tag_names)
-        schema = info.schema
-        known_tags = [c.name for c in schema.tag_columns]
-        tag_vals: dict[str, list] = {t: [] for t in known_tags}
-        ts_vals: list[int] = []
-        vals: list[float] = []
-        for labels, samples in entries:
-            for value, ts in samples:
-                for t in known_tags:
-                    tag_vals[t].append(labels.get(t))
-                ts_vals.append(ts)
-                vals.append(value)
-        cols: dict = {t: DictVector.encode(v) for t, v in tag_vals.items()}
-        cols[GREPTIME_TIMESTAMP] = np.asarray(ts_vals, dtype=np.int64)
-        cols[GREPTIME_VALUE] = np.asarray(vals, dtype=np.float64)
-        batch = RecordBatch(schema, cols)
+    for table, slab in slabs.items():
+        # label columns create in sorted order (stable table shapes
+        # regardless of series arrival order), via the shared schema
+        # bootstrap every front door uses
+        slab.tags = {k: slab.tags[k] for k in sorted(slab.tags)}
+        info = ensure_table(query_engine, ctx, table, slab,
+                            time_index=GREPTIME_TIMESTAMP,
+                            value_field=GREPTIME_VALUE)
+        batch = slab.to_batch(info.schema)
         total += query_engine._sharded_write(info, batch, delete=False)
     INGEST_ROWS.inc(total)
     return total
@@ -106,32 +104,6 @@ def handle_remote_write(query_engine, body: bytes, db: str = "public") -> int:
 
 def _sanitize(metric: str) -> str:
     return re.sub(r"[^0-9a-zA-Z_]", "_", metric)
-
-
-def _ensure_table(query_engine, ctx, table: str, tag_names: list[str]):
-    qe = query_engine
-    try:
-        info = qe._table(table, ctx)
-        missing = [t for t in tag_names if t not in info.schema.names]
-        if missing:
-            raise ValueError(
-                f"new label(s) {missing} on existing metric table {table!r} "
-                "not supported (create the table with the full label set)"
-            )
-        return info
-    except CatalogError:
-        cols = [ColumnSchema(t, DataType.STRING, SemanticType.TAG) for t in tag_names]
-        cols.append(
-            ColumnSchema(GREPTIME_TIMESTAMP, DataType.TIMESTAMP_MILLISECOND,
-                         SemanticType.TIMESTAMP, nullable=False)
-        )
-        cols.append(ColumnSchema(GREPTIME_VALUE, DataType.FLOAT64, SemanticType.FIELD))
-        info = qe.catalog.create_table(ctx.db, table, Schema(cols), options={},
-                                       if_not_exists=True)
-        for rid in info.region_ids:
-            qe.region_engine.create_region(rid, info.schema)
-            qe._open_regions.add(rid)
-        return info
 
 
 # ---------------------------------------------------------------- read

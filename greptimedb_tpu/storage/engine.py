@@ -91,6 +91,19 @@ class EngineConfig:
     # byte budget for the per-file decoded-part LRU (incremental scan
     # cache: a flush re-decodes only the files it added)
     scan_part_cache_bytes: int = 1 << 30
+    # ---- ingest pipeline ([ingest] options, storage/group_commit.py) ----
+    # per-region group commit: concurrent writers coalesce into one WAL
+    # append + one fsync + one memtable apply; off = the legacy serial
+    # path (WAL+apply under one region-lock hold), kept for bit-for-bit
+    # differential tests
+    ingest_group_commit: bool = True
+    # caps on one drained commit group (ack latency bound)
+    ingest_max_batch_rows: int = 65536
+    ingest_max_batch_bytes: int = 8 << 20
+    # bounded per-region ingest queue; full -> typed Overloaded
+    ingest_queue_depth: int = 512
+    # pipeline the WAL encode of group N+1 under group N's fsync
+    ingest_overlap: bool = True
     # object store backend for SSTs/manifest/index (reference
     # object-store crate; fs|memory|s3, optional LRU read cache)
     object_store: str = "fs"
@@ -183,8 +196,8 @@ class RegionEngine:
         return r
 
     def _apply_scan_config(self, region) -> None:
-        """Push the engine's scan knobs onto a freshly opened region
-        (hasattr-guarded: alternate engines register non-Region
+        """Push the engine's scan + ingest knobs onto a freshly opened
+        region (hasattr-guarded: alternate engines register non-Region
         objects via openers)."""
         for attr, value in (
                 ("scan_cache_entries", self.config.scan_cache_entries),
@@ -192,6 +205,16 @@ class RegionEngine:
                 ("part_cache_budget", self.config.scan_part_cache_bytes)):
             if hasattr(region, attr):
                 setattr(region, attr, value)
+        if self.config.ingest_group_commit \
+                and hasattr(region, "group_reserve"):
+            from greptimedb_tpu.storage.group_commit import GroupCommitter
+
+            region.committer = GroupCommitter(
+                region,
+                max_batch_rows=self.config.ingest_max_batch_rows,
+                max_batch_bytes=self.config.ingest_max_batch_bytes,
+                queue_depth=self.config.ingest_queue_depth,
+                overlap=self.config.ingest_overlap)
 
     # ---- handle_request (reference region_server.rs:120) -------------------
 

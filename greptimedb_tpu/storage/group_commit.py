@@ -1,0 +1,206 @@
+"""Per-region group commit: the ingest pipeline's durability stage.
+
+Every front door (SQL INSERT, Influx line protocol, Prometheus
+remote-write, OTLP) decodes into RecordBatches and lands here through
+``Region.write_many``. Concurrent writers enqueue into a bounded
+per-region queue; the first writer in becomes the commit LEADER and
+drains the queue up to a row/byte cap into ONE WAL append + ONE fsync +
+ONE memtable apply, while followers wait on their commit future
+(reference: the mito2 region worker drains ≤64 requests per cycle into
+one ``RegionWriteCtx`` WAL write, worker.rs:576-650 — here leadership
+is writer-elected instead of a dedicated actor thread, so an idle
+region costs no thread).
+
+Pipelining: with ``[ingest] overlap`` on, up to TWO leaders run
+concurrently — sequences are reserved under the region lock (fast),
+the Arrow-IPC/LZ4 WAL encode runs outside every lock, and a commit
+ticket orders the appends so the WAL file stays in sequence order.
+While group N's fsync is in flight, group N+1 is already encoding: the
+fsync latency amortizes across ALL queued writers instead of gating
+each one (``Region.group_commit`` holds no region lock across the
+fsync — the blocking-call-in-lock lint checker guards this).
+
+Backpressure: a full queue raises the typed ``Overloaded`` the
+admission plane already maps to HTTP 503 / MySQL 1040 — protocol
+ingest rides the same degradation contract as queries instead of
+piling unbounded memory.
+
+Failure: any error between reserve and apply fails ONLY the drained
+group's writers (never acknowledged), burns the reserved sequences (a
+WAL gap, which replay tolerates), and advances the commit ticket so
+later groups proceed. A crash mid-commit leaves at most a torn WAL
+tail that replay truncates — nothing in the group was acknowledged.
+Chaos hooks: ``ingest.commit`` fires at op=drain/append/apply.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+
+from greptimedb_tpu.fault import FAULTS
+from greptimedb_tpu.utils.metrics import (
+    INGEST_BATCH_SIZE,
+    INGEST_GROUP_COMMIT_EVENTS,
+)
+
+
+class _Pending:
+    """One writer's queued mutation group."""
+
+    __slots__ = ("items", "rows", "nbytes", "error", "event")
+
+    def __init__(self, items: list, rows: int, nbytes: int):
+        self.items = items
+        self.rows = rows
+        self.nbytes = nbytes
+        self.error = None
+        self.event = threading.Event()
+
+    @property
+    def done(self) -> bool:
+        return self.event.is_set()
+
+
+def _batch_nbytes(batch) -> int:
+    """Host-byte estimate for the queue's byte cap (cheap, not exact:
+    dictionary values undercount like the scan caches do)."""
+    n = 0
+    for col in batch.columns.values():
+        arr = getattr(col, "codes", col)
+        nb = getattr(arr, "nbytes", None)
+        n += int(nb) if nb is not None else 8 * batch.num_rows
+    return n
+
+
+class GroupCommitter:
+    def __init__(self, region, max_batch_rows: int = 65536,
+                 max_batch_bytes: int = 8 << 20, queue_depth: int = 512,
+                 overlap: bool = True):
+        self.region = region
+        self.max_batch_rows = max(1, int(max_batch_rows))
+        self.max_batch_bytes = max(1, int(max_batch_bytes))
+        self.queue_depth = max(1, int(queue_depth))
+        # up to 2 concurrent leaders when overlapping: N+1 encodes while
+        # N fsyncs; the region's commit ticket keeps the WAL in order
+        self._leaders = threading.Semaphore(2 if overlap else 1)
+        self._cv = threading.Condition()
+        self._queue: deque = deque()
+
+    # ---- the write surface (Region.write_many delegates here) --------------
+
+    def write_many(self, items: list) -> list[int]:
+        counts = [b.num_rows for b, _ in items]
+        live = [(b, op) for b, op in items if b.num_rows]
+        if not live:
+            return counts
+        rows = sum(b.num_rows for b, _ in live)
+        pend = _Pending(live, rows,
+                        sum(_batch_nbytes(b) for b, _ in live))
+        with self._cv:
+            if len(self._queue) >= self.queue_depth:
+                INGEST_GROUP_COMMIT_EVENTS.inc(event="overflow")
+                # typed rejection riding the admission plane's contract
+                # (HTTP 503 / MySQL 1040 / retryable Unavailable) — the
+                # lazy import keeps the storage plane's import closure
+                # free of the frontend package at module load
+                from greptimedb_tpu.concurrency.admission import Overloaded
+
+                raise Overloaded(
+                    f"region {self.region.region_id} ingest queue full "
+                    f"({len(self._queue)} groups waiting)")
+            self._queue.append(pend)
+        while True:
+            # leadership is opportunistic: whoever finds a free leader
+            # slot drains for everyone; the rest sleep on _cv — a
+            # finishing leader notifies under it (after resolving its
+            # group and after releasing the slot), so a queued writer
+            # both learns its result and picks up leadership promptly
+            # instead of polling. The timeout is a belt-and-braces
+            # re-check, not the wakeup mechanism.
+            if self._leaders.acquire(blocking=False):
+                try:
+                    self._lead(pend)
+                finally:
+                    self._leaders.release()
+                    with self._cv:
+                        self._cv.notify_all()
+            if pend.done:
+                break
+            with self._cv:
+                if pend.done:
+                    break
+                self._cv.wait(timeout=0.05)
+        if pend.error is not None:
+            raise pend.error
+        return counts
+
+    # ---- leader ------------------------------------------------------------
+
+    def _take_locked(self) -> list:
+        """Pop a cap-bounded prefix of the queue (caller holds _cv).
+        Always takes at least one group so an oversized single batch
+        still commits."""
+        take: list = []
+        rows = nbytes = 0
+        while self._queue:
+            p = self._queue[0]
+            if take and (rows + p.rows > self.max_batch_rows
+                         or nbytes + p.nbytes > self.max_batch_bytes):
+                break
+            take.append(self._queue.popleft())
+            rows += p.rows
+            nbytes += p.nbytes
+        return take
+
+    def _lead(self, pend: _Pending) -> None:
+        region = self.region
+        while not pend.done:
+            with self._cv:
+                take = self._take_locked()
+            if not take:
+                # queue drained — `pend` is either resolved or inside
+                # another leader's in-flight group; wait it out
+                return
+            rows = sum(p.rows for p in take)
+            try:
+                FAULTS.fire("ingest.commit", op="drain",
+                            region=str(region.region_id))
+                self._commit(take)
+            except BaseException as e:  # noqa: BLE001 — delivered to writers
+                for p in take:
+                    p.error = e
+                    p.event.set()
+                with self._cv:
+                    self._cv.notify_all()
+                continue
+            INGEST_GROUP_COMMIT_EVENTS.inc(event="lead")
+            if len(take) > 1:
+                INGEST_GROUP_COMMIT_EVENTS.inc(
+                    float(len(take) - 1), event="follow")
+            INGEST_BATCH_SIZE.observe(float(rows))
+            for p in take:
+                p.event.set()
+            with self._cv:
+                self._cv.notify_all()
+
+    def _commit(self, take: list) -> None:
+        """One drained group → reserve, encode, ticket-ordered
+        append+fsync, memtable apply (see Region.group_commit)."""
+        region = self.region
+        live = [item for p in take for item in p.items]
+        ticket, entries = region.group_reserve(live)
+        entered = False
+        try:
+            # WAL encode outside every lock: this is the stage that
+            # overlaps the previous group's fsync
+            encode = getattr(region.wal, "encode_entries", None)
+            blob = None if encode is None else \
+                encode(region.region_id, entries)
+            entered = True
+            region.group_commit(ticket, entries, blob=blob)
+        finally:
+            if not entered:
+                # encode failed before the commit owned the ticket —
+                # release it so later groups don't wait forever
+                region.group_abort(ticket)

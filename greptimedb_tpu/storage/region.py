@@ -231,6 +231,32 @@ class Region:
         self.part_cache_budget = 1 << 30  # overridden from EngineConfig
         # SST decode fan-out cap; 0 = auto (storage/scan_pool.py)
         self.decode_threads = 0
+        # ---- group-commit ingest pipeline (storage/group_commit.py) ----
+        # attached by the engine when [ingest] group_commit is on; None
+        # = the legacy serial write path (bit-for-bit differential tests
+        # compare the two)
+        self.committer = None
+        # commit tickets order the WAL appends of concurrent group
+        # commits: sequences are reserved under the region lock (fast),
+        # but the append+fsync runs OUTSIDE it — the ticket turn keeps
+        # the WAL file in sequence order anyway
+        self._commit_tickets = itertools.count()
+        self._wal_turn = 0
+        self._wal_turn_cv = threading.Condition()
+        # tickets reserved but not yet applied: flush/drop must wait for
+        # these — a flush between reserve and apply would record a
+        # flushed_seq past rows that are not yet in the memtable and
+        # lose them on replay (acked-write loss)
+        self._inflight_commits: set = set()
+        self._commit_idle = threading.Condition(self._lock)
+        # tickets abandoned before their turn (interrupt mid-wait): the
+        # turn counter skips them instead of wedging every later commit
+        self._dead_tickets: set = set()
+        # flush/drop waiting for the commit pipeline to drain: while
+        # nonzero, group_reserve holds new reservations back — without
+        # the gate, overlapped commits under sustained ingest keep the
+        # in-flight set nonempty and the quiesce would starve
+        self._quiesce_waiters = 0
 
     # ---- lifecycle ---------------------------------------------------------
 
@@ -266,6 +292,10 @@ class Region:
     def drop(self) -> None:
         with self._lock:
             self.dropped = True
+            # in-flight group commits may still be appending to the WAL
+            # this is about to delete; `dropped` blocks new reservations
+            # and fails the in-flight ones at apply time
+            self._quiesce_commits_locked()
             self._drain_purge(force=True)
             self.wal.delete_region(self.region_id)
             for fid in list(self.files):
@@ -668,7 +698,21 @@ class Region:
         """Apply several mutations with ONE WAL group commit (reference
         RegionWriteCtx batches all of a worker cycle's mutations into one
         WalWriter write, region_write_ctx.rs:92-144). Returns per-item
-        affected rows."""
+        affected rows.
+
+        With the [ingest] group-commit pipeline attached, concurrent
+        callers coalesce through the per-region bounded queue (one WAL
+        append + one fsync + one memtable apply per drained group, the
+        fsync OUTSIDE the region lock); otherwise the legacy serial path
+        below runs — preserved bit-for-bit for differential tests."""
+        if self.committer is not None:
+            return self.committer.write_many(items)
+        return self.write_many_serial(items)
+
+    def write_many_serial(self, items: list[tuple[RecordBatch, int]]
+                          ) -> list[int]:
+        """The pre-pipeline write path: WAL append (and its fsync) and
+        memtable apply under one region-lock hold."""
         counts = [b.num_rows for b, _ in items]
         live = [(b, op) for b, op in items if b.num_rows]
         if not live:
@@ -691,11 +735,127 @@ class Region:
             self.data_version += 1
         return counts
 
+    # ---- group-commit hooks (storage/group_commit.py drives these) ---------
+
+    def group_reserve(self, live: list[tuple[RecordBatch, int]]
+                      ) -> tuple[int, list]:
+        """Reserve the group's WAL sequences and a commit ticket under
+        the region lock — metadata only, the slow encode/fsync work runs
+        outside. Returns (ticket, [(seq, op_type, batch), ...])."""
+        with self._lock:
+            # a pending flush/DROP quiesce has priority: new
+            # reservations wait so the in-flight set can actually drain
+            while self._quiesce_waiters:
+                self._commit_idle.wait(timeout=1.0)
+            if self.dropped:
+                raise RegionDroppedError(
+                    f"region {self.region_id} is dropped")
+            seq = self.next_seq
+            entries = []
+            for batch, op_type in live:
+                entries.append((seq, op_type, batch))
+                seq += batch.num_rows
+            self.next_seq = seq
+            ticket = next(self._commit_tickets)
+            self._inflight_commits.add(ticket)
+            return ticket, entries
+
+    def group_commit(self, ticket: int, entries: list,
+                     blob: Optional[bytes] = None) -> None:
+        """Ticket-ordered durable commit: WAL append + fsync OUTSIDE the
+        region lock (readers and other regions' writers never wait on
+        the disk), then the memtable apply under it. `blob` is the
+        pre-encoded WAL frame blob (encoded outside every lock, so the
+        next group's encode overlaps this one's fsync); None falls back
+        to the backend's own encode (remote WAL)."""
+        from greptimedb_tpu.fault import FAULTS
+        from greptimedb_tpu.utils.metrics import INGEST_WAL_FSYNC_SECONDS
+
+        try:
+            with self._wal_turn_cv:
+                while self._wal_turn != ticket:
+                    self._wal_turn_cv.wait()
+            # sole owner of this region's WAL tail until the turn
+            # advances; a crash in here leaves at most a torn tail that
+            # replay truncates (nothing in the group was acknowledged)
+            FAULTS.fire("ingest.commit", op="append",
+                        region=str(self.region_id))
+            with INGEST_WAL_FSYNC_SECONDS.time():
+                if blob is not None:
+                    self.wal.append_blob(self.region_id, blob)
+                else:
+                    self.wal.append_many(self.region_id, entries)
+            FAULTS.fire("ingest.commit", op="apply",
+                        region=str(self.region_id))
+            with self._lock:
+                dropped = self.dropped
+                if not dropped:
+                    for s, op_type, batch in entries:
+                        self.memtable.write(batch, s, op_type)
+                    self.data_version += 1
+            if dropped:
+                # the rows are durable in a WAL that drop() is about to
+                # delete — the write must not be acknowledged
+                raise RegionDroppedError(
+                    f"region {self.region_id} is dropped")
+        finally:
+            self._finish_commit(ticket)
+
+    def group_abort(self, ticket: int) -> None:
+        """Release a reserved ticket whose commit never started (encode
+        failed, fault fired pre-append). Waits its WAL turn so the turn
+        counter stays strictly sequential; the reserved sequences become
+        a gap, which replay tolerates. The finally mirrors
+        group_commit's: an interrupt landing mid-wait must still retire
+        the ticket (as a dead one) or every later commit wedges."""
+        try:
+            with self._wal_turn_cv:
+                while self._wal_turn != ticket:
+                    self._wal_turn_cv.wait()
+        finally:
+            self._finish_commit(ticket)
+
+    def _finish_commit(self, ticket: int) -> None:
+        with self._wal_turn_cv:
+            if self._wal_turn == ticket:
+                self._wal_turn = ticket + 1
+                while self._wal_turn in self._dead_tickets:
+                    self._dead_tickets.discard(self._wal_turn)
+                    self._wal_turn += 1
+                self._wal_turn_cv.notify_all()
+            elif self._wal_turn < ticket:
+                # abandoned before its turn came up (interrupt during
+                # the wait): let the predecessor's advance skip it
+                self._dead_tickets.add(ticket)
+        with self._lock:
+            self._inflight_commits.discard(ticket)
+            if not self._inflight_commits:
+                self._commit_idle.notify_all()
+
+    def _quiesce_commits_locked(self) -> None:
+        """Wait (holding self._lock, released during the wait) until no
+        group commit sits between reserve and apply: flush would record
+        a flushed_seq past the reserved-but-unapplied rows and lose them
+        on replay; drop would delete the WAL a commit is appending to.
+        While waiting, group_reserve holds NEW reservations back (the
+        _quiesce_waiters gate), so the drain is bounded by the already-
+        reserved groups' fsyncs even under sustained overlapped ingest —
+        commits always terminate via their finally."""
+        self._quiesce_waiters += 1
+        try:
+            while self._inflight_commits:
+                self._commit_idle.wait(timeout=5.0)
+        finally:
+            self._quiesce_waiters -= 1
+            if not self._quiesce_waiters:
+                self._commit_idle.notify_all()
+
     # ---- flush -------------------------------------------------------------
 
     def flush(self) -> Optional[FileMeta]:
         """Memtable → sorted SST; manifest edit; WAL truncate."""
         with self._lock:
+            self._quiesce_commits_locked()
             return self._flush_locked()
 
     def _flush_locked(self) -> Optional[FileMeta]:

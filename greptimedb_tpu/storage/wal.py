@@ -128,14 +128,30 @@ class Wal:
         mito2/src/wal.rs:133-150)."""
         if not entries:
             return
-        segno, f = self._writer(region_id)
+        self.append_blob(region_id, self.encode_entries(region_id, entries))
+
+    @staticmethod
+    def encode_entries(region_id: int,
+                       entries: list[tuple[int, int, "RecordBatch"]]
+                       ) -> bytes:
+        """Frame (seq, op_type, batch) entries into the CRC'd append
+        blob WITHOUT touching file state. Pure CPU (Arrow IPC + LZ4), so
+        the group-commit pipeline runs it outside every lock: batch N+1
+        encodes while batch N's fsync is still in flight."""
         parts = []
         for seq, op_type, batch in entries:
             payload = _encode_batch(batch)
             parts.append(_HEADER.pack(len(payload), zlib.crc32(payload),
                                       region_id, seq, op_type))
             parts.append(payload)
-        blob = b"".join(parts)
+        return b"".join(parts)
+
+    def append_blob(self, region_id: int, blob: bytes) -> None:
+        """Durably append a pre-encoded frame blob: one write, one
+        fsync, crash-consistent (a partial tail is truncated before the
+        error surfaces). Callers serialize per region — group commit by
+        ticket order, the legacy path under the region lock."""
+        _segno, f = self._writer(region_id)
 
         def sink(mangled: bytes) -> None:
             f.write(mangled)

@@ -14,7 +14,6 @@ import threading
 import time
 from collections import defaultdict
 
-import numpy as np
 
 logger = logging.getLogger(__name__)
 
@@ -28,9 +27,8 @@ def _sanitize(name: str) -> str:
 
 def write_metrics_once(query_engine, db: str = "greptime_metrics") -> int:
     """One scrape: REGISTRY samples -> rows. Returns rows written."""
-    from greptimedb_tpu.datatypes import DictVector, RecordBatch
+    from greptimedb_tpu.ingest import TableSlab, ensure_table
     from greptimedb_tpu.query.engine import QueryContext
-    from greptimedb_tpu.servers.prom_store import _ensure_table
     from greptimedb_tpu.utils.metrics import REGISTRY
 
     query_engine.execute_one(f"CREATE DATABASE IF NOT EXISTS {db}")
@@ -44,20 +42,17 @@ def write_metrics_once(query_engine, db: str = "greptime_metrics") -> int:
         # one broken metric table (e.g. a label key that appeared after
         # creation) must not stop the rest of the scrape — skip it loudly
         try:
-            tag_names = sorted({k for labels, _ in entries for k in labels})
-            info = _ensure_table(query_engine, ctx, table, tag_names)
-            known = [c.name for c in info.schema.tag_columns]
-            cols: dict = {
-                t: DictVector.encode([str(labels.get(t)) if labels.get(t)
-                                      is not None else None
-                                      for labels, _ in entries])
-                for t in known
-            }
-            cols[GREPTIME_TIMESTAMP] = np.full(len(entries), now,
-                                               dtype=np.int64)
-            cols[GREPTIME_VALUE] = np.asarray([v for _, v in entries],
-                                              dtype=np.float64)
-            batch = RecordBatch(info.schema, cols)
+            slab = TableSlab()
+            for labels, v in entries:
+                slab.add_row(
+                    [(k, None if val is None else str(val))
+                     for k, val in labels.items()],
+                    [(GREPTIME_VALUE, v)], now)
+            slab.tags = {k: slab.tags[k] for k in sorted(slab.tags)}
+            info = ensure_table(query_engine, ctx, table, slab,
+                                time_index=GREPTIME_TIMESTAMP,
+                                value_field=GREPTIME_VALUE)
+            batch = slab.to_batch(info.schema)
             total += query_engine._sharded_write(info, batch, delete=False)
         except Exception:  # noqa: BLE001
             logger.warning("self-scrape: skipping metric table %r",

@@ -73,9 +73,13 @@ class Gauge(Counter):
 class Histogram:
     BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 60.0)
 
-    def __init__(self, name: str, help_: str):
+    def __init__(self, name: str, help_: str, buckets=None):
         self.name = name
         self.help = help_
+        if buckets is not None:
+            # per-instance bounds for non-latency shapes (batch sizes,
+            # byte counts) — the default decade grid is seconds-tuned
+            self.BUCKETS = tuple(sorted(buckets))
         self._buckets: dict[tuple, list[int]] = {}
         self._sum: dict[tuple, float] = defaultdict(float)
         self._count: dict[tuple, int] = defaultdict(int)
@@ -179,8 +183,8 @@ class Registry:
             self._metrics.append(m)
         return m
 
-    def histogram(self, name, help_="") -> Histogram:
-        m = Histogram(name, help_)
+    def histogram(self, name, help_="", buckets=None) -> Histogram:
+        m = Histogram(name, help_, buckets=buckets)
         with self._lock:
             self._metrics.append(m)
         return m
@@ -233,6 +237,26 @@ QUERY_DURATION = REGISTRY.histogram("greptimedb_tpu_query_duration_seconds",
                                     "Query execution latency")
 INGEST_ROWS = REGISTRY.counter("greptimedb_tpu_ingest_rows_total",
                                "Rows ingested by protocol")
+
+# ingest pipeline (storage/group_commit.py + the protocol front doors):
+# every front door lands on the bulk path through a per-region group
+# commit — these series prove the fsync amortization is real (batch
+# size > 1 under concurrency) and show where admission pressure lands
+INGEST_BATCH_SIZE = REGISTRY.histogram(
+    "greptimedb_tpu_ingest_batch_size",
+    "Rows per group-committed WAL batch (one fsync each; sizes > the "
+    "per-writer batch mean concurrent writers were coalesced)",
+    buckets=(1, 8, 64, 256, 1024, 4096, 16384, 65536, 262144))
+INGEST_GROUP_COMMIT_EVENTS = REGISTRY.counter(
+    "greptimedb_tpu_ingest_group_commit_events_total",
+    "Group-commit events by kind (lead = a writer drained the queue and "
+    "paid the fsync, follow = a writer rode another's commit, overflow "
+    "= the bounded ingest queue rejected a writer with typed "
+    "Overloaded)")
+INGEST_WAL_FSYNC_SECONDS = REGISTRY.histogram(
+    "greptimedb_tpu_ingest_wal_fsync_seconds",
+    "WAL append+fsync wall time per group commit (the durability "
+    "boundary every queued writer amortizes over)")
 STMT_DURATION = REGISTRY.histogram(
     "greptimedb_tpu_statement_duration_seconds",
     "Statement execution latency by statement kind")

@@ -160,9 +160,13 @@ def _record(kind, query, db, dur_ms, thr, w, started, sink) -> None:
     SLOW_QUERIES.inc(kind=kind)
     import logging
 
+    # log a bounded prefix: a multi-thousand-row INSERT VALUES is tens
+    # of KB — the full statement lives in the ring (information_schema.
+    # slow_queries), the log line only needs enough to identify it
     logging.getLogger("greptimedb_tpu.slow_query").warning(
         "slow query (%.1f ms >= %.0f ms) kind=%s rows=%d path=%s: %s",
-        dur_ms, thr, kind, rec.rows, rec.execution_path, rec.query)
+        dur_ms, thr, kind, rec.rows, rec.execution_path,
+        rec.query[:400] + ("..." if len(rec.query) > 400 else ""))
 
 
 def records(n: Optional[int] = None) -> list[SlowQuery]:

@@ -57,9 +57,16 @@ def _wait_port(path, proc, name, timeout_s=90.0):
 
 def _sql(port, sql, timeout=30):
     q = urllib.parse.urlencode({"sql": sql})
-    with urllib.request.urlopen(
-            f"http://127.0.0.1:{port}/v1/sql?{q}", timeout=timeout) as r:
-        return json.loads(r.read())
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/v1/sql?{q}", timeout=timeout) as r:
+            return json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        # surface the server's error body — a bare "HTTP Error 400"
+        # is undiagnosable when the failure is load-dependent
+        body = e.read().decode(errors="replace")[:500]
+        raise AssertionError(
+            f"HTTP {e.code} for {sql!r}: {body}") from None
 
 
 @pytest.fixture

@@ -1,0 +1,648 @@
+"""Ingest pipeline (ISSUE 9): per-region group-commit WAL, the columnar
+protocol fast path, and the crash-mid-commit chaos scenario.
+
+Three layers: a differential suite proving the group-commit path yields
+bit-for-bit the region contents of the legacy serial path, concurrency
+tests proving the fsync amortization and the typed-Overloaded
+backpressure are real, and a 2-datanode ProcessCluster run SIGKILLing
+the write owner mid-group-commit asserting no acknowledged write is
+lost and the survivor replays a torn-free WAL.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from greptimedb_tpu.concurrency.admission import Overloaded
+from greptimedb_tpu.datatypes import (
+    ColumnSchema,
+    DataType,
+    DictVector,
+    RecordBatch,
+    Schema,
+    SemanticType,
+)
+from greptimedb_tpu.fault import FAULTS, Fault, FaultError
+from greptimedb_tpu.storage.engine import EngineConfig, RegionEngine
+
+RID = 77
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    FAULTS.reset()
+    yield
+    FAULTS.reset()
+
+
+def make_schema() -> Schema:
+    return Schema([
+        ColumnSchema("host", DataType.STRING, SemanticType.TAG),
+        ColumnSchema("ts", DataType.TIMESTAMP_MILLISECOND,
+                     SemanticType.TIMESTAMP, nullable=False),
+        ColumnSchema("v", DataType.FLOAT64, SemanticType.FIELD),
+    ])
+
+
+def make_engine(path, **cfg) -> RegionEngine:
+    eng = RegionEngine(EngineConfig(data_dir=str(path), **cfg))
+    eng.create_region(RID, make_schema())
+    return eng
+
+
+def make_batch(i: int, n: int = 50) -> RecordBatch:
+    return RecordBatch(make_schema(), {
+        "host": DictVector.encode([f"h{(i + j) % 7}" for j in range(n)]),
+        "ts": np.arange(i * n, (i + 1) * n, dtype=np.int64),
+        "v": np.arange(n, dtype=np.float64) + i,
+    })
+
+
+def scan_tuple(eng: RegionEngine, rid: int = RID):
+    sd = eng.region(rid).scan()
+    if sd is None:
+        return None
+    cols = {k: np.asarray(v) for k, v in sd.columns.items()}
+    return cols, np.asarray(sd.seq), np.asarray(sd.op_type)
+
+
+class TestGroupCommitDifferential:
+    def test_serial_vs_group_bit_for_bit(self, tmp_path):
+        """The acceptance differential: the same write sequence through
+        the legacy serial path and the group-commit path must produce
+        identical region contents — same columns, same seq order, same
+        flush boundary, same replay."""
+        legacy = make_engine(tmp_path / "legacy",
+                             ingest_group_commit=False)
+        group = make_engine(tmp_path / "group")
+        assert legacy.region(RID).committer is None
+        assert group.region(RID).committer is not None
+        for eng in (legacy, group):
+            for i in range(12):
+                eng.put(RID, make_batch(i))
+        a, b = scan_tuple(legacy), scan_tuple(group)
+        for x, y in zip(a[0].values(), b[0].values()):
+            np.testing.assert_array_equal(x, y)
+        np.testing.assert_array_equal(a[1], b[1])  # seq ordering
+        np.testing.assert_array_equal(a[2], b[2])
+        # flush boundary: same rows land in the SST, same next_seq
+        ml, mg = legacy.region(RID).flush(), group.region(RID).flush()
+        assert ml.num_rows == mg.num_rows
+        assert legacy.region(RID).next_seq == group.region(RID).next_seq
+        # replay parity after reopen
+        legacy.close()
+        group.close()
+        l2 = RegionEngine(EngineConfig(
+            data_dir=str(tmp_path / "legacy"), ingest_group_commit=False))
+        g2 = RegionEngine(EngineConfig(data_dir=str(tmp_path / "group")))
+        l2.open_region(RID)
+        g2.open_region(RID)
+        a, b = scan_tuple(l2), scan_tuple(g2)
+        np.testing.assert_array_equal(a[1], b[1])
+        for x, y in zip(a[0].values(), b[0].values()):
+            np.testing.assert_array_equal(x, y)
+        l2.close()
+        g2.close()
+
+    def test_counts_and_zero_row_batches(self, tmp_path):
+        eng = make_engine(tmp_path)
+        empty = RecordBatch(make_schema(), {
+            "host": DictVector.encode([]),
+            "ts": np.asarray([], dtype=np.int64),
+            "v": np.asarray([], dtype=np.float64)})
+        counts = eng.region(RID).write_many(
+            [(make_batch(0, 5), 0), (empty, 0), (make_batch(1, 3), 0)])
+        assert counts == [5, 0, 3]
+        eng.close()
+
+    def test_delete_rides_the_pipeline(self, tmp_path):
+        """DELETE is an op_type on the same write path — tombstones must
+        flow through group commit like puts."""
+        eng = make_engine(tmp_path)
+        eng.put(RID, make_batch(0, 10))
+        from greptimedb_tpu.storage.region import OP_DELETE
+
+        eng.region(RID).write(make_batch(0, 10), OP_DELETE)
+        sd = eng.region(RID).scan()
+        assert (np.asarray(sd.op_type) == 1).sum() == 10
+        eng.close()
+
+
+class TestGroupCommitConcurrency:
+    def test_concurrent_writers_amortize_fsyncs(self, tmp_path):
+        eng = make_engine(tmp_path)
+        errs: list = []
+
+        def writer(k):
+            try:
+                for i in range(15):
+                    eng.put(RID, make_batch(k * 15 + i))
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errs.append(e)
+
+        threads = [threading.Thread(target=writer, args=(k,))
+                   for k in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs
+        sd = eng.region(RID).scan()
+        total = 8 * 15 * 50
+        assert sd.num_rows == total
+        # no seq gap, no duplicate: every row got exactly one sequence
+        assert sorted(np.asarray(sd.seq).tolist()) == list(range(total))
+        writes = 8 * 15
+        assert eng.wal.sync_count < writes, (
+            f"{eng.wal.sync_count} fsyncs for {writes} concurrent writes "
+            "— group commit should coalesce")
+        eng.close()
+
+    def test_queue_overflow_is_typed_overloaded(self, tmp_path):
+        eng = make_engine(tmp_path, ingest_queue_depth=2,
+                          ingest_overlap=False)
+        region = eng.region(RID)
+        gate = threading.Event()
+        entered = threading.Event()
+        orig = region.group_commit
+
+        def slow_commit(ticket, entries, blob=None):
+            entered.set()
+            gate.wait(10.0)
+            return orig(ticket, entries, blob=blob)
+
+        region.group_commit = slow_commit
+        threads = []
+        errs: list = []
+
+        def write():
+            try:
+                eng.put(RID, make_batch(len(threads)))
+            except Exception as e:  # noqa: BLE001 — collected
+                errs.append(e)
+
+        try:
+            # leader enters the gated commit; two more fill the queue
+            t0 = threading.Thread(target=write)
+            t0.start()
+            threads.append(t0)
+            assert entered.wait(5.0)
+            for _ in range(2):
+                t = threading.Thread(target=write)
+                t.start()
+                threads.append(t)
+            deadline = time.monotonic() + 5.0
+            while region.committer._queue is not None \
+                    and len(region.committer._queue) < 2:
+                assert time.monotonic() < deadline
+                time.sleep(0.01)
+            with pytest.raises(Overloaded):
+                eng.put(RID, make_batch(99))
+        finally:
+            gate.set()
+            for t in threads:
+                t.join(10.0)
+        assert not errs
+        from greptimedb_tpu.utils.metrics import (
+            INGEST_GROUP_COMMIT_EVENTS,
+        )
+
+        assert INGEST_GROUP_COMMIT_EVENTS.total(event="overflow") >= 1
+        eng.close()
+
+    def test_append_fault_fails_writers_without_ack(self, tmp_path):
+        """A fault at the WAL append boundary must surface to the
+        writers (unacknowledged), leave no rows behind, and leave the
+        pipeline healthy for the next write."""
+        eng = make_engine(tmp_path)
+        FAULTS.arm("ingest.commit",
+                   Fault(kind="fail", nth=1, match={"op": "append"}))
+        with pytest.raises(FaultError):
+            eng.put(RID, make_batch(0))
+        assert eng.region(RID).scan() is None  # nothing applied
+        # pipeline recovered: the next write commits normally
+        assert eng.put(RID, make_batch(1)) == 50
+        sd = eng.region(RID).scan()
+        assert sd.num_rows == 50
+        # the burned reservation left a seq gap; replay tolerates it
+        eng.close()
+        e2 = RegionEngine(EngineConfig(data_dir=str(tmp_path)))
+        e2.open_region(RID)
+        assert e2.region(RID).scan().num_rows == 50
+        e2.close()
+
+    def test_flush_during_inflight_commit_loses_nothing(self, tmp_path):
+        """A flush racing the reserve→apply window must wait: flushing
+        in between would record a flushed_seq past rows not yet in the
+        memtable and skip their WAL entries on replay."""
+        eng = make_engine(tmp_path)
+        eng.put(RID, make_batch(0))
+        # widen the dangerous window: the first commit sleeps between
+        # the durable append and the memtable apply
+        FAULTS.arm("ingest.commit",
+                   Fault(kind="latency", arg=0.3, nth=1,
+                         match={"op": "apply"}))
+        t = threading.Thread(target=lambda: eng.put(RID, make_batch(1)))
+        t.start()
+        time.sleep(0.1)  # the writer is inside the latency window
+        eng.region(RID).flush()
+        t.join(10.0)
+        assert eng.region(RID).scan().num_rows == 100
+        eng.close()
+        # crash-equivalent: reopen and replay — both batches survive
+        e2 = RegionEngine(EngineConfig(data_dir=str(tmp_path)))
+        e2.open_region(RID)
+        assert e2.region(RID).scan().num_rows == 100
+        e2.close()
+
+    def test_drop_during_commit_refuses_ack(self, tmp_path):
+        eng = make_engine(tmp_path)
+        FAULTS.arm("ingest.commit",
+                   Fault(kind="latency", arg=0.3, nth=1,
+                         match={"op": "apply"}))
+        from greptimedb_tpu.storage.region import RegionDroppedError
+
+        errs: list = []
+
+        def write():
+            try:
+                eng.put(RID, make_batch(0))
+            except RegionDroppedError as e:
+                errs.append(e)
+
+        t = threading.Thread(target=write)
+        t.start()
+        time.sleep(0.1)
+        from greptimedb_tpu.storage.engine import (
+            RegionRequest,
+            RequestType,
+        )
+
+        eng.handle_request(RegionRequest(RequestType.DROP, RID))
+        t.join(10.0)
+        assert errs, "a write racing DROP must not be acknowledged"
+        eng.close()
+
+
+# ---- line-protocol parse fuzz ----------------------------------------------
+
+
+class TestLineProtocolFuzz:
+    def _slab(self, text, **kw):
+        from greptimedb_tpu.servers.influx import parse_lines_columnar
+
+        return parse_lines_columnar(text, **kw)
+
+    def test_escaped_commas_spaces_and_quotes(self):
+        slabs = self._slab(
+            'my\\ table,ta\\,g=va\\ lue,b=c\\=d '
+            'f1=1.5,msg="say \\"hi\\", bye" 1000\n')
+        slab = slabs["my table"]
+        assert slab.tags["ta,g"] == ["va lue"]
+        assert slab.tags["b"] == ["c=d"]
+        assert slab.fields["msg"] == ['say "hi", bye']
+        assert slab.fields["f1"] == [1.5]
+
+    def test_nan_inf_rejected_with_line_numbers(self):
+        from greptimedb_tpu.servers.influx import LineProtocolError
+
+        body = ("cpu,h=a v=1.0 1000\n"
+                "cpu,h=a v=NaN 2000\n"
+                "cpu,h=a v=inf 3000\n"
+                "cpu,h=a v=-Infinity 4000\n")
+        with pytest.raises(LineProtocolError) as ei:
+            self._slab(body)
+        assert ei.value.lines == [2, 3, 4]
+        assert "non-finite" in str(ei.value)
+
+    def test_torn_partial_line_rejected_by_number(self):
+        from greptimedb_tpu.servers.influx import LineProtocolError
+
+        body = ("cpu,h=a v=1.0 1000\n"
+                "cpu,h=b v=")  # torn mid-value (crashed client)
+        with pytest.raises(LineProtocolError) as ei:
+            self._slab(body)
+        assert ei.value.lines == [2]
+        assert "line 2" in str(ei.value)
+
+    def test_out_of_order_tags_share_columns(self):
+        slabs = self._slab("m,b=2,a=1 v=1.0 1000\n"
+                           "m,a=3,b=4 v=2.0 2000\n")
+        slab = slabs["m"]
+        assert slab.tags["a"] == ["1", "3"]
+        assert slab.tags["b"] == ["2", "4"]
+
+    def test_sparse_fields_null_pad(self):
+        slabs = self._slab("m f1=1.0 1000\n"
+                           "m f2=2.0 2000\n")
+        slab = slabs["m"]
+        assert slab.fields["f1"] == [1.0, None]
+        assert slab.fields["f2"] == [None, 2.0]
+
+    def test_bad_timestamp_and_missing_fields(self):
+        from greptimedb_tpu.servers.influx import LineProtocolError
+
+        with pytest.raises(LineProtocolError) as ei:
+            self._slab("m v=1.0 notatime\nm,h=a  \nok v=2.0 5\n")
+        assert ei.value.lines == [1, 2]
+
+    def test_integer_and_bool_suffixes(self):
+        slabs = self._slab("m i=42i,u=7u,t=true,f=F,neg=-3i 1000\n")
+        f = slabs["m"].fields
+        assert f["i"] == [42] and f["u"] == [7] and f["neg"] == [-3]
+        assert f["t"] == [True] and f["f"] == [False]
+
+    def test_duplicate_key_last_wins(self):
+        slabs = self._slab("m v=1.0,v=2.0 1000\n")
+        assert slabs["m"].fields["v"] == [2.0]
+
+    def test_trailing_junk_rejected_in_every_lane(self):
+        from greptimedb_tpu.servers.influx import LineProtocolError
+
+        # plain (fused lane) and escaped (char-walking lane) spellings
+        # of the same junk-after-timestamp shape must BOTH reject —
+        # lane parity
+        for body in ("m v=1.0 123 456\n",
+                     'm,t=a\\ b v=1.0 123 456\n'):
+            with pytest.raises(LineProtocolError) as ei:
+                self._slab(body)
+            assert ei.value.lines == [1], body
+
+    def test_precision_scaling_exact_at_ns(self):
+        # ns-epoch values exceed 2^53 — integer math must stay exact
+        ns = 1_465_839_830_100_400_200
+        slabs = self._slab(f"m v=1.0 {ns}\n", precision="ns")
+        assert slabs["m"].ts == [ns // 1_000_000]
+
+    def test_http_400_names_bad_lines(self, tmp_path):
+        import json
+        import urllib.error
+        import urllib.request
+
+        from greptimedb_tpu.catalog import Catalog, MemoryKv
+        from greptimedb_tpu.query import QueryEngine
+        from greptimedb_tpu.servers import HttpServer
+
+        eng = RegionEngine(EngineConfig(data_dir=str(tmp_path / "d")))
+        qe = QueryEngine(Catalog(MemoryKv()), eng)
+        srv = HttpServer(qe, port=0)
+        port = srv.start()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/influxdb/write",
+                data=b"cpu,h=a v=1.0 1000\ncpu,h=b v=oops 2000",
+                method="POST",
+                headers={"Content-Type": "text/plain"})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req)
+            assert ei.value.code == 400
+            body = json.loads(ei.value.read())
+            assert body["lines"] == [2]
+            assert "line 2" in body["error"]
+        finally:
+            srv.stop()
+            eng.close()
+
+
+class TestVectorParseLane:
+    def test_parity_with_python_lane(self):
+        """The Arrow-CSV vector lane and the Python fused lane must
+        produce bit-identical batches for the uniform shape."""
+        from greptimedb_tpu.servers.influx import (
+            _PRECISION_TO_MS,
+            _vector_parse,
+            parse_lines_columnar,
+        )
+
+        rng = np.random.default_rng(3)
+        fields = ["f0", "f1", "f2"]
+        body = "\n".join(
+            f"cpu,hostname=host_{int(h)},dc=dc{int(h) % 3} "
+            + ",".join(f"{f}={v:.4f}" for f, v in zip(fields, row))
+            + f" {1000 + j}"
+            for j, (h, row) in enumerate(zip(
+                rng.integers(0, 40, 500),
+                rng.uniform(-50.0, 50.0, (500, 3)))))
+        num, den = _PRECISION_TO_MS["ms"]
+        vec = _vector_parse(body, num, den, now_ms=0)
+        assert vec is not None and "cpu" in vec
+        py = parse_lines_columnar(body, precision="ms", now_ms=0)
+        schema = Schema([
+            ColumnSchema("hostname", DataType.STRING, SemanticType.TAG),
+            ColumnSchema("dc", DataType.STRING, SemanticType.TAG),
+            ColumnSchema("ts", DataType.TIMESTAMP_MILLISECOND,
+                         SemanticType.TIMESTAMP, nullable=False),
+        ] + [ColumnSchema(f, DataType.FLOAT64, SemanticType.FIELD)
+             for f in fields])
+        bv, bp = vec["cpu"].to_batch(schema), py["cpu"].to_batch(schema)
+        assert bv.num_rows == bp.num_rows == 500
+        for name in schema.names:
+            cv, cp = bv.columns[name], bp.columns[name]
+            if hasattr(cv, "decode"):
+                np.testing.assert_array_equal(cv.decode(), cp.decode())
+            else:
+                np.testing.assert_array_equal(np.asarray(cv),
+                                              np.asarray(cp))
+
+    def test_vector_lane_bails_to_python_diagnostics(self):
+        """Every precondition miss must return None, never a wrong
+        batch — and the Python lane then owns the line numbers."""
+        from greptimedb_tpu.servers.influx import (
+            _vector_parse,
+            parse_lines_columnar,
+        )
+
+        cases = [
+            "cpu,h=a v=1.0 1000\ncpu,h=b v=inf 2000",    # non-finite
+            "cpu,h=a v=1.0 1000\nmem,h=b v=2.0 2000",    # mixed tables
+            "cpu,h=a v=1.0 1000\ncpu,h=b v=2.0",         # mixed ts
+            "cpu,h=a v=1.0 1000\ncpu,h=b v=",            # torn line
+            'cpu,h=a msg="x" 1000',                      # string field
+            "cpu,h=a v=2i 1000",                         # int suffix
+            "cpu,h=a v=1.0 1000\ncpu,v=2.0,h=b x 1",     # ragged/odd
+        ]
+        for body in cases:
+            assert _vector_parse(body, 1, 1, 0) is None, body
+        # and the diagnostics lane still yields line numbers for the bad
+        from greptimedb_tpu.servers.influx import LineProtocolError
+
+        with pytest.raises(LineProtocolError) as ei:
+            parse_lines_columnar(cases[0], precision="ms")
+        assert ei.value.lines == [2]
+
+    def test_write_lines_roundtrip_through_vector_lane(self, tmp_path):
+        from greptimedb_tpu.catalog import Catalog, MemoryKv
+        from greptimedb_tpu.query import QueryEngine
+        from greptimedb_tpu.servers.influx import write_lines
+
+        eng = RegionEngine(EngineConfig(data_dir=str(tmp_path)))
+        qe = QueryEngine(Catalog(MemoryKv()), eng)
+        body = ("vm,host=a cpu=1.5,mem=10.0 1000\n"
+                "vm,host=b cpu=2.5,mem=20.0 2000\n"
+                "vm,host=a cpu=3.5,mem=30.0 3000")
+        assert write_lines(qe, "public", body, precision="ms") == 3
+        res = qe.execute_one("SELECT host, cpu, mem FROM vm ORDER BY ts")
+        assert res.rows() == [["a", 1.5, 10.0], ["b", 2.5, 20.0],
+                              ["a", 3.5, 30.0]]
+        eng.close()
+
+
+# ---- columnar front doors land on the bulk path -----------------------------
+
+
+class TestColumnarFrontDoors:
+    def test_batched_auto_alter_one_schema_swap(self, tmp_path):
+        """A request introducing several new fields must alter the
+        schema ONCE (one region flush), not once per column."""
+        from greptimedb_tpu.catalog import Catalog, MemoryKv
+        from greptimedb_tpu.query import QueryEngine
+        from greptimedb_tpu.servers.influx import write_lines
+
+        eng = RegionEngine(EngineConfig(data_dir=str(tmp_path)))
+        qe = QueryEngine(Catalog(MemoryKv()), eng)
+        write_lines(qe, "public", "m,h=a f1=1.0 1000\n", precision="ms")
+        from greptimedb_tpu.query.engine import QueryContext
+
+        info = qe._table("m", QueryContext(db="public"))
+        rid = info.region_ids[0]
+        region = eng.region(rid)
+        flushes_before = len(region.files)
+        write_lines(qe, "public",
+                    "m,h=a f1=1.0,f2=2.0,f3=3.0,f4=4.0 2000\n",
+                    precision="ms")
+        info = qe._table("m", QueryContext(db="public"))
+        for fn in ("f2", "f3", "f4"):
+            assert fn in info.schema
+        # one ALTER = one flush of the old memtable, not three
+        assert len(region.files) - flushes_before <= 1
+        res = qe.execute_one("SELECT f1, f2, f3, f4 FROM m WHERE ts = 2000")
+        assert res.rows()[0] == [1.0, 2.0, 3.0, 4.0]
+        eng.close()
+
+    def test_remote_write_series_bulk_extend(self, tmp_path):
+        """Prometheus remote-write lands columnar: one RecordBatch per
+        metric, tag columns extended per series, NULLs for labels a
+        series does not carry."""
+        from greptimedb_tpu.catalog import Catalog, MemoryKv
+        from greptimedb_tpu.query import QueryEngine
+        from greptimedb_tpu.servers.prom_store import (
+            handle_remote_write,
+        )
+        from greptimedb_tpu.utils import protowire as pw
+        from greptimedb_tpu.utils import snappy
+
+        def label(n, v):
+            return pw.field_bytes(1, pw.field_str(1, n)
+                                  + pw.field_str(2, v))
+
+        def sample(val, ts):
+            return pw.field_bytes(2, pw.field_double(1, val)
+                                  + pw.field_varint(2, ts))
+
+        ts1 = pw.field_bytes(1, label("__name__", "up")
+                             + label("job", "api")
+                             + sample(1.0, 1000) + sample(0.0, 2000))
+        ts2 = pw.field_bytes(1, label("__name__", "up")
+                             + label("job", "db")
+                             + label("zone", "z1")
+                             + sample(1.0, 1500))
+        body = snappy.compress(ts1 + ts2)
+        eng = RegionEngine(EngineConfig(data_dir=str(tmp_path)))
+        qe = QueryEngine(Catalog(MemoryKv()), eng)
+        n = handle_remote_write(qe, body)
+        assert n == 3
+        res = qe.execute_one(
+            "SELECT job, zone, greptime_value FROM up "
+            "ORDER BY greptime_timestamp")
+        assert res.rows() == [["api", None, 1.0], ["db", "z1", 1.0],
+                              ["api", None, 0.0]]
+        eng.close()
+
+
+# ---- the acceptance chaos scenario ------------------------------------------
+
+
+@pytest.mark.chaos
+class TestCrashMidGroupCommit:
+    def test_2dn_owner_killed_mid_commit_no_acked_loss(
+            self, tmp_path, monkeypatch):
+        """SIGKILL the write owner while group commits are in flight on
+        a 2-datanode ProcessCluster: every INSERT acknowledged to the
+        client must survive failover (the survivor replays the shared
+        remote WAL), and the replay must not trip on a torn frame."""
+        import os
+
+        from greptimedb_tpu.cluster.process_cluster import ProcessCluster
+        from greptimedb_tpu.meta.metasrv import MetasrvOptions
+
+        seed = int(os.environ.get("GTPU_CHAOS_SEED", "0")) or 909
+        monkeypatch.setenv("GTPU_CHAOS_SEED", str(seed))
+        # children widen the append→apply window so the SIGKILL lands
+        # mid-group-commit with high probability
+        monkeypatch.setenv(
+            "GTPU_CHAOS",
+            f"ingest.commit=latency,arg:0.05,prob:0.5,@op:apply,seed:{seed}")
+        c = ProcessCluster(str(tmp_path), num_datanodes=2,
+                           opts=MetasrvOptions())
+        try:
+            t = 0.0
+            for _ in range(5):
+                c.beat_all(t)
+                t += 3000.0
+            c.sql("CREATE TABLE m (host STRING, v DOUBLE, "
+                  "ts TIMESTAMP TIME INDEX, PRIMARY KEY(host))")
+            rid = c.catalog.table("public", "m").region_ids[0]
+            owner = c.metasrv.routes.get(
+                str(rid >> 32)).regions[0].leader_node
+            for _ in range(3):
+                c.beat_all(t)
+                t += 3000.0
+            acked: list = []
+            lock = threading.Lock()
+
+            def writer(w):
+                for i in range(25):
+                    key = f"h{w}_{i:02d}"
+                    try:
+                        c.sql(f"INSERT INTO m VALUES ('{key}', "
+                              f"{float(w * 100 + i)}, {1000 * (i + 1)})")
+                        with lock:
+                            acked.append((key, float(w * 100 + i)))
+                    except Exception:  # noqa: BLE001 — unacked may fail
+                        pass
+
+            threads = [threading.Thread(target=writer, args=(w,))
+                       for w in range(3)]
+            for th in threads:
+                th.start()
+            # kill only once the stream is demonstrably mid-flight:
+            # some writes acked, more still coming
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline:
+                with lock:
+                    if len(acked) >= 5:
+                        break
+                time.sleep(0.01)
+            c.kill_datanode(owner)
+            for th in threads:
+                th.join(30.0)
+            assert acked, "no write was acknowledged before the kill"
+            for _ in range(30):
+                c.beat_all(t)
+                t += 3000.0
+            assert c.tick(t), "failover should start"
+            c.beat_all(t)  # deliver OPEN_REGION to the survivor
+            rows = c.sql("SELECT host, v FROM m ORDER BY host").rows()
+            got = {r[0]: r[1] for r in rows}
+            for key, v in acked:
+                assert got.get(key) == v, \
+                    f"acknowledged write {key} lost after failover"
+            survivor = c.metasrv.routes.get(
+                str(rid >> 32)).regions[0].leader_node
+            assert survivor != owner
+        finally:
+            c.close()

@@ -27,6 +27,7 @@ from greptimedb_tpu.lint import (
     run_checkers,
 )
 from greptimedb_tpu.lint import lockdep as rt_lockdep
+from greptimedb_tpu.lint.blocking import check as blocking_check
 from greptimedb_tpu.lint.deadcode import check as deadcode_check
 from greptimedb_tpu.lint.fault_seam import check as fault_seam_check
 from greptimedb_tpu.lint.jax_imports import check as jax_import_check
@@ -377,6 +378,91 @@ class C:
 """)
     found = lockdep_check(fixture_repo(bad))
     assert any("self-deadlock" in f.message for f in found)
+
+
+# ---- blocking (no blocking syscall while holding a lock) --------------------
+
+
+def test_blocking_fires_on_direct_sleep_under_lock():
+    bad = ("greptimedb_tpu/concurrency/napper.py", """
+import threading
+import time
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def do(self):
+        with self._lock:
+            time.sleep(1.0)
+""")
+    found = blocking_check(fixture_repo(bad))
+    assert any("time.sleep" in f.message and "C.do" in f.message
+               for f in found)
+
+
+def test_blocking_fires_transitively_through_annotated_attr():
+    # the group-commit contract: fsync reached through an injected
+    # collaborator (self.wal.append, `wal: Sink` annotation) while the
+    # region lock is held must be flagged — the call resolution rides
+    # the annotation-inferred attribute type
+    bad = ("greptimedb_tpu/concurrency/pipe.py", """
+import os
+import threading
+
+class Sink:
+    def append(self, b):
+        f = open("/tmp/x", "ab")
+        f.write(b)
+        os.fsync(f.fileno())
+
+class Holder:
+    def __init__(self, wal: Sink):
+        self._lock = threading.Lock()
+        self.wal = wal
+
+    def write(self, b):
+        with self._lock:
+            self.wal.append(b)
+""")
+    found = blocking_check(fixture_repo(bad))
+    assert any("os.fsync" in f.message and "Holder.write" in f.message
+               for f in found)
+
+
+def test_blocking_quiet_outside_lock_and_on_condition_wait():
+    ok = ("greptimedb_tpu/concurrency/pipe.py", """
+import os
+import threading
+import time
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+
+    def do(self, f):
+        with self._lock:
+            pass
+        time.sleep(0.01)          # outside the lock: fine
+        os.fsync(f.fileno())      # outside the lock: fine
+        with self._cv:
+            self._cv.wait(1.0)    # releases the lock: fine
+""")
+    assert blocking_check(fixture_repo(ok)) == []
+
+
+def test_blocking_guards_the_real_group_commit_path():
+    # the production commit path must stay clean, and the legacy serial
+    # path (fsync under the region lock by design) must be the ONLY
+    # allowlisted finding in the storage plane
+    repo = load_repo(REPO_ROOT)
+    found = blocking_check(repo)
+    assert not any("group_commit" in f.path for f in found), \
+        [f.render() for f in found]
+    serial = [f for f in found
+              if "write_many_serial" in f.message]
+    assert len(serial) == 1  # the documented legacy exception
 
 
 # ---- deadcode ---------------------------------------------------------------
