@@ -1334,6 +1334,88 @@ def bench_ingest_qps(engine, qe, results, writers=None, seconds=None):
     }
 
 
+_BATCH_EVENTS = ("join", "coalesced", "stacked", "vmapped",
+                 "serial_fallback")
+
+
+def _serving_snapshot():
+    """Counter/histogram state before a qps phase: per-shape batching
+    events, batch/vmap width histograms, and the execute-vs-encode
+    wall-time split (engine seconds vs encode-pool seconds)."""
+    from greptimedb_tpu.utils.metrics import (
+        ENCODE_POOL_EVENTS,
+        ENCODE_SECONDS,
+        QUERY_BATCH_EVENTS,
+        QUERY_BATCH_SIZE,
+        QUERY_DURATION,
+        VMAP_BATCH_WIDTH,
+    )
+
+    return {
+        "events": {e: QUERY_BATCH_EVENTS.get(event=e)
+                   for e in _BATCH_EVENTS},
+        "batch_sum": QUERY_BATCH_SIZE.sum(),
+        "batch_n": QUERY_BATCH_SIZE.count(),
+        "vmap_sum": VMAP_BATCH_WIDTH.sum(),
+        "vmap_n": VMAP_BATCH_WIDTH.count(),
+        "exec_s": QUERY_DURATION.sum(kind="sql"),
+        "exec_n": QUERY_DURATION.count(kind="sql"),
+        # thread-mode encodes observe protocol="http"; process-mode
+        # round trips are timed parent-side as protocol="process"
+        "encode_s": ENCODE_SECONDS.sum(protocol="http")
+        + ENCODE_SECONDS.sum(protocol="process"),
+        "encode_n": ENCODE_SECONDS.count(protocol="http")
+        + ENCODE_SECONDS.count(protocol="process"),
+        "offloaded": ENCODE_POOL_EVENTS.get(event="offload")
+        + ENCODE_POOL_EVENTS.get(event="offload_process"),
+        "inline": ENCODE_POOL_EVENTS.get(event="inline"),
+        "small_inline": ENCODE_POOL_EVENTS.get(event="small_inline"),
+    }
+
+
+def _serving_report(before):
+    """The per-shape batching breakdown + execute/encode split since
+    `before` — makes the vmap and GIL-escape wins separately
+    attributable in BENCH_* output."""
+    now = _serving_snapshot()
+    ev = {e: int(now["events"][e] - before["events"][e])
+          for e in _BATCH_EVENTS}
+    groups = now["batch_n"] - before["batch_n"]
+    widths = now["batch_sum"] - before["batch_sum"]
+    vgroups = now["vmap_n"] - before["vmap_n"]
+    vwidths = now["vmap_sum"] - before["vmap_sum"]
+    exec_s = now["exec_s"] - before["exec_s"]
+    exec_n = now["exec_n"] - before["exec_n"]
+    enc_s = now["encode_s"] - before["encode_s"]
+    enc_n = now["encode_n"] - before["encode_n"]
+    return {
+        "batching": {
+            **ev,
+            "mean_batch_width": (round(widths / groups, 2)
+                                 if groups else None),
+            "mean_vmap_width": (round(vwidths / vgroups, 2)
+                                if vgroups else None),
+        },
+        "encode_split": {
+            "execute_s": round(exec_s, 3),
+            "encode_s": round(enc_s, 3),
+            "encode_share": (round(enc_s / (exec_s + enc_s), 4)
+                             if exec_s + enc_s > 0 else None),
+            "mean_execute_ms": (round(exec_s / exec_n * 1000, 3)
+                                if exec_n else None),
+            "mean_encode_ms": (round(enc_s / enc_n * 1000, 3)
+                               if enc_n else None),
+            "encode_offloaded": int(now["offloaded"]
+                                    - before["offloaded"]),
+            "encode_inline": int(now["inline"] - before["inline"]),
+            # results under [concurrency] encode_min_rows: encoded on
+            # the request thread by design (handoff > serialization)
+            "encode_small_inline": int(now["small_inline"]
+                                       - before["small_inline"]),
+        },
+    }
+
+
 def bench_qps(qe, results, clients=None, requests_total=None):
     """Config: concurrent query throughput over real HTTP (reference
     tracks 1165.73 qps @50 clients on single-groupby-1-1-1,
@@ -1372,7 +1454,9 @@ def bench_qps(qe, results, clients=None, requests_total=None):
         cache0 = (PLAN_CACHE_EVENTS.get(event="hit"),
                   PLAN_CACHE_EVENTS.get(event="miss"))
         batch0 = (QUERY_BATCH_EVENTS.get(event="coalesced"),
-                  QUERY_BATCH_EVENTS.get(event="stacked"))
+                  QUERY_BATCH_EVENTS.get(event="stacked"),
+                  QUERY_BATCH_EVENTS.get(event="vmapped"))
+        serving0 = _serving_snapshot()
 
         per_client = max(1, requests_total // clients)
         latencies = [[] for _ in range(clients)]
@@ -1431,19 +1515,23 @@ def bench_qps(qe, results, clients=None, requests_total=None):
     d_miss = PLAN_CACHE_EVENTS.get(event="miss") - cache0[1]
     hit_rate = d_hit / (d_hit + d_miss) if (d_hit + d_miss) else None
     batched = (QUERY_BATCH_EVENTS.get(event="coalesced") - batch0[0]
-               + QUERY_BATCH_EVENTS.get(event="stacked") - batch0[1])
+               + QUERY_BATCH_EVENTS.get(event="stacked") - batch0[1]
+               + QUERY_BATCH_EVENTS.get(event="vmapped") - batch0[2])
+    serving = _serving_report(serving0)
     log(f"qps: {qps:.0f} qps @{clients} clients "
         f"(mean {lats.mean() * 1000:.1f} ms, p99 "
         f"{np.percentile(lats, 99) * 1000:.1f} ms, {n_err} errors, "
         f"plan-cache hit rate "
         f"{-1.0 if hit_rate is None else hit_rate:.3f}, "
-        f"{batched:.0f} batched)")
+        f"{batched:.0f} batched, batching {serving['batching']}, "
+        f"encode {serving['encode_split']})")
     results["qps_single_groupby"] = {
         "qps": round(qps, 1), "clients": clients, "requests": done,
         "errors": n_err,
         "mean_ms": round(float(lats.mean() * 1000), 2),
         "p99_ms": round(float(np.percentile(lats, 99) * 1000), 2),
         "p999_ms": round(float(np.percentile(lats, 99.9) * 1000), 2),
+        **serving,
         # the ISSUE-6 acceptance: the repeated-dashboard workload must
         # serve >90% of plans from the shape-keyed cache
         "plan_cache_hit_rate": (None if hit_rate is None
@@ -1519,9 +1607,11 @@ def bench_qps_mixed(qe, results, clients_per_tenant=None,
         cache0 = (PLAN_CACHE_EVENTS.get(event="hit"),
                   PLAN_CACHE_EVENTS.get(event="miss"))
         batch0 = (QUERY_BATCH_EVENTS.get(event="coalesced"),
-                  QUERY_BATCH_EVENTS.get(event="stacked"))
+                  QUERY_BATCH_EVENTS.get(event="stacked"),
+                  QUERY_BATCH_EVENTS.get(event="vmapped"))
         rej0 = ADMISSION_EVENTS.total(event="reject_full") \
             + ADMISSION_EVENTS.total(event="reject_timeout")
+        serving0 = _serving_snapshot()
 
         per_client = max(1, requests_total
                          // (3 * clients_per_tenant))
@@ -1577,7 +1667,8 @@ def bench_qps_mixed(qe, results, clients_per_tenant=None,
     d_miss = PLAN_CACHE_EVENTS.get(event="miss") - cache0[1]
     hit_rate = d_hit / (d_hit + d_miss) if (d_hit + d_miss) else None
     batched = (QUERY_BATCH_EVENTS.get(event="coalesced") - batch0[0]
-               + QUERY_BATCH_EVENTS.get(event="stacked") - batch0[1])
+               + QUERY_BATCH_EVENTS.get(event="stacked") - batch0[1]
+               + QUERY_BATCH_EVENTS.get(event="vmapped") - batch0[2])
     rejected = (ADMISSION_EVENTS.total(event="reject_full")
                 + ADMISSION_EVENTS.total(event="reject_timeout") - rej0)
     per_tenant = {}
@@ -1596,16 +1687,19 @@ def bench_qps_mixed(qe, results, clients_per_tenant=None,
             "p999_ms": round(float(np.percentile(ls, 99.9) * 1000), 2),
         }
     qps = done / wall if wall > 0 else 0.0
+    serving = _serving_report(serving0)
     log(f"qps_mixed: {qps:.0f} qps @3x{clients_per_tenant} clients, "
         f"plan-cache hit rate "
         f"{-1.0 if hit_rate is None else hit_rate:.3f}, "
-        f"{batched:.0f} batched, {rejected:.0f} rejected; " + ", ".join(
+        f"{batched:.0f} batched, {rejected:.0f} rejected, "
+        f"batching {serving['batching']}; " + ", ".join(
             f"{n} p99 {per_tenant[n].get('p99_ms', '?')} ms"
             for n, _ in tenants))
     results["qps_mixed_tenants"] = {
         "qps": round(qps, 1),
         "clients_per_tenant": clients_per_tenant,
         "tenants": per_tenant,
+        **serving,
         "plan_cache_hit_rate": (None if hit_rate is None
                                 else round(hit_rate, 4)),
         "batched_queries": int(batched),

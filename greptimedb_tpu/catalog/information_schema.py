@@ -197,7 +197,8 @@ def _slow_queries(qe, ctx):
 
     cols = {k: [] for k in (
         "trace_id", "kind", "query", "db", "duration_ms", "threshold_ms",
-        "rows", "execution_path", "started_at", "stages")}
+        "rows", "execution_path", "plan_cache_skip", "started_at",
+        "stages")}
     for rec in slow_query.records():
         cols["trace_id"].append(rec.trace_id)
         cols["kind"].append(rec.kind)
@@ -207,6 +208,7 @@ def _slow_queries(qe, ctx):
         cols["threshold_ms"].append(rec.threshold_ms)
         cols["rows"].append(rec.rows)
         cols["execution_path"].append(rec.execution_path or "")
+        cols["plan_cache_skip"].append(rec.plan_cache_skip or "")
         cols["started_at"].append(int(rec.started_at * 1000))
         cols["stages"].append("; ".join(
             f"{'' if n == 'local' else '[' + str(n) + '] '}{s}={d:.2f}ms"

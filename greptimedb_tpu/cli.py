@@ -171,6 +171,9 @@ def cmd_standalone(args):
                 s.stop()
             except AttributeError:
                 s.shutdown()
+        # reclaim encode workers deterministically (spawn-mode worker
+        # PROCESSES especially must not outlive a clean shutdown)
+        qe.concurrency.shutdown()
         engine.close()
 
 

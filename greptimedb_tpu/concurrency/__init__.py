@@ -10,9 +10,12 @@ query engine (L3) that makes fleet-scale concurrent traffic cheap:
 - `admission`    — bounded admission queue + per-tenant weighted fair
                    scheduling with typed `Overloaded` rejection;
 - `batcher`      — a short collection window that coalesces identical
-                   statements and stacks shape-compatible small
-                   aggregates into one device dispatch, demuxed
-                   bit-for-bit.
+                   statements and executes parameter-sibling aggregates
+                   (multi-tag selectors, differing time windows) as one
+                   vmap'd stacked dispatch, bit-for-bit with serial;
+- `encode_pool`  — a bounded pool that serializes query results off the
+                   request threads (admission slots are released at
+                   execute-done, serialization never holds one).
 
 `QueryEngine` routes every statement through the plane; configuration
 comes from the `[concurrency]` options section via `configure()` (env
@@ -32,6 +35,7 @@ from greptimedb_tpu.concurrency.admission import (  # noqa: F401
     parse_weights,
 )
 from greptimedb_tpu.concurrency.batcher import QueryBatcher
+from greptimedb_tpu.concurrency.encode_pool import EncodePool
 from greptimedb_tpu.concurrency.plan_cache import PlanCache
 
 __all__ = ["ConcurrencyConfig", "ConcurrencyPlane", "Overloaded",
@@ -54,6 +58,21 @@ class ConcurrencyConfig:
     #: stacked dispatch only below this estimated row count (single
     #: kernel dispatch keeps float parity provable); 0 = no bound
     batch_max_rows: int = 4 << 20
+    #: vmap'd multi-query kernel for parameter-sibling batch members
+    #: (off -> IN-list stacking / serial fallback only)
+    batch_vmap: bool = True
+    #: bounded result-encode pool (off -> serialize on request threads)
+    encode_offload: bool = True
+    #: encode workers; 0 = auto (max(2, min(8, cpu/2)))
+    encode_workers: int = 0
+    #: serializations in flight before inline fallback
+    encode_queue: int = 64
+    #: results smaller than this many rows encode inline (a thread
+    #: handoff costs more than serializing a dashboard-sized result)
+    encode_min_rows: int = 256
+    #: spawn-mode worker processes instead of threads (full GIL escape;
+    #: pays pickling, opt-in for very large result sets)
+    encode_process_pool: bool = False
 
 
 _config = ConcurrencyConfig()
@@ -92,6 +111,12 @@ def current_config() -> ConcurrencyConfig:
                             int) != 0
     cfg.batch_window_ms = _env_num("GTPU_BATCH_WINDOW_MS",
                                    cfg.batch_window_ms, float)
+    cfg.batch_vmap = _env_num("GTPU_BATCH_VMAP", int(cfg.batch_vmap),
+                              int) != 0
+    cfg.encode_offload = _env_num("GTPU_ENCODE_OFFLOAD",
+                                  int(cfg.encode_offload), int) != 0
+    cfg.encode_workers = _env_num("GTPU_ENCODE_WORKERS",
+                                  cfg.encode_workers, int)
     return cfg
 
 
@@ -112,7 +137,14 @@ class ConcurrencyPlane:
             window_s=cfg.batch_window_ms / 1000.0,
             max_queries=cfg.batch_max_queries,
             max_rows=cfg.batch_max_rows,
-            enabled=cfg.enabled and cfg.batching)
+            enabled=cfg.enabled and cfg.batching,
+            vmap=cfg.batch_vmap)
+        self.encode = EncodePool(
+            workers=cfg.encode_workers,
+            queue_size=cfg.encode_queue,
+            process=cfg.encode_process_pool,
+            enabled=cfg.enabled and cfg.encode_offload,
+            min_rows=cfg.encode_min_rows)
         self._tls = threading.local()
 
     # ---- batching gate -----------------------------------------------------
@@ -148,6 +180,13 @@ class ConcurrencyPlane:
         user = getattr(ctx, "user", None)
         name = getattr(user, "username", None)
         return name or "default"
+
+    # ---- lifecycle ---------------------------------------------------------
+
+    def shutdown(self) -> None:
+        """Deterministic teardown of pool resources (encode workers —
+        the GC finalizer is only the backstop for discarded planes)."""
+        self.encode.shutdown()
 
     # ---- invalidation ------------------------------------------------------
 

@@ -9,22 +9,33 @@ with less work than member-by-member execution:
 - **coalescing**: members whose statements are identical (same shape,
   same parameters, same db/timezone) share ONE execution — the common
   case for dashboard fan-out, trivially bit-for-bit.
-- **stacked dispatch**: members identical except for the value of one
-  tag-equality predicate (`... WHERE host = ? ...`) rewrite into a
-  single combined query — the selector tag becomes the leading group
-  key and the predicate becomes `host IN (v1..vN)` — so one stacked
-  segment-aggregate dispatch over the shared scan computes every
-  member's groups. Demultiplexing slices each member's rows back out
-  of the combined result. Per (tag, bucket) group the kernel folds
-  exactly the member's rows in the member's row order, and excluded
-  rows contribute the exact additive/extremal identity, so results are
-  bit-for-bit identical to serial execution (tier-1 asserts this).
+- **vmapped dispatch**: members identical except for the values of
+  *parameter conjuncts* — tag equalities (one or several: multi-tag
+  selectors stack) and time-index comparisons (different windows stack
+  via one scan covering the union, per-member masks) — execute as ONE
+  `jax.vmap`'d kernel over a stacked parameter axis
+  (query/vmapped.py). Each member's output is a slice of the [M, G, F]
+  accumulator: separated by construction, no demux. Per (group, member)
+  the kernel folds exactly the member's rows in the member's row order,
+  and excluded rows land in the dead segment, so results are
+  bit-for-bit identical to serial execution (tier-1 asserts this,
+  including window-union and multi-tag members).
+- **stacked dispatch** (fallback): when the vmapped path declines (a
+  scan part spanning several device blocks, sparse group domains, host
+  aggregates) and the members differ in a single tag equality, the
+  group rewrites into one combined query — the selector tag becomes the
+  leading group key and the predicate becomes `host IN (v1..vN)` — and
+  demultiplexes the combined result, bit-for-bit as before.
 
-Only aggregate shapes whose parity is provable stack (plain
+Only aggregate shapes whose parity is provable batch (plain
 sum/count/min/max/avg over columns, non-empty GROUP BY, a conjunctive
 WHERE); everything else falls back to coalescing or per-member serial
 execution inside the same admission slot. The collection window only
 opens when other queries are in flight — an idle client never pays it.
+
+Batched results carry a shared `encode_memo` dict so the protocol
+servers' result encoders materialize the (identical) wire rows once
+per group, not once per member.
 """
 
 from __future__ import annotations
@@ -41,6 +52,7 @@ from greptimedb_tpu.sql import ast
 from greptimedb_tpu.utils.metrics import (
     QUERY_BATCH_EVENTS,
     QUERY_BATCH_SIZE,
+    VMAP_BATCH_WIDTH,
 )
 
 #: aggregate functions whose masked/stacked evaluation is exactly the
@@ -49,6 +61,9 @@ SAFE_FUNCS = frozenset(
     {"sum", "count", "min", "max", "avg", "mean"})
 
 BATCH_TAG = "__batch_tag"
+
+#: time-index comparison operators that can become stacked parameters
+_TS_PARAM_OPS = frozenset({"=", "<", "<=", ">", ">="})
 
 
 def _replace_node(e, target, repl):
@@ -78,23 +93,48 @@ def _conjuncts(e) -> list:
     return split_conjuncts(e)
 
 
-class BatchShape:
-    """Analysis of one stack-eligible SELECT: which tag selects the
-    member, its value, and the statement with that value masked (the
-    group key — members share it iff they differ ONLY in the value)."""
+def _statement_errors() -> tuple:
+    """Error classes that belong to ONE statement (plan validation,
+    catalog lookups) — a vmapped-dispatch failure of this kind must not
+    latch the path off for the whole process."""
+    from greptimedb_tpu.catalog.catalog import CatalogError
+    from greptimedb_tpu.query.planner import PlanError
 
-    __slots__ = ("tag", "value", "conjunct", "masked")
+    return (PlanError, CatalogError)
 
-    def __init__(self, tag, value, conjunct, masked):
-        self.tag = tag
-        self.value = value
+
+class BatchParam:
+    """One parameter conjunct of a stack-eligible SELECT: the conjunct
+    node (by identity, inside the statement's WHERE), the column it
+    constrains, its kind ("tag" equality | "ts" comparison), and the
+    normalized operator (column on the left)."""
+
+    __slots__ = ("conjunct", "col", "kind", "op")
+
+    def __init__(self, conjunct, col, kind, op):
         self.conjunct = conjunct
+        self.col = col
+        self.kind = kind
+        self.op = op
+
+
+class BatchShape:
+    """Analysis of one batch-eligible SELECT: its parameter conjuncts,
+    this statement's parameter values, and the statement with the
+    parameter literals masked (the group key — members share it iff
+    they differ ONLY in parameter values)."""
+
+    __slots__ = ("params", "values", "masked")
+
+    def __init__(self, params, values, masked):
+        self.params = params  # tuple[BatchParam]
+        self.values = values  # tuple of this statement's literal values
         self.masked = masked
 
 
 def analyze(sel: ast.Select, info) -> Optional[BatchShape]:
-    """None when the statement can't join a stacked group (it may still
-    coalesce with byte-identical statements)."""
+    """None when the statement can't join a parameterized group (it may
+    still coalesce with byte-identical statements)."""
     if (sel.joins or sel.ctes or sel.from_subquery is not None
             or sel.distinct or sel.having is not None or sel.order_by
             or sel.limit is not None or sel.offset
@@ -102,7 +142,11 @@ def analyze(sel: ast.Select, info) -> Optional[BatchShape]:
             or sel.where is None):
         return None
     from greptimedb_tpu.query import range_select as rs
-    from greptimedb_tpu.query.expr import collect_columns, has_aggregate
+    from greptimedb_tpu.query.expr import (
+        _flip,
+        collect_columns,
+        has_aggregate,
+    )
     from greptimedb_tpu.query.planner import _FUNC_CANON
     from greptimedb_tpu.query.window import select_has_window
 
@@ -131,48 +175,66 @@ def analyze(sel: ast.Select, info) -> Optional[BatchShape]:
         return None
     schema = info.schema
     tag_names = {c.name for c in schema.tag_columns}
-    # the selector must not feed the output relation: a tag that is
-    # also a group key / projected column changes shape when batched
+    ts_name = schema.time_index.name
+    # a selector tag must not feed the output relation: a tag that is
+    # also a group key / projected column changes shape when batched.
+    # (The time index IS typically a group key via date_bin — that's
+    # fine: window parameters only mask rows, the bucket key decodes by
+    # value.)
     used: set = set()
     for it in sel.items:
         collect_columns(it.expr, used)
     for g in sel.group_by:
         collect_columns(g, used)
-    conj = _conjuncts(sel.where)
-    selector = None
-    for c in conj:
-        if not (isinstance(c, ast.BinaryOp) and c.op == "="):
+
+    params: list[BatchParam] = []
+    values: list = []
+    for c in _conjuncts(sel.where):
+        if not isinstance(c, ast.BinaryOp):
             continue
-        col, lit = c.left, c.right
+        col, lit, flipped = c.left, c.right, False
         if isinstance(col, ast.Literal) and isinstance(lit, ast.Column):
-            col, lit = lit, col
+            col, lit, flipped = lit, col, True
         if not (isinstance(col, ast.Column) and isinstance(lit, ast.Literal)):
             continue
         if col.table not in (None, sel.table, sel.table_alias):
             continue
-        if col.name in tag_names and col.name not in used \
-                and isinstance(lit.value, str):
-            selector = (c, col.name, lit.value)
-            break
-    if selector is None:
+        op = _flip(c.op) if flipped else c.op
+        if (op == "=" and col.name in tag_names
+                and col.name not in used
+                and isinstance(lit.value, str)):
+            params.append(BatchParam(c, col.name, "tag", "="))
+            values.append(lit.value)
+        elif (op in _TS_PARAM_OPS and col.name == ts_name
+                and isinstance(lit.value, (int, float, str))
+                and not isinstance(lit.value, bool)):
+            params.append(BatchParam(c, col.name, "ts", op))
+            values.append(lit.value)
+    if not params:
         return None
-    conjunct, tag, value = selector
-    marker = ast.BinaryOp("=", ast.Column(tag),
-                          ast.Literal("__gtpu_batch_value__"))
-    masked = repr(dataclasses.replace(
-        sel, where=_replace_node(sel.where, conjunct, marker)))
-    return BatchShape(tag, value, conjunct, masked)
+    masked_where = sel.where
+    for i, p in enumerate(params):
+        marker = ast.BinaryOp(p.op, ast.Column(p.col),
+                              ast.Literal(("__gtpu_batch_param__", i)))
+        masked_where = _replace_node(masked_where, p.conjunct, marker)
+    masked = repr(dataclasses.replace(sel, where=masked_where))
+    return BatchShape(tuple(params), tuple(values), masked)
 
 
 def combined_select(base: ast.Select, shape: BatchShape,
-                    values: list[str]) -> ast.Select:
-    """The stacked rewrite: selector eq -> IN over every member value,
-    selector tag prepended as the leading group key (leading so each
-    member's groups come back as one contiguous, serial-ordered run)
-    and appended to the projection for demux."""
-    tagcol = ast.Column(shape.tag)
+                    values: list[str],
+                    param: BatchParam) -> ast.Select:
+    """The legacy stacked rewrite (single varying tag parameter):
+    selector eq -> IN over every member value, selector tag prepended
+    as the leading group key (leading so each member's groups come back
+    as one contiguous, serial-ordered run) and appended to the
+    projection for demux. `param` names the one VARYING tag parameter —
+    the caller determines it, defaulting would silently rewrite the
+    wrong conjunct on multi-param shapes."""
+    p = param
+    tagcol = ast.Column(p.col)
     in_list = ast.InList(tagcol, tuple(ast.Literal(v) for v in values))
-    new_where = _replace_node(base.where, shape.conjunct, in_list)
+    new_where = _replace_node(base.where, p.conjunct, in_list)
     items = list(base.items) + [ast.SelectItem(tagcol, alias=BATCH_TAG)]
     group_by = [tagcol] + list(base.group_by)
     return dataclasses.replace(base, items=items, group_by=group_by,
@@ -195,8 +257,30 @@ def demux(combined: QueryResult, value: str) -> QueryResult:
         [np.asarray(combined.columns[i])[idx] for i in keep])
 
 
+#: by_value sentinel: this member executes its own statement on its own
+#: thread (the group could not be served batched, and leader-serial
+#: execution would park N-1 admitted threads behind one — pre-batching
+#: traffic ran these queries in parallel and still must)
+SELF_EXECUTE = object()
+
+
+class _Relay:
+    """Fallback coalescing for one distinct non-leader value: the first
+    member with the value executes and publishes here; its duplicates
+    wait on it instead of re-running the same query."""
+
+    __slots__ = ("event", "result", "error", "path")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.result = None
+        self.error = None
+        self.path = None
+
+
 class _Member:
-    __slots__ = ("event", "result", "error", "path", "value", "sel")
+    __slots__ = ("event", "result", "error", "path", "value", "sel",
+                 "self_execute", "relay", "wait_relay")
 
     def __init__(self, value, sel):
         self.event = threading.Event()
@@ -205,6 +289,9 @@ class _Member:
         self.path = None
         self.value = value
         self.sel = sel
+        self.self_execute = False
+        self.relay = None       # publish my self-execution here
+        self.wait_relay = None  # ride another member's self-execution
 
 
 class _Group:
@@ -215,22 +302,36 @@ class _Group:
         self.closed = False
         self.shape = shape
         self.sel = sel
-        self.value = shape.value if shape is not None else None
+        self.value = shape.values if shape is not None else None
 
 
-def _copy(r: QueryResult) -> QueryResult:
+def _copy(r: QueryResult, memo: Optional[dict] = None) -> QueryResult:
     # column arrays shared (read-only downstream); the container is
-    # per-caller so one member's post-processing can't surprise another
-    return QueryResult(list(r.names), list(r.dtypes), list(r.columns))
+    # per-caller so one member's post-processing can't surprise another.
+    # `memo` is the group-shared encode cache: every copy of one
+    # execution's result points at the same dict, so the HTTP/MySQL
+    # encoders materialize the wire rows once per group.
+    out = QueryResult(list(r.names), list(r.dtypes), list(r.columns))
+    if memo is not None:
+        out.encode_memo = memo
+    return out
 
 
 class QueryBatcher:
     def __init__(self, window_s: float = 0.002, max_queries: int = 64,
-                 max_rows: int = 4 << 20, enabled: bool = True):
+                 max_rows: int = 4 << 20, enabled: bool = True,
+                 vmap: bool = True):
         self.window_s = window_s
         self.max_queries = max_queries
         self.max_rows = max_rows
         self.enabled = enabled
+        self.vmap = vmap
+        #: runtime-failure latch (mirrors the fused kernel's
+        #: _FUSED_DISABLED): one unexpected vmapped-dispatch failure —
+        #: compile error, device OOM — routes this and every later
+        #: group to the stacked/serial fallbacks instead of re-failing
+        #: whole batches per window
+        self._vmap_failed = False
         self._lock = threading.Lock()
         self._open: dict[tuple, _Group] = {}
 
@@ -254,7 +355,8 @@ class QueryBatcher:
             g = self._open.get(gkey)
             if g is not None and not g.closed \
                     and len(g.members) < self.max_queries:
-                m = _Member(shape.value if shape is not None else None, sel)
+                m = _Member(shape.values if shape is not None else None,
+                            sel)
                 g.members.append(m)
                 QUERY_BATCH_EVENTS.inc(event="join")
                 joined = True
@@ -263,7 +365,7 @@ class QueryBatcher:
                 self._open[gkey] = g
                 joined = False
         if joined:
-            return self._await(qe, m)
+            return self._await(qe, m, info, ctx)
         interrupted = None
         try:
             if busy and self.window_s > 0:
@@ -282,7 +384,7 @@ class QueryBatcher:
             raise interrupted
         return self._lead(qe, g, info, ctx)
 
-    def _await(self, qe, m: _Member) -> QueryResult:
+    def _await(self, qe, m: _Member, info, ctx) -> QueryResult:
         # wait as long as the leader runs: its execution IS this
         # member's execution, so a slow leader means a slow query, not
         # an overload (the leader sets every member's event in a
@@ -293,8 +395,35 @@ class QueryBatcher:
             pass
         if m.error is not None:
             raise m.error
+        if m.self_execute:
+            # the group fell back without a batched execution for this
+            # member's parameters: run it here, in parallel with the
+            # other members, exactly as un-batched traffic would —
+            # publishing to the relay so duplicates don't re-run it
+            try:
+                res = qe._select_table(m.sel, info, ctx)
+            except BaseException as e:
+                if m.relay is not None:
+                    m.relay.error = e
+                    m.relay.event.set()
+                raise
+            if m.relay is not None:
+                res.encode_memo = {}
+                m.relay.result = res
+                m.relay.path = qe.executor.last_path
+                m.relay.event.set()
+                return _copy(res, res.encode_memo)
+            return res
+        if m.wait_relay is not None:
+            r = m.wait_relay
+            while not r.event.wait(30.0):
+                pass
+            if r.error is not None:
+                raise r.error
+            qe.executor.last_path = r.path
+            return _copy(r.result, r.result.encode_memo)
         qe.executor.last_path = m.path
-        return _copy(m.result)
+        return _copy(m.result, getattr(m.result, "encode_memo", None))
 
     # ---- leader ------------------------------------------------------------
 
@@ -308,13 +437,14 @@ class QueryBatcher:
             if g.shape is None:
                 # every member is statement-identical: one execution
                 res = run(g.sel)
+                res.encode_memo = {}
                 path = qe.executor.last_path
                 QUERY_BATCH_EVENTS.inc(float(len(g.members)),
                                        event="coalesced")
                 for m in g.members:
                     m.result, m.path = res, path
                     m.event.set()
-                return _copy(res)
+                return _copy(res, res.encode_memo)
             order: list = [g.value]
             for m in g.members:
                 if m.value not in order:
@@ -325,36 +455,117 @@ class QueryBatcher:
                 by_value[g.value] = (res, path)
                 QUERY_BATCH_EVENTS.inc(float(len(g.members)),
                                        event="coalesced")
-            elif self._stack_ok(qe, info):
-                combined = combined_select(g.sel, g.shape, sorted(order))
-                full = run(combined)
-                path = (qe.executor.last_path or "") + "+stacked"
-                for v in order:
-                    by_value[v] = (demux(full, v), path)
-                QUERY_BATCH_EVENTS.inc(float(len(order)), event="stacked")
             else:
-                # too big to stack safely: serial per distinct value,
-                # duplicates still coalesce
-                for v in order:
-                    one = g.sel if v == g.value else _replace_node(
-                        g.sel, g.shape.conjunct,
-                        ast.BinaryOp("=", ast.Column(g.shape.tag),
-                                     ast.Literal(v)))
-                    by_value[v] = (run(one), qe.executor.last_path)
-                QUERY_BATCH_EVENTS.inc(float(len(order)),
-                                       event="serial_fallback")
+                by_value = self._execute_group(qe, g, info, ctx, order,
+                                               run)
+            for v, entry in by_value.items():
+                if entry is not SELF_EXECUTE:
+                    entry[0].encode_memo = {}
+            relays: dict = {}
             for m in g.members:
-                m.result, m.path = by_value[m.value]
+                entry = by_value[m.value]
+                if entry is SELF_EXECUTE:
+                    r = relays.get(m.value)
+                    if r is None:
+                        # first member with this value executes for all
+                        # its duplicates (one execution per distinct
+                        # value, like the old leader-serial fallback —
+                        # but in parallel across values)
+                        relays[m.value] = m.relay = _Relay()
+                        m.self_execute = True
+                    else:
+                        m.wait_relay = r
+                else:
+                    m.result, m.path = entry
                 m.event.set()
-            res, path = by_value[g.value]
+            res, path = by_value[g.value]  # the leader always executes
             qe.executor.last_path = path
-            return _copy(res)
+            return _copy(res, res.encode_memo)
         except BaseException as e:
             for m in g.members:
                 if not m.event.is_set():
                     m.error = e
                     m.event.set()
             raise
+
+    def _execute_group(self, qe, g: _Group, info, ctx, order, run) -> dict:
+        """Execute one multi-member group: vmapped stacked axis first,
+        the legacy IN-list rewrite for single-tag shapes it declines,
+        serial per distinct member as the last resort."""
+        by_value: dict = {}
+        shape = g.shape
+        if self.vmap and not self._vmap_failed \
+                and self._stack_ok(qe, info):
+            from greptimedb_tpu.query.vmapped import (
+                VmapIneligible,
+                run_vmapped,
+            )
+
+            from greptimedb_tpu.fault import FaultError, Unavailable
+
+            try:
+                results = run_vmapped(qe.executor, g.sel, info,
+                                      shape.params, order)
+            except VmapIneligible:
+                pass
+            except (Unavailable, FaultError):
+                # typed, transient (region unavailable, chaos seam):
+                # the fallbacks reproduce the real per-member error or
+                # ride a retry — no reason to disable the path forever
+                pass
+            except _statement_errors():
+                # statement-scoped (a DDL race invalidating the plan, a
+                # bad literal): the member's own serial run surfaces the
+                # same error; the NEXT group is healthy — don't latch
+                pass
+            except Exception:  # noqa: BLE001 — members must not inherit
+                # a batched-dispatch infra failure (compile error,
+                # device OOM) their serial runs would not hit; latch,
+                # degrade, and let the fallbacks serve the group
+                self._vmap_failed = True
+                QUERY_BATCH_EVENTS.inc(event="vmapped_failed")
+                import logging
+
+                logging.getLogger("greptimedb_tpu.batcher").exception(
+                    "vmapped dispatch failed; latching fallback")
+            else:
+                path = qe.executor.last_path or "dense_vmapped"
+                for v, res in zip(order, results):
+                    by_value[v] = (res, path)
+                QUERY_BATCH_EVENTS.inc(float(len(order)), event="vmapped")
+                VMAP_BATCH_WIDTH.observe(float(len(order)))
+                return by_value
+        # IN-list rewrite fallback: only one parameter actually varies
+        # across the members and it is a tag equality (the constant
+        # window/tag conjuncts stay literal in the leader's statement)
+        varying = [j for j in range(len(shape.params))
+                   if len({v[j] for v in order}) > 1]
+        single_tag = (len(varying) == 1
+                      and shape.params[varying[0]].kind == "tag")
+        if single_tag and self._stack_ok(qe, info):
+            j = varying[0]
+            vals = sorted({v[j] for v in order})
+            combined = combined_select(g.sel, shape, vals,
+                                       param=shape.params[j])
+            full = run(combined)
+            path = (qe.executor.last_path or "") + "+stacked"
+            for v in order:
+                by_value[v] = (demux(full, v[j]), path)
+            QUERY_BATCH_EVENTS.inc(float(len(order)), event="stacked")
+            return by_value
+        # vmapped declined and the IN-list rewrite doesn't cover the
+        # shape (or the scan is too big to stack safely): the leader
+        # executes ITS statement (members sharing its parameters still
+        # coalesce onto it); everyone else self-executes on their own
+        # thread — pre-batching traffic ran these distinct queries in
+        # parallel, and a leader-serial loop would park N-1 admitted
+        # threads behind one
+        by_value[g.value] = (run(g.sel), qe.executor.last_path)
+        for v in order:
+            if v != g.value:
+                by_value[v] = SELF_EXECUTE
+        QUERY_BATCH_EVENTS.inc(float(len(order)), event="serial_fallback")
+        return by_value
 
     def _stack_ok(self, qe, info) -> bool:
         """Stacked parity needs the whole scan in one kernel dispatch:

@@ -1,0 +1,147 @@
+"""Bounded result-encode pool: serving I/O off the engine threads.
+
+The 50-client qps bench is parse/JSON-bound on host threads: every
+connection thread that just finished executing re-enters the GIL to
+materialize Python row objects and JSON-encode them, convoying with the
+threads still executing queries. The pool bounds that contention:
+
+- the admission slot is released at *execute-done* (the engine holds it
+  only inside `execute_sql`), so serialization never occupies an
+  execution slot;
+- at most `workers` serializations run at once — the other request
+  threads park on a future (releasing the GIL) instead of thrashing it;
+- the encoders themselves are columnar (servers/encode.py): numpy
+  C-loop casts and a single C `json.dumps`, no per-value Python
+  sanitization, and batched results share one materialization through
+  their group `encode_memo`;
+- `process=True` ([concurrency] encode_process_pool) moves the
+  serialization into spawn-mode worker processes for a true GIL
+  escape — worth it only for very large result sets, so it is opt-in.
+
+Saturation degrades, never drops: when every worker is busy and the
+queue is full, the request thread encodes inline (the pre-pool
+behavior), counted as `encode_pool_events_total{event="inline"}`.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import ProcessPoolExecutor, ThreadPoolExecutor
+from typing import Optional
+
+from greptimedb_tpu.utils.metrics import (
+    ENCODE_POOL_EVENTS,
+    ENCODE_POOL_QUEUE_DEPTH,
+)
+
+
+def _auto_workers() -> int:
+    import os
+
+    return max(2, min(8, (os.cpu_count() or 4) // 2))
+
+
+class EncodePool:
+    def __init__(self, workers: int = 0, queue_size: int = 64,
+                 process: bool = False, enabled: bool = True,
+                 min_rows: int = 256):
+        self.workers = workers if workers > 0 else _auto_workers()
+        self.queue_size = max(1, int(queue_size))
+        self.process = process
+        self.enabled = enabled
+        self.min_rows = max(0, int(min_rows))
+        self._lock = threading.Lock()
+        self._executor = None
+        self._inflight = 0
+
+    # ---- lifecycle ---------------------------------------------------------
+
+    def _pool(self):
+        """Lazy executor construction: servers that never serve a query
+        (storage-only datanodes) must not spawn encode workers."""
+        with self._lock:
+            if self._executor is None:
+                if self.process:
+                    import multiprocessing
+
+                    # spawn, not fork: the serving process has live JAX
+                    # runtime threads a fork would copy mid-lock
+                    self._executor = ProcessPoolExecutor(
+                        max_workers=self.workers,
+                        mp_context=multiprocessing.get_context("spawn"))
+                else:
+                    self._executor = ThreadPoolExecutor(
+                        max_workers=self.workers,
+                        thread_name_prefix="gtpu-encode")
+                # a discarded plane (tests, embedded engines) must not
+                # leak idle workers until interpreter exit
+                import weakref
+
+                weakref.finalize(self, self._executor.shutdown,
+                                 wait=False)
+            return self._executor
+
+    def shutdown(self) -> None:
+        with self._lock:
+            ex, self._executor = self._executor, None
+        if ex is not None:
+            ex.shutdown(wait=False)
+
+    # ---- entry -------------------------------------------------------------
+
+    def run(self, fn, *args, cost_rows: Optional[int] = None):
+        """Run `fn(*args)` on a pool worker and wait for the bytes; the
+        calling request thread sleeps on the future (GIL released)
+        instead of competing for it. Falls back to inline encoding when
+        the pool is disabled or saturated — output is byte-identical
+        either way (same encoder function). `cost_rows` gates the
+        handoff: a dashboard-sized result encodes in microseconds, and
+        a thread handoff would cost more than it saves — those encode
+        inline without touching the pool."""
+        if not self.enabled:
+            return fn(*args)
+        if cost_rows is not None and cost_rows < self.min_rows:
+            ENCODE_POOL_EVENTS.inc(event="small_inline")
+            return fn(*args)
+        with self._lock:
+            if self._inflight >= self.queue_size:
+                saturated = True
+            else:
+                saturated = False
+                self._inflight += 1
+                ENCODE_POOL_QUEUE_DEPTH.set(float(self._inflight))
+        if saturated:
+            ENCODE_POOL_EVENTS.inc(event="inline")
+            return fn(*args)
+        try:
+            try:
+                fut = self._pool().submit(fn, *args)
+            except RuntimeError:
+                # executor torn down concurrently (submit after
+                # shutdown): the request still gets its bytes. Errors
+                # raised by the encoder itself propagate from
+                # fut.result() below — they must NOT be retried inline
+                ENCODE_POOL_EVENTS.inc(event="inline")
+                return fn(*args)
+            ENCODE_POOL_EVENTS.inc(
+                event="offload_process" if self.process else "offload")
+            if self.process:
+                # a worker PROCESS observes its metrics into its own
+                # registry (lost to the parent's /metrics) — time the
+                # round trip here so the encode split stays visible
+                import time
+
+                from greptimedb_tpu.utils.metrics import ENCODE_SECONDS
+
+                t0 = time.perf_counter()
+                out = fut.result()
+                ENCODE_SECONDS.observe(time.perf_counter() - t0,
+                                       protocol="process")
+                return out
+            return fut.result()
+        finally:
+            with self._lock:
+                self._inflight -= 1
+                ENCODE_POOL_QUEUE_DEPTH.set(float(self._inflight))
+
+

@@ -46,6 +46,11 @@ SCOPE_FILES = (
     "greptimedb_tpu/storage/wal.py",
     "greptimedb_tpu/storage/group_commit.py",
     "greptimedb_tpu/query/device_cache.py",
+    # serving path: the vmapped batch leader and the result-encode
+    # seam run under the batch-window/encode-pool locks (the
+    # concurrency/ package itself is scope-prefixed)
+    "greptimedb_tpu/query/vmapped.py",
+    "greptimedb_tpu/servers/encode.py",
 )
 
 LOCK_CTORS = {"threading.Lock": "lock", "threading.RLock": "rlock",

@@ -55,6 +55,9 @@ class QueryEngine:
         # routes through it (pass concurrency= to inject a tuned one)
         self.concurrency = concurrency if concurrency is not None \
             else ConcurrencyPlane()
+        # per-thread statement-scope flags (plan-cache skip noted once
+        # per top-level statement)
+        self._skip_tls = threading.local()
         from collections import OrderedDict
 
         self._stmt_cache: "OrderedDict[str, list]" = OrderedDict()
@@ -153,6 +156,9 @@ class QueryEngine:
         # statement authorization (reference checks permissions in the
         # frontend before dispatch, src/frontend/src/instance.rs:305-338)
         self.permission_checker.check(ctx.user, stmt, ctx.db)
+        # new top-level statement: its first plan-cache skip (if any)
+        # is the one that gets counted/recorded
+        self._skip_tls.noted = False
         from greptimedb_tpu.utils import tracing
         from greptimedb_tpu.utils.metrics import STMT_DURATION
         ctx.trace_id = tracing.set_trace(ctx.trace_id)
@@ -629,10 +635,33 @@ class QueryEngine:
 
     # ---- SELECT ------------------------------------------------------------
 
+    def _note_plan_cache_skip(self, reason: str) -> None:
+        """A statement shape the plan cache cannot hold: count it with a
+        reason label and stamp the slow-query record, so an uncacheable
+        dashboard query is visible instead of just slow. Once per
+        top-level statement — a CTE body re-entering _select must not
+        double-count or overwrite the outer statement's reason."""
+        if not self.concurrency.plan_cache.enabled:
+            return
+        if getattr(self._skip_tls, "noted", False):
+            return
+        self._skip_tls.noted = True
+        from greptimedb_tpu.utils import slow_query
+        from greptimedb_tpu.utils.metrics import PLAN_CACHE_EVENTS
+
+        PLAN_CACHE_EVENTS.inc(event="skip", reason=reason)
+        slow_query.annotate(plan_cache_skip=reason)
+
     def _select(self, sel: ast.Select, ctx: QueryContext) -> QueryResult:
         from greptimedb_tpu.catalog import information_schema as infoschema
         from greptimedb_tpu.query.join import execute_select_over
 
+        if sel.ctes:
+            self._note_plan_cache_skip("cte")
+        elif sel.joins:
+            self._note_plan_cache_skip("join")
+        elif sel.from_subquery is not None:
+            self._note_plan_cache_skip("subquery")
         if sel.ctes:
             # WITH ...: run each CTE once, visible to later CTEs and the
             # body (reference: DataFusion CTE planning)
@@ -701,6 +730,7 @@ class QueryEngine:
         from greptimedb_tpu.query.window import select_has_window
 
         if select_has_window(sel):
+            self._note_plan_cache_skip("window")
             if sel.group_by:
                 # SQL evaluation order: aggregate first (full device agg
                 # path — all aggregate functions), then windows over the
@@ -752,6 +782,7 @@ class QueryEngine:
                 dict(zip(base.names, base.dtypes)),
                 alias=sel.table_alias or sel.table)
         if rs.is_range_select(sel):
+            self._note_plan_cache_skip("range_select")
             rplan = rs.plan_range_select(sel, info)
             return rs.execute_range_select(self.executor, rplan)
         # shape-keyed plan cache: repeated dashboard statements re-bind

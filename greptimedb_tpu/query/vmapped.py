@@ -1,0 +1,420 @@
+"""vmap'd multi-query execution: a batch of parameter-sibling queries
+as ONE device program.
+
+The cross-query batcher (concurrency/batcher.py) collects SELECTs that
+share a plan shape but differ in parameter literals — which host, which
+datacenter, which time window. The previous stacked path rewrote the
+group into one IN-list query and demultiplexed the combined result;
+that only covers a single tag-equality selector and forces every member
+onto the same time window. Here the members' parameters become a
+STACKED AXIS instead: the scan, group ids, and value planes are built
+once (they are member-invariant), each member contributes only its
+per-row predicate mask, and `jax.vmap` maps the masked segment
+reduction over the member axis — one dispatch computes an [M, G, F]
+accumulator whose member slices are separated by construction. No
+rewrite, no demux.
+
+Bit-for-bit parity with serial execution is by masking identity, not by
+approximation: the kernel scans the region's full row set and routes
+every row a member's WHERE rejects into the dead segment — exactly what
+the serial kernels do with their own masks — so a member's per-segment
+fold visits precisely the rows its serial run would, in the same order.
+Two structural conditions keep the fold association identical too, and
+`run_vmapped` refuses (raises `VmapIneligible`, the batcher falls back
+to the stacked/serial paths) when they don't hold:
+
+- every scan part maps to ONE device block (so a serial scan of any
+  sub-window, which decodes a row-subset of each part, splits partials
+  at the same part seams — inserting identity elements into a left fold
+  preserves every partial sum exactly);
+- the member's whole predicate decomposes into shared conjuncts plus
+  `column <op> literal` parameter conjuncts the kernel can evaluate
+  from a stacked array (tag equality by dictionary code, time-index
+  comparisons in storage units — bound through the SAME `bind_expr`
+  the serial path uses, so literal coercion cannot drift).
+
+Window-union batching falls out for free: members with different time
+windows share the one full scan and differ only in their ts-comparison
+parameters; multi-tag selectors are just several tag parameters.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from greptimedb_tpu.query import logical as lp
+from greptimedb_tpu.query import physical as ph
+from greptimedb_tpu.query.expr import (
+    BindContext,
+    bind_expr,
+    eval_device,
+    extract_ts_bounds,
+    split_conjuncts,
+)
+from greptimedb_tpu.ops.segment import segment_agg
+from greptimedb_tpu.sql import ast
+
+
+class VmapIneligible(Exception):
+    """This batch group cannot ride the vmapped kernel with provable
+    serial parity — the batcher falls back to stacked/serial paths."""
+
+
+#: member-axis padding buckets: compile one executable per (shape,
+#: width bucket) instead of one per batch width
+_WIDTH_BUCKETS = (2, 4, 8, 16, 32, 64, 128)
+
+
+def _pad_width(m: int) -> int:
+    for b in _WIDTH_BUCKETS:
+        if m <= b:
+            return b
+    return m
+
+
+def _rebuild_conjunction(conjuncts: list) -> Optional[ast.Expr]:
+    if not conjuncts:
+        return None
+    e = conjuncts[0]
+    for c in conjuncts[1:]:
+        e = ast.BinaryOp("and", e, c)
+    return e
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("shared_where", "param_specs", "keys", "agg_args",
+                     "ops", "num_segments", "tag_names", "schema",
+                     "acc_dtype", "float_ops", "pack_dtype"),
+)
+def _vmapped_agg_scan(
+    blocks: tuple,  # per-block col dicts (member-invariant)
+    n_valids: jax.Array,
+    dedup_masks,
+    params: tuple,  # per-spec [M] stacked parameter arrays
+    *,
+    shared_where, param_specs, keys, agg_args, ops, num_segments,
+    tag_names, schema, acc_dtype, float_ops, pack_dtype,
+):
+    """One dispatch for M parameter-sibling queries. Everything that
+    does not depend on the member parameters (group ids, value planes,
+    the shared-predicate mask) is traced once and stays unbatched;
+    only the per-member mask and the segment reductions carry the
+    vmapped leading axis."""
+
+    def member(pvals):
+        acc = None
+        for i, cols in enumerate(blocks):
+            some = next(iter(cols.values()))
+            mask = jnp.arange(some.shape[0]) < n_valids[i]
+            if dedup_masks is not None:
+                mask = mask & dedup_masks[i]
+            if shared_where is not None:
+                w = eval_device(shared_where, cols, tag_names, schema)
+                mask = mask & (w if w.dtype == jnp.bool_ else w != 0)
+            for (name, op), pv in zip(param_specs, pvals):
+                c = cols[name]
+                if op == "=":
+                    mask = mask & (c == pv)
+                elif op == "<":
+                    mask = mask & (c < pv)
+                elif op == "<=":
+                    mask = mask & (c <= pv)
+                elif op == ">":
+                    mask = mask & (c > pv)
+                else:  # ">="
+                    mask = mask & (c >= pv)
+            gid = ph._group_ids(cols, keys, mask.shape[0])
+            if agg_args:
+                values = ph._value_planes(agg_args, cols, tag_names,
+                                          schema, mask.shape, acc_dtype)
+            else:
+                values = jnp.zeros((mask.shape[0], 1), dtype=acc_dtype)
+            part = segment_agg(values, gid, mask, num_segments, ops=ops)
+            acc = ph._combine_partials(acc, part)
+        parts = []
+        for k in float_ops:
+            v = acc[k]
+            if v.ndim == 1:
+                v = v[:, None]
+            parts.append(v.astype(pack_dtype))
+        return jnp.concatenate(parts, axis=1)
+
+    return jax.vmap(member)(params)
+
+
+def _bind_param(pspec, value, bctx) -> tuple:
+    """One member's value for one parameter conjunct, bound through the
+    engine's own literal coercion. Returns (device column name, op,
+    bound int). Tag equality binds to a dictionary code, time-index
+    comparisons coerce to storage units — identical to what the serial
+    path's bound WHERE would compare against."""
+    conj = ast.BinaryOp(pspec.op, ast.Column(pspec.col), ast.Literal(value))
+    bound = bind_expr(conj, bctx)
+    if not (isinstance(bound, ast.BinaryOp)
+            and isinstance(bound.left, ast.Column)
+            and isinstance(bound.right, ast.Literal)
+            and isinstance(bound.right.value, (int, np.integer))
+            and not isinstance(bound.right.value, bool)):
+        raise VmapIneligible(f"unbindable parameter {pspec.col} {pspec.op}")
+    return bound.left.name, bound.op, int(bound.right.value)
+
+
+def run_vmapped(executor, sel: ast.Select, info, pspecs,
+                member_values: list) -> list:
+    """Execute `sel`'s shape once for every member value tuple; returns
+    QueryResults aligned with `member_values`. Raises VmapIneligible
+    when the shape/scan cannot guarantee bit-for-bit serial parity."""
+    from greptimedb_tpu import config
+    from greptimedb_tpu.query.planner import plan_select
+
+    plan = plan_select(sel, info)
+    node = plan
+    if not isinstance(node, lp.Project):
+        raise VmapIneligible("plan root is not a projection")
+    project = node
+    node = node.input
+    if not isinstance(node, lp.Aggregate):
+        raise VmapIneligible("not an aggregate shape")
+    agg = node
+    node = node.input
+    if not isinstance(node, lp.Filter):
+        raise VmapIneligible("no predicate to parameterize")
+    template_where = node.predicate
+    node = node.input
+    if not isinstance(node, lp.Scan):
+        raise VmapIneligible("unexpected scan node")
+    scan_node = node
+    table = scan_node.table
+    schema = table.schema
+    ts_name = schema.time_index.name
+
+    if len(table.region_ids) != 1 or not hasattr(executor.engine, "scan"):
+        raise VmapIneligible("multi-region scans gather via fragments")
+    if any(ph._needs_host_agg(spec, schema) for spec in agg.aggs):
+        raise VmapIneligible("host-side aggregate in batch shape")
+
+    # split the predicate: parameter conjuncts out, shared rest stays.
+    # plan_select passes sel.where through by reference, so the
+    # batcher-identified conjunct objects are found by identity.
+    param_ids = {id(p.conjunct) for p in pspecs}
+    shared = [c for c in split_conjuncts(template_where)
+              if id(c) not in param_ids]
+    if len(shared) + len(pspecs) != len(split_conjuncts(template_where)):
+        raise VmapIneligible("parameter conjuncts lost in planning")
+    shared_where_ast = _rebuild_conjunction(shared)
+
+    # union time range (drives only the bucket-key domain; the scan
+    # itself reads the full region so every member's serial scan is a
+    # per-part row-subset of it)
+    lo = hi = None
+    lo_open = hi_open = False
+    for values in member_values:
+        repl = {id(p.conjunct): ast.BinaryOp(
+            p.op, ast.Column(p.col), ast.Literal(v))
+            for p, v in zip(pspecs, values)}
+        member_where = _replace_by_id(template_where, repl)
+        r = extract_ts_bounds(member_where, ts_name,
+                              schema.time_index.dtype)
+        mlo, mhi = r if r is not None else (None, None)
+        if mlo is None:
+            lo_open = True
+        elif lo is None or mlo < lo:
+            lo = mlo
+        if mhi is None:
+            hi_open = True
+        elif hi is None or mhi > hi:
+            hi = mhi
+    union_range = None
+    if not (lo_open and hi_open):
+        union_range = (None if lo_open else lo, None if hi_open else hi)
+        if union_range == (None, None):
+            union_range = None
+
+    # one scan covering the UNION of the member windows (tag predicates
+    # stay None: every member's rows must be present); member masks
+    # carve their slices on device. Region.scan's own covering-range
+    # widening keeps the parity cases aligned: if any member's serial
+    # scan would widen to the full region, the union (a superset range)
+    # widens too, so the one-block-per-part gate below always runs over
+    # a superset of every member's decoded parts.
+    scan = executor.engine.scan(table.region_ids[0],
+                                ph._closed_range(union_range),
+                                scan_node.columns, None)
+    if scan is None or scan.num_rows == 0:
+        raise VmapIneligible("empty scan: serial path settles it")
+    if table.append_mode and \
+            scan.num_rows >= config.stream_threshold_rows():
+        raise VmapIneligible("serial path would stream this scan")
+    if executor.mesh is not None and \
+            scan.num_rows >= config.mesh_min_rows():
+        raise VmapIneligible("serial path would shard over the mesh")
+
+    # parity gate: one device block per part seam (see module docstring)
+    block_plan = ph._block_plan(scan)
+    seen: set = set()
+    for entry in block_plan:
+        seam = (entry.pkey, entry.part_start)
+        if seam in seen:
+            raise VmapIneligible("a scan part spans multiple blocks")
+        seen.add(seam)
+
+    bctx = BindContext(schema, scan.tag_dicts)
+    bound_shared = bind_expr(shared_where_ast, bctx) \
+        if shared_where_ast is not None else None
+
+    # stacked parameter matrix: [n_specs][M] bound ints
+    cols_ops: list[tuple] = []
+    matrix: list[list[int]] = [[] for _ in pspecs]
+    for values in member_values:
+        for j, (p, v) in enumerate(zip(pspecs, values)):
+            name, op, bval = _bind_param(p, v, bctx)
+            if len(cols_ops) <= j:
+                cols_ops.append((name, op))
+            elif cols_ops[j] != (name, op):
+                raise VmapIneligible("parameter spec drift across members")
+            matrix[j].append(bval)
+
+    # group keys over the union scan; decode is value-based, so a base
+    # shift against a member's narrower serial window is invisible
+    scan_node_u = lp.Scan(table, scan_node.columns, union_range)
+    keys: list = []
+    decoders: list = []
+    extra_cols: dict[str, np.ndarray] = {}
+    for i, (name, kexpr) in enumerate(agg.keys):
+        dk, decode = executor._plan_key(i, kexpr, bctx, scan, scan_node_u,
+                                        extra_cols)
+        keys.append(dk)
+        decoders.append(decode)
+    num_groups = 1
+    for k in keys:
+        num_groups *= k.size
+    if not keys or num_groups > config.dense_groups_max() \
+            or num_groups >= ph._GID_SENTINEL:
+        raise VmapIneligible(f"group domain {num_groups} needs sparse path")
+    # the stacked axis multiplies the accumulator: bound M*G by the
+    # same dense budget one serial query is allowed, so a wide batch
+    # over a near-max group domain can't ask XLA for a multi-GB output
+    if _pad_width(len(member_values)) * num_groups \
+            > config.dense_groups_max():
+        raise VmapIneligible("stacked accumulator exceeds dense budget")
+
+    # aggregate layout (mirrors _stream_agg_inner's dense packing)
+    arg_exprs: list = []
+    spec_slot: list = []
+    for spec in agg.aggs:
+        if spec.arg is None:
+            spec_slot.append(None)
+            continue
+        b = bind_expr(spec.arg, bctx)
+        if b not in arg_exprs:
+            arg_exprs.append(b)
+        spec_slot.append(arg_exprs.index(b))
+    ops: set = {"rows"}
+    for spec in agg.aggs:
+        ops.update(ph._PRIMITIVES[spec.func])
+    if {"first", "last"} & ops:
+        raise VmapIneligible("first/last need the ts-paired planes")
+
+    acc_dtype = jnp.dtype(config.compute_dtype())
+    nf = max(len(arg_exprs), 1)
+    float_ops_l, widths = [], {}
+    for op in sorted(ops):
+        float_ops_l.append(op)
+        widths[op] = 1 if op == "rows" else nf
+    float_ops = tuple(float_ops_l)
+    pack_dtype = jnp.dtype(jnp.float64) if num_groups <= 4096 else acc_dtype
+    if not jnp.issubdtype(pack_dtype, jnp.floating):
+        pack_dtype = jnp.dtype(jnp.float64)
+    if "sumsq" in float_ops:
+        pack_dtype = jnp.dtype(jnp.float64)
+
+    dedup_mask = executor._maybe_dedup(scan, table, bctx)
+    tag_names = frozenset(bctx.tag_names)
+    float_fields = {c.name for c in schema.field_columns
+                    if c.dtype.is_float}
+    device_col_names = executor._device_columns(
+        scan, bound_shared, keys, tuple(arg_exprs), ts_name, extra_cols)
+    for name, _op in cols_ops:
+        if name not in device_col_names:
+            device_col_names.append(name)
+
+    tier = executor.tier_for(agg, scan.num_rows)
+    executor.last_tier = tier
+
+    def fetch_block(entry, prefetch_only=False):
+        out = {}
+        for name in device_col_names:
+            out[name] = executor._device_block(
+                scan, name, entry, extra_cols,
+                acc_dtype if name in float_fields else None,
+                prefetch_only=prefetch_only)
+        return out
+
+    m = len(member_values)
+    mp = _pad_width(m)
+    params = []
+    for j, (name, _op) in enumerate(cols_ops):
+        dt = np.int64 if name == ts_name else np.int32
+        vals = matrix[j] + [matrix[j][-1]] * (mp - m)
+        params.append(jnp.asarray(np.asarray(vals, dtype=dt)))
+
+    with ph._TierCtx(tier):
+        blocks, n_valids, dmasks = executor._gather_blocks(
+            scan, block_plan, fetch_block, dedup_mask)
+        packed = _vmapped_agg_scan(
+            tuple(blocks), jnp.asarray(np.asarray(n_valids)),
+            tuple(dmasks) if dmasks is not None else None,
+            tuple(params),
+            shared_where=bound_shared, param_specs=tuple(cols_ops),
+            keys=tuple(keys), agg_args=tuple(arg_exprs),
+            ops=tuple(sorted(ops)), num_segments=num_groups,
+            tag_names=tag_names, schema=schema, acc_dtype=acc_dtype,
+            float_ops=float_ops, pack_dtype=pack_dtype)
+        host = ph._readback(packed)
+
+    results = []
+    host_info = (scan, extra_cols, bound_shared, bctx, num_groups)
+    for i in range(m):
+        acc: dict = {}
+        off = 0
+        for k in float_ops:
+            w = widths[k]
+            sl = host[i][:, off:off + w]
+            off += w
+            if k in ("count", "rows"):
+                sl = sl.astype(np.int64)
+            acc[k] = sl
+        results.append(executor._agg_tail(
+            acc, None, agg, keys, decoders, spec_slot, host_info,
+            None, project, None, None, None, table))
+    executor.last_path = "dense_vmapped"
+    return results
+
+
+def _replace_by_id(e, repl: dict):
+    """Rebuild `e` with nodes replaced by identity (id(node) -> new)."""
+    r = repl.get(id(e))
+    if r is not None:
+        return r
+    if isinstance(e, (list, tuple)):
+        return type(e)(_replace_by_id(x, repl) for x in e)
+    if dataclasses.is_dataclass(e) and not isinstance(e, type) \
+            and not isinstance(e, ast.Statement):
+        changes = {}
+        for f in dataclasses.fields(e):
+            v = getattr(e, f.name)
+            if isinstance(v, (ast.Expr, list, tuple)) or (
+                    dataclasses.is_dataclass(v)
+                    and not isinstance(v, (type, ast.Statement))):
+                nv = _replace_by_id(v, repl)
+                if nv is not v:
+                    changes[f.name] = nv
+        return dataclasses.replace(e, **changes) if changes else e
+    return e

@@ -307,6 +307,8 @@ class _Handler(BaseHTTPRequestHandler):
     # ---- /v1/sql (reference http.rs:724 sql handler) -----------------------
 
     def _handle_sql(self):
+        from greptimedb_tpu.servers.encode import encode_sql_payload
+
         params = self._form_or_query()
         sql = params.get("sql")
         if not sql:
@@ -315,15 +317,19 @@ class _Handler(BaseHTTPRequestHandler):
         t0 = time.perf_counter()
         with QUERY_DURATION.time(kind="sql"):
             results = self.query_engine.execute_sql(sql, ctx)
+        # the admission slot was released inside execute_sql (at
+        # execute-done): serialization below never occupies an
+        # execution slot, and runs on the bounded encode pool rather
+        # than this request thread (byte-identical either way)
         elapsed = round((time.perf_counter() - t0) * 1000, 3)
-        out = []
-        for r in results:
-            if not r.is_query:
-                out.append({"affectedrows": r.affected_rows})
-            else:
-                out.append({"records": _records_json(r)})
-        self._send(200, {"code": 0, "output": out,
-                         "execution_time_ms": elapsed})
+        pool = getattr(self.query_engine.concurrency, "encode", None)
+        if pool is not None:
+            rows = sum(r.num_rows for r in results if r.is_query)
+            data = pool.run(encode_sql_payload, results, elapsed,
+                            cost_rows=rows)
+        else:
+            data = encode_sql_payload(results, elapsed)
+        self._send(200, data)
 
     # ---- Prometheus API (reference http.rs:724-744) ------------------------
 
@@ -607,28 +613,11 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 def _records_json(r: QueryResult) -> dict:
-    schema = {"column_schemas": [
-        {"name": n, "data_type": (dt.value if dt else "string")}
-        for n, dt in zip(r.names, r.dtypes)
-    ]}
-    return {"schema": schema, "rows": _json_rows(r), "total_rows": r.num_rows}
+    # columnar encoding (timestamps stay epoch ints, like greptime's
+    # HTTP default) — shared with the encode-pool workers
+    from greptimedb_tpu.servers.encode import records_json
 
-
-def _json_rows(r: QueryResult) -> list:
-    rows = r.rows()
-    # make timestamps ISO strings is greptime-like; keep raw ints (greptime
-    # returns epoch values over HTTP by default)
-    return [[_json_safe(v) for v in row] for row in rows]
-
-
-def _json_safe(v):
-    if isinstance(v, float) and (math.isnan(v) or math.isinf(v)):
-        return None
-    if isinstance(v, (np.integer,)):
-        return int(v)
-    if isinstance(v, (np.floating,)):
-        return float(v)
-    return v
+    return records_json(r)
 
 
 def _matrix_json(times: np.ndarray, sm) -> dict:

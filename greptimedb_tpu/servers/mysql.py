@@ -24,8 +24,6 @@ import struct
 import threading
 from typing import Optional
 
-import numpy as np
-
 from greptimedb_tpu.fault import Unavailable
 from greptimedb_tpu.query.engine import QueryContext, QueryEngine
 
@@ -78,18 +76,14 @@ MYSQL_TYPE_DECIMAL = 0
 MYSQL_TYPE_NEWDECIMAL = 246
 
 
-def lenc_int(n: int) -> bytes:
-    if n < 251:
-        return bytes([n])
-    if n < 1 << 16:
-        return b"\xfc" + struct.pack("<H", n)
-    if n < 1 << 24:
-        return b"\xfd" + struct.pack("<I", n)[:3]
-    return b"\xfe" + struct.pack("<Q", n)
-
-
-def lenc_str(s: bytes) -> bytes:
-    return lenc_int(len(s)) + s
+# wire fragments shared with the encode-pool workers (servers/encode.py)
+from greptimedb_tpu.servers.encode import (  # noqa: E402
+    _coldef,
+    _eof,
+    encode_mysql_result,
+    encode_mysql_rows,
+    lenc_int,
+)
 
 
 class _PacketIO:
@@ -275,7 +269,8 @@ class _Session(socketserver.BaseRequestHandler):
                 except Exception as e:  # noqa: BLE001 — wire must stay up
                     io.send_packet(_err(1064, "42000", str(e)[:400]))
                     continue
-                _send_result(io, result, binary=True)
+                _send_result(io, result, binary=True,
+                             pool=_encode_pool(server))
                 continue
             if cmd == COM_STMT_CLOSE:
                 stmts.pop(struct.unpack("<I", body[:4])[0], None)
@@ -301,7 +296,7 @@ class _Session(socketserver.BaseRequestHandler):
             except Exception as e:  # noqa: BLE001 — wire must stay up
                 io.send_packet(_err(1064, "42000", str(e)[:400]))
                 continue
-            _send_result(io, result)
+            _send_result(io, result, pool=_encode_pool(server))
 
 
 def _dispatch(engine: QueryEngine, sql: str, ctx: QueryContext):
@@ -322,7 +317,10 @@ def _dispatch(engine: QueryEngine, sql: str, ctx: QueryContext):
     res = engine.execute_one(sql, ctx)
     if not res.is_query:
         return ("affected", res.affected_rows)
-    return ("rows", list(res.names), res.rows())
+    # the QueryResult itself, NOT materialized rows: row building is
+    # the GIL-heaviest half of serialization and belongs on the encode
+    # pool (encode_mysql_result), not the session thread
+    return ("result", res)
 
 
 _SESSION_VARS = {
@@ -558,75 +556,48 @@ def _ok(affected: int = 0) -> bytes:
     return b"\x00" + lenc_int(affected) + lenc_int(0) + struct.pack("<H", 0x0002) + struct.pack("<H", 0)
 
 
-def _eof() -> bytes:
-    return b"\xfe" + struct.pack("<H", 0) + struct.pack("<H", 0x0002)
-
-
 def _err(code: int, state: str, msg: str) -> bytes:
     return b"\xff" + struct.pack("<H", code) + b"#" + state.encode() + msg.encode()
 
 
-def _coldef(name: str, ftype: int) -> bytes:
-    return (
-        lenc_str(b"def")
-        + lenc_str(b"")  # schema
-        + lenc_str(b"")  # table
-        + lenc_str(b"")  # org_table
-        + lenc_str(name.encode())
-        + lenc_str(name.encode())
-        + bytes([0x0C])  # fixed-length fields length
-        + struct.pack("<H", 0x21)  # charset utf8
-        + struct.pack("<I", 1024)  # column length
-        + bytes([ftype])
-        + struct.pack("<H", 0)  # flags
-        + bytes([0x1F])  # decimals
-        + b"\x00\x00"
-    )
+def _encode_pool(server):
+    """The engine's concurrency-plane encode pool, or None for engines
+    constructed without one (encoding then runs inline, pre-pool
+    behavior)."""
+    conc = getattr(server.query_engine, "concurrency", None)
+    return getattr(conc, "encode", None)
 
 
-def _send_result(io: _PacketIO, result, binary: bool = False) -> None:
+def _send_result(io: _PacketIO, result, binary: bool = False,
+                 pool=None) -> None:
     """Text resultset for COM_QUERY; binary-protocol rows for
     COM_STMT_EXECUTE (all columns declared VAR_STRING, so binary values
-    are length-encoded strings — connectors convert from the metadata)."""
+    are length-encoded strings — connectors convert from the metadata).
+    Row serialization runs on the bounded encode pool when one is
+    wired (the session thread parks on the future instead of holding
+    the GIL); the session loop only stamps sequence ids and writes."""
     if result is None:
         io.send_packet(_ok())
         return
     if result[0] == "affected":
         io.send_packet(_ok(result[1]))
         return
-    _, names, rows = result
-    io.send_packet(lenc_int(len(names)))
-    for n in names:
-        io.send_packet(_coldef(n, MYSQL_TYPE_VAR_STRING))
-    io.send_packet(_eof())
-    for row in rows:
-        if binary:
-            # binary row: 0x00 header + null bitmap (offset 2) + values
-            nb = bytearray((len(row) + 7 + 2) // 8)
-            payload = b""
-            for i, v in enumerate(row):
-                if v is None or (isinstance(v, float) and np.isnan(v)):
-                    nb[(i + 2) // 8] |= 1 << ((i + 2) % 8)
-                else:
-                    payload += lenc_str(_fmt(v).encode())
-            io.send_packet(b"\x00" + bytes(nb) + payload)
+    if result[0] == "result":
+        res = result[1]
+        if pool is not None:
+            packets = pool.run(encode_mysql_result, res, binary,
+                               cost_rows=res.num_rows)
         else:
-            payload = b""
-            for v in row:
-                if v is None or (isinstance(v, float) and np.isnan(v)):
-                    payload += b"\xfb"  # NULL
-                else:
-                    payload += lenc_str(_fmt(v).encode())
-            io.send_packet(payload)
-    io.send_packet(_eof())
-
-
-def _fmt(v) -> str:
-    if isinstance(v, (bool, np.bool_)):
-        return "1" if v else "0"
-    if isinstance(v, (float, np.floating)):
-        return repr(float(v))
-    return str(v)
+            packets = encode_mysql_result(res, binary)
+    else:
+        _, names, rows = result
+        if pool is not None:
+            packets = pool.run(encode_mysql_rows, names, rows, binary,
+                               cost_rows=len(rows))
+        else:
+            packets = encode_mysql_rows(names, rows, binary)
+    for p in packets:
+        io.send_packet(p)
 
 
 class _TcpServer(socketserver.ThreadingTCPServer):

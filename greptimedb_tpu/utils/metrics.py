@@ -101,6 +101,16 @@ class Histogram:
     def time(self, **labels):
         return _Timer(self, labels)
 
+    def sum(self, **labels) -> float:
+        """Total of observed values for one label set (benches read the
+        execute/encode wall-time split from here)."""
+        with self._lock:
+            return self._sum.get(tuple(sorted(labels.items())), 0.0)
+
+    def count(self, **labels) -> int:
+        with self._lock:
+            return self._count.get(tuple(sorted(labels.items())), 0)
+
     def render(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
         with self._lock:
@@ -374,7 +384,9 @@ PLAN_CACHE_EVENTS = REGISTRY.counter(
     "greptimedb_tpu_plan_cache_events_total",
     "Shape-keyed logical-plan cache events by kind (hit/miss/evict/"
     "invalidate — invalidations come from DDL, schema drift, and "
-    "rollup-substitution state changes)")
+    "rollup-substitution state changes; skip events carry a reason "
+    "label naming why a statement never reached the cache: join/cte/"
+    "subquery/range_select/window)")
 ADMISSION_EVENTS = REGISTRY.counter(
     "greptimedb_tpu_admission_events_total",
     "Admission control decisions by kind (admit/queue/reject_full/"
@@ -387,12 +399,32 @@ ADMISSION_WAIT_SECONDS = REGISTRY.histogram(
     "Time queued statements waited for an execution slot")
 QUERY_BATCH_EVENTS = REGISTRY.counter(
     "greptimedb_tpu_query_batch_events_total",
-    "Cross-query batching events by kind (join/coalesced/stacked/"
-    "serial_fallback — coalesced and stacked members skipped their own "
-    "device dispatch)")
+    "Cross-query batching events by kind (join/coalesced/vmapped/"
+    "stacked/serial_fallback — coalesced, vmapped, and stacked members "
+    "skipped their own device dispatch; vmapped_failed marks the "
+    "runtime latch that degrades to the fallbacks)")
 QUERY_BATCH_SIZE = REGISTRY.histogram(
     "greptimedb_tpu_query_batch_size",
     "Queries served per batch group (leader + members)")
+VMAP_BATCH_WIDTH = REGISTRY.histogram(
+    "greptimedb_tpu_query_vmap_batch_width",
+    "Distinct parameter-sibling queries executed per vmapped multi-"
+    "query dispatch (the stacked member axis M)",
+    buckets=(2, 4, 8, 16, 32, 64, 128))
+ENCODE_POOL_EVENTS = REGISTRY.counter(
+    "greptimedb_tpu_encode_pool_events_total",
+    "Result-encode pool decisions by kind (offload = serialized on a "
+    "pool worker, inline = pool saturated, small_inline = result "
+    "under encode_min_rows; inline encodes run on the request thread)")
+ENCODE_POOL_QUEUE_DEPTH = REGISTRY.gauge(
+    "greptimedb_tpu_encode_pool_queue_depth",
+    "Result serializations queued or running in the bounded encode "
+    "pool")
+ENCODE_SECONDS = REGISTRY.histogram(
+    "greptimedb_tpu_encode_seconds",
+    "Wall time serializing one query result to its wire format "
+    "(HTTP JSON / MySQL packets), by protocol — compare against "
+    "query_duration_seconds for the execute-vs-encode split")
 
 ROLLUP_SUBSTITUTIONS = REGISTRY.counter(
     "greptimedb_tpu_maintenance_rollup_substitutions_total",

@@ -88,6 +88,10 @@ class SlowQuery:
     rows: int
     execution_path: Optional[str]
     started_at: float    # epoch seconds
+    #: why the statement never reached the plan cache (join/cte/
+    #: subquery/range_select/window) — uncacheable dashboard queries
+    #: show up here instead of just being slow
+    plan_cache_skip: Optional[str] = None
     stages: list = field(default_factory=list)  # (node, name, ms) triples
 
     def to_dict(self) -> dict:
@@ -97,6 +101,7 @@ class SlowQuery:
             "duration_ms": round(self.duration_ms, 3),
             "threshold_ms": self.threshold_ms, "rows": self.rows,
             "execution_path": self.execution_path,
+            "plan_cache_skip": self.plan_cache_skip,
             "started_at_ms": int(self.started_at * 1000),
             "stages": [
                 {"node": n, "stage": s, "duration_ms": round(d, 3)}
@@ -109,11 +114,28 @@ class _Watch:
     """Mutable per-statement record the caller annotates after the run
     (rows, execution path) — only read if the statement turns out slow."""
 
-    __slots__ = ("rows", "execution_path")
+    __slots__ = ("rows", "execution_path", "plan_cache_skip")
 
     def __init__(self):
         self.rows = 0
         self.execution_path = None
+        self.plan_cache_skip = None
+
+
+#: the active watch, reachable from deep inside planning (the engine's
+#: plan-cache skip annotation fires levels below execute_sql)
+_current: contextvars.ContextVar = contextvars.ContextVar(
+    "gtpu_slow_query_watch", default=None)
+
+
+def annotate(**attrs) -> None:
+    """Set fields on the current statement's watch (no-op outside one)."""
+    w = _current.get()
+    if w is None:
+        return
+    for k, v in attrs.items():
+        if k in _Watch.__slots__:
+            setattr(w, k, v)
 
 
 @contextlib.contextmanager
@@ -127,6 +149,7 @@ def watch(kind: str, query: str, db: str = "public"):
         return
     token = _active.set(True)
     w = _Watch()
+    w_token = _current.set(w)
     # entry points that bypass the SQL engine (direct PromQL HTTP) have
     # no trace yet — mint one so the record, the spans, and the log
     # lines of this evaluation still join on an id
@@ -140,6 +163,7 @@ def watch(kind: str, query: str, db: str = "public"):
             yield w
     finally:
         _active.reset(token)
+        _current.reset(w_token)
         dur_ms = (time.perf_counter() - t0) * 1000.0
         if dur_ms >= thr:
             _record(kind, query, db, dur_ms, thr, w, started, sink)
@@ -152,7 +176,8 @@ def _record(kind, query, db, dur_ms, thr, w, started, sink) -> None:
         trace_id=tracing.current_trace_id() or "-",
         kind=kind, query=query[:4096], db=db,
         duration_ms=dur_ms, threshold_ms=thr, rows=w.rows,
-        execution_path=w.execution_path, started_at=started,
+        execution_path=w.execution_path,
+        plan_cache_skip=w.plan_cache_skip, started_at=started,
         stages=[(s.node or "local", s.name, s.duration_ms) for s in sink],
     )
     with _lock:
