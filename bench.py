@@ -1709,6 +1709,272 @@ def bench_qps_mixed(qe, results, clients_per_tenant=None,
                 "measure cross-tenant interference"}
 
 
+# ---- mesh_scale: shard-count scaling + cluster pushdown ---------------------
+
+MESH_CHILD_HOSTS = 120
+MESH_CHILD_POINTS = 1500  # x hosts = 180k rows, 4 SST files
+
+
+def mesh_scale_child(n_shard: int) -> int:
+    """One mesh size measured in a fresh process (the device count is
+    fixed at backend init, so each size needs its own interpreter).
+    Emits one JSON line on stdout: per-query p50s, a sequential-QPS
+    proxy, the serving path, and a parity digest the parent compares
+    across sizes (bit-for-bit vs the 1-device oracle)."""
+    import hashlib
+
+    import jax
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    data_dir = tempfile.mkdtemp(prefix="gtpu_mesh_")
+    try:
+        from greptimedb_tpu.datatypes import DictVector, RecordBatch
+
+        engine, qe = build_db(data_dir)
+        qe.execute_one(
+            "CREATE TABLE mesh_t (host STRING, v0 DOUBLE, v1 DOUBLE, "
+            "ts TIMESTAMP(3) NOT NULL, TIME INDEX (ts), PRIMARY KEY "
+            "(host)) WITH (append_mode = 'true')")
+        info = qe.catalog.table("public", "mesh_t")
+        rid = info.region_ids[0]
+        rng = np.random.default_rng(17)
+        hosts, points = MESH_CHILD_HOSTS, MESH_CHILD_POINTS
+        n = hosts * points
+        codes = np.repeat(np.arange(hosts, dtype=np.int32), points)
+        names = np.asarray([f"h{i:03d}" for i in range(hosts)],
+                           dtype=object)
+        ts = np.tile(np.arange(points, dtype=np.int64) * 1000, hosts)
+        # integer-valued doubles: float sums are associativity-free, so
+        # the cross-size digest is exact, not approximate
+        v0 = rng.integers(0, 1000, n).astype(np.float64)
+        v1 = rng.integers(0, 1000, n).astype(np.float64)
+        files = 4
+        per = n // files
+        for i in range(files):
+            sl = slice(i * per, n if i == files - 1 else (i + 1) * per)
+            engine.put(rid, RecordBatch(info.schema, {
+                "host": DictVector(codes[sl], names), "v0": v0[sl],
+                "v1": v1[sl], "ts": ts[sl]}))
+            engine.flush(rid)
+        dg_sql = ("SELECT host, date_bin(INTERVAL '1 minute', ts) AS b, "
+                  "avg(v0), avg(v1), max(v0), min(v1) FROM mesh_t "
+                  "GROUP BY host, b ORDER BY host, b")
+        sg_sql = ("SELECT host, max(v0), sum(v1) FROM mesh_t "
+                  "GROUP BY host ORDER BY host")
+        dg_p50, dg_warm, dg_rows, _ = timed_sql(qe, dg_sql, repeats=7)
+        path = qe.executor.last_path
+        tier = qe.executor.last_tier
+        digest = hashlib.sha256(
+            repr(qe.execute_one(dg_sql).rows()).encode()).hexdigest()[:16]
+        sg_p50, _, _, _ = timed_sql(qe, sg_sql, repeats=7)
+        # sequential-QPS proxy for the single-groupby class
+        t0 = time.perf_counter()
+        reps = 30
+        for _ in range(reps):
+            qe.execute_one(sg_sql)
+        qps = reps / (time.perf_counter() - t0)
+        print(json.dumps({
+            "shards": n_shard, "rows": n, "path": path, "tier": tier,
+            "double_groupby_p50_ms": round(dg_p50, 2),
+            "warm_ms": round(dg_warm, 1),
+            "groups": dg_rows,
+            "single_groupby_p50_ms": round(sg_p50, 2),
+            "qps_single_groupby": round(qps, 1),
+            "digest": digest,
+        }))
+        engine.close()
+        return 0
+    finally:
+        shutil.rmtree(data_dir, ignore_errors=True)
+
+
+def bench_mesh_scale(results):
+    """Shard-count scaling sweep: 1/2/4/8-device meshes each in a child
+    process (CPU: --xla_force_host_platform_device_count; a real TPU box
+    exposes its chips and GREPTIMEDB_TPU_MESH=Nx1 takes the first N),
+    reporting per-size p50, scaling efficiency vs 1 shard, and a
+    bit-for-bit parity digest against the 1-device oracle."""
+    import subprocess
+
+    import jax
+
+    sizes = [1, 2, 4, 8]
+    on_cpu = jax.default_backend() == "cpu"
+    if not on_cpu:
+        sizes = [s for s in sizes if s <= len(jax.devices())] or [1]
+    out = {}
+    for s in sizes:
+        if budget_left_s() < 180:
+            log(f"mesh_scale: budget low, stopping before size {s}")
+            break
+        env = dict(os.environ)
+        env["BENCH_MESH_CHILD"] = str(s)
+        env.pop("BENCH_CHILD", None)
+        env["GREPTIMEDB_TPU_MESH"] = "off" if s == 1 else f"{s}x1"
+        env["GREPTIMEDB_TPU_MESH_MIN_ROWS"] = "1"
+        if on_cpu:
+            flags = [f for f in env.get("XLA_FLAGS", "").split()
+                     if "xla_force_host_platform_device_count" not in f]
+            flags.append(f"--xla_force_host_platform_device_count={s}")
+            env["XLA_FLAGS"] = " ".join(flags)
+            env["JAX_PLATFORMS"] = "cpu"
+        log(f"mesh_scale: size {s} ...")
+        try:
+            p = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                               env=env, capture_output=True, text=True,
+                               timeout=max(120, budget_left_s() - 60))
+            line = [ln for ln in p.stdout.splitlines() if ln.strip()][-1]
+            out[str(s)] = json.loads(line)
+        except Exception as e:  # noqa: BLE001 — one size must not sink all
+            log(f"mesh_scale size {s} failed: {e!r}")
+            out[str(s)] = {"error": repr(e)[:200]}
+    base = out.get("1", {})
+    base_p50 = base.get("double_groupby_p50_ms")
+    base_digest = base.get("digest")
+    for s, d in out.items():
+        p50 = d.get("double_groupby_p50_ms")
+        if base_p50 and p50 and s != "1":
+            d["speedup_vs_1"] = round(base_p50 / p50, 2)
+            d["scaling_efficiency"] = round(base_p50 / (int(s) * p50), 2)
+        if base_digest and d.get("digest"):
+            d["parity_vs_1"] = d["digest"] == base_digest
+    results["mesh_scale"] = out
+    log(f"mesh_scale: {json.dumps(out)}")
+
+
+def bench_cluster_pushdown(results):
+    """Cluster-mode rollup substitution + lastpoint pruning through the
+    distributed frontend: measured with the pushdown planes on vs the
+    raw paths (GTPU_ROLLUP_SUBSTITUTE=0 / GTPU_LASTFRAG=0), asserting
+    the served last_path so the speedup provably comes from partial
+    planes, not noise."""
+    import tempfile as _tf
+
+    from greptimedb_tpu.cluster import Cluster
+    from greptimedb_tpu.meta.metasrv import MetasrvOptions
+    from greptimedb_tpu.partition.rule import (
+        PartitionBound,
+        RangePartitionRule,
+    )
+
+    cdir = _tf.mkdtemp(prefix="gtpu_clbench_")
+    out = {}
+    try:
+        from greptimedb_tpu.datatypes import DictVector, RecordBatch
+
+        c = Cluster(cdir, num_datanodes=3, opts=MetasrvOptions())
+        hosts, minutes, per_minute = 96, 20, 300
+        split1, split2 = f"host{hosts // 3:03d}", f"host{2 * hosts // 3:03d}"
+        bounds = [PartitionBound((split1,)), PartitionBound((split2,)),
+                  PartitionBound(())]
+        rule = RangePartitionRule(["host"], bounds)
+        c.create_partitioned_table(
+            "CREATE TABLE cpu (host STRING, v DOUBLE, ts TIMESTAMP(3) "
+            "NOT NULL, TIME INDEX (ts), PRIMARY KEY(host))", rule)
+        info = c.catalog.table("public", "cpu")
+        rng = np.random.default_rng(23)
+        names = np.asarray([f"host{h:03d}" for h in range(hosts)],
+                           dtype=object)
+        # direct scattered puts (the write path's find_regions contract),
+        # flushed every 2 minutes: ~5 SSTs per region so the lastpoint
+        # A/B below has files for newest-first pruning to skip
+        for m in range(minutes):
+            n = hosts * per_minute
+            codes = np.repeat(np.arange(hosts, dtype=np.int32),
+                              per_minute)
+            ts = (m * 60_000
+                  + np.tile(np.arange(per_minute, dtype=np.int64)
+                            * (60_000 // per_minute), hosts))
+            v = rng.integers(0, 1000, n).astype(np.float64)
+            batch = RecordBatch(info.schema, {
+                "host": DictVector(codes, names), "v": v, "ts": ts})
+            for idx, rows_idx in rule.split(
+                    [names[codes]], n_rows=n).items():
+                part = batch.take(rows_idx)
+                part = RecordBatch(part.schema, {
+                    k: (col.compact() if isinstance(col, DictVector)
+                        else col)
+                    for k, col in part.columns.items()})
+                c.router.put(info.region_ids[idx], part)
+            # one SST per region per minute: lastpoint's newest-first
+            # termination needs more files than one decode wave, or the
+            # wave reads everything and pruning can't pay
+            for rid in info.region_ids:
+                c.router.flush(rid)
+        from greptimedb_tpu.maintenance.rollup import (
+            RollupRule,
+            rule_slot,
+            run_rollup_job,
+        )
+
+        rule = RollupRule(resolution_ms=60_000)
+        for dn in c.datanodes.values():
+            dn.engine.maintenance.rollup_rules = [rule]
+            for rid in list(dn.engine.regions):
+                run_rollup_job(dn.engine, rid, rule_slot(60_000), rule)
+        hi = (minutes - 1) * 60_000
+        roll_sql = (f"SELECT host, min(v), max(v), sum(v), count(v) "
+                    f"FROM cpu WHERE ts >= 0 AND ts < {hi} "
+                    f"GROUP BY host ORDER BY host")
+
+        def p50(sql, reps=5):
+            c.sql(sql)  # warm
+            times = []
+            for _ in range(reps):
+                t = time.perf_counter()
+                c.sql(sql)
+                times.append((time.perf_counter() - t) * 1000)
+            return float(np.median(times))
+
+        sub_ms = p50(roll_sql)
+        sub_path = c.frontend.executor.last_path
+        os.environ["GTPU_ROLLUP_SUBSTITUTE"] = "0"
+        try:
+            raw_ms = p50(roll_sql)
+            raw_path = c.frontend.executor.last_path
+        finally:
+            os.environ.pop("GTPU_ROLLUP_SUBSTITUTE", None)
+        out["rollup"] = {
+            "pushdown_p50_ms": round(sub_ms, 2), "path": sub_path,
+            "raw_p50_ms": round(raw_ms, 2), "raw_path": raw_path,
+            "speedup": round(raw_ms / max(sub_ms, 1e-6), 2)}
+
+        lp_sql = "SELECT host, last(v) FROM cpu GROUP BY host ORDER BY host"
+
+        def p50_postwrite(reps=5):
+            """Dashboard-refresh-after-ingest: each repeat lands one
+            write first (bumping the data version, as live ingest does
+            continuously), so the measured scan is the realistic
+            incremental one — this is where newest-first pruning pays
+            (the raw fragment re-assembles every region's full row set)."""
+            c.sql(lp_sql)  # warm compile
+            times = []
+            for i in range(reps):
+                c.sql("INSERT INTO cpu (host, v, ts) VALUES "
+                      f"('host000', 1, {9_000_000 + i})")
+                t = time.perf_counter()
+                c.sql(lp_sql)
+                times.append((time.perf_counter() - t) * 1000)
+            return float(np.median(times))
+
+        lp_ms = p50_postwrite()
+        lp_path = c.frontend.executor.last_path
+        os.environ["GTPU_LASTFRAG"] = "0"
+        try:
+            lp_raw_ms = p50_postwrite()
+        finally:
+            os.environ.pop("GTPU_LASTFRAG", None)
+        out["lastpoint"] = {
+            "postwrite_p50_ms": round(lp_ms, 2), "path": lp_path,
+            "unpruned_p50_ms": round(lp_raw_ms, 2),
+            "speedup": round(lp_raw_ms / max(lp_ms, 1e-6), 2)}
+        c.close()
+    finally:
+        shutil.rmtree(cdir, ignore_errors=True)
+    results["cluster_pushdown"] = out
+    log(f"cluster_pushdown: {json.dumps(out)}")
+
+
 def roofline_detail(platform, results, rows):
     """Analytic achieved-bandwidth/FLOP numbers for the headline query,
     plus the chip roofline when on TPU — the MFU computation the round-3
@@ -1911,6 +2177,9 @@ def main():
         guarded("qps_single_groupby", lambda: bench_qps(qe, results))
         guarded("qps_mixed_tenants",
                 lambda: bench_qps_mixed(qe, results))
+        guarded("mesh_scale", lambda: bench_mesh_scale(results))
+        guarded("cluster_pushdown",
+                lambda: bench_cluster_pushdown(results))
         guarded("maintenance",
                 lambda: bench_maintenance(engine, qe, results))
         # PRELIMINARY emit: the quick configs are done — if a big tracked
@@ -2146,6 +2415,10 @@ def supervise():
 
 
 if __name__ == "__main__":
+    if os.environ.get("BENCH_MESH_CHILD"):
+        # one mesh_scale size in its own interpreter (device count is
+        # fixed at backend init) — must run BEFORE the supervisor check
+        sys.exit(mesh_scale_child(int(os.environ["BENCH_MESH_CHILD"])))
     if os.environ.get("BENCH_CHILD") != "1":
         sys.exit(supervise())
     try:

@@ -176,6 +176,9 @@ class RegionRouter:
         self.datanodes = datanodes
         self._region_node: dict[int, str] = {}
         self._agg_executors: dict[int, object] = {}  # per-engine pushdown
+        # rollup_probe TTL cache: dashboards re-asking the same window
+        # within the coverage-state TTL skip the per-region RPC fan-out
+        self._rollup_probe_cache: dict[tuple, tuple] = {}
         self._lock = threading.Lock()
         metasrv.subscribe_invalidation(self._on_invalidate)
 
@@ -185,6 +188,7 @@ class RegionRouter:
             # pushdown executors pin their engines (and device caches):
             # drop them with the routes so failed-over engines can free
             self._agg_executors.clear()
+            self._rollup_probe_cache.clear()
 
     def _refresh(self) -> None:
         with self._lock:
@@ -194,7 +198,21 @@ class RegionRouter:
                     if rr.leader_node is not None:
                         self._region_node[rr.region_id] = rr.leader_node
 
+    @staticmethod
+    def _route_rid(region_id: int) -> int:
+        """Routing identity for a region id: rollup COMPANION regions
+        (raw_rid + ROLLUP_RID_FLAG + slot<<20, maintenance/rollup.py)
+        are created by the owning datanode's maintenance plane and never
+        get their own route entry — they live wherever their raw region
+        lives, so route lookups strip the companion bits."""
+        from greptimedb_tpu.maintenance.rollup import ROLLUP_RID_FLAG
+
+        if region_id & ROLLUP_RID_FLAG:
+            return (region_id >> 32 << 32) | (region_id & ((1 << 20) - 1))
+        return region_id
+
     def _engine_for(self, region_id: int) -> RegionEngine:
+        region_id = self._route_rid(region_id)
         node = self._region_node.get(region_id)
         if node is None:
             self._refresh()
@@ -277,7 +295,7 @@ class RegionRouter:
                 raise
             DEGRADED.inc(point="router.scan")
             with self._lock:
-                self._region_node.pop(region_id, None)
+                self._region_node.pop(self._route_rid(region_id), None)
             self._refresh()
             try:
                 return op(self._engine_for(region_id))
@@ -333,6 +351,45 @@ class RegionRouter:
             return execute_region_fragment(self._local_executor_for(eng),
                                            region_id, frag)
         return self._with_failover(region_id, op)
+
+    #: rollup_probe answers stay valid for about as long as the
+    #: datanode-side coverage-state cache (maintenance/rollup.py)
+    _ROLLUP_PROBE_TTL_S = 2.0
+
+    def rollup_probe(self, region_id: int, lo: int, hi: int) -> list:
+        """Ask the region's owner which rollup rules fully cover
+        [lo, hi) on it (maintenance/rollup.probe_region_rollups) — the
+        eligibility half of cluster-mode rollup substitution. Only
+        NEGATIVE answers are cached (tables with no usable rollup would
+        otherwise fan an RPC per query forever): a positive answer must
+        stay live, because the datanode's late-data check is what keeps
+        substituted aggregates exact after an out-of-order write."""
+        import time as _time
+
+        key = (region_id, int(lo), int(hi))
+        now = _time.monotonic()
+        with self._lock:
+            hit = self._rollup_probe_cache.get(key)
+            if hit is not None and hit[0] > now:
+                return hit[1]
+            if len(self._rollup_probe_cache) > 4096:
+                self._rollup_probe_cache.clear()
+
+        def op(eng):
+            if hasattr(eng, "rollup_probe"):  # RemoteRegionEngine: wire
+                return eng.rollup_probe(region_id, lo, hi)
+            from greptimedb_tpu.maintenance.rollup import (
+                probe_region_rollups,
+            )
+
+            return probe_region_rollups(eng, region_id, int(lo), int(hi))
+
+        out = self._with_failover(region_id, op)
+        if not out:
+            with self._lock:
+                self._rollup_probe_cache[key] = (
+                    now + self._ROLLUP_PROBE_TTL_S, out)
+        return out
 
     def alter_region_schema(self, region_id: int, schema) -> None:
         self._engine_for(region_id).alter_region_schema(region_id, schema)

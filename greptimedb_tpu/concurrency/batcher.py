@@ -56,9 +56,11 @@ from greptimedb_tpu.utils.metrics import (
 )
 
 #: aggregate functions whose masked/stacked evaluation is exactly the
-#: serial evaluation (order-insensitive, or identity-element exact)
+#: serial evaluation (order-insensitive, or identity-element exact;
+#: first/last resolve by their companion timestamps in the vmapped
+#: kernel and by ordinary serial evaluation in the IN-list rewrite)
 SAFE_FUNCS = frozenset(
-    {"sum", "count", "min", "max", "avg", "mean"})
+    {"sum", "count", "min", "max", "avg", "mean", "first", "last"})
 
 BATCH_TAG = "__batch_tag"
 

@@ -104,6 +104,20 @@ def mesh_min_rows() -> int:
     return int(os.environ.get("GREPTIMEDB_TPU_MESH_MIN_ROWS", "65536"))
 
 
+def default_hash_partitions() -> int:
+    """Hash-partition count for cluster CREATE TABLE without an explicit
+    PARTITION clause ([partition] default_hash_regions); 0/1 = one
+    region (the standalone default)."""
+    return int(os.environ.get("GREPTIMEDB_TPU_DEFAULT_HASH_REGIONS", "0"))
+
+
+def hash_partition_columns() -> list:
+    """Columns for default hash partitioning ([partition] hash_columns,
+    comma-separated); empty = the table's leading tag column."""
+    env = os.environ.get("GREPTIMEDB_TPU_HASH_PARTITION_COLUMNS", "")
+    return [s.strip() for s in env.split(",") if s.strip()]
+
+
 def device_cache_bytes() -> int:
     """HBM budget for the device block cache (reference: CacheManager page
     cache, mito2/src/cache.rs:53-61 — here the 'page cache' IS device HBM).

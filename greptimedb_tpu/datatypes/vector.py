@@ -45,6 +45,25 @@ class DictVector:
     def take(self, indices: np.ndarray) -> "DictVector":
         return DictVector(self.codes[indices], self.values)
 
+    def compact(self) -> "DictVector":
+        """Shrink the dictionary to the values the codes actually use
+        (NULL codes preserved). The partition write scatter calls this
+        per region slice: without it every region's tag registry learns
+        every OTHER region's series too, which silently disables any
+        optimization that reasons over the registry's value set
+        (lastpoint's newest-first termination waits forever for series
+        that can never appear in that region)."""
+        used = np.unique(self.codes)
+        used = used[used >= 0]
+        if len(used) == len(self.values):
+            return self
+        remap = np.full(len(self.values) + 1, -1, dtype=np.int32)
+        remap[used] = np.arange(len(used), dtype=np.int32)
+        # index -1 hits the sentinel slot (remap[-1] == last) — keep
+        # NULLs NULL by writing the sentinel last
+        remap[-1] = -1
+        return DictVector(remap[self.codes], self.values[used])
+
     @staticmethod
     def encode(strings: Sequence, values: Optional[np.ndarray] = None) -> "DictVector":
         """Encode a sequence of strings (None == NULL) against an optional

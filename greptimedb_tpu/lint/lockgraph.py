@@ -36,6 +36,10 @@ from greptimedb_tpu.lint.astutil import call_name, dotted, find_cycle
 SCOPE_PREFIXES = (
     "greptimedb_tpu/concurrency/",
     "greptimedb_tpu/maintenance/",
+    # the mesh hot path: shard dispatch runs under server threads and
+    # shares the DeviceCache lock — machine-check it like the rest of
+    # the serving plane
+    "greptimedb_tpu/parallel/",
 )
 SCOPE_FILES = (
     "greptimedb_tpu/storage/scan_pool.py",

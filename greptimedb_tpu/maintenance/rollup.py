@@ -711,6 +711,82 @@ def _rewrite_aggs(sel, info, rule: RollupRule):
     )
 
 
+def _companion_state(engine, region, rid: int, rule_idx: int,
+                     r_units: int):
+    """Locate `rid`'s rollup companion at this rule slot and read its
+    coverage state. Returns (rollup_rid, state) or (None, None) when no
+    companion with matching-resolution coverage exists. Shares the
+    negative-open TTL cache with the query path (an absent rollup must
+    not cost a manifest probe per region per rule per query)."""
+    rrid = rollup_region_id(rid, rule_idx)
+    try:
+        engine.region(rrid)
+    except KeyError:
+        miss_key = f"open-miss:{rrid}"
+        now = time.monotonic()
+        with _state_lock:
+            hit = _state_cache.get(miss_key)
+        if hit is not None and hit[0] > now:
+            return None, None
+        try:
+            engine.open_region(rrid)
+        except Exception:  # noqa: BLE001 — no rollup yet
+            with _state_lock:
+                _state_cache[miss_key] = (now + _STATE_TTL_S, None)
+            return None, None
+    rollup_region = engine.region(rrid)
+    store = region.store if region.store is not None \
+        else rollup_region.manifest.store
+    state = read_state(store, rollup_region.region_dir)
+    if state is None or state.get("resolution_units") != r_units:
+        return None, None
+    return rrid, state
+
+
+def probe_region_rollups(engine, region_id: int, lo: int,
+                         hi: int) -> list:
+    """Datanode-side rollup eligibility probe — the Partial half of
+    DISTRIBUTED substitution. For each configured rule, answer whether
+    this region's companion fully covers [lo, hi) with no late raw
+    writes. Returns [{"resolution_ms", "rollup_rid", "fields"}] sorted
+    coarsest-first; the frontend intersects the per-region answers,
+    rewrites the aggregates to plane form, and ships ordinary
+    partial-agg PlanFragments to the COMPANION regions — [G, F] planes
+    come back, never raw rows (the cluster-mode analog of the local
+    `try_substitute` fast path)."""
+    from greptimedb_tpu.storage.region import Region
+
+    maint = getattr(engine, "maintenance", None)
+    if maint is None or not maint.rollup_rules or \
+            not substitution_enabled():
+        return []
+    try:
+        region = engine.region(region_id)
+    except Exception:  # noqa: BLE001 — not open here (stale route)
+        return []
+    if not isinstance(region, Region):
+        return []
+    dtype = region.schema.time_index.dtype
+    out = []
+    for rule in sorted(maint.rollup_rules, key=lambda r: -r.resolution_ms):
+        rule_idx = rule_slot(rule.resolution_ms)
+        r_units = max(1, ms_to_units(rule.resolution_ms, dtype))
+        if lo % r_units or hi % r_units:
+            continue
+        rrid, state = _companion_state(engine, region, region_id,
+                                       rule_idx, r_units)
+        if rrid is None:
+            continue
+        if not (state["cov_lo"] <= lo and hi <= state["cov_hi"]):
+            continue
+        if _late_data_since(region, lo, hi, state.get("as_of_seq", -1)):
+            continue
+        out.append({"resolution_ms": int(rule.resolution_ms),
+                    "rollup_rid": int(rrid),
+                    "fields": list(rule.fields)})
+    return out
+
+
 def try_substitute(qe, sel, info, ctx, shape_note=None):
     """Serve an eligible aggregate SELECT from rollup planes instead of
     raw SSTs. Returns a QueryResult, or None to fall through to the raw
@@ -732,7 +808,15 @@ def try_substitute(qe, sel, info, ctx, shape_note=None):
         shape_note["memoizable"] = True
     engine = qe.region_engine
     maint = getattr(engine, "maintenance", None)
-    if maint is None or not maint.rollup_rules or not substitution_enabled():
+    if maint is None or not maint.rollup_rules:
+        # distributed frontend: no local maintenance plane, but the
+        # region owners have one — classify eligibility here, probe the
+        # datanodes, and serve from the companion plane regions
+        if hasattr(engine, "rollup_probe") and substitution_enabled():
+            return _try_substitute_distributed(qe, sel, info, ctx,
+                                               shape_note)
+        return None
+    if not substitution_enabled():
         return None
     if sel.distinct or sel.joins or sel.ctes or sel.from_subquery is not None:
         return None
@@ -774,33 +858,9 @@ def try_substitute(qe, sel, info, ctx, shape_note=None):
                 return None
             if not isinstance(region, Region):
                 return None  # frontend router: planes live datanode-side
-            rrid = rollup_region_id(rid, rule_idx)
-            try:
-                engine.region(rrid)
-            except KeyError:
-                # negative-open TTL cache: until a rollup exists, every
-                # eligible query would otherwise pay a manifest probe
-                # (an object-store GET) per region per rule
-                miss_key = f"open-miss:{rrid}"
-                now = time.monotonic()
-                with _state_lock:
-                    hit = _state_cache.get(miss_key)
-                if hit is not None and hit[0] > now:
-                    ok = False
-                    break
-                try:
-                    engine.open_region(rrid)
-                except Exception:  # noqa: BLE001 — no rollup yet
-                    with _state_lock:
-                        _state_cache[miss_key] = (now + _STATE_TTL_S,
-                                                  None)
-                    ok = False
-                    break
-            rollup_region = engine.region(rrid)
-            store = region.store if region.store is not None \
-                else rollup_region.manifest.store
-            state = read_state(store, rollup_region.region_dir)
-            if state is None or state.get("resolution_units") != r_units:
+            rrid, state = _companion_state(engine, region, rid, rule_idx,
+                                           r_units)
+            if rrid is None:
                 ok = False
                 break
             if not (state["cov_lo"] <= lo and hi <= state["cov_hi"]):
@@ -834,6 +894,103 @@ def try_substitute(qe, sel, info, ctx, shape_note=None):
 
         ROLLUP_SUBSTITUTIONS.inc(table=info.name,
                                  resolution_ms=rule.resolution_ms)
+        qe.executor.last_path = (qe.executor.last_path or "") + "+rollup"
+        return res
+    return None
+
+
+def _try_substitute_distributed(qe, sel, info, ctx, shape_note=None):
+    """Cluster-mode rollup substitution: the frontend classifies shape
+    eligibility, fans a `rollup_probe` to each raw region's owner, and
+    — when every region's companion covers the window at a common
+    resolution — re-plans over the COMPANION region ids. The multi-
+    region executor then ships ordinary partial-agg PlanFragments to
+    the plane regions, so what crosses the wire is [G, F] partial
+    planes over pre-aggregated rows, not raw scans (this used to fall
+    back to a full raw-row gather — the known biggest cluster-mode
+    perf cliff, ROADMAP item 3)."""
+    from greptimedb_tpu.query.expr import extract_ts_bounds
+    from greptimedb_tpu.query.planner import plan_select
+
+    # structural gates first (mirroring the local path): a shape that
+    # fails THESE can be memoized as ineligible — no literal values or
+    # coverage state could make it substitute
+    if sel.distinct or sel.joins or sel.ctes or sel.from_subquery is not None:
+        return None
+    schema = info.schema
+    dtype = schema.time_index.dtype
+    if not _where_ok(sel.where, schema):
+        return None
+    bounds = extract_ts_bounds(sel.where, schema.time_index.name, dtype)
+    if bounds is None or bounds[0] is None or bounds[1] is None:
+        # structurally unbounded (the shape has no ts literals to
+        # parameterize): memoizable, same as the local path
+        return None
+    lo, hi = int(bounds[0]), int(bounds[1])
+    # from here every outcome depends on live per-region coverage
+    # state: the plan cache must keep re-probing
+    if shape_note is not None:
+        shape_note["memoizable"] = False
+
+    engine = qe.region_engine
+    rids = list(info.region_ids)
+    try:
+        if len(rids) > 1:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(max_workers=min(8, len(rids))) as pool:
+                per_region = list(pool.map(
+                    lambda rid: engine.rollup_probe(rid, lo, hi), rids))
+        else:
+            per_region = [engine.rollup_probe(rids[0], lo, hi)]
+    except Exception:  # noqa: BLE001 — probe RPC failed: raw is correct
+        return None
+    # intersect: a resolution is usable only when EVERY region's
+    # companion covers the window (fields must agree too — they are
+    # rule config, so a disagreement means mid-rollout drift)
+    common: Optional[dict] = None
+    for lst in per_region:
+        if lst is None:
+            return None
+        here = {e["resolution_ms"]: e for e in lst}
+        if common is None:
+            common = {k: [v] for k, v in here.items()}
+        else:
+            common = {k: v + [here[k]] for k, v in common.items()
+                      if k in here
+                      and here[k].get("fields") == v[0].get("fields")}
+    if not common:
+        return None
+    for res_ms in sorted(common, reverse=True):  # coarsest wins
+        r_units = max(1, ms_to_units(res_ms, dtype))
+        if lo % r_units or hi % r_units:
+            continue
+        steps = _group_keys_ok(sel, info, r_units)
+        if steps is None:
+            continue
+        rule = RollupRule(resolution_ms=int(res_ms),
+                          fields=tuple(common[res_ms][0].get("fields", ())))
+        new_sel = _rewrite_aggs(sel, info, rule)
+        if new_sel is None:
+            continue
+        from greptimedb_tpu.catalog.catalog import TableInfo
+
+        rollup_info = TableInfo(
+            table_id=info.table_id, name=info.name, db=info.db,
+            schema=rollup_schema(schema, rule), options={},
+            region_ids=[e["rollup_rid"] for e in common[res_ms]])
+        try:
+            plan = plan_select(new_sel, rollup_info)
+            res = qe.executor.execute(plan)
+        except Exception:  # noqa: BLE001 — drift/rewrite doubt: raw wins
+            continue
+        from greptimedb_tpu.utils.metrics import (
+            FRAGMENT_PUSHDOWNS,
+            ROLLUP_SUBSTITUTIONS,
+        )
+
+        ROLLUP_SUBSTITUTIONS.inc(table=info.name, resolution_ms=res_ms)
+        FRAGMENT_PUSHDOWNS.inc(mode="rollup")
         qe.executor.last_path = (qe.executor.last_path or "") + "+rollup"
         return res
     return None

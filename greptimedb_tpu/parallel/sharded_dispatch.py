@@ -1,0 +1,267 @@
+"""Part-aligned mesh shard dispatch: the hot-path bridge between the
+region scan's immutable SST parts and the (shard, field) device mesh.
+
+The legacy sharded path placed the WHOLE scan with one `jax.device_put`
+over a NamedSharding — correct, but every flush (a data-version bump)
+re-uploaded the entire working set because the only cacheable identity
+was the snapshot. This module gives the mesh path the same file-anchored
+economics the single-device hot set has (query/device_cache.py):
+
+- `plan_shards` assigns part-aligned row segments to shards: each SST
+  part splits into at most `n_shard` contiguous chunks (chunk size is a
+  pure function of the immutable part, so boundaries never move), and
+  chunks greedily land on the least-loaded shard in deterministic scan
+  order. Appending a new file extends the plan without disturbing any
+  earlier assignment — the prefix-stability that makes per-(segment,
+  shard) cache keys survive flushes.
+- `sharded_column` materializes one logical plane across the mesh:
+  per-segment device buffers are file-anchored (key carries the part
+  identity + in-part offset + owning shard) and uploaded ONCE to the
+  owning shard's device; the assembled per-shard buffer (segments
+  concatenated on-device + padding fill) is snapshot-anchored and
+  rebuilt from the resident segments on a version bump, so a flush
+  transfers ONLY its new file's rows to the shard that owns them. The
+  global array forms with `jax.make_array_from_single_device_arrays` —
+  no cross-device traffic at assembly.
+- `sharded_mask` ships the [n_shard, L] validity/dedup mask.
+
+Row order within a shard differs from scan order (segments interleave),
+which is invisible to the collective aggregation: group ids are global
+and per-shard partials combine with psum/pmin/pmax (first/last resolve
+by their companion timestamps in `combine_partial_aggs`).
+
+Shapes the plan cannot serve raise `MeshIneligible`; the executor
+degrades to the single-device dense paths — typed fallback, never an
+error.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from greptimedb_tpu.utils import device_telemetry
+from greptimedb_tpu.utils.metrics import MESH_DISPATCHES, MESH_SHARD_SKEW
+
+
+class MeshIneligible(Exception):
+    """This scan/shape cannot ride the part-aligned mesh dispatch; the
+    caller falls back to the single-device paths (typed degradation)."""
+
+
+@dataclass(frozen=True)
+class ShardSeg:
+    """One contiguous scan slice assigned to a shard. `pkey` is the
+    immutable part identity ((file_id, ts_range, pred_key)) or None for
+    memtable/synthetic rows; `part_start` anchors the in-part offset so
+    cache keys stay stable across scans."""
+
+    pkey: Optional[tuple]
+    part_start: int
+    start: int
+    end: int
+
+
+@dataclass
+class ShardPlan:
+    n_shard: int
+    segs: list  # per shard: list[ShardSeg]
+    lens: list  # real rows per shard
+    pad: int    # common padded per-shard length L
+    skew: float  # max / mean per-shard rows
+
+    @property
+    def total_pad(self) -> int:
+        return self.pad * self.n_shard
+
+
+#: per-shard buffers pad to a multiple of this (TPU lane alignment; on
+#: CPU it just keeps shapes stable across nearby row counts)
+_PAD_QUANTUM = 128
+
+
+def eligible(mesh) -> bool:
+    """The part-aligned dispatch assembles one committed array per
+    shard device; a mesh with a real field axis would need replicated
+    placement per row shard — the legacy whole-scan device_put path
+    handles that layout instead."""
+    try:
+        return int(mesh.shape.get("field", 1)) == 1
+    except Exception:  # noqa: BLE001 — exotic mesh: legacy path
+        return False
+
+
+def shard_devices(mesh) -> list:
+    """One device per "shard" coordinate (field axis of 1)."""
+    arr = np.asarray(mesh.devices).reshape(mesh.shape["shard"], -1)
+    return [arr[s][0] for s in range(arr.shape[0])]
+
+
+def plan_shards(scan, n_shard: int) -> ShardPlan:
+    """Assign the scan's rows to shards along part seams (see module
+    docstring for the stability argument). Scans without per-part
+    identity (merged/synthetic) fall back to an even contiguous split —
+    still a valid plan, just snapshot-anchored only."""
+    n = int(scan.num_rows)
+    if n_shard <= 0:
+        raise MeshIneligible("mesh has no shard axis")
+    offs = getattr(scan, "sorted_part_offsets", None)
+    pkeys = getattr(scan, "part_keys", ())
+    parts: list[tuple] = []
+    if pkeys is not None and offs is not None \
+            and len(offs) == len(pkeys) + 1 and offs[-1] <= n:
+        parts = [(pkeys[i], offs[i], offs[i + 1])
+                 for i in range(len(pkeys)) if offs[i + 1] > offs[i]]
+        if offs[-1] < n:  # memtable tail: no immutable identity
+            parts.append((None, offs[-1], n))
+    if not parts:
+        parts = [(None, 0, n)]
+
+    segs: list[list[ShardSeg]] = [[] for _ in range(n_shard)]
+    lens = [0] * n_shard
+    for pk, s0, s1 in parts:
+        rows = s1 - s0
+        # chunk size is a function of the PART ONLY: boundaries (and so
+        # the per-segment cache keys) never move when other files come
+        # and go
+        chunk = -(-rows // n_shard)
+        for st in range(s0, s1, max(chunk, 1)):
+            en = min(st + chunk, s1)
+            # deterministic greedy: least-loaded shard, lowest index wins
+            s = min(range(n_shard), key=lambda i: (lens[i], i))
+            segs[s].append(ShardSeg(pk, s0, st, en))
+            lens[s] += en - st
+    longest = max(lens) if lens else 0
+    pad = max(-(-max(longest, 1) // _PAD_QUANTUM) * _PAD_QUANTUM,
+              _PAD_QUANTUM)
+    mean = n / n_shard if n else 1.0
+    skew = (longest / mean) if n else 1.0
+    return ShardPlan(n_shard=n_shard, segs=segs, lens=lens, pad=pad,
+                     skew=skew)
+
+
+def sharded_column(
+    cache,
+    mesh,
+    plan: ShardPlan,
+    scan,
+    name_key,
+    build_slice: Callable[[int, int, int], np.ndarray],
+    *,
+    tier: str,
+    snap_version: tuple,
+    extra: tuple = (),
+    pad_fill=0.0,
+) -> jax.Array:
+    """One logical plane ([N] column or [N, W] prepared plane) across
+    the mesh. `build_slice(start, end, out_rows)` materializes host rows
+    [start, end) padded/filled to `out_rows` (the same builders the
+    dense block path uses). Cache anatomy per shard:
+
+    - file-anchored ("file", region, file_id, tier, window, pred, name,
+      in-part offset, rows, "mshard", shard, extra): one segment's
+      upload to the owning shard's device — survives version bumps.
+    - snap-anchored ("snap", region, version, tier, fingerprint, name,
+      "mshard", shard, pad, extra): the assembled padded shard buffer —
+      concatenated on-device from resident segments (+ memtable slices
+      and the padding fill, which are device-side and free), retired by
+      the next data version.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    devs = shard_devices(mesh)
+    cacheable = scan.region_id >= 0 and cache is not None
+
+    def build_shard(s: int):
+        dev = devs[s]
+        arrs = []
+        for seg in plan.segs[s]:
+            m = seg.end - seg.start
+
+            def upload(seg=seg, m=m, dev=dev):
+                return jax.device_put(
+                    build_slice(seg.start, seg.end, m), dev)
+
+            if seg.pkey is not None and cacheable:
+                fid, ts_r, pred_key = seg.pkey
+                key = ("file", scan.region_id, fid, tier, ts_r, pred_key,
+                       name_key, seg.start - seg.part_start, m,
+                       "mshard", s, extra)
+                arrs.append(cache.get(key, upload))
+            else:
+                arr = upload()
+                device_telemetry.count_h2d(arr.nbytes)
+                arrs.append(arr)
+        pad = plan.pad - plan.lens[s]
+        with jax.default_device(dev):
+            if pad or not arrs:
+                if arrs:
+                    tail_shape = arrs[0].shape[1:]
+                    dt = arrs[0].dtype
+                else:
+                    sample = build_slice(0, 0, 1)
+                    tail_shape = sample.shape[1:]
+                    dt = sample.dtype
+                # device-side fill: padding never crosses the link
+                arrs.append(jnp.full((pad,) + tuple(tail_shape), pad_fill,
+                                     dtype=dt))
+            piece = arrs[0] if len(arrs) == 1 else jnp.concatenate(arrs)
+        return piece
+
+    if cacheable:
+        pieces = [
+            cache.get(("snap", scan.region_id, snap_version, tier,
+                       scan.scan_fingerprint, name_key, "mshard", s,
+                       plan.pad, extra),
+                      lambda s=s: build_shard(s), count_h2d=False)
+            for s in range(plan.n_shard)
+        ]
+    else:
+        pieces = [build_shard(s) for s in range(plan.n_shard)]
+    shape = (plan.total_pad,) + tuple(pieces[0].shape[1:])
+    spec = P("shard") if len(shape) == 1 else P("shard", None)
+    return jax.make_array_from_single_device_arrays(
+        shape, NamedSharding(mesh, spec), pieces)
+
+
+def sharded_mask(mesh, plan: ShardPlan, scan, dedup_mask, *,
+                 cache=None, tier: str = "", snap_version=()) -> jax.Array:
+    """[n_shard * L] base validity mask: per-shard padding is False and
+    dedup survivors carry through in segment order. `dedup_mask` is the
+    scan-order device mask or None. Snapshot-anchored in the hot set
+    (the mask is a pure function of the scan snapshot + plan), so warm
+    repeats pay zero H2D."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def build():
+        dm = None if dedup_mask is None else np.asarray(dedup_mask)
+        base = np.zeros((plan.n_shard, plan.pad), dtype=bool)
+        for s in range(plan.n_shard):
+            off = 0
+            for seg in plan.segs[s]:
+                m = seg.end - seg.start
+                if dm is None:
+                    base[s, off:off + m] = True
+                else:
+                    base[s, off:off + m] = dm[seg.start:seg.end]
+                off += m
+        flat = base.reshape(-1)
+        return jax.device_put(flat, NamedSharding(mesh, P("shard")))
+
+    if cache is not None and scan.region_id >= 0:
+        key = ("snap", scan.region_id, snap_version, tier,
+               scan.scan_fingerprint, "__mshard_mask__", plan.pad,
+               dedup_mask is not None)
+        return cache.get(key, build)
+    out = build()
+    device_telemetry.count_h2d(out.nbytes)
+    return out
+
+
+def note_dispatch(path: str, plan: ShardPlan) -> None:
+    MESH_DISPATCHES.inc(path=path, shards=str(plan.n_shard))
+    MESH_SHARD_SKEW.set(float(plan.skew))

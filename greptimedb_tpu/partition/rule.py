@@ -44,6 +44,24 @@ class PartitionRule:
         per row."""
         raise NotImplementedError
 
+    def split(
+        self, cols: Sequence[np.ndarray], n_rows: Optional[int] = None
+    ) -> dict[int, np.ndarray]:
+        """Row splitter (partition/src/splitter.rs analog): region index →
+        row positions, computed with one argsort over find_regions."""
+        if self.num_regions() == 1:
+            n = len(cols[0]) if cols else (n_rows or 0)
+            return {0: np.arange(n)}
+        regions = self.find_regions(cols, n_rows)
+        order = np.argsort(regions, kind="stable")
+        sorted_regions = regions[order]
+        out: dict[int, np.ndarray] = {}
+        uniq, starts = np.unique(sorted_regions, return_index=True)
+        bounds = list(starts) + [len(order)]
+        for i, r in enumerate(uniq):
+            out[int(r)] = order[bounds[i]:bounds[i + 1]]
+        return out
+
     def to_json(self) -> str:
         raise NotImplementedError
 
@@ -104,24 +122,6 @@ class RangePartitionRule(PartitionRule):
             region += le.astype(np.int32)
         return region
 
-    def split(
-        self, cols: Sequence[np.ndarray], n_rows: Optional[int] = None
-    ) -> dict[int, np.ndarray]:
-        """Row splitter (partition/src/splitter.rs analog): region index →
-        row positions, computed with one argsort."""
-        if self.num_regions() == 1:
-            n = len(cols[0]) if cols else (n_rows or 0)
-            return {0: np.arange(n)}
-        regions = self.find_regions(cols, n_rows)
-        order = np.argsort(regions, kind="stable")
-        sorted_regions = regions[order]
-        out: dict[int, np.ndarray] = {}
-        uniq, starts = np.unique(sorted_regions, return_index=True)
-        bounds = list(starts) + [len(order)]
-        for i, r in enumerate(uniq):
-            out[int(r)] = order[bounds[i]:bounds[i + 1]]
-        return out
-
     def to_json(self) -> str:
         return json.dumps(
             {
@@ -137,6 +137,86 @@ class RangePartitionRule(PartitionRule):
         return RangePartitionRule(
             d["columns"], [PartitionBound(tuple(v)) for v in d["bounds"]]
         )
+
+
+def _hash_column(vals: np.ndarray) -> np.ndarray:
+    """Stable vectorized per-value hash (uint64). Strings factorize once
+    and crc32 the uniques (crc32 is stable across processes — required:
+    write scatter must agree between any frontend and any replay);
+    integers run a splitmix64-style scramble so adjacent series ids
+    don't all land on adjacent regions."""
+    import zlib
+
+    vals = np.asarray(vals)
+    if vals.dtype.kind in ("U", "S", "O"):
+        s = vals.astype(str)
+        uniq, inv = np.unique(s, return_inverse=True)
+        hu = np.asarray([zlib.crc32(u.encode("utf-8")) for u in uniq],
+                        dtype=np.uint64)
+        return hu[inv]
+    x = np.asarray(vals)
+    if x.dtype.kind == "f":
+        x = x.astype(np.float64).view(np.uint64)
+    else:
+        x = x.astype(np.int64).astype(np.uint64)
+    with np.errstate(over="ignore"):
+        x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+        x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+        x = x ^ (x >> np.uint64(31))
+    return x
+
+
+class HashPartitionRule(PartitionRule):
+    """N regions by a stable hash of the partition columns — the write
+    scatter for workloads without a natural range key. Every region owns
+    WHOLE series (all rows of one partition-column tuple hash alike), so
+    LWW dedup, lastpoint pruning, and window-partition pushdown keep
+    their per-region arguments; the reference's HASH PARTITION analog."""
+
+    def __init__(self, columns: list[str], num_regions: int):
+        if not columns:
+            raise ValueError("hash partitioning needs >=1 column")
+        if int(num_regions) < 1:
+            raise ValueError("hash partitioning needs >=1 region")
+        self.columns = list(columns)
+        self._n = int(num_regions)
+
+    def num_regions(self) -> int:
+        return self._n
+
+    def find_regions(
+        self, cols: Sequence[np.ndarray], n_rows: Optional[int] = None
+    ) -> np.ndarray:
+        if len(cols) != len(self.columns):
+            raise ValueError("column count mismatch")
+        n = len(cols[0]) if cols else (n_rows or 0)
+        if self._n == 1:
+            return np.zeros(n, dtype=np.int32)
+        h = np.zeros(n, dtype=np.uint64)
+        with np.errstate(over="ignore"):
+            for c in cols:
+                h = h * np.uint64(1000003) ^ _hash_column(c)
+        return (h % np.uint64(self._n)).astype(np.int32)
+
+    def to_json(self) -> str:
+        return json.dumps({"type": "hash", "columns": self.columns,
+                           "regions": self._n})
+
+    @staticmethod
+    def from_json(s: str) -> "HashPartitionRule":
+        d = json.loads(s)
+        return HashPartitionRule(d["columns"], d["regions"])
+
+
+def rule_from_json(obj) -> PartitionRule:
+    """Rule loader by type tag ("range" is the pre-hash default for
+    manifests written before the tag existed). Accepts a JSON string or
+    the already-decoded dict the catalog stores."""
+    d = json.loads(obj) if isinstance(obj, str) else obj
+    if d.get("type") == "hash":
+        return HashPartitionRule(d["columns"], d["regions"])
+    return RangePartitionRule(
+        d["columns"], [PartitionBound(tuple(v)) for v in d["bounds"]])
 
 
 def single_region_rule() -> RangePartitionRule:

@@ -123,7 +123,8 @@ class DeviceCache:
     def _is_snap_key(key: tuple) -> bool:
         return len(key) >= 3 and key[0] == "snap"
 
-    def get(self, key: tuple, build: Callable[[], jax.Array]) -> jax.Array:
+    def get(self, key: tuple, build: Callable[[], jax.Array],
+            count_h2d: bool = True) -> jax.Array:
         with self._lock:
             hit = self._lru.get(key)
             if hit is not None:
@@ -156,8 +157,12 @@ class DeviceCache:
         DEVICE_HOT_SET_EVENTS.inc(event="miss")
         arr = build()
         # a cache-miss build materializes the block on device: that IS
-        # the H2D upload this cache exists to amortize
-        device_telemetry.count_h2d(arr.nbytes)
+        # the H2D upload this cache exists to amortize. count_h2d=False
+        # is for DERIVED entries (e.g. a mesh shard buffer concatenated
+        # on-device from already-resident segment uploads) whose build
+        # moves no bytes over the link itself.
+        if count_h2d:
+            device_telemetry.count_h2d(arr.nbytes)
         self._store(key, arr, epoch=epoch)
         return arr
 

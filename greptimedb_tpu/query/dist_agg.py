@@ -43,10 +43,22 @@ def execute_region_fragment(executor, region_id: int, frag: PlanFragment,
     agg = frag.stage("partial_agg")
     common = dict(where=where, ts_range=frag.ts_range,
                   append_mode=frag.append_mode, tz=frag.tz)
+    vm = frag.stage("vmapped_agg")
+    if vm is not None:
+        from greptimedb_tpu.query.vmapped import run_vmapped_region_partial
+
+        return run_vmapped_region_partial(executor, region_id, vm,
+                                          schema=schema, **common)
     if agg is not None:
         shim = SimpleNamespace(keys=agg["keys"], args=agg["args"],
                                ops=agg["ops"], **common)
-        return partial_region_agg(executor, region_id, shim, schema)
+        lastp = frag.stage("lastpoint")
+        prescan = None
+        if lastp is not None and where is None and frag.ts_range is None:
+            prescan = _lastpoint_prescan(executor, region_id,
+                                         lastp["tag"], shim, schema)
+        return partial_region_agg(executor, region_id, shim, schema,
+                                  prescan=prescan)
     sort = frag.stage("sort")
     limit = frag.stage("limit")
     prune = frag.stage("prune")
@@ -63,6 +75,35 @@ def execute_region_fragment(executor, region_id: int, frag: PlanFragment,
     return partial_region_rows(executor, region_id, columns,
                                limit["k"] if limit else None,
                                schema=schema, **common)
+
+
+def _lastpoint_prescan(executor, region_id: int, tag: str, shim,
+                       schema=None):
+    """Newest-first pruned scan for a lastpoint-class partial_agg
+    fragment: the region visits SSTs in descending ts_max order and
+    stops once every series provably holds its winner in the visited
+    set (Region.scan_last) — the partial planes then reduce a few
+    thousand candidate rows instead of the whole region. Returns None
+    (full-scan partial) when the engine can't serve it exactly
+    (tombstones, no scan_last, projection mismatch) — the fragment
+    still returns partial planes either way, never raw rows."""
+    from greptimedb_tpu.query.expr import collect_columns
+
+    eng = executor.engine
+    if not hasattr(eng, "scan_last"):
+        return None
+    probe = eng.region(region_id)
+    schema = schema or probe.schema
+    needed: set[str] = {schema.time_index.name}
+    for _, kexpr in shim.keys:
+        collect_columns(kexpr, needed)
+    for a in shim.args:
+        collect_columns(a, needed)
+    proj = [c for c in schema.names if c in needed]
+    try:
+        return eng.scan_last(region_id, tag, proj)
+    except Exception:  # noqa: BLE001 — pruning is an optimization only
+        return None
 
 
 def partial_region_rows(executor, region_id: int, columns, k,
@@ -153,7 +194,7 @@ def partial_region_window(executor, region_id: int, columns, calls,
 def _region_host_columns(executor, region_id: int, where, ts_range,
                          needed: set, append_mode: bool,
                          schema=None, tz=None, seq_min=None,
-                         stats_out=None) -> Optional[dict]:
+                         stats_out=None, prescan=None) -> Optional[dict]:
     """Shared Partial-step prologue: scan (projected + index-pruned),
     LWW-dedup/filter, decode tags, apply the exact ts bounds. Returns the
     filtered host column dict, or None for an empty result. `tz` is the
@@ -169,14 +210,14 @@ def _region_host_columns(executor, region_id: int, where, ts_range,
     try:
         return _region_host_columns_inner(
             executor, region_id, where, ts_range, needed, append_mode,
-            schema, seq_min=seq_min, stats_out=stats_out)
+            schema, seq_min=seq_min, stats_out=stats_out, prescan=prescan)
     finally:
         reset_session_tz(tz_token)
 
 
 def _region_host_columns_inner(executor, region_id, where, ts_range, needed,
                                append_mode, schema, seq_min=None,
-                               stats_out=None):
+                               stats_out=None, prescan=None):
     from types import SimpleNamespace
 
     from greptimedb_tpu.datatypes.vector import DictVector
@@ -190,7 +231,12 @@ def _region_host_columns_inner(executor, region_id, where, ts_range, needed,
     ts_name = schema.time_index.name
     proj = [c for c in schema.names if c in needed]
     tag_preds = extract_tag_predicates(where, schema) or None
-    if seq_min is not None:
+    if prescan is not None:
+        # lastpoint-pruned candidate rows stand in for the region scan
+        # (same dedup/filter tail below — scan_last's contract is that
+        # the subset contains every LWW winner)
+        scan = prescan
+    elif seq_min is not None:
         scan = executor.engine.scan(region_id, ts_range, proj, tag_preds,
                                     seq_min=seq_min)
     else:
@@ -244,7 +290,7 @@ def _region_host_columns_inner(executor, region_id, where, ts_range, needed,
 
 def partial_region_agg(executor, region_id: int, frag,
                        schema=None, seq_min=None,
-                       stats_out=None) -> Optional[dict]:
+                       stats_out=None, prescan=None) -> Optional[dict]:
     """Compute one region's partial aggregate. Returns
     {"keys": [np.ndarray per key], "planes": {op: [G, F] np.ndarray}}
     with G = observed groups in this region, or None for an empty scan.
@@ -268,7 +314,7 @@ def partial_region_agg(executor, region_id: int, frag,
     host = _region_host_columns(executor, region_id, frag.where, ts_range,
                                 needed, frag.append_mode, schema,
                                 tz=frag.tz, seq_min=seq_min,
-                                stats_out=stats_out)
+                                stats_out=stats_out, prescan=prescan)
     if host is None:
         return None
     n = len(host[ts_name])

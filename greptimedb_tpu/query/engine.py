@@ -837,7 +837,7 @@ class QueryEngine:
                 or not hasattr(eng, "execute_fragment")
                 or sel.having is not None):
             return None
-        from greptimedb_tpu.partition.rule import RangePartitionRule
+        from greptimedb_tpu.partition.rule import PartitionRule, rule_from_json
         from greptimedb_tpu.query.expr import extract_ts_bounds
         from greptimedb_tpu.query.join import _columns_in, execute_select_over
         from greptimedb_tpu.query.plan_ser import PlanFragment
@@ -848,8 +848,8 @@ class QueryEngine:
         )
 
         rule = info.partition_rules
-        if not isinstance(rule, RangePartitionRule):
-            rule = RangePartitionRule.from_json(json.dumps(rule))
+        if not isinstance(rule, PartitionRule):
+            rule = rule_from_json(rule)
         rule_cols = set(rule.columns)
         calls = collect_window_calls(sel)
         if not calls:
@@ -897,6 +897,9 @@ class QueryEngine:
         from greptimedb_tpu.query.dist_agg import merge_topk
         from greptimedb_tpu.utils import tracing
 
+        from greptimedb_tpu.utils.metrics import FRAGMENT_PUSHDOWNS
+
+        FRAGMENT_PUSHDOWNS.inc(mode="window")
         with tracing.span("window_pushdown", regions=len(info.region_ids)):
             one = tracing.propagate(
                 lambda rid: eng.execute_fragment(rid, frag))
@@ -979,6 +982,8 @@ class QueryEngine:
             raise PlanError("CREATE TABLE requires a column list")
         if stmt.engine == "metric":
             return self._create_metric_table(db, name, schema, stmt, ctx)
+        if rule is None and not stmt.partitions:
+            rule = self._default_hash_rule(schema)
         ddl = getattr(self.region_engine, "ddl_manager", None)
         if ddl is not None:
             # cluster mode: DDL is a journaled procedure across datanodes
@@ -1009,6 +1014,27 @@ class QueryEngine:
             self.region_engine.create_region(rid, schema)
             self._open_regions.add(rid)
         return QueryResult.of_affected(0)
+
+    def _default_hash_rule(self, schema):
+        """[partition] default_hash_regions: cluster DDL without an
+        explicit PARTITION clause spreads the new table over N hash
+        partitions on the leading tag (or [partition] hash_columns) so
+        ingest scatters and scans fan out without per-table ceremony.
+        Single-node engines (no placement selector) keep one region."""
+        from greptimedb_tpu import config
+
+        n = config.default_hash_partitions()
+        if n <= 1 or not hasattr(self.region_engine, "select_node"):
+            return None
+        tag_names = [c.name for c in schema.tag_columns]
+        cols = config.hash_partition_columns()
+        cols = [c for c in cols if c in tag_names] if cols \
+            else tag_names[:1]
+        if not cols:
+            return None
+        from greptimedb_tpu.partition.rule import HashPartitionRule
+
+        return HashPartitionRule(cols, n)
 
     def _create_file_table(self, db, name, schema, stmt, ctx) -> QueryResult:
         """CREATE EXTERNAL TABLE: an external file as a read-only table
@@ -1449,7 +1475,17 @@ class QueryEngine:
         n = 0
         for region_idx, rows in rule.split(cols, n_rows=batch.num_rows).items():
             rid = info.region_ids[region_idx]
-            n += write(rid, batch.take(rows))
+            part = batch.take(rows)
+            # compact each slice's tag dictionaries to the values its
+            # rows USE: take() keeps the whole statement's dictionary,
+            # so without this every region's tag registry would learn
+            # every other region's series — poisoning registry-based
+            # pruning (lastpoint termination) forever
+            part = RecordBatch(part.schema, {
+                name: (col.compact() if isinstance(col, DictVector)
+                       else col)
+                for name, col in part.columns.items()})
+            n += write(rid, part)
         return n
 
     def _delete(self, stmt: ast.Delete, ctx: QueryContext) -> QueryResult:
@@ -1849,14 +1885,14 @@ def _subst_session_funcs(sel: ast.Select, ctx: QueryContext) -> ast.Select:
 def _cached_rule(info: TableInfo):
     """Parse the table's partition rule once and memoize it on the
     TableInfo (hot write path: no JSON round-trip per INSERT)."""
-    from greptimedb_tpu.partition.rule import RangePartitionRule
+    from greptimedb_tpu.partition.rule import PartitionRule, rule_from_json
 
     rule = getattr(info, "_rule_cache", None)
     if rule is None:
         rule = (
             info.partition_rules
-            if isinstance(info.partition_rules, RangePartitionRule)
-            else RangePartitionRule.from_json(json.dumps(info.partition_rules))
+            if isinstance(info.partition_rules, PartitionRule)
+            else rule_from_json(info.partition_rules)
         )
         info._rule_cache = rule
     return rule

@@ -1174,7 +1174,7 @@ class PhysicalExecutor:
     def _note_tier(self, tier: str, num_rows: int, seconds: float) -> None:
         """Feed one measured execution into the per-tier history ring
         (the device_agg span's duration, bucketed by scan size)."""
-        if tier not in ("device", "host"):
+        if tier not in ("device", "host", "mesh"):
             return
         from collections import deque as _deque
 
@@ -1207,6 +1207,38 @@ class PhysicalExecutor:
             return "host" if winner == "device" else "device"
         return winner
 
+    def _mesh_from_history(self, num_rows: int) -> str:
+        """Measured mesh-vs-single-device verdict for this scan-size
+        class. Defaults to "mesh" until both tiers hold >=3 real samples
+        (the mesh must get its first measurements from somewhere); every
+        16th decision explores the loser so a regression on the unused
+        tier is re-measured instead of frozen in. GREPTIMEDB_TPU_
+        TIER_ADAPTIVE=off pins the static always-mesh routing."""
+        from greptimedb_tpu import config
+
+        if not config.tier_adaptive():
+            return "mesh"
+        b = max(int(num_rows), 1).bit_length()
+        with self._tier_lock:
+            mesh = sorted(self._tier_hist.get(("mesh", b), ()))
+            dev = sorted(self._tier_hist.get(("device", b), ()))
+            n = self._tier_explore.get(("mesh", b), 0) + 1
+            self._tier_explore[("mesh", b)] = n
+            if len(mesh) < 3 or len(dev) < 3:
+                # seed the underfilled ring: mesh-eligible shapes never
+                # reach the single-device paths on their own, so without
+                # this forced sample the >=3 gate would hold forever and
+                # the measured arbitration below would be unreachable
+                if len(dev) < 3 and n % 8 == 0:
+                    return "device"
+                return "mesh"
+            med_m = mesh[len(mesh) // 2]
+            med_d = dev[len(dev) // 2]
+            winner = "mesh" if med_m <= med_d else "device"
+        if n % 16 == 0:
+            return "device" if winner == "mesh" else "mesh"
+        return winner
+
     def tier_for(self, agg, num_rows: int, streaming: bool = False) -> str:
         """Tiered execution (round-5 redesign): over a REMOTE
         accelerator link every interactive query is readback-bound —
@@ -1220,7 +1252,16 @@ class PhysicalExecutor:
         device."""
         from greptimedb_tpu import config
 
-        if jax.default_backend() == "cpu" or self.mesh is not None:
+        if self.mesh is not None:
+            # measured "mesh" tier: aggregate scans big enough to
+            # amortize per-shard dispatch ride the mesh, unless the
+            # latency history says single-device wins this size class
+            if (agg is not None and not streaming
+                    and num_rows >= config.mesh_min_rows()
+                    and self._mesh_from_history(num_rows) == "mesh"):
+                return "mesh"
+            return "device"
+        if jax.default_backend() == "cpu":
             return "device"
         mode = config.host_tier_mode()
         if mode == "off":
@@ -1506,6 +1547,21 @@ class PhysicalExecutor:
         if out is None:
             return None
         frag, mode = out
+        lp_tag = None
+        if mode == "agg" and os.environ.get("GTPU_LASTFRAG", "1") \
+                not in ("0", "off"):
+            # lastpoint pruning hint: an all-`last` single-tag aggregate
+            # lets each region owner serve its partial from the newest-
+            # first pruned scan (Region.scan_last) instead of decoding
+            # the whole region — cluster mode used to pay the full raw
+            # scan per datanode here (ROADMAP item 3 cliff).
+            # GTPU_LASTFRAG=0 pins the unhinted fragment for A/B.
+            lp_tag = self._lastpoint_tag(table, where, agg, ts_range)
+            if lp_tag is not None:
+                frag.stages.insert(0, {"op": "lastpoint", "tag": lp_tag})
+        from greptimedb_tpu.utils.metrics import FRAGMENT_PUSHDOWNS
+
+        FRAGMENT_PUSHDOWNS.inc(mode="lastpoint" if lp_tag else mode)
         with tracing.span("fragment_pushdown", mode=mode,
                           regions=len(table.region_ids)):
             rids = list(table.region_ids)
@@ -1533,21 +1589,10 @@ class PhysicalExecutor:
                     else agg_stage["args"].index(spec.arg))
             combined = combine_partials(partials, len(agg.keys),
                                         tuple(agg_stage["ops"]))
-            self.last_path = "pushdown"
-            if combined is None:
-                return self._empty_agg_result(table, agg, having, project,
-                                              sort, limit, offset)
-            planes = combined["planes"]
-            g = len(combined["keys"][0]) if agg.keys else 1
-            present = np.arange(g)
-            env: dict = {}
-            for i, (name, kexpr) in enumerate(agg.keys):
-                env[kexpr] = combined["keys"][i]
-            for spec, slot in zip(agg.aggs, spec_slot):
-                env[spec.call] = _finalize_agg(spec.func, planes, slot,
-                                               present)
-            return self._post_process(env, agg, having, project, sort,
-                                      limit, offset, table, g)
+            self.last_path = "lastfrag+pushdown" if lp_tag else "pushdown"
+            return self._finalize_combined_agg(
+                combined, table, agg, having, project, sort, limit,
+                offset, spec_slot)
 
         merged = merge_topk(partials)
         if mode == "rows_agg":
@@ -1574,6 +1619,26 @@ class PhysicalExecutor:
         nrows = len(next(iter(host_cols.values()))) if host_cols else 0
         return self._post_process({}, None, None, project, sort, limit,
                                   offset, table, nrows, host_cols=host_cols)
+
+    def _finalize_combined_agg(self, combined, table, agg, having, project,
+                               sort, limit, offset,
+                               spec_slot) -> QueryResult:
+        """Final step over combined [G, F] partial planes — shared by
+        the fragment pushdown and the vmapped-fragments member loop."""
+        if combined is None:
+            return self._empty_agg_result(table, agg, having, project,
+                                          sort, limit, offset)
+        planes = combined["planes"]
+        g = len(combined["keys"][0]) if agg.keys else 1
+        present = np.arange(g)
+        env: dict = {}
+        for i, (name, kexpr) in enumerate(agg.keys):
+            env[kexpr] = combined["keys"][i]
+        for spec, slot in zip(agg.aggs, spec_slot):
+            env[spec.call] = _finalize_agg(spec.func, planes, slot,
+                                           present)
+        return self._post_process(env, agg, having, project, sort,
+                                  limit, offset, table, g)
 
     def _execute_agg(self, scan, table, where, agg, having, project, sort,
                      limit, offset, scan_node) -> QueryResult:
@@ -1646,8 +1711,11 @@ class PhysicalExecutor:
             acc, sparse_gids = self._stream_agg(*stream_args)
         # measured-routing feed: what this tier actually cost for this
         # scan size (results are materialized host-side by here, so the
-        # clock covers upload + kernels + readback)
-        self._note_tier(tier, scan.num_rows, time.perf_counter() - t0)
+        # clock covers upload + kernels + readback). last_tier is the
+        # EFFECTIVE tier — a mesh-routed query that degraded to the
+        # single-device paths must feed the device history, not mesh's
+        self._note_tier(self.last_tier, scan.num_rows,
+                        time.perf_counter() - t0)
         if reduced is not None:
             self.last_path = "boundary+" + (self.last_path or "")
         host_info = (scan, extra_cols, bound_where, ctx, num_groups)
@@ -2298,6 +2366,10 @@ class PhysicalExecutor:
 
         if sparse:
             self.last_path = "sparse"
+            if self.last_tier == "mesh":
+                # high-cardinality shapes run the single-device
+                # sort-compact path; report the tier that actually served
+                self.last_tier = "device"
             return self._sparse_scan(
                 scan, device_col_names, extra_cols, float_fields, acc_dtype,
                 dedup_mask, bound_where, keys, arg_exprs, ops, ts_name,
@@ -2308,17 +2380,31 @@ class PhysicalExecutor:
         # INSIDE the collective combine — only value planes leave the mesh
         ts_only_ints = bool(int_ops) and all(k.endswith("_ts")
                                              for k in int_ops)
-        if (mesh is not None and (not int_ops or ts_only_ints)
-                and set(ops) <= set(COLLECTIVE_OPS)
-                and n >= config.mesh_min_rows()):
-            self.last_path = "sharded"
-            packed_f = self._sharded_scan(
-                scan, mesh, device_col_names, extra_cols, float_fields,
-                acc_dtype, dedup_mask, bound_where, keys, arg_exprs, ops,
-                num_groups, ts_name, tag_names, schema, float_ops, pack_dtype)
-            packed_i = None
-            int_ops = ()
-        elif self._prepared_ok(arg_exprs, ops, int_ops, schema, extra_cols):
+        mesh_shape_ok = (mesh is not None and (not int_ops or ts_only_ints)
+                         and set(ops) <= set(COLLECTIVE_OPS))
+        if mesh_shape_ok and self.last_tier == "mesh":
+            from greptimedb_tpu.parallel.sharded_dispatch import (
+                MeshIneligible,
+            )
+
+            try:
+                self.last_path = "sharded"
+                packed_f = self._sharded_scan(
+                    scan, mesh, device_col_names, extra_cols, float_fields,
+                    acc_dtype, dedup_mask, bound_where, keys, arg_exprs,
+                    ops, num_groups, ts_name, tag_names, schema, float_ops,
+                    pack_dtype)
+                return (_unpack_acc(packed_f, None, float_ops, (),
+                                    widths), None)
+            except MeshIneligible:
+                # typed degradation: a plan/shape the shard dispatch
+                # cannot serve falls back to the single-device paths
+                self.last_tier = "device"
+        elif self.last_tier == "mesh":
+            # the router picked the mesh before seeing the op set; a
+            # non-collective shape runs single-device and must report so
+            self.last_tier = "device"
+        if self._prepared_ok(arg_exprs, ops, int_ops, schema, extra_cols):
             arg_names = tuple(a.name for a in arg_exprs)
             aux_names = self._device_columns(
                 scan, bound_where, keys, (), ts_name, extra_cols)
@@ -2468,7 +2554,116 @@ class PhysicalExecutor:
                       arg_exprs, ops, num_groups, ts_name, tag_names, schema,
                       float_ops, pack_dtype):
         """Place the scan's columns across the mesh's "shard" axis and run
-        the collective aggregation — the integrated multi-chip MergeScan."""
+        the collective aggregation — the integrated multi-chip MergeScan.
+        Part-aligned dispatch (parallel/sharded_dispatch.py) is the
+        default: per-segment uploads are file-anchored on their owning
+        shard, so a flush transfers only its new file. Meshes with a real
+        field axis keep the legacy whole-scan device_put placement."""
+        from greptimedb_tpu.parallel import sharded_dispatch as sd
+
+        if sd.eligible(mesh):
+            return self._sharded_scan_parts(
+                scan, mesh, device_col_names, extra_cols, float_fields,
+                acc_dtype, dedup_mask, bound_where, keys, arg_exprs, ops,
+                num_groups, ts_name, tag_names, schema, float_ops,
+                pack_dtype)
+        return self._sharded_scan_even(
+            scan, mesh, device_col_names, extra_cols, float_fields,
+            acc_dtype, dedup_mask, bound_where, keys, arg_exprs, ops,
+            num_groups, ts_name, tag_names, schema, float_ops, pack_dtype)
+
+    def _sharded_scan_parts(self, scan, mesh, device_col_names, extra_cols,
+                            float_fields, acc_dtype, dedup_mask, bound_where,
+                            keys, arg_exprs, ops, num_groups, ts_name,
+                            tag_names, schema, float_ops, pack_dtype):
+        """Part-aligned mesh dispatch: the shard plan assigns immutable
+        SST segments to shards (prefix-stable greedy balance), per-
+        segment uploads land file-anchored on the owning shard's device,
+        and the assembled per-shard buffers form the global array with
+        zero cross-device traffic (sharded_dispatch module docstring)."""
+        from greptimedb_tpu.parallel import sharded_dispatch as sd
+
+        n_shard = mesh.shape["shard"]
+        plan = sd.plan_shards(scan, n_shard)
+        tier = _ACTIVE_TIER_VAR.get()
+        snap_v = _snap_version(scan)
+        cache = self.cache
+        prepared = self._prepared_ok(arg_exprs, ops, (), schema, extra_cols)
+        names = device_col_names
+        if prepared:
+            names = self._device_columns(scan, bound_where, keys, (),
+                                         ts_name, extra_cols)
+        cols = {}
+        for name in names:
+            cast = acc_dtype if name in float_fields else None
+
+            def build_slice(start, end, out_rows, name=name, cast=cast):
+                src = extra_cols[name] if name in extra_cols \
+                    else scan.columns[name]
+                arr = pad_rows(src[start:end], out_rows)
+                if cast is not None and arr.dtype != cast:
+                    arr = arr.astype(cast)
+                return arr
+
+            cols[name] = sd.sharded_column(
+                # extra_cols hold query-specific factorized keys: their
+                # content is not a pure function of the file — never
+                # cache them under file/snapshot identity
+                None if name in extra_cols else cache,
+                mesh, plan, scan, name, build_slice, tier=tier,
+                snap_version=snap_v, extra=(str(cast),))
+        base_s = sd.sharded_mask(mesh, plan, scan, dedup_mask, cache=cache,
+                                 tier=tier, snap_version=snap_v)
+        if prepared:
+            self.last_path = "sharded_prepared"
+            arg_names = tuple(a.name for a in arg_exprs)
+            has_nan = self._scan_has_nan(scan, arg_names)
+            nf = len(arg_names)
+            # sum + sq moments both need f64 for stddev/variance (see the
+            # dense branch note)
+            prep_dtype = jnp.dtype(jnp.float64) if "sumsq" in ops \
+                else acc_dtype
+            plane_kinds = [("__prep__", None, prep_dtype, 0.0)]
+            if "min" in ops:
+                plane_kinds.append(("__prep_min__", "min", acc_dtype,
+                                    np.inf))
+            if "max" in ops:
+                plane_kinds.append(("__prep_max__", "max", acc_dtype,
+                                    -np.inf))
+            if "sumsq" in ops:
+                plane_kinds.append(("__prep_sq__", "sq", prep_dtype, 0.0))
+            for plane_name, kind, pdt, fill in plane_kinds:
+                def build_plane_slice(start, end, out_rows, kind=kind,
+                                      pdt=pdt):
+                    return _build_prep(scan, arg_names, start, end,
+                                       out_rows, pdt, has_nan, kind)
+
+                cols[plane_name] = sd.sharded_column(
+                    cache, mesh, plan, scan,
+                    (plane_name,) + arg_names, build_plane_slice,
+                    tier=tier, snap_version=snap_v,
+                    extra=(str(pdt), has_nan), pad_fill=fill)
+            sd.note_dispatch("sharded_prepared", plan)
+            return _agg_scan_sharded_prepared(
+                cols, base_s, mesh=mesh, where=bound_where, keys=keys,
+                nf=nf, has_nan=has_nan, num_segments=num_groups,
+                tag_names=tag_names, schema=schema, float_ops=float_ops,
+                pack_dtype=pack_dtype)
+        sd.note_dispatch("sharded", plan)
+        return _agg_scan_sharded(
+            cols, base_s, mesh=mesh, where=bound_where, keys=keys,
+            agg_args=arg_exprs, ops=ops, num_segments=num_groups,
+            ts_name=ts_name, tag_names=tag_names, schema=schema,
+            acc_dtype=acc_dtype, float_ops=float_ops, pack_dtype=pack_dtype)
+
+    def _sharded_scan_even(self, scan, mesh, device_col_names, extra_cols,
+                           float_fields, acc_dtype, dedup_mask, bound_where,
+                           keys, arg_exprs, ops, num_groups, ts_name,
+                           tag_names, schema, float_ops, pack_dtype):
+        """Legacy whole-scan placement (one device_put over the
+        NamedSharding): kept for meshes with a real field axis, where the
+        per-shard committed-buffer assembly would need replicated
+        placement. Snapshot-anchored only — a flush re-uploads."""
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         n = scan.num_rows

@@ -87,11 +87,36 @@ def _rebuild_conjunction(conjuncts: list) -> Optional[ast.Expr]:
     return e
 
 
+def _member_mask(cols, base_mask, shared_where, param_specs, pvals,
+                 tag_names, schema):
+    """One member's row mask: shared conjuncts plus its stacked
+    parameter comparisons (shared by the single-region and region-
+    partial kernels)."""
+    mask = base_mask
+    if shared_where is not None:
+        w = eval_device(shared_where, cols, tag_names, schema)
+        mask = mask & (w if w.dtype == jnp.bool_ else w != 0)
+    for (name, op), pv in zip(param_specs, pvals):
+        c = cols[name]
+        if op == "=":
+            mask = mask & (c == pv)
+        elif op == "<":
+            mask = mask & (c < pv)
+        elif op == "<=":
+            mask = mask & (c <= pv)
+        elif op == ">":
+            mask = mask & (c > pv)
+        else:  # ">="
+            mask = mask & (c >= pv)
+    return mask
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("shared_where", "param_specs", "keys", "agg_args",
-                     "ops", "num_segments", "tag_names", "schema",
-                     "acc_dtype", "float_ops", "pack_dtype"),
+                     "ops", "num_segments", "ts_name", "need_ts",
+                     "tag_names", "schema", "acc_dtype", "float_ops",
+                     "pack_dtype"),
 )
 def _vmapped_agg_scan(
     blocks: tuple,  # per-block col dicts (member-invariant)
@@ -100,13 +125,16 @@ def _vmapped_agg_scan(
     params: tuple,  # per-spec [M] stacked parameter arrays
     *,
     shared_where, param_specs, keys, agg_args, ops, num_segments,
-    tag_names, schema, acc_dtype, float_ops, pack_dtype,
+    ts_name, need_ts, tag_names, schema, acc_dtype, float_ops,
+    pack_dtype,
 ):
     """One dispatch for M parameter-sibling queries. Everything that
     does not depend on the member parameters (group ids, value planes,
     the shared-predicate mask) is traced once and stays unbatched;
     only the per-member mask and the segment reductions carry the
-    vmapped leading axis."""
+    vmapped leading axis. first/last ride as ts-paired planes: the
+    companion *_ts planes drive the cross-block combine on device and
+    never leave the kernel (the value planes are what the host reads)."""
 
     def member(pvals):
         acc = None
@@ -115,28 +143,16 @@ def _vmapped_agg_scan(
             mask = jnp.arange(some.shape[0]) < n_valids[i]
             if dedup_masks is not None:
                 mask = mask & dedup_masks[i]
-            if shared_where is not None:
-                w = eval_device(shared_where, cols, tag_names, schema)
-                mask = mask & (w if w.dtype == jnp.bool_ else w != 0)
-            for (name, op), pv in zip(param_specs, pvals):
-                c = cols[name]
-                if op == "=":
-                    mask = mask & (c == pv)
-                elif op == "<":
-                    mask = mask & (c < pv)
-                elif op == "<=":
-                    mask = mask & (c <= pv)
-                elif op == ">":
-                    mask = mask & (c > pv)
-                else:  # ">="
-                    mask = mask & (c >= pv)
+            mask = _member_mask(cols, mask, shared_where, param_specs,
+                                pvals, tag_names, schema)
             gid = ph._group_ids(cols, keys, mask.shape[0])
             if agg_args:
                 values = ph._value_planes(agg_args, cols, tag_names,
                                           schema, mask.shape, acc_dtype)
             else:
                 values = jnp.zeros((mask.shape[0], 1), dtype=acc_dtype)
-            part = segment_agg(values, gid, mask, num_segments, ops=ops)
+            part = segment_agg(values, gid, mask, num_segments, ops=ops,
+                               ts=cols[ts_name] if need_ts else None)
             acc = ph._combine_partials(acc, part)
         parts = []
         for k in float_ops:
@@ -195,10 +211,20 @@ def run_vmapped(executor, sel: ast.Select, info, pspecs,
     schema = table.schema
     ts_name = schema.time_index.name
 
-    if len(table.region_ids) != 1 or not hasattr(executor.engine, "scan"):
-        raise VmapIneligible("multi-region scans gather via fragments")
     if any(ph._needs_host_agg(spec, schema) for spec in agg.aggs):
         raise VmapIneligible("host-side aggregate in batch shape")
+    if len(table.region_ids) != 1:
+        # cluster frontend: the members execute as ONE vmapped_agg
+        # fragment per region — per-member [G, F] partials come back
+        # and combine like the serial pushdown's Final step (no raw
+        # rows, no IN-list/serial fallback)
+        if hasattr(executor.engine, "execute_fragment"):
+            return _run_vmapped_fragments(
+                executor, sel, info, pspecs, member_values, project, agg,
+                template_where)
+        raise VmapIneligible("multi-region scans gather via fragments")
+    if not hasattr(executor.engine, "scan"):
+        raise VmapIneligible("engine has no materialized scan")
 
     # split the predicate: parameter conjuncts out, shared rest stays.
     # plan_select passes sel.where through by reference, so the
@@ -210,32 +236,11 @@ def run_vmapped(executor, sel: ast.Select, info, pspecs,
         raise VmapIneligible("parameter conjuncts lost in planning")
     shared_where_ast = _rebuild_conjunction(shared)
 
-    # union time range (drives only the bucket-key domain; the scan
-    # itself reads the full region so every member's serial scan is a
-    # per-part row-subset of it)
-    lo = hi = None
-    lo_open = hi_open = False
-    for values in member_values:
-        repl = {id(p.conjunct): ast.BinaryOp(
-            p.op, ast.Column(p.col), ast.Literal(v))
-            for p, v in zip(pspecs, values)}
-        member_where = _replace_by_id(template_where, repl)
-        r = extract_ts_bounds(member_where, ts_name,
-                              schema.time_index.dtype)
-        mlo, mhi = r if r is not None else (None, None)
-        if mlo is None:
-            lo_open = True
-        elif lo is None or mlo < lo:
-            lo = mlo
-        if mhi is None:
-            hi_open = True
-        elif hi is None or mhi > hi:
-            hi = mhi
-    union_range = None
-    if not (lo_open and hi_open):
-        union_range = (None if lo_open else lo, None if hi_open else hi)
-        if union_range == (None, None):
-            union_range = None
+    # union time range (drives the bucket-key domain and the scan's
+    # coarse pruning; member masks carve exact slices on device)
+    union_range = _union_member_range(template_where, pspecs,
+                                      member_values, ts_name,
+                                      schema.time_index.dtype)
 
     # one scan covering the UNION of the member windows (tag predicates
     # stay None: every member's rows must be present); member masks
@@ -319,13 +324,17 @@ def run_vmapped(executor, sel: ast.Select, info, pspecs,
     ops: set = {"rows"}
     for spec in agg.aggs:
         ops.update(ph._PRIMITIVES[spec.func])
-    if {"first", "last"} & ops:
-        raise VmapIneligible("first/last need the ts-paired planes")
+    # first/last batch too (ROADMAP item 1 rung): the kernel pairs each
+    # group's value with its timestamp, so lastpoint-class dashboards
+    # ride the stacked axis like every other aggregate
+    need_ts = bool({"first", "last"} & ops)
 
     acc_dtype = jnp.dtype(config.compute_dtype())
     nf = max(len(arg_exprs), 1)
     float_ops_l, widths = [], {}
     for op in sorted(ops):
+        if op.endswith("_ts"):
+            continue  # companion planes stay inside the kernel
         float_ops_l.append(op)
         widths[op] = 1 if op == "rows" else nf
     float_ops = tuple(float_ops_l)
@@ -375,6 +384,7 @@ def run_vmapped(executor, sel: ast.Select, info, pspecs,
             shared_where=bound_shared, param_specs=tuple(cols_ops),
             keys=tuple(keys), agg_args=tuple(arg_exprs),
             ops=tuple(sorted(ops)), num_segments=num_groups,
+            ts_name=ts_name, need_ts=need_ts,
             tag_names=tag_names, schema=schema, acc_dtype=acc_dtype,
             float_ops=float_ops, pack_dtype=pack_dtype)
         host = ph._readback(packed)
@@ -395,6 +405,363 @@ def run_vmapped(executor, sel: ast.Select, info, pspecs,
             acc, None, agg, keys, decoders, spec_slot, host_info,
             None, project, None, None, None, table))
     executor.last_path = "dense_vmapped"
+    return results
+
+
+def _union_member_range(template_where, pspecs, member_values, ts_name,
+                        ts_dtype):
+    """(lo, hi) covering every member's ts bounds, or None when any
+    member is unbounded on either side. Scanning the union is the
+    parity-preserving coarse prune: rows outside a member's own window
+    are masked by its bound ts parameters on device."""
+    lo = hi = None
+    lo_open = hi_open = False
+    for values in member_values:
+        repl = {id(p.conjunct): ast.BinaryOp(
+            p.op, ast.Column(p.col), ast.Literal(v))
+            for p, v in zip(pspecs, values)}
+        member_where = _replace_by_id(template_where, repl)
+        r = extract_ts_bounds(member_where, ts_name, ts_dtype)
+        mlo, mhi = r if r is not None else (None, None)
+        if mlo is None:
+            lo_open = True
+        elif lo is None or mlo < lo:
+            lo = mlo
+        if mhi is None:
+            hi_open = True
+        elif hi is None or mhi > hi:
+            hi = mhi
+    if lo_open and hi_open:
+        return None
+    union_range = (None if lo_open else lo, None if hi_open else hi)
+    return None if union_range == (None, None) else union_range
+
+
+# ---- multi-region: vmapped partials over plan fragments ---------------------
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("shared_where", "param_specs", "keys", "agg_args",
+                     "ops", "num_segments", "ts_name", "need_ts",
+                     "tag_names", "schema", "acc_dtype"),
+)
+def _vmapped_partial_scan(
+    cols: dict,  # whole-scan padded column arrays (member-invariant)
+    base_mask: jax.Array,
+    params: tuple,
+    *,
+    shared_where, param_specs, keys, agg_args, ops, num_segments,
+    ts_name, need_ts, tag_names, schema, acc_dtype,
+):
+    """Region-side member batch: ONE whole-scan segment reduction per
+    member over the stacked axis. Deliberately not block-split: the
+    serial cluster partial (`partial_region_agg`) reduces the region's
+    filtered rows with a single segment_agg, and a masked whole-scan
+    fold visits the same rows in the same order with identity elements
+    interleaved — bit-for-bit the same per-group result."""
+
+    def member(pvals):
+        mask = _member_mask(cols, base_mask, shared_where, param_specs,
+                            pvals, tag_names, schema)
+        gid = ph._group_ids(cols, keys, mask.shape[0])
+        if agg_args:
+            values = ph._value_planes(agg_args, cols, tag_names, schema,
+                                      mask.shape, acc_dtype)
+        else:
+            values = jnp.zeros((mask.shape[0], 1), dtype=acc_dtype)
+        return segment_agg(values, gid, mask, num_segments, ops=ops,
+                           ts=cols[ts_name] if need_ts else None)
+
+    return jax.vmap(member)(params)
+
+
+def run_vmapped_region_partial(executor, region_id: int, vm: dict,
+                               schema=None, *, where=None, ts_range=None,
+                               append_mode=False, tz=None):
+    """Execute a `vmapped_agg` fragment stage against ONE local region:
+    all members' partial aggregates in a single stacked dispatch.
+    Returns {"members": [per-member {"keys", "planes"} | None]} — the
+    per-member twin of `partial_region_agg`'s output, combined by the
+    frontend with the same `combine_partials` Final step — or
+    {"vmap_ineligible": reason} when this region cannot serve the batch
+    with provable serial parity (the frontend then falls back to
+    serial/stacked member execution; typed, never an error)."""
+    from greptimedb_tpu.query.expr import reset_session_tz, set_session_tz
+
+    token = set_session_tz(tz)
+    try:
+        return _region_partial_inner(executor, region_id, vm, schema,
+                                     append_mode, ts_range)
+    except VmapIneligible as e:
+        return {"vmap_ineligible": str(e)}
+    finally:
+        reset_session_tz(token)
+
+
+def _region_partial_inner(executor, region_id, vm, schema, append_mode,
+                          ts_range=None):
+    from types import SimpleNamespace
+
+    from greptimedb_tpu import config
+    from greptimedb_tpu.ops.blocks import block_size_for, pad_rows
+    from greptimedb_tpu.query.expr import collect_columns
+
+    eng = executor.engine
+    probe = eng.region(region_id)
+    schema = schema or probe.schema
+    ts_name = schema.time_index.name
+    keys_spec = list(vm["keys"])
+    args = list(vm["args"])
+    ops = tuple(sorted(vm["ops"]))
+    pspecs = [tuple(p) for p in vm["params"]]
+    values = vm["values"]
+    m = len(values)
+    need_ts = bool({"first", "last"} & set(ops))
+
+    needed: set = {ts_name}
+    collect_columns(vm.get("shared_where"), needed)
+    for _, kexpr in keys_spec:
+        collect_columns(kexpr, needed)
+    for a in args:
+        collect_columns(a, needed)
+    for col, _op in pspecs:
+        needed.add(col)
+    proj = [c for c in schema.names if c in needed]
+    # the fragment's ts_range is the UNION of member windows: index-
+    # pruned like the serial per-member pushdown scan; rows outside a
+    # member's own window are masked by its ts parameters below
+    scan = eng.scan(region_id, ph._closed_range(ts_range), proj, None)
+    if scan is None or scan.num_rows == 0:
+        return {"members": [None] * m}
+    n = scan.num_rows
+    bctx = BindContext(schema, scan.tag_dicts)
+    shared_ast = vm.get("shared_where")
+    bound_shared = bind_expr(shared_ast, bctx) \
+        if shared_ast is not None else None
+
+    # stacked parameters bound through the engine's own literal
+    # coercion (identical to what each member's serial WHERE would
+    # compare against on THIS region's dictionaries)
+    cols_ops: list[tuple] = []
+    matrix: list[list[int]] = [[] for _ in pspecs]
+    for vals in values:
+        for j, ((col, op), v) in enumerate(zip(pspecs, vals)):
+            name, bop, bval = _bind_param(
+                SimpleNamespace(col=col, op=op), v, bctx)
+            if len(cols_ops) <= j:
+                cols_ops.append((name, bop))
+            elif cols_ops[j] != (name, bop):
+                raise VmapIneligible("parameter spec drift across members")
+            matrix[j].append(bval)
+
+    shim_node = SimpleNamespace(ts_range=None, columns=proj)
+    keys: list = []
+    decoders: list = []
+    extra_cols: dict[str, np.ndarray] = {}
+    for i, (name, kexpr) in enumerate(keys_spec):
+        dk, decode = executor._plan_key(i, kexpr, bctx, scan, shim_node,
+                                        extra_cols)
+        keys.append(dk)
+        decoders.append(decode)
+    num_groups = 1
+    for k in keys:
+        num_groups *= k.size
+    if num_groups > config.dense_groups_max() \
+            or num_groups >= ph._GID_SENTINEL:
+        raise VmapIneligible(f"group domain {num_groups} needs sparse path")
+    mp = _pad_width(m)
+    if keys and mp * num_groups > config.dense_groups_max():
+        raise VmapIneligible("stacked accumulator exceeds dense budget")
+
+    bound_args = [bind_expr(a, bctx) for a in args]
+    for b in bound_args:
+        if ph._needs_host_agg(SimpleNamespace(func="sum", arg=b), schema):
+            raise VmapIneligible("non-numeric aggregate argument")
+    tshim = SimpleNamespace(schema=schema, append_mode=append_mode)
+    dedup_mask = executor._maybe_dedup(scan, tshim, bctx)
+
+    # the serial partial computes in float64 (partial_region_agg casts
+    # eval_host planes to f64) — match it exactly, even on f32 backends
+    acc_dtype = jnp.dtype(jnp.float64)
+    tag_names = frozenset(bctx.tag_names)
+    names = executor._device_columns(scan, bound_shared, keys,
+                                     tuple(bound_args), ts_name,
+                                     extra_cols)
+    for pname, _op in cols_ops:
+        if pname not in names:
+            names.append(pname)
+    n_pad = block_size_for(n)
+    float_fields = {c.name for c in schema.field_columns
+                    if c.dtype.is_float}
+    dev_cols = {}
+    for name in names:
+        src = extra_cols[name] if name in extra_cols else scan.columns[name]
+        arr = pad_rows(np.asarray(src), n_pad)
+        if name in float_fields and arr.dtype != acc_dtype:
+            arr = arr.astype(acc_dtype)
+        dev_cols[name] = jnp.asarray(arr)
+    base = np.arange(n_pad) < n
+    base = jnp.asarray(base)
+    if dedup_mask is not None:
+        base = base & jnp.concatenate(
+            [dedup_mask, jnp.zeros(n_pad - n, dtype=bool)])
+    params = []
+    for j, (pname, _op) in enumerate(cols_ops):
+        dt = np.int64 if pname == ts_name else np.int32
+        vals = matrix[j] + [matrix[j][-1]] * (mp - m)
+        params.append(jnp.asarray(np.asarray(vals, dtype=dt)))
+
+    out = _vmapped_partial_scan(
+        dev_cols, base, tuple(params),
+        shared_where=bound_shared, param_specs=tuple(cols_ops),
+        keys=tuple(keys), agg_args=tuple(bound_args), ops=ops,
+        num_segments=num_groups, ts_name=ts_name, need_ts=need_ts,
+        tag_names=tag_names, schema=schema, acc_dtype=acc_dtype)
+    host = {op: np.asarray(v) for op, v in out.items()}
+
+    strides = ph._strides([k.size for k in keys])
+    members = []
+    for i in range(m):
+        rows = host["rows"][i].reshape(-1)
+        if keys:
+            present = np.flatnonzero(rows > 0)
+            if present.size == 0:
+                members.append(None)
+                continue
+            key_cols = []
+            for j, decode in enumerate(decoders):
+                idx = (present // strides[j]) % keys[j].size
+                col, _dt = decode(idx)
+                key_cols.append(np.asarray(col))
+        else:
+            if rows[0] <= 0:
+                members.append(None)
+                continue
+            present = np.arange(1)
+            key_cols = []
+        planes = {}
+        for op, plane in host.items():
+            p = plane[i]
+            planes[op] = p[present] if p.ndim >= 1 else p
+        members.append({"keys": key_cols, "planes": planes})
+    return {"members": members}
+
+
+_JSON_LITERALS = (str, int, float, bool, type(None))
+
+
+def _coerce_partial(part: dict) -> dict:
+    """Normalize a per-member partial from either transport (in-process
+    numpy or JSON lists over Flight) into combine_partials' shape."""
+    keys = []
+    for k in part["keys"]:
+        if isinstance(k, np.ndarray):
+            keys.append(k)
+            continue
+        arr = np.asarray(k, dtype=object)
+        vals = arr.tolist()
+        if len(vals) and all(
+                isinstance(x, (int, np.integer)) and not isinstance(x, bool)
+                for x in vals):
+            arr = arr.astype(np.int64)  # bucket keys stay int64
+        keys.append(arr)
+    planes = {}
+    for op, v in part["planes"].items():
+        planes[op] = v if isinstance(v, np.ndarray) else np.asarray(v)
+    return {"keys": keys, "planes": planes}
+
+
+def _run_vmapped_fragments(executor, sel, info, pspecs, member_values,
+                           project, agg, template_where) -> list:
+    """Cluster-mode member batch: ship ONE `vmapped_agg` fragment per
+    region, combine each member's per-region [G, F] partials with the
+    SAME Final step the serial pushdown uses (`combine_partials`), and
+    post-process per member. What crosses the wire is partial planes
+    per member — today's fallback was IN-list/serial per member over
+    the same regions."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from greptimedb_tpu.query.dist_agg import combine_partials
+    from greptimedb_tpu.query.expr import current_session_tz
+    from greptimedb_tpu.query.plan_ser import PlanFragment
+    from greptimedb_tpu.utils import tracing
+    from greptimedb_tpu.utils.metrics import FRAGMENT_PUSHDOWNS
+
+    table = info
+    for vals in member_values:
+        for v in vals:
+            if not isinstance(v, _JSON_LITERALS):
+                raise VmapIneligible("non-literal member parameter")
+    param_ids = {id(p.conjunct) for p in pspecs}
+    shared = [c for c in split_conjuncts(template_where)
+              if id(c) not in param_ids]
+    if len(shared) + len(pspecs) != len(split_conjuncts(template_where)):
+        raise VmapIneligible("parameter conjuncts lost in planning")
+    shared_where_ast = _rebuild_conjunction(shared)
+
+    arg_exprs: list = []
+    spec_slot: list = []
+    for spec in agg.aggs:
+        if spec.arg is None:
+            spec_slot.append(None)
+            continue
+        if spec.arg not in arg_exprs:
+            arg_exprs.append(spec.arg)
+        spec_slot.append(arg_exprs.index(spec.arg))
+    ops: set = {"rows"}
+    for spec in agg.aggs:
+        ops.update(ph._PRIMITIVES[spec.func])
+
+    schema = table.schema
+    union_range = _union_member_range(
+        template_where, pspecs, member_values,
+        schema.time_index.name, schema.time_index.dtype)
+    stage = {"op": "vmapped_agg",
+             "keys": list(agg.keys),
+             "args": arg_exprs,
+             "ops": sorted(ops),
+             "shared_where": shared_where_ast,
+             "params": [(p.col, p.op) for p in pspecs],
+             "values": [list(vals) for vals in member_values]}
+    frag = PlanFragment(stages=[stage], ts_range=union_range,
+                        append_mode=table.append_mode,
+                        tz=current_session_tz())
+    FRAGMENT_PUSHDOWNS.inc(mode="vmapped")
+    rids = list(table.region_ids)
+    m = len(member_values)
+    with tracing.span("vmapped_fragments", regions=len(rids), members=m):
+        one = tracing.propagate(
+            lambda rid: executor.engine.execute_fragment(rid, frag))
+        if len(rids) > 1:
+            with ThreadPoolExecutor(
+                    max_workers=min(8, len(rids))) as pool:
+                resps = list(pool.map(one, rids))
+        else:
+            resps = [one(rids[0])]
+
+    per_member: list = [[] for _ in range(m)]
+    for resp in resps:
+        if resp is None:
+            continue  # empty region contributes nothing
+        if "vmap_ineligible" in resp:
+            raise VmapIneligible(str(resp["vmap_ineligible"]))
+        members = resp.get("members")
+        if members is None or len(members) != m:
+            raise VmapIneligible("member count drift across regions")
+        for i, part in enumerate(members):
+            if part is not None:
+                per_member[i].append(_coerce_partial(part))
+
+    results = []
+    sorted_ops = tuple(sorted(ops))
+    for i in range(m):
+        combined = combine_partials(per_member[i], len(agg.keys),
+                                    sorted_ops)
+        results.append(executor._finalize_combined_agg(
+            combined, table, agg, None, project, None, None, None,
+            spec_slot))
+    executor.last_path = "vmapped_fragments"
     return results
 
 

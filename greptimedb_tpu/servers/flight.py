@@ -128,6 +128,29 @@ def table_to_partial(t: pa.Table) -> dict:
     return {"keys": keys, "planes": planes}
 
 
+def vmapped_part_to_wire(part: dict) -> dict:
+    """JSON-safe form of a vmapped_agg fragment result ({"members":
+    [...]} or {"vmap_ineligible": reason}). float64 round-trips exactly
+    through Python json (shortest-repr), int64 stays int, NULL keys stay
+    null — the frontend re-materializes numpy arrays."""
+    if "members" not in part:
+        return {"vmap_ineligible": str(part.get("vmap_ineligible", ""))}
+    out = []
+    for p in part["members"]:
+        if p is None:
+            out.append(None)
+            continue
+        keys = []
+        for k in p["keys"]:
+            vals = np.asarray(k, dtype=object).tolist()
+            keys.append([None if (isinstance(x, float) and x != x) else x
+                         for x in vals])
+        planes = {op: np.asarray(v).tolist()
+                  for op, v in p["planes"].items()}
+        out.append({"keys": keys, "planes": planes})
+    return {"members": out}
+
+
 def table_to_scan(t: pa.Table) -> ScanData:
     meta = t.schema.metadata or {}
     schema = Schema.from_dict(json.loads(meta[b"schema"].decode()))
@@ -390,6 +413,14 @@ class FlightServer(fl.FlightServerBase):
             if part is None:
                 table = pa.Table.from_arrays(
                     [], schema=pa.schema([], metadata={b"empty": b"1"}))
+            elif "members" in part or "vmap_ineligible" in part:
+                # vmapped_agg terminal: per-member partials (or the
+                # typed ineligibility marker) ride schema metadata
+                table = pa.Table.from_arrays([], schema=pa.schema(
+                    [], metadata={
+                        b"kind": b"vmapped",
+                        b"payload": json.dumps(
+                            vmapped_part_to_wire(part)).encode()}))
             elif "planes" in part:
                 table = partial_to_table(part)
             else:
@@ -525,6 +556,23 @@ class FlightServer(fl.FlightServerBase):
             else:
                 raise fl.FlightServerError(f"unknown region op {op!r}")
             return [b'{"ok": true}']
+        if action.type == "rollup_probe":
+            # cluster-mode rollup substitution, eligibility half: which
+            # rules fully cover [lo, hi) on this region (the frontend
+            # intersects per-region answers and re-plans over the
+            # companion plane regions — maintenance/rollup.py)
+            req = json.loads(action.body.to_pybytes().decode())
+            user = self._resolve_user(context)
+            if user is not None and not user.can("read"):
+                raise fl.FlightUnauthorizedError(
+                    f"user {user.username!r} lacks read permission")
+            from greptimedb_tpu.maintenance.rollup import (
+                probe_region_rollups,
+            )
+
+            out = probe_region_rollups(self.engine, req["region_id"],
+                                       int(req["lo"]), int(req["hi"]))
+            return [json.dumps(out).encode()]
         if action.type == "sql":
             req = json.loads(action.body.to_pybytes().decode())
             ctx = QueryContext(db=req.get("db", "public"), channel=Channel.GRPC,
@@ -789,6 +837,8 @@ class RemoteRegionEngine:
         md = t.schema.metadata or {}
         if md.get(b"empty") == b"1":
             return None
+        if md.get(b"kind") == b"vmapped":
+            return json.loads(md[b"payload"].decode())
         if md.get(b"kind") == b"rows":
             t = t.combine_chunks()
             cols = {}
@@ -797,6 +847,16 @@ class RemoteRegionEngine:
                 cols[name] = col.to_numpy(zero_copy_only=False)
             return {"cols": cols}
         return table_to_partial(t)
+
+    def rollup_probe(self, region_id: int, lo: int, hi: int) -> list:
+        """Rollup-coverage probe on the region's owner (the cluster
+        substitution eligibility RPC; see the server's rollup_probe
+        action)."""
+        body = json.dumps({"region_id": region_id, "lo": int(lo),
+                           "hi": int(hi)}).encode()
+        res = self._rpc("flight.do_get", lambda: list(
+            self.client.do_action(fl.Action("rollup_probe", body))))
+        return json.loads(res[0].body.to_pybytes().decode())
 
     def scan_stream(self, region_id: int, ts_range=None, projection=None,
                     tag_predicates=None):

@@ -430,6 +430,24 @@ ROLLUP_SUBSTITUTIONS = REGISTRY.counter(
     "greptimedb_tpu_maintenance_rollup_substitutions_total",
     "Aggregate queries served from rollup plane SSTs instead of raw "
     "data, by table and resolution")
+
+# mesh-sharded hot path (parallel/sharded_dispatch.py) + distributed
+# plan-fragment pushdown (query/dist_agg.py): the scale-out surface —
+# how often queries ride the device mesh / ship partial planes instead
+# of raw rows, and how balanced the shard assignment is
+MESH_DISPATCHES = REGISTRY.counter(
+    "greptimedb_tpu_mesh_dispatch_total",
+    "Aggregate scans dispatched over the device mesh, by kernel path "
+    "(sharded/sharded_prepared) and shard count")
+MESH_SHARD_SKEW = REGISTRY.gauge(
+    "greptimedb_tpu_mesh_shard_skew_ratio",
+    "Row-balance of the latest mesh shard plan: max per-shard rows over "
+    "the mean (1.0 = perfectly balanced; padding wastes cycles above it)")
+FRAGMENT_PUSHDOWNS = REGISTRY.counter(
+    "greptimedb_tpu_fragment_pushdown_total",
+    "Distributed plan fragments shipped to region owners, by mode "
+    "(agg/topk/rows/rows_agg/window/lastpoint/rollup/vmapped — partial "
+    "planes or pruned candidates return, never raw region scans)")
 EXPIRED_SSTS = REGISTRY.counter(
     "greptimedb_tpu_maintenance_expired_ssts_total",
     "SSTs dropped whole by retention (TTL) expiry")
