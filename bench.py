@@ -22,6 +22,9 @@ run in the same process and land in detail.configs:
  11. qps_mixed_tenants     — 3-tenant mixed workload (dashboard /
                              point lastpoint / high-card groupby) with
                              per-tenant p99/p999 + plan-cache hit rate
+ 12. incremental_agg       — partial-aggregate cache: cold fold vs warm
+                             repeat vs post-flush one-new-file fold,
+                             bit-for-bit digests + delta-row proof
 
 Pipeline measured end-to-end through the SQL engine: SQL parse -> plan ->
 region scan (SST/memtable) -> device blocks -> fused filter+group+segment
@@ -1345,6 +1348,8 @@ def _serving_snapshot():
     from greptimedb_tpu.utils.metrics import (
         ENCODE_POOL_EVENTS,
         ENCODE_SECONDS,
+        PARTIAL_AGG_CACHE_EVENTS,
+        PARTIAL_AGG_DELTA_ROWS,
         QUERY_BATCH_EVENTS,
         QUERY_BATCH_SIZE,
         QUERY_DURATION,
@@ -1352,6 +1357,11 @@ def _serving_snapshot():
     )
 
     return {
+        "pc_hit": PARTIAL_AGG_CACHE_EVENTS.get(event="hit"),
+        "pc_miss": PARTIAL_AGG_CACHE_EVENTS.get(event="miss"),
+        "pc_fallback": PARTIAL_AGG_CACHE_EVENTS.get(event="fallback"),
+        "pc_delta_rows": PARTIAL_AGG_DELTA_ROWS.get(kind="delta"),
+        "pc_cached_rows": PARTIAL_AGG_DELTA_ROWS.get(kind="cached"),
         "events": {e: QUERY_BATCH_EVENTS.get(event=e)
                    for e in _BATCH_EVENTS},
         "batch_sum": QUERY_BATCH_SIZE.sum(),
@@ -1388,7 +1398,22 @@ def _serving_report(before):
     exec_n = now["exec_n"] - before["exec_n"]
     enc_s = now["encode_s"] - before["encode_s"]
     enc_n = now["encode_n"] - before["encode_n"]
+    pc_hit = now["pc_hit"] - before["pc_hit"]
+    pc_miss = now["pc_miss"] - before["pc_miss"]
+    pc_delta = now["pc_delta_rows"] - before["pc_delta_rows"]
+    pc_cached = now["pc_cached_rows"] - before["pc_cached_rows"]
     return {
+        "partial_cache": {
+            "hits": int(pc_hit),
+            "misses": int(pc_miss),
+            "hit_rate": (round(pc_hit / (pc_hit + pc_miss), 4)
+                         if pc_hit + pc_miss else None),
+            "fallbacks": int(now["pc_fallback"] - before["pc_fallback"]),
+            "delta_rows_folded": int(pc_delta),
+            "cached_rows_served": int(pc_cached),
+            "delta_row_share": (round(pc_delta / (pc_delta + pc_cached), 4)
+                                if pc_delta + pc_cached else None),
+        },
         "batching": {
             **ev,
             "mean_batch_width": (round(widths / groups, 2)
@@ -1451,6 +1476,22 @@ def bench_qps(qe, results, clients=None, requests_total=None):
         # warm once (compile + cache) before the clock starts
         urllib.request.urlopen(
             urllib.request.Request(url, data=body), timeout=60)
+
+        # cold-vs-warm partial-cache p50 split: the same request against
+        # an emptied partial-aggregate cache (full per-part fold) vs
+        # warm repeats that serve cached [G, F] partials and fold only
+        # the memtable delta
+        from greptimedb_tpu.query import partial_cache as _pc
+
+        def _one_req():
+            t0 = time.perf_counter()
+            urllib.request.urlopen(
+                urllib.request.Request(url, data=body), timeout=60)
+            return (time.perf_counter() - t0) * 1000
+
+        _pc.global_cache().clear()
+        cold_cache_ms = _one_req()
+        warm_cache_ms = float(np.median([_one_req() for _ in range(9)]))
         cache0 = (PLAN_CACHE_EVENTS.get(event="hit"),
                   PLAN_CACHE_EVENTS.get(event="miss"))
         batch0 = (QUERY_BATCH_EVENTS.get(event="coalesced"),
@@ -1537,6 +1578,10 @@ def bench_qps(qe, results, clients=None, requests_total=None):
         "plan_cache_hit_rate": (None if hit_rate is None
                                 else round(hit_rate, 4)),
         "batched_queries": int(batched),
+        # single-request split: cold = partial cache emptied (every
+        # part re-folds), warm = cached partials + memtable delta only
+        "cold_cache_ms": round(cold_cache_ms, 2),
+        "warm_cache_p50_ms": round(warm_cache_ms, 2),
         "baseline_qps": 1165.73,
         "vs_baseline": round(qps / 1165.73, 3),
         "note": ("clients run in-process; baseline is the reference on "
@@ -1786,6 +1831,128 @@ def mesh_scale_child(n_shard: int) -> int:
         return 0
     finally:
         shutil.rmtree(data_dir, ignore_errors=True)
+
+
+def bench_incremental_agg(engine, qe, results):
+    """Incremental-aggregation micro-phase (ISSUE 13): the single-
+    groupby shape over a multi-file table — cold fold (empty partial
+    cache: every part reduces) vs warm repeat (cached [G, F] partials,
+    only the memtable delta runs kernels) vs the post-flush fold that
+    must compute exactly ONE new file + the memtable tail. Digests are
+    bit-for-bit checked against the cache-disabled classic path."""
+    import hashlib
+
+    from greptimedb_tpu.datatypes import DictVector, RecordBatch
+    from greptimedb_tpu.query import partial_cache as pc
+
+    n_files, n_hosts, pts = 4, 200, 500
+    qe.execute_one(
+        "CREATE TABLE incragg (hostname STRING, ts TIMESTAMP(3) NOT NULL, "
+        "usage_user DOUBLE, usage_system DOUBLE, TIME INDEX (ts), "
+        "PRIMARY KEY (hostname)) WITH (append_mode = 'true')")
+    info = qe.catalog.table("public", "incragg")
+    rid = info.region_ids[0]
+    rng = np.random.default_rng(31)
+    names = np.asarray([f"host_{i}" for i in range(n_hosts)], dtype=object)
+
+    def put(f, rows, flush):
+        codes = np.tile(np.arange(n_hosts, dtype=np.int32), rows)
+        ts = np.repeat(
+            T0_MS + (f * pts + np.arange(rows, dtype=np.int64)) * 1000,
+            n_hosts)
+        cols = {"hostname": DictVector(codes, names), "ts": ts,
+                "usage_user": rng.uniform(0.0, 100.0, rows * n_hosts),
+                "usage_system": rng.uniform(0.0, 100.0, rows * n_hosts)}
+        engine.put(rid, RecordBatch(info.schema, cols))
+        if flush:
+            engine.flush(rid)
+
+    for f in range(n_files):
+        put(f, pts, flush=True)
+    put(n_files, 50, flush=False)  # memtable tail
+
+    sql = ("SELECT date_bin(INTERVAL '1 minute', ts) AS minute, "
+           "max(usage_user), avg(usage_system) FROM incragg "
+           f"WHERE hostname = 'host_1' AND ts >= {T0_MS} "
+           "GROUP BY minute ORDER BY minute")
+
+    def digest(res):
+        h = hashlib.sha256()
+        for c in res.columns:
+            h.update(np.ascontiguousarray(np.asarray(c, dtype=float)))
+        return h.hexdigest()[:16]
+
+    def timed(repeats=9):
+        qe.execute_one(sql)  # shape warm-up outside the clock
+        times, res = [], None
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            res = qe.execute_one(sql)
+            times.append((time.perf_counter() - t0) * 1000)
+        return float(np.median(times)), res
+
+    # classic oracle: partial cache off, bit-for-bit reference (the
+    # operator's own A/B env value is restored, never clobbered)
+    prev_pc = os.environ.get("GREPTIMEDB_TPU_PARTIAL_CACHE")
+
+    def restore_pc():
+        if prev_pc is None:
+            os.environ.pop("GREPTIMEDB_TPU_PARTIAL_CACHE", None)
+        else:
+            os.environ["GREPTIMEDB_TPU_PARTIAL_CACHE"] = prev_pc
+
+    os.environ["GREPTIMEDB_TPU_PARTIAL_CACHE"] = "off"
+    try:
+        classic_ms, classic_res = timed()
+    finally:
+        restore_pc()
+    classic_digest = digest(classic_res)
+
+    # cold: every part folds (and populates the cache)
+    pc.global_cache().clear()
+    t0 = time.perf_counter()
+    cold_res = qe.execute_one(sql)
+    cold_ms = (time.perf_counter() - t0) * 1000
+    cold_stats = qe.executor.last_partial_stats or {}
+    # warm: cached partials + memtable delta only
+    warm_ms, warm_res = timed()
+    warm_stats = qe.executor.last_partial_stats or {}
+    # post-flush: ONE new file + memtable must fold, nothing else
+    put(n_files + 1, 20, flush=True)
+    put(n_files + 2, 10, flush=False)
+    t0 = time.perf_counter()
+    incr_res = qe.execute_one(sql)
+    incr_ms = (time.perf_counter() - t0) * 1000
+    incr_stats = qe.executor.last_partial_stats or {}
+    os.environ["GREPTIMEDB_TPU_PARTIAL_CACHE"] = "off"
+    try:
+        incr_oracle = qe.execute_one(sql)
+    finally:
+        restore_pc()
+
+    digests_equal = (digest(cold_res) == classic_digest
+                     and digest(warm_res) == classic_digest
+                     and digest(incr_res) == digest(incr_oracle))
+    log(f"incremental-agg: classic {classic_ms:.1f} ms, cold "
+        f"{cold_ms:.1f} ms, warm {warm_ms:.1f} ms "
+        f"(delta {warm_stats.get('delta_rows')}/"
+        f"{warm_stats.get('total_rows')} rows), post-flush "
+        f"{incr_ms:.1f} ms (hits {incr_stats.get('part_hits')}, "
+        f"misses {incr_stats.get('part_misses')}), "
+        f"bit-for-bit={digests_equal}")
+    results["incremental_agg"] = {
+        "classic_p50_ms": round(classic_ms, 2),
+        "cold_fold_ms": round(cold_ms, 2),
+        "warm_repeat_p50_ms": round(warm_ms, 2),
+        "warm_vs_classic": (round(classic_ms / warm_ms, 2)
+                            if warm_ms > 0 else None),
+        "cold_stats": cold_stats,
+        "warm_stats": warm_stats,
+        "post_flush_ms": round(incr_ms, 2),
+        "post_flush_stats": incr_stats,
+        "bit_for_bit_identical": bool(digests_equal),
+        "path": qe.executor.last_path,
+    }
 
 
 def bench_mesh_scale(results):
@@ -2177,6 +2344,8 @@ def main():
         guarded("qps_single_groupby", lambda: bench_qps(qe, results))
         guarded("qps_mixed_tenants",
                 lambda: bench_qps_mixed(qe, results))
+        guarded("incremental_agg",
+                lambda: bench_incremental_agg(engine, qe, results))
         guarded("mesh_scale", lambda: bench_mesh_scale(results))
         guarded("cluster_pushdown",
                 lambda: bench_cluster_pushdown(results))

@@ -71,8 +71,17 @@ class ConcurrencyConfig:
     #: handoff costs more than serializing a dashboard-sized result)
     encode_min_rows: int = 256
     #: spawn-mode worker processes instead of threads (full GIL escape;
-    #: pays pickling, opt-in for very large result sets)
+    #: pays pickling) — legacy pin: True forces every offload to the
+    #: process pool (same as encode_process_mode="on")
     encode_process_pool: bool = False
+    #: process-pool routing: "auto" escapes to spawn workers only for
+    #: results at/above encode_process_min_rows (measured size picks the
+    #: executor), "on" pins process mode, "off" disables it (A/B knob,
+    #: GTPU_ENCODE_PROCESS_MODE)
+    encode_process_mode: str = "auto"
+    #: auto-mode threshold: results at/above this many rows serialize in
+    #: a worker process; dashboard-sized rows keep the thread pool
+    encode_process_min_rows: int = 100_000
 
 
 _config = ConcurrencyConfig()
@@ -117,6 +126,11 @@ def current_config() -> ConcurrencyConfig:
                                   int(cfg.encode_offload), int) != 0
     cfg.encode_workers = _env_num("GTPU_ENCODE_WORKERS",
                                   cfg.encode_workers, int)
+    mode = os.environ.get("GTPU_ENCODE_PROCESS_MODE", "").lower()
+    if mode in ("auto", "on", "off"):
+        cfg.encode_process_mode = mode
+    cfg.encode_process_min_rows = _env_num("GTPU_ENCODE_PROCESS_MIN_ROWS",
+                                           cfg.encode_process_min_rows, int)
     return cfg
 
 
@@ -144,7 +158,10 @@ class ConcurrencyPlane:
             queue_size=cfg.encode_queue,
             process=cfg.encode_process_pool,
             enabled=cfg.enabled and cfg.encode_offload,
-            min_rows=cfg.encode_min_rows)
+            min_rows=cfg.encode_min_rows,
+            process_mode=("on" if cfg.encode_process_pool
+                          else cfg.encode_process_mode),
+            process_min_rows=cfg.encode_process_min_rows)
         self._tls = threading.local()
 
     # ---- batching gate -----------------------------------------------------

@@ -14,9 +14,15 @@ threads still executing queries. The pool bounds that contention:
   C-loop casts and a single C `json.dumps`, no per-value Python
   sanitization, and batched results share one materialization through
   their group `encode_memo`;
-- `process=True` ([concurrency] encode_process_pool) moves the
-  serialization into spawn-mode worker processes for a true GIL
-  escape — worth it only for very large result sets, so it is opt-in.
+- process mode moves the serialization into spawn-mode worker processes
+  for a true GIL escape. It is selected PER RESULT by measured size
+  (`process_mode="auto"`, the default): results at or above
+  `process_min_rows` rows pay the pickle round trip to escape the GIL,
+  dashboard-sized results keep the thread pool (handoff to a process
+  costs more than their serialization). `process_mode="on"` pins every
+  offload to the process pool (the legacy [concurrency]
+  encode_process_pool=true behavior), `"off"` disables it — the A/B
+  knob (GTPU_ENCODE_PROCESS_MODE).
 
 Saturation degrades, never drops: when every worker is busy and the
 queue is full, the request thread encodes inline (the pre-pool
@@ -44,48 +50,79 @@ def _auto_workers() -> int:
 class EncodePool:
     def __init__(self, workers: int = 0, queue_size: int = 64,
                  process: bool = False, enabled: bool = True,
-                 min_rows: int = 256):
+                 min_rows: int = 256, process_mode: Optional[str] = None,
+                 process_min_rows: int = 100_000):
         self.workers = workers if workers > 0 else _auto_workers()
         self.queue_size = max(1, int(queue_size))
-        self.process = process
+        # process_mode supersedes the boolean `process` (kept for
+        # back-compat: True maps to "on")
+        if process_mode is None:
+            process_mode = "on" if process else "auto"
+        process_mode = str(process_mode).strip().lower()
+        if process_mode not in ("auto", "on", "off"):
+            # fail loudly at plane construction: a typo'd TOML value
+            # silently pinning thread mode would make the A/B knob
+            # measure nothing
+            raise ValueError(
+                f"encode_process_mode must be auto|on|off, "
+                f"got {process_mode!r}")
+        self.process_mode = process_mode
+        self.process_min_rows = max(0, int(process_min_rows))
         self.enabled = enabled
         self.min_rows = max(0, int(min_rows))
         self._lock = threading.Lock()
-        self._executor = None
+        self._thread_executor = None
+        self._process_executor = None
         self._inflight = 0
 
     # ---- lifecycle ---------------------------------------------------------
 
-    def _pool(self):
+    def _want_process(self, cost_rows: Optional[int]) -> bool:
+        """Per-result routing: is THIS serialization big enough that a
+        spawn-mode worker (pickle round trip included) beats fighting
+        the request threads for the GIL?"""
+        if self.process_mode == "on":
+            return True
+        if self.process_mode != "auto":
+            return False
+        return cost_rows is not None and cost_rows >= self.process_min_rows
+
+    def _pool(self, process: bool):
         """Lazy executor construction: servers that never serve a query
-        (storage-only datanodes) must not spawn encode workers."""
+        (storage-only datanodes) must not spawn encode workers, and the
+        process pool only exists once a result actually routed to it."""
+        import weakref
+
         with self._lock:
-            if self._executor is None:
-                if self.process:
+            if process:
+                if self._process_executor is None:
                     import multiprocessing
 
                     # spawn, not fork: the serving process has live JAX
                     # runtime threads a fork would copy mid-lock
-                    self._executor = ProcessPoolExecutor(
+                    self._process_executor = ProcessPoolExecutor(
                         max_workers=self.workers,
                         mp_context=multiprocessing.get_context("spawn"))
-                else:
-                    self._executor = ThreadPoolExecutor(
-                        max_workers=self.workers,
-                        thread_name_prefix="gtpu-encode")
-                # a discarded plane (tests, embedded engines) must not
-                # leak idle workers until interpreter exit
-                import weakref
-
-                weakref.finalize(self, self._executor.shutdown,
+                    # a discarded plane (tests, embedded engines) must
+                    # not leak idle workers until interpreter exit
+                    weakref.finalize(self, self._process_executor.shutdown,
+                                     wait=False)
+                return self._process_executor
+            if self._thread_executor is None:
+                self._thread_executor = ThreadPoolExecutor(
+                    max_workers=self.workers,
+                    thread_name_prefix="gtpu-encode")
+                weakref.finalize(self, self._thread_executor.shutdown,
                                  wait=False)
-            return self._executor
+            return self._thread_executor
 
     def shutdown(self) -> None:
         with self._lock:
-            ex, self._executor = self._executor, None
-        if ex is not None:
-            ex.shutdown(wait=False)
+            pools = (self._thread_executor, self._process_executor)
+            self._thread_executor = self._process_executor = None
+        for ex in pools:
+            if ex is not None:
+                ex.shutdown(wait=False)
 
     # ---- entry -------------------------------------------------------------
 
@@ -95,14 +132,16 @@ class EncodePool:
         instead of competing for it. Falls back to inline encoding when
         the pool is disabled or saturated — output is byte-identical
         either way (same encoder function). `cost_rows` gates the
-        handoff: a dashboard-sized result encodes in microseconds, and
-        a thread handoff would cost more than it saves — those encode
-        inline without touching the pool."""
+        handoff twice: results under `min_rows` encode inline (handoff
+        costs more than dashboard-sized serialization), and results at
+        or above `process_min_rows` escape to the process pool in auto
+        mode (measured size picks the executor, not a static flag)."""
         if not self.enabled:
             return fn(*args)
         if cost_rows is not None and cost_rows < self.min_rows:
             ENCODE_POOL_EVENTS.inc(event="small_inline")
             return fn(*args)
+        process = self._want_process(cost_rows)
         with self._lock:
             if self._inflight >= self.queue_size:
                 saturated = True
@@ -115,7 +154,7 @@ class EncodePool:
             return fn(*args)
         try:
             try:
-                fut = self._pool().submit(fn, *args)
+                fut = self._pool(process).submit(fn, *args)
             except RuntimeError:
                 # executor torn down concurrently (submit after
                 # shutdown): the request still gets its bytes. Errors
@@ -124,8 +163,8 @@ class EncodePool:
                 ENCODE_POOL_EVENTS.inc(event="inline")
                 return fn(*args)
             ENCODE_POOL_EVENTS.inc(
-                event="offload_process" if self.process else "offload")
-            if self.process:
+                event="offload_process" if process else "offload")
+            if process:
                 # a worker PROCESS observes its metrics into its own
                 # registry (lost to the parent's /metrics) — time the
                 # round trip here so the encode split stays visible
@@ -143,5 +182,3 @@ class EncodePool:
             with self._lock:
                 self._inflight -= 1
                 ENCODE_POOL_QUEUE_DEPTH.set(float(self._inflight))
-
-

@@ -287,7 +287,16 @@ class FlowEngine:
             ts_name = src.schema.time_index.name
             cond = ast.BinaryOp(">=", ast.Column(ts_name), ast.Literal(lo))
             sel.where = cond if sel.where is None else ast.BinaryOp("and", sel.where, cond)
+        # the dirty-span re-aggregate rides the executor's shared
+        # delta-fold seam (ISSUE 13): where the shape is partial-cache
+        # eligible, immutable parts fold from cached [G, F] partials and
+        # only the span's delta (memtable + new files) runs kernels —
+        # this path no longer pays a private full re-fold per tick
         res = self.qe.execute_statement(sel, ctx)
+        pstats = getattr(self.qe.executor, "last_partial_stats", None)
+        FlowEngine.last_tick_stats = {
+            "flow": info.name, "path": "dirty_span",
+            "partial_cache": pstats}
         n = self._upsert_sink(info, res, ctx)
         # advance watermark to max source ts seen
         scan = None

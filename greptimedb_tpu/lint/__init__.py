@@ -20,6 +20,10 @@ them over the repo's own AST so the next PR cannot silently regress:
   blocking      no blocking syscall (sleep/fsync/socket/subprocess)
                 while holding a lock — the group-commit pipeline's
                 fsync-outside-the-region-lock contract, machine-checked
+  datarace      attributes guarded by a lock in one method must not be
+                accessed bare in another (caller-holds-lock docstring
+                contracts and the _locked naming convention count as
+                guarded)
   deadcode      unused imports / unused module-level names / unreachable
                 statements
   metrics       every registered metric is prefixed, documented, charted
@@ -213,6 +217,7 @@ def _import_checkers() -> None:
     # GTPU_LOCKDEP=1) doesn't pay for the static-analysis modules
     from greptimedb_tpu.lint import (  # noqa: F401
         blocking,
+        datarace,
         deadcode,
         fault_seam,
         jax_imports,

@@ -32,12 +32,56 @@ from greptimedb_tpu.query.expr import BindContext, bind_expr, eval_host
 from greptimedb_tpu.query.plan_ser import PlanFragment
 
 
+#: marker for a cached empty region contribution (None itself means
+#: "cache miss" to the cache API)
+_FRAG_NONE = {"__frag_none__": True}
+
+
 def execute_region_fragment(executor, region_id: int, frag: PlanFragment,
                             schema=None) -> Optional[dict]:
     """Interpret a PlanFragment over one region's rows. Returns
     {"keys": ..., "planes": ...} for a partial_agg terminal, or
     {"cols": {...}} of candidate/filtered rows otherwise; None when the
-    region contributes nothing."""
+    region contributes nothing.
+
+    Partial-agg terminals memoize their plane in the partial-aggregate
+    cache keyed by the region's (incarnation, data_version) + the
+    fragment JSON: a repeated dashboard fragment over an unchanged
+    region answers from the cached plane without touching SSTs (ISSUE
+    13 cluster tier). Any write bumps data_version; TRUNCATE resets the
+    incarnation; compaction/expiry both bump the version AND drop the
+    region's entries through the invalidation seam."""
+    from greptimedb_tpu.query import partial_cache as pc
+
+    if frag.stage("partial_agg") is not None \
+            and frag.stage("vmapped_agg") is None and pc.enabled():
+        reg = version = None
+        try:
+            reg = executor.engine.region(region_id)
+            version = getattr(reg, "data_version", None)
+        except Exception:  # noqa: BLE001 — remote probe: no local identity
+            pass
+        if version is not None:
+            cache = pc.global_cache()
+            key = ("frag", region_id, getattr(reg, "incarnation", 0),
+                   version, frag.to_json())
+            hit = cache.get(key)
+            if hit is not None:
+                return None if hit is _FRAG_NONE \
+                    or hit.get("__frag_none__") else hit
+            epoch = cache.epoch(region_id)
+            out = _execute_region_fragment_uncached(
+                executor, region_id, frag, schema)
+            cache.put(key, _FRAG_NONE if out is None else out,
+                      epoch=epoch)
+            return out
+    return _execute_region_fragment_uncached(executor, region_id, frag,
+                                             schema)
+
+
+def _execute_region_fragment_uncached(executor, region_id: int,
+                                      frag: PlanFragment,
+                                      schema=None) -> Optional[dict]:
     filt = frag.stage("filter")
     where = filt["expr"] if filt else None
     agg = frag.stage("partial_agg")

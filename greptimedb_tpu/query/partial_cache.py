@@ -1,0 +1,323 @@
+"""Partial-aggregate cache: per-part [G, F] planes, delta-only folding.
+
+The LSM design makes an immutable SST part's contribution to a given
+aggregate shape a FIXED plane: the part's rows never change until
+compaction/expiry/DROP rewrites the file, so re-reducing the part on
+every query is pure waste (PAPER.md §1 — mito2's immutable parquet
+parts + append-only memtable). PR 5/7/12 already key the host part
+cache, the HBM hot set, and the mesh shard buffers by file identity;
+this module adds the top layer: memoize the *aggregated partials*
+themselves, so query execution becomes
+
+    gather cached part partials
+      -> compute partials only for uncached parts + the memtable delta
+      -> combine by group-key VALUE (query/dist_agg.combine_partials)
+      -> the shared Final step (_finalize_combined_agg)
+
+Entries are value-space partials — ``{"keys": [per-key decoded value
+arrays], "planes": {op: [G_part, F]}}`` — exactly the shape one region
+ships for a distributed PlanFragment. Caching VALUES (not dictionary
+codes) makes entries immune to group-key dictionary drift: tag
+dictionaries grow append-only between flushes, and the combine step
+re-factorizes by value, so a partial cached under an older (smaller)
+dictionary merges correctly with partials computed under a newer one.
+
+Key discipline mirrors the device hot set (query/device_cache.py):
+
+- **part entries** ``("part", region_id, file_id, part_ts_range,
+  pred_key, shape_fp)`` anchor to the immutable file (+ the window/
+  predicate that selected its rows) and a canonical plan-shape
+  fingerprint. They survive data-version bumps — a flush leaves every
+  cached part partial valid and adds only the new file's rows to the
+  delta — and die through the exact region seams that kill host parts
+  and HBM blocks: compaction swap, retention expiry, DROP/TRUNCATE
+  (storage/region.py notifies this module alongside device_cache).
+- **fragment entries** ``("frag", region_id, incarnation,
+  data_version, frag_fp)`` memoize a whole region's partial plane for a
+  repeated distributed PlanFragment (cluster mode): the datanode
+  answers from the cached plane without touching SSTs; any write bumps
+  data_version and the next fragment recomputes.
+
+DELETE rides the same tombstone-reachability argument as scan_last: a
+tombstone anywhere in the scan voids the per-part decomposition (the
+delete may mask rows in a DIFFERENT part), so the executor falls back
+to the classic whole-scan fold — typed degradation, never an error.
+
+This module deliberately imports numpy only (no jax): the datanode's
+fragment seam uses it inside storage-only processes.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import weakref
+from collections import OrderedDict
+from typing import Optional
+
+import numpy as np
+
+from greptimedb_tpu.utils.metrics import (
+    PARTIAL_AGG_CACHE_BYTES,
+    PARTIAL_AGG_CACHE_EVENTS,
+)
+
+
+class PartialCacheIneligible(Exception):
+    """This scan/shape cannot ride the incremental per-part fold; the
+    executor falls back to the classic whole-scan paths (typed
+    degradation, mirroring VmapIneligible / MeshIneligible)."""
+
+
+def enabled() -> bool:
+    """[query] partial_cache / GREPTIMEDB_TPU_PARTIAL_CACHE; on by
+    default."""
+    return os.environ.get("GREPTIMEDB_TPU_PARTIAL_CACHE", "1").lower() \
+        not in ("0", "false", "off")
+
+
+def budget_bytes() -> int:
+    """[query] partial_cache_bytes / GREPTIMEDB_TPU_PARTIAL_CACHE_BYTES
+    (<= 0 = auto, matching the option doc); partials are [G, F] planes
+    (KBs each), so a modest default covers thousands of (part, shape)
+    combinations."""
+    env = os.environ.get("GREPTIMEDB_TPU_PARTIAL_CACHE_BYTES")
+    try:
+        v = int(env) if env else 0
+    except ValueError:
+        v = 0
+    return v if v > 0 else (256 << 20)
+
+
+def groups_max() -> int:
+    """Largest dense group count the incremental path materializes per
+    part ([G, F] readback per part; beyond this the classic single-
+    readback fold wins)."""
+    return int(os.environ.get("GREPTIMEDB_TPU_PARTIAL_CACHE_GROUPS_MAX",
+                              str(1 << 16)))
+
+
+#: live caches — storage-layer invalidation seams reach every instance
+#: through the module functions below (region.py looks this module up in
+#: sys.modules, so a storage-only process never pays the import)
+_CACHES: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def invalidate_files(region_id: int, file_ids) -> None:
+    """Region seam fan-out: compaction swap / retention expiry /
+    DROP-TRUNCATE killed these SSTs — their partial planes must die with
+    them (same contract as device_cache.invalidate_files)."""
+    for cache in list(_CACHES):
+        cache.invalidate_files(region_id, file_ids)
+
+
+def invalidate_region(region_id: int) -> None:
+    for cache in list(_CACHES):
+        cache.invalidate_region(region_id)
+
+
+#: accounted floor per entry: dict/tuple overhead + the key itself (a
+#: fragment key embeds the fragment JSON) — without it, empty-marker
+#: entries cost 0 accounted bytes and the byte budget would never bound
+#: their COUNT (version-churning fragment keys grow one entry per write)
+_ENTRY_OVERHEAD = 512
+
+
+def partial_nbytes(partial: dict) -> int:
+    """Approximate host bytes of one cached partial (planes + decoded
+    key columns; object arrays estimate ~48 B/element for the boxed
+    strings the pointer-width nbytes hides)."""
+    total = _ENTRY_OVERHEAD
+    for arr in partial.get("planes", {}).values():
+        total += int(np.asarray(arr).nbytes)
+    for arr in partial.get("keys", ()):
+        a = np.asarray(arr)
+        total += int(a.nbytes) + (48 * len(a) if a.dtype == object else 0)
+    return total
+
+
+class PartialAggCache:
+    """Bytes-budgeted LRU of host-side partial-aggregate planes.
+    Thread-safe; `put` runs under the same dead-file tombstone guard as
+    the device hot set — a partial computed for a file that died while
+    the fold was in flight never becomes resident."""
+
+    _DEAD_FILES_CAP = 4096
+
+    def __init__(self, budget: Optional[int] = None):
+        self.budget = budget if budget is not None else budget_bytes()
+        self._lru: "OrderedDict[tuple, tuple]" = OrderedDict()  # key -> (partial, nbytes)
+        self._bytes = 0
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self._dead_files: "OrderedDict[tuple, None]" = OrderedDict()
+        # per-region epoch for fragment entries: data_versions are
+        # reused after TRUNCATE recreates the region, so
+        # invalidate_region bumps the epoch and in-flight puts started
+        # under the old one are refused at store time
+        self._region_epoch: dict[int, int] = {}
+        _CACHES.add(self)
+
+    @staticmethod
+    def _region_of(key: tuple) -> Optional[int]:
+        return key[1] if len(key) >= 2 and key[0] in ("part", "frag") \
+            else None
+
+    def epoch(self, region_id: int) -> int:
+        with self._lock:
+            return self._region_epoch.get(region_id, 0)
+
+    def get(self, key: tuple) -> Optional[dict]:
+        with self._lock:
+            hit = self._lru.get(key)
+            if hit is None:
+                self.misses += 1
+                PARTIAL_AGG_CACHE_EVENTS.inc(event="miss")
+                return None
+            self._lru.move_to_end(key)
+            self.hits += 1
+            PARTIAL_AGG_CACHE_EVENTS.inc(event="hit")
+            return hit[0]
+
+    def put(self, key: tuple, partial: dict,
+            epoch: Optional[int] = None) -> None:
+        nbytes = partial_nbytes(partial)
+        if nbytes > self.budget:
+            return  # an entry that can never fit must not wipe the cache
+        evictions = 0
+        with self._lock:
+            region = self._region_of(key)
+            if key[0] == "part" and (region, key[2]) in self._dead_files:
+                # the file died while this partial was computing: the
+                # caller's scan pinned it (its result is fine), but the
+                # dead key must never become resident
+                return
+            if epoch is not None and region is not None \
+                    and self._region_epoch.get(region, 0) != epoch:
+                # region invalidated (TRUNCATE/DROP) mid-compute: a
+                # recreated region may reuse the colliding data_version
+                return
+            if key[0] == "frag":
+                # generation retirement: fragment keys embed (incarnation,
+                # data_version), and lookups always use the CURRENT pair —
+                # entries under any older pair are unreachable forever.
+                # Writes bump the version without any invalidation seam,
+                # so without this sweep a hot small region would strand
+                # one dead entry per (write, fragment) combination.
+                gen = (key[2], key[3])
+                evictions += self._drop_locked(
+                    lambda k: k[0] == "frag" and k[1] == region
+                    and (k[2], k[3]) != gen)
+            old = self._lru.pop(key, None)
+            if old is not None:
+                self._bytes -= old[1]
+            self._lru[key] = (partial, nbytes)
+            self._bytes += nbytes
+            while self._bytes > self.budget and self._lru:
+                _, (_, nb) = self._lru.popitem(last=False)
+                self._bytes -= nb
+                evictions += 1
+            PARTIAL_AGG_CACHE_BYTES.set(float(self._bytes))
+        if evictions:
+            PARTIAL_AGG_CACHE_EVENTS.inc(float(evictions), event="evict")
+
+    def _drop_locked(self, pred) -> int:
+        doomed = [k for k in self._lru if pred(k)]
+        for k in doomed:
+            _, nb = self._lru.pop(k)
+            self._bytes -= nb
+        return len(doomed)
+
+    def invalidate_files(self, region_id: int, file_ids) -> None:
+        """Drop part entries for dead SSTs, and every fragment plane of
+        the region (its data changed; the version key already prevents
+        stale serves — this is bookkeeping so ghosts don't hold the
+        budget)."""
+        gone = set(file_ids)
+        with self._lock:
+            for fid in gone:
+                self._dead_files[(region_id, fid)] = None
+                self._dead_files.move_to_end((region_id, fid))
+            while len(self._dead_files) > self._DEAD_FILES_CAP:
+                self._dead_files.popitem(last=False)
+            n = self._drop_locked(
+                lambda k: (k[0] == "part" and k[1] == region_id
+                           and k[2] in gone)
+                or (k[0] == "frag" and k[1] == region_id))
+            PARTIAL_AGG_CACHE_BYTES.set(float(self._bytes))
+        if n:
+            PARTIAL_AGG_CACHE_EVENTS.inc(float(n), event="invalidate")
+
+    def invalidate_region(self, region_id: int) -> None:
+        with self._lock:
+            n = self._drop_locked(
+                lambda k: self._region_of(k) == region_id)
+            self._region_epoch[region_id] = \
+                self._region_epoch.get(region_id, 0) + 1
+            PARTIAL_AGG_CACHE_BYTES.set(float(self._bytes))
+        if n:
+            PARTIAL_AGG_CACHE_EVENTS.inc(float(n), event="invalidate")
+
+    def part_keys(self, region_id: Optional[int] = None) -> list:
+        """Resident part-anchored keys (diagnostics + tests)."""
+        with self._lock:
+            return [k for k in self._lru if k[0] == "part"
+                    and (region_id is None or k[1] == region_id)]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._lru.clear()
+            self._bytes = 0
+            PARTIAL_AGG_CACHE_BYTES.set(0.0)
+
+    @property
+    def bytes(self) -> int:
+        with self._lock:
+            return self._bytes
+
+
+_GLOBAL: Optional[PartialAggCache] = None
+_GLOBAL_LOCK = threading.Lock()
+
+
+def global_cache() -> PartialAggCache:
+    """The process-wide cache: executors and the datanode fragment seam
+    share ONE byte budget (the issue's 'shared byte budget' — per-
+    executor budgets would multiply under the threaded servers)."""
+    global _GLOBAL
+    with _GLOBAL_LOCK:
+        if _GLOBAL is None:
+            _GLOBAL = PartialAggCache()
+        return _GLOBAL
+
+
+def canonical_key(k, kexpr) -> tuple:
+    """Canonical form of one group key for the shape fingerprint: tag
+    cardinality and bucket base/size are EXCLUDED on purpose — cached
+    partials hold decoded VALUES, which are invariant to dictionary
+    growth and to the scan extent the dense id spaces derive from.
+    Generic ("pre") keys canonicalize by the ORIGINAL expression, not
+    the per-scan factorized column name. Only what changes the per-part
+    VALUES may enter the fingerprint."""
+    if k.kind == "tag":
+        return ("tag", k.column)
+    if k.kind == "bucket":
+        return ("bucket", k.column, k.step)
+    return ("pre", repr(kexpr))
+
+
+def shape_fingerprint(bound_where, keys, key_exprs, arg_exprs, ops,
+                      acc_dtype) -> tuple:
+    """Canonical plan-shape fingerprint: everything that changes a
+    part's [G, F] partial VALUES. `bound_where` reprs with tag literals
+    already rewritten to dictionary codes — append-only dictionaries
+    keep those codes stable, and TRUNCATE (which resets them) kills the
+    region's entries wholesale."""
+    return (
+        tuple(canonical_key(k, e) for k, e in zip(keys, key_exprs)),
+        repr(bound_where),
+        tuple(repr(a) for a in arg_exprs),
+        tuple(ops),
+        str(acc_dtype),
+    )

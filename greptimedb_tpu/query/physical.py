@@ -951,6 +951,11 @@ def _combine_partials(acc: Optional[dict], p: dict) -> dict:
 #: XLA scatter path instead of re-failing per query
 _FUSED_DISABLED = {"flag": False}
 
+#: incremental-aggregation runtime-failure latch (same contract): an
+#: unexpected per-part fold failure degrades to the classic whole-scan
+#: kernels instead of re-failing every query
+_PARTIAL_DISABLED = {"flag": False}
+
 
 def _snap_version(scan) -> tuple:
     """Snapshot identity for snap-anchored hot-set keys: (incarnation,
@@ -1134,6 +1139,17 @@ class PhysicalExecutor:
     @last_tier.setter
     def last_tier(self, v):
         self._tls.last_tier = v
+
+    @property
+    def last_partial_stats(self):
+        """Incremental-aggregation stats of this thread's last query
+        (None when the classic paths served): part hit/miss counts,
+        delta rows actually folded vs total scan rows."""
+        return getattr(self._tls, "partial_stats", None)
+
+    @last_partial_stats.setter
+    def last_partial_stats(self, v):
+        self._tls.partial_stats = v
 
     def _prewarm_kernels(self) -> None:
         """Background compile of the dominant Pallas kernel shapes
@@ -1644,6 +1660,7 @@ class PhysicalExecutor:
                      limit, offset, scan_node) -> QueryResult:
         schema = table.schema
         ts_name = schema.time_index.name
+        self.last_partial_stats = None
         if scan is None:
             return self._empty_agg_result(table, agg, having, project, sort, limit, offset)
 
@@ -1694,6 +1711,20 @@ class PhysicalExecutor:
 
         reduced = self._boundary_firstlast(scan, table, agg, bound_where,
                                            keys, extra_cols)
+        # incremental aggregation (ISSUE 13): immutable parts' [G, F]
+        # partials come from the partial-aggregate cache; only uncached
+        # parts + the memtable delta run kernels. Runs after the
+        # boundary first/last reduction (whose candidate gather is
+        # already snapshot-memoized) — a reduced scan has no per-part
+        # identity and falls through to the classic kernels. Typed
+        # fallback (PartialCacheIneligible) lands back here too.
+        if not sparse and reduced is None:
+            res = self._try_incremental_agg(
+                scan, table, bound_where, keys, decoders, arg_exprs, ops,
+                num_groups, ts_name, ctx, extra_cols, agg, having, project,
+                sort, limit, offset, spec_slot)
+            if res is not None:
+                return res
         if reduced is not None:
             scan = reduced
         # tier re-decision on the POST-reduction row count: the
@@ -1722,6 +1753,362 @@ class PhysicalExecutor:
         return self._agg_tail(acc, sparse_gids, agg, keys, decoders,
                               spec_slot, host_info, having, project, sort,
                               limit, offset, table)
+
+    # ---- incremental aggregation (partial-aggregate cache) -----------------
+
+    def _try_incremental_agg(self, scan, table, bound_where, keys, decoders,
+                             arg_exprs, ops, num_groups, ts_name, ctx,
+                             extra_cols, agg, having, project, sort, limit,
+                             offset, spec_slot) -> Optional[QueryResult]:
+        """Serve this aggregate from per-part cached partials + a
+        delta-only fold (query/partial_cache.py module docstring), or
+        return None for the classic whole-scan paths. Any gate the
+        per-part decomposition cannot prove raises the typed
+        PartialCacheIneligible internally and counts one `fallback`."""
+        from greptimedb_tpu.query import partial_cache as pc
+        from greptimedb_tpu.query.dist_agg import combine_partials
+        from greptimedb_tpu.utils import tracing
+        from greptimedb_tpu.utils.metrics import PARTIAL_AGG_CACHE_EVENTS
+
+        if not pc.enabled() or _PARTIAL_DISABLED["flag"]:
+            return None
+        try:
+            t0 = time.perf_counter()
+            partials, stats, tier = self._incremental_partials(
+                scan, table, bound_where, keys, decoders, arg_exprs, ops,
+                num_groups, ts_name, ctx, extra_cols, agg)
+        except pc.PartialCacheIneligible:
+            PARTIAL_AGG_CACHE_EVENTS.inc(event="fallback")
+            return None
+        except PlanError:
+            # a planning error (e.g. a substituted rollup plan probing a
+            # column the companion scan lacks) is the GUARDED-FALLBACK
+            # signal upstream relies on — the classic path would raise
+            # the identical error here, so propagate it and never latch
+            raise
+        except Exception:  # noqa: BLE001 — degrade, don't fail the query
+            # an unexpected incremental failure (compile, OOM) must not
+            # take serving down: latch the path off and let the classic
+            # whole-scan kernels answer this and later queries — the
+            # same degradation contract as the fused-kernel latch
+            import traceback
+
+            traceback.print_exc()
+            print("incremental aggregation failed; serving this and "
+                  "later queries through the classic paths", flush=True)
+            _PARTIAL_DISABLED["flag"] = True
+            PARTIAL_AGG_CACHE_EVENTS.inc(event="fallback")
+            return None
+        with tracing.span("incremental_agg", parts=stats["parts"],
+                          part_hits=stats["part_hits"],
+                          delta_rows=stats["delta_rows"],
+                          total_rows=stats["total_rows"]):
+            combined = combine_partials(partials, len(agg.keys),
+                                        tuple(sorted(ops)))
+        # measured-routing feed: the fold only ran kernels over the
+        # DELTA rows — recording a cache-served query against the full
+        # scan size would teach the router that this tier folds 17M
+        # rows in a millisecond and misroute non-cacheable queries of
+        # the same size class. Pure-cache serves feed nothing.
+        if stats["delta_rows"]:
+            self._note_tier(tier, stats["delta_rows"],
+                            time.perf_counter() - t0)
+        self.last_path = "incremental"
+        self.last_partial_stats = stats
+        return self._finalize_combined_agg(combined, table, agg, having,
+                                           project, sort, limit, offset,
+                                           spec_slot)
+
+    def _incremental_partials(self, scan, table, bound_where, keys,
+                              decoders, arg_exprs, ops, num_groups, ts_name,
+                              ctx, extra_cols, agg):
+        """Gather cached part partials, compute the uncached parts and
+        the memtable delta with the SAME per-block kernel the classic
+        dense path runs, and return the part-ordered partial list (the
+        left-fold order combine_partials preserves). Raises
+        PartialCacheIneligible when the per-part decomposition is not
+        provably exact."""
+        from collections import OrderedDict as _OrderedDict
+
+        from greptimedb_tpu import config
+        from greptimedb_tpu.query import partial_cache as pc
+        from greptimedb_tpu.utils.metrics import PARTIAL_AGG_DELTA_ROWS
+
+        schema = table.schema
+        if scan.region_id < 0:
+            raise pc.PartialCacheIneligible("synthetic scan")
+        if any(_needs_host_agg(spec, schema) for spec in agg.aggs):
+            raise pc.PartialCacheIneligible("host-side aggregate")
+        if num_groups > pc.groups_max():
+            raise pc.PartialCacheIneligible("group count over cache cap")
+        # DELETE voids the decomposition exactly like scan_last: a
+        # tombstone may mask rows in a different part (memoized on the
+        # snapshot, shared with the boundary fast path)
+        has_delete = getattr(scan, "_has_delete", None)
+        if has_delete is None:
+            from greptimedb_tpu.storage.region import OP_PUT
+
+            has_delete = bool((scan.op_type != OP_PUT).any())
+            scan._has_delete = has_delete
+        if has_delete:
+            raise pc.PartialCacheIneligible("tombstones reachable")
+
+        plan = _block_plan(scan)
+        parts: "_OrderedDict[tuple, list]" = _OrderedDict()
+        mem_entries: list[_BlockEntry] = []
+        for e in plan:
+            if e.pkey is not None:
+                parts.setdefault(e.pkey, []).append(e)
+            else:
+                mem_entries.append(e)
+        if not parts:
+            raise pc.PartialCacheIneligible("no immutable parts")
+        for pk, es in parts.items():
+            if len(es) != 1:
+                # one-device-block-per-part gate (the vmapped parity
+                # precedent): the cached partial must BE the part's
+                # left-fold contribution for combine order to reproduce
+                # the classic block-sequential association bit-for-bit
+                raise pc.PartialCacheIneligible("multi-block part")
+        # LWW dedup is whole-scan: a newer duplicate in part Q can kill
+        # a row in part P, so a masked per-part partial is only
+        # file-pure when no duplicate can CROSS a part seam. Duplicates
+        # share an exact (series, ts) instant, so pairwise-disjoint
+        # part/memtable ts extents prove the dedup part-local — the
+        # sliced global mask then equals the part's own LWW mask
+        # bit-for-bit. Overlapping extents (late writes) fall back.
+        dedup_mask = None
+        if not table.append_mode and scan.needs_dedup:
+            if not self._parts_ts_disjoint(scan, ts_name):
+                raise pc.PartialCacheIneligible("cross-part dedup")
+            dedup_mask = self._maybe_dedup(scan, table, ctx)
+
+        acc_dtype = jnp.dtype(config.compute_dtype())
+        ops_t = tuple(sorted(ops))
+        fp = pc.shape_fingerprint(bound_where, keys,
+                                  [kexpr for _, kexpr in agg.keys],
+                                  arg_exprs, ops_t, acc_dtype)
+        cache = pc.global_cache()
+        # probe the cache BEFORE routing: only the delta (uncached parts
+        # + memtable) runs kernels, and routing a 50-row warm delta to a
+        # remote accelerator would pay the link RTT for microseconds of
+        # compute — the same argument as the boundary fast path's
+        # post-reduction tier re-decision
+        probed: list[tuple] = []
+        delta_est = sum(e.end - e.start for e in mem_entries)
+        first_uncached = None
+        for pk, (entry,) in parts.items():
+            key = ("part", scan.region_id, pk[0], pk[1], pk[2], fp)
+            p = cache.get(key)
+            probed.append((key, entry, p))
+            if p is None:
+                delta_est += entry.end - entry.start
+                if first_uncached is None:
+                    first_uncached = entry
+        tier = self.tier_for(agg, delta_est)
+        # first-touch hedge (the classic paths' 40s-cold-start fix must
+        # not regress here): until this shape's per-part kernel has
+        # compiled on the accelerator, folds serve host-side and a
+        # background thread warms the device — same contract as
+        # _hedge_device_warmup, keyed by the incremental fingerprint
+        hedge = delta_est > 0 and self._incremental_hedge_needed(tier, fp)
+        if hedge:
+            tier = "host"
+        self.last_tier = tier
+        place = self._incremental_placement(tier, scan)
+
+        tag_names = frozenset(ctx.tag_names)
+        float_fields = {c.name for c in schema.field_columns
+                        if c.dtype.is_float}
+        col_names = self._device_columns(scan, bound_where, keys, arg_exprs,
+                                         ts_name, extra_cols)
+        kw = dict(where=bound_where, keys=tuple(keys),
+                  agg_args=tuple(arg_exprs), ops=ops_t,
+                  num_segments=num_groups, ts_name=ts_name,
+                  tag_names=tag_names, schema=schema,
+                  need_ts=bool({"first", "last"} & set(ops)),
+                  acc_dtype=acc_dtype)
+        strides = _strides([k.size for k in keys])
+
+        def compute_partial(entry):
+            cols = {name: self._device_block(
+                        scan, name, entry, extra_cols,
+                        acc_dtype if name in float_fields else None)
+                    for name in col_names}
+            dmask = None if dedup_mask is None else _pad_device_mask(
+                dedup_mask, entry.start, entry.end, entry.block)
+            out = _agg_block_jit(cols, jnp.asarray(entry.end - entry.start),
+                                 dmask, **kw)
+            planes = {op: _readback(v) for op, v in out.items()}
+            rows = planes["rows"]
+            rows1 = rows[:, 0] if rows.ndim == 2 else rows
+            # keyed aggregates keep only observed groups (matching the
+            # per-region Partial step); a global aggregate keeps its one
+            # group even when empty so the combined result has a row
+            present = np.flatnonzero(rows1 > 0) if agg.keys \
+                else np.arange(1)
+            key_cols = []
+            for i, decode in enumerate(decoders):
+                idx = (present // strides[i]) % keys[i].size
+                col, _ = decode(idx)
+                key_cols.append(np.asarray(col))
+            return {"keys": key_cols,
+                    "planes": {op: pl[present]
+                               for op, pl in planes.items()}}
+
+        if hedge:
+            self._kick_incremental_warm(
+                fp,
+                first_uncached if first_uncached is not None
+                else mem_entries[0],
+                compute_partial)
+
+        partials: list[dict] = []
+        hits = misses = 0
+        delta_rows = cached_rows = 0
+        for key, entry, p in probed:
+            if p is None:
+                epoch = cache.epoch(scan.region_id)
+                with place(key[2]):
+                    p = compute_partial(entry)
+                cache.put(key, p, epoch=epoch)
+                misses += 1
+                delta_rows += entry.end - entry.start
+            else:
+                hits += 1
+                cached_rows += entry.end - entry.start
+            partials.append(p)
+        mem_rows = 0
+        for entry in mem_entries:
+            with place(None):
+                partials.append(compute_partial(entry))
+            mem_rows += entry.end - entry.start
+        delta_rows += mem_rows
+        if delta_rows:
+            PARTIAL_AGG_DELTA_ROWS.inc(float(delta_rows), kind="delta")
+        if cached_rows:
+            PARTIAL_AGG_DELTA_ROWS.inc(float(cached_rows), kind="cached")
+        stats = {"parts": len(parts), "part_hits": hits,
+                 "part_misses": misses, "delta_rows": delta_rows,
+                 "cached_rows": cached_rows, "memtable_rows": mem_rows,
+                 "total_rows": scan.num_rows}
+        return partials, stats, tier
+
+    def _incremental_hedge_needed(self, tier: str, fp: tuple) -> bool:
+        """Whether this incremental fold must serve host-side while the
+        accelerator compile of its per-part kernel warms in the
+        background (auto host-tier mode on a real accelerator only —
+        mode=off means the caller wants the device NOW and will wait,
+        and the mesh tier has its own placement)."""
+        from greptimedb_tpu import config
+
+        if tier != "device" or jax.default_backend() == "cpu" \
+                or self.mesh is not None \
+                or config.host_tier_mode() != "auto":
+            return False
+        with self._warm_lock:
+            return fp not in self._device_warm
+
+    def _kick_incremental_warm(self, fp: tuple, entry, compute_partial):
+        """Background device compile of the incremental per-part kernel
+        for this shape: runs ONE part's fold on the accelerator and
+        DISCARDS the result (the host-computed partials are already
+        cached — a device-computed twin could differ in the last ulp on
+        emulated f64, and warm/cold serves must stay bit-identical).
+        Once it lands, the shape joins `_device_warm` and later delta
+        folds run on the chip."""
+        with self._warm_lock:
+            if fp in self._device_warming or fp in self._device_warm \
+                    or fp in self._device_warm_failed:
+                return
+            self._device_warming.add(fp)
+
+        def warm():
+            try:
+                with _TierCtx("device"):
+                    compute_partial(entry)
+                with self._warm_lock:
+                    self._device_warm.add(fp)
+            except Exception:  # noqa: BLE001 — hedge must not raise
+                with self._warm_lock:
+                    self._device_warm_failed.add(fp)
+            finally:
+                with self._warm_lock:
+                    self._device_warming.discard(fp)
+
+        threading.Thread(target=warm, daemon=True,
+                         name="gtpu-incremental-warm").start()
+
+    def _parts_ts_disjoint(self, scan, ts_name: str) -> bool:
+        """Whether every SST part's ts extent (and the memtable tail's)
+        is pairwise disjoint — the proof that LWW dedup cannot cross a
+        part seam. One O(N) min/max pass, memoized on the snapshot."""
+        cached = getattr(scan, "_parts_ts_disjoint_cache", None)
+        if cached is not None:
+            return cached
+        offs = list(scan.sorted_part_offsets) or [0]
+        if offs[-1] < scan.num_rows:
+            offs.append(scan.num_rows)  # memtable tail interval
+        ts = scan.columns[ts_name]
+        spans = []
+        for i in range(len(offs) - 1):
+            s0, s1 = offs[i], offs[i + 1]
+            if s1 > s0:
+                seg = ts[s0:s1]
+                spans.append((int(seg.min()), int(seg.max())))
+        spans.sort()
+        ok = all(spans[i][1] < spans[i + 1][0]
+                 for i in range(len(spans) - 1))
+        scan._parts_ts_disjoint_cache = ok
+        return ok
+
+    def _incremental_placement(self, tier: str, scan):
+        """Compute-placement context per part for the incremental fold:
+        host tier pins the CPU backend; the mesh tier computes each
+        part's partial on the shard `plan_shards` assigns the part's
+        FIRST chunk to (the dispatch's deterministic greedy balance, so
+        uncached folds spread across the mesh the way the classic
+        dispatch's load does). The per-block uploads key under
+        tier="mesh" — a namespace deliberately distinct from both the
+        single-device tiers and the classic dispatch's per-segment
+        "mshard" entries (which chunk parts ACROSS shards and can't be
+        reused at part granularity); all classes share the one
+        DeviceCache byte budget, so duplicates are bounded by LRU, not
+        leaked. Cached partials are host numpy either way — the warm
+        path never touches a device."""
+        if tier == "mesh" and self.mesh is not None:
+            from greptimedb_tpu.parallel import sharded_dispatch as sd
+
+            if sd.eligible(self.mesh):
+                devs = sd.shard_devices(self.mesh)
+                plan = sd.plan_shards(scan, len(devs))
+                owner_of = {}
+                for s, segs in enumerate(plan.segs):
+                    for seg in segs:
+                        if seg.pkey is not None and seg.start == \
+                                seg.part_start:
+                            owner_of[seg.pkey[0]] = s
+                tok = _ACTIVE_TIER_VAR
+
+                class _OnShard:
+                    def __init__(self, fid):
+                        owner = owner_of.get(fid, 0) if fid is not None \
+                            else 0
+                        self._dd = jax.default_device(devs[owner])
+                        self._token = None
+
+                    def __enter__(self):
+                        self._token = tok.set("mesh")
+                        self._dd.__enter__()
+                        return self
+
+                    def __exit__(self, *exc):
+                        self._dd.__exit__(*exc)
+                        tok.reset(self._token)
+                        return False
+
+                return _OnShard
+        return lambda fid: _TierCtx(tier)
 
     def _agg_tail(self, acc, sparse_gids, agg, keys, decoders, spec_slot,
                   host_info, having, project, sort, limit, offset,

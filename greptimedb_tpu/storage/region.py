@@ -317,6 +317,8 @@ class Region:
             self._drain_purge(force=True)
 
     def _drain_purge(self, force: bool = False) -> None:
+        """Delete deferred SSTs no reader pins (caller holds
+        self._lock — drop/close/_unpin_files all enter under it)."""
         keep: list[tuple[str, float]] = []
         for fid, t in self._purge_queue:
             if self._file_refs.get(fid, 0) > 0 and not force:
@@ -345,7 +347,8 @@ class Region:
     @property
     def _host_cache_bytes(self) -> int:
         """Bytes the part cache AND the whole-scan snapshots hold —
-        the one number the shared budget bounds."""
+        the one number the shared budget bounds (caller holds
+        self._lock; both put paths read it under the region lock)."""
         return self._part_cache_bytes + self._scan_cache_bytes
 
     def _part_cache_put(self, key: tuple, ent: _PartEntry) -> None:
@@ -425,18 +428,23 @@ class Region:
         self._notify_device_cache("invalidate_files", gone)
 
     def _notify_device_cache(self, fn_name: str, *args) -> None:
-        """Best-effort invalidation fan-out to the HBM columnar hot set.
-        sys.modules lookup, not an import: a storage-only process that
-        never ran a query has no hot set to notify (and this runs under
-        the region lock — the hot set takes only its own lock)."""
+        """Best-effort invalidation fan-out to the query-layer caches
+        keyed by file identity: the HBM columnar hot set AND the
+        partial-aggregate cache (per-part [G, F] planes) die through
+        the exact same seams that kill host parts. sys.modules lookup,
+        not an import: a storage-only process that never ran a query
+        has no caches to notify (and this runs under the region lock —
+        the caches take only their own locks)."""
         import sys
 
-        mod = sys.modules.get("greptimedb_tpu.query.device_cache")
-        if mod is not None:
-            try:
-                getattr(mod, fn_name)(self.region_id, *args)
-            except Exception:  # noqa: BLE001 — upkeep must not fail the seam
-                pass
+        for modname in ("greptimedb_tpu.query.device_cache",
+                        "greptimedb_tpu.query.partial_cache"):
+            mod = sys.modules.get(modname)
+            if mod is not None:
+                try:
+                    getattr(mod, fn_name)(self.region_id, *args)
+                except Exception:  # noqa: BLE001 — upkeep must not fail the seam
+                    pass
 
     def _decode_file_part(self, meta: FileMeta, ts_range, names,
                           tag_predicates) -> Optional[tuple]:

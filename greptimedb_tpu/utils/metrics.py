@@ -452,6 +452,30 @@ EXPIRED_SSTS = REGISTRY.counter(
     "greptimedb_tpu_maintenance_expired_ssts_total",
     "SSTs dropped whole by retention (TTL) expiry")
 
+# incremental aggregation (query/partial_cache.py): per-part partial-
+# aggregate planes cached by immutable file identity — repeated
+# aggregate queries fold only the delta (memtable rows + files flushed
+# since) instead of re-reducing every SST part from scratch
+PARTIAL_AGG_CACHE_EVENTS = REGISTRY.counter(
+    "greptimedb_tpu_partial_agg_cache_events_total",
+    "Partial-aggregate cache events by kind (hit = an immutable part's "
+    "[G, F] partial served without touching its rows, miss = computed "
+    "and cached, evict = aged out of the byte budget, invalidate = "
+    "dropped by a region seam — compaction swap, retention expiry, "
+    "DROP/TRUNCATE, fallback = an aggregate shape the incremental fold "
+    "could not serve exactly: tombstones, cross-part dedup, sparse "
+    "cardinality, or multi-block parts)")
+PARTIAL_AGG_CACHE_BYTES = REGISTRY.gauge(
+    "greptimedb_tpu_partial_agg_cache_bytes",
+    "Host bytes held by the partial-aggregate cache (per-part [G, F] "
+    "planes + their decoded group-key columns, plus cached per-region "
+    "fragment planes in cluster mode)")
+PARTIAL_AGG_DELTA_ROWS = REGISTRY.counter(
+    "greptimedb_tpu_partial_agg_delta_rows_total",
+    "Rows actually folded by incremental aggregate executions, by kind "
+    "(delta = uncached part + memtable rows that ran through kernels, "
+    "cached = rows whose partial plane was served from the cache)")
+
 # ---- static analysis (tools/gtpu_lint.py, tier-1) --------------------------
 
 LINT_FINDINGS = REGISTRY.gauge(

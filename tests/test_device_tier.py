@@ -454,6 +454,12 @@ def fused_latch_reset():
 
 
 class TestFusedDegradation:
+    @pytest.fixture(autouse=True)
+    def _classic_paths(self, monkeypatch):
+        # these tests pin the fused-vs-scatter machinery; the partial-
+        # aggregate cache would intercept the shape before it reaches it
+        monkeypatch.setenv("GREPTIMEDB_TPU_PARTIAL_CACHE", "off")
+
     def test_kernel_failure_degrades_to_scatter(self, db, monkeypatch,
                                                 fused_latch_reset):
         """A fused-kernel failure mid-query must answer THAT query via

@@ -16,6 +16,15 @@ from greptimedb_tpu.storage import RegionEngine
 from greptimedb_tpu.storage.engine import EngineConfig
 
 
+@pytest.fixture(autouse=True)
+def _classic_mesh_paths(monkeypatch):
+    # this module pins the classic shard_map dispatch machinery (paths,
+    # H2D accounting, dispatch counters); the partial-aggregate cache
+    # would intercept eligible shapes before they reach it — its own
+    # mesh-tier behavior is covered in test_partial_cache.py
+    monkeypatch.setenv("GREPTIMEDB_TPU_PARTIAL_CACHE", "off")
+
+
 @pytest.fixture
 def mesh_db(tmp_path, monkeypatch):
     monkeypatch.setenv("GREPTIMEDB_TPU_MESH", "8x1")

@@ -124,6 +124,9 @@ def test_sql_pallas_vs_scatter_subprocess():
     for mode in ("on", "off"):
         env = dict(os.environ, GREPTIMEDB_TPU_PALLAS=mode,
                    JAX_PLATFORMS="cpu",
+                   # this test pins the fused-vs-scatter kernel routing;
+                   # the partial-aggregate cache would intercept first
+                   GREPTIMEDB_TPU_PARTIAL_CACHE="off",
                    PYTHONPATH=os.path.dirname(os.path.dirname(
                        os.path.abspath(__file__))))
         r = subprocess.run([sys.executable, "-c", _INTEGRATION],

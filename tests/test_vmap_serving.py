@@ -527,6 +527,59 @@ class TestEncodePath:
         assert pool._inflight == 0
         pool.shutdown()
 
+    def test_auto_mode_routes_by_measured_result_size(self):
+        """ISSUE-13 satellite: process_mode="auto" escapes to the spawn
+        pool only for results at/above the threshold — dashboard-sized
+        rows keep the thread pool, and the on/off knobs pin it."""
+        pool = EncodePool(workers=1, min_rows=0,
+                          process_min_rows=1000)
+        assert pool.process_mode == "auto"
+        assert not pool._want_process(10)       # dashboard-sized
+        assert not pool._want_process(999)
+        assert pool._want_process(1000)         # measured size escapes
+        assert not pool._want_process(None)     # unknown: stay thread
+        off = EncodePool(workers=1, process_mode="off",
+                         process_min_rows=0)
+        assert not off._want_process(1 << 30)
+        pinned = EncodePool(workers=1, process=True)
+        assert pinned.process_mode == "on"
+        assert pinned._want_process(1)
+
+    def test_auto_mode_process_escape_round_trip(self):
+        """A result over the auto threshold actually rides the spawn
+        pool and returns byte-identical output; a small one offloads to
+        the thread pool in the same EncodePool instance."""
+        from greptimedb_tpu.servers.encode import encode_sql_payload
+
+        r = QueryResult(["a"], [None], [np.arange(8, dtype=float)])
+        want = encode_sql_payload([r], 1.0)
+        pool = EncodePool(workers=1, min_rows=0, process_min_rows=4)
+        po0 = ENCODE_POOL_EVENTS.get(event="offload_process")
+        o0 = ENCODE_POOL_EVENTS.get(event="offload")
+        try:
+            got = pool.run(encode_sql_payload, [r], 1.0, cost_rows=8)
+            assert got == want
+            assert ENCODE_POOL_EVENTS.get(event="offload_process") \
+                == po0 + 1
+            small = pool.run(encode_sql_payload, [r], 1.0, cost_rows=2)
+            assert small == want
+            assert ENCODE_POOL_EVENTS.get(event="offload") == o0 + 1
+        finally:
+            pool.shutdown()
+
+    def test_encode_process_mode_env_knob(self, monkeypatch):
+        """GTPU_ENCODE_PROCESS_MODE / GTPU_ENCODE_PROCESS_MIN_ROWS A/B
+        the routing without an options object."""
+        from greptimedb_tpu import concurrency as conc
+
+        monkeypatch.setenv("GTPU_ENCODE_PROCESS_MODE", "off")
+        assert conc.current_config().encode_process_mode == "off"
+        monkeypatch.setenv("GTPU_ENCODE_PROCESS_MODE", "on")
+        monkeypatch.setenv("GTPU_ENCODE_PROCESS_MIN_ROWS", "7")
+        cfg = conc.current_config()
+        assert cfg.encode_process_mode == "on"
+        assert cfg.encode_process_min_rows == 7
+
     def test_process_pool_round_trip(self):
         """Spawn-mode process encoding returns the same bytes as
         inline (full GIL escape behind [concurrency]
