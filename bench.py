@@ -1339,6 +1339,7 @@ def bench_ingest_qps(engine, qe, results, writers=None, seconds=None):
 
 _BATCH_EVENTS = ("join", "coalesced", "stacked", "vmapped",
                  "serial_fallback")
+_STAGES = ("parse", "plan", "execute", "fast_bind", "fast_execute")
 
 
 def _serving_snapshot():
@@ -1346,17 +1347,28 @@ def _serving_snapshot():
     events, batch/vmap width histograms, and the execute-vs-encode
     wall-time split (engine seconds vs encode-pool seconds)."""
     from greptimedb_tpu.utils.metrics import (
+        ADMISSION_WAIT_SECONDS,
         ENCODE_POOL_EVENTS,
         ENCODE_SECONDS,
+        FAST_LANE_EVENTS,
         PARTIAL_AGG_CACHE_EVENTS,
         PARTIAL_AGG_DELTA_ROWS,
         QUERY_BATCH_EVENTS,
         QUERY_BATCH_SIZE,
         QUERY_DURATION,
+        STAGE_SECONDS,
         VMAP_BATCH_WIDTH,
     )
 
     return {
+        "fl": {e: FAST_LANE_EVENTS.get(event=e)
+               for e in ("hit", "miss", "coalesced", "invalidate")},
+        "fl_fallback": FAST_LANE_EVENTS.total(event="fallback"),
+        "stages": {s: STAGE_SECONDS.sum(stage=s)
+                   for s in _STAGES},
+        "stage_n": {s: STAGE_SECONDS.count(stage=s)
+                    for s in _STAGES},
+        "admission_wait_s": ADMISSION_WAIT_SECONDS.sum(),
         "pc_hit": PARTIAL_AGG_CACHE_EVENTS.get(event="hit"),
         "pc_miss": PARTIAL_AGG_CACHE_EVENTS.get(event="miss"),
         "pc_fallback": PARTIAL_AGG_CACHE_EVENTS.get(event="fallback"),
@@ -1402,7 +1414,42 @@ def _serving_report(before):
     pc_miss = now["pc_miss"] - before["pc_miss"]
     pc_delta = now["pc_delta_rows"] - before["pc_delta_rows"]
     pc_cached = now["pc_cached_rows"] - before["pc_cached_rows"]
+    fl = {e: now["fl"][e] - before["fl"][e] for e in now["fl"]}
+    fl_fb = now["fl_fallback"] - before["fl_fallback"]
+    fl_requests = fl["hit"] + fl["miss"] + fl_fb
+    stages = {s: now["stages"][s] - before["stages"][s] for s in _STAGES}
+    stage_n = {s: now["stage_n"][s] - before["stage_n"][s]
+               for s in _STAGES}
+    adm_wait = now["admission_wait_s"] - before["admission_wait_s"]
+    enc_stage = now["encode_s"] - before["encode_s"]
+    stage_total = sum(stages.values()) + adm_wait + enc_stage
     return {
+        # the per-stage wall breakdown (ISSUE 14): where serving time
+        # actually went — parse share ~= 0 proves warm fast-lane
+        # requests never touch the parser
+        "stage_breakdown": {
+            **{f"{s}_s": round(stages[s], 3) for s in _STAGES},
+            "admission_wait_s": round(adm_wait, 3),
+            "encode_s": round(enc_stage, 3),
+            "counts": {s: int(stage_n[s]) for s in _STAGES
+                       if stage_n[s]},
+            "shares": ({s: round(v / stage_total, 4)
+                        for s, v in {**stages,
+                                     "admission_wait": adm_wait,
+                                     "encode": enc_stage}.items()}
+                       if stage_total > 0 else None),
+            "parse_share": (round(stages["parse"] / stage_total, 4)
+                            if stage_total > 0 else None),
+        },
+        "fast_lane": {
+            "hits": int(fl["hit"]),
+            "misses": int(fl["miss"]),
+            "fallbacks": int(fl_fb),
+            "coalesced": int(fl["coalesced"]),
+            "invalidates": int(fl["invalidate"]),
+            "hit_rate": (round(fl["hit"] / fl_requests, 4)
+                         if fl_requests else None),
+        },
         "partial_cache": {
             "hits": int(pc_hit),
             "misses": int(pc_miss),
@@ -1565,6 +1612,8 @@ def bench_qps(qe, results, clients=None, requests_total=None):
         f"plan-cache hit rate "
         f"{-1.0 if hit_rate is None else hit_rate:.3f}, "
         f"{batched:.0f} batched, batching {serving['batching']}, "
+        f"fast lane {serving['fast_lane']}, "
+        f"stages {serving['stage_breakdown']['shares']}, "
         f"encode {serving['encode_split']})")
     results["qps_single_groupby"] = {
         "qps": round(qps, 1), "clients": clients, "requests": done,
@@ -1737,6 +1786,7 @@ def bench_qps_mixed(qe, results, clients_per_tenant=None,
         f"plan-cache hit rate "
         f"{-1.0 if hit_rate is None else hit_rate:.3f}, "
         f"{batched:.0f} batched, {rejected:.0f} rejected, "
+        f"fast lane {serving['fast_lane']}, "
         f"batching {serving['batching']}; " + ", ".join(
             f"{n} p99 {per_tenant[n].get('p99_ms', '?')} ms"
             for n, _ in tenants))

@@ -36,6 +36,7 @@ from greptimedb_tpu.concurrency.admission import (  # noqa: F401
 )
 from greptimedb_tpu.concurrency.batcher import QueryBatcher
 from greptimedb_tpu.concurrency.encode_pool import EncodePool
+from greptimedb_tpu.concurrency.fast_lane import FastLane
 from greptimedb_tpu.concurrency.plan_cache import PlanCache
 
 __all__ = ["ConcurrencyConfig", "ConcurrencyPlane", "Overloaded",
@@ -52,6 +53,10 @@ class ConcurrencyConfig:
     #: "tenantA=3,tenantB=1" weighted round-robin shares; unlisted = 1
     tenant_weights: str = ""
     plan_cache_entries: int = 512
+    #: text-keyed parse-free serving fast lane (concurrency/fast_lane.py)
+    fast_lane: bool = True
+    #: fast-lane template capacity; 0 disables
+    fast_lane_entries: int = 512
     batching: bool = True
     batch_window_ms: float = 2.0
     batch_max_queries: int = 64
@@ -116,6 +121,10 @@ def current_config() -> ConcurrencyConfig:
                                    cfg.max_concurrency, int)
     cfg.plan_cache_entries = _env_num("GTPU_PLAN_CACHE_ENTRIES",
                                       cfg.plan_cache_entries, int)
+    cfg.fast_lane = _env_num("GTPU_FAST_LANE", int(cfg.fast_lane),
+                             int) != 0
+    cfg.fast_lane_entries = _env_num("GTPU_FAST_LANE_ENTRIES",
+                                     cfg.fast_lane_entries, int)
     cfg.batching = _env_num("GTPU_QUERY_BATCHING", int(cfg.batching),
                             int) != 0
     cfg.batch_window_ms = _env_num("GTPU_BATCH_WINDOW_MS",
@@ -147,6 +156,12 @@ class ConcurrencyPlane:
             enabled=cfg.enabled)
         self.plan_cache = PlanCache(
             cfg.plan_cache_entries if cfg.enabled else 0)
+        # the fast lane needs the plan cache: its entries hold
+        # plan-cache entries, so disabling the cache disables the lane
+        self.fast_lane = FastLane(
+            cfg.fast_lane_entries,
+            enabled=(cfg.enabled and cfg.fast_lane
+                     and self.plan_cache.enabled))
         self.batcher = QueryBatcher(
             window_s=cfg.batch_window_ms / 1000.0,
             max_queries=cfg.batch_max_queries,
@@ -208,4 +223,7 @@ class ConcurrencyPlane:
     # ---- invalidation ------------------------------------------------------
 
     def invalidate_table(self, db=None, name=None) -> int:
+        # one seam for both layers: DDL hooks and the remote-catalog
+        # watch invalidate plan shapes AND text templates together
+        self.fast_lane.invalidate_table(db, name)
         return self.plan_cache.invalidate_table(db, name)

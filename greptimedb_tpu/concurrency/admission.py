@@ -15,6 +15,16 @@ Re-entrant by thread: nested statements (views, CTEs, EXPLAIN ANALYZE,
 flow ticks inside an admitted statement) pass through on the slot their
 top-level statement already holds — an inner acquire would deadlock
 against a full house.
+
+Uncontended fast path (ISSUE 14): execution slots are a token pool
+(`_tokens`), and when nobody is queued an acquire is one GIL-atomic
+`list.pop` + a sharded-counter inc — no lock, no condition round-trip.
+The slow path (waiters exist, or the pool is empty) keeps the classic
+lock + per-tenant WRR queues. The lost-wakeup race between a lock-free
+release and a concurrent enqueue is closed from both sides: release
+re-checks the queue AFTER returning its token (and rescues under the
+lock), and a waiter re-checks the pool AFTER enqueuing — under the
+GIL's total order one of the two always observes the other.
 """
 
 from __future__ import annotations
@@ -71,7 +81,10 @@ class AdmissionController:
         self.weights = dict(weights or {})
         self.enabled = enabled
         self._lock = threading.Lock()
-        self._active = 0
+        # free execution slots; invariant: active = max_concurrency -
+        # len(_tokens) (a direct waiter handoff transfers a slot without
+        # touching the pool, keeping both sides constant)
+        self._tokens: list = [None] * self.max_concurrency
         self._queued = 0
         self._queues: dict[str, deque] = {}
         self._ring: list[str] = []
@@ -83,7 +96,7 @@ class AdmissionController:
 
     @property
     def active(self) -> int:
-        return self._active
+        return self.max_concurrency - len(self._tokens)
 
     @property
     def queued(self) -> int:
@@ -115,16 +128,29 @@ class AdmissionController:
         return self.weights.get(tenant, 1)
 
     def _acquire(self, tenant: str) -> None:
+        # fast path: atomic slot grab when nobody is queued. The
+        # _queued read is racy, but a request that slips past a
+        # concurrently-enqueuing waiter grabbed a token that waiter
+        # could not have been handed yet — fairness drift of at most
+        # one request, never a lost slot.
+        if self._queued == 0:
+            try:
+                self._tokens.pop()
+            except IndexError:
+                pass
+            else:
+                ADMISSION_EVENTS.inc(event="admit")
+                return
         with self._lock:
-            if self._active < self.max_concurrency and self._queued == 0:
-                self._active += 1
+            if self._queued == 0 and self._tokens:
+                self._tokens.pop()
                 ADMISSION_EVENTS.inc(event="admit")
                 return
             if self._queued >= self.queue_size:
                 ADMISSION_EVENTS.inc(event="reject_full", tenant=tenant)
                 raise Overloaded(
                     f"admission queue full ({self._queued} waiting, "
-                    f"{self._active} executing)")
+                    f"{self.active} executing)")
             w = _Waiter(tenant)
             q = self._queues.get(tenant)
             if q is None:
@@ -136,6 +162,12 @@ class AdmissionController:
             self._queued += 1
             ADMISSION_QUEUE_DEPTH.set(float(self._queued))
             ADMISSION_EVENTS.inc(event="queue", tenant=tenant)
+        # close the lock-free release race: a token appended between our
+        # fast-path check and the enqueue above would strand this waiter
+        # until timeout — re-check the pool now that we are visible in
+        # _queued (one of the two sides always sees the other)
+        if self._tokens:
+            self._rescue()
         t0 = time.perf_counter()
         granted = w.event.wait(self.queue_timeout_s)
         ADMISSION_WAIT_SECONDS.observe(time.perf_counter() - t0)
@@ -158,17 +190,41 @@ class AdmissionController:
             "admission")
 
     def _release(self) -> None:
+        if self._queued:
+            with self._lock:
+                w = self._next_waiter()
+                if w is not None:
+                    # hand the slot over directly: the token pool is
+                    # untouched, so `active` stays constant
+                    w.granted = True
+                    self._queued -= 1
+                    ADMISSION_QUEUE_DEPTH.set(float(self._queued))
+                    ADMISSION_EVENTS.inc(event="admit")
+                    w.event.set()
+                    return
+        # nobody visibly queued: return the token lock-free, then
+        # re-check — a waiter that enqueued between the read above and
+        # the append is rescued under the lock instead of timing out
+        self._tokens.append(None)
+        if self._queued:
+            self._rescue()
+
+    def _rescue(self) -> None:
+        """Match free tokens to queued waiters under the lock. Both
+        lock-free halves (release's token append, a fresh waiter's
+        enqueue) call this after publishing their side, which closes
+        the lost-wakeup window in every interleaving."""
         with self._lock:
-            w = self._next_waiter()
-            if w is None:
-                self._active -= 1
-                return
-            # hand the slot over directly: _active stays constant
-            w.granted = True
-            self._queued -= 1
-            ADMISSION_QUEUE_DEPTH.set(float(self._queued))
-            ADMISSION_EVENTS.inc(event="admit")
-            w.event.set()
+            while self._queued and self._tokens:
+                w = self._next_waiter()
+                if w is None:
+                    break
+                self._tokens.pop()
+                w.granted = True
+                self._queued -= 1
+                ADMISSION_QUEUE_DEPTH.set(float(self._queued))
+                ADMISSION_EVENTS.inc(event="admit")
+                w.event.set()
 
     def _next_waiter(self):
         """Weighted round-robin pop (caller holds the lock): serve up to

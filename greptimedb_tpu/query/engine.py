@@ -28,7 +28,6 @@ from greptimedb_tpu.query.planner import plan_select
 from greptimedb_tpu.query.result import QueryResult
 from greptimedb_tpu.sql import ast, parse_sql
 from greptimedb_tpu.storage.engine import RegionEngine
-from greptimedb_tpu.utils.time import coerce_ts_literal
 
 
 # session-owned context; re-exported here for the many call sites that
@@ -83,15 +82,37 @@ class QueryEngine:
             # every protocol builds its own ctx; the engine-level default
             # (default_timezone option) applies unless the client set one
             ctx.timezone = self.default_timezone
+        # parse-free fast lane: a known statement template executes its
+        # cached bound plan with zero parse/AST/planning; everything
+        # else (and every first sighting) takes _execute_sql_slow below
+        fl = self.concurrency.fast_lane
+        if fl.enabled:
+            return fl.execute(self, sql, ctx)
+        return self._execute_sql_slow(sql, ctx)
+
+    def _execute_sql_slow(self, sql: str, ctx: QueryContext,
+                          _intercepted: bool = False) -> list[QueryResult]:
+        """The full statement path: intercept, parse, dispatch. The
+        fast lane routes through here on any miss or fallback — this IS
+        the authoritative semantics the lane must match byte-for-byte.
+        `_intercepted=True` means the fast lane already ran the plugin
+        interceptor chain on this exact text (it must run ONCE per
+        statement — auditing/rate-limit interceptors count calls)."""
+        import time as _time
+
+        if ctx.timezone is None:
+            ctx.timezone = self.default_timezone
         # plugin interceptors may rewrite or veto the statement before
         # parsing (reference SqlQueryInterceptor, frontend/src/instance.rs)
-        sql = self.plugins.intercept_sql(sql, ctx)
+        if not _intercepted:
+            sql = self.plugins.intercept_sql(sql, ctx)
         from greptimedb_tpu.plugins import reset_active, set_active
 
         # expression evaluation resolves plugin scalar functions against
         # THIS engine's container for the duration of the statement
         token = set_active(self.plugins)
         from greptimedb_tpu.utils import slow_query
+        from greptimedb_tpu.utils.metrics import STAGE_SECONDS
 
         try:
             # slow-query watch: crosses the threshold -> structured
@@ -103,7 +124,10 @@ class QueryEngine:
                 # assign it — clear it so a non-aggregate slow statement
                 # doesn't inherit the previous query's path
                 self.executor.last_path = None
+                t_parse = _time.perf_counter()
                 stmts = self._parse_cached(sql)
+                STAGE_SECONDS.observe(_time.perf_counter() - t_parse,
+                                      stage="parse")
                 # bounded admission + per-tenant fair scheduling: wait
                 # time counts into the slow-query watch (queueing IS
                 # part of the latency the operator debugs); nested
@@ -789,6 +813,11 @@ class QueryEngine:
         # a cached validated plan instead of re-planning; the entry also
         # memoizes a negative rollup-substitution probe (version-stamped
         # — any rollup state change re-probes)
+        import time as _time
+
+        from greptimedb_tpu.utils.metrics import STAGE_SECONDS
+
+        t_plan = _time.perf_counter()
         plan, entry, binding = self.concurrency.plan_cache.lookup(sel, info)
         # non-aggregate statements never probe, so their memo is
         # trivially safe; a probed shape may memoize the negative
@@ -810,9 +839,16 @@ class QueryEngine:
                 # pre-probe stamp: a roll finishing mid-probe must not
                 # lend its fresher version to this negative outcome
                 sub_stamp = substitution_stamp()
+                # the probe itself is planning work, but a POSITIVE
+                # substitution runs the whole substituted query inside
+                # try_substitute — attribute that to execute, not plan
+                t_sub = _time.perf_counter()
                 res = try_substitute(self, sel, info, ctx,
                                      shape_note=sub_note)
                 if res is not None:
+                    STAGE_SECONDS.observe(t_sub - t_plan, stage="plan")
+                    STAGE_SECONDS.observe(_time.perf_counter() - t_sub,
+                                          stage="execute")
                     return res
                 if entry is not None and sub_note.get("memoizable"):
                     entry.mark_sub_ineligible(sub_stamp)
@@ -822,7 +858,17 @@ class QueryEngine:
                                                       plan)
             if entry is not None and sub_note.get("memoizable"):
                 entry.mark_sub_ineligible(sub_stamp)
-        return self.executor.execute(plan)
+        STAGE_SECONDS.observe(_time.perf_counter() - t_plan, stage="plan")
+        # stamp a fast-lane build ticket (if this thread armed one):
+        # the statement is about to execute exactly this plan-cache
+        # plan, which is what a text-template entry memoizes
+        self.concurrency.fast_lane.note_plan_execution(sel, info, entry)
+        t_exec = _time.perf_counter()
+        try:
+            return self.executor.execute(plan)
+        finally:
+            STAGE_SECONDS.observe(_time.perf_counter() - t_exec,
+                                  stage="execute")
 
     def _try_window_pushdown(self, sel: ast.Select, info, ctx):
         """Ship [filter, prune, window] PlanFragments when every window
@@ -1385,76 +1431,50 @@ class QueryEngine:
         unknown = set(col_names) - set(schema.names)
         if unknown:
             raise PlanError(f"unknown insert columns {sorted(unknown)}")
-        nrows = len(stmt.rows)
         ncols = len(col_names)
-        by_col: dict[str, list] = {}
-        # bulk-load fast path: plain literal tuples (the overwhelming
-        # VALUES shape) transpose column-wise without per-value dispatch;
-        # the parser's INSERT fast path pre-certifies all-literal rows of
-        # UNIFORM length — the arity against THIS table's column list
-        # must still hold here (the parser doesn't know the schema)
-        if (getattr(stmt, "all_literal_rows", False)
-                and stmt.rows and len(stmt.rows[0]) == ncols) or \
-                all(len(row) == ncols and all(type(e) is ast.Literal
-                                              for e in row)
-                    for row in stmt.rows):
-            for name, col in zip(col_names, zip(*stmt.rows)):
-                by_col[name] = [None if (v := e.value) != v else v
-                                for e in col]
+        cv = stmt.columnar_values
+        if cv is not None:
+            # parser literal fast lane: ready-made raw value columns —
+            # zero per-cell work here. The arity against THIS table's
+            # column list must still hold (the parser doesn't know the
+            # schema).
+            if len(cv) != ncols:
+                raise PlanError("INSERT row arity mismatch")
+            nrows = len(cv[0]) if cv else 0
+            by_col: dict[str, list] = dict(zip(col_names, cv))
         else:
-            by_col = {n: [] for n in col_names}
-            for row in stmt.rows:
-                if len(row) != ncols:
-                    raise PlanError("INSERT row arity mismatch")
-                for n, e in zip(col_names, row):
-                    v = eval_host(e, {}, schema, None) \
-                        if not isinstance(e, ast.Literal) else e.value
-                    v = None if _is_nan_scalar(v) else v
-                    by_col[n].append(v)
-        batch_cols: dict = {}
-        for c in schema.columns:
-            vals = by_col.get(c.name)
-            if vals is None:
-                vals = [c.default] * nrows
-            if c.semantic is SemanticType.TAG:
-                if not all(type(v) is str for v in vals):
-                    vals = [None if v is None else str(v) for v in vals]
-                batch_cols[c.name] = DictVector.encode(vals)
-            elif c.dtype.is_timestamp:
-                if all(type(v) is int for v in vals):
-                    # integer literals are already in the column's unit
-                    batch_cols[c.name] = np.asarray(vals, dtype=np.int64)
-                    continue
-                coerced = []
-                for v in vals:
-                    if v is None:
-                        raise PlanError(f"time index {c.name} cannot be NULL")
-                    coerced.append(
-                        coerce_ts_literal(v, c.dtype, ctx.timezone))
-                batch_cols[c.name] = np.asarray(coerced, dtype=np.int64)
-            elif c.dtype.is_string:
-                batch_cols[c.name] = DictVector.encode(
-                    [None if v is None else str(v) for v in vals]
-                )
-            elif c.dtype.is_float:
-                try:
-                    batch_cols[c.name] = np.asarray(
-                        vals, dtype=c.dtype.to_numpy())
-                except (TypeError, ValueError):  # Nones / mixed types
-                    batch_cols[c.name] = np.asarray(
-                        [np.nan if v is None else float(v) for v in vals],
-                        dtype=c.dtype.to_numpy(),
-                    )
-            elif c.dtype is DataType.BOOL:
-                batch_cols[c.name] = np.asarray(
-                    [False if v is None else bool(v) for v in vals]
-                )
+            nrows = len(stmt.rows)
+            # literal tuples (the overwhelming VALUES shape) transpose
+            # column-wise without per-value dispatch
+            if all(len(row) == ncols and all(type(e) is ast.Literal
+                                             for e in row)
+                   for row in stmt.rows):
+                by_col = {}
+                for name, col in zip(col_names, zip(*stmt.rows)):
+                    by_col[name] = [None if (v := e.value) != v else v
+                                    for e in col]
             else:
-                batch_cols[c.name] = np.asarray(
-                    [0 if v is None else int(v) for v in vals],
-                    dtype=c.dtype.to_numpy(),
-                )
-        batch = RecordBatch(schema, batch_cols)
+                by_col = {n: [] for n in col_names}
+                for row in stmt.rows:
+                    if len(row) != ncols:
+                        raise PlanError("INSERT row arity mismatch")
+                    for n, e in zip(col_names, row):
+                        v = eval_host(e, {}, schema, None) \
+                            if not isinstance(e, ast.Literal) else e.value
+                        v = None if _is_nan_scalar(v) else v
+                        by_col[n].append(v)
+        # decode through the ingest columnar slab seam — the same
+        # vectorized per-dtype conversions every protocol front door
+        # uses (ingest.py), one pass per column
+        from greptimedb_tpu import ingest as _ingest
+
+        try:
+            batch = _ingest.sql_values_batch(schema, by_col, nrows,
+                                             ctx.timezone)
+        except ValueError as e:
+            if "time index" in str(e):
+                raise PlanError(str(e)) from None
+            raise
         n = self._sharded_write(info, batch, delete=False)
         from greptimedb_tpu.utils.metrics import INGEST_ROWS
 

@@ -80,18 +80,47 @@ def records_json(r) -> dict:
             "total_rows": r.num_rows}
 
 
+#: memoized pre-serialized schema headers, keyed by the result shape —
+#: dashboards repeat a handful of shapes, and re-dumping the identical
+#: column_schemas fragment per response was pure per-request overhead.
+#: Plain dict under the GIL (benign race: equal values); bounded by a
+#: wholesale clear.
+_SCHEMA_CACHE: dict = {}
+
+
+def schema_header_json(names, dtypes) -> str:
+    key = (tuple(names),
+           tuple(dt.value if dt else None for dt in dtypes))
+    cached = _SCHEMA_CACHE.get(key)
+    if cached is None:
+        cached = json.dumps({"column_schemas": [
+            {"name": n, "data_type": (dt.value if dt else "string")}
+            for n, dt in zip(names, dtypes)]})
+        if len(_SCHEMA_CACHE) > 512:
+            _SCHEMA_CACHE.clear()
+        _SCHEMA_CACHE[key] = cached
+    return cached
+
+
 def encode_sql_payload(results, elapsed_ms: float) -> bytes:
     """The full /v1/sql response body — built and dumped in one place
-    so the pool can run it off the request thread."""
+    so the pool can run it off the request thread. Assembled from the
+    memoized schema-header fragment + one C `json.dumps` of the rows;
+    byte-identical to dumping the whole document (json.dumps emits
+    `", "`/`": "` separators — pinned by the tier-1 parity test)."""
     with ENCODE_SECONDS.time(protocol="http"):
         out = []
         for r in results:
             if not r.is_query:
-                out.append({"affectedrows": r.affected_rows})
+                out.append('{"affectedrows": %d}' % r.affected_rows)
             else:
-                out.append({"records": records_json(r)})
-        return json.dumps({"code": 0, "output": out,
-                           "execution_time_ms": elapsed_ms}).encode()
+                out.append(
+                    '{"records": {"schema": %s, "rows": %s, '
+                    '"total_rows": %d}}'
+                    % (schema_header_json(r.names, r.dtypes),
+                       json.dumps(json_rows(r)), r.num_rows))
+        return ('{"code": 0, "output": [%s], "execution_time_ms": %s}'
+                % (", ".join(out), json.dumps(elapsed_ms))).encode()
 
 
 # ---- MySQL wire fragments --------------------------------------------------
@@ -168,33 +197,55 @@ def encode_mysql_result(result, binary: bool = False) -> list[bytes]:
                              binary)
 
 
+#: memoized resultset header packets (column count + column definitions
+#: + EOF) keyed by the column-name tuple — every repeat of a dashboard
+#: shape re-encoded identical coldef packets. Benign-race dict, bounded
+#: by a wholesale clear.
+_HEADER_CACHE: dict = {}
+
+
+def mysql_header_packets(names) -> list[bytes]:
+    key = tuple(names)
+    cached = _HEADER_CACHE.get(key)
+    if cached is None:
+        cached = [lenc_int(len(names))] \
+            + [_coldef(n, MYSQL_TYPE_VAR_STRING) for n in names] \
+            + [_eof()]
+        if len(_HEADER_CACHE) > 512:
+            _HEADER_CACHE.clear()
+        _HEADER_CACHE[key] = cached
+    return list(cached)
+
+
 def encode_mysql_rows(names, rows, binary: bool = False) -> list[bytes]:
     """Resultset packet payloads for one query result (column count,
     column definitions, EOF, row packets, EOF) — the session loop only
-    stamps sequence numbers and writes."""
+    stamps sequence numbers and writes. Row payloads accumulate in a
+    reusable bytearray (amortized append) instead of quadratic bytes
+    concatenation; the emitted packets are byte-identical."""
     with ENCODE_SECONDS.time(protocol="mysql"):
-        packets = [lenc_int(len(names))]
-        for n in names:
-            packets.append(_coldef(n, MYSQL_TYPE_VAR_STRING))
-        packets.append(_eof())
+        packets = mysql_header_packets(names)
         for row in rows:
+            payload = bytearray()
             if binary:
                 # binary row: 0x00 header + null bitmap (offset 2) + values
                 nb = bytearray((len(row) + 7 + 2) // 8)
-                payload = b""
                 for i, v in enumerate(row):
                     if v is None or (isinstance(v, float) and np.isnan(v)):
                         nb[(i + 2) // 8] |= 1 << ((i + 2) % 8)
                     else:
-                        payload += lenc_str(_fmt(v).encode())
-                packets.append(b"\x00" + bytes(nb) + payload)
+                        s = _fmt(v).encode()
+                        payload += lenc_int(len(s))
+                        payload += s
+                packets.append(b"\x00" + bytes(nb) + bytes(payload))
             else:
-                payload = b""
                 for v in row:
                     if v is None or (isinstance(v, float) and np.isnan(v)):
                         payload += b"\xfb"  # NULL
                     else:
-                        payload += lenc_str(_fmt(v).encode())
-                packets.append(payload)
+                        s = _fmt(v).encode()
+                        payload += lenc_int(len(s))
+                        payload += s
+                packets.append(bytes(payload))
         packets.append(_eof())
         return packets

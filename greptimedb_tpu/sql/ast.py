@@ -281,6 +281,11 @@ class Insert(Statement):
     columns: list[str]
     rows: list[list[Expr]]
     select: Optional[Select] = None
+    #: columnar VALUES payload from the parser's literal fast path:
+    #: per-column lists of raw Python values (no per-cell Literal
+    #: boxing). When set, `rows` is empty and the engine hands the
+    #: columns to the ingest slab seam (ingest.sql_values_batch)
+    columnar_values: Optional[list] = None
 
 
 @dataclass

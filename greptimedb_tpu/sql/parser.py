@@ -1230,7 +1230,6 @@ def _fast_parse_insert(sql: str):
     n = len(sql)
     in_row = False
     expect_value = False
-    Literal = ast.Literal
     # one C-driven finditer sweep; contiguity check per token (finditer
     # would silently SKIP an unmatched char — a gap means a construct
     # the fast path doesn't know, so fall back)
@@ -1268,21 +1267,21 @@ def _fast_parse_insert(sql: str):
         elif not in_row or not expect_value:
             return None
         elif text == "str":
-            row.append(Literal(tm.group("str")[1:-1].replace("''", "'")))
+            row.append(tm.group("str")[1:-1].replace("''", "'"))
             expect_value = False
         elif text == "num":
             t = tm.group("num")
-            row.append(Literal(
-                float(t) if _NUM_IS_FLOAT.search(t) else int(t)))
+            row.append(
+                float(t) if _NUM_IS_FLOAT.search(t) else int(t))
             expect_value = False
         else:  # keyword literal
             kw = tm.group("kw").lower()
             if kw == "null":
-                row.append(Literal(None))
+                row.append(None)
             elif kw == "true":
-                row.append(Literal(True))
+                row.append(True)
             elif kw == "false":
-                row.append(Literal(False))
+                row.append(False)
             else:
                 return None  # function call / identifier: full parser
             expect_value = False
@@ -1291,14 +1290,12 @@ def _fast_parse_insert(sql: str):
     ncols = len(rows[0])
     if any(len(r) != ncols for r in rows):
         return None  # let the full parser raise its arity error
-    ins = ast.Insert(table, columns, rows)
-    try:
-        # every row is literal tuples BY CONSTRUCTION — let the engine
-        # skip its per-value re-verification on the bulk path
-        ins.all_literal_rows = True
-    except Exception:  # noqa: BLE001 — frozen ast: flag is optional
-        pass
-    return [ins]
+    # column-major raw values, no per-cell Literal boxing: one zip
+    # transpose hands the engine ready-made columns for the ingest
+    # slab seam (a 500x10 INSERT used to allocate 5000 Literal objects
+    # only for the engine to immediately unwrap them)
+    return [ast.Insert(table, columns, rows=[],
+                       columnar_values=[list(c) for c in zip(*rows)])]
 
 
 def parse_sql(sql: str) -> list[ast.Statement]:

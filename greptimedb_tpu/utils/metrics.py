@@ -6,6 +6,7 @@ from __future__ import annotations
 
 import threading
 import time
+import weakref
 from collections import defaultdict
 
 
@@ -22,36 +23,104 @@ class Counter:
             self._values[key] += value
 
     def get(self, **labels) -> float:
-        # under the lock: a bare dict read races concurrent inc/set
-        # (resize mid-read) and could observe a half-applied update
-        with self._lock:
-            return self._values.get(tuple(sorted(labels.items())), 0.0)
+        # via _snapshot (copied under the lock): a bare dict read races
+        # concurrent inc/set and could observe a half-applied update;
+        # subclasses that shard their writes only override _snapshot
+        return self._snapshot().get(tuple(sorted(labels.items())), 0.0)
 
     def total(self, **labels) -> float:
         """Sum over every series whose labels are a superset of the
         given ones (PromQL `sum by` analog) — assertions stay valid
         when a call site starts attaching extra labels."""
         want = set(labels.items())
-        with self._lock:
-            return sum(v for key, v in self._values.items()
-                       if want <= set(key))
+        return sum(v for key, v in self._snapshot().items()
+                   if want <= set(key))
 
     def series(self, **labels) -> list:
         """Every (labels dict, value) series whose labels are a superset
         of the given ones — feeds per-node/per-edge breakdowns in debug
         surfaces (information_schema.cluster_faults, /v1/faults)."""
         want = set(labels.items())
+        return [(dict(key), v)
+                for key, v in sorted(self._snapshot().items())
+                if want <= set(key)]
+
+    def _snapshot(self) -> dict:
+        """Point-in-time copy of every series (Registry sampling uses
+        this so sharded subclasses can fold their shards in)."""
         with self._lock:
-            return [(dict(key), v)
-                    for key, v in sorted(self._values.items())
-                    if want <= set(key)]
+            return dict(self._values)
 
     def render(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
-        with self._lock:
-            items = sorted(self._values.items())
+        items = sorted(self._snapshot().items())
         for key, v in items:
             out.append(f"{self.name}{_labels(key)} {v}")
+        return out
+
+
+class ShardedCounter(Counter):
+    """Counter whose `inc` writes a per-thread shard instead of taking
+    the global metric lock.
+
+    The per-request counters (http_requests, admission events, plan/
+    fast-lane cache events) are incremented by every serving thread on
+    every request; under 50 concurrent clients the single `Counter`
+    lock is a measurable contention point. Each thread owns a private
+    dict (only that thread ever writes it — plain dict updates are
+    GIL-atomic), and the read side folds base + shards at scrape/assert
+    time. A dying thread's shard is folded into the base dict by a
+    weakref finalizer on its Thread object, so counts survive thread
+    churn and the shard list stays bounded by live threads."""
+
+    def __init__(self, name: str, help_: str):
+        super().__init__(name, help_)
+        self._shards: list[dict] = []
+        self._tls = threading.local()
+
+    def _cell(self) -> dict:
+        cell = getattr(self._tls, "cell", None)
+        if cell is None:
+            cell = {}
+            with self._lock:
+                self._shards.append(cell)
+            # fold the shard into the durable base when the thread dies
+            # (cumulative counters must never lose counts)
+            weakref.finalize(threading.current_thread(),
+                             self._fold, cell)
+            self._tls.cell = cell
+        return cell
+
+    def _fold(self, cell: dict) -> None:
+        with self._lock:
+            try:
+                self._shards.remove(cell)
+            except ValueError:
+                return
+            for k, v in cell.items():
+                self._values[k] += v
+
+    def inc(self, value: float = 1.0, **labels):
+        cell = self._cell()
+        key = tuple(sorted(labels.items()))
+        # single-writer dict update: no lock, no condition, no CAS loop
+        cell[key] = cell.get(key, 0.0) + value
+
+    def shard_count(self) -> int:
+        with self._lock:
+            return len(self._shards)
+
+    def _snapshot(self) -> dict:
+        # the read methods (get/total/series/render) all fold through
+        # here — the only read-side difference from a plain Counter
+        with self._lock:
+            out = dict(self._values)
+            shards = list(self._shards)
+        for cell in shards:
+            # list(dict.items()) is one C call — an atomic snapshot of
+            # a shard another thread may be appending to
+            for k, v in list(cell.items()):
+                out[k] = out.get(k, 0.0) + v
         return out
 
 
@@ -187,6 +256,14 @@ class Registry:
             self._metrics.append(m)
         return m
 
+    def sharded_counter(self, name, help_="") -> ShardedCounter:
+        """Lock-light counter for the per-request hot path: inc() writes
+        a per-thread shard, reads fold at scrape time."""
+        m = ShardedCounter(name, help_)
+        with self._lock:
+            self._metrics.append(m)
+        return m
+
     def gauge(self, name, help_="") -> Gauge:
         m = Gauge(name, help_)
         with self._lock:
@@ -222,8 +299,7 @@ class Registry:
                     yield m.name + "_sum", s, key
                     yield m.name + "_count", c, key
             else:
-                with m._lock:
-                    items = sorted(m._values.items())
+                items = sorted(m._snapshot().items())
                 for key, v in items:
                     yield m.name, v, key
 
@@ -241,12 +317,17 @@ class Registry:
 REGISTRY = Registry()
 
 # framework-wide metrics (analogs of servers/src/metrics.rs etc.)
-HTTP_REQUESTS = REGISTRY.counter("greptimedb_tpu_http_requests_total",
-                                 "HTTP requests by path and status")
+# per-request counters are SHARDED: every serving thread touches them on
+# every request, and a single counter lock is measurable contention at
+# benchmark concurrency (ISSUE 14)
+HTTP_REQUESTS = REGISTRY.sharded_counter(
+    "greptimedb_tpu_http_requests_total",
+    "HTTP requests by path and status")
 QUERY_DURATION = REGISTRY.histogram("greptimedb_tpu_query_duration_seconds",
                                     "Query execution latency")
-INGEST_ROWS = REGISTRY.counter("greptimedb_tpu_ingest_rows_total",
-                               "Rows ingested by protocol")
+INGEST_ROWS = REGISTRY.sharded_counter(
+    "greptimedb_tpu_ingest_rows_total",
+    "Rows ingested by protocol")
 
 # ingest pipeline (storage/group_commit.py + the protocol front doors):
 # every front door lands on the bulk path through a per-region group
@@ -380,14 +461,14 @@ WRITE_STALL_TIMEOUTS = REGISTRY.counter(
 # plan cache, admission control, and cross-query batching that carry
 # fleet-scale dashboard traffic (ISSUE 6) — hit rates and rejection
 # behavior are asserted from these series, not eyeballed
-PLAN_CACHE_EVENTS = REGISTRY.counter(
+PLAN_CACHE_EVENTS = REGISTRY.sharded_counter(
     "greptimedb_tpu_plan_cache_events_total",
     "Shape-keyed logical-plan cache events by kind (hit/miss/evict/"
     "invalidate — invalidations come from DDL, schema drift, and "
     "rollup-substitution state changes; skip events carry a reason "
     "label naming why a statement never reached the cache: join/cte/"
     "subquery/range_select/window)")
-ADMISSION_EVENTS = REGISTRY.counter(
+ADMISSION_EVENTS = REGISTRY.sharded_counter(
     "greptimedb_tpu_admission_events_total",
     "Admission control decisions by kind (admit/queue/reject_full/"
     "reject_timeout; rejections carry the tenant label)")
@@ -397,7 +478,7 @@ ADMISSION_QUEUE_DEPTH = REGISTRY.gauge(
 ADMISSION_WAIT_SECONDS = REGISTRY.histogram(
     "greptimedb_tpu_admission_wait_seconds",
     "Time queued statements waited for an execution slot")
-QUERY_BATCH_EVENTS = REGISTRY.counter(
+QUERY_BATCH_EVENTS = REGISTRY.sharded_counter(
     "greptimedb_tpu_query_batch_events_total",
     "Cross-query batching events by kind (join/coalesced/vmapped/"
     "stacked/serial_fallback — coalesced, vmapped, and stacked members "
@@ -411,7 +492,7 @@ VMAP_BATCH_WIDTH = REGISTRY.histogram(
     "Distinct parameter-sibling queries executed per vmapped multi-"
     "query dispatch (the stacked member axis M)",
     buckets=(2, 4, 8, 16, 32, 64, 128))
-ENCODE_POOL_EVENTS = REGISTRY.counter(
+ENCODE_POOL_EVENTS = REGISTRY.sharded_counter(
     "greptimedb_tpu_encode_pool_events_total",
     "Result-encode pool decisions by kind (offload = serialized on a "
     "pool worker, inline = pool saturated, small_inline = result "
@@ -425,6 +506,46 @@ ENCODE_SECONDS = REGISTRY.histogram(
     "Wall time serializing one query result to its wire format "
     "(HTTP JSON / MySQL packets), by protocol — compare against "
     "query_duration_seconds for the execute-vs-encode split")
+
+# parse-free serving fast lane (concurrency/fast_lane.py, ISSUE 14): a
+# text-keyed template cache in front of the plan cache — a repeat-shape
+# statement goes socket bytes -> admission -> bind -> execute -> encode
+# with zero parse_sql, zero AST, zero logical planning
+FAST_LANE_EVENTS = REGISTRY.sharded_counter(
+    "greptimedb_tpu_fast_lane_events_total",
+    "Text-template serving fast-lane events by kind (hit = a statement "
+    "executed from its cached bound-plan template without parsing, "
+    "miss = first sighting of a template (built via the slow lane), "
+    "fallback = scanned but ineligible — the reason label names why: "
+    "ambiguous literals, comments, non-SELECT verbs, plugins, pending "
+    "rollup-substitution probes — invalidate = entries dropped by DDL "
+    "or a TableInfo drift check, coalesced = concurrent identical "
+    "requests that rode another request's in-flight execution)")
+STAGE_SECONDS = REGISTRY.histogram(
+    "greptimedb_tpu_query_stage_seconds",
+    "Per-request serving-stage wall time by stage (parse / plan = "
+    "plan-cache lookup + substitution probe + plan_select / execute on "
+    "the slow lane; fast_bind / fast_execute on the fast lane) — with "
+    "admission_wait_seconds and encode_seconds this makes the QPS "
+    "breakdown attributable per stage instead of inferred")
+COUNTER_SHARDS = REGISTRY.gauge(
+    "greptimedb_tpu_metrics_counter_shards",
+    "Live per-thread shard cells across all sharded hot counters "
+    "(folded into the base series when their thread dies); scrape-time "
+    "visibility into the lock-light counter plane")
+
+
+def _collect_counter_shards() -> None:
+    n = 0
+    with REGISTRY._lock:
+        metrics = list(REGISTRY._metrics)
+    for m in metrics:
+        if isinstance(m, ShardedCounter):
+            n += m.shard_count()
+    COUNTER_SHARDS.set(float(n))
+
+
+REGISTRY.register_collector(_collect_counter_shards)
 
 ROLLUP_SUBSTITUTIONS = REGISTRY.counter(
     "greptimedb_tpu_maintenance_rollup_substitutions_total",

@@ -96,8 +96,11 @@ def run_threads(fns, timeout=120):
 class TestPlanCache:
     def test_shape_hit_rebinds_parameters(self, tmp_path):
         """2000 dashboard queries differing only in WHERE literals share
-        ONE cache entry, and every rebind computes the RIGHT answer."""
-        engine, qe = make_qe(tmp_path)
+        ONE cache entry, and every rebind computes the RIGHT answer.
+        (Fast lane off: the plan cache's own hit counter is asserted —
+        with the lane on, repeats would be fast-lane hits instead.)"""
+        engine, qe = make_qe(tmp_path, plane=ConcurrencyPlane(
+            ConcurrencyConfig(fast_lane=False)))
         create_cpu(qe)
         ingest(qe)
         oracle = {}
@@ -420,6 +423,10 @@ class BatchPlane(ConcurrencyPlane):
     on scheduler timing."""
 
     def __init__(self, window_ms=60.0, **kw):
+        # the batcher is the layer under test: the parse-free fast lane
+        # (which would otherwise serve these repeats before batching)
+        # has its own suite in test_fast_lane.py
+        kw.setdefault("fast_lane", False)
         super().__init__(ConcurrencyConfig(batch_window_ms=window_ms, **kw))
 
     def execute_select(self, qe, sel, info, ctx):

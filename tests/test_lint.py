@@ -735,11 +735,15 @@ def test_runtime_lockdep_under_scan_pool_and_admission():
     """GTPU_LOCKDEP=1 over the real multithreaded path: 6 threads of
     GROUP BY queries through admission slots and the 2-worker scan
     decode pool; the observed lock nesting must be acyclic and must
-    include the admission controller's lock."""
+    include the admission controller's lock. GTPU_MAX_CONCURRENCY=2
+    forces queueing: the uncontended admission path is lock-free
+    (token pop under the GIL), so only the contended slow path takes
+    the admission lock this assertion watches."""
     res = subprocess.run(
         [sys.executable, "-c", _LOCKDEP_SCRIPT],
         capture_output=True, text=True, timeout=480, cwd=REPO_ROOT,
         env={**os.environ, "JAX_PLATFORMS": "cpu", "GTPU_LOCKDEP": "1",
+             "GTPU_MAX_CONCURRENCY": "2",
              "GTPU_SLOW_QUERY_MS": "600000"})
     assert res.returncode == 0, res.stdout + "\n" + res.stderr
     assert "LOCKDEP_EDGES=" in res.stdout

@@ -75,6 +75,9 @@ def ingest(qe, hosts=4, dcs=0, points=120, step_ms=1000, seed=7):
 
 
 def batch_plane(window_ms=25.0, **kw):
+    # batcher-layer tests: the parse-free fast lane would serve these
+    # repeat shapes before they could form batch groups
+    kw.setdefault("fast_lane", False)
     return ConcurrencyPlane(ConcurrencyConfig(batch_window_ms=window_ms,
                                               **kw))
 

@@ -1,0 +1,58 @@
+#!/usr/bin/env python
+"""Install the repo's git hooks into .git/hooks (the builder-loop
+wiring for `tools/pre-commit`, which runs `gtpu_lint --changed-only`
+over every commit's diff).
+
+Idempotent: re-running replaces an existing hook only when it differs.
+Run once per clone:
+
+    python tools/install_hooks.py
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import stat
+import subprocess
+import sys
+
+HOOKS = ("pre-commit",)
+
+
+def git_dir(repo_root: str) -> str:
+    out = subprocess.run(
+        ["git", "rev-parse", "--git-dir"], cwd=repo_root,
+        capture_output=True, text=True, check=True)
+    path = out.stdout.strip()
+    return path if os.path.isabs(path) else os.path.join(repo_root, path)
+
+
+def main() -> int:
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    try:
+        hooks_dir = os.path.join(git_dir(repo_root), "hooks")
+    except (subprocess.CalledProcessError, OSError) as e:
+        print(f"install_hooks: not a git checkout ({e})")
+        return 1
+    os.makedirs(hooks_dir, exist_ok=True)
+    installed = []
+    for name in HOOKS:
+        src = os.path.join(repo_root, "tools", name)
+        dst = os.path.join(hooks_dir, name)
+        if os.path.exists(dst):
+            with open(src, "rb") as f_src, open(dst, "rb") as f_dst:
+                if f_src.read() == f_dst.read():
+                    print(f"install_hooks: {name} already installed")
+                    continue
+        shutil.copyfile(src, dst)
+        os.chmod(dst, os.stat(dst).st_mode | stat.S_IXUSR | stat.S_IXGRP
+                 | stat.S_IXOTH)
+        installed.append(name)
+    for name in installed:
+        print(f"install_hooks: installed {name}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
