@@ -1584,6 +1584,77 @@ def bench_qps(qe, results, clients=None, requests_total=None):
         for t in threads:
             t.join()
         wall = time.perf_counter() - t_start
+
+        # observability overhead A/B (ISSUE 15): the same request on
+        # one keep-alive connection with the tracing plane (spans +
+        # ledger + exporter hook) on vs GTPU_TRACING=off — the <3%
+        # budget gate. Sequential single-connection runs are far less
+        # noisy than re-running the full 50-client storm.
+        from greptimedb_tpu.utils import tracing as _tr
+
+        def _seq_qps(n):
+            conn = http.client.HTTPConnection("127.0.0.1", port,
+                                              timeout=60)
+            try:
+                for _ in range(10):  # settle the lane/caches per mode
+                    conn.request("POST", "/v1/sql", body=body,
+                                 headers=headers)
+                    conn.getresponse().read()
+                t0 = time.perf_counter()
+                for _ in range(n):
+                    conn.request("POST", "/v1/sql", body=body,
+                                 headers=headers)
+                    conn.getresponse().read()
+                return n / (time.perf_counter() - t0)
+            finally:
+                conn.close()
+
+        ab_n = max(100, min(400, requests_total // 5))
+        # spans per query: ride the W3C ingress — a request with a
+        # known traceparent lands its whole tree under that id
+        ab_tid = "feedbeefcafe4242"
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+        conn.request("POST", "/v1/sql", body=body, headers={
+            **headers,
+            "traceparent": f"00-{ab_tid.rjust(32, '0')}-00f067aa0ba902b7-01"})
+        conn.getresponse().read()
+        conn.close()
+        spans_per_query = len(_tr.spans_for(ab_tid))
+        from greptimedb_tpu.utils.otlp_trace import OTLP_TRACE_SPANS
+        otlp0 = (OTLP_TRACE_SPANS.total(event="exported"),
+                 OTLP_TRACE_SPANS.total(event="dropped"))
+        # alternate on/off rounds and take per-mode medians: a single
+        # sequential pair confounds the mode with drift on a busy box
+        prev_tracing = os.environ.get("GTPU_TRACING")
+        on_rounds, off_rounds = [], []
+        try:
+            for _ in range(3):
+                if prev_tracing is None:
+                    os.environ.pop("GTPU_TRACING", None)
+                else:
+                    os.environ["GTPU_TRACING"] = prev_tracing
+                on_rounds.append(_seq_qps(ab_n))
+                os.environ["GTPU_TRACING"] = "off"
+                off_rounds.append(_seq_qps(ab_n))
+        finally:
+            if prev_tracing is None:
+                os.environ.pop("GTPU_TRACING", None)
+            else:
+                os.environ["GTPU_TRACING"] = prev_tracing
+        qps_on = float(np.median(on_rounds))
+        qps_off = float(np.median(off_rounds))
+        overhead_pct = (1.0 - qps_on / qps_off) * 100 if qps_off else 0.0
+        tracing_ab = {
+            "qps_tracing_on": round(qps_on, 1),
+            "qps_tracing_off": round(qps_off, 1),
+            "overhead_pct": round(overhead_pct, 2),
+            "budget_pct": 3.0,
+            "spans_per_query": spans_per_query,
+            "otlp_exported": int(OTLP_TRACE_SPANS.total(event="exported")
+                                 - otlp0[0]),
+            "otlp_dropped": int(OTLP_TRACE_SPANS.total(event="dropped")
+                                - otlp0[1]),
+        }
     except Exception as e:  # one config may not sink the whole bench
         log(f"qps bench failed: {e!r}")
         results["qps_single_groupby"] = {"error": repr(e)[:200]}
@@ -1615,7 +1686,14 @@ def bench_qps(qe, results, clients=None, requests_total=None):
         f"fast lane {serving['fast_lane']}, "
         f"stages {serving['stage_breakdown']['shares']}, "
         f"encode {serving['encode_split']})")
+    log(f"qps tracing A/B: on {tracing_ab['qps_tracing_on']} vs off "
+        f"{tracing_ab['qps_tracing_off']} qps -> "
+        f"{tracing_ab['overhead_pct']:+.2f}% overhead (budget 3%), "
+        f"{tracing_ab['spans_per_query']} spans/query, "
+        f"otlp exported {tracing_ab['otlp_exported']} / dropped "
+        f"{tracing_ab['otlp_dropped']}")
     results["qps_single_groupby"] = {
+        "tracing_overhead": tracing_ab,
         "qps": round(qps, 1), "clients": clients, "requests": done,
         "errors": n_err,
         "mean_ms": round(float(lats.mean() * 1000), 2),

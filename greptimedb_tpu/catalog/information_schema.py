@@ -198,7 +198,7 @@ def _slow_queries(qe, ctx):
     cols = {k: [] for k in (
         "trace_id", "kind", "query", "db", "duration_ms", "threshold_ms",
         "rows", "execution_path", "plan_cache_skip", "started_at",
-        "stages")}
+        "stages", "ledger")}
     for rec in slow_query.records():
         cols["trace_id"].append(rec.trace_id)
         cols["kind"].append(rec.kind)
@@ -213,6 +213,9 @@ def _slow_queries(qe, ctx):
         cols["stages"].append("; ".join(
             f"{'' if n == 'local' else '[' + str(n) + '] '}{s}={d:.2f}ms"
             for n, s, d in rec.stages))
+        from greptimedb_tpu.utils import ledger as _ledger
+
+        cols["ledger"].append(_ledger.format_dict(rec.ledger))
     return cols
 
 

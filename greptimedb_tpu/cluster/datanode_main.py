@@ -31,9 +31,13 @@ def main() -> None:
 
     from greptimedb_tpu.servers.flight import FlightServer
     from greptimedb_tpu.storage.engine import EngineConfig, RegionEngine
+    from greptimedb_tpu.utils.otlp_trace import maybe_install
     from greptimedb_tpu.utils.tracing import install_trace_logging
 
     install_trace_logging()
+    # inherited GTPU_OTLP_ENDPOINT: datanode children export their own
+    # spans under the same trace ids the frontend propagates
+    maybe_install()
 
     def _env_num(name, default, cast):
         try:

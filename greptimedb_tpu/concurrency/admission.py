@@ -35,6 +35,7 @@ from collections import deque
 from contextlib import contextmanager
 
 from greptimedb_tpu.fault.retry import Unavailable
+from greptimedb_tpu.utils import ledger
 from greptimedb_tpu.utils.metrics import (
     ADMISSION_EVENTS,
     ADMISSION_QUEUE_DEPTH,
@@ -170,7 +171,9 @@ class AdmissionController:
             self._rescue()
         t0 = time.perf_counter()
         granted = w.event.wait(self.queue_timeout_s)
-        ADMISSION_WAIT_SECONDS.observe(time.perf_counter() - t0)
+        waited = time.perf_counter() - t0
+        ADMISSION_WAIT_SECONDS.observe(waited)
+        ledger.add("admission_wait_ms", waited * 1000.0)
         if granted:
             return
         with self._lock:

@@ -60,6 +60,7 @@ from typing import Optional
 
 from greptimedb_tpu.concurrency.plan_cache import _info_matches, normalize
 from greptimedb_tpu.sql import ast
+from greptimedb_tpu.utils import ledger
 from greptimedb_tpu.utils.metrics import (
     FAST_LANE_EVENTS,
     STAGE_SECONDS,
@@ -283,6 +284,7 @@ class FastLane:
             # must not pay — the SECOND sighting proves the template
             # repeats and builds the entry.
             FAST_LANE_EVENTS.inc(event="miss")
+            ledger.cache_event("fast_lane", "miss")
             self._note_seen(key)
             return qe._execute_sql_slow(sql, ctx, _intercepted=intercepted)
         if tmpl.uncacheable:
@@ -459,6 +461,7 @@ class FastLane:
             return self._refresh_entry(qe, sql, ctx, entry, intercepted)
         params = entry.bind_params(values)
         FAST_LANE_EVENTS.inc(event="hit")
+        ledger.cache_event("fast_lane", "hit")
         return self._run(qe, sql, ctx, key, entry, params, intercepted)
 
     def _refresh_entry(self, qe, sql, ctx, entry,

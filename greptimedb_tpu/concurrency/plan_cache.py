@@ -179,8 +179,11 @@ class PlanCache:
             ent = self._entries.get(key)
             if ent is not None:
                 self._entries.move_to_end(key)
+        from greptimedb_tpu.utils import ledger
+
         if ent is None:
             PLAN_CACHE_EVENTS.inc(event="miss")
+            ledger.cache_event("plan", "miss")
             return None, None, (key, params)
         if not _info_matches(ent.info, info):
             # DDL this process never executed (remote frontend's ALTER,
@@ -188,13 +191,16 @@ class PlanCache:
             with self._lock:
                 self._entries.pop(key, None)
             PLAN_CACHE_EVENTS.inc(event="invalidate")
+            ledger.cache_event("plan", "invalidate")
             return None, None, (key, params)
         try:
             plan = self._bind(ent, params)
         except Exception:  # noqa: BLE001 — any doubt means re-plan
             PLAN_CACHE_EVENTS.inc(event="miss")
+            ledger.cache_event("plan", "miss")
             return None, None, (key, params)
         PLAN_CACHE_EVENTS.inc(event="hit")
+        ledger.cache_event("plan", "hit")
         return plan, ent, (key, params)
 
     def _bind(self, ent: _Entry, params: tuple) -> lp.LogicalPlan:

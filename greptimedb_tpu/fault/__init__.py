@@ -102,6 +102,11 @@ POINTS = frozenset({
     # mid-drain and assert no acknowledged write is lost and no torn
     # WAL frame survives; @op targets one phase
     "ingest.commit",
+    # OTLP trace exporter POST (utils/otlp_trace.py): fired before each
+    # export batch hits the wire — chaos runs arm it to prove a dead
+    # collector degrades typed (failed counter, log throttle) with zero
+    # query impact
+    "otlp.export",
 })
 
 #: points that cross a process boundary and therefore have a peer: the

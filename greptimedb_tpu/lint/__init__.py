@@ -24,6 +24,9 @@ them over the repo's own AST so the next PR cannot silently regress:
                 accessed bare in another (caller-holds-lock docstring
                 contracts and the _locked naming convention count as
                 guarded)
+  span_coverage every FAULTS-registered I/O seam and wire entry point
+                executes inside a tracing span — untraced I/O is where
+                production stalls hide from EXPLAIN ANALYZE
   deadcode      unused imports / unused module-level names / unreachable
                 statements
   metrics       every registered metric is prefixed, documented, charted
@@ -223,6 +226,7 @@ def _import_checkers() -> None:
         jax_imports,
         lockgraph,
         metrics_options,
+        span_coverage,
         tracer,
         typed_errors,
     )

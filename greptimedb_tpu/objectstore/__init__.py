@@ -32,6 +32,7 @@ from typing import Optional
 import pyarrow as pa
 
 from greptimedb_tpu.fault import FAULTS, retry_call
+from greptimedb_tpu.utils import tracing
 from greptimedb_tpu.utils.metrics import REGISTRY
 
 OBJECT_STORE_READS = REGISTRY.counter(
@@ -57,18 +58,26 @@ class ObjectStore:
     name = "base"
 
     def read(self, key: str) -> bytes:
-        def op():
-            return FAULTS.mangled_read("objectstore.read",
-                                       self._do_read(key))
-        return retry_call(op, point="objectstore.read")
+        # the span joins the request trace when a scan-pool worker runs
+        # this under tracing.propagate — SST reads become visible (and
+        # attributable) in EXPLAIN ANALYZE trees
+        with tracing.span("objectstore_read", backend=self.name) as attrs:
+            def op():
+                return FAULTS.mangled_read("objectstore.read",
+                                           self._do_read(key))
+            data = retry_call(op, point="objectstore.read")
+            attrs["bytes"] = len(data)
+            return data
 
     def write(self, key: str, data: bytes) -> None:
-        retry_call(
-            lambda: FAULTS.mangled_write(
-                "objectstore.write", data,
-                lambda blob: self._do_write(key, blob),
-                spill=lambda blob: self._spill_partial(key, blob)),
-            point="objectstore.write")
+        with tracing.span("objectstore_write", backend=self.name,
+                          bytes=len(data)):
+            retry_call(
+                lambda: FAULTS.mangled_write(
+                    "objectstore.write", data,
+                    lambda blob: self._do_write(key, blob),
+                    spill=lambda blob: self._spill_partial(key, blob)),
+                point="objectstore.write")
 
     def _do_read(self, key: str) -> bytes:
         raise NotImplementedError
@@ -166,14 +175,15 @@ class FsStore(ObjectStore):
             and os.path.isfile(os.path.join(d, n)))
 
     def open_input(self, key: str):
-        def op():
-            FAULTS.fire("objectstore.read")
-            OBJECT_STORE_READS.inc(backend="fs", outcome="mmap")
-            try:
-                return pa.memory_map(key, "rb")
-            except FileNotFoundError as e:
-                raise ObjectStoreError(f"object {key!r} not found") from e
-        return retry_call(op, point="objectstore.read")
+        with tracing.span("objectstore_read", backend="fs", mmap=True):
+            def op():
+                FAULTS.fire("objectstore.read")
+                OBJECT_STORE_READS.inc(backend="fs", outcome="mmap")
+                try:
+                    return pa.memory_map(key, "rb")
+                except FileNotFoundError as e:
+                    raise ObjectStoreError(f"object {key!r} not found") from e
+            return retry_call(op, point="objectstore.read")
 
     def size(self, key: str) -> int:
         return os.path.getsize(key)

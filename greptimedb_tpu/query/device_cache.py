@@ -41,6 +41,7 @@ import jax
 
 from greptimedb_tpu import config
 from greptimedb_tpu.utils import device_telemetry
+from greptimedb_tpu.utils import ledger
 from greptimedb_tpu.utils.metrics import (
     DEVICE_CACHE_EVENTS,
     DEVICE_HOT_SET_BYTES,
@@ -132,6 +133,7 @@ class DeviceCache:
                 self.hits += 1
                 DEVICE_CACHE_EVENTS.inc(event="hit")
                 DEVICE_HOT_SET_EVENTS.inc(event="hit")
+                ledger.cache_event("device_hot_set", "hit")
                 return hit
             fut = self._inflight.get(key)
         if fut is not None:
@@ -155,6 +157,7 @@ class DeviceCache:
             epoch = self._key_epoch_locked(key)
         DEVICE_CACHE_EVENTS.inc(event="miss")
         DEVICE_HOT_SET_EVENTS.inc(event="miss")
+        ledger.cache_event("device_hot_set", "miss")
         arr = build()
         # a cache-miss build materializes the block on device: that IS
         # the H2D upload this cache exists to amortize. count_h2d=False

@@ -183,7 +183,7 @@ class QueryEngine:
         # new top-level statement: its first plan-cache skip (if any)
         # is the one that gets counted/recorded
         self._skip_tls.noted = False
-        from greptimedb_tpu.utils import tracing
+        from greptimedb_tpu.utils import ledger, tracing
         from greptimedb_tpu.utils.metrics import STMT_DURATION
         ctx.trace_id = tracing.set_trace(ctx.trace_id)
         from greptimedb_tpu.query.expr import reset_session_tz, set_session_tz
@@ -193,8 +193,19 @@ class QueryEngine:
         tz_token = set_session_tz(ctx.timezone or self.default_timezone)
         try:
             with STMT_DURATION.time(stmt=type(stmt).__name__), \
-                    tracing.span(f"stmt:{type(stmt).__name__}"):
-                return self._execute_statement(stmt, ctx)
+                    tracing.span(f"stmt:{type(stmt).__name__}") as sp:
+                # the statement's resource-ledger slice is stamped onto
+                # its root span (diffed: a multi-statement request
+                # shares one request-scoped ledger)
+                with ledger.attach() as led:
+                    led0 = led.snapshot() if led is not None else {}
+                    try:
+                        return self._execute_statement(stmt, ctx)
+                    finally:
+                        if led is not None:
+                            d = ledger.diff(led0, led.snapshot())
+                            if d:
+                                sp["ledger"] = ledger.format_dict(d)
         finally:
             reset_session_tz(tz_token)
 
@@ -1629,52 +1640,45 @@ class QueryEngine:
                            [np.asarray(lines, dtype=object)])
 
     def _analyze_run(self, run, show_path: bool = False) -> list[str]:
-        """Execute `run` under a FRESH trace id and report its spans
+        """Execute `run` under a FRESH trace id and report its span tree
         (shared by EXPLAIN ANALYZE and TQL ANALYZE). A fresh id matters:
         connection-scoped contexts pin one trace id, and reusing it would
         dump every prior statement's spans into this report. The
-        connection's id is restored afterwards."""
+        connection's trace AND parent-span context are restored
+        afterwards (adopt_remote with a cleared parent makes the inner
+        run its own tree root instead of a child of the request span)."""
         import time as _time
 
-        from greptimedb_tpu.utils import tracing
+        from greptimedb_tpu.utils import ledger, tracing
 
-        prev = tracing.current_trace_id()
-        tid = tracing.set_trace(None)
-        try:
-            t0 = _time.perf_counter()
-            # ANALYZE must run ITS OWN execution: riding a batch
-            # leader's run would report someone else's (empty) trace
-            with self.concurrency.suppress_batching():
-                result = run()
-            total_ms = (_time.perf_counter() - t0) * 1000.0
+        tid = tracing.new_trace_id()
+        with tracing.adopt_remote(tid, None):
+            # a fresh ledger too: the report must attribute THIS
+            # statement's resources, not the whole request's
+            with ledger.attach_fresh() as led:
+                t0 = _time.perf_counter()
+                # ANALYZE must run ITS OWN execution: riding a batch
+                # leader's run would report someone else's (empty) trace
+                with self.concurrency.suppress_batching():
+                    result = run()
+                total_ms = (_time.perf_counter() - t0) * 1000.0
             spans = tracing.spans_for(tid)
-        finally:
-            tracing.restore_trace(prev)
         lines = ["", f"ANALYZE trace={tid} total={total_ms:.2f} ms "
                      f"rows={result.num_rows}"]
         if show_path:
             path = getattr(self.executor, "last_path", None)
             if path:
                 lines.append(f"  execution path: {path}")
-
-        def fmt(s, indent="  "):
-            attrs = " ".join(f"{k}={v}" for k, v in s.attrs.items())
-            return (f"{indent}{s.name}: {s.duration_ms:.2f} ms"
-                    + (f" [{attrs}]" if attrs else ""))
-
-        # per-process span tree: this process's spans first (recorded
-        # order), then one section per remote node whose spans rode back
-        # on the region wire protocol (merge_scan.rs:245-259 piggyback)
-        for s in spans:
-            if s.node is None:
-                lines.append(fmt(s))
-        by_node: dict = {}
-        for s in spans:
-            if s.node is not None:
-                by_node.setdefault(s.node, []).append(s)
-        for node in sorted(by_node):
-            lines.append(f"  [{node}]")
-            lines.extend(fmt(s, "    ") for s in by_node[node])
+        # the merged per-process span TREE: children nest under their
+        # parents (remote datanode spans re-parent under the frontend
+        # span that issued the RPC via the piggybacked linkage), each
+        # parent reporting self-time, each remote process marked with a
+        # [node] line (merge_scan.rs:245-259 piggyback analog)
+        lines.extend(tracing.render_tree(spans))
+        if led is not None:
+            summary = led.summary()
+            if summary:
+                lines.append(f"  resource ledger: {summary}")
         return lines
 
     # ---- admin -------------------------------------------------------------

@@ -169,16 +169,22 @@ class PartialAggCache:
             return self._region_epoch.get(region_id, 0)
 
     def get(self, key: tuple) -> Optional[dict]:
+        from greptimedb_tpu.utils import ledger
+
         with self._lock:
             hit = self._lru.get(key)
             if hit is None:
                 self.misses += 1
-                PARTIAL_AGG_CACHE_EVENTS.inc(event="miss")
-                return None
-            self._lru.move_to_end(key)
-            self.hits += 1
-            PARTIAL_AGG_CACHE_EVENTS.inc(event="hit")
-            return hit[0]
+            else:
+                self._lru.move_to_end(key)
+                self.hits += 1
+        if hit is None:
+            PARTIAL_AGG_CACHE_EVENTS.inc(event="miss")
+            ledger.cache_event("partial_agg", "miss")
+            return None
+        PARTIAL_AGG_CACHE_EVENTS.inc(event="hit")
+        ledger.cache_event("partial_agg", "hit")
+        return hit[0]
 
     def put(self, key: tuple, partial: dict,
             epoch: Optional[int] = None) -> None:

@@ -1405,7 +1405,7 @@ class PhysicalExecutor:
                         stream.close()
 
             with tracing.span("scan", table=table.name,
-                              regions=len(table.region_ids)):
+                              regions=len(table.region_ids)) as scan_attrs:
                 if len(table.region_ids) == 1:
                     scan = self.engine.scan(table.region_ids[0], ts_range,
                                             scan_node.columns, tag_preds)
@@ -1421,6 +1421,9 @@ class PhysicalExecutor:
                             for rid in table.region_ids
                         ]
                     )
+                # rows land on the span (and, through it, the resource
+                # ledger's rows_scanned)
+                scan_attrs["rows"] = 0 if scan is None else scan.num_rows
 
             nrows = 0 if scan is None else scan.num_rows
             if agg is not None:

@@ -309,18 +309,29 @@ class FlightServer(fl.FlightServerBase):
             return self._region_frag(req["region_frag"])
         if self.qe is None:
             raise fl.FlightServerError("datanode service: region tickets only")
-        ctx = QueryContext(db=req.get("db", "public"), channel=Channel.GRPC,
-                           user=self._resolve_user(context),
-                           trace_id=req.get("trace_id"))
-        if "sql" in req:
-            result = self.qe.execute_one(req["sql"], ctx)
-        elif "tql" in req:
-            t = req["tql"]
-            from greptimedb_tpu.promql.engine import PromqlEngine
-            result = PromqlEngine(self.qe).eval_range(
-                t["query"], t["start"], t["end"], t["step"], ctx)
-        else:
+        from greptimedb_tpu.utils import tracing
+
+        if "sql" not in req and "tql" not in req:
             raise fl.FlightServerError("ticket needs 'sql', 'tql' or 'region_scan'")
+        # request-root span for the Flight SQL surface: adopt the
+        # caller's trace context when the ticket carries one (the
+        # region_server.rs:74 re-attach analog), else mint a fresh trace
+        with tracing.adopt_remote(req.get("trace_id")
+                                  or tracing.new_trace_id(),
+                                  req.get("parent_span")):
+            ctx = QueryContext(db=req.get("db", "public"),
+                               channel=Channel.GRPC,
+                               user=self._resolve_user(context),
+                               trace_id=tracing.current_trace_id())
+            if "sql" in req:
+                with tracing.span("flight:sql"):
+                    result = self.qe.execute_one(req["sql"], ctx)
+            else:
+                t = req["tql"]
+                from greptimedb_tpu.promql.engine import PromqlEngine
+                with tracing.span("flight:tql"):
+                    result = PromqlEngine(self.qe).eval_range(
+                        t["query"], t["start"], t["end"], t["step"], ctx)
         if not result.is_query:
             # DML/DDL ack: flagged via schema metadata, not column names
             # (a SELECT could legitimately project `affected_rows`)
@@ -356,10 +367,13 @@ class FlightServer(fl.FlightServerBase):
         from greptimedb_tpu.storage.index import deserialize_predicates
         preds = deserialize_predicates(
             req.get("tag_predicates_v2") or req.get("tag_predicates"))
-        if req.get("trace_id"):
-            # adopt the caller's trace (region_server.rs:74 analog)
-            tracing.set_trace(req["trace_id"])
-        with tracing.collect_spans() as sink:
+        # adopt the caller's trace AND parent span (region_server.rs:74
+        # analog): this datanode's region_scan re-parents under the
+        # frontend span that issued the RPC, so the merged ANALYZE tree
+        # nests across the process hop
+        with tracing.adopt_remote(req.get("trace_id"),
+                                  req.get("parent_span")), \
+                tracing.collect_spans() as sink:
             with tracing.span("region_scan", region=region_id) as attrs:
                 # server-side injection INSIDE the scan span: latency
                 # armed here (e.g. via GTPU_CHAOS inherited by a child
@@ -398,12 +412,12 @@ class FlightServer(fl.FlightServerBase):
 
         region_id = req["region_id"]
         frag = PlanFragment.from_json(req["fragment"])
-        if req.get("trace_id"):
-            tracing.set_trace(req["trace_id"])
         if self._agg_executor is None:
             from greptimedb_tpu.query.physical import PhysicalExecutor
             self._agg_executor = PhysicalExecutor(self.engine)
-        with tracing.collect_spans() as sink:
+        with tracing.adopt_remote(req.get("trace_id"),
+                                  req.get("parent_span")), \
+                tracing.collect_spans() as sink:
             with tracing.span("region_frag", region=region_id,
                               stages=len(frag.stages)):
                 FAULTS.fire("flight.do_get", side="server",
@@ -453,12 +467,16 @@ class FlightServer(fl.FlightServerBase):
 
             rid = int(path[1])
             op = path[2] if len(path) > 2 else "put"
-            # the caller's trace id rides the descriptor path tail so
-            # write-side spans join the same trace (do_get carries it in
-            # the ticket; do_put has only the descriptor)
-            if len(path) > 3 and path[3]:
-                tracing.set_trace(path[3])
-            with tracing.collect_spans() as sink:
+            # the caller's trace id (and parent span id, one element
+            # further) ride the descriptor path tail so write-side
+            # spans join — and nest under — the same trace (do_get
+            # carries them in the ticket; do_put has only the
+            # descriptor). Old peers sent shorter paths; extras are
+            # ignored both ways.
+            tid_p = path[3] if len(path) > 3 and path[3] else None
+            par_p = path[4] if len(path) > 4 and path[4] else None
+            with tracing.adopt_remote(tid_p, par_p), \
+                    tracing.collect_spans() as sink:
                 with tracing.span("region_write", region=rid,
                                   op=op) as attrs:
                     # server-side seam inside the write span (the do_put
@@ -670,12 +688,17 @@ class RemoteRegionEngine:
         after a mid-stream failure are at-least-once; the LSM's
         key+timestamp LWW collapses the duplicates (append-mode tables
         trade exactness for availability, as the reference's gRPC retry
-        does)."""
-        def op():
-            FAULTS.fire(point, addr=self.addr, side="client",
-                        src=local_node(), dst=self.peer or self.addr)
-            return fn()
-        return retry_call(op, point=point, retryable=RETRYABLE_FLIGHT)
+        does). The span makes the wire+retry cost visible as self-time
+        under the enclosing remote_region_* span."""
+        from greptimedb_tpu.utils import tracing
+
+        with tracing.span("flight_rpc", point=point, dst=self.peer
+                          or self.addr):
+            def op():
+                FAULTS.fire(point, addr=self.addr, side="client",
+                            src=local_node(), dst=self.peer or self.addr)
+                return fn()
+            return retry_call(op, point=point, retryable=RETRYABLE_FLIGHT)
 
     def _merge_remote_spans(self, meta) -> None:
         """Fold the response's piggybacked datanode spans into the local
@@ -748,31 +771,36 @@ class RemoteRegionEngine:
         from greptimedb_tpu.utils import tracing
 
         tid = tracing.current_trace_id()
-        # trace id rides the descriptor path tail (do_put has no ticket);
-        # old servers ignore the extra element
-        path = ["__region__", str(region_id), op] + ([tid] if tid else [])
-        desc = fl.FlightDescriptor.for_path(*path)
-        arrow = batch.to_arrow()
+        with tracing.span("remote_region_write", region=region_id,
+                          op=op, addr=self.addr):
+            # trace id + parent span id ride the descriptor path tail
+            # (do_put has no ticket); the datanode's region_write span
+            # re-parents under THIS span. Old servers ignore extras.
+            path = ["__region__", str(region_id), op] + \
+                ([tid, tracing.current_span_id() or ""] if tid else [])
+            desc = fl.FlightDescriptor.for_path(*path)
+            arrow = batch.to_arrow()
 
-        def put_once():
-            writer, reader = self.client.do_put(desc, arrow.schema)
-            try:
-                writer.write_batch(arrow)
-                writer.done_writing()
-                ack_buf = reader.read()
-                if ack_buf is None:
-                    raise fl.FlightServerError("no ack from region server")
-                ack = json.loads(ack_buf.to_pybytes().decode())
-                self._merge_remote_spans(ack)
-                return ack["affected_rows"]
-            finally:
-                # close on EVERY path: a failed put that leaks its stream
-                # would accumulate one half-open stream per retry attempt
+            def put_once():
+                writer, reader = self.client.do_put(desc, arrow.schema)
                 try:
-                    writer.close()
-                except Exception:  # noqa: BLE001 — stream already dead
-                    pass
-        return self._rpc("flight.do_put", put_once)
+                    writer.write_batch(arrow)
+                    writer.done_writing()
+                    ack_buf = reader.read()
+                    if ack_buf is None:
+                        raise fl.FlightServerError("no ack from region server")
+                    ack = json.loads(ack_buf.to_pybytes().decode())
+                    self._merge_remote_spans(ack)
+                    return ack["affected_rows"]
+                finally:
+                    # close on EVERY path: a failed put that leaks its
+                    # stream would accumulate one half-open stream per
+                    # retry attempt
+                    try:
+                        writer.close()
+                    except Exception:  # noqa: BLE001 — stream already dead
+                        pass
+            return self._rpc("flight.do_put", put_once)
 
     def put(self, region_id: int, batch) -> int:
         return self._write(region_id, batch, "put")
@@ -809,6 +837,10 @@ class RemoteRegionEngine:
             spec["trace_id"] = tid
         with tracing.span("remote_region_scan", region=region_id,
                           addr=self.addr):
+            if tid:
+                # parent linkage: the datanode's region_scan span nests
+                # under THIS span in the merged tree
+                spec["parent_span"] = tracing.current_span_id()
             ticket = fl.Ticket(json.dumps({"region_scan": spec}).encode())
             t = self._rpc("flight.do_get",
                           lambda: self.client.do_get(ticket).read_all())
@@ -830,6 +862,8 @@ class RemoteRegionEngine:
             spec["trace_id"] = tid
         with tracing.span("remote_region_frag", region=region_id,
                           addr=self.addr):
+            if tid:
+                spec["parent_span"] = tracing.current_span_id()
             ticket = fl.Ticket(json.dumps({"region_frag": spec}).encode())
             t = self._rpc("flight.do_get",
                           lambda: self.client.do_get(ticket).read_all())

@@ -121,6 +121,11 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(data)))
+        # W3C egress: echo the request's trace context so the caller
+        # can join its spans to ours (set per traced request in _route)
+        tp = getattr(self, "_traceparent", None)
+        if tp:
+            self.send_header("traceparent", tp)
         self.end_headers()
         self.wfile.write(data)
         route = urllib.parse.urlparse(self.path).path
@@ -144,11 +149,18 @@ class _Handler(BaseHTTPRequestHandler):
         tenant = self.headers.get("X-Greptime-Tenant") \
             or params.get("tenant") \
             or getattr(user, "username", None)
+        from greptimedb_tpu.utils import tracing
+
         return QueryContext(db=params.get("db", "public"),
                             channel=Channel.HTTP,
                             timezone=tz or None,
                             tenant=tenant,
-                            user=user)
+                            user=user,
+                            # the request trace installed by _route's
+                            # ingress span (adopted from an incoming
+                            # traceparent header, or freshly minted) —
+                            # the engine joins the same trace
+                            trace_id=tracing.current_trace_id())
 
     # ---- routing -----------------------------------------------------------
 
@@ -160,6 +172,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _route(self):
         path = urllib.parse.urlparse(self.path).path
+        self._traceparent = None
         try:
             if path == "/health" or path == "/ready":
                 return self._send(200, {})
@@ -169,8 +182,17 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._send(200, PAGE.encode(),
                                   "text/html; charset=utf-8")
             if path == "/metrics":
-                return self._send(200, REGISTRY.render().encode(),
-                                  "text/plain; version=0.0.4")
+                # content negotiation: an OpenMetrics scraper gets the
+                # exemplar-bearing exposition (trace_id exemplars on
+                # histogram buckets + the spec's # EOF), classic
+                # scrapers keep the byte-stable text format
+                om = "application/openmetrics-text" in \
+                    (self.headers.get("Accept") or "")
+                ctype = ("application/openmetrics-text; version=1.0.0; "
+                         "charset=utf-8") if om \
+                    else "text/plain; version=0.0.4"
+                return self._send(
+                    200, REGISTRY.render(openmetrics=om).encode(), ctype)
             if self.user_provider is not None:
                 # Basic auth on every data route (reference
                 # servers/src/http/authorize.rs; /health and /metrics
@@ -190,6 +212,28 @@ class _Handler(BaseHTTPRequestHandler):
                     self.wfile.write(data)
                     HTTP_REQUESTS.inc(path=path, status="401")
                     return
+            from greptimedb_tpu.utils import tracing
+
+            # every data route runs under a request root span: the
+            # incoming W3C traceparent (if any) is adopted so our spans
+            # join the caller's trace, and _send echoes the context back
+            with tracing.request_span(f"http:{path}",
+                                      traceparent=self.headers.get(
+                                          "traceparent")):
+                self._traceparent = tracing.to_traceparent()
+                return self._route_traced(path)
+        except Unavailable as e:
+            # typed degradation (retries + route refresh exhausted): a
+            # 503 the client should back off on, not a stack trace
+            self._send(503, {"code": 5003, "error": str(e),
+                             "execution_time_ms": 0})
+        except Exception as e:  # noqa: BLE001 — wire boundary
+            traceback.print_exc()
+            self._send(400, {"code": 3000, "error": str(e),
+                             "execution_time_ms": 0})
+
+    def _route_traced(self, path: str):
+        try:
             if path.startswith("/debug/pprof/"):
                 # on-demand profiling (reference servers/src/http/pprof.rs
                 # + mem_prof.rs) — folded CPU stacks / tracemalloc heap.
@@ -259,6 +303,30 @@ class _Handler(BaseHTTPRequestHandler):
                     "slow_queries": [r.to_dict()
                                      for r in slow_query.records(n)],
                     "threshold_ms": slow_query.threshold_ms()})
+            if path.startswith("/v1/traces/"):
+                # one trace's span tree by id (auth-gated like
+                # /v1/slow_queries — span attrs carry query shape);
+                # tools/trace_dump.py renders it, and the stage-
+                # histogram exemplars at /metrics point here
+                from greptimedb_tpu.utils import tracing
+
+                tid = path.rsplit("/", 1)[1].lower()
+                # accept the zero-padded 32-hex form our own
+                # traceparent egress emits for internally-minted ids
+                # (same normalization as parse_traceparent)
+                if len(tid) == 32 and tid.startswith("0" * 16):
+                    tid = tid[16:]
+                spans = tracing.spans_for(tid)
+                if not spans:
+                    return self._send(404, {"error": f"no spans for "
+                                                     f"trace {tid!r}"})
+                wire = tracing.spans_to_wire(spans)
+                for w, s in zip(wire, spans):
+                    w["node"] = s.node
+                return self._send(200, {
+                    "trace_id": tid,
+                    "spans": wire,
+                    "tree": tracing.render_tree(spans)})
             if path == "/v1/sql":
                 return self._handle_sql()
             if path == "/v1/promql":

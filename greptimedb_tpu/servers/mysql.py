@@ -314,13 +314,23 @@ def _dispatch(engine: QueryEngine, sql: str, ctx: QueryContext):
             names.append("@@" + name)
             vals.append(_SESSION_VARS.get(name, ""))
         return ("rows", names, [vals])
-    res = engine.execute_one(sql, ctx)
-    if not res.is_query:
-        return ("affected", res.affected_rows)
-    # the QueryResult itself, NOT materialized rows: row building is
-    # the GIL-heaviest half of serialization and belongs on the encode
-    # pool (encode_mysql_result), not the session thread
-    return ("result", res)
+    from greptimedb_tpu.utils import tracing
+
+    # the MySQL wire has no headers: a W3C traceparent rides a leading
+    # SQL comment instead. Each statement is one request-root span; the
+    # connection-scoped ctx adopts the per-statement trace so the
+    # engine (and its spans/ledger) join it.
+    with tracing.request_span(
+            "mysql:query",
+            traceparent=tracing.traceparent_from_sql(sql)):
+        ctx.trace_id = tracing.current_trace_id()
+        res = engine.execute_one(sql, ctx)
+        if not res.is_query:
+            return ("affected", res.affected_rows)
+        # the QueryResult itself, NOT materialized rows: row building is
+        # the GIL-heaviest half of serialization and belongs on the
+        # encode pool (encode_mysql_result), not the session thread
+        return ("result", res)
 
 
 _SESSION_VARS = {

@@ -288,8 +288,17 @@ class _Session(socketserver.BaseRequestHandler):
         if low.startswith(("set ", "begin", "commit", "rollback", "discard")):
             conn.send(b"C", b"SET\x00")
             return
+        from greptimedb_tpu.utils import tracing
+
         try:
-            res = engine.execute_one(sql, QueryContext(db=ctx.db))
+            # header-less wire: a W3C traceparent rides a leading SQL
+            # comment; each statement is one request-root span
+            with tracing.request_span(
+                    "postgres:query",
+                    traceparent=tracing.traceparent_from_sql(sql)):
+                res = engine.execute_one(
+                    sql, QueryContext(db=ctx.db,
+                                      trace_id=tracing.current_trace_id()))
         except Unavailable as e:
             # typed backpressure/degradation: SQLSTATE 53300
             # (too_many_connections) tells drivers to back off —

@@ -547,8 +547,11 @@ class Region:
                     return None
                 return self._decode_table_part(table, ts_range, names)
 
+            from greptimedb_tpu.utils import tracing
+
             live_runs = [run for run in runs if run]
-            futs = [pool.submit(work, run, pf0 if i == 0 else None)
+            run_one = tracing.propagate(work)
+            futs = [pool.submit(run_one, run, pf0 if i == 0 else None)
                     for i, run in enumerate(live_runs)]
             chunks: list = []
             first_err = None
@@ -615,7 +618,12 @@ class Region:
             return self._decode_file_part(meta, ts_range, names,
                                           tag_predicates)
 
-        futs = [pool.submit(work, m) for m in metas]
+        # carry the request's trace/span/ledger context onto the pool
+        # workers: per-file decode (and the objectstore_read spans
+        # inside it) lands in the query's span tree
+        from greptimedb_tpu.utils import tracing
+
+        futs = [pool.submit(tracing.propagate(work), m) for m in metas]
         results: list = []
         first_err = None
         for f in futs:
@@ -684,10 +692,20 @@ class Region:
                     # entries in the budget forever
                     if insert and file_list[i].file_id in self.files:
                         self._part_cache_put(keys[i], ent)
+        from greptimedb_tpu.utils import ledger
+
         if hits:
             SCAN_PART_CACHE_EVENTS.inc(float(hits), event="hit")
+            ledger.cache_event("scan_part", "hit", float(hits))
         if missing:
             SCAN_PART_CACHE_EVENTS.inc(float(len(missing)), event="miss")
+            ledger.cache_event("scan_part", "miss", float(len(missing)))
+            # decode-byte attribution on the request thread (the global
+            # SCAN_DECODE_BYTES inc fires on pool workers, which don't
+            # carry this request's contextvars)
+            ledger.add("bytes_decoded",
+                       float(sum(parts[i].nbytes for i in missing
+                                 if parts[i] is not None)))
         return parts, {
             "part_hits": hits,
             "files_decoded": len(missing),

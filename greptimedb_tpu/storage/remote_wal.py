@@ -37,6 +37,7 @@ logger = logging.getLogger(__name__)
 from greptimedb_tpu.datatypes.recordbatch import RecordBatch
 from greptimedb_tpu.fault import FAULTS, FaultError, retry_call
 from greptimedb_tpu.objectstore import ObjectStore, ObjectStoreError
+from greptimedb_tpu.utils import tracing
 from greptimedb_tpu.storage.wal import WalEntry, _decode_batch, _encode_batch
 
 _HEADER = struct.Struct("<IIQQB")  # payload_len, crc32, region_id, seq, op_type
@@ -125,22 +126,26 @@ class RemoteWal:
         # a torn write here is SAFE to leave in place: segments are
         # separate immutable objects, so a corrupt tail in this one
         # never shadows later acknowledged segments at replay
-        def attempt():
-            try:
-                FAULTS.mangled_write(
-                    "wal.append", blob,
-                    lambda mangled: self.store.write(key, mangled),
-                    # ENOSPC spill: the partial segment lands as a real
-                    # object (the multipart-upload-interrupted shape)...
-                    spill=lambda mangled: self.store.write(key, mangled))
-            except FaultError as e:
-                if e.kind == "enospc":
-                    # ...and must NOT survive: the unacknowledged
-                    # partial's intact leading frames would replay as
-                    # phantom writes on a failover candidate
-                    self._erase_partial(key)
-                raise
-        retry_call(attempt, point="wal.append")
+        with tracing.span("wal_append", region=region_id,
+                          bytes=len(blob), backend="remote"):
+            def attempt():
+                try:
+                    FAULTS.mangled_write(
+                        "wal.append", blob,
+                        lambda mangled: self.store.write(key, mangled),
+                        # ENOSPC spill: the partial segment lands as a
+                        # real object (the multipart-upload-interrupted
+                        # shape)...
+                        spill=lambda mangled: self.store.write(key,
+                                                               mangled))
+                except FaultError as e:
+                    if e.kind == "enospc":
+                        # ...and must NOT survive: the unacknowledged
+                        # partial's intact leading frames would replay
+                        # as phantom writes on a failover candidate
+                        self._erase_partial(key)
+                    raise
+            retry_call(attempt, point="wal.append")
         with self._lock:
             self._seeded(region_id).append((first, last, key))
 
@@ -169,7 +174,12 @@ class RemoteWal:
     def replay(self, region_id: int, from_seq: int = 0) -> Iterator[WalEntry]:
         # transient replay faults retry like the local WAL's; the object
         # reads below carry their own retry at the objectstore seam
-        retry_call(lambda: FAULTS.fire("wal.replay"), point="wal.replay")
+        # (no yield inside the with: the span closes before the
+        # generator can suspend)
+        with tracing.span("wal_replay", region=region_id,
+                          backend="remote"):
+            retry_call(lambda: FAULTS.fire("wal.replay"),
+                       point="wal.replay")
         segs = []
         for key in sorted(self.store.list(self._region_prefix(region_id))):
             try:

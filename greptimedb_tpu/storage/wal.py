@@ -45,6 +45,7 @@ _native = _try_native()
 import pyarrow as pa
 
 from greptimedb_tpu.datatypes.recordbatch import RecordBatch
+from greptimedb_tpu.utils import tracing
 from greptimedb_tpu.datatypes.schema import Schema
 from greptimedb_tpu.fault import FAULTS, FaultError, retry_call
 
@@ -153,34 +154,43 @@ class Wal:
         ticket order, the legacy path under the region lock."""
         _segno, f = self._writer(region_id)
 
-        def sink(mangled: bytes) -> None:
-            f.write(mangled)
-            f.flush()
-            if self.sync:
-                os.fsync(f.fileno())  # ← the durability boundary
-                self.sync_count += 1
+        # span-covered durability boundary: on the serial write path the
+        # append lands in the request's trace; group-commit leaders
+        # record it unattributed (one span per drained group, not per
+        # writer — a leader serves many writers' traces at once)
+        with tracing.span("wal_append", region=region_id,
+                          bytes=len(blob)):
+            def sink(mangled: bytes) -> None:
+                f.write(mangled)
+                f.flush()
+                if self.sync:
+                    os.fsync(f.fileno())  # ← the durability boundary
+                    self.sync_count += 1
 
-        def attempt():
-            start = f.tell()
-            try:
-                # spill=sink: an injected ENOSPC lands its partial bytes
-                # in the file tail first (what a real full disk does to
-                # an append) — the repair below must erase them
-                FAULTS.mangled_write("wal.append", blob, sink, spill=sink)
-            except BaseException:
-                # crash-consistency repair: an append lands whole or not
-                # at all. A partial tail left in place would orphan every
-                # LATER acknowledged frame at replay (replay stops at the
-                # first corrupt frame); a partial ENOSPC tail is the same
-                # shape and takes the same truncate.
+            def attempt():
+                start = f.tell()
                 try:
-                    f.flush()
-                    f.truncate(start)
-                    f.seek(start)
-                except OSError:
-                    pass
-                raise
-        retry_call(attempt, point="wal.append")
+                    # spill=sink: an injected ENOSPC lands its partial
+                    # bytes in the file tail first (what a real full
+                    # disk does to an append) — the repair below must
+                    # erase them
+                    FAULTS.mangled_write("wal.append", blob, sink,
+                                         spill=sink)
+                except BaseException:
+                    # crash-consistency repair: an append lands whole or
+                    # not at all. A partial tail left in place would
+                    # orphan every LATER acknowledged frame at replay
+                    # (replay stops at the first corrupt frame); a
+                    # partial ENOSPC tail is the same shape and takes
+                    # the same truncate.
+                    try:
+                        f.flush()
+                        f.truncate(start)
+                        f.seek(start)
+                    except OSError:
+                        pass
+                    raise
+            retry_call(attempt, point="wal.append")
         if f.tell() >= self.segment_bytes:
             self._roll(region_id)
 
@@ -194,17 +204,22 @@ class Wal:
         self.close_region(region_id)
         segs = self._segments(region_id)
         for i, (segno, path) in enumerate(segs):
-            def read_segment(path=path):
-                with open(path, "rb") as f:
-                    raw = f.read()
-                mangled, _ = FAULTS.mangle("wal.replay", raw)
-                if len(mangled) < len(raw):
-                    # injected short read: surfacing the truncated bytes
-                    # would truncate DURABLE frames below — treat as a
-                    # transient I/O error and re-read
-                    raise FaultError("wal.replay", kind="short_read")
-                return raw
-            data = retry_call(read_segment, point="wal.replay")
+            # the with-block holds no yield: the span closes before the
+            # generator can suspend, so the caller's span-parent context
+            # is never left dangling across a consumption gap
+            with tracing.span("wal_replay_read", region=region_id,
+                              segment=segno):
+                def read_segment(path=path):
+                    with open(path, "rb") as f:
+                        raw = f.read()
+                    mangled, _ = FAULTS.mangle("wal.replay", raw)
+                    if len(mangled) < len(raw):
+                        # injected short read: surfacing the truncated
+                        # bytes would truncate DURABLE frames below —
+                        # treat as a transient I/O error and re-read
+                        raise FaultError("wal.replay", kind="short_read")
+                    return raw
+                data = retry_call(read_segment, point="wal.replay")
             entries = []
             if _native is not None:
                 # one native pass: bounds + checksum + record table

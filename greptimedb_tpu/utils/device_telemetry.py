@@ -26,6 +26,7 @@ from __future__ import annotations
 import threading
 import weakref
 
+from greptimedb_tpu.utils import ledger
 from greptimedb_tpu.utils.metrics import (
     DEVICE_MEMORY,
     DEVICE_TRANSFER_BYTES,
@@ -51,11 +52,13 @@ def register_cache(cache) -> None:
 def count_h2d(nbytes: int) -> None:
     if nbytes:
         DEVICE_TRANSFER_BYTES.inc(float(nbytes), direction="h2d")
+        ledger.add("h2d_bytes", float(nbytes))
 
 
 def count_d2h(nbytes: int) -> None:
     if nbytes:
         DEVICE_TRANSFER_BYTES.inc(float(nbytes), direction="d2h")
+        ledger.add("d2h_bytes", float(nbytes))
 
 
 def _on_event_duration(event: str, duration_secs: float, **kwargs) -> None:

@@ -51,8 +51,16 @@ class Counter:
         with self._lock:
             return dict(self._values)
 
-    def render(self) -> list[str]:
-        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+    def render(self, exemplars: bool = False) -> list[str]:
+        # OpenMetrics family naming: the metric FAMILY drops the _total
+        # suffix while counter samples keep it — a strict OM parser
+        # (modern Prometheus negotiates OM by default) rejects a family
+        # named ..._total. The classic text format keeps the suffixed
+        # name, byte-stable for legacy scrapers.
+        family = self.name
+        if exemplars and family.endswith("_total"):
+            family = family[:-len("_total")]
+        out = [f"# HELP {family} {self.help}", f"# TYPE {family} counter"]
         items = sorted(self._snapshot().items())
         for key, v in items:
             out.append(f"{self.name}{_labels(key)} {v}")
@@ -130,7 +138,7 @@ class Gauge(Counter):
         with self._lock:
             self._values[key] = value
 
-    def render(self) -> list[str]:
+    def render(self, exemplars: bool = False) -> list[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
         with self._lock:
             items = sorted(self._values.items())
@@ -142,7 +150,8 @@ class Gauge(Counter):
 class Histogram:
     BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 60.0)
 
-    def __init__(self, name: str, help_: str, buckets=None):
+    def __init__(self, name: str, help_: str, buckets=None,
+                 exemplars: bool = False):
         self.name = name
         self.help = help_
         if buckets is not None:
@@ -152,9 +161,23 @@ class Histogram:
         self._buckets: dict[tuple, list[int]] = {}
         self._sum: dict[tuple, float] = defaultdict(float)
         self._count: dict[tuple, int] = defaultdict(int)
+        # OpenMetrics exemplars: per (labels, bucket) the most recent
+        # (trace_id, value, ts) — the metrics→trace join (a slow
+        # gtpu_query_stage_seconds bucket links to a trace to pull)
+        self._exemplars_on = exemplars
+        self._exemplar: dict[tuple, tuple] = {}
         self._lock = threading.Lock()
 
     def observe(self, value: float, **labels):
+        tid = None
+        if self._exemplars_on:
+            from greptimedb_tpu.utils import tracing
+
+            # gate on the tracing master switch: with GTPU_TRACING=off
+            # no spans exist, so an exemplar would point at a trace
+            # whose /v1/traces lookup can only 404
+            if tracing.enabled():
+                tid = tracing.current_trace_id()
         key = tuple(sorted(labels.items()))
         with self._lock:
             b = self._buckets.setdefault(key, [0] * (len(self.BUCKETS) + 1))
@@ -163,9 +186,12 @@ class Histogram:
                     b[i] += 1
                     break
             else:
+                i = len(self.BUCKETS)
                 b[-1] += 1
             self._sum[key] += value
             self._count[key] += 1
+            if tid:
+                self._exemplar[(key, i)] = (tid, value, time.time())
 
     def time(self, **labels):
         return _Timer(self, labels)
@@ -180,20 +206,23 @@ class Histogram:
         with self._lock:
             return self._count.get(tuple(sorted(labels.items())), 0)
 
-    def render(self) -> list[str]:
+    def render(self, exemplars: bool = False) -> list[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
         with self._lock:
             snapshot = sorted(
                 (key, list(b), self._sum[key], self._count[key])
                 for key, b in self._buckets.items()
             )
+            ex = dict(self._exemplar) if exemplars else {}
         for key, b, _sum, _count in snapshot:
             cum = 0
             for i, ub in enumerate(self.BUCKETS):
                 cum += b[i]
-                out.append(f"{self.name}_bucket{_labels(key, le=str(ub))} {cum}")
+                out.append(f"{self.name}_bucket{_labels(key, le=str(ub))} "
+                           f"{cum}{_exemplar_suffix(ex.get((key, i)))}")
             cum += b[-1]
-            out.append(f"{self.name}_bucket{_labels(key, le='+Inf')} {cum}")
+            out.append(f"{self.name}_bucket{_labels(key, le='+Inf')} {cum}"
+                       f"{_exemplar_suffix(ex.get((key, len(self.BUCKETS))))}")
             out.append(f"{self.name}_sum{_labels(key)} {_sum}")
             out.append(f"{self.name}_count{_labels(key)} {_count}")
         return out
@@ -210,6 +239,17 @@ class _Timer:
 
     def __exit__(self, *exc):
         self.hist.observe(time.perf_counter() - self.t0, **self.labels)
+
+
+def _exemplar_suffix(ex) -> str:
+    """OpenMetrics exemplar rendering for one bucket line:
+    ` # {trace_id="<id>"} <value> <timestamp>` — omitted (empty string)
+    when no exemplar was captured for that bucket."""
+    if ex is None:
+        return ""
+    tid, value, ts = ex
+    return (f' # {{trace_id="{_escape_label_value(tid)}"}} '
+            f"{value} {round(ts, 3)}")
 
 
 def _escape_label_value(v) -> str:
@@ -270,19 +310,26 @@ class Registry:
             self._metrics.append(m)
         return m
 
-    def histogram(self, name, help_="", buckets=None) -> Histogram:
-        m = Histogram(name, help_, buckets=buckets)
+    def histogram(self, name, help_="", buckets=None,
+                  exemplars: bool = False) -> Histogram:
+        m = Histogram(name, help_, buckets=buckets, exemplars=exemplars)
         with self._lock:
             self._metrics.append(m)
         return m
 
-    def render(self) -> str:
+    def render(self, openmetrics: bool = False) -> str:
+        """Exposition text. `openmetrics=True` (the scraper sent
+        Accept: application/openmetrics-text) adds exemplar suffixes to
+        histogram bucket lines and the spec's `# EOF` terminator; the
+        classic text format stays byte-stable for legacy parsers."""
         self._collect()
         with self._lock:
             metrics = list(self._metrics)
         lines = []
         for m in metrics:
-            lines.extend(m.render())
+            lines.extend(m.render(exemplars=openmetrics))
+        if openmetrics:
+            lines.append("# EOF")
         return "\n".join(lines) + "\n"
 
     def _iter_samples(self):
@@ -324,7 +371,8 @@ HTTP_REQUESTS = REGISTRY.sharded_counter(
     "greptimedb_tpu_http_requests_total",
     "HTTP requests by path and status")
 QUERY_DURATION = REGISTRY.histogram("greptimedb_tpu_query_duration_seconds",
-                                    "Query execution latency")
+                                    "Query execution latency",
+                                    exemplars=True)
 INGEST_ROWS = REGISTRY.sharded_counter(
     "greptimedb_tpu_ingest_rows_total",
     "Rows ingested by protocol")
@@ -527,7 +575,9 @@ STAGE_SECONDS = REGISTRY.histogram(
     "plan-cache lookup + substitution probe + plan_select / execute on "
     "the slow lane; fast_bind / fast_execute on the fast lane) — with "
     "admission_wait_seconds and encode_seconds this makes the QPS "
-    "breakdown attributable per stage instead of inferred")
+    "breakdown attributable per stage instead of inferred; buckets "
+    "carry OpenMetrics trace_id exemplars — a slow bucket links "
+    "straight to a trace to pull via /v1/traces/<id>", exemplars=True)
 COUNTER_SHARDS = REGISTRY.gauge(
     "greptimedb_tpu_metrics_counter_shards",
     "Live per-thread shard cells across all sharded hot counters "

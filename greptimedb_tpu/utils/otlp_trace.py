@@ -1,0 +1,354 @@
+"""OTLP/HTTP trace export: completed spans leave the process.
+
+The span ring (utils/tracing.py) answers "what happened recently on
+THIS node"; fleet operators want the same trees in their tracing
+backend (Jaeger/Tempo/anything OTLP). A bounded-queue background
+exporter drains completed spans into OTLP/HTTP **JSON**
+(`/v1/traces` ExportTraceServiceRequest) — no protobuf dependency, and
+the payload builder is a pure function the golden-payload test pins.
+
+Sampling is two-sided:
+
+- **head**: a deterministic per-trace hash against `sample_ratio`
+  decides at record time whether a trace's spans enter the queue;
+- **tail keep**: spans from unsampled traces park in a bounded
+  lookback ring, and `mark_keep(trace_id)` — called by the slow-query
+  log for every slow or failed statement — promotes them after the
+  fact, so the traces worth keeping survive even at 1% head sampling.
+
+Failure contract: the exporter must NEVER impact a query. Enqueue past
+the bound drops (counted), a dead endpoint counts `failed` and moves
+on (log-throttled), and the chaos point `otlp.export` injects exactly
+that failure in tests. Health is observable at /metrics:
+`otlp_trace_queue_depth` + `otlp_trace_spans_total{event=...}`.
+
+Configuration: `[tracing]` options (options.apply_observability) write
+the `GTPU_OTLP_*` env knobs this module reads via `maybe_install()` —
+env-is-truth layering, so child datanode processes inherit the
+operator's endpoint and export their own spans too.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+import urllib.request
+import zlib
+from collections import OrderedDict, deque
+from typing import Optional
+
+from greptimedb_tpu.utils.metrics import REGISTRY
+
+OTLP_TRACE_SPANS = REGISTRY.counter(
+    "greptimedb_tpu_otlp_trace_spans_total",
+    "OTLP trace exporter span outcomes by event (exported = delivered, "
+    "dropped = bounded queue was full, failed = endpoint error after "
+    "the span was queued, kept = promoted from the unsampled lookback "
+    "ring by a tail-based keep — slow/failed statements)")
+OTLP_TRACE_QUEUE_DEPTH = REGISTRY.gauge(
+    "greptimedb_tpu_otlp_trace_queue_depth",
+    "Spans waiting in the bounded OTLP exporter queue")
+OTLP_TRACE_EXPORTS = REGISTRY.counter(
+    "greptimedb_tpu_otlp_trace_exports_total",
+    "OTLP export batches by outcome (ok/error)")
+
+_log = logging.getLogger("greptimedb_tpu.otlp_trace")
+
+#: traces remembered as keep-worthy / recently-decided (bounded)
+_KEEP_CAP = 512
+_LOOKBACK_CAP = 2048
+
+
+def _span_otlp(s) -> dict:
+    """One tracing.Span -> OTLP JSON span."""
+    start_ns = int(s.started_at * 1e9)
+    out = {
+        "traceId": (s.trace_id or "").rjust(32, "0"),
+        "spanId": (s.span_id or "").rjust(16, "0"),
+        "name": s.name,
+        "kind": 1,  # SPAN_KIND_INTERNAL
+        "startTimeUnixNano": str(start_ns),
+        "endTimeUnixNano": str(start_ns + int(s.duration_ms * 1e6)),
+        "attributes": [
+            {"key": str(k), "value": _attr_value(v)}
+            for k, v in s.attrs.items()
+        ],
+    }
+    if s.parent_id:
+        out["parentSpanId"] = s.parent_id.rjust(16, "0")
+    if s.node:
+        out["attributes"].append(
+            {"key": "gtpu.node", "value": {"stringValue": str(s.node)}})
+    return out
+
+
+def _attr_value(v) -> dict:
+    if isinstance(v, bool):  # before int: bool subclasses int
+        return {"boolValue": v}
+    if isinstance(v, int):
+        return {"intValue": str(v)}  # proto3 JSON maps int64 to string
+    if isinstance(v, float):
+        return {"doubleValue": v}
+    return {"stringValue": str(v)}
+
+
+def payload(spans, service_name: str = "greptimedb_tpu",
+            node: Optional[str] = None) -> dict:
+    """ExportTraceServiceRequest JSON for one batch — pure, so the
+    golden-payload test pins the wire shape without a live endpoint."""
+    resource_attrs = [
+        {"key": "service.name", "value": {"stringValue": service_name}},
+    ]
+    if node:
+        resource_attrs.append(
+            {"key": "service.instance.id", "value": {"stringValue": node}})
+    return {
+        "resourceSpans": [{
+            "resource": {"attributes": resource_attrs},
+            "scopeSpans": [{
+                "scope": {"name": "greptimedb_tpu.tracing"},
+                "spans": [_span_otlp(s) for s in spans],
+            }],
+        }],
+    }
+
+
+def _sampled(trace_id: str, ratio: float) -> bool:
+    """Deterministic head sampling: the same trace decides the same way
+    on every node (crc32 over the id, uniform in [0, 1))."""
+    if ratio >= 1.0:
+        return True
+    if ratio <= 0.0:
+        return False
+    return (zlib.crc32(trace_id.encode()) & 0xFFFFFFFF) / 2**32 < ratio
+
+
+class OtlpTraceExporter:
+    """Bounded-queue background exporter. Thread starts lazily on the
+    first enqueued span; `flush()` is for tests and shutdown."""
+
+    def __init__(self, endpoint: str, sample_ratio: float = 1.0,
+                 queue_size: int = 2048, batch: int = 256,
+                 flush_interval_s: float = 2.0, timeout_s: float = 5.0,
+                 node: Optional[str] = None):
+        self.endpoint = endpoint.rstrip("/")
+        if not self.endpoint.endswith("/v1/traces"):
+            self.endpoint += "/v1/traces"
+        self.sample_ratio = float(sample_ratio)
+        self.queue_size = int(queue_size)
+        self.batch = int(batch)
+        self.flush_interval_s = float(flush_interval_s)
+        self.timeout_s = float(timeout_s)
+        self.node = node or os.environ.get("GTPU_NODE_ID") or ""
+        self._q: deque = deque()
+        self._cv = threading.Condition()
+        self._busy = 0          # spans taken off the queue, not yet posted
+        self._keep: "OrderedDict[str, bool]" = OrderedDict()
+        self._lookback: deque = deque(maxlen=_LOOKBACK_CAP)
+        self._thread: Optional[threading.Thread] = None
+        self._stop = False
+        self._fail_streak = 0
+
+    # -- producer side (called from tracing._record; must never raise) -------
+
+    def on_span(self, span) -> None:
+        try:
+            tid = span.trace_id
+            if not tid:
+                return  # background spans outside any request trace
+            with self._cv:
+                keep = tid in self._keep
+            if keep or _sampled(tid, self.sample_ratio):
+                self._enqueue([span])
+            else:
+                self._lookback.append(span)
+        except Exception:  # noqa: BLE001 — telemetry must never hurt a query
+            pass
+
+    def mark_keep(self, trace_id: str) -> None:
+        """Tail-based keep: promote an unsampled trace (the slow-query
+        ring calls this for every slow or failed statement) — its parked
+        spans enter the queue, and spans still being recorded follow."""
+        if not trace_id:
+            return
+        try:
+            with self._cv:
+                already = trace_id in self._keep
+                self._keep[trace_id] = True
+                while len(self._keep) > _KEEP_CAP:
+                    self._keep.popitem(last=False)
+            if already or self.sample_ratio >= 1.0:
+                return
+            promoted = [s for s in list(self._lookback)
+                        if s.trace_id == trace_id]
+            if promoted:
+                OTLP_TRACE_SPANS.inc(float(len(promoted)), event="kept")
+                self._enqueue(promoted)
+        except Exception:  # noqa: BLE001 — telemetry must never hurt a query
+            pass
+
+    def _enqueue(self, spans) -> None:
+        with self._cv:
+            for s in spans:
+                if len(self._q) >= self.queue_size:
+                    OTLP_TRACE_SPANS.inc(event="dropped")
+                    continue
+                self._q.append(s)
+            OTLP_TRACE_QUEUE_DEPTH.set(float(len(self._q)))
+            if self._thread is None and not self._stop:
+                self._thread = threading.Thread(
+                    target=self._run, name="gtpu-otlp-export", daemon=True)
+                self._thread.start()
+            self._cv.notify_all()
+
+    # -- worker side ----------------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                # idle: block untimed — producers notify on enqueue and
+                # flush/shutdown notify too, so there is no 20 Hz
+                # wakeup loop on a quiet node
+                while not self._stop and not self._q:
+                    self._cv.wait()
+                if self._stop and not self._q:
+                    return
+                # batch-accumulation window: give a bursting producer
+                # up to flush_interval_s to fill the batch
+                deadline = time.monotonic() + self.flush_interval_s
+                while not self._stop and len(self._q) < self.batch:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._cv.wait(remaining)
+                chunk = [self._q.popleft()
+                         for _ in range(min(self.batch, len(self._q)))]
+                self._busy = len(chunk)
+                OTLP_TRACE_QUEUE_DEPTH.set(float(len(self._q)))
+            if chunk:
+                self._post(chunk)
+            with self._cv:
+                self._busy = 0
+                self._cv.notify_all()
+
+    def _post(self, spans) -> None:
+        from greptimedb_tpu.fault import FAULTS
+
+        try:
+            # serialization INSIDE the guard: a surprise in one span's
+            # attrs must count as a failed batch, never kill the worker
+            # thread (it is the only one; _enqueue never respawns it)
+            body = json.dumps(payload(spans, node=self.node)).encode()
+            # chaos seam: the fault-injected-endpoint test arms this to
+            # prove typed degradation (counted, logged, zero query
+            # impact) without standing up a broken collector
+            FAULTS.fire("otlp.export")
+            req = urllib.request.Request(
+                self.endpoint, data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=self.timeout_s):
+                pass
+        except Exception as e:  # noqa: BLE001 — export must degrade, not raise
+            OTLP_TRACE_SPANS.inc(float(len(spans)), event="failed")
+            OTLP_TRACE_EXPORTS.inc(event="error")
+            self._fail_streak += 1
+            if self._fail_streak == 1 or self._fail_streak % 100 == 0:
+                _log.warning("OTLP trace export to %s failing (streak %d): %s",
+                             self.endpoint, self._fail_streak, e)
+            return
+        self._fail_streak = 0
+        OTLP_TRACE_SPANS.inc(float(len(spans)), event="exported")
+        OTLP_TRACE_EXPORTS.inc(event="ok")
+
+    def flush(self, timeout_s: float = 5.0) -> bool:
+        """Block until the queue drains (tests / shutdown)."""
+        deadline = time.monotonic() + timeout_s
+        with self._cv:
+            self._cv.notify_all()
+            while self._q or self._busy:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cv.wait(min(remaining, 0.1))
+        return True
+
+    def shutdown(self, timeout_s: float = 2.0) -> None:
+        self.flush(timeout_s)
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=timeout_s)
+
+    def depth(self) -> int:
+        with self._cv:
+            return len(self._q)
+
+
+# ---- module-level wiring ----------------------------------------------------
+
+_EXPORTER: Optional[OtlpTraceExporter] = None
+_install_lock = threading.Lock()
+
+
+def exporter() -> Optional[OtlpTraceExporter]:
+    return _EXPORTER
+
+
+def configure(endpoint: Optional[str], **kwargs) -> Optional[OtlpTraceExporter]:
+    """Install (endpoint set) or tear down (empty/None) the process
+    exporter and hand it to tracing's span-completion hook."""
+    global _EXPORTER
+    from greptimedb_tpu.utils import tracing
+
+    with _install_lock:
+        old, _EXPORTER = _EXPORTER, None
+        tracing._exporter = None
+        if old is not None:
+            old.shutdown(timeout_s=0.5)
+        if endpoint:
+            _EXPORTER = OtlpTraceExporter(endpoint, **kwargs)
+            tracing._exporter = _EXPORTER
+        return _EXPORTER
+
+
+def maybe_install() -> Optional[OtlpTraceExporter]:
+    """Env-driven install (GTPU_OTLP_ENDPOINT + GTPU_OTLP_* knobs) —
+    idempotent; called by apply_observability and datanode bootstrap so
+    every process in a cluster exports under one configuration. Any
+    changed knob (not just the endpoint) reinstalls the exporter."""
+    endpoint = os.environ.get("GTPU_OTLP_ENDPOINT", "")
+    cur = _EXPORTER
+    if not endpoint:
+        if cur is not None:
+            configure(None)
+        return None
+
+    def _f(name, default):
+        try:
+            return float(os.environ.get(name, default))
+        except ValueError:
+            return default
+
+    cfg = (endpoint.rstrip("/"),
+           _f("GTPU_OTLP_SAMPLE_RATIO", 1.0),
+           int(_f("GTPU_OTLP_QUEUE", 2048)),
+           _f("GTPU_OTLP_FLUSH_S", 2.0))
+    if cur is not None and getattr(cur, "_env_cfg", None) == cfg:
+        return cur
+    exp = configure(cfg[0], sample_ratio=cfg[1], queue_size=cfg[2],
+                    flush_interval_s=cfg[3])
+    if exp is not None:
+        exp._env_cfg = cfg
+    return exp
+
+
+def mark_keep(trace_id: str) -> None:
+    """Module-level tail-keep hook (slow_query imports this lazily)."""
+    exp = _EXPORTER
+    if exp is not None:
+        exp.mark_keep(trace_id)

@@ -30,7 +30,7 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Optional
 
-from greptimedb_tpu.utils import tracing
+from greptimedb_tpu.utils import ledger, tracing
 from greptimedb_tpu.utils.metrics import SLOW_QUERIES
 
 #: default threshold (ms); the reference defaults its slow-query timer on
@@ -93,6 +93,9 @@ class SlowQuery:
     #: show up here instead of just being slow
     plan_cache_skip: Optional[str] = None
     stages: list = field(default_factory=list)  # (node, name, ms) triples
+    #: the statement's slice of the per-query resource ledger (cache
+    #: hits, H2D bytes, admission wait, rows scanned — utils/ledger.py)
+    ledger: dict = field(default_factory=dict)
 
     def to_dict(self) -> dict:
         return {
@@ -107,6 +110,7 @@ class SlowQuery:
                 {"node": n, "stage": s, "duration_ms": round(d, 3)}
                 for n, s, d in self.stages
             ],
+            "ledger": dict(self.ledger),
         }
 
 
@@ -159,19 +163,28 @@ def watch(kind: str, query: str, db: str = "public"):
     started = time.time()
     t0 = time.perf_counter()
     try:
-        with tracing.collect_spans() as sink:
-            yield w
+        # the statement's resource-ledger slice: attach one if the
+        # server didn't (direct engine callers), and diff around the
+        # run so multi-statement requests attribute per statement
+        with ledger.attach() as led:
+            led0 = led.snapshot() if led is not None else {}
+            with tracing.collect_spans() as sink:
+                yield w
     finally:
         _active.reset(token)
         _current.reset(w_token)
         dur_ms = (time.perf_counter() - t0) * 1000.0
         if dur_ms >= thr:
-            _record(kind, query, db, dur_ms, thr, w, started, sink)
+            led_slice = ledger.diff(led0, led.snapshot()) \
+                if led is not None else {}
+            _record(kind, query, db, dur_ms, thr, w, started, sink,
+                    led_slice)
         if prev_tid is None:
             tracing.restore_trace(None)
 
 
-def _record(kind, query, db, dur_ms, thr, w, started, sink) -> None:
+def _record(kind, query, db, dur_ms, thr, w, started, sink,
+            led_slice=None) -> None:
     rec = SlowQuery(
         trace_id=tracing.current_trace_id() or "-",
         kind=kind, query=query[:4096], db=db,
@@ -179,10 +192,16 @@ def _record(kind, query, db, dur_ms, thr, w, started, sink) -> None:
         execution_path=w.execution_path,
         plan_cache_skip=w.plan_cache_skip, started_at=started,
         stages=[(s.node or "local", s.name, s.duration_ms) for s in sink],
+        ledger=led_slice or {},
     )
     with _lock:
         _ring.append(rec)
     SLOW_QUERIES.inc(kind=kind)
+    # tail-based keep: a slow (or slow-failing) statement's trace is
+    # worth exporting even when head sampling passed on it
+    from greptimedb_tpu.utils import otlp_trace
+
+    otlp_trace.mark_keep(rec.trace_id if rec.trace_id != "-" else "")
     import logging
 
     # log a bounded prefix: a multi-thousand-row INSERT VALUES is tens

@@ -69,6 +69,46 @@ def test_explain_analyze_shows_datanode_spans(cluster):
     assert "rows=" in section, text
 
 
+def test_analyze_tree_nests_across_the_flight_hop(cluster):
+    """Acceptance (ISSUE 15): the datanode's region_scan span carries
+    parent linkage through the Flight piggyback and re-parents under
+    the frontend span that issued the RPC — the merged ANALYZE output
+    renders one nested tree across the process hop, not flat per-node
+    sections."""
+    from greptimedb_tpu.utils import tracing
+
+    cluster.beat_all(time.time() * 1000)
+    cluster.sql(CREATE)
+    cluster.sql("INSERT INTO m VALUES ('a', 1.0, 1000), ('b', 2.0, 2000)")
+    r = cluster.sql("EXPLAIN ANALYZE SELECT host, v FROM m ORDER BY host")
+    lines = [row[0] for row in r.rows()]
+    text = "\n".join(lines)
+    tid = next(ln for ln in lines if "ANALYZE trace=" in ln) \
+        .split("trace=")[1].split(" ")[0]
+    spans = tracing.spans_for(tid)
+    remote = [s for s in spans if s.node is not None
+              and s.name == "region_scan"]
+    assert remote, text
+    by_id = {s.span_id: s for s in spans if s.span_id}
+    for s in remote:
+        # span-id linkage: the child process's scan hangs off the
+        # frontend's remote_region_scan span
+        assert s.parent_id in by_id, text
+        assert by_id[s.parent_id].name == "remote_region_scan"
+    # and the rendering nests: the [dn-N] marker + region_scan line are
+    # indented deeper than the frontend span that owns them
+    dn_line = next(ln for ln in lines if ln.strip().startswith("[dn-"))
+    rrs_line = next(ln for ln in lines if "remote_region_scan" in ln)
+    scan_line = next(ln for ln in lines
+                     if "region_scan" in ln and "remote" not in ln)
+    def indent(ln):
+        return len(ln) - len(ln.lstrip())
+    assert indent(scan_line) > indent(rrs_line)
+    assert indent(dn_line) == indent(scan_line)
+    # parents with children report self-time
+    assert "(self " in rrs_line, text
+
+
 def test_kill9_failover_replays_remote_wal(cluster):
     """kill -9 the owning datanode with UNFLUSHED writes; failover must
     reopen the region on the survivor and replay them from the shared

@@ -8,7 +8,12 @@ every metric registered on the process-wide REGISTRY must
   consumer gets), and
 - appear in grafana/greptimedb_tpu.json (a metric nobody charts is a
   metric nobody watches; the dashboard ships with the repo like the
-  reference's grafana/greptimedb.json).
+  reference's grafana/greptimedb.json), and
+- render a syntactically valid OpenMetrics exposition: the
+  exemplar-bearing variant (`REGISTRY.render(openmetrics=True)`) must
+  carry well-formed `# {trace_id="..."} value [ts]` suffixes on
+  histogram `_bucket` lines ONLY, and terminate with `# EOF` — a
+  malformed exemplar corrupts the whole scrape for OpenMetrics parsers.
 
 Exit code 0 = clean; 1 = violations (printed one per line).
 """
@@ -17,9 +22,15 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import sys
 
 PREFIX = "greptimedb_tpu_"
+
+#: OpenMetrics exemplar suffix: ` # {label="value"} value [timestamp]`
+EXEMPLAR_RE = re.compile(
+    r'^ # \{[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\\n]|\\["\\n])*"\}'
+    r" -?[0-9.eE+-]+( [0-9.]+)?$")
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DASHBOARD = os.path.join(REPO_ROOT, "grafana", "greptimedb_tpu.json")
 
@@ -28,6 +39,7 @@ DASHBOARD = os.path.join(REPO_ROOT, "grafana", "greptimedb_tpu.json")
 #: imported so the lint sees the full surface, not just utils.metrics
 METRIC_MODULES = (
     "greptimedb_tpu.utils.metrics",
+    "greptimedb_tpu.utils.otlp_trace",
     "greptimedb_tpu.objectstore",
     "greptimedb_tpu.servers.otlp",
     "greptimedb_tpu.servers.prom_store",
@@ -46,6 +58,28 @@ def registered_metrics():
     from greptimedb_tpu.utils.metrics import REGISTRY
 
     return list(REGISTRY._metrics)
+
+
+def check_exemplars(exposition: str) -> list[str]:
+    """Validate the OpenMetrics render: exemplars only on `_bucket`
+    sample lines, each matching the spec's `# {labels} value [ts]`
+    shape, and the exposition terminated by `# EOF`."""
+    problems = []
+    lines = exposition.rstrip("\n").split("\n")
+    if not lines or lines[-1] != "# EOF":
+        problems.append("openmetrics exposition missing '# EOF' terminator")
+    for line in lines:
+        if line.startswith("#") or " # " not in line:
+            continue
+        sample, suffix = line.split(" # ", 1)
+        name = sample.split("{")[0].split(" ")[0]
+        if not name.endswith("_bucket"):
+            problems.append(
+                f"exemplar on a non-bucket line ({name}): OpenMetrics "
+                "allows exemplars on histogram buckets only here")
+        if not EXEMPLAR_RE.match(" # " + suffix):
+            problems.append(f"malformed exemplar syntax: {line!r}")
+    return problems
 
 
 def check(metrics, dashboard_text: str) -> list[str]:
@@ -72,6 +106,9 @@ def main() -> int:
         dashboard_text = f.read()
     json.loads(dashboard_text)  # the dashboard must at least be valid JSON
     problems = check(registered_metrics(), dashboard_text)
+    from greptimedb_tpu.utils.metrics import REGISTRY
+
+    problems += check_exemplars(REGISTRY.render(openmetrics=True))
     for p in problems:
         print(f"check_metrics: {p}")
     if problems:

@@ -1,0 +1,69 @@
+#!/usr/bin/env python
+"""Fetch and render one trace's span tree from a running server.
+
+    python tools/trace_dump.py <trace_id> [--addr HOST:PORT]
+                               [--user U --password P] [--json]
+
+The id comes from anywhere the plane surfaces one: an EXPLAIN ANALYZE
+header (`ANALYZE trace=...`), a `trace_id=` log line, a slow-query
+record, or a `gtpu_query_stage_seconds` bucket exemplar at /metrics —
+this tool closes the loop by pulling `GET /v1/traces/<id>` (auth-gated
+like /v1/slow_queries) and printing the nested tree with self-time.
+
+Exit code 0 = rendered; 2 = trace not found (evicted from the bounded
+ring, or never recorded on this node); 1 = transport/auth error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import sys
+import urllib.error
+import urllib.request
+
+
+def fetch(addr: str, trace_id: str, user: str = "",
+          password: str = "") -> dict:
+    req = urllib.request.Request(f"http://{addr}/v1/traces/{trace_id}")
+    if user:
+        cred = base64.b64encode(f"{user}:{password}".encode()).decode()
+        req.add_header("Authorization", f"Basic {cred}")
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("trace_id")
+    ap.add_argument("--addr", default="127.0.0.1:4000",
+                    help="HTTP address (default 127.0.0.1:4000)")
+    ap.add_argument("--user", default="")
+    ap.add_argument("--password", default="")
+    ap.add_argument("--json", action="store_true",
+                    help="raw span records instead of the rendered tree")
+    args = ap.parse_args()
+    try:
+        out = fetch(args.addr, args.trace_id, args.user, args.password)
+    except urllib.error.HTTPError as e:
+        if e.code == 404:
+            print(f"trace {args.trace_id!r} not found on {args.addr} "
+                  "(evicted from the span ring, or recorded elsewhere)")
+            return 2
+        print(f"HTTP {e.code} from {args.addr}: {e.reason}")
+        return 1
+    except OSError as e:
+        print(f"cannot reach {args.addr}: {e}")
+        return 1
+    if args.json:
+        print(json.dumps(out["spans"], indent=2))
+        return 0
+    print(f"trace {out['trace_id']} ({len(out['spans'])} spans)")
+    for line in out["tree"]:
+        print(line)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
