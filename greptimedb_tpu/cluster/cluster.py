@@ -51,11 +51,14 @@ def _recoverable(e: BaseException, region_id: int) -> bool:
     must surface), injected or self-described transient failures, and
     Flight transport errors after the client's own retries are
     exhausted."""
-    if isinstance(e, KeyError):
-        # every ownership-contract KeyError (engine "region N not open",
-        # router "no route for region N" / "region N has no live
-        # datanode") names the region with this exact phrase; a KeyError
-        # about anything else (a column, a dict key) does not
+    if isinstance(e, KeyError) or (isinstance(e, Unavailable)
+                                   and e.cause is None):
+        # every ownership-contract error (engine "region N not open",
+        # router "no route for region N", the typed "region N has no
+        # live datanode" Unavailable) names the region with this exact
+        # phrase; a KeyError about anything else (a column, a dict key)
+        # does not, and a cause-carrying Unavailable is already the
+        # terminal verdict of a refresh-and-retry loop
         return f"region {region_id}" in str(e)
     if isinstance(e, FaultError) or is_transient(e):
         return True
@@ -226,7 +229,12 @@ class RegionRouter:
             node = self._region_node.get(region_id)
             dn = self.datanodes[node] if node else None
             if dn is None or not dn.alive:
-                raise KeyError(f"region {region_id} has no live datanode")
+                # transient by contract: the leader died and failover
+                # has not landed yet — typed so clients retry, never a
+                # bare KeyError escaping the routing table
+                raise Unavailable(
+                    f"region {region_id} has no live datanode "
+                    f"(failover pending)")
         return dn.data_engine()
 
     # --- RegionEngine surface used by QueryEngine ---
@@ -405,7 +413,7 @@ class RegionRouter:
 
         try:
             eng = self._engine_for(region_id)
-        except KeyError:
+        except (KeyError, Unavailable):
             eng = None  # no route, or no live datanode: metadata-only drop
         if eng is not None:
             try:

@@ -27,7 +27,7 @@ from typing import Optional
 
 from ..catalog.catalog import Catalog
 from ..catalog.kv import KvBackend, MemoryKv
-from ..fault import FAULTS, FaultError
+from ..fault import FAULTS, FaultError, Unavailable
 from ..meta.instruction import Instruction, InstructionKind
 from ..meta.metasrv import HeartbeatRequest, Metasrv, MetasrvOptions
 from ..query.engine import QueryContext, QueryEngine
@@ -210,7 +210,19 @@ class ProcessCluster:
                                      node_id),
                                  now_ms=now_ms))
             for inst in resp.instructions:
-                self._apply(dn, inst)
+                try:
+                    self._apply(dn, inst)
+                except Exception as e:  # noqa: BLE001 — classified below
+                    typed = isinstance(e, (FaultError, Unavailable)) \
+                        or "Flight" in type(e).__name__
+                    requeue = getattr(target, "send_instruction", None)
+                    if not typed or requeue is None:
+                        raise
+                    # the mailbox contract is redeliver-until-applied: a
+                    # chaos fault mid-delivery (WAL replay dying inside
+                    # an OpenRegion) must NOT drop the instruction, or
+                    # the region stays routed-but-closed forever
+                    requeue(node_id, inst)
 
     def _apply(self, dn: ProcDatanode, inst: Instruction) -> None:
         from ..storage.engine import RegionRequest, RequestType

@@ -43,6 +43,12 @@ registry holds partition state installable without arming a schedule:
     GTPU_CHAOS="partition=frontend<->dn-1"            # same, via env
     GTPU_CHAOS="heartbeat.send=fail,@edge:dn-1->metasrv-0"  # asymmetric
 
+Partitions may carry a call-count WINDOW so install/heal timing lives
+in the same deterministic call-space as nth schedules (the chaos
+explorer samples these): `partition=a<->b,nth:3,times:5` drops calls
+3..7 on each cut direction independently, then heals itself. Without a
+window the cut is permanent until heal_partition()/reset().
+
 (coordinator-bound edges name the metasrv's real node id — default
 "metasrv-0" — so HA runs can cut a node from ONE metasrv peer)
 
@@ -61,6 +67,7 @@ is counted in `greptimedb_tpu_fault_injections_total{point,kind}`
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
 import time
@@ -118,6 +125,18 @@ EDGE_POINTS = frozenset({
 #: fault kinds a schedule can produce ("partition" is registry state,
 #: not an armable schedule kind — see install_partition)
 KINDS = frozenset({"fail", "latency", "torn", "short_read", "enospc"})
+
+_LOG = logging.getLogger("greptimedb_tpu.fault")
+
+
+def _log_throttle_s() -> float:
+    """Per-(point, kind) minimum spacing of injection log lines —
+    chaos schedules can fire thousands of times a second and the log
+    must stay readable (GTPU_CHAOS_LOG_THROTTLE_S overrides)."""
+    try:
+        return float(os.environ.get("GTPU_CHAOS_LOG_THROTTLE_S", "1.0"))
+    except ValueError:
+        return 1.0
 
 
 def chaos_seed() -> int:
@@ -246,14 +265,19 @@ class FaultRegistry:
 
     def __init__(self):
         self._points: dict[str, Fault] = {}
-        #: installed network partitions: directed (src, dst) edges every
-        #: EDGE_POINTS call is checked against, armed schedule or not
-        self._partitions: set = set()
+        #: installed network partitions: directed (src, dst) edge →
+        #: optional call-count window ({"nth", "times", "calls"}, None =
+        #: permanent) every EDGE_POINTS call is checked against, armed
+        #: schedule or not
+        self._partitions: dict = {}
         #: cluster topology registered by the harnesses — when non-empty,
         #: edge/@node specs naming an unknown node fail at arm time (the
         #: typo guard that matches the canonical-point check)
         self._known_nodes: set = set()
         self._lock = threading.Lock()
+        #: last injection log timestamp per (point, kind) — see
+        #: _log_injection
+        self._log_last: dict = {}
 
     # ---- topology -----------------------------------------------------------
 
@@ -272,25 +296,40 @@ class FaultRegistry:
 
     # ---- partitions ----------------------------------------------------------
 
-    def install_partition(self, a: str, b: str,
-                          symmetric: bool = True) -> None:
+    def install_partition(self, a: str, b: str, symmetric: bool = True,
+                          nth: Optional[int] = None,
+                          times: int = 1) -> None:
         """Sever the network between two nodes: every EDGE_POINTS call
         whose (src, dst) crosses the cut raises a transient
         FaultError(kind="partition"). Symmetric by default; pass
-        symmetric=False to cut only the a→b direction."""
+        symmetric=False to cut only the a→b direction.
+
+        With `nth` the cut is WINDOWED: only calls nth..nth+times-1 on
+        the edge drop (each direction counts its own calls), after which
+        the cut self-heals — install/heal timing expressed in the same
+        deterministic call-space as nth fault schedules."""
         for n in (a, b):
             self._check_node(n, "install_partition")
+        window = None
+        if nth is not None:
+            if nth < 1 or times < 1:
+                raise ValueError(
+                    f"bad partition window nth:{nth},times:{times} "
+                    "(nth and times are 1-based counts)")
+            window = {"nth": nth, "times": times}
         with self._lock:
-            self._partitions.add((a, b))
+            self._partitions[(a, b)] = \
+                dict(window, calls=0) if window else None
             if symmetric:
-                self._partitions.add((b, a))
+                self._partitions[(b, a)] = \
+                    dict(window, calls=0) if window else None
 
     def heal_partition(self, a: str, b: str,
                        symmetric: bool = True) -> None:
         with self._lock:
-            self._partitions.discard((a, b))
+            self._partitions.pop((a, b), None)
             if symmetric:
-                self._partitions.discard((b, a))
+                self._partitions.pop((b, a), None)
 
     def heal_partitions(self) -> None:
         with self._lock:
@@ -315,8 +354,14 @@ class FaultRegistry:
             for a, b in fault.edges:
                 self._check_node(a, f"@edge on {point}")
                 self._check_node(b, f"@edge on {point}")
-        if fault.match and "node" in fault.match:
-            self._check_node(fault.match["node"], f"@node on {point}")
+        if fault.match:
+            # every node-valued matcher key is topology-checked — a
+            # typo'd @node/@src/@dst would otherwise never fire and
+            # silently green the run
+            for key in ("node", "src", "dst"):
+                if key in fault.match:
+                    self._check_node(fault.match[key],
+                                     f"@{key} on {point}")
         if fault.seed is None:
             # default seeding decorrelates points (crc32, stable across
             # processes — hash() is salted) while staying replayable
@@ -364,11 +409,32 @@ class FaultRegistry:
                 })
             return out
 
+    def fingerprint(self) -> dict:
+        """Canonical armed-state snapshot (schedules + partitions, call
+        counters excluded) for schedule-equality checks — the repro
+        round-trip contract: `arm_from_env(repro's GTPU_CHAOS)` on a
+        fresh registry must produce an identical fingerprint."""
+        with self._lock:
+            points = {}
+            for point, f in sorted(self._points.items()):
+                points[point] = {
+                    "kind": f.kind, "arg": f.arg, "nth": f.nth,
+                    "times": f.times, "prob": f.prob, "seed": f.seed,
+                    "match": dict(f.match) if f.match else {},
+                    "edges": sorted(f"{a}->{b}" for a, b in f.edges)
+                    if f.edges else [],
+                }
+            parts = {}
+            for (a, b), window in sorted(self._partitions.items()):
+                parts[f"{a}->{b}"] = None if window is None else {
+                    "nth": window["nth"], "times": window["times"]}
+            return {"points": points, "partitions": parts}
+
     def arm_from_env(self, spec: Optional[str] = None) -> None:
         """Parse GTPU_CHAOS and arm each entry. Grammar (`;`-separated):
 
             point=kind[,nth:N][,times:T][,prob:P][,arg:F][,seed:S][,@label:value]
-            partition=a<->b | a->b
+            partition=a<->b | a->b  [,nth:N][,times:T]
 
         `@label:value` tokens restrict the fault to matching call sites
         (e.g. `heartbeat.send=fail,@node:dn-1`); `@edge:a->b` (or
@@ -384,8 +450,17 @@ class FaultRegistry:
                 raise ValueError(f"bad GTPU_CHAOS entry {entry!r}")
             point = point.strip()
             if point == "partition":
-                for a, b in _parse_edge(rhs.strip()):
-                    self.install_partition(a, b, symmetric=False)
+                ptoks = [t.strip() for t in rhs.split(",") if t.strip()]
+                pkw: dict = {}
+                for tok in ptoks[1:]:
+                    k, _, v = tok.partition(":")
+                    if k in ("nth", "times"):
+                        pkw[k] = int(v)
+                    else:
+                        raise ValueError(
+                            f"bad partition token {tok!r} in {entry!r}")
+                for a, b in _parse_edge(ptoks[0]):
+                    self.install_partition(a, b, symmetric=False, **pkw)
                 continue
             tokens = [t.strip() for t in rhs.split(",") if t.strip()]
             kw: dict = {"kind": tokens[0]}
@@ -421,10 +496,52 @@ class FaultRegistry:
         if not self._partitions or point not in EDGE_POINTS:
             return
         edge = (labels.get("src"), labels.get("dst"))
-        if edge in self._partitions:
-            FAULT_INJECTIONS.inc(point=point, kind="partition",
-                                 edge=f"{edge[0]}->{edge[1]}")
-            raise FaultError(point, kind="partition")
+        with self._lock:
+            if edge not in self._partitions:
+                return
+            window = self._partitions[edge]
+            if window is not None:
+                # windowed cut: count this edge's calls and drop only
+                # inside [nth, nth+times) — outside the window the call
+                # passes (the cut is installed but not yet/no longer
+                # active)
+                window["calls"] += 1
+                lo = window["nth"]
+                if not (lo <= window["calls"] < lo + window["times"]):
+                    return
+        FAULT_INJECTIONS.inc(point=point, kind="partition",
+                             edge=f"{edge[0]}->{edge[1]}")
+        self._log_injection(point, "partition", labels)
+        raise FaultError(point, kind="partition")
+
+    def _log_injection(self, point: str, kind: str,
+                       labels: Optional[dict]) -> None:
+        """Throttled WARNING line per injection, stamped with the active
+        tracing span's trace_id (utils/tracing contextvar) so a red
+        chaos run links straight to its span tree. Never raises."""
+        try:
+            now = time.monotonic()
+            key = (point, kind)
+            with self._lock:
+                last = self._log_last.get(key)
+                if last is not None and now - last < _log_throttle_s():
+                    return
+                self._log_last[key] = now
+            trace_id = None
+            try:
+                from greptimedb_tpu.utils.tracing import current_trace_id
+
+                trace_id = current_trace_id()
+            except Exception:  # noqa: BLE001 — tracing is optional here
+                pass
+            extra = " ".join(
+                f"{k}={v}" for k, v in sorted((labels or {}).items()))
+            _LOG.warning(
+                "fault injected point=%s kind=%s%s%s", point, kind,
+                f" {extra}" if extra else "",
+                f" trace_id={trace_id}" if trace_id else "")
+        except Exception:  # noqa: BLE001 — logging must never mask the fault
+            pass
 
     def fire(self, point: str, **labels) -> None:
         """Control-path hook: may raise FaultError or sleep. Data-kind
@@ -456,6 +573,7 @@ class FaultRegistry:
             return data, None
         FAULT_INJECTIONS.inc(point=point, kind=fault.kind,
                              **self._counter_labels(labels))
+        self._log_injection(point, fault.kind, labels)
         if fault.kind == "latency":
             time.sleep(fault.arg)
             return data, None
@@ -510,6 +628,7 @@ class FaultRegistry:
             return
         FAULT_INJECTIONS.inc(point=point, kind=fault.kind,
                              **self._counter_labels(labels))
+        self._log_injection(point, fault.kind, labels)
         if fault.kind == "latency":
             time.sleep(fault.arg)
             return

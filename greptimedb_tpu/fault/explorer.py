@@ -1,0 +1,881 @@
+"""Generative chaos explorer: seeded random fault schedules + workloads
+against live clusters, with the invariant checkers as a universal
+oracle and delta-debugged (ddmin) minimal repros.
+
+PR 3 shipped four hand-written compound scenarios; the Jepsen /
+FoundationDB lesson is that hand-picked interleavings find the bugs you
+imagined. This module samples the schedule space the registry already
+defines — canonical POINTS × oracle-compatible KINDS × EDGE_POINTS
+edges × timing knobs (nth/times/prob/arg), plus windowed partitions and
+election lease-loss nemeses — and runs every sampled schedule against a
+live ProcessCluster (or a 3-process MetasrvProcessCluster in election
+mode) under a seeded random workload of concurrent writes, reads,
+flush/compact ADMIN calls, node kills, and DDL.
+
+Every run is checked by the PR-3 oracle: no acknowledged write lost,
+at most one leader per lease epoch (CAS journal), failover inside its
+beat deadline, typed-only degradation, no partial WAL objects. A
+failing schedule is delta-debugged down to a minimal entry subset
+(`ddmin`), re-verified, and printed as the standard bit-for-bit
+`GTPU_CHAOS`/`GTPU_CHAOS_SEED` repro line — the same seed re-runs the
+same schedule AND the same workload, entry for entry, op for op.
+
+Determinism contract: schedules derive from `Random(f"schedule:{seed}")`
+and workloads from `Random(f"workload:{seed}")` (string seeding hashes
+via SHA-512, stable across processes), so `--replay --seed S` with the
+printed GTPU_CHAOS regenerates the exact run. Nothing here reads the
+wall clock for decisions.
+
+Test-only bug hook: when GTPU_CHAOS_BUG is set ("point:<name>" or
+"env:<substring>"), runs short-circuit BEFORE spawning a cluster — the
+schedule is validated against a scratch registry and the hook decides
+pass/fail. This lets the tier-1 suite prove the whole
+explore → catch → shrink → repro pipeline (including that the minimal
+repro line re-triggers the failure) in milliseconds.
+
+CLI: tools/chaos_explorer.py. Metrics:
+`greptimedb_tpu_chaos_runs_total{outcome}` and
+`greptimedb_tpu_chaos_shrink_steps_total`.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import tempfile
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass
+from typing import Callable, Optional, Sequence
+
+from ..utils.metrics import CHAOS_RUNS, CHAOS_SHRINK_STEPS
+from . import FAULTS, FaultRegistry
+from .scenarios import (
+    BEAT_MS,
+    CREATE,
+    ElectionEpochJournal,
+    InvariantViolation,
+    ScenarioRun,
+    _typed_failure,
+    _warm_up,
+    scenario_cluster,
+    try_insert,
+    verify_acked,
+    verify_epochs,
+    verify_wal_objects_clean,
+)
+
+#: oracle-compatible kind pool per point for DATA-plane exploration.
+#: Deliberately narrower than KINDS: torn/short_read on these seams
+#: corrupt bytes the oracle's strict checkers (complete-frame WAL scan,
+#: Arrow decode) would flag as red without any bug — those kinds keep
+#: their hand-written scenarios. enospc rides the proven spill+cleanup
+#: path; everything else degrades typed through the retry layer.
+CLUSTER_KIND_POOL = {
+    "objectstore.read": ("fail", "latency"),
+    "objectstore.write": ("fail", "latency", "enospc"),
+    "wal.append": ("fail", "latency", "enospc"),
+    "wal.replay": ("fail", "latency"),
+    "flight.do_get": ("fail", "latency"),
+    "flight.do_put": ("fail", "latency"),
+    "heartbeat.send": ("fail", "latency"),
+    "ingest.commit": ("fail", "latency"),
+    "maintenance.job": ("fail", "latency"),
+}
+
+#: election-mode pool: lease loss in the child + wire chaos in the
+#: parent's kv_service seam
+ELECTION_KIND_POOL = {
+    "election.lease": ("fail",),
+    "metasrv.kv": ("fail", "latency"),
+}
+
+
+@dataclass(frozen=True)
+class Topology:
+    """The node universe a sampler draws edges/targets from — derived
+    from what the harness will register, so every sampled spec passes
+    the registry's arm-time validation."""
+
+    datanodes: tuple = ()
+    metasrvs: tuple = ()
+    frontend: str = "frontend"
+    coordinator: str = "metasrv-0"  # ProcessCluster's metasrv node id
+
+    @classmethod
+    def cluster(cls, num_datanodes: int) -> "Topology":
+        return cls(datanodes=tuple(f"dn-{i}"
+                                   for i in range(num_datanodes)))
+
+    @classmethod
+    def election(cls, num_metasrv: int) -> "Topology":
+        from ..cluster.metasrv_cluster import KV_HOST_ID
+
+        return cls(metasrvs=tuple(f"meta-{i}"
+                                  for i in range(num_metasrv)),
+                   coordinator=KV_HOST_ID)
+
+
+@dataclass(frozen=True)
+class ScheduleEntry:
+    """One sampled GTPU_CHAOS entry (a fault schedule or a partition).
+    `to_env()` emits exactly the registry grammar, so a schedule and its
+    env string round-trip bit-for-bit."""
+
+    point: str                      # a POINTS name or "partition"
+    kind: str                       # KINDS member ("partition" implied)
+    nth: Optional[int] = None
+    times: int = 1
+    prob: float = 0.0
+    arg: Optional[float] = None
+    node: Optional[str] = None      # @node matcher
+    edge: Optional[str] = None      # "a->b" / "a<->b" (@edge / cut spec)
+
+    def to_env(self) -> str:
+        if self.point == "partition":
+            s = f"partition={self.edge}"
+            if self.nth is not None:
+                s += f",nth:{self.nth}"
+                if self.times != 1:
+                    s += f",times:{self.times}"
+            return s
+        toks = [f"{self.point}={self.kind}"]
+        if self.nth is not None:
+            toks.append(f"nth:{self.nth}")
+            if self.times != 1:
+                toks.append(f"times:{self.times}")
+        if self.prob:
+            toks.append(f"prob:{self.prob}")
+        if self.arg is not None:
+            toks.append(f"arg:{self.arg}")
+        if self.node:
+            toks.append(f"@node:{self.node}")
+        if self.edge:
+            toks.append(f"@edge:{self.edge}")
+        return ",".join(toks)
+
+
+def compile_env(entries: Sequence) -> str:
+    """Entries (ScheduleEntry or raw env strings) → one GTPU_CHAOS."""
+    return ";".join(e.to_env() if isinstance(e, ScheduleEntry) else e
+                    for e in entries)
+
+
+def split_env(chaos_env: str) -> list[str]:
+    """GTPU_CHAOS → entry strings (the ddmin atoms on replayed envs)."""
+    return [s.strip() for s in chaos_env.split(";") if s.strip()]
+
+
+# ---- samplers ----------------------------------------------------------------
+
+
+def _sample_timing(rng: random.Random, entry: dict) -> None:
+    """nth-window (70%) or seeded coin flips (30%) — both replay from
+    the seed alone."""
+    if rng.random() < 0.7:
+        entry["nth"] = rng.randint(1, 10)
+        entry["times"] = rng.randint(1, 3)
+    else:
+        entry["prob"] = round(rng.uniform(0.05, 0.25), 3)
+
+
+def _sample_arg(rng: random.Random, kind: str) -> Optional[float]:
+    if kind == "latency":
+        # small enough to keep retry budgets green, large enough to be
+        # on the clock
+        return round(rng.uniform(0.001, 0.02), 4)
+    if kind in ("torn", "short_read", "enospc"):
+        return round(rng.uniform(0.0, 0.9), 2)   # fraction of bytes kept
+    return None
+
+
+def sample_schedule(rng: random.Random, topo: Topology,
+                    max_entries: int = 4) -> list[ScheduleEntry]:
+    """A seeded random data-plane schedule: distinct points (the
+    registry holds ONE schedule per point), oracle-compatible kinds,
+    sampled timing, optional @node/@edge scoping, windowed partitions,
+    and — on multi-datanode topologies — a datanode-kill nemesis."""
+    slots = sorted(CLUSTER_KIND_POOL)
+    slots.append("partition")
+    if len(topo.datanodes) >= 2:
+        # a kill needs a failover candidate; single-datanode runs keep
+        # the cluster readable for the final verification instead
+        slots.append("datanode.crash")
+    rng.shuffle(slots)
+    picked = slots[:rng.randint(2, max(2, max_entries))]
+    entries = []
+    for point in picked:
+        if point == "partition":
+            dn = rng.choice(topo.datanodes)
+            entries.append(ScheduleEntry(
+                point="partition", kind="partition",
+                edge=f"{topo.frontend}<->{dn}",
+                # always windowed: sampled cuts self-heal in call space,
+                # so the final chaos-free verification can reach the node
+                nth=rng.randint(1, 8), times=rng.randint(1, 5)))
+            continue
+        if point == "datanode.crash":
+            # nth past the 5-round warm-up + first beats so the victim
+            # has reported its regions (failover only covers regions the
+            # coordinator has SEEN — an unreported region on a dead node
+            # is an orphan by design, not a missed deadline)
+            entries.append(ScheduleEntry(
+                point=point, kind="fail", nth=rng.randint(7, 14),
+                node=rng.choice(topo.datanodes)))
+            continue
+        kind = rng.choice(CLUSTER_KIND_POOL[point])
+        entry: dict = {"point": point, "kind": kind,
+                       "arg": _sample_arg(rng, kind)}
+        _sample_timing(rng, entry)
+        if point in ("flight.do_get", "flight.do_put") \
+                and rng.random() < 0.3:
+            entry["edge"] = \
+                f"{topo.frontend}->{rng.choice(topo.datanodes)}"
+        elif point == "heartbeat.send" and rng.random() < 0.4:
+            if rng.random() < 0.5:
+                entry["edge"] = \
+                    f"{rng.choice(topo.datanodes)}->{topo.coordinator}"
+            else:
+                entry["node"] = rng.choice(topo.datanodes)
+        entries.append(ScheduleEntry(**entry))
+    return entries
+
+
+def sample_election_schedule(rng: random.Random, topo: Topology,
+                             max_entries: int = 3) \
+        -> list[ScheduleEntry]:
+    """Election-mode nemeses: forced lease loss inside a metasrv child,
+    kv_service wire faults, and windowed peer↔KV-host partitions."""
+    entries = [ScheduleEntry(
+        point="election.lease", kind="fail",
+        nth=rng.randint(1, 5), times=rng.randint(1, 3),
+        node=rng.choice(topo.metasrvs))]
+    if rng.random() < 0.7 and max_entries >= 2:
+        entry: dict = {"point": "metasrv.kv",
+                       "kind": rng.choice(ELECTION_KIND_POOL["metasrv.kv"])}
+        entry["arg"] = _sample_arg(rng, entry["kind"])
+        _sample_timing(rng, entry)
+        if rng.random() < 0.4:
+            entry["edge"] = \
+                f"{rng.choice(topo.metasrvs)}->{topo.coordinator}"
+        entries.append(ScheduleEntry(**entry))
+    if rng.random() < 0.6 and max_entries >= 3:
+        entries.append(ScheduleEntry(
+            point="partition", kind="partition",
+            edge=f"{rng.choice(topo.metasrvs)}<->{topo.coordinator}",
+            nth=rng.randint(1, 10), times=rng.randint(1, 6)))
+    return entries
+
+
+def sample_skews(rng: random.Random, topo: Topology,
+                 lease_s: float) -> dict:
+    """The clock nemesis: with 50% probability one metasrv peer runs
+    skewed forward by up to 40% of a lease. Seed-derived, so the repro
+    seed regenerates it — skew is scenario state, not a GTPU_CHAOS
+    entry."""
+    if rng.random() < 0.5 and topo.metasrvs:
+        node = rng.choice(topo.metasrvs)
+        return {node: round(rng.uniform(0.1, 0.4) * lease_s * 1000.0)}
+    return {}
+
+
+def sample_workload(rng: random.Random, steps: int, topo: Topology,
+                    allow_kill: bool = True) -> list[tuple]:
+    """A seeded random workload: tracked inserts, reads, virtual-clock
+    beats, flush/compact ADMIN calls, DDL, and (multi-datanode) node
+    kills. Pure function of the rng — execution never feeds back into
+    the op sequence, so the same seed replays the same ops even when
+    outcomes differ. `allow_kill=False` when the SCHEDULE already
+    carries a datanode.crash nemesis: workload kills + a scheduled
+    crash could together take every datanode down."""
+    ops: list[tuple] = [("create",)]
+    weighted = [("insert", 5.0), ("read", 3.0), ("beat", 4.0),
+                ("flush", 1.0), ("compact", 1.0), ("ddl", 1.0)]
+    killable = list(topo.datanodes[1:])  # dn-0 survives as the
+    # failover candidate — every acked write must stay readable
+    if allow_kill and killable and len(topo.datanodes) >= 2:
+        weighted.append(("kill", 0.7))
+    names = [w[0] for w in weighted]
+    weights = [w[1] for w in weighted]
+    insert_i = ddl_i = 0
+    for _ in range(steps):
+        op = rng.choices(names, weights=weights)[0]
+        if op == "insert":
+            ops.append(("insert", insert_i))
+            insert_i += 1
+        elif op == "ddl":
+            ops.append(("ddl", ddl_i))
+            ddl_i += 1
+        elif op == "kill":
+            if not killable:
+                ops.append(("beat",))
+                continue
+            target = rng.choice(killable)
+            killable.remove(target)
+            ops.append(("kill", target))
+        else:
+            ops.append((op,))
+    ops.append(("beat",))
+    return ops
+
+
+# ---- the live runner ---------------------------------------------------------
+
+
+@contextmanager
+def _chaos_env(seed: int, chaos_env: str):
+    """Export GTPU_CHAOS/GTPU_CHAOS_SEED for children, reset the parent
+    registry on both sides (the scenario_cluster contract, minus the
+    ProcessCluster — election mode brings its own harness)."""
+    saved = {k: os.environ.get(k) for k in ("GTPU_CHAOS",
+                                            "GTPU_CHAOS_SEED")}
+    os.environ["GTPU_CHAOS_SEED"] = str(seed)
+    if chaos_env:
+        os.environ["GTPU_CHAOS"] = chaos_env
+    else:
+        os.environ.pop("GTPU_CHAOS", None)
+    FAULTS.reset()
+    try:
+        yield
+    finally:
+        FAULTS.reset()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _bug_hook() -> Optional[str]:
+    return os.environ.get("GTPU_CHAOS_BUG") or None
+
+
+def _bug_hook_check(run: ScenarioRun, chaos_env: str) -> None:
+    """Test-only deliberate invariant bug: 'point:<name>' trips when the
+    schedule arms that point, 'env:<substr>' when the env contains the
+    text. Raises the same InvariantViolation (repro line attached) a
+    real red run would."""
+    spec = _bug_hook() or ""
+    mode, _, val = spec.partition(":")
+    if mode == "point":
+        hit = any(e.split("=", 1)[0].strip() == val
+                  for e in split_env(chaos_env))
+    elif mode == "env":
+        hit = val in chaos_env
+    else:
+        raise ValueError(f"bad GTPU_CHAOS_BUG spec {spec!r} "
+                         "(want point:<name> or env:<substr>)")
+    run.check(not hit, f"test-only bug hook GTPU_CHAOS_BUG={spec} "
+                       "tripped")
+
+
+def _validate_schedule(chaos_env: str, topo: Topology) -> None:
+    """Arm a scratch registry so malformed entries fail loud even on
+    the no-cluster (bug hook) path."""
+    reg = FaultRegistry()
+    nodes = [*topo.datanodes, *topo.metasrvs, topo.frontend,
+             topo.coordinator]
+    reg.register_nodes(nodes)
+    reg.arm_from_env(chaos_env)
+
+
+def _replay_cmd(seed: int, num_datanodes: int, steps: int,
+                election: bool = False) -> str:
+    base = f"python tools/chaos_explorer.py --replay --seed {seed}"
+    if election:
+        return f"{base} --election"
+    return f"{base} --datanodes {num_datanodes} --steps {steps}"
+
+
+def run_schedule(entries: Sequence, seed: int,
+                 data_dir: Optional[str] = None, num_datanodes: int = 1,
+                 steps: int = 28, cmd: Optional[str] = None) -> dict:
+    """Execute one schedule + the seed's workload against a live
+    ProcessCluster and run the full oracle. Raises InvariantViolation
+    (repro attached) on any violated invariant; returns the report."""
+    chaos_env = compile_env(entries)
+    topo = Topology.cluster(num_datanodes)
+    run = ScenarioRun(f"explore[{seed}]", seed, chaos_env=chaos_env,
+                      cmd=cmd or _replay_cmd(seed, num_datanodes, steps))
+    _validate_schedule(chaos_env, topo)
+    if _bug_hook():
+        _bug_hook_check(run, chaos_env)
+        run.report.update(dry=True, entries=len(split_env(chaos_env)))
+        return run.report
+    crash_scheduled = any(
+        e.split("=", 1)[0].strip() == "datanode.crash"
+        for e in split_env(chaos_env))
+    workload = sample_workload(random.Random(f"workload:{seed}"), steps,
+                               topo, allow_kill=not crash_scheduled)
+    if data_dir is None:
+        with tempfile.TemporaryDirectory(prefix="gtpu_explore_") as d:
+            return _run_live(run, chaos_env, seed, d, num_datanodes,
+                             workload)
+    return _run_live(run, chaos_env, seed, data_dir, num_datanodes,
+                     workload)
+
+
+def _try_create(run: ScenarioRun, cluster, sql: str = CREATE) -> bool:
+    from ..query.expr import PlanError
+
+    try:
+        cluster.sql(sql)
+        return True
+    except PlanError as e:
+        # the DDL path's TYPED surface: a journaled procedure that
+        # exhausted its retries rolls back and resurfaces as PlanError
+        # ("ddl/create_table rolled_back: ..."); "already exists" means
+        # an earlier chaos-failed attempt actually committed
+        return "already exists" in str(e)
+    except Exception as e:  # noqa: BLE001 — classified below
+        run.check(_typed_failure(e),
+                  f"DDL failed with UNTYPED {type(e).__name__}: {e}")
+        return False
+
+
+def _first_region(cluster) -> Optional[tuple[int, str]]:
+    try:
+        rid = cluster.catalog.table("public", "m").region_ids[0]
+        route = cluster.metasrv.routes.get(str(rid >> 32))
+        return rid, route.region(rid).leader_node
+    except Exception:  # noqa: BLE001 — table/route not there yet
+        return None
+
+
+def _dead_led_regions(cluster) -> tuple[list[int], list[int]]:
+    """Regions whose route leader is dead, split into (reported,
+    orphans). Failover's contract covers only regions the coordinator
+    SAW in a heartbeat (`_node_regions`); a region whose owner died
+    before ever reporting it cannot be failed over by design — it's an
+    orphan to record, not a missed deadline."""
+    reported, orphans = [], []
+    for route in cluster.metasrv.routes.all():
+        for rr in route.regions:
+            dn = cluster.datanodes.get(rr.leader_node)
+            if dn is not None and dn.alive:
+                continue
+            known = cluster.metasrv._node_regions.get(rr.leader_node, {})
+            (reported if rr.region_id in known
+             else orphans).append(rr.region_id)
+    return reported, orphans
+
+
+def _run_live(run: ScenarioRun, chaos_env: str, seed: int,
+              data_dir: str, num_datanodes: int,
+              workload: Sequence[tuple]) -> dict:
+    stats = {"ops": 0, "acked": 0, "typed_failures": 0, "skipped": 0,
+             "killed": []}
+    with scenario_cluster(seed, data_dir,
+                          num_datanodes=num_datanodes,
+                          chaos_env=chaos_env or None) as c:
+        # the parent registry was reset by scenario_cluster; arm it now
+        # that the topology is registered (children armed at import) —
+        # this is where partitions and frontend-seam faults come live
+        FAULTS.arm_from_env(chaos_env)
+        t = _warm_up(c, 0.0)
+        acked: dict = {}
+        table_ready = False
+        aux_ready: set = set()
+        for op in workload:
+            stats["ops"] += 1
+            kind = op[0]
+            if kind == "create" or (kind in ("insert", "read", "flush",
+                                             "compact")
+                                    and not table_ready):
+                if not table_ready and _try_create(run, c):
+                    table_ready = True
+                    c.beat_all(t)  # report the new region before chaos
+                    t += BEAT_MS   # can kill its owner (see "ddl")
+                if kind == "create":
+                    continue
+                if not table_ready:
+                    stats["skipped"] += 1
+                    continue
+            if kind == "insert":
+                if try_insert(run, c, op[1], acked):
+                    stats["acked"] += 1
+                else:
+                    stats["typed_failures"] += 1
+            elif kind == "read":
+                try:
+                    c.sql("SELECT count(*) FROM m")
+                except Exception as e:  # noqa: BLE001 — classified
+                    run.check(_typed_failure(e),
+                              f"read failed with UNTYPED "
+                              f"{type(e).__name__}: {e}")
+                    stats["typed_failures"] += 1
+            elif kind == "beat":
+                c.beat_all(t)
+                c.tick(t)
+                t += BEAT_MS
+            elif kind in ("flush", "compact"):
+                target = _first_region(c)
+                if target is None:
+                    stats["skipped"] += 1
+                    continue
+                rid, owner = target
+                dn = c.datanodes.get(owner)
+                if dn is None:
+                    stats["skipped"] += 1
+                    continue
+                try:
+                    getattr(dn.remote, kind)(rid)
+                except Exception as e:  # noqa: BLE001 — classified
+                    run.check(_typed_failure(e),
+                              f"{kind} ADMIN failed with UNTYPED "
+                              f"{type(e).__name__}: {e}")
+                    stats["typed_failures"] += 1
+            elif kind == "ddl":
+                name = f"aux{op[1]}"
+                if name not in aux_ready and _try_create(
+                        run, c,
+                        f"CREATE TABLE {name} (host STRING, v DOUBLE, "
+                        "ts TIMESTAMP TIME INDEX, PRIMARY KEY(host))"):
+                    aux_ready.add(name)
+                    # report the fresh region promptly: a node killed
+                    # before its next heartbeat orphans regions the
+                    # failover machinery can never have seen
+                    c.beat_all(t)
+                    t += BEAT_MS
+            elif kind == "kill":
+                node = op[1]
+                alive = [n for n, d in c.datanodes.items() if d.alive]
+                if node in alive and len(alive) > 1:
+                    c.kill_datanode(node)
+                    stats["killed"].append(node)
+                else:
+                    stats["skipped"] += 1
+
+        # the chaos schedule also kills (datanode.crash nemesis) — the
+        # oracle below needs to know regardless of who pulled the plug
+        stats["killed"] = sorted(
+            set(stats["killed"])
+            | {n for n, d in c.datanodes.items() if not d.alive})
+
+        # ---- oracle: verify chaos-free ----------------------------------
+        FAULTS.heal_partitions()
+        FAULTS.reset()
+        for dn in c.datanodes.values():
+            if dn.alive:
+                dn.remote.chaos_reset()
+        if table_ready:
+            rounds = 0
+            deadline_rounds = 30
+            # settle until failover has landed AND the mailbox is
+            # drained — a redelivered OpenRegion (instruction delivery
+            # hit by chaos before the heal) needs one more beat to land
+            def _unsettled() -> bool:
+                if _dead_led_regions(c)[0]:
+                    return True
+                with c.metasrv._lock:
+                    # dead nodes never beat again: their CLOSE_REGION
+                    # (split-brain guard) legitimately stays queued
+                    return any(
+                        insts and n in c.datanodes
+                        and c.datanodes[n].alive
+                        for n, insts in c.metasrv._pending.items())
+
+            while _unsettled() and rounds < deadline_rounds:
+                c.beat_all(t)
+                c.tick(t)
+                t += BEAT_MS
+                rounds += 1
+            bad, orphans = _dead_led_regions(c)
+            run.check(not bad,
+                      f"failover missed its deadline: regions {bad} "
+                      f"still led by dead nodes after {rounds} rounds")
+            run.report["settle_rounds"] = rounds
+            if orphans:
+                run.report["orphaned_regions"] = orphans
+            try:
+                verify_acked(run, c, acked)
+            except InvariantViolation:
+                raise
+            except Exception as e:  # noqa: BLE001 — classified below
+                # an orphaned region (owner died pre-report) can make
+                # the table unreadable through no failover fault; a
+                # typed failure is then recorded, anything else — or a
+                # failed read with NO orphan in play — stays a violation
+                run.check(_typed_failure(e) and bool(orphans),
+                          f"final read after chaos healed failed with "
+                          f"{type(e).__name__}: {e}")
+                run.report["verify_acked_skipped"] = True
+        if not stats["killed"]:
+            # SIGKILL mid-write may legally leave staging files the
+            # next open cleans; the no-partial-WAL invariant is the
+            # ENOSPC-cleanup contract, so it's checked on kill-free runs
+            verify_wal_objects_clean(
+                run, os.path.join(data_dir, "shared"))
+    run.report.update(stats, entries=len(split_env(chaos_env)))
+    return run.report
+
+
+# ---- election mode -----------------------------------------------------------
+
+
+def run_election_schedule(entries: Sequence, seed: int,
+                          data_dir: Optional[str] = None,
+                          num_metasrv: int = 3, lease_s: float = 9.0,
+                          rounds: int = 24,
+                          skews: Optional[dict] = None,
+                          cmd: Optional[str] = None) -> dict:
+    """Execute one election-chaos schedule against N real metasrv
+    processes electing over the kv_service wire. Oracle: at most one
+    leader per lease epoch (CAS journal in the parent's KV host), a
+    leader re-emerges after chaos heals, follower redirects stay typed
+    (`NotLeaderError` with a leader hint over HTTP 409), and every
+    tick-time failure is typed."""
+    from ..catalog.kv import MemoryKv
+    from ..meta.election import NotLeaderError
+
+    chaos_env = compile_env(entries)
+    topo = Topology.election(num_metasrv)
+    if skews is None:
+        skews = sample_skews(random.Random(f"skew:{seed}"), topo,
+                             lease_s)
+    run = ScenarioRun(f"explore_election[{seed}]", seed,
+                      chaos_env=chaos_env,
+                      cmd=cmd or _replay_cmd(seed, 0, 0, election=True))
+    _validate_schedule(chaos_env, topo)
+    if _bug_hook():
+        _bug_hook_check(run, chaos_env)
+        run.report.update(dry=True, entries=len(split_env(chaos_env)))
+        return run.report
+    if data_dir is None:
+        with tempfile.TemporaryDirectory(prefix="gtpu_elect_") as d:
+            return _run_election_live(run, chaos_env, seed, d,
+                                      num_metasrv, lease_s, rounds,
+                                      skews, NotLeaderError, MemoryKv)
+    return _run_election_live(run, chaos_env, seed, data_dir,
+                              num_metasrv, lease_s, rounds, skews,
+                              NotLeaderError, MemoryKv)
+
+
+def _run_election_live(run: ScenarioRun, chaos_env: str, seed: int,
+                       data_dir: str, num_metasrv: int, lease_s: float,
+                       rounds: int, skews: dict, NotLeaderError,
+                       MemoryKv) -> dict:
+    from ..cluster.metasrv_cluster import MetasrvProcessCluster
+    from ..meta.metasrv import HeartbeatRequest
+
+    journal = ElectionEpochJournal(MemoryKv())
+    with _chaos_env(seed, chaos_env):
+        cluster = MetasrvProcessCluster(data_dir,
+                                        num_metasrv=num_metasrv,
+                                        kv=journal, lease_s=lease_s,
+                                        clock_skew_ms=skews)
+        try:
+            FAULTS.arm_from_env(chaos_env)
+            t = BEAT_MS
+            for _ in range(rounds):
+                for node, res in cluster.tick_all(t).items():
+                    if isinstance(res, Exception):
+                        run.check(
+                            _typed_failure(res)
+                            or isinstance(res, NotLeaderError),
+                            f"tick on {node} failed UNTYPED "
+                            f"{type(res).__name__}: {res}")
+                t += BEAT_MS
+
+            # heal everything, then the cluster must converge on ONE
+            # authoritative lease holder within a lease-expiry's worth
+            # of rounds
+            FAULTS.heal_partitions()
+            FAULTS.reset()
+            cluster.chaos_reset_all()
+            settle = 0
+            while cluster.leader(t) is None and settle < 15:
+                cluster.tick_all(t)
+                t += BEAT_MS
+                settle += 1
+            leader = cluster.leader(t)
+            run.check(leader is not None,
+                      f"no leader re-emerged within {settle} rounds "
+                      "after chaos healed")
+            cluster.tick_all(t)  # followers refresh their local views
+
+            # redirect correctness across processes: a follower answers
+            # heartbeats leader=False (+hint) and refuses leader-only
+            # admin ops with the TYPED NotLeaderError over the wire
+            followers = [n for n, ms in cluster.metasrvs.items()
+                         if n != leader and ms.alive]
+            run.check(bool(followers),
+                      "no live follower left to verify redirects")
+            fol = cluster.metasrvs[followers[0]].client
+            resp = fol.handle_heartbeat(HeartbeatRequest(
+                node_id="dn-probe", region_stats=[], now_ms=t))
+            run.check(not resp.leader,
+                      f"follower {followers[0]} answered a heartbeat "
+                      "as leader")
+            try:
+                fol.migrate_region("m", 0, "dn-0")
+                run.check(False,
+                          f"follower {followers[0]} accepted a "
+                          "leader-only admin op")
+            except NotLeaderError as e:
+                run.report["redirect_leader_hint"] = e.leader
+            except InvariantViolation:
+                raise
+            except Exception as e:  # noqa: BLE001 — classified
+                run.check(False,
+                          f"follower redirect was UNTYPED "
+                          f"{type(e).__name__}: {e}")
+
+            run.check(len(journal.epochs) >= 1,
+                      "no election epoch was ever granted (vacuous run)")
+            verify_epochs(run, journal, lease_s,
+                          max_skew_ms=max(skews.values(), default=0.0))
+            run.report.update(
+                leader=leader, epochs=len(journal.epochs),
+                skews=skews, entries=len(split_env(chaos_env)))
+        finally:
+            cluster.close()
+    return run.report
+
+
+# ---- shrinking ---------------------------------------------------------------
+
+
+def ddmin(entries: Sequence, still_fails: Callable[[list], bool],
+          max_probes: int = 32) -> list:
+    """Zeller delta-debugging (complement reduction): find a smaller
+    entry subset that still fails. Each probe is one full (seeded,
+    deterministic) re-run; `max_probes` bounds the spend and every probe
+    counts into gtpu_chaos_shrink_steps."""
+    entries = list(entries)
+    n = 2
+    probes = 0
+    while len(entries) >= 2 and probes < max_probes:
+        chunk = max(1, len(entries) // n)
+        subsets = [entries[i:i + chunk]
+                   for i in range(0, len(entries), chunk)]
+        reduced = False
+        for i in range(len(subsets)):
+            complement = [e for j, s in enumerate(subsets)
+                          for e in s if j != i]
+            if not complement or len(complement) == len(entries):
+                continue
+            probes += 1
+            CHAOS_SHRINK_STEPS.inc()
+            if still_fails(complement):
+                entries = complement
+                n = max(n - 1, 2)
+                reduced = True
+                break
+            if probes >= max_probes:
+                break
+        if not reduced:
+            if n >= len(entries):
+                break
+            n = min(len(entries), n * 2)
+    return entries
+
+
+def shrink_failing(entries: Sequence, seed: int, *,
+                   election: bool = False, num_datanodes: int = 1,
+                   steps: int = 28, max_probes: int = 32) \
+        -> tuple[list, Optional[InvariantViolation]]:
+    """ddmin a failing schedule, then re-verify the minimal subset and
+    return (minimal_entries, the re-verified violation). The violation
+    carries the final repro line — the contract is that pasting it
+    re-triggers the failure."""
+    def still_fails(subset: list) -> bool:
+        try:
+            if election:
+                run_election_schedule(subset, seed)
+            else:
+                run_schedule(subset, seed,
+                             num_datanodes=num_datanodes, steps=steps)
+        except InvariantViolation:
+            return True
+        return False
+
+    minimal = ddmin(entries, still_fails, max_probes=max_probes)
+    CHAOS_SHRINK_STEPS.inc()  # the final re-verification probe
+    try:
+        if election:
+            run_election_schedule(minimal, seed)
+        else:
+            run_schedule(minimal, seed, num_datanodes=num_datanodes,
+                         steps=steps)
+    except InvariantViolation as e:
+        return minimal, e
+    # the minimal set no longer fails (flaky/non-minimal interaction):
+    # fall back to the original, which the caller knows fails
+    return list(entries), None
+
+
+# ---- the explorer loop -------------------------------------------------------
+
+
+def explore(runs: int = 3, seed: int = 0,
+            budget_s: Optional[float] = None, shrink: bool = True,
+            num_datanodes: int = 1, steps: int = 28,
+            max_entries: int = 4, election: bool = False,
+            rounds: int = 24, lease_s: float = 9.0,
+            shrink_probes: int = 32) -> dict:
+    """Sample and execute `runs` seeded schedules (run i uses seed
+    `seed + i`), oracle-checking each; failing schedules are shrunk to a
+    minimal repro. Returns the machine-readable report the CLI emits
+    with --json."""
+    report: dict = {"seed": seed, "mode": "election" if election
+                    else "cluster", "runs": [],
+                    "passed": 0, "failed": 0, "errors": 0}
+    t0 = time.monotonic()
+    for i in range(runs):
+        if budget_s is not None and report["runs"] \
+                and time.monotonic() - t0 > budget_s:
+            report["budget_exhausted"] = True
+            break
+        run_seed = seed + i
+        topo = Topology.election(3) if election \
+            else Topology.cluster(num_datanodes)
+        rng = random.Random(f"schedule:{run_seed}")
+        if election:
+            entries = [e.to_env() for e in
+                       sample_election_schedule(rng, topo, max_entries)]
+        else:
+            entries = [e.to_env() for e in
+                       sample_schedule(rng, topo, max_entries)]
+        rec: dict = {"seed": run_seed, "chaos_env": compile_env(entries),
+                     "entries": len(entries)}
+        t_run = time.monotonic()
+        try:
+            if election:
+                rec["report"] = run_election_schedule(entries, run_seed,
+                                                      lease_s=lease_s,
+                                                      rounds=rounds)
+            else:
+                rec["report"] = run_schedule(
+                    entries, run_seed, num_datanodes=num_datanodes,
+                    steps=steps)
+            rec["outcome"] = "pass"
+            report["passed"] += 1
+            CHAOS_RUNS.inc(outcome="pass")
+        except InvariantViolation as e:
+            rec["outcome"] = "fail"
+            rec["violation"] = str(e)
+            rec["repro"] = getattr(e, "repro", None)
+            report["failed"] += 1
+            CHAOS_RUNS.inc(outcome="fail")
+            if shrink:
+                minimal, verified = shrink_failing(
+                    entries, run_seed, election=election,
+                    num_datanodes=num_datanodes, steps=steps,
+                    max_probes=shrink_probes)
+                rec["shrunk_entries"] = len(minimal)
+                rec["shrunk_env"] = compile_env(minimal)
+                if verified is not None:
+                    rec["violation"] = str(verified)
+                    rec["repro"] = getattr(verified, "repro",
+                                           rec["repro"])
+        except Exception as e:  # noqa: BLE001 — harness error, not a
+            # cluster invariant: recorded, counted, never hidden
+            rec["outcome"] = "error"
+            rec["error"] = f"{type(e).__name__}: {e}"
+            report["errors"] += 1
+            CHAOS_RUNS.inc(outcome="error")
+        rec["duration_s"] = round(time.monotonic() - t_run, 2)
+        report["runs"].append(rec)
+    report["duration_s"] = round(time.monotonic() - t0, 2)
+    return report

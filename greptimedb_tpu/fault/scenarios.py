@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import json
 import os
+import shlex
 import zlib
 from contextlib import contextmanager
 from typing import Optional
@@ -48,7 +49,12 @@ BEAT_MS = 3000.0
 
 class InvariantViolation(AssertionError):
     """A cluster invariant failed under a seeded fault schedule. The
-    message carries the exact reproduction line."""
+    message carries the exact reproduction line; the machine-readable
+    pieces ride as `.scenario` and `.repro` attributes (tools consume
+    them for --json output)."""
+
+    scenario: Optional[str] = None
+    repro: Optional[str] = None
 
 
 class ScenarioRun:
@@ -57,24 +63,33 @@ class ScenarioRun:
     prints how to replay itself."""
 
     def __init__(self, name: str, seed: int,
-                 chaos_env: Optional[str] = None):
+                 chaos_env: Optional[str] = None,
+                 cmd: Optional[str] = None):
         self.name = name
         self.seed = seed
         self.chaos_env = chaos_env
+        #: the replay command — scenarios default to run_scenarios.py;
+        #: the chaos explorer substitutes its own --replay invocation
+        self.cmd = cmd or f"python tools/run_scenarios.py {name}"
         self.report: dict = {"name": name, "seed": seed}
 
     def repro(self) -> str:
         parts = [f"GTPU_CHAOS_SEED={self.seed}"]
         if self.chaos_env:
-            parts.append(f'GTPU_CHAOS="{self.chaos_env}"')
-        parts.append(f"python tools/run_scenarios.py {self.name}")
+            # shell-quoted: schedules carry `;` entry separators and
+            # `@edge:a->b` tokens that paste-break an unquoted line
+            parts.append(f"GTPU_CHAOS={shlex.quote(self.chaos_env)}")
+        parts.append(self.cmd)
         return " ".join(parts)
 
     def check(self, cond: bool, what: str) -> None:
         if not cond:
-            raise InvariantViolation(
+            err = InvariantViolation(
                 f"[{self.name}] invariant violated: {what}\n"
                 f"  replay: {self.repro()}")
+            err.scenario = self.name
+            err.repro = self.repro()
+            raise err
 
 
 @contextmanager
@@ -279,11 +294,16 @@ class ElectionEpochJournal(KvBackend):
 
 
 def verify_epochs(run: ScenarioRun, journal: ElectionEpochJournal,
-                  lease_s: float) -> None:
+                  lease_s: float, max_skew_ms: float = 0.0) -> None:
     """At most one leader per lease epoch: a takeover by a DIFFERENT
     node is legal only after the previous lease expired (campaign time,
     reconstructed from the granted deadline, past the old deadline) or
-    was resigned (deadline zeroed). Overlap = split-brain."""
+    was resigned (deadline zeroed). Overlap = split-brain.
+
+    `max_skew_ms` relaxes the bound under a clock-skew nemesis: a node
+    skewed forward by S legally sees the old lease expire S early by the
+    true clock, so takeovers up to S before the deadline are correct
+    behavior, not split-brain."""
     lease_ms = lease_s * 1000.0
     for prev, cur in zip(journal.epochs, journal.epochs[1:]):
         if cur["node"] == prev["node"]:
@@ -291,10 +311,12 @@ def verify_epochs(run: ScenarioRun, journal: ElectionEpochJournal,
         if prev["lease_until_ms"] == 0:
             continue  # previous holder resigned: immediate takeover ok
         granted_at = cur["lease_until_ms"] - lease_ms
-        run.check(granted_at > prev["lease_until_ms"],
+        run.check(granted_at > prev["lease_until_ms"] - max_skew_ms,
                   f"epoch overlap: {cur['node']} took the lease at "
                   f"t={granted_at:.0f} while {prev['node']}'s ran to "
-                  f"t={prev['lease_until_ms']:.0f}")
+                  f"t={prev['lease_until_ms']:.0f}"
+                  + (f" (skew slack {max_skew_ms:.0f}ms)"
+                     if max_skew_ms else ""))
     run.report["lease_epochs"] = len(journal.epochs)
 
 

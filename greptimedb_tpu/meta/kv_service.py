@@ -36,6 +36,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from ..catalog.kv import KvBackend
+from .election import NotLeaderError
 from .instruction import Instruction, InstructionKind
 from .metasrv import (HeartbeatRequest, HeartbeatResponse, Metasrv,
                       RegionStat)
@@ -133,6 +134,15 @@ class MetaHttpService:
                     out = service._dispatch(
                         self.path, req,
                         src=self.headers.get("X-GTPU-Src"))
+                except NotLeaderError as e:
+                    # structured redirect, not a bare 500: clients
+                    # re-raise the typed NotLeaderError (leader hint
+                    # included) instead of an opaque MetaServiceError —
+                    # the meta-client ask-leader contract over the wire
+                    self._reply({"error": f"NotLeaderError: {e}",
+                                 "not_leader": True,
+                                 "leader": e.leader}, 409)
+                    return
                 except Exception as e:  # noqa: BLE001 — wire boundary
                     self._reply({"error": f"{type(e).__name__}: {e}"}, 500)
                     return
@@ -214,6 +224,16 @@ class MetaHttpService:
             return {"procedure_id": rec.procedure_id}
         if path == "/admin/tick":
             return {"started": self.metasrv.tick(req.get("now_ms"))}
+        if path == "/admin/chaos_reset":
+            # chaos-harness control: disarm THIS process's fault
+            # registry so the explorer's final verification runs
+            # chaos-free (deliberately NOT behind the metasrv.kv seam —
+            # the disarm call must never be blocked by the very chaos it
+            # clears)
+            from greptimedb_tpu.fault import FAULTS
+
+            FAULTS.reset()
+            return {"ok": True}
         raise KeyError(f"unknown path {path}")
 
     def _heartbeat(self, req: dict) -> dict:
@@ -296,10 +316,19 @@ class _HttpJson:
                 r = c.getresponse()
                 raw = r.read()
                 if r.status != 200:
+                    try:
+                        err = json.loads(raw)
+                    except ValueError:
+                        err = {}
+                    if isinstance(err, dict) and err.get("not_leader"):
+                        # the follower's structured 409: surface the
+                        # TYPED redirect (leader hint attached), never
+                        # retried — redirecting is the caller's job
+                        raise NotLeaderError(err.get("leader"))
                     raise MetaServiceError(
                         f"{path}: HTTP {r.status}: {raw[:200]!r}")
                 return json.loads(raw)
-            except MetaServiceError:
+            except (MetaServiceError, NotLeaderError):
                 raise
             except Exception as e:  # noqa: BLE001 — transport layer
                 last = e
@@ -431,6 +460,16 @@ class MetaClient:
         return self._http.post("/admin/migrate_region", {
             "table": table, "region_id": region_id,
             "to_node": to_node})["procedure_id"]
+
+    def tick(self, now_ms: Optional[float] = None) -> list[str]:
+        """Drive the remote metasrv's virtual clock one step (the
+        deterministic multi-process chaos harness beats real metasrv
+        processes with explicit timestamps)."""
+        return self._http.post("/admin/tick", {"now_ms": now_ms})["started"]
+
+    def chaos_reset(self) -> None:
+        """Disarm the remote process's fault registry (chaos harness)."""
+        self._http.post("/admin/chaos_reset", {})
 
     def watch(self, prefix: str, since_rev: int = 0,
               timeout_s: float = 30.0) -> dict:

@@ -544,6 +544,13 @@ class FlightServer(fl.FlightServerBase):
                     f"user {user.username!r} lacks {needed} permission")
             from greptimedb_tpu.storage.engine import RegionRequest, RequestType
 
+            if op == "chaos_reset":
+                # chaos-harness control: clear THIS process's fault
+                # registry (schedules + partitions) so an explorer run's
+                # final verification reads the cluster chaos-free; a
+                # no-op when nothing is armed
+                FAULTS.reset()
+                return [b'{"ok": true}']
             if op == "info":
                 region = self.engine.region(rid)
                 return [json.dumps(
@@ -754,6 +761,12 @@ class RemoteRegionEngine:
 
     def compact(self, region_id: int) -> None:
         self._admin("compact", region_id)
+
+    def chaos_reset(self) -> None:
+        """Disarm the remote process's fault registry (chaos harness:
+        the explorer verifies invariants chaos-free after the workload).
+        region_id 0 — the op is process-scoped, not region-scoped."""
+        self._admin("chaos_reset", 0)
 
     def handle_request(self, req) -> int:
         from greptimedb_tpu.storage.engine import RequestType

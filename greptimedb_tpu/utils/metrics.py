@@ -415,6 +415,12 @@ RETRY_EXHAUSTED = REGISTRY.counter(
 DEGRADED = REGISTRY.counter(
     "greptimedb_tpu_degraded_total",
     "Graceful degradations (route re-resolution after retry exhaustion)")
+CHAOS_RUNS = REGISTRY.counter(
+    "greptimedb_tpu_chaos_runs_total",
+    "Chaos-explorer runs by outcome (pass|fail|error)")
+CHAOS_SHRINK_STEPS = REGISTRY.counter(
+    "greptimedb_tpu_chaos_shrink_steps_total",
+    "Delta-debugging probe runs spent shrinking failing chaos schedules")
 FLOW_TICK_ERRORS = REGISTRY.counter(
     "greptimedb_tpu_flow_tick_errors_total",
     "Flow engine tick failures deferred to the next tick, by flow")

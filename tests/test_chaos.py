@@ -186,6 +186,66 @@ class TestRetryAndObjectStore:
         assert time.monotonic() - t0 < 2.0
         assert 2 <= len(calls) < 100
 
+    def test_exhaustion_raises_the_last_typed_error(self):
+        """Each attempt may fail differently (fault, then timeout, then
+        connection refused); the exhausted call must surface the LAST
+        error — the one describing the state the caller actually hit."""
+        from greptimedb_tpu.fault import RetryPolicy
+
+        errors = [FaultError("flight.do_get", kind="fail"),
+                  FaultError("flight.do_get", kind="latency"),
+                  FaultError("flight.do_get", kind="partition")]
+        it = iter(errors)
+
+        def op():
+            raise next(it)
+
+        with pytest.raises(FaultError) as ei:
+            retry_call(op, point="flight.do_get",
+                       policy=RetryPolicy(max_attempts=3, base_s=0.0,
+                                          cap_s=0.0, deadline_s=5.0))
+        assert ei.value is errors[-1], \
+            "exhaustion must re-raise the final attempt's error"
+
+    def test_jitter_stays_within_bounds_seeded(self):
+        """Full-jitter backoff: sleep_i = U(0, min(cap, base*2^i)),
+        bit-replayable under a seeded RNG."""
+        import random as _random
+
+        from greptimedb_tpu.fault import RetryPolicy
+
+        policy = RetryPolicy(max_attempts=10, base_s=0.02, cap_s=0.5,
+                             deadline_s=10.0)
+        a = [policy.backoff_s(i, _random.Random(99)) for i in range(12)]
+        b = [policy.backoff_s(i, _random.Random(99)) for i in range(12)]
+        assert a == b, "seeded jitter must replay exactly"
+        for i, delay in enumerate(a):
+            assert 0.0 <= delay <= min(policy.cap_s,
+                                       policy.base_s * (2 ** i))
+        # the cap binds once base*2^i crosses it
+        assert all(d <= policy.cap_s for d in a)
+
+    def test_zero_budget_policy_fails_fast(self):
+        """max_attempts=1 is a no-retry policy: one call, immediate
+        raise, exhaustion counted, no sleeping."""
+        from greptimedb_tpu.fault import RetryPolicy
+
+        calls = []
+
+        def op():
+            calls.append(1)
+            raise FaultError("wal.append")
+
+        before = RETRY_EXHAUSTED.get(point="wal.append")
+        t0 = time.monotonic()
+        with pytest.raises(FaultError):
+            retry_call(op, point="wal.append",
+                       policy=RetryPolicy(max_attempts=1, base_s=1.0,
+                                          cap_s=1.0, deadline_s=10.0))
+        assert len(calls) == 1
+        assert time.monotonic() - t0 < 0.5, "zero-budget call slept"
+        assert RETRY_EXHAUSTED.get(point="wal.append") == before + 1
+
 
 # ---- WAL seams --------------------------------------------------------------
 

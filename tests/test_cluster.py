@@ -142,6 +142,26 @@ class TestFailover:
         c.close()
 
 
+    def test_dead_route_raises_typed_unavailable(self, tmp_path):
+        """Between a leader's death and failover landing, routing to
+        its region must degrade TYPED (Unavailable — retryable), never
+        leak a bare KeyError out of the routing table. Found by the
+        chaos explorer (seed 18, datanode.crash@dn-0)."""
+        from greptimedb_tpu.fault import Unavailable
+
+        c = make_cluster(tmp_path)
+        info = c.create_partitioned_table(CREATE, host_rule("host2", "host4"))
+        seed(c)
+        rid0 = info.region_ids[0]
+        victim_id = (c.metasrv.routes.get(str(rid0 >> 32))
+                     .region(rid0).leader_node)
+        c.datanodes[victim_id].kill()
+        # failover has NOT run: the stale route points at a dead node
+        with pytest.raises(Unavailable, match="no live datanode"):
+            c.router.region(rid0)
+        c.close()
+
+
 class TestMigration:
     def test_manual_region_migration(self, tmp_path):
         c = make_cluster(tmp_path)

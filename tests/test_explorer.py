@@ -1,0 +1,338 @@
+"""Randomized chaos explorer (greptimedb_tpu/fault/explorer.py):
+generative schedule/workload sampling, ddmin shrinking, repro-line
+round-trips, and live randomized runs against ProcessClusters.
+
+Tier-1 keeps a small always-on budget: the deterministic sampler units,
+the ddmin machinery, the explore→catch→shrink→repro pipeline against a
+test-only injected invariant bug (dry mode — no clusters spawned), and
+3 live randomized single-datanode runs. The deep matrix (2-datanode
+kill/crash runs, live outcome-determinism double runs) is slow-marked:
+`pytest -m slow tests/test_explorer.py`."""
+
+import logging
+import random
+
+import pytest
+
+from greptimedb_tpu.fault import FAULTS, Fault, FaultRegistry
+from greptimedb_tpu.fault import explorer as ex
+from greptimedb_tpu.fault.scenarios import InvariantViolation
+from greptimedb_tpu.utils.metrics import CHAOS_RUNS, CHAOS_SHRINK_STEPS
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def clean_faults():
+    FAULTS.reset()
+    yield
+    FAULTS.reset()
+
+
+# ---- samplers: determinism + validity ---------------------------------------
+
+
+class TestSamplers:
+    def test_schedule_is_seed_deterministic(self):
+        topo = ex.Topology.cluster(2)
+        for seed in range(20):
+            a = ex.sample_schedule(random.Random(f"schedule:{seed}"),
+                                   topo)
+            b = ex.sample_schedule(random.Random(f"schedule:{seed}"),
+                                   topo)
+            assert [e.to_env() for e in a] == [e.to_env() for e in b]
+
+    def test_different_seeds_diverge(self):
+        topo = ex.Topology.cluster(1)
+        envs = {ex.compile_env(ex.sample_schedule(
+            random.Random(f"schedule:{s}"), topo)) for s in range(30)}
+        assert len(envs) > 20, "sampler barely varies across seeds"
+
+    def test_workload_is_seed_deterministic_and_replayable(self):
+        topo = ex.Topology.cluster(3)
+        a = ex.sample_workload(random.Random("workload:5"), 40, topo)
+        b = ex.sample_workload(random.Random("workload:5"), 40, topo)
+        assert a == b
+        assert a[0] == ("create",)
+        kills = [op for op in a if op[0] == "kill"]
+        # never kills dn-0 (the failover candidate) and never the same
+        # node twice
+        assert all(op[1] != "dn-0" for op in kills)
+        assert len({op[1] for op in kills}) == len(kills)
+
+    def test_workload_kills_suppressed_when_crash_scheduled(self):
+        topo = ex.Topology.cluster(3)
+        ops = ex.sample_workload(random.Random("workload:5"), 40, topo,
+                                 allow_kill=False)
+        assert not [op for op in ops if op[0] == "kill"]
+
+    def test_single_datanode_schedules_never_kill(self):
+        topo = ex.Topology.cluster(1)
+        for seed in range(40):
+            entries = ex.sample_schedule(
+                random.Random(f"schedule:{seed}"), topo)
+            assert all(e.point != "datanode.crash" for e in entries)
+        ops = ex.sample_workload(random.Random("workload:9"), 40, topo)
+        assert not [op for op in ops if op[0] == "kill"]
+
+    def test_sampled_schedules_arm_cleanly(self):
+        """Every sampled schedule must pass the registry's arm-time
+        validation — points exist, kinds legal, edges in topology."""
+        for num_dn in (1, 2, 3):
+            topo = ex.Topology.cluster(num_dn)
+            for seed in range(25):
+                env = ex.compile_env(ex.sample_schedule(
+                    random.Random(f"schedule:{seed}"), topo))
+                ex._validate_schedule(env, topo)  # raises on any flaw
+
+    def test_sampled_election_schedules_arm_cleanly(self):
+        topo = ex.Topology.election(3)
+        for seed in range(25):
+            env = ex.compile_env(ex.sample_election_schedule(
+                random.Random(f"schedule:{seed}"), topo))
+            ex._validate_schedule(env, topo)
+            assert "election.lease" in env
+
+    def test_schedule_kinds_stay_oracle_compatible(self):
+        """torn/short_read on WAL/objectstore seams corrupt bytes the
+        strict checkers would flag without a bug — the sampler must
+        never emit them."""
+        for seed in range(40):
+            for e in ex.sample_schedule(
+                    random.Random(f"schedule:{seed}"),
+                    ex.Topology.cluster(2)):
+                if e.point in ("partition", "datanode.crash"):
+                    continue
+                assert e.kind in ex.CLUSTER_KIND_POOL[e.point]
+                assert e.kind not in ("torn", "short_read")
+
+    def test_entry_env_round_trips_through_registry(self):
+        """to_env() → arm_from_env() → fingerprint() preserves every
+        knob: the repro line IS the schedule, bit for bit."""
+        topo = ex.Topology.cluster(2)
+        for seed in range(15):
+            entries = ex.sample_schedule(
+                random.Random(f"schedule:{seed}"), topo)
+            env = ex.compile_env(entries)
+            r1, r2 = FaultRegistry(), FaultRegistry()
+            r1.arm_from_env(env)
+            r2.arm_from_env(ex.compile_env(ex.split_env(env)))
+            assert r1.fingerprint() == r2.fingerprint()
+            for e in entries:
+                if e.point == "partition":
+                    continue
+                fp = r1.fingerprint()["points"][e.point]
+                assert fp["kind"] == e.kind
+                assert fp["nth"] == e.nth
+                assert fp["prob"] == (e.prob or 0.0)
+
+    def test_skew_sampler_is_seeded_and_bounded(self):
+        topo = ex.Topology.election(3)
+        for seed in range(20):
+            a = ex.sample_skews(random.Random(f"skew:{seed}"), topo, 9.0)
+            b = ex.sample_skews(random.Random(f"skew:{seed}"), topo, 9.0)
+            assert a == b
+            for node, ms in a.items():
+                assert node in topo.metasrvs
+                assert 0 < ms <= 0.4 * 9000.0
+
+
+# ---- ddmin -------------------------------------------------------------------
+
+
+class TestDdmin:
+    def test_shrinks_to_single_culprit(self):
+        entries = [f"e{i}" for i in range(8)]
+        probes = []
+
+        def still_fails(subset):
+            probes.append(list(subset))
+            return "e5" in subset
+
+        before = CHAOS_SHRINK_STEPS.get()
+        assert ex.ddmin(entries, still_fails) == ["e5"]
+        assert CHAOS_SHRINK_STEPS.get() == before + len(probes)
+
+    def test_shrinks_to_interacting_pair(self):
+        entries = [f"e{i}" for i in range(9)]
+
+        def still_fails(subset):
+            return "e1" in subset and "e7" in subset
+
+        minimal = ex.ddmin(entries, still_fails)
+        assert set(minimal) == {"e1", "e7"}
+
+    def test_probe_budget_bounds_the_spend(self):
+        entries = [f"e{i}" for i in range(64)]
+        probes = []
+
+        def still_fails(subset):
+            probes.append(1)
+            return "e63" in subset
+
+        ex.ddmin(entries, still_fails, max_probes=5)
+        assert len(probes) <= 5
+
+    def test_unshrinkable_failure_returns_input(self):
+        entries = ["a", "b"]
+        assert ex.ddmin(entries, lambda s: len(s) >= 2) == ["a", "b"]
+
+
+# ---- the catch → shrink → repro pipeline (dry: no clusters) -----------------
+
+
+class TestBugHookPipeline:
+    def test_injected_bug_is_caught_shrunk_and_reproducible(
+            self, monkeypatch):
+        """The acceptance loop: a deliberately injected invariant bug
+        (test-only hook) must be caught by exploration, shrunk to <=3
+        entries, and the resulting repro line must re-trigger it."""
+        monkeypatch.setenv("GTPU_CHAOS_BUG", "point:wal.append")
+        report = ex.explore(runs=10, seed=100, shrink=True)
+        fails = [r for r in report["runs"] if r["outcome"] == "fail"]
+        assert fails, "no sampled schedule armed wal.append in 10 runs"
+        for rec in fails:
+            assert rec["shrunk_entries"] <= 3
+            assert "wal.append=" in rec["shrunk_env"]
+            assert rec["repro"] and "GTPU_CHAOS" in rec["repro"]
+            # the repro line re-triggers: re-run its exact schedule
+            # under the same seed and the same bug hook
+            with pytest.raises(InvariantViolation):
+                ex.run_schedule(ex.split_env(rec["shrunk_env"]),
+                                rec["seed"])
+        # clean schedules stay green under a hook they never arm
+        passes = [r for r in report["runs"] if r["outcome"] == "pass"]
+        assert passes, "every sampled schedule armed wal.append?!"
+
+    def test_same_seed_same_outcome(self, monkeypatch):
+        monkeypatch.setenv("GTPU_CHAOS_BUG", "env:heartbeat")
+        a = ex.explore(runs=6, seed=300, shrink=False)
+        b = ex.explore(runs=6, seed=300, shrink=False)
+        assert [(r["chaos_env"], r["outcome"]) for r in a["runs"]] \
+            == [(r["chaos_env"], r["outcome"]) for r in b["runs"]]
+
+    def test_outcome_metrics_count_by_outcome(self, monkeypatch):
+        monkeypatch.setenv("GTPU_CHAOS_BUG", "env:partition")
+        p0 = CHAOS_RUNS.get(outcome="pass")
+        f0 = CHAOS_RUNS.get(outcome="fail")
+        report = ex.explore(runs=6, seed=40, shrink=False)
+        assert CHAOS_RUNS.get(outcome="pass") - p0 == report["passed"]
+        assert CHAOS_RUNS.get(outcome="fail") - f0 == report["failed"]
+        assert report["passed"] + report["failed"] == 6
+
+    def test_election_mode_bug_hook(self, monkeypatch):
+        monkeypatch.setenv("GTPU_CHAOS_BUG", "point:election.lease")
+        report = ex.explore(runs=3, seed=0, shrink=True, election=True)
+        # every election schedule carries election.lease by design
+        assert report["failed"] == 3
+        for rec in report["runs"]:
+            assert rec["shrunk_entries"] <= 3
+            assert "--election" in (rec["repro"] or "")
+
+    def test_bad_hook_spec_is_loud(self, monkeypatch):
+        monkeypatch.setenv("GTPU_CHAOS_BUG", "bogus")
+        report = ex.explore(runs=1, seed=0, shrink=False)
+        assert report["errors"] == 1
+
+
+# ---- satellite: fault log lines carry the active trace id -------------------
+
+
+class TestFaultLogTraceId:
+    def test_injection_log_carries_trace_id(self, caplog, monkeypatch):
+        from greptimedb_tpu.utils import tracing
+
+        monkeypatch.setenv("GTPU_CHAOS_LOG_THROTTLE_S", "0")
+        r = FaultRegistry()
+        r.arm("wal.append", Fault(kind="fail", nth=1))
+        tid = tracing.set_trace()
+        try:
+            with caplog.at_level(logging.WARNING,
+                                 logger="greptimedb_tpu.fault"):
+                with pytest.raises(Exception):
+                    r.fire("wal.append")
+        finally:
+            tracing.restore_trace(None)
+        line = next(rec.getMessage() for rec in caplog.records
+                    if "fault injected" in rec.getMessage())
+        assert f"trace_id={tid}" in line
+        assert "point=wal.append" in line and "kind=fail" in line
+
+    def test_injection_log_is_throttled(self, caplog, monkeypatch):
+        monkeypatch.setenv("GTPU_CHAOS_LOG_THROTTLE_S", "60")
+        r = FaultRegistry()
+        r.arm("wal.append", Fault(kind="fail", nth=1, times=5))
+        with caplog.at_level(logging.WARNING,
+                             logger="greptimedb_tpu.fault"):
+            for _ in range(5):
+                with pytest.raises(Exception):
+                    r.fire("wal.append")
+        lines = [rec for rec in caplog.records
+                 if "fault injected" in rec.getMessage()]
+        assert len(lines) == 1, "throttle must collapse a fault storm"
+
+    def test_no_trace_suffix_outside_a_span(self, caplog, monkeypatch):
+        monkeypatch.setenv("GTPU_CHAOS_LOG_THROTTLE_S", "0")
+        r = FaultRegistry()
+        r.arm("wal.append", Fault(kind="fail", nth=1))
+        with caplog.at_level(logging.WARNING,
+                             logger="greptimedb_tpu.fault"):
+            with pytest.raises(Exception):
+                r.fire("wal.append")
+        line = next(rec.getMessage() for rec in caplog.records
+                    if "fault injected" in rec.getMessage())
+        assert "trace_id=" not in line
+
+
+# ---- live: the tier-1 explorer budget ---------------------------------------
+
+
+class TestLiveExplorerBudget:
+    def test_three_randomized_single_datanode_runs(self, tmp_path):
+        """The always-on budget: 3 seeded random schedules + workloads
+        against live single-datanode ProcessClusters, full oracle."""
+        report = ex.explore(runs=3, seed=0, shrink=False,
+                            num_datanodes=1, steps=24)
+        bad = [r for r in report["runs"] if r["outcome"] != "pass"]
+        assert not bad, f"explorer runs failed: {bad}"
+        for r in report["runs"]:
+            assert r["report"]["ops"] >= 24
+            assert "wal_objects_checked" in r["report"]
+
+
+@pytest.mark.slow
+class TestDeepExplorerMatrix:
+    def test_live_outcome_determinism(self):
+        """Same seed, live clusters, twice: same schedule, same acked
+        set, same outcome (the FoundationDB replay property)."""
+        a = ex.explore(runs=2, seed=42, shrink=False,
+                       num_datanodes=1, steps=24)
+        b = ex.explore(runs=2, seed=42, shrink=False,
+                       num_datanodes=1, steps=24)
+        key = [(r["chaos_env"], r["outcome"], r["report"].get("acked"),
+                r["report"].get("typed_failures")) for r in a["runs"]]
+        assert key == [(r["chaos_env"], r["outcome"],
+                        r["report"].get("acked"),
+                        r["report"].get("typed_failures"))
+                       for r in b["runs"]]
+
+    def test_two_datanode_runs_with_kill_nemeses(self):
+        """Multi-datanode matrix: kills + crash schedules + failover,
+        12 seeded runs."""
+        report = ex.explore(runs=12, seed=7, shrink=False,
+                            num_datanodes=2, steps=26)
+        bad = [r for r in report["runs"] if r["outcome"] != "pass"]
+        assert not bad, f"explorer runs failed: {bad}"
+        assert any(r["report"]["killed"] for r in report["runs"]), \
+            "no run exercised a kill nemesis in 12 seeds"
+
+    def test_replay_cli_reproduces_a_seed(self):
+        import subprocess
+        import sys
+
+        out = subprocess.run(
+            [sys.executable, "tools/chaos_explorer.py", "--replay",
+             "--seed", "43"],
+            capture_output=True, text=True, timeout=300)
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "PASS" in out.stdout

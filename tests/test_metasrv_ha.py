@@ -1,0 +1,75 @@
+"""Multi-metasrv election HA: N real metasrv OS processes electing
+over the kv_service wire (cluster/metasrv_cluster.py), exercised by the
+chaos explorer's election mode (fault/explorer.py).
+
+Oracle (scenarios.verify_epochs + run_election_schedule checks):
+at most one leader per lease epoch — proven by a CAS journal wrapped
+around the parent's KV host, not by asking the processes — a leader
+re-emerges after chaos heals, follower redirects stay typed
+(NotLeaderError with a leader hint over HTTP 409), and every tick-time
+failure is typed. Tier-1 keeps one basic wire election + one seeded
+lease-loss run; the seeded chaos matrix (partitions, clock skew) is
+slow-marked."""
+
+import random
+
+import pytest
+
+from greptimedb_tpu.fault import FAULTS
+from greptimedb_tpu.fault import explorer as ex
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def clean_faults():
+    FAULTS.reset()
+    yield
+    FAULTS.reset()
+
+
+class TestWireElection:
+    def test_three_process_election_over_wire(self, tmp_path):
+        """Chaos-free sanity: 3 metasrv processes elect over HTTP, the
+        epoch journal is non-empty and serialized, followers redirect
+        typed."""
+        report = ex.run_election_schedule(
+            [], seed=0, data_dir=str(tmp_path), rounds=12, skews={})
+        assert report["epochs"] >= 1
+        assert report["leader"] in ("meta-0", "meta-1", "meta-2")
+        assert report["redirect_leader_hint"] == report["leader"]
+
+    def test_lease_loss_nemesis_recovers(self, tmp_path):
+        """A deterministic election.lease loss on one peer: the lease
+        lapses, a (possibly different) leader re-acquires, epochs stay
+        serialized, redirects stay typed."""
+        report = ex.run_election_schedule(
+            ["election.lease=fail,nth:2,times:2,@node:meta-0"],
+            seed=1, data_dir=str(tmp_path), rounds=20, skews={})
+        assert report["epochs"] >= 1
+        assert report["leader"] is not None
+
+
+@pytest.mark.slow
+class TestElectionChaosMatrix:
+    def test_seeded_election_matrix(self):
+        """The generative matrix: lease-loss + metasrv.kv faults +
+        metasrv<->kv-host partitions + clock skew, 6 seeds, full
+        oracle, shrinking on."""
+        report = ex.explore(runs=6, seed=0, shrink=True, election=True)
+        bad = [r for r in report["runs"] if r["outcome"] != "pass"]
+        assert not bad, f"election chaos runs failed: {bad}"
+        assert all(r["report"]["epochs"] >= 1 for r in report["runs"])
+
+    def test_clock_skew_never_double_leases(self, tmp_path):
+        """Force a skewed peer on every run: the skew-adjusted epoch
+        oracle (verify_epochs max_skew_ms) must still hold."""
+        for seed in range(4):
+            topo = ex.Topology.election(3)
+            entries = [e.to_env() for e in ex.sample_election_schedule(
+                random.Random(f"schedule:{seed}"), topo)]
+            skews = {"meta-1": 2000.0}
+            report = ex.run_election_schedule(
+                entries, seed, rounds=20, skews=skews)
+            assert report["epochs"] >= 1
+            FAULTS.reset()

@@ -396,8 +396,68 @@ class TestScenarioHarness:
             run.check(False, "acked write h03 lost")
         msg = str(ei.value)
         assert "GTPU_CHAOS_SEED=77" in msg
-        assert 'GTPU_CHAOS="wal.append=enospc,nth:4"' in msg
+        # shlex leaves a shell-safe single entry unquoted
+        assert "GTPU_CHAOS=wal.append=enospc,nth:4" in msg
         assert "python tools/run_scenarios.py wal_enospc" in msg
+        assert ei.value.scenario == "wal_enospc"
+        assert ei.value.repro is not None and "GTPU_CHAOS=" in ei.value.repro
+
+    def test_repro_line_shell_quotes_hostile_entries(self):
+        """Satellite: `;` separators and `<->` edge arrows paste-break
+        an unquoted shell line — repro() must shlex-quote them."""
+        import shlex
+
+        env = ("partition=frontend<->dn-1,nth:2;"
+               "flight.do_get=fail,@edge:frontend->dn-0")
+        run = ScenarioRun("explore[9]", 9, chaos_env=env,
+                          cmd="python tools/chaos_explorer.py --replay "
+                              "--seed 9")
+        line = run.repro()
+        assert shlex.quote(env) in line
+        # the round trip: shell-split the line, recover the env var,
+        # re-arm a fresh registry — the armed schedule must fingerprint
+        # identically to one armed from the original env
+        toks = shlex.split(line)
+        env_tok = next(t for t in toks if t.startswith("GTPU_CHAOS="))
+        recovered = env_tok[len("GTPU_CHAOS="):]
+        assert recovered == env
+        r1, r2 = FaultRegistry(), FaultRegistry()
+        r1.arm_from_env(env)
+        r2.arm_from_env(recovered)
+        assert r1.fingerprint() == r2.fingerprint()
+        assert r1.fingerprint()["partitions"] == {
+            "frontend->dn-1": {"nth": 2, "times": 1},
+            "dn-1->frontend": {"nth": 2, "times": 1}}
+
+    def test_partition_env_window_round_trips(self):
+        """Windowed partition entries (nth/times) survive the env
+        grammar and drop exactly their window of calls."""
+        r = FaultRegistry()
+        r.arm_from_env("partition=frontend<->dn-0,nth:2,times:2")
+        fp = r.fingerprint()
+        assert fp["partitions"]["frontend->dn-0"] == {"nth": 2,
+                                                      "times": 2}
+        dropped = 0
+        for _ in range(5):
+            try:
+                r.fire("flight.do_get", src="frontend", dst="dn-0")
+            except FaultError:
+                dropped += 1
+        assert dropped == 2, "windowed cut must drop calls 2..3 only"
+        with pytest.raises(ValueError):
+            r.arm_from_env("partition=a<->b,bogus:1")
+
+    def test_unknown_node_in_src_dst_matchers_rejected(self):
+        """Satellite: @src/@dst matcher values validate against the
+        registered topology at arm time, like @node and @edge."""
+        FAULTS.register_nodes(["dn-0", "frontend"])
+        with pytest.raises(ValueError, match="unknown node"):
+            FAULTS.arm("flight.do_get",
+                       Fault(kind="fail", match={"src": "dn-9"}))
+        with pytest.raises(ValueError, match="unknown node"):
+            FAULTS.arm_from_env("heartbeat.send=fail,@dst:metasrv-9")
+        FAULTS.arm("flight.do_get",
+                   Fault(kind="fail", match={"src": "frontend"}))
 
     def test_epoch_overlap_is_flagged(self):
         from greptimedb_tpu.fault.scenarios import (

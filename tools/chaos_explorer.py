@@ -1,0 +1,141 @@
+#!/usr/bin/env python
+"""Randomized chaos explorer CLI (greptimedb_tpu/fault/explorer.py).
+
+    python tools/chaos_explorer.py                       # 3 seeded runs
+    python tools/chaos_explorer.py --runs 20 --seed 7 --datanodes 2
+    python tools/chaos_explorer.py --election --runs 5   # metasrv HA
+    python tools/chaos_explorer.py --budget-s 120 --runs 999 --json
+
+Each run samples a random fault schedule + workload from its seed
+(run i uses --seed + i), executes it against a live cluster, and checks
+every invariant. Failing schedules are delta-debugged (ddmin) to a
+minimal entry subset and printed as a GTPU_CHAOS / GTPU_CHAOS_SEED
+repro line; re-run one with:
+
+    python tools/chaos_explorer.py --replay --seed <S> [--election]
+
+which regenerates that seed's schedule and workload bit-for-bit (or
+honors an exported GTPU_CHAOS, e.g. a shrunk subset, verbatim).
+Exit code 1 when any run fails or errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _replay(args) -> int:
+    import random
+
+    from greptimedb_tpu.fault import explorer as ex
+    from greptimedb_tpu.fault.scenarios import InvariantViolation
+
+    env = os.environ.get("GTPU_CHAOS")
+    if env is not None:
+        entries = ex.split_env(env)
+        source = "GTPU_CHAOS"
+    else:
+        rng = random.Random(f"schedule:{args.seed}")
+        if args.election:
+            topo = ex.Topology.election(3)
+            entries = [e.to_env() for e in
+                       ex.sample_election_schedule(rng, topo,
+                                                   args.max_entries)]
+        else:
+            topo = ex.Topology.cluster(args.datanodes)
+            entries = [e.to_env() for e in
+                       ex.sample_schedule(rng, topo, args.max_entries)]
+        source = f"seed {args.seed}"
+    print(f"replaying ({source}): {ex.compile_env(entries)}")
+    try:
+        if args.election:
+            report = ex.run_election_schedule(entries, args.seed,
+                                              rounds=args.rounds)
+        else:
+            report = ex.run_schedule(entries, args.seed,
+                                     num_datanodes=args.datanodes,
+                                     steps=args.steps)
+    except InvariantViolation as e:
+        print(f"FAIL\n{e}")
+        return 1
+    print(f"PASS {json.dumps(report)}")
+    return 0
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--runs", type=int, default=3,
+                   help="number of seeded runs (default 3)")
+    p.add_argument("--seed", type=int, default=0,
+                   help="base seed; run i uses seed+i (default 0)")
+    p.add_argument("--budget-s", type=float, default=None,
+                   help="stop sampling new runs after this many seconds")
+    p.add_argument("--shrink", dest="shrink", action="store_true",
+                   default=True, help="ddmin failing schedules (default)")
+    p.add_argument("--no-shrink", dest="shrink", action="store_false")
+    p.add_argument("--shrink-probes", type=int, default=32,
+                   help="max ddmin probe runs per failure (default 32)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the full machine-readable report")
+    p.add_argument("--datanodes", type=int, default=1,
+                   help="datanodes per sampled cluster (default 1; "
+                        ">=2 enables kill/crash nemeses)")
+    p.add_argument("--steps", type=int, default=28,
+                   help="workload ops per run (default 28)")
+    p.add_argument("--max-entries", type=int, default=4,
+                   help="max schedule entries per run (default 4)")
+    p.add_argument("--election", action="store_true",
+                   help="multi-metasrv election chaos (3 real metasrv "
+                        "processes over the kv_service wire)")
+    p.add_argument("--rounds", type=int, default=24,
+                   help="election mode: chaos tick rounds (default 24)")
+    p.add_argument("--replay", action="store_true",
+                   help="re-run ONE schedule: --seed regenerates it, an "
+                        "exported GTPU_CHAOS overrides it verbatim")
+    args = p.parse_args()
+
+    if args.replay:
+        return _replay(args)
+
+    from greptimedb_tpu.fault import explorer as ex
+
+    report = ex.explore(runs=args.runs, seed=args.seed,
+                        budget_s=args.budget_s, shrink=args.shrink,
+                        num_datanodes=args.datanodes, steps=args.steps,
+                        max_entries=args.max_entries,
+                        election=args.election, rounds=args.rounds,
+                        shrink_probes=args.shrink_probes)
+    if args.json:
+        print(json.dumps(report, indent=1))
+    else:
+        for r in report["runs"]:
+            line = (f"{r['outcome'].upper():5s} seed={r['seed']} "
+                    f"entries={r['entries']} [{r['chaos_env']}] "
+                    f"({r['duration_s']}s)")
+            print(line)
+            if r["outcome"] == "fail":
+                if "shrunk_env" in r:
+                    print(f"      shrunk to {r['shrunk_entries']} "
+                          f"entr{'y' if r['shrunk_entries'] == 1 else 'ies'}: "
+                          f"[{r['shrunk_env']}]")
+                print(f"      {r['violation'].splitlines()[0]}")
+                if r.get("repro"):
+                    print(f"      repro: {r['repro']}")
+            elif r["outcome"] == "error":
+                print(f"      {r['error']}")
+        print(f"\n{report['passed']} passed, {report['failed']} failed, "
+              f"{report['errors']} errors in {report['duration_s']}s"
+              + (" (budget exhausted)"
+                 if report.get("budget_exhausted") else ""))
+    return 1 if (report["failed"] or report["errors"]) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -39,6 +39,8 @@ def main() -> int:
                         f"{DEFAULT_SEED})")
     p.add_argument("--list", action="store_true",
                    help="list scenario names and exit")
+    p.add_argument("--json", action="store_true",
+                   help="emit machine-readable pass/fail + repro lines")
     args = p.parse_args()
 
     if args.list:
@@ -49,27 +51,49 @@ def main() -> int:
     names = args.scenarios or [n for n in SCENARIOS
                                if not n.startswith("smoke_")]
     failed = []
+    results = []
     for name in names:
         t0 = time.monotonic()
+        rec = {"name": name}
         try:
             report = run_scenario(name, seed=args.seed)
         except InvariantViolation as e:
-            print(f"FAIL {name} ({time.monotonic() - t0:.1f}s)\n{e}")
+            rec.update(outcome="fail", violation=str(e),
+                       scenario=e.scenario, repro=e.repro)
+            if not args.json:
+                print(f"FAIL {name} ({time.monotonic() - t0:.1f}s)\n{e}")
             failed.append(name)
         except KeyError as e:
-            print(f"FAIL {name}: {e}")
+            rec.update(outcome="error", error=str(e))
+            if not args.json:
+                print(f"FAIL {name}: {e}")
             failed.append(name)
-        except Exception:  # noqa: BLE001 — one crash must not hide the rest
-            import traceback
+        except Exception as e:  # noqa: BLE001 — one crash must not hide the rest
+            rec.update(outcome="error",
+                       error=f"{type(e).__name__}: {e}")
+            if not args.json:
+                import traceback
 
-            print(f"FAIL {name} ({time.monotonic() - t0:.1f}s) — "
-                  "unexpected error:")
-            traceback.print_exc()
+                print(f"FAIL {name} ({time.monotonic() - t0:.1f}s) — "
+                      "unexpected error:")
+                traceback.print_exc()
             failed.append(name)
         else:
-            detail = " ".join(f"{k}={v}" for k, v in report.items()
-                              if k != "name")
-            print(f"PASS {name} ({time.monotonic() - t0:.1f}s) {detail}")
+            rec.update(outcome="pass", report=report)
+            if not args.json:
+                detail = " ".join(f"{k}={v}" for k, v in report.items()
+                                  if k != "name")
+                print(f"PASS {name} ({time.monotonic() - t0:.1f}s) "
+                      f"{detail}")
+        rec["duration_s"] = round(time.monotonic() - t0, 2)
+        results.append(rec)
+    if args.json:
+        import json
+
+        print(json.dumps({"results": results,
+                          "passed": len(names) - len(failed),
+                          "failed": len(failed)}, indent=1))
+        return 1 if failed else 0
     if failed:
         print(f"\n{len(failed)}/{len(names)} scenarios failed: "
               f"{', '.join(failed)}")
