@@ -1004,11 +1004,12 @@ def bench_device_tier(engine, qe, results):
     """Device-tier micro-phase (ISSUE 7): the headline double-groupby
     shape pinned to the device tier — cold (empty hot set) vs hot-set-
     warm p50, warmup compile seconds, per-query H2D bytes from the
-    transfer-counter deltas, MEASURED hbm utilization from the
-    allocator (not the analytic roofline), and the post-flush query
-    that must re-upload ONLY the new file's blocks."""
-    import jax
+    transfer-counter deltas, accountant-folded achieved GB/s and
+    roofline fraction (ledger bytes over probed link peak — replaces
+    the old allocator-only hbm_utilization readout), and the
+    post-flush query that must re-upload ONLY the new file's blocks."""
     from greptimedb_tpu.datatypes import DictVector, RecordBatch
+    from greptimedb_tpu.utils import ledger, roofline
     from greptimedb_tpu.utils.metrics import (
         DEVICE_HOT_SET_BYTES,
         DEVICE_TRANSFER_BYTES,
@@ -1040,9 +1041,15 @@ def bench_device_tier(engine, qe, results):
     try:
         ex.cache.clear()  # cold: nothing resident in HBM
         c0, b0, f0 = compile_s(), h2d(), fused_dispatches()
-        t0 = time.perf_counter()
-        qe.execute_one(sql)
-        cold_ms = (time.perf_counter() - t0) * 1000
+        # fold the cold run (the bandwidth-bound one: real H2D traffic)
+        # through the per-query ledger so the roofline numbers come from
+        # the same accountant that stamps spans and slow-query records
+        with ledger.attach_fresh() as led:
+            t0 = time.perf_counter()
+            qe.execute_one(sql)
+            cold_ms = (time.perf_counter() - t0) * 1000
+        cold_counts = ledger.derive(led.snapshot()) if led is not None \
+            else {}
         warmup_compile_s = compile_s() - c0
         cold_h2d = h2d() - b0
         path = ex.last_path
@@ -1086,17 +1093,18 @@ def bench_device_tier(engine, qe, results):
             os.environ.pop("GREPTIMEDB_TPU_HOST_TIER", None)
         else:
             os.environ["GREPTIMEDB_TPU_HOST_TIER"] = prev
-    # measured residency, not the analytic roofline: what the allocator
-    # says is actually living in HBM after the warm queries
-    stats = jax.devices()[0].memory_stats() or {}
-    in_use, limit = stats.get("bytes_in_use"), stats.get("bytes_limit")
-    hbm_util = (round(in_use / limit, 4)
-                if in_use and limit else None)
+    # accountant-folded roofline for the cold (bandwidth-bound) run:
+    # ledger bytes over device time vs the probed link peak — the same
+    # numbers stamped on spans, so bench and traces can't disagree
+    rf = roofline.account(cold_counts, duration_ms=cold_ms)
+    achieved = round(rf["achieved_gbps"], 3) if rf else None
+    fraction = round(rf["roofline_fraction"], 4) if rf else None
     log(f"device-tier: cold {cold_ms:.0f} ms ({cold_h2d / 1e6:.0f} MB "
         f"H2D, compile {warmup_compile_s:.1f}s) -> warm {warm_ms:.1f} ms "
         f"({warm_h2d_per_q / 1e6:.2f} MB/query), post-flush "
         f"{incr_ms:.0f} ms ({incr_h2d / 1e6:.1f} MB), path={path}, "
-        f"hot set {hot_bytes / 1e6:.0f} MB, hbm_util={hbm_util}")
+        f"hot set {hot_bytes / 1e6:.0f} MB, achieved_gbps={achieved} "
+        f"roofline_fraction={fraction}")
     results["device_tier"] = {
         "path": path,
         "cold_ms": round(cold_ms, 1),
@@ -1108,7 +1116,8 @@ def bench_device_tier(engine, qe, results):
         "post_flush_h2d_bytes": int(incr_h2d),
         "hot_set_bytes": int(hot_bytes),
         "fused_kernel_dispatches": int(fused_served),
-        "hbm_utilization_measured": hbm_util,
+        "achieved_gbps": achieved,
+        "roofline_fraction": fraction,
         "baseline_ms": None, "vs_baseline": None}
 
 
@@ -1512,6 +1521,7 @@ def bench_qps(qe, results, clients=None, requests_total=None):
     )
     from greptimedb_tpu.utils.metrics import (
         PLAN_CACHE_EVENTS,
+        QUERY_ACHIEVED_GBPS,
         QUERY_BATCH_EVENTS,
     )
 
@@ -1545,6 +1555,8 @@ def bench_qps(qe, results, clients=None, requests_total=None):
                   QUERY_BATCH_EVENTS.get(event="stacked"),
                   QUERY_BATCH_EVENTS.get(event="vmapped"))
         serving0 = _serving_snapshot()
+        gbps0 = (QUERY_ACHIEVED_GBPS.total_count(),
+                 QUERY_ACHIEVED_GBPS.total_sum())
 
         per_client = max(1, requests_total // clients)
         latencies = [[] for _ in range(clients)]
@@ -1655,6 +1667,41 @@ def bench_qps(qe, results, clients=None, requests_total=None):
             "otlp_dropped": int(OTLP_TRACE_SPANS.total(event="dropped")
                                 - otlp0[1]),
         }
+
+        # continuous-profiler overhead A/B (ISSUE 17): the same
+        # sequential lane with the flame sampler on vs fully stopped —
+        # the <=2% budget gate for leaving it always-on in production.
+        # The top-10 self-time digest rides into BENCH detail so the
+        # re-capture lands with attribution built in.
+        from greptimedb_tpu.utils import flame as _fl
+
+        prof_prev = _fl.running()
+        prof_on_rounds, prof_off_rounds, flame_digest = [], [], None
+        try:
+            for _ in range(3):
+                _fl.configure(enabled=True)
+                prof_on_rounds.append(_seq_qps(ab_n))
+                # read the digest while the windows are still live
+                flame_digest = _fl.summary(top=10)
+                _fl.shutdown()
+                prof_off_rounds.append(_seq_qps(ab_n))
+        finally:
+            if prof_prev:
+                _fl.configure(enabled=True)
+        prof_on = float(np.median(prof_on_rounds))
+        prof_off = float(np.median(prof_off_rounds))
+        profiling_ab = {
+            "qps_profiling_on": round(prof_on, 1),
+            "qps_profiling_off": round(prof_off, 1),
+            "overhead_pct": round(
+                (1.0 - prof_on / prof_off) * 100 if prof_off else 0.0, 2),
+            "budget_pct": 2.0,
+            "flame_samples": (flame_digest or {}).get("samples", 0),
+            "flame_attributed": (flame_digest or {}).get("attributed", 0),
+            "flame_top10": [
+                f"{t['frame']} x{t['self']}"
+                for t in (flame_digest or {}).get("top", [])],
+        }
     except Exception as e:  # one config may not sink the whole bench
         log(f"qps bench failed: {e!r}")
         results["qps_single_groupby"] = {"error": repr(e)[:200]}
@@ -1670,6 +1717,13 @@ def bench_qps(qe, results, clients=None, requests_total=None):
             "qps": 0.0, "clients": clients, "requests": 0, "errors": n_err}
         return
     qps = done / wall
+    d_cnt = QUERY_ACHIEVED_GBPS.total_count() - gbps0[0]
+    d_sum = QUERY_ACHIEVED_GBPS.total_sum() - gbps0[1]
+    mean_gbps = (d_sum / d_cnt) if d_cnt else None
+    from greptimedb_tpu.utils import roofline as _rl
+
+    peak = _rl.peak_gbps()
+    rl_fraction = (mean_gbps / peak) if (mean_gbps and peak) else None
     d_hit = PLAN_CACHE_EVENTS.get(event="hit") - cache0[0]
     d_miss = PLAN_CACHE_EVENTS.get(event="miss") - cache0[1]
     hit_rate = d_hit / (d_hit + d_miss) if (d_hit + d_miss) else None
@@ -1692,8 +1746,19 @@ def bench_qps(qe, results, clients=None, requests_total=None):
         f"{tracing_ab['spans_per_query']} spans/query, "
         f"otlp exported {tracing_ab['otlp_exported']} / dropped "
         f"{tracing_ab['otlp_dropped']}")
+    log(f"qps profiling A/B: on {profiling_ab['qps_profiling_on']} vs "
+        f"off {profiling_ab['qps_profiling_off']} qps -> "
+        f"{profiling_ab['overhead_pct']:+.2f}% overhead (budget 2%), "
+        f"{profiling_ab['flame_samples']} samples "
+        f"({profiling_ab['flame_attributed']} attributed); mean achieved "
+        f"{-1.0 if mean_gbps is None else mean_gbps:.3f} GB/s")
     results["qps_single_groupby"] = {
         "tracing_overhead": tracing_ab,
+        "profiling_overhead": profiling_ab,
+        "achieved_gbps_mean": (None if mean_gbps is None
+                               else round(mean_gbps, 4)),
+        "roofline_fraction_mean": (None if rl_fraction is None
+                                   else round(rl_fraction, 6)),
         "qps": round(qps, 1), "clients": clients, "requests": done,
         "errors": n_err,
         "mean_ms": round(float(lats.mean() * 1000), 2),
@@ -2297,8 +2362,11 @@ def roofline_detail(platform, results, rows):
         "achieved_gflops": round(flops / p50_s / 1e9, 1),
     }
     if platform == "tpu":
-        # v5e: 819 GB/s HBM, 197 TFLOP/s bf16 / 98.5 f32 per chip
-        peak_gbps = 819.0
+        # v5e: 819 GB/s HBM, 197 TFLOP/s bf16 / 98.5 f32 per chip —
+        # sourced from the roofline accountant so bench and span stamps
+        # share one peak table
+        from greptimedb_tpu.utils import roofline
+        peak_gbps = roofline.peak_gbps("tpu")
         out["peak_hbm_gbps"] = peak_gbps
         out["hbm_utilization"] = round(
             total_bytes / p50_s / 1e9 / peak_gbps, 3)

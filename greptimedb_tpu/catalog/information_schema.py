@@ -198,7 +198,7 @@ def _slow_queries(qe, ctx):
     cols = {k: [] for k in (
         "trace_id", "kind", "query", "db", "duration_ms", "threshold_ms",
         "rows", "execution_path", "plan_cache_skip", "started_at",
-        "stages", "ledger")}
+        "stages", "ledger", "achieved_gbps", "roofline_fraction")}
     for rec in slow_query.records():
         cols["trace_id"].append(rec.trace_id)
         cols["kind"].append(rec.kind)
@@ -216,6 +216,44 @@ def _slow_queries(qe, ctx):
         from greptimedb_tpu.utils import ledger as _ledger
 
         cols["ledger"].append(_ledger.format_dict(rec.ledger))
+        cols["achieved_gbps"].append(rec.achieved_gbps)
+        cols["roofline_fraction"].append(rec.roofline_fraction)
+    return cols
+
+
+@_virtual("cluster_profile")
+def _cluster_profile(qe, ctx):
+    """Merged continuous-profiling view (utils/flame.py): one row per
+    (node × coarse stage) from the local sampler plus every datanode
+    digest that rode in on Flight piggybacks or heartbeats. Empty when
+    profiling is disabled everywhere. The `share` column is that
+    stage's fraction of the node's samples; `top_frames` names the
+    node's hottest self-time frames."""
+    from greptimedb_tpu.utils import flame
+
+    cols = {k: [] for k in (
+        "node", "stage", "stage_samples", "share", "node_samples",
+        "attributed_ratio", "hz", "window_s", "captured_at",
+        "top_frames")}
+    view = flame.cluster_view()
+    for node in sorted(view["nodes"]):
+        summ = view["nodes"][node]
+        total = summ.get("samples", 0) or 0
+        top = "; ".join(f"{r['frame']} x{r['self']}"
+                        for r in (summ.get("top") or [])[:3])
+        for stage, n in sorted((summ.get("stages") or {}).items()):
+            cols["node"].append(node)
+            cols["stage"].append(stage)
+            cols["stage_samples"].append(int(n))
+            cols["share"].append(round(n / total, 4) if total else 0.0)
+            cols["node_samples"].append(int(total))
+            cols["attributed_ratio"].append(
+                round(summ.get("attributed", 0) / total, 4) if total
+                else 0.0)
+            cols["hz"].append(float(summ.get("hz", 0.0)))
+            cols["window_s"].append(float(summ.get("window_s", 0.0)))
+            cols["captured_at"].append(int(summ.get("ts_ms", 0)))
+            cols["top_frames"].append(top)
     return cols
 
 

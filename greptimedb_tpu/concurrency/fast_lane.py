@@ -60,9 +60,10 @@ from typing import Optional
 
 from greptimedb_tpu.concurrency.plan_cache import _info_matches, normalize
 from greptimedb_tpu.sql import ast
-from greptimedb_tpu.utils import ledger
+from greptimedb_tpu.utils import ledger, roofline
 from greptimedb_tpu.utils.metrics import (
     FAST_LANE_EVENTS,
+    QUERY_ACHIEVED_GBPS,
     STAGE_SECONDS,
     STMT_DURATION,
 )
@@ -591,7 +592,21 @@ class FastLane:
         STAGE_SECONDS.observe(time.perf_counter() - t0, stage="fast_bind")
         t1 = time.perf_counter()
         try:
-            result = qe.executor.execute(plan)
+            # the parse-free lane bypasses execute_statement, so the
+            # roofline accountant folds here too — one observation per
+            # materialization (coalesced followers share the leader's)
+            with ledger.attach() as led:
+                led0 = led.snapshot() if led is not None else {}
+                try:
+                    result = qe.executor.execute(plan)
+                finally:
+                    if led is not None:
+                        d = ledger.diff(led0, led.snapshot())
+                        rf = roofline.account(
+                            d, duration_ms=(time.perf_counter() - t1) * 1e3)
+                        if rf is not None:
+                            QUERY_ACHIEVED_GBPS.observe(
+                                rf["achieved_gbps"], stmt="Select")
         finally:
             STAGE_SECONDS.observe(time.perf_counter() - t1,
                                   stage="fast_execute")

@@ -33,6 +33,9 @@ them over the repo's own AST so the next PR cannot silently regress:
                 (folds tools/check_metrics.py in as a pass)
   options       options.py dataclasses <-> config/standalone.example.toml
                 stay in sync, every scalar option is documented
+  exemplars     serving-hot-path Histograms (query_/statement_/encode_/
+                admission_) must declare exemplars=True so dashboard
+                latency spikes pivot into concrete traces
 
 Escape hatch: `lint_allow.toml` at the repo root — every entry names a
 checker, a path glob, a match substring, and a one-line reason. Unused

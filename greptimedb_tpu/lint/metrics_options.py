@@ -13,10 +13,18 @@ prefix, has help text, and is charted in the Grafana dashboard.
   get);
 - every `_DOC` key still names a real option (stale docs are findings
   too).
+
+`exemplars` keeps the trace<->metric correlation loop closed: every
+serving-hot-path Histogram (query_/statement_/encode_/admission_ —
+the latencies a dashboard spike sends an operator chasing) must be
+registered with `exemplars=True`, so its buckets carry trace ids that
+tools/trace_dump.py can pull. A p99 histogram an operator cannot pivot
+into a concrete trace is a dead end.
 """
 
 from __future__ import annotations
 
+import ast
 import dataclasses
 import os
 
@@ -48,6 +56,47 @@ def check_metrics_pass(repo: Repo) -> list:
     for problem in cm.check(cm.registered_metrics(), dashboard_text):
         findings.append(Finding(
             "metrics", "greptimedb_tpu/utils/metrics.py", 1, problem))
+    return findings
+
+
+#: histogram-name prefixes on the serving hot path: a latency spike in
+#: one of these is what sends an operator from the dashboard into a
+#: trace — without exemplars that pivot is impossible
+_EXEMPLAR_PREFIXES = (
+    "greptimedb_tpu_query_",
+    "greptimedb_tpu_statement_",
+    "greptimedb_tpu_encode_",
+    "greptimedb_tpu_admission_",
+)
+
+
+@checker("exemplars")
+def check_exemplars(repo: Repo) -> list:
+    findings = []
+    for src in repo.files:
+        for node in ast.walk(src.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "histogram"):
+                continue
+            if not (node.args and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue
+            name = node.args[0].value
+            if not name.startswith(_EXEMPLAR_PREFIXES):
+                continue
+            ok = any(
+                kw.arg == "exemplars"
+                and isinstance(kw.value, ast.Constant)
+                and kw.value.value is True
+                for kw in node.keywords)
+            if not ok:
+                findings.append(Finding(
+                    "exemplars", src.path, node.lineno,
+                    f"serving-hot-path histogram '{name}' does not "
+                    "declare exemplars=True — its buckets carry no "
+                    "trace ids, so a latency spike here cannot be "
+                    "pivoted into a trace (tools/trace_dump.py)"))
     return findings
 
 

@@ -206,6 +206,14 @@ class QueryEngine:
                             d = ledger.diff(led0, led.snapshot())
                             if d:
                                 sp["ledger"] = ledger.format_dict(d)
+                                from greptimedb_tpu.utils import roofline
+                                from greptimedb_tpu.utils.metrics import \
+                                    QUERY_ACHIEVED_GBPS
+                                rf = roofline.stamp(sp, d)
+                                if rf is not None:
+                                    QUERY_ACHIEVED_GBPS.observe(
+                                        rf["achieved_gbps"],
+                                        stmt=type(stmt).__name__)
         finally:
             reset_session_tz(tz_token)
 
@@ -1679,6 +1687,10 @@ class QueryEngine:
             summary = led.summary()
             if summary:
                 lines.append(f"  resource ledger: {summary}")
+                from greptimedb_tpu.utils import roofline
+                rf = roofline.account(ledger.derive(led.snapshot()))
+                if rf is not None:
+                    lines.append(f"  roofline: {roofline.format_line(rf)}")
         return lines
 
     # ---- admin -------------------------------------------------------------

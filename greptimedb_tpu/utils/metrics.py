@@ -206,6 +206,21 @@ class Histogram:
         with self._lock:
             return self._count.get(tuple(sorted(labels.items())), 0)
 
+    def total_count(self, **labels) -> int:
+        """Observation count summed over every series whose labels are
+        a superset of the given ones (Counter.total's analog)."""
+        want = set(labels.items())
+        with self._lock:
+            return sum(c for key, c in self._count.items()
+                       if want <= set(key))
+
+    def total_sum(self, **labels) -> float:
+        """Observed-value total over matching series (see total_count)."""
+        want = set(labels.items())
+        with self._lock:
+            return sum(s for key, s in self._sum.items()
+                       if want <= set(key))
+
     def render(self, exemplars: bool = False) -> list[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
         with self._lock:
@@ -398,7 +413,7 @@ INGEST_WAL_FSYNC_SECONDS = REGISTRY.histogram(
     "boundary every queued writer amortizes over)")
 STMT_DURATION = REGISTRY.histogram(
     "greptimedb_tpu_statement_duration_seconds",
-    "Statement execution latency by statement kind")
+    "Statement execution latency by statement kind", exemplars=True)
 
 # resilience plane (fault/ package): every injected fault, every retry,
 # every exhaustion, and every degradation is observable at /metrics so
@@ -531,7 +546,8 @@ ADMISSION_QUEUE_DEPTH = REGISTRY.gauge(
     "Statements currently waiting in the bounded admission queue")
 ADMISSION_WAIT_SECONDS = REGISTRY.histogram(
     "greptimedb_tpu_admission_wait_seconds",
-    "Time queued statements waited for an execution slot")
+    "Time queued statements waited for an execution slot",
+    exemplars=True)
 QUERY_BATCH_EVENTS = REGISTRY.sharded_counter(
     "greptimedb_tpu_query_batch_events_total",
     "Cross-query batching events by kind (join/coalesced/vmapped/"
@@ -540,12 +556,12 @@ QUERY_BATCH_EVENTS = REGISTRY.sharded_counter(
     "runtime latch that degrades to the fallbacks)")
 QUERY_BATCH_SIZE = REGISTRY.histogram(
     "greptimedb_tpu_query_batch_size",
-    "Queries served per batch group (leader + members)")
+    "Queries served per batch group (leader + members)", exemplars=True)
 VMAP_BATCH_WIDTH = REGISTRY.histogram(
     "greptimedb_tpu_query_vmap_batch_width",
     "Distinct parameter-sibling queries executed per vmapped multi-"
     "query dispatch (the stacked member axis M)",
-    buckets=(2, 4, 8, 16, 32, 64, 128))
+    buckets=(2, 4, 8, 16, 32, 64, 128), exemplars=True)
 ENCODE_POOL_EVENTS = REGISTRY.sharded_counter(
     "greptimedb_tpu_encode_pool_events_total",
     "Result-encode pool decisions by kind (offload = serialized on a "
@@ -559,7 +575,8 @@ ENCODE_SECONDS = REGISTRY.histogram(
     "greptimedb_tpu_encode_seconds",
     "Wall time serializing one query result to its wire format "
     "(HTTP JSON / MySQL packets), by protocol — compare against "
-    "query_duration_seconds for the execute-vs-encode split")
+    "query_duration_seconds for the execute-vs-encode split",
+    exemplars=True)
 
 # parse-free serving fast lane (concurrency/fast_lane.py, ISSUE 14): a
 # text-keyed template cache in front of the plan cache — a repeat-shape
@@ -652,6 +669,26 @@ PARTIAL_AGG_DELTA_ROWS = REGISTRY.counter(
     "Rows actually folded by incremental aggregate executions, by kind "
     "(delta = uncached part + memtable rows that ran through kernels, "
     "cached = rows whose partial plane was served from the cache)")
+
+# continuous profiling & roofline (utils/flame.py + utils/roofline.py):
+# the always-on sampler's attribution counts and the per-query achieved
+# memory bandwidth the roofline accountant folds out of the resource
+# ledger — ROADMAP item 1's headline capture metric, now a live series
+PROFILE_SAMPLES = REGISTRY.counter(
+    "greptimedb_tpu_profile_samples_total",
+    "Continuous-profiler stack samples by coarse stage (http/stmt/scan/"
+    "device_agg/... from the innermost active span; host = a busy "
+    "thread outside any span) — attributed/total ratio is the sampler's "
+    "own health metric")
+QUERY_ACHIEVED_GBPS = REGISTRY.histogram(
+    "greptimedb_tpu_query_achieved_gbps",
+    "Per-statement achieved memory bandwidth in GB/s from the roofline "
+    "accountant ((h2d + d2h + decoded bytes) / device span time); "
+    "compare against the chip peak (819 GB/s on v5e) for the roofline "
+    "fraction; buckets carry trace_id exemplars so an anomalous "
+    "bandwidth bin links straight to its trace",
+    buckets=(0.1, 0.5, 1.0, 5.0, 10.0, 25.0, 50.0, 100.0, 200.0, 400.0,
+             819.0), exemplars=True)
 
 # ---- static analysis (tools/gtpu_lint.py, tier-1) --------------------------
 

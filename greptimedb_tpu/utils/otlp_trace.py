@@ -54,6 +54,13 @@ OTLP_TRACE_QUEUE_DEPTH = REGISTRY.gauge(
 OTLP_TRACE_EXPORTS = REGISTRY.counter(
     "greptimedb_tpu_otlp_trace_exports_total",
     "OTLP export batches by outcome (ok/error)")
+OTLP_LOG_RECORDS = REGISTRY.counter(
+    "greptimedb_tpu_otlp_log_records_total",
+    "OTLP log exporter record outcomes by event (exported = delivered "
+    "to /v1/logs, dropped = bounded queue was full, failed = endpoint "
+    "error, throttled = over the per-second rate cap) — fault, "
+    "slow-query, and degradation warnings ride the trace exporter's "
+    "queue with trace_id correlation")
 
 _log = logging.getLogger("greptimedb_tpu.otlp_trace")
 
@@ -116,6 +123,60 @@ def payload(spans, service_name: str = "greptimedb_tpu",
     }
 
 
+#: python logging levelno -> OTLP severityNumber (spec table)
+_SEVERITY = ((logging.CRITICAL, 21, "FATAL"), (logging.ERROR, 17, "ERROR"),
+             (logging.WARNING, 13, "WARN"), (logging.INFO, 9, "INFO"),
+             (logging.DEBUG, 5, "DEBUG"))
+
+
+def _severity(levelno: int):
+    for floor, num, text in _SEVERITY:
+        if levelno >= floor:
+            return num, text
+    return 1, "TRACE"
+
+
+def log_payload(records, service_name: str = "greptimedb_tpu",
+                node: Optional[str] = None) -> dict:
+    """ExportLogsServiceRequest JSON for one batch of log-record dicts
+    (see OtlpLogHandler.emit for the dict shape) — pure, golden-testable
+    like payload()."""
+    resource_attrs = [
+        {"key": "service.name", "value": {"stringValue": service_name}},
+    ]
+    if node:
+        resource_attrs.append(
+            {"key": "service.instance.id", "value": {"stringValue": node}})
+    out = []
+    for r in records:
+        num, text = _severity(int(r.get("levelno", logging.INFO)))
+        rec = {
+            "timeUnixNano": str(int(r.get("ts", 0.0) * 1e9)),
+            "severityNumber": num,
+            "severityText": text,
+            "body": {"stringValue": str(r.get("body", ""))},
+            "attributes": [
+                {"key": "logger",
+                 "value": {"stringValue": str(r.get("logger", ""))}},
+            ],
+        }
+        # trace correlation: same 32-hex zero-pad as span export, so the
+        # backend joins this record to the statement's exported tree
+        tid = r.get("trace_id") or ""
+        if tid:
+            rec["traceId"] = tid.rjust(32, "0")
+        out.append(rec)
+    return {
+        "resourceLogs": [{
+            "resource": {"attributes": resource_attrs},
+            "scopeLogs": [{
+                "scope": {"name": "greptimedb_tpu.logging"},
+                "logRecords": out,
+            }],
+        }],
+    }
+
+
 def _sampled(trace_id: str, ratio: float) -> bool:
     """Deterministic head sampling: the same trace decides the same way
     on every node (crc32 over the id, uniform in [0, 1))."""
@@ -151,6 +212,14 @@ class OtlpTraceExporter:
         self._thread: Optional[threading.Thread] = None
         self._stop = False
         self._fail_streak = 0
+        # log lane: fault/slow-query/degradation records share this
+        # exporter's worker + endpoint host, posted to /v1/logs
+        self.log_endpoint = self.endpoint[:-len("/v1/traces")] + "/v1/logs"
+        self._logq: deque = deque()
+        self._log_rate = 20.0          # records/s token bucket
+        self._log_tokens = self._log_rate
+        self._log_refill = time.monotonic()
+        self._log_fail_streak = 0
 
     # -- producer side (called from tracing._record; must never raise) -------
 
@@ -190,6 +259,36 @@ class OtlpTraceExporter:
         except Exception:  # noqa: BLE001 — telemetry must never hurt a query
             pass
 
+    def on_log(self, record: dict) -> None:
+        """Enqueue one log-record dict (throttled, bounded, never
+        raises) — the OtlpLogHandler's sink."""
+        try:
+            now = time.monotonic()
+            with self._cv:
+                # token bucket: a fault storm logging thousands of
+                # warnings must not monopolize the export lane
+                self._log_tokens = min(
+                    self._log_rate,
+                    self._log_tokens + (now - self._log_refill)
+                    * self._log_rate)
+                self._log_refill = now
+                if self._log_tokens < 1.0:
+                    OTLP_LOG_RECORDS.inc(event="throttled")
+                    return
+                self._log_tokens -= 1.0
+                if len(self._logq) >= self.queue_size:
+                    OTLP_LOG_RECORDS.inc(event="dropped")
+                    return
+                self._logq.append(record)
+                if self._thread is None and not self._stop:
+                    self._thread = threading.Thread(
+                        target=self._run, name="gtpu-otlp-export",
+                        daemon=True)
+                    self._thread.start()
+                self._cv.notify_all()
+        except Exception:  # noqa: BLE001 — telemetry must never hurt a query
+            pass
+
     def _enqueue(self, spans) -> None:
         with self._cv:
             for s in spans:
@@ -212,9 +311,9 @@ class OtlpTraceExporter:
                 # idle: block untimed — producers notify on enqueue and
                 # flush/shutdown notify too, so there is no 20 Hz
                 # wakeup loop on a quiet node
-                while not self._stop and not self._q:
+                while not self._stop and not self._q and not self._logq:
                     self._cv.wait()
-                if self._stop and not self._q:
+                if self._stop and not self._q and not self._logq:
                     return
                 # batch-accumulation window: give a bursting producer
                 # up to flush_interval_s to fill the batch
@@ -226,10 +325,14 @@ class OtlpTraceExporter:
                     self._cv.wait(remaining)
                 chunk = [self._q.popleft()
                          for _ in range(min(self.batch, len(self._q)))]
-                self._busy = len(chunk)
+                logs = [self._logq.popleft()
+                        for _ in range(min(self.batch, len(self._logq)))]
+                self._busy = len(chunk) + len(logs)
                 OTLP_TRACE_QUEUE_DEPTH.set(float(len(self._q)))
             if chunk:
                 self._post(chunk)
+            if logs:
+                self._post_logs(logs)
             with self._cv:
                 self._busy = 0
                 self._cv.notify_all()
@@ -263,12 +366,35 @@ class OtlpTraceExporter:
         OTLP_TRACE_SPANS.inc(float(len(spans)), event="exported")
         OTLP_TRACE_EXPORTS.inc(event="ok")
 
+    def _post_logs(self, records) -> None:
+        from greptimedb_tpu.fault import FAULTS
+
+        try:
+            body = json.dumps(log_payload(records, node=self.node)).encode()
+            # same chaos seam + typed-degradation contract as span
+            # export: an armed otlp.export fault fails this batch too
+            FAULTS.fire("otlp.export")
+            req = urllib.request.Request(
+                self.log_endpoint, data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=self.timeout_s):
+                pass
+        except Exception as e:  # noqa: BLE001 — export must degrade, not raise
+            OTLP_LOG_RECORDS.inc(float(len(records)), event="failed")
+            self._log_fail_streak += 1
+            if self._log_fail_streak == 1 or self._log_fail_streak % 100 == 0:
+                _log.warning("OTLP log export to %s failing (streak %d): %s",
+                             self.log_endpoint, self._log_fail_streak, e)
+            return
+        self._log_fail_streak = 0
+        OTLP_LOG_RECORDS.inc(float(len(records)), event="exported")
+
     def flush(self, timeout_s: float = 5.0) -> bool:
-        """Block until the queue drains (tests / shutdown)."""
+        """Block until both queues drain (tests / shutdown)."""
         deadline = time.monotonic() + timeout_s
         with self._cv:
             self._cv.notify_all()
-            while self._q or self._busy:
+            while self._q or self._logq or self._busy:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     return False
@@ -289,9 +415,45 @@ class OtlpTraceExporter:
             return len(self._q)
 
 
+class OtlpLogHandler(logging.Handler):
+    """logging.Handler that ships warning+ records from the repo's own
+    loggers (fault injections, slow queries, degradations) through the
+    exporter's queue as OTLP logs — trace-correlated via the current
+    trace id, throttled by the exporter's token bucket, and never
+    raising (the logging contract and the telemetry contract agree)."""
+
+    #: never re-export the exporter's own failure warnings: a dead
+    #: collector would otherwise feed its own error log back into the
+    #: queue it cannot drain
+    _SKIP = ("greptimedb_tpu.otlp_trace",)
+
+    def __init__(self, exporter: "OtlpTraceExporter"):
+        super().__init__(level=logging.WARNING)
+        self._exporter = exporter
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            if record.name in self._SKIP:
+                return
+            tid = getattr(record, "trace_id", None)
+            if not tid or tid == "-":
+                from greptimedb_tpu.utils import tracing
+                tid = tracing.current_trace_id() or ""
+            self._exporter.on_log({
+                "ts": record.created,
+                "levelno": record.levelno,
+                "logger": record.name,
+                "body": record.getMessage(),
+                "trace_id": tid,
+            })
+        except Exception:  # noqa: BLE001 — logging must never raise
+            pass
+
+
 # ---- module-level wiring ----------------------------------------------------
 
 _EXPORTER: Optional[OtlpTraceExporter] = None
+_LOG_HANDLER: Optional[OtlpLogHandler] = None
 _install_lock = threading.Lock()
 
 
@@ -302,17 +464,28 @@ def exporter() -> Optional[OtlpTraceExporter]:
 def configure(endpoint: Optional[str], **kwargs) -> Optional[OtlpTraceExporter]:
     """Install (endpoint set) or tear down (empty/None) the process
     exporter and hand it to tracing's span-completion hook."""
-    global _EXPORTER
+    global _EXPORTER, _LOG_HANDLER
     from greptimedb_tpu.utils import tracing
 
     with _install_lock:
         old, _EXPORTER = _EXPORTER, None
         tracing._exporter = None
+        repo_logger = logging.getLogger("greptimedb_tpu")
+        if _LOG_HANDLER is not None:
+            repo_logger.removeHandler(_LOG_HANDLER)
+            _LOG_HANDLER = None
         if old is not None:
             old.shutdown(timeout_s=0.5)
         if endpoint:
             _EXPORTER = OtlpTraceExporter(endpoint, **kwargs)
             tracing._exporter = _EXPORTER
+            # log lane rides the same exporter: fault/slow-query/
+            # degradation warnings under the repo's logger namespace
+            # (gate: GTPU_OTLP_LOGS=off opts out)
+            if os.environ.get("GTPU_OTLP_LOGS", "1").strip().lower() \
+                    not in ("off", "0", "false", "no"):
+                _LOG_HANDLER = OtlpLogHandler(_EXPORTER)
+                repo_logger.addHandler(_LOG_HANDLER)
         return _EXPORTER
 
 
@@ -337,7 +510,8 @@ def maybe_install() -> Optional[OtlpTraceExporter]:
     cfg = (endpoint.rstrip("/"),
            _f("GTPU_OTLP_SAMPLE_RATIO", 1.0),
            int(_f("GTPU_OTLP_QUEUE", 2048)),
-           _f("GTPU_OTLP_FLUSH_S", 2.0))
+           _f("GTPU_OTLP_FLUSH_S", 2.0),
+           os.environ.get("GTPU_OTLP_LOGS", "1"))
     if cur is not None and getattr(cur, "_env_cfg", None) == cfg:
         return cur
     exp = configure(cfg[0], sample_ratio=cfg[1], queue_size=cfg[2],

@@ -15,6 +15,20 @@ import time
 import tracemalloc
 from collections import Counter
 
+#: thread idents of every live profiler/sampler thread — each sampler
+#: (this module's on-demand one, utils/flame.py's continuous one)
+#: registers itself so no flame is ever polluted by the instruments
+#: observing each other. Plain set mutations are GIL-atomic.
+_PROFILER_TIDS: set = set()
+
+
+def register_profiler_thread(tid: int) -> None:
+    _PROFILER_TIDS.add(tid)
+
+
+def unregister_profiler_thread(tid: int) -> None:
+    _PROFILER_TIDS.discard(tid)
+
 
 def sample_cpu(seconds: float = 5.0, hz: float = 99.0,
                include_idle: bool = False) -> str:
@@ -23,15 +37,31 @@ def sample_cpu(seconds: float = 5.0, hz: float = 99.0,
     Returns folded stacks: `frame;frame;...;leaf count` per line, leaf
     last — feed to any flamegraph renderer. Threads blocked in epoll/GIL
     waits are skipped unless include_idle (matching pprof's on-CPU view
-    as closely as a wall sampler can)."""
+    as closely as a wall sampler can). Profiler threads — this one and
+    any registered continuous sampler — are excluded: an earlier version
+    counted its own sampling loop when invoked off the serving thread,
+    so every flame carried a phantom `sample_cpu` tower."""
     deadline = time.monotonic() + seconds
     interval = 1.0 / hz
     stacks: Counter = Counter()
     me = threading.get_ident()
+    register_profiler_thread(me)
+    try:
+        n_samples = _sample_loop(deadline, interval, stacks, include_idle)
+    finally:
+        unregister_profiler_thread(me)
+    lines = [f"# sampler: {n_samples} samples @ {hz:g}Hz over {seconds:g}s"]
+    for stack, count in stacks.most_common():
+        lines.append(f"{stack} {count}")
+    return "\n".join(lines) + "\n"
+
+
+def _sample_loop(deadline: float, interval: float, stacks: Counter,
+                 include_idle: bool) -> int:
     n_samples = 0
     while time.monotonic() < deadline:
         for tid, frame in sys._current_frames().items():
-            if tid == me:
+            if tid in _PROFILER_TIDS:
                 continue
             parts = []
             f = frame
@@ -50,10 +80,7 @@ def sample_cpu(seconds: float = 5.0, hz: float = 99.0,
             stacks[";".join(reversed(parts))] += 1
         n_samples += 1
         time.sleep(interval)
-    lines = [f"# sampler: {n_samples} samples @ {hz:g}Hz over {seconds:g}s"]
-    for stack, count in stacks.most_common():
-        lines.append(f"{stack} {count}")
-    return "\n".join(lines) + "\n"
+    return n_samples
 
 
 _mem_lock = threading.Lock()

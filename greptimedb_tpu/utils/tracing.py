@@ -39,7 +39,8 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Optional
 
-from greptimedb_tpu.utils import ledger
+from greptimedb_tpu.utils import flame as _flame
+from greptimedb_tpu.utils import ledger, roofline
 
 _current: contextvars.ContextVar[Optional[str]] = contextvars.ContextVar(
     "gtpu_trace_id", default=None)
@@ -161,21 +162,34 @@ def span(name: str, **attrs):
     the (mutable) attrs dict so the body can attach result stats it only
     knows at the end (rows, bytes, pruning counts) — they land on the
     recorded span."""
-    if not enabled():
-        yield attrs
-        return
-    sid = new_span_id()
-    parent = _parent.get()
-    token = _parent.set(sid)
-    t0 = time.perf_counter()
-    started = time.time()
+    # the continuous profiler's stage attribution rides span entry/exit
+    # (a thread-id-keyed registry the sampler thread can read — the
+    # contextvar stack is invisible cross-thread); guarded by flame's
+    # fast flag so the cost with profiling off is one attribute read,
+    # and kept alive even with GTPU_TRACING=off so flames stay staged
+    # during tracing A/B runs
+    prof = _flame._ENABLED
+    if prof:
+        _flame.push_stage(name)
     try:
-        yield attrs
+        if not enabled():
+            yield attrs
+            return
+        sid = new_span_id()
+        parent = _parent.get()
+        token = _parent.set(sid)
+        t0 = time.perf_counter()
+        started = time.time()
+        try:
+            yield attrs
+        finally:
+            _parent.reset(token)
+            _record(Span(_current.get(), name,
+                         (time.perf_counter() - t0) * 1000.0,
+                         started, attrs, span_id=sid, parent_id=parent))
     finally:
-        _parent.reset(token)
-        _record(Span(_current.get(), name,
-                     (time.perf_counter() - t0) * 1000.0,
-                     started, attrs, span_id=sid, parent_id=parent))
+        if prof:
+            _flame.pop_stage()
 
 
 @contextlib.contextmanager
@@ -201,9 +215,13 @@ def request_span(name: str, traceparent: Optional[str] = None, **attrs):
                     # a later mutation would race the export serializer
                     # and leave the exported copy ledger-less
                     if led is not None:
-                        summary = led.summary()
-                        if summary:
-                            a["ledger"] = summary
+                        counts = ledger.derive(led.snapshot())
+                        if counts:
+                            a["ledger"] = ledger.format_dict(counts)
+                            # roofline fold on the request root: same
+                            # ledger dict, so the stamped numbers agree
+                            # with the byte counts by construction
+                            roofline.stamp(a, counts)
     finally:
         _parent.reset(tok_par)
         _current.reset(tok_tid)

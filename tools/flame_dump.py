@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""Fetch the continuous-profiling flame view from a running server.
+
+    python tools/flame_dump.py [--addr HOST:PORT] [--user U --password P]
+                               [--stage STAGE] [--speedscope] [--cluster]
+                               [-o FILE]
+
+Default output is folded stacks (`stage;path;frame;... count`) straight
+off `GET /v1/profile/flame` — pipe into flamegraph.pl, or pass
+`--speedscope` for a JSON profile that https://speedscope.app opens
+directly. `--cluster` prints the metasrv/Flight-piggyback rollup from
+`GET /v1/profile/cluster` (per-node sample counts, stage shares, merged
+top frames) instead of the local node's stacks.
+
+Exit code 0 = rendered; 2 = profiling disabled on the target (503 —
+enable `[profiling]` / GTPU_PROFILE); 1 = transport/auth error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import sys
+import urllib.error
+import urllib.parse
+import urllib.request
+
+
+def fetch(addr: str, path: str, user: str = "",
+          password: str = "") -> tuple[bytes, str]:
+    req = urllib.request.Request(f"http://{addr}{path}")
+    if user:
+        cred = base64.b64encode(f"{user}:{password}".encode()).decode()
+        req.add_header("Authorization", f"Basic {cred}")
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return resp.read(), resp.headers.get("Content-Type", "")
+
+
+def render_cluster(view: dict) -> str:
+    lines = []
+    nodes = view.get("nodes") or {}
+    merged = view.get("merged") or {}
+    lines.append(f"cluster profile: {len(nodes)} node(s), "
+                 f"{merged.get('samples', 0)} merged samples")
+    for name in sorted(nodes):
+        n = nodes[name]
+        stages = n.get("stages") or {}
+        total = n.get("samples", 0) or 0
+        share = ", ".join(
+            f"{s} {c} ({c / total:.0%})" if total else f"{s} {c}"
+            for s, c in sorted(stages.items(), key=lambda kv: -kv[1]))
+        lines.append(f"  {name}: {total} samples @ "
+                     f"{n.get('hz', '?')} Hz — {share or 'no stages'}")
+    top = merged.get("top") or []
+    if top:
+        lines.append("  merged top frames:")
+        for t in top:
+            lines.append(f"    {t['frame']} x{t['self']}")
+    return "\n".join(lines)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--addr", default="127.0.0.1:4000",
+                    help="HTTP address (default 127.0.0.1:4000)")
+    ap.add_argument("--user", default="")
+    ap.add_argument("--password", default="")
+    ap.add_argument("--stage", default="",
+                    help="filter folded stacks to one stage prefix")
+    ap.add_argument("--speedscope", action="store_true",
+                    help="speedscope JSON instead of folded stacks")
+    ap.add_argument("--cluster", action="store_true",
+                    help="cluster-wide rollup instead of local stacks")
+    ap.add_argument("-o", "--out", default="",
+                    help="write to FILE instead of stdout")
+    args = ap.parse_args()
+
+    if args.cluster:
+        path = "/v1/profile/cluster"
+    else:
+        q = {}
+        if args.stage:
+            q["stage"] = args.stage
+        if args.speedscope:
+            q["format"] = "speedscope"
+        path = "/v1/profile/flame"
+        if q:
+            path += "?" + urllib.parse.urlencode(q)
+    try:
+        body, ctype = fetch(args.addr, path, args.user, args.password)
+    except urllib.error.HTTPError as e:
+        if e.code == 503:
+            print(f"profiling is disabled on {args.addr} — enable "
+                  "[profiling] in the config or GTPU_PROFILE=1")
+            return 2
+        print(f"HTTP {e.code} from {args.addr}: {e.reason}")
+        return 1
+    except OSError as e:
+        print(f"cannot reach {args.addr}: {e}")
+        return 1
+
+    if args.cluster:
+        out = render_cluster(json.loads(body))
+    elif args.speedscope:
+        out = json.dumps(json.loads(body), indent=2)
+    else:
+        out = body.decode()
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(out if out.endswith("\n") else out + "\n")
+        print(f"wrote {len(out)} bytes ({ctype or 'text/plain'}) "
+              f"to {args.out}")
+    else:
+        print(out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
