@@ -2335,6 +2335,108 @@ def bench_cluster_pushdown(results):
     log(f"cluster_pushdown: {json.dumps(out)}")
 
 
+def bench_tail_latency(results):
+    """Tail-tolerance A/B over real datanode processes: fixed-QPS
+    point-in-time aggregates against a ProcessCluster whose region
+    owner suffers probabilistic 400 ms Flight stalls (injected
+    server-side via GTPU_CHAOS env inheritance, ~2% of reads — inside
+    the <=5% hedge budget by design). Three phases: unstalled baseline,
+    stalled with hedging off, stalled with hedging on — reporting
+    p50/p99/p999, deadline timeouts, and the hedge counters, so the
+    artifact shows whether first-response-wins hedging pulls the
+    stalled p99 back toward the unstalled one without extra load."""
+    import tempfile as _tf
+
+    from greptimedb_tpu.cluster.process_cluster import ProcessCluster
+    from greptimedb_tpu.fault.retry import DeadlineExceeded
+    from greptimedb_tpu.meta.metasrv import MetasrvOptions
+    from greptimedb_tpu.session import QueryContext
+    from greptimedb_tpu.utils.metrics import HEDGE_EVENTS
+
+    SQL = "SELECT count(*), sum(v) FROM cpu"
+    N, INTERVAL_S = 150, 0.02  # ~50 QPS offered, ~3 s per phase
+
+    def mk_cluster(tmp):
+        c = ProcessCluster(tmp, num_datanodes=2, opts=MetasrvOptions())
+        c.beat_all(time.time() * 1000)
+        c.sql("CREATE TABLE cpu (host STRING, v DOUBLE, ts TIMESTAMP "
+              "TIME INDEX, PRIMARY KEY(host))")
+        rows = ", ".join(f"('h{i:03d}', {float(i)}, {1000 * (i + 1)})"
+                         for i in range(200))
+        c.sql(f"INSERT INTO cpu (host, v, ts) VALUES {rows}")
+        return c
+
+    def run_phase(c):
+        lat, timeouts = [], 0
+        c.sql(SQL)  # warm (plan bind + scan cache path)
+        for _ in range(N):
+            t0 = time.perf_counter()
+            try:
+                c.frontend.execute_one(
+                    SQL, QueryContext(db="public", timeout_ms=2000))
+            except DeadlineExceeded:
+                timeouts += 1
+            el = time.perf_counter() - t0
+            lat.append(el * 1000)
+            if INTERVAL_S - el > 0:
+                time.sleep(INTERVAL_S - el)
+        lat.sort()
+
+        def q(p):
+            return round(lat[min(len(lat) - 1, int(p * len(lat)))], 2)
+
+        return {"p50_ms": q(0.50), "p99_ms": q(0.99),
+                "p999_ms": q(0.999), "timeouts": timeouts}
+
+    out = {}
+    saved = {k: os.environ.get(k) for k in
+             ("GTPU_CHAOS", "GTPU_HEDGE", "GTPU_HEDGE_DELAY_MS")}
+    dirs = [_tf.mkdtemp(prefix="gtpu_tail_") for _ in range(2)]
+    try:
+        os.environ.pop("GTPU_CHAOS", None)
+        os.environ["GTPU_HEDGE"] = "off"
+        c = mk_cluster(dirs[0])
+        try:
+            out["unstalled"] = run_phase(c)
+        finally:
+            c.close()
+        # children arm the stall from env at spawn: 400 ms latency on
+        # ~2% of server-side region reads — the per-request straggler
+        # shape hedging exists for (a re-rolled attempt dodges it)
+        os.environ["GTPU_CHAOS"] = \
+            "flight.do_get=latency,arg:0.4,prob:0.02,@side:server"
+        c = mk_cluster(dirs[1])
+        try:
+            out["stalled_hedge_off"] = run_phase(c)
+            os.environ.pop("GTPU_HEDGE", None)  # hedging back on
+            os.environ["GTPU_HEDGE_DELAY_MS"] = "25"
+            before = {ev: HEDGE_EVENTS.get(event=ev) for ev in
+                      ("fired", "won", "lost", "budget_denied")}
+            phase = run_phase(c)
+            phase.update({f"hedges_{ev}": int(HEDGE_EVENTS.get(event=ev)
+                                              - before[ev])
+                          for ev in before})
+            out["stalled_hedge_on"] = phase
+        finally:
+            c.close()
+        base_p99 = max(out["unstalled"]["p99_ms"], 1e-6)
+        out["p99_vs_unstalled"] = {
+            "hedge_off": round(
+                out["stalled_hedge_off"]["p99_ms"] / base_p99, 2),
+            "hedge_on": round(
+                out["stalled_hedge_on"]["p99_ms"] / base_p99, 2)}
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        for d in dirs:
+            shutil.rmtree(d, ignore_errors=True)
+    results["tail_latency"] = out
+    log(f"tail_latency: {json.dumps(out)}")
+
+
 def roofline_detail(platform, results, rows):
     """Analytic achieved-bandwidth/FLOP numbers for the headline query,
     plus the chip roofline when on TPU — the MFU computation the round-3
@@ -2545,6 +2647,7 @@ def main():
         guarded("mesh_scale", lambda: bench_mesh_scale(results))
         guarded("cluster_pushdown",
                 lambda: bench_cluster_pushdown(results))
+        guarded("tail_latency", lambda: bench_tail_latency(results))
         guarded("maintenance",
                 lambda: bench_maintenance(engine, qe, results))
         # PRELIMINARY emit: the quick configs are done — if a big tracked

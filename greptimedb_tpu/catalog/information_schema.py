@@ -221,6 +221,33 @@ def _slow_queries(qe, ctx):
     return cols
 
 
+@_virtual("running_queries")
+def _running_queries(qe, ctx):
+    """Live statements on this frontend (utils/deadline.py RUNNING
+    registry) — id, text, origin, elapsed vs remaining budget, and
+    whether a cancel is already pending. The id column feeds
+    KILL QUERY <id> and DELETE /v1/queries/<id>."""
+    from greptimedb_tpu.utils import deadline
+
+    cols = {k: [] for k in (
+        "id", "query", "db", "channel", "tenant", "trace_id",
+        "started_at", "elapsed_ms", "remaining_ms", "cancelled")}
+    for e in deadline.RUNNING.list():
+        cols["id"].append(e["id"])
+        cols["query"].append(e["query"][:4096])
+        cols["db"].append(e["db"])
+        cols["channel"].append(e["channel"])
+        cols["tenant"].append(e["tenant"])
+        cols["trace_id"].append(e["trace_id"])
+        cols["started_at"].append(e["start_time_ms"])
+        cols["elapsed_ms"].append(round(e["elapsed_ms"], 3))
+        cols["remaining_ms"].append(
+            None if e["remaining_ms"] is None
+            else round(e["remaining_ms"], 3))
+        cols["cancelled"].append(e["cancelled"])
+    return cols
+
+
 @_virtual("cluster_profile")
 def _cluster_profile(qe, ctx):
     """Merged continuous-profiling view (utils/flame.py): one row per

@@ -17,6 +17,8 @@ from __future__ import annotations
 
 import os
 import threading
+import time
+from collections import deque
 from typing import Optional
 
 from ..catalog.catalog import Catalog, TableInfo
@@ -171,6 +173,76 @@ class Datanode:
         self.engine.close()
 
 
+class _HedgePlane:
+    """Adaptive request hedging for remote fragment reads (the
+    tail-tolerance half of `[cluster]`): when a peer's response is
+    slower than its own recent p99 (floored at `hedge_delay_ms`), race
+    a second attempt and take the first response — a per-request
+    straggler (GC pause, queue-head blocking, an injected stall) loses
+    to the hedge instead of setting the query's tail. A token bucket
+    caps hedges at `hedge_budget_pct` of eligible requests so a slow
+    CLUSTER degrades to plain waiting instead of doubling its own load.
+    Knobs ride the env (options.apply_query_env writes them) so child
+    datanode processes and tests see one source of truth."""
+
+    #: burst cap: at most this many banked hedges (bucket depth)
+    _CAP = 10.0
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._lat: dict[str, deque] = {}
+        self._credit = 1.0  # one immediate hedge; then pct-per-request
+
+    @staticmethod
+    def enabled() -> bool:
+        return os.environ.get("GTPU_HEDGE", "") != "off"
+
+    @staticmethod
+    def floor_s() -> float:
+        try:
+            return float(os.environ.get("GTPU_HEDGE_DELAY_MS", "")
+                         or 30.0) / 1000.0
+        except ValueError:
+            return 0.03
+
+    @staticmethod
+    def budget_pct() -> float:
+        try:
+            return float(os.environ.get("GTPU_HEDGE_BUDGET_PCT", "")
+                         or 5.0)
+        except ValueError:
+            return 5.0
+
+    def delay_s(self, peer: str) -> float:
+        """When to fire the hedge: the peer's recent p99, floored — a
+        cold ring (under 8 samples) has no p99 worth trusting."""
+        floor = self.floor_s()
+        with self._lock:
+            ring = self._lat.get(peer)
+            if not ring or len(ring) < 8:
+                return floor
+            srt = sorted(ring)
+            p99 = srt[min(len(srt) - 1, int(len(srt) * 0.99))]
+        return max(floor, p99)
+
+    def record(self, peer: str, elapsed_s: float) -> None:
+        with self._lock:
+            self._lat.setdefault(peer, deque(maxlen=128)).append(elapsed_s)
+
+    def accrue(self) -> None:
+        """One eligible request = pct/100 of a hedge earned."""
+        with self._lock:
+            self._credit = min(self._CAP,
+                               self._credit + self.budget_pct() / 100.0)
+
+    def try_fire(self) -> bool:
+        with self._lock:
+            if self._credit >= 1.0:
+                self._credit -= 1.0
+                return True
+            return False
+
+
 class RegionRouter:
     """Frontend-side region request routing over table routes."""
 
@@ -182,6 +254,7 @@ class RegionRouter:
         # rollup_probe TTL cache: dashboards re-asking the same window
         # within the coverage-state TTL skip the per-region RPC fan-out
         self._rollup_probe_cache: dict[tuple, tuple] = {}
+        self._hedge = _HedgePlane()
         self._lock = threading.Lock()
         metasrv.subscribe_invalidation(self._on_invalidate)
 
@@ -316,10 +389,15 @@ class RegionRouter:
 
     def scan(self, region_id: int, ts_range=None, projection=None,
              tag_predicates=None, seq_min=None):
-        return self._with_failover(
-            region_id,
-            lambda eng: eng.scan(region_id, ts_range, projection,
-                                 tag_predicates, seq_min=seq_min))
+        def op(eng):
+            call = lambda e: e.scan(region_id, ts_range, projection,  # noqa: E731
+                                    tag_predicates, seq_min=seq_min)
+            if hasattr(eng, "execute_fragment") and _HedgePlane.enabled():
+                # wire-mode region read: the same hedge plane as
+                # fragment pushdown — a straggling scan races a backup
+                return self._hedged_call(region_id, eng, call)
+            return call(eng)
+        return self._with_failover(region_id, op)
 
     def scan_stream(self, region_id: int, ts_range=None, projection=None,
                     tag_predicates=None):
@@ -349,16 +427,97 @@ class RegionRouter:
         the node that owns the region (over Flight in wire mode), so
         only the terminal stage's output — partial planes, top-k
         candidates, or filtered rows — returns to the frontend
-        (reference dist_plan Partial/Final split, analyzer.rs:35)."""
+        (reference dist_plan Partial/Final split, analyzer.rs:35).
+        Wire-mode reads hedge (see _HedgePlane): an attempt slower than
+        the peer's adaptive delay races a second one, first response
+        wins, the loser's token is cancelled."""
         def op(eng):
             if hasattr(eng, "execute_fragment"):  # RemoteRegionEngine: wire
-                return eng.execute_fragment(region_id, frag)
+                call = lambda e: e.execute_fragment(region_id, frag)  # noqa: E731
+                if _HedgePlane.enabled():
+                    return self._hedged_call(region_id, eng, call)
+                return call(eng)
             # in-process datanode: same computation, no serialization
             from greptimedb_tpu.query.dist_agg import execute_region_fragment
 
             return execute_region_fragment(self._local_executor_for(eng),
                                            region_id, frag)
         return self._with_failover(region_id, op)
+
+    def _hedged_call(self, region_id: int, eng, call):
+        """First-response-wins hedged dispatch of `call(eng)`.
+
+        Both attempts run under CHILD tokens carrying the outer
+        statement's remaining budget — never the outer token itself, so
+        cancelling the loser cannot cancel the query. The winner's
+        latency feeds the peer's p99 ring; the loser's cancel unwinds
+        its retry loop locally and its server-side work via the
+        budget the ticket carried. The waiter itself stays on the
+        OUTER token: a KILL or deadline during the race unwinds typed
+        here and the finally cancels both attempts."""
+        from greptimedb_tpu.utils import deadline as dl
+        from greptimedb_tpu.utils import tracing
+        from greptimedb_tpu.utils.metrics import HEDGE_EVENTS
+
+        peer = self._region_node.get(self._route_rid(region_id)) or "?"
+        self._hedge.accrue()
+        outer = dl.current()
+        budget = dl.budget_ms()
+        lock = threading.Lock()
+        done = threading.Event()
+        winner: list = [None]  # (tag, ok, value, elapsed_s)
+        tokens: dict[str, dl.CancelToken] = {}
+        # capture the caller's trace context HERE: attempts run on their
+        # own threads, and the remote_region_* spans they open must stay
+        # attached to the statement's span tree
+        run = tracing.propagate(lambda: call(eng))
+
+        def attempt(tag):
+            tok = tokens[tag]
+            t0 = time.monotonic()
+            with dl.activate(tok):
+                try:
+                    ok, val = True, run()
+                except BaseException as e:  # noqa: BLE001 — relayed to waiter
+                    ok, val = False, e
+            with lock:
+                if winner[0] is None:
+                    winner[0] = (tag, ok, val, time.monotonic() - t0)
+                    done.set()
+
+        def spawn(tag):
+            tokens[tag] = dl.CancelToken(timeout_ms=budget)
+            threading.Thread(target=attempt, args=(tag,),
+                             name=f"gtpu-hedge-{tag}", daemon=True).start()
+
+        try:
+            spawn("primary")
+            delay = self._hedge.delay_s(peer)
+            if outer is not None:
+                # a hedge fired after the deadline helps nobody
+                delay = outer.clip(delay)
+            if not done.wait(delay):
+                if self._hedge.try_fire():
+                    HEDGE_EVENTS.inc(event="fired")
+                    spawn("hedge")
+                else:
+                    HEDGE_EVENTS.inc(event="budget_denied")
+            while not dl.wait_event(done, 30.0, where="hedged fragment"):
+                pass
+        finally:
+            with lock:
+                won = winner[0][0] if winner[0] is not None else None
+            for tag, tok in tokens.items():
+                if tag != won:
+                    tok.cancel("hedge loser", kind="cancelled",
+                               count=False)
+        tag, ok, val, elapsed = winner[0]
+        self._hedge.record(peer, elapsed)
+        if "hedge" in tokens:
+            HEDGE_EVENTS.inc(event="won" if tag == "hedge" else "lost")
+        if not ok:
+            raise val
+        return val
 
     #: rollup_probe answers stay valid for about as long as the
     #: datanode-side coverage-state cache (maintenance/rollup.py)

@@ -170,7 +170,34 @@ class AdmissionController:
         if self._tokens:
             self._rescue()
         t0 = time.perf_counter()
-        granted = w.event.wait(self.queue_timeout_s)
+        from greptimedb_tpu.utils import deadline as dl
+
+        try:
+            # deadline/cancel-aware wait: a killed or expired query
+            # leaves the queue typed instead of burning queue_timeout_s
+            granted = dl.wait_event(w.event, self.queue_timeout_s,
+                                    where="admission queue")
+        except Unavailable:
+            waited = time.perf_counter() - t0
+            ADMISSION_WAIT_SECONDS.observe(waited)
+            ledger.add("admission_wait_ms", waited * 1000.0)
+            with self._lock:
+                granted_in_race = w.granted
+                if not granted_in_race:
+                    q = self._queues.get(tenant)
+                    if q is not None:
+                        try:
+                            q.remove(w)
+                            self._queued -= 1
+                            ADMISSION_QUEUE_DEPTH.set(float(self._queued))
+                        except ValueError:
+                            granted_in_race = w.granted
+            if granted_in_race:
+                # a slot was handed over in the race window: give it
+                # back so the typed unwind cannot leak admission
+                self._release()
+            ADMISSION_EVENTS.inc(event="deadline", tenant=tenant)
+            raise
         waited = time.perf_counter() - t0
         ADMISSION_WAIT_SECONDS.observe(waited)
         ledger.add("admission_wait_ms", waited * 1000.0)

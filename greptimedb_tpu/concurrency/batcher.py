@@ -42,13 +42,14 @@ from __future__ import annotations
 
 import dataclasses
 import threading
-import time
 from typing import Optional
 
 import numpy as np
 
+from greptimedb_tpu.fault.retry import Cancelled, DeadlineExceeded
 from greptimedb_tpu.query.result import QueryResult
 from greptimedb_tpu.sql import ast
+from greptimedb_tpu.utils import deadline as dl
 from greptimedb_tpu.utils.metrics import (
     QUERY_BATCH_EVENTS,
     QUERY_BATCH_SIZE,
@@ -282,7 +283,7 @@ class _Relay:
 
 class _Member:
     __slots__ = ("event", "result", "error", "path", "value", "sel",
-                 "self_execute", "relay", "wait_relay")
+                 "self_execute", "relay", "wait_relay", "abandoned")
 
     def __init__(self, value, sel):
         self.event = threading.Event()
@@ -294,6 +295,7 @@ class _Member:
         self.self_execute = False
         self.relay = None       # publish my self-execution here
         self.wait_relay = None  # ride another member's self-execution
+        self.abandoned = False  # deadline/cancel drop-out (no relay duty)
 
 
 class _Group:
@@ -371,7 +373,9 @@ class QueryBatcher:
         interrupted = None
         try:
             if busy and self.window_s > 0:
-                time.sleep(self.window_s)
+                # deadline-aware: an expired leader aborts the window
+                # instead of burning its last budget collecting
+                dl.sleep(self.window_s, "batch window")
         except BaseException as e:  # noqa: BLE001 — members must not hang
             interrupted = e
         finally:
@@ -380,8 +384,14 @@ class QueryBatcher:
                 if self._open.get(gkey) is g:
                     del self._open[gkey]
         if interrupted is not None:
+            typed = isinstance(interrupted, (DeadlineExceeded, Cancelled))
             for m in g.members:
-                m.error = interrupted
+                if typed:
+                    # the leader's own deadline/cancel: members
+                    # re-execute for themselves (see _lead)
+                    m.self_execute = True
+                else:
+                    m.error = interrupted
                 m.event.set()
             raise interrupted
         return self._lead(qe, g, info, ctx)
@@ -393,8 +403,23 @@ class QueryBatcher:
         # finally on ALL exit paths — see execute()/_lead). The
         # periodic wakeup exists only so a wedged process shows a live
         # thread doing something diagnosable instead of parking forever.
-        while not m.event.wait(30.0):
-            pass
+        try:
+            while not dl.wait_event(m.event, 30.0, where="batch member"):
+                pass
+        except (DeadlineExceeded, Cancelled):
+            # drop out of the group: the leader continues for everyone
+            # else. The abandon is claimed under the batcher lock so it
+            # is atomic against the leader's relay assignment — a
+            # member the leader already tasked with relay duty (event
+            # set) must stay and serve it: its execution unwinds typed
+            # below and publishes the error, and duplicates recover by
+            # self-executing.
+            with self._lock:
+                if not m.event.is_set():
+                    m.abandoned = True
+                    raise
+            if not m.self_execute:
+                raise
         if m.error is not None:
             raise m.error
         if m.self_execute:
@@ -418,9 +443,14 @@ class QueryBatcher:
             return res
         if m.wait_relay is not None:
             r = m.wait_relay
-            while not r.event.wait(30.0):
+            while not dl.wait_event(r.event, 30.0, where="batch relay"):
                 pass
             if r.error is not None:
+                if isinstance(r.error, (DeadlineExceeded, Cancelled)):
+                    # the relay executor hit ITS deadline/cancel, not
+                    # ours (our own token raised from the wait above):
+                    # serve this member's statement directly
+                    return qe._select_table(m.sel, info, ctx)
                 raise r.error
             qe.executor.last_path = r.path
             return _copy(r.result, r.result.encode_memo)
@@ -464,25 +494,40 @@ class QueryBatcher:
                 if entry is not SELF_EXECUTE:
                     entry[0].encode_memo = {}
             relays: dict = {}
-            for m in g.members:
-                entry = by_value[m.value]
-                if entry is SELF_EXECUTE:
-                    r = relays.get(m.value)
-                    if r is None:
-                        # first member with this value executes for all
-                        # its duplicates (one execution per distinct
-                        # value, like the old leader-serial fallback —
-                        # but in parallel across values)
-                        relays[m.value] = m.relay = _Relay()
-                        m.self_execute = True
+            # assignment runs under the batcher lock so it is atomic
+            # against a member abandoning on deadline/cancel: an
+            # abandoned member never receives relay duty, and a member
+            # that sees its event set always serves the duty it got
+            with self._lock:
+                for m in g.members:
+                    entry = by_value[m.value]
+                    if entry is SELF_EXECUTE:
+                        r = relays.get(m.value)
+                        if r is None and not m.abandoned:
+                            # first live member with this value executes
+                            # for all its duplicates (one execution per
+                            # distinct value, like the old leader-serial
+                            # fallback — but in parallel across values)
+                            relays[m.value] = m.relay = _Relay()
+                            m.self_execute = True
+                        elif r is not None:
+                            m.wait_relay = r
                     else:
-                        m.wait_relay = r
-                else:
-                    m.result, m.path = entry
-                m.event.set()
+                        m.result, m.path = entry
+                    m.event.set()
             res, path = by_value[g.value]  # the leader always executes
             qe.executor.last_path = path
             return _copy(res, res.encode_memo)
+        except (DeadlineExceeded, Cancelled):
+            # the LEADER's deadline/cancel must not fail the other
+            # members (their deadlines are their own): every unserved
+            # member re-executes its statement on its own thread
+            with self._lock:
+                for m in g.members:
+                    if not m.event.is_set():
+                        m.self_execute = True
+                        m.event.set()
+            raise
         except BaseException as e:
             for m in g.members:
                 if not m.event.is_set():

@@ -35,6 +35,7 @@ import threading
 from concurrent.futures import ProcessPoolExecutor, ThreadPoolExecutor
 from typing import Optional
 
+from greptimedb_tpu.utils import deadline
 from greptimedb_tpu.utils.metrics import (
     ENCODE_POOL_EVENTS,
     ENCODE_POOL_QUEUE_DEPTH,
@@ -173,11 +174,11 @@ class EncodePool:
                 from greptimedb_tpu.utils.metrics import ENCODE_SECONDS
 
                 t0 = time.perf_counter()
-                out = fut.result()
+                out = deadline.wait_future(fut, "encode offload")
                 ENCODE_SECONDS.observe(time.perf_counter() - t0,
                                        protocol="process")
                 return out
-            return fut.result()
+            return deadline.wait_future(fut, "encode offload")
         finally:
             with self._lock:
                 self._inflight -= 1

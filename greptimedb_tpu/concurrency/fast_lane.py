@@ -59,6 +59,7 @@ from collections import OrderedDict
 from typing import Optional
 
 from greptimedb_tpu.concurrency.plan_cache import _info_matches, normalize
+from greptimedb_tpu.fault.retry import Cancelled, DeadlineExceeded
 from greptimedb_tpu.sql import ast
 from greptimedb_tpu.utils import ledger, roofline
 from greptimedb_tpu.utils.metrics import (
@@ -538,6 +539,17 @@ class FastLane:
                         FAST_LANE_EVENTS.inc(event="invalidate")
                         return qe._execute_sql_slow(
                             sql, ctx, _intercepted=intercepted)
+                    except (DeadlineExceeded, Cancelled):
+                        # the fast lane bypasses execute_statement, so
+                        # the deadline event is stamped on the slow-
+                        # query record here
+                        from greptimedb_tpu.utils import deadline as dl
+
+                        tok = dl.current()
+                        w.deadline_event = (tok.kind
+                                            if tok and tok.kind
+                                            else "expired")
+                        raise
                     finally:
                         reset_session_tz(tz_token)
                 w.rows = result.num_rows
@@ -562,7 +574,13 @@ class FastLane:
                 flight = _Flight()
                 self._flights[fkey] = flight
         if not leader:
-            if flight.event.wait(30.0) and flight.done:
+            from greptimedb_tpu.utils import deadline as dl
+
+            # a cancelled/expired follower unwinds typed; the leader
+            # (and everyone else in the flight) keeps executing
+            if dl.wait_event(flight.event, 30.0,
+                             where="fast-lane single-flight") \
+                    and flight.done:
                 FAST_LANE_EVENTS.inc(event="coalesced")
                 if flight.error is not None:
                     raise flight.error
@@ -583,6 +601,9 @@ class FastLane:
             flight.event.set()
 
     def _bind_execute(self, qe, entry, params):
+        from greptimedb_tpu.utils import deadline as dl
+
+        dl.check("fast-lane bind")
         t0 = time.perf_counter()
         try:
             plan = qe.concurrency.plan_cache._bind(entry.plan_entry,

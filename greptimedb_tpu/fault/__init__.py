@@ -179,6 +179,16 @@ def _parse_edge(spec: str) -> list[tuple[str, str]]:
     return [(a, b), (b, a)] if sym else [(a, b)]
 
 
+def _deadline_sleep(delay_s: float, point: str) -> None:
+    """Injected latency honours the caller's deadline: a stall that
+    outlives the query budget surfaces as typed DeadlineExceeded at the
+    injection point instead of blocking uninterruptibly — the same
+    behaviour a real slow peer exhibits once gRPC deadlines fire.
+    Lazy import: utils.deadline depends on fault.retry."""
+    from greptimedb_tpu.utils import deadline
+    deadline.sleep(delay_s, f"injected latency at {point}")
+
+
 class FaultError(Exception):
     """An injected fault. `transient=True` faults model retryable I/O
     errors (including partition drops — a healed cut makes the retry
@@ -575,7 +585,7 @@ class FaultRegistry:
                              **self._counter_labels(labels))
         self._log_injection(point, fault.kind, labels)
         if fault.kind == "latency":
-            time.sleep(fault.arg)
+            _deadline_sleep(fault.arg, point)
             return data, None
         if fault.kind == "fail":
             raise FaultError(point)
@@ -630,7 +640,7 @@ class FaultRegistry:
                              **self._counter_labels(labels))
         self._log_injection(point, fault.kind, labels)
         if fault.kind == "latency":
-            time.sleep(fault.arg)
+            _deadline_sleep(fault.arg, point)
             return
         raise FaultError(point, kind=fault.kind,
                          transient=fault.kind not in ("torn", "enospc"))

@@ -179,8 +179,35 @@ def _sample_timing(rng: random.Random, entry: dict) -> None:
         entry["prob"] = round(rng.uniform(0.05, 0.25), 3)
 
 
-def _sample_arg(rng: random.Random, kind: str) -> Optional[float]:
+#: seams where a beyond-deadline stall exercises the deadline plane —
+#: the points a query's wall-clock actually crosses
+_DEADLINE_STALL_POINTS = frozenset({
+    "flight.do_get", "flight.do_put",
+    "objectstore.read", "objectstore.write",
+})
+
+
+def _sample_deadline_ms(seed: int) -> Optional[int]:
+    """Per-run query deadline, a pure function of the seed alone (so a
+    shrunk replay arms the same budget): ~40% of runs serve under a
+    tight `default_timeout_ms`, the rest run unbounded."""
+    r = random.Random(f"deadline:{seed}")
+    if r.random() < 0.4:
+        return r.randint(400, 1200)
+    return None
+
+
+def _sample_arg(rng: random.Random, kind: str,
+                deadline_ms: Optional[int] = None,
+                point: str = "") -> Optional[float]:
     if kind == "latency":
+        if deadline_ms is not None and point in _DEADLINE_STALL_POINTS \
+                and rng.random() < 0.5:
+            # a stall PAST the run's deadline: the response must still
+            # arrive typed within deadline+ε (the oracle checks), not
+            # hang for the stall's duration times the retry count
+            return round(rng.uniform(deadline_ms * 1.2,
+                                     deadline_ms * 3.0) / 1000.0, 3)
         # small enough to keep retry budgets green, large enough to be
         # on the clock
         return round(rng.uniform(0.001, 0.02), 4)
@@ -190,7 +217,9 @@ def _sample_arg(rng: random.Random, kind: str) -> Optional[float]:
 
 
 def sample_schedule(rng: random.Random, topo: Topology,
-                    max_entries: int = 4) -> list[ScheduleEntry]:
+                    max_entries: int = 4,
+                    deadline_ms: Optional[int] = None
+                    ) -> list[ScheduleEntry]:
     """A seeded random data-plane schedule: distinct points (the
     registry holds ONE schedule per point), oracle-compatible kinds,
     sampled timing, optional @node/@edge scoping, windowed partitions,
@@ -225,7 +254,8 @@ def sample_schedule(rng: random.Random, topo: Topology,
             continue
         kind = rng.choice(CLUSTER_KIND_POOL[point])
         entry: dict = {"point": point, "kind": kind,
-                       "arg": _sample_arg(rng, kind)}
+                       "arg": _sample_arg(rng, kind, deadline_ms,
+                                          point)}
         _sample_timing(rng, entry)
         if point in ("flight.do_get", "flight.do_put") \
                 and rng.random() < 0.3:
@@ -407,12 +437,25 @@ def run_schedule(entries: Sequence, seed: int,
         for e in split_env(chaos_env))
     workload = sample_workload(random.Random(f"workload:{seed}"), steps,
                                topo, allow_kill=not crash_scheduled)
-    if data_dir is None:
-        with tempfile.TemporaryDirectory(prefix="gtpu_explore_") as d:
-            return _run_live(run, chaos_env, seed, d, num_datanodes,
-                             workload)
-    return _run_live(run, chaos_env, seed, data_dir, num_datanodes,
-                     workload)
+    # the per-run deadline replays from the seed alone, so a shrunk
+    # schedule re-arms the same budget the failing run served under
+    deadline_ms = _sample_deadline_ms(seed)
+    saved_timeout = os.environ.get("GTPU_QUERY_DEFAULT_TIMEOUT_MS")
+    if deadline_ms is not None:
+        os.environ["GTPU_QUERY_DEFAULT_TIMEOUT_MS"] = str(deadline_ms)
+        run.report["deadline_ms"] = deadline_ms
+    try:
+        if data_dir is None:
+            with tempfile.TemporaryDirectory(prefix="gtpu_explore_") as d:
+                return _run_live(run, chaos_env, seed, d, num_datanodes,
+                                 workload, deadline_ms=deadline_ms)
+        return _run_live(run, chaos_env, seed, data_dir, num_datanodes,
+                         workload, deadline_ms=deadline_ms)
+    finally:
+        if saved_timeout is None:
+            os.environ.pop("GTPU_QUERY_DEFAULT_TIMEOUT_MS", None)
+        else:
+            os.environ["GTPU_QUERY_DEFAULT_TIMEOUT_MS"] = saved_timeout
 
 
 def _try_create(run: ScenarioRun, cluster, sql: str = CREATE) -> bool:
@@ -460,11 +503,34 @@ def _dead_led_regions(cluster) -> tuple[list[int], list[int]]:
     return reported, orphans
 
 
+def _max_latency_s(chaos_env: str) -> float:
+    """The largest latency-stall arg the schedule can fire — one
+    injected sleep is uninterruptible, so the deadline+ε oracle must
+    tolerate a single full stall on top of the budget."""
+    worst = 0.0
+    for e in split_env(chaos_env):
+        if "=latency" not in e:
+            continue
+        for tok in e.split(","):
+            if tok.startswith("arg:"):
+                try:
+                    worst = max(worst, float(tok[4:]))
+                except ValueError:
+                    pass
+    return worst
+
+
 def _run_live(run: ScenarioRun, chaos_env: str, seed: int,
               data_dir: str, num_datanodes: int,
-              workload: Sequence[tuple]) -> dict:
+              workload: Sequence[tuple],
+              deadline_ms: Optional[int] = None) -> dict:
     stats = {"ops": 0, "acked": 0, "typed_failures": 0, "skipped": 0,
              "killed": []}
+    # within-deadline+ε invariant: ε covers ONE uninterruptible
+    # injected stall (time.sleep at the seam) plus scheduling slack —
+    # what it must NEVER absorb is an unbounded wait
+    deadline_s = None if deadline_ms is None else deadline_ms / 1000.0
+    eps_s = _max_latency_s(chaos_env) + 2.0
     with scenario_cluster(seed, data_dir,
                           num_datanodes=num_datanodes,
                           chaos_env=chaos_env or None) as c:
@@ -497,13 +563,30 @@ def _run_live(run: ScenarioRun, chaos_env: str, seed: int,
                 else:
                     stats["typed_failures"] += 1
             elif kind == "read":
+                t0r = time.monotonic()
                 try:
                     c.sql("SELECT count(*) FROM m")
                 except Exception as e:  # noqa: BLE001 — classified
+                    elapsed = time.monotonic() - t0r
                     run.check(_typed_failure(e),
                               f"read failed with UNTYPED "
                               f"{type(e).__name__}: {e}")
+                    if deadline_s is not None:
+                        run.check(
+                            elapsed <= deadline_s + eps_s,
+                            f"typed read failure took {elapsed:.2f}s "
+                            f"against a {deadline_s:.2f}s deadline "
+                            f"(+{eps_s:.2f}s ε) — a wait the deadline "
+                            "plane cannot reach")
                     stats["typed_failures"] += 1
+                else:
+                    if deadline_s is not None:
+                        elapsed = time.monotonic() - t0r
+                        run.check(
+                            elapsed <= deadline_s + eps_s,
+                            f"read succeeded but took {elapsed:.2f}s "
+                            f"against a {deadline_s:.2f}s deadline "
+                            f"(+{eps_s:.2f}s ε)")
             elif kind == "beat":
                 c.beat_all(t)
                 c.tick(t)
@@ -553,6 +636,9 @@ def _run_live(run: ScenarioRun, chaos_env: str, seed: int,
             | {n for n, d in c.datanodes.items() if not d.alive})
 
         # ---- oracle: verify chaos-free ----------------------------------
+        # the verification reads must not trip the run's tight deadline
+        # on a loaded box: the invariant under test was checked above
+        os.environ.pop("GTPU_QUERY_DEFAULT_TIMEOUT_MS", None)
         FAULTS.heal_partitions()
         FAULTS.reset()
         for dn in c.datanodes.values():
@@ -836,7 +922,9 @@ def explore(runs: int = 3, seed: int = 0,
                        sample_election_schedule(rng, topo, max_entries)]
         else:
             entries = [e.to_env() for e in
-                       sample_schedule(rng, topo, max_entries)]
+                       sample_schedule(
+                           rng, topo, max_entries,
+                           deadline_ms=_sample_deadline_ms(run_seed))]
         rec: dict = {"seed": run_seed, "chaos_env": compile_env(entries),
                      "entries": len(entries)}
         t_run = time.monotonic()

@@ -30,6 +30,23 @@ class Unavailable(Exception):
         self.cause = cause
 
 
+class DeadlineExceeded(Unavailable):
+    """Typed deadline expiry: the query's absolute deadline passed while
+    work was still pending (admission wait, device dispatch, scan
+    decode, a remote fragment...). An Unavailable sibling so existing
+    typed-error plumbing treats it as degradation, but servers map it to
+    the timeout shape (HTTP 408 / MySQL 3024 / PG 57014), never 503.
+    Catch it BEFORE `except Unavailable` at wire boundaries."""
+
+
+class Cancelled(Unavailable):
+    """Typed cooperative cancellation: the query's CancelToken was
+    cancelled (KILL QUERY, DELETE /v1/queries/<id>, or client
+    disconnect) while work was still pending. Like DeadlineExceeded, an
+    Unavailable sibling with its own wire mapping (HTTP 499 / MySQL 1317
+    / PG 57014); catch before `except Unavailable`."""
+
+
 def _env_float(name: str, default: float) -> float:
     try:
         return float(os.environ.get(name, default))
@@ -76,8 +93,15 @@ def retry_call(op: Callable, *, point: str,
     """Run `op()` under the retry policy. An exception retries when the
     shared transience predicate says so (injected faults, self-described
     transient errors) or it is an instance of `retryable`. Non-transient
-    errors (not-found, auth, torn writes) surface immediately."""
+    errors (not-found, auth, torn writes) surface immediately.
+
+    Deadline/cancel aware: the backoff sleep waits on the active query's
+    CancelToken (utils/deadline) instead of an uninterruptible
+    time.sleep, is clipped to the query's remaining budget, and a token
+    already expired/cancelled re-raises typed before the next attempt —
+    a killed query never lingers through backoff."""
     from greptimedb_tpu.fault import is_transient  # late: sibling module
+    from greptimedb_tpu.utils import deadline as dl
 
     policy = policy or DEFAULT_POLICY
     rng = rng or _jitter_rng
@@ -87,8 +111,11 @@ def retry_call(op: Callable, *, point: str,
         try:
             return op()
         except Exception as e:  # noqa: BLE001 — predicate filters below
+            if isinstance(e, (DeadlineExceeded, Cancelled)):
+                raise  # typed unwind, never worth a retry
             if not (is_transient(e) or isinstance(e, tuple(retryable))):
                 raise
+            dl.check(point)  # expired/killed mid-attempt: unwind typed
             attempt += 1
             if attempt >= policy.max_attempts \
                     or time.monotonic() >= deadline:
@@ -97,5 +124,6 @@ def retry_call(op: Callable, *, point: str,
             RETRY_ATTEMPTS.inc(point=point)
             delay = policy.backoff_s(attempt - 1, rng)
             if delay > 0:
-                time.sleep(min(delay, max(0.0,
-                                          deadline - time.monotonic())))
+                dl.sleep(min(delay, max(0.0,
+                                        deadline - time.monotonic())),
+                         point=point)

@@ -225,6 +225,7 @@ def _import_checkers() -> None:
         blocking,
         datarace,
         deadcode,
+        deadline,
         fault_seam,
         jax_imports,
         lockgraph,

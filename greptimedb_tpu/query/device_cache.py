@@ -137,8 +137,12 @@ class DeviceCache:
                 return hit
             fut = self._inflight.get(key)
         if fut is not None:
+            from greptimedb_tpu.utils import deadline as dl
+
             try:
-                arr = fut.result()
+                arr = dl.wait_future(fut, "device prefetch join")
+            except (dl.DeadlineExceeded, dl.Cancelled):
+                raise  # typed unwind, not a failed prefetch
             except Exception:  # noqa: BLE001 — prefetch is best-effort
                 arr = None
             if arr is not None:

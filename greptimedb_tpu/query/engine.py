@@ -82,6 +82,38 @@ class QueryEngine:
             # every protocol builds its own ctx; the engine-level default
             # (default_timezone option) applies unless the client set one
             ctx.timezone = self.default_timezone
+        from greptimedb_tpu.utils import deadline as dl
+
+        if dl.current() is not None:
+            # nested statement (view expansion, TQL-in-SQL, a batch
+            # member re-entering) rides the outer statement's token —
+            # a fresh one would let inner work outlive the outer kill
+            if ctx.cancel_token is None:
+                ctx.cancel_token = dl.current()
+            return self._dispatch_lane(sql, ctx)
+        # top level: the statement runs under one CancelToken for its
+        # whole life — deadline from the client (timeout_ms stamped by
+        # the server), the session vars, or [query] default_timeout_ms;
+        # registered so KILL QUERY / DELETE /v1/queries can find it
+        token = ctx.cancel_token  # servers pre-create for disconnect
+        created = token is None
+        if created:
+            token = dl.CancelToken()
+            ctx.cancel_token = token
+        token.set_timeout(self._resolve_timeout_ms(ctx))
+        qid = dl.RUNNING.register(
+            token, sql, db=ctx.db,
+            channel=getattr(ctx.channel, "value", str(ctx.channel)),
+            tenant=ctx.tenant or "", trace_id=ctx.trace_id or "")
+        try:
+            with dl.activate(token):
+                return self._dispatch_lane(sql, ctx)
+        finally:
+            dl.RUNNING.unregister(qid)
+            if created:
+                ctx.cancel_token = None
+
+    def _dispatch_lane(self, sql: str, ctx: QueryContext) -> list[QueryResult]:
         # parse-free fast lane: a known statement template executes its
         # cached bound plan with zero parse/AST/planning; everything
         # else (and every first sighting) takes _execute_sql_slow below
@@ -89,6 +121,22 @@ class QueryEngine:
         if fl.enabled:
             return fl.execute(self, sql, ctx)
         return self._execute_sql_slow(sql, ctx)
+
+    def _resolve_timeout_ms(self, ctx: QueryContext):
+        """Deadline precedence: explicit client timeout (header) >
+        session vars (MySQL max_execution_time / PG statement_timeout,
+        landed in ctx.extensions via SET) > [query] default_timeout_ms;
+        0/absent everywhere = unbounded."""
+        from greptimedb_tpu.utils import deadline as dl
+
+        if ctx.timeout_ms is not None and ctx.timeout_ms > 0:
+            return float(ctx.timeout_ms)
+        for var in ("max_execution_time", "statement_timeout"):
+            t = dl.parse_timeout_ms(ctx.extensions.get(var))
+            if t is not None and t > 0:
+                return t
+        t = dl.default_timeout_ms()
+        return t if t > 0 else None
 
     def _execute_sql_slow(self, sql: str, ctx: QueryContext,
                           _intercepted: bool = False) -> list[QueryResult]:
@@ -183,7 +231,7 @@ class QueryEngine:
         # new top-level statement: its first plan-cache skip (if any)
         # is the one that gets counted/recorded
         self._skip_tls.noted = False
-        from greptimedb_tpu.utils import ledger, tracing
+        from greptimedb_tpu.utils import ledger, slow_query, tracing
         from greptimedb_tpu.utils.metrics import STMT_DURATION
         ctx.trace_id = tracing.set_trace(ctx.trace_id)
         from greptimedb_tpu.query.expr import reset_session_tz, set_session_tz
@@ -200,7 +248,30 @@ class QueryEngine:
                 with ledger.attach() as led:
                     led0 = led.snapshot() if led is not None else {}
                     try:
-                        return self._execute_statement(stmt, ctx)
+                        from greptimedb_tpu.fault.retry import (
+                            Cancelled,
+                            DeadlineExceeded,
+                        )
+                        from greptimedb_tpu.utils import deadline as dl
+
+                        try:
+                            dl.check(f"{type(stmt).__name__} start")
+                            return self._execute_statement(stmt, ctx)
+                        except (DeadlineExceeded, Cancelled) as e:
+                            # stamp the terminal deadline event on the
+                            # statement span, the resource ledger, and
+                            # (if the statement turns out slow — it
+                            # usually is, that's why it expired) the
+                            # slow-query record
+                            tok = dl.current()
+                            kind = (tok.kind if tok and tok.kind else
+                                    ("expired"
+                                     if isinstance(e, DeadlineExceeded)
+                                     else "cancelled"))
+                            sp["deadline_event"] = kind
+                            ledger.add(f"deadline_{kind}", 1)
+                            slow_query.annotate(deadline_event=kind)
+                            raise
                     finally:
                         if led is not None:
                             d = ledger.diff(led0, led.snapshot())
@@ -229,6 +300,15 @@ class QueryEngine:
             return QueryResult.of_affected(1)
         if isinstance(stmt, ast.SetVar):
             return self._set_var(stmt, ctx)
+        if isinstance(stmt, ast.KillQuery):
+            from greptimedb_tpu.utils import deadline as dl
+
+            if not dl.RUNNING.kill(stmt.query_id,
+                                   reason="KILL QUERY"):
+                raise PlanError(
+                    f"unknown query id: {stmt.query_id} (see "
+                    "information_schema.running_queries)")
+            return QueryResult.of_affected(1)
         if isinstance(stmt, ast.Union):
             return self._union(stmt, ctx)
         if isinstance(stmt, ast.Insert):
@@ -966,8 +1046,10 @@ class QueryEngine:
 
         FRAGMENT_PUSHDOWNS.inc(mode="window")
         with tracing.span("window_pushdown", regions=len(info.region_ids)):
-            one = tracing.propagate(
-                lambda rid: eng.execute_fragment(rid, frag))
+            from greptimedb_tpu.utils import deadline as dl
+
+            one = dl.propagate(tracing.propagate(
+                lambda rid: eng.execute_fragment(rid, frag)))
 
             with ThreadPoolExecutor(
                     max_workers=min(8, len(info.region_ids))) as pool:

@@ -763,7 +763,15 @@ def _prefetch(items, depth: int = 2):
     t.start()
     try:
         while True:
-            item = q.get()
+            try:
+                item = q.get(timeout=0.1)
+            except queue.Empty:
+                # deadline checkpoint: a cancelled/expired consumer
+                # unwinds typed; the finally stops the producer
+                from greptimedb_tpu.utils import deadline as dl
+
+                dl.check("streaming scan wait")
+                continue
             if item is sentinel:
                 break
             yield item
@@ -778,9 +786,11 @@ def _prefetch(items, depth: int = 2):
                 q.get_nowait()
         except queue.Empty:
             pass
-        # unbounded: the producer exits after its CURRENT read; waiting
-        # keeps SST file pins valid until no thread touches the files
-        t.join()
+        # the producer exits after its CURRENT read; waiting keeps SST
+        # file pins valid until no thread touches the files (bounded
+        # laps, never abandoned — the pin contract is absolute)
+        while t.is_alive():
+            t.join(0.1)
 
 
 class _NotStreamable(Exception):
@@ -1596,8 +1606,13 @@ class PhysicalExecutor:
                 # region streams concurrently for the same reason)
                 from concurrent.futures import ThreadPoolExecutor
 
-                one = tracing.propagate(
-                    lambda rid: self.engine.execute_fragment(rid, frag))
+                from greptimedb_tpu.utils import deadline as dl
+
+                # the statement's CancelToken rides into every region
+                # worker: a stalled region unwinds typed at the deadline
+                # instead of pinning the fan-out past it
+                one = dl.propagate(tracing.propagate(
+                    lambda rid: self.engine.execute_fragment(rid, frag)))
 
                 with ThreadPoolExecutor(
                         max_workers=min(8, len(rids))) as pool:
@@ -3151,10 +3166,17 @@ class PhysicalExecutor:
         """Walk the block plan through `fetch`, double-buffering block
         i+1's host build + H2D behind block i's assembly (the upload
         prefetch worker). Returns (blocks, n_valids, dedup block masks)."""
+        from greptimedb_tpu.utils import deadline as dl
+
         blocks, n_valids = [], []
         dmasks = [] if dedup_mask is not None else None
         do_prefetch = self._upload_prefetch_ok(scan)
         for i, entry in enumerate(plan):
+            # host-level deadline checkpoint per device block: the
+            # jitted kernels below can't be interrupted, but a streamed
+            # scan crosses here once per block — an expired or killed
+            # query stops dispatching instead of walking the whole plan
+            dl.check("device dispatch")
             if do_prefetch and i + 1 < len(plan):
                 # double buffering: the background worker builds and
                 # uploads block i+1 while this thread assembles

@@ -731,8 +731,10 @@ def _run_vmapped_fragments(executor, sel, info, pspecs, member_values,
     rids = list(table.region_ids)
     m = len(member_values)
     with tracing.span("vmapped_fragments", regions=len(rids), members=m):
-        one = tracing.propagate(
-            lambda rid: executor.engine.execute_fragment(rid, frag))
+        from greptimedb_tpu.utils import deadline as dl
+
+        one = dl.propagate(tracing.propagate(
+            lambda rid: executor.engine.execute_fragment(rid, frag)))
         if len(rids) > 1:
             with ThreadPoolExecutor(
                     max_workers=min(8, len(rids))) as pool:

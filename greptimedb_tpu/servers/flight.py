@@ -47,6 +47,25 @@ RETRYABLE_FLIGHT = (fl.FlightUnavailableError, fl.FlightTimedOutError,
                     fl.FlightInternalError)
 
 
+def _call_options() -> Optional[fl.FlightCallOptions]:
+    """Per-call gRPC deadline from the active query token: a stalled
+    peer then fails the call locally (FlightTimedOutError) right at the
+    query deadline instead of blocking in read_all() forever — the
+    retry loop's deadline check converts that into typed
+    DeadlineExceeded. Recomputed per attempt so retries ride the
+    shrinking budget. Floor keeps an almost-spent budget from turning
+    into timeout=0 (gRPC treats that as already-expired)."""
+    from greptimedb_tpu.utils import deadline as dl
+
+    token = dl.current()
+    if token is None:
+        return None
+    remaining = token.remaining_s()
+    if remaining is None:
+        return None
+    return fl.FlightCallOptions(timeout=max(0.05, remaining))
+
+
 # ---- QueryResult ⇄ Arrow: shared converters live in datasource ------------
 
 from greptimedb_tpu.datasource import result_to_table, table_to_result  # noqa: E402,F401
@@ -376,12 +395,21 @@ class FlightServer(fl.FlightServerBase):
         from greptimedb_tpu.storage.index import deserialize_predicates
         preds = deserialize_predicates(
             req.get("tag_predicates_v2") or req.get("tag_predicates"))
+        from greptimedb_tpu.utils import deadline as dl
+        from greptimedb_tpu.utils.metrics import REQUEST_BUDGET_REMAINING
+
+        budget = req.get("budget_ms")
+        if budget is not None:
+            REQUEST_BUDGET_REMAINING.observe(float(budget))
         # adopt the caller's trace AND parent span (region_server.rs:74
         # analog): this datanode's region_scan re-parents under the
         # frontend span that issued the RPC, so the merged ANALYZE tree
-        # nests across the process hop
-        with tracing.adopt_remote(req.get("trace_id"),
-                                  req.get("parent_span")), \
+        # nests across the process hop. The ticket's remaining budget
+        # becomes a local token: a scan whose frontend already gave up
+        # unwinds typed here instead of burning datanode workers.
+        with dl.activate(dl.token_for_budget(budget)), \
+                tracing.adopt_remote(req.get("trace_id"),
+                                     req.get("parent_span")), \
                 tracing.collect_spans() as sink:
             with tracing.span("region_scan", region=region_id) as attrs:
                 # server-side injection INSIDE the scan span: latency
@@ -424,8 +452,15 @@ class FlightServer(fl.FlightServerBase):
         if self._agg_executor is None:
             from greptimedb_tpu.query.physical import PhysicalExecutor
             self._agg_executor = PhysicalExecutor(self.engine)
-        with tracing.adopt_remote(req.get("trace_id"),
-                                  req.get("parent_span")), \
+        from greptimedb_tpu.utils import deadline as dl
+        from greptimedb_tpu.utils.metrics import REQUEST_BUDGET_REMAINING
+
+        budget = req.get("budget_ms")
+        if budget is not None:
+            REQUEST_BUDGET_REMAINING.observe(float(budget))
+        with dl.activate(dl.token_for_budget(budget)), \
+                tracing.adopt_remote(req.get("trace_id"),
+                                     req.get("parent_span")), \
                 tracing.collect_spans() as sink:
             with tracing.span("region_frag", region=region_id,
                               stages=len(frag.stages)):
@@ -714,7 +749,25 @@ class RemoteRegionEngine:
                 FAULTS.fire(point, addr=self.addr, side="client",
                             src=local_node(), dst=self.peer or self.addr)
                 return fn()
-            return retry_call(op, point=point, retryable=RETRYABLE_FLIGHT)
+            try:
+                return retry_call(op, point=point,
+                                  retryable=RETRYABLE_FLIGHT)
+            except Exception as e:
+                from greptimedb_tpu.fault.retry import (
+                    Cancelled,
+                    DeadlineExceeded,
+                )
+                from greptimedb_tpu.utils import deadline as dl
+
+                if isinstance(e, (DeadlineExceeded, Cancelled)):
+                    raise
+                # the datanode enforcing the ticket's budget raises its
+                # own typed error, but it crosses the wire as an opaque
+                # FlightServerError — once OUR budget is spent, the
+                # typed deadline outranks whichever wire error the race
+                # produced (gRPC timeout vs server-side unwind)
+                dl.check(point)
+                raise
 
     def _merge_remote_spans(self, meta) -> None:
         """Fold the response's piggybacked datanode spans into the local
@@ -859,6 +912,14 @@ class RemoteRegionEngine:
             if legacy:  # shape old peers can parse (InSets only)
                 spec["tag_predicates"] = legacy
             spec["tag_predicates_v2"] = serialize_predicates(tag_predicates)
+        from greptimedb_tpu.utils import deadline as dl
+
+        budget = dl.budget_ms()
+        if budget is not None:
+            # remaining budget rides the ticket so the datanode enforces
+            # the deadline server-side (the frontend token can't cross
+            # the process boundary)
+            spec["budget_ms"] = budget
         tid = tracing.current_trace_id()
         if tid:
             # W3C-style propagation: the frontend's trace id crosses the
@@ -871,8 +932,8 @@ class RemoteRegionEngine:
                 # under THIS span in the merged tree
                 spec["parent_span"] = tracing.current_span_id()
             ticket = fl.Ticket(json.dumps({"region_scan": spec}).encode())
-            t = self._rpc("flight.do_get",
-                          lambda: self.client.do_get(ticket).read_all())
+            t = self._rpc("flight.do_get", lambda: self.client.do_get(
+                ticket, _call_options()).read_all())
         self._merge_remote_spans(t.schema.metadata)
         if (t.schema.metadata or {}).get(b"empty") == b"1":
             return None
@@ -886,6 +947,11 @@ class RemoteRegionEngine:
         from greptimedb_tpu.utils import tracing
 
         spec = {"region_id": region_id, "fragment": frag.to_json()}
+        from greptimedb_tpu.utils import deadline as dl
+
+        budget = dl.budget_ms()
+        if budget is not None:
+            spec["budget_ms"] = budget
         tid = tracing.current_trace_id()
         if tid:
             spec["trace_id"] = tid
@@ -894,8 +960,8 @@ class RemoteRegionEngine:
             if tid:
                 spec["parent_span"] = tracing.current_span_id()
             ticket = fl.Ticket(json.dumps({"region_frag": spec}).encode())
-            t = self._rpc("flight.do_get",
-                          lambda: self.client.do_get(ticket).read_all())
+            t = self._rpc("flight.do_get", lambda: self.client.do_get(
+                ticket, _call_options()).read_all())
         self._merge_remote_spans(t.schema.metadata)
         md = t.schema.metadata or {}
         if md.get(b"empty") == b"1":
@@ -918,7 +984,8 @@ class RemoteRegionEngine:
         body = json.dumps({"region_id": region_id, "lo": int(lo),
                            "hi": int(hi)}).encode()
         res = self._rpc("flight.do_get", lambda: list(
-            self.client.do_action(fl.Action("rollup_probe", body))))
+            self.client.do_action(fl.Action("rollup_probe", body),
+                                  _call_options())))
         return json.loads(res[0].body.to_pybytes().decode())
 
     def scan_stream(self, region_id: int, ts_range=None, projection=None,

@@ -21,6 +21,7 @@ import numpy as np
 
 from greptimedb_tpu.catalog.catalog import CatalogError
 from greptimedb_tpu.fault import FaultError, Unavailable
+from greptimedb_tpu.fault.retry import Cancelled, DeadlineExceeded
 from greptimedb_tpu.query.engine import QueryContext, QueryEngine
 from greptimedb_tpu.query.result import QueryResult
 from greptimedb_tpu.utils.metrics import HTTP_REQUESTS, QUERY_DURATION, REGISTRY
@@ -149,12 +150,20 @@ class _Handler(BaseHTTPRequestHandler):
         tenant = self.headers.get("X-Greptime-Tenant") \
             or params.get("tenant") \
             or getattr(user, "username", None)
+        # X-Greptime-Timeout: per-request deadline ("500ms", "5s", or a
+        # bare millisecond count); absent = session/config default
+        from greptimedb_tpu.utils import deadline
+
+        timeout_ms = deadline.parse_timeout_ms(
+            self.headers.get("X-Greptime-Timeout")
+            or params.get("timeout"))
         from greptimedb_tpu.utils import tracing
 
         return QueryContext(db=params.get("db", "public"),
                             channel=Channel.HTTP,
                             timezone=tz or None,
                             tenant=tenant,
+                            timeout_ms=timeout_ms,
                             user=user,
                             # the request trace installed by _route's
                             # ingress span (adopted from an incoming
@@ -222,6 +231,16 @@ class _Handler(BaseHTTPRequestHandler):
                                           "traceparent")):
                 self._traceparent = tracing.to_traceparent()
                 return self._route_traced(path)
+        except DeadlineExceeded as e:
+            # typed deadline expiry: the timeout shape (408), not 503 —
+            # the client asked for the bound it just hit
+            self._send(408, {"code": 3001, "error": str(e),
+                             "execution_time_ms": 0})
+        except Cancelled as e:
+            # typed cancellation (KILL / DELETE-to-kill / disconnect):
+            # nginx's 499 "client closed request" shape
+            self._send(499, {"code": 3002, "error": str(e),
+                             "execution_time_ms": 0})
         except Unavailable as e:
             # typed degradation (retries + route refresh exhausted): a
             # 503 the client should back off on, not a stack trace
@@ -349,6 +368,8 @@ class _Handler(BaseHTTPRequestHandler):
                     "trace_id": tid,
                     "spans": wire,
                     "tree": tracing.render_tree(spans)})
+            if path == "/v1/queries" or path.startswith("/v1/queries/"):
+                return self._handle_queries(path)
             if path == "/v1/sql":
                 return self._handle_sql()
             if path == "/v1/promql":
@@ -384,6 +405,12 @@ class _Handler(BaseHTTPRequestHandler):
             if path == "/v1/run-script":
                 return self._handle_run_script()
             return self._send(404, {"error": f"no route {path}"})
+        except DeadlineExceeded as e:
+            self._send(408, {"code": 3001, "error": str(e),
+                             "execution_time_ms": 0})
+        except Cancelled as e:
+            self._send(499, {"code": 3002, "error": str(e),
+                             "execution_time_ms": 0})
         except Unavailable as e:
             # typed degradation (retries + route refresh exhausted): a
             # 503 the client should back off on, not a stack trace
@@ -394,19 +421,53 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(400, {"code": 3000, "error": str(e),
                              "execution_time_ms": 0})
 
+    # ---- /v1/queries (running-queries surface) -----------------------------
+
+    def do_DELETE(self):
+        self._route()
+
+    def _handle_queries(self, path: str):
+        """GET /v1/queries lists live statements on this frontend;
+        DELETE /v1/queries/<id> cancels one (the HTTP twin of
+        KILL QUERY <id>)."""
+        from greptimedb_tpu.utils import deadline
+
+        if self.command == "DELETE":
+            qid_s = path[len("/v1/queries/"):] \
+                if path.startswith("/v1/queries/") else ""
+            try:
+                qid = int(qid_s)
+            except ValueError:
+                return self._send(400,
+                                  {"error": f"bad query id {qid_s!r}"})
+            if deadline.RUNNING.kill(qid, reason="DELETE /v1/queries"):
+                return self._send(200, {"killed": qid})
+            return self._send(404, {"error": f"no running query {qid}"})
+        return self._send(200, {"queries": deadline.RUNNING.list()})
+
     # ---- /v1/sql (reference http.rs:724 sql handler) -----------------------
 
     def _handle_sql(self):
         from greptimedb_tpu.servers.encode import encode_sql_payload
+        from greptimedb_tpu.utils import deadline
 
         params = self._form_or_query()
         sql = params.get("sql")
         if not sql:
             return self._send(400, {"code": 1004, "error": "missing sql"})
         ctx = self._ctx(params)
+        # pre-create the statement token so a client that hangs up
+        # mid-execution cancels the work it abandoned (the engine arms
+        # the deadline and registers it in the running-queries table)
+        token = deadline.CancelToken()
+        ctx.cancel_token = token
+        stop_watch = deadline.watch_disconnect(self.connection, token)
         t0 = time.perf_counter()
-        with QUERY_DURATION.time(kind="sql"):
-            results = self.query_engine.execute_sql(sql, ctx)
+        try:
+            with QUERY_DURATION.time(kind="sql"):
+                results = self.query_engine.execute_sql(sql, ctx)
+        finally:
+            stop_watch()
         # the admission slot was released inside execute_sql (at
         # execute-done): serialization below never occupies an
         # execution slot, and runs on the bounded encode pool rather

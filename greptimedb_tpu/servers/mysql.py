@@ -25,6 +25,7 @@ import threading
 from typing import Optional
 
 from greptimedb_tpu.fault import Unavailable
+from greptimedb_tpu.fault.retry import Cancelled, DeadlineExceeded
 from greptimedb_tpu.query.engine import QueryContext, QueryEngine
 
 CLIENT_PROTOCOL_41 = 0x00000200
@@ -260,7 +261,16 @@ class _Session(socketserver.BaseRequestHandler):
                         body, n_params, cached_types)
                     stmts[stmt_id][2] = types
                     bound = _bind_params(sql, params)
-                    result = _dispatch(server.query_engine, bound, ctx)
+                    result = _dispatch(server.query_engine, bound, ctx,
+                                       sock=self.request)
+                except DeadlineExceeded as e:
+                    # ER_QUERY_TIMEOUT: max_execution_time shape
+                    io.send_packet(_err(3024, "HY000", str(e)[:400]))
+                    continue
+                except Cancelled as e:
+                    # ER_QUERY_INTERRUPTED: KILL QUERY shape
+                    io.send_packet(_err(1317, "70100", str(e)[:400]))
+                    continue
                 except Unavailable as e:
                     # typed overload/degradation: 1040 tells clients to
                     # back off and retry, not report a syntax error
@@ -289,7 +299,14 @@ class _Session(socketserver.BaseRequestHandler):
                 continue
             sql = body.decode("utf-8", "replace").strip().rstrip(";")
             try:
-                result = _dispatch(server.query_engine, sql, ctx)
+                result = _dispatch(server.query_engine, sql, ctx,
+                                   sock=self.request)
+            except DeadlineExceeded as e:
+                io.send_packet(_err(3024, "HY000", str(e)[:400]))
+                continue
+            except Cancelled as e:
+                io.send_packet(_err(1317, "70100", str(e)[:400]))
+                continue
             except Unavailable as e:
                 io.send_packet(_err(1040, "08004", str(e)[:400]))
                 continue
@@ -299,12 +316,26 @@ class _Session(socketserver.BaseRequestHandler):
             _send_result(io, result, pool=_encode_pool(server))
 
 
-def _dispatch(engine: QueryEngine, sql: str, ctx: QueryContext):
+def _dispatch(engine: QueryEngine, sql: str, ctx: QueryContext,
+              sock=None):
     """Run the SQL, shimming the session variables standard clients probe
     on connect (reference servers/src/mysql/federated.rs)."""
     low = sql.lower()
-    if low.startswith(("set ", "commit", "rollback", "begin", "start transaction")):
+    if low.startswith(("commit", "rollback", "begin", "start transaction")):
         return None  # accepted, no-op
+    if low.startswith("set "):
+        # SET now reaches the engine: _set_var stores session vars in
+        # the connection-scoped ctx.extensions, which is how
+        # `SET max_execution_time = 500` arms the deadline plane for
+        # every later statement on this connection. Client-compat vars
+        # the parser/engine can't digest stay an accepted no-op.
+        try:
+            engine.execute_one(sql, ctx)
+        except Unavailable:
+            raise  # typed degradation must reach the wire mapping
+        except Exception:  # noqa: BLE001 — connector-compat vars vary
+            pass
+        return None
     if "@@" in low and low.startswith("select"):
         # SELECT @@version_comment / @@max_allowed_packet / ...
         names, vals = [], []
@@ -312,7 +343,10 @@ def _dispatch(engine: QueryEngine, sql: str, ctx: QueryContext):
             var = var.strip().split(" ")[0]
             name = var.replace("@@", "").split(".")[-1]
             names.append("@@" + name)
-            vals.append(_SESSION_VARS.get(name, ""))
+            # a var this connection SET (e.g. max_execution_time)
+            # reads back its session value, not the static shim
+            vals.append(str(ctx.extensions.get(
+                name, _SESSION_VARS.get(name, ""))))
         return ("rows", names, [vals])
     from greptimedb_tpu.utils import tracing
 
@@ -324,7 +358,20 @@ def _dispatch(engine: QueryEngine, sql: str, ctx: QueryContext):
             "mysql:query",
             traceparent=tracing.traceparent_from_sql(sql)):
         ctx.trace_id = tracing.current_trace_id()
-        res = engine.execute_one(sql, ctx)
+        from greptimedb_tpu.utils import deadline
+
+        # per-statement cancel token: a client that hangs up mid-query
+        # cancels the work (EOF on the session socket); the engine arms
+        # the deadline from max_execution_time / config defaults
+        token = deadline.CancelToken()
+        ctx.cancel_token = token
+        stop_watch = deadline.watch_disconnect(sock, token) \
+            if sock is not None else (lambda: None)
+        try:
+            res = engine.execute_one(sql, ctx)
+        finally:
+            stop_watch()
+            ctx.cancel_token = None
         if not res.is_query:
             return ("affected", res.affected_rows)
         # the QueryResult itself, NOT materialized rows: row building is

@@ -18,7 +18,11 @@ from typing import Optional
 import numpy as np
 
 from greptimedb_tpu.datatypes.types import DataType
-from greptimedb_tpu.fault.retry import Unavailable
+from greptimedb_tpu.fault.retry import (
+    Cancelled,
+    DeadlineExceeded,
+    Unavailable,
+)
 from greptimedb_tpu.query.engine import QueryContext, QueryEngine
 
 OID_BOOL = 16
@@ -285,10 +289,27 @@ class _Session(socketserver.BaseRequestHandler):
             conn.send(b"I")
             return
         low = sql.lower()
-        if low.startswith(("set ", "begin", "commit", "rollback", "discard")):
+        if low.startswith(("begin", "commit", "rollback", "discard")):
             conn.send(b"C", b"SET\x00")
             return
-        from greptimedb_tpu.utils import tracing
+        if low.startswith("set "):
+            # SET reaches the engine so session vars persist on the
+            # connection ctx — `SET statement_timeout = '500ms'` arms
+            # the deadline plane for every later statement here; vars
+            # the parser can't digest stay an accepted no-op
+            try:
+                engine.execute_one(sql, ctx)
+            except (DeadlineExceeded, Cancelled) as e:
+                self._error(conn, str(e), sqlstate=b"57014")
+                return
+            except Unavailable as e:
+                self._error(conn, str(e), sqlstate=b"53300")
+                return
+            except Exception:  # noqa: BLE001 — client-compat vars vary
+                pass
+            conn.send(b"C", b"SET\x00")
+            return
+        from greptimedb_tpu.utils import deadline, tracing
 
         try:
             # header-less wire: a W3C traceparent rides a leading SQL
@@ -296,9 +317,23 @@ class _Session(socketserver.BaseRequestHandler):
             with tracing.request_span(
                     "postgres:query",
                     traceparent=tracing.traceparent_from_sql(sql)):
-                res = engine.execute_one(
-                    sql, QueryContext(db=ctx.db,
-                                      trace_id=tracing.current_trace_id()))
+                # the CONNECTION ctx executes (a fresh one here used to
+                # drop the session vars SET just stored); per-statement
+                # token so a hung-up client cancels its work
+                ctx.trace_id = tracing.current_trace_id()
+                token = deadline.CancelToken()
+                ctx.cancel_token = token
+                stop_watch = deadline.watch_disconnect(conn.sock, token)
+                try:
+                    res = engine.execute_one(sql, ctx)
+                finally:
+                    stop_watch()
+                    ctx.cancel_token = None
+        except (DeadlineExceeded, Cancelled) as e:
+            # query_canceled: PG uses 57014 for both statement_timeout
+            # expiry and pg_cancel_backend-style cancellation
+            self._error(conn, str(e), sqlstate=b"57014")
+            return
         except Unavailable as e:
             # typed backpressure/degradation: SQLSTATE 53300
             # (too_many_connections) tells drivers to back off —

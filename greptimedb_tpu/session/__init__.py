@@ -52,6 +52,15 @@ class QueryContext:
     tenant: Optional[str] = None
     # W3C trace context for cross-process propagation (SURVEY §5)
     trace_id: Optional[str] = None
+    # deadline plane (utils/deadline.py): timeout_ms is the requested
+    # per-statement budget (0/None = fall back to [query]
+    # default_timeout_ms); servers stamp it from X-Greptime-Timeout /
+    # max_execution_time / statement_timeout. cancel_token is the live
+    # per-statement CancelToken while a statement is executing — servers
+    # cancel it on client disconnect, KILL QUERY finds it via the
+    # running-queries registry
+    timeout_ms: Optional[float] = None
+    cancel_token: Optional[object] = None  # deadline.CancelToken
     extensions: dict = field(default_factory=dict)
 
     @property
@@ -63,4 +72,6 @@ class QueryContext:
                             channel=self.channel, user=self.user,
                             tenant=self.tenant,
                             trace_id=self.trace_id,
+                            timeout_ms=self.timeout_ms,
+                            cancel_token=self.cancel_token,
                             extensions=self.extensions)

@@ -253,6 +253,16 @@ class CreateDatabase(Statement):
 
 
 @dataclass
+class KillQuery(Statement):
+    """KILL [QUERY] <id> — cancel a running statement through the
+    frontend running-queries registry (MySQL KILL QUERY compat; the
+    same registry backs information_schema.running_queries and
+    DELETE /v1/queries/<id>)."""
+
+    query_id: int
+
+
+@dataclass
 class SetVar(Statement):
     """SET <name> = <value> (session variable; reference handles
     time_zone and swallows client-compat vars, statement.rs SetVariables)."""

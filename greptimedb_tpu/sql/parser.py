@@ -146,6 +146,19 @@ class Parser:
 
     def parse_statement(self) -> ast.Statement:
         t = self.peek()
+        if t.kind == "ident" and t.value.lower() == "kill":
+            # KILL [QUERY] <id> — "kill" isn't a lexer keyword (it must
+            # stay usable as a column name), so pre-check the ident
+            self.next()
+            nxt = self.peek()
+            if nxt.kind in ("ident", "keyword") \
+                    and nxt.value.lower() == "query":
+                self.next()
+            idt = self.next()
+            if idt.kind != "number":
+                raise SqlError(f"KILL QUERY expects a numeric query id, "
+                               f"got {idt!r}")
+            return ast.KillQuery(int(float(idt.value)))
         if t.kind != "keyword":
             raise SqlError(f"expected statement at {t!r}")
         if t.value == "select":

@@ -130,6 +130,21 @@ class GroupCommitter:
             with self._cv:
                 if pend.done:
                     break
+                # deadline/cancel checkpoint: abandoning is only safe
+                # while our pend still sits in the queue — once a
+                # leader drained it the write may commit, and then the
+                # writer must stay for its true result (exactly-once)
+                from greptimedb_tpu.utils import deadline as dl
+
+                tok = dl.current()
+                if tok is not None and (tok.cancelled or tok.expired()):
+                    try:
+                        self._queue.remove(pend)
+                    except ValueError:
+                        pass  # drained: in flight, wait it out
+                    else:
+                        INGEST_GROUP_COMMIT_EVENTS.inc(event="deadline")
+                        tok.check("group commit wait")
                 self._cv.wait(timeout=0.05)
         if pend.error is not None:
             raise pend.error

@@ -32,6 +32,7 @@ from greptimedb_tpu.storage.manifest import ManifestManager
 from greptimedb_tpu.storage.memtable import Memtable, TagRegistry
 from greptimedb_tpu.storage.sst import OP_COL, SEQ_COL, FileMeta, SstReader, SstWriter
 from greptimedb_tpu.storage.wal import Wal
+from greptimedb_tpu.utils import deadline as dl
 
 OP_PUT = 0
 OP_DELETE = 1
@@ -551,13 +552,16 @@ class Region:
 
             live_runs = [run for run in runs if run]
             run_one = tracing.propagate(work)
-            futs = [pool.submit(run_one, run, pf0 if i == 0 else None)
+            # scan_pool.submit re-adopts the query's CancelToken in the
+            # worker: queued units for a dead query unwind typed
+            futs = [scan_pool.submit(pool, run_one, run,
+                                     pf0 if i == 0 else None)
                     for i, run in enumerate(live_runs)]
             chunks: list = []
             first_err = None
             for f in futs:
                 try:
-                    chunks.append(f.result())
+                    chunks.append(dl.wait_future(f, "scan gather"))
                 except BaseException as e:  # noqa: BLE001 — re-raised below
                     chunks.append(None)
                     if first_err is None:
@@ -623,12 +627,13 @@ class Region:
         # inside it) lands in the query's span tree
         from greptimedb_tpu.utils import tracing
 
-        futs = [pool.submit(tracing.propagate(work), m) for m in metas]
+        futs = [scan_pool.submit(pool, tracing.propagate(work), m)
+                for m in metas]
         results: list = []
         first_err = None
         for f in futs:
             try:
-                results.append(f.result())
+                results.append(dl.wait_future(f, "decode gather"))
             except BaseException as e:  # noqa: BLE001 — re-raised below
                 results.append(None)
                 if first_err is None:
@@ -649,9 +654,11 @@ class Region:
             return {n: np.concatenate([p[n] for p in parts_cols])
                     for n in names}
         pool = scan_pool.get(threads)
-        futs = {n: pool.submit(
-            np.concatenate, [p[n] for p in parts_cols]) for n in names}
-        return {n: f.result() for n, f in futs.items()}
+        futs = {n: scan_pool.submit(
+            pool, np.concatenate, [p[n] for p in parts_cols])
+            for n in names}
+        return {n: dl.wait_future(f, "concat gather")
+                for n, f in futs.items()}
 
     def _cached_parts(self, file_list, ts_range, names, pred_key,
                       tag_predicates, insert: bool = True
@@ -800,7 +807,9 @@ class Region:
         try:
             with self._wal_turn_cv:
                 while self._wal_turn != ticket:
-                    self._wal_turn_cv.wait()
+                    # bounded laps, never abandoned: the ticket MUST
+                    # retire in sequence or every later commit wedges
+                    self._wal_turn_cv.wait(timeout=1.0)
             # sole owner of this region's WAL tail until the turn
             # advances; a crash in here leaves at most a torn tail that
             # replay truncates (nothing in the group was acknowledged)
@@ -837,7 +846,7 @@ class Region:
         try:
             with self._wal_turn_cv:
                 while self._wal_turn != ticket:
-                    self._wal_turn_cv.wait()
+                    self._wal_turn_cv.wait(timeout=1.0)
         finally:
             self._finish_commit(ticket)
 
@@ -1591,6 +1600,8 @@ class Region:
         from concurrent.futures import ThreadPoolExecutor
         from concurrent.futures import TimeoutError as _FutTimeout
 
+        from greptimedb_tpu.storage import scan_pool
+
         pool = ThreadPoolExecutor(
             max_workers=workers,
             thread_name_prefix="gtpu-stream-decode")
@@ -1638,11 +1649,18 @@ class Region:
                 while nxt < len(files) and nxt < i + workers:
                     q = _queue.Queue(maxsize=2)
                     queues[nxt] = q
-                    futs.append(pool.submit(produce, files[nxt], q))
+                    futs.append(scan_pool.submit(pool, produce,
+                                                 files[nxt], q))
                     nxt += 1
                 q = queues.pop(i)
                 while True:
-                    kind, payload = q.get()
+                    try:
+                        kind, payload = q.get(timeout=0.1)
+                    except _queue.Empty:
+                        # deadline checkpoint: a dead consumer unwinds
+                        # typed; the finally stops the producers
+                        dl.check("streaming scan wait")
+                        continue
                     if kind == "end":
                         break
                     if kind == "error":

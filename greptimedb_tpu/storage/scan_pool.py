@@ -54,3 +54,23 @@ def get(workers: int) -> ThreadPoolExecutor:
                 max_workers=_pool_size,
                 thread_name_prefix="gtpu-scan-decode")
         return _pool
+
+
+def submit(pool: ThreadPoolExecutor, fn, *args, **kwargs):
+    """Submit a decode unit with the caller's CancelToken re-adopted
+    inside the worker (contextvars don't cross threads on their own):
+    each unit checkpoints before decoding, so a cancelled or expired
+    query's still-queued units unwind typed instead of burning pool
+    workers on dead work. Tokenless callers get a plain submit."""
+    from greptimedb_tpu.utils import deadline as dl
+
+    token = dl.current()
+    if token is None:
+        return pool.submit(fn, *args, **kwargs)
+
+    def run():
+        with dl.activate(token):
+            dl.check("scan decode")
+            return fn(*args, **kwargs)
+
+    return pool.submit(run)

@@ -440,6 +440,30 @@ FLOW_TICK_ERRORS = REGISTRY.counter(
     "greptimedb_tpu_flow_tick_errors_total",
     "Flow engine tick failures deferred to the next tick, by flow")
 
+# deadline/cancellation/hedging plane (utils/deadline.py,
+# cluster/cluster.py): tail tolerance is only credible when every
+# expiry, kill, and hedge decision is a counted event
+DEADLINE_EVENTS = REGISTRY.counter(
+    "greptimedb_tpu_query_deadline_events_total",
+    "Query deadline-plane terminal events by event (expired = the "
+    "absolute deadline passed at a cooperative checkpoint, cancelled = "
+    "client disconnect or hedge-loser cancellation, killed = KILL "
+    "QUERY / DELETE /v1/queries/<id>); counted once per query at the "
+    "first typed raise")
+HEDGE_EVENTS = REGISTRY.counter(
+    "greptimedb_tpu_hedge_events_total",
+    "Hedged region-request events by event (fired = a backup fragment "
+    "was issued after the adaptive straggler delay, won = the hedge "
+    "finished first, lost = the primary finished first and the hedge "
+    "was cancelled, budget_denied = the <=5% token-bucket hedge budget "
+    "suppressed a hedge)")
+REQUEST_BUDGET_REMAINING = REGISTRY.histogram(
+    "greptimedb_tpu_region_request_budget_remaining_ms",
+    "Remaining deadline budget (ms) observed at datanode ingress on "
+    "scan/fragment tickets that carried one — low buckets mean "
+    "frontends are shipping nearly-dead work to datanodes",
+    buckets=(5, 25, 100, 250, 500, 1000, 2500, 5000, 10000, 30000))
+
 # TPU runtime telemetry (SURVEY §5: the north star is unfalsifiable
 # without per-device numbers): XLA compiles, device memory, link
 # traffic, and HBM block-cache behavior — wired by

@@ -92,6 +92,10 @@ class SlowQuery:
     #: subquery/range_select/window) — uncacheable dashboard queries
     #: show up here instead of just being slow
     plan_cache_skip: Optional[str] = None
+    #: how the deadline plane ended this statement, if it did
+    #: (expired | cancelled | killed) — an expired statement is almost
+    #: always a slow one, so the record says WHY it stopped
+    deadline_event: Optional[str] = None
     stages: list = field(default_factory=list)  # (node, name, ms) triples
     #: the statement's slice of the per-query resource ledger (cache
     #: hits, H2D bytes, admission wait, rows scanned — utils/ledger.py)
@@ -109,6 +113,7 @@ class SlowQuery:
             "threshold_ms": self.threshold_ms, "rows": self.rows,
             "execution_path": self.execution_path,
             "plan_cache_skip": self.plan_cache_skip,
+            "deadline_event": self.deadline_event,
             "started_at_ms": int(self.started_at * 1000),
             "stages": [
                 {"node": n, "stage": s, "duration_ms": round(d, 3)}
@@ -124,12 +129,14 @@ class _Watch:
     """Mutable per-statement record the caller annotates after the run
     (rows, execution path) — only read if the statement turns out slow."""
 
-    __slots__ = ("rows", "execution_path", "plan_cache_skip")
+    __slots__ = ("rows", "execution_path", "plan_cache_skip",
+                 "deadline_event")
 
     def __init__(self):
         self.rows = 0
         self.execution_path = None
         self.plan_cache_skip = None
+        self.deadline_event = None
 
 
 #: the active watch, reachable from deep inside planning (the engine's
@@ -196,7 +203,8 @@ def _record(kind, query, db, dur_ms, thr, w, started, sink,
         kind=kind, query=query[:4096], db=db,
         duration_ms=dur_ms, threshold_ms=thr, rows=w.rows,
         execution_path=w.execution_path,
-        plan_cache_skip=w.plan_cache_skip, started_at=started,
+        plan_cache_skip=w.plan_cache_skip,
+        deadline_event=w.deadline_event, started_at=started,
         stages=[(s.node or "local", s.name, s.duration_ms) for s in sink],
         ledger=led_slice or {},
     )

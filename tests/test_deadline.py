@@ -1,0 +1,418 @@
+"""End-to-end query deadlines, cooperative cancellation, and hedged
+region requests (the tail-tolerance plane, utils/deadline.py +
+cluster/cluster.py).
+
+The acceptance scenario: a datanode stalled far beyond the query's
+budget still yields a TYPED DeadlineExceeded in bounded time — with
+every admission slot released and the running-queries registry empty —
+because each wait a query can park on (admission, scan pool gathers,
+injected latency, the Flight wire itself) re-checks the statement's
+CancelToken. KILL QUERY and client-disconnect ride the same token;
+hedged fragment reads race a backup attempt and cancel the loser
+without ever touching the outer statement's token."""
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import pytest
+
+from greptimedb_tpu.cluster import Cluster
+from greptimedb_tpu.fault import FAULTS, Fault
+from greptimedb_tpu.fault.retry import Cancelled, DeadlineExceeded
+from greptimedb_tpu.meta.metasrv import MetasrvOptions
+from greptimedb_tpu.partition.rule import PartitionBound, RangePartitionRule
+from greptimedb_tpu.session import QueryContext
+from greptimedb_tpu.utils import deadline as dl
+from greptimedb_tpu.utils.metrics import DEADLINE_EVENTS, HEDGE_EVENTS
+
+CREATE = (
+    "CREATE TABLE cpu (host STRING, region STRING, usage_user DOUBLE, "
+    "usage_system DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(host, region))"
+)
+
+
+@pytest.fixture(autouse=True)
+def clean_faults():
+    FAULTS.reset()
+    yield
+    FAULTS.reset()
+
+
+def _host_rule(*splits):
+    bounds = [PartitionBound((s,)) for s in splits] + [PartitionBound(())]
+    return RangePartitionRule(["host"], bounds)
+
+
+def _seed_rows(cluster, n_hosts=6, points_per_host=4):
+    rows = []
+    for h in range(n_hosts):
+        for t in range(points_per_host):
+            rows.append(f"('host{h}', 'us-west', {10.0 + h}, {1.0 * t}, "
+                        f"{1000 * (t + 1)})")
+    cluster.sql(
+        "INSERT INTO cpu (host, region, usage_user, usage_system, ts) "
+        "VALUES " + ", ".join(rows))
+
+
+# ---- token/unit surface -----------------------------------------------------
+
+
+class TestTokenUnit:
+    def test_parse_timeout_ms(self):
+        assert dl.parse_timeout_ms(500) == 500.0
+        assert dl.parse_timeout_ms("500") == 500.0
+        assert dl.parse_timeout_ms("'250ms'") == 250.0
+        assert dl.parse_timeout_ms("2s") == 2000.0
+        assert dl.parse_timeout_ms("1min") == 60000.0
+        assert dl.parse_timeout_ms(None) is None
+        assert dl.parse_timeout_ms("garbage") is None
+
+    def test_expired_token_counts_exactly_once(self):
+        before = DEADLINE_EVENTS.get(event="expired")
+        tok = dl.CancelToken(timeout_ms=1)
+        time.sleep(0.01)
+        for _ in range(3):  # every checkpoint raises, ONE counted event
+            with pytest.raises(DeadlineExceeded):
+                tok.check("unit")
+        assert DEADLINE_EVENTS.get(event="expired") == before + 1
+
+    def test_uncounted_cancel_is_metric_silent(self):
+        """Hedge losers are infrastructure churn: their cancel must not
+        inflate the user-facing deadline-events counter."""
+        before = DEADLINE_EVENTS.get(event="cancelled")
+        tok = dl.CancelToken()
+        tok.cancel("hedge loser", kind="cancelled", count=False)
+        with pytest.raises(Cancelled):
+            tok.check("unit")
+        assert DEADLINE_EVENTS.get(event="cancelled") == before
+
+    def test_wait_future_unwinds_typed_on_deadline(self):
+        pool = ThreadPoolExecutor(max_workers=1)
+        gate = threading.Event()
+        try:
+            fut = pool.submit(gate.wait, 30.0)
+            tok = dl.CancelToken(timeout_ms=50)
+            t0 = time.monotonic()
+            with dl.activate(tok):
+                with pytest.raises(DeadlineExceeded):
+                    dl.wait_future(fut, "unit")
+            assert time.monotonic() - t0 < 2.0
+        finally:
+            gate.set()
+            pool.shutdown(wait=True)
+
+    def test_wait_future_without_token_returns_value(self):
+        pool = ThreadPoolExecutor(max_workers=1)
+        try:
+            assert dl.wait_future(pool.submit(lambda: 7)) == 7
+        finally:
+            pool.shutdown(wait=True)
+
+    def test_running_queries_register_kill_unregister(self):
+        tok = dl.CancelToken()
+        qid = dl.RUNNING.register(tok, "SELECT 1", db="public",
+                                  channel="http")
+        assert any(e["id"] == qid for e in dl.RUNNING.list())
+        assert dl.RUNNING.kill(qid)
+        with pytest.raises(Cancelled):
+            tok.check("unit")
+        dl.RUNNING.unregister(qid)
+        assert not any(e["id"] == qid for e in dl.RUNNING.list())
+        assert not dl.RUNNING.kill(qid)  # already gone
+
+    def test_client_disconnect_cancels_token(self):
+        import socket
+
+        a, b = socket.socketpair()
+        tok = dl.CancelToken()
+        stop = dl.watch_disconnect(a, tok)
+        try:
+            b.close()  # the client goes away mid-statement
+            deadline = time.monotonic() + 3.0
+            while time.monotonic() < deadline and not tok.cancelled:
+                time.sleep(0.02)
+            with pytest.raises(Cancelled):
+                tok.check("unit")
+        finally:
+            stop()
+            a.close()
+
+
+# ---- the straggler matrix ---------------------------------------------------
+
+
+class TestStragglerDeadline:
+    def test_stalled_scan_unwinds_typed_within_budget(self, tmp_path):
+        """A 5 s object-store stall under a 500 ms budget: the query
+        answers typed DeadlineExceeded in well under the stall, the
+        admission slots drain, the registry empties, and the SAME query
+        succeeds once the stall clears — nothing leaked or wedged."""
+        c = Cluster(str(tmp_path), num_datanodes=3, opts=MetasrvOptions())
+        try:
+            info = c.create_partitioned_table(CREATE,
+                                              _host_rule("host2", "host4"))
+            _seed_rows(c)
+            for rid in info.region_ids:
+                c.router.flush(rid)  # the scan must hit the object store
+            before = DEADLINE_EVENTS.get(event="expired")
+            FAULTS.arm("objectstore.read", Fault(kind="latency", arg=5.0))
+            t0 = time.monotonic()
+            with pytest.raises(DeadlineExceeded):
+                c.frontend.execute_one(
+                    "SELECT count(*) FROM cpu",
+                    QueryContext(db="public", timeout_ms=500))
+            elapsed = time.monotonic() - t0
+            assert elapsed < 3.0, f"typed unwind took {elapsed:.2f}s"
+            assert DEADLINE_EVENTS.get(event="expired") == before + 1
+            # resource postconditions: nothing stays admitted/registered
+            adm = c.frontend.concurrency.admission
+            assert adm.active == 0 and adm.queued == 0
+            assert dl.RUNNING.list() == []
+            # the stall clears: the identical query now answers correctly
+            FAULTS.reset()
+            res = c.frontend.execute_one(
+                "SELECT count(*) FROM cpu",
+                QueryContext(db="public", timeout_ms=5000))
+            assert res.rows()[0][0] == 24
+        finally:
+            c.close()
+
+    def test_kill_query_mid_scan(self, tmp_path):
+        """KILL QUERY <id> while the victim is parked inside a stalled
+        scan: the victim unwinds typed Cancelled promptly (not after the
+        stall), the killed event is counted, the registry empties."""
+        c = Cluster(str(tmp_path), num_datanodes=3, opts=MetasrvOptions())
+        try:
+            info = c.create_partitioned_table(CREATE,
+                                              _host_rule("host2", "host4"))
+            _seed_rows(c)
+            for rid in info.region_ids:
+                c.router.flush(rid)
+            before = DEADLINE_EVENTS.get(event="killed")
+            FAULTS.arm("objectstore.read", Fault(kind="latency", arg=30.0))
+            victim_sql = "SELECT count(*) FROM cpu"
+            outcome: list = []
+
+            def run():
+                try:
+                    outcome.append(c.frontend.execute_one(
+                        victim_sql, QueryContext(db="public")))
+                except BaseException as e:  # noqa: BLE001 — asserted below
+                    outcome.append(e)
+
+            th = threading.Thread(target=run, daemon=True)
+            th.start()
+            qid = None
+            poll_until = time.monotonic() + 5.0
+            while time.monotonic() < poll_until and qid is None:
+                for e in dl.RUNNING.list():
+                    if e["query"] == victim_sql:
+                        qid = e["id"]
+                time.sleep(0.02)
+            assert qid is not None, "victim never registered"
+            t0 = time.monotonic()
+            assert c.sql(f"KILL QUERY {qid}").rows() is not None
+            th.join(timeout=8.0)
+            assert not th.is_alive(), "victim still parked after KILL"
+            assert time.monotonic() - t0 < 8.0
+            assert outcome and isinstance(outcome[0], Cancelled), outcome
+            assert DEADLINE_EVENTS.get(event="killed") == before + 1
+            assert dl.RUNNING.list() == []
+        finally:
+            c.close()
+
+
+class TestProcessClusterStraggler:
+    def test_stalled_datanode_typed_deadline_over_the_wire(
+            self, tmp_path, monkeypatch):
+        """The cross-process acceptance case: a child datanode stalled
+        5 s inside its Flight do_get handler, frontend budget 500 ms.
+        Typed DeadlineExceeded must come back in bounded time — via the
+        ticket's budget unwinding server-side, the per-call gRPC
+        deadline, or both racing — and a follow-up query on the SAME
+        cluster must succeed (no slot, pin, or route left wedged)."""
+        from greptimedb_tpu.cluster.process_cluster import ProcessCluster
+
+        monkeypatch.setenv(
+            "GTPU_CHAOS",
+            "flight.do_get=latency,arg:5,times:1,@side:server")
+        monkeypatch.setenv("GTPU_HEDGE", "off")  # isolate the deadline path
+        c = ProcessCluster(str(tmp_path), num_datanodes=2,
+                           opts=MetasrvOptions())
+        try:
+            c.beat_all(time.time() * 1000)
+            c.sql("CREATE TABLE m (host STRING, v DOUBLE, ts TIMESTAMP "
+                  "TIME INDEX, PRIMARY KEY(host))")
+            c.sql("INSERT INTO m VALUES ('a', 1.0, 1000), ('b', 2.0, 2000)")
+            t0 = time.monotonic()
+            with pytest.raises(DeadlineExceeded):
+                c.frontend.execute_one(
+                    "SELECT host, v FROM m ORDER BY host",
+                    QueryContext(db="public", timeout_ms=500))
+            elapsed = time.monotonic() - t0
+            assert elapsed < 3.0, f"typed unwind took {elapsed:.2f}s"
+            adm = c.frontend.concurrency.admission
+            assert adm.active == 0 and adm.queued == 0
+            assert dl.RUNNING.list() == []
+            # the schedule is consumed (times:1): same query now answers
+            r = c.frontend.execute_one(
+                "SELECT host, v FROM m ORDER BY host",
+                QueryContext(db="public", timeout_ms=10000))
+            assert r.rows() == [["a", 1.0], ["b", 2.0]]
+        finally:
+            c.close()
+
+
+# ---- hedged region requests -------------------------------------------------
+
+
+class TestHedging:
+    def _bare_router(self):
+        from greptimedb_tpu.cluster.cluster import RegionRouter, _HedgePlane
+
+        router = object.__new__(RegionRouter)
+        router._hedge = _HedgePlane()
+        router._region_node = {}
+        return router
+
+    def test_hedge_wins_and_loser_is_cancelled(self, monkeypatch):
+        """Stalled primary, fast hedge: the hedge's value comes back,
+        fired/won are counted, the primary's token is cancelled (it
+        stops burning the stalled path) — and the loser's cancel never
+        shows up in the user-facing deadline-events counter."""
+        monkeypatch.setenv("GTPU_HEDGE_DELAY_MS", "10")
+        router = self._bare_router()
+        fired0 = HEDGE_EVENTS.get(event="fired")
+        won0 = HEDGE_EVENTS.get(event="won")
+        cancelled0 = DEADLINE_EVENTS.get(event="cancelled")
+        lock = threading.Lock()
+        calls: list = []
+        primary_cancelled = threading.Event()
+
+        def call(eng):
+            with lock:
+                calls.append(1)
+                first = len(calls) == 1
+            if first:
+                try:
+                    dl.sleep(30.0, "stalled primary")
+                except Cancelled:
+                    primary_cancelled.set()
+                    raise
+                return "slow"
+            return 42
+
+        t0 = time.monotonic()
+        assert router._hedged_call(1 << 32, None, call) == 42
+        assert time.monotonic() - t0 < 5.0
+        assert HEDGE_EVENTS.get(event="fired") == fired0 + 1
+        assert HEDGE_EVENTS.get(event="won") == won0 + 1
+        assert primary_cancelled.wait(5.0), "loser never cancelled"
+        assert DEADLINE_EVENTS.get(event="cancelled") == cancelled0
+
+    def test_primary_win_cancels_hedge_and_counts_lost(self, monkeypatch):
+        monkeypatch.setenv("GTPU_HEDGE_DELAY_MS", "10")
+        router = self._bare_router()
+        lost0 = HEDGE_EVENTS.get(event="lost")
+        lock = threading.Lock()
+        calls: list = []
+
+        def call(eng):
+            with lock:
+                calls.append(1)
+                first = len(calls) == 1
+            if first:
+                time.sleep(0.1)  # slow enough for the hedge to fire
+                return "primary"
+            dl.sleep(30.0, "stalled hedge")
+            return "hedge"
+
+        assert router._hedged_call(1 << 32, None, call) == "primary"
+        assert HEDGE_EVENTS.get(event="lost") == lost0 + 1
+
+    def test_budget_denied_when_bucket_empty(self, monkeypatch):
+        monkeypatch.setenv("GTPU_HEDGE_DELAY_MS", "1")
+        router = self._bare_router()
+        router._hedge._credit = 0.0  # drained token bucket
+        denied0 = HEDGE_EVENTS.get(event="budget_denied")
+
+        def call(eng):
+            time.sleep(0.05)
+            return "only"
+
+        assert router._hedged_call(1 << 32, None, call) == "only"
+        assert HEDGE_EVENTS.get(event="budget_denied") == denied0 + 1
+
+    def test_hedged_read_bit_identical_over_the_wire(
+            self, tmp_path, monkeypatch):
+        """Hedging forced on every remote fragment (delay 0): results
+        are identical to the unhedged run and hedge events are
+        observable — first-response-wins changes tail latency, never
+        answers."""
+        from greptimedb_tpu.cluster.process_cluster import ProcessCluster
+
+        c = ProcessCluster(str(tmp_path), num_datanodes=2,
+                           opts=MetasrvOptions())
+        try:
+            c.beat_all(time.time() * 1000)
+            c.sql("CREATE TABLE m (host STRING, v DOUBLE, ts TIMESTAMP "
+                  "TIME INDEX, PRIMARY KEY(host))")
+            c.sql("INSERT INTO m VALUES ('a', 1.0, 1000), ('b', 2.0, 2000),"
+                  " ('c', 3.0, 3000)")
+            agg = "SELECT count(*), sum(v) FROM m"
+            monkeypatch.setenv("GTPU_HEDGE", "off")
+            baseline = c.sql(agg).rows()
+            monkeypatch.delenv("GTPU_HEDGE", raising=False)
+            monkeypatch.setenv("GTPU_HEDGE_DELAY_MS", "0")
+            fired0 = HEDGE_EVENTS.get(event="fired")
+            for _ in range(3):
+                assert c.sql(agg).rows() == baseline
+            assert HEDGE_EVENTS.get(event="fired") > fired0
+            done0 = (HEDGE_EVENTS.get(event="won")
+                     + HEDGE_EVENTS.get(event="lost"))
+            assert done0 > 0  # every fired hedge resolved won-or-lost
+        finally:
+            c.close()
+
+
+# ---- the lint checker (satellite a) -----------------------------------------
+
+
+class TestDeadlineLintChecker:
+    def _check(self, path, src):
+        from greptimedb_tpu.lint import Repo, SourceFile
+        from greptimedb_tpu.lint.deadline import check
+
+        return check(Repo(root="",
+                          files=[SourceFile.from_text(path, src)]))
+
+    def test_unbounded_wait_in_serving_scope_fires(self):
+        found = self._check("greptimedb_tpu/servers/foo.py", """
+def handler(ev):
+    ev.wait()
+""")
+        assert len(found) == 1 and "ev.wait" in found[0].message
+
+    def test_timeout_clears_the_finding(self):
+        found = self._check("greptimedb_tpu/servers/foo.py", """
+def handler(ev, fut, q):
+    ev.wait(1.0)
+    fut.result(timeout=2.0)
+    q.get(timeout=0.1)
+""")
+        assert found == []
+
+    def test_blocking_queue_get_fires(self):
+        found = self._check("greptimedb_tpu/query/foo.py", """
+def drain(work_queue):
+    return work_queue.get()
+""")
+        assert len(found) == 1
+
+    def test_outside_serving_scope_is_free(self):
+        found = self._check("greptimedb_tpu/cli/foo.py", """
+def offline(ev):
+    ev.wait()
+""")
+        assert found == []
