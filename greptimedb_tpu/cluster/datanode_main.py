@@ -64,8 +64,17 @@ def main() -> None:
         f.write(str(server.port))
     os.replace(tmp, port_file)  # atomic: readers never see a partial file
     try:
+        from greptimedb_tpu.lint import lockdep
+
         while True:
-            time.sleep(3600)
+            if lockdep.enabled() and os.environ.get("GTPU_LOCKDEP_DIR"):
+                # the parent stops children with SIGKILL (the failover
+                # scenario IS abrupt death), so atexit never runs here:
+                # refresh the edge dump continuously instead
+                lockdep.dump()
+                time.sleep(1.0)
+            else:
+                time.sleep(3600)
     except KeyboardInterrupt:
         pass
 

@@ -46,15 +46,22 @@ class ProcDatanode:
         # post-crash diagnostic
         self.stderr_path = os.path.join(run_dir, f"{node_id}.stderr")
         self._stderr_f = open(self.stderr_path, "wb")
+        child_env = {**os.environ, "JAX_PLATFORMS": "cpu",
+                     # GTPU_NODE_ID: identity stamped on the spans the
+                     # child piggybacks on its Flight responses
+                     # (EXPLAIN ANALYZE attribution)
+                     "GTPU_NODE_ID": node_id}
+        if os.environ.get("GTPU_LOCKDEP") \
+                and not os.environ.get("GTPU_LOCKDEP_DIR"):
+            # cross-process lockdep: children dump their observed edge
+            # sets next to the port files; lockdep.merged_report unions
+            # them with the parent's graph
+            child_env["GTPU_LOCKDEP_DIR"] = run_dir
         self.proc = subprocess.Popen(
             [sys.executable, "-m", "greptimedb_tpu.cluster.datanode_main",
              shared_dir, self.port_file],
             stdout=subprocess.DEVNULL, stderr=self._stderr_f,
-            # GTPU_NODE_ID: identity stamped on the spans the child
-            # piggybacks on its Flight responses (EXPLAIN ANALYZE
-            # attribution)
-            env={**os.environ, "JAX_PLATFORMS": "cpu",
-                 "GTPU_NODE_ID": node_id},
+            env=child_env,
         )
         self.remote = None  # connected lazily once the port file appears
 

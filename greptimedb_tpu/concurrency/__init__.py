@@ -178,6 +178,34 @@ class ConcurrencyPlane:
                           else cfg.encode_process_mode),
             process_min_rows=cfg.encode_process_min_rows)
         self._tls = threading.local()
+        # the serving fabric (shm/): attach once per process, register
+        # the scrape-time collectors (fabric gauges + worker-metrics
+        # fold), and default the persistent XLA compilation cache to the
+        # shared namespace — all no-ops when GTPU_SHM_FABRIC is off
+        from greptimedb_tpu import shm
+
+        if cfg.enabled and shm.get_fabric() is not None:
+            from greptimedb_tpu.shm import metrics_bridge
+
+            metrics_bridge.install_collector()
+            shm.install_stats_collector()
+            shm.apply_shared_xla_cache()
+            # the engine builds its PhysicalExecutor BEFORE this plane,
+            # so the executor's enable_compilation_cache() ran without
+            # the shared dir; re-wire now (idempotent, process-global
+            # jax config) so THIS process caches into the fabric
+            from greptimedb_tpu.query.physical import (
+                enable_compilation_cache,
+            )
+
+            if enable_compilation_cache():
+                # in the shared namespace cache even sub-second
+                # compiles: on an N-process box every executable cached
+                # here is another frontend's first-query win
+                import jax
+
+                jax.config.update(
+                    "jax_persistent_cache_min_compile_time_secs", 0.0)
 
     # ---- batching gate -----------------------------------------------------
 
@@ -223,7 +251,31 @@ class ConcurrencyPlane:
     # ---- invalidation ------------------------------------------------------
 
     def invalidate_table(self, db=None, name=None) -> int:
-        # one seam for both layers: DDL hooks and the remote-catalog
-        # watch invalidate plan shapes AND text templates together
+        # one seam for all layers: DDL hooks and the remote-catalog
+        # watch invalidate plan shapes, text templates, AND (fabric on)
+        # every peer process's published artifacts for the table
+        self._fabric_invalidate(db, name)
         self.fast_lane.invalidate_table(db, name)
         return self.plan_cache.invalidate_table(db, name)
+
+    @staticmethod
+    def _fabric_invalidate(db, name) -> None:
+        """Bump the (db, table) fabric version so artifacts peers
+        published under the old one die on their next adopt check; a
+        widened match (None field — the remote watch can't tell what
+        moved) wipes the whole fabric."""
+        from greptimedb_tpu import shm
+        from greptimedb_tpu.shm.fabric import FabricError
+        from greptimedb_tpu.utils.metrics import SHM_FABRIC_EVENTS
+
+        fabric = shm.get_fabric()
+        if fabric is None:
+            return
+        try:
+            if db is None or name is None:
+                fabric.wipe()
+            else:
+                fabric.bump_version(db, name)
+            SHM_FABRIC_EVENTS.inc(event="invalidate", kind="fabric")
+        except (FabricError, OSError, ValueError):
+            shm.detach()

@@ -48,6 +48,29 @@ def _auto_workers() -> int:
     return max(2, min(8, (os.cpu_count() or 4) // 2))
 
 
+def _worker_watchdog(parent: int) -> None:
+    import os
+    import time
+
+    while True:
+        time.sleep(5.0)
+        if os.getppid() != parent:
+            # the serving process died without reclaiming us (SIGKILL:
+            # shutdown() never ran, the call-queue read blocks forever).
+            # An orphan worker holds attach flocks on the shm fabric and
+            # result arena, pinning segments no live frontend uses —
+            # exit and let the kernel release them
+            os._exit(0)
+
+
+def _worker_init() -> None:
+    import os
+
+    t = threading.Thread(target=_worker_watchdog, args=(os.getppid(),),
+                         daemon=True, name="gtpu-encode-watchdog")
+    t.start()
+
+
 class EncodePool:
     def __init__(self, workers: int = 0, queue_size: int = 64,
                  process: bool = False, enabled: bool = True,
@@ -103,7 +126,8 @@ class EncodePool:
                     # runtime threads a fork would copy mid-lock
                     self._process_executor = ProcessPoolExecutor(
                         max_workers=self.workers,
-                        mp_context=multiprocessing.get_context("spawn"))
+                        mp_context=multiprocessing.get_context("spawn"),
+                        initializer=_worker_init)
                     # a discarded plane (tests, embedded engines) must
                     # not leak idle workers until interpreter exit
                     weakref.finalize(self, self._process_executor.shutdown,
@@ -127,7 +151,8 @@ class EncodePool:
 
     # ---- entry -------------------------------------------------------------
 
-    def run(self, fn, *args, cost_rows: Optional[int] = None):
+    def run(self, fn, *args, cost_rows: Optional[int] = None,
+            shm_result: bool = False):
         """Run `fn(*args)` on a pool worker and wait for the bytes; the
         calling request thread sleeps on the future (GIL released)
         instead of competing for it. Falls back to inline encoding when
@@ -136,13 +161,25 @@ class EncodePool:
         handoff twice: results under `min_rows` encode inline (handoff
         costs more than dashboard-sized serialization), and results at
         or above `process_min_rows` escape to the process pool in auto
-        mode (measured size picks the executor, not a static flag)."""
+        mode (measured size picks the executor, not a static flag).
+
+        With the serving fabric on, process-mode workers hand bytes
+        payloads back through the shared-memory result arena instead of
+        the executor's pickle queue; `shm_result=True` callers (the
+        HTTP writer) may receive a zero-copy `ShmPayload` view over the
+        segment, everyone else gets plain bytes copied out of it."""
         if not self.enabled:
             return fn(*args)
         if cost_rows is not None and cost_rows < self.min_rows:
             ENCODE_POOL_EVENTS.inc(event="small_inline")
             return fn(*args)
         process = self._want_process(cost_rows)
+        shm_results = None
+        if process:
+            from greptimedb_tpu.shm import results as _sr
+
+            if _sr.get_arena() is not None:
+                shm_results = _sr
         with self._lock:
             if self._inflight >= self.queue_size:
                 saturated = True
@@ -155,7 +192,11 @@ class EncodePool:
             return fn(*args)
         try:
             try:
-                fut = self._pool(process).submit(fn, *args)
+                if shm_results is not None:
+                    fut = self._pool(process).submit(
+                        shm_results.shm_encode, fn, *args)
+                else:
+                    fut = self._pool(process).submit(fn, *args)
             except RuntimeError:
                 # executor torn down concurrently (submit after
                 # shutdown): the request still gets its bytes. Errors
@@ -166,9 +207,22 @@ class EncodePool:
             ENCODE_POOL_EVENTS.inc(
                 event="offload_process" if process else "offload")
             if process:
-                # a worker PROCESS observes its metrics into its own
-                # registry (lost to the parent's /metrics) — time the
-                # round trip here so the encode split stays visible
+                if shm_results is not None:
+                    # the worker timed its encode EXACTLY (shm_encode)
+                    # and the metrics bridge folds it into /metrics —
+                    # no parent-side round-trip approximation needed
+                    out = deadline.wait_future(fut, "encode offload")
+                    out = shm_results.resolve(out, fn, args)
+                    if getattr(out, "is_shm_payload", False) \
+                            and not shm_result:
+                        data = bytes(out)
+                        out.release()
+                        return data
+                    return out
+                # fabric off: a worker PROCESS observes its metrics
+                # into its own registry (lost to the parent's /metrics)
+                # — time the round trip here so the encode split stays
+                # visible, approximately
                 import time
 
                 from greptimedb_tpu.utils.metrics import ENCODE_SECONDS

@@ -48,10 +48,23 @@ scanner:
 Concurrent identical requests single-flight: followers ride the
 leader's in-flight execution (the cross-query batcher's coalescing
 semantics, without the collection window).
+
+With the serving fabric on (`[shm] fabric`), a template another process
+on the box already validated is ADOPTED instead of re-proved: the
+published payload carries only the value-independent structure — which
+text slot binds which parameter position, which slots are pinned, the
+plan shape — never the publisher's literal values. A first sighting
+that finds a peer's payload skips both the second-sighting wait and the
+O(slots) probe parses: it runs the slow lane once (stamping its own
+plan + TableInfo) and assembles the entry from the adopted binder,
+re-checking `_type_eq` per bound slot and shape equality, so a peer
+running subtly different code degrades to the normal probe build.
 """
 
 from __future__ import annotations
 
+import hashlib
+import pickle
 import re
 import threading
 import time
@@ -65,6 +78,7 @@ from greptimedb_tpu.utils import ledger, roofline
 from greptimedb_tpu.utils.metrics import (
     FAST_LANE_EVENTS,
     QUERY_ACHIEVED_GBPS,
+    SHM_FABRIC_EVENTS,
     STAGE_SECONDS,
     STMT_DURATION,
 )
@@ -281,6 +295,20 @@ class FastLane:
             if tmpl is not None:
                 self._templates.move_to_end(key)
         if tmpl is None:
+            adopted = self._fabric_probe(key)
+            if adopted is not None and adopted.get("uncacheable"):
+                # a peer already proved this template context-dependent
+                self._mark_uncacheable(key, publish=False)
+                FAST_LANE_EVENTS.inc(event="fallback",
+                                     reason="uncacheable")
+                return qe._execute_sql_slow(sql, ctx,
+                                            _intercepted=intercepted)
+            if adopted is not None:
+                # a peer proved the template repeats AND published its
+                # verified binder: build NOW (skipping the second-
+                # sighting wait and the probe parses)
+                return self._miss(qe, sql, ctx, key, values, spans,
+                                  "miss", intercepted, adopted=adopted)
             # first sighting: just mark the template. Probing costs
             # O(slots) parses, which a never-repeated ad-hoc statement
             # must not pay — the SECOND sighting proves the template
@@ -316,7 +344,7 @@ class FastLane:
     # ---- miss / build ------------------------------------------------------
 
     def _miss(self, qe, sql, ctx, key, values, spans, event: str,
-              intercepted: bool = False) -> list:
+              intercepted: bool = False, adopted: Optional[dict] = None) -> list:
         FAST_LANE_EVENTS.inc(event=event)
         if qe.concurrency.admission.depth() != 0:
             # nested statement (script, flow tick): serve it, but only
@@ -335,12 +363,14 @@ class FastLane:
         finally:
             self._tls.ticket = None
         try:
-            self._build(qe, sql, ctx, key, values, spans, ticket)
+            self._build(qe, sql, ctx, key, values, spans, ticket,
+                        adopted=adopted)
         except Exception:  # noqa: BLE001 — a build bug must never fail serving
             self._mark_uncacheable(key)
         return results
 
-    def _build(self, qe, sql, ctx, key, values, spans, ticket) -> None:
+    def _build(self, qe, sql, ctx, key, values, spans, ticket,
+               adopted: Optional[dict] = None) -> None:
         """Probe-verify a literal->parameter binder and store the entry
         (see module docstring). Any doubt marks the template
         uncacheable — the slow lane stays authoritative."""
@@ -360,6 +390,96 @@ class FastLane:
         if len(plan_entry.slots) != len(params0):
             self._mark_uncacheable(key)
             return
+        binder, pinned = self._adopt_binder(adopted, shape0, params0,
+                                            values)
+        if binder is None:
+            binder, pinned = self._probe_binder(sql, spans, values,
+                                                shape0, params0)
+        from greptimedb_tpu.query.expr import has_aggregate
+
+        entry = _Entry(
+            db=info.db, table=info.name, stmt=sel, info=info,
+            plan_entry=plan_entry, binder=tuple(binder),
+            pinned=tuple(pinned),
+            needs_sub_check=bool(sel.group_by
+                                 or any(has_aggregate(it.expr)
+                                        for it in sel.items)),
+            shape=shape0)
+        churned = False
+        with self._lock:
+            tmpl = self._templates.get(key)
+            if tmpl is None:
+                tmpl = _Template()
+                self._templates[key] = tmpl
+            if tmpl.uncacheable:
+                return
+            tmpl.builds += 1
+            if tmpl.builds > 4 * _MAX_VARIANTS \
+                    and len(tmpl.entries) >= _MAX_VARIANTS:
+                # churn guard: the variant list is saturated yet builds
+                # keep coming — a pinned slot is rotating per request
+                # (ever-changing LIMIT / interval), so the per-request
+                # probe rebuild costs more than the lane saves
+                tmpl.uncacheable = True
+                tmpl.entries = []
+                churned = True
+            else:
+                tmpl.entries = [e for e in tmpl.entries
+                                if e.pinned != entry.pinned]
+                tmpl.entries.append(entry)
+                if len(tmpl.entries) > _MAX_VARIANTS:
+                    tmpl.entries.pop(0)
+                self._templates.move_to_end(key)
+                while len(self._templates) > self.capacity:
+                    self._templates.popitem(last=False)
+        if churned:
+            self._fabric_publish_uncacheable(key)
+            return
+        if adopted is None:
+            # locally proven binders are shared; adopted ones are
+            # already published (by their prover)
+            self._fabric_publish(key, entry)
+
+    def _adopt_binder(self, adopted: Optional[dict], shape0, params0,
+                      values):
+        """Assemble (binder, pinned) from a peer's published structure
+        — re-deriving every VALUE from this process's own parse, so the
+        payload only steers which slot feeds which position. Returns
+        (None, None) on any doubt; the caller probe-builds as usual."""
+        if adopted is None:
+            return None, None
+        try:
+            if adopted.get("shape") != shape0:
+                return None, None
+            bound_pairs = adopted["bound"]
+            pinned_idx = adopted["pinned"]
+            if len(bound_pairs) + len(pinned_idx) != len(values):
+                return None, None
+            binder: list = [("c", p) for p in params0]
+            seen_slots: set = set()
+            for pos, slot in bound_pairs:
+                if not (0 <= pos < len(params0)) \
+                        or not (0 <= slot < len(values)) \
+                        or slot in seen_slots \
+                        or not _type_eq(params0[pos], values[slot]):
+                    return None, None
+                binder[pos] = ("s", slot)
+                seen_slots.add(slot)
+            pinned: list = []
+            for i in pinned_idx:
+                if not (0 <= i < len(values)) or i in seen_slots:
+                    return None, None
+                seen_slots.add(i)
+                pinned.append((i, type(values[i]).__name__, values[i]))
+            SHM_FABRIC_EVENTS.inc(event="hit", kind="template")
+            return binder, pinned
+        except (KeyError, TypeError, ValueError):
+            return None, None
+
+    def _probe_binder(self, sql, spans, values, shape0, params0):
+        """The original probe loop: prove each text slot bindable by
+        splicing a magic literal and re-parsing (see module
+        docstring)."""
         from greptimedb_tpu.sql import parse_sql
 
         binder: list = [("c", p) for p in params0]
@@ -389,43 +509,9 @@ class FastLane:
                 # structural / fragile slot: the value must match this
                 # entry exactly, or the request builds its own variant
                 pinned.append((i, type(val).__name__, val))
-        from greptimedb_tpu.query.expr import has_aggregate
+        return binder, pinned
 
-        entry = _Entry(
-            db=info.db, table=info.name, stmt=sel, info=info,
-            plan_entry=plan_entry, binder=tuple(binder),
-            pinned=tuple(pinned),
-            needs_sub_check=bool(sel.group_by
-                                 or any(has_aggregate(it.expr)
-                                        for it in sel.items)),
-            shape=shape0)
-        with self._lock:
-            tmpl = self._templates.get(key)
-            if tmpl is None:
-                tmpl = _Template()
-                self._templates[key] = tmpl
-            if tmpl.uncacheable:
-                return
-            tmpl.builds += 1
-            if tmpl.builds > 4 * _MAX_VARIANTS \
-                    and len(tmpl.entries) >= _MAX_VARIANTS:
-                # churn guard: the variant list is saturated yet builds
-                # keep coming — a pinned slot is rotating per request
-                # (ever-changing LIMIT / interval), so the per-request
-                # probe rebuild costs more than the lane saves
-                tmpl.uncacheable = True
-                tmpl.entries = []
-                return
-            tmpl.entries = [e for e in tmpl.entries
-                            if e.pinned != entry.pinned]
-            tmpl.entries.append(entry)
-            if len(tmpl.entries) > _MAX_VARIANTS:
-                tmpl.entries.pop(0)
-            self._templates.move_to_end(key)
-            while len(self._templates) > self.capacity:
-                self._templates.popitem(last=False)
-
-    def _mark_uncacheable(self, key) -> None:
+    def _mark_uncacheable(self, key, publish: bool = True) -> None:
         with self._lock:
             tmpl = self._templates.get(key)
             if tmpl is None:
@@ -435,6 +521,99 @@ class FastLane:
                     self._templates.popitem(last=False)
             tmpl.uncacheable = True
             tmpl.entries = []
+        if publish:
+            self._fabric_publish_uncacheable(key)
+
+    # ---- fabric tier -------------------------------------------------------
+
+    @staticmethod
+    def _fabric_key(key: tuple) -> bytes:
+        h = hashlib.blake2b(digest_size=16)
+        for part in key:
+            b = part.encode()
+            h.update(len(b).to_bytes(4, "little"))
+            h.update(b)
+        return h.digest()
+
+    def _fabric_probe(self, key: tuple) -> Optional[dict]:
+        """First sighting of a template: fetch a peer's published
+        structure (or its uncacheable verdict). None = nothing shared
+        (or no fabric) — the normal second-sighting rule applies."""
+        from greptimedb_tpu import shm
+        from greptimedb_tpu.shm.fabric import FabricError
+
+        fabric = shm.get_fabric()
+        if fabric is None:
+            return None
+        try:
+            blob = fabric.get("tpl", self._fabric_key(key))
+        except (FabricError, OSError, ValueError):
+            shm.detach()
+            return None
+        if blob is None:
+            SHM_FABRIC_EVENTS.inc(event="miss", kind="template")
+            return None
+        try:
+            payload = pickle.loads(blob)
+        except Exception:  # noqa: BLE001 — a stale-code peer's blob
+            return None
+        if not isinstance(payload, dict):
+            return None
+        if not payload.get("uncacheable"):
+            try:
+                if payload.get("ver") != fabric.version(
+                        payload["db"], payload["table"]):
+                    # peer DDL since publish: the binder structure may
+                    # describe a dead shape
+                    SHM_FABRIC_EVENTS.inc(event="miss", kind="template")
+                    return None
+            except (FabricError, OSError, ValueError):
+                shm.detach()
+                return None
+            except KeyError:
+                return None
+        return payload
+
+    def _fabric_publish(self, key: tuple, entry: _Entry) -> None:
+        """Share a locally proven binder — structure only, no literal
+        values (adopters re-derive those from their own parse)."""
+        from greptimedb_tpu import shm
+        from greptimedb_tpu.shm.fabric import FabricError
+
+        fabric = shm.get_fabric()
+        if fabric is None:
+            return
+        payload = {
+            "db": entry.db,
+            "table": entry.table,
+            "shape": entry.shape,
+            "bound": [(pos, x) for pos, (tag, x)
+                      in enumerate(entry.binder) if tag == "s"],
+            "pinned": [i for (i, _t, _v) in entry.pinned],
+        }
+        try:
+            payload["ver"] = fabric.version(entry.db, entry.table)
+            blob = pickle.dumps(payload,
+                                protocol=pickle.HIGHEST_PROTOCOL)
+            if fabric.put("tpl", self._fabric_key(key), blob):
+                SHM_FABRIC_EVENTS.inc(event="publish", kind="template")
+        except (FabricError, OSError, ValueError):
+            shm.detach()
+
+    def _fabric_publish_uncacheable(self, key: tuple) -> None:
+        from greptimedb_tpu import shm
+        from greptimedb_tpu.shm.fabric import FabricError
+
+        fabric = shm.get_fabric()
+        if fabric is None:
+            return
+        try:
+            blob = pickle.dumps({"uncacheable": True},
+                                protocol=pickle.HIGHEST_PROTOCOL)
+            if fabric.put("tpl", self._fabric_key(key), blob):
+                SHM_FABRIC_EVENTS.inc(event="publish", kind="template")
+        except (FabricError, OSError, ValueError):
+            shm.detach()
 
     # ---- hit ---------------------------------------------------------------
 

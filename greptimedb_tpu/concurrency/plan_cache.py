@@ -25,20 +25,31 @@ stamped with `rollup.substitution_state_version()` and dies the moment
 any rollup state changes (a new roll, a drop), so a shape that becomes
 substitutable is re-probed.
 
+With the serving fabric on (`[shm] fabric`), the cache grows a third
+tier: a local miss probes the shared-memory fabric for a peer process's
+published entry before re-planning, and every local build publishes its
+entry for peers. Adoption runs the SAME safety nets an in-process hit
+runs (`_info_matches` + `_bind`), plus a fabric-version check: a peer
+DDL bumps the (db, table) version through the fabric, killing every
+artifact published under the old one. The rollup-substitution memo is
+NEVER adopted — it indexes this process's rollup state.
+
 Every event lands in gtpu_plan_cache_events_total{event=hit|miss|evict|
-invalidate}.
+invalidate}; fabric traffic in gtpu_shm_fabric_events_total{kind=plan}.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import hashlib
+import pickle
 import threading
 from collections import OrderedDict
 from typing import Optional
 
 from greptimedb_tpu.query import logical as lp
 from greptimedb_tpu.sql import ast
-from greptimedb_tpu.utils.metrics import PLAN_CACHE_EVENTS
+from greptimedb_tpu.utils.metrics import PLAN_CACHE_EVENTS, SHM_FABRIC_EVENTS
 
 
 def _map_where_literals(e, fn):
@@ -181,6 +192,10 @@ class PlanCache:
                 self._entries.move_to_end(key)
         from greptimedb_tpu.utils import ledger
 
+        adopted = False
+        if ent is None:
+            ent = self._fabric_probe(key, info)
+            adopted = ent is not None
         if ent is None:
             PLAN_CACHE_EVENTS.inc(event="miss")
             ledger.cache_event("plan", "miss")
@@ -199,9 +214,87 @@ class PlanCache:
             PLAN_CACHE_EVENTS.inc(event="miss")
             ledger.cache_event("plan", "miss")
             return None, None, (key, params)
+        if adopted:
+            # insert only after the bind proved the adopted entry sound
+            with self._lock:
+                self._entries[key] = ent
+                self._entries.move_to_end(key)
+                while len(self._entries) > self.capacity:
+                    self._entries.popitem(last=False)
         PLAN_CACHE_EVENTS.inc(event="hit")
         ledger.cache_event("plan", "hit")
         return plan, ent, (key, params)
+
+    # ---- fabric tier -------------------------------------------------------
+
+    @staticmethod
+    def _fabric_key(key: tuple) -> bytes:
+        """(db, table, shape) → fixed digest; shape reprs routinely
+        exceed the fabric's key cap."""
+        h = hashlib.blake2b(digest_size=16)
+        for part in key:
+            b = part.encode()
+            h.update(len(b).to_bytes(4, "little"))
+            h.update(b)
+        return h.digest()
+
+    def _fabric_probe(self, key: tuple, info) -> Optional[_Entry]:
+        """After a local miss: adopt a peer process's published entry.
+        Returns None on any doubt (absent fabric, stale version, info
+        drift, undecodable blob) — the caller re-plans as before."""
+        from greptimedb_tpu import shm
+        from greptimedb_tpu.shm.fabric import FabricError
+
+        fabric = shm.get_fabric()
+        if fabric is None:
+            return None
+        try:
+            blob = fabric.get("plan", self._fabric_key(key))
+            if blob is None:
+                SHM_FABRIC_EVENTS.inc(event="miss", kind="plan")
+                return None
+            cur = fabric.version(key[0], key[1])
+        except (FabricError, OSError, ValueError):
+            shm.detach()
+            return None
+        try:
+            ver, ent = pickle.loads(blob)
+        except Exception:  # noqa: BLE001 — a stale-code peer's blob
+            return None
+        if not isinstance(ent, _Entry) or ver != cur \
+                or not _info_matches(ent.info, info):
+            SHM_FABRIC_EVENTS.inc(event="miss", kind="plan")
+            return None
+        # the substitution memo indexes the PUBLISHER's rollup state;
+        # this process re-probes from scratch
+        ent.sub_skip_version = None
+        SHM_FABRIC_EVENTS.inc(event="hit", kind="plan")
+        return ent
+
+    def _fabric_publish(self, key: tuple, ent: _Entry) -> None:
+        """After a local build: share the validated entry. The version
+        is read BEFORE the put — a concurrent DDL bumping it makes the
+        published artifact fail its adopt check (fail closed)."""
+        from greptimedb_tpu import shm
+        from greptimedb_tpu.shm.fabric import FabricError
+
+        fabric = shm.get_fabric()
+        if fabric is None:
+            return
+        try:
+            ver = fabric.version(key[0], key[1])
+            blob = pickle.dumps((ver, ent),
+                                protocol=pickle.HIGHEST_PROTOCOL)
+        except (FabricError, OSError, ValueError):
+            shm.detach()
+            return
+        except Exception:  # noqa: BLE001 — unpicklable plan: not shared
+            return
+        try:
+            if fabric.put("plan", self._fabric_key(key), blob):
+                SHM_FABRIC_EVENTS.inc(event="publish", kind="plan")
+        except (FabricError, OSError, ValueError):
+            shm.detach()
 
     def _bind(self, ent: _Entry, params: tuple) -> lp.LogicalPlan:
         """Re-bind the template to this query's parameter values and
@@ -272,6 +365,7 @@ class PlanCache:
                 evicted += 1
         if evicted:
             PLAN_CACHE_EVENTS.inc(float(evicted), event="evict")
+        self._fabric_publish(key, ent)
         return ent
 
     # ---- invalidation ------------------------------------------------------

@@ -49,6 +49,7 @@ SERVING_PREFIXES = (
     "greptimedb_tpu/fault/",
     "greptimedb_tpu/utils/deadline.py",
     "greptimedb_tpu/ingest.py",
+    "greptimedb_tpu/shm/",
 )
 
 #: method names whose zero-timeout call parks the thread

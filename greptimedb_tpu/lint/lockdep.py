@@ -177,6 +177,12 @@ def install() -> None:
     threading.Lock = make_lock
     threading.RLock = make_rlock
     _installed = True
+    if os.environ.get("GTPU_LOCKDEP_DIR"):
+        # cross-process mode (ProcessCluster children, encode workers):
+        # leave this process's edge set behind for the parent's merge
+        import atexit
+
+        atexit.register(dump)
 
 
 def uninstall() -> None:
@@ -219,6 +225,81 @@ def assert_acyclic() -> dict:
     problems = list(rep["violations"])
     if rep["cycle"]:
         problems.append("observed lock-order cycle: "
+                        + " -> ".join(rep["cycle"]))
+    if problems:
+        raise LockOrderViolation("; ".join(problems))
+    return rep
+
+
+# ---- cross-process merge (the serving-fabric box: N frontends) -------------
+
+def dump(dir_path: str = "") -> str | None:
+    """Write this process's observed edge set to
+    `<dir>/lockdep-<pid>.json` (atomic rename) so a coordinating parent
+    can merge lock graphs across every process on the box. The dir
+    comes from GTPU_LOCKDEP_DIR when not given; no dir = no-op."""
+    dir_path = dir_path or os.environ.get("GTPU_LOCKDEP_DIR", "")
+    if not dir_path:
+        return None
+    import json
+
+    os.makedirs(dir_path, exist_ok=True)
+    with _meta:
+        edges = sorted(_edges)
+        violations = list(_violations)
+    path = os.path.join(dir_path, f"lockdep-{os.getpid()}.json")
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump({"pid": os.getpid(),
+                   "edges": [list(e) for e in edges],
+                   "violations": violations}, f)
+    os.replace(tmp, path)
+    return path
+
+
+def merged_report(dir_path: str = "") -> dict:
+    """The cross-process union: this process's live edges plus every
+    `lockdep-*.json` a child/peer dumped. Lock identities are creation
+    sites (file:line), so the same lock class in two processes merges
+    into one node — exactly what makes the union meaningful."""
+    import glob
+    import json
+
+    rep = report()
+    edges = {tuple(e) for e in rep["edges"]}
+    violations = list(rep["violations"])
+    sources = 1
+    dir_path = dir_path or os.environ.get("GTPU_LOCKDEP_DIR", "")
+    if dir_path:
+        for path in sorted(glob.glob(
+                os.path.join(dir_path, "lockdep-*.json"))):
+            try:
+                with open(path) as f:
+                    d = json.load(f)
+            except (OSError, ValueError):
+                continue  # a child died mid-dump: its edges are lost,
+                #           not corrupting
+            sources += 1
+            edges.update(tuple(e) for e in d.get("edges", [])
+                         if isinstance(e, list) and len(e) == 2)
+            violations.extend(str(v) for v in d.get("violations", []))
+    from greptimedb_tpu.lint.astutil import find_cycle
+
+    graph: dict = {}
+    for a, b in sorted(edges):
+        graph.setdefault(a, set()).add(b)
+    return {"edges": [list(e) for e in sorted(edges)],
+            "violations": violations,
+            "cycle": find_cycle(graph),
+            "processes": sources}
+
+
+def assert_acyclic_merged(dir_path: str = "") -> dict:
+    """assert_acyclic over the merged cross-process graph."""
+    rep = merged_report(dir_path)
+    problems = list(rep["violations"])
+    if rep["cycle"]:
+        problems.append("observed lock-order cycle (merged): "
                         + " -> ".join(rep["cycle"]))
     if problems:
         raise LockOrderViolation("; ".join(problems))

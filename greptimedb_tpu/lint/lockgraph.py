@@ -40,6 +40,9 @@ SCOPE_PREFIXES = (
     # shares the DeviceCache lock — machine-check it like the rest of
     # the serving plane
     "greptimedb_tpu/parallel/",
+    # the serving fabric: every request thread may touch the shared
+    # segment locks, so its nesting is part of the serving lock graph
+    "greptimedb_tpu/shm/",
 )
 SCOPE_FILES = (
     "greptimedb_tpu/storage/scan_pool.py",

@@ -36,6 +36,16 @@ from greptimedb_tpu.utils.metrics import (
 )
 
 _COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+#: fired INSIDE backend_compile when the persistent compilation cache
+#: serves the executable — that enclosing compile event is a retrieval,
+#: not a compilation, and must not count as one (the serving fabric's
+#: shared-executable contract is "process 2 compiles nothing", asserted
+#: as an xla_compile_total delta of zero)
+_CACHE_HIT_EVENT = "/jax/compilation_cache/cache_retrieval_time_sec"
+
+#: both events fire on the thread running the compile, so a plain
+#: thread-local flag pairs a retrieval with its enclosing compile event
+_compile_tls = threading.local()
 
 _install_lock = threading.Lock()
 _installed = False
@@ -62,7 +72,17 @@ def count_d2h(nbytes: int) -> None:
 
 
 def _on_event_duration(event: str, duration_secs: float, **kwargs) -> None:
+    if event == _CACHE_HIT_EVENT:
+        pending = getattr(_compile_tls, "cache_hits", 0)
+        _compile_tls.cache_hits = pending + 1
+        return
     if event != _COMPILE_EVENT:
+        return
+    pending = getattr(_compile_tls, "cache_hits", 0)
+    if pending:
+        # persistent-cache retrieval wrapped in a compile event: the
+        # backend compiled nothing, so the compile counter stays put
+        _compile_tls.cache_hits = pending - 1
         return
     import jax
 

@@ -15,7 +15,26 @@ class Counter:
         self.name = name
         self.help = help_
         self._values: dict[tuple, float] = defaultdict(float)
+        # cumulative snapshots published by OTHER processes (encode-pool
+        # workers via the shm fabric), folded into every read — the
+        # cross-process twin of the per-thread shards
+        self._external: dict[str, dict] = {}
         self._lock = threading.Lock()
+
+    def set_external(self, source: str, snapshot: dict) -> None:
+        """Install a cumulative series snapshot from another process
+        (keyed by a stable source id, e.g. the worker pid); replaces
+        that source's previous snapshot — snapshots are cumulative, so
+        folding the latest one per source never double-counts."""
+        with self._lock:
+            self._external[source] = dict(snapshot)
+
+    def _fold_external_locked(self, out: dict) -> dict:
+        """Caller holds self._lock."""
+        for snap in self._external.values():
+            for k, v in snap.items():
+                out[k] = out.get(k, 0.0) + v
+        return out
 
     def inc(self, value: float = 1.0, **labels):
         key = tuple(sorted(labels.items()))
@@ -49,7 +68,7 @@ class Counter:
         """Point-in-time copy of every series (Registry sampling uses
         this so sharded subclasses can fold their shards in)."""
         with self._lock:
-            return dict(self._values)
+            return self._fold_external_locked(dict(self._values))
 
     def render(self, exemplars: bool = False) -> list[str]:
         # OpenMetrics family naming: the metric FAMILY drops the _total
@@ -129,7 +148,8 @@ class ShardedCounter(Counter):
             # a shard another thread may be appending to
             for k, v in list(cell.items()):
                 out[k] = out.get(k, 0.0) + v
-        return out
+        with self._lock:
+            return self._fold_external_locked(out)
 
 
 class Gauge(Counter):
@@ -166,7 +186,44 @@ class Histogram:
         # gtpu_query_stage_seconds bucket links to a trace to pull)
         self._exemplars_on = exemplars
         self._exemplar: dict[tuple, tuple] = {}
+        # cumulative (buckets, sum, count) snapshots published by other
+        # processes (encode-pool workers via the shm fabric); folded
+        # into every read so worker-side observations are exact in the
+        # parent's /metrics instead of parent-side approximations
+        self._external: dict[str, dict] = {}
         self._lock = threading.Lock()
+
+    def set_external(self, source: str, state: dict) -> None:
+        """Install another process's cumulative series state (the shape
+        `export_state` returns). Replaces that source's previous
+        snapshot, so cumulative republishing never double-counts."""
+        with self._lock:
+            self._external[source] = state
+
+    def export_state(self) -> dict:
+        """This process's cumulative series, keyed for set_external:
+        {label-key: ([bucket counts], sum, count)}."""
+        with self._lock:
+            return {key: (list(b), self._sum[key], self._count[key])
+                    for key, b in self._buckets.items()}
+
+    def _merged_locked(self):
+        """Local series with every external snapshot folded in —
+        caller holds self._lock."""
+        buckets = {key: list(b) for key, b in self._buckets.items()}
+        sums = dict(self._sum)
+        counts = dict(self._count)
+        for state in self._external.values():
+            for key, (b, s, c) in state.items():
+                if len(b) != len(self.BUCKETS) + 1:
+                    continue  # bucket-grid drift across versions: skip
+                if key in buckets:
+                    buckets[key] = [x + y for x, y in zip(buckets[key], b)]
+                else:
+                    buckets[key] = list(b)
+                sums[key] = sums.get(key, 0.0) + s
+                counts[key] = counts.get(key, 0) + c
+        return buckets, sums, counts
 
     def observe(self, value: float, **labels):
         tid = None
@@ -200,33 +257,36 @@ class Histogram:
         """Total of observed values for one label set (benches read the
         execute/encode wall-time split from here)."""
         with self._lock:
-            return self._sum.get(tuple(sorted(labels.items())), 0.0)
+            _, sums, _ = self._merged_locked()
+        return sums.get(tuple(sorted(labels.items())), 0.0)
 
     def count(self, **labels) -> int:
         with self._lock:
-            return self._count.get(tuple(sorted(labels.items())), 0)
+            _, _, counts = self._merged_locked()
+        return counts.get(tuple(sorted(labels.items())), 0)
 
     def total_count(self, **labels) -> int:
         """Observation count summed over every series whose labels are
         a superset of the given ones (Counter.total's analog)."""
         want = set(labels.items())
         with self._lock:
-            return sum(c for key, c in self._count.items()
-                       if want <= set(key))
+            _, _, counts = self._merged_locked()
+        return sum(c for key, c in counts.items() if want <= set(key))
 
     def total_sum(self, **labels) -> float:
         """Observed-value total over matching series (see total_count)."""
         want = set(labels.items())
         with self._lock:
-            return sum(s for key, s in self._sum.items()
-                       if want <= set(key))
+            _, sums, _ = self._merged_locked()
+        return sum(s for key, s in sums.items() if want <= set(key))
 
     def render(self, exemplars: bool = False) -> list[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
         with self._lock:
+            buckets, sums, counts = self._merged_locked()
             snapshot = sorted(
-                (key, list(b), self._sum[key], self._count[key])
-                for key, b in self._buckets.items()
+                (key, b, sums[key], counts[key])
+                for key, b in buckets.items()
             )
             ex = dict(self._exemplar) if exemplars else {}
         for key, b, _sum, _count in snapshot:
@@ -355,8 +415,8 @@ class Registry:
         for m in metrics:
             if isinstance(m, Histogram):
                 with m._lock:
-                    items = [(key, m._sum[key], m._count[key])
-                             for key in m._count]
+                    _, sums, counts = m._merged_locked()
+                items = [(key, sums[key], counts[key]) for key in counts]
                 for key, s, c in items:
                     yield m.name + "_sum", s, key
                     yield m.name + "_count", c, key
@@ -599,8 +659,31 @@ ENCODE_SECONDS = REGISTRY.histogram(
     "greptimedb_tpu_encode_seconds",
     "Wall time serializing one query result to its wire format "
     "(HTTP JSON / MySQL packets), by protocol — compare against "
-    "query_duration_seconds for the execute-vs-encode split",
+    "query_duration_seconds for the execute-vs-encode split; "
+    "protocol=process series are measured inside the spawn-mode encode "
+    "workers and folded in through the shm fabric metrics bridge, so "
+    "they are exact worker wall time, not a parent-side round trip",
     exemplars=True)
+
+# cross-process serving fabric (greptimedb_tpu/shm/): the shared-memory
+# artifact plane N frontend processes on one box attach to — fast-lane
+# templates, plan-cache entries, warm XLA shape keys, zero-copy result
+# handoff, and the worker->parent metrics bridge all ride it
+SHM_FABRIC_EVENTS = REGISTRY.sharded_counter(
+    "greptimedb_tpu_shm_fabric_events_total",
+    "Serving-fabric events by kind (hit = an artifact adopted from a "
+    "peer process instead of rebuilt, miss = probed but absent, "
+    "publish = a locally built artifact shared, invalidate = a version "
+    "bump or wipe fanned out to peers, corrupt = a slot failed its "
+    "generation/bounds check, detach = this process fell back to the "
+    "private in-process lane; the kind label names the artifact plane: "
+    "template/plan/result/metrics/fabric)")
+SHM_FABRIC_BYTES = REGISTRY.gauge(
+    "greptimedb_tpu_shm_fabric_bytes",
+    "Bytes of the attached shared-memory fabric by segment "
+    "(fabric = the artifact plane, arena = the zero-copy result "
+    "arena) and dimension (size = mapped capacity, used = heap bytes "
+    "behind the current write cursor)")
 
 # parse-free serving fast lane (concurrency/fast_lane.py, ISSUE 14): a
 # text-keyed template cache in front of the plan cache — a repeat-shape
