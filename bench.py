@@ -621,6 +621,131 @@ def bench_high_cardinality(engine, qe, results, ingest_rps=300000.0):
         "target_rows": target_rows, "at_spec": rows >= target_rows,
         "scan_rows_per_s": round(rps), "baseline_ms": None,
         "vs_baseline": None}
+    if budget_left_s() > 150:
+        results["high_cardinality"]["sparse_envelope"] = \
+            _bench_sparse_envelope(engine, qe)
+    else:
+        log("hc sparse envelope skipped: budget")
+
+
+def _bench_sparse_envelope(engine, qe):
+    """ISSUE 20 acceptance leg: a 256k-group group-by served by the
+    sort-compact plane on the fused and incremental tiers (no dense
+    fallback — the served paths are asserted, not assumed), its warm
+    repeat against the pre-sparse fallback (whole-scan recompute with
+    the partial cache refusing >64k groups), a label-selector lastpoint,
+    and the sparse dispatch/compaction metrics for the capture file."""
+    import jax
+
+    from greptimedb_tpu.datatypes import DictVector, RecordBatch
+    from greptimedb_tpu.utils.metrics import (
+        SPARSE_COMPACTION_RATIO,
+        SPARSE_DISPATCHES,
+    )
+
+    groups = int(os.environ.get("BENCH_HC_SPARSE_GROUPS", str(1 << 18)))
+    points = int(os.environ.get("BENCH_HC_SPARSE_POINTS", "4"))
+    qe.execute_one(
+        "CREATE TABLE hc_sparse (tag STRING, v DOUBLE, ts TIMESTAMP(3) "
+        "NOT NULL, TIME INDEX (ts), PRIMARY KEY (tag)) "
+        "WITH (append_mode = 'true')")
+    info = qe.catalog.table("public", "hc_sparse")
+    rid = info.region_ids[0]
+    rng = np.random.default_rng(17)
+    names = np.asarray([f"t{i:06d}" for i in range(groups)], dtype=object)
+    # ts tracks the row index, so a ts window selects a GROUP subset —
+    # what lets the CPU fused leg (interpret mode) run a budget-sized
+    # slice that still crosses the 4096-segment envelope
+    n_total = groups * points
+    t0 = time.perf_counter()
+    written = 0
+    while written < n_total:
+        n = min(1 << 21, n_total - written)
+        idx = written + np.arange(n)
+        codes = (idx // points).astype(np.int32)
+        c0, c1 = int(codes[0]), int(codes[-1]) + 1
+        engine.put(rid, RecordBatch(info.schema, {
+            "tag": DictVector(codes - c0, names[c0:c1]),
+            "ts": (T0_MS + idx).astype(np.int64),
+            "v": np.floor(rng.uniform(0, 1000, n))}))
+        prev = written
+        written += n
+        if prev < n_total // 2 <= written:
+            engine.flush(rid)  # two files: the incremental fold has parts
+    engine.flush(rid)
+    ingest_s = time.perf_counter() - t0
+    log(f"hc sparse: {n_total} rows / {groups} groups ingested in "
+        f"{ingest_s:.1f}s")
+
+    paths = {}
+
+    def leg(name, sql, repeats=REPEATS, **overrides):
+        saved = {k: os.environ.get(k) for k in overrides}
+        for k, v in overrides.items():
+            os.environ[k] = v
+        try:
+            p50, warm_ms, nrows, _ = timed_sql(qe, sql, repeats=repeats)
+            paths[name] = qe.executor.last_path
+            return p50, warm_ms, nrows
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    # the 256k domain sits inside the default dense budget; the
+    # sparse_groups_min knob is exactly the lever that routes it onto
+    # the sort-compact plane (as a 1M+ domain would route by itself)
+    force = {"GREPTIMEDB_TPU_SPARSE_GROUPS_MIN": "1"}
+    sql = "SELECT tag, sum(v), count(v), max(v) FROM hc_sparse GROUP BY tag"
+    inc_p50, inc_cold, nrows = leg("incremental", sql, **force)
+    assert nrows == groups, (nrows, groups)
+    fb_p50, _, _ = leg("fallback", sql,
+                       GREPTIMEDB_TPU_PARTIAL_CACHE="off",
+                       GREPTIMEDB_TPU_PALLAS="off", **force)
+    on_tpu = jax.default_backend() == "tpu"
+    fused_rows = n_total if on_tpu else int(
+        os.environ.get("BENCH_HC_FUSED_ROWS", "20480"))  # 5120 groups:
+    # past the 4096-segment envelope, so interpret mode really tiles
+    fused_sql = sql if on_tpu else (
+        f"SELECT tag, sum(v) FROM hc_sparse WHERE ts < "
+        f"{T0_MS + fused_rows} GROUP BY tag")
+    fused_p50, _, fused_groups = leg(
+        "fused", fused_sql, repeats=1,
+        GREPTIMEDB_TPU_PALLAS="on",
+        GREPTIMEDB_TPU_PARTIAL_CACHE="off", **force)
+    lp_sql = ("SELECT last_value(v ORDER BY ts) FROM hc_sparse "
+              f"WHERE tag = 't{groups // 2:06d}'")
+    lp_p50, _, _ = leg("lastpoint", lp_sql)
+
+    for name in ("incremental", "fallback", "fused"):
+        if "sparse" not in (paths.get(name) or ""):
+            raise RuntimeError(
+                f"hc sparse leg {name!r} fell back to {paths.get(name)!r} "
+                "— dense fallback is an acceptance failure")
+    speedup = fb_p50 / inc_p50 if inc_p50 > 0 else float("inf")
+    log(f"hc sparse 256k-group: warm {inc_p50:.1f} ms vs pre-sparse "
+        f"fallback {fb_p50:.1f} ms ({speedup:.1f}x); fused "
+        f"{fused_p50:.1f} ms over {fused_groups} groups; lastpoint "
+        f"{lp_p50:.2f} ms")
+    return {
+        "groups": groups, "rows": n_total,
+        "ingest_rows_per_s": round(n_total / ingest_s),
+        "groupby_warm_p50_ms": round(inc_p50, 2),
+        "groupby_cold_ms": round(inc_cold, 2),
+        "fallback_p50_ms": round(fb_p50, 2),
+        "warm_speedup_vs_fallback": round(speedup, 2),
+        "meets_2x": speedup >= 2.0,
+        "fused_p50_ms": round(fused_p50, 2), "fused_rows": fused_rows,
+        "fused_groups": int(fused_groups),
+        "lastpoint_p50_ms": round(lp_p50, 2),
+        "paths": paths,
+        "sparse_dispatch_total": {
+            p: SPARSE_DISPATCHES.get(path=p)
+            for p in ("classic", "fused", "sharded", "incremental",
+                      "vmapped")},
+        "compaction_ratio": round(SPARSE_COMPACTION_RATIO.get(), 6)}
 
 
 def bench_double_groupby_100m(engine, qe, results, ingest_rps):

@@ -79,6 +79,27 @@ def sparse_groups_max() -> int:
     return int(os.environ.get("GREPTIMEDB_TPU_SPARSE_GROUPS_MAX", str(1 << 22)))
 
 
+def sparse_groups_min() -> int:
+    """Key products at or above this ALSO take the sparse sort-compact
+    path even when they fit the dense budget (0 = off, the default:
+    dense wins while its planes fit). The lever for date_bin queries
+    whose bucket domain blows the fused kernel's 4096-segment envelope
+    but whose observed groups compact well — the tiled sparse-fused
+    path keeps them on the kernel."""
+    return int(os.environ.get("GREPTIMEDB_TPU_SPARSE_GROUPS_MIN", "0"))
+
+
+def tier_admission() -> bool:
+    """Hot-set-aware tier admission: before the latency-history router,
+    consult which tier's device/HBM hot set already holds the scan's
+    file-anchored blocks and route there (re-uploading a hot scan to
+    the OTHER tier pays the full H2D cost for nothing).
+    GREPTIMEDB_TPU_TIER_ADMISSION=off restores pure history/heuristic
+    routing — the A/B benching override."""
+    return os.environ.get("GREPTIMEDB_TPU_TIER_ADMISSION", "on").lower() \
+        not in ("0", "false", "off")
+
+
 def stream_threshold_rows() -> int:
     """Aggregate scans at or above this row estimate run the streaming
     (bounded-memory) path: lazy row-group chunks -> fixed-shape device

@@ -20,6 +20,10 @@ them over the repo's own AST so the next PR cannot silently regress:
   blocking      no blocking syscall (sleep/fsync/socket/subprocess)
                 while holding a lock — the group-commit pipeline's
                 fsync-outside-the-region-lock contract, machine-checked
+  escape        lambdas/closures built under a `with lock:` that read
+                guarded state must not escape the guard into pools,
+                queues, threads, or callbacks — the closure runs later
+                without the lock the author visibly wrote
   datarace      attributes guarded by a lock in one method must not be
                 accessed bare in another (caller-holds-lock docstring
                 contracts and the _locked naming convention count as
@@ -226,6 +230,7 @@ def _import_checkers() -> None:
         datarace,
         deadcode,
         deadline,
+        escape,
         fault_seam,
         jax_imports,
         lockgraph,

@@ -58,6 +58,9 @@ MAX_SEGMENTS = 4096
 #: fused kernel: raw value lanes F padded to 8, [vals | valid | rows]
 #: layout 2*FW+1 must fit the 128-lane output tile
 MAX_FUSED_FIELDS = 56
+#: with the sumsq lanes riding along ([vals | valid | rows | sq]):
+#: 3*FW+1 <= 128
+MAX_FUSED_FIELDS_SUMSQ = 40
 
 
 def _round_up(x: int, m: int) -> int:
@@ -146,7 +149,8 @@ def eligible(shape: tuple, num_segments: int) -> bool:
 # 2F+1 sum plane plus two F-wide identity-filled extreme planes.
 
 
-def _fused_kernel(ids_ref, vals_ref, *out_refs, nf, fw, want_min, want_max):
+def _fused_kernel(ids_ref, vals_ref, *out_refs, nf, fw, want_min, want_max,
+                  want_sumsq):
     i = pl.program_id(0)
     sum_ref = out_refs[0]
     min_ref = out_refs[1] if want_min else None
@@ -168,12 +172,17 @@ def _fused_kernel(ids_ref, vals_ref, *out_refs, nf, fw, want_min, want_max):
     onehot_b = (jax.lax.broadcasted_iota(jnp.int32, (gp, nb), 0) == ids)
     valid = ~jnp.isnan(vals)                       # [Nb, FW] in-register
     zeroed = jnp.where(valid, vals, jnp.asarray(0, dt))
-    pad_w = sum_ref.shape[1] - 2 * fw
-    # [zeroed | valid | rows-one | 0-pad]: the prepared-plane layout,
-    # assembled in VMEM registers instead of host RAM + H2D
+    pad_w = sum_ref.shape[1] - (3 if want_sumsq else 2) * fw
+    # [zeroed | valid | rows-one | 0-pad (| squares)]: the prepared-plane
+    # layout, assembled in VMEM registers instead of host RAM + H2D; the
+    # variance moment rides the SAME matmul as extra lanes (NaN already
+    # zeroed, so squares contribute exactly where elem-valid)
     rows_col = (jax.lax.broadcasted_iota(jnp.int32, (nb, pad_w), 1)
                 == 0).astype(dt)
-    plane = jnp.concatenate([zeroed, valid.astype(dt), rows_col], axis=1)
+    segs = [zeroed, valid.astype(dt), rows_col]
+    if want_sumsq:
+        segs.append(zeroed * zeroed)
+    plane = jnp.concatenate(segs, axis=1)
     # see _kernel: HIGHEST recovers f32 accuracy from the bf16 MXU passes
     sum_ref[...] += jnp.dot(onehot_b.astype(dt), plane,
                             preferred_element_type=dt,
@@ -209,21 +218,25 @@ def _fused_kernel(ids_ref, vals_ref, *out_refs, nf, fw, want_min, want_max):
 
 @functools.partial(jax.jit,
                    static_argnames=("num_segments", "want_min", "want_max",
-                                    "block_rows", "interpret"))
+                                    "want_sumsq", "block_rows", "interpret"))
 def pallas_fused_segment_agg(
     vals: jax.Array,  # [N, F] raw field values (NaN = NULL)
     ids: jax.Array,  # [N] int32 group ids (masked rows -> num_segments-1)
     num_segments: int,
     want_min: bool = False,
     want_max: bool = False,
+    want_sumsq: bool = False,
     block_rows: int = 512,
     interpret: bool = False,
 ) -> dict:
     """Fused masked segment aggregation on the MXU/VPU: one pallas_call
-    emits {"sum" [G, F], "count" [G, F], "rows" [G], "min"/"max" [G, F]}.
-    Caller must pre-check fused_eligible() and prove the values finite
-    (Inf would poison the 0*x matmul — same contract as the sum kernel);
-    NaN is handled in-register as SQL NULL. Masked rows arrive encoded
+    emits {"sum" [G, F], "count" [G, F], "rows" [G], "min"/"max" [G, F],
+    "sumsq" [G, F]}. Caller must pre-check fused_eligible() and prove
+    the values finite (Inf would poison the 0*x matmul — same contract
+    as the sum kernel); NaN is handled in-register as SQL NULL. The
+    sumsq lanes accumulate in the kernel dtype — callers needing the
+    f64 variance contract must feed f64 values (interpret mode / x64
+    chips) or stay on the prepared path. Masked rows arrive encoded
     into the dead segment num_segments-1, exactly like the sum kernel;
     empty/all-NULL groups come back as 0 counts and ±inf extremes."""
     n, nf = vals.shape
@@ -242,7 +255,8 @@ def pallas_fused_segment_agg(
         out_shapes.append(jax.ShapeDtypeStruct((gp, fw), vals.dtype))
         out_specs.append(pl.BlockSpec((gp, fw), lambda i: (0, 0)))
     kern = functools.partial(_fused_kernel, nf=nf, fw=fw,
-                             want_min=want_min, want_max=want_max)
+                             want_min=want_min, want_max=want_max,
+                             want_sumsq=want_sumsq)
     ctx = _enable_x64(False) if vals.dtype != jnp.float64 \
         else contextlib.nullcontext()
     with ctx:
@@ -264,6 +278,9 @@ def pallas_fused_segment_agg(
         "count": total[:g, fw:fw + nf],
         "rows": total[:g, 2 * fw],
     }
+    if want_sumsq:
+        # squares sit at the plane's tail: [.. | rows+pad | sq] layout
+        out["sumsq"] = total[:g, MAX_WIDTH - fw:MAX_WIDTH - fw + nf]
     k = 1
     if want_min:
         out["min"] = outs[k][:g, :nf]
@@ -273,10 +290,14 @@ def pallas_fused_segment_agg(
     return out
 
 
-def fused_eligible(nf: int, num_segments: int) -> bool:
+def fused_eligible(nf: int, num_segments: int,
+                   want_sumsq: bool = False) -> bool:
     """Shapes the fused kernel handles; everything else takes the
-    prepared-plane path (XLA scatter reductions)."""
-    return 0 < nf <= MAX_FUSED_FIELDS and 0 < num_segments <= MAX_SEGMENTS
+    prepared-plane path (XLA scatter reductions). The sumsq lanes eat a
+    third field-width stripe of the 128-lane output tile: 3*FW+1 <= 128
+    caps the field count at 40 when the variance moment rides along."""
+    limit = MAX_FUSED_FIELDS_SUMSQ if want_sumsq else MAX_FUSED_FIELDS
+    return 0 < nf <= limit and 0 < num_segments <= MAX_SEGMENTS
 
 
 _TPU_COMPILE_OK: bool | None = None

@@ -31,6 +31,7 @@ import numpy as np
 from greptimedb_tpu.datatypes.types import DataType, SemanticType
 from greptimedb_tpu.ops.blocks import DEFAULT_BLOCK_ROWS, block_size_for, pad_rows
 from greptimedb_tpu.ops.dedup import sort_dedup
+from greptimedb_tpu.ops import sparse_segment as sparse_ops
 from greptimedb_tpu.ops.segment import (
     _type_max as _seg_type_max,
     _type_min as _seg_type_min,
@@ -338,12 +339,16 @@ def _agg_scan_prepared(
 
 
 def _pack_float_ops(sums, cnts, rows, tmin, tmax, tsq, float_ops,
-                    pack_dtype):
+                    pack_dtype, extra=None):
     """Finalize + pack the prepared/fused accumulator planes into the
-    one packed_f matrix both paths ship back over the link."""
+    one packed_f matrix both paths ship back over the link. `extra`
+    supplies already-finalized planes the kernel can't derive (the
+    fused path's first/last value planes)."""
     acc: dict[str, jax.Array] = {}
     for k in float_ops:
-        if k == "sum":
+        if extra is not None and k in extra:
+            acc[k] = extra[k]
+        elif k == "sum":
             acc[k] = sums
         elif k == "count":
             acc[k] = cnts
@@ -371,30 +376,41 @@ def _pack_float_ops(sums, cnts, rows, tmin, tmax, tsq, float_ops,
 @functools.partial(
     jax.jit,
     static_argnames=("where", "keys", "arg_names", "num_segments",
-                     "tag_names", "schema", "float_ops", "pack_dtype",
-                     "acc_dtype", "want_min", "want_max", "interpret"),
+                     "ts_name", "tag_names", "schema", "float_ops",
+                     "int_ops", "pack_dtype", "acc_dtype", "want_min",
+                     "want_max", "want_sumsq", "interpret"),
 )
 def _agg_scan_fused(
     blocks: tuple,  # per-block dicts of RAW column arrays (hot set)
     n_valids: jax.Array,
     dedup_masks,
     *,
-    where, keys, arg_names, num_segments, tag_names, schema, float_ops,
-    pack_dtype, acc_dtype, want_min, want_max, interpret,
+    where, keys, arg_names, num_segments, ts_name, tag_names, schema,
+    float_ops, int_ops, pack_dtype, acc_dtype, want_min, want_max,
+    want_sumsq, interpret,
 ):
     """Fused-kernel twin of _agg_scan_prepared: the hot set holds only
     the RAW value columns — validity masks, the [vals|valid|rows]
-    reduction plane, and the min/max identity fills are all built
-    in-register by ops/pallas_segment.pallas_fused_segment_agg, so the
-    HBM footprint per block is F lanes instead of 2F+1 (+F +F when
-    min/max ride along) and each block costs ONE kernel dispatch."""
+    reduction plane, and the min/max identity fills / squared values are
+    all built in-register by ops/pallas_segment.pallas_fused_segment_agg,
+    so the HBM footprint per block is F lanes instead of 2F+1 (+F per
+    min/max/sumsq rider) and each block costs ONE kernel dispatch.
+    first/last ride along OUTSIDE the kernel: their (value, ts) pairing
+    needs the arg-extreme select segment_agg implements, so each block
+    adds one segment_agg over the ts column, folded across blocks with
+    the same pairwise _combine_partials the classic dense path uses —
+    a lastpoint + sum dashboard panel no longer kicks the whole query
+    off the fused kernel."""
     from greptimedb_tpu.ops import pallas_segment as ps
 
     G = num_segments
-    # smaller row tile when the min/max lanes ride along: the [Gp, Nb]
+    # first/last riders, named by their *_ts int planes
+    fl_ops = tuple(sorted(op[:-3] for op in int_ops))
+    # smaller row tile when extra lanes ride along: the [Gp, Nb]
     # select temporaries double, so halve Nb to stay inside VMEM
-    block_rows = 256 if (want_min or want_max) else 512
-    tsum = tcnt = trow = tmin = tmax = None
+    block_rows = 256 if (want_min or want_max or want_sumsq) else 512
+    tsum = tcnt = trow = tmin = tmax = tsq = None
+    flacc = None
     for i, cols in enumerate(blocks):
         some = cols[arg_names[0]]
         nrows = some.shape[0]
@@ -410,7 +426,8 @@ def _agg_scan_fused(
                          axis=1)
         out = ps.pallas_fused_segment_agg(
             vals, ids, G + 1, want_min=want_min, want_max=want_max,
-            block_rows=block_rows, interpret=interpret)
+            want_sumsq=want_sumsq, block_rows=block_rows,
+            interpret=interpret)
         s, c, r = out["sum"][:G], out["count"][:G], out["rows"][:G][:, None]
         tsum = s if tsum is None else tsum + s
         tcnt = c if tcnt is None else tcnt + c
@@ -421,8 +438,21 @@ def _agg_scan_fused(
         if want_max:
             m = out["max"][:G]
             tmax = m if tmax is None else jnp.maximum(tmax, m)
-    return _pack_float_ops(tsum, tcnt, trow, tmin, tmax, None,
-                           float_ops, pack_dtype)
+        if want_sumsq:
+            q = out["sumsq"][:G]
+            tsq = q if tsq is None else tsq + q
+        if fl_ops:
+            part = segment_agg(vals, gid, mask, G, ops=fl_ops,
+                               ts=cols[ts_name])
+            flacc = _combine_partials(flacc, part)
+    extra = {k: flacc[k] for k in fl_ops} if fl_ops else None
+    packed_f = _pack_float_ops(tsum, tcnt, trow, tmin, tmax, tsq,
+                               float_ops, pack_dtype, extra=extra)
+    if int_ops:
+        packed_i = jnp.stack([flacc[k] for k in int_ops], axis=1)
+    else:
+        packed_i = jnp.zeros((0,), jnp.int64)
+    return packed_f, packed_i
 
 
 @functools.partial(
@@ -511,6 +541,51 @@ def _agg_scan_sharded(
         combined = combine_partial_aggs(part, "shard")
         return jnp.concatenate(
             [combined[k].astype(pack_dtype) for k in float_ops], axis=1)
+
+    return step(cols, base_mask)
+
+
+def _agg_scan_sharded_sparse(
+    cols: dict,  # {name: [N_pad] array sharded along "shard"}
+    base_mask: jax.Array,  # [N_pad] bool, sharded
+    *,
+    mesh, where, keys, agg_args, ops, cap, ts_name, tag_names, schema,
+    need_ts, acc_dtype, float_ops, int_ops, pack_dtype,
+):
+    """Multi-device SPARSE aggregation: each shard sort-compacts the
+    group ids IT observes and ships [cap, W] value-keyed partials plus
+    its rank -> global-id table. Unlike the dense collective, partials
+    cannot psum in place — compact slots don't line up across shards —
+    so out_specs stack the per-shard planes along "shard" and the host
+    merges them in GID space (combine_sparse_gid_partials; global ids
+    are shard-invariant, see _sparse_gid). Per-shard group counts ride
+    along so the host can slice each shard's observed prefix."""
+    from jax.sharding import PartitionSpec as P
+
+    from greptimedb_tpu.parallel.mesh import _SHARD_MAP_KW, shard_map
+
+    in_specs = ({k: P("shard") for k in cols}, P("shard"))
+    out_specs = (P("shard"), P("shard"), P("shard"), P("shard"))
+
+    @functools.partial(shard_map, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, **_SHARD_MAP_KW)
+    def step(local_cols, local_mask):
+        mask = local_mask
+        if where is not None:
+            w = eval_device(where, local_cols, tag_names, schema)
+            mask = mask & (w if w.dtype == jnp.bool_ else w != 0)
+        gid = _sparse_gid(local_cols, keys)
+        if agg_args:
+            values = _value_planes(agg_args, local_cols, tag_names, schema,
+                                   mask.shape, acc_dtype)
+        else:
+            values = jnp.zeros((mask.shape[0], 1), dtype=acc_dtype)
+        ts = local_cols[ts_name] if need_ts else None
+        part, uniq, n_groups = sparse_ops.sparse_segment_agg(
+            values, gid, mask, cap, ops=ops, ts=ts)
+        packed_f, packed_i = _pack_part(part, float_ops, int_ops, pack_dtype)
+        return (packed_f, packed_i, uniq,
+                n_groups.astype(jnp.int64)[None])
 
     return step(cols, base_mask)
 
@@ -807,6 +882,43 @@ _agg_block_jit = functools.partial(
 )(_agg_block)
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("where", "keys", "agg_args", "ops", "cap", "ts_name",
+                     "tag_names", "schema", "need_ts", "acc_dtype"),
+)
+def _agg_block_sparse(
+    cols: dict,
+    n_valid: jax.Array,
+    dedup_mask,
+    *,
+    where, keys, agg_args, ops, cap, ts_name, tag_names, schema, need_ts,
+    acc_dtype,
+):
+    """Sparse twin of _agg_block for the incremental per-part fold:
+    sort-compact the part's observed group ids and segment-reduce over
+    the static `cap` — the partial carries [cap, F] planes plus the
+    rank -> global-id table, and the host keeps only the observed [:U]
+    prefix. Replaces the dense [G, F] per-part planes past the partial
+    cache's dense group cap."""
+    some = next(iter(cols.values()))
+    mask = jnp.arange(some.shape[0]) < n_valid
+    if dedup_mask is not None:
+        mask = mask & dedup_mask
+    if where is not None:
+        w = eval_device(where, cols, tag_names, schema)
+        mask = mask & (w if w.dtype == jnp.bool_ else w != 0)
+    gid = _sparse_gid(cols, keys)
+    if agg_args:
+        values = _value_planes(agg_args, cols, tag_names, schema,
+                               mask.shape, acc_dtype)
+    else:
+        values = jnp.zeros((mask.shape[0], 1), dtype=acc_dtype)
+    ts = cols[ts_name] if need_ts else None
+    return sparse_ops.sparse_segment_agg(values, gid, mask, cap, ops=ops,
+                                         ts=ts)
+
+
 def _agg_step_impl(acc, cols, n_valid, *, where, keys, agg_args, ops,
                    num_segments, ts_name, tag_names, schema, need_ts,
                    acc_dtype):
@@ -830,7 +942,42 @@ _agg_step_donated = functools.partial(
     donate_argnums=(0, 1))(_agg_step_impl)
 
 
-_GID_SENTINEL = (1 << 62)  # > any real combined group id (product guarded)
+_GID_SENTINEL = sparse_ops.GID_SENTINEL  # > any real combined group id
+
+
+def _sparse_gid(cols: dict, keys) -> jax.Array:
+    """Combined int64 group id per row — shard-invariant (tag dictionary
+    codes and bucket bases don't depend on which rows a shard holds), so
+    gids computed per shard / per part merge globally."""
+    key_arrays, sizes = [], []
+    for k in keys:
+        c = cols[k.column]
+        if k.kind == "tag":
+            arr = (c + 1).astype(jnp.int64)
+        elif k.kind == "bucket":
+            arr = (c // k.step - k.base).astype(jnp.int64)
+        else:
+            arr = c.astype(jnp.int64)
+        key_arrays.append(jnp.clip(arr, 0, k.size - 1))
+        sizes.append(k.size)
+    return combine_group_ids(key_arrays, tuple(sizes), dtype=jnp.int64)
+
+
+def _pack_part(part: dict, float_ops, int_ops, pack_dtype):
+    """Pack a segment_agg plane dict into the (packed_f, packed_i) pair
+    shipped over the link (same layout _unpack_acc splits)."""
+    parts = []
+    for k in float_ops:
+        v = part[k]
+        if v.ndim == 1:
+            v = v[:, None]
+        parts.append(v.astype(pack_dtype))
+    packed_f = jnp.concatenate(parts, axis=1)
+    if int_ops:
+        packed_i = jnp.stack([part[k] for k in int_ops], axis=1)
+    else:
+        packed_i = jnp.zeros((0,), jnp.int64)
+    return packed_f, packed_i
 
 
 @functools.partial(
@@ -853,57 +1000,63 @@ def _agg_scan_sparse(
     aggregate (DataFusion row-hash; BASELINE config #5: 1M tag combos).
     Sorting is XLA-native and shapes stay static: all arrays are [N] or
     [cap, F]; only the group *count* is dynamic (returned as a scalar).
+    The sort-compact core lives in ops/sparse_segment.py, shared with the
+    fused/sharded/incremental/vmapped sparse flavors.
     """
     mask = base_mask
     if where is not None:
         w = eval_device(where, cols, tag_names, schema)
         mask = mask & (w if w.dtype == jnp.bool_ else w != 0)
-    key_arrays, sizes = [], []
-    for k in keys:
-        c = cols[k.column]
-        if k.kind == "tag":
-            arr = (c + 1).astype(jnp.int64)
-        elif k.kind == "bucket":
-            arr = (c // k.step - k.base).astype(jnp.int64)
-        else:
-            arr = c.astype(jnp.int64)
-        key_arrays.append(jnp.clip(arr, 0, k.size - 1))
-        sizes.append(k.size)
-    gid = combine_group_ids(key_arrays, tuple(sizes), dtype=jnp.int64)
-    gid = jnp.where(mask, gid, jnp.int64(_GID_SENTINEL))
-    order = jnp.argsort(gid)
-    sg = gid[order]
-    valid_s = sg != _GID_SENTINEL
-    prev = jnp.concatenate([jnp.full((1,), -1, jnp.int64), sg[:-1]])
-    new = valid_s & (sg != prev)
-    cid = jnp.cumsum(new.astype(jnp.int32)) - 1  # compact id per sorted row
-    ids = jnp.where(valid_s, jnp.clip(cid, 0, cap - 1), jnp.int32(cap))
-    n_groups = new.sum()
-    # observed global id per compact slot (ascending; overflow slots drop)
-    uniq = jnp.full((cap,), _GID_SENTINEL, dtype=jnp.int64).at[
-        jnp.where(new & (cid < cap), cid, cap)
-    ].set(sg, mode="drop")
-
+    gid = _sparse_gid(cols, keys)
     if agg_args:
         values = _value_planes(agg_args, cols, tag_names, schema,
-                               mask.shape, acc_dtype)[order]
+                               mask.shape, acc_dtype)
     else:
         values = jnp.zeros((mask.shape[0], 1), dtype=acc_dtype)
-    ts = cols[ts_name][order] if need_ts else None
-    part = segment_agg(values, ids, valid_s, cap, ops=ops, ts=ts,
-                       indices_are_sorted=True)
-    parts = []
-    for k in float_ops:
-        v = part[k]
-        if v.ndim == 1:
-            v = v[:, None]
-        parts.append(v.astype(pack_dtype))
-    packed_f = jnp.concatenate(parts, axis=1)
-    if int_ops:
-        packed_i = jnp.stack([part[k] for k in int_ops], axis=1)
-    else:
-        packed_i = jnp.zeros((0,), jnp.int64)
+    ts = cols[ts_name] if need_ts else None
+    part, uniq, n_groups = sparse_ops.sparse_segment_agg(
+        values, gid, mask, cap, ops=ops, ts=ts)
+    packed_f, packed_i = _pack_part(part, float_ops, int_ops, pack_dtype)
     return packed_f, packed_i, uniq, n_groups
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("where", "keys", "arg_names", "ops", "cap",
+                     "tag_names", "schema", "acc_dtype", "float_ops",
+                     "pack_dtype", "interpret"),
+)
+def _agg_scan_sparse_fused(
+    cols: dict,  # {name: [N] padded whole-scan arrays}
+    base_mask: jax.Array,
+    *,
+    where, keys, arg_names, ops, cap, tag_names, schema, acc_dtype,
+    float_ops, pack_dtype, interpret,
+):
+    """Sparse aggregation with the reductions on the fused Pallas kernel:
+    sort-compact once, then tile the compacted segment axis in FUSED_TILE
+    windows (ops/sparse_segment.fused_sparse_segment_agg). The kernel's
+    4096-segment envelope becomes a tile size — date_bin bucket domains
+    and tag products far past it stay fused instead of falling back to
+    the XLA scatter chain. Eligibility (plain finite field columns, op
+    subset, mode gates) is the caller's job, mirroring _fused_ok."""
+    mask = base_mask
+    if where is not None:
+        w = eval_device(where, cols, tag_names, schema)
+        mask = mask & (w if w.dtype == jnp.bool_ else w != 0)
+    gid = _sparse_gid(cols, keys)
+    order, ids, valid_s, uniq, n_groups = sparse_ops.sort_compact(
+        gid, mask, cap)
+    vals = jnp.stack([cols[a].astype(acc_dtype) for a in arg_names],
+                     axis=1)[order]
+    out = sparse_ops.fused_sparse_segment_agg(
+        vals, ids, cap, want_min="min" in ops, want_max="max" in ops,
+        want_sumsq="sumsq" in ops, interpret=interpret)
+    packed_f = _pack_float_ops(out["sum"], out["count"],
+                               out["rows"][:, None], out.get("min"),
+                               out.get("max"), out.get("sumsq"),
+                               float_ops, pack_dtype)
+    return packed_f, jnp.zeros((0,), jnp.int64), uniq, n_groups
 
 
 @functools.partial(jax.jit, static_argnames=("where", "tag_names", "schema"))
@@ -1271,7 +1424,8 @@ class PhysicalExecutor:
             return "device" if winner == "mesh" else "mesh"
         return winner
 
-    def tier_for(self, agg, num_rows: int, streaming: bool = False) -> str:
+    def tier_for(self, agg, num_rows: int, streaming: bool = False,
+                 scan=None) -> str:
         """Tiered execution (round-5 redesign): over a REMOTE
         accelerator link every interactive query is readback-bound —
         66 ms RTT dwarfs single-digit-ms host execution — so only work
@@ -1307,6 +1461,12 @@ class PhysicalExecutor:
         # slower than its own host tier). GREPTIMEDB_TPU_TIER_ADAPTIVE
         # =off restores the pure heuristic for A/B benching.
         if agg is not None and not streaming:
+            # hot-set-aware admission runs BEFORE the latency history:
+            # a tier already holding the scan's file-anchored blocks
+            # serves warm (zero H2D), which no size-class average sees
+            adv = self._hot_set_admission(scan)
+            if adv is not None:
+                return adv
             adv = self._tier_from_history(num_rows)
             if adv is not None:
                 return adv
@@ -1316,6 +1476,42 @@ class PhysicalExecutor:
                 and num_rows >= config.device_tier_rows():
             return "device"
         return "host"
+
+    def _hot_set_admission(self, scan) -> Optional[str]:
+        """Hot-set-aware tier admission: which tier's block cache already
+        holds this scan's file-anchored blocks? Routing a warm scan to
+        the OTHER tier re-uploads the whole working set for nothing —
+        the history router can't see that (it averages a size class, not
+        a residency state). Returns the hot tier, or None to fall
+        through to history/heuristic routing. Decisions are counted on
+        greptimedb_tpu_tier_admission_total{reason}; the
+        GREPTIMEDB_TPU_TIER_ADMISSION knob is the A/B override."""
+        from greptimedb_tpu import config
+        from greptimedb_tpu.utils.metrics import TIER_ADMISSION
+
+        if scan is None or getattr(scan, "region_id", -1) < 0:
+            return None
+        if not config.tier_admission():
+            TIER_ADMISSION.inc(reason="off")
+            return None
+        fids = {e.pkey[0] for e in _block_plan(scan) if e.pkey is not None}
+        if not fids:
+            return None  # memtable/synthetic-only: nothing file-anchored
+        per_tier: dict[str, int] = {}
+        try:
+            resident = self.cache.file_keys(scan.region_id)
+        except Exception:
+            return None
+        for k in resident:
+            if len(k) > 3 and k[2] in fids and k[3] in ("device", "host"):
+                per_tier[k[3]] = per_tier.get(k[3], 0) + 1
+        if not per_tier:
+            TIER_ADMISSION.inc(reason="cold")
+            return None
+        # ties go to the device tier (its planes also serve the kernels)
+        best = max(per_tier, key=lambda t: (per_tier[t], t == "device"))
+        TIER_ADMISSION.inc(reason=f"{best}_hot")
+        return best
 
     def execute(self, plan: lp.LogicalPlan) -> QueryResult:
         # unwrap the linear chain
@@ -1710,8 +1906,14 @@ class PhysicalExecutor:
                 "domain; add predicates or reduce keys"
             )
         # dense [G, F] planes up to the configured budget; beyond that the
-        # sparse sort-compact path handles arbitrary cardinality
-        sparse = bool(keys) and num_groups > config.dense_groups_max()
+        # sparse sort-compact path handles arbitrary cardinality.
+        # sparse_groups_min (off by default) pulls smaller key products
+        # onto the sparse path too — the lever for date_bin domains that
+        # fit the dense budget but blow the fused 4096-segment envelope
+        sparse = bool(keys) and (
+            num_groups > config.dense_groups_max()
+            or (config.sparse_groups_min() > 0
+                and num_groups >= config.sparse_groups_min()))
 
         # aggregate args -> values matrix columns (host-computed
         # order-statistic aggs don't consume a device value plane)
@@ -1742,11 +1944,11 @@ class PhysicalExecutor:
         # already snapshot-memoized) — a reduced scan has no per-part
         # identity and falls through to the classic kernels. Typed
         # fallback (PartialCacheIneligible) lands back here too.
-        if not sparse and reduced is None:
+        if reduced is None:
             res = self._try_incremental_agg(
                 scan, table, bound_where, keys, decoders, arg_exprs, ops,
                 num_groups, ts_name, ctx, extra_cols, agg, having, project,
-                sort, limit, offset, spec_slot)
+                sort, limit, offset, spec_slot, sparse)
             if res is not None:
                 return res
         if reduced is not None:
@@ -1755,7 +1957,7 @@ class PhysicalExecutor:
         # boundary fast path shrinks a 17M-row lastpoint to a few
         # thousand candidate rows — routing those to a remote chip
         # would pay the link RTT for microseconds of compute
-        tier = self.tier_for(agg, scan.num_rows)
+        tier = self.tier_for(agg, scan.num_rows, scan=scan)
         stream_args = (scan, table, bound_where, tuple(keys),
                        tuple(arg_exprs), tuple(sorted(ops)), num_groups,
                        ts_name, ctx, extra_cols, sparse)
@@ -1783,7 +1985,8 @@ class PhysicalExecutor:
     def _try_incremental_agg(self, scan, table, bound_where, keys, decoders,
                              arg_exprs, ops, num_groups, ts_name, ctx,
                              extra_cols, agg, having, project, sort, limit,
-                             offset, spec_slot) -> Optional[QueryResult]:
+                             offset, spec_slot,
+                             sparse=False) -> Optional[QueryResult]:
         """Serve this aggregate from per-part cached partials + a
         delta-only fold (query/partial_cache.py module docstring), or
         return None for the classic whole-scan paths. Any gate the
@@ -1800,7 +2003,7 @@ class PhysicalExecutor:
             t0 = time.perf_counter()
             partials, stats, tier = self._incremental_partials(
                 scan, table, bound_where, keys, decoders, arg_exprs, ops,
-                num_groups, ts_name, ctx, extra_cols, agg)
+                num_groups, ts_name, ctx, extra_cols, agg, sparse)
         except pc.PartialCacheIneligible:
             PARTIAL_AGG_CACHE_EVENTS.inc(event="fallback")
             return None
@@ -1837,7 +2040,8 @@ class PhysicalExecutor:
         if stats["delta_rows"]:
             self._note_tier(tier, stats["delta_rows"],
                             time.perf_counter() - t0)
-        self.last_path = "incremental"
+        self.last_path = "incremental_sparse" if stats.get("sparse") \
+            else "incremental"
         self.last_partial_stats = stats
         return self._finalize_combined_agg(combined, table, agg, having,
                                            project, sort, limit, offset,
@@ -1845,13 +2049,20 @@ class PhysicalExecutor:
 
     def _incremental_partials(self, scan, table, bound_where, keys,
                               decoders, arg_exprs, ops, num_groups, ts_name,
-                              ctx, extra_cols, agg):
+                              ctx, extra_cols, agg, sparse=False):
         """Gather cached part partials, compute the uncached parts and
         the memtable delta with the SAME per-block kernel the classic
         dense path runs, and return the part-ordered partial list (the
         left-fold order combine_partials preserves). Raises
         PartialCacheIneligible when the per-part decomposition is not
-        provably exact."""
+        provably exact.
+
+        Past the dense cache cap (or when the query is already sparse),
+        the per-part fold sort-compacts instead: partials carry only the
+        OBSERVED groups' value-keyed planes ([U, F], U <= part rows) —
+        the 64k-group fallback becomes a different per-part kernel, and
+        the value-keyed combine (query/dist_agg.py) is cardinality-
+        oblivious either way."""
         from collections import OrderedDict as _OrderedDict
 
         from greptimedb_tpu import config
@@ -1863,8 +2074,9 @@ class PhysicalExecutor:
             raise pc.PartialCacheIneligible("synthetic scan")
         if any(_needs_host_agg(spec, schema) for spec in agg.aggs):
             raise pc.PartialCacheIneligible("host-side aggregate")
-        if num_groups > pc.groups_max():
-            raise pc.PartialCacheIneligible("group count over cache cap")
+        # past the dense cache cap the fold goes sparse instead of
+        # falling back (value-keyed partials never materialize [G, F])
+        use_sparse = sparse or num_groups > pc.groups_max()
         # DELETE voids the decomposition exactly like scan_last: a
         # tombstone may mask rows in a different part (memoized on the
         # snapshot, shared with the boundary fast path)
@@ -1912,6 +2124,10 @@ class PhysicalExecutor:
         fp = pc.shape_fingerprint(bound_where, keys,
                                   [kexpr for _, kexpr in agg.keys],
                                   arg_exprs, ops_t, acc_dtype)
+        if use_sparse:
+            # sparse partials fold sorted (different float association
+            # than the dense scatter) — never mix with dense cache hits
+            fp = fp + ("sparse",)
         cache = pc.global_cache()
         # probe the cache BEFORE routing: only the delta (uncached parts
         # + memtable) runs kernels, and routing a 50-row warm delta to a
@@ -1929,7 +2145,7 @@ class PhysicalExecutor:
                 delta_est += entry.end - entry.start
                 if first_uncached is None:
                     first_uncached = entry
-        tier = self.tier_for(agg, delta_est)
+        tier = self.tier_for(agg, delta_est, scan=scan)
         # first-touch hedge (the classic paths' 40s-cold-start fix must
         # not regress here): until this shape's per-part kernel has
         # compiled on the accelerator, folds serve host-side and a
@@ -1954,15 +2170,20 @@ class PhysicalExecutor:
                   acc_dtype=acc_dtype)
         strides = _strides([k.size for k in keys])
 
-        def compute_partial(entry):
-            cols = {name: self._device_block(
+        def fetch_cols(entry):
+            return {name: self._device_block(
                         scan, name, entry, extra_cols,
                         acc_dtype if name in float_fields else None)
                     for name in col_names}
-            dmask = None if dedup_mask is None else _pad_device_mask(
+
+        def entry_dmask(entry):
+            return None if dedup_mask is None else _pad_device_mask(
                 dedup_mask, entry.start, entry.end, entry.block)
-            out = _agg_block_jit(cols, jnp.asarray(entry.end - entry.start),
-                                 dmask, **kw)
+
+        def compute_partial_dense(entry):
+            out = _agg_block_jit(fetch_cols(entry),
+                                 jnp.asarray(entry.end - entry.start),
+                                 entry_dmask(entry), **kw)
             planes = {op: _readback(v) for op, v in out.items()}
             rows = planes["rows"]
             rows1 = rows[:, 0] if rows.ndim == 2 else rows
@@ -1979,6 +2200,35 @@ class PhysicalExecutor:
             return {"keys": key_cols,
                     "planes": {op: pl[present]
                                for op, pl in planes.items()}}
+
+        sparse_kw = {k: v for k, v in kw.items() if k != "num_segments"}
+
+        def compute_partial_sparse(entry):
+            # sort-compact the part's own rows: the cap is one device
+            # block (observed groups can't exceed part rows), so the
+            # 64k dense cache ceiling never enters the per-part shapes
+            cap = min(entry.block, config.sparse_groups_max())
+            out, uniq, n_groups = _agg_block_sparse(
+                fetch_cols(entry), jnp.asarray(entry.end - entry.start),
+                entry_dmask(entry), cap=cap, **sparse_kw)
+            u = int(n_groups)
+            if u > cap:
+                raise PlanError(
+                    f"part observed {u} distinct groups, exceeding the "
+                    f"sparse cap {cap}; raise "
+                    "GREPTIMEDB_TPU_SPARSE_GROUPS_MAX or add predicates")
+            gids = np.asarray(uniq)[:u]
+            key_cols = []
+            for i, decode in enumerate(decoders):
+                idx = (gids // strides[i]) % keys[i].size
+                col, _ = decode(idx)
+                key_cols.append(np.asarray(col))
+            return {"keys": key_cols,
+                    "planes": {op: _readback(v)[:u]
+                               for op, v in out.items()}}
+
+        compute_partial = compute_partial_sparse if use_sparse \
+            else compute_partial_dense
 
         if hedge:
             self._kick_incremental_warm(
@@ -2015,7 +2265,11 @@ class PhysicalExecutor:
         stats = {"parts": len(parts), "part_hits": hits,
                  "part_misses": misses, "delta_rows": delta_rows,
                  "cached_rows": cached_rows, "memtable_rows": mem_rows,
-                 "total_rows": scan.num_rows}
+                 "total_rows": scan.num_rows, "sparse": use_sparse}
+        if use_sparse:
+            from greptimedb_tpu.utils.metrics import SPARSE_DISPATCHES
+
+            SPARSE_DISPATCHES.inc(path="incremental")
         return partials, stats, tier
 
     def _incremental_hedge_needed(self, tier: str, fp: tuple) -> bool:
@@ -2778,9 +3032,21 @@ class PhysicalExecutor:
         if sparse:
             self.last_path = "sparse"
             if self.last_tier == "mesh":
-                # high-cardinality shapes run the single-device
-                # sort-compact path; report the tier that actually served
-                self.last_tier = "device"
+                from greptimedb_tpu.parallel.sharded_dispatch import (
+                    MeshIneligible,
+                )
+
+                try:
+                    # per-shard sort-compact + gid-space combine: the
+                    # compact slots differ per shard but the global ids
+                    # they decode to don't, so the host merge is exact
+                    return self._sparse_sharded_scan(
+                        scan, self.mesh, device_col_names, extra_cols,
+                        float_fields, acc_dtype, dedup_mask, bound_where,
+                        keys, arg_exprs, ops, ts_name, tag_names, schema,
+                        float_ops, int_ops, widths, pack_dtype)
+                except MeshIneligible:
+                    self.last_tier = "device"
             return self._sparse_scan(
                 scan, device_col_names, extra_cols, float_fields, acc_dtype,
                 dedup_mask, bound_where, keys, arg_exprs, ops, ts_name,
@@ -2815,7 +3081,17 @@ class PhysicalExecutor:
             # the router picked the mesh before seeing the op set; a
             # non-collective shape runs single-device and must report so
             self.last_tier = "device"
-        if self._prepared_ok(arg_exprs, ops, int_ops, schema, extra_cols):
+        prepared = self._prepared_ok(arg_exprs, ops, int_ops, schema,
+                                     extra_cols)
+        # first/last can't ride the PREPARED planes (no ts pairing) but
+        # CAN ride the fused kernel: the kernel covers the other ops and
+        # a per-block segment_agg folds the (value, ts) pairs alongside
+        fused_extra = (not prepared and bool(int_ops)
+                       and all(k.endswith("_ts") for k in int_ops)
+                       and self._prepared_ok(
+                           arg_exprs, set(ops) - {"first", "last"}, (),
+                           schema, extra_cols))
+        if prepared or fused_extra:
             arg_names = tuple(a.name for a in arg_exprs)
             aux_names = self._device_columns(
                 scan, bound_where, keys, (), ts_name, extra_cols)
@@ -2825,15 +3101,17 @@ class PhysicalExecutor:
                 # hot-set columns — mask/validity/plane assembly never
                 # touch HBM (ops/pallas_segment.py); degrades to the
                 # prepared scatter path below on any kernel failure
-                packed_f = self._dense_fused_scan(
+                res = self._dense_fused_scan(
                     scan, plan, aux_names, arg_names, extra_cols,
                     float_fields, acc_dtype, dedup_mask, bound_where,
-                    keys, ops, num_groups, tag_names, schema, float_ops,
-                    pack_dtype)
-                if packed_f is not None:
+                    keys, ops, num_groups, ts_name, tag_names, schema,
+                    float_ops, int_ops, pack_dtype)
+                if res is not None:
+                    packed_f, packed_i = res
                     self.last_path = "dense_fused"
-                    return (_unpack_acc(packed_f, None, float_ops, (),
-                                        widths), None)
+                    return (_unpack_acc(packed_f, packed_i, float_ops,
+                                        int_ops, widths), None)
+        if prepared:
             # fast dense path: query-invariant [N, 2F+1] value/validity
             # planes are HBM-cached; per query only [N] masks/keys run
             self.last_path = "dense_prepared"
@@ -2915,8 +3193,15 @@ class PhysicalExecutor:
                      ops, ts_name, tag_names, schema, float_ops, int_ops,
                      widths, pack_dtype):
         """High-cardinality aggregation over the whole scan as one padded
-        device program (sort-compact; see _agg_scan_sparse)."""
+        device program (sort-compact; see _agg_scan_sparse). Routes the
+        reductions through the tiled fused kernel when eligible
+        (_sparse_fused_ok), degrading to the XLA scatter chain on any
+        kernel failure — same latch as the dense fused path."""
         from greptimedb_tpu import config
+        from greptimedb_tpu.utils.metrics import (
+            SPARSE_COMPACTION_RATIO,
+            SPARSE_DISPATCHES,
+        )
 
         n = scan.num_rows
         n_pad = block_size_for(n)
@@ -2944,21 +3229,173 @@ class PhysicalExecutor:
         base = np.arange(n_pad) < n
         if dedup_mask is not None:
             base[:n] &= np.asarray(dedup_mask)[:n]
-        packed_f, packed_i, uniq, n_groups = _agg_scan_sparse(
-            cols, jnp.asarray(base), where=bound_where, keys=keys,
-            agg_args=arg_exprs, ops=ops, cap=cap, ts_name=ts_name,
-            tag_names=tag_names, schema=schema,
-            need_ts=bool({"first", "last"} & set(ops)), acc_dtype=acc_dtype,
-            float_ops=float_ops, int_ops=int_ops, pack_dtype=pack_dtype)
+        packed = None
+        if self._sparse_fused_ok(ops, arg_exprs, scan, schema, extra_cols,
+                                 acc_dtype):
+            from greptimedb_tpu.utils.metrics import PALLAS_DISPATCHES
+
+            try:
+                packed_f, packed_i, uniq, n_groups = _agg_scan_sparse_fused(
+                    cols, jnp.asarray(base), where=bound_where, keys=keys,
+                    arg_names=tuple(a.name for a in arg_exprs), ops=ops,
+                    cap=cap, tag_names=tag_names, schema=schema,
+                    acc_dtype=acc_dtype, float_ops=float_ops,
+                    pack_dtype=pack_dtype,
+                    interpret=jax.default_backend() != "tpu")
+                packed_f.block_until_ready()
+                packed = (packed_f, packed_i, uniq, n_groups)
+                self.last_path = "sparse_fused"
+                PALLAS_DISPATCHES.inc(kernel="sparse_fused_agg")
+                SPARSE_DISPATCHES.inc(path="fused")
+            except Exception:  # noqa: BLE001 — degrade, never fail the query
+                import traceback
+
+                traceback.print_exc()
+                print("sparse fused pallas kernel failed; serving this and "
+                      "later queries through the XLA scatter path",
+                      flush=True)
+                _FUSED_DISABLED["flag"] = True
+                PALLAS_DISPATCHES.inc(kernel="fused_agg_failed")
+        if packed is None:
+            packed = _agg_scan_sparse(
+                cols, jnp.asarray(base), where=bound_where, keys=keys,
+                agg_args=arg_exprs, ops=ops, cap=cap, ts_name=ts_name,
+                tag_names=tag_names, schema=schema,
+                need_ts=bool({"first", "last"} & set(ops)),
+                acc_dtype=acc_dtype, float_ops=float_ops, int_ops=int_ops,
+                pack_dtype=pack_dtype)
+            SPARSE_DISPATCHES.inc(path="classic")
+        packed_f, packed_i, uniq, n_groups = packed
         u = int(n_groups)
         if u > cap:
             raise PlanError(
                 f"query observed {u} distinct groups, exceeding the sparse "
                 f"cap {cap}; raise GREPTIMEDB_TPU_SPARSE_GROUPS_MAX or add "
                 "predicates")
+        SPARSE_COMPACTION_RATIO.set(sparse_ops.compaction_ratio(u, n))
         acc = _unpack_acc(packed_f, packed_i, float_ops, int_ops, widths)
         acc = {k: v[:u] for k, v in acc.items()}
         return acc, np.asarray(uniq)[:u]
+
+    def _sparse_fused_ok(self, ops, arg_exprs, scan, schema, extra_cols,
+                         acc_dtype) -> bool:
+        """Route the sparse scan through the tiled fused kernel? Mirrors
+        _fused_ok (mode/backend gates, finite proof, failure latch) with
+        the sparse twists: the segment count is a tile size so no group
+        envelope applies, sumsq rides only when the accumulator already
+        carries f64 (the tiled fold can't upcast moments the way
+        segment_agg does), and first/last stay on the XLA path (the
+        kernel has no ts pairing)."""
+        from greptimedb_tpu.ops import pallas_segment as ps
+        from greptimedb_tpu.ops.segment import _pallas_mode
+
+        if _FUSED_DISABLED["flag"]:
+            return False
+        if not set(ops) <= {"sum", "count", "mean", "rows", "min", "max",
+                            "sumsq"}:
+            return False
+        if "sumsq" in ops and acc_dtype != jnp.dtype(jnp.float64):
+            return False
+        if not self._prepared_ok(arg_exprs, ops, (), schema, extra_cols):
+            return False  # plain field columns only (same as dense fused)
+        if not ps.fused_eligible(len(arg_exprs), ps.MAX_SEGMENTS,
+                                 want_sumsq="sumsq" in ops):
+            return False
+        if acc_dtype not in (jnp.dtype(jnp.float32), jnp.dtype(jnp.float64)):
+            return False
+        arg_names = tuple(a.name for a in arg_exprs)
+        if self._scan_has_inf(scan, arg_names, dtype=acc_dtype):
+            return False
+        mode = _pallas_mode()
+        if mode == "on":
+            return True
+        return (mode == "auto" and jax.default_backend() == "tpu"
+                and _ACTIVE_TIER_VAR.get() != "host"
+                and ps.fused_tpu_compile_ok())
+
+    def _sparse_sharded_scan(self, scan, mesh, device_col_names, extra_cols,
+                             float_fields, acc_dtype, dedup_mask,
+                             bound_where, keys, arg_exprs, ops, ts_name,
+                             tag_names, schema, float_ops, int_ops, widths,
+                             pack_dtype):
+        """High-cardinality aggregation on the mesh: part-aligned column
+        placement (same file-anchored per-shard uploads as the dense
+        collective), per-shard sort-compact, host-side GID-space merge
+        (_agg_scan_sharded_sparse has the why). Raises MeshIneligible for
+        shapes the shard dispatch can't serve — caller falls back to the
+        single-device sparse scan."""
+        from greptimedb_tpu import config
+        from greptimedb_tpu.parallel import sharded_dispatch as sd
+        from greptimedb_tpu.utils.metrics import (
+            SPARSE_COMPACTION_RATIO,
+            SPARSE_DISPATCHES,
+        )
+
+        if not sd.eligible(mesh):
+            raise sd.MeshIneligible("sparse path needs part-aligned dispatch")
+        n_shard = mesh.shape["shard"]
+        plan = sd.plan_shards(scan, n_shard)
+        tier = _ACTIVE_TIER_VAR.get()
+        snap_v = _snap_version(scan)
+        cols = {}
+        for name in device_col_names:
+            cast = acc_dtype if name in float_fields else None
+
+            def build_slice(start, end, out_rows, name=name, cast=cast):
+                src = extra_cols[name] if name in extra_cols \
+                    else scan.columns[name]
+                arr = pad_rows(src[start:end], out_rows)
+                if cast is not None and arr.dtype != cast:
+                    arr = arr.astype(cast)
+                return arr
+
+            cols[name] = sd.sharded_column(
+                None if name in extra_cols else self.cache,
+                mesh, plan, scan, name, build_slice, tier=tier,
+                snap_version=snap_v, extra=(str(cast),))
+        base_s = sd.sharded_mask(mesh, plan, scan, dedup_mask,
+                                 cache=self.cache, tier=tier,
+                                 snap_version=snap_v)
+        shard_rows = base_s.shape[0] // n_shard
+        cap = min(shard_rows, config.sparse_groups_max())
+        sd.note_dispatch("sharded_sparse", plan)
+        packed_f, packed_i, uniqs, ns = _agg_scan_sharded_sparse(
+            cols, base_s, mesh=mesh, where=bound_where, keys=keys,
+            agg_args=arg_exprs, ops=ops, cap=cap, ts_name=ts_name,
+            tag_names=tag_names, schema=schema,
+            need_ts=bool({"first", "last"} & set(ops)),
+            acc_dtype=acc_dtype, float_ops=float_ops, int_ops=int_ops,
+            pack_dtype=pack_dtype)
+        host_un = np.asarray(uniqs)
+        host_ns = np.asarray(ns)
+        parts = []
+        for s in range(n_shard):
+            u_s = int(host_ns[s])
+            if u_s > cap:
+                raise PlanError(
+                    f"shard {s} observed {u_s} distinct groups, exceeding "
+                    f"the sparse cap {cap}; raise "
+                    "GREPTIMEDB_TPU_SPARSE_GROUPS_MAX or add predicates")
+            pf_s = packed_f[s * cap:(s + 1) * cap]
+            pi_s = packed_i[s * cap:(s + 1) * cap] if int_ops else None
+            acc_s = _unpack_acc(pf_s, pi_s, float_ops, int_ops, widths)
+            parts.append({
+                "gids": host_un[s * cap:s * cap + u_s],
+                "planes": {op: v[:u_s] for op, v in acc_s.items()},
+            })
+        gids, planes = sparse_ops.combine_sparse_gid_partials(parts)
+        total = len(gids)
+        self.last_path = "sparse_sharded"
+        SPARSE_DISPATCHES.inc(path="sharded")
+        SPARSE_COMPACTION_RATIO.set(
+            sparse_ops.compaction_ratio(total, scan.num_rows))
+        if not total:
+            # no shard observed a group: empty keyed result with the
+            # same plane layout _unpack_acc would produce
+            planes = {op: np.zeros((0, widths[op])) for op in float_ops}
+            for op in int_ops:
+                planes[op] = np.zeros((0,), np.int64)
+        return planes, gids
 
     def _sharded_scan(self, scan, mesh, device_col_names, extra_cols,
                       float_fields, acc_dtype, dedup_mask, bound_where, keys,
@@ -3203,11 +3640,17 @@ class PhysicalExecutor:
 
         if _FUSED_DISABLED["flag"]:
             return False
-        if not set(ops) <= {"sum", "count", "mean", "rows", "min", "max"}:
-            return False
-        if not ps.fused_eligible(len(arg_names), num_groups + 1):
+        if not set(ops) <= {"sum", "count", "mean", "rows", "min", "max",
+                            "sumsq", "first", "last"}:
             return False
         acc_dtype = jnp.dtype(config.compute_dtype())
+        if "sumsq" in ops and acc_dtype != jnp.dtype(jnp.float64):
+            # the kernel accumulates moments in the compute dtype; only
+            # f64 carries the variance cancellation (see segment_agg)
+            return False
+        if not ps.fused_eligible(len(arg_names), num_groups + 1,
+                                 want_sumsq="sumsq" in ops):
+            return False
         if acc_dtype not in (jnp.dtype(jnp.float32), jnp.dtype(jnp.float64)):
             return False
         if self._scan_has_inf(scan, arg_names, dtype=acc_dtype):
@@ -3222,16 +3665,19 @@ class PhysicalExecutor:
 
     def _dense_fused_scan(self, scan, plan, aux_names, arg_names,
                           extra_cols, float_fields, acc_dtype, dedup_mask,
-                          bound_where, keys, ops, num_groups, tag_names,
-                          schema, float_ops, pack_dtype):
-        """Run the fused-kernel aggregation; returns packed_f, or None
-        after latching the kernel off when anything in the fused program
-        fails (trace, Mosaic compile, or execution) — the caller then
-        serves the same query through the XLA scatter path, so a kernel
-        regression degrades throughput, never availability."""
+                          bound_where, keys, ops, num_groups, ts_name,
+                          tag_names, schema, float_ops, int_ops,
+                          pack_dtype):
+        """Run the fused-kernel aggregation; returns (packed_f,
+        packed_i), or None after latching the kernel off when anything
+        in the fused program fails (trace, Mosaic compile, or execution)
+        — the caller then serves the same query through the XLA scatter
+        path, so a kernel regression degrades throughput, never
+        availability."""
         from greptimedb_tpu.utils.metrics import PALLAS_DISPATCHES
 
-        need_cols = sorted(set(aux_names) | set(arg_names))
+        need_cols = sorted(set(aux_names) | set(arg_names)
+                           | ({ts_name} if int_ops else set()))
 
         def fetch_block(entry, prefetch_only=False):
             cols = {}
@@ -3246,14 +3692,15 @@ class PhysicalExecutor:
         blocks, n_valids, dmasks = self._gather_blocks(
             scan, plan, fetch_block, dedup_mask)
         try:
-            packed_f = _agg_scan_fused(
+            packed_f, packed_i = _agg_scan_fused(
                 tuple(blocks), jnp.asarray(np.asarray(n_valids)),
                 tuple(dmasks) if dmasks is not None else None,
                 where=bound_where, keys=keys, arg_names=arg_names,
-                num_segments=num_groups, tag_names=tag_names,
-                schema=schema, float_ops=float_ops, pack_dtype=pack_dtype,
+                num_segments=num_groups, ts_name=ts_name,
+                tag_names=tag_names, schema=schema, float_ops=float_ops,
+                int_ops=int_ops, pack_dtype=pack_dtype,
                 acc_dtype=acc_dtype, want_min="min" in ops,
-                want_max="max" in ops,
+                want_max="max" in ops, want_sumsq="sumsq" in ops,
                 interpret=jax.default_backend() != "tpu")
             # surface async execution errors HERE, inside the latch —
             # the result is consumed immediately downstream anyway
@@ -3268,7 +3715,7 @@ class PhysicalExecutor:
             PALLAS_DISPATCHES.inc(kernel="fused_agg_failed")
             return None
         PALLAS_DISPATCHES.inc(float(len(blocks)), kernel="fused_agg")
-        return packed_f
+        return packed_f, packed_i
 
     def _device_block(self, scan: ScanData, name, entry: _BlockEntry,
                       extra_cols, cast_dtype, prefetch_only=False):

@@ -165,6 +165,66 @@ def _vmapped_agg_scan(
     return jax.vmap(member)(params)
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("shared_where", "param_specs", "keys", "agg_args",
+                     "ops", "cap", "ts_name", "need_ts", "tag_names",
+                     "schema", "acc_dtype", "float_ops", "pack_dtype"),
+)
+def _vmapped_sparse_agg_scan(
+    cols: dict,  # whole-scan padded col arrays (member-invariant)
+    base_mask: jax.Array,  # [N] padding & dedup survivors
+    params: tuple,  # per-spec [M] stacked parameter arrays
+    *,
+    shared_where, param_specs, keys, agg_args, ops, cap, ts_name,
+    need_ts, tag_names, schema, acc_dtype, float_ops, pack_dtype,
+):
+    """Sparse (sort-compact) twin of _vmapped_agg_scan: ONE shared
+    compaction over the member-invariant base mask (padding, dedup,
+    shared conjuncts — every member's rows are a subset), then each
+    member's parameter mask rides the vmapped axis as the segment_agg
+    validity over the already-sorted rows. The compact ranks cover the
+    UNION of observed groups; a member's unobserved ranks come back
+    with rows == 0 and the host drops them, so each member sees exactly
+    the groups its serial sparse run would. Parity is the masking
+    identity again: the shared sort is stable, so a member's surviving
+    rows keep their serial fold order, and masked rows contribute fold
+    identities."""
+    from greptimedb_tpu.ops import sparse_segment as sparse_ops
+
+    mask0 = base_mask
+    if shared_where is not None:
+        w = eval_device(shared_where, cols, tag_names, schema)
+        mask0 = mask0 & (w if w.dtype == jnp.bool_ else w != 0)
+    gid = ph._sparse_gid(cols, keys)
+    order, ids, valid_s, uniq, n_groups = sparse_ops.sort_compact(
+        gid, mask0, cap)
+    if agg_args:
+        values = ph._value_planes(agg_args, cols, tag_names, schema,
+                                  mask0.shape, acc_dtype)
+    else:
+        values = jnp.zeros((mask0.shape[0], 1), dtype=acc_dtype)
+    values_s = values[order]
+    ts_s = cols[ts_name][order] if need_ts else None
+    param_cols_s = {name: cols[name][order]
+                    for name, _op in dict.fromkeys(param_specs)}
+
+    def member(pvals):
+        mask = _member_mask(param_cols_s, valid_s, None, param_specs,
+                            pvals, tag_names, schema)
+        part = segment_agg(values_s, ids, mask, cap, ops=ops, ts=ts_s,
+                           indices_are_sorted=True)
+        parts = []
+        for k in float_ops:
+            v = part[k]
+            if v.ndim == 1:
+                v = v[:, None]
+            parts.append(v.astype(pack_dtype))
+        return jnp.concatenate(parts, axis=1)
+
+    return jax.vmap(member)(params), uniq, n_groups
+
+
 def _bind_param(pspec, value, bctx) -> tuple:
     """One member's value for one parameter conjunct, bound through the
     engine's own literal coercion. Returns (device column name, op,
@@ -300,15 +360,26 @@ def run_vmapped(executor, sel: ast.Select, info, pspecs,
     num_groups = 1
     for k in keys:
         num_groups *= k.size
-    if not keys or num_groups > config.dense_groups_max() \
-            or num_groups >= ph._GID_SENTINEL:
-        raise VmapIneligible(f"group domain {num_groups} needs sparse path")
+    if not keys:
+        raise VmapIneligible("global aggregate has no group axis")
+    if num_groups >= ph._GID_SENTINEL:
+        raise VmapIneligible(f"group domain {num_groups} overflows gid space")
+    # past the dense envelope the members ride the sparse (sort-compact)
+    # twin instead of falling back to serial — the batch's accumulator
+    # is [M, cap, F] over OBSERVED groups, not the key-domain product
+    sparse = num_groups > config.dense_groups_max() or (
+        config.sparse_groups_min() > 0
+        and num_groups >= config.sparse_groups_min())
+    cap = min(ph.block_size_for(scan.num_rows), config.sparse_groups_max())
     # the stacked axis multiplies the accumulator: bound M*G by the
-    # same dense budget one serial query is allowed, so a wide batch
-    # over a near-max group domain can't ask XLA for a multi-GB output
-    if _pad_width(len(member_values)) * num_groups \
-            > config.dense_groups_max():
-        raise VmapIneligible("stacked accumulator exceeds dense budget")
+    # budget one serial query of the same flavor is allowed (dense key
+    # domain, or sparse compact cap), so a wide batch over a near-max
+    # group domain can't ask XLA for a multi-GB output
+    budget = config.sparse_groups_max() if sparse \
+        else config.dense_groups_max()
+    if _pad_width(len(member_values)) * (cap if sparse else num_groups) \
+            > budget:
+        raise VmapIneligible("stacked accumulator exceeds group budget")
 
     # aggregate layout (mirrors _stream_agg_inner's dense packing)
     arg_exprs: list = []
@@ -354,7 +425,7 @@ def run_vmapped(executor, sel: ast.Select, info, pspecs,
         if name not in device_col_names:
             device_col_names.append(name)
 
-    tier = executor.tier_for(agg, scan.num_rows)
+    tier = executor.tier_for(agg, scan.num_rows, scan=scan)
     executor.last_tier = tier
 
     def fetch_block(entry, prefetch_only=False):
@@ -373,6 +444,14 @@ def run_vmapped(executor, sel: ast.Select, info, pspecs,
         dt = np.int64 if name == ts_name else np.int32
         vals = matrix[j] + [matrix[j][-1]] * (mp - m)
         params.append(jnp.asarray(np.asarray(vals, dtype=dt)))
+
+    if sparse:
+        return _run_vmapped_sparse(
+            executor, scan, agg, project, table, keys, decoders, spec_slot,
+            extra_cols, bound_shared, bctx, cols_ops, params, m,
+            device_col_names, float_fields, acc_dtype, dedup_mask,
+            tag_names, schema, ts_name, need_ts, arg_exprs, ops, cap,
+            float_ops, widths, pack_dtype, tier, num_groups)
 
     with ph._TierCtx(tier):
         blocks, n_valids, dmasks = executor._gather_blocks(
@@ -405,6 +484,92 @@ def run_vmapped(executor, sel: ast.Select, info, pspecs,
             acc, None, agg, keys, decoders, spec_slot, host_info,
             None, project, None, None, None, table))
     executor.last_path = "dense_vmapped"
+    return results
+
+
+def _run_vmapped_sparse(executor, scan, agg, project, table, keys, decoders,
+                        spec_slot, extra_cols, bound_shared, bctx, cols_ops,
+                        params, m, device_col_names, float_fields, acc_dtype,
+                        dedup_mask, tag_names, schema, ts_name, need_ts,
+                        arg_exprs, ops, cap, float_ops, widths, pack_dtype,
+                        tier, num_groups) -> list:
+    """Sparse execution tail of run_vmapped: whole-scan padded columns
+    (sharing the serial sparse path's snapshot cache keys, so a batch
+    after a serial high-card query reuses its uploads), ONE stacked
+    sort-compact dispatch, then a per-member demux that keeps only the
+    compact ranks the member actually observed (rows > 0) before the
+    shared gid-decoding tail."""
+    from greptimedb_tpu.ops import sparse_segment as sparse_ops
+    from greptimedb_tpu.utils.metrics import (
+        SPARSE_COMPACTION_RATIO,
+        SPARSE_DISPATCHES,
+    )
+
+    n = scan.num_rows
+    n_pad = ph.block_size_for(n)
+    cols = {}
+    for name in device_col_names:
+        cast = acc_dtype if name in float_fields else None
+
+        def build(name=name, cast=cast):
+            src = extra_cols[name] if name in extra_cols \
+                else scan.columns[name]
+            arr = ph.pad_rows(src, n_pad)
+            if cast is not None and arr.dtype != cast:
+                arr = arr.astype(cast)
+            return jnp.asarray(arr)
+
+        if scan.region_id < 0 or name in extra_cols:
+            cols[name] = build()
+        else:
+            key = ("snap", scan.region_id, ph._snap_version(scan),
+                   ph._ACTIVE_TIER_VAR.get(), scan.scan_fingerprint,
+                   name, "whole", n_pad, str(cast))
+            cols[name] = executor.cache.get(key, build)
+    base = np.arange(n_pad) < n
+    if dedup_mask is not None:
+        base[:n] &= np.asarray(dedup_mask)[:n]
+
+    with ph._TierCtx(tier):
+        packed, uniq, n_obs = _vmapped_sparse_agg_scan(
+            cols, jnp.asarray(base), tuple(params),
+            shared_where=bound_shared, param_specs=tuple(cols_ops),
+            keys=tuple(keys), agg_args=tuple(arg_exprs),
+            ops=tuple(sorted(ops)), cap=cap, ts_name=ts_name,
+            need_ts=need_ts, tag_names=tag_names, schema=schema,
+            acc_dtype=acc_dtype, float_ops=float_ops,
+            pack_dtype=pack_dtype)
+        host = ph._readback(packed)
+        host_uniq = np.asarray(uniq)
+    u = int(n_obs)
+    if u > cap:
+        # the UNION of member windows overflowed the sparse cap; each
+        # member alone may still fit, so hand back to the serial paths
+        raise VmapIneligible(
+            f"batch observed {u} distinct groups over sparse cap {cap}")
+    SPARSE_DISPATCHES.inc(path="vmapped")
+    SPARSE_COMPACTION_RATIO.set(sparse_ops.compaction_ratio(u, n))
+
+    results = []
+    host_info = (scan, extra_cols, bound_shared, bctx, num_groups)
+    gids_u = host_uniq[:u]
+    for i in range(m):
+        acc: dict = {}
+        off = 0
+        for k in float_ops:
+            w = widths[k]
+            sl = host[i][:u, off:off + w]
+            off += w
+            if k in ("count", "rows"):
+                sl = sl.astype(np.int64)
+            acc[k] = sl
+        rows = acc["rows"][:, 0] if acc["rows"].ndim == 2 else acc["rows"]
+        present = np.flatnonzero(rows > 0)
+        acc = {k: v[present] for k, v in acc.items()}
+        results.append(executor._agg_tail(
+            acc, gids_u[present], agg, keys, decoders, spec_slot,
+            host_info, None, project, None, None, None, table))
+    executor.last_path = "sparse_vmapped"
     return results
 
 

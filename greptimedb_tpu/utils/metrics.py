@@ -561,6 +561,23 @@ PALLAS_DISPATCHES = REGISTRY.counter(
     "scan/filter/bucket/aggregate kernel, segment_sum = the one-hot "
     "matmul segment-sum; fused_agg_failed = mid-query degradations to "
     "the XLA scatter path)")
+SPARSE_DISPATCHES = REGISTRY.counter(
+    "greptimedb_tpu_sparse_dispatch_total",
+    "Sparse sort-compact aggregation dispatches by path (classic = "
+    "whole-scan XLA segment reduce, fused = tiled Pallas windows, "
+    "sharded = per-shard compaction + gid-space combine, incremental = "
+    "per-part value-space partials, vmapped = shared compaction across "
+    "stacked batch members)")
+SPARSE_COMPACTION_RATIO = REGISTRY.gauge(
+    "greptimedb_tpu_sparse_compaction_ratio",
+    "Observed groups per scanned row in the last sparse aggregation "
+    "(1.0 = every row its own group, no compaction win)")
+TIER_ADMISSION = REGISTRY.counter(
+    "greptimedb_tpu_tier_admission_total",
+    "Hot-set-aware tier admission decisions by reason (device_hot/"
+    "host_hot = routed to the tier already holding the scan's "
+    "file-anchored blocks, cold = no tier holds them, off = the "
+    "GREPTIMEDB_TPU_TIER_ADMISSION knob disabled the probe)")
 SLOW_QUERIES = REGISTRY.counter(
     "greptimedb_tpu_slow_queries_total",
     "Statements slower than the slow-query threshold, by kind")

@@ -30,6 +30,7 @@ from greptimedb_tpu.lint import lockdep as rt_lockdep
 from greptimedb_tpu.lint.blocking import check as blocking_check
 from greptimedb_tpu.lint.datarace import check as datarace_check
 from greptimedb_tpu.lint.deadcode import check as deadcode_check
+from greptimedb_tpu.lint.escape import check as escape_check
 from greptimedb_tpu.lint.fault_seam import check as fault_seam_check
 from greptimedb_tpu.lint.jax_imports import check as jax_import_check
 from greptimedb_tpu.lint.lockgraph import check as lockdep_check
@@ -465,6 +466,118 @@ def test_blocking_guards_the_real_group_commit_path():
     serial = [f for f in found
               if "write_many_serial" in f.message]
     assert len(serial) == 1  # the documented legacy exception
+
+
+# ---- escape (closures over guarded state escaping the lock) -----------------
+
+
+def test_escape_fires_on_lambda_under_lock_into_pool():
+    bad = ("greptimedb_tpu/concurrency/cb.py", """
+import threading
+
+class C:
+    def __init__(self, pool):
+        self._lock = threading.Lock()
+        self._q = []
+        self._pool = pool
+
+    def kick(self):
+        with self._lock:
+            self._pool.submit(lambda: self._q.pop())
+""")
+    found = escape_check(fixture_repo(bad))
+    assert len(found) == 1
+    f = found[0]
+    assert "lambda" in f.message and "self._q" in f.message
+    assert "C.kick" in f.message and "runs later without the guard" \
+        in f.message
+
+
+def test_escape_fires_on_closure_into_thread_and_queue():
+    # nested def built under the lock, escaping via Thread(target=) and
+    # queue.put — both are deferred executions of guarded reads
+    bad = ("greptimedb_tpu/maintenance/defer.py", """
+import threading
+
+class S:
+    def __init__(self, q):
+        self._lock = threading.Lock()
+        self._jobs = {}
+        self._q = q
+
+    def go(self):
+        with self._lock:
+            def drain():
+                return list(self._jobs)
+            threading.Thread(target=drain, daemon=True).start()
+            self._q.put(drain)
+""")
+    found = escape_check(fixture_repo(bad))
+    assert len(found) == 2
+    assert all("closure drain()" in f.message and "self._jobs" in f.message
+               for f in found)
+
+
+def test_escape_fires_on_partial_wrapped_lambda():
+    bad = ("greptimedb_tpu/concurrency/pw.py", """
+import functools
+import threading
+
+class C:
+    def __init__(self, pool):
+        self._lock = threading.Lock()
+        self._n = 0
+        self._pool = pool
+
+    def kick(self):
+        with self._lock:
+            self._pool.submit(functools.partial(
+                (lambda k: self._n + k), 3))
+""")
+    found = escape_check(fixture_repo(bad))
+    assert len(found) == 1
+    assert "partial(lambda)" in found[0].message
+
+
+def test_escape_quiet_on_safe_idioms():
+    # the contract patterns stay quiet: a bound method (re-locks
+    # internally), a partial over a bound method, a snapshot evaluated
+    # under the lock and passed as a plain argument, and the same
+    # lambda submitted OUTSIDE the lock
+    ok = ("greptimedb_tpu/concurrency/okc.py", """
+import functools
+import threading
+
+def work(rows):
+    return len(rows)
+
+class C:
+    def __init__(self, pool):
+        self._lock = threading.Lock()
+        self._q = []
+        self._pool = pool
+
+    def _build(self, key):
+        with self._lock:
+            return self._q.count(key)
+
+    def kick(self, key):
+        with self._lock:
+            self._pool.submit(self._build, key)
+            self._pool.submit(functools.partial(self._build, key))
+            self._pool.submit(work, list(self._q))
+            snapshot = list(self._q)
+        self._pool.submit(lambda: self._q.pop())
+        return snapshot
+""")
+    assert escape_check(fixture_repo(ok)) == []
+
+
+def test_escape_repo_is_clean():
+    # the deferred-work planes (device-cache prefetch, scan pool,
+    # maintenance scheduler, encode pool) all hand over bound methods —
+    # no closure over guarded state escapes a lock anywhere in scope
+    assert escape_check(load_repo(REPO_ROOT)) == []
 
 
 # ---- deadcode ---------------------------------------------------------------

@@ -209,19 +209,21 @@ class TestRoutingAndDegradation:
         assert len(got) == 12
         assert got == _off_oracle(qe, sql, monkeypatch)
 
-    def test_sparse_cardinality_degrades_to_device(self, mesh_db,
-                                                   monkeypatch):
-        """Beyond the dense budget the sort-compact path serves
-        (single-device): typed degradation, effective tier reported."""
+    def test_sparse_cardinality_shards_over_mesh(self, mesh_db,
+                                                 monkeypatch):
+        """Beyond the dense budget the sort-compact path no longer
+        demotes to a single device: each shard compacts its own rows
+        and the partials combine in gid space, bit-for-bit with the
+        single-device sparse result."""
         monkeypatch.setenv("GREPTIMEDB_TPU_DENSE_GROUPS_MAX", "4")
         qe = mesh_db
         _fill(qe, files=1, tail=False)
-        got = qe.execute_one(
-            "SELECT host, sum(v) FROM m GROUP BY host "
-            "ORDER BY host").rows()
+        sql = "SELECT host, sum(v) FROM m GROUP BY host ORDER BY host"
+        got = qe.execute_one(sql).rows()
         assert len(got) == 12
-        assert qe.executor.last_path == "sparse"
-        assert qe.executor.last_tier == "device"
+        assert qe.executor.last_path == "sparse_sharded"
+        assert qe.executor.last_tier == "mesh"
+        assert got == _off_oracle(qe, sql, monkeypatch)
 
     def test_small_scan_stays_single_device(self, mesh_db, monkeypatch):
         monkeypatch.setenv("GREPTIMEDB_TPU_MESH_MIN_ROWS", "1000000")

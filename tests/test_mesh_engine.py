@@ -85,8 +85,13 @@ def test_partitioned_regions_dict_remap_on_mesh(mesh_db, monkeypatch):
 
 def test_sparse_cardinality_with_mesh_present(mesh_db, monkeypatch):
     """Cardinality beyond the dense budget: the sparse sort-compact path
-    must take over (mesh or not) and stay correct."""
+    takes over AND rides the mesh (per-shard compaction, gid-space
+    combine) instead of demoting to a single device — and stays
+    correct."""
     monkeypatch.setenv("GREPTIMEDB_TPU_DENSE_GROUPS_MAX", "64")
+    # pin the shard_map machinery: the partial-aggregate cache would
+    # otherwise serve this append-mode shape via incremental_sparse
+    monkeypatch.setenv("GREPTIMEDB_TPU_PARTIAL_CACHE", "off")
     qe = mesh_db
     qe.execute_one(
         "CREATE TABLE hc (tag STRING, v DOUBLE, ts TIMESTAMP(3) NOT NULL, "
@@ -105,7 +110,8 @@ def test_sparse_cardinality_with_mesh_present(mesh_db, monkeypatch):
     qe.region_engine.flush(info.region_ids[0])
     got = qe.execute_one(
         "SELECT tag, sum(v) FROM hc GROUP BY tag ORDER BY tag").rows()
-    assert qe.executor.last_path == "sparse"
+    assert qe.executor.last_path == "sparse_sharded"
+    assert qe.executor.last_tier == "mesh"
     assert len(got) == combos
     expect = np.zeros(combos)
     np.add.at(expect, codes, v)

@@ -416,7 +416,8 @@ class TestDeviceHedge:
         monkeypatch.setattr(ph.jax, "default_backend", lambda: "tpu")
         monkeypatch.setattr(ex, "mesh", None)
         monkeypatch.setattr(
-            ex, "tier_for", lambda agg, n, streaming=False: "device")
+            ex, "tier_for",
+            lambda agg, n, streaming=False, scan=None: "device")
         res = qe.execute_one(AGG_SQL, CTX)
         assert qe.executor.last_path == "incremental"
         assert qe.executor.last_tier == "host"  # hedged: no compile stall
